@@ -1,0 +1,153 @@
+"""Crypto core: key interfaces, hashing, and the batch-verification seam.
+
+Reference parity: crypto/crypto.go:16-33 defines `PubKey{Address, Bytes,
+VerifyBytes, Equals}` / `PrivKey{Bytes, Sign, PubKey, Equals}` and tmhash
+(SHA256 with a 20-byte truncated form). That one-signature-at-a-time
+interface is the exact seam the TPU backend replaces: this package adds a
+first-class `BatchVerifier` (crypto/batch.py) with pluggable backends, which
+the reference does not have anywhere.
+
+Concrete keys: ed25519 (crypto/ed25519.py), secp256k1 (crypto/secp256k1.py),
+k-of-n threshold multisig (crypto/multisig.py).
+"""
+from __future__ import annotations
+
+import hashlib
+from abc import ABC, abstractmethod
+
+ADDRESS_SIZE = 20  # tmhash truncated size (reference crypto/crypto.go:16-20)
+HASH_SIZE = 32
+
+
+def sum_sha256(b: bytes) -> bytes:
+    """tmhash.Sum — full 32-byte SHA256 (reference crypto/hash.go)."""
+    return hashlib.sha256(b).digest()
+
+
+def sum_truncated(b: bytes) -> bytes:
+    """tmhash.SumTruncated — first 20 bytes of SHA256."""
+    return hashlib.sha256(b).digest()[:ADDRESS_SIZE]
+
+
+class PubKey(ABC):
+    """Reference crypto/crypto.go:22-27."""
+
+    TYPE: str = ""
+
+    @abstractmethod
+    def address(self) -> bytes: ...
+
+    @abstractmethod
+    def bytes(self) -> bytes: ...
+
+    @abstractmethod
+    def verify(self, msg: bytes, sig: bytes) -> bool: ...
+
+    def __eq__(self, other: object) -> bool:
+        return (
+            isinstance(other, PubKey)
+            and self.TYPE == other.TYPE
+            and self.bytes() == other.bytes()
+        )
+
+    def __hash__(self) -> int:
+        return hash((self.TYPE, self.bytes()))
+
+    def __repr__(self) -> str:
+        return f"PubKey{{{self.TYPE}:{self.bytes().hex()[:16]}…}}"
+
+
+class PrivKey(ABC):
+    """Reference crypto/crypto.go:29-33."""
+
+    TYPE: str = ""
+
+    @abstractmethod
+    def bytes(self) -> bytes: ...
+
+    @abstractmethod
+    def sign(self, msg: bytes) -> bytes: ...
+
+    @abstractmethod
+    def pub_key(self) -> PubKey: ...
+
+    def __eq__(self, other: object) -> bool:
+        return (
+            isinstance(other, PrivKey)
+            and self.TYPE == other.TYPE
+            and self.bytes() == other.bytes()
+        )
+
+    def __hash__(self) -> int:
+        return hash((self.TYPE, self.bytes()))
+
+
+# --- pubkey type registry --------------------------------------------------
+# The reference registers concrete key types with amino names
+# ("tendermint/PubKeyEd25519", crypto/ed25519/ed25519.go:21-27). Here the
+# registry maps a 1-byte tag + type name to a decoder, used by CBE encoding.
+
+_PUBKEY_TYPES: dict[str, tuple[int, object]] = {}
+_PUBKEY_TAGS: dict[int, str] = {}
+
+
+def register_pubkey_type(type_name: str, tag: int, from_bytes) -> None:
+    if type_name in _PUBKEY_TYPES or tag in _PUBKEY_TAGS:
+        existing = _PUBKEY_TYPES.get(type_name)
+        if existing is not None and existing[0] == tag:
+            return  # idempotent re-registration
+        raise ValueError(f"pubkey type {type_name}/{tag} already registered")
+    _PUBKEY_TYPES[type_name] = (tag, from_bytes)
+    _PUBKEY_TAGS[tag] = type_name
+
+
+def encode_pubkey(pub: PubKey) -> bytes:
+    tag, _ = _PUBKEY_TYPES[pub.TYPE]
+    from tendermint_tpu.encoding import Writer
+
+    return Writer().u8(tag).bytes(pub.bytes()).build()
+
+
+def decode_pubkey(data: bytes) -> PubKey:
+    from tendermint_tpu.encoding import Reader
+
+    r = Reader(data)
+    pub = read_pubkey(r)
+    r.expect_done()
+    return pub
+
+
+def read_pubkey(r) -> PubKey:
+    tag = r.u8()
+    if tag not in _PUBKEY_TAGS:
+        from tendermint_tpu.encoding import DecodeError
+
+        raise DecodeError(f"unknown pubkey tag {tag}")
+    type_name = _PUBKEY_TAGS[tag]
+    _, from_bytes = _PUBKEY_TYPES[type_name]
+    return from_bytes(r.bytes())
+
+
+def pubkey_from_type_and_bytes(type_name: str, raw: bytes) -> PubKey:
+    _, from_bytes = _PUBKEY_TYPES[type_name]
+    return from_bytes(raw)
+
+
+# Register the standard key types on import.
+from tendermint_tpu.crypto import ed25519 as _ed  # noqa: E402
+from tendermint_tpu.crypto import secp256k1 as _secp  # noqa: E402
+from tendermint_tpu.crypto import multisig as _multisig  # noqa: E402,F401
+
+__all__ = [
+    "ADDRESS_SIZE",
+    "HASH_SIZE",
+    "PubKey",
+    "PrivKey",
+    "sum_sha256",
+    "sum_truncated",
+    "register_pubkey_type",
+    "encode_pubkey",
+    "decode_pubkey",
+    "read_pubkey",
+    "pubkey_from_type_and_bytes",
+]

@@ -1,0 +1,113 @@
+"""BatchVerifier — the first-class batch signature-verification seam.
+
+The reference has *no* batch-verify API anywhere: every hot loop calls
+`PubKey.VerifyBytes` one signature at a time under a mutex
+(types/vote_set.go:189, types/validator_set.go:609-627,
+state/validation.go:99,141, lite/dynamic_verifier.go). This type is the new
+framework's replacement seam: accumulation points (VoteSet, Commit verify,
+header-chain verify) add (pubkey, msg, sig) triples and flush them through a
+pluggable backend — the serial CPU path by default, the JAX/TPU kernel when
+registered (tendermint_tpu.ops registers itself on import; see
+tendermint_tpu/ops/__init__.py).
+
+Multisig keys are *exploded* into their sub-key triples so mixed
+ed25519+secp256k1+multisig batches still verify in as few device launches as
+possible (BASELINE.json config #5).
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+from tendermint_tpu.crypto import PubKey
+from tendermint_tpu.crypto.multisig import PubKeyMultisigThreshold
+
+# A backend verifies a homogeneous batch of primitive signatures:
+#   fn(pubs: list[bytes], msgs: list[bytes], sigs: list[bytes]) -> list[bool]
+Backend = Callable[[Sequence[bytes], Sequence[bytes], Sequence[bytes]], Sequence[bool]]
+
+_BACKENDS: dict[str, Backend] = {}
+
+
+def register_backend(key_type: str, fn: Backend) -> None:
+    _BACKENDS[key_type] = fn
+
+
+def get_backend(key_type: str) -> Backend | None:
+    return _BACKENDS.get(key_type)
+
+
+def clear_backend(key_type: str) -> None:
+    _BACKENDS.pop(key_type, None)
+
+
+class BatchVerifier:
+    """Accumulate signatures, verify them all in grouped batches.
+
+    Usage:
+        bv = BatchVerifier()
+        for ...: bv.add(pub, msg, sig)
+        ok = bv.verify_all()      # list[bool], one per add() call
+    """
+
+    def __init__(self) -> None:
+        # item = one add() call; job = one primitive signature check
+        self._n_items = 0
+        self._invalid_items: set[int] = set()
+        # key_type -> (item_idx list, pub PubKey list, msg list, sig list)
+        self._groups: dict[str, tuple[list, list, list, list]] = {}
+
+    def __len__(self) -> int:
+        return self._n_items
+
+    def add(self, pub: PubKey, msg: bytes, sig: bytes) -> int:
+        """Queue one signature check; returns its item index."""
+        idx = self._n_items
+        self._n_items += 1
+        if isinstance(pub, PubKeyMultisigThreshold):
+            triples = pub.explode(msg, sig)
+            if triples is None:
+                self._invalid_items.add(idx)
+                return idx
+            for sub_pub, sub_msg, sub_sig in triples:
+                self._enqueue(idx, sub_pub, sub_msg, sub_sig)
+        else:
+            self._enqueue(idx, pub, msg, sig)
+        return idx
+
+    def _enqueue(self, item: int, pub: PubKey, msg: bytes, sig: bytes) -> None:
+        g = self._groups.setdefault(pub.TYPE, ([], [], [], []))
+        g[0].append(item)
+        g[1].append(pub)
+        g[2].append(msg)
+        g[3].append(sig)
+
+    def verify_all(self) -> list[bool]:
+        ok = [True] * self._n_items
+        for idx in self._invalid_items:
+            ok[idx] = False
+        for key_type, (items, pubs, msgs, sigs) in self._groups.items():
+            backend = _BACKENDS.get(key_type)
+            if backend is not None:
+                results = backend([p.bytes() for p in pubs], msgs, sigs)
+            else:
+                results = [p.verify(m, s) for p, m, s in zip(pubs, msgs, sigs)]
+            for item, res in zip(items, results):
+                if not res:
+                    ok[item] = False
+        self._reset()
+        return ok
+
+    def _reset(self) -> None:
+        self._n_items = 0
+        self._invalid_items = set()
+        self._groups = {}
+
+
+def verify_batch(
+    triples: Sequence[tuple[PubKey, bytes, bytes]]
+) -> list[bool]:
+    """One-shot convenience wrapper."""
+    bv = BatchVerifier()
+    for pub, msg, sig in triples:
+        bv.add(pub, msg, sig)
+    return bv.verify_all()

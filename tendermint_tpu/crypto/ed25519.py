@@ -1,0 +1,91 @@
+"""Ed25519 keys.
+
+Reference parity: crypto/ed25519/ed25519.go — `PrivKeyEd25519 [64]byte`
+(seed || pubkey), `PubKeyEd25519 [32]byte`, address = first 20 bytes of
+SHA256(pubkey) (ed25519.go:138), Sign/Verify delegate to a vetted library
+(there: golang.org/x/crypto/ed25519; here: the `cryptography` package's
+OpenSSL-backed implementation for the serial path). The batched path is the
+TPU kernel in tendermint_tpu/ops, selected via crypto/batch.py.
+"""
+from __future__ import annotations
+
+import os
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives import serialization
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+    Ed25519PublicKey,
+)
+
+from tendermint_tpu import crypto as _crypto
+from tendermint_tpu.crypto import PrivKey, PubKey, sum_truncated
+
+TYPE = "ed25519"
+PUBKEY_SIZE = 32
+PRIVKEY_SIZE = 64  # seed || pubkey, like the reference
+SIGNATURE_SIZE = 64
+_TAG = 1
+
+
+class PubKeyEd25519(PubKey):
+    TYPE = TYPE
+
+    __slots__ = ("_raw",)
+
+    def __init__(self, raw: bytes) -> None:
+        if len(raw) != PUBKEY_SIZE:
+            raise ValueError(f"ed25519 pubkey must be {PUBKEY_SIZE} bytes")
+        self._raw = bytes(raw)
+
+    def address(self) -> bytes:
+        return sum_truncated(self._raw)
+
+    def bytes(self) -> bytes:
+        return self._raw
+
+    def verify(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != SIGNATURE_SIZE:
+            return False
+        try:
+            Ed25519PublicKey.from_public_bytes(self._raw).verify(sig, msg)
+            return True
+        except (InvalidSignature, ValueError):
+            return False
+
+
+class PrivKeyEd25519(PrivKey):
+    TYPE = TYPE
+
+    __slots__ = ("_raw", "_sk")
+
+    def __init__(self, raw: bytes) -> None:
+        if len(raw) != PRIVKEY_SIZE:
+            raise ValueError(f"ed25519 privkey must be {PRIVKEY_SIZE} bytes")
+        self._raw = bytes(raw)
+        self._sk = Ed25519PrivateKey.from_private_bytes(self._raw[:32])
+
+    def bytes(self) -> bytes:
+        return self._raw
+
+    def sign(self, msg: bytes) -> bytes:
+        return self._sk.sign(msg)
+
+    def pub_key(self) -> PubKeyEd25519:
+        return PubKeyEd25519(self._raw[32:])
+
+
+def gen_priv_key(seed: bytes | None = None) -> PrivKeyEd25519:
+    """Reference crypto/ed25519/ed25519.go GenPrivKey (+FromSecret)."""
+    if seed is None:
+        seed = os.urandom(32)
+    elif len(seed) != 32:
+        seed = _crypto.sum_sha256(seed)
+    sk = Ed25519PrivateKey.from_private_bytes(seed)
+    pub = sk.public_key().public_bytes(
+        serialization.Encoding.Raw, serialization.PublicFormat.Raw
+    )
+    return PrivKeyEd25519(seed + pub)
+
+
+_crypto.register_pubkey_type(TYPE, _TAG, PubKeyEd25519)

@@ -1,0 +1,145 @@
+"""Pure-integer edwards25519 curve math (host side).
+
+Used for: pubkey decompression + extended-coordinate caching when building
+device batches (ValidatorSet caches decompressed keys), host-side scalar
+reduction, and as an independent oracle in tests. The batched hot path lives
+in tendermint_tpu/ops (JAX limb arithmetic); signing and one-off verification
+go through the `cryptography` library (crypto/ed25519.py).
+
+Curve: -x^2 + y^2 = 1 + d x^2 y^2 over GF(2^255-19), per RFC 8032 §5.1.
+"""
+from __future__ import annotations
+
+P = 2**255 - 19
+L = 2**252 + 27742317777372353535851937790883648493
+D = (-121665 * pow(121666, P - 2, P)) % P
+SQRT_M1 = pow(2, (P - 1) // 4, P)  # sqrt(-1)
+
+# Base point (RFC 8032 §5.1): y = 4/5, x recovered with even... x is the
+# point with positive (even) x? RFC defines B_x explicitly:
+BASE_Y = (4 * pow(5, P - 2, P)) % P
+
+
+def _recover_x(y: int, sign: int) -> int | None:
+    """x from y and sign bit; None if y is not on the curve (RFC 8032 §5.1.3)."""
+    if y >= P:
+        return None
+    x2 = (y * y - 1) * pow(D * y * y + 1, P - 2, P) % P
+    if x2 == 0:
+        if sign:
+            return None
+        return 0
+    x = pow(x2, (P + 3) // 8, P)
+    if (x * x - x2) % P != 0:
+        x = x * SQRT_M1 % P
+    if (x * x - x2) % P != 0:
+        return None
+    if x & 1 != sign:
+        x = P - x
+    return x
+
+
+BASE_X = _recover_x(BASE_Y, 0)
+assert BASE_X is not None
+
+# Extended homogeneous coordinates (X, Y, Z, T) with x=X/Z, y=Y/Z, T=XY/Z.
+IDENTITY = (0, 1, 1, 0)
+BASE = (BASE_X, BASE_Y, 1, BASE_X * BASE_Y % P)
+
+
+def point_add(p1, p2):
+    """Complete twisted-Edwards addition (RFC 8032 §5.1.4)."""
+    x1, y1, z1, t1 = p1
+    x2, y2, z2, t2 = p2
+    a = (y1 - x1) * (y2 - x2) % P
+    b = (y1 + x1) * (y2 + x2) % P
+    c = 2 * t1 * t2 * D % P
+    d = 2 * z1 * z2 % P
+    e, f, g, h = b - a, d - c, d + c, b + a
+    return (e * f % P, g * h % P, f * g % P, e * h % P)
+
+
+def point_double(p1):
+    """Dedicated doubling (RFC 8032 §5.1.4)."""
+    x1, y1, z1, _ = p1
+    a = x1 * x1 % P
+    b = y1 * y1 % P
+    c = 2 * z1 * z1 % P
+    h = a + b
+    e = h - (x1 + y1) * (x1 + y1)
+    g = a - b
+    f = c + g
+    return (e * f % P, g * h % P, f * g % P, e * h % P)
+
+
+def point_neg(p1):
+    x, y, z, t = p1
+    return (P - x if x else 0, y, z, P - t if t else 0)
+
+
+def scalar_mult(s: int, p1):
+    q = IDENTITY
+    while s > 0:
+        if s & 1:
+            q = point_add(q, p1)
+        p1 = point_double(p1)
+        s >>= 1
+    return q
+
+
+def point_equal(p1, p2) -> bool:
+    x1, y1, z1, _ = p1
+    x2, y2, z2, _ = p2
+    return (x1 * z2 - x2 * z1) % P == 0 and (y1 * z2 - y2 * z1) % P == 0
+
+
+def to_affine(p1):
+    x, y, z, _ = p1
+    zi = pow(z, P - 2, P)
+    return (x * zi % P, y * zi % P)
+
+
+def compress(p1) -> bytes:
+    x, y = to_affine(p1)
+    return ((y | ((x & 1) << 255)).to_bytes(32, "little"))
+
+
+def decompress(data: bytes):
+    """Compressed 32-byte point -> extended coords, or None if invalid."""
+    if len(data) != 32:
+        return None
+    n = int.from_bytes(data, "little")
+    sign = n >> 255
+    y = n & ((1 << 255) - 1)
+    x = _recover_x(y, sign)
+    if x is None:
+        return None
+    return (x, y, 1, x * y % P)
+
+
+def reduce_scalar(b: bytes) -> int:
+    return int.from_bytes(b, "little") % L
+
+
+def verify_scalar_range(s_bytes: bytes) -> bool:
+    """RFC 8032 §5.1.7: reject S >= L (malleability)."""
+    return int.from_bytes(s_bytes, "little") < L
+
+
+def verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
+    """Reference single verify, used as test oracle (RFC 8032 §5.1.7)."""
+    import hashlib
+
+    if len(sig) != 64:
+        return False
+    a = decompress(pub)
+    if a is None:
+        return False
+    r_bytes, s_bytes = sig[:32], sig[32:]
+    if not verify_scalar_range(s_bytes):
+        return False
+    s = int.from_bytes(s_bytes, "little")
+    h = reduce_scalar(hashlib.sha512(r_bytes + pub + msg).digest())
+    # [S]B - [h]A == R  <=>  encode([S]B + [h](-A)) == r_bytes
+    rp = point_add(scalar_mult(s, BASE), scalar_mult(h, point_neg(a)))
+    return compress(rp) == r_bytes

@@ -1,0 +1,284 @@
+"""Merkle trees and proofs.
+
+Reference parity: crypto/merkle/simple_tree.go (simple merkle root over byte
+slices), simple_proof.go (`SimpleProof` with aunts), simple_map.go (sorted
+KV-pair map hashing for the block header), proof.go (chained
+`ProofOperator`/`ProofRuntime` for light-client ABCI query proofs).
+
+This implementation uses RFC-6962 domain separation (0x00 leaf prefix, 0x01
+inner prefix) with the largest-power-of-two-less-than split, which hardens
+against proof-type confusion; byte compatibility with the reference is not a
+goal (different codebase, documented encoding).
+"""
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+_LEAF_PREFIX = b"\x00"
+_INNER_PREFIX = b"\x01"
+
+
+def _hash(b: bytes) -> bytes:
+    return hashlib.sha256(b).digest()
+
+
+def leaf_hash(leaf: bytes) -> bytes:
+    return _hash(_LEAF_PREFIX + leaf)
+
+
+def inner_hash(left: bytes, right: bytes) -> bytes:
+    return _hash(_INNER_PREFIX + left + right)
+
+
+def _split_point(n: int) -> int:
+    """Largest power of two strictly less than n."""
+    k = 1
+    while k * 2 < n:
+        k *= 2
+    return k
+
+
+def hash_from_byte_slices(items: list[bytes]) -> bytes:
+    """Reference merkle.SimpleHashFromByteSlices (simple_tree.go)."""
+    n = len(items)
+    if n == 0:
+        return _hash(b"")
+    if n == 1:
+        return leaf_hash(items[0])
+    k = _split_point(n)
+    return inner_hash(
+        hash_from_byte_slices(items[:k]), hash_from_byte_slices(items[k:])
+    )
+
+
+@dataclass
+class SimpleProof:
+    """Reference merkle.SimpleProof (simple_proof.go)."""
+
+    total: int
+    index: int
+    leaf_hash: bytes
+    aunts: list[bytes] = field(default_factory=list)
+
+    def verify(self, root_hash: bytes, leaf: bytes) -> bool:
+        if self.total <= 0 or not (0 <= self.index < self.total):
+            return False
+        if leaf_hash(leaf) != self.leaf_hash:
+            return False
+        computed = _root_from_aunts(self.index, self.total, self.leaf_hash, self.aunts)
+        return computed == root_hash
+
+    def encode(self) -> bytes:
+        from tendermint_tpu.encoding import Writer
+
+        w = Writer().u32(self.total).u32(self.index).bytes(self.leaf_hash)
+        w.u32(len(self.aunts))
+        for a in self.aunts:
+            w.bytes(a)
+        return w.build()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "SimpleProof":
+        from tendermint_tpu.encoding import Reader
+
+        r = Reader(data)
+        p = cls.read(r)
+        r.expect_done()
+        return p
+
+    @classmethod
+    def read(cls, r) -> "SimpleProof":
+        total, index, lh = r.u32(), r.u32(), r.bytes()
+        aunts = [r.bytes() for _ in range(r.u32())]
+        return cls(total, index, lh, aunts)
+
+
+def _root_from_aunts(
+    index: int, total: int, leaf: bytes, aunts: list[bytes]
+) -> bytes | None:
+    if total == 1:
+        return leaf if not aunts else None
+    if not aunts:
+        return None
+    k = _split_point(total)
+    if index < k:
+        left = _root_from_aunts(index, k, leaf, aunts[:-1])
+        return None if left is None else inner_hash(left, aunts[-1])
+    right = _root_from_aunts(index - k, total - k, leaf, aunts[:-1])
+    return None if right is None else inner_hash(aunts[-1], right)
+
+
+def proofs_from_byte_slices(
+    items: list[bytes],
+) -> tuple[bytes, list[SimpleProof]]:
+    """Root hash + one SimpleProof per item (simple_proof.go SimpleProofsFromByteSlices)."""
+    trails, root = _trails_from_byte_slices(items)
+    root_hash = root.hash
+    proofs = []
+    for i, trail in enumerate(trails):
+        proofs.append(
+            SimpleProof(len(items), i, trail.hash, trail.flatten_aunts())
+        )
+    return root_hash, proofs
+
+
+class _Node:
+    __slots__ = ("hash", "parent", "left", "right")
+
+    def __init__(self, h: bytes) -> None:
+        self.hash = h
+        self.parent = None
+        self.left = None  # sibling pointers, as in the reference trail nodes
+        self.right = None
+
+    def flatten_aunts(self) -> list[bytes]:
+        aunts = []
+        node = self
+        while node is not None:
+            if node.left is not None:
+                aunts.append(node.left.hash)
+            elif node.right is not None:
+                aunts.append(node.right.hash)
+            node = node.parent
+        return aunts
+
+
+def _trails_from_byte_slices(items: list[bytes]):
+    n = len(items)
+    if n == 0:
+        return [], _Node(_hash(b""))
+    if n == 1:
+        node = _Node(leaf_hash(items[0]))
+        return [node], node
+    k = _split_point(n)
+    lefts, left_root = _trails_from_byte_slices(items[:k])
+    rights, right_root = _trails_from_byte_slices(items[k:])
+    root = _Node(inner_hash(left_root.hash, right_root.hash))
+    left_root.parent, left_root.right = root, right_root
+    right_root.parent, right_root.left = root, left_root
+    return lefts + rights, root
+
+
+# --- simple map (sorted KV hashing, reference simple_map.go) ---------------
+
+
+def hash_from_map(kvs: dict[str, bytes]) -> bytes:
+    """Deterministic hash of string->bytes map: sort keys, hash encoded pairs."""
+    from tendermint_tpu.encoding import Writer
+
+    items = []
+    for k in sorted(kvs):
+        items.append(Writer().str(k).bytes(kvs[k]).build())
+    return hash_from_byte_slices(items)
+
+
+# --- chained proofs (reference proof.go ProofOperator/ProofRuntime) --------
+
+
+@dataclass
+class ProofOp:
+    """One verification step; mirrors merkle.ProofOp (proof.go:22)."""
+
+    type: str
+    key: bytes
+    data: bytes
+
+
+class ProofOperator:
+    def run(self, values: list[bytes]) -> list[bytes]:
+        raise NotImplementedError
+
+    def get_key(self) -> bytes:
+        raise NotImplementedError
+
+    def proof_op(self) -> ProofOp:
+        raise NotImplementedError
+
+
+class SimpleValueOp(ProofOperator):
+    """Leaf-value op: proves value at key in a simple merkle tree
+    (reference crypto/merkle/proof_simple_value.go)."""
+
+    TYPE = "simple:v"
+
+    def __init__(self, key: bytes, proof: SimpleProof) -> None:
+        self.key = key
+        self.proof = proof
+
+    def get_key(self) -> bytes:
+        return self.key
+
+    def run(self, values: list[bytes]) -> list[bytes]:
+        if len(values) != 1:
+            raise ValueError("SimpleValueOp expects one value")
+        vhash = hashlib.sha256(values[0]).digest()
+        from tendermint_tpu.encoding import Writer
+
+        kv = Writer().str(self.key.decode("utf-8", "surrogateescape")).bytes(vhash).build()
+        if leaf_hash(kv) != self.proof.leaf_hash:
+            raise ValueError("leaf hash mismatch")
+        root = _root_from_aunts(
+            self.proof.index, self.proof.total, self.proof.leaf_hash, self.proof.aunts
+        )
+        if root is None:
+            raise ValueError("bad aunts")
+        return [root]
+
+    def proof_op(self) -> ProofOp:
+        from tendermint_tpu.encoding import Writer
+
+        return ProofOp(self.TYPE, self.key, Writer().raw(self.proof.encode()).build())
+
+    @classmethod
+    def decode(cls, op: ProofOp) -> "SimpleValueOp":
+        return cls(op.key, SimpleProof.decode(op.data))
+
+
+class ProofRuntime:
+    """Registry of op decoders + chained verification (reference proof.go:75)."""
+
+    def __init__(self) -> None:
+        self._decoders: dict[str, object] = {}
+
+    def register_op_decoder(self, type_name: str, decoder) -> None:
+        self._decoders[type_name] = decoder
+
+    def decode_proof(self, ops: list[ProofOp]) -> list[ProofOperator]:
+        out = []
+        for op in ops:
+            if op.type not in self._decoders:
+                raise ValueError(f"unknown proof op type {op.type!r}")
+            out.append(self._decoders[op.type](op))
+        return out
+
+    def verify_value(
+        self, ops: list[ProofOp], root: bytes, keypath: list[bytes], value: bytes
+    ) -> bool:
+        return self._verify(ops, root, keypath, [value])
+
+    def verify_absence(self, ops: list[ProofOp], root: bytes, keypath: list[bytes]) -> bool:
+        return self._verify(ops, root, keypath, [])
+
+    def _verify(
+        self, ops: list[ProofOp], root: bytes, keypath: list[bytes], args: list[bytes]
+    ) -> bool:
+        try:
+            operators = self.decode_proof(ops)
+            keys = list(keypath)
+            for op in operators:
+                key = op.get_key()
+                if key:
+                    if not keys or keys[-1] != key:
+                        return False
+                    keys.pop()
+                args = op.run(args)
+            return bool(args) and args[0] == root and not keys
+        except Exception:
+            return False
+
+
+def default_proof_runtime() -> ProofRuntime:
+    rt = ProofRuntime()
+    rt.register_op_decoder(SimpleValueOp.TYPE, SimpleValueOp.decode)
+    return rt

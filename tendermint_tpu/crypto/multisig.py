@@ -1,0 +1,175 @@
+"""K-of-N threshold multisig pubkeys.
+
+Reference parity: crypto/multisig/threshold_pubkey.go (PubKeyMultisigThreshold
+whose VerifyBytes iterates sub-keys against a compact bit array,
+threshold_pubkey.go:33), multisignature.go (Multisignature accumulator), and
+bitarray/ (CompactBitArray).
+
+Batch-friendliness: `explode` flattens a multisig verification into its
+(sub-pubkey, msg, sub-sig) triples so the TPU batch verifier can fold
+multisig checks into the same device batch as plain votes (BASELINE.json
+config #5: mixed-key 10k-validator streaming AddVote).
+"""
+from __future__ import annotations
+
+from tendermint_tpu import crypto as _crypto
+from tendermint_tpu.crypto import PrivKey, PubKey, sum_truncated
+from tendermint_tpu.encoding import Reader, Writer
+
+TYPE = "multisig-threshold"
+_TAG = 3
+
+
+class CompactBitArray:
+    """Reference crypto/multisig/bitarray/compact_bit_array.go."""
+
+    __slots__ = ("size", "_elems")
+
+    def __init__(self, size: int) -> None:
+        if size < 0:
+            raise ValueError("negative size")
+        self.size = size
+        self._elems = bytearray((size + 7) // 8)
+
+    def get(self, i: int) -> bool:
+        if not (0 <= i < self.size):
+            return False
+        return bool(self._elems[i >> 3] & (1 << (7 - (i & 7))))
+
+    def set(self, i: int, v: bool) -> bool:
+        if not (0 <= i < self.size):
+            return False
+        if v:
+            self._elems[i >> 3] |= 1 << (7 - (i & 7))
+        else:
+            self._elems[i >> 3] &= ~(1 << (7 - (i & 7)))
+        return True
+
+    def num_true_before(self, i: int) -> int:
+        return sum(1 for j in range(i) if self.get(j))
+
+    def count(self) -> int:
+        return self.num_true_before(self.size)
+
+    def encode(self) -> bytes:
+        return Writer().u32(self.size).bytes(bytes(self._elems)).build()
+
+    @classmethod
+    def read(cls, r: Reader) -> "CompactBitArray":
+        size = r.u32()
+        elems = r.bytes()
+        ba = cls(size)
+        if len(elems) != len(ba._elems):
+            from tendermint_tpu.encoding import DecodeError
+
+            raise DecodeError("bitarray length mismatch")
+        ba._elems = bytearray(elems)
+        return ba
+
+
+class Multisignature:
+    """Signature accumulator (reference multisignature.go:13)."""
+
+    def __init__(self, n: int) -> None:
+        self.bitarray = CompactBitArray(n)
+        self.sigs: list[bytes] = []
+
+    def add_signature_from_pubkey(
+        self, sig: bytes, pub: PubKey, keys: list[PubKey]
+    ) -> None:
+        try:
+            index = keys.index(pub)
+        except ValueError:
+            raise ValueError("pubkey not in multisig key list")
+        new_sig_index = self.bitarray.num_true_before(index)
+        if self.bitarray.get(index):
+            self.sigs[new_sig_index] = sig
+        else:
+            self.bitarray.set(index, True)
+            self.sigs.insert(new_sig_index, sig)
+
+    def encode(self) -> bytes:
+        w = Writer().raw(self.bitarray.encode()).u32(len(self.sigs))
+        for s in self.sigs:
+            w.bytes(s)
+        return w.build()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Multisignature":
+        r = Reader(data)
+        ba = CompactBitArray.read(r)
+        nsigs = r.u32()
+        sigs = [r.bytes() for _ in range(nsigs)]
+        r.expect_done()
+        ms = cls(ba.size)
+        ms.bitarray = ba
+        ms.sigs = sigs
+        return ms
+
+
+class PubKeyMultisigThreshold(PubKey):
+    """Reference threshold_pubkey.go:8."""
+
+    TYPE = TYPE
+
+    __slots__ = ("k", "pubkeys")
+
+    def __init__(self, k: int, pubkeys: list[PubKey]) -> None:
+        if k <= 0:
+            raise ValueError("threshold k must be positive")
+        if len(pubkeys) < k:
+            raise ValueError("fewer pubkeys than threshold")
+        self.k = k
+        self.pubkeys = list(pubkeys)
+
+    def bytes(self) -> bytes:
+        w = Writer().u32(self.k).u32(len(self.pubkeys))
+        for pk in self.pubkeys:
+            w.bytes(_crypto.encode_pubkey(pk))
+        return w.build()
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "PubKeyMultisigThreshold":
+        r = Reader(raw)
+        k = r.u32()
+        n = r.u32()
+        keys = [_crypto.decode_pubkey(r.bytes()) for _ in range(n)]
+        r.expect_done()
+        return cls(k, keys)
+
+    def address(self) -> bytes:
+        return sum_truncated(self.bytes())
+
+    def explode(
+        self, msg: bytes, sig: bytes
+    ) -> list[tuple[PubKey, bytes, bytes]] | None:
+        """Flatten into sub-key (pub, msg, sig) triples, or None if the
+        signature is structurally invalid / below threshold."""
+        try:
+            ms = Multisignature.decode(sig)
+        except Exception:
+            return None
+        if ms.bitarray.size != len(self.pubkeys):
+            return None
+        if len(ms.sigs) < self.k:
+            return None
+        triples = []
+        si = 0
+        for i, pk in enumerate(self.pubkeys):
+            if ms.bitarray.get(i):
+                if si >= len(ms.sigs):
+                    return None
+                triples.append((pk, msg, ms.sigs[si]))
+                si += 1
+        if si != len(ms.sigs):
+            return None
+        return triples
+
+    def verify(self, msg: bytes, sig: bytes) -> bool:
+        triples = self.explode(msg, sig)
+        if triples is None:
+            return False
+        return all(pk.verify(m, s) for pk, m, s in triples)
+
+
+_crypto.register_pubkey_type(TYPE, _TAG, PubKeyMultisigThreshold.from_bytes)

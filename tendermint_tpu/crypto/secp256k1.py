@@ -1,0 +1,118 @@
+"""secp256k1 ECDSA keys.
+
+Reference parity: crypto/secp256k1/secp256k1.go — 32-byte privkey, 33-byte
+compressed pubkey, address = RIPEMD160(SHA256(pubkey)). The reference has a
+dual build: pure-Go btcec (secp256k1_nocgo.go:21-50, rejects high-S
+malleable signatures) vs cgo libsecp256k1 (secp256k1_cgo.go). Here the
+serial path delegates to the `cryptography` package (OpenSSL native code —
+the analog of the cgo path); signatures are 64-byte compact r||s with the
+same low-S rule enforced on both sign and verify.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    Prehashed,
+    decode_dss_signature,
+    encode_dss_signature,
+)
+
+from tendermint_tpu import crypto as _crypto
+from tendermint_tpu.crypto import PrivKey, PubKey
+
+TYPE = "secp256k1"
+PUBKEY_SIZE = 33  # compressed
+PRIVKEY_SIZE = 32
+SIGNATURE_SIZE = 64  # compact r||s
+_TAG = 2
+
+# Curve order (for the low-S malleability rule, reference secp256k1_nocgo.go:40-50)
+N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+HALF_N = N // 2
+
+
+def _address(pub_bytes: bytes) -> bytes:
+    h = hashlib.sha256(pub_bytes).digest()
+    r = hashlib.new("ripemd160")
+    r.update(h)
+    return r.digest()
+
+
+class PubKeySecp256k1(PubKey):
+    TYPE = TYPE
+
+    __slots__ = ("_raw",)
+
+    def __init__(self, raw: bytes) -> None:
+        if len(raw) != PUBKEY_SIZE:
+            raise ValueError(f"secp256k1 pubkey must be {PUBKEY_SIZE} bytes")
+        self._raw = bytes(raw)
+
+    def address(self) -> bytes:
+        return _address(self._raw)
+
+    def bytes(self) -> bytes:
+        return self._raw
+
+    def verify(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != SIGNATURE_SIZE:
+            return False
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        if not (0 < r < N and 0 < s <= HALF_N):  # reject malleable high-S
+            return False
+        try:
+            pk = ec.EllipticCurvePublicKey.from_encoded_point(
+                ec.SECP256K1(), self._raw
+            )
+            pk.verify(encode_dss_signature(r, s), msg, ec.ECDSA(hashes.SHA256()))
+            return True
+        except (InvalidSignature, ValueError):
+            return False
+
+
+class PrivKeySecp256k1(PrivKey):
+    TYPE = TYPE
+
+    __slots__ = ("_raw", "_sk")
+
+    def __init__(self, raw: bytes) -> None:
+        if len(raw) != PRIVKEY_SIZE:
+            raise ValueError(f"secp256k1 privkey must be {PRIVKEY_SIZE} bytes")
+        self._raw = bytes(raw)
+        self._sk = ec.derive_private_key(
+            int.from_bytes(raw, "big"), ec.SECP256K1()
+        )
+
+    def bytes(self) -> bytes:
+        return self._raw
+
+    def sign(self, msg: bytes) -> bytes:
+        der = self._sk.sign(msg, ec.ECDSA(hashes.SHA256()))
+        r, s = decode_dss_signature(der)
+        if s > HALF_N:  # normalize to low-S (reference secp256k1_nocgo.go:30-38)
+            s = N - s
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+    def pub_key(self) -> PubKeySecp256k1:
+        raw = self._sk.public_key().public_bytes(
+            serialization.Encoding.X962, serialization.PublicFormat.CompressedPoint
+        )
+        return PubKeySecp256k1(raw)
+
+
+def gen_priv_key(seed: bytes | None = None) -> PrivKeySecp256k1:
+    while True:
+        raw = hashlib.sha256(seed).digest() if seed is not None else os.urandom(32)
+        d = int.from_bytes(raw, "big")
+        if 0 < d < N:
+            return PrivKeySecp256k1(raw)
+        seed = raw  # re-hash until in range (reference GenPrivKey loop)
+
+
+_crypto.register_pubkey_type(TYPE, _TAG, PubKeySecp256k1)

@@ -1,0 +1,129 @@
+"""Canonical deterministic binary encoding (CBE).
+
+The reference encodes all wire/disk/sign-bytes with go-amino (registered
+concrete types, proto3-compatible wire format); determinism of sign-bytes is
+consensus-critical (reference: types/canonical.go, types/codec.go). Rather
+than imitate amino's quirks, this framework defines a small, documented,
+deterministic encoding:
+
+- fixed-width big-endian integers (u8/u16/u32/u64, i64 two's complement)
+- length-prefixed byte strings (u32 length + raw bytes)
+- structs are the concatenation of their fields in a fixed, documented order
+- unions (message types) are a 1-byte tag followed by the payload
+
+Big-endian fixed-width was chosen over varints because it is branch-free to
+produce in bulk on the host when forming device batches of sign-bytes, and
+trivially canonical (one byte representation per value).
+
+Encoding is intentionally *not* self-describing: every message type owns its
+encode/decode pair. `Writer`/`Reader` are the only primitives.
+"""
+from __future__ import annotations
+
+import struct
+
+
+class Writer:
+    __slots__ = ("_parts",)
+
+    def __init__(self) -> None:
+        self._parts: list[bytes] = []
+
+    def u8(self, v: int) -> "Writer":
+        self._parts.append(struct.pack(">B", v))
+        return self
+
+    def u16(self, v: int) -> "Writer":
+        self._parts.append(struct.pack(">H", v))
+        return self
+
+    def u32(self, v: int) -> "Writer":
+        self._parts.append(struct.pack(">I", v))
+        return self
+
+    def u64(self, v: int) -> "Writer":
+        self._parts.append(struct.pack(">Q", v))
+        return self
+
+    def i64(self, v: int) -> "Writer":
+        self._parts.append(struct.pack(">q", v))
+        return self
+
+    def bool(self, v: bool) -> "Writer":
+        return self.u8(1 if v else 0)
+
+    def raw(self, b: bytes) -> "Writer":
+        self._parts.append(bytes(b))
+        return self
+
+    def bytes(self, b: bytes) -> "Writer":
+        self.u32(len(b))
+        return self.raw(b)
+
+    def str(self, s: str) -> "Writer":
+        return self.bytes(s.encode("utf-8"))
+
+    def build(self) -> bytes:
+        return b"".join(self._parts)
+
+
+class DecodeError(Exception):
+    pass
+
+
+class Reader:
+    __slots__ = ("_buf", "_pos")
+
+    def __init__(self, buf: bytes) -> None:
+        self._buf = buf
+        self._pos = 0
+
+    def _take(self, n: int) -> bytes:
+        if self._pos + n > len(self._buf):
+            raise DecodeError(
+                f"short read: need {n} bytes at {self._pos}, have {len(self._buf)}"
+            )
+        b = self._buf[self._pos : self._pos + n]
+        self._pos += n
+        return b
+
+    def u8(self) -> int:
+        return self._take(1)[0]
+
+    def u16(self) -> int:
+        return struct.unpack(">H", self._take(2))[0]
+
+    def u32(self) -> int:
+        return struct.unpack(">I", self._take(4))[0]
+
+    def u64(self) -> int:
+        return struct.unpack(">Q", self._take(8))[0]
+
+    def i64(self) -> int:
+        return struct.unpack(">q", self._take(8))[0]
+
+    def bool(self) -> bool:
+        v = self.u8()
+        if v not in (0, 1):
+            raise DecodeError(f"bad bool byte {v}")
+        return v == 1
+
+    def raw(self, n: int) -> bytes:
+        return self._take(n)
+
+    def bytes(self) -> bytes:
+        n = self.u32()
+        return self._take(n)
+
+    def str(self) -> str:
+        return self.bytes().decode("utf-8")
+
+    def remaining(self) -> int:
+        return len(self._buf) - self._pos
+
+    def done(self) -> bool:
+        return self._pos == len(self._buf)
+
+    def expect_done(self) -> None:
+        if not self.done():
+            raise DecodeError(f"{self.remaining()} trailing bytes")

@@ -1,0 +1,243 @@
+"""Crypto-core tests (mirrors reference crypto/*/..._test.go)."""
+import os
+
+import pytest
+
+from tendermint_tpu import crypto
+from tendermint_tpu.crypto import batch, ed25519, ed25519_math, merkle, multisig, secp256k1
+from tendermint_tpu.encoding import Reader, Writer
+
+
+class TestEncoding:
+    def test_roundtrip(self):
+        w = (
+            Writer()
+            .u8(7)
+            .u16(513)
+            .u32(1 << 30)
+            .u64(1 << 60)
+            .i64(-5)
+            .bool(True)
+            .bytes(b"abc")
+            .str("héllo")
+        )
+        r = Reader(w.build())
+        assert r.u8() == 7
+        assert r.u16() == 513
+        assert r.u32() == 1 << 30
+        assert r.u64() == 1 << 60
+        assert r.i64() == -5
+        assert r.bool() is True
+        assert r.bytes() == b"abc"
+        assert r.str() == "héllo"
+        r.expect_done()
+
+    def test_determinism(self):
+        a = Writer().u64(42).bytes(b"x").build()
+        b = Writer().u64(42).bytes(b"x").build()
+        assert a == b
+
+
+class TestEd25519:
+    def test_sign_verify(self):
+        priv = ed25519.gen_priv_key()
+        pub = priv.pub_key()
+        msg = b"hello tendermint"
+        sig = priv.sign(msg)
+        assert len(sig) == 64
+        assert pub.verify(msg, sig)
+        assert not pub.verify(msg + b"!", sig)
+        assert not pub.verify(msg, b"\x00" * 64)
+
+    def test_address(self):
+        priv = ed25519.gen_priv_key(b"\x01" * 32)
+        assert len(priv.pub_key().address()) == crypto.ADDRESS_SIZE
+        # deterministic
+        assert priv.pub_key().address() == ed25519.gen_priv_key(b"\x01" * 32).pub_key().address()
+
+    def test_pure_math_oracle_agrees(self):
+        """ed25519_math.verify must agree with the cryptography library."""
+        priv = ed25519.gen_priv_key()
+        pub = priv.pub_key().bytes()
+        for i in range(8):
+            msg = os.urandom(32 + i)
+            sig = priv.sign(msg)
+            assert ed25519_math.verify(pub, msg, sig)
+            bad = bytearray(sig)
+            bad[0] ^= 1
+            assert not ed25519_math.verify(pub, msg, bytes(bad))
+
+    def test_rfc8032_vector(self):
+        # RFC 8032 §7.1 TEST 3
+        sk = bytes.fromhex(
+            "c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7"
+        )
+        pk = bytes.fromhex(
+            "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025"
+        )
+        msg = bytes.fromhex("af82")
+        expected_sig = bytes.fromhex(
+            "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+            "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a"
+        )
+        priv = ed25519.gen_priv_key(sk)
+        assert priv.pub_key().bytes() == pk
+        assert priv.sign(msg) == expected_sig
+        assert priv.pub_key().verify(msg, expected_sig)
+        assert ed25519_math.verify(pk, msg, expected_sig)
+
+    def test_compress_decompress(self):
+        for _ in range(4):
+            priv = ed25519.gen_priv_key()
+            pt = ed25519_math.decompress(priv.pub_key().bytes())
+            assert pt is not None
+            assert ed25519_math.compress(pt) == priv.pub_key().bytes()
+
+
+class TestSecp256k1:
+    def test_sign_verify(self):
+        priv = secp256k1.gen_priv_key()
+        pub = priv.pub_key()
+        msg = b"secp message"
+        sig = priv.sign(msg)
+        assert len(sig) == 64
+        assert pub.verify(msg, sig)
+        assert not pub.verify(msg + b"!", sig)
+
+    def test_low_s_enforced(self):
+        priv = secp256k1.gen_priv_key()
+        msg = b"malleable?"
+        sig = priv.sign(msg)
+        s = int.from_bytes(sig[32:], "big")
+        assert s <= secp256k1.HALF_N
+        # the malleated high-S twin must be rejected
+        high_s = secp256k1.N - s
+        mall = sig[:32] + high_s.to_bytes(32, "big")
+        assert not priv.pub_key().verify(msg, mall)
+
+    def test_address_len(self):
+        assert len(secp256k1.gen_priv_key().pub_key().address()) == 20
+
+
+class TestPubkeyRegistry:
+    def test_encode_decode(self):
+        for priv in (ed25519.gen_priv_key(), secp256k1.gen_priv_key()):
+            pub = priv.pub_key()
+            enc = crypto.encode_pubkey(pub)
+            dec = crypto.decode_pubkey(enc)
+            assert dec == pub
+            assert dec.address() == pub.address()
+
+
+class TestMerkle:
+    def test_root_and_proofs(self):
+        items = [b"a", b"bb", b"ccc", b"dddd", b"eeeee"]
+        root, proofs = merkle.proofs_from_byte_slices(items)
+        assert root == merkle.hash_from_byte_slices(items)
+        for i, item in enumerate(items):
+            assert proofs[i].verify(root, item)
+            assert not proofs[i].verify(root, item + b"!")
+        # wrong-index proof fails
+        assert not proofs[0].verify(root, items[1])
+
+    def test_edge_sizes(self):
+        assert merkle.hash_from_byte_slices([]) != merkle.hash_from_byte_slices([b""])
+        for n in (1, 2, 3, 4, 7, 8, 9):
+            items = [bytes([i]) for i in range(n)]
+            root, proofs = merkle.proofs_from_byte_slices(items)
+            for i in range(n):
+                assert proofs[i].verify(root, items[i])
+
+    def test_proof_encode_roundtrip(self):
+        items = [b"a", b"b", b"c"]
+        root, proofs = merkle.proofs_from_byte_slices(items)
+        p = merkle.SimpleProof.decode(proofs[1].encode())
+        assert p.verify(root, b"b")
+
+    def test_map_hash_deterministic(self):
+        h1 = merkle.hash_from_map({"b": b"2", "a": b"1"})
+        h2 = merkle.hash_from_map({"a": b"1", "b": b"2"})
+        assert h1 == h2
+
+
+class TestMultisig:
+    def _setup(self, k=2, n=3):
+        privs = [ed25519.gen_priv_key() for _ in range(n)]
+        pubs = [p.pub_key() for p in privs]
+        mpk = multisig.PubKeyMultisigThreshold(k, pubs)
+        return privs, pubs, mpk
+
+    def test_threshold_verify(self):
+        privs, pubs, mpk = self._setup()
+        msg = b"multisig msg"
+        ms = multisig.Multisignature(3)
+        ms.add_signature_from_pubkey(privs[0].sign(msg), pubs[0], pubs)
+        ms.add_signature_from_pubkey(privs[2].sign(msg), pubs[2], pubs)
+        assert mpk.verify(msg, ms.encode())
+
+    def test_below_threshold_rejected(self):
+        privs, pubs, mpk = self._setup()
+        msg = b"m"
+        ms = multisig.Multisignature(3)
+        ms.add_signature_from_pubkey(privs[0].sign(msg), pubs[0], pubs)
+        assert not mpk.verify(msg, ms.encode())
+
+    def test_wrong_sig_rejected(self):
+        privs, pubs, mpk = self._setup()
+        msg = b"m"
+        ms = multisig.Multisignature(3)
+        ms.add_signature_from_pubkey(privs[0].sign(msg), pubs[0], pubs)
+        ms.add_signature_from_pubkey(privs[1].sign(b"other"), pubs[1], pubs)
+        assert not mpk.verify(msg, ms.encode())
+
+    def test_roundtrip_pubkey(self):
+        _, _, mpk = self._setup()
+        enc = crypto.encode_pubkey(mpk)
+        dec = crypto.decode_pubkey(enc)
+        assert dec == mpk
+
+
+class TestBatchVerifier:
+    def test_mixed_batch(self):
+        bv = batch.BatchVerifier()
+        expected = []
+        for i in range(6):
+            priv = ed25519.gen_priv_key() if i % 2 == 0 else secp256k1.gen_priv_key()
+            msg = os.urandom(16)
+            sig = priv.sign(msg)
+            if i == 3:
+                sig = b"\x00" * 64
+            bv.add(priv.pub_key(), msg, sig)
+            expected.append(i != 3)
+        assert bv.verify_all() == expected
+
+    def test_multisig_in_batch(self):
+        privs = [ed25519.gen_priv_key() for _ in range(3)]
+        pubs = [p.pub_key() for p in privs]
+        mpk = multisig.PubKeyMultisigThreshold(2, pubs)
+        msg = b"batched multisig"
+        ms = multisig.Multisignature(3)
+        ms.add_signature_from_pubkey(privs[0].sign(msg), pubs[0], pubs)
+        ms.add_signature_from_pubkey(privs[1].sign(msg), pubs[1], pubs)
+        bv = batch.BatchVerifier()
+        bv.add(mpk, msg, ms.encode())
+        p2 = ed25519.gen_priv_key()
+        bv.add(p2.pub_key(), b"x", p2.sign(b"x"))
+        assert bv.verify_all() == [True, True]
+
+    def test_backend_registry(self):
+        calls = {}
+
+        def fake_backend(pubs, msgs, sigs):
+            calls["n"] = len(pubs)
+            return [True] * len(pubs)
+
+        batch.register_backend("ed25519", fake_backend)
+        try:
+            bv = batch.BatchVerifier()
+            priv = ed25519.gen_priv_key()
+            bv.add(priv.pub_key(), b"m", b"\x00" * 64)  # invalid, but backend says yes
+            assert bv.verify_all() == [True]
+            assert calls["n"] == 1
+        finally:
+            batch.clear_backend("ed25519")
