@@ -1,0 +1,46 @@
+"""TPU kernel package — batched signature verification.
+
+Importing this package registers the JAX ed25519 batch backend into
+tendermint_tpu.crypto.batch, replacing the serial per-signature loop for
+batches of at least MIN_DEVICE_BATCH signatures (smaller batches stay on the
+CPU serial path: a single OpenSSL verify is ~50µs, well under a device
+launch, which matters for consensus hot loop #1 where votes arrive one at a
+time — see SURVEY.md §3.2).
+
+Set TMTPU_NO_ACCEL=1 to disable the device backend entirely (the analog of
+the reference's cgo/nocgo dual build, crypto/secp256k1/secp256k1_cgo.go).
+"""
+from __future__ import annotations
+
+import os
+
+MIN_DEVICE_BATCH = int(os.environ.get("TMTPU_MIN_DEVICE_BATCH", "8"))
+
+
+def _ed25519_backend(pubs, msgs, sigs):
+    if len(pubs) < MIN_DEVICE_BATCH:
+        from tendermint_tpu.crypto.ed25519 import PubKeyEd25519
+
+        out = []
+        for p, m, s in zip(pubs, msgs, sigs):
+            try:
+                out.append(PubKeyEd25519(bytes(p)).verify(m, s))
+            except ValueError:
+                out.append(False)
+        return out
+    from tendermint_tpu.ops import ed25519_batch
+
+    return ed25519_batch.verify_batch(pubs, msgs, sigs)
+
+
+def register() -> bool:
+    """Register device-backed batch verification. Returns True if enabled."""
+    if os.environ.get("TMTPU_NO_ACCEL"):
+        return False
+    from tendermint_tpu.crypto import batch
+
+    batch.register_backend("ed25519", _ed25519_backend)
+    return True
+
+
+register()

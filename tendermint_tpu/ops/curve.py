@@ -1,0 +1,119 @@
+"""Batched edwards25519 point arithmetic on limb arrays.
+
+Points are batched in extended homogeneous coordinates (X, Y, Z, T) with
+x = X/Z, y = Y/Z, T = XY/Z — each coordinate a (22, B) limb array (see
+ops/field.py). Formulas are the complete a=-1 twisted-Edwards ones from
+RFC 8032 §5.1.4, valid for all inputs including the identity, so the
+scalar-multiplication loop needs no branches — the constant-time pattern
+that XLA compiles well.
+
+Table entries for the Straus/Shamir double-scalar multiplication are kept in
+"cached" form (Y-X, Y+X, 2d*T, 2Z), which turns each addition into exactly
+8 field multiplies.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from tendermint_tpu.crypto.ed25519_math import BASE_X, BASE_Y, D
+from tendermint_tpu.ops import field
+from tendermint_tpu.ops.limbs import NLIMB, int_to_limb_column
+
+D2 = (2 * D) % field.P
+
+
+class Point(NamedTuple):
+    """Extended coordinates, each (22, B)."""
+
+    x: jnp.ndarray
+    y: jnp.ndarray
+    z: jnp.ndarray
+    t: jnp.ndarray
+
+
+class CachedPoint(NamedTuple):
+    """Precomputed addend: (Y-X, Y+X, 2d*T, 2Z), each (22, B) or (22, 1)."""
+
+    ymx: jnp.ndarray
+    ypx: jnp.ndarray
+    t2d: jnp.ndarray
+    z2: jnp.ndarray
+
+
+# Module-level constants as (22, 1) columns (broadcast over the batch).
+_ONE = int_to_limb_column(1)
+_ZERO = np.zeros((NLIMB, 1), dtype=np.int32)
+_TWO = int_to_limb_column(2)
+_D2 = int_to_limb_column(D2)
+_BASE_T = BASE_X * BASE_Y % field.P
+
+IDENTITY = Point(_ZERO, _ONE, _ONE, _ZERO)
+IDENTITY_CACHED = CachedPoint(_ONE, _ONE, _ZERO, _TWO)
+BASE = Point(
+    int_to_limb_column(BASE_X),
+    int_to_limb_column(BASE_Y),
+    _ONE,
+    int_to_limb_column(_BASE_T),
+)
+BASE_CACHED = CachedPoint(
+    int_to_limb_column((BASE_Y - BASE_X) % field.P),
+    int_to_limb_column((BASE_Y + BASE_X) % field.P),
+    int_to_limb_column(_BASE_T * D2 % field.P),
+    _TWO,
+)
+
+
+def to_cached(p: Point) -> CachedPoint:
+    return CachedPoint(
+        field.sub(p.y, p.x),
+        field.add(p.y, p.x),
+        field.mul(p.t, jnp.broadcast_to(jnp.asarray(_D2), p.t.shape)),
+        field.add(p.z, p.z),
+    )
+
+
+def add_cached(p: Point, q: CachedPoint) -> Point:
+    """Complete addition P + Q with Q precomputed (RFC 8032 §5.1.4): 8 muls."""
+    a = field.mul(field.sub(p.y, p.x), q.ymx)
+    b = field.mul(field.add(p.y, p.x), q.ypx)
+    c = field.mul(p.t, q.t2d)
+    d = field.mul(p.z, q.z2)
+    e = field.sub(b, a)
+    f = field.sub(d, c)
+    g = field.add(d, c)
+    h = field.add(b, a)
+    return Point(field.mul(e, f), field.mul(g, h), field.mul(f, g), field.mul(e, h))
+
+
+def double(p: Point) -> Point:
+    """Dedicated doubling (RFC 8032 §5.1.4): 4 squares + 4 muls."""
+    a = field.square(p.x)
+    b = field.square(p.y)
+    zz = field.square(p.z)
+    c = field.add(zz, zz)
+    h = field.add(a, b)
+    e = field.sub(h, field.square(field.add(p.x, p.y)))
+    g = field.sub(a, b)
+    f = field.add(c, g)
+    return Point(field.mul(e, f), field.mul(g, h), field.mul(f, g), field.mul(e, h))
+
+
+def select_cached(cond, a: CachedPoint, b: CachedPoint) -> CachedPoint:
+    """Per-element select between cached points; cond (B,)."""
+    return CachedPoint(
+        field.select(cond, a.ymx, b.ymx),
+        field.select(cond, a.ypx, b.ypx),
+        field.select(cond, a.t2d, b.t2d),
+        field.select(cond, a.z2, b.z2),
+    )
+
+
+def to_affine(p: Point) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(x, y) canonical digits — one batched inversion."""
+    zinv = field.inv(p.z)
+    x = field.canonicalize(field.mul(p.x, zinv))
+    y = field.canonicalize(field.mul(p.y, zinv))
+    return x, y

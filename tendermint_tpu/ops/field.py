@@ -1,0 +1,189 @@
+"""Batched GF(2^255-19) arithmetic in 12-bit limbs on int32 — the TPU hot core.
+
+Design notes (why this shape):
+- TPUs have no big-int and no cheap int64 multiply; int32 multiply on the VPU
+  is the primitive. 12-bit limbs make every schoolbook partial product fit
+  comfortably in int32: partials are <= ~2^26 and a 22-term accumulation plus
+  fold stays under ~6e8 < 2^31 (bound analysis below, checked by
+  tests/test_field_bounds.py with an interval tracker).
+- Arrays are limb-major (22, B): the batch dimension B maps to the 128-wide
+  TPU vector lanes, limbs to sublanes; every op is static-shape, branch-free
+  and identical across lanes — exactly what XLA wants under jit.
+- Between operations values are kept *weakly reduced* ("class R": limb0 <=
+  ~24k, limbs 1..21 <= ~4120) using a fixed number of vectorized carry
+  passes; exact canonicalization (unique digits of a mod p) happens once per
+  verify, at the final compare, using short unrolled sequential carries.
+
+p = 2^255 - 19;  2^264 == 19 * 2^9 == 9728 (mod p) is the fold constant:
+carries out of limb 21 (weight 2^264) re-enter at limb 0 multiplied by 9728.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tendermint_tpu.ops.limbs import LIMB_BITS, LIMB_MASK, NLIMB, int_to_limb_column
+
+P = 2**255 - 19
+FOLD = 19 << (NLIMB * LIMB_BITS - 255)  # 2^264 mod p = 9728
+
+
+def _make_bias() -> np.ndarray:
+    """A multiple of p in non-canonical digits, every limb large enough to
+    dominate a class-R operand, so sub(a, b) = a + BIAS - b never goes
+    negative limb-wise. Built from 2^9 * p (fits 22 digits exactly), digits
+    rebalanced by borrowing, then doubled so the top limb has headroom."""
+    v = (1 << 9) * P
+    digits = [(v >> (LIMB_BITS * i)) & LIMB_MASK for i in range(NLIMB)]
+    mins = [1 << 15] + [1 << 14] * (NLIMB - 2) + [0]
+    for i in range(NLIMB - 2, -1, -1):
+        while digits[i] < mins[i]:
+            digits[i] += 1 << LIMB_BITS
+            digits[i + 1] -= 1
+    assert all(d >= 0 for d in digits), digits
+    assert sum(d << (LIMB_BITS * i) for i, d in enumerate(digits)) == v
+    digits = [2 * d for d in digits]  # top limb >= ~8170 > any class-R limb
+    return np.array(digits, dtype=np.int32).reshape(NLIMB, 1)
+
+
+BIAS = _make_bias()
+P_LIMBS = int_to_limb_column(P)
+NEGP_LIMBS = int_to_limb_column((1 << (NLIMB * LIMB_BITS)) - P)  # 2^264 - p
+
+
+def carry_pass(c):
+    """One vectorized carry pass over (22, B) with top fold into limb 0."""
+    c = jnp.asarray(c)
+    cc = c >> LIMB_BITS
+    c = c & LIMB_MASK
+    c = c.at[1:].add(cc[:-1])
+    c = c.at[0].add(cc[NLIMB - 1] * FOLD)
+    return c
+
+
+def mul(a, b):
+    """Batched field multiply: (22,B) x (22,B) -> (22,B), class R out.
+
+    Schoolbook partial products accumulated by limb weight into a (44, B)
+    array, two wide carry passes (top limb kept unmasked so no carry is ever
+    lost), fold of the high half with 2^264 == 9728 (mod p), then four
+    narrow passes back to class R.
+    """
+    parts = [
+        jnp.pad(a[i][None, :] * b, ((i, NLIMB - i), (0, 0))) for i in range(NLIMB)
+    ]
+    c = parts[0]
+    for p_ in parts[1:]:
+        c = c + p_  # (44, B); limb 43 is 0 until carries arrive
+    for _ in range(2):
+        cc = c >> LIMB_BITS
+        lo = c & LIMB_MASK
+        lo = lo.at[1:].add(cc[:-1])
+        # top limb accumulates: restore its masked-off high bits
+        lo = lo.at[-1].add(cc[-1] << LIMB_BITS)
+        c = lo
+    d = c[:NLIMB] + FOLD * c[NLIMB:]
+    for _ in range(4):
+        d = carry_pass(d)
+    return d
+
+
+def square(a):
+    return mul(a, a)
+
+
+def add(a, b):
+    return carry_pass(a + b)
+
+
+def sub(a, b):
+    return carry_pass(a + (jnp.asarray(BIAS) - b))
+
+
+def select(cond, a, b):
+    """Per-batch-element select: cond (B,), a/b (22, B)."""
+    return jnp.where(cond[None, :] != 0, a, b)
+
+
+def pow2k(a, k: int):
+    return jax.lax.fori_loop(0, k, lambda _, x: square(x), a)
+
+
+def inv(a):
+    """a^(p-2) via the standard 25519 addition chain (254 squarings, 11
+    multiplies), with squaring runs as fori_loops to keep the graph small."""
+    t0 = square(a)  # 2
+    t1 = square(square(t0))  # 8
+    t1 = mul(a, t1)  # 9
+    t0 = mul(t0, t1)  # 11
+    t2 = square(t0)  # 22
+    t1 = mul(t1, t2)  # 2^5 - 1
+    t2 = pow2k(t1, 5)
+    t1 = mul(t2, t1)  # 2^10 - 1
+    t2 = pow2k(t1, 10)
+    t2 = mul(t2, t1)  # 2^20 - 1
+    t3 = pow2k(t2, 20)
+    t2 = mul(t3, t2)  # 2^40 - 1
+    t2 = pow2k(t2, 10)
+    t1 = mul(t2, t1)  # 2^50 - 1
+    t2 = pow2k(t1, 50)
+    t2 = mul(t2, t1)  # 2^100 - 1
+    t3 = pow2k(t2, 100)
+    t2 = mul(t3, t2)  # 2^200 - 1
+    t2 = pow2k(t2, 50)
+    t1 = mul(t2, t1)  # 2^250 - 1
+    t1 = pow2k(t1, 5)
+    return mul(t1, t0)  # 2^255 - 21 = p - 2
+
+
+def _seq_carry(a, topfold: bool):
+    """Exact sequential carry over 22 limbs (unrolled; 21 static steps).
+    With topfold, the limb-21 carry re-enters limb 0 via the 9728 fold;
+    without, limb 21 must be known small enough not to carry."""
+    for k in range(NLIMB - 1):
+        cc = a[k] >> LIMB_BITS
+        a = a.at[k].set(a[k] & LIMB_MASK)
+        a = a.at[k + 1].add(cc)
+    if topfold:
+        cc = a[NLIMB - 1] >> LIMB_BITS
+        a = a.at[NLIMB - 1].set(a[NLIMB - 1] & LIMB_MASK)
+        a = a.at[0].add(cc * FOLD)
+    return a
+
+
+def canonicalize(a):
+    """Exact canonical digits of (a mod p), in [0, p). Runs once per verify
+    (final encode-and-compare), so the unrolled sequential carries are cheap
+    relative to the 253-iteration scalar-mult loop."""
+    a = jnp.asarray(a)
+    a = carry_pass(carry_pass(a))  # shrink class R to near-canonical
+    a = _seq_carry(a, topfold=True)
+    a = _seq_carry(a, topfold=True)  # settles: all limbs canonical, V < 2^264
+    # fold bits >= 255: V = hi*2^255 + lo == 19*hi + lo (mod p); twice
+    for _ in range(2):
+        hi = a[NLIMB - 1] >> 3
+        a = a.at[NLIMB - 1].set(a[NLIMB - 1] & 0x7)
+        a = a.at[0].add(hi * 19)
+        a = _seq_carry(a, topfold=False)
+    # now V < 2^255: one conditional subtract of p, computed as the 264-bit
+    # add V + (2^264 - p); carry out of limb 21 <=> V >= p
+    t = a + jnp.asarray(NEGP_LIMBS)
+    overflow = jnp.zeros_like(a[0])
+    for k in range(NLIMB - 1):
+        cc = t[k] >> LIMB_BITS
+        t = t.at[k].set(t[k] & LIMB_MASK)
+        t = t.at[k + 1].add(cc)
+    overflow = t[NLIMB - 1] >> LIMB_BITS
+    t = t.at[NLIMB - 1].set(t[NLIMB - 1] & LIMB_MASK)
+    return jnp.where(overflow[None, :] > 0, t, a)
+
+
+def eq(a, b):
+    """Canonical-digit equality -> (B,) bool. Inputs must be canonical."""
+    return jnp.all(a == b, axis=0)
+
+
+def is_odd(a):
+    """Parity of a canonical element -> (B,) int32 in {0,1}."""
+    return a[0] & 1

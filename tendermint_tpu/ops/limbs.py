@@ -1,0 +1,59 @@
+"""Host-side limb packing for the TPU field arithmetic.
+
+Field elements of GF(2^255-19) are represented on device as 22 limbs of 12
+bits in int32, limb-major: shape (22, B) so the batch dimension maps to TPU
+vector lanes (128-wide) and limbs to sublanes. 22*12 = 264 bits of capacity;
+values are kept weakly reduced (see ops/field.py for the bound contracts).
+
+These helpers convert between Python ints / little-endian byte strings and
+the packed numpy arrays, vectorized over the batch.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+NLIMB = 22
+LIMB_BITS = 12
+LIMB_MASK = (1 << LIMB_BITS) - 1
+
+
+def ints_to_limbs(vals: list[int]) -> np.ndarray:
+    """Pack non-negative ints < 2^264 into a (22, B) int32 limb array."""
+    if not vals:
+        return np.zeros((NLIMB, 0), dtype=np.int32)
+    buf = b"".join(v.to_bytes(33, "little") for v in vals)
+    b = np.frombuffer(buf, dtype=np.uint8).reshape(len(vals), 33).astype(np.int32)
+    trip = b.reshape(len(vals), 11, 3)
+    lo = trip[:, :, 0] | ((trip[:, :, 1] & 0xF) << 8)
+    hi = (trip[:, :, 1] >> 4) | (trip[:, :, 2] << 4)
+    limbs = np.stack([lo, hi], axis=2).reshape(len(vals), NLIMB)
+    return np.ascontiguousarray(limbs.T)
+
+
+def limbs_to_ints(arr) -> list[int]:
+    """Inverse of ints_to_limbs; accepts any (22, B) integer array (limbs may
+    be loose, i.e. larger than 12 bits — weights still apply)."""
+    a = np.asarray(arr, dtype=np.int64)
+    out = []
+    for col in range(a.shape[1]):
+        v = 0
+        for k in range(NLIMB - 1, -1, -1):
+            v = (v << LIMB_BITS) + int(a[k, col])
+        out.append(v)
+    return out
+
+
+def int_to_limb_column(v: int) -> np.ndarray:
+    """(22, 1) column for module-level constants."""
+    return ints_to_limbs([v])
+
+
+def scalars_to_bits(vals: list[int], nbits: int = 253) -> np.ndarray:
+    """Pack scalars (< 2^nbits) into a (nbits, B) int32 bit array,
+    little-endian bit order (bits[i] = bit i)."""
+    if not vals:
+        return np.zeros((nbits, 0), dtype=np.int32)
+    buf = b"".join(v.to_bytes(32, "little") for v in vals)
+    b = np.frombuffer(buf, dtype=np.uint8).reshape(len(vals), 32)
+    bits = np.unpackbits(b, axis=1, bitorder="little")[:, :nbits]
+    return np.ascontiguousarray(bits.T.astype(np.int32))

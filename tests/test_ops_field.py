@@ -1,0 +1,169 @@
+"""Field/curve kernel tests.
+
+Strategy: every limb-arithmetic op is checked for *value* correctness
+(mod p) against Python big-int arithmetic, including on adversarial loose
+limb representations at the documented class-R bounds — an int32 overflow
+anywhere wraps and corrupts the value, so these checks double as overflow
+detection for the bound contracts in ops/field.py.
+"""
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from tendermint_tpu.crypto import ed25519_math as em
+from tendermint_tpu.ops import curve, field
+from tendermint_tpu.ops.limbs import (
+    LIMB_BITS,
+    NLIMB,
+    ints_to_limbs,
+    limbs_to_ints,
+    scalars_to_bits,
+)
+
+P = em.P
+rng = np.random.default_rng(42)
+
+
+def rand_elems(n):
+    return [int.from_bytes(rng.bytes(32), "little") % P for _ in range(n)]
+
+
+def loose_class_r(n):
+    """Adversarial loose representations at class-R limb bounds."""
+    limbs = np.full((NLIMB, n), 4104, dtype=np.int32)
+    limbs[0] = 23551
+    limbs[NLIMB - 1] = 4100
+    return limbs
+
+
+def vals_of(arr):
+    return [v % P for v in limbs_to_ints(np.asarray(arr))]
+
+
+class TestLimbs:
+    def test_roundtrip(self):
+        vals = rand_elems(16)
+        assert limbs_to_ints(ints_to_limbs(vals)) == vals
+
+    def test_bits(self):
+        vals = [0, 1, em.L - 1, 2**252]
+        bits = scalars_to_bits(vals, 253)
+        assert bits.shape == (253, 4)
+        for i, v in enumerate(vals):
+            assert sum(int(bits[k, i]) << k for k in range(253)) == v
+
+
+class TestFieldOps:
+    def test_mul_random(self):
+        a, b = rand_elems(32), rand_elems(32)
+        out = vals_of(field.mul(ints_to_limbs(a), ints_to_limbs(b)))
+        assert out == [(x * y) % P for x, y in zip(a, b)]
+
+    def test_mul_loose_bounds(self):
+        """Worst-case loose inputs on both sides must not overflow."""
+        la = loose_class_r(8)
+        lb = loose_class_r(8)
+        va = [v % P for v in limbs_to_ints(la)]
+        vb = [v % P for v in limbs_to_ints(lb)]
+        out = vals_of(field.mul(la, lb))
+        assert out == [(x * y) % P for x, y in zip(va, vb)]
+
+    def test_add_sub(self):
+        a, b = rand_elems(16), rand_elems(16)
+        la, lb = ints_to_limbs(a), ints_to_limbs(b)
+        assert vals_of(field.add(la, lb)) == [(x + y) % P for x, y in zip(a, b)]
+        assert vals_of(field.sub(la, lb)) == [(x - y) % P for x, y in zip(a, b)]
+
+    def test_sub_loose(self):
+        la, lb = loose_class_r(4), loose_class_r(4)
+        va = [v % P for v in limbs_to_ints(la)]
+        vb = [v % P for v in limbs_to_ints(lb)]
+        assert vals_of(field.sub(la, lb)) == [(x - y) % P for x, y in zip(va, vb)]
+
+    def test_chained_ops_stay_bounded(self):
+        """Long chains of mul/add/sub keep values exact (no overflow drift)."""
+        a = ints_to_limbs(rand_elems(8))
+        b = ints_to_limbs(rand_elems(8))
+        va = [v % P for v in limbs_to_ints(a)]
+        vb = [v % P for v in limbs_to_ints(b)]
+        for _ in range(20):
+            a2 = field.mul(field.add(a, b), field.sub(a, b))
+            va = [((x + y) * (x - y)) % P for x, y in zip(va, vb)]
+            b2 = field.mul(a, b)
+            vb = [(x * y) % P for x, y in zip(limbs_to_ints(a), vb)]
+            vb = [v % P for v in vb]
+            a, b = a2, b2
+            assert vals_of(a) == va
+            assert vals_of(b) == vb
+
+    def test_inv(self):
+        a = rand_elems(8)
+        out = vals_of(field.inv(ints_to_limbs(a)))
+        assert out == [pow(x, P - 2, P) for x in a]
+
+    def test_canonicalize(self):
+        # values that need the conditional subtract: p-1, p, p+1, 2^255-1
+        vals = [P - 1, P, P + 1, 2**255 - 1, 0, 1, 19]
+        out = field.canonicalize(ints_to_limbs(vals))
+        arr = np.asarray(out)
+        assert (arr <= 0xFFF).all() and (arr >= 0).all()
+        assert limbs_to_ints(arr) == [v % P for v in vals]
+
+    def test_canonicalize_loose(self):
+        la = loose_class_r(4)
+        va = [v % P for v in limbs_to_ints(la)]
+        out = np.asarray(field.canonicalize(la))
+        assert limbs_to_ints(out) == va
+
+    def test_eq_parity(self):
+        vals = [5, P - 2, 7, 7]
+        ca = field.canonicalize(ints_to_limbs(vals))
+        cb = field.canonicalize(ints_to_limbs([5, 3, 7, 8]))
+        assert list(np.asarray(field.eq(ca, cb))) == [True, False, True, False]
+        assert list(np.asarray(field.is_odd(ca))) == [1, 1, 1, 1]
+
+
+def _to_point_batch(pts):
+    """List of extended-coord int tuples -> batched curve.Point."""
+    xs = ints_to_limbs([p[0] for p in pts])
+    ys = ints_to_limbs([p[1] for p in pts])
+    zs = ints_to_limbs([p[2] for p in pts])
+    ts = ints_to_limbs([p[3] for p in pts])
+    return curve.Point(xs, ys, zs, ts)
+
+
+def _affine_ints(p: curve.Point):
+    x, y = curve.to_affine(p)
+    return list(zip(limbs_to_ints(np.asarray(x)), limbs_to_ints(np.asarray(y))))
+
+
+class TestCurveOps:
+    def _random_points(self, n):
+        return [em.scalar_mult(int.from_bytes(rng.bytes(32), "little") % em.L, em.BASE) for _ in range(n)]
+
+    def test_double(self):
+        pts = self._random_points(6)
+        batched = _to_point_batch(pts)
+        got = _affine_ints(curve.double(batched))
+        want = [em.to_affine(em.point_double(p)) for p in pts]
+        assert got == want
+
+    def test_add_cached(self):
+        ps = self._random_points(6)
+        qs = self._random_points(6)
+        got = _affine_ints(curve.add_cached(_to_point_batch(ps), curve.to_cached(_to_point_batch(qs))))
+        want = [em.to_affine(em.point_add(p, q)) for p, q in zip(ps, qs)]
+        assert got == want
+
+    def test_add_identity(self):
+        ps = self._random_points(3)
+        ident = [em.IDENTITY] * 3
+        got = _affine_ints(curve.add_cached(_to_point_batch(ps), curve.to_cached(_to_point_batch(ident))))
+        want = [em.to_affine(p) for p in ps]
+        assert got == want
+        # identity + identity
+        got2 = _affine_ints(
+            curve.add_cached(_to_point_batch(ident), curve.to_cached(_to_point_batch(ident)))
+        )
+        assert got2 == [em.to_affine(em.IDENTITY)] * 3

@@ -1,0 +1,103 @@
+"""End-to-end batched Ed25519 verification kernel tests."""
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from tendermint_tpu.crypto import ed25519
+from tendermint_tpu.ops import ed25519_batch
+
+
+def _make_sigs(n, msg_len=48):
+    pubs, msgs, sigs = [], [], []
+    for i in range(n):
+        priv = ed25519.gen_priv_key()
+        msg = os.urandom(msg_len)
+        pubs.append(priv.pub_key().bytes())
+        msgs.append(msg)
+        sigs.append(priv.sign(msg))
+    return pubs, msgs, sigs
+
+
+class TestVerifyBatch:
+    def test_all_valid(self):
+        pubs, msgs, sigs = _make_sigs(8)
+        assert ed25519_batch.verify_batch(pubs, msgs, sigs) == [True] * 8
+
+    def test_mixed_invalid(self):
+        pubs, msgs, sigs = _make_sigs(10)
+        expected = [True] * 10
+        # corrupt various components
+        sigs[1] = sigs[1][:10] + bytes([sigs[1][10] ^ 1]) + sigs[1][11:]
+        expected[1] = False
+        msgs[3] = msgs[3] + b"!"
+        expected[3] = False
+        sigs[5] = b"\x00" * 64
+        expected[5] = False
+        pubs[7] = b"\xff" * 32  # undecompressable pubkey
+        expected[7] = False
+        # S >= L rejection (malleability)
+        from tendermint_tpu.crypto.ed25519_math import L
+
+        s = int.from_bytes(sigs[9][32:], "little") + L
+        if s < 2**256:
+            sigs[9] = sigs[9][:32] + s.to_bytes(32, "little")
+            expected[9] = False
+        assert ed25519_batch.verify_batch(pubs, msgs, sigs) == expected
+
+    def test_rfc8032_vectors(self):
+        # RFC 8032 §7.1 TEST 1-3
+        vectors = [
+            (
+                "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+                "",
+                "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+                "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b",
+            ),
+            (
+                "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+                "72",
+                "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+                "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00",
+            ),
+            (
+                "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025",
+                "af82",
+                "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+                "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a",
+            ),
+        ]
+        pubs = [bytes.fromhex(v[0]) for v in vectors]
+        msgs = [bytes.fromhex(v[1]) for v in vectors]
+        sigs = [bytes.fromhex(v[2]) for v in vectors]
+        assert ed25519_batch.verify_batch(pubs, msgs, sigs) == [True] * 3
+
+    def test_pubkey_cache_reuse(self):
+        priv = ed25519.gen_priv_key()
+        pub = priv.pub_key().bytes()
+        msgs = [os.urandom(16) for _ in range(4)]
+        sigs = [priv.sign(m) for m in msgs]
+        assert ed25519_batch.verify_batch([pub] * 4, msgs, sigs) == [True] * 4
+        sigs[2] = sigs[3]  # wrong message/sig pairing
+        assert ed25519_batch.verify_batch([pub] * 4, msgs, sigs) == [
+            True,
+            True,
+            False,
+            True,
+        ]
+
+    def test_backend_registration(self):
+        """Importing tendermint_tpu.ops registers the batch backend."""
+        import tendermint_tpu.ops  # noqa: F401
+        from tendermint_tpu.crypto import batch
+
+        assert batch.get_backend("ed25519") is not None
+        bv = batch.BatchVerifier()
+        pubs, msgs, sigs = _make_sigs(3)
+        for p, m, s in zip(pubs, msgs, sigs):
+            bv.add(ed25519.PubKeyEd25519(p), m, s)
+        bad = ed25519.gen_priv_key()
+        bv.add(bad.pub_key(), b"m", b"\x01" * 64)
+        assert bv.verify_all() == [True, True, True, False]
