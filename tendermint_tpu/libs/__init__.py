@@ -1,0 +1,4 @@
+"""Runtime substrate (reference libs/): service lifecycle, bit arrays,
+events, pubsub, concurrent lists, rotating files, flow rate, fail injection,
+logging. The control plane is asyncio-based — the idiomatic Python analog of
+the reference's goroutine fabric."""

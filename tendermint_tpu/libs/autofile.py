@@ -1,0 +1,97 @@
+"""Rotating file groups — the consensus WAL storage substrate.
+
+Reference parity: libs/autofile/group.go — `Group` of size-limited rotating
+files (`head` plus numbered chunks `name.000`, `name.001`, …) with
+sequential read across chunks. The reference's AutoFile reopen-on-rotation
+and ticker-based size checks collapse here into explicit checks on write.
+"""
+from __future__ import annotations
+
+import os
+from typing import Iterator
+
+
+class Group:
+    def __init__(self, head_path: str, head_size_limit: int = 10 * 1024 * 1024,
+                 total_size_limit: int = 1024 * 1024 * 1024) -> None:
+        self.head_path = head_path
+        self.head_size_limit = head_size_limit
+        self.total_size_limit = total_size_limit
+        os.makedirs(os.path.dirname(head_path) or ".", exist_ok=True)
+        self._head = open(head_path, "ab")
+
+    # -- writing ------------------------------------------------------------
+
+    def write(self, data: bytes) -> None:
+        self._head.write(data)
+
+    def flush(self) -> None:
+        self._head.flush()
+
+    def flush_sync(self) -> None:
+        self._head.flush()
+        os.fsync(self._head.fileno())
+
+    def maybe_rotate(self) -> None:
+        """Rotate head to the next numbered chunk if over the size limit."""
+        self._head.flush()
+        if self._head.tell() < self.head_size_limit:
+            return
+        self._head.close()
+        idx = self.max_index() + 1
+        os.rename(self.head_path, f"{self.head_path}.{idx:03d}")
+        self._head = open(self.head_path, "ab")
+        self._enforce_total_size()
+
+    def _enforce_total_size(self) -> None:
+        chunks = self._chunk_indices()
+        total = sum(os.path.getsize(self._chunk_path(i)) for i in chunks)
+        total += os.path.getsize(self.head_path)
+        while chunks and total > self.total_size_limit:
+            path = self._chunk_path(chunks[0])
+            total -= os.path.getsize(path)
+            os.remove(path)
+            chunks = chunks[1:]
+
+    def close(self) -> None:
+        self._head.flush()
+        self._head.close()
+
+    # -- reading ------------------------------------------------------------
+
+    def _chunk_path(self, idx: int) -> str:
+        return f"{self.head_path}.{idx:03d}"
+
+    def _chunk_indices(self) -> list[int]:
+        d = os.path.dirname(self.head_path) or "."
+        base = os.path.basename(self.head_path)
+        out = []
+        for name in os.listdir(d):
+            if name.startswith(base + "."):
+                suffix = name[len(base) + 1 :]
+                if suffix.isdigit():
+                    out.append(int(suffix))
+        return sorted(out)
+
+    def min_index(self) -> int:
+        idx = self._chunk_indices()
+        return idx[0] if idx else -1
+
+    def max_index(self) -> int:
+        idx = self._chunk_indices()
+        return idx[-1] if idx else -1
+
+    def read_all(self) -> Iterator[bytes]:
+        """Yield the raw contents of every chunk, oldest first, head last."""
+        self._head.flush()
+        for i in self._chunk_indices():
+            with open(self._chunk_path(i), "rb") as f:
+                yield f.read()
+        with open(self.head_path, "rb") as f:
+            yield f.read()
+
+    def reader(self):
+        """A single concatenated byte stream of the whole group."""
+        import io
+
+        return io.BytesIO(b"".join(self.read_all()))

@@ -1,0 +1,118 @@
+"""BitArray — vote bookkeeping structure.
+
+Reference parity: libs/common/bit_array.go. Used by VoteSet (which votes are
+present), consensus reactor PeerState mirrors, and block-part tracking.
+Backed by a Python int for O(1) bulk ops.
+"""
+from __future__ import annotations
+
+import secrets
+
+
+class BitArray:
+    __slots__ = ("size", "_bits")
+
+    def __init__(self, size: int, bits: int = 0) -> None:
+        if size < 0:
+            raise ValueError("negative size")
+        self.size = size
+        self._bits = bits & ((1 << size) - 1) if size else 0
+
+    def get_index(self, i: int) -> bool:
+        if not (0 <= i < self.size):
+            return False
+        return bool((self._bits >> i) & 1)
+
+    def set_index(self, i: int, v: bool) -> bool:
+        if not (0 <= i < self.size):
+            return False
+        if v:
+            self._bits |= 1 << i
+        else:
+            self._bits &= ~(1 << i)
+        return True
+
+    def copy(self) -> "BitArray":
+        return BitArray(self.size, self._bits)
+
+    def or_(self, other: "BitArray") -> "BitArray":
+        return BitArray(max(self.size, other.size), self._bits | other._bits)
+
+    def and_(self, other: "BitArray") -> "BitArray":
+        return BitArray(min(self.size, other.size), self._bits & other._bits)
+
+    def not_(self) -> "BitArray":
+        return BitArray(self.size, ~self._bits)
+
+    def sub(self, other: "BitArray") -> "BitArray":
+        """Bits set in self but not in other (reference bit_array.go Sub)."""
+        return BitArray(self.size, self._bits & ~other._bits)
+
+    def is_empty(self) -> bool:
+        return self._bits == 0
+
+    def is_full(self) -> bool:
+        return self.size > 0 and self._bits == (1 << self.size) - 1
+
+    def num_true(self) -> int:
+        return bin(self._bits).count("1")
+
+    def pick_random(self) -> tuple[int, bool]:
+        """Random set bit index (reference PickRandom) — used by the vote
+        gossip routine to pick a vote the peer needs."""
+        n = self.num_true()
+        if n == 0:
+            return 0, False
+        k = secrets.randbelow(n)
+        bits = self._bits
+        idx = 0
+        while True:
+            lsb = (bits & -bits).bit_length() - 1
+            if k == 0:
+                return lsb, True
+            bits &= bits - 1
+            k -= 1
+
+    def indices(self) -> list[int]:
+        out = []
+        bits = self._bits
+        while bits:
+            lsb = (bits & -bits).bit_length() - 1
+            out.append(lsb)
+            bits &= bits - 1
+        return out
+
+    def update(self, other: "BitArray") -> None:
+        """Copy other's bits into self (sizes must match)."""
+        self._bits = other._bits & ((1 << self.size) - 1)
+
+    def encode(self) -> bytes:
+        from tendermint_tpu.encoding import Writer
+
+        nbytes = (self.size + 7) // 8
+        return Writer().u32(self.size).bytes(self._bits.to_bytes(nbytes, "little")).build()
+
+    @classmethod
+    def read(cls, r) -> "BitArray":
+        size = r.u32()
+        raw = r.bytes()
+        return cls(size, int.from_bytes(raw, "little"))
+
+    @classmethod
+    def decode(cls, data: bytes) -> "BitArray":
+        from tendermint_tpu.encoding import Reader
+
+        r = Reader(data)
+        ba = cls.read(r)
+        r.expect_done()
+        return ba
+
+    def __eq__(self, other: object) -> bool:
+        return (
+            isinstance(other, BitArray)
+            and self.size == other.size
+            and self._bits == other._bits
+        )
+
+    def __repr__(self) -> str:
+        return "BA{" + "".join("x" if self.get_index(i) else "_" for i in range(min(self.size, 64))) + "}"

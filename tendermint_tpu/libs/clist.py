@@ -1,0 +1,95 @@
+"""Concurrent ordered list with blocking iteration.
+
+Reference parity: libs/clist/clist.go:44,220 — the lock-coupled linked list
+whose `NextWait()` lets gossip routines follow the mempool/evidence pool as
+items are appended and removed. asyncio version: waiters await an Event that
+push_back sets.
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Any
+
+
+class CElement:
+    __slots__ = ("value", "prev", "next", "removed", "_next_event", "_list")
+
+    def __init__(self, value: Any, lst: "CList") -> None:
+        self.value = value
+        self.prev: CElement | None = None
+        self.next: CElement | None = None
+        self.removed = False
+        self._next_event = asyncio.Event()
+        self._list = lst
+
+    async def next_wait(self) -> "CElement | None":
+        """Wait until this element has a successor or is removed; returns the
+        successor (or None if removed while waiting at the tail)."""
+        while True:
+            if self.next is not None:
+                return self.next
+            if self.removed:
+                return None
+            self._next_event.clear()
+            await self._next_event.wait()
+
+
+class CList:
+    def __init__(self) -> None:
+        self._head: CElement | None = None
+        self._tail: CElement | None = None
+        self._len = 0
+        self._wait_event = asyncio.Event()
+
+    def __len__(self) -> int:
+        return self._len
+
+    def front(self) -> CElement | None:
+        return self._head
+
+    def back(self) -> CElement | None:
+        return self._tail
+
+    async def front_wait(self) -> CElement:
+        """Wait until the list is non-empty, return the head."""
+        while self._head is None:
+            self._wait_event.clear()
+            await self._wait_event.wait()
+        return self._head
+
+    def push_back(self, value: Any) -> CElement:
+        el = CElement(value, self)
+        if self._tail is None:
+            self._head = self._tail = el
+        else:
+            el.prev = self._tail
+            self._tail.next = el
+            self._tail._next_event.set()
+            self._tail = el
+        self._len += 1
+        self._wait_event.set()
+        return el
+
+    def remove(self, el: CElement) -> Any:
+        if el.removed:
+            return el.value
+        if el.prev is not None:
+            el.prev.next = el.next
+            if el.next is not None:
+                el.prev._next_event.set()
+        else:
+            self._head = el.next
+        if el.next is not None:
+            el.next.prev = el.prev
+        else:
+            self._tail = el.prev
+        self._len -= 1
+        el.removed = True
+        el._next_event.set()  # wake waiters so they observe removal
+        return el.value
+
+    def __iter__(self):
+        el = self._head
+        while el is not None:
+            yield el
+            el = el.next

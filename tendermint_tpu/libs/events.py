@@ -1,0 +1,29 @@
+"""Synchronous EventSwitch.
+
+Reference parity: libs/events/events.go:45,147 — a listener-callback switch
+used inside consensus for reactor wakeups (distinct from the async pubsub
+EventBus). Callbacks run inline on fire.
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Callable
+
+
+class EventSwitch:
+    def __init__(self) -> None:
+        self._listeners: dict[str, dict[str, Callable]] = defaultdict(dict)
+
+    def add_listener_for_event(self, listener_id: str, event: str, cb: Callable) -> None:
+        self._listeners[event][listener_id] = cb
+
+    def remove_listener_for_event(self, event: str, listener_id: str) -> None:
+        self._listeners[event].pop(listener_id, None)
+
+    def remove_listener(self, listener_id: str) -> None:
+        for listeners in self._listeners.values():
+            listeners.pop(listener_id, None)
+
+    def fire_event(self, event: str, data=None) -> None:
+        for cb in list(self._listeners.get(event, {}).values()):
+            cb(data)

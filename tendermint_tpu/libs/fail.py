@@ -1,0 +1,40 @@
+"""Deterministic crash-point injection.
+
+Reference parity: libs/fail/fail.go:10,27 — `fail.Fail()` exits the process
+when its call index matches the FAIL_TEST_INDEX env var. Call sites straddle
+every durability boundary of the commit pipeline (state/execution.go:131-173,
+consensus/state.go:1287-1344) and the crash-consistency suite restarts the
+node once per index (test/persist/test_failure_indices.sh).
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+_counter = 0
+
+
+def env_index() -> int:
+    try:
+        return int(os.environ.get("FAIL_TEST_INDEX", "-1"))
+    except ValueError:
+        return -1
+
+
+def reset() -> None:
+    global _counter
+    _counter = 0
+
+
+def fail() -> None:
+    """Hard-exit the process if this is the FAIL_TEST_INDEX'th call."""
+    global _counter
+    index = env_index()
+    if index < 0:
+        return
+    if _counter == index:
+        sys.stdout.flush()
+        sys.stderr.write(f"fail.fail(): crash point {index}\n")
+        sys.stderr.flush()
+        os._exit(99)
+    _counter += 1

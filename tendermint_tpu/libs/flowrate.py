@@ -1,0 +1,77 @@
+"""Transfer-rate monitoring and limiting.
+
+Reference parity: libs/flowrate/flowrate.go — per-connection send/recv rate
+monitors with EMA rates and limit computation; used by MConnection and the
+fast-sync block pool (blockchain/v0/pool.go:452).
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+
+@dataclass
+class Status:
+    bytes: int = 0
+    samples: int = 0
+    inst_rate: float = 0.0
+    cur_rate: float = 0.0
+    avg_rate: float = 0.0
+    peak_rate: float = 0.0
+    duration: float = 0.0
+    idle: float = 0.0
+
+
+class Monitor:
+    """EMA rate monitor; `limit()` returns how many bytes may be transferred
+    now to stay under a target rate (token-bucket style)."""
+
+    def __init__(self, sample_period: float = 0.1, window: float = 1.0) -> None:
+        self._period = sample_period
+        self._window = window
+        self._start = time.monotonic()
+        self._last = self._start
+        self._sample_start = self._start
+        self._sample_bytes = 0
+        self._total = 0
+        self._samples = 0
+        self._cur_rate = 0.0
+        self._peak = 0.0
+
+    def update(self, n: int) -> None:
+        now = time.monotonic()
+        self._total += n
+        self._sample_bytes += n
+        elapsed = now - self._sample_start
+        if elapsed >= self._period:
+            rate = self._sample_bytes / elapsed
+            alpha = min(1.0, elapsed / self._window)
+            self._cur_rate = self._cur_rate * (1 - alpha) + rate * alpha
+            self._peak = max(self._peak, self._cur_rate)
+            self._samples += 1
+            self._sample_start = now
+            self._sample_bytes = 0
+        self._last = now
+
+    def limit(self, want: int, rate_limit: float) -> int:
+        """How many of `want` bytes may be sent now under rate_limit B/s."""
+        if rate_limit <= 0:
+            return want
+        now = time.monotonic()
+        elapsed = max(now - self._start, 1e-9)
+        allowed = rate_limit * elapsed - self._total
+        return max(0, min(want, int(allowed)))
+
+    def status(self) -> Status:
+        now = time.monotonic()
+        dur = now - self._start
+        return Status(
+            bytes=self._total,
+            samples=self._samples,
+            inst_rate=self._cur_rate,
+            cur_rate=self._cur_rate,
+            avg_rate=self._total / dur if dur > 0 else 0.0,
+            peak_rate=self._peak,
+            duration=dur,
+            idle=now - self._last,
+        )

@@ -1,0 +1,87 @@
+"""Structured key-value logging with per-module level filtering.
+
+Reference parity: libs/log — go-kit style `Logger.With(k, v)` context
+chaining, tmfmt/JSON output, per-module level filter
+(libs/log/filter.go, config "log_level": "consensus:debug,*:info").
+"""
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+from typing import Any
+
+_LEVELS = {"debug": 10, "info": 20, "error": 40, "none": 100}
+
+
+class Logger:
+    def __init__(self, module: str = "main", context: dict[str, Any] | None = None,
+                 sink=None, levels: dict[str, int] | None = None) -> None:
+        self.module = module
+        self._ctx = context or {}
+        self._sink = sink if sink is not None else sys.stderr
+        self._levels = levels if levels is not None else {"*": 20}
+
+    def with_(self, **kv) -> "Logger":
+        ctx = dict(self._ctx)
+        ctx.update(kv)
+        lg = Logger(self.module, ctx, self._sink, self._levels)
+        return lg
+
+    def module_logger(self, module: str) -> "Logger":
+        return Logger(module, dict(self._ctx), self._sink, self._levels)
+
+    def _enabled(self, level: int) -> bool:
+        threshold = self._levels.get(self.module, self._levels.get("*", 20))
+        return level >= threshold
+
+    def _log(self, level: str, lvl_num: int, msg: str, kv: dict) -> None:
+        if not self._enabled(lvl_num):
+            return
+        rec = {"ts": round(time.time(), 3), "level": level, "module": self.module, "msg": msg}
+        rec.update(self._ctx)
+        rec.update({k: _render(v) for k, v in kv.items()})
+        try:
+            self._sink.write(json.dumps(rec, default=str) + "\n")
+        except Exception:
+            pass
+
+    def debug(self, msg: str, **kv) -> None:
+        self._log("debug", 10, msg, kv)
+
+    def info(self, msg: str, **kv) -> None:
+        self._log("info", 20, msg, kv)
+
+    def error(self, msg: str, **kv) -> None:
+        self._log("error", 40, msg, kv)
+
+
+def _render(v: Any) -> Any:
+    if isinstance(v, bytes):
+        return v.hex()
+    return v
+
+
+def parse_log_level(spec: str, default: str = "info") -> dict[str, int]:
+    """Parse "consensus:debug,p2p:info,*:error" (reference libs/cli/flags)."""
+    levels = {"*": _LEVELS.get(default, 20)}
+    if not spec:
+        return levels
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            mod, lvl = part.rsplit(":", 1)
+            levels[mod.strip()] = _LEVELS.get(lvl.strip().lower(), 20)
+        else:
+            levels["*"] = _LEVELS.get(part.lower(), 20)
+    return levels
+
+
+NOP = Logger("nop", levels={"*": 100})
+
+
+def new_logger(log_level: str = "info", sink=None) -> Logger:
+    return Logger("main", sink=sink, levels=parse_log_level(log_level))

@@ -1,0 +1,233 @@
+"""Query-filtered pub/sub server.
+
+Reference parity: libs/pubsub/pubsub.go:90 (Server with per-subscriber
+queries and buffered delivery) and libs/pubsub/query (PEG query language:
+"tm.event='NewBlock' AND tx.height>5"). Backs types.EventBus and the RPC
+websocket `subscribe` route.
+
+The query language supports: key = 'value', key < / <= / > / >= number,
+key EXISTS, key CONTAINS 'substr', joined with AND. (OR is not in the
+reference grammar either.)
+"""
+from __future__ import annotations
+
+import asyncio
+import re
+from dataclasses import dataclass, field
+from typing import Any
+
+
+class QueryError(Exception):
+    pass
+
+
+_TOKEN = re.compile(
+    r"""\s*(?:
+      (?P<op><=|>=|=|<|>)
+    | (?P<and>AND\b)
+    | (?P<exists>EXISTS\b)
+    | (?P<contains>CONTAINS\b)
+    | (?P<str>'(?:[^'\\]|\\.)*')
+    | (?P<num>-?\d+(?:\.\d+)?)
+    | (?P<key>[A-Za-z_][\w.]*)
+    )""",
+    re.VERBOSE,
+)
+
+
+@dataclass(frozen=True)
+class Condition:
+    key: str
+    op: str  # '=', '<', '<=', '>', '>=', 'EXISTS', 'CONTAINS'
+    value: Any = None
+
+    def matches(self, events: dict[str, list[str]]) -> bool:
+        vals = events.get(self.key)
+        if vals is None:
+            return False
+        if self.op == "EXISTS":
+            return True
+        for v in vals:
+            if self.op == "=":
+                if v == str(self.value):
+                    return True
+            elif self.op == "CONTAINS":
+                if str(self.value) in v:
+                    return True
+            else:
+                try:
+                    fv = float(v)
+                except ValueError:
+                    continue
+                t = float(self.value)
+                if (
+                    (self.op == "<" and fv < t)
+                    or (self.op == "<=" and fv <= t)
+                    or (self.op == ">" and fv > t)
+                    or (self.op == ">=" and fv >= t)
+                ):
+                    return True
+        return False
+
+
+class Query:
+    """Parsed conjunction of conditions."""
+
+    def __init__(self, conditions: tuple[Condition, ...], source: str) -> None:
+        self.conditions = conditions
+        self._source = source
+
+    @classmethod
+    def parse(cls, s: str) -> "Query":
+        tokens = []
+        pos = 0
+        while pos < len(s):
+            m = _TOKEN.match(s, pos)
+            if not m or m.end() == pos:
+                if s[pos:].strip() == "":
+                    break
+                raise QueryError(f"bad query near {s[pos:pos+20]!r}")
+            pos = m.end()
+            kind = m.lastgroup
+            tokens.append((kind, m.group(kind)))
+        conds = []
+        i = 0
+        while i < len(tokens):
+            if tokens[i][0] != "key":
+                raise QueryError(f"expected key, got {tokens[i]}")
+            key = tokens[i][1]
+            i += 1
+            if i >= len(tokens):
+                raise QueryError("trailing key")
+            kind, tok = tokens[i]
+            if kind == "exists":
+                conds.append(Condition(key, "EXISTS"))
+                i += 1
+            elif kind == "contains":
+                i += 1
+                if i >= len(tokens) or tokens[i][0] != "str":
+                    raise QueryError("CONTAINS needs a string")
+                conds.append(Condition(key, "CONTAINS", _unquote(tokens[i][1])))
+                i += 1
+            elif kind == "op":
+                i += 1
+                if i >= len(tokens):
+                    raise QueryError("operator needs a value")
+                vkind, vtok = tokens[i]
+                if vkind == "str":
+                    if tok != "=":
+                        raise QueryError("strings only support =")
+                    conds.append(Condition(key, "=", _unquote(vtok)))
+                elif vkind == "num":
+                    val = float(vtok) if "." in vtok else int(vtok)
+                    conds.append(Condition(key, tok, val))
+                else:
+                    raise QueryError(f"bad value {vtok!r}")
+                i += 1
+            else:
+                raise QueryError(f"expected operator after {key!r}")
+            if i < len(tokens):
+                if tokens[i][0] != "and":
+                    raise QueryError("conditions must be joined with AND")
+                i += 1
+        return cls(tuple(conds), s)
+
+    def matches(self, events: dict[str, list[str]]) -> bool:
+        return all(c.matches(events) for c in self.conditions)
+
+    def __str__(self) -> str:
+        return self._source
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Query) and self.conditions == other.conditions
+
+    def __hash__(self) -> int:
+        return hash(self.conditions)
+
+
+def _unquote(s: str) -> str:
+    return s[1:-1].replace("\\'", "'")
+
+
+@dataclass
+class Message:
+    data: Any
+    events: dict[str, list[str]] = field(default_factory=dict)
+
+
+class Subscription:
+    def __init__(self, query: Query, buffer: int) -> None:
+        self.query = query
+        self._queue: asyncio.Queue[Message] = asyncio.Queue(maxsize=buffer or 0)
+        self.cancelled = asyncio.Event()
+        self.cancel_reason: str | None = None
+
+    async def next(self) -> Message:
+        get = asyncio.ensure_future(self._queue.get())
+        cancel = asyncio.ensure_future(self.cancelled.wait())
+        done, pending = await asyncio.wait(
+            {get, cancel}, return_when=asyncio.FIRST_COMPLETED
+        )
+        for p in pending:
+            p.cancel()
+        if get in done:
+            return get.result()
+        raise SubscriptionCancelled(self.cancel_reason or "cancelled")
+
+    def try_next(self) -> Message | None:
+        try:
+            return self._queue.get_nowait()
+        except asyncio.QueueEmpty:
+            return None
+
+
+class SubscriptionCancelled(Exception):
+    pass
+
+
+class Server:
+    """Async pub/sub with per-(subscriber, query) subscriptions.
+
+    Semantics follow the reference: a full subscriber buffer cancels the
+    subscription (slow-client protection) rather than blocking publishers.
+    """
+
+    def __init__(self, buffer: int = 1024) -> None:
+        self._buffer = buffer
+        self._subs: dict[tuple[str, Query], Subscription] = {}
+
+    def subscribe(self, subscriber: str, query: Query, buffer: int | None = None) -> Subscription:
+        key = (subscriber, query)
+        if key in self._subs:
+            raise ValueError("already subscribed")
+        sub = Subscription(query, self._buffer if buffer is None else buffer)
+        self._subs[key] = sub
+        return sub
+
+    def unsubscribe(self, subscriber: str, query: Query) -> None:
+        sub = self._subs.pop((subscriber, query), None)
+        if sub is not None:
+            sub.cancel_reason = "unsubscribed"
+            sub.cancelled.set()
+
+    def unsubscribe_all(self, subscriber: str) -> None:
+        for (s, q) in [k for k in self._subs if k[0] == subscriber]:
+            self.unsubscribe(s, q)
+
+    def num_clients(self) -> int:
+        return len({s for s, _ in self._subs})
+
+    def num_client_subscriptions(self, subscriber: str) -> int:
+        return sum(1 for s, _ in self._subs if s == subscriber)
+
+    async def publish(self, data: Any, events: dict[str, list[str]] | None = None) -> None:
+        events = events or {}
+        msg = Message(data, events)
+        for key, sub in list(self._subs.items()):
+            if sub.query.matches(events):
+                try:
+                    sub._queue.put_nowait(msg)
+                except asyncio.QueueFull:
+                    sub.cancel_reason = "client is too slow"
+                    sub.cancelled.set()
+                    self._subs.pop(key, None)

@@ -1,0 +1,84 @@
+"""Service lifecycle.
+
+Reference parity: libs/common/service.go:24,97 — `Service` interface +
+`BaseService` with start-once/stop-once semantics and a quit channel. Here
+services are asyncio-native: `start()`/`stop()` are coroutines, `wait()`
+awaits termination, and subclasses override `on_start`/`on_stop`.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+
+
+class AlreadyStarted(Exception):
+    pass
+
+
+class AlreadyStopped(Exception):
+    pass
+
+
+class BaseService:
+    """Start-once / stop-once lifecycle wrapper."""
+
+    def __init__(self, name: str | None = None, logger: logging.Logger | None = None):
+        self.name = name or type(self).__name__
+        self.logger = logger or logging.getLogger(self.name)
+        self._started = False
+        self._stopped = False
+        self._quit = asyncio.Event()
+        self._tasks: list[asyncio.Task] = []
+
+    @property
+    def is_running(self) -> bool:
+        return self._started and not self._stopped
+
+    async def start(self) -> None:
+        if self._started:
+            raise AlreadyStarted(self.name)
+        if self._stopped:
+            raise AlreadyStopped(self.name)
+        self._started = True
+        self.logger.debug("starting %s", self.name)
+        await self.on_start()
+
+    async def stop(self) -> None:
+        if self._stopped:
+            return
+        if not self._started:
+            self._stopped = True
+            self._quit.set()
+            return
+        self._stopped = True
+        self.logger.debug("stopping %s", self.name)
+        try:
+            await self.on_stop()
+        finally:
+            for t in self._tasks:
+                t.cancel()
+            for t in self._tasks:
+                try:
+                    await t
+                except (asyncio.CancelledError, Exception):
+                    pass
+            self._tasks.clear()
+            self._quit.set()
+
+    async def wait(self) -> None:
+        """Block until the service stops."""
+        await self._quit.wait()
+
+    def spawn(self, coro, name: str | None = None) -> asyncio.Task:
+        """Track a background task; cancelled automatically on stop
+        (the analog of a goroutine tied to the service's quit channel)."""
+        task = asyncio.create_task(coro, name=name or self.name)
+        self._tasks.append(task)
+        self._tasks = [t for t in self._tasks if not t.done()]
+        return task
+
+    async def on_start(self) -> None:  # override
+        pass
+
+    async def on_stop(self) -> None:  # override
+        pass

@@ -1,0 +1,240 @@
+"""libs substrate tests (mirrors reference libs/*/..._test.go)."""
+import asyncio
+import os
+
+import pytest
+
+from tendermint_tpu.libs import autofile, bit_array, clist, events, flowrate, log, pubsub
+from tendermint_tpu.libs.service import AlreadyStarted, BaseService
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestService:
+    def test_start_stop_once(self):
+        async def main():
+            svc = BaseService("t")
+            await svc.start()
+            assert svc.is_running
+            with pytest.raises(AlreadyStarted):
+                await svc.start()
+            await svc.stop()
+            assert not svc.is_running
+            await svc.stop()  # idempotent
+
+        run(main())
+
+    def test_spawn_cancelled_on_stop(self):
+        async def main():
+            svc = BaseService("t")
+            await svc.start()
+            started = asyncio.Event()
+
+            async def loops():
+                started.set()
+                while True:
+                    await asyncio.sleep(10)
+
+            t = svc.spawn(loops())
+            await started.wait()
+            await svc.stop()
+            assert t.cancelled() or t.done()
+
+        run(main())
+
+
+class TestBitArray:
+    def test_basic(self):
+        ba = bit_array.BitArray(10)
+        assert ba.is_empty()
+        ba.set_index(3, True)
+        ba.set_index(9, True)
+        assert ba.get_index(3) and ba.get_index(9)
+        assert not ba.get_index(4)
+        assert ba.num_true() == 2
+        assert ba.indices() == [3, 9]
+        assert not ba.set_index(10, True)
+
+    def test_ops(self):
+        a = bit_array.BitArray(8, 0b1100)
+        b = bit_array.BitArray(8, 0b1010)
+        assert a.or_(b)._bits == 0b1110
+        assert a.and_(b)._bits == 0b1000
+        assert a.sub(b)._bits == 0b0100
+        assert a.not_().get_index(0)
+
+    def test_pick_random(self):
+        ba = bit_array.BitArray(64)
+        ba.set_index(5, True)
+        ba.set_index(40, True)
+        seen = set()
+        for _ in range(50):
+            idx, ok = ba.pick_random()
+            assert ok
+            seen.add(idx)
+        assert seen <= {5, 40}
+        assert len(seen) == 2
+
+    def test_encode_roundtrip(self):
+        ba = bit_array.BitArray(13, 0b1010101010101)
+        assert bit_array.BitArray.decode(ba.encode()) == ba
+
+
+class TestEvents:
+    def test_fire(self):
+        sw = events.EventSwitch()
+        got = []
+        sw.add_listener_for_event("l1", "ev", got.append)
+        sw.fire_event("ev", 1)
+        sw.fire_event("other", 2)
+        sw.remove_listener("l1")
+        sw.fire_event("ev", 3)
+        assert got == [1]
+
+
+class TestPubsubQuery:
+    def test_parse_and_match(self):
+        q = pubsub.Query.parse("tm.event='NewBlock' AND tx.height>5")
+        assert q.matches({"tm.event": ["NewBlock"], "tx.height": ["6"]})
+        assert not q.matches({"tm.event": ["NewBlock"], "tx.height": ["5"]})
+        assert not q.matches({"tm.event": ["Tx"], "tx.height": ["6"]})
+
+    def test_exists_contains(self):
+        q = pubsub.Query.parse("account.name EXISTS AND account.owner CONTAINS 'Igor'")
+        assert q.matches({"account.name": ["x"], "account.owner": ["Igor Smith"]})
+        assert not q.matches({"account.owner": ["Igor"]})
+
+    def test_bad_queries(self):
+        for bad in ["=5", "key OR key2=1", "key ~ 3", "key='unterminated"]:
+            with pytest.raises(pubsub.QueryError):
+                pubsub.Query.parse(bad)
+
+    def test_pubsub_server(self):
+        async def main():
+            srv = pubsub.Server()
+            sub = srv.subscribe("c1", pubsub.Query.parse("tm.event='Tx'"))
+            await srv.publish("block", {"tm.event": ["NewBlock"]})
+            await srv.publish("tx1", {"tm.event": ["Tx"]})
+            msg = await sub.next()
+            assert msg.data == "tx1"
+            srv.unsubscribe("c1", pubsub.Query.parse("tm.event='Tx'"))
+            with pytest.raises(pubsub.SubscriptionCancelled):
+                await sub.next()
+
+        run(main())
+
+    def test_slow_client_cancelled(self):
+        async def main():
+            srv = pubsub.Server(buffer=1)
+            sub = srv.subscribe("c1", pubsub.Query.parse("k EXISTS"))
+            await srv.publish("a", {"k": ["1"]})
+            await srv.publish("b", {"k": ["1"]})  # overflows -> cancel
+            assert sub.cancelled.is_set()
+
+        run(main())
+
+
+class TestCList:
+    def test_push_remove_iterate(self):
+        async def main():
+            cl = clist.CList()
+            e1 = cl.push_back(1)
+            e2 = cl.push_back(2)
+            e3 = cl.push_back(3)
+            assert [e.value for e in cl] == [1, 2, 3]
+            cl.remove(e2)
+            assert [e.value for e in cl] == [1, 3]
+            assert len(cl) == 2
+            cl.remove(e1)
+            assert cl.front().value == 3
+
+        run(main())
+
+    def test_next_wait(self):
+        async def main():
+            cl = clist.CList()
+            e1 = cl.push_back(1)
+
+            async def waiter():
+                return await e1.next_wait()
+
+            t = asyncio.create_task(waiter())
+            await asyncio.sleep(0.01)
+            assert not t.done()
+            cl.push_back(2)
+            nxt = await asyncio.wait_for(t, 1)
+            assert nxt.value == 2
+
+        run(main())
+
+    def test_front_wait(self):
+        async def main():
+            cl = clist.CList()
+
+            async def waiter():
+                return await cl.front_wait()
+
+            t = asyncio.create_task(waiter())
+            await asyncio.sleep(0.01)
+            cl.push_back(42)
+            el = await asyncio.wait_for(t, 1)
+            assert el.value == 42
+
+        run(main())
+
+
+class TestAutofile:
+    def test_write_rotate_read(self, tmp_path):
+        head = str(tmp_path / "wal" / "wal")
+        g = autofile.Group(head, head_size_limit=100)
+        g.write(b"A" * 80)
+        g.maybe_rotate()
+        assert g.max_index() == -1  # under limit
+        g.write(b"B" * 40)
+        g.maybe_rotate()  # 120 > 100 -> rotated
+        assert g.max_index() == 0
+        g.write(b"C" * 10)
+        g.flush_sync()
+        data = b"".join(g.read_all())
+        assert data == b"A" * 80 + b"B" * 40 + b"C" * 10
+        g.close()
+
+    def test_reader_continuity(self, tmp_path):
+        head = str(tmp_path / "g")
+        g = autofile.Group(head, head_size_limit=10)
+        for i in range(5):
+            g.write(bytes([i]) * 8)
+            g.maybe_rotate()
+        r = g.reader()
+        assert r.read() == b"".join(bytes([i]) * 8 for i in range(5))
+        g.close()
+
+
+class TestFlowrate:
+    def test_limit(self):
+        m = flowrate.Monitor()
+        # nothing sent yet: limit allows roughly rate*elapsed bytes
+        allowed = m.limit(10**9, 1000.0)
+        assert 0 <= allowed < 10**6
+        m.update(500)
+        st = m.status()
+        assert st.bytes == 500
+
+
+class TestLog:
+    def test_levels_and_context(self):
+        import io
+
+        buf = io.StringIO()
+        lg = log.Logger("consensus", sink=buf, levels=log.parse_log_level("consensus:debug,*:error"))
+        lg.debug("dbg", height=5)
+        lg2 = lg.module_logger("p2p")
+        lg2.info("hidden")
+        out = buf.getvalue()
+        assert "dbg" in out and "hidden" not in out
+
+    def test_parse_spec(self):
+        lv = log.parse_log_level("consensus:debug,*:info")
+        assert lv["consensus"] == 10 and lv["*"] == 20
