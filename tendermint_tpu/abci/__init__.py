@@ -1,0 +1,36 @@
+"""ABCI — the application blockchain interface.
+
+Reference parity: abci/types/application.go:11-30 (the 11-method
+Application interface), abci/client (socket/local clients with async
+pipelining), abci/server, abci/example (kvstore/counter test fixtures).
+Wire format here is CBE-framed (u32 length + 1-byte tag + payload) instead
+of length-prefixed protobuf; semantics are unchanged.
+"""
+from tendermint_tpu.abci.types import (  # noqa: F401
+    CODE_TYPE_OK,
+    Application,
+    BaseApplication,
+    RequestBeginBlock,
+    RequestCheckTx,
+    RequestCommit,
+    RequestDeliverTx,
+    RequestEcho,
+    RequestEndBlock,
+    RequestFlush,
+    RequestInfo,
+    RequestInitChain,
+    RequestQuery,
+    RequestSetOption,
+    ResponseBeginBlock,
+    ResponseCheckTx,
+    ResponseCommit,
+    ResponseDeliverTx,
+    ResponseEcho,
+    ResponseEndBlock,
+    ResponseFlush,
+    ResponseInfo,
+    ResponseInitChain,
+    ResponseQuery,
+    ResponseSetOption,
+    ValidatorUpdate,
+)

@@ -1,0 +1,211 @@
+"""ABCI clients.
+
+Reference parity: abci/client/client.go:21 (Client = async+sync API),
+abci/client/local_client.go:16 (in-process, global lock),
+abci/client/socket_client.go:26,122,154 (pipelined request queue + FIFO
+response matching over a length-prefixed socket).
+
+Async methods return awaitables; the "Sync" variants of the reference are
+just `await` here. Pipelining: `deliver_tx_async` enqueues without waiting;
+`flush` drains the pipeline — exactly the reference's usage pattern in
+state/execution.go:284-293.
+"""
+from __future__ import annotations
+
+import asyncio
+import struct
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.types import (
+    decode_response,
+    encode_request,
+)
+from tendermint_tpu.libs.service import BaseService
+
+
+class ABCIClientError(Exception):
+    pass
+
+
+class Client(BaseService):
+    """Interface: one async method per ABCI request + flush."""
+
+    async def echo(self, message: str) -> abci.ResponseEcho: ...
+    async def info(self, req: abci.RequestInfo) -> abci.ResponseInfo: ...
+    async def set_option(self, req: abci.RequestSetOption) -> abci.ResponseSetOption: ...
+    async def query(self, req: abci.RequestQuery) -> abci.ResponseQuery: ...
+    async def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx: ...
+    async def init_chain(self, req: abci.RequestInitChain) -> abci.ResponseInitChain: ...
+    async def begin_block(self, req: abci.RequestBeginBlock) -> abci.ResponseBeginBlock: ...
+    async def deliver_tx(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx: ...
+    async def end_block(self, req: abci.RequestEndBlock) -> abci.ResponseEndBlock: ...
+    async def commit(self) -> abci.ResponseCommit: ...
+    async def flush(self) -> None: ...
+
+    def deliver_tx_async(self, req: abci.RequestDeliverTx) -> "asyncio.Future":
+        """Pipelined delivery; result available after flush()."""
+        raise NotImplementedError
+
+    def check_tx_async(self, req: abci.RequestCheckTx) -> "asyncio.Future":
+        raise NotImplementedError
+
+
+class LocalClient(Client):
+    """In-process app behind one lock (reference local_client.go:16)."""
+
+    def __init__(self, app: abci.Application, lock: asyncio.Lock | None = None) -> None:
+        super().__init__("LocalABCIClient")
+        self.app = app
+        # one shared lock per app across the 3 proxy connections, like the
+        # reference's global mutex
+        self._lock = lock or asyncio.Lock()
+
+    async def _call(self, fn, *args):
+        async with self._lock:
+            return fn(*args)
+
+    async def echo(self, message: str) -> abci.ResponseEcho:
+        return abci.ResponseEcho(message)
+
+    async def info(self, req):
+        return await self._call(self.app.info, req)
+
+    async def set_option(self, req):
+        return await self._call(self.app.set_option, req)
+
+    async def query(self, req):
+        return await self._call(self.app.query, req)
+
+    async def check_tx(self, req):
+        return await self._call(self.app.check_tx, req)
+
+    async def init_chain(self, req):
+        return await self._call(self.app.init_chain, req)
+
+    async def begin_block(self, req):
+        return await self._call(self.app.begin_block, req)
+
+    async def deliver_tx(self, req):
+        return await self._call(self.app.deliver_tx, req)
+
+    async def end_block(self, req):
+        return await self._call(self.app.end_block, req)
+
+    async def commit(self):
+        return await self._call(self.app.commit)
+
+    async def flush(self) -> None:
+        return None
+
+    def deliver_tx_async(self, req):
+        return asyncio.ensure_future(self.deliver_tx(req))
+
+    def check_tx_async(self, req):
+        return asyncio.ensure_future(self.check_tx(req))
+
+
+class SocketClient(Client):
+    """Length-prefixed framed protocol over TCP or unix socket, pipelined:
+    requests are written immediately, responses matched FIFO
+    (reference socket_client.go:122,154)."""
+
+    def __init__(self, address: str) -> None:
+        super().__init__("SocketABCIClient")
+        self.address = address
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._pending: asyncio.Queue[asyncio.Future] = asyncio.Queue()
+        self._conn_err: Exception | None = None
+
+    async def on_start(self) -> None:
+        if self.address.startswith("unix://"):
+            self._reader, self._writer = await asyncio.open_unix_connection(
+                self.address[len("unix://") :]
+            )
+        else:
+            host, port = self.address.replace("tcp://", "").rsplit(":", 1)
+            self._reader, self._writer = await asyncio.open_connection(host, int(port))
+        self.spawn(self._recv_routine(), "abci-recv")
+
+    async def on_stop(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+
+    async def _recv_routine(self) -> None:
+        try:
+            while True:
+                hdr = await self._reader.readexactly(4)
+                (ln,) = struct.unpack(">I", hdr)
+                payload = await self._reader.readexactly(ln)
+                resp = decode_response(payload)
+                fut = self._pending.get_nowait()
+                if isinstance(resp, abci.ResponseException):
+                    fut.set_exception(ABCIClientError(resp.error))
+                else:
+                    fut.set_result(resp)
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.QueueEmpty) as e:
+            self._conn_err = e
+            while not self._pending.empty():
+                fut = self._pending.get_nowait()
+                if not fut.done():
+                    fut.set_exception(ABCIClientError(f"connection lost: {e}"))
+        except asyncio.CancelledError:
+            pass
+
+    def _send(self, req) -> asyncio.Future:
+        if self._conn_err is not None:
+            raise ABCIClientError(f"connection lost: {self._conn_err}")
+        payload = encode_request(req)
+        self._writer.write(struct.pack(">I", len(payload)) + payload)
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._pending.put_nowait(fut)
+        return fut
+
+    async def _send_wait(self, req):
+        fut = self._send(req)
+        await self._drain()
+        return await fut
+
+    async def _drain(self) -> None:
+        await self._writer.drain()
+
+    async def echo(self, message: str):
+        return await self._send_wait(abci.RequestEcho(message))
+
+    async def info(self, req):
+        return await self._send_wait(req)
+
+    async def set_option(self, req):
+        return await self._send_wait(req)
+
+    async def query(self, req):
+        return await self._send_wait(req)
+
+    async def check_tx(self, req):
+        return await self._send_wait(req)
+
+    async def init_chain(self, req):
+        return await self._send_wait(req)
+
+    async def begin_block(self, req):
+        return await self._send_wait(req)
+
+    async def deliver_tx(self, req):
+        return await self._send_wait(req)
+
+    async def end_block(self, req):
+        return await self._send_wait(req)
+
+    async def commit(self):
+        return await self._send_wait(abci.RequestCommit())
+
+    async def flush(self) -> None:
+        fut = self._send(abci.RequestFlush())
+        await self._drain()
+        await fut
+
+    def deliver_tx_async(self, req):
+        return self._send(req)
+
+    def check_tx_async(self, req):
+        return self._send(req)

@@ -1,0 +1,7 @@
+"""Example ABCI applications — the standard test fixtures
+(reference abci/example/: kvstore, persistent_kvstore, counter)."""
+from tendermint_tpu.abci.examples.counter import CounterApplication  # noqa: F401
+from tendermint_tpu.abci.examples.kvstore import (  # noqa: F401
+    KVStoreApplication,
+    PersistentKVStoreApplication,
+)

@@ -1,0 +1,56 @@
+"""Counter example app (reference abci/example/counter/counter.go:11):
+optionally-serial nonce application used by mempool and consensus tests."""
+from __future__ import annotations
+
+from tendermint_tpu.abci import types as abci
+
+
+class CounterApplication(abci.BaseApplication):
+    def __init__(self, serial: bool = False) -> None:
+        self.serial = serial
+        self.tx_count = 0
+        self.height = 0
+
+    def info(self, req: abci.RequestInfo) -> abci.ResponseInfo:
+        return abci.ResponseInfo(
+            data=f"txs:{self.tx_count}",
+            last_block_height=self.height,
+            last_block_app_hash=self._hash() if self.height else b"",
+        )
+
+    def set_option(self, req: abci.RequestSetOption) -> abci.ResponseSetOption:
+        if req.key == "serial":
+            self.serial = req.value == "on"
+        return abci.ResponseSetOption()
+
+    def _nonce(self, tx: bytes) -> int:
+        return int.from_bytes(tx, "big") if tx else 0
+
+    def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
+        if self.serial:
+            if len(req.tx) > 8:
+                return abci.ResponseCheckTx(code=1, log="tx too big")
+            if self._nonce(req.tx) < self.tx_count:
+                return abci.ResponseCheckTx(code=2, log="nonce too low")
+        return abci.ResponseCheckTx(code=abci.CODE_TYPE_OK)
+
+    def deliver_tx(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx:
+        if self.serial:
+            if self._nonce(req.tx) != self.tx_count:
+                return abci.ResponseDeliverTx(
+                    code=2, log=f"expected nonce {self.tx_count}"
+                )
+        self.tx_count += 1
+        return abci.ResponseDeliverTx(code=abci.CODE_TYPE_OK)
+
+    def end_block(self, req: abci.RequestEndBlock) -> abci.ResponseEndBlock:
+        self.height = req.height
+        return abci.ResponseEndBlock()
+
+    def _hash(self) -> bytes:
+        return self.tx_count.to_bytes(8, "big")
+
+    def commit(self) -> abci.ResponseCommit:
+        if self.tx_count == 0 and self.height <= 1:
+            return abci.ResponseCommit(data=b"")
+        return abci.ResponseCommit(data=self._hash())

@@ -1,0 +1,172 @@
+"""KVStore example app.
+
+Reference parity: abci/example/kvstore/kvstore.go:59 (merkle KV app; txs are
+"key=value" or "val" meaning key==value; Query supports /store with
+optional merkle proofs) and persistent_kvstore.go:26,172 (adds disk
+persistence, InitChain validator bookkeeping, and "val:PUBKEY!POWER"
+transactions that produce EndBlock validator updates).
+"""
+from __future__ import annotations
+
+import json
+import os
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.crypto import merkle, sum_sha256
+from tendermint_tpu.encoding import Writer
+
+VALIDATOR_TX_PREFIX = b"val:"
+
+
+class KVStoreApplication(abci.BaseApplication):
+    def __init__(self) -> None:
+        self.state: dict[str, bytes] = {}
+        self.height = 0
+        self.app_hash = b""
+        self.tx_count = 0
+
+    # -- helpers ------------------------------------------------------------
+
+    def _compute_app_hash(self) -> bytes:
+        return merkle.hash_from_map({k: sum_sha256(v) for k, v in self.state.items()})
+
+    @staticmethod
+    def _parse_tx(tx: bytes) -> tuple[str, bytes]:
+        if b"=" in tx:
+            k, v = tx.split(b"=", 1)
+        else:
+            k, v = tx, tx
+        return k.decode("utf-8", "replace"), v
+
+    # -- ABCI ---------------------------------------------------------------
+
+    def info(self, req: abci.RequestInfo) -> abci.ResponseInfo:
+        return abci.ResponseInfo(
+            data=json.dumps({"size": len(self.state)}),
+            version="kvstore/0.1.0",
+            last_block_height=self.height,
+            last_block_app_hash=self.app_hash,
+        )
+
+    def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
+        return abci.ResponseCheckTx(code=abci.CODE_TYPE_OK, gas_wanted=1)
+
+    def deliver_tx(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx:
+        key, value = self._parse_tx(req.tx)
+        self.state[key] = value
+        self.tx_count += 1
+        return abci.ResponseDeliverTx(
+            code=abci.CODE_TYPE_OK,
+            events={"app.creator": ["kvstore"], "app.key": [key]},
+        )
+
+    def end_block(self, req: abci.RequestEndBlock) -> abci.ResponseEndBlock:
+        self.height = req.height
+        return abci.ResponseEndBlock()
+
+    def commit(self) -> abci.ResponseCommit:
+        self.app_hash = self._compute_app_hash()
+        return abci.ResponseCommit(data=self.app_hash)
+
+    def query(self, req: abci.RequestQuery) -> abci.ResponseQuery:
+        key = req.data.decode("utf-8", "replace")
+        value = self.state.get(key)
+        resp = abci.ResponseQuery(
+            code=abci.CODE_TYPE_OK,
+            key=req.data,
+            value=value if value is not None else b"",
+            height=self.height,
+            log="exists" if value is not None else "does not exist",
+        )
+        if req.prove and value is not None:
+            # merkle proof of (key, sha256(value)) in the sorted state map
+            items, keys = [], sorted(self.state)
+            for k in keys:
+                items.append(Writer().str(k).bytes(sum_sha256(self.state[k])).build())
+            root, proofs = merkle.proofs_from_byte_slices(items)
+            idx = keys.index(key)
+            op = merkle.SimpleValueOp(req.data, proofs[idx])
+            resp.proof_ops = [op.proof_op()]
+        return resp
+
+
+class PersistentKVStoreApplication(KVStoreApplication):
+    """Adds disk persistence + validator-update transactions
+    (reference persistent_kvstore.go)."""
+
+    def __init__(self, db_dir: str) -> None:
+        super().__init__()
+        self.db_dir = db_dir
+        os.makedirs(db_dir, exist_ok=True)
+        self._db_path = os.path.join(db_dir, "kvstore_state.json")
+        self.validators: dict[str, int] = {}  # pubkey hex -> power
+        self._pending_updates: list[abci.ValidatorUpdate] = []
+        self._load()
+
+    def _load(self) -> None:
+        if os.path.exists(self._db_path):
+            with open(self._db_path) as f:
+                d = json.load(f)
+            self.state = {k: bytes.fromhex(v) for k, v in d["state"].items()}
+            self.height = d["height"]
+            self.app_hash = bytes.fromhex(d["app_hash"])
+            self.validators = d.get("validators", {})
+
+    def _save(self) -> None:
+        with open(self._db_path, "w") as f:
+            json.dump(
+                {
+                    "state": {k: v.hex() for k, v in self.state.items()},
+                    "height": self.height,
+                    "app_hash": self.app_hash.hex(),
+                    "validators": self.validators,
+                },
+                f,
+            )
+
+    def init_chain(self, req: abci.RequestInitChain) -> abci.ResponseInitChain:
+        for vu in req.validators:
+            self.validators[vu.pub_key.hex()] = vu.power
+        return abci.ResponseInitChain()
+
+    def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
+        if req.tx.startswith(VALIDATOR_TX_PREFIX):
+            if self._parse_validator_tx(req.tx) is None:
+                return abci.ResponseCheckTx(code=1, log="bad validator tx")
+        return abci.ResponseCheckTx(code=abci.CODE_TYPE_OK, gas_wanted=1)
+
+    @staticmethod
+    def _parse_validator_tx(tx: bytes) -> tuple[bytes, int] | None:
+        # format: val:<pubkey hex>!<power>
+        body = tx[len(VALIDATOR_TX_PREFIX) :]
+        if b"!" not in body:
+            return None
+        pk_hex, power_s = body.split(b"!", 1)
+        try:
+            return bytes.fromhex(pk_hex.decode()), int(power_s)
+        except ValueError:
+            return None
+
+    def deliver_tx(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx:
+        if req.tx.startswith(VALIDATOR_TX_PREFIX):
+            parsed = self._parse_validator_tx(req.tx)
+            if parsed is None:
+                return abci.ResponseDeliverTx(code=1, log="bad validator tx")
+            pub_key, power = parsed
+            self._pending_updates.append(abci.ValidatorUpdate(pub_key, power))
+            if power == 0:
+                self.validators.pop(pub_key.hex(), None)
+            else:
+                self.validators[pub_key.hex()] = power
+            return abci.ResponseDeliverTx(code=abci.CODE_TYPE_OK)
+        return super().deliver_tx(req)
+
+    def end_block(self, req: abci.RequestEndBlock) -> abci.ResponseEndBlock:
+        self.height = req.height
+        updates, self._pending_updates = self._pending_updates, []
+        return abci.ResponseEndBlock(validator_updates=updates)
+
+    def commit(self) -> abci.ResponseCommit:
+        resp = super().commit()
+        self._save()
+        return resp

@@ -1,0 +1,86 @@
+"""ABCI socket server (reference abci/server/socket_server.go).
+
+Serves an Application over the length-prefixed framed protocol; requests
+from one connection are processed in order (the protocol is ordered), but
+multiple connections (consensus/mempool/query) are independent, matching
+proxy.AppConns' three connections.
+"""
+from __future__ import annotations
+
+import asyncio
+import struct
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.types import decode_request, encode_response
+from tendermint_tpu.libs.service import BaseService
+
+
+class ABCIServer(BaseService):
+    def __init__(self, app: abci.Application, address: str) -> None:
+        super().__init__("ABCIServer")
+        self.app = app
+        self.address = address
+        self._server: asyncio.AbstractServer | None = None
+
+    async def on_start(self) -> None:
+        if self.address.startswith("unix://"):
+            self._server = await asyncio.start_unix_server(
+                self._handle, self.address[len("unix://") :]
+            )
+        else:
+            host, port = self.address.replace("tcp://", "").rsplit(":", 1)
+            self._server = await asyncio.start_server(self._handle, host, int(port))
+
+    async def on_stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    @property
+    def port(self) -> int:
+        return self._server.sockets[0].getsockname()[1]
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                hdr = await reader.readexactly(4)
+                (ln,) = struct.unpack(">I", hdr)
+                req = decode_request(await reader.readexactly(ln))
+                try:
+                    resp = self._dispatch(req)
+                except Exception as e:  # app panic -> exception response
+                    resp = abci.ResponseException(str(e))
+                payload = encode_response(resp)
+                writer.write(struct.pack(">I", len(payload)) + payload)
+                if isinstance(req, abci.RequestFlush):
+                    await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+
+    def _dispatch(self, req):
+        a = self.app
+        if isinstance(req, abci.RequestEcho):
+            return abci.ResponseEcho(req.message)
+        if isinstance(req, abci.RequestFlush):
+            return abci.ResponseFlush()
+        if isinstance(req, abci.RequestInfo):
+            return a.info(req)
+        if isinstance(req, abci.RequestSetOption):
+            return a.set_option(req)
+        if isinstance(req, abci.RequestInitChain):
+            return a.init_chain(req)
+        if isinstance(req, abci.RequestQuery):
+            return a.query(req)
+        if isinstance(req, abci.RequestBeginBlock):
+            return a.begin_block(req)
+        if isinstance(req, abci.RequestCheckTx):
+            return a.check_tx(req)
+        if isinstance(req, abci.RequestDeliverTx):
+            return a.deliver_tx(req)
+        if isinstance(req, abci.RequestEndBlock):
+            return a.end_block(req)
+        if isinstance(req, abci.RequestCommit):
+            return a.commit()
+        return abci.ResponseException(f"unknown request {req!r}")

@@ -1,0 +1,473 @@
+"""ABCI message types + Application interface.
+
+Reference parity: abci/types/application.go:11-30 and the Request/Response
+oneof in abci/types/types.proto. Messages are plain dataclasses with CBE
+encode/decode (tagged union for the socket protocol). `events` on
+CheckTx/DeliverTx are the reference's kv tag pairs feeding the tx indexer
+and pubsub filters.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tendermint_tpu.encoding import DecodeError, Reader, Writer
+
+CODE_TYPE_OK = 0
+
+
+# ---------------------------------------------------------------------------
+# auxiliary payload types
+
+
+@dataclass
+class ValidatorUpdate:
+    """abci.ValidatorUpdate: pubkey (CBE-encoded crypto pubkey) + power."""
+
+    pub_key: bytes  # crypto.encode_pubkey output
+    power: int
+
+    def encode_into(self, w: Writer) -> None:
+        w.bytes(self.pub_key).i64(self.power)
+
+    @classmethod
+    def read(cls, r: Reader) -> "ValidatorUpdate":
+        return cls(r.bytes(), r.i64())
+
+
+@dataclass
+class VoteInfo:
+    """Per-validator commit participation, passed to BeginBlock."""
+
+    address: bytes
+    power: int
+    signed_last_block: bool
+
+    def encode_into(self, w: Writer) -> None:
+        w.bytes(self.address).i64(self.power).bool(self.signed_last_block)
+
+    @classmethod
+    def read(cls, r: Reader) -> "VoteInfo":
+        return cls(r.bytes(), r.i64(), r.bool())
+
+
+@dataclass
+class EvidenceInfo:
+    type: str
+    address: bytes
+    height: int
+    total_voting_power: int
+
+    def encode_into(self, w: Writer) -> None:
+        w.str(self.type).bytes(self.address).u64(self.height).i64(self.total_voting_power)
+
+    @classmethod
+    def read(cls, r: Reader) -> "EvidenceInfo":
+        return cls(r.str(), r.bytes(), r.u64(), r.i64())
+
+
+def _encode_events(w: Writer, events: dict[str, list[str]]) -> None:
+    w.u32(len(events))
+    for k in sorted(events):
+        w.str(k)
+        w.u32(len(events[k]))
+        for v in events[k]:
+            w.str(v)
+
+
+def _read_events(r: Reader) -> dict[str, list[str]]:
+    out: dict[str, list[str]] = {}
+    for _ in range(r.u32()):
+        k = r.str()
+        out[k] = [r.str() for _ in range(r.u32())]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# requests
+
+
+@dataclass
+class RequestEcho:
+    message: str = ""
+
+
+@dataclass
+class RequestFlush:
+    pass
+
+
+@dataclass
+class RequestInfo:
+    version: str = ""
+    block_version: int = 0
+    p2p_version: int = 0
+
+
+@dataclass
+class RequestSetOption:
+    key: str = ""
+    value: str = ""
+
+
+@dataclass
+class RequestInitChain:
+    time: int = 0
+    chain_id: str = ""
+    consensus_params: bytes = b""  # encoded ConsensusParams
+    validators: list[ValidatorUpdate] = field(default_factory=list)
+    app_state_bytes: bytes = b""
+
+
+@dataclass
+class RequestQuery:
+    data: bytes = b""
+    path: str = ""
+    height: int = 0
+    prove: bool = False
+
+
+@dataclass
+class RequestBeginBlock:
+    hash: bytes = b""
+    header: bytes = b""  # encoded types.Header
+    last_commit_votes: list[VoteInfo] = field(default_factory=list)
+    byzantine_validators: list[EvidenceInfo] = field(default_factory=list)
+
+
+@dataclass
+class RequestCheckTx:
+    tx: bytes = b""
+    new_check: bool = True  # False = recheck after a block commit
+
+
+@dataclass
+class RequestDeliverTx:
+    tx: bytes = b""
+
+
+@dataclass
+class RequestEndBlock:
+    height: int = 0
+
+
+@dataclass
+class RequestCommit:
+    pass
+
+
+# ---------------------------------------------------------------------------
+# responses
+
+
+@dataclass
+class ResponseEcho:
+    message: str = ""
+
+
+@dataclass
+class ResponseFlush:
+    pass
+
+
+@dataclass
+class ResponseInfo:
+    data: str = ""
+    version: str = ""
+    app_version: int = 0
+    last_block_height: int = 0
+    last_block_app_hash: bytes = b""
+
+
+@dataclass
+class ResponseSetOption:
+    code: int = CODE_TYPE_OK
+    log: str = ""
+
+
+@dataclass
+class ResponseInitChain:
+    consensus_params: bytes = b""
+    validators: list[ValidatorUpdate] = field(default_factory=list)
+
+
+@dataclass
+class ResponseQuery:
+    code: int = CODE_TYPE_OK
+    log: str = ""
+    info: str = ""
+    index: int = 0
+    key: bytes = b""
+    value: bytes = b""
+    proof_ops: list = field(default_factory=list)  # list[merkle.ProofOp]
+    height: int = 0
+    codespace: str = ""
+
+    @property
+    def is_ok(self) -> bool:
+        return self.code == CODE_TYPE_OK
+
+
+@dataclass
+class ResponseBeginBlock:
+    events: dict[str, list[str]] = field(default_factory=dict)
+
+
+@dataclass
+class ResponseCheckTx:
+    code: int = CODE_TYPE_OK
+    data: bytes = b""
+    log: str = ""
+    info: str = ""
+    gas_wanted: int = 0
+    gas_used: int = 0
+    events: dict[str, list[str]] = field(default_factory=dict)
+    codespace: str = ""
+
+    @property
+    def is_ok(self) -> bool:
+        return self.code == CODE_TYPE_OK
+
+    def encode(self) -> bytes:
+        w = Writer().u32(self.code).bytes(self.data).str(self.log)
+        w.i64(self.gas_wanted).i64(self.gas_used)
+        _encode_events(w, self.events)
+        return w.build()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "ResponseCheckTx":
+        r = Reader(data)
+        out = cls(
+            code=r.u32(), data=r.bytes(), log=r.str(), gas_wanted=r.i64(), gas_used=r.i64()
+        )
+        out.events = _read_events(r)
+        return out
+
+
+@dataclass
+class ResponseDeliverTx:
+    code: int = CODE_TYPE_OK
+    data: bytes = b""
+    log: str = ""
+    info: str = ""
+    gas_wanted: int = 0
+    gas_used: int = 0
+    events: dict[str, list[str]] = field(default_factory=dict)
+    codespace: str = ""
+
+    @property
+    def is_ok(self) -> bool:
+        return self.code == CODE_TYPE_OK
+
+    def encode(self) -> bytes:
+        w = Writer().u32(self.code).bytes(self.data).str(self.log)
+        w.i64(self.gas_wanted).i64(self.gas_used)
+        _encode_events(w, self.events)
+        return w.build()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "ResponseDeliverTx":
+        r = Reader(data)
+        out = cls(
+            code=r.u32(), data=r.bytes(), log=r.str(), gas_wanted=r.i64(), gas_used=r.i64()
+        )
+        out.events = _read_events(r)
+        return out
+
+
+@dataclass
+class ResponseEndBlock:
+    validator_updates: list[ValidatorUpdate] = field(default_factory=list)
+    consensus_param_updates: bytes = b""
+    events: dict[str, list[str]] = field(default_factory=dict)
+
+
+@dataclass
+class ResponseCommit:
+    data: bytes = b""  # the app hash
+
+
+@dataclass
+class ResponseException:
+    error: str = ""
+
+
+# ---------------------------------------------------------------------------
+# Application interface
+
+
+class Application:
+    """Reference abci/types/application.go:11-30."""
+
+    def info(self, req: RequestInfo) -> ResponseInfo: ...
+
+    def set_option(self, req: RequestSetOption) -> ResponseSetOption: ...
+
+    def query(self, req: RequestQuery) -> ResponseQuery: ...
+
+    def check_tx(self, req: RequestCheckTx) -> ResponseCheckTx: ...
+
+    def init_chain(self, req: RequestInitChain) -> ResponseInitChain: ...
+
+    def begin_block(self, req: RequestBeginBlock) -> ResponseBeginBlock: ...
+
+    def deliver_tx(self, req: RequestDeliverTx) -> ResponseDeliverTx: ...
+
+    def end_block(self, req: RequestEndBlock) -> ResponseEndBlock: ...
+
+    def commit(self) -> ResponseCommit: ...
+
+
+class BaseApplication(Application):
+    """No-op base (reference abci/types/application.go:33)."""
+
+    def info(self, req: RequestInfo) -> ResponseInfo:
+        return ResponseInfo()
+
+    def set_option(self, req: RequestSetOption) -> ResponseSetOption:
+        return ResponseSetOption()
+
+    def query(self, req: RequestQuery) -> ResponseQuery:
+        return ResponseQuery(code=CODE_TYPE_OK)
+
+    def check_tx(self, req: RequestCheckTx) -> ResponseCheckTx:
+        return ResponseCheckTx(code=CODE_TYPE_OK)
+
+    def init_chain(self, req: RequestInitChain) -> ResponseInitChain:
+        return ResponseInitChain()
+
+    def begin_block(self, req: RequestBeginBlock) -> ResponseBeginBlock:
+        return ResponseBeginBlock()
+
+    def deliver_tx(self, req: RequestDeliverTx) -> ResponseDeliverTx:
+        return ResponseDeliverTx(code=CODE_TYPE_OK)
+
+    def end_block(self, req: RequestEndBlock) -> ResponseEndBlock:
+        return ResponseEndBlock()
+
+    def commit(self) -> ResponseCommit:
+        return ResponseCommit()
+
+
+# ---------------------------------------------------------------------------
+# socket wire codec: tagged union
+
+_REQ_TAGS: list[tuple[int, type]] = [
+    (1, RequestEcho),
+    (2, RequestFlush),
+    (3, RequestInfo),
+    (4, RequestSetOption),
+    (5, RequestInitChain),
+    (6, RequestQuery),
+    (7, RequestBeginBlock),
+    (8, RequestCheckTx),
+    (9, RequestDeliverTx),
+    (10, RequestEndBlock),
+    (11, RequestCommit),
+]
+_RESP_TAGS: list[tuple[int, type]] = [
+    (1, ResponseEcho),
+    (2, ResponseFlush),
+    (3, ResponseInfo),
+    (4, ResponseSetOption),
+    (5, ResponseInitChain),
+    (6, ResponseQuery),
+    (7, ResponseBeginBlock),
+    (8, ResponseCheckTx),
+    (9, ResponseDeliverTx),
+    (10, ResponseEndBlock),
+    (11, ResponseCommit),
+    (12, ResponseException),
+]
+
+
+def _encode_msg(msg) -> bytes:
+    """Generic dataclass field encoder (schema fixed by field order)."""
+    w = Writer()
+    for name, val in vars(msg).items():
+        if isinstance(val, bool):
+            w.bool(val)
+        elif isinstance(val, int):
+            w.i64(val)
+        elif isinstance(val, bytes):
+            w.bytes(val)
+        elif isinstance(val, str):
+            w.str(val)
+        elif isinstance(val, dict):
+            _encode_events(w, val)
+        elif isinstance(val, list):
+            w.u32(len(val))
+            for item in val:
+                if hasattr(item, "encode_into"):
+                    item.encode_into(w)
+                else:  # merkle.ProofOp
+                    from tendermint_tpu.crypto.merkle import ProofOp
+
+                    assert isinstance(item, ProofOp)
+                    w.str(item.type).bytes(item.key).bytes(item.data)
+        else:
+            raise TypeError(f"cannot encode field {name}={val!r}")
+    return w.build()
+
+
+def _decode_msg(cls, data: bytes):
+    import dataclasses as dc
+
+    r = Reader(data)
+    kwargs = {}
+    for f in dc.fields(cls):
+        if f.type in ("bool", bool):
+            kwargs[f.name] = r.bool()
+        elif f.type in ("int", int):
+            kwargs[f.name] = r.i64()
+        elif f.type in ("bytes", bytes):
+            kwargs[f.name] = r.bytes()
+        elif f.type in ("str", str):
+            kwargs[f.name] = r.str()
+        elif "dict" in str(f.type):
+            kwargs[f.name] = _read_events(r)
+        elif "ValidatorUpdate" in str(f.type):
+            kwargs[f.name] = [ValidatorUpdate.read(r) for _ in range(r.u32())]
+        elif "VoteInfo" in str(f.type):
+            kwargs[f.name] = [VoteInfo.read(r) for _ in range(r.u32())]
+        elif "EvidenceInfo" in str(f.type):
+            kwargs[f.name] = [EvidenceInfo.read(r) for _ in range(r.u32())]
+        elif f.name == "proof_ops":
+            from tendermint_tpu.crypto.merkle import ProofOp
+
+            kwargs[f.name] = [
+                ProofOp(r.str(), r.bytes(), r.bytes()) for _ in range(r.u32())
+            ]
+        else:
+            raise TypeError(f"cannot decode field {f.name}: {f.type}")
+    r.expect_done()
+    return cls(**kwargs)
+
+
+def encode_request(req) -> bytes:
+    for tag, cls in _REQ_TAGS:
+        if type(req) is cls:
+            return bytes([tag]) + _encode_msg(req)
+    raise TypeError(f"unknown request {req!r}")
+
+
+def decode_request(data: bytes):
+    tag = data[0]
+    for t, cls in _REQ_TAGS:
+        if t == tag:
+            return _decode_msg(cls, data[1:])
+    raise DecodeError(f"unknown request tag {tag}")
+
+
+def encode_response(resp) -> bytes:
+    for tag, cls in _RESP_TAGS:
+        if type(resp) is cls:
+            return bytes([tag]) + _encode_msg(resp)
+    raise TypeError(f"unknown response {resp!r}")
+
+
+def decode_response(data: bytes):
+    tag = data[0]
+    for t, cls in _RESP_TAGS:
+        if t == tag:
+            return _decode_msg(cls, data[1:])
+    raise DecodeError(f"unknown response tag {tag}")

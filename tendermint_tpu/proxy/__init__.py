@@ -1,0 +1,147 @@
+"""proxy — the node's three typed ABCI connections.
+
+Reference parity: proxy/multi_app_conn.go:12,30,64 (AppConns starts
+consensus/mempool/query clients), proxy/app_conn.go:11-43 (typed facades),
+proxy/client.go:15,27,66 (ClientCreator mapping --proxy_app to an
+in-process example app, a local client, or a socket client).
+"""
+from __future__ import annotations
+
+import asyncio
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.client import Client, LocalClient, SocketClient
+from tendermint_tpu.libs.service import BaseService
+
+
+class ClientCreator:
+    """Creates one ABCI client per proxy connection."""
+
+    def new_client(self) -> Client:
+        raise NotImplementedError
+
+
+class LocalClientCreator(ClientCreator):
+    """In-process app shared behind one lock (reference NewLocalClientCreator)."""
+
+    def __init__(self, app: abci.Application) -> None:
+        self.app = app
+        self._lock = asyncio.Lock()
+
+    def new_client(self) -> Client:
+        return LocalClient(self.app, self._lock)
+
+
+class RemoteClientCreator(ClientCreator):
+    """Socket connection to an external app process (reference
+    NewRemoteClientCreator)."""
+
+    def __init__(self, address: str) -> None:
+        self.address = address
+
+    def new_client(self) -> Client:
+        return SocketClient(self.address)
+
+
+def default_client_creator(proxy_app: str, app: abci.Application | None = None) -> ClientCreator:
+    """Reference proxy/client.go:66 DefaultClientCreator."""
+    if app is not None:
+        return LocalClientCreator(app)
+    if proxy_app == "kvstore":
+        from tendermint_tpu.abci.examples import KVStoreApplication
+
+        return LocalClientCreator(KVStoreApplication())
+    if proxy_app == "counter":
+        from tendermint_tpu.abci.examples import CounterApplication
+
+        return LocalClientCreator(CounterApplication())
+    if proxy_app == "counter_serial":
+        from tendermint_tpu.abci.examples import CounterApplication
+
+        return LocalClientCreator(CounterApplication(serial=True))
+    if proxy_app == "noop":
+        return LocalClientCreator(abci.BaseApplication())
+    return RemoteClientCreator(proxy_app)
+
+
+class AppConnConsensus:
+    """Reference proxy/app_conn.go:11 — the consensus connection facade."""
+
+    def __init__(self, client: Client) -> None:
+        self._client = client
+
+    async def init_chain(self, req: abci.RequestInitChain) -> abci.ResponseInitChain:
+        return await self._client.init_chain(req)
+
+    async def begin_block(self, req: abci.RequestBeginBlock) -> abci.ResponseBeginBlock:
+        return await self._client.begin_block(req)
+
+    def deliver_tx_async(self, tx: bytes) -> asyncio.Future:
+        return self._client.deliver_tx_async(abci.RequestDeliverTx(tx))
+
+    async def end_block(self, req: abci.RequestEndBlock) -> abci.ResponseEndBlock:
+        return await self._client.end_block(req)
+
+    async def commit(self) -> abci.ResponseCommit:
+        return await self._client.commit()
+
+    async def flush(self) -> None:
+        await self._client.flush()
+
+
+class AppConnMempool:
+    def __init__(self, client: Client) -> None:
+        self._client = client
+
+    def check_tx_async(self, tx: bytes, new_check: bool = True) -> asyncio.Future:
+        return self._client.check_tx_async(abci.RequestCheckTx(tx, new_check))
+
+    async def check_tx(self, tx: bytes, new_check: bool = True) -> abci.ResponseCheckTx:
+        return await self._client.check_tx(abci.RequestCheckTx(tx, new_check))
+
+    async def flush(self) -> None:
+        await self._client.flush()
+
+
+class AppConnQuery:
+    def __init__(self, client: Client) -> None:
+        self._client = client
+
+    async def echo(self, msg: str) -> abci.ResponseEcho:
+        return await self._client.echo(msg)
+
+    async def info(self, req: abci.RequestInfo) -> abci.ResponseInfo:
+        return await self._client.info(req)
+
+    async def query(self, req: abci.RequestQuery) -> abci.ResponseQuery:
+        return await self._client.query(req)
+
+    async def set_option(self, req: abci.RequestSetOption) -> abci.ResponseSetOption:
+        return await self._client.set_option(req)
+
+
+class AppConns(BaseService):
+    """Reference proxy/multi_app_conn.go:30 — starts the three clients."""
+
+    def __init__(self, creator: ClientCreator) -> None:
+        super().__init__("AppConns")
+        self._creator = creator
+        self.consensus: AppConnConsensus | None = None
+        self.mempool: AppConnMempool | None = None
+        self.query: AppConnQuery | None = None
+        self._clients: list[Client] = []
+
+    async def on_start(self) -> None:
+        for attr, facade in (
+            ("consensus", AppConnConsensus),
+            ("mempool", AppConnMempool),
+            ("query", AppConnQuery),
+        ):
+            client = self._creator.new_client()
+            await client.start()
+            self._clients.append(client)
+            setattr(self, attr, facade(client))
+
+    async def on_stop(self) -> None:
+        for c in self._clients:
+            await c.stop()

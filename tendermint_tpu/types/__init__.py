@@ -1,0 +1,21 @@
+"""Domain model (reference types/): blocks, votes, validators, evidence,
+events — built batch-first: every multi-signature verification path routes
+through crypto.batch.BatchVerifier so the TPU backend sees whole batches."""
+from tendermint_tpu.types.part_set import Part, PartSet, PartSetHeader  # noqa: F401
+from tendermint_tpu.types.vote import BlockID, Proposal, Vote, VoteType  # noqa: F401
+from tendermint_tpu.types.block import (  # noqa: F401
+    Block,
+    Commit,
+    Data,
+    Header,
+    SignedHeader,
+    make_block,
+)
+from tendermint_tpu.types.validator import Validator  # noqa: F401
+from tendermint_tpu.types.validator_set import ValidatorSet  # noqa: F401
+from tendermint_tpu.types.vote_set import VoteSet  # noqa: F401
+from tendermint_tpu.types.evidence import DuplicateVoteEvidence, Evidence  # noqa: F401
+from tendermint_tpu.types.priv_validator import MockPV, PrivValidator  # noqa: F401
+from tendermint_tpu.types.params import ConsensusParams  # noqa: F401
+from tendermint_tpu.types.genesis import GenesisDoc  # noqa: F401
+from tendermint_tpu.types.tx import Tx, tx_hash, txs_hash  # noqa: F401

@@ -1,0 +1,405 @@
+"""Block, Header, Commit, SignedHeader.
+
+Reference parity: types/block.go:36 (Block{Header,Data,Evidence,LastCommit}),
+:337 (Header; Hash = merkle over the 16 field encodings, block.go:393),
+:488 (Commit = BlockID + precommit signatures, one slot per validator,
+nullable), :710 (SignedHeader). CommitSig is represented by Vote directly
+(the reference aliases them, block.go:469).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+from tendermint_tpu.crypto import merkle, sum_sha256
+from tendermint_tpu.encoding import DecodeError, Reader, Writer
+from tendermint_tpu.libs.bit_array import BitArray
+from tendermint_tpu.types.part_set import PartSet, PartSetHeader
+from tendermint_tpu.types.tx import Tx, txs_hash
+from tendermint_tpu.types.vote import BlockID, Vote, VoteType, canonical_vote_sign_bytes
+
+BLOCK_PROTOCOL_VERSION = 1
+APP_PROTOCOL_VERSION = 0
+MAX_HEADER_BYTES = 653
+MAX_BLOCK_SIZE_BYTES = 104857600  # 100 MiB hard cap (reference block.go MaxBlockSizeBytes)
+
+
+@dataclass(frozen=True)
+class Version:
+    block: int = BLOCK_PROTOCOL_VERSION
+    app: int = APP_PROTOCOL_VERSION
+
+    def encode_into(self, w: Writer) -> None:
+        w.u64(self.block).u64(self.app)
+
+    @classmethod
+    def read(cls, r: Reader) -> "Version":
+        return cls(r.u64(), r.u64())
+
+
+@dataclass(frozen=True)
+class Header:
+    """Reference types/block.go:337."""
+
+    version: Version = Version()
+    chain_id: str = ""
+    height: int = 0
+    time: int = 0  # ns since epoch
+    num_txs: int = 0
+    total_txs: int = 0
+    last_block_id: BlockID = BlockID()
+    last_commit_hash: bytes = b""
+    data_hash: bytes = b""
+    validators_hash: bytes = b""
+    next_validators_hash: bytes = b""
+    consensus_hash: bytes = b""
+    app_hash: bytes = b""
+    last_results_hash: bytes = b""
+    evidence_hash: bytes = b""
+    proposer_address: bytes = b""
+
+    def hash(self) -> bytes:
+        """Merkle root over the encoded fields, in fixed order (reference
+        block.go:393 — merkle of the 16 header fields)."""
+        if not self.validators_hash:
+            return b""
+        fields = [
+            Writer().u64(self.version.block).u64(self.version.app).build(),
+            Writer().str(self.chain_id).build(),
+            Writer().u64(self.height).build(),
+            Writer().u64(self.time).build(),
+            Writer().u64(self.num_txs).build(),
+            Writer().u64(self.total_txs).build(),
+            _encode_block_id(self.last_block_id),
+            self.last_commit_hash,
+            self.data_hash,
+            self.validators_hash,
+            self.next_validators_hash,
+            self.consensus_hash,
+            self.app_hash,
+            self.last_results_hash,
+            self.evidence_hash,
+            self.proposer_address,
+        ]
+        return merkle.hash_from_byte_slices(fields)
+
+    def encode(self) -> bytes:
+        w = Writer()
+        self.version.encode_into(w)
+        w.str(self.chain_id).u64(self.height).u64(self.time)
+        w.u64(self.num_txs).u64(self.total_txs)
+        self.last_block_id.encode_into(w)
+        for b in (
+            self.last_commit_hash,
+            self.data_hash,
+            self.validators_hash,
+            self.next_validators_hash,
+            self.consensus_hash,
+            self.app_hash,
+            self.last_results_hash,
+            self.evidence_hash,
+            self.proposer_address,
+        ):
+            w.bytes(b)
+        return w.build()
+
+    @classmethod
+    def read(cls, r: Reader) -> "Header":
+        version = Version.read(r)
+        chain_id = r.str()
+        height = r.u64()
+        time_ = r.u64()
+        num_txs = r.u64()
+        total_txs = r.u64()
+        last_block_id = BlockID.read(r)
+        rest = [r.bytes() for _ in range(9)]
+        return cls(
+            version, chain_id, height, time_, num_txs, total_txs, last_block_id, *rest
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Header":
+        r = Reader(data)
+        h = cls.read(r)
+        r.expect_done()
+        return h
+
+
+def _encode_block_id(bid: BlockID) -> bytes:
+    w = Writer()
+    bid.encode_into(w)
+    return w.build()
+
+
+class Commit:
+    """Reference types/block.go:488: the +2/3 precommits for a block; one
+    slot per validator in validator-set order, None where absent."""
+
+    def __init__(self, block_id: BlockID, precommits: list[Vote | None]) -> None:
+        self.block_id = block_id
+        self.precommits = precommits
+        self._height: int | None = None
+        self._round: int | None = None
+        self._bit_array: BitArray | None = None
+        self._hash: bytes | None = None
+
+    def _first(self) -> Vote | None:
+        for p in self.precommits:
+            if p is not None:
+                return p
+        return None
+
+    def height(self) -> int:
+        if self._height is None:
+            first = self._first()
+            self._height = first.height if first else 0
+        return self._height
+
+    def round(self) -> int:
+        if self._round is None:
+            first = self._first()
+            self._round = first.round if first else 0
+        return self._round
+
+    def size(self) -> int:
+        return len(self.precommits)
+
+    def is_commit(self) -> bool:
+        return len(self.precommits) > 0
+
+    def vote_sign_bytes(self, chain_id: str, idx: int) -> bytes:
+        p = self.precommits[idx]
+        assert p is not None
+        return canonical_vote_sign_bytes(
+            chain_id, int(p.type), p.height, p.round, p.block_id, p.timestamp
+        )
+
+    def bit_array(self) -> BitArray:
+        if self._bit_array is None:
+            ba = BitArray(len(self.precommits))
+            for i, p in enumerate(self.precommits):
+                ba.set_index(i, p is not None)
+            self._bit_array = ba
+        return self._bit_array.copy()
+
+    def validate_basic(self) -> None:
+        if self.block_id.is_zero():
+            raise ValueError("commit cannot be for a nil block")
+        if not self.precommits:
+            raise ValueError("no precommits in commit")
+        height, round_ = self.height(), self.round()
+        for i, p in enumerate(self.precommits):
+            if p is None:
+                continue
+            if p.type != VoteType.PRECOMMIT:
+                raise ValueError(f"invalid commit vote type at {i}")
+            if p.height != height:
+                raise ValueError(f"invalid commit precommit height at {i}")
+            if p.round != round_:
+                raise ValueError(f"invalid commit precommit round at {i}")
+
+    def hash(self) -> bytes:
+        if self._hash is None:
+            items = [p.encode() if p is not None else b"" for p in self.precommits]
+            self._hash = merkle.hash_from_byte_slices(items)
+        return self._hash
+
+    def encode(self) -> bytes:
+        w = Writer()
+        self.block_id.encode_into(w)
+        w.u32(len(self.precommits))
+        for p in self.precommits:
+            if p is None:
+                w.u8(0)
+            else:
+                w.u8(1).bytes(p.encode())
+        return w.build()
+
+    @classmethod
+    def read(cls, r: Reader) -> "Commit":
+        bid = BlockID.read(r)
+        n = r.u32()
+        precommits: list[Vote | None] = []
+        for _ in range(n):
+            if r.u8():
+                precommits.append(Vote.decode(r.bytes()))
+            else:
+                precommits.append(None)
+        return cls(bid, precommits)
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Commit":
+        r = Reader(data)
+        c = cls.read(r)
+        r.expect_done()
+        return c
+
+    def __str__(self) -> str:
+        return f"Commit{{h={self.height()} r={self.round()} {self.bit_array()}}}"
+
+
+@dataclass
+class Data:
+    """Block transaction payload (reference types/block.go Data)."""
+
+    txs: list[Tx] = field(default_factory=list)
+
+    def hash(self) -> bytes:
+        return txs_hash(self.txs)
+
+    def encode(self) -> bytes:
+        w = Writer().u32(len(self.txs))
+        for tx in self.txs:
+            w.bytes(tx)
+        return w.build()
+
+    @classmethod
+    def read(cls, r: Reader) -> "Data":
+        return cls([r.bytes() for _ in range(r.u32())])
+
+
+class Block:
+    """Reference types/block.go:36."""
+
+    def __init__(
+        self,
+        header: Header,
+        data: Data,
+        evidence: list | None = None,
+        last_commit: Commit | None = None,
+    ) -> None:
+        self.header = header
+        self.data = data
+        self.evidence = evidence or []
+        self.last_commit = last_commit
+        self._hash: bytes | None = None
+        self._part_set: PartSet | None = None
+
+    def hash(self) -> bytes:
+        if self._hash is None:
+            self._hash = self.header.hash()
+        return self._hash
+
+    def make_part_set(self, part_size: int | None = None) -> PartSet:
+        if self._part_set is None:
+            from tendermint_tpu.types.part_set import BLOCK_PART_SIZE
+
+            self._part_set = PartSet.from_data(
+                self.encode(), part_size or BLOCK_PART_SIZE
+            )
+        return self._part_set
+
+    def hashes_to(self, block_id: BlockID) -> bool:
+        return (
+            self.hash() == block_id.hash
+            and self.make_part_set().header() == block_id.parts
+        )
+
+    def block_id(self) -> BlockID:
+        return BlockID(self.hash(), self.make_part_set().header())
+
+    def validate_basic(self) -> None:
+        h = self.header
+        if h.height < 1:
+            raise ValueError(f"invalid block height {h.height}")
+        if h.height > 1:
+            if self.last_commit is None or not self.last_commit.precommits:
+                raise ValueError("block at height > 1 needs a last commit")
+            self.last_commit.validate_basic()
+            if h.last_commit_hash != self.last_commit.hash():
+                raise ValueError("wrong last_commit_hash")
+        if h.num_txs != len(self.data.txs):
+            raise ValueError("wrong num_txs")
+        if h.data_hash != self.data.hash():
+            raise ValueError("wrong data_hash")
+        from tendermint_tpu.types.evidence import evidence_hash
+
+        if h.evidence_hash != evidence_hash(self.evidence):
+            raise ValueError("wrong evidence_hash")
+
+    def encode(self) -> bytes:
+        from tendermint_tpu.types.evidence import encode_evidence_list
+
+        w = Writer()
+        w.bytes(self.header.encode())
+        w.bytes(self.data.encode())
+        w.bytes(encode_evidence_list(self.evidence))
+        if self.last_commit is None:
+            w.u8(0)
+        else:
+            w.u8(1).bytes(self.last_commit.encode())
+        return w.build()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Block":
+        from tendermint_tpu.types.evidence import decode_evidence_list
+
+        r = Reader(data)
+        header = Header.decode(r.bytes())
+        block_data = Data.read(Reader(r.bytes()))
+        evidence = decode_evidence_list(r.bytes())
+        last_commit = Commit.decode(r.bytes()) if r.u8() else None
+        r.expect_done()
+        return cls(header, block_data, evidence, last_commit)
+
+    def __str__(self) -> str:
+        return f"Block{{h={self.header.height} txs={len(self.data.txs)} {self.hash().hex()[:12]}}}"
+
+
+@dataclass
+class SignedHeader:
+    """Header + the commit that signs it (reference types/block.go:710);
+    the light-client verification unit."""
+
+    header: Header
+    commit: Commit
+
+    @property
+    def height(self) -> int:
+        return self.header.height
+
+    @property
+    def chain_id(self) -> str:
+        return self.header.chain_id
+
+    def validate_basic(self, chain_id: str) -> None:
+        if self.header.chain_id != chain_id:
+            raise ValueError(f"header chain_id {self.header.chain_id} != {chain_id}")
+        self.commit.validate_basic()
+        if self.commit.height() != self.header.height:
+            raise ValueError("commit height != header height")
+        if self.commit.block_id.hash != self.header.hash():
+            raise ValueError("commit signs a different header")
+
+    def encode(self) -> bytes:
+        return Writer().bytes(self.header.encode()).bytes(self.commit.encode()).build()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "SignedHeader":
+        r = Reader(data)
+        sh = cls(Header.decode(r.bytes()), Commit.decode(r.bytes()))
+        r.expect_done()
+        return sh
+
+
+def make_block(
+    height: int,
+    txs: list[Tx],
+    last_commit: Commit | None,
+    evidence: list | None = None,
+    **header_fields,
+) -> Block:
+    """Convenience constructor filling derived header fields (reference
+    state.MakeBlock + Block.fillHeader)."""
+    from tendermint_tpu.types.evidence import evidence_hash as ev_hash
+
+    data = Data(txs)
+    evidence = evidence or []
+    header = Header(
+        height=height,
+        num_txs=len(txs),
+        data_hash=data.hash(),
+        last_commit_hash=last_commit.hash() if last_commit else b"",
+        evidence_hash=ev_hash(evidence),
+        **header_fields,
+    )
+    return Block(header, data, evidence, last_commit)

@@ -1,0 +1,97 @@
+"""EventBus — typed publish wrappers over the pubsub server.
+
+Reference parity: types/event_bus.go:33,123-213. Every consensus-visible
+occurrence (blocks, txs, votes, round steps, validator-set updates) is
+published here and flows to RPC websocket subscribers and the tx indexer.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from tendermint_tpu.libs import pubsub
+from tendermint_tpu.libs.service import BaseService
+from tendermint_tpu.types import events as ev
+from tendermint_tpu.types.tx import tx_hash
+
+
+class EventBus(BaseService):
+    def __init__(self, buffer: int = 4096) -> None:
+        super().__init__("EventBus")
+        self.server = pubsub.Server(buffer=buffer)
+
+    def subscribe(self, subscriber: str, query: pubsub.Query, buffer: int | None = None):
+        return self.server.subscribe(subscriber, query, buffer)
+
+    def unsubscribe(self, subscriber: str, query: pubsub.Query) -> None:
+        self.server.unsubscribe(subscriber, query)
+
+    def unsubscribe_all(self, subscriber: str) -> None:
+        self.server.unsubscribe_all(subscriber)
+
+    async def _publish(self, event_type: str, data: Any, extra: dict[str, list[str]] | None = None) -> None:
+        events = {ev.EVENT_TYPE_KEY: [event_type]}
+        if extra:
+            for k, v in extra.items():
+                events.setdefault(k, []).extend(v)
+        await self.server.publish(data, events)
+
+    async def publish_new_block(self, block, result_begin_block=None, result_end_block=None) -> None:
+        await self._publish(
+            ev.EVENT_NEW_BLOCK,
+            {"block": block, "result_begin_block": result_begin_block, "result_end_block": result_end_block},
+        )
+
+    async def publish_new_block_header(self, header, result_begin_block=None, result_end_block=None) -> None:
+        await self._publish(ev.EVENT_NEW_BLOCK_HEADER, {"header": header})
+
+    async def publish_tx(self, height: int, index: int, tx: bytes, result: Any, extra_events: dict | None = None) -> None:
+        """Reference event_bus.go PublishEventTx — tags txs by hash and
+        height plus app-provided events for tx_search/indexing."""
+        extra = {
+            ev.TX_HASH_KEY: [tx_hash(tx).hex()],
+            ev.TX_HEIGHT_KEY: [str(height)],
+        }
+        if extra_events:
+            for k, v in extra_events.items():
+                extra.setdefault(k, []).extend(v)
+        await self._publish(
+            ev.EVENT_TX,
+            {"height": height, "index": index, "tx": tx, "result": result},
+            extra,
+        )
+
+    async def publish_vote(self, vote) -> None:
+        await self._publish(ev.EVENT_VOTE, {"vote": vote})
+
+    async def publish_new_round_step(self, rs) -> None:
+        await self._publish(ev.EVENT_NEW_ROUND_STEP, rs)
+
+    async def publish_new_round(self, rs) -> None:
+        await self._publish(ev.EVENT_NEW_ROUND, rs)
+
+    async def publish_complete_proposal(self, rs) -> None:
+        await self._publish(ev.EVENT_COMPLETE_PROPOSAL, rs)
+
+    async def publish_polka(self, rs) -> None:
+        await self._publish(ev.EVENT_POLKA, rs)
+
+    async def publish_unlock(self, rs) -> None:
+        await self._publish(ev.EVENT_UNLOCK, rs)
+
+    async def publish_lock(self, rs) -> None:
+        await self._publish(ev.EVENT_LOCK, rs)
+
+    async def publish_relock(self, rs) -> None:
+        await self._publish(ev.EVENT_RELOCK, rs)
+
+    async def publish_timeout_propose(self, rs) -> None:
+        await self._publish(ev.EVENT_TIMEOUT_PROPOSE, rs)
+
+    async def publish_timeout_wait(self, rs) -> None:
+        await self._publish(ev.EVENT_TIMEOUT_WAIT, rs)
+
+    async def publish_valid_block(self, rs) -> None:
+        await self._publish(ev.EVENT_VALID_BLOCK, rs)
+
+    async def publish_validator_set_updates(self, updates) -> None:
+        await self._publish(ev.EVENT_VALIDATOR_SET_UPDATES, {"validator_updates": updates})

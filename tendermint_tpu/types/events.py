@@ -1,0 +1,46 @@
+"""Event constants and queries (reference types/events.go)."""
+from __future__ import annotations
+
+from tendermint_tpu.libs.pubsub import Query
+
+# event type values (the value of the "tm.event" key)
+EVENT_NEW_BLOCK = "NewBlock"
+EVENT_NEW_BLOCK_HEADER = "NewBlockHeader"
+EVENT_TX = "Tx"
+EVENT_VOTE = "Vote"
+EVENT_NEW_ROUND_STEP = "NewRoundStep"
+EVENT_NEW_ROUND = "NewRound"
+EVENT_COMPLETE_PROPOSAL = "CompleteProposal"
+EVENT_POLKA = "Polka"
+EVENT_UNLOCK = "Unlock"
+EVENT_LOCK = "Lock"
+EVENT_RELOCK = "Relock"
+EVENT_TIMEOUT_PROPOSE = "TimeoutPropose"
+EVENT_TIMEOUT_WAIT = "TimeoutWait"
+EVENT_VALID_BLOCK = "ValidBlock"
+EVENT_VALIDATOR_SET_UPDATES = "ValidatorSetUpdates"
+
+EVENT_TYPE_KEY = "tm.event"
+TX_HASH_KEY = "tx.hash"
+TX_HEIGHT_KEY = "tx.height"
+
+
+def query_for_event(event_type: str) -> Query:
+    return Query.parse(f"{EVENT_TYPE_KEY}='{event_type}'")
+
+
+EVENT_QUERY_NEW_BLOCK = query_for_event(EVENT_NEW_BLOCK)
+EVENT_QUERY_NEW_BLOCK_HEADER = query_for_event(EVENT_NEW_BLOCK_HEADER)
+EVENT_QUERY_TX = query_for_event(EVENT_TX)
+EVENT_QUERY_VOTE = query_for_event(EVENT_VOTE)
+EVENT_QUERY_NEW_ROUND_STEP = query_for_event(EVENT_NEW_ROUND_STEP)
+EVENT_QUERY_NEW_ROUND = query_for_event(EVENT_NEW_ROUND)
+EVENT_QUERY_COMPLETE_PROPOSAL = query_for_event(EVENT_COMPLETE_PROPOSAL)
+EVENT_QUERY_POLKA = query_for_event(EVENT_POLKA)
+EVENT_QUERY_UNLOCK = query_for_event(EVENT_UNLOCK)
+EVENT_QUERY_LOCK = query_for_event(EVENT_LOCK)
+EVENT_QUERY_VALIDATOR_SET_UPDATES = query_for_event(EVENT_VALIDATOR_SET_UPDATES)
+
+
+def query_for_tx(tx_hash_hex: str) -> Query:
+    return Query.parse(f"{EVENT_TYPE_KEY}='{EVENT_TX}' AND {TX_HASH_KEY}='{tx_hash_hex}'")

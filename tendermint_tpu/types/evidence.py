@@ -1,0 +1,140 @@
+"""Evidence of Byzantine behaviour.
+
+Reference parity: types/evidence.go — `Evidence` interface and
+`DuplicateVoteEvidence` (two signed votes for the same height/round/step but
+different blocks). Signature checks are batchable: `add_to_batch` lets
+state.VerifyEvidence fold evidence sigs into the block-verification device
+batch (BASELINE config #3).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tendermint_tpu.crypto import PubKey, merkle, sum_sha256
+from tendermint_tpu.crypto import decode_pubkey, encode_pubkey
+from tendermint_tpu.crypto.batch import BatchVerifier
+from tendermint_tpu.encoding import DecodeError, Reader, Writer
+from tendermint_tpu.types.vote import Vote
+
+MAX_EVIDENCE_BYTES = 484
+
+
+class Evidence:
+    """Interface (reference types/evidence.go Evidence)."""
+
+    def height(self) -> int:
+        raise NotImplementedError
+
+    def address(self) -> bytes:
+        raise NotImplementedError
+
+    def hash(self) -> bytes:
+        return sum_sha256(self.encode())
+
+    def verify(self, chain_id: str, pub_key: PubKey) -> None:
+        raise NotImplementedError
+
+    def add_to_batch(self, chain_id: str, pub_key: PubKey, bv: BatchVerifier) -> list[int]:
+        raise NotImplementedError
+
+    def encode(self) -> bytes:
+        raise NotImplementedError
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Evidence) and self.encode() == other.encode()
+
+    def __hash__(self) -> int:
+        return hash(self.encode())
+
+
+@dataclass(eq=False)
+class DuplicateVoteEvidence(Evidence):
+    """Reference types/evidence.go DuplicateVoteEvidence."""
+
+    pub_key: PubKey
+    vote_a: Vote
+    vote_b: Vote
+
+    def height(self) -> int:
+        return self.vote_a.height
+
+    def address(self) -> bytes:
+        return self.pub_key.address()
+
+    def _structural_check(self, chain_id: str, pub_key: PubKey) -> None:
+        a, b = self.vote_a, self.vote_b
+        if (a.height, a.round, a.type) != (b.height, b.round, b.type):
+            raise ValueError("duplicate vote evidence: H/R/S mismatch")
+        if a.block_id == b.block_id:
+            raise ValueError("duplicate vote evidence: same block id")
+        if a.validator_address != b.validator_address:
+            raise ValueError("duplicate vote evidence: different validators")
+        if pub_key.address() != a.validator_address:
+            raise ValueError("evidence pubkey does not match vote address")
+        if pub_key != self.pub_key:
+            raise ValueError("evidence pubkey mismatch")
+
+    def verify(self, chain_id: str, pub_key: PubKey) -> None:
+        self._structural_check(chain_id, pub_key)
+        bv = BatchVerifier()
+        self.add_to_batch(chain_id, pub_key, bv)
+        if not all(bv.verify_all()):
+            raise ValueError("duplicate vote evidence: invalid signature")
+
+    def add_to_batch(self, chain_id: str, pub_key: PubKey, bv: BatchVerifier) -> list[int]:
+        """Queue this evidence's two signature checks; caller verifies the
+        batch and must see True at both returned indices."""
+        self._structural_check(chain_id, pub_key)
+        ia = bv.add(pub_key, self.vote_a.sign_bytes(chain_id), self.vote_a.signature)
+        ib = bv.add(pub_key, self.vote_b.sign_bytes(chain_id), self.vote_b.signature)
+        return [ia, ib]
+
+    def encode(self) -> bytes:
+        return (
+            Writer()
+            .u8(1)  # evidence type tag
+            .bytes(encode_pubkey(self.pub_key))
+            .bytes(self.vote_a.encode())
+            .bytes(self.vote_b.encode())
+            .build()
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "DuplicateVoteEvidence":
+        ev = decode_evidence(data)
+        if not isinstance(ev, DuplicateVoteEvidence):
+            raise DecodeError("not duplicate vote evidence")
+        return ev
+
+    def __str__(self) -> str:
+        return f"DuplicateVoteEvidence{{{self.address().hex()[:12]} h={self.height()}}}"
+
+
+def decode_evidence(data: bytes) -> Evidence:
+    r = Reader(data)
+    tag = r.u8()
+    if tag == 1:
+        ev = DuplicateVoteEvidence(
+            decode_pubkey(r.bytes()), Vote.decode(r.bytes()), Vote.decode(r.bytes())
+        )
+        r.expect_done()
+        return ev
+    raise DecodeError(f"unknown evidence tag {tag}")
+
+
+def encode_evidence_list(evs: list[Evidence]) -> bytes:
+    w = Writer().u32(len(evs))
+    for ev in evs:
+        w.bytes(ev.encode())
+    return w.build()
+
+
+def decode_evidence_list(data: bytes) -> list[Evidence]:
+    r = Reader(data)
+    out = [decode_evidence(r.bytes()) for _ in range(r.u32())]
+    r.expect_done()
+    return out
+
+
+def evidence_hash(evs: list[Evidence]) -> bytes:
+    return merkle.hash_from_byte_slices([e.hash() for e in evs])

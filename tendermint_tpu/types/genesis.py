@@ -1,0 +1,125 @@
+"""Genesis document (reference types/genesis.go): chain identity, initial
+validator set, consensus params, opaque app state. JSON on disk, like the
+reference's genesis.json."""
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+
+from tendermint_tpu import crypto
+from tendermint_tpu.crypto import PubKey, sum_sha256
+from tendermint_tpu.types.params import (
+    BlockParams,
+    ConsensusParams,
+    EvidenceParams,
+    ValidatorParams,
+)
+from tendermint_tpu.types.validator import Validator
+from tendermint_tpu.types.validator_set import MAX_TOTAL_VOTING_POWER
+
+MAX_CHAIN_ID_LEN = 50
+
+
+@dataclass
+class GenesisValidator:
+    pub_key: PubKey
+    power: int
+    name: str = ""
+
+
+@dataclass
+class GenesisDoc:
+    chain_id: str
+    genesis_time: int = 0  # ns since epoch
+    consensus_params: ConsensusParams = field(default_factory=ConsensusParams)
+    validators: list[GenesisValidator] = field(default_factory=list)
+    app_hash: bytes = b""
+    app_state: bytes = b""  # opaque, handed to InitChain
+
+    def validate_and_complete(self) -> None:
+        """Reference genesis.go ValidateAndComplete."""
+        if not self.chain_id:
+            raise ValueError("genesis doc must include chain_id")
+        if len(self.chain_id) > MAX_CHAIN_ID_LEN:
+            raise ValueError(f"chain_id too long (> {MAX_CHAIN_ID_LEN})")
+        self.consensus_params.validate()
+        for v in self.validators:
+            if v.power < 0:
+                raise ValueError("genesis validator with negative power")
+        if self.validators and sum(v.power for v in self.validators) > MAX_TOTAL_VOTING_POWER:
+            raise ValueError("genesis total voting power exceeds max")
+        if self.genesis_time == 0:
+            self.genesis_time = time.time_ns()
+
+    def validator_set(self):
+        from tendermint_tpu.types.validator_set import ValidatorSet
+
+        return ValidatorSet([Validator(v.pub_key, v.power) for v in self.validators])
+
+    def hash(self) -> bytes:
+        return sum_sha256(self.to_json().encode())
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "chain_id": self.chain_id,
+                "genesis_time": self.genesis_time,
+                "consensus_params": {
+                    "block": {
+                        "max_bytes": self.consensus_params.block.max_bytes,
+                        "max_gas": self.consensus_params.block.max_gas,
+                        "time_iota_ms": self.consensus_params.block.time_iota_ms,
+                    },
+                    "evidence": {"max_age": self.consensus_params.evidence.max_age},
+                    "validator": {
+                        "pub_key_types": list(self.consensus_params.validator.pub_key_types)
+                    },
+                },
+                "validators": [
+                    {
+                        "pub_key": crypto.encode_pubkey(v.pub_key).hex(),
+                        "power": v.power,
+                        "name": v.name,
+                    }
+                    for v in self.validators
+                ],
+                "app_hash": self.app_hash.hex(),
+                "app_state": self.app_state.hex(),
+            },
+            sort_keys=True,
+        )
+
+    @classmethod
+    def from_json(cls, s: str) -> "GenesisDoc":
+        d = json.loads(s)
+        cp = d.get("consensus_params", {})
+        doc = cls(
+            chain_id=d["chain_id"],
+            genesis_time=d.get("genesis_time", 0),
+            consensus_params=ConsensusParams(
+                BlockParams(**cp.get("block", {})),
+                EvidenceParams(**cp.get("evidence", {})),
+                ValidatorParams(tuple(cp.get("validator", {}).get("pub_key_types", ("ed25519",)))),
+            ),
+            validators=[
+                GenesisValidator(
+                    crypto.decode_pubkey(bytes.fromhex(v["pub_key"])), v["power"], v.get("name", "")
+                )
+                for v in d.get("validators", [])
+            ],
+            app_hash=bytes.fromhex(d.get("app_hash", "")),
+            app_state=bytes.fromhex(d.get("app_state", "")),
+        )
+        return doc
+
+    def save_as(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_json())
+
+    @classmethod
+    def from_file(cls, path: str) -> "GenesisDoc":
+        with open(path) as f:
+            doc = cls.from_json(f.read())
+        doc.validate_and_complete()
+        return doc

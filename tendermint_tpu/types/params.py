@@ -1,0 +1,75 @@
+"""Consensus parameters (reference types/params.go): block size/gas limits,
+evidence aging, allowed validator key types; hashed into Header.ConsensusHash
+and amendable by the application via EndBlock."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+from tendermint_tpu.crypto import sum_sha256
+from tendermint_tpu.encoding import Reader, Writer
+
+MAX_BLOCK_SIZE_BYTES = 104857600  # 100 MiB
+
+
+@dataclass(frozen=True)
+class BlockParams:
+    max_bytes: int = 22020096  # 21 MiB (reference defaults)
+    max_gas: int = -1
+    time_iota_ms: int = 1000
+
+
+@dataclass(frozen=True)
+class EvidenceParams:
+    max_age: int = 100000  # blocks
+
+
+@dataclass(frozen=True)
+class ValidatorParams:
+    pub_key_types: tuple[str, ...] = ("ed25519",)
+
+
+@dataclass(frozen=True)
+class ConsensusParams:
+    block: BlockParams = BlockParams()
+    evidence: EvidenceParams = EvidenceParams()
+    validator: ValidatorParams = ValidatorParams()
+
+    def validate(self) -> None:
+        if not (0 < self.block.max_bytes <= MAX_BLOCK_SIZE_BYTES):
+            raise ValueError(f"block.max_bytes out of range: {self.block.max_bytes}")
+        if self.block.max_gas < -1:
+            raise ValueError("block.max_gas must be >= -1")
+        if self.block.time_iota_ms <= 0:
+            raise ValueError("block.time_iota_ms must be positive")
+        if self.evidence.max_age <= 0:
+            raise ValueError("evidence.max_age must be positive")
+        if not self.validator.pub_key_types:
+            raise ValueError("at least one validator pubkey type required")
+
+    def hash(self) -> bytes:
+        return sum_sha256(self.encode())
+
+    def update(self, block=None, evidence=None, validator=None) -> "ConsensusParams":
+        """Apply an ABCI EndBlock param-change (None sections unchanged)."""
+        return ConsensusParams(
+            block or self.block, evidence or self.evidence, validator or self.validator
+        )
+
+    def encode(self) -> bytes:
+        w = Writer()
+        w.i64(self.block.max_bytes).i64(self.block.max_gas).i64(self.block.time_iota_ms)
+        w.i64(self.evidence.max_age)
+        w.u32(len(self.validator.pub_key_types))
+        for t in self.validator.pub_key_types:
+            w.str(t)
+        return w.build()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "ConsensusParams":
+        r = Reader(data)
+        block = BlockParams(r.i64(), r.i64(), r.i64())
+        ev = EvidenceParams(r.i64())
+        n = r.u32()
+        val = ValidatorParams(tuple(r.str() for _ in range(n)))
+        r.expect_done()
+        return cls(block, ev, val)

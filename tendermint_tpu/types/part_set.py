@@ -1,0 +1,125 @@
+"""Block parts — the unit of block gossip.
+
+Reference parity: types/part_set.go:85,97,188 — a serialized block is split
+into fixed-size parts, each carrying a merkle proof against the PartSet
+root; PartSetHeader {total, hash} travels in BlockID. This is the
+"long-context chunking" analog of the framework (SURVEY.md §5): no gossip
+message exceeds the part size.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tendermint_tpu.crypto import merkle
+from tendermint_tpu.encoding import Reader, Writer
+from tendermint_tpu.libs.bit_array import BitArray
+
+BLOCK_PART_SIZE = 65536  # bytes (reference types/params.go BlockPartSizeBytes)
+
+
+@dataclass(frozen=True)
+class PartSetHeader:
+    total: int = 0
+    hash: bytes = b""
+
+    def is_zero(self) -> bool:
+        return self.total == 0 and len(self.hash) == 0
+
+    def encode_into(self, w: Writer) -> None:
+        w.u32(self.total).bytes(self.hash)
+
+    @classmethod
+    def read(cls, r: Reader) -> "PartSetHeader":
+        return cls(r.u32(), r.bytes())
+
+    def __str__(self) -> str:
+        return f"{self.total}:{self.hash.hex()[:12]}"
+
+
+@dataclass
+class Part:
+    index: int
+    bytes_: bytes
+    proof: merkle.SimpleProof
+
+    def encode(self) -> bytes:
+        w = Writer().u32(self.index).bytes(self.bytes_)
+        w.raw(self.proof.encode())
+        return w.build()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Part":
+        r = Reader(data)
+        index = r.u32()
+        b = r.bytes()
+        proof = merkle.SimpleProof.read(r)
+        r.expect_done()
+        return cls(index, b, proof)
+
+
+class PartSet:
+    """Either built complete from data (proposer side) or assembled
+    incrementally from a header (gossip receiver side)."""
+
+    def __init__(self, header: PartSetHeader) -> None:
+        self._header = header
+        self._parts: list[Part | None] = [None] * header.total
+        self._bit_array = BitArray(header.total)
+        self._count = 0
+
+    @classmethod
+    def from_data(cls, data: bytes, part_size: int = BLOCK_PART_SIZE) -> "PartSet":
+        chunks = [data[i : i + part_size] for i in range(0, len(data), part_size)] or [b""]
+        root, proofs = merkle.proofs_from_byte_slices(chunks)
+        ps = cls(PartSetHeader(len(chunks), root))
+        for i, (chunk, proof) in enumerate(zip(chunks, proofs)):
+            ps._parts[i] = Part(i, chunk, proof)
+            ps._bit_array.set_index(i, True)
+        ps._count = len(chunks)
+        return ps
+
+    def header(self) -> PartSetHeader:
+        return self._header
+
+    def has_header(self, h: PartSetHeader) -> bool:
+        return self._header == h
+
+    @property
+    def total(self) -> int:
+        return self._header.total
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def bit_array(self) -> BitArray:
+        return self._bit_array.copy()
+
+    def is_complete(self) -> bool:
+        return self._count == self._header.total
+
+    def get_part(self, index: int) -> Part | None:
+        if 0 <= index < len(self._parts):
+            return self._parts[index]
+        return None
+
+    def add_part(self, part: Part) -> bool:
+        """Verify the part's proof against the header hash and store it.
+        Returns False (without storing) on invalid/duplicate parts."""
+        if not (0 <= part.index < self._header.total):
+            return False
+        if self._parts[part.index] is not None:
+            return False
+        if part.proof.total != self._header.total or part.proof.index != part.index:
+            return False
+        if not part.proof.verify(self._header.hash, part.bytes_):
+            return False
+        self._parts[part.index] = part
+        self._bit_array.set_index(part.index, True)
+        self._count += 1
+        return True
+
+    def get_data(self) -> bytes:
+        if not self.is_complete():
+            raise ValueError("incomplete part set")
+        return b"".join(p.bytes_ for p in self._parts)  # type: ignore[union-attr]

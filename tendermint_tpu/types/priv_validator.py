@@ -1,0 +1,53 @@
+"""PrivValidator interface + in-process implementations.
+
+Reference parity: types/priv_validator.go:14 — {GetPubKey, SignVote,
+SignProposal}; MockPV (:46) and erroring mock for tests. The file-backed
+double-sign-protected FilePV lives in tendermint_tpu/privval.
+"""
+from __future__ import annotations
+
+from tendermint_tpu.crypto import PubKey
+from tendermint_tpu.crypto import ed25519
+from tendermint_tpu.types.vote import Proposal, Vote
+
+
+class PrivValidator:
+    def get_pub_key(self) -> PubKey:
+        raise NotImplementedError
+
+    def sign_vote(self, chain_id: str, vote: Vote) -> Vote:
+        """Returns the vote with signature attached (may raise)."""
+        raise NotImplementedError
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> Proposal:
+        raise NotImplementedError
+
+    @property
+    def address(self) -> bytes:
+        return self.get_pub_key().address()
+
+
+class MockPV(PrivValidator):
+    """Unsafe test signer (reference types/priv_validator.go:46)."""
+
+    def __init__(self, priv_key: ed25519.PrivKeyEd25519 | None = None) -> None:
+        self._priv = priv_key or ed25519.gen_priv_key()
+
+    def get_pub_key(self) -> PubKey:
+        return self._priv.pub_key()
+
+    def sign_vote(self, chain_id: str, vote: Vote) -> Vote:
+        return vote.with_signature(self._priv.sign(vote.sign_bytes(chain_id)))
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> Proposal:
+        return proposal.with_signature(self._priv.sign(proposal.sign_bytes(chain_id)))
+
+
+class ErroringMockPV(MockPV):
+    """Always fails to sign (reference priv_validator.go:110)."""
+
+    def sign_vote(self, chain_id: str, vote: Vote) -> Vote:
+        raise RuntimeError("erroringMockPV always fails to sign")
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> Proposal:
+        raise RuntimeError("erroringMockPV always fails to sign")

@@ -1,0 +1,15 @@
+"""Transactions (reference types/tx.go): opaque bytes; Txs hash is the
+merkle root over tx hashes."""
+from __future__ import annotations
+
+from tendermint_tpu.crypto import merkle, sum_sha256
+
+Tx = bytes
+
+
+def tx_hash(tx: Tx) -> bytes:
+    return sum_sha256(tx)
+
+
+def txs_hash(txs: list[Tx]) -> bytes:
+    return merkle.hash_from_byte_slices([tx_hash(tx) for tx in txs])

@@ -1,0 +1,67 @@
+"""Validator (reference types/validator.go): address, pubkey, voting power,
+proposer priority. Holds the decompressed-pubkey device cache hook: the
+ValidatorSet pre-warms the ops-layer pubkey cache so steady-state commit
+verification pays zero decompression."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tendermint_tpu import crypto
+from tendermint_tpu.crypto import PubKey
+from tendermint_tpu.encoding import Reader, Writer
+
+
+@dataclass
+class Validator:
+    pub_key: PubKey
+    voting_power: int
+    proposer_priority: int = 0
+    address: bytes = field(default=b"")
+
+    def __post_init__(self) -> None:
+        if not self.address:
+            self.address = self.pub_key.address()
+
+    def copy(self) -> "Validator":
+        return Validator(self.pub_key, self.voting_power, self.proposer_priority, self.address)
+
+    def compare_proposer_priority(self, other: "Validator") -> "Validator":
+        """Higher priority wins; tie-break by address (reference
+        types/validator.go CompareProposerPriority)."""
+        if self.proposer_priority > other.proposer_priority:
+            return self
+        if self.proposer_priority < other.proposer_priority:
+            return other
+        return self if self.address < other.address else other
+
+    def hash_bytes(self) -> bytes:
+        """Bytes committed to in ValidatorsHash (reference validator.go Bytes:
+        pubkey + voting power, not priority)."""
+        w = Writer()
+        w.bytes(crypto.encode_pubkey(self.pub_key))
+        w.i64(self.voting_power)
+        return w.build()
+
+    def encode(self) -> bytes:
+        w = Writer()
+        w.bytes(crypto.encode_pubkey(self.pub_key))
+        w.i64(self.voting_power)
+        w.i64(self.proposer_priority)
+        return w.build()
+
+    @classmethod
+    def read(cls, r: Reader) -> "Validator":
+        pub = crypto.decode_pubkey(r.bytes())
+        power = r.i64()
+        prio = r.i64()
+        return cls(pub, power, prio)
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Validator":
+        r = Reader(data)
+        v = cls.read(r)
+        r.expect_done()
+        return v
+
+    def __str__(self) -> str:
+        return f"Validator{{{self.address.hex()[:12]} VP:{self.voting_power} A:{self.proposer_priority}}}"

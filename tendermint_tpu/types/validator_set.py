@@ -1,0 +1,334 @@
+"""ValidatorSet — sorted set with weighted-round-robin proposer selection and
+batch-first commit verification.
+
+Reference parity: types/validator_set.go —
+- proposer selection via ProposerPriority with rescaling/centering
+  (validator_set.go:82,106,129); priority arithmetic clips at int64 bounds
+  (safeAddClip/safeSubClip, validator_set.go:807-845) and divisions mirror
+  Go semantics (truncation toward zero) so rotation sequences match.
+- incremental updates (validator_set.go:414-588): new validators enter at
+  -1.125 * total power; removals by power 0.
+- VerifyCommit (validator_set.go:591-633) and VerifyFutureCommit
+  (validator_set.go:664-718) — north-star hot loops #2/#3 — here built on
+  crypto.batch.BatchVerifier: all precommit signatures go to the device in
+  one batch instead of a serial loop.
+"""
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Iterable
+
+from tendermint_tpu.crypto import PubKey, merkle
+from tendermint_tpu.crypto.batch import BatchVerifier
+from tendermint_tpu.types.validator import Validator
+from tendermint_tpu.types.vote import BlockID, VoteType
+
+if TYPE_CHECKING:
+    from tendermint_tpu.types.block import Commit
+
+INT64_MAX = (1 << 63) - 1
+INT64_MIN = -(1 << 63)
+MAX_TOTAL_VOTING_POWER = INT64_MAX // 8
+PRIORITY_WINDOW_SIZE_FACTOR = 2
+
+
+def _clip(v: int) -> int:
+    return max(INT64_MIN, min(INT64_MAX, v))
+
+
+def _trunc_div(a: int, b: int) -> int:
+    """Go integer division: truncation toward zero."""
+    q = abs(a) // abs(b)
+    return -q if (a < 0) != (b < 0) else q
+
+
+class VerifyError(Exception):
+    pass
+
+
+class TooMuchChangeError(VerifyError):
+    """Insufficient old voting power (reference errTooMuchChange)."""
+
+
+class ValidatorSet:
+    def __init__(self, validators: Iterable[Validator]) -> None:
+        self.validators: list[Validator] = sorted(
+            (v.copy() for v in validators), key=lambda v: v.address
+        )
+        addrs = [v.address for v in self.validators]
+        if len(set(addrs)) != len(addrs):
+            raise ValueError("duplicate validator address")
+        self._total: int | None = None
+        self.proposer: Validator | None = None
+        if self.validators:
+            self.increment_proposer_priority(1)
+
+    # -- basic accessors ----------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self.validators)
+
+    def size(self) -> int:
+        return len(self.validators)
+
+    def has_address(self, address: bytes) -> bool:
+        return self.get_by_address(address)[1] is not None
+
+    def get_by_address(self, address: bytes) -> tuple[int, Validator | None]:
+        for i, v in enumerate(self.validators):
+            if v.address == address:
+                return i, v
+        return -1, None
+
+    def get_by_index(self, index: int) -> tuple[bytes, Validator | None]:
+        if not (0 <= index < len(self.validators)):
+            return b"", None
+        v = self.validators[index]
+        return v.address, v
+
+    def total_voting_power(self) -> int:
+        if self._total is None:
+            total = 0
+            for v in self.validators:
+                total += v.voting_power
+            if total > MAX_TOTAL_VOTING_POWER:
+                raise ValueError(
+                    f"total voting power {total} exceeds max {MAX_TOTAL_VOTING_POWER}"
+                )
+            self._total = total
+        return self._total
+
+    def copy(self) -> "ValidatorSet":
+        new = object.__new__(ValidatorSet)
+        new.validators = [v.copy() for v in self.validators]
+        new._total = self._total
+        new.proposer = self.proposer.copy() if self.proposer else None
+        return new
+
+    def hash(self) -> bytes:
+        return merkle.hash_from_byte_slices([v.hash_bytes() for v in self.validators])
+
+    # -- proposer rotation --------------------------------------------------
+
+    def increment_proposer_priority(self, times: int) -> None:
+        """Reference validator_set.go:82 IncrementProposerPriority."""
+        if not self.validators:
+            raise ValueError("empty validator set")
+        if times <= 0:
+            raise ValueError("times must be positive")
+        diff_max = PRIORITY_WINDOW_SIZE_FACTOR * self.total_voting_power()
+        self._rescale_priorities(diff_max)
+        self._shift_by_avg_proposer_priority()
+        proposer = None
+        for _ in range(times):
+            proposer = self._increment_proposer_priority()
+        self.proposer = proposer
+
+    def _rescale_priorities(self, diff_max: int) -> None:
+        if diff_max <= 0:
+            return
+        prios = [v.proposer_priority for v in self.validators]
+        diff = max(prios) - min(prios)
+        if diff > diff_max:
+            ratio = (diff + diff_max - 1) // diff_max
+            for v in self.validators:
+                v.proposer_priority = _trunc_div(v.proposer_priority, ratio)
+
+    def _shift_by_avg_proposer_priority(self) -> None:
+        n = len(self.validators)
+        avg = sum(v.proposer_priority for v in self.validators) // n
+        for v in self.validators:
+            v.proposer_priority = _clip(v.proposer_priority - avg)
+
+    def _increment_proposer_priority(self) -> Validator:
+        for v in self.validators:
+            v.proposer_priority = _clip(v.proposer_priority + v.voting_power)
+        mostest = self.validators[0]
+        for v in self.validators[1:]:
+            mostest = mostest.compare_proposer_priority(v)
+        mostest.proposer_priority = _clip(
+            mostest.proposer_priority - self.total_voting_power()
+        )
+        return mostest
+
+    def get_proposer(self) -> Validator:
+        if self.proposer is None:
+            self.increment_proposer_priority(1)
+        assert self.proposer is not None
+        return self.proposer
+
+    def copy_increment_proposer_priority(self, times: int) -> "ValidatorSet":
+        c = self.copy()
+        c.increment_proposer_priority(times)
+        return c
+
+    # -- updates ------------------------------------------------------------
+
+    def update_with_change_set(self, changes: list[Validator]) -> None:
+        """Reference validator_set.go:526-588 UpdateWithChangeSet. Power 0
+        removes; unknown removal or duplicate addresses raise; on error the
+        set is unchanged."""
+        if not changes:
+            return
+        by_addr: dict[bytes, Validator] = {}
+        for c in changes:
+            if c.voting_power < 0:
+                raise ValueError("negative voting power")
+            if c.address in by_addr:
+                raise ValueError("duplicate address in change set")
+            by_addr[c.address] = c
+        updates = sorted(
+            (c for c in by_addr.values() if c.voting_power > 0), key=lambda v: v.address
+        )
+        deletes = [c for c in by_addr.values() if c.voting_power == 0]
+        cur = {v.address: v for v in self.validators}
+        for d in deletes:
+            if d.address not in cur:
+                raise ValueError(f"cannot remove unknown validator {d.address.hex()}")
+        # verify resulting total power fits
+        new_total = self.total_voting_power()
+        for u in updates:
+            old = cur.get(u.address)
+            new_total += u.voting_power - (old.voting_power if old else 0)
+        for d in deletes:
+            new_total -= cur[d.address].voting_power
+        if new_total > MAX_TOTAL_VOTING_POWER:
+            raise ValueError("updated total voting power exceeds max")
+        if new_total <= 0:
+            raise ValueError("applying changes empties the validator set")
+        # compute priorities for genuinely new validators against new total
+        # (reference computeNewPriorities: -1.125 * updatedTotalVotingPower)
+        for u in updates:
+            old = cur.get(u.address)
+            if old is None:
+                u.proposer_priority = _clip(-(new_total + (new_total >> 3)))
+            else:
+                u.proposer_priority = old.proposer_priority
+        # apply
+        for u in updates:
+            cur[u.address] = u.copy()
+        for d in deletes:
+            del cur[d.address]
+        self.validators = sorted(cur.values(), key=lambda v: v.address)
+        self._total = None
+        self._rescale_priorities(PRIORITY_WINDOW_SIZE_FACTOR * self.total_voting_power())
+        self._shift_by_avg_proposer_priority()
+
+    # -- commit verification (batch-first hot paths) -------------------------
+
+    def verify_commit(
+        self, chain_id: str, block_id: BlockID, height: int, commit: "Commit"
+    ) -> None:
+        """Reference validator_set.go:591-633 — hot loop #2. All precommit
+        signatures are verified in ONE device batch. Raises VerifyError."""
+        commit.validate_basic()
+        if self.size() != len(commit.precommits):
+            raise VerifyError(
+                f"invalid commit: {len(commit.precommits)} precommits for {self.size()} validators"
+            )
+        if height != commit.height():
+            raise VerifyError(f"invalid commit height {commit.height()} != {height}")
+        if block_id != commit.block_id:
+            raise VerifyError(
+                f"invalid commit: wrong block id {commit.block_id} != {block_id}"
+            )
+        bv = BatchVerifier()
+        indexed = []
+        for idx, precommit in enumerate(commit.precommits):
+            if precommit is None:
+                continue
+            _, val = self.get_by_index(idx)
+            bv.add(val.pub_key, commit.vote_sign_bytes(chain_id, idx), precommit.signature)
+            indexed.append((idx, precommit, val))
+        results = bv.verify_all()
+        tallied = 0
+        for ok, (idx, precommit, val) in zip(results, indexed):
+            if not ok:
+                raise VerifyError(f"invalid commit: invalid signature at index {idx}")
+            if block_id == precommit.block_id:
+                tallied += val.voting_power
+        if tallied <= self.total_voting_power() * 2 // 3:
+            raise TooMuchChangeError(
+                f"insufficient voting power: got {tallied}, "
+                f"needed > {self.total_voting_power() * 2 // 3}"
+            )
+
+    def verify_future_commit(
+        self,
+        new_set: "ValidatorSet",
+        chain_id: str,
+        block_id: BlockID,
+        height: int,
+        commit: "Commit",
+    ) -> None:
+        """Reference validator_set.go:664-718 — hot loop #4 (light client
+        bisection across validator-set changes). The commit must be valid for
+        new_set AND carry > 2/3 of *this* (old) set's power."""
+        old_vals = self
+        new_set.verify_commit(chain_id, block_id, height, commit)
+        round_ = commit.round()
+        bv = BatchVerifier()
+        indexed = []
+        seen: set[int] = set()
+        for idx, precommit in enumerate(commit.precommits):
+            if precommit is None:
+                continue
+            if precommit.height != height:
+                raise VerifyError(f"blocks don't match: {precommit.height} vs {height}")
+            if precommit.round != round_:
+                raise VerifyError(f"wrong round: {round_} vs {precommit.round}")
+            if precommit.type != VoteType.PRECOMMIT:
+                raise VerifyError(f"not a precommit @ index {idx}")
+            old_idx, val = old_vals.get_by_address(precommit.validator_address)
+            if val is None or old_idx in seen:
+                continue  # missing from old set, or double vote
+            seen.add(old_idx)
+            bv.add(val.pub_key, commit.vote_sign_bytes(chain_id, idx), precommit.signature)
+            indexed.append((idx, precommit, val))
+        results = bv.verify_all()
+        old_power = 0
+        for ok, (idx, precommit, val) in zip(results, indexed):
+            if not ok:
+                raise VerifyError(f"invalid commit: invalid signature at index {idx}")
+            if block_id == precommit.block_id:
+                old_power += val.voting_power
+        if old_power <= old_vals.total_voting_power() * 2 // 3:
+            raise TooMuchChangeError(
+                f"insufficient old voting power: got {old_power}, "
+                f"needed > {old_vals.total_voting_power() * 2 // 3}"
+            )
+
+    # -- codec --------------------------------------------------------------
+
+    def encode(self) -> bytes:
+        from tendermint_tpu.encoding import Writer
+
+        w = Writer().u32(len(self.validators))
+        for v in self.validators:
+            w.bytes(v.encode())
+        prop_idx = -1
+        if self.proposer is not None:
+            prop_idx, _ = self.get_by_address(self.proposer.address)
+        w.i64(prop_idx)
+        return w.build()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "ValidatorSet":
+        from tendermint_tpu.encoding import Reader
+
+        r = Reader(data)
+        n = r.u32()
+        vals = [Validator.decode(r.bytes()) for _ in range(n)]
+        prop_idx = r.i64()
+        r.expect_done()
+        new = object.__new__(cls)
+        new.validators = vals
+        new._total = None
+        new.proposer = vals[prop_idx].copy() if 0 <= prop_idx < len(vals) else None
+        return new
+
+    def __str__(self) -> str:
+        return f"ValidatorSet{{n={len(self.validators)} power={self.total_voting_power()}}}"
+
+
+def new_validator_set(pubkeys_powers: list[tuple[PubKey, int]]) -> ValidatorSet:
+    return ValidatorSet([Validator(pk, p) for pk, p in pubkeys_powers])

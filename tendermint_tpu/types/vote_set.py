@@ -1,0 +1,250 @@
+"""VoteSet — the 2/3-majority accumulator. North-star hot loop #1.
+
+Reference parity: types/vote_set.go:54 — canonical votes[] plus per-block
+votesByBlock for conflict tracking, peer-claimed majorities (SetPeerMaj23),
+quorum detection (vote_set.go:261-281), MakeCommit (vote_set.go:534).
+
+Batch-first redesign: the reference verifies one ed25519 signature per
+AddVote, serially, under the mutex (vote_set.go:189). Here structural
+validation and signature verification are split so that `add_votes` (bulk
+ingest: fast sync, commit reconstruction, gossip bursts) pushes ALL
+signatures through crypto.batch in one device launch; `add_vote` is the
+single-vote convenience wrapper over the same path.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tendermint_tpu.crypto.batch import BatchVerifier
+from tendermint_tpu.libs.bit_array import BitArray
+from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.types.vote import BlockID, Vote, VoteType
+
+
+class VoteSetError(Exception):
+    pass
+
+
+class ConflictingVoteError(VoteSetError):
+    """Equivocation detected — carries both votes for evidence creation."""
+
+    def __init__(self, existing: Vote, conflicting: Vote) -> None:
+        super().__init__(f"conflicting votes: {existing} vs {conflicting}")
+        self.existing = existing
+        self.conflicting = conflicting
+
+
+@dataclass
+class _BlockVotes:
+    peer_maj23: bool
+    bit_array: BitArray
+    votes: list[Vote | None]
+    sum: int = 0
+
+    @classmethod
+    def new(cls, peer_maj23: bool, num_validators: int) -> "_BlockVotes":
+        return cls(peer_maj23, BitArray(num_validators), [None] * num_validators)
+
+    def add_verified_vote(self, vote: Vote, voting_power: int) -> None:
+        idx = vote.validator_index
+        if self.votes[idx] is None:
+            self.bit_array.set_index(idx, True)
+            self.votes[idx] = vote
+            self.sum += voting_power
+
+
+class VoteSet:
+    def __init__(
+        self,
+        chain_id: str,
+        height: int,
+        round_: int,
+        type_: VoteType,
+        val_set: ValidatorSet,
+    ) -> None:
+        if height < 1:
+            raise ValueError("cannot make VoteSet for height <= 0")
+        self.chain_id = chain_id
+        self.height = height
+        self.round = round_
+        self.type = type_
+        self.val_set = val_set
+        self.votes_bit_array = BitArray(val_set.size())
+        self.votes: list[Vote | None] = [None] * val_set.size()
+        self.sum = 0
+        self.maj23: BlockID | None = None
+        self.votes_by_block: dict[bytes, _BlockVotes] = {}
+        self.peer_maj23s: dict[str, BlockID] = {}
+
+    # -- ingest -------------------------------------------------------------
+
+    def add_vote(self, vote: Vote) -> bool:
+        """Single-vote ingest (arrival-driven consensus path)."""
+        return self.add_votes([vote])[0]
+
+    def add_votes(self, votes: list[Vote]) -> list[bool]:
+        """Bulk ingest: structural checks per vote, then ONE signature batch,
+        then application in order. Raises on the first hard error (bad index,
+        conflicting signature from the same validator, invalid signature) —
+        matching the reference's per-vote error semantics."""
+        bv = BatchVerifier()
+        checked: list[tuple[Vote, int, Vote | None] | None] = []
+        for vote in votes:
+            prepared = self._precheck(vote)
+            if prepared is None:
+                checked.append(None)  # duplicate — no signature work needed
+                continue
+            power, conflict = prepared
+            bv.add(
+                self.val_set.validators[vote.validator_index].pub_key,
+                vote.sign_bytes(self.chain_id),
+                vote.signature,
+            )
+            checked.append((vote, power, conflict))
+        results = iter(bv.verify_all())
+        out = []
+        for vote, item in zip(votes, checked):
+            if item is None:
+                out.append(False)  # duplicate
+                continue
+            v, power, conflict = item
+            if not next(results):
+                raise VoteSetError(f"invalid signature for {v}")
+            if conflict is not None:
+                # track under the peer-claimed block, then surface the
+                # equivocation for evidence (reference vote_set.go:217-240)
+                self.votes_by_block[v.block_id.key()].add_verified_vote(v, power)
+                raise ConflictingVoteError(conflict, v)
+            out.append(self._apply_verified(v, power))
+        return out
+
+    def _precheck(self, vote: Vote) -> tuple[int, Vote | None] | None:
+        """Structural validation. Returns (voting power, conflicting vote or
+        None), or None for an exact duplicate. Raises VoteSetError /
+        ConflictingVoteError."""
+        idx = vote.validator_index
+        if idx < 0:
+            raise VoteSetError("negative validator index")
+        if not vote.signature:
+            raise VoteSetError("vote has no signature")
+        if (vote.height, vote.round, vote.type) != (self.height, self.round, self.type):
+            raise VoteSetError(
+                f"expected {self.height}/{self.round}/{self.type}, got "
+                f"{vote.height}/{vote.round}/{vote.type}"
+            )
+        addr, val = self.val_set.get_by_index(idx)
+        if val is None:
+            raise VoteSetError(f"validator index {idx} out of range")
+        if addr != vote.validator_address:
+            raise VoteSetError("validator address does not match index")
+        existing = self.votes[idx]
+        if existing is not None and existing.block_id == vote.block_id:
+            if existing.signature == vote.signature:
+                return None  # exact duplicate
+            raise VoteSetError(
+                "non-deterministic signature from the same validator for the same block"
+            )
+        if existing is not None:
+            # conflicting vote: only track if a peer claimed maj23 for it
+            by_block = self.votes_by_block.get(vote.block_id.key())
+            if by_block is None or not by_block.peer_maj23:
+                raise ConflictingVoteError(existing, vote)
+            return val.voting_power, existing
+        return val.voting_power, None
+
+    def _apply_verified(self, vote: Vote, power: int) -> bool:
+        idx = vote.validator_index
+        key = vote.block_id.key()
+        existing = self.votes[idx]
+        if existing is None:
+            self.votes[idx] = vote
+            self.votes_bit_array.set_index(idx, True)
+            self.sum += power
+        by_block = self.votes_by_block.get(key)
+        if by_block is None:
+            if existing is not None:
+                return False  # conflict without peer_maj23 (already raised)
+            by_block = _BlockVotes.new(False, self.val_set.size())
+            self.votes_by_block[key] = by_block
+        had = by_block.votes[idx] is not None
+        by_block.add_verified_vote(vote, power)
+        if had:
+            return False
+        # quorum detection (reference vote_set.go:261-281)
+        quorum = self.val_set.total_voting_power() * 2 // 3 + 1
+        if by_block.sum >= quorum and self.maj23 is None:
+            self.maj23 = vote.block_id
+            # canonicalize: maj23 votes win the votes[] slots
+            for i, v in enumerate(by_block.votes):
+                if v is not None:
+                    self.votes[i] = v
+        return True
+
+    # -- peer claims --------------------------------------------------------
+
+    def set_peer_maj23(self, peer_id: str, block_id: BlockID) -> None:
+        """A peer claims 2/3 majority for block_id (reference
+        vote_set.go:286): start tracking conflicting votes for it."""
+        existing = self.peer_maj23s.get(peer_id)
+        if existing is not None:
+            if existing != block_id:
+                raise VoteSetError("conflicting peer maj23 claims")
+            return
+        self.peer_maj23s[peer_id] = block_id
+        key = block_id.key()
+        if key not in self.votes_by_block:
+            self.votes_by_block[key] = _BlockVotes.new(True, self.val_set.size())
+        else:
+            self.votes_by_block[key].peer_maj23 = True
+
+    # -- queries ------------------------------------------------------------
+
+    def two_thirds_majority(self) -> tuple[BlockID, bool]:
+        if self.maj23 is not None:
+            return self.maj23, True
+        return BlockID(), False
+
+    def has_two_thirds_majority(self) -> bool:
+        return self.maj23 is not None
+
+    def has_two_thirds_any(self) -> bool:
+        return self.sum > self.val_set.total_voting_power() * 2 // 3
+
+    def has_all(self) -> bool:
+        return self.sum == self.val_set.total_voting_power()
+
+    def bit_array(self) -> BitArray:
+        return self.votes_bit_array.copy()
+
+    def bit_array_by_block_id(self, block_id: BlockID) -> BitArray | None:
+        bv = self.votes_by_block.get(block_id.key())
+        return bv.bit_array.copy() if bv else None
+
+    def get_by_index(self, idx: int) -> Vote | None:
+        if 0 <= idx < len(self.votes):
+            return self.votes[idx]
+        return None
+
+    def get_by_address(self, address: bytes) -> Vote | None:
+        idx, val = self.val_set.get_by_address(address)
+        return self.votes[idx] if val is not None else None
+
+    def make_commit(self):
+        """Reference vote_set.go:534 — requires a precommit 2/3 majority."""
+        from tendermint_tpu.types.block import Commit
+
+        if self.type != VoteType.PRECOMMIT:
+            raise VoteSetError("cannot MakeCommit from non-precommit VoteSet")
+        if self.maj23 is None:
+            raise VoteSetError("cannot MakeCommit: no 2/3 majority")
+        by_block = self.votes_by_block[self.maj23.key()]
+        return Commit(self.maj23, list(by_block.votes))
+
+    def __len__(self) -> int:
+        return sum(1 for v in self.votes if v is not None)
+
+    def __str__(self) -> str:
+        return (
+            f"VoteSet{{{self.height}/{self.round}/{self.type.name} "
+            f"{self.votes_bit_array} sum={self.sum}}}"
+        )

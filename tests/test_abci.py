@@ -1,0 +1,203 @@
+"""ABCI protocol, client/server, example apps, proxy tests
+(mirrors reference abci conformance: test/app/test.sh, abci/tests)."""
+import asyncio
+
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.client import LocalClient, SocketClient
+from tendermint_tpu.abci.examples import (
+    CounterApplication,
+    KVStoreApplication,
+    PersistentKVStoreApplication,
+)
+from tendermint_tpu.abci.server import ABCIServer
+from tendermint_tpu.abci.types import (
+    decode_request,
+    decode_response,
+    encode_request,
+    encode_response,
+)
+from tendermint_tpu.crypto.merkle import default_proof_runtime
+from tendermint_tpu import proxy
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestWireCodec:
+    def test_request_roundtrip(self):
+        reqs = [
+            abci.RequestEcho("hi"),
+            abci.RequestFlush(),
+            abci.RequestInfo("v1", 10, 7),
+            abci.RequestSetOption("serial", "on"),
+            abci.RequestInitChain(
+                5, "chain", b"params", [abci.ValidatorUpdate(b"\x01pk", 10)], b"state"
+            ),
+            abci.RequestQuery(b"key", "/store", 3, True),
+            abci.RequestBeginBlock(
+                b"hash",
+                b"header",
+                [abci.VoteInfo(b"addr", 5, True)],
+                [abci.EvidenceInfo("duplicate/vote", b"addr", 2, 100)],
+            ),
+            abci.RequestCheckTx(b"tx", False),
+            abci.RequestDeliverTx(b"tx2"),
+            abci.RequestEndBlock(9),
+            abci.RequestCommit(),
+        ]
+        for req in reqs:
+            assert decode_request(encode_request(req)) == req
+
+    def test_response_roundtrip(self):
+        resps = [
+            abci.ResponseEcho("hi"),
+            abci.ResponseInfo("d", "v", 1, 5, b"hash"),
+            abci.ResponseCheckTx(code=1, log="bad", events={"k": ["v1", "v2"]}),
+            abci.ResponseDeliverTx(code=0, data=b"result"),
+            abci.ResponseEndBlock([abci.ValidatorUpdate(b"pk", 7)], b"", {}),
+            abci.ResponseCommit(b"apphash"),
+            abci.ResponseException("boom"),
+        ]
+        for resp in resps:
+            assert decode_response(encode_response(resp)) == resp
+
+
+class TestKVStore:
+    def test_deliver_query(self):
+        app = KVStoreApplication()
+        assert app.check_tx(abci.RequestCheckTx(b"a=1")).is_ok
+        app.deliver_tx(abci.RequestDeliverTx(b"a=1"))
+        app.deliver_tx(abci.RequestDeliverTx(b"noequals"))
+        app.end_block(abci.RequestEndBlock(1))
+        c = app.commit()
+        assert c.data != b""
+        q = app.query(abci.RequestQuery(data=b"a"))
+        assert q.value == b"1"
+        q2 = app.query(abci.RequestQuery(data=b"noequals"))
+        assert q2.value == b"noequals"
+        q3 = app.query(abci.RequestQuery(data=b"missing"))
+        assert q3.value == b""
+
+    def test_query_proof_verifies(self):
+        app = KVStoreApplication()
+        for kv in (b"a=1", b"b=2", b"c=3"):
+            app.deliver_tx(abci.RequestDeliverTx(kv))
+        app.end_block(abci.RequestEndBlock(1))
+        root = app.commit().data
+        q = app.query(abci.RequestQuery(data=b"b", prove=True))
+        assert q.proof_ops
+        rt = default_proof_runtime()
+        assert rt.verify_value(q.proof_ops, root, [b"b"], q.value)
+        assert not rt.verify_value(q.proof_ops, root, [b"b"], b"22")
+
+    def test_persistent_recovers(self, tmp_path):
+        d = str(tmp_path)
+        app = PersistentKVStoreApplication(d)
+        app.deliver_tx(abci.RequestDeliverTx(b"k=v"))
+        app.end_block(abci.RequestEndBlock(3))
+        h = app.commit().data
+        app2 = PersistentKVStoreApplication(d)
+        assert app2.height == 3
+        assert app2.app_hash == h
+        assert app2.state["k"] == b"v"
+
+    def test_validator_tx(self, tmp_path):
+        app = PersistentKVStoreApplication(str(tmp_path))
+        pk = bytes(33)
+        tx = b"val:" + pk.hex().encode() + b"!42"
+        assert app.check_tx(abci.RequestCheckTx(tx)).is_ok
+        assert app.deliver_tx(abci.RequestDeliverTx(tx)).is_ok
+        eb = app.end_block(abci.RequestEndBlock(1))
+        assert eb.validator_updates == [abci.ValidatorUpdate(pk, 42)]
+        assert not app.check_tx(abci.RequestCheckTx(b"val:zz!1")).is_ok
+
+
+class TestCounter:
+    def test_serial(self):
+        app = CounterApplication(serial=True)
+        assert app.check_tx(abci.RequestCheckTx((0).to_bytes(8, "big"))).is_ok
+        assert app.deliver_tx(abci.RequestDeliverTx((0).to_bytes(8, "big"))).is_ok
+        assert not app.deliver_tx(abci.RequestDeliverTx((5).to_bytes(8, "big"))).is_ok
+        assert app.deliver_tx(abci.RequestDeliverTx((1).to_bytes(8, "big"))).is_ok
+        assert app.tx_count == 2
+        assert not app.check_tx(abci.RequestCheckTx((0).to_bytes(8, "big"))).is_ok
+
+
+class TestSocketClientServer:
+    def test_roundtrip_and_pipelining(self):
+        async def main():
+            app = KVStoreApplication()
+            server = ABCIServer(app, "tcp://127.0.0.1:0")
+            await server.start()
+            try:
+                client = SocketClient(f"tcp://127.0.0.1:{server.port}")
+                await client.start()
+                echo = await client.echo("ping")
+                assert echo.message == "ping"
+                info = await client.info(abci.RequestInfo())
+                assert info.last_block_height == 0
+                # pipelined delivery, like execBlockOnProxyApp
+                futs = [
+                    client.deliver_tx_async(abci.RequestDeliverTx(f"k{i}=v{i}".encode()))
+                    for i in range(20)
+                ]
+                await client.flush()
+                for f in futs:
+                    assert (await f).is_ok
+                await client.end_block(abci.RequestEndBlock(1))
+                commit = await client.commit()
+                assert commit.data == app.app_hash
+                await client.stop()
+            finally:
+                await server.stop()
+
+        run(main())
+
+    def test_exception_response(self):
+        class BadApp(abci.BaseApplication):
+            def deliver_tx(self, req):
+                raise RuntimeError("app exploded")
+
+        async def main():
+            server = ABCIServer(BadApp(), "tcp://127.0.0.1:0")
+            await server.start()
+            try:
+                client = SocketClient(f"tcp://127.0.0.1:{server.port}")
+                await client.start()
+                from tendermint_tpu.abci.client import ABCIClientError
+
+                with pytest.raises(ABCIClientError):
+                    await client.deliver_tx(abci.RequestDeliverTx(b"x"))
+                await client.stop()
+            finally:
+                await server.stop()
+
+        run(main())
+
+
+class TestProxy:
+    def test_app_conns_local(self):
+        async def main():
+            conns = proxy.AppConns(proxy.default_client_creator("kvstore"))
+            await conns.start()
+            info = await conns.query.info(abci.RequestInfo())
+            assert info.last_block_height == 0
+            fut = conns.consensus.deliver_tx_async(b"x=y")
+            await conns.consensus.flush()
+            assert (await fut).is_ok
+            resp = await conns.consensus.commit()
+            assert resp.data
+            check = await conns.mempool.check_tx(b"z")
+            assert check.is_ok
+            await conns.stop()
+
+        run(main())
+
+    def test_creator_mapping(self):
+        assert isinstance(proxy.default_client_creator("counter"), proxy.LocalClientCreator)
+        assert isinstance(
+            proxy.default_client_creator("tcp://127.0.0.1:1234"), proxy.RemoteClientCreator
+        )

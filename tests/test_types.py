@@ -1,0 +1,387 @@
+"""Domain-model tests (mirrors reference types/*_test.go)."""
+import pytest
+
+from tendermint_tpu.crypto import ed25519
+from tendermint_tpu.types import (
+    Block,
+    BlockID,
+    Commit,
+    Data,
+    Header,
+    MockPV,
+    PartSet,
+    PartSetHeader,
+    Proposal,
+    ValidatorSet,
+    Vote,
+    VoteSet,
+    VoteType,
+    make_block,
+)
+from tendermint_tpu.types.validator import Validator
+from tendermint_tpu.types.validator_set import TooMuchChangeError, VerifyError
+from tendermint_tpu.types.vote_set import ConflictingVoteError, VoteSetError
+
+CHAIN_ID = "test-chain"
+
+
+def make_valset(n, power=10):
+    pvs = [MockPV() for _ in range(n)]
+    vs = ValidatorSet([Validator(pv.get_pub_key(), power) for pv in pvs])
+    # sort pvs to validator order
+    pvs.sort(key=lambda pv: pv.address)
+    return vs, pvs
+
+
+def make_vote(pv, vs, height, round_, type_, block_id, ts=1_700_000_000_000_000_000):
+    idx, val = vs.get_by_address(pv.address)
+    vote = Vote(
+        type=type_,
+        height=height,
+        round=round_,
+        block_id=block_id,
+        timestamp=ts,
+        validator_address=pv.address,
+        validator_index=idx,
+    )
+    return pv.sign_vote(CHAIN_ID, vote)
+
+
+def rand_block_id(seed=b"x"):
+    import hashlib
+
+    h = hashlib.sha256(seed).digest()
+    return BlockID(h, PartSetHeader(1, hashlib.sha256(h).digest()))
+
+
+class TestPartSet:
+    def test_roundtrip(self):
+        data = b"Q" * 300
+        ps = PartSet.from_data(data, part_size=64)
+        assert ps.is_complete() and ps.total == 5
+        # reassemble through gossip
+        ps2 = PartSet(ps.header())
+        for i in range(ps.total):
+            assert ps2.add_part(ps.get_part(i))
+        assert ps2.is_complete()
+        assert ps2.get_data() == data
+
+    def test_bad_part_rejected(self):
+        ps = PartSet.from_data(b"A" * 100, part_size=32)
+        ps2 = PartSet(ps.header())
+        part = ps.get_part(0)
+        import copy
+
+        bad = copy.deepcopy(part)
+        bad.bytes_ = b"tampered" + bad.bytes_[8:]
+        assert not ps2.add_part(bad)
+        assert ps2.add_part(part)
+        assert not ps2.add_part(part)  # duplicate
+
+
+class TestVote:
+    def test_sign_verify_roundtrip(self):
+        vs, pvs = make_valset(1)
+        bid = rand_block_id()
+        vote = make_vote(pvs[0], vs, 5, 0, VoteType.PRECOMMIT, bid)
+        assert vote.verify(CHAIN_ID, pvs[0].get_pub_key())
+        assert not vote.verify("other-chain", pvs[0].get_pub_key())
+        v2 = Vote.decode(vote.encode())
+        assert v2 == vote
+
+    def test_sign_bytes_deterministic_and_distinct(self):
+        bid = rand_block_id()
+        v = Vote(VoteType.PREVOTE, 1, 0, bid, 42, b"\x01" * 20, 0)
+        assert v.sign_bytes(CHAIN_ID) == v.sign_bytes(CHAIN_ID)
+        import dataclasses
+
+        assert v.sign_bytes(CHAIN_ID) != dataclasses.replace(v, height=2).sign_bytes(CHAIN_ID)
+        assert v.sign_bytes(CHAIN_ID) != dataclasses.replace(v, round=1).sign_bytes(CHAIN_ID)
+        assert v.sign_bytes(CHAIN_ID) != dataclasses.replace(
+            v, type=VoteType.PRECOMMIT
+        ).sign_bytes(CHAIN_ID)
+
+
+class TestValidatorSet:
+    def test_sorted_and_hash_stable(self):
+        vs, _ = make_valset(5)
+        addrs = [v.address for v in vs.validators]
+        assert addrs == sorted(addrs)
+        assert vs.hash() == vs.copy().hash()
+
+    def test_proposer_rotation_proportional(self):
+        """Weighted round robin: proposer frequency tracks voting power
+        (reference validator_set_test.go proposer-priority properties)."""
+        pv_a, pv_b, pv_c = MockPV(), MockPV(), MockPV()
+        vs = ValidatorSet(
+            [
+                Validator(pv_a.get_pub_key(), 1),
+                Validator(pv_b.get_pub_key(), 2),
+                Validator(pv_c.get_pub_key(), 7),
+            ]
+        )
+        counts = {}
+        for _ in range(1000):
+            p = vs.get_proposer()
+            counts[p.address] = counts.get(p.address, 0) + 1
+            vs.increment_proposer_priority(1)
+        by_power = {v.address: v.voting_power for v in vs.validators}
+        for addr, cnt in counts.items():
+            expected = 1000 * by_power[addr] / 10
+            assert abs(cnt - expected) <= 25, (cnt, expected)
+
+    def test_priorities_centered(self):
+        vs, _ = make_valset(7)
+        vs.increment_proposer_priority(3)
+        total = sum(v.proposer_priority for v in vs.validators)
+        assert abs(total) < len(vs.validators) * vs.total_voting_power()
+
+    def test_update_add_remove(self):
+        vs, pvs = make_valset(3, power=10)
+        new_pv = MockPV()
+        vs.update_with_change_set([Validator(new_pv.get_pub_key(), 5)])
+        assert vs.size() == 4
+        assert vs.total_voting_power() == 35
+        # new validator enters with lowest priority — not immediate proposer
+        idx, v = vs.get_by_address(new_pv.address)
+        assert v is not None
+        # update power
+        vs.update_with_change_set([Validator(new_pv.get_pub_key(), 20)])
+        assert vs.total_voting_power() == 50
+        # removal
+        vs.update_with_change_set([Validator(new_pv.get_pub_key(), 0)])
+        assert vs.size() == 3
+        with pytest.raises(ValueError):
+            vs.update_with_change_set([Validator(MockPV().get_pub_key(), 0)])
+
+    def test_encode_roundtrip(self):
+        vs, _ = make_valset(4)
+        vs2 = ValidatorSet.decode(vs.encode())
+        assert vs2.hash() == vs.hash()
+        assert [v.proposer_priority for v in vs2.validators] == [
+            v.proposer_priority for v in vs.validators
+        ]
+
+
+def build_commit(vs, pvs, height, round_, block_id, signers=None, vote_block=None):
+    """Create a commit by running votes through a VoteSet."""
+    voteset = VoteSet(CHAIN_ID, height, round_, VoteType.PRECOMMIT, vs)
+    votes = []
+    for i, pv in enumerate(pvs):
+        if signers is not None and i not in signers:
+            continue
+        votes.append(
+            make_vote(pv, vs, height, round_, VoteType.PRECOMMIT, vote_block or block_id)
+        )
+    voteset.add_votes(votes)
+    return voteset.make_commit()
+
+
+class TestVoteSetAndCommit:
+    def test_quorum_detection(self):
+        vs, pvs = make_valset(4)
+        bid = rand_block_id()
+        voteset = VoteSet(CHAIN_ID, 1, 0, VoteType.PREVOTE, vs)
+        for i, pv in enumerate(pvs[:2]):
+            voteset.add_vote(make_vote(pv, vs, 1, 0, VoteType.PREVOTE, bid))
+        assert not voteset.has_two_thirds_majority()
+        voteset.add_vote(make_vote(pvs[2], vs, 1, 0, VoteType.PREVOTE, bid))
+        maj, ok = voteset.two_thirds_majority()
+        assert ok and maj == bid
+
+    def test_nil_votes_no_quorum_for_block(self):
+        vs, pvs = make_valset(4)
+        bid = rand_block_id()
+        voteset = VoteSet(CHAIN_ID, 1, 0, VoteType.PREVOTE, vs)
+        voteset.add_vote(make_vote(pvs[0], vs, 1, 0, VoteType.PREVOTE, bid))
+        for pv in pvs[1:]:
+            voteset.add_vote(make_vote(pv, vs, 1, 0, VoteType.PREVOTE, BlockID()))
+        maj, ok = voteset.two_thirds_majority()
+        assert ok and maj.is_zero()  # 2/3 voted nil
+
+    def test_duplicate_and_invalid(self):
+        vs, pvs = make_valset(3)
+        bid = rand_block_id()
+        voteset = VoteSet(CHAIN_ID, 1, 0, VoteType.PREVOTE, vs)
+        v = make_vote(pvs[0], vs, 1, 0, VoteType.PREVOTE, bid)
+        assert voteset.add_vote(v)
+        assert not voteset.add_vote(v)  # duplicate
+        with pytest.raises(VoteSetError):
+            bad = v.with_signature(b"\x00" * 64)
+            voteset.add_vote(bad)  # conflicting? no: same block, bad sig -> dup
+        # wrong height
+        with pytest.raises(VoteSetError):
+            voteset.add_vote(make_vote(pvs[1], vs, 2, 0, VoteType.PREVOTE, bid))
+
+    def test_conflicting_votes_raise(self):
+        vs, pvs = make_valset(3)
+        voteset = VoteSet(CHAIN_ID, 1, 0, VoteType.PREVOTE, vs)
+        voteset.add_vote(make_vote(pvs[0], vs, 1, 0, VoteType.PREVOTE, rand_block_id(b"a")))
+        with pytest.raises(ConflictingVoteError):
+            voteset.add_vote(make_vote(pvs[0], vs, 1, 0, VoteType.PREVOTE, rand_block_id(b"b")))
+
+    def test_peer_maj23_tracks_conflicts(self):
+        vs, pvs = make_valset(3)
+        bid_a, bid_b = rand_block_id(b"a"), rand_block_id(b"b")
+        voteset = VoteSet(CHAIN_ID, 1, 0, VoteType.PREVOTE, vs)
+        voteset.add_vote(make_vote(pvs[0], vs, 1, 0, VoteType.PREVOTE, bid_a))
+        voteset.set_peer_maj23("peer1", bid_b)
+        # now the conflicting vote is tracked (but still raises for evidence)
+        with pytest.raises(ConflictingVoteError):
+            voteset.add_vote(make_vote(pvs[0], vs, 1, 0, VoteType.PREVOTE, bid_b))
+
+    def test_make_commit_and_verify(self):
+        vs, pvs = make_valset(4)
+        bid = rand_block_id()
+        commit = build_commit(vs, pvs, 3, 1, bid)
+        assert commit.height() == 3 and commit.round() == 1
+        vs.verify_commit(CHAIN_ID, bid, 3, commit)  # no raise
+        with pytest.raises(VerifyError):
+            vs.verify_commit(CHAIN_ID, bid, 4, commit)
+        with pytest.raises(VerifyError):
+            vs.verify_commit(CHAIN_ID, rand_block_id(b"other"), 3, commit)
+
+    def test_verify_commit_insufficient_power(self):
+        vs, pvs = make_valset(4)
+        bid = rand_block_id()
+        voteset = VoteSet(CHAIN_ID, 1, 0, VoteType.PRECOMMIT, vs)
+        votes = [make_vote(pv, vs, 1, 0, VoteType.PRECOMMIT, bid) for pv in pvs]
+        voteset.add_votes(votes)
+        commit = voteset.make_commit()
+        # drop two signatures -> only 2/4 power
+        commit.precommits[0] = None
+        commit.precommits[1] = None
+        with pytest.raises(TooMuchChangeError):
+            vs.verify_commit(CHAIN_ID, bid, 1, commit)
+
+    def test_verify_commit_bad_sig_rejected(self):
+        vs, pvs = make_valset(4)
+        bid = rand_block_id()
+        commit = build_commit(vs, pvs, 1, 0, bid)
+        import dataclasses
+
+        idx = next(i for i, p in enumerate(commit.precommits) if p is not None)
+        commit.precommits[idx] = dataclasses.replace(
+            commit.precommits[idx], signature=b"\x11" * 64
+        )
+        with pytest.raises(VerifyError):
+            vs.verify_commit(CHAIN_ID, bid, 1, commit)
+
+    def test_verify_future_commit(self):
+        vs, pvs = make_valset(4, power=10)
+        bid = rand_block_id()
+        # new set: one validator swapped out
+        new_pv = MockPV()
+        new_vs = vs.copy()
+        new_vs.update_with_change_set([Validator(new_pv.get_pub_key(), 10)])
+        new_pvs = sorted(pvs + [new_pv], key=lambda pv: pv.address)
+        # remove one old validator from new set
+        removed = pvs[0]
+        new_vs.update_with_change_set([Validator(removed.get_pub_key(), 0)])
+        new_pvs = [pv for pv in new_pvs if pv.address != removed.address]
+        commit = build_commit(new_vs, new_pvs, 10, 0, bid)
+        # old set still has 3/4 of its validators signing -> >2/3
+        vs.verify_future_commit(new_vs, CHAIN_ID, bid, 10, commit)
+
+    def test_commit_roundtrip(self):
+        vs, pvs = make_valset(4)
+        bid = rand_block_id()
+        commit = build_commit(vs, pvs, 1, 0, bid, signers={0, 1, 2})
+        c2 = Commit.decode(commit.encode())
+        assert c2.block_id == commit.block_id
+        assert c2.hash() == commit.hash()
+        vs.verify_commit(CHAIN_ID, bid, 1, c2)
+
+
+class TestBlock:
+    def _block(self):
+        vs, pvs = make_valset(4)
+        bid = rand_block_id()
+        last_commit = build_commit(vs, pvs, 1, 0, bid)
+        block = make_block(
+            2,
+            [b"tx1", b"tx2"],
+            last_commit,
+            chain_id=CHAIN_ID,
+            validators_hash=vs.hash(),
+            next_validators_hash=vs.hash(),
+            proposer_address=vs.get_proposer().address,
+        )
+        return block
+
+    def test_basic_validation_and_hash(self):
+        block = self._block()
+        block.validate_basic()
+        assert len(block.hash()) == 32
+        h2 = Header.decode(block.header.encode())
+        assert h2.hash() == block.hash()
+
+    def test_encode_roundtrip(self):
+        block = self._block()
+        b2 = Block.decode(block.encode())
+        b2.validate_basic()
+        assert b2.hash() == block.hash()
+        assert b2.data.txs == block.data.txs
+
+    def test_part_set_roundtrip(self):
+        block = self._block()
+        ps = block.make_part_set(part_size=128)
+        ps2 = PartSet(ps.header())
+        for i in range(ps.total):
+            assert ps2.add_part(ps.get_part(i))
+        b2 = Block.decode(ps2.get_data())
+        assert b2.hash() == block.hash()
+
+    def test_tampered_block_detected(self):
+        block = self._block()
+        import dataclasses
+
+        block.data.txs.append(b"evil")
+        with pytest.raises(ValueError):
+            block.validate_basic()
+
+
+class TestEvidence:
+    def test_duplicate_vote_evidence(self):
+        from tendermint_tpu.types.evidence import DuplicateVoteEvidence, decode_evidence
+
+        vs, pvs = make_valset(3)
+        pv = pvs[0]
+        va = make_vote(pv, vs, 5, 0, VoteType.PREVOTE, rand_block_id(b"a"))
+        vb = make_vote(pv, vs, 5, 0, VoteType.PREVOTE, rand_block_id(b"b"))
+        ev = DuplicateVoteEvidence(pv.get_pub_key(), va, vb)
+        ev.verify(CHAIN_ID, pv.get_pub_key())  # no raise
+        ev2 = decode_evidence(ev.encode())
+        assert ev2 == ev
+        # same-block "evidence" is invalid
+        ev_bad = DuplicateVoteEvidence(pv.get_pub_key(), va, va)
+        with pytest.raises(ValueError):
+            ev_bad.verify(CHAIN_ID, pv.get_pub_key())
+        # bad signature
+        import dataclasses
+
+        ev_badsig = DuplicateVoteEvidence(
+            pv.get_pub_key(), va, dataclasses.replace(vb, signature=b"\x01" * 64)
+        )
+        with pytest.raises(ValueError):
+            ev_badsig.verify(CHAIN_ID, pv.get_pub_key())
+
+
+class TestGenesis:
+    def test_roundtrip(self, tmp_path):
+        from tendermint_tpu.types import GenesisDoc
+        from tendermint_tpu.types.genesis import GenesisValidator
+
+        pv = MockPV()
+        doc = GenesisDoc(
+            chain_id=CHAIN_ID,
+            validators=[GenesisValidator(pv.get_pub_key(), 10, "v0")],
+            app_state=b'{"k":"v"}',
+        )
+        doc.validate_and_complete()
+        path = str(tmp_path / "genesis.json")
+        doc.save_as(path)
+        doc2 = GenesisDoc.from_file(path)
+        assert doc2.chain_id == doc.chain_id
+        assert doc2.validator_set().hash() == doc.validator_set().hash()
+        assert doc2.app_state == doc.app_state
