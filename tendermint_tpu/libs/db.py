@@ -1,0 +1,107 @@
+"""Key-value DB abstraction — the tm-db analog.
+
+The reference depends on tm-db v0.1.1 (goleveldb/cleveldb/boltdb behind
+dbm.DB, chosen by config.DBBackend; node/node.go:64-67). Here: `DB`
+interface with an in-memory backend and a sqlite3-backed durable backend
+(stdlib, transactional, crash-safe — the natural Python substitute for
+leveldb).
+"""
+from __future__ import annotations
+
+import os
+import sqlite3
+import threading
+from typing import Iterator
+
+
+class DB:
+    def get(self, key: bytes) -> bytes | None:
+        raise NotImplementedError
+
+    def set(self, key: bytes, value: bytes) -> None:
+        raise NotImplementedError
+
+    def set_sync(self, key: bytes, value: bytes) -> None:
+        self.set(key, value)
+
+    def delete(self, key: bytes) -> None:
+        raise NotImplementedError
+
+    def has(self, key: bytes) -> bool:
+        return self.get(key) is not None
+
+    def iterate_prefix(self, prefix: bytes) -> Iterator[tuple[bytes, bytes]]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class MemDB(DB):
+    def __init__(self) -> None:
+        self._d: dict[bytes, bytes] = {}
+
+    def get(self, key: bytes) -> bytes | None:
+        return self._d.get(key)
+
+    def set(self, key: bytes, value: bytes) -> None:
+        self._d[bytes(key)] = bytes(value)
+
+    def delete(self, key: bytes) -> None:
+        self._d.pop(key, None)
+
+    def iterate_prefix(self, prefix: bytes) -> Iterator[tuple[bytes, bytes]]:
+        for k in sorted(self._d):
+            if k.startswith(prefix):
+                yield k, self._d[k]
+
+
+class SQLiteDB(DB):
+    def __init__(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock:
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS kv (k BLOB PRIMARY KEY, v BLOB)"
+            )
+            self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.commit()
+
+    def get(self, key: bytes) -> bytes | None:
+        with self._lock:
+            row = self._conn.execute("SELECT v FROM kv WHERE k=?", (key,)).fetchone()
+        return row[0] if row else None
+
+    def set(self, key: bytes, value: bytes) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO kv (k, v) VALUES (?, ?)", (key, value)
+            )
+            self._conn.commit()
+
+    def delete(self, key: bytes) -> None:
+        with self._lock:
+            self._conn.execute("DELETE FROM kv WHERE k=?", (key,))
+            self._conn.commit()
+
+    def iterate_prefix(self, prefix: bytes) -> Iterator[tuple[bytes, bytes]]:
+        hi = prefix + b"\xff" * 8
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT k, v FROM kv WHERE k >= ? AND k <= ? ORDER BY k", (prefix, hi)
+            ).fetchall()
+        for k, v in rows:
+            if bytes(k).startswith(prefix):
+                yield bytes(k), bytes(v)
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+
+def new_db(backend: str, name: str, db_dir: str) -> DB:
+    """Reference node/node.go:64-67 DBProvider."""
+    if backend in ("mem", "memdb"):
+        return MemDB()
+    return SQLiteDB(os.path.join(db_dir, f"{name}.db"))

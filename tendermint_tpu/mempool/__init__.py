@@ -1,0 +1,256 @@
+"""Mempool — CheckTx'd transaction FIFO with gossip support.
+
+Reference parity: mempool/clist_mempool.go:31 — concurrent FIFO (clist) of
+app-admitted txs with an LRU dedup cache (:211,660), app-callback-driven
+admission (:363), ReapMaxBytesMaxGas for proposals (:462), post-commit
+Update + recheck (:520,582), optional WAL (:135). The gossip reactor lives
+in tendermint_tpu/mempool/reactor.py.
+"""
+from __future__ import annotations
+
+import asyncio
+from collections import OrderedDict
+from dataclasses import dataclass
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.crypto import sum_sha256
+from tendermint_tpu.libs.clist import CList
+from tendermint_tpu.libs.log import NOP, Logger
+
+
+class MempoolError(Exception):
+    pass
+
+
+class TxInCacheError(MempoolError):
+    pass
+
+
+class MempoolFullError(MempoolError):
+    pass
+
+
+@dataclass
+class MempoolTx:
+    """clist payload (reference mempoolTx): tx + admission metadata."""
+
+    tx: bytes
+    height: int  # height at which the tx was validated
+    gas_wanted: int
+    senders: set  # peer ids that sent us this tx (no-echo)
+
+
+class TxCache:
+    """LRU dedup cache (reference mempool/cache.go mapTxCache)."""
+
+    def __init__(self, size: int) -> None:
+        self.size = size
+        self._map: OrderedDict[bytes, None] = OrderedDict()
+
+    def push(self, tx: bytes) -> bool:
+        key = sum_sha256(tx)
+        if key in self._map:
+            self._map.move_to_end(key)
+            return False
+        if len(self._map) >= self.size:
+            self._map.popitem(last=False)
+        self._map[key] = None
+        return True
+
+    def remove(self, tx: bytes) -> None:
+        self._map.pop(sum_sha256(tx), None)
+
+    def reset(self) -> None:
+        self._map.clear()
+
+
+class CListMempool:
+    def __init__(
+        self,
+        app_conn,  # proxy.AppConnMempool
+        height: int = 0,
+        max_txs: int = 5000,
+        max_txs_bytes: int = 1024 * 1024 * 1024,
+        cache_size: int = 10000,
+        keep_invalid_txs_in_cache: bool = False,
+        recheck: bool = True,
+        wal_path: str | None = None,
+        logger: Logger = NOP,
+    ) -> None:
+        self.app_conn = app_conn
+        self.height = height
+        self.max_txs = max_txs
+        self.max_txs_bytes = max_txs_bytes
+        self.recheck = recheck
+        self.txs = CList()
+        self._tx_map: dict[bytes, object] = {}  # tx hash -> CElement
+        self.cache = TxCache(cache_size)
+        self._keep_invalid_in_cache = keep_invalid_txs_in_cache
+        self._txs_bytes = 0
+        self._lock = asyncio.Lock()
+        self._tx_available = asyncio.Event()
+        self._notified_available = False
+        self.logger = logger
+        self._wal = None
+        if wal_path:
+            from tendermint_tpu.libs.autofile import Group
+
+            self._wal = Group(wal_path)
+
+    # -- sizing -------------------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self.txs)
+
+    def size(self) -> int:
+        return len(self.txs)
+
+    def txs_bytes(self) -> int:
+        return self._txs_bytes
+
+    # -- locking around block commit (reference Lock/Unlock) ----------------
+
+    async def lock(self) -> None:
+        await self._lock.acquire()
+
+    def unlock(self) -> None:
+        self._lock.release()
+
+    # -- admission ----------------------------------------------------------
+
+    async def check_tx(self, tx: bytes, sender: str | None = None) -> abci.ResponseCheckTx:
+        """Reference clist_mempool.go:211 CheckTx + resCbFirstTime (:363)."""
+        if len(self.txs) >= self.max_txs or self._txs_bytes + len(tx) > self.max_txs_bytes:
+            raise MempoolFullError(f"mempool full: {len(self.txs)} txs")
+        if not self.cache.push(tx):
+            # record the extra sender for no-echo gossip, then reject
+            el = self._tx_map.get(sum_sha256(tx))
+            if el is not None and sender is not None:
+                el.value.senders.add(sender)
+            raise TxInCacheError("tx already in cache")
+        if self._wal is not None:
+            self._wal.write(tx + b"\n")
+            self._wal.flush()
+        res = await self.app_conn.check_tx(tx)
+        if res.is_ok:
+            self._add_tx(tx, res.gas_wanted, sender)
+        else:
+            if not self._keep_invalid_in_cache:
+                self.cache.remove(tx)
+            self.logger.debug("rejected bad tx", code=res.code, log=res.log)
+        return res
+
+    def _add_tx(self, tx: bytes, gas_wanted: int, sender: str | None) -> None:
+        mtx = MempoolTx(tx, self.height, gas_wanted, {sender} if sender else set())
+        el = self.txs.push_back(mtx)
+        self._tx_map[sum_sha256(tx)] = el
+        self._txs_bytes += len(tx)
+        self._notify_tx_available()
+
+    def _notify_tx_available(self) -> None:
+        if len(self.txs) > 0 and not self._notified_available:
+            self._notified_available = True
+            self._tx_available.set()
+
+    @property
+    def tx_available(self) -> asyncio.Event:
+        """Fired once per height when txs become available (reference
+        TxsAvailable channel)."""
+        return self._tx_available
+
+    # -- reaping (reference :462) -------------------------------------------
+
+    def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> list[bytes]:
+        total_bytes = 0
+        total_gas = 0
+        out = []
+        for el in self.txs:
+            mtx = el.value
+            if max_bytes > -1 and total_bytes + len(mtx.tx) > max_bytes:
+                break
+            if max_gas > -1 and total_gas + mtx.gas_wanted > max_gas:
+                break
+            total_bytes += len(mtx.tx)
+            total_gas += mtx.gas_wanted
+            out.append(mtx.tx)
+        return out
+
+    def reap_max_txs(self, n: int) -> list[bytes]:
+        out = []
+        for el in self.txs:
+            if 0 <= n <= len(out):
+                break
+            out.append(el.value.tx)
+        return out
+
+    # -- post-commit update (reference :520) --------------------------------
+
+    async def update(self, height: int, txs: list[bytes], pre_check=None) -> None:
+        """Remove committed txs; recheck the remainder against the new app
+        state. Caller must hold the mempool lock (BlockExecutor.Commit)."""
+        self.height = height
+        self._notified_available = False
+        self._tx_available.clear()
+        for tx in txs:
+            self.cache.push(tx)  # committed txs stay in cache
+            el = self._tx_map.pop(sum_sha256(tx), None)
+            if el is not None:
+                self._txs_bytes -= len(el.value.tx)
+                self.txs.remove(el)
+        if self.recheck and len(self.txs) > 0:
+            await self._recheck_txs()
+        self._notify_tx_available()
+
+    async def _recheck_txs(self) -> None:
+        """Reference recheckTxs: pipelined CheckTx(recheck) for survivors."""
+        els = list(self.txs)
+        futs = [
+            self.app_conn.check_tx_async(el.value.tx, new_check=False) for el in els
+        ]
+        await self.app_conn.flush()
+        for el, fut in zip(els, futs):
+            res = await fut
+            if not res.is_ok:
+                tx = el.value.tx
+                self._txs_bytes -= len(tx)
+                self.txs.remove(el)
+                self._tx_map.pop(sum_sha256(tx), None)
+                if not self._keep_invalid_in_cache:
+                    self.cache.remove(tx)
+
+    def flush(self) -> None:
+        """Remove everything (reference Flush)."""
+        for el in list(self.txs):
+            self.txs.remove(el)
+        self._tx_map.clear()
+        self.cache.reset()
+        self._txs_bytes = 0
+
+
+class NopMempool:
+    """Reference mock/mempool.go: the no-op mempool."""
+
+    def __len__(self) -> int:
+        return 0
+
+    def size(self) -> int:
+        return 0
+
+    async def lock(self) -> None:
+        pass
+
+    def unlock(self) -> None:
+        pass
+
+    async def check_tx(self, tx: bytes, sender: str | None = None):
+        raise MempoolError("nop mempool does not accept txs")
+
+    def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> list[bytes]:
+        return []
+
+    async def update(self, height: int, txs: list[bytes], pre_check=None) -> None:
+        pass
+
+    @property
+    def tx_available(self) -> asyncio.Event:
+        return asyncio.Event()

@@ -1,0 +1,245 @@
+"""State — the replicated deterministic state snapshot + persistence.
+
+Reference parity: state/state.go:51 (State struct: validator-set triple,
+consensus params, app hash, last results), state/store.go (persistence with
+per-height validator-set and params history for light clients/evidence).
+"""
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field, replace
+
+from tendermint_tpu.encoding import Reader, Writer
+from tendermint_tpu.libs.db import DB
+from tendermint_tpu.types import Block, BlockID, ConsensusParams, GenesisDoc, ValidatorSet
+from tendermint_tpu.types.block import Version
+
+STATE_KEY = b"ST:state"
+
+
+@dataclass
+class State:
+    """Immutable-ish snapshot of the chain state after applying a block."""
+
+    chain_id: str = ""
+    version: Version = Version()
+    last_block_height: int = 0
+    last_block_total_tx: int = 0
+    last_block_id: BlockID = BlockID()
+    last_block_time: int = 0  # ns
+    validators: ValidatorSet | None = None
+    next_validators: ValidatorSet | None = None
+    last_validators: ValidatorSet | None = None
+    last_height_validators_changed: int = 0
+    consensus_params: ConsensusParams = field(default_factory=ConsensusParams)
+    last_height_consensus_params_changed: int = 0
+    last_results_hash: bytes = b""
+    app_hash: bytes = b""
+
+    def copy(self) -> "State":
+        return replace(self)
+
+    def is_empty(self) -> bool:
+        return self.validators is None
+
+    def make_block(
+        self,
+        height: int,
+        txs: list[bytes],
+        commit,
+        evidence: list,
+        proposer_address: bytes,
+        time_ns: int | None = None,
+    ) -> Block:
+        """Reference state/state.go:133 MakeBlock + fillHeader."""
+        from tendermint_tpu.types import make_block
+        from tendermint_tpu.types.vote import now_ns
+
+        block = make_block(
+            height,
+            txs,
+            commit,
+            evidence,
+            version=self.version,
+            chain_id=self.chain_id,
+            time=time_ns if time_ns is not None else now_ns(),
+            total_txs=self.last_block_total_tx + len(txs),
+            last_block_id=self.last_block_id,
+            validators_hash=self.validators.hash(),
+            next_validators_hash=self.next_validators.hash(),
+            consensus_hash=self.consensus_params.hash(),
+            app_hash=self.app_hash,
+            last_results_hash=self.last_results_hash,
+            proposer_address=proposer_address,
+        )
+        return block
+
+    def encode(self) -> bytes:
+        w = Writer()
+        w.str(self.chain_id)
+        w.u64(self.version.block).u64(self.version.app)
+        w.u64(self.last_block_height).u64(self.last_block_total_tx)
+        self.last_block_id.encode_into(w)
+        w.u64(self.last_block_time)
+        for vs in (self.validators, self.next_validators, self.last_validators):
+            if vs is None:
+                w.u8(0)
+            else:
+                w.u8(1).bytes(vs.encode())
+        w.u64(self.last_height_validators_changed)
+        w.bytes(self.consensus_params.encode())
+        w.u64(self.last_height_consensus_params_changed)
+        w.bytes(self.last_results_hash)
+        w.bytes(self.app_hash)
+        return w.build()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "State":
+        r = Reader(data)
+        chain_id = r.str()
+        version = Version(r.u64(), r.u64())
+        lbh = r.u64()
+        lbt = r.u64()
+        lbid = BlockID.read(r)
+        lbtime = r.u64()
+        sets = []
+        for _ in range(3):
+            sets.append(ValidatorSet.decode(r.bytes()) if r.u8() else None)
+        lhvc = r.u64()
+        params = ConsensusParams.decode(r.bytes())
+        lhcpc = r.u64()
+        lrh = r.bytes()
+        ah = r.bytes()
+        r.expect_done()
+        return cls(
+            chain_id, version, lbh, lbt, lbid, lbtime, sets[0], sets[1], sets[2],
+            lhvc, params, lhcpc, lrh, ah,
+        )
+
+
+def state_from_genesis(genesis: GenesisDoc) -> State:
+    """Reference state/state.go MakeGenesisState."""
+    genesis.validate_and_complete()
+    val_set = genesis.validator_set() if genesis.validators else None
+    next_vals = val_set.copy_increment_proposer_priority(1) if val_set else None
+    return State(
+        chain_id=genesis.chain_id,
+        last_block_height=0,
+        last_block_time=genesis.genesis_time,
+        validators=val_set,
+        next_validators=next_vals,
+        last_validators=ValidatorSet([]),
+        last_height_validators_changed=1,
+        consensus_params=genesis.consensus_params,
+        last_height_consensus_params_changed=1,
+        app_hash=genesis.app_hash,
+    )
+
+
+def _h(height: int) -> bytes:
+    return struct.pack(">Q", height)
+
+
+class StateStore:
+    """Reference state/store.go: current state + historical validator sets,
+    consensus params, and ABCI responses per height."""
+
+    def __init__(self, db: DB) -> None:
+        self._db = db
+
+    def load(self) -> State | None:
+        raw = self._db.get(STATE_KEY)
+        return State.decode(raw) if raw else None
+
+    def save(self, state: State) -> None:
+        # validator sets are saved under the height they take effect
+        self.save_validators(state.last_block_height + 1, state.validators)
+        self.save_validators(state.last_block_height + 2, state.next_validators)
+        self._db.set(
+            b"ST:params:" + _h(state.last_block_height + 1),
+            state.consensus_params.encode(),
+        )
+        self._db.set_sync(STATE_KEY, state.encode())
+
+    def save_validators(self, height: int, vals: ValidatorSet | None) -> None:
+        if vals is not None:
+            self._db.set(b"ST:vals:" + _h(height), vals.encode())
+
+    def load_validators(self, height: int) -> ValidatorSet | None:
+        """Reference state/store.go:188 LoadValidators."""
+        raw = self._db.get(b"ST:vals:" + _h(height))
+        return ValidatorSet.decode(raw) if raw else None
+
+    def load_consensus_params(self, height: int) -> ConsensusParams | None:
+        raw = self._db.get(b"ST:params:" + _h(height))
+        if raw is None:
+            # walk back to the last change
+            for h in range(height, 0, -1):
+                raw = self._db.get(b"ST:params:" + _h(h))
+                if raw is not None:
+                    break
+        return ConsensusParams.decode(raw) if raw else None
+
+    def save_abci_responses(self, height: int, responses: "ABCIResponses") -> None:
+        self._db.set(b"ST:abci:" + _h(height), responses.encode())
+
+    def load_abci_responses(self, height: int) -> "ABCIResponses | None":
+        raw = self._db.get(b"ST:abci:" + _h(height))
+        return ABCIResponses.decode(raw) if raw else None
+
+
+@dataclass
+class ABCIResponses:
+    """Reference state/store.go ABCIResponses: persisted results of a block's
+    execution, source of LastResultsHash."""
+
+    deliver_txs: list = field(default_factory=list)  # list[abci.ResponseDeliverTx]
+    end_block: object = None
+    begin_block: object = None
+
+    def results_hash(self) -> bytes:
+        from tendermint_tpu.crypto import merkle
+
+        items = [
+            Writer().u32(r.code).bytes(r.data).build() for r in self.deliver_txs
+        ]
+        return merkle.hash_from_byte_slices(items)
+
+    def encode(self) -> bytes:
+        from tendermint_tpu.abci import types as abci
+
+        w = Writer().u32(len(self.deliver_txs))
+        for r in self.deliver_txs:
+            w.bytes(r.encode())
+        eb = self.end_block
+        if eb is None:
+            w.u8(0)
+        else:
+            w.u8(1)
+            w.u32(len(eb.validator_updates))
+            for vu in eb.validator_updates:
+                vu.encode_into(w)
+            w.bytes(eb.consensus_param_updates)
+        return w.build()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "ABCIResponses":
+        from tendermint_tpu.abci import types as abci
+
+        r = Reader(data)
+        txs = [abci.ResponseDeliverTx.decode(r.bytes()) for _ in range(r.u32())]
+        eb = None
+        if r.u8():
+            n = r.u32()
+            vus = [abci.ValidatorUpdate.read(r) for _ in range(n)]
+            eb = abci.ResponseEndBlock(validator_updates=vus, consensus_param_updates=r.bytes())
+        return cls(txs, eb)
+
+
+def load_state_from_db_or_genesis(db: DB, genesis: GenesisDoc) -> State:
+    """Reference node/node.go:1118 LoadStateFromDBOrGenesisDocProvider."""
+    store = StateStore(db)
+    state = store.load()
+    if state is None:
+        state = state_from_genesis(genesis)
+    return state

@@ -1,0 +1,245 @@
+"""BlockExecutor — validate, execute against the app, update state.
+
+Reference parity: state/execution.go:117-180 (ApplyBlock: validate →
+execBlockOnProxyApp → save responses → updateState → mempool-locked Commit →
+SaveState → fire events), :84 (CreateProposalBlock), :239-296 (pipelined
+DeliverTx over the consensus connection), :382 (updateState: the
+validator-set shift — changes take effect at H+2), :188-232 (Commit with
+mempool lock/flush/update). fail.fail() crash points straddle the same
+durability boundaries as the reference (execution.go:131,136,167,173).
+"""
+from __future__ import annotations
+
+from tendermint_tpu import proxy
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu import crypto
+from tendermint_tpu.libs import fail
+from tendermint_tpu.libs.log import NOP, Logger
+from tendermint_tpu.state import ABCIResponses, State, StateStore
+from tendermint_tpu.state.validation import validate_block
+from tendermint_tpu.types import Block, BlockID, ValidatorSet
+from tendermint_tpu.types.event_bus import EventBus
+from tendermint_tpu.types.params import BlockParams, ConsensusParams
+from tendermint_tpu.types.validator import Validator
+
+
+class BlockExecutionError(Exception):
+    pass
+
+
+class BlockExecutor:
+    def __init__(
+        self,
+        state_store: StateStore,
+        app_conn: proxy.AppConnConsensus,
+        mempool=None,
+        evidence_pool=None,
+        event_bus: EventBus | None = None,
+        logger: Logger = NOP,
+    ) -> None:
+        self.state_store = state_store
+        self.app = app_conn
+        self.mempool = mempool
+        self.evidence_pool = evidence_pool
+        self.event_bus = event_bus
+        self.logger = logger
+
+    # -- proposal creation (reference execution.go:84) ----------------------
+
+    def create_proposal_block(
+        self, height: int, state: State, commit, proposer_address: bytes
+    ) -> Block:
+        max_bytes = state.consensus_params.block.max_bytes
+        max_gas = state.consensus_params.block.max_gas
+        evidence = (
+            self.evidence_pool.pending_evidence(max_bytes // 10)
+            if self.evidence_pool
+            else []
+        )
+        txs = (
+            self.mempool.reap_max_bytes_max_gas(max_bytes - 2048, max_gas)
+            if self.mempool
+            else []
+        )
+        return state.make_block(height, txs, commit, evidence, proposer_address)
+
+    # -- validation ---------------------------------------------------------
+
+    def validate_block(self, state: State, block: Block) -> None:
+        validate_block(state, block, self.state_store)
+
+    # -- the apply pipeline (reference execution.go:117) --------------------
+
+    async def apply_block(self, state: State, block_id: BlockID, block: Block) -> State:
+        self.validate_block(state, block)
+
+        abci_responses = await self._exec_block_on_proxy_app(state, block)
+
+        fail.fail()  # crash point: after exec, before saving responses
+        self.state_store.save_abci_responses(block.header.height, abci_responses)
+        fail.fail()  # crash point: after saving responses
+
+        validator_updates = self._validate_validator_updates(
+            abci_responses.end_block.validator_updates if abci_responses.end_block else [],
+            state.consensus_params,
+        )
+        new_state = self._update_state(
+            state, block_id, block, abci_responses, validator_updates
+        )
+
+        app_hash = await self._commit(new_state, block)
+        fail.fail()  # crash point: after app commit, before SaveState
+
+        new_state.app_hash = app_hash
+        self.state_store.save(new_state)
+        fail.fail()  # crash point: after SaveState
+
+        if self.evidence_pool is not None:
+            self.evidence_pool.update(block, new_state)
+        if self.event_bus is not None:
+            await self._fire_events(block, abci_responses, validator_updates)
+        return new_state
+
+    async def _exec_block_on_proxy_app(self, state: State, block: Block) -> ABCIResponses:
+        """Reference execution.go:239 execBlockOnProxyApp — pipelined."""
+        commit_votes = self._last_commit_info(state, block)
+        byz = [
+            abci.EvidenceInfo(
+                "duplicate/vote",
+                ev.address(),
+                ev.height(),
+                state.last_validators.total_voting_power()
+                if state.last_validators.size()
+                else 0,
+            )
+            for ev in block.evidence
+        ]
+        begin_resp = await self.app.begin_block(
+            abci.RequestBeginBlock(
+                block.hash(), block.header.encode(), commit_votes, byz
+            )
+        )
+        futs = [self.app.deliver_tx_async(tx) for tx in block.data.txs]
+        await self.app.flush()
+        deliver_resps = []
+        invalid = 0
+        for fut in futs:
+            resp = await fut
+            if not resp.is_ok:
+                invalid += 1
+            deliver_resps.append(resp)
+        if invalid:
+            self.logger.info("invalid txs in block", count=invalid)
+        end_resp = await self.app.end_block(abci.RequestEndBlock(block.header.height))
+        return ABCIResponses(deliver_resps, end_resp, begin_resp)
+
+    def _last_commit_info(self, state: State, block: Block) -> list[abci.VoteInfo]:
+        votes: list[abci.VoteInfo] = []
+        if block.header.height > 1 and block.last_commit is not None:
+            for i, val in enumerate(state.last_validators.validators):
+                signed = (
+                    i < len(block.last_commit.precommits)
+                    and block.last_commit.precommits[i] is not None
+                )
+                votes.append(abci.VoteInfo(val.address, val.voting_power, signed))
+        return votes
+
+    @staticmethod
+    def _validate_validator_updates(
+        updates: list[abci.ValidatorUpdate], params: ConsensusParams
+    ) -> list[Validator]:
+        """Reference execution.go:139-150 + types/protobuf.go checks."""
+        out = []
+        for vu in updates:
+            if vu.power < 0:
+                raise BlockExecutionError("validator update with negative power")
+            pub = crypto.decode_pubkey(vu.pub_key)
+            if vu.power > 0 and pub.TYPE not in params.validator.pub_key_types:
+                raise BlockExecutionError(
+                    f"validator pubkey type {pub.TYPE} not allowed by params"
+                )
+            out.append(Validator(pub, vu.power))
+        return out
+
+    def _update_state(
+        self,
+        state: State,
+        block_id: BlockID,
+        block: Block,
+        abci_responses: ABCIResponses,
+        validator_updates: list[Validator],
+    ) -> State:
+        """Reference execution.go:382 updateState."""
+        n_vals = state.next_validators.copy()
+        last_height_vals_changed = state.last_height_validators_changed
+        if validator_updates:
+            try:
+                n_vals.update_with_change_set(validator_updates)
+            except ValueError as e:
+                raise BlockExecutionError(f"error changing validator set: {e}") from e
+            last_height_vals_changed = block.header.height + 1 + 1
+
+        # rotate proposer priority for the set that will sign H+2
+        n_vals.increment_proposer_priority(1)
+
+        params = state.consensus_params
+        last_height_params_changed = state.last_height_consensus_params_changed
+        if abci_responses.end_block and abci_responses.end_block.consensus_param_updates:
+            params = ConsensusParams.decode(
+                abci_responses.end_block.consensus_param_updates
+            )
+            params.validate()
+            last_height_params_changed = block.header.height + 1
+
+        return State(
+            chain_id=state.chain_id,
+            version=state.version,
+            last_block_height=block.header.height,
+            last_block_total_tx=state.last_block_total_tx + block.header.num_txs,
+            last_block_id=block_id,
+            last_block_time=block.header.time,
+            validators=state.next_validators.copy(),
+            next_validators=n_vals,
+            last_validators=state.validators.copy(),
+            last_height_validators_changed=last_height_vals_changed,
+            consensus_params=params,
+            last_height_consensus_params_changed=last_height_params_changed,
+            last_results_hash=abci_responses.results_hash(),
+            app_hash=b"",  # filled after app commit
+        )
+
+    async def _commit(self, state: State, block: Block) -> bytes:
+        """Reference execution.go:188-232 Commit: mempool locked around app
+        commit + mempool update."""
+        if self.mempool is not None:
+            await self.mempool.lock()
+        try:
+            await self.app.flush()
+            fail.fail()  # crash point: before app commit
+            res = await self.app.commit()
+            if self.mempool is not None:
+                await self.mempool.update(
+                    block.header.height,
+                    block.data.txs,
+                    pre_check=None,
+                )
+            return res.data
+        finally:
+            if self.mempool is not None:
+                self.mempool.unlock()
+
+    async def _fire_events(
+        self, block: Block, abci_responses: ABCIResponses, validator_updates
+    ) -> None:
+        """Reference execution.go:448 fireEvents."""
+        await self.event_bus.publish_new_block(
+            block, abci_responses.begin_block, abci_responses.end_block
+        )
+        await self.event_bus.publish_new_block_header(block.header)
+        for i, tx in enumerate(block.data.txs):
+            resp = abci_responses.deliver_txs[i]
+            await self.event_bus.publish_tx(
+                block.header.height, i, tx, resp, resp.events
+            )
+        if validator_updates:
+            await self.event_bus.publish_validator_set_updates(validator_updates)

@@ -1,0 +1,151 @@
+"""Transaction indexer.
+
+Reference parity: state/txindex/ — IndexerService subscribes to the
+EventBus Tx stream and indexes TxResult by hash plus event key=value pairs
+into a KV store (kv/kv.go); `null` indexer is the no-op default.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tendermint_tpu.abci.types import ResponseDeliverTx
+from tendermint_tpu.crypto import sum_sha256
+from tendermint_tpu.encoding import Reader, Writer
+from tendermint_tpu.libs.db import DB
+from tendermint_tpu.libs.pubsub import Query
+from tendermint_tpu.libs.service import BaseService
+from tendermint_tpu.types import events as ev
+from tendermint_tpu.types.event_bus import EventBus
+
+
+@dataclass
+class TxResult:
+    height: int
+    index: int
+    tx: bytes
+    result: ResponseDeliverTx
+
+    def encode(self) -> bytes:
+        return (
+            Writer().u64(self.height).u32(self.index).bytes(self.tx)
+            .bytes(self.result.encode()).build()
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "TxResult":
+        r = Reader(data)
+        out = cls(r.u64(), r.u32(), r.bytes(), ResponseDeliverTx.decode(r.bytes()))
+        r.expect_done()
+        return out
+
+
+class TxIndexer:
+    def index(self, result: TxResult) -> None:
+        raise NotImplementedError
+
+    def get(self, tx_hash: bytes) -> TxResult | None:
+        raise NotImplementedError
+
+    def search(self, query: Query) -> list[TxResult]:
+        raise NotImplementedError
+
+
+class NullTxIndexer(TxIndexer):
+    """Reference state/txindex/null."""
+
+    def index(self, result: TxResult) -> None:
+        pass
+
+    def get(self, tx_hash: bytes) -> TxResult | None:
+        return None
+
+    def search(self, query: Query) -> list[TxResult]:
+        return []
+
+
+class KVTxIndexer(TxIndexer):
+    """Reference state/txindex/kv/kv.go: primary record by tx hash,
+    secondary keys "event_key/event_value/height/index" -> hash."""
+
+    def __init__(self, db: DB) -> None:
+        self._db = db
+
+    def index(self, result: TxResult) -> None:
+        h = sum_sha256(result.tx)
+        self._db.set(b"TX:h:" + h, result.encode())
+        for key, values in result.result.events.items():
+            for v in values:
+                sec = f"TX:e:{key}/{v}/".encode() + Writer().u64(result.height).u32(result.index).build()
+                self._db.set(sec, h)  # suffix: "/" + 12 bytes (height u64 + index u32)
+        self._db.set(
+            b"TX:e:tx.height/%d/" % result.height
+            + Writer().u64(result.height).u32(result.index).build(),
+            h,
+        )
+
+    def get(self, tx_hash: bytes) -> TxResult | None:
+        raw = self._db.get(b"TX:h:" + tx_hash)
+        return TxResult.decode(raw) if raw else None
+
+    def search(self, query: Query) -> list[TxResult]:
+        """Supports equality conditions on indexed event keys plus tx.hash."""
+        hashes: set[bytes] | None = None
+        for cond in query.conditions:
+            if cond.key == ev.EVENT_TYPE_KEY:
+                continue
+            if cond.key == ev.TX_HASH_KEY and cond.op == "=":
+                h = bytes.fromhex(str(cond.value))
+                cur = {h} if self._db.has(b"TX:h:" + h) else set()
+            elif cond.op == "=":
+                prefix = f"TX:e:{cond.key}/{cond.value}/".encode()
+                cur = {v for _, v in self._db.iterate_prefix(prefix)}
+            else:
+                # range conditions: scan the key's entries
+                prefix = f"TX:e:{cond.key}/".encode()
+                cur = set()
+                for k, v in self._db.iterate_prefix(prefix):
+                    # key layout: prefix + value + "/" + 12 binary bytes
+                    val = k[len(prefix) : -13]
+                    try:
+                        if cond.matches({cond.key: [val.decode()]}):
+                            cur.add(v)
+                    except Exception:
+                        continue
+            hashes = cur if hashes is None else (hashes & cur)
+        if hashes is None:
+            return []
+        results = [self.get(h) for h in hashes]
+        out = [r for r in results if r is not None]
+        out.sort(key=lambda r: (r.height, r.index))
+        return out
+
+
+class IndexerService(BaseService):
+    """Reference state/txindex/indexer_service.go: EventBus -> indexer."""
+
+    SUBSCRIBER = "IndexerService"
+
+    def __init__(self, indexer: TxIndexer, event_bus: EventBus) -> None:
+        super().__init__("IndexerService")
+        self.indexer = indexer
+        self.event_bus = event_bus
+
+    async def on_start(self) -> None:
+        sub = self.event_bus.subscribe(self.SUBSCRIBER, ev.EVENT_QUERY_TX)
+        self.spawn(self._run(sub), "tx-indexing")
+
+    async def on_stop(self) -> None:
+        self.event_bus.unsubscribe_all(self.SUBSCRIBER)
+
+    async def _run(self, sub) -> None:
+        from tendermint_tpu.libs.pubsub import SubscriptionCancelled
+
+        try:
+            while True:
+                msg = await sub.next()
+                d = msg.data
+                self.indexer.index(
+                    TxResult(d["height"], d["index"], d["tx"], d["result"])
+                )
+        except (SubscriptionCancelled, Exception):
+            pass

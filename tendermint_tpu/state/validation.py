@@ -1,0 +1,108 @@
+"""Block validation against state — north-star hot loop #2 lives here.
+
+Reference parity: state/validation.go:16 (validateBlock: header consistency
+checks, then LastValidators.VerifyCommit at :99 — the serial signature loop
+the TPU batch path replaces) and :168 (VerifyEvidence). Evidence signatures
+are folded into the same BatchVerifier launch as the commit signatures.
+"""
+from __future__ import annotations
+
+from tendermint_tpu.crypto.batch import BatchVerifier
+from tendermint_tpu.state import State, StateStore
+from tendermint_tpu.types import Block
+from tendermint_tpu.types.evidence import Evidence
+
+
+class ValidationError(Exception):
+    pass
+
+
+def validate_block(state: State, block: Block, state_store: StateStore | None = None) -> None:
+    """Reference state/validation.go:16 validateBlock."""
+    block.validate_basic()
+    h = block.header
+    if h.version != state.version:
+        raise ValidationError(f"wrong version {h.version}")
+    if h.chain_id != state.chain_id:
+        raise ValidationError(f"wrong chain id {h.chain_id}")
+    if h.height != state.last_block_height + 1:
+        raise ValidationError(
+            f"wrong height {h.height}, expected {state.last_block_height + 1}"
+        )
+    if h.last_block_id != state.last_block_id:
+        raise ValidationError("wrong last_block_id")
+    if h.total_txs != state.last_block_total_tx + h.num_txs:
+        raise ValidationError("wrong total_txs")
+    if h.app_hash != state.app_hash:
+        raise ValidationError("wrong app_hash")
+    if h.consensus_hash != state.consensus_params.hash():
+        raise ValidationError("wrong consensus_hash")
+    if h.last_results_hash != state.last_results_hash:
+        raise ValidationError("wrong last_results_hash")
+    if h.validators_hash != state.validators.hash():
+        raise ValidationError("wrong validators_hash")
+    if h.next_validators_hash != state.next_validators.hash():
+        raise ValidationError("wrong next_validators_hash")
+
+    # LastCommit: +2/3 of the previous validator set — ONE device batch
+    if h.height == 1:
+        if block.last_commit is not None and block.last_commit.precommits:
+            raise ValidationError("block at height 1 cannot have LastCommit")
+    else:
+        if block.last_commit is None:
+            raise ValidationError("missing LastCommit")
+        if len(block.last_commit.precommits) != state.last_validators.size():
+            raise ValidationError(
+                f"wrong LastCommit size {len(block.last_commit.precommits)}"
+            )
+        try:
+            state.last_validators.verify_commit(
+                state.chain_id, state.last_block_id, h.height - 1, block.last_commit
+            )
+        except Exception as e:
+            raise ValidationError(f"invalid LastCommit: {e}") from e
+
+    if not state.validators.has_address(h.proposer_address):
+        raise ValidationError("proposer not in validator set")
+
+    # Evidence (reference state/validation.go:141): aging + batched sigs
+    max_age = state.consensus_params.evidence.max_age
+    bv = BatchVerifier()
+    for ev in block.evidence:
+        if ev.height() < h.height - max_age:
+            raise ValidationError(f"evidence too old: {ev}")
+        _queue_evidence(state, state_store, ev, bv)
+    if not all(bv.verify_all()):
+        raise ValidationError("invalid evidence signature")
+
+
+def _queue_evidence(
+    state: State, state_store: StateStore | None, ev: Evidence, bv: BatchVerifier
+) -> None:
+    """Reference state/validation.go:168 VerifyEvidence (structural part);
+    sigs queued into the shared batch."""
+    ev_height = ev.height()
+    # the validator must have been in the set at the evidence height
+    vals = None
+    if state_store is not None:
+        vals = state_store.load_validators(ev_height)
+    if vals is None:
+        vals = state.validators  # fallback for in-memory setups
+    _, val = vals.get_by_address(ev.address())
+    if val is None:
+        raise ValidationError(
+            f"address {ev.address().hex()} was not a validator at height {ev_height}"
+        )
+    ev.add_to_batch(state.chain_id, val.pub_key, bv)
+
+
+def verify_evidence(state: State, state_store: StateStore | None, ev: Evidence) -> None:
+    """Standalone evidence verification (evidence pool admission)."""
+    ev_height = ev.height()
+    max_age = state.consensus_params.evidence.max_age
+    if ev_height < state.last_block_height - max_age:
+        raise ValidationError(f"evidence from height {ev_height} is too old")
+    bv = BatchVerifier()
+    _queue_evidence(state, state_store, ev, bv)
+    if not all(bv.verify_all()):
+        raise ValidationError("invalid evidence signature")

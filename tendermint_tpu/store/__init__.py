@@ -1,0 +1,115 @@
+"""BlockStore — blocks, parts, and commits on disk.
+
+Reference parity: store/store.go — per height: BlockMeta, the block's parts,
+the block commit (LastCommit of the next block) and the SeenCommit (the +2/3
+precommits this node actually saw). Keys are prefixed, height big-endian so
+prefix iteration is ordered.
+"""
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+from tendermint_tpu.encoding import Reader, Writer
+from tendermint_tpu.libs.db import DB
+from tendermint_tpu.types import Block, BlockID, Commit, Part, PartSet
+from tendermint_tpu.types.block import Header
+
+
+@dataclass
+class BlockMeta:
+    """Reference types/block_meta.go."""
+
+    block_id: BlockID
+    header: Header
+    block_size: int
+    num_txs: int
+
+    def encode(self) -> bytes:
+        w = Writer()
+        self.block_id.encode_into(w)
+        w.bytes(self.header.encode()).u64(self.block_size).u64(self.num_txs)
+        return w.build()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "BlockMeta":
+        r = Reader(data)
+        bid = BlockID.read(r)
+        header = Header.decode(r.bytes())
+        size = r.u64()
+        ntxs = r.u64()
+        r.expect_done()
+        return cls(bid, header, size, ntxs)
+
+
+def _h(height: int) -> bytes:
+    return struct.pack(">Q", height)
+
+
+class BlockStore:
+    def __init__(self, db: DB) -> None:
+        self._db = db
+
+    def height(self) -> int:
+        raw = self._db.get(b"BS:height")
+        return struct.unpack(">Q", raw)[0] if raw else 0
+
+    def base(self) -> int:
+        raw = self._db.get(b"BS:base")
+        return struct.unpack(">Q", raw)[0] if raw else 0
+
+    def save_block(self, block: Block, parts: PartSet, seen_commit: Commit) -> None:
+        """Reference store/store.go SaveBlock."""
+        height = block.header.height
+        if height != self.height() + 1 and self.height() != 0:
+            raise ValueError(
+                f"cannot save block at height {height}, store is at {self.height()}"
+            )
+        if not parts.is_complete():
+            raise ValueError("cannot save block with incomplete part set")
+        meta = BlockMeta(
+            BlockID(block.hash(), parts.header()),
+            block.header,
+            len(block.encode()),
+            len(block.data.txs),
+        )
+        self._db.set(b"BS:meta:" + _h(height), meta.encode())
+        for i in range(parts.total):
+            part = parts.get_part(i)
+            self._db.set(b"BS:part:" + _h(height) + struct.pack(">I", i), part.encode())
+        if block.last_commit is not None:
+            self._db.set(b"BS:commit:" + _h(height - 1), block.last_commit.encode())
+        self._db.set(b"BS:seen:" + _h(height), seen_commit.encode())
+        if self.base() == 0:
+            self._db.set(b"BS:base", _h(height))
+        self._db.set_sync(b"BS:height", _h(height))
+
+    def load_block_meta(self, height: int) -> BlockMeta | None:
+        raw = self._db.get(b"BS:meta:" + _h(height))
+        return BlockMeta.decode(raw) if raw else None
+
+    def load_block(self, height: int) -> Block | None:
+        meta = self.load_block_meta(height)
+        if meta is None:
+            return None
+        data = []
+        for i in range(meta.block_id.parts.total):
+            raw = self._db.get(b"BS:part:" + _h(height) + struct.pack(">I", i))
+            if raw is None:
+                return None
+            data.append(Part.decode(raw).bytes_)
+        return Block.decode(b"".join(data))
+
+    def load_block_part(self, height: int, index: int) -> Part | None:
+        raw = self._db.get(b"BS:part:" + _h(height) + struct.pack(">I", index))
+        return Part.decode(raw) if raw else None
+
+    def load_block_commit(self, height: int) -> Commit | None:
+        """The canonical commit for block at `height` (stored in block
+        height+1's LastCommit)."""
+        raw = self._db.get(b"BS:commit:" + _h(height))
+        return Commit.decode(raw) if raw else None
+
+    def load_seen_commit(self, height: int) -> Commit | None:
+        raw = self._db.get(b"BS:seen:" + _h(height))
+        return Commit.decode(raw) if raw else None

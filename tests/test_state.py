@@ -1,0 +1,235 @@
+"""State execution pipeline tests (mirrors reference state/*_test.go)."""
+import asyncio
+
+import pytest
+
+from tendermint_tpu import proxy
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.examples import KVStoreApplication
+from tendermint_tpu.libs.db import MemDB, SQLiteDB
+from tendermint_tpu.mempool import CListMempool, TxInCacheError
+from tendermint_tpu.state import StateStore, state_from_genesis
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.state.validation import ValidationError, validate_block
+from tendermint_tpu.store import BlockStore
+from tendermint_tpu.types import (
+    BlockID,
+    GenesisDoc,
+    MockPV,
+    VoteSet,
+    VoteType,
+)
+from tendermint_tpu.types.genesis import GenesisValidator
+from tendermint_tpu.types.vote import Vote
+
+CHAIN_ID = "exec-test-chain"
+
+
+def make_genesis(n=4, power=10):
+    pvs = [MockPV() for _ in range(n)]
+    doc = GenesisDoc(
+        chain_id=CHAIN_ID,
+        genesis_time=1_700_000_000_000_000_000,
+        validators=[GenesisValidator(pv.get_pub_key(), power) for pv in pvs],
+    )
+    pvs.sort(key=lambda pv: pv.address)
+    return doc, pvs
+
+
+def sign_commit(state, pvs, block):
+    """Produce the +2/3 seen-commit for a block."""
+    block_id = block.block_id()
+    height = block.header.height
+    voteset = VoteSet(state.chain_id, height, 0, VoteType.PRECOMMIT, state.validators)
+    votes = []
+    for pv in pvs:
+        idx, val = state.validators.get_by_address(pv.address)
+        if val is None:
+            continue
+        vote = Vote(
+            VoteType.PRECOMMIT,
+            height,
+            0,
+            block_id,
+            block.header.time + 1,
+            pv.address,
+            idx,
+        )
+        votes.append(pv.sign_vote(state.chain_id, vote))
+    voteset.add_votes(votes)
+    return voteset.make_commit()
+
+
+async def make_chain(n_blocks, app=None, db=None, txs_per_block=2):
+    """Drive the full pipeline for n blocks; returns final state + stores."""
+    doc, pvs = make_genesis()
+    state = state_from_genesis(doc)
+    db = db or MemDB()
+    state_store = StateStore(db)
+    block_store = BlockStore(MemDB())
+    conns = proxy.AppConns(proxy.default_client_creator("kvstore", app))
+    await conns.start()
+    executor = BlockExecutor(state_store, conns.consensus)
+    commit = None
+    for h in range(1, n_blocks + 1):
+        txs = [f"k{h}-{i}=v{i}".encode() for i in range(txs_per_block)]
+        proposer = state.validators.get_proposer().address
+        block = executor.create_proposal_block(h, state, commit, proposer)
+        block.data.txs = txs
+        # re-make with txs (create_proposal_block reaps from mempool normally)
+        block = state.make_block(h, txs, commit, [], proposer, time_ns=block.header.time)
+        block_id = block.block_id()
+        seen_commit = sign_commit(state, pvs, block)
+        block_store.save_block(block, block.make_part_set(), seen_commit)
+        state = await executor.apply_block(state, block_id, block)
+        commit = seen_commit
+    await conns.stop()
+    return state, state_store, block_store, pvs, doc
+
+
+class TestBlockExecutor:
+    def test_apply_blocks_advances_state(self):
+        async def main():
+            app = KVStoreApplication()
+            state, state_store, block_store, _, _ = await make_chain(3, app)
+            assert state.last_block_height == 3
+            assert state.last_block_total_tx == 6
+            assert state.app_hash == app.app_hash
+            assert app.height == 3
+            # state persisted
+            loaded = state_store.load()
+            assert loaded.last_block_height == 3
+            assert loaded.app_hash == state.app_hash
+            # abci responses persisted
+            resp = state_store.load_abci_responses(2)
+            assert resp is not None and len(resp.deliver_txs) == 2
+            # historical validators stored
+            assert state_store.load_validators(3) is not None
+            # block store
+            assert block_store.height() == 3
+            blk = block_store.load_block(2)
+            assert blk is not None and blk.header.height == 2
+            assert block_store.load_seen_commit(3) is not None
+            assert block_store.load_block_commit(2) is not None  # from block 3
+
+        asyncio.run(main())
+
+    def test_validate_rejects_bad_blocks(self):
+        async def main():
+            state, state_store, block_store, pvs, _ = await make_chain(2)
+            good = block_store.load_block(2)
+            # wrong height
+            import dataclasses
+
+            state2 = state  # state is after block 2 -> expects height 3
+            bad = block_store.load_block(1)
+            with pytest.raises(ValidationError):
+                validate_block(state2, bad, state_store)
+
+        asyncio.run(main())
+
+    def test_validator_updates_take_effect_h2(self):
+        async def main():
+            from tendermint_tpu import crypto
+            from tendermint_tpu.abci.examples import PersistentKVStoreApplication
+            import tempfile
+
+            with tempfile.TemporaryDirectory() as d:
+                app = PersistentKVStoreApplication(d)
+                doc, pvs = make_genesis()
+                state = state_from_genesis(doc)
+                state_store = StateStore(MemDB())
+                conns = proxy.AppConns(proxy.LocalClientCreator(app))
+                await conns.start()
+                executor = BlockExecutor(state_store, conns.consensus)
+                new_val = MockPV()
+                pk_hex = crypto.encode_pubkey(new_val.get_pub_key()).hex()
+                commit = None
+                heights_with_5 = []
+                for h in range(1, 4):
+                    txs = [f"val:{pk_hex}!7".encode()] if h == 1 else [b"a=b"]
+                    proposer = state.validators.get_proposer().address
+                    block = state.make_block(h, txs, commit, [], proposer)
+                    seen = sign_commit(state, pvs, block)
+                    state = await executor.apply_block(state, block.block_id(), block)
+                    commit = seen
+                    if state.validators.size() == 5:
+                        heights_with_5.append(h)
+                # update in block 1 -> Validators (the set that signs the
+                # *next* height) first has 5 members in the state after
+                # block 2, i.e. at H+2 = 3
+                assert heights_with_5 == [2, 3]
+                assert state.validators.has_address(new_val.get_pub_key().address())
+                await conns.stop()
+
+        asyncio.run(main())
+
+
+class TestMempool:
+    def test_check_reap_update(self):
+        async def main():
+            conns = proxy.AppConns(proxy.default_client_creator("kvstore"))
+            await conns.start()
+            mp = CListMempool(conns.mempool)
+            for i in range(5):
+                res = await mp.check_tx(b"k%d=v" % i)
+                assert res.is_ok
+            assert mp.size() == 5
+            with pytest.raises(TxInCacheError):
+                await mp.check_tx(b"k0=v")
+            reaped = mp.reap_max_bytes_max_gas(-1, -1)
+            assert len(reaped) == 5
+            # byte-limited reap
+            limited = mp.reap_max_bytes_max_gas(len(reaped[0]) * 2, -1)
+            assert len(limited) == 2
+            # commit the first three
+            await mp.lock()
+            await mp.update(1, reaped[:3])
+            mp.unlock()
+            assert mp.size() == 2
+            assert mp.tx_available.is_set()
+            await conns.stop()
+
+        asyncio.run(main())
+
+    def test_counter_serial_recheck_drops_stale(self):
+        async def main():
+            conns = proxy.AppConns(proxy.default_client_creator("counter_serial"))
+            await conns.start()
+            mp = CListMempool(conns.mempool)
+            for i in range(4):
+                await mp.check_tx(i.to_bytes(8, "big"))
+            assert mp.size() == 4
+            # app executes txs 0..1 out-of-band -> nonces 0,1 now stale
+            app = conns.query._client.app
+            app.deliver_tx(abci.RequestDeliverTx((0).to_bytes(8, "big")))
+            app.deliver_tx(abci.RequestDeliverTx((1).to_bytes(8, "big")))
+            await mp.lock()
+            await mp.update(1, [(0).to_bytes(8, "big")])  # tx0 was committed
+            mp.unlock()
+            # tx1 dropped by recheck (nonce < count), 2,3 remain
+            assert mp.size() == 2
+            await conns.stop()
+
+        asyncio.run(main())
+
+
+class TestTxIndexer:
+    def test_index_and_search(self):
+        from tendermint_tpu.libs.pubsub import Query
+        from tendermint_tpu.state.txindex import KVTxIndexer, TxResult
+        from tendermint_tpu.crypto import sum_sha256
+
+        idx = KVTxIndexer(MemDB())
+        r1 = TxResult(1, 0, b"tx-a", abci.ResponseDeliverTx(events={"app.key": ["a"]}))
+        r2 = TxResult(2, 0, b"tx-b", abci.ResponseDeliverTx(events={"app.key": ["b"]}))
+        idx.index(r1)
+        idx.index(r2)
+        assert idx.get(sum_sha256(b"tx-a")).height == 1
+        hits = idx.search(Query.parse("app.key='b'"))
+        assert [h.tx for h in hits] == [b"tx-b"]
+        hits2 = idx.search(Query.parse("tx.height>1"))
+        assert [h.tx for h in hits2] == [b"tx-b"]
+        hx = sum_sha256(b"tx-a").hex()
+        hits3 = idx.search(Query.parse(f"tx.hash='{hx}'"))
+        assert [h.tx for h in hits3] == [b"tx-a"]
