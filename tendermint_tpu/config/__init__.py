@@ -1,0 +1,227 @@
+"""Configuration.
+
+Reference parity: config/config.go:59 — Config of 9 sections (Base, RPC,
+P2P, Mempool, FastSync, Consensus, TxIndex, Instrumentation); all consensus
+timeouts including the per-round linear growth (config.go:796-811);
+TOML-template persistence is replaced by JSON (config.json) with identical
+precedence: flags > env > file > defaults.
+"""
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import asdict, dataclass, field, replace
+
+
+@dataclass
+class BaseConfig:
+    chain_id: str = ""
+    moniker: str = "node"
+    fast_sync: bool = True
+    db_backend: str = "sqlite"
+    log_level: str = "info"
+    proxy_app: str = "kvstore"
+    abci: str = "local"  # local | socket
+    priv_validator_key_file: str = "config/priv_validator_key.json"
+    priv_validator_state_file: str = "data/priv_validator_state.json"
+    priv_validator_laddr: str = ""
+    node_key_file: str = "config/node_key.json"
+    genesis_file: str = "config/genesis.json"
+    filter_peers: bool = False
+    prof_laddr: str = ""
+
+
+@dataclass
+class RPCConfig:
+    laddr: str = "tcp://127.0.0.1:26657"
+    grpc_laddr: str = ""
+    unsafe: bool = False
+    max_open_connections: int = 900
+    max_subscription_clients: int = 100
+    max_subscriptions_per_client: int = 5
+    timeout_broadcast_tx_commit: float = 10.0
+
+
+@dataclass
+class P2PConfig:
+    laddr: str = "tcp://0.0.0.0:26656"
+    external_address: str = ""
+    seeds: str = ""
+    persistent_peers: str = ""
+    addr_book_file: str = "config/addrbook.json"
+    addr_book_strict: bool = True
+    max_num_inbound_peers: int = 40
+    max_num_outbound_peers: int = 10
+    flush_throttle_timeout: float = 0.1
+    max_packet_msg_payload_size: int = 1024
+    send_rate: int = 5120000
+    recv_rate: int = 5120000
+    pex: bool = True
+    seed_mode: bool = False
+    private_peer_ids: str = ""
+    allow_duplicate_ip: bool = False
+    handshake_timeout: float = 20.0
+    dial_timeout: float = 3.0
+    test_fuzz: bool = False
+
+
+@dataclass
+class MempoolConfig:
+    recheck: bool = True
+    broadcast: bool = True
+    wal_dir: str = ""
+    size: int = 5000
+    max_txs_bytes: int = 1073741824
+    cache_size: int = 10000
+    max_tx_bytes: int = 1048576
+
+
+@dataclass
+class FastSyncConfig:
+    version: str = "v0"
+
+
+@dataclass
+class ConsensusConfig:
+    wal_path: str = "data/cs.wal/wal"
+    # timeouts in seconds (reference config.go:730-824, ms there)
+    timeout_propose: float = 3.0
+    timeout_propose_delta: float = 0.5
+    timeout_prevote: float = 1.0
+    timeout_prevote_delta: float = 0.5
+    timeout_precommit: float = 1.0
+    timeout_precommit_delta: float = 0.5
+    timeout_commit: float = 1.0
+    skip_timeout_commit: bool = False
+    create_empty_blocks: bool = True
+    create_empty_blocks_interval: float = 0.0
+    peer_gossip_sleep_duration: float = 0.1
+    peer_query_maj23_sleep_duration: float = 2.0
+
+    def propose_timeout(self, round_: int) -> float:
+        return self.timeout_propose + self.timeout_propose_delta * round_
+
+    def prevote_timeout(self, round_: int) -> float:
+        return self.timeout_prevote + self.timeout_prevote_delta * round_
+
+    def precommit_timeout(self, round_: int) -> float:
+        return self.timeout_precommit + self.timeout_precommit_delta * round_
+
+    def commit_time(self) -> float:
+        return self.timeout_commit
+
+
+@dataclass
+class TxIndexConfig:
+    indexer: str = "kv"  # kv | null
+
+
+@dataclass
+class InstrumentationConfig:
+    prometheus: bool = False
+    prometheus_listen_addr: str = ":26660"
+    namespace: str = "tendermint"
+
+
+@dataclass
+class Config:
+    root_dir: str = "."
+    base: BaseConfig = field(default_factory=BaseConfig)
+    rpc: RPCConfig = field(default_factory=RPCConfig)
+    p2p: P2PConfig = field(default_factory=P2PConfig)
+    mempool: MempoolConfig = field(default_factory=MempoolConfig)
+    fast_sync: FastSyncConfig = field(default_factory=FastSyncConfig)
+    consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
+    instrumentation: InstrumentationConfig = field(default_factory=InstrumentationConfig)
+
+    # -- path helpers -------------------------------------------------------
+
+    def _abs(self, p: str) -> str:
+        return p if os.path.isabs(p) else os.path.join(self.root_dir, p)
+
+    @property
+    def genesis_path(self) -> str:
+        return self._abs(self.base.genesis_file)
+
+    @property
+    def priv_validator_key_path(self) -> str:
+        return self._abs(self.base.priv_validator_key_file)
+
+    @property
+    def priv_validator_state_path(self) -> str:
+        return self._abs(self.base.priv_validator_state_file)
+
+    @property
+    def node_key_path(self) -> str:
+        return self._abs(self.base.node_key_file)
+
+    @property
+    def db_dir(self) -> str:
+        return self._abs("data")
+
+    @property
+    def wal_path(self) -> str:
+        return self._abs(self.consensus.wal_path)
+
+    def validate_basic(self) -> None:
+        for name, section in (
+            ("consensus", self.consensus),
+            ("p2p", self.p2p),
+            ("mempool", self.mempool),
+        ):
+            for k, v in asdict(section).items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool) and v < -1:
+                    raise ValueError(f"config {name}.{k} must be >= -1, got {v}")
+
+    # -- persistence --------------------------------------------------------
+
+    def save(self, path: str | None = None) -> None:
+        path = path or os.path.join(self.root_dir, "config", "config.json")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        d = asdict(self)
+        d.pop("root_dir")
+        with open(path, "w") as f:
+            json.dump(d, f, indent=1, sort_keys=True)
+
+    @classmethod
+    def load(cls, root_dir: str) -> "Config":
+        path = os.path.join(root_dir, "config", "config.json")
+        cfg = cls(root_dir=root_dir)
+        if os.path.exists(path):
+            with open(path) as f:
+                d = json.load(f)
+            cfg = cls(
+                root_dir=root_dir,
+                base=BaseConfig(**d.get("base", {})),
+                rpc=RPCConfig(**d.get("rpc", {})),
+                p2p=P2PConfig(**d.get("p2p", {})),
+                mempool=MempoolConfig(**d.get("mempool", {})),
+                fast_sync=FastSyncConfig(**d.get("fast_sync", {})),
+                consensus=ConsensusConfig(**d.get("consensus", {})),
+                tx_index=TxIndexConfig(**d.get("tx_index", {})),
+                instrumentation=InstrumentationConfig(**d.get("instrumentation", {})),
+            )
+        return cfg
+
+
+def make_test_config(root_dir: str) -> Config:
+    """Fast timeouts for in-process tests (reference config.ResetTestRoot)."""
+    cfg = Config(root_dir=root_dir)
+    cfg.base.db_backend = "mem"
+    cfg.consensus = ConsensusConfig(
+        wal_path="data/cs.wal/wal",
+        timeout_propose=0.4,
+        timeout_propose_delta=0.1,
+        timeout_prevote=0.2,
+        timeout_prevote_delta=0.1,
+        timeout_precommit=0.2,
+        timeout_precommit_delta=0.1,
+        timeout_commit=0.1,
+        skip_timeout_commit=True,
+        peer_gossip_sleep_duration=0.01,
+        peer_query_maj23_sleep_duration=0.25,
+    )
+    os.makedirs(os.path.join(root_dir, "data"), exist_ok=True)
+    os.makedirs(os.path.join(root_dir, "config"), exist_ok=True)
+    return cfg

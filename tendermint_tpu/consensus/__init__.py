@@ -1,0 +1,3 @@
+"""Consensus — the Tendermint BFT state machine and its support systems
+(reference consensus/): ConsensusState, WAL, replay/handshake, timeout
+ticker, reactor."""

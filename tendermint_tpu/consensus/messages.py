@@ -1,0 +1,156 @@
+"""Consensus wire messages (shared by the reactor, the WAL, and replay).
+
+Reference parity: consensus/reactor.go message types (NewRoundStep,
+NewValidBlock, Proposal, ProposalPOL, BlockPart, Vote, HasVote,
+VoteSetMaj23, VoteSetBits) and consensus/wal.go msgInfo/timeoutInfo
+framing. Tagged-union CBE encoding.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tendermint_tpu.consensus.round_state import RoundStep
+from tendermint_tpu.encoding import DecodeError, Reader, Writer
+from tendermint_tpu.libs.bit_array import BitArray
+from tendermint_tpu.types import BlockID, Part, PartSetHeader, Proposal, Vote, VoteType
+
+
+@dataclass
+class NewRoundStepMessage:
+    height: int
+    round: int
+    step: RoundStep
+    seconds_since_start_time: int
+    last_commit_round: int
+
+
+@dataclass
+class NewValidBlockMessage:
+    height: int
+    round: int
+    block_parts_header: PartSetHeader
+    block_parts: BitArray
+    is_commit: bool
+
+
+@dataclass
+class ProposalMessage:
+    proposal: Proposal
+
+
+@dataclass
+class ProposalPOLMessage:
+    height: int
+    proposal_pol_round: int
+    proposal_pol: BitArray
+
+
+@dataclass
+class BlockPartMessage:
+    height: int
+    round: int
+    part: Part
+
+
+@dataclass
+class VoteMessage:
+    vote: Vote
+
+
+@dataclass
+class HasVoteMessage:
+    height: int
+    round: int
+    type: VoteType
+    index: int
+
+
+@dataclass
+class VoteSetMaj23Message:
+    height: int
+    round: int
+    type: VoteType
+    block_id: BlockID
+
+
+@dataclass
+class VoteSetBitsMessage:
+    height: int
+    round: int
+    type: VoteType
+    block_id: BlockID
+    votes: BitArray
+
+
+_TAGS: list[tuple[int, type]] = [
+    (1, NewRoundStepMessage),
+    (2, NewValidBlockMessage),
+    (3, ProposalMessage),
+    (4, ProposalPOLMessage),
+    (5, BlockPartMessage),
+    (6, VoteMessage),
+    (7, HasVoteMessage),
+    (8, VoteSetMaj23Message),
+    (9, VoteSetBitsMessage),
+]
+
+
+def encode_consensus_message(msg) -> bytes:
+    w = Writer()
+    if isinstance(msg, NewRoundStepMessage):
+        w.u8(1).u64(msg.height).u32(msg.round).u8(int(msg.step))
+        w.u64(msg.seconds_since_start_time).i64(msg.last_commit_round)
+    elif isinstance(msg, NewValidBlockMessage):
+        w.u8(2).u64(msg.height).u32(msg.round)
+        msg.block_parts_header.encode_into(w)
+        w.raw(msg.block_parts.encode())
+        w.bool(msg.is_commit)
+    elif isinstance(msg, ProposalMessage):
+        w.u8(3).bytes(msg.proposal.encode())
+    elif isinstance(msg, ProposalPOLMessage):
+        w.u8(4).u64(msg.height).i64(msg.proposal_pol_round)
+        w.raw(msg.proposal_pol.encode())
+    elif isinstance(msg, BlockPartMessage):
+        w.u8(5).u64(msg.height).u32(msg.round).bytes(msg.part.encode())
+    elif isinstance(msg, VoteMessage):
+        w.u8(6).bytes(msg.vote.encode())
+    elif isinstance(msg, HasVoteMessage):
+        w.u8(7).u64(msg.height).u32(msg.round).u8(int(msg.type)).u32(msg.index)
+    elif isinstance(msg, VoteSetMaj23Message):
+        w.u8(8).u64(msg.height).u32(msg.round).u8(int(msg.type))
+        msg.block_id.encode_into(w)
+    elif isinstance(msg, VoteSetBitsMessage):
+        w.u8(9).u64(msg.height).u32(msg.round).u8(int(msg.type))
+        msg.block_id.encode_into(w)
+        w.raw(msg.votes.encode())
+    else:
+        raise TypeError(f"unknown consensus message {msg!r}")
+    return w.build()
+
+
+def decode_consensus_message(data: bytes):
+    r = Reader(data)
+    tag = r.u8()
+    if tag == 1:
+        return NewRoundStepMessage(r.u64(), r.u32(), RoundStep(r.u8()), r.u64(), r.i64())
+    if tag == 2:
+        return NewValidBlockMessage(
+            r.u64(), r.u32(), PartSetHeader.read(r), BitArray.read(r), r.bool()
+        )
+    if tag == 3:
+        return ProposalMessage(Proposal.decode(r.bytes()))
+    if tag == 4:
+        return ProposalPOLMessage(r.u64(), r.i64(), BitArray.read(r))
+    if tag == 5:
+        return BlockPartMessage(r.u64(), r.u32(), Part.decode(r.bytes()))
+    if tag == 6:
+        return VoteMessage(Vote.decode(r.bytes()))
+    if tag == 7:
+        return HasVoteMessage(r.u64(), r.u32(), VoteType(r.u8()), r.u32())
+    if tag == 8:
+        return VoteSetMaj23Message(r.u64(), r.u32(), VoteType(r.u8()), BlockID.read(r))
+    if tag == 9:
+        return VoteSetBitsMessage(
+            r.u64(), r.u32(), VoteType(r.u8()), BlockID.read(r), BitArray.read(r)
+        )
+    raise DecodeError(f"unknown consensus message tag {tag}")

@@ -1,0 +1,193 @@
+"""Crash recovery: WAL catchup replay + ABCI handshake replay.
+
+Reference parity: consensus/replay.go —
+(1) catchupReplay (:100): after boot, messages logged since the last height
+    barrier are re-fed through the state machine (called from
+    ConsensusState.on_start); signing is disabled during replay because every
+    own vote/proposal was WriteSync'd to the WAL before use.
+(2) Handshaker (:241): ABCI Info -> compare app height vs block-store height
+    vs state height -> ReplayBlocks (:285) brings the application back in
+    sync with the chain, including InitChain for fresh apps and full
+    ApplyBlock for the final block when state lags the store by one (the
+    crash-between-SaveBlock-and-SaveState case).
+"""
+from __future__ import annotations
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu import crypto
+from tendermint_tpu.consensus.wal import (
+    EndHeightMessage,
+    EventDataRoundState,
+    MsgInfo,
+    WALTimeoutInfo,
+)
+from tendermint_tpu.libs.log import NOP, Logger
+from tendermint_tpu.state import State, StateStore, state_from_genesis
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.types import BlockID, GenesisDoc, ValidatorSet
+from tendermint_tpu.types.validator import Validator
+
+
+def catchup_replay(cs, cs_height: int) -> None:
+    """Reference :100. Feeds WAL messages synchronously into the state
+    machine's queues for the receive routine to process on start — with
+    replay-time signing disabled via the logged votes themselves."""
+    # if the WAL already contains the end of cs_height, our state is stale —
+    # replaying would double-sign (reference :61 panics here too)
+    if cs_height >= 1 and cs.wal.search_for_end_height(cs_height) is not None:
+        raise RuntimeError(
+            f"WAL contains end of height {cs_height}; state appears stale"
+        )
+    msgs = cs.wal.search_for_end_height(cs_height - 1)
+    if msgs is None:
+        if cs_height > 1:
+            cs.log.info("no WAL data for height", height=cs_height)
+        return
+    count = 0
+    for tm in msgs:
+        msg = tm.msg
+        if isinstance(msg, EndHeightMessage):
+            continue
+        if isinstance(msg, EventDataRoundState):
+            continue
+        if isinstance(msg, WALTimeoutInfo):
+            continue  # timeouts re-fire naturally
+        if isinstance(msg, MsgInfo):
+            cs.peer_msg_queue.put_nowait(MsgInfo(msg.msg, "replay"))
+            count += 1
+    if count:
+        cs.log.info("replaying WAL messages", count=count, height=cs_height)
+
+
+class HandshakeError(Exception):
+    pass
+
+
+class Handshaker:
+    """Reference :200-453."""
+
+    def __init__(
+        self,
+        state_store: StateStore,
+        state: State,
+        block_store,
+        genesis: GenesisDoc,
+        event_bus=None,
+        logger: Logger = NOP,
+    ) -> None:
+        self.state_store = state_store
+        self.initial_state = state
+        self.block_store = block_store
+        self.genesis = genesis
+        self.event_bus = event_bus
+        self.log = logger
+        self.n_blocks = 0
+
+    async def handshake(self, app_conns) -> State:
+        """Sync the app with the chain; returns the (possibly new) state."""
+        info = await app_conns.query.info(abci.RequestInfo(version="tendermint-tpu"))
+        app_height = max(0, info.last_block_height)
+        app_hash = info.last_block_app_hash
+        self.log.info(
+            "ABCI handshake", app_height=app_height, app_hash=app_hash.hex()[:12]
+        )
+        state = await self.replay_blocks(self.initial_state, app_conns, app_height, app_hash)
+        self.log.info("handshake complete", height=state.last_block_height)
+        return state
+
+    async def replay_blocks(
+        self, state: State, app_conns, app_height: int, app_hash: bytes
+    ) -> State:
+        """Reference :285 ReplayBlocks."""
+        store_height = self.block_store.height()
+        state_height = state.last_block_height
+
+        # InitChain for a fresh app
+        if app_height == 0:
+            validators = [
+                abci.ValidatorUpdate(crypto.encode_pubkey(v.pub_key), v.power)
+                for v in self.genesis.validators
+            ]
+            req = abci.RequestInitChain(
+                time=self.genesis.genesis_time,
+                chain_id=self.genesis.chain_id,
+                consensus_params=self.genesis.consensus_params.encode(),
+                validators=validators,
+                app_state_bytes=self.genesis.app_state,
+            )
+            res = await app_conns.consensus.init_chain(req)
+            if state_height == 0:
+                # adopt app-provided genesis validators/params
+                if res.validators:
+                    vals = [
+                        Validator(crypto.decode_pubkey(vu.pub_key), vu.power)
+                        for vu in res.validators
+                    ]
+                    state.validators = ValidatorSet(vals)
+                    state.next_validators = state.validators.copy_increment_proposer_priority(1)
+                self.state_store.save(state)
+
+        if store_height == 0:
+            return state
+
+        if app_height > store_height:
+            raise HandshakeError(
+                f"app block height {app_height} ahead of store {store_height}"
+            )
+        if state_height > store_height:
+            raise HandshakeError(
+                f"state height {state_height} ahead of store {store_height}"
+            )
+
+        # replay blocks the app is missing
+        if store_height > state_height + 1:
+            raise HandshakeError(
+                f"store height {store_height} > state height {state_height} + 1"
+            )
+
+        exec_ = BlockExecutor(self.state_store, app_conns.consensus, event_bus=self.event_bus)
+
+        # blocks <= state_height: exec against the app only (state has them)
+        for h in range(app_height + 1, min(store_height, state_height) + 1):
+            self.log.info("replaying block to app", height=h)
+            block = self.block_store.load_block(h)
+            await exec_._exec_block_on_proxy_app(state, block)
+            await app_conns.consensus.commit()
+            self.n_blocks += 1
+
+        if store_height == state_height + 1:
+            # crash between SaveBlock and SaveState: full ApplyBlock
+            block = self.block_store.load_block(store_height)
+            self.log.info("applying final block", height=store_height)
+            if app_height == store_height:
+                # app already has it: replay state update only, using the
+                # stored ABCI responses (reference mock app path :499-534)
+                responses = self.state_store.load_abci_responses(store_height)
+                if responses is None:
+                    raise HandshakeError(
+                        f"no ABCI responses stored for height {store_height}"
+                    )
+                validator_updates = exec_._validate_validator_updates(
+                    responses.end_block.validator_updates if responses.end_block else [],
+                    state.consensus_params,
+                )
+                block_id = BlockID(block.hash(), block.make_part_set().header())
+                state = exec_._update_state(
+                    state, block_id, block, responses, validator_updates
+                )
+                state.app_hash = app_hash
+                self.state_store.save(state)
+            else:
+                block_id = BlockID(block.hash(), block.make_part_set().header())
+                state = await exec_.apply_block(state, block_id, block)
+            self.n_blocks += 1
+
+        # verify app hash consistency
+        if state.app_hash and app_hash and state.last_block_height == app_height:
+            info2 = await app_conns.query.info(abci.RequestInfo())
+            if info2.last_block_app_hash != state.app_hash:
+                raise HandshakeError(
+                    f"app hash mismatch after replay: app "
+                    f"{info2.last_block_app_hash.hex()} != state {state.app_hash.hex()}"
+                )
+        return state

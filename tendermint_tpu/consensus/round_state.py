@@ -1,0 +1,157 @@
+"""Round state + height vote bookkeeping.
+
+Reference parity: consensus/types/round_state.go:16,67 (8-step enum +
+RoundState snapshot), consensus/types/height_vote_set.go:36,111
+(prevotes+precommits per round with peer-triggered round bounding),
+consensus/types/peer_round_state.go.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+from tendermint_tpu.libs.bit_array import BitArray
+from tendermint_tpu.types import (
+    Block,
+    BlockID,
+    Commit,
+    PartSet,
+    PartSetHeader,
+    Proposal,
+    ValidatorSet,
+    Vote,
+    VoteSet,
+    VoteType,
+)
+from tendermint_tpu.types.vote_set import ConflictingVoteError
+
+
+class RoundStep(enum.IntEnum):
+    """Reference round_state.go:16."""
+
+    NEW_HEIGHT = 1
+    NEW_ROUND = 2
+    PROPOSE = 3
+    PREVOTE = 4
+    PREVOTE_WAIT = 5
+    PRECOMMIT = 6
+    PRECOMMIT_WAIT = 7
+    COMMIT = 8
+
+
+class HeightVoteSet:
+    """Reference height_vote_set.go:36 — one prevote + one precommit VoteSet
+    per round; rounds created on demand; peer-suggested rounds bounded so a
+    Byzantine peer can't make us allocate unboundedly."""
+
+    MAX_PEER_CATCHUP_ROUNDS = 2
+
+    def __init__(self, chain_id: str, height: int, val_set: ValidatorSet) -> None:
+        self.chain_id = chain_id
+        self.height = height
+        self.val_set = val_set
+        self.round = 0
+        self._sets: dict[int, dict[VoteType, VoteSet]] = {}
+        self._peer_catchup_rounds: dict[str, list[int]] = {}
+        self._add_round(0)
+
+    def _add_round(self, round_: int) -> None:
+        if round_ not in self._sets:
+            self._sets[round_] = {
+                VoteType.PREVOTE: VoteSet(
+                    self.chain_id, self.height, round_, VoteType.PREVOTE, self.val_set
+                ),
+                VoteType.PRECOMMIT: VoteSet(
+                    self.chain_id, self.height, round_, VoteType.PRECOMMIT, self.val_set
+                ),
+            }
+
+    def set_round(self, round_: int) -> None:
+        """Create vote sets up to round+1 (reference SetRound)."""
+        for r in range(self.round, round_ + 2):
+            self._add_round(r)
+        self.round = round_
+
+    def add_vote(self, vote: Vote, peer_id: str = "") -> bool:
+        """Reference height_vote_set.go:111 AddVote."""
+        if vote.round not in self._sets:
+            rounds = self._peer_catchup_rounds.setdefault(peer_id, [])
+            if len(rounds) >= self.MAX_PEER_CATCHUP_ROUNDS:
+                raise ValueError("peer has sent votes for too many catchup rounds")
+            self._add_round(vote.round)
+            rounds.append(vote.round)
+        return self._sets[vote.round][vote.type].add_vote(vote)
+
+    def prevotes(self, round_: int) -> VoteSet | None:
+        return self._sets.get(round_, {}).get(VoteType.PREVOTE)
+
+    def precommits(self, round_: int) -> VoteSet | None:
+        return self._sets.get(round_, {}).get(VoteType.PRECOMMIT)
+
+    def pol_info(self) -> tuple[int, BlockID]:
+        """Highest round with a prevote 2/3 majority (reference POLInfo)."""
+        for r in sorted(self._sets, reverse=True):
+            vs = self.prevotes(r)
+            if vs is not None:
+                bid, ok = vs.two_thirds_majority()
+                if ok:
+                    return r, bid
+        return -1, BlockID()
+
+    def set_peer_maj23(self, round_: int, type_: VoteType, peer_id: str, block_id: BlockID) -> None:
+        self._add_round(round_)
+        self._sets[round_][type_].set_peer_maj23(peer_id, block_id)
+
+
+@dataclass
+class RoundState:
+    """Reference round_state.go:67 — the consensus state snapshot."""
+
+    height: int = 0
+    round: int = 0
+    step: RoundStep = RoundStep.NEW_HEIGHT
+    start_time: float = 0.0
+    commit_time: float = 0.0
+    validators: ValidatorSet | None = None
+    proposal: Proposal | None = None
+    proposal_block: Block | None = None
+    proposal_block_parts: PartSet | None = None
+    locked_round: int = -1
+    locked_block: Block | None = None
+    locked_block_parts: PartSet | None = None
+    valid_round: int = -1
+    valid_block: Block | None = None
+    valid_block_parts: PartSet | None = None
+    votes: HeightVoteSet | None = None
+    commit_round: int = -1
+    last_commit: VoteSet | None = None
+    last_validators: ValidatorSet | None = None
+    triggered_timeout_precommit: bool = False
+
+    def event_data(self) -> dict:
+        return {
+            "height": self.height,
+            "round": self.round,
+            "step": self.step.name,
+        }
+
+
+@dataclass
+class PeerRoundState:
+    """Reference peer_round_state.go — our view of one peer's progress."""
+
+    height: int = 0
+    round: int = -1
+    step: RoundStep = RoundStep.NEW_HEIGHT
+    start_time: float = 0.0
+    proposal: bool = False
+    proposal_block_parts_header: PartSetHeader = PartSetHeader()
+    proposal_block_parts: BitArray | None = None
+    proposal_pol_round: int = -1
+    proposal_pol: BitArray | None = None
+    prevotes: BitArray | None = None
+    precommits: BitArray | None = None
+    last_commit_round: int = -1
+    last_commit: BitArray | None = None
+    catchup_commit_round: int = -1
+    catchup_commit: BitArray | None = None

@@ -1,0 +1,800 @@
+"""ConsensusState — the Tendermint BFT state machine.
+
+Reference parity: consensus/state.go — single receive routine serializing
+all input (:587), step functions enterNewRound/enterPropose/enterPrevote/
+enterPrevoteWait/enterPrecommit/enterPrecommitWait/enterCommit/
+finalizeCommit (:774-1354), POL lock/unlock rules (:1060-1156,1596-1630),
+WAL write-ahead of every message (:630,635), monotonic vote time
+(:1681-1739), panic-on-invariant = halt (:600-613), fail.fail() crash
+points across the commit pipeline (:1287-1344).
+
+asyncio mapping: goroutine -> task, channel -> Queue; the single
+receive_routine task preserves the reference's total ordering of state
+transitions.
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass
+
+from tendermint_tpu.config import ConsensusConfig
+from tendermint_tpu.consensus import messages as m
+from tendermint_tpu.consensus.round_state import HeightVoteSet, RoundState, RoundStep
+from tendermint_tpu.consensus.ticker import TimeoutInfo, TimeoutTicker
+from tendermint_tpu.consensus.wal import (
+    WAL,
+    EndHeightMessage,
+    EventDataRoundState,
+    MsgInfo,
+    NilWAL,
+    WALTimeoutInfo,
+)
+from tendermint_tpu.libs import fail
+from tendermint_tpu.libs.events import EventSwitch
+from tendermint_tpu.libs.log import NOP, Logger
+from tendermint_tpu.libs.service import BaseService
+from tendermint_tpu.state import State
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.types import (
+    Block,
+    BlockID,
+    PartSet,
+    Proposal,
+    Vote,
+    VoteSet,
+    VoteType,
+)
+from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+from tendermint_tpu.types.priv_validator import PrivValidator
+from tendermint_tpu.types.vote import now_ns
+from tendermint_tpu.types.vote_set import ConflictingVoteError
+
+
+class ConsensusHalt(Exception):
+    """Invariant broken — halt rather than diverge (reference :600-613)."""
+
+
+@dataclass
+class _Internal:
+    """Sentinel wrapper distinguishing our own messages in the WAL."""
+
+    mi: MsgInfo
+
+
+class ConsensusState(BaseService):
+    def __init__(
+        self,
+        config: ConsensusConfig,
+        state: State,
+        block_exec: BlockExecutor,
+        block_store,
+        mempool=None,
+        evidence_pool=None,
+        priv_validator: PrivValidator | None = None,
+        wal: WAL | None = None,
+        event_bus=None,
+        logger: Logger = NOP,
+    ) -> None:
+        super().__init__("ConsensusState")
+        self.config = config
+        self.block_exec = block_exec
+        self.block_store = block_store
+        self.mempool = mempool
+        self.evidence_pool = evidence_pool
+        self.priv_validator = priv_validator
+        self.wal = wal or NilWAL()
+        self.event_bus = event_bus
+        self.log = logger
+
+        self.rs = RoundState()
+        self.state: State | None = None
+
+        self.peer_msg_queue: asyncio.Queue[MsgInfo] = asyncio.Queue(maxsize=1000)
+        self.internal_msg_queue: asyncio.Queue[MsgInfo] = asyncio.Queue(maxsize=1000)
+        self.ticker = TimeoutTicker()
+        # synchronous switch for reactor wakeups (reference libs/events usage)
+        self.event_switch = EventSwitch()
+        self._last_vote_time = 0
+
+        self.done_first_block = asyncio.Event()
+        self.update_to_state(state)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+
+    async def on_start(self) -> None:
+        await self.ticker.start()
+        self._catchup_replay()
+        self.spawn(self.receive_routine(), "cs-receive")
+        self.schedule_round_0()
+
+    async def on_stop(self) -> None:
+        await self.ticker.stop()
+        self.wal.flush()
+
+    def _catchup_replay(self) -> None:
+        """Reference consensus/replay.go:100 catchupReplay: re-feed WAL
+        messages recorded after the last height barrier."""
+        from tendermint_tpu.consensus import replay
+
+        replay.catchup_replay(self, self.rs.height)
+
+    # ------------------------------------------------------------------
+    # state/round bookkeeping
+
+    def update_to_state(self, state: State) -> None:
+        """Reference :1342 updateToState — prepare RoundState for the next
+        height after a commit (or at boot)."""
+        if self.rs.commit_round > -1 and 0 < self.rs.height != state.last_block_height:
+            raise ConsensusHalt(
+                f"updateToState expected state height {self.rs.height}, got "
+                f"{state.last_block_height}"
+            )
+        last_commit = None
+        if state.last_block_height > 0:
+            if self.rs.commit_round > -1 and self.rs.votes is not None:
+                precommits = self.rs.votes.precommits(self.rs.commit_round)
+                if precommits is None or not precommits.has_two_thirds_majority():
+                    raise ConsensusHalt("updateToState without +2/3 precommits")
+                last_commit = precommits
+            elif self.rs.last_commit is not None and self.rs.height == state.last_block_height + 1:
+                last_commit = self.rs.last_commit
+            else:
+                # boot: rebuild from the seen commit in the store
+                seen = self.block_store.load_seen_commit(state.last_block_height)
+                if seen is not None:
+                    vs = VoteSet(
+                        state.chain_id,
+                        state.last_block_height,
+                        seen.round(),
+                        VoteType.PRECOMMIT,
+                        state.last_validators,
+                    )
+                    vs.add_votes([p for p in seen.precommits if p is not None])
+                    last_commit = vs
+
+        height = state.last_block_height + 1
+        self.rs = RoundState(
+            height=height,
+            round=0,
+            step=RoundStep.NEW_HEIGHT,
+            start_time=self._commit_start_time(),
+            validators=state.validators,
+            votes=HeightVoteSet(state.chain_id, height, state.validators),
+            last_commit=last_commit,
+            last_validators=state.last_validators,
+            commit_round=-1,
+        )
+        self.state = state
+
+    def _commit_start_time(self) -> float:
+        return time.monotonic() + self.config.commit_time()
+
+    def schedule_round_0(self) -> None:
+        sleep = max(0.0, self.rs.start_time - time.monotonic())
+        self.ticker.schedule_timeout(
+            TimeoutInfo(sleep, self.rs.height, 0, RoundStep.NEW_HEIGHT)
+        )
+
+    def is_proposer(self) -> bool:
+        if self.priv_validator is None:
+            return False
+        return self.rs.validators.get_proposer().address == self.priv_validator.address
+
+    def round_state_event(self) -> EventDataRoundState:
+        return EventDataRoundState(self.rs.height, self.rs.round, self.rs.step.name)
+
+    # ------------------------------------------------------------------
+    # input
+
+    async def send_internal(self, msg, peer_id: str = "") -> None:
+        await self.internal_msg_queue.put(MsgInfo(msg, peer_id))
+
+    async def send_peer_msg(self, msg, peer_id: str) -> None:
+        await self.peer_msg_queue.put(MsgInfo(msg, peer_id))
+
+    async def receive_routine(self) -> None:
+        """Reference :587 — the single-threaded heart."""
+        while True:
+            peer_get = asyncio.ensure_future(self.peer_msg_queue.get())
+            internal_get = asyncio.ensure_future(self.internal_msg_queue.get())
+            tock_get = asyncio.ensure_future(self.ticker.tock.get())
+            done, pending = await asyncio.wait(
+                {peer_get, internal_get, tock_get},
+                return_when=asyncio.FIRST_COMPLETED,
+            )
+            for p in pending:
+                p.cancel()
+            try:
+                if internal_get in done:
+                    mi = internal_get.result()
+                    self.wal.write_sync(mi)  # our own msgs: fsync (:635)
+                    await self.handle_msg(mi)
+                if peer_get in done:
+                    mi = peer_get.result()
+                    self.wal.write(mi)  # peer msgs: async write (:630)
+                    await self.handle_msg(mi)
+                if tock_get in done:
+                    ti = tock_get.result()
+                    self.wal.write(
+                        WALTimeoutInfo(ti.duration, ti.height, ti.round, int(ti.step))
+                    )
+                    await self.handle_timeout(ti)
+            except ConsensusHalt:
+                self.log.error("CONSENSUS FAILURE: halting node")
+                raise
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                self.log.error("consensus error", err=repr(e))
+                import traceback
+
+                self.log.debug("traceback", tb=traceback.format_exc())
+
+    async def handle_msg(self, mi: MsgInfo) -> None:
+        msg, peer_id = mi.msg, mi.peer_id
+        if isinstance(msg, m.ProposalMessage):
+            await self.set_proposal(msg.proposal)
+        elif isinstance(msg, m.BlockPartMessage):
+            added = await self.add_proposal_block_part(msg, peer_id)
+            if added:
+                self.event_switch.fire_event("block_part", (msg, peer_id))
+        elif isinstance(msg, m.VoteMessage):
+            await self.try_add_vote(msg.vote, peer_id)
+        else:
+            self.log.error("unknown consensus message", msg=type(msg).__name__)
+
+    async def handle_timeout(self, ti: TimeoutInfo) -> None:
+        """Reference :692 handleTimeout."""
+        rs = self.rs
+        if ti.height != rs.height or ti.round < rs.round or (
+            ti.round == rs.round and int(ti.step) < int(rs.step)
+        ):
+            return
+        if ti.step == RoundStep.NEW_HEIGHT:
+            await self.enter_new_round(ti.height, 0)
+        elif ti.step == RoundStep.NEW_ROUND:
+            await self.enter_propose(ti.height, 0)
+        elif ti.step == RoundStep.PROPOSE:
+            if self.event_bus:
+                await self.event_bus.publish_timeout_propose(self.round_state_event())
+            await self.enter_prevote(ti.height, ti.round)
+        elif ti.step == RoundStep.PREVOTE_WAIT:
+            if self.event_bus:
+                await self.event_bus.publish_timeout_wait(self.round_state_event())
+            await self.enter_precommit(ti.height, ti.round)
+        elif ti.step == RoundStep.PRECOMMIT_WAIT:
+            if self.event_bus:
+                await self.event_bus.publish_timeout_wait(self.round_state_event())
+            await self.enter_precommit(ti.height, ti.round)
+            await self.enter_new_round(ti.height, ti.round + 1)
+
+    # ------------------------------------------------------------------
+    # step functions
+
+    async def enter_new_round(self, height: int, round_: int) -> None:
+        """Reference :774."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step != RoundStep.NEW_HEIGHT
+        ):
+            return
+        self.log.debug("enterNewRound", height=height, round=round_)
+        if round_ > rs.round:
+            validators = rs.validators.copy()
+            validators.increment_proposer_priority(round_ - rs.round)
+            rs.validators = validators
+        rs.round = round_
+        rs.step = RoundStep.NEW_ROUND
+        if round_ > 0:
+            # round 0 keeps the proposal from NewHeight; later rounds reset
+            rs.proposal = None
+            rs.proposal_block = None
+            rs.proposal_block_parts = None
+        rs.votes.set_round(round_)
+        rs.triggered_timeout_precommit = False
+        if self.event_bus:
+            await self.event_bus.publish_new_round(self.round_state_event())
+        self.event_switch.fire_event("new_round_step", self.rs)
+
+        wait_for_txs = (
+            not self.config.create_empty_blocks
+            and round_ == 0
+            and self.mempool is not None
+            and self.mempool.size() == 0
+        )
+        if wait_for_txs:
+            self.spawn(self._wait_for_txs(height, round_), "cs-wait-txs")
+        else:
+            await self.enter_propose(height, round_)
+
+    async def _wait_for_txs(self, height: int, round_: int) -> None:
+        await self.mempool.tx_available.wait()
+        if self.rs.height == height and self.rs.round == round_:
+            await self.enter_propose(height, round_)
+
+    async def enter_propose(self, height: int, round_: int) -> None:
+        """Reference :836."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and int(rs.step) >= int(RoundStep.PROPOSE)
+        ):
+            return
+        self.log.debug("enterPropose", height=height, round=round_)
+        rs.step = RoundStep.PROPOSE
+        self._new_step()
+        self.ticker.schedule_timeout(
+            TimeoutInfo(
+                self.config.propose_timeout(round_), height, round_, RoundStep.PROPOSE
+            )
+        )
+        if self.priv_validator is not None and self.is_proposer():
+            await self.decide_proposal(height, round_)
+        if self.is_proposal_complete():
+            await self.enter_prevote(height, round_)
+
+    async def decide_proposal(self, height: int, round_: int) -> None:
+        """Reference :895 defaultDecideProposal (overridable — the byzantine
+        test plugs a double-proposer here)."""
+        rs = self.rs
+        if rs.valid_block is not None:
+            block, parts = rs.valid_block, rs.valid_block_parts
+        else:
+            commit = None
+            if height == 1:
+                commit = None
+            elif rs.last_commit is not None and rs.last_commit.has_two_thirds_majority():
+                commit = rs.last_commit.make_commit()
+            else:
+                self.log.error("propose without LastCommit majority")
+                return
+            block = self.block_exec.create_proposal_block(
+                height, self.state, commit, self.priv_validator.address
+            )
+            parts = block.make_part_set()
+        block_id = BlockID(block.hash(), parts.header())
+        proposal = Proposal(height, round_, rs.valid_round, block_id, now_ns())
+        try:
+            proposal = self.priv_validator.sign_proposal(self.state.chain_id, proposal)
+        except Exception as e:
+            self.log.error("failed to sign proposal", err=repr(e))
+            return
+        await self.send_internal(m.ProposalMessage(proposal))
+        for i in range(parts.total):
+            await self.send_internal(m.BlockPartMessage(height, round_, parts.get_part(i)))
+        self.log.info("proposed block", height=height, round=round_, hash=block.hash())
+
+    def is_proposal_complete(self) -> bool:
+        """Reference :891."""
+        rs = self.rs
+        if rs.proposal is None or rs.proposal_block is None:
+            return False
+        if rs.proposal.pol_round < 0:
+            return True
+        prevotes = rs.votes.prevotes(rs.proposal.pol_round)
+        return prevotes is not None and prevotes.has_two_thirds_majority()
+
+    async def enter_prevote(self, height: int, round_: int) -> None:
+        """Reference :1008."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and int(rs.step) >= int(RoundStep.PREVOTE)
+        ):
+            return
+        self.log.debug("enterPrevote", height=height, round=round_)
+        rs.step = RoundStep.PREVOTE
+        self._new_step()
+        # sign and broadcast prevote (reference :1029 doPrevote)
+        if rs.locked_block is not None:
+            await self.sign_add_vote(VoteType.PREVOTE, rs.locked_block.hash(),
+                                     rs.locked_block_parts.header())
+        elif rs.proposal_block is None:
+            await self.sign_add_vote(VoteType.PREVOTE, b"", None)
+        else:
+            try:
+                self.block_exec.validate_block(self.state, rs.proposal_block)
+                await self.sign_add_vote(
+                    VoteType.PREVOTE,
+                    rs.proposal_block.hash(),
+                    rs.proposal_block_parts.header(),
+                )
+            except Exception as e:
+                self.log.error("invalid proposal block; prevoting nil", err=repr(e))
+                await self.sign_add_vote(VoteType.PREVOTE, b"", None)
+
+    async def enter_prevote_wait(self, height: int, round_: int) -> None:
+        """Reference :1044."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and int(rs.step) >= int(RoundStep.PREVOTE_WAIT)
+        ):
+            return
+        prevotes = rs.votes.prevotes(round_)
+        if prevotes is None or not prevotes.has_two_thirds_any():
+            raise ConsensusHalt("enterPrevoteWait without +2/3 prevotes")
+        rs.step = RoundStep.PREVOTE_WAIT
+        self._new_step()
+        self.ticker.schedule_timeout(
+            TimeoutInfo(
+                self.config.prevote_timeout(round_), height, round_, RoundStep.PREVOTE_WAIT
+            )
+        )
+
+    async def enter_precommit(self, height: int, round_: int) -> None:
+        """Reference :1060 — the POL lock/unlock rules."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and int(rs.step) >= int(RoundStep.PRECOMMIT)
+        ):
+            return
+        self.log.debug("enterPrecommit", height=height, round=round_)
+        rs.step = RoundStep.PRECOMMIT
+        self._new_step()
+
+        prevotes = rs.votes.prevotes(round_)
+        block_id, has_maj = (
+            prevotes.two_thirds_majority() if prevotes else (BlockID(), False)
+        )
+        if not has_maj:
+            # no polka: precommit nil (keep locks)
+            await self.sign_add_vote(VoteType.PRECOMMIT, b"", None)
+            return
+        if self.event_bus:
+            await self.event_bus.publish_polka(self.round_state_event())
+        pol_round, _ = rs.votes.pol_info()
+        if pol_round < round_:
+            raise ConsensusHalt(f"POLRound {pol_round} < {round_} with polka")
+
+        if block_id.is_zero():
+            # +2/3 prevoted nil: unlock (reference :1102)
+            if rs.locked_block is not None:
+                rs.locked_round = -1
+                rs.locked_block = None
+                rs.locked_block_parts = None
+                if self.event_bus:
+                    await self.event_bus.publish_unlock(self.round_state_event())
+            await self.sign_add_vote(VoteType.PRECOMMIT, b"", None)
+            return
+        if rs.locked_block is not None and rs.locked_block.hash() == block_id.hash:
+            # relock (reference :1120)
+            rs.locked_round = round_
+            if self.event_bus:
+                await self.event_bus.publish_relock(self.round_state_event())
+            await self.sign_add_vote(VoteType.PRECOMMIT, block_id.hash, block_id.parts)
+            return
+        if rs.proposal_block is not None and rs.proposal_block.hash() == block_id.hash:
+            # lock the proposal block (reference :1132)
+            try:
+                self.block_exec.validate_block(self.state, rs.proposal_block)
+            except Exception as e:
+                raise ConsensusHalt(f"+2/3 prevoted an invalid block: {e}")
+            rs.locked_round = round_
+            rs.locked_block = rs.proposal_block
+            rs.locked_block_parts = rs.proposal_block_parts
+            if self.event_bus:
+                await self.event_bus.publish_lock(self.round_state_event())
+            await self.sign_add_vote(VoteType.PRECOMMIT, block_id.hash, block_id.parts)
+            return
+        # polka for a block we don't have: unlock, fetch, precommit nil (:1147)
+        rs.locked_round = -1
+        rs.locked_block = None
+        rs.locked_block_parts = None
+        if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(
+            block_id.parts
+        ):
+            rs.proposal_block = None
+            rs.proposal_block_parts = PartSet(block_id.parts)
+        if self.event_bus:
+            await self.event_bus.publish_unlock(self.round_state_event())
+        await self.sign_add_vote(VoteType.PRECOMMIT, b"", None)
+
+    async def enter_precommit_wait(self, height: int, round_: int) -> None:
+        """Reference :1163."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.triggered_timeout_precommit
+        ):
+            return
+        precommits = rs.votes.precommits(round_)
+        if precommits is None or not precommits.has_two_thirds_any():
+            raise ConsensusHalt("enterPrecommitWait without +2/3 precommits")
+        rs.triggered_timeout_precommit = True
+        self._new_step()
+        self.ticker.schedule_timeout(
+            TimeoutInfo(
+                self.config.precommit_timeout(round_),
+                height,
+                round_,
+                RoundStep.PRECOMMIT_WAIT,
+            )
+        )
+
+    async def enter_commit(self, height: int, commit_round: int) -> None:
+        """Reference :1184."""
+        rs = self.rs
+        if rs.height != height or int(rs.step) >= int(RoundStep.COMMIT):
+            return
+        self.log.debug("enterCommit", height=height, commit_round=commit_round)
+        rs.step = RoundStep.COMMIT
+        rs.commit_round = commit_round
+        rs.commit_time = time.monotonic()
+        self._new_step()
+
+        precommits = rs.votes.precommits(commit_round)
+        block_id, ok = precommits.two_thirds_majority()
+        if not ok:
+            raise ConsensusHalt("enterCommit without +2/3 precommit majority")
+        # if we have the locked block, it's the committed one
+        if rs.locked_block is not None and rs.locked_block.hash() == block_id.hash:
+            rs.proposal_block = rs.locked_block
+            rs.proposal_block_parts = rs.locked_block_parts
+        if rs.proposal_block is None or rs.proposal_block.hash() != block_id.hash:
+            if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(
+                block_id.parts
+            ):
+                # we don't have the committed block yet: wait for parts
+                rs.proposal_block = None
+                rs.proposal_block_parts = PartSet(block_id.parts)
+                if self.event_bus:
+                    await self.event_bus.publish_valid_block(self.round_state_event())
+                return
+        await self.try_finalize_commit(height)
+
+    async def try_finalize_commit(self, height: int) -> None:
+        """Reference :1237."""
+        rs = self.rs
+        if rs.height != height:
+            raise ConsensusHalt("tryFinalizeCommit on wrong height")
+        precommits = rs.votes.precommits(rs.commit_round)
+        block_id, ok = precommits.two_thirds_majority()
+        if not ok or block_id.is_zero():
+            return
+        if rs.proposal_block is None or rs.proposal_block.hash() != block_id.hash:
+            return  # don't have the block yet
+        await self.finalize_commit(height)
+
+    async def finalize_commit(self, height: int) -> None:
+        """Reference :1261 — the commit pipeline with crash points."""
+        rs = self.rs
+        if rs.height != height or rs.step != RoundStep.COMMIT:
+            return
+        precommits = rs.votes.precommits(rs.commit_round)
+        block_id, _ = precommits.two_thirds_majority()
+        block, parts = rs.proposal_block, rs.proposal_block_parts
+        if not block.hashes_to(block_id):
+            raise ConsensusHalt("cannot finalize: proposal block does not hash to maj23")
+        self.block_exec.validate_block(self.state, block)
+        fail.fail()  # crash point (reference :1287)
+        if self.block_store.height() < block.header.height:
+            seen_commit = precommits.make_commit()
+            self.block_store.save_block(block, parts, seen_commit)
+        fail.fail()  # crash point (reference :1301)
+        self.wal.write_sync(EndHeightMessage(height))  # (:1316)
+        fail.fail()  # crash point (reference :1318)
+
+        state_copy = self.state.copy()
+        new_state = await self.block_exec.apply_block(
+            state_copy, BlockID(block.hash(), parts.header()), block
+        )
+        fail.fail()  # crash point (reference :1336)
+        self.update_to_state(new_state)
+        fail.fail()  # crash point (reference :1344)
+        self._last_vote_time = 0
+        self.done_first_block.set()
+        self.schedule_round_0()
+        self.event_switch.fire_event("new_round_step", self.rs)
+
+    def _new_step(self) -> None:
+        rsd = self.round_state_event()
+        self.wal.write(rsd)
+        self.event_switch.fire_event("new_round_step", self.rs)
+        if self.event_bus:
+            asyncio.ensure_future(self.event_bus.publish_new_round_step(rsd))
+
+    # ------------------------------------------------------------------
+    # proposal handling
+
+    async def set_proposal(self, proposal: Proposal) -> None:
+        """Reference defaultSetProposal (:1399)."""
+        rs = self.rs
+        if rs.proposal is not None:
+            return
+        if proposal.height != rs.height or proposal.round != rs.round:
+            return
+        if proposal.pol_round < -1 or (
+            proposal.pol_round >= 0 and proposal.pol_round >= proposal.round
+        ):
+            raise ValueError("invalid proposal POL round")
+        proposer = rs.validators.get_proposer()
+        if not proposal.verify(self.state.chain_id, proposer.pub_key):
+            raise ValueError("invalid proposal signature")
+        rs.proposal = proposal
+        if rs.proposal_block_parts is None:
+            rs.proposal_block_parts = PartSet(proposal.block_id.parts)
+        self.log.info("received proposal", height=proposal.height, round=proposal.round)
+
+    async def add_proposal_block_part(self, msg: m.BlockPartMessage, peer_id: str) -> bool:
+        """Reference :1426 addProposalBlockPart."""
+        rs = self.rs
+        if msg.height != rs.height:
+            return False
+        if rs.proposal_block_parts is None:
+            return False
+        added = rs.proposal_block_parts.add_part(msg.part)
+        if not added:
+            return False
+        if rs.proposal_block_parts.is_complete() and rs.proposal_block is None:
+            try:
+                rs.proposal_block = Block.decode(rs.proposal_block_parts.get_data())
+            except Exception as e:
+                raise ConsensusHalt(f"undecodable proposal block: {e}")
+            self.log.info("received complete proposal block",
+                          height=rs.proposal_block.header.height,
+                          hash=rs.proposal_block.hash())
+            if self.event_bus:
+                await self.event_bus.publish_complete_proposal(self.round_state_event())
+            prevotes = rs.votes.prevotes(rs.round)
+            block_id, has_maj = (
+                prevotes.two_thirds_majority() if prevotes else (BlockID(), False)
+            )
+            if has_maj and not block_id.is_zero() and rs.valid_round < rs.round:
+                if rs.proposal_block.hash() == block_id.hash:
+                    rs.valid_round = rs.round
+                    rs.valid_block = rs.proposal_block
+                    rs.valid_block_parts = rs.proposal_block_parts
+            if int(rs.step) <= int(RoundStep.PROPOSE) and self.is_proposal_complete():
+                await self.enter_prevote(rs.height, rs.round)
+            elif rs.step == RoundStep.COMMIT:
+                await self.try_finalize_commit(rs.height)
+        return added
+
+    # ------------------------------------------------------------------
+    # votes
+
+    async def try_add_vote(self, vote: Vote, peer_id: str) -> bool:
+        """Reference :1504 — equivocation becomes evidence."""
+        try:
+            return await self.add_vote(vote, peer_id)
+        except ConflictingVoteError as e:
+            if self.priv_validator is not None and vote.validator_address == self.priv_validator.address:
+                self.log.error("found conflicting vote from ourselves; did you restart with a stale WAL?")
+                return False
+            _, val = self.rs.validators.get_by_address(vote.validator_address)
+            if val is not None and self.evidence_pool is not None:
+                ev = DuplicateVoteEvidence(val.pub_key, e.existing, e.conflicting)
+                try:
+                    self.evidence_pool.add_evidence(ev)
+                    self.log.info("added evidence for conflicting vote")
+                except Exception as err:
+                    self.log.error("failed to add evidence", err=repr(err))
+            return False
+
+    async def add_vote(self, vote: Vote, peer_id: str) -> bool:
+        """Reference :1534 addVote."""
+        rs = self.rs
+        # precommit for the previous height (LastCommit catch-up)
+        if vote.height + 1 == rs.height and vote.type == VoteType.PRECOMMIT:
+            if rs.step != RoundStep.NEW_HEIGHT or rs.last_commit is None:
+                return False
+            added = rs.last_commit.add_vote(vote)
+            if added:
+                self.log.debug("added vote to LastCommit")
+                if self.event_bus:
+                    await self.event_bus.publish_vote(vote)
+                self.event_switch.fire_event("vote", vote)
+                if self.config.skip_timeout_commit and rs.last_commit.has_all():
+                    await self.enter_new_round(rs.height, 0)
+            return added
+        if vote.height != rs.height:
+            return False
+
+        added = rs.votes.add_vote(vote, peer_id)
+        if not added:
+            return False
+        if self.event_bus:
+            await self.event_bus.publish_vote(vote)
+        self.event_switch.fire_event("vote", vote)
+
+        if vote.type == VoteType.PREVOTE:
+            await self._on_prevote_added(vote)
+        else:
+            await self._on_precommit_added(vote)
+        return True
+
+    async def _on_prevote_added(self, vote: Vote) -> None:
+        """Reference :1596-1656 — unlock on higher POL, valid-block update,
+        step transitions."""
+        rs = self.rs
+        prevotes = rs.votes.prevotes(vote.round)
+        block_id, has_maj = prevotes.two_thirds_majority()
+        if has_maj:
+            # unlock if there's a polka for something else in a later round
+            if (
+                rs.locked_block is not None
+                and rs.locked_round < vote.round <= rs.round
+                and rs.locked_block.hash() != block_id.hash
+            ):
+                self.log.info("unlocking because of POL", locked_round=rs.locked_round)
+                rs.locked_round = -1
+                rs.locked_block = None
+                rs.locked_block_parts = None
+                if self.event_bus:
+                    await self.event_bus.publish_unlock(self.round_state_event())
+            # update valid block (reference :1627)
+            if (
+                not block_id.is_zero()
+                and rs.valid_round < vote.round
+                and vote.round == rs.round
+            ):
+                if rs.proposal_block is not None and rs.proposal_block.hash() == block_id.hash:
+                    rs.valid_round = vote.round
+                    rs.valid_block = rs.proposal_block
+                    rs.valid_block_parts = rs.proposal_block_parts
+                else:
+                    # we don't have the block: start collecting it
+                    rs.proposal_block = None
+                    if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(block_id.parts):
+                        rs.proposal_block_parts = PartSet(block_id.parts)
+                    rs.valid_round = vote.round
+                    rs.valid_block = None
+                    rs.valid_block_parts = None
+                self.event_switch.fire_event("valid_block", rs)
+                if self.event_bus:
+                    await self.event_bus.publish_valid_block(self.round_state_event())
+
+        # transitions (reference :1639)
+        if rs.round < vote.round and prevotes.has_two_thirds_any():
+            await self.enter_new_round(rs.height, vote.round)
+        elif rs.round == vote.round and int(RoundStep.PREVOTE) <= int(rs.step):
+            if has_maj and (self.is_proposal_complete() or block_id.is_zero()):
+                await self.enter_precommit(rs.height, vote.round)
+            elif prevotes.has_two_thirds_any():
+                await self.enter_prevote_wait(rs.height, vote.round)
+        elif rs.proposal is not None and 0 <= rs.proposal.pol_round == vote.round:
+            if self.is_proposal_complete():
+                await self.enter_prevote(rs.height, rs.round)
+
+    async def _on_precommit_added(self, vote: Vote) -> None:
+        """Reference :1659-1679."""
+        rs = self.rs
+        precommits = rs.votes.precommits(vote.round)
+        block_id, has_maj = precommits.two_thirds_majority()
+        if has_maj:
+            await self.enter_new_round(rs.height, vote.round)
+            await self.enter_precommit(rs.height, vote.round)
+            if not block_id.is_zero():
+                await self.enter_commit(rs.height, vote.round)
+                if self.config.skip_timeout_commit and precommits.has_all():
+                    await self.enter_new_round(rs.height, 0)
+            else:
+                await self.enter_precommit_wait(rs.height, vote.round)
+        elif rs.round <= vote.round and precommits.has_two_thirds_any():
+            await self.enter_new_round(rs.height, vote.round)
+            await self.enter_precommit_wait(rs.height, vote.round)
+
+    async def sign_add_vote(
+        self, type_: VoteType, hash_: bytes, parts_header
+    ) -> Vote | None:
+        """Reference :1728 signAddVote + :1681 voteTime monotonicity."""
+        if self.priv_validator is None:
+            return None
+        rs = self.rs
+        idx, val = rs.validators.get_by_address(self.priv_validator.address)
+        if val is None:
+            return None  # not a validator this height
+        from tendermint_tpu.types import PartSetHeader
+
+        block_id = BlockID(hash_, parts_header or PartSetHeader())
+        ts = max(now_ns(), self._last_vote_time + 1, self.state.last_block_time + 1)
+        self._last_vote_time = ts
+        vote = Vote(
+            type_, rs.height, rs.round, block_id, ts, self.priv_validator.address, idx
+        )
+        try:
+            vote = self.priv_validator.sign_vote(self.state.chain_id, vote)
+        except Exception as e:
+            self.log.error("failed to sign vote", err=repr(e))
+            return None
+        await self.send_internal(m.VoteMessage(vote))
+        return vote

@@ -1,0 +1,52 @@
+"""TimeoutTicker — the single consensus timer.
+
+Reference parity: consensus/ticker.go:17,94 — one timer; scheduling a
+timeout overwrites the pending one only for a later (height, round, step);
+fired timeouts are delivered on a channel (here: asyncio.Queue).
+"""
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+
+from tendermint_tpu.consensus.round_state import RoundStep
+from tendermint_tpu.libs.service import BaseService
+
+
+@dataclass(frozen=True)
+class TimeoutInfo:
+    duration: float
+    height: int
+    round: int
+    step: RoundStep
+
+    def hrs(self) -> tuple[int, int, int]:
+        return (self.height, self.round, int(self.step))
+
+
+class TimeoutTicker(BaseService):
+    def __init__(self) -> None:
+        super().__init__("TimeoutTicker")
+        self.tock: asyncio.Queue[TimeoutInfo] = asyncio.Queue()
+        self._current: TimeoutInfo | None = None
+        self._timer: asyncio.TimerHandle | None = None
+
+    async def on_stop(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+
+    def schedule_timeout(self, ti: TimeoutInfo) -> None:
+        """Only later (H,R,S) may replace a pending timeout
+        (reference ticker.go:94 timeoutRoutine)."""
+        if self._current is not None and self._timer is not None:
+            if ti.hrs() <= self._current.hrs():
+                return
+            self._timer.cancel()
+        self._current = ti
+        loop = asyncio.get_event_loop()
+        self._timer = loop.call_later(ti.duration, self._fire, ti)
+
+    def _fire(self, ti: TimeoutInfo) -> None:
+        self._current = None
+        self._timer = None
+        self.tock.put_nowait(ti)

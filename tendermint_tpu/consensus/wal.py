@@ -1,0 +1,186 @@
+"""Consensus write-ahead log.
+
+Reference parity: consensus/wal.go — TimedWALMessage framing with CRC32 +
+length (:270), Write vs fsynced WriteSync (:177,191), EndHeightMessage
+height barrier (:39), rotating autofile group storage, backward
+SearchForEndHeight (:213). Every message the state machine consumes is
+logged BEFORE processing so a crash replays deterministically.
+"""
+from __future__ import annotations
+
+import io
+import struct
+import time
+import zlib
+from dataclasses import dataclass
+
+from tendermint_tpu.consensus.messages import (
+    decode_consensus_message,
+    encode_consensus_message,
+)
+from tendermint_tpu.consensus.round_state import RoundStep
+from tendermint_tpu.encoding import DecodeError, Reader, Writer
+from tendermint_tpu.libs.autofile import Group
+
+MAX_WAL_MSG_SIZE = 1024 * 1024  # 1MB per message hard cap (reference wal.go)
+
+
+@dataclass(frozen=True)
+class EndHeightMessage:
+    """Reference wal.go:39 — written after a height commits."""
+
+    height: int
+
+
+@dataclass(frozen=True)
+class WALTimeoutInfo:
+    duration: float
+    height: int
+    round: int
+    step: int
+
+
+@dataclass
+class MsgInfo:
+    """A consensus message + its source peer ('' = internal)."""
+
+    msg: object
+    peer_id: str = ""
+
+
+@dataclass
+class EventDataRoundState:
+    height: int
+    round: int
+    step: str
+
+
+@dataclass
+class TimedWALMessage:
+    time_ns: int
+    msg: object
+
+
+def _encode_wal_msg(msg) -> bytes:
+    w = Writer()
+    if isinstance(msg, EndHeightMessage):
+        w.u8(1).u64(msg.height)
+    elif isinstance(msg, WALTimeoutInfo):
+        w.u8(2).u64(int(msg.duration * 1e9)).u64(msg.height).u32(msg.round).u8(msg.step)
+    elif isinstance(msg, MsgInfo):
+        w.u8(3).str(msg.peer_id).bytes(encode_consensus_message(msg.msg))
+    elif isinstance(msg, EventDataRoundState):
+        w.u8(4).u64(msg.height).u32(msg.round).str(msg.step)
+    else:
+        raise TypeError(f"cannot WAL-encode {msg!r}")
+    return w.build()
+
+
+def _decode_wal_msg(data: bytes):
+    r = Reader(data)
+    tag = r.u8()
+    if tag == 1:
+        return EndHeightMessage(r.u64())
+    if tag == 2:
+        return WALTimeoutInfo(r.u64() / 1e9, r.u64(), r.u32(), r.u8())
+    if tag == 3:
+        peer = r.str()
+        return MsgInfo(decode_consensus_message(r.bytes()), peer)
+    if tag == 4:
+        return EventDataRoundState(r.u64(), r.u32(), r.str())
+    raise DecodeError(f"unknown WAL tag {tag}")
+
+
+def encode_frame(tm: TimedWALMessage) -> bytes:
+    """crc32(payload) u32 | length u32 | payload (reference wal.go:270)."""
+    payload = Writer().u64(tm.time_ns).raw(_encode_wal_msg(tm.msg)).build()
+    if len(payload) > MAX_WAL_MSG_SIZE:
+        raise ValueError(f"WAL message too big: {len(payload)}")
+    return struct.pack(">II", zlib.crc32(payload), len(payload)) + payload
+
+
+class WALCorruptionError(Exception):
+    pass
+
+
+def decode_frames(stream: io.BufferedIOBase):
+    """Yield TimedWALMessages; raises WALCorruptionError on a bad frame
+    (callers may treat a corrupt tail as a crash artifact)."""
+    while True:
+        hdr = stream.read(8)
+        if len(hdr) == 0:
+            return
+        if len(hdr) < 8:
+            raise WALCorruptionError("truncated frame header")
+        crc, length = struct.unpack(">II", hdr)
+        if length > MAX_WAL_MSG_SIZE:
+            raise WALCorruptionError(f"frame too big: {length}")
+        payload = stream.read(length)
+        if len(payload) < length:
+            raise WALCorruptionError("truncated frame payload")
+        if zlib.crc32(payload) != crc:
+            raise WALCorruptionError("crc mismatch")
+        r = Reader(payload)
+        time_ns = r.u64()
+        try:
+            msg = _decode_wal_msg(payload[8:])
+        except DecodeError as e:
+            raise WALCorruptionError(f"bad WAL message: {e}") from e
+        yield TimedWALMessage(time_ns, msg)
+
+
+class WAL:
+    """Reference wal.go:57 baseWAL."""
+
+    def __init__(self, path: str, head_size_limit: int = 10 * 1024 * 1024) -> None:
+        self.group = Group(path, head_size_limit=head_size_limit)
+
+    def write(self, msg) -> None:
+        self.group.write(encode_frame(TimedWALMessage(time.time_ns(), msg)))
+
+    def write_sync(self, msg) -> None:
+        self.write(msg)
+        self.group.flush_sync()
+
+    def flush(self) -> None:
+        self.group.flush()
+
+    def close(self) -> None:
+        self.group.close()
+
+    def search_for_end_height(self, height: int):
+        """Return an iterator of messages AFTER #ENDHEIGHT for height, or
+        None if not found (reference wal.go:213). height=0 with an empty WAL
+        counts as found (fresh chain)."""
+        msgs = []
+        found = height == 0
+        try:
+            for tm in decode_frames(self.group.reader()):
+                if found:
+                    msgs.append(tm)
+                if isinstance(tm.msg, EndHeightMessage) and tm.msg.height == height:
+                    found = True
+                    msgs = []
+        except WALCorruptionError:
+            # corrupt tail: everything before it is still usable
+            pass
+        return msgs if found else None
+
+
+class NilWAL:
+    """Reference wal.go:382 — used when WAL is disabled."""
+
+    def write(self, msg) -> None:
+        pass
+
+    def write_sync(self, msg) -> None:
+        pass
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def search_for_end_height(self, height: int):
+        return None if height > 0 else []

@@ -1,0 +1,93 @@
+"""Evidence pool — pending/committed Byzantine evidence.
+
+Reference parity: evidence/pool.go:17 (validate via state.VerifyEvidence,
+clist for gossip, prune on block commit), evidence/store.go (pending/
+committed prefixes with priority keys).
+"""
+from __future__ import annotations
+
+import struct
+
+from tendermint_tpu.libs.clist import CList
+from tendermint_tpu.libs.db import DB
+from tendermint_tpu.libs.log import NOP, Logger
+from tendermint_tpu.state import State, StateStore
+from tendermint_tpu.state.validation import ValidationError, verify_evidence
+from tendermint_tpu.types.evidence import Evidence, decode_evidence
+
+
+class EvidenceError(Exception):
+    pass
+
+
+class EvidencePool:
+    def __init__(
+        self, db: DB, state_store: StateStore, state: State, logger: Logger = NOP
+    ) -> None:
+        self._db = db
+        self.state_store = state_store
+        self.state = state
+        self.log = logger
+        self.evidence_list = CList()  # gossip data structure
+        self._in_list: dict[bytes, object] = {}
+        # load pending from disk
+        for _, raw in self._db.iterate_prefix(b"EV:pending:"):
+            ev = decode_evidence(raw)
+            self._in_list[ev.hash()] = self.evidence_list.push_back(ev)
+
+    def _pending_key(self, ev: Evidence) -> bytes:
+        return b"EV:pending:" + struct.pack(">Q", ev.height()) + ev.hash()
+
+    def _committed_key(self, ev: Evidence) -> bytes:
+        return b"EV:committed:" + ev.hash()
+
+    def is_committed(self, ev: Evidence) -> bool:
+        return self._db.has(self._committed_key(ev))
+
+    def is_pending(self, ev: Evidence) -> bool:
+        return self._db.has(self._pending_key(ev))
+
+    def add_evidence(self, ev: Evidence) -> None:
+        """Verify and admit new evidence (reference pool.go AddEvidence)."""
+        if self.is_committed(ev) or self.is_pending(ev):
+            return
+        try:
+            verify_evidence(self.state, self.state_store, ev)
+        except ValidationError as e:
+            raise EvidenceError(str(e)) from e
+        self._db.set(self._pending_key(ev), ev.encode())
+        self._in_list[ev.hash()] = self.evidence_list.push_back(ev)
+        self.log.info("added evidence", evidence=str(ev))
+
+    def pending_evidence(self, max_bytes: int = -1) -> list[Evidence]:
+        out = []
+        total = 0
+        for _, raw in self._db.iterate_prefix(b"EV:pending:"):
+            ev = decode_evidence(raw)
+            if max_bytes >= 0 and total + len(raw) > max_bytes:
+                break
+            total += len(raw)
+            out.append(ev)
+        return out
+
+    def mark_committed(self, evidence: list[Evidence]) -> None:
+        for ev in evidence:
+            self._db.set(self._committed_key(ev), b"1")
+            self._db.delete(self._pending_key(ev))
+            el = self._in_list.pop(ev.hash(), None)
+            if el is not None:
+                self.evidence_list.remove(el)
+
+    def update(self, block, state: State) -> None:
+        """Reference pool.go Update: mark block evidence committed, prune
+        expired pending evidence."""
+        self.state = state
+        self.mark_committed(block.evidence)
+        max_age = state.consensus_params.evidence.max_age
+        for _, raw in list(self._db.iterate_prefix(b"EV:pending:")):
+            ev = decode_evidence(raw)
+            if ev.height() < state.last_block_height - max_age:
+                self._db.delete(self._pending_key(ev))
+                el = self._in_list.pop(ev.hash(), None)
+                if el is not None:
+                    self.evidence_list.remove(el)
