@@ -1,0 +1,85 @@
+"""Driver benchmark: batched Ed25519 verification throughput per chip.
+
+Measures the end-to-end device verification of a 10,000-validator commit —
+the BASELINE.json north star (reference serial path: one `VerifyBytes` per
+CommitSig, types/validator_set.go:609-627, ~150 us each on modern x86 per
+x/crypto context in BASELINE.md → ~6.7k verifies/sec serial baseline).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Diagnostics go to stderr.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+N_COMMIT = 10_000         # validators in the north-star commit
+N_UNIQUE = 512            # unique real signatures; tiled to N_COMMIT
+# Serial Go x/crypto/ed25519 verify ~150us/op (BASELINE.md context) →
+# baseline verifies/sec for one CPU core, the reference's actual hot path.
+BASELINE_VERIFIES_PER_SEC = 1e6 / 150.0
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    import jax
+
+    from tendermint_tpu.ops import ed25519_batch
+    from tendermint_tpu.utils import make_sig_batch
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform} ({dev.device_kind})")
+
+    # Real signatures (unique keys + messages), tiled to commit size; device
+    # work per lane is data-independent so tiling measures true throughput.
+    pubs, msgs, sigs = make_sig_batch(N_UNIQUE, msg_prefix=b"bench vote ")
+    reps = -(-N_COMMIT // N_UNIQUE)
+    pubs = (pubs * reps)[:N_COMMIT]
+    msgs = (msgs * reps)[:N_COMMIT]
+    sigs = (sigs * reps)[:N_COMMIT]
+
+    t0 = time.perf_counter()
+    inputs, mask = ed25519_batch.prepare_batch(pubs, msgs, sigs)
+    host_prep_s = time.perf_counter() - t0
+    assert inputs is not None and mask.all()
+    log(f"host prep (hash+decompress+limbs) for {N_COMMIT}: {host_prep_s:.3f}s")
+
+    placed = {k: jax.device_put(v, dev) for k, v in inputs.items()}
+
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(ed25519_batch.verify_kernel(**placed))
+    log(f"compile + first run: {time.perf_counter() - t0:.1f}s")
+    assert np.asarray(out)[:N_COMMIT].all(), "kernel rejected valid sigs"
+
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = ed25519_batch.verify_kernel(**placed)
+    jax.block_until_ready(out)
+    per_commit_s = (time.perf_counter() - t0) / iters
+
+    rate = N_COMMIT / per_commit_s
+    log(
+        f"10k-validator commit verify: {per_commit_s * 1e3:.2f} ms "
+        f"({rate:,.0f} verifies/sec/chip; north star <5ms on v4-8)"
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "ed25519_batch_verifies_per_sec_per_chip",
+                "value": round(rate, 1),
+                "unit": "verifies/s",
+                "vs_baseline": round(rate / BASELINE_VERIFIES_PER_SEC, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -114,7 +114,7 @@ def _pad_to_bucket(n: int, min_bucket: int = 128) -> int:
     return b
 
 
-def prepare_batch(pubs, msgs, sigs):
+def prepare_batch(pubs, msgs, sigs, min_bucket: int = 128):
     """Host-side batch build. Returns (device_inputs dict | None, valid_mask).
 
     valid_mask marks signatures that failed structural checks (bad lengths,
@@ -153,7 +153,7 @@ def prepare_batch(pubs, msgs, sigs):
         h_int[i] = em.reduce_scalar(hashlib.sha512(r_bytes + pub + msg).digest())
     if not mask.any():
         return None, mask
-    padded = _pad_to_bucket(n)
+    padded = _pad_to_bucket(n, min_bucket)
     pad = padded - n
 
     def padl(limbs):  # (22, n) -> (22, padded)

@@ -1,0 +1,24 @@
+"""Multi-chip parallelism for the verification data plane.
+
+The reference verifies commit signatures serially on a single core
+(types/validator_set.go:591-633); its only "distributed backend" is the p2p
+TCP stack (SURVEY §2.3).  In the TPU-native framework the scaling axis is
+signatures-per-commit: a commit's (pubkey, msg, sig) batch is sharded across
+the chips of a `jax.sharding.Mesh` on the batch dimension — the framework's
+data-parallel axis — and the quorum decision (sum of voting power of valid
+signatures vs 2/3 threshold) is computed on-device with a `psum` collective
+riding ICI.
+"""
+from tendermint_tpu.parallel.sharded import (
+    build_commit_verifier,
+    build_sharded_verifier,
+    make_batch_mesh,
+    shard_inputs,
+)
+
+__all__ = [
+    "build_commit_verifier",
+    "build_sharded_verifier",
+    "make_batch_mesh",
+    "shard_inputs",
+]

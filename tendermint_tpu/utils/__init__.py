@@ -1,0 +1,4 @@
+"""Shared utilities."""
+from tendermint_tpu.utils.sigbatch import make_sig_batch
+
+__all__ = ["make_sig_batch"]

@@ -1,0 +1,31 @@
+"""Deterministic (pubkey, msg, sig) batch builder.
+
+Shared by bench.py, __graft_entry__.py and the test suite so the benchmark
+measures exactly what the tests verify.
+"""
+from __future__ import annotations
+
+
+def make_sig_batch(
+    n: int,
+    tamper: set[int] | tuple[int, ...] = (),
+    msg_prefix: bytes = b"vote ",
+) -> tuple[list[bytes], list[bytes], list[bytes]]:
+    """n real ed25519 triples from seeded keys; `tamper` indices get a
+    corrupted signature (first byte flipped)."""
+    from tendermint_tpu.crypto.ed25519 import gen_priv_key
+
+    pubs: list[bytes] = []
+    msgs: list[bytes] = []
+    sigs: list[bytes] = []
+    tamper = set(tamper)
+    for i in range(n):
+        priv = gen_priv_key(seed=i.to_bytes(4, "big") * 8)
+        msg = msg_prefix + b"%d" % i
+        sig = bytearray(priv.sign(msg))
+        if i in tamper:
+            sig[0] ^= 0xFF
+        pubs.append(priv.pub_key().bytes())
+        msgs.append(msg)
+        sigs.append(bytes(sig))
+    return pubs, msgs, sigs

@@ -6,12 +6,20 @@ These env vars must be set before jax is imported anywhere.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the ambient environment points at a real TPU
+# (JAX_PLATFORMS=axon): the suite needs 8 virtual devices for sharding tests.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+# The axon TPU plugin registers itself regardless of JAX_PLATFORMS; the
+# config update is the authoritative override.
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
