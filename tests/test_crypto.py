@@ -232,6 +232,7 @@ class TestBatchVerifier:
             calls["n"] = len(pubs)
             return [True] * len(pubs)
 
+        prev = batch.get_backend("ed25519")
         batch.register_backend("ed25519", fake_backend)
         try:
             bv = batch.BatchVerifier()
@@ -240,4 +241,7 @@ class TestBatchVerifier:
             assert bv.verify_all() == [True]
             assert calls["n"] == 1
         finally:
-            batch.clear_backend("ed25519")
+            if prev is not None:
+                batch.register_backend("ed25519", prev)
+            else:
+                batch.clear_backend("ed25519")
