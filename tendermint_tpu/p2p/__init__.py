@@ -1,0 +1,31 @@
+"""p2p — the distributed communication backend (host-side).
+
+Reference parity: p2p/ — Switch (switch.go:67), Reactor contract
+(base_reactor.go), MultiplexTransport (transport.go:125), MConnection
+multiplexed channels (conn/connection.go:74), SecretConnection authenticated
+encryption (conn/secret_connection.go:49), PEX/addrbook (pex/).
+
+Per SURVEY.md §2.3 the consensus gossip network stays host-side (TCP between
+mutually untrusting machines); ICI/collectives are used only inside the batch
+signature-verification data plane (tendermint_tpu.parallel). Everything here
+is asyncio-native: goroutine-per-peer in the reference maps to task-per-peer.
+"""
+from __future__ import annotations
+
+from tendermint_tpu.p2p.key import NodeKey, node_id_from_pubkey
+from tendermint_tpu.p2p.node_info import NodeInfo
+from tendermint_tpu.p2p.netaddress import NetAddress
+from tendermint_tpu.p2p.base_reactor import BaseReactor, ChannelDescriptor
+from tendermint_tpu.p2p.peer import Peer
+from tendermint_tpu.p2p.switch import Switch
+
+__all__ = [
+    "NodeKey",
+    "node_id_from_pubkey",
+    "NodeInfo",
+    "NetAddress",
+    "BaseReactor",
+    "ChannelDescriptor",
+    "Peer",
+    "Switch",
+]

@@ -1,0 +1,45 @@
+"""Reactor contract.
+
+Reference parity: p2p/base_reactor.go — a Reactor owns a set of channels on
+the Switch and reacts to peer lifecycle + messages:
+`{GetChannels, InitPeer, AddPeer, RemovePeer, Receive}`.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tendermint_tpu.libs.service import BaseService
+
+
+@dataclass(frozen=True)
+class ChannelDescriptor:
+    """Reference p2p/conn/connection.go:696."""
+
+    id: int
+    priority: int = 1
+    send_queue_capacity: int = 100
+    recv_message_capacity: int = 1 << 20
+
+
+class BaseReactor(BaseService):
+    def __init__(self, name: str) -> None:
+        super().__init__(name=name)
+        self.switch = None  # set by Switch.add_reactor
+
+    def set_switch(self, switch) -> None:
+        self.switch = switch
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        return []
+
+    def init_peer(self, peer) -> None:
+        """Called before the peer starts; install per-peer state."""
+
+    async def add_peer(self, peer) -> None:
+        """Called once the peer is started."""
+
+    async def remove_peer(self, peer, reason) -> None:
+        pass
+
+    async def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
+        pass

@@ -1,0 +1,8 @@
+"""Connection substrate: SecretConnection (authenticated encryption) and
+MConnection (channel multiplexing) — reference p2p/conn/."""
+from __future__ import annotations
+
+from tendermint_tpu.p2p.conn.secret_connection import SecretConnection
+from tendermint_tpu.p2p.conn.connection import MConnection, ChannelStatus
+
+__all__ = ["SecretConnection", "MConnection", "ChannelStatus"]

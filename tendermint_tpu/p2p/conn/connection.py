@@ -1,0 +1,257 @@
+"""MConnection: N logical channels multiplexed over one encrypted link.
+
+Reference parity: p2p/conn/connection.go:74 — per-channel priority send
+queues drained by a single send routine (least recently-sent/priority ratio
+first, :405), a recv routine reassembling chunked messages per channel
+(:539), ping/pong keepalive with a pong timeout, flow-rate metering, and
+`ChannelDescriptor{ID, Priority, SendQueueCapacity, RecvMessageCapacity}`
+(:696). Packet framing rides the SecretConnection's length-prefixed message
+layer instead of amino `PacketMsg` (:884).
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+
+from tendermint_tpu.encoding import DecodeError, Reader, Writer
+from tendermint_tpu.libs.flowrate import Monitor
+from tendermint_tpu.libs.service import BaseService
+
+_PKT_PING = 0
+_PKT_PONG = 1
+_PKT_MSG = 2
+
+MAX_PACKET_PAYLOAD = 1024
+
+
+@dataclass
+class MConnConfig:
+    send_rate: float = 5 * 1024 * 1024  # bytes/sec (config/config.go:473)
+    recv_rate: float = 5 * 1024 * 1024
+    max_packet_payload: int = MAX_PACKET_PAYLOAD
+    flush_throttle: float = 0.1
+    ping_interval: float = 60.0
+    pong_timeout: float = 45.0
+    send_timeout: float = 10.0
+
+
+@dataclass
+class ChannelStatus:
+    id: int
+    send_queue_size: int
+    priority: int
+    recently_sent: int
+
+
+class _Channel:
+    def __init__(self, desc, max_payload: int) -> None:
+        self.desc = desc
+        self.queue: asyncio.Queue[bytes] = asyncio.Queue(desc.send_queue_capacity)
+        self.recving = bytearray()
+        self.recently_sent = 0
+        self.sending: bytes | None = None  # message currently being chunked
+        self.sent_offset = 0
+        self.max_payload = max_payload
+
+    def is_send_pending(self) -> bool:
+        return self.sending is not None or not self.queue.empty()
+
+    def next_packet(self) -> tuple[bytes, bool]:
+        """Pop up to max_payload bytes of the in-flight message; returns
+        (chunk, eof)."""
+        if self.sending is None:
+            self.sending = self.queue.get_nowait()
+            self.sent_offset = 0
+        chunk = self.sending[self.sent_offset : self.sent_offset + self.max_payload]
+        self.sent_offset += len(chunk)
+        eof = self.sent_offset >= len(self.sending)
+        if eof:
+            self.sending = None
+            self.sent_offset = 0
+        return chunk, eof
+
+
+class MConnection(BaseService):
+    """One peer link: channels in, packets out (and back)."""
+
+    def __init__(
+        self,
+        conn,  # SecretConnection-like: write/drain/read_msg/close
+        chan_descs,
+        on_receive,  # async (ch_id: int, msg: bytes) -> None
+        on_error,  # async (exc: Exception) -> None
+        config: MConnConfig | None = None,
+    ) -> None:
+        super().__init__(name="MConn")
+        self.config = config or MConnConfig()
+        self._conn = conn
+        self._channels = {
+            d.id: _Channel(d, self.config.max_packet_payload) for d in chan_descs
+        }
+        self._on_receive = on_receive
+        self._on_error = on_error
+        self._send_wake = asyncio.Event()
+        self._pong_pending = 0
+        self._last_pong = time.monotonic()
+        self._send_monitor = Monitor()
+        self._recv_monitor = Monitor()
+        self._errored = False
+
+    async def on_start(self) -> None:
+        self.spawn(self._send_routine(), "mconn-send")
+        self.spawn(self._recv_routine(), "mconn-recv")
+        self.spawn(self._ping_routine(), "mconn-ping")
+
+    async def on_stop(self) -> None:
+        self._conn.close()
+
+    # --- sending ---------------------------------------------------------
+
+    async def send(self, ch_id: int, msg: bytes) -> bool:
+        """Queue msg on channel; False if unknown channel or queue full past
+        the timeout (reference connection.go Send)."""
+        ch = self._channels.get(ch_id)
+        if ch is None or not self.is_running:
+            return False
+        try:
+            await asyncio.wait_for(ch.queue.put(msg), self.config.send_timeout)
+        except asyncio.TimeoutError:
+            return False
+        self._send_wake.set()
+        return True
+
+    def try_send(self, ch_id: int, msg: bytes) -> bool:
+        ch = self._channels.get(ch_id)
+        if ch is None or not self.is_running:
+            return False
+        try:
+            ch.queue.put_nowait(msg)
+        except asyncio.QueueFull:
+            return False
+        self._send_wake.set()
+        return True
+
+    def _pick_channel(self) -> _Channel | None:
+        """Least recently_sent/priority ratio among channels with data
+        (reference connection.go:405 sendPacketMsg)."""
+        best, best_ratio = None, None
+        for ch in self._channels.values():
+            if not ch.is_send_pending():
+                continue
+            ratio = ch.recently_sent / max(ch.desc.priority, 1)
+            if best_ratio is None or ratio < best_ratio:
+                best, best_ratio = ch, ratio
+        return best
+
+    async def _send_routine(self) -> None:
+        try:
+            while True:
+                await self._send_wake.wait()
+                self._send_wake.clear()
+                while True:
+                    if self._pong_pending:
+                        self._pong_pending -= 1
+                        await self._write_packet(Writer().u8(_PKT_PONG).build())
+                        continue
+                    ch = self._pick_channel()
+                    if ch is None:
+                        break
+                    chunk, eof = ch.next_packet()
+                    w = Writer().u8(_PKT_MSG).u8(ch.desc.id).bool(eof).bytes(chunk)
+                    await self._write_packet(w.build())
+                    ch.recently_sent += len(chunk)
+                await self._conn.drain()
+                # decay so bursts don't starve low-priority channels forever
+                for c in self._channels.values():
+                    c.recently_sent = int(c.recently_sent * 0.8)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            await self._fail(e)
+
+    async def _write_packet(self, pkt: bytes) -> None:
+        await self._conn.write(pkt)
+        self._send_monitor.update(len(pkt))
+        # crude rate limit: sleep off any excess over send_rate
+        st = self._send_monitor.status()
+        if st.cur_rate > self.config.send_rate > 0:
+            await asyncio.sleep(len(pkt) / self.config.send_rate)
+
+    # --- receiving -------------------------------------------------------
+
+    async def _recv_routine(self) -> None:
+        try:
+            while True:
+                pkt = await self._conn.read_msg()
+                self._recv_monitor.update(len(pkt))
+                r = Reader(pkt)
+                tag = r.u8()
+                if tag == _PKT_PING:
+                    self._pong_pending += 1
+                    self._send_wake.set()
+                elif tag == _PKT_PONG:
+                    self._last_pong = time.monotonic()
+                elif tag == _PKT_MSG:
+                    ch_id = r.u8()
+                    eof = r.bool()
+                    data = r.bytes()
+                    ch = self._channels.get(ch_id)
+                    if ch is None:
+                        raise DecodeError(f"packet on unknown channel {ch_id:#x}")
+                    ch.recving += data
+                    if len(ch.recving) > ch.desc.recv_message_capacity:
+                        raise DecodeError(
+                            f"message on channel {ch_id:#x} exceeds capacity "
+                            f"{ch.desc.recv_message_capacity}"
+                        )
+                    if eof:
+                        msg = bytes(ch.recving)
+                        ch.recving.clear()
+                        await self._on_receive(ch_id, msg)
+                else:
+                    raise DecodeError(f"unknown packet tag {tag}")
+        except asyncio.CancelledError:
+            raise
+        except (asyncio.IncompleteReadError, ConnectionError, OSError) as e:
+            await self._fail(e)
+        except Exception as e:
+            await self._fail(e)
+
+    async def _ping_routine(self) -> None:
+        try:
+            while True:
+                await asyncio.sleep(self.config.ping_interval)
+                await self._write_packet(Writer().u8(_PKT_PING).build())
+                await self._conn.drain()
+                await asyncio.sleep(self.config.pong_timeout)
+                if time.monotonic() - self._last_pong > (
+                    self.config.ping_interval + self.config.pong_timeout
+                ):
+                    await self._fail(TimeoutError("pong timeout"))
+                    return
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            await self._fail(e)
+
+    async def _fail(self, e: Exception) -> None:
+        if self._errored:
+            return
+        self._errored = True
+        self.logger.debug("connection failed: %s", e)
+        try:
+            await self._on_error(e)
+        except Exception:
+            pass
+
+    def status(self) -> list[ChannelStatus]:
+        return [
+            ChannelStatus(
+                id=ch.desc.id,
+                send_queue_size=ch.queue.qsize(),
+                priority=ch.desc.priority,
+                recently_sent=ch.recently_sent,
+            )
+            for ch in self._channels.values()
+        ]

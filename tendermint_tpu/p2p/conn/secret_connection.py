@@ -1,0 +1,185 @@
+"""SecretConnection: authenticated encryption for peer links.
+
+Reference parity: p2p/conn/secret_connection.go:49 — Station-to-Station
+protocol: X25519 ephemeral Diffie-Hellman (:253,381), HKDF key derivation
+(:346), ChaCha20-Poly1305 AEAD framing, and an ed25519 signature over the
+derived challenge authenticating each peer's long-lived node key (:405,419).
+Low-order DH result rejection (:335) is handled by the `cryptography`
+library, which raises on an all-zero shared secret.
+
+Wire format: 32-byte ephemeral pubkeys in the clear, then fixed-size sealed
+frames: plaintext = u32 BE payload length + payload, zero-padded to
+DATA_MAX_SIZE + 4; ciphertext = plaintext + 16-byte Poly1305 tag. Fixed-size
+frames avoid leaking message lengths (same rationale as the reference's
+1044-byte frames). Nonces are 96-bit little-endian counters, one counter per
+direction.
+"""
+from __future__ import annotations
+
+import asyncio
+import struct
+
+from cryptography.exceptions import InvalidTag
+from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey,
+    X25519PublicKey,
+)
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+from tendermint_tpu.crypto import ed25519
+from tendermint_tpu.encoding import Reader, Writer
+
+DATA_MAX_SIZE = 1024
+_FRAME_SIZE = DATA_MAX_SIZE + 4
+_SEALED_SIZE = _FRAME_SIZE + 16
+_HKDF_INFO = b"TMTPU_SECRET_CONNECTION_KEY_AND_CHALLENGE_GEN"
+
+# Ceiling on a single length-prefixed message. The length prefix comes from
+# the (authenticated but untrusted) remote peer; without a cap it could claim
+# 4 GiB and force unbounded buffering before MConnection's per-channel
+# recv_message_capacity is ever consulted.
+MAX_MSG_SIZE = 8 * 1024 * 1024
+
+
+class HandshakeError(Exception):
+    pass
+
+
+class _NonceCounter:
+    __slots__ = ("_n",)
+
+    def __init__(self) -> None:
+        self._n = 0
+
+    def next(self) -> bytes:
+        n = self._n
+        self._n += 1
+        if self._n >= 1 << 64:
+            raise OverflowError("nonce counter exhausted")
+        return b"\x00\x00\x00\x00" + struct.pack("<Q", n)
+
+
+class SecretConnection:
+    """Encrypted, peer-authenticated byte stream over an asyncio socket."""
+
+    def __init__(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        send_aead: ChaCha20Poly1305,
+        recv_aead: ChaCha20Poly1305,
+        remote_pubkey: ed25519.PubKeyEd25519,
+    ) -> None:
+        self._reader = reader
+        self._writer = writer
+        self._send_aead = send_aead
+        self._recv_aead = recv_aead
+        self._send_nonce = _NonceCounter()
+        self._recv_nonce = _NonceCounter()
+        self._recv_buf = bytearray()
+        self.remote_pubkey = remote_pubkey
+
+    @classmethod
+    async def make(
+        cls,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        priv_key: ed25519.PrivKeyEd25519,
+    ) -> "SecretConnection":
+        """Run the handshake as either dialer or acceptor (symmetric)."""
+        eph_priv = X25519PrivateKey.generate()
+        loc_eph_pub = eph_priv.public_key().public_bytes_raw()
+        writer.write(loc_eph_pub)
+        await writer.drain()
+        rem_eph_pub = await reader.readexactly(32)
+
+        try:
+            shared = eph_priv.exchange(X25519PublicKey.from_public_bytes(rem_eph_pub))
+        except ValueError as e:
+            raise HandshakeError(f"bad ephemeral key: {e}") from e
+
+        # Key schedule: the party with the lexicographically smaller ephemeral
+        # pubkey receives with key1/sends with key2; the other side mirrors
+        # (reference secret_connection.go:346-376).
+        okm = HKDF(
+            algorithm=hashes.SHA256(), length=96, salt=None, info=_HKDF_INFO
+        ).derive(shared)
+        key1, key2, challenge = okm[:32], okm[32:64], okm[64:]
+        if loc_eph_pub < rem_eph_pub:
+            recv_key, send_key = key1, key2
+        elif loc_eph_pub > rem_eph_pub:
+            recv_key, send_key = key2, key1
+        else:
+            raise HandshakeError("identical ephemeral keys (reflection attack)")
+
+        conn = cls(
+            reader,
+            writer,
+            ChaCha20Poly1305(send_key),
+            ChaCha20Poly1305(recv_key),
+            remote_pubkey=None,  # set below after authentication
+        )
+
+        # Authenticate over the encrypted channel: sign the shared challenge
+        # with the long-lived node key (reference :405,419).
+        sig = priv_key.sign(challenge)
+        w = Writer()
+        w.bytes(priv_key.pub_key().bytes())
+        w.bytes(sig)
+        await conn.write(w.build())
+        await conn.drain()
+
+        auth = await conn.read_msg()
+        r = Reader(auth)
+        rem_pub_raw = r.bytes()
+        rem_sig = r.bytes()
+        r.expect_done()
+        rem_pub = ed25519.PubKeyEd25519(rem_pub_raw)
+        if not rem_pub.verify(challenge, rem_sig):
+            raise HandshakeError("challenge signature verification failed")
+        conn.remote_pubkey = rem_pub
+        return conn
+
+    # --- encrypted byte stream -------------------------------------------
+
+    async def write(self, data: bytes) -> None:
+        """Send as a length-prefixed message (one or more sealed frames)."""
+        msg = struct.pack(">I", len(data)) + data
+        for off in range(0, len(msg), _FRAME_SIZE):
+            frame = msg[off : off + _FRAME_SIZE].ljust(_FRAME_SIZE, b"\x00")
+            sealed = self._send_aead.encrypt(self._send_nonce.next(), frame, None)
+            self._writer.write(sealed)
+
+    async def drain(self) -> None:
+        await self._writer.drain()
+
+    async def _read_frame(self) -> bytes:
+        sealed = await self._reader.readexactly(_SEALED_SIZE)
+        try:
+            return self._recv_aead.decrypt(self._recv_nonce.next(), sealed, None)
+        except InvalidTag as e:
+            raise HandshakeError("frame authentication failed") from e
+
+    async def read_msg(self) -> bytes:
+        """Receive one length-prefixed message."""
+        while len(self._recv_buf) < 4:
+            self._recv_buf += await self._read_frame()
+        (n,) = struct.unpack(">I", self._recv_buf[:4])
+        if n > MAX_MSG_SIZE:
+            raise HandshakeError(f"message length {n} exceeds cap {MAX_MSG_SIZE}")
+        while len(self._recv_buf) < 4 + n:
+            self._recv_buf += await self._read_frame()
+        msg = bytes(self._recv_buf[4 : 4 + n])
+        # Each message starts on a frame boundary; drop its frames, padding
+        # included, so the buffer stays frame-aligned.
+        frames = (4 + n + _FRAME_SIZE - 1) // _FRAME_SIZE
+        del self._recv_buf[: frames * _FRAME_SIZE]
+        return msg
+
+    def close(self) -> None:
+        try:
+            self._writer.close()
+        except Exception:
+            pass

@@ -1,0 +1,51 @@
+"""Network fault injection.
+
+Reference parity: p2p/fuzz.go:14 — FuzzedConnection probabilistically delays
+or drops reads/writes, used to shake out reactor assumptions about timing and
+delivery. Wraps any SecretConnection-shaped object (write/drain/read_msg/
+close).
+"""
+from __future__ import annotations
+
+import asyncio
+import random
+from dataclasses import dataclass
+
+
+@dataclass
+class FuzzConfig:
+    prob_drop_rw: float = 0.2  # chance a message write is silently dropped
+    prob_delay: float = 0.2  # chance an op is delayed
+    max_delay: float = 0.3  # seconds
+    seed: int | None = None
+
+
+class FuzzedConnection:
+    def __init__(self, conn, config: FuzzConfig | None = None) -> None:
+        self._conn = conn
+        self.config = config or FuzzConfig()
+        self._rng = random.Random(self.config.seed)
+
+    @property
+    def remote_pubkey(self):
+        return self._conn.remote_pubkey
+
+    async def _maybe_delay(self) -> None:
+        if self._rng.random() < self.config.prob_delay:
+            await asyncio.sleep(self._rng.random() * self.config.max_delay)
+
+    async def write(self, data: bytes) -> None:
+        await self._maybe_delay()
+        if self._rng.random() < self.config.prob_drop_rw:
+            return  # dropped on the floor
+        await self._conn.write(data)
+
+    async def drain(self) -> None:
+        await self._conn.drain()
+
+    async def read_msg(self) -> bytes:
+        await self._maybe_delay()
+        return await self._conn.read_msg()
+
+    def close(self) -> None:
+        self._conn.close()

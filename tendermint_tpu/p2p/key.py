@@ -1,0 +1,53 @@
+"""Node identity key.
+
+Reference parity: p2p/key.go — a node's ID is the hex of the address of its
+ed25519 public key (address = first 20 bytes of SHA256(pubkey), same rule as
+validator addresses). The key persists as a JSON file.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+from tendermint_tpu.crypto import PubKey
+from tendermint_tpu.crypto import ed25519
+
+
+def node_id_from_pubkey(pub: PubKey) -> str:
+    return pub.address().hex()
+
+
+class NodeKey:
+    """Persistent ed25519 identity for the p2p layer."""
+
+    def __init__(self, priv_key: ed25519.PrivKeyEd25519) -> None:
+        self.priv_key = priv_key
+
+    @property
+    def pub_key(self) -> ed25519.PubKeyEd25519:
+        return self.priv_key.pub_key()
+
+    def id(self) -> str:
+        return node_id_from_pubkey(self.pub_key)
+
+    def save_as(self, path: str) -> None:
+        doc = {"priv_key": {"type": "ed25519", "value": self.priv_key.bytes().hex()}}
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+
+    @classmethod
+    def load(cls, path: str) -> "NodeKey":
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        raw = bytes.fromhex(doc["priv_key"]["value"])
+        return cls(ed25519.PrivKeyEd25519(raw))
+
+    @classmethod
+    def load_or_gen(cls, path: str) -> "NodeKey":
+        if os.path.exists(path):
+            return cls.load(path)
+        nk = cls(ed25519.gen_priv_key())
+        nk.save_as(path)
+        return nk

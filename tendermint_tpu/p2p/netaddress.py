@@ -1,0 +1,49 @@
+"""Network addresses: `id@host:port`.
+
+Reference parity: p2p/netaddress.go — addresses carry the expected node ID so
+dialing can authenticate the remote identity after the SecretConnection
+handshake.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+class AddressError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class NetAddress:
+    id: str  # hex node ID ("" if unknown)
+    host: str
+    port: int
+
+    def __str__(self) -> str:
+        hp = f"{self.host}:{self.port}"
+        return f"{self.id}@{hp}" if self.id else hp
+
+    def dial_string(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    @classmethod
+    def parse(cls, s: str) -> "NetAddress":
+        node_id = ""
+        rest = s
+        if "@" in s:
+            node_id, rest = s.split("@", 1)
+            node_id = node_id.lower()
+            if len(node_id) != 40 or any(c not in "0123456789abcdef" for c in node_id):
+                raise AddressError(f"bad node id in address {s!r}")
+        if ":" not in rest:
+            raise AddressError(f"missing port in address {s!r}")
+        host, port_s = rest.rsplit(":", 1)
+        try:
+            port = int(port_s)
+        except ValueError as e:
+            raise AddressError(f"bad port in address {s!r}") from e
+        if not (0 <= port <= 65535):
+            raise AddressError(f"port out of range in address {s!r}")
+        if not host:
+            raise AddressError(f"missing host in address {s!r}")
+        return cls(node_id, host, port)

@@ -1,0 +1,96 @@
+"""NodeInfo: identity + capability advertisement exchanged at handshake.
+
+Reference parity: p2p/node_info.go — DefaultNodeInfo{ProtocolVersion, ID,
+ListenAddr, Network, Version, Channels, Moniker, Other{TxIndex, RPCAddress}}
+with CompatibleWith (same network, shared protocol block version, at least one
+common channel) and Validate rules.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tendermint_tpu.encoding import Reader, Writer
+
+MAX_NUM_CHANNELS = 16
+MAX_MONIKER_LEN = 64
+
+
+class NodeInfoError(Exception):
+    pass
+
+
+@dataclass
+class ProtocolVersion:
+    p2p: int = 1
+    block: int = 1
+    app: int = 0
+
+
+@dataclass
+class NodeInfo:
+    node_id: str
+    listen_addr: str
+    network: str  # chain ID
+    version: str
+    channels: bytes  # one byte per advertised channel ID
+    moniker: str = ""
+    protocol_version: ProtocolVersion = field(default_factory=ProtocolVersion)
+    tx_index: str = "on"
+    rpc_address: str = ""
+
+    def validate(self) -> None:
+        if len(self.node_id) != 40:
+            raise NodeInfoError(f"invalid node ID {self.node_id!r}")
+        if len(self.channels) > MAX_NUM_CHANNELS:
+            raise NodeInfoError(f"too many channels ({len(self.channels)})")
+        if len(set(self.channels)) != len(self.channels):
+            raise NodeInfoError("duplicate channel IDs")
+        if len(self.moniker) > MAX_MONIKER_LEN:
+            raise NodeInfoError("moniker too long")
+
+    def compatible_with(self, other: "NodeInfo") -> None:
+        """Raise NodeInfoError unless the peers can talk (reference
+        p2p/node_info.go CompatibleWith)."""
+        if self.protocol_version.block != other.protocol_version.block:
+            raise NodeInfoError(
+                f"block protocol mismatch: {self.protocol_version.block} vs "
+                f"{other.protocol_version.block}"
+            )
+        if self.network != other.network:
+            raise NodeInfoError(f"network mismatch: {self.network} vs {other.network}")
+        if self.channels and other.channels:
+            if not set(self.channels) & set(other.channels):
+                raise NodeInfoError("no common channels")
+
+    def encode(self) -> bytes:
+        w = Writer()
+        w.u64(self.protocol_version.p2p)
+        w.u64(self.protocol_version.block)
+        w.u64(self.protocol_version.app)
+        w.str(self.node_id)
+        w.str(self.listen_addr)
+        w.str(self.network)
+        w.str(self.version)
+        w.bytes(self.channels)
+        w.str(self.moniker)
+        w.str(self.tx_index)
+        w.str(self.rpc_address)
+        return w.build()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "NodeInfo":
+        r = Reader(data)
+        pv = ProtocolVersion(r.u64(), r.u64(), r.u64())
+        ni = cls(
+            node_id=r.str(),
+            listen_addr=r.str(),
+            network=r.str(),
+            version=r.str(),
+            channels=r.bytes(),
+            moniker=r.str(),
+            protocol_version=pv,
+            tx_index=r.str(),
+            rpc_address=r.str(),
+        )
+        r.expect_done()
+        return ni

@@ -1,0 +1,66 @@
+"""Peer: one connected remote node.
+
+Reference parity: p2p/peer.go — wraps the MConnection, carries the remote
+NodeInfo, a key-value store for per-peer reactor state (Set/Get), and
+send/try_send routed by channel ID.
+"""
+from __future__ import annotations
+
+from tendermint_tpu.libs.service import BaseService
+from tendermint_tpu.p2p.conn.connection import MConnection, MConnConfig
+from tendermint_tpu.p2p.node_info import NodeInfo
+
+
+class Peer(BaseService):
+    def __init__(
+        self,
+        conn,  # SecretConnection (already handshaked)
+        node_info: NodeInfo,
+        chan_descs,
+        on_receive,  # async (ch_id, peer, msg) -> None
+        on_error,  # async (peer, exc) -> None
+        outbound: bool,
+        persistent: bool = False,
+        mconfig: MConnConfig | None = None,
+        socket_addr=None,
+    ) -> None:
+        super().__init__(name=f"Peer:{node_info.node_id[:8]}")
+        self.node_info = node_info
+        self.outbound = outbound
+        self.persistent = persistent
+        self.socket_addr = socket_addr  # NetAddress dialed/accepted from
+        self._data: dict[str, object] = {}
+
+        async def _recv(ch_id: int, msg: bytes) -> None:
+            await on_receive(ch_id, self, msg)
+
+        async def _err(e: Exception) -> None:
+            await on_error(self, e)
+
+        self.mconn = MConnection(conn, chan_descs, _recv, _err, mconfig)
+
+    @property
+    def id(self) -> str:
+        return self.node_info.node_id
+
+    async def on_start(self) -> None:
+        await self.mconn.start()
+
+    async def on_stop(self) -> None:
+        await self.mconn.stop()
+
+    async def send(self, ch_id: int, msg: bytes) -> bool:
+        return await self.mconn.send(ch_id, msg)
+
+    def try_send(self, ch_id: int, msg: bytes) -> bool:
+        return self.mconn.try_send(ch_id, msg)
+
+    def set(self, key: str, value) -> None:
+        self._data[key] = value
+
+    def get(self, key: str):
+        return self._data.get(key)
+
+    def __repr__(self) -> str:
+        d = "out" if self.outbound else "in"
+        return f"Peer{{{self.id[:12]} {d}}}"

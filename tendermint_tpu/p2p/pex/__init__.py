@@ -1,0 +1,7 @@
+"""Peer exchange (PEX) + address book."""
+from __future__ import annotations
+
+from tendermint_tpu.p2p.pex.addrbook import AddrBook
+from tendermint_tpu.p2p.pex.pex_reactor import PexReactor, PEX_CHANNEL
+
+__all__ = ["AddrBook", "PexReactor", "PEX_CHANNEL"]

@@ -1,0 +1,157 @@
+"""Address book: known peer addresses with quality tracking.
+
+Reference parity: p2p/pex/addrbook.go — file-backed book of peer addresses
+split into "new" (heard about) and "old" (vetted: we connected at least once)
+buckets, with attempt counting, bias-toward-vetted random picking for dialing,
+and random selections for PEX responses. The reference's 256/64 hashed bucket
+scheme exists to bound memory and resist address-flooding; here the same
+goals are met with two flat dicts capped in size (the eviction policy —
+drop the unvetted address with the most failed dial attempts — matches the
+reference's spirit without the per-bucket bookkeeping).
+"""
+from __future__ import annotations
+
+import json
+import os
+import random
+import time
+from dataclasses import dataclass, field
+
+from tendermint_tpu.p2p.netaddress import NetAddress
+
+MAX_NEW_ADDRS = 1024
+MAX_OLD_ADDRS = 512
+GET_SELECTION_MAX = 32
+
+
+@dataclass
+class _KnownAddress:
+    addr: NetAddress
+    src_id: str = ""
+    attempts: int = 0
+    last_attempt: float = 0.0
+    last_success: float = 0.0
+    is_old: bool = False  # vetted: connected successfully at least once
+
+    def to_json(self) -> dict:
+        return {
+            "addr": str(self.addr),
+            "src_id": self.src_id,
+            "attempts": self.attempts,
+            "last_attempt": self.last_attempt,
+            "last_success": self.last_success,
+            "is_old": self.is_old,
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "_KnownAddress":
+        return cls(
+            addr=NetAddress.parse(d["addr"]),
+            src_id=d.get("src_id", ""),
+            attempts=d.get("attempts", 0),
+            last_attempt=d.get("last_attempt", 0.0),
+            last_success=d.get("last_success", 0.0),
+            is_old=d.get("is_old", False),
+        )
+
+
+class AddrBook:
+    def __init__(self, file_path: str | None = None, our_ids: set[str] | None = None):
+        self._addrs: dict[str, _KnownAddress] = {}  # node_id -> entry
+        self.file_path = file_path
+        self.our_ids = our_ids or set()
+        if file_path and os.path.exists(file_path):
+            self.load(file_path)
+
+    def __len__(self) -> int:
+        return len(self._addrs)
+
+    def add_address(self, addr: NetAddress, src_id: str = "") -> bool:
+        """Record a heard-about address; returns True if newly added."""
+        if not addr.id or addr.id in self.our_ids or addr.port == 0:
+            return False
+        known = self._addrs.get(addr.id)
+        if known is not None:
+            if not known.is_old:
+                known.addr = addr  # refresh endpoint for unvetted entries
+            return False
+        self._evict_if_full()
+        self._addrs[addr.id] = _KnownAddress(addr=addr, src_id=src_id)
+        return True
+
+    def _evict_if_full(self) -> None:
+        new = [k for k in self._addrs.values() if not k.is_old]
+        if len(new) >= MAX_NEW_ADDRS:
+            victim = max(new, key=lambda k: k.attempts)
+            del self._addrs[victim.addr.id]
+
+    def remove_address(self, addr: NetAddress) -> None:
+        self._addrs.pop(addr.id, None)
+
+    def mark_attempt(self, addr: NetAddress) -> None:
+        k = self._addrs.get(addr.id)
+        if k is not None:
+            k.attempts += 1
+            k.last_attempt = time.time()
+
+    def mark_good(self, addr: NetAddress) -> None:
+        """Successful connection: promote to the vetted ("old") set."""
+        k = self._addrs.get(addr.id)
+        if k is None:
+            if not addr.id or addr.id in self.our_ids or addr.port == 0:
+                return
+            k = _KnownAddress(addr=addr)
+            self._addrs[addr.id] = k
+        k.attempts = 0
+        k.last_success = time.time()
+        k.is_old = True
+        old = [a for a in self._addrs.values() if a.is_old]
+        if len(old) > MAX_OLD_ADDRS:
+            victim = min(old, key=lambda a: a.last_success)
+            del self._addrs[victim.addr.id]
+
+    def mark_bad(self, addr: NetAddress) -> None:
+        self.remove_address(addr)
+
+    def pick_address(self, new_bias_pct: int = 30, exclude: set[str] | None = None
+                     ) -> NetAddress | None:
+        """Random address to dial; biased toward vetted addresses
+        (reference addrbook.go PickAddress: bias is % chance of a new addr)."""
+        exclude = exclude or set()
+        cands = [k for k in self._addrs.values() if k.addr.id not in exclude]
+        if not cands:
+            return None
+        new = [k for k in cands if not k.is_old]
+        old = [k for k in cands if k.is_old]
+        pool = new if (not old or (new and random.random() * 100 < new_bias_pct)) else old
+        return random.choice(pool).addr if pool else None
+
+    def get_selection(self, max_n: int = GET_SELECTION_MAX) -> list[NetAddress]:
+        """Random subset for a PEX response."""
+        addrs = [k.addr for k in self._addrs.values()]
+        random.shuffle(addrs)
+        return addrs[:max_n]
+
+    def is_good(self, addr: NetAddress) -> bool:
+        k = self._addrs.get(addr.id)
+        return bool(k and k.is_old)
+
+    # --- persistence -----------------------------------------------------
+
+    def save(self, path: str | None = None) -> None:
+        path = path or self.file_path
+        if not path:
+            return
+        doc = {"addrs": [k.to_json() for k in self._addrs.values()]}
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+
+    def load(self, path: str) -> None:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        for d in doc.get("addrs", []):
+            k = _KnownAddress.from_json(d)
+            if k.addr.id not in self.our_ids:
+                self._addrs[k.addr.id] = k

@@ -1,0 +1,149 @@
+"""PEX reactor: peer address gossip + outbound connection maintenance.
+
+Reference parity: p2p/pex/pex_reactor.go — channel 0x00; inbound peers may
+send one address request per interval (rate limited); `ensure_peers` routine
+dials from the address book (biased toward vetted addresses) while below the
+outbound target; seed mode answers requests then disconnects.
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+
+from tendermint_tpu.encoding import DecodeError, Reader, Writer
+from tendermint_tpu.p2p.base_reactor import BaseReactor, ChannelDescriptor
+from tendermint_tpu.p2p.netaddress import AddressError, NetAddress
+from tendermint_tpu.p2p.pex.addrbook import AddrBook
+
+PEX_CHANNEL = 0x00
+
+_MSG_REQUEST = 0
+_MSG_ADDRS = 1
+
+ENSURE_PEERS_INTERVAL = 30.0
+MIN_REQUEST_INTERVAL = 60.0  # per-peer inbound request rate limit
+MAX_ADDRS_PER_MSG = 100
+
+
+def encode_request() -> bytes:
+    return Writer().u8(_MSG_REQUEST).build()
+
+
+def encode_addrs(addrs: list[NetAddress]) -> bytes:
+    w = Writer().u8(_MSG_ADDRS).u32(len(addrs))
+    for a in addrs:
+        w.str(str(a))
+    return w.build()
+
+
+def decode_pex_message(data: bytes):
+    r = Reader(data)
+    tag = r.u8()
+    if tag == _MSG_REQUEST:
+        r.expect_done()
+        return ("request", None)
+    if tag == _MSG_ADDRS:
+        n = r.u32()
+        if n > MAX_ADDRS_PER_MSG:
+            raise DecodeError(f"too many addrs ({n})")
+        addrs = [NetAddress.parse(r.str()) for _ in range(n)]
+        r.expect_done()
+        return ("addrs", addrs)
+    raise DecodeError(f"unknown pex message tag {tag}")
+
+
+class PexReactor(BaseReactor):
+    def __init__(
+        self,
+        book: AddrBook,
+        seed_mode: bool = False,
+        ensure_interval: float = ENSURE_PEERS_INTERVAL,
+    ) -> None:
+        super().__init__(name="PEX")
+        self.book = book
+        self.seed_mode = seed_mode
+        self.ensure_interval = ensure_interval
+        self._last_request_from: dict[str, float] = {}
+        self._requested_of: set[str] = set()
+
+    def get_channels(self):
+        return [ChannelDescriptor(id=PEX_CHANNEL, priority=1,
+                                  recv_message_capacity=64 * 1024)]
+
+    async def on_start(self) -> None:
+        self.spawn(self._ensure_peers_routine(), "pex-ensure")
+
+    async def on_stop(self) -> None:
+        self.book.save()
+
+    async def add_peer(self, peer) -> None:
+        if peer.socket_addr is not None and peer.outbound:
+            self.book.mark_good(peer.socket_addr)
+        if peer.outbound:
+            # inbound peers could lie about being short on addresses; only
+            # ask peers we chose to dial (reference pex_reactor.go AddPeer)
+            await self._request_addrs(peer)
+        elif peer.socket_addr is not None and peer.socket_addr.id:
+            self.book.add_address(peer.socket_addr, src_id=peer.id)
+
+    async def remove_peer(self, peer, reason) -> None:
+        self._last_request_from.pop(peer.id, None)
+        self._requested_of.discard(peer.id)
+
+    async def _request_addrs(self, peer) -> None:
+        if peer.id in self._requested_of:
+            return
+        self._requested_of.add(peer.id)
+        await peer.send(PEX_CHANNEL, encode_request())
+
+    async def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
+        try:
+            kind, payload = decode_pex_message(msg_bytes)
+        except (DecodeError, AddressError) as e:
+            await self.switch.stop_peer_for_error(peer, f"bad pex msg: {e}")
+            return
+        if kind == "request":
+            now = time.monotonic()
+            last = self._last_request_from.get(peer.id)
+            if last is not None and now - last < MIN_REQUEST_INTERVAL:
+                await self.switch.stop_peer_for_error(
+                    peer, "pex request rate exceeded"
+                )
+                return
+            self._last_request_from[peer.id] = now
+            await peer.send(PEX_CHANNEL, encode_addrs(self.book.get_selection()))
+            if self.seed_mode:
+                await self.switch.stop_peer_gracefully(peer)
+        else:  # addrs
+            if peer.id not in self._requested_of:
+                await self.switch.stop_peer_for_error(peer, "unsolicited pex addrs")
+                return
+            self._requested_of.discard(peer.id)
+            for addr in payload:
+                self.book.add_address(addr, src_id=peer.id)
+
+    async def _ensure_peers_routine(self) -> None:
+        while True:
+            try:
+                await self._ensure_peers()
+            except Exception as e:  # keep the maintenance loop alive
+                self.logger.debug("ensure_peers: %s", e)
+            await asyncio.sleep(self.ensure_interval)
+
+    async def _ensure_peers(self) -> None:
+        out, _ = self.switch.num_peers()
+        need = self.switch.max_outbound_peers - out
+        if need <= 0:
+            return
+        connected = {p.id for p in self.switch.peers.list()} | {self.switch.node_id()}
+        to_dial = []
+        for _ in range(need * 2):
+            addr = self.book.pick_address(exclude=connected)
+            if addr is None:
+                break
+            connected.add(addr.id)
+            to_dial.append(addr)
+            if len(to_dial) >= need:
+                break
+        if to_dial:
+            await self.switch.dial_peers_async(to_dial)

@@ -1,0 +1,258 @@
+"""Switch: peer lifecycle + reactor message routing.
+
+Reference parity: p2p/switch.go:67 — owns the transport, the peer set, and
+all reactors. `add_reactor` claims channel IDs (switch.go:154); `broadcast`
+fans out to every peer (switch.go:258); dial/accept routines add peers with
+retry + exponential backoff for persistent peers (switch.go:362,572).
+"""
+from __future__ import annotations
+
+import asyncio
+import random
+
+from tendermint_tpu.libs.service import BaseService
+from tendermint_tpu.p2p.netaddress import NetAddress
+from tendermint_tpu.p2p.peer import Peer
+from tendermint_tpu.p2p.transport import RejectedError, Transport
+
+RECONNECT_BASE_DELAY = 1.0
+RECONNECT_MAX_DELAY = 300.0
+MAX_RECONNECT_ATTEMPTS = 20
+
+
+class SwitchError(Exception):
+    pass
+
+
+class PeerSet:
+    def __init__(self) -> None:
+        self._by_id: dict[str, Peer] = {}
+
+    def add(self, peer: Peer) -> None:
+        if peer.id in self._by_id:
+            raise SwitchError(f"duplicate peer {peer.id}")
+        self._by_id[peer.id] = peer
+
+    def remove(self, peer: Peer) -> bool:
+        return self._by_id.pop(peer.id, None) is not None
+
+    def has(self, peer_id: str) -> bool:
+        return peer_id in self._by_id
+
+    def get(self, peer_id: str) -> Peer | None:
+        return self._by_id.get(peer_id)
+
+    def list(self) -> list[Peer]:
+        return list(self._by_id.values())
+
+    def __len__(self) -> int:
+        return len(self._by_id)
+
+
+class Switch(BaseService):
+    def __init__(
+        self,
+        transport: Transport,
+        max_inbound_peers: int = 40,
+        max_outbound_peers: int = 10,
+    ) -> None:
+        super().__init__(name="Switch")
+        self.transport = transport
+        self.peers = PeerSet()
+        self.reactors: dict[str, object] = {}
+        self._chan_descs: list = []
+        self._reactors_by_ch: dict[int, object] = {}
+        self.max_inbound_peers = max_inbound_peers
+        self.max_outbound_peers = max_outbound_peers
+        self._dialing: set[str] = set()
+        self._reconnecting: set[str] = set()
+        self._persistent_addrs: dict[str, NetAddress] = {}
+        self.addr_book = None  # optional, set by PEX wiring
+
+    def node_id(self) -> str:
+        return self.transport.node_key.id()
+
+    # --- reactors --------------------------------------------------------
+
+    def add_reactor(self, name: str, reactor) -> None:
+        for d in reactor.get_channels():
+            if d.id in self._reactors_by_ch:
+                raise SwitchError(f"channel {d.id:#x} already claimed")
+            self._reactors_by_ch[d.id] = reactor
+            self._chan_descs.append(d)
+        self.reactors[name] = reactor
+        reactor.set_switch(self)
+
+    def reactor(self, name: str):
+        return self.reactors.get(name)
+
+    # --- lifecycle -------------------------------------------------------
+
+    async def on_start(self) -> None:
+        for reactor in self.reactors.values():
+            await reactor.start()
+        self.spawn(self._accept_routine(), "switch-accept")
+
+    async def on_stop(self) -> None:
+        for peer in self.peers.list():
+            await self._stop_and_remove(peer, "switch stopping")
+        for reactor in self.reactors.values():
+            await reactor.stop()
+        await self.transport.stop()
+
+    async def _accept_routine(self) -> None:
+        while True:
+            conn, ni, addr = await self.transport.accept()
+            inbound = sum(1 for p in self.peers.list() if not p.outbound)
+            if inbound >= self.max_inbound_peers:
+                self.logger.debug("rejecting inbound %s: at capacity", ni.node_id)
+                conn.close()
+                continue
+            try:
+                await self._add_peer(conn, ni, outbound=False, socket_addr=addr)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                # any failure (reactor add_peer bug included) must not kill
+                # the accept loop — the node would stop taking inbound peers
+                self.logger.debug("inbound peer rejected: %s", e)
+                conn.close()
+
+    # --- dialing ---------------------------------------------------------
+
+    async def dial_peers_async(
+        self, addrs: list[NetAddress], persistent: bool = False
+    ) -> None:
+        for addr in addrs:
+            if persistent and addr.id:
+                self._persistent_addrs[addr.id] = addr
+            self.spawn(self._dial_one(addr, persistent), f"dial-{addr.id[:8]}")
+
+    async def _dial_one(self, addr: NetAddress, persistent: bool) -> None:
+        ok = await self._dial_attempt(addr, persistent)
+        if not ok and persistent:
+            self._schedule_reconnect(addr)
+
+    async def _dial_attempt(self, addr: NetAddress, persistent: bool) -> bool:
+        """One dial + add-peer attempt with addr-book bookkeeping; returns
+        True on success (or if already connected/dialing)."""
+        key = addr.id or addr.dial_string()
+        if key in self._dialing or (addr.id and self.peers.has(addr.id)):
+            return True
+        self._dialing.add(key)
+        try:
+            # jitter so a restarted network doesn't dial in lockstep
+            await asyncio.sleep(random.random() * 0.05)
+            conn, ni = await self.transport.dial(addr)
+            await self._add_peer(
+                conn, ni, outbound=True, persistent=persistent, socket_addr=addr
+            )
+            if self.addr_book is not None:
+                self.addr_book.mark_good(addr)
+            return True
+        except (OSError, RejectedError, SwitchError, asyncio.TimeoutError) as e:
+            self.logger.debug("dial %s failed: %s", addr, e)
+            if self.addr_book is not None:
+                self.addr_book.mark_attempt(addr)
+            return False
+        finally:
+            self._dialing.discard(key)
+
+    def _schedule_reconnect(self, addr: NetAddress) -> None:
+        if addr.id in self._reconnecting or not self.is_running:
+            return
+        self._reconnecting.add(addr.id)
+        self.spawn(self._reconnect_routine(addr), f"reconnect-{addr.id[:8]}")
+
+    async def _reconnect_routine(self, addr: NetAddress) -> None:
+        """Exponential backoff redial for persistent peers
+        (reference switch.go:362 reconnectToPeer)."""
+        try:
+            delay = RECONNECT_BASE_DELAY
+            for _ in range(MAX_RECONNECT_ATTEMPTS):
+                await asyncio.sleep(delay * (1 + random.random() * 0.1))
+                if not self.is_running or self.peers.has(addr.id):
+                    return
+                if await self._dial_attempt(addr, persistent=True):
+                    return
+                delay = min(delay * 2, RECONNECT_MAX_DELAY)
+            self.logger.info("gave up reconnecting to %s", addr)
+        finally:
+            self._reconnecting.discard(addr.id)
+
+    # --- peer management -------------------------------------------------
+
+    async def _add_peer(
+        self, conn, ni, outbound: bool, persistent: bool = False, socket_addr=None
+    ) -> Peer:
+        if ni.node_id == self.node_id():
+            raise SwitchError("self connection")
+        if self.peers.has(ni.node_id):
+            raise SwitchError(f"already connected to {ni.node_id}")
+        persistent = persistent or ni.node_id in self._persistent_addrs
+        peer = Peer(
+            conn,
+            ni,
+            self._chan_descs,
+            on_receive=self._route_receive,
+            on_error=self._on_peer_error,
+            outbound=outbound,
+            persistent=persistent,
+            socket_addr=socket_addr,
+        )
+        for reactor in self.reactors.values():
+            reactor.init_peer(peer)
+        self.peers.add(peer)
+        try:
+            await peer.start()
+            for reactor in self.reactors.values():
+                await reactor.add_peer(peer)
+        except Exception:
+            self.peers.remove(peer)
+            await peer.stop()
+            raise
+        self.logger.info("added peer %s (%s)", peer, "out" if outbound else "in")
+        return peer
+
+    async def _route_receive(self, ch_id: int, peer: Peer, msg: bytes) -> None:
+        reactor = self._reactors_by_ch.get(ch_id)
+        if reactor is None:
+            await self.stop_peer_for_error(peer, f"msg on unclaimed channel {ch_id:#x}")
+            return
+        await reactor.receive(ch_id, peer, msg)
+
+    async def _on_peer_error(self, peer: Peer, e: Exception) -> None:
+        await self.stop_peer_for_error(peer, e)
+
+    async def stop_peer_for_error(self, peer: Peer, reason) -> None:
+        if not self.peers.has(peer.id):
+            return
+        self.logger.info("stopping peer %s: %s", peer, reason)
+        await self._stop_and_remove(peer, reason)
+        if peer.persistent and self.is_running:
+            addr = self._persistent_addrs.get(peer.id) or peer.socket_addr
+            if addr is not None and addr.id:
+                self._schedule_reconnect(addr)
+
+    async def stop_peer_gracefully(self, peer: Peer) -> None:
+        await self._stop_and_remove(peer, "graceful stop")
+
+    async def _stop_and_remove(self, peer: Peer, reason) -> None:
+        self.peers.remove(peer)
+        await peer.stop()
+        for reactor in self.reactors.values():
+            await reactor.remove_peer(peer, reason)
+
+    # --- messaging -------------------------------------------------------
+
+    async def broadcast(self, ch_id: int, msg: bytes) -> None:
+        """Fan out to all peers (reference switch.go:258); failures are the
+        peer's problem, not the broadcaster's."""
+        await asyncio.gather(
+            *(p.send(ch_id, msg) for p in self.peers.list()),
+            return_exceptions=True,
+        )
+
+    def num_peers(self) -> tuple[int, int]:
+        out = sum(1 for p in self.peers.list() if p.outbound)
+        return out, len(self.peers) - out

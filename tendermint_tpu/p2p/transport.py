@@ -1,0 +1,140 @@
+"""Transport: TCP listen/dial → SecretConnection upgrade → NodeInfo handshake.
+
+Reference parity: p2p/transport.go:125 (MultiplexTransport) — accept and dial
+produce authenticated, version-checked connections; filters reject duplicate
+or unwanted peers before the Switch sees them (transport.go:82).
+"""
+from __future__ import annotations
+
+import asyncio
+
+from tendermint_tpu.libs.service import BaseService
+from tendermint_tpu.p2p.conn.secret_connection import HandshakeError, SecretConnection
+from tendermint_tpu.p2p.key import NodeKey, node_id_from_pubkey
+from tendermint_tpu.p2p.netaddress import NetAddress
+from tendermint_tpu.p2p.node_info import NodeInfo, NodeInfoError
+
+HANDSHAKE_TIMEOUT = 20.0
+
+
+class TransportError(Exception):
+    pass
+
+
+class RejectedError(TransportError):
+    """Peer failed authentication/compatibility/filter checks."""
+
+
+class Transport(BaseService):
+    """Owns the listener; produces (SecretConnection, NodeInfo, NetAddress)
+    triples through an accept queue."""
+
+    def __init__(
+        self,
+        node_key: NodeKey,
+        node_info: NodeInfo,
+        conn_filters=None,  # [async (host) -> None or raise RejectedError]
+        handshake_timeout: float = HANDSHAKE_TIMEOUT,
+    ) -> None:
+        super().__init__(name="Transport")
+        self.node_key = node_key
+        self.node_info = node_info
+        self.conn_filters = conn_filters or []
+        self.handshake_timeout = handshake_timeout
+        self._server: asyncio.base_events.Server | None = None
+        self._accepted: asyncio.Queue = asyncio.Queue(32)
+        self.listen_addr: NetAddress | None = None
+
+    async def listen(self, addr: NetAddress) -> None:
+        if not self._started:
+            await self.start()  # ensure stop() reaches on_stop and closes us
+        self._server = await asyncio.start_server(
+            self._handle_inbound, addr.host, addr.port
+        )
+        sock = self._server.sockets[0]
+        host, port = sock.getsockname()[:2]
+        self.listen_addr = NetAddress(self.node_key.id(), host, port)
+        # Advertise the actual bound port (addr.port may have been 0).
+        self.node_info.listen_addr = f"{host}:{port}"
+        self.logger.info("listening on %s", self.listen_addr)
+
+    async def _handle_inbound(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        peerhost = writer.get_extra_info("peername")
+        try:
+            for f in self.conn_filters:
+                await f(peerhost[0] if peerhost else "")
+            conn, ni = await asyncio.wait_for(
+                self._upgrade(reader, writer, expected_id=""),
+                self.handshake_timeout,
+            )
+        except Exception as e:
+            self.logger.debug("inbound rejected from %s: %s", peerhost, e)
+            writer.close()
+            return
+        # Dialable address for the peer: its socket IP + its self-advertised
+        # listen port (the ephemeral source port is useless for dialing;
+        # reference p2p uses NodeInfo.ListenAddr the same way). Port 0 means
+        # "not dialable" and is rejected by the addr book.
+        port = 0
+        try:
+            port = NetAddress.parse(f"{ni.node_id}@{ni.listen_addr}").port
+        except Exception:
+            pass
+        addr = NetAddress(ni.node_id, peerhost[0] if peerhost else "", port)
+        await self._accepted.put((conn, ni, addr))
+
+    async def accept(self):
+        """Next authenticated inbound connection: (conn, node_info, addr)."""
+        return await self._accepted.get()
+
+    async def dial(self, addr: NetAddress):
+        """Dial, upgrade, handshake; returns (conn, node_info)."""
+        reader, writer = await asyncio.open_connection(addr.host, addr.port)
+        try:
+            return await asyncio.wait_for(
+                self._upgrade(reader, writer, expected_id=addr.id),
+                self.handshake_timeout,
+            )
+        except Exception:
+            writer.close()
+            raise
+
+    async def _upgrade(self, reader, writer, expected_id: str):
+        try:
+            conn = await SecretConnection.make(reader, writer, self.node_key.priv_key)
+        except (HandshakeError, asyncio.IncompleteReadError, OSError) as e:
+            raise RejectedError(f"secret handshake failed: {e}") from e
+
+        remote_id = node_id_from_pubkey(conn.remote_pubkey)
+        if expected_id and remote_id != expected_id:
+            raise RejectedError(
+                f"dialed {expected_id} but authenticated {remote_id}"
+            )
+        if remote_id == self.node_key.id():
+            raise RejectedError("connected to self")
+
+        # NodeInfo exchange over the encrypted channel.
+        await conn.write(self.node_info.encode())
+        await conn.drain()
+        try:
+            ni = NodeInfo.decode(await conn.read_msg())
+            ni.validate()
+            self.node_info.compatible_with(ni)
+        except NodeInfoError as e:
+            raise RejectedError(f"incompatible peer: {e}") from e
+        if ni.node_id != remote_id:
+            raise RejectedError(
+                f"node info ID {ni.node_id} != authenticated {remote_id}"
+            )
+        return conn, ni
+
+    async def on_stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        # close any accepted-but-undrained connections
+        while not self._accepted.empty():
+            conn, _, _ = self._accepted.get_nowait()
+            conn.close()

@@ -21,6 +21,9 @@ import jax  # noqa: E402
 # config update is the authoritative override.
 jax.config.update("jax_platforms", "cpu")
 
+import asyncio  # noqa: E402
+import inspect  # noqa: E402
+
 import pytest  # noqa: E402
 
 
@@ -29,3 +32,17 @@ def cpu_mesh_devices():
     import jax
 
     return jax.devices()
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    """Run `async def` tests under asyncio.run (pytest-asyncio isn't in the
+    image; this is the minimal equivalent)."""
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(fn(**kwargs))
+        return True
+    return None

@@ -1,0 +1,238 @@
+"""p2p layer tests: secret connection, mconnection, transport, switch.
+
+Mirrors the reference's p2p/conn/connection_test.go (socket pairs),
+p2p/switch_test.go, and test_util.go harness patterns.
+"""
+from __future__ import annotations
+
+import asyncio
+import contextlib
+
+import pytest
+
+from tendermint_tpu.crypto import ed25519
+from tendermint_tpu.p2p.base_reactor import BaseReactor, ChannelDescriptor
+from tendermint_tpu.p2p.conn.secret_connection import SecretConnection
+from tendermint_tpu.p2p.key import NodeKey
+from tendermint_tpu.p2p.netaddress import AddressError, NetAddress
+from tendermint_tpu.p2p.node_info import NodeInfo, NodeInfoError
+from tendermint_tpu.p2p.test_util import (
+    make_connected_switches,
+    make_switch,
+    stop_switches,
+)
+
+
+@contextlib.asynccontextmanager
+async def tcp_pair():
+    """Two connected (reader, writer) stream pairs over loopback."""
+    accepted: asyncio.Queue = asyncio.Queue()
+
+    async def on_conn(r, w):
+        await accepted.put((r, w))
+
+    server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+    cr, cw = await asyncio.open_connection("127.0.0.1", port)
+    sr, sw = await accepted.get()
+    try:
+        yield (cr, cw), (sr, sw)
+    finally:
+        cw.close()
+        sw.close()
+        server.close()
+        await server.wait_closed()
+
+
+class TestNetAddress:
+    def test_parse_roundtrip(self):
+        a = NetAddress.parse("aa" * 20 + "@10.0.0.1:26656")
+        assert a.id == "aa" * 20
+        assert a.host == "10.0.0.1"
+        assert a.port == 26656
+        assert NetAddress.parse(str(a)) == a
+
+    def test_parse_no_id(self):
+        a = NetAddress.parse("localhost:80")
+        assert a.id == "" and a.host == "localhost" and a.port == 80
+
+    @pytest.mark.parametrize(
+        "bad", ["noport", "zz@1.2.3.4:80", "1.2.3.4:notaport", ":80", "h:99999"]
+    )
+    def test_parse_bad(self, bad):
+        with pytest.raises(AddressError):
+            NetAddress.parse(bad)
+
+
+class TestNodeInfo:
+    def _ni(self, **kw):
+        d = dict(
+            node_id="ab" * 20,
+            listen_addr="127.0.0.1:26656",
+            network="chain-1",
+            version="dev",
+            channels=bytes([0x20, 0x21]),
+        )
+        d.update(kw)
+        return NodeInfo(**d)
+
+    def test_encode_decode(self):
+        ni = self._ni(moniker="m1", rpc_address="tcp://0.0.0.0:26657")
+        assert NodeInfo.decode(ni.encode()) == ni
+
+    def test_compatibility(self):
+        a, b = self._ni(), self._ni(node_id="cd" * 20)
+        a.compatible_with(b)
+        with pytest.raises(NodeInfoError):
+            a.compatible_with(self._ni(network="other-chain"))
+        with pytest.raises(NodeInfoError):
+            a.compatible_with(self._ni(channels=bytes([0x99])))
+
+    def test_validate(self):
+        with pytest.raises(NodeInfoError):
+            self._ni(node_id="short").validate()
+        with pytest.raises(NodeInfoError):
+            self._ni(channels=bytes([1, 1])).validate()
+
+
+class TestSecretConnection:
+    async def test_handshake_and_roundtrip(self):
+        k1, k2 = ed25519.gen_priv_key(), ed25519.gen_priv_key()
+        async with tcp_pair() as ((cr, cw), (sr, sw)):
+            c1, c2 = await asyncio.gather(
+                SecretConnection.make(cr, cw, k1),
+                SecretConnection.make(sr, sw, k2),
+            )
+            assert c1.remote_pubkey == k2.pub_key()
+            assert c2.remote_pubkey == k1.pub_key()
+
+            await c1.write(b"hello over encrypted link")
+            await c1.drain()
+            assert await c2.read_msg() == b"hello over encrypted link"
+
+            big = bytes(range(256)) * 50  # 12.8 KB spans many frames
+            await c2.write(big)
+            await c2.drain()
+            assert await c1.read_msg() == big
+
+    async def test_wire_is_encrypted(self):
+        """The plaintext must not appear on the wire."""
+        k1, k2 = ed25519.gen_priv_key(), ed25519.gen_priv_key()
+        captured = bytearray()
+
+        async with tcp_pair() as ((cr, cw), (sr, sw)):
+            orig_write = cw.write
+
+            def spy_write(data):
+                captured.extend(data)
+                return orig_write(data)
+
+            cw.write = spy_write
+            c1, c2 = await asyncio.gather(
+                SecretConnection.make(cr, cw, k1),
+                SecretConnection.make(sr, sw, k2),
+            )
+            secret = b"TOP-SECRET-PAYLOAD-12345"
+            await c1.write(secret)
+            await c1.drain()
+            assert await c2.read_msg() == secret
+            assert secret not in bytes(captured)
+
+
+class EchoReactor(BaseReactor):
+    """Echoes every message back on the same channel; records receipts."""
+
+    def __init__(self, ch_id: int, echo: bool = True):
+        super().__init__(name=f"Echo{ch_id:#x}")
+        self.ch_id = ch_id
+        self.echo = echo
+        self.received: list[tuple[str, bytes]] = []
+        self.got_msg = asyncio.Event()
+        self.peers_added: list[str] = []
+        self.peers_removed: list[str] = []
+
+    def get_channels(self):
+        return [ChannelDescriptor(id=self.ch_id, priority=5)]
+
+    async def add_peer(self, peer):
+        self.peers_added.append(peer.id)
+
+    async def remove_peer(self, peer, reason):
+        self.peers_removed.append(peer.id)
+
+    async def receive(self, ch_id, peer, msg):
+        self.received.append((peer.id, msg))
+        self.got_msg.set()
+        if self.echo:
+            await peer.send(ch_id, b"echo:" + msg)
+
+
+class TestSwitch:
+    async def test_two_switches_exchange(self):
+        r1, r2 = EchoReactor(0x11, echo=False), EchoReactor(0x11, echo=True)
+        s1 = await make_switch({"echo": r1})
+        s2 = await make_switch({"echo": r2})
+        await s1.start()
+        await s2.start()
+        try:
+            await s1.dial_peers_async([s2.transport.listen_addr])
+            for _ in range(200):
+                if len(s1.peers) and len(s2.peers):
+                    break
+                await asyncio.sleep(0.02)
+            assert len(s1.peers) == 1 and len(s2.peers) == 1
+            assert r1.peers_added == [s2.node_id()]
+            assert r2.peers_added == [s1.node_id()]
+
+            peer = s1.peers.list()[0]
+            assert await peer.send(0x11, b"ping-data")
+            await asyncio.wait_for(r2.got_msg.wait(), 5)
+            assert r2.received == [(s1.node_id(), b"ping-data")]
+            await asyncio.wait_for(r1.got_msg.wait(), 5)
+            assert r1.received == [(s2.node_id(), b"echo:ping-data")]
+        finally:
+            await stop_switches([s1, s2])
+
+    async def test_connected_mesh_broadcast(self):
+        n = 4
+        reactors = [EchoReactor(0x22, echo=False) for _ in range(n)]
+        switches = await make_connected_switches(n, lambda i: {"echo": reactors[i]})
+        try:
+            await switches[0].broadcast(0x22, b"fanout")
+            for i in range(1, n):
+                await asyncio.wait_for(reactors[i].got_msg.wait(), 5)
+                assert reactors[i].received[0][1] == b"fanout"
+        finally:
+            await stop_switches(switches)
+
+    async def test_network_mismatch_rejected(self):
+        s1 = await make_switch({"echo": EchoReactor(0x33)}, network="chain-A")
+        s2 = await make_switch({"echo": EchoReactor(0x33)}, network="chain-B")
+        await s1.start()
+        await s2.start()
+        try:
+            await s1.dial_peers_async([s2.transport.listen_addr])
+            await asyncio.sleep(0.5)
+            assert len(s1.peers) == 0 and len(s2.peers) == 0
+        finally:
+            await stop_switches([s1, s2])
+
+    async def test_peer_disconnect_removes(self):
+        r1, r2 = EchoReactor(0x44), EchoReactor(0x44)
+        switches = await make_connected_switches(
+            2, lambda i: {"echo": [r1, r2][i]}
+        )
+        s1, s2 = switches
+        try:
+            peer_on_s2 = s2.peers.list()[0]
+            await s2.stop_peer_gracefully(peer_on_s2)
+            assert len(s2.peers) == 0
+            assert r2.peers_removed == [s1.node_id()]
+            # s1 notices the dead link
+            for _ in range(200):
+                if len(s1.peers) == 0:
+                    break
+                await asyncio.sleep(0.02)
+            assert len(s1.peers) == 0
+        finally:
+            await stop_switches(switches)

@@ -1,0 +1,97 @@
+"""PEX reactor + address book tests (reference p2p/pex/*_test.go patterns)."""
+from __future__ import annotations
+
+import asyncio
+
+from tendermint_tpu.p2p.netaddress import NetAddress
+from tendermint_tpu.p2p.pex import AddrBook, PexReactor
+from tendermint_tpu.p2p.test_util import make_switch, stop_switches
+
+
+def _addr(i: int, port: int = 26656) -> NetAddress:
+    return NetAddress(("%02x" % i) * 20, f"10.0.0.{i}", port)
+
+
+class TestAddrBook:
+    def test_add_and_pick(self):
+        book = AddrBook()
+        for i in range(1, 11):
+            assert book.add_address(_addr(i), src_id="src")
+        assert len(book) == 10
+        assert not book.add_address(_addr(1))  # dup
+        picked = book.pick_address()
+        assert picked is not None and picked.id in {a.id for a in book.get_selection(100)}
+
+    def test_mark_good_promotes(self):
+        book = AddrBook()
+        book.add_address(_addr(1))
+        assert not book.is_good(_addr(1))
+        book.mark_good(_addr(1))
+        assert book.is_good(_addr(1))
+        # vetted entries survive mark_attempt churn
+        book.mark_attempt(_addr(1))
+        assert book.is_good(_addr(1))
+
+    def test_exclude_and_exhaustion(self):
+        book = AddrBook()
+        book.add_address(_addr(1))
+        assert book.pick_address(exclude={_addr(1).id}) is None
+
+    def test_own_id_rejected(self):
+        me = _addr(42)
+        book = AddrBook(our_ids={me.id})
+        assert not book.add_address(me)
+
+    def test_save_load(self, tmp_path):
+        path = str(tmp_path / "addrbook.json")
+        book = AddrBook(file_path=path)
+        book.add_address(_addr(1))
+        book.mark_good(_addr(2))
+        book.save()
+        book2 = AddrBook(file_path=path)
+        assert len(book2) == 2
+        assert book2.is_good(_addr(2)) and not book2.is_good(_addr(1))
+
+
+class TestPexReactor:
+    async def test_addresses_gossip(self):
+        """B knows C's address; A connects to B and learns it via PEX."""
+        book_a, book_b = AddrBook(), AddrBook()
+        c_addr = _addr(3)
+        book_b.add_address(c_addr)
+
+        pex_a = PexReactor(book_a, ensure_interval=1000)
+        pex_b = PexReactor(book_b, ensure_interval=1000)
+        sa = await make_switch({"pex": pex_a})
+        sb = await make_switch({"pex": pex_b})
+        await sa.start()
+        await sb.start()
+        try:
+            await sa.dial_peers_async([sb.transport.listen_addr])
+            for _ in range(300):
+                if c_addr.id in {a.id for a in book_a.get_selection(1000)}:
+                    break
+                await asyncio.sleep(0.02)
+            assert c_addr.id in {a.id for a in book_a.get_selection(1000)}
+        finally:
+            await stop_switches([sa, sb])
+
+    async def test_ensure_peers_dials_from_book(self):
+        """A has B in its book; the ensure_peers loop connects them."""
+        book_a, book_b = AddrBook(), AddrBook()
+        pex_a = PexReactor(book_a, ensure_interval=0.1)
+        pex_b = PexReactor(book_b, ensure_interval=1000)
+        sa = await make_switch({"pex": pex_a})
+        sb = await make_switch({"pex": pex_b})
+        await sb.start()
+        book_a.add_address(sb.transport.listen_addr)
+        await sa.start()
+        try:
+            for _ in range(300):
+                if len(sa.peers) == 1:
+                    break
+                await asyncio.sleep(0.02)
+            assert len(sa.peers) == 1
+            assert sa.peers.list()[0].id == sb.node_id()
+        finally:
+            await stop_switches([sa, sb])
