@@ -1,0 +1,234 @@
+"""Fast sync — parallel block download, serial verify+apply.
+
+Reference parity: blockchain/v0/pool.go:63 — BlockPool schedules up to
+MAX_PENDING_REQUESTS concurrent per-height requesters against peers
+advertising sufficient height, monitors per-peer receive rate and evicts
+peers that stall (:133), and hands blocks to the reactor strictly in height
+order (PeekTwoBlocks/PopRequest, :193).
+
+The verify step is the TPU win: each block's LastCommit is verified as ONE
+device batch (types/validator_set.py verify_commit) instead of the
+reference's serial loop (types/validator_set.go:609-627), so sync
+throughput is bounded by download + ABCI replay, not signature checking.
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+
+from tendermint_tpu.libs.log import NOP, Logger
+from tendermint_tpu.libs.service import BaseService
+from tendermint_tpu.types.block import Block
+
+MAX_PENDING_REQUESTS = 600
+REQUEST_TIMEOUT = 15.0  # per-block; reference pool.go requestRetrySeconds
+MIN_RECV_RATE = 7680  # B/s, reference pool.go:26
+PEER_TIMEOUT_CHECK = 1.0
+
+
+class PoolPeer:
+    def __init__(self, peer_id: str, base: int, height: int) -> None:
+        self.id = peer_id
+        self.base = base
+        self.height = height
+        self.num_pending = 0
+        self._recv_bytes = 0
+        self._recv_since = time.monotonic()
+        self.did_timeout = False
+
+    def record_recv(self, size: int) -> None:
+        self._recv_bytes += size
+        self.num_pending = max(0, self.num_pending - 1)
+
+    def recv_rate(self) -> float:
+        dt = time.monotonic() - self._recv_since
+        if dt <= 0:
+            return float("inf")
+        return self._recv_bytes / dt
+
+    def reset_monitor(self) -> None:
+        self._recv_bytes = 0
+        self._recv_since = time.monotonic()
+
+
+class Requester:
+    """One outstanding block request (reference bpRequester)."""
+
+    def __init__(self, height: int) -> None:
+        self.height = height
+        self.peer_id: str | None = None
+        self.block: Block | None = None
+        self.got_block = asyncio.Event()
+        self.started_at = time.monotonic()
+
+    def set_block(self, block: Block, peer_id: str) -> bool:
+        if self.peer_id != peer_id or self.block is not None:
+            return False
+        self.block = block
+        self.got_block.set()
+        return True
+
+    def redo(self) -> None:
+        self.peer_id = None
+        self.block = None
+        self.got_block.clear()
+        self.started_at = time.monotonic()
+
+
+class BlockPool(BaseService):
+    """Reference blockchain/v0/pool.go:63."""
+
+    def __init__(
+        self,
+        start_height: int,
+        send_request,  # async (height, peer_id) -> None
+        on_peer_error=None,  # async (peer_id, reason) -> None
+        logger: Logger = NOP,
+    ) -> None:
+        super().__init__("BlockPool")
+        self.height = start_height  # next height to sync
+        self.send_request = send_request
+        self.on_peer_error = on_peer_error
+        self.log = logger
+        self.peers: dict[str, PoolPeer] = {}
+        self.requesters: dict[int, Requester] = {}
+        self.max_peer_height = 0
+        self._started_at = time.monotonic()
+        self._num_synced = 0
+        self._wake = asyncio.Event()
+
+    async def on_start(self) -> None:
+        self.spawn(self._make_requesters_routine(), "pool-requesters")
+        self.spawn(self._timeout_routine(), "pool-timeouts")
+
+    # -- peers --------------------------------------------------------
+
+    def set_peer_range(self, peer_id: str, base: int, height: int) -> None:
+        """Peer advertised its height (StatusResponse)."""
+        p = self.peers.get(peer_id)
+        if p is None:
+            p = PoolPeer(peer_id, base, height)
+            self.peers[peer_id] = p
+        else:
+            p.base, p.height = base, height
+        self.max_peer_height = max(self.max_peer_height, height)
+        self._wake.set()
+
+    def remove_peer(self, peer_id: str) -> None:
+        self.peers.pop(peer_id, None)
+        for req in self.requesters.values():
+            if req.peer_id == peer_id and req.block is None:
+                req.redo()
+        self._wake.set()
+
+    def _pick_peer(self, height: int) -> PoolPeer | None:
+        candidates = [
+            p
+            for p in self.peers.values()
+            if p.base <= height <= p.height and not p.did_timeout
+        ]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda p: p.num_pending)
+
+    # -- requesters ---------------------------------------------------
+
+    async def _make_requesters_routine(self) -> None:
+        """Reference pool.go:108 makeRequestersRoutine."""
+        while True:
+            next_height = self.height + len(self.requesters)
+            if (
+                len(self.requesters) >= MAX_PENDING_REQUESTS
+                or next_height > self.max_peer_height
+            ):
+                self._wake.clear()
+                try:
+                    await asyncio.wait_for(self._wake.wait(), 0.25)
+                except asyncio.TimeoutError:
+                    pass
+                continue
+            req = Requester(next_height)
+            self.requesters[next_height] = req
+            await self._assign(req)
+
+    async def _assign(self, req: Requester) -> None:
+        peer = self._pick_peer(req.height)
+        if peer is None:
+            return
+        req.peer_id = peer.id
+        req.started_at = time.monotonic()
+        peer.num_pending += 1
+        await self.send_request(req.height, peer.id)
+
+    async def _timeout_routine(self) -> None:
+        """Reference pool.go:133 removeTimedoutPeers + retry unassigned."""
+        while True:
+            await asyncio.sleep(PEER_TIMEOUT_CHECK)
+            now = time.monotonic()
+            for peer in list(self.peers.values()):
+                if peer.num_pending > 0 and peer.recv_rate() < MIN_RECV_RATE:
+                    if now - peer._recv_since > REQUEST_TIMEOUT:
+                        peer.did_timeout = True
+                        self.log.info("fast-sync peer timed out", peer=peer.id)
+                        if self.on_peer_error:
+                            await self.on_peer_error(peer.id, "fast-sync timeout")
+                        self.remove_peer(peer.id)
+            for req in list(self.requesters.values()):
+                if req.block is None:
+                    if req.peer_id is None:
+                        await self._assign(req)
+                    elif now - req.started_at > REQUEST_TIMEOUT:
+                        req.redo()
+                        await self._assign(req)
+
+    # -- block intake -------------------------------------------------
+
+    def add_block(self, peer_id: str, block: Block, size: int) -> None:
+        """Reference pool.go:244 AddBlock."""
+        req = self.requesters.get(block.header.height)
+        if req is None:
+            return
+        peer = self.peers.get(peer_id)
+        if peer is not None:
+            peer.record_recv(size)
+        req.set_block(block, peer_id)
+
+    def peek_two_blocks(self) -> tuple[Block | None, Block | None]:
+        """Reference pool.go:193 — blocks at pool.height and height+1."""
+        first = self.requesters.get(self.height)
+        second = self.requesters.get(self.height + 1)
+        return (
+            first.block if first else None,
+            second.block if second else None,
+        )
+
+    def pop_request(self) -> None:
+        """First block verified+applied: advance (reference PopRequest)."""
+        self.requesters.pop(self.height, None)
+        self.height += 1
+        self._num_synced += 1
+        self._wake.set()
+
+    def redo_request(self, height: int) -> str | None:
+        """First block failed verification: ban the peers that sent the pair
+        (reference pool.go RedoRequest)."""
+        req = self.requesters.get(height)
+        if req is None:
+            return None
+        bad = req.peer_id
+        if bad is not None:
+            self.remove_peer(bad)
+        req.redo()
+        return bad
+
+    # -- status -------------------------------------------------------
+
+    def is_caught_up(self) -> bool:
+        """Reference pool.go:168 IsCaughtUp."""
+        if not self.peers:
+            return False
+        return self.height >= self.max_peer_height
+
+    def sync_rate(self) -> float:
+        dt = time.monotonic() - self._started_at
+        return self._num_synced / dt if dt > 0 else 0.0

@@ -1,0 +1,250 @@
+"""Fast-sync reactor — BlockchainChannel 0x40.
+
+Reference parity: blockchain/v0/reactor.go:57 — serves BlockRequests from
+the store, runs poolRoutine: pull ordered block pairs from the pool, verify
+`second.LastCommit` against `first`'s validator set (one TPU batch —
+reference's serial hot loop #3, reactor.go:313), ApplyBlock, and
+SwitchToConsensus when caught up.
+"""
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+
+from tendermint_tpu.blockchain import BlockPool
+from tendermint_tpu.encoding import DecodeError, Reader, Writer
+from tendermint_tpu.libs.log import NOP, Logger
+from tendermint_tpu.p2p.base_reactor import BaseReactor, ChannelDescriptor
+from tendermint_tpu.types import BlockID
+from tendermint_tpu.types.block import Block
+from tendermint_tpu.types.validator_set import VerifyError
+
+BLOCKCHAIN_CHANNEL = 0x40
+
+TRY_SYNC_INTERVAL = 0.01  # reference reactor.go trySyncTicker 10ms
+STATUS_UPDATE_INTERVAL = 10.0
+SWITCH_TO_CONSENSUS_INTERVAL = 1.0
+
+
+@dataclass
+class BlockRequestMessage:
+    height: int
+
+
+@dataclass
+class BlockResponseMessage:
+    block: Block
+
+
+@dataclass
+class NoBlockResponseMessage:
+    height: int
+
+
+@dataclass
+class StatusRequestMessage:
+    pass
+
+
+@dataclass
+class StatusResponseMessage:
+    base: int
+    height: int
+
+
+def encode_bc_message(msg) -> bytes:
+    w = Writer()
+    if isinstance(msg, BlockRequestMessage):
+        w.u8(1).u64(msg.height)
+    elif isinstance(msg, BlockResponseMessage):
+        w.u8(2).bytes(msg.block.encode())
+    elif isinstance(msg, NoBlockResponseMessage):
+        w.u8(3).u64(msg.height)
+    elif isinstance(msg, StatusRequestMessage):
+        w.u8(4)
+    elif isinstance(msg, StatusResponseMessage):
+        w.u8(5).u64(msg.base).u64(msg.height)
+    else:
+        raise TypeError(f"unknown blockchain message {type(msg).__name__}")
+    return w.build()
+
+
+def decode_bc_message(data: bytes):
+    r = Reader(data)
+    tag = r.u8()
+    if tag == 1:
+        msg = BlockRequestMessage(r.u64())
+    elif tag == 2:
+        msg = BlockResponseMessage(Block.decode(r.bytes()))
+    elif tag == 3:
+        msg = NoBlockResponseMessage(r.u64())
+    elif tag == 4:
+        msg = StatusRequestMessage()
+    elif tag == 5:
+        msg = StatusResponseMessage(r.u64(), r.u64())
+    else:
+        raise DecodeError(f"unknown blockchain message tag {tag}")
+    r.expect_done()
+    return msg
+
+
+class BlockchainReactor(BaseReactor):
+    def __init__(
+        self,
+        state,  # state.State snapshot at boot
+        block_exec,
+        block_store,
+        fast_sync: bool,
+        logger: Logger = NOP,
+    ) -> None:
+        super().__init__("BlockchainReactor")
+        self.initial_state = state
+        self.state = state
+        self.block_exec = block_exec
+        self.block_store = block_store
+        self.fast_sync = fast_sync
+        self.log = logger
+        self.pool = BlockPool(
+            start_height=block_store.height() + 1,
+            send_request=self._send_block_request,
+            on_peer_error=self._on_pool_peer_error,
+            logger=logger,
+        )
+        self.blocks_synced = 0
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        return [
+            ChannelDescriptor(
+                BLOCKCHAIN_CHANNEL,
+                priority=10,
+                send_queue_capacity=1000,
+                recv_message_capacity=1 << 22,
+            )
+        ]
+
+    async def on_start(self) -> None:
+        if self.fast_sync:
+            await self.pool.start()
+            self.spawn(self._pool_routine(), "bc-pool-routine")
+
+    async def on_stop(self) -> None:
+        if self.pool.is_running:
+            await self.pool.stop()
+
+    # -- p2p plumbing -------------------------------------------------
+
+    async def _send_block_request(self, height: int, peer_id: str) -> None:
+        peer = self.switch.peers.get(peer_id) if self.switch else None
+        if peer is None:
+            return
+        await peer.send(BLOCKCHAIN_CHANNEL, encode_bc_message(BlockRequestMessage(height)))
+
+    async def _on_pool_peer_error(self, peer_id: str, reason) -> None:
+        peer = self.switch.peers.get(peer_id) if self.switch else None
+        if peer is not None:
+            await self.switch.stop_peer_for_error(peer, reason)
+
+    async def add_peer(self, peer) -> None:
+        # advertise our status; the peer replies with its own so the pool
+        # learns its height (reference reactor.go AddPeer)
+        await peer.send(
+            BLOCKCHAIN_CHANNEL,
+            encode_bc_message(
+                StatusResponseMessage(self.block_store.base(), self.block_store.height())
+            ),
+        )
+
+    async def remove_peer(self, peer, reason) -> None:
+        self.pool.remove_peer(peer.id)
+
+    async def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
+        try:
+            msg = decode_bc_message(msg_bytes)
+        except Exception as e:
+            self.log.error("bad blockchain message", peer=peer.id, err=repr(e))
+            await self.switch.stop_peer_for_error(peer, e)
+            return
+
+        if isinstance(msg, BlockRequestMessage):
+            block = self.block_store.load_block(msg.height)
+            if block is not None:
+                await peer.send(
+                    BLOCKCHAIN_CHANNEL, encode_bc_message(BlockResponseMessage(block))
+                )
+            else:
+                await peer.send(
+                    BLOCKCHAIN_CHANNEL,
+                    encode_bc_message(NoBlockResponseMessage(msg.height)),
+                )
+        elif isinstance(msg, BlockResponseMessage):
+            self.pool.add_block(peer.id, msg.block, len(msg_bytes))
+        elif isinstance(msg, StatusRequestMessage):
+            await peer.send(
+                BLOCKCHAIN_CHANNEL,
+                encode_bc_message(
+                    StatusResponseMessage(self.block_store.base(), self.block_store.height())
+                ),
+            )
+        elif isinstance(msg, StatusResponseMessage):
+            self.pool.set_peer_range(peer.id, msg.base, msg.height)
+        elif isinstance(msg, NoBlockResponseMessage):
+            self.log.debug("peer has no block", peer=peer.id, height=msg.height)
+
+    # -- sync loop ----------------------------------------------------
+
+    async def _pool_routine(self) -> None:
+        """Reference reactor.go:211 poolRoutine."""
+        last_status = 0.0
+        last_switch_check = 0.0
+        loop = asyncio.get_event_loop()
+        while True:
+            now = loop.time()
+            if now - last_status > STATUS_UPDATE_INTERVAL:
+                last_status = now
+                if self.switch is not None:
+                    await self.switch.broadcast(
+                        BLOCKCHAIN_CHANNEL, encode_bc_message(StatusRequestMessage())
+                    )
+            if now - last_switch_check > SWITCH_TO_CONSENSUS_INTERVAL:
+                last_switch_check = now
+                if self.pool.is_caught_up():
+                    self.log.info(
+                        "fast sync complete", height=self.pool.height,
+                        blocks=self.blocks_synced, rate=f"{self.pool.sync_rate():.1f}/s",
+                    )
+                    await self.pool.stop()
+                    cons = self.switch.reactor("CONSENSUS") if self.switch else None
+                    if cons is not None:
+                        await cons.switch_to_consensus(self.state, self.blocks_synced)
+                    return
+            if not await self._try_sync_one():
+                await asyncio.sleep(TRY_SYNC_INTERVAL)
+
+    async def _try_sync_one(self) -> bool:
+        """Verify+apply the first block using the second's LastCommit
+        (reference reactor.go:271-330). Returns True if a block was applied."""
+        first, second = self.pool.peek_two_blocks()
+        if first is None or second is None:
+            return False
+        first_parts = first.make_part_set()
+        first_id = BlockID(first.hash(), first_parts.header())
+        try:
+            # hot loop #3: one batched device verify per commit
+            self.state.validators.verify_commit(
+                self.state.chain_id, first_id, first.header.height, second.last_commit
+            )
+        except VerifyError as e:
+            self.log.error("fast-sync block verify failed", height=first.header.height, err=str(e))
+            self.pool.redo_request(first.header.height)
+            self.pool.redo_request(first.header.height + 1)
+            return False
+        self.pool.pop_request()
+        self.block_store.save_block(first, first_parts, second.last_commit)
+        self.state = await self.block_exec.apply_block(self.state, first_id, first)
+        self.blocks_synced += 1
+        if self.blocks_synced % 100 == 0:
+            self.log.info(
+                "fast sync progress", height=self.pool.height,
+                rate=f"{self.pool.sync_rate():.1f} blocks/s",
+            )
+        return True

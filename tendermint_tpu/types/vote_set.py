@@ -240,6 +240,11 @@ class VoteSet:
         by_block = self.votes_by_block[self.maj23.key()]
         return Commit(self.maj23, list(by_block.votes))
 
+    def size(self) -> int:
+        """Number of validator slots (reference vote_set.go Size() —
+        valSet.Size(), NOT the number of votes received)."""
+        return self.val_set.size()
+
     def __len__(self) -> int:
         return sum(1 for v in self.votes if v is not None)
 

@@ -1,0 +1,195 @@
+"""Fast-sync tests — the reference's blockchain/v0/reactor_test.go pattern:
+a producing node with a populated block store, and a fresh node that
+fast-syncs from it then switches to consensus."""
+import asyncio
+import os
+
+import pytest
+
+from tendermint_tpu import proxy
+from tendermint_tpu.blockchain import BlockPool
+from tendermint_tpu.blockchain.reactor import (
+    BlockchainReactor,
+    BlockRequestMessage,
+    BlockResponseMessage,
+    NoBlockResponseMessage,
+    StatusRequestMessage,
+    StatusResponseMessage,
+    decode_bc_message,
+    encode_bc_message,
+)
+from tendermint_tpu.config import make_test_config
+from tendermint_tpu.consensus.reactor import ConsensusReactor
+from tendermint_tpu.consensus.replay import Handshaker
+from tendermint_tpu.consensus.state import ConsensusState
+from tendermint_tpu.consensus.wal import NilWAL
+from tendermint_tpu.evidence import EvidencePool
+from tendermint_tpu.evidence.reactor import EvidenceReactor
+from tendermint_tpu.libs.db import MemDB
+from tendermint_tpu.mempool import CListMempool
+from tendermint_tpu.mempool.reactor import MempoolReactor
+from tendermint_tpu.p2p.test_util import make_connected_switches, stop_switches
+from tendermint_tpu.state import StateStore, load_state_from_db_or_genesis
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.store import BlockStore
+from tendermint_tpu.types import GenesisDoc, MockPV
+from tendermint_tpu.types.genesis import GenesisValidator
+
+CHAIN_ID = "fastsync-test-chain"
+
+
+class SyncNode:
+    """A node with a BlockchainReactor; validator=True makes it the (only)
+    block producer, validator=False boots in fast-sync mode."""
+
+    def __init__(self, root, pv, validator: bool):
+        self.root = root
+        self.cfg = make_test_config(root)
+        self.pv = pv
+        self.validator = validator
+        self.genesis = GenesisDoc(
+            chain_id=CHAIN_ID,
+            genesis_time=1_700_000_000_000_000_000,
+            validators=[GenesisValidator(pv.get_pub_key(), 10)],
+        )
+
+    async def setup(self):
+        from tendermint_tpu.abci.examples import KVStoreApplication
+
+        self.conns = proxy.AppConns(proxy.LocalClientCreator(KVStoreApplication()))
+        await self.conns.start()
+        state_db = MemDB()
+        self.state_store = StateStore(state_db)
+        self.block_store = BlockStore(MemDB())
+        state = load_state_from_db_or_genesis(state_db, self.genesis)
+        state = await Handshaker(
+            self.state_store, state, self.block_store, self.genesis
+        ).handshake(self.conns)
+        from tendermint_tpu.types.event_bus import EventBus
+
+        self.event_bus = EventBus()
+        await self.event_bus.start()
+        self.mempool = CListMempool(self.conns.mempool)
+        self.ev_pool = EvidencePool(MemDB(), self.state_store, state)
+        self.block_exec = BlockExecutor(
+            self.state_store,
+            self.conns.consensus,
+            mempool=self.mempool,
+            evidence_pool=self.ev_pool,
+            event_bus=self.event_bus,
+        )
+        self.cs = ConsensusState(
+            self.cfg.consensus,
+            state,
+            self.block_exec,
+            self.block_store,
+            mempool=self.mempool,
+            evidence_pool=self.ev_pool,
+            priv_validator=self.pv if self.validator else None,
+            wal=NilWAL(),
+            event_bus=self.event_bus,
+        )
+        fast_sync = not self.validator
+        self.cons_reactor = ConsensusReactor(self.cs, fast_sync=fast_sync)
+        self.bc_reactor = BlockchainReactor(
+            state, self.block_exec, self.block_store, fast_sync=fast_sync
+        )
+        return {
+            "BLOCKCHAIN": self.bc_reactor,
+            "CONSENSUS": self.cons_reactor,
+            "MEMPOOL": MempoolReactor(self.mempool),
+            "EVIDENCE": EvidenceReactor(self.ev_pool),
+        }
+
+    async def teardown(self):
+        await self.event_bus.stop()
+        await self.conns.stop()
+
+
+class TestFastSync:
+    def test_new_node_catches_up_and_switches(self, tmp_path):
+        async def main():
+            pv = MockPV()
+            syncer = None
+            producer = SyncNode(os.path.join(tmp_path, "producer"), pv, validator=True)
+            producer_reactors = await producer.setup()
+            # run the producer alone until it has a chain
+            switches = await make_connected_switches(
+                1, lambda i: producer_reactors, network=CHAIN_ID
+            )
+            try:
+                async with asyncio.timeout(60):
+                    while producer.block_store.height() < 8:
+                        await asyncio.sleep(0.05)
+
+                syncer = SyncNode(
+                    os.path.join(tmp_path, "syncer"), pv, validator=False
+                )
+                syncer_reactors = await syncer.setup()
+                from tendermint_tpu.p2p.test_util import make_switch
+
+                sw2 = await make_switch(syncer_reactors, network=CHAIN_ID)
+                await sw2.start()
+                switches.append(sw2)
+                await sw2.dial_peers_async([switches[0].transport.listen_addr])
+
+                # the syncer must fast-sync the chain and switch to consensus
+                async with asyncio.timeout(60):
+                    while syncer.block_store.height() < 8:
+                        await asyncio.sleep(0.05)
+                    while not syncer.cs.is_running:
+                        await asyncio.sleep(0.05)
+                assert syncer.bc_reactor.blocks_synced >= 5
+                # after switching, the syncer keeps following new blocks
+                target = producer.block_store.height() + 2
+                async with asyncio.timeout(60):
+                    while syncer.block_store.height() < target:
+                        await asyncio.sleep(0.05)
+                # both agree on block 5
+                h1 = producer.block_store.load_block_meta(5).block_id.hash
+                h2 = syncer.block_store.load_block_meta(5).block_id.hash
+                assert h1 == h2
+            finally:
+                await stop_switches(switches)
+                await producer.teardown()
+                if syncer is not None:
+                    await syncer.teardown()
+
+        asyncio.run(main())
+
+
+class TestBcWire:
+    def test_message_roundtrips(self):
+        for msg in (
+            BlockRequestMessage(7),
+            NoBlockResponseMessage(9),
+            StatusRequestMessage(),
+            StatusResponseMessage(1, 42),
+        ):
+            assert decode_bc_message(encode_bc_message(msg)) == msg
+
+
+class TestBlockPool:
+    def test_pick_peer_prefers_least_pending(self):
+        sent = []
+
+        async def send(height, peer_id):
+            sent.append((height, peer_id))
+
+        pool = BlockPool(1, send)
+        pool.set_peer_range("a", 1, 100)
+        pool.set_peer_range("b", 1, 100)
+        pa, pb = pool.peers["a"], pool.peers["b"]
+        pa.num_pending = 5
+        assert pool._pick_peer(10) is pb
+
+    def test_caught_up(self):
+        async def send(height, peer_id):
+            pass
+
+        pool = BlockPool(5, send)
+        assert not pool.is_caught_up()  # no peers
+        pool.set_peer_range("a", 1, 4)
+        assert pool.is_caught_up()  # our height exceeds all peers
+        pool.set_peer_range("b", 1, 50)
+        assert not pool.is_caught_up()
