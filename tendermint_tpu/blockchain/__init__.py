@@ -39,12 +39,17 @@ class PoolPeer:
     def record_recv(self, size: int) -> None:
         self._recv_bytes += size
         self.num_pending = max(0, self.num_pending - 1)
+        if self.num_pending == 0:
+            self.reset_monitor()  # idle peers aren't judged on stale windows
 
     def recv_rate(self) -> float:
         dt = time.monotonic() - self._recv_since
         if dt <= 0:
             return float("inf")
         return self._recv_bytes / dt
+
+    def window_age(self) -> float:
+        return time.monotonic() - self._recv_since
 
     def reset_monitor(self) -> None:
         self._recv_bytes = 0
@@ -116,6 +121,9 @@ class BlockPool(BaseService):
 
     def remove_peer(self, peer_id: str) -> None:
         self.peers.pop(peer_id, None)
+        # recompute: a stale tall peer would otherwise pin max_peer_height
+        # and keep is_caught_up() false forever
+        self.max_peer_height = max((p.height for p in self.peers.values()), default=0)
         for req in self.requesters.values():
             if req.peer_id == peer_id and req.block is None:
                 req.redo()
@@ -157,6 +165,8 @@ class BlockPool(BaseService):
             return
         req.peer_id = peer.id
         req.started_at = time.monotonic()
+        if peer.num_pending == 0:
+            peer.reset_monitor()  # start the stall window at assignment
         peer.num_pending += 1
         await self.send_request(req.height, peer.id)
 
@@ -166,13 +176,21 @@ class BlockPool(BaseService):
             await asyncio.sleep(PEER_TIMEOUT_CHECK)
             now = time.monotonic()
             for peer in list(self.peers.values()):
-                if peer.num_pending > 0 and peer.recv_rate() < MIN_RECV_RATE:
-                    if now - peer._recv_since > REQUEST_TIMEOUT:
-                        peer.did_timeout = True
-                        self.log.info("fast-sync peer timed out", peer=peer.id)
-                        if self.on_peer_error:
-                            await self.on_peer_error(peer.id, "fast-sync timeout")
-                        self.remove_peer(peer.id)
+                # windowed stall check: the window resets whenever the peer
+                # drains its pending requests, so only a peer that has been
+                # continuously slow *while owing us blocks* for a full
+                # timeout period is evicted (reference uses a flowrate
+                # monitor's current rate, not a lifetime average)
+                if (
+                    peer.num_pending > 0
+                    and peer.window_age() > REQUEST_TIMEOUT
+                    and peer.recv_rate() < MIN_RECV_RATE
+                ):
+                    peer.did_timeout = True
+                    self.log.info("fast-sync peer timed out", peer=peer.id)
+                    if self.on_peer_error:
+                        await self.on_peer_error(peer.id, "fast-sync timeout")
+                    self.remove_peer(peer.id)
             for req in list(self.requesters.values()):
                 if req.block is None:
                     if req.peer_id is None:

@@ -235,8 +235,15 @@ class BlockchainReactor(BaseReactor):
             )
         except VerifyError as e:
             self.log.error("fast-sync block verify failed", height=first.header.height, err=str(e))
-            self.pool.redo_request(first.header.height)
-            self.pool.redo_request(first.header.height + 1)
+            # disconnect both senders (reference reactor.go poolRoutine
+            # StopPeerForError) — pool removal alone lets a Byzantine peer
+            # rejoin on the next status broadcast and stall sync forever
+            for bad in (
+                self.pool.redo_request(first.header.height),
+                self.pool.redo_request(first.header.height + 1),
+            ):
+                if bad is not None:
+                    await self._on_pool_peer_error(bad, "sent invalid block")
             return False
         self.pool.pop_request()
         self.block_store.save_block(first, first_parts, second.last_commit)

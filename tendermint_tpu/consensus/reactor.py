@@ -426,11 +426,6 @@ class ConsensusReactor(BaseReactor):
         elif isinstance(msg, m.ProposalPOLMessage):
             ps.apply_proposal_pol(msg)
         elif isinstance(msg, m.BlockPartMessage):
-            ps.init_proposal_block_parts(
-                self.cs.rs.proposal_block_parts.header()
-                if self.cs.rs.proposal_block_parts
-                else PartSetHeader()
-            )
             ps.set_has_proposal_block_part(msg.height, msg.round, msg.part.index)
             await self.cs.send_peer_msg(msg, peer.id)
 

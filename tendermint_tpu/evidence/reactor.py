@@ -67,9 +67,11 @@ class EvidenceReactor(BaseReactor):
             try:
                 self.pool.add_evidence(ev)
             except EvidenceError as e:
-                self.log.info("invalid evidence from peer", peer=peer.id, err=str(e))
-                await self.switch.stop_peer_for_error(peer, e)
-                return
+                # Not necessarily Byzantine: height skew between peers makes
+                # valid evidence unverifiable here (too old for us, or from a
+                # height we haven't stored validators for). Reject the
+                # evidence, keep the peer.
+                self.log.info("rejected evidence from peer", peer=peer.id, err=str(e))
 
     async def _broadcast_routine(self, peer) -> None:
         el = None
