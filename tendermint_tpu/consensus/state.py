@@ -356,7 +356,12 @@ class ConsensusState(BaseService):
         block_id = BlockID(block.hash(), parts.header())
         proposal = Proposal(height, round_, rs.valid_round, block_id, now_ns())
         try:
-            proposal = self.priv_validator.sign_proposal(self.state.chain_id, proposal)
+            if hasattr(self.priv_validator, "sign_proposal_async"):
+                proposal = await self.priv_validator.sign_proposal_async(
+                    self.state.chain_id, proposal
+                )
+            else:
+                proposal = self.priv_validator.sign_proposal(self.state.chain_id, proposal)
         except Exception as e:
             self.log.error("failed to sign proposal", err=repr(e))
             return
@@ -796,7 +801,12 @@ class ConsensusState(BaseService):
             type_, rs.height, rs.round, block_id, ts, self.priv_validator.address, idx
         )
         try:
-            vote = self.priv_validator.sign_vote(self.state.chain_id, vote)
+            # remote signers (privval.remote.SignerClient) expose an async
+            # variant; file/mock signers are synchronous
+            if hasattr(self.priv_validator, "sign_vote_async"):
+                vote = await self.priv_validator.sign_vote_async(self.state.chain_id, vote)
+            else:
+                vote = self.priv_validator.sign_vote(self.state.chain_id, vote)
         except Exception as e:
             self.log.error("failed to sign vote", err=repr(e))
             return None

@@ -1,0 +1,353 @@
+"""Light client — verify headers without replaying the chain.
+
+Reference parity: lite/ package.
+- FullCommit = SignedHeader + the validator sets that signed it and the
+  next set (lite/commit.go:16).
+- BaseVerifier: static validator set (lite/base_verifier.go:20,45).
+- DynamicVerifier: trusted-state updates with binary-search bisection
+  through intermediate headers, using VerifyFutureCommit when the
+  validator-set hash changed (lite/dynamic_verifier.go:24,73,190,211) —
+  north-star hot loop #4. Each header in the bisection costs ONE batched
+  device verify (the reference does one serial ed25519 verify per
+  signature per header).
+- Providers: DBProvider trusted store with pruning (lite/dbprovider.go:19,
+  192), multiprovider (lite/multiprovider.go:13).
+"""
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+from tendermint_tpu.encoding import Reader, Writer
+from tendermint_tpu.libs.db import DB
+from tendermint_tpu.libs.log import NOP, Logger
+from tendermint_tpu.types import BlockID
+from tendermint_tpu.types.block import Commit, SignedHeader
+from tendermint_tpu.types.validator_set import TooMuchChangeError, ValidatorSet, VerifyError
+
+
+class LiteError(Exception):
+    pass
+
+
+class MissingHeaderError(LiteError):
+    """Requested height not available from the provider."""
+
+
+@dataclass
+class FullCommit:
+    """Reference lite/commit.go:16 FullCommit."""
+
+    signed_header: SignedHeader
+    validators: ValidatorSet
+    next_validators: ValidatorSet
+
+    @property
+    def height(self) -> int:
+        return self.signed_header.height
+
+    @property
+    def chain_id(self) -> str:
+        return self.signed_header.chain_id
+
+    def validate_full(self, chain_id: str) -> None:
+        """Reference commit.go ValidateFull: internal consistency only —
+        signature checks happen in the verifiers."""
+        self.signed_header.validate_basic(chain_id)
+        if self.signed_header.header.validators_hash != self.validators.hash():
+            raise LiteError(
+                f"full commit validators hash mismatch at height {self.height}"
+            )
+        if self.signed_header.header.next_validators_hash != self.next_validators.hash():
+            raise LiteError(
+                f"full commit next-validators hash mismatch at height {self.height}"
+            )
+
+    def encode(self) -> bytes:
+        return (
+            Writer()
+            .bytes(self.signed_header.encode())
+            .bytes(self.validators.encode())
+            .bytes(self.next_validators.encode())
+            .build()
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "FullCommit":
+        r = Reader(data)
+        sh = SignedHeader.decode(r.bytes())
+        vals = ValidatorSet.decode(r.bytes())
+        nvals = ValidatorSet.decode(r.bytes())
+        r.expect_done()
+        return cls(sh, vals, nvals)
+
+
+# ---------------------------------------------------------------------------
+# providers
+
+
+class Provider:
+    """Reference lite/provider.go:10."""
+
+    def latest_full_commit(self, chain_id: str, min_height: int, max_height: int) -> FullCommit:
+        """The highest stored full commit in [min_height, max_height]."""
+        raise NotImplementedError
+
+    def validator_set(self, chain_id: str, height: int) -> ValidatorSet | None:
+        raise NotImplementedError
+
+
+class UpdatingProvider(Provider):
+    """Reference lite/provider.go UpdatingProvider."""
+
+    def save_full_commit(self, fc: FullCommit) -> None:
+        raise NotImplementedError
+
+
+class DBProvider(UpdatingProvider):
+    """Trusted store (reference lite/dbprovider.go:19). Keys are
+    height-descending so 'latest in range' is one short scan; keeps at most
+    `limit` full commits, pruning the oldest (dbprovider.go:192)."""
+
+    def __init__(self, label: str, db: DB, limit: int = 0, logger: Logger = NOP) -> None:
+        self.label = label
+        self.db = db
+        self.limit = limit
+        self.log = logger
+
+    def _fc_key(self, height: int) -> bytes:
+        # descending: invert height so iterate_prefix yields newest first
+        return b"lite:fc:" + struct.pack(">Q", (1 << 63) - height)
+
+    def save_full_commit(self, fc: FullCommit) -> None:
+        self.db.set(self._fc_key(fc.height), fc.encode())
+        if self.limit > 0:
+            self._prune()
+
+    def _prune(self) -> None:
+        keys = [k for k, _ in self.db.iterate_prefix(b"lite:fc:")]
+        for k in keys[self.limit:]:  # keys are newest-first
+            self.db.delete(k)
+
+    def latest_full_commit(self, chain_id: str, min_height: int, max_height: int) -> FullCommit:
+        if max_height <= 0:
+            max_height = 1 << 62
+        for _, raw in self.db.iterate_prefix(b"lite:fc:"):
+            fc = FullCommit.decode(raw)
+            if fc.chain_id != chain_id:
+                continue
+            if fc.height > max_height:
+                continue
+            if fc.height < min_height:
+                break  # newest-first: everything after is lower still
+            return fc
+        raise MissingHeaderError(
+            f"no full commit for {chain_id} in [{min_height},{max_height}]"
+        )
+
+    def validator_set(self, chain_id: str, height: int) -> ValidatorSet | None:
+        try:
+            fc = self.latest_full_commit(chain_id, height, height)
+        except MissingHeaderError:
+            return None
+        return fc.validators
+
+
+class MultiProvider(UpdatingProvider):
+    """Try providers in order (reference lite/multiprovider.go:13)."""
+
+    def __init__(self, *providers: Provider) -> None:
+        self.providers = list(providers)
+
+    def save_full_commit(self, fc: FullCommit) -> None:
+        for p in self.providers:
+            if isinstance(p, UpdatingProvider):
+                p.save_full_commit(fc)
+
+    def latest_full_commit(self, chain_id: str, min_height: int, max_height: int) -> FullCommit:
+        best: FullCommit | None = None
+        for p in self.providers:
+            try:
+                fc = p.latest_full_commit(chain_id, min_height, max_height)
+            except MissingHeaderError:
+                continue
+            if best is None or fc.height > best.height:
+                best = fc
+            if best.height == max_height:
+                break
+        if best is None:
+            raise MissingHeaderError(
+                f"no provider has a full commit for {chain_id} in [{min_height},{max_height}]"
+            )
+        return best
+
+    def validator_set(self, chain_id: str, height: int) -> ValidatorSet | None:
+        for p in self.providers:
+            vs = p.validator_set(chain_id, height)
+            if vs is not None:
+                return vs
+        return None
+
+
+class NodeProvider(Provider):
+    """Source provider backed by a local node's stores — the in-process
+    analog of the reference's HTTP provider (lite/client/provider.go); the
+    RPC-backed variant lives in rpc/client once RPC lands."""
+
+    def __init__(self, state_store, block_store) -> None:
+        self.state_store = state_store
+        self.block_store = block_store
+
+    def full_commit_at(self, height: int) -> FullCommit:
+        meta = self.block_store.load_block_meta(height)
+        commit = self.block_store.load_block_commit(height)  # commit FOR height
+        vals = self.state_store.load_validators(height)
+        nvals = self.state_store.load_validators(height + 1)
+        if meta is None or commit is None or vals is None or nvals is None:
+            raise MissingHeaderError(f"height {height} not available")
+        return FullCommit(SignedHeader(meta.header, commit), vals, nvals)
+
+    def latest_full_commit(self, chain_id: str, min_height: int, max_height: int) -> FullCommit:
+        # commit for height H is stored with block H+1; the last *committed*
+        # height with an available commit is store.height() - 1
+        top = self.block_store.height() - 1
+        if max_height <= 0:
+            max_height = top
+        h = min(max_height, top)
+        if h < min_height:
+            raise MissingHeaderError(f"no commit in [{min_height},{max_height}]")
+        return self.full_commit_at(h)
+
+    def validator_set(self, chain_id: str, height: int) -> ValidatorSet | None:
+        return self.state_store.load_validators(height)
+
+
+# ---------------------------------------------------------------------------
+# verifiers
+
+
+class BaseVerifier:
+    """Static validator set (reference lite/base_verifier.go:20)."""
+
+    def __init__(self, chain_id: str, height: int, valset: ValidatorSet) -> None:
+        self.chain_id = chain_id
+        self.height = height
+        self.valset = valset
+
+    def verify(self, signed_header: SignedHeader) -> None:
+        """Reference base_verifier.go:45 Certify."""
+        if signed_header.chain_id != self.chain_id:
+            raise LiteError(
+                f"chain id mismatch: {signed_header.chain_id} != {self.chain_id}"
+            )
+        if signed_header.height < self.height:
+            raise LiteError(
+                f"header height {signed_header.height} below verifier base {self.height}"
+            )
+        if signed_header.header.validators_hash != self.valset.hash():
+            raise LiteError("validators hash mismatch")
+        signed_header.validate_basic(self.chain_id)
+        self.valset.verify_commit(
+            self.chain_id,
+            signed_header.commit.block_id,
+            signed_header.height,
+            signed_header.commit,
+        )
+
+
+class DynamicVerifier:
+    """Bisection verifier (reference lite/dynamic_verifier.go:24).
+
+    Keeps a trusted store of verified FullCommits; to verify a new header it
+    walks forward from the latest trusted commit, trying the target directly
+    (VerifyFutureCommit tolerates validator changes with > 2/3 continuity)
+    and bisecting through intermediate headers from the source when the set
+    changed too much (TooMuchChangeError)."""
+
+    def __init__(
+        self,
+        chain_id: str,
+        trusted: UpdatingProvider,
+        source: Provider,
+        logger: Logger = NOP,
+    ) -> None:
+        self.chain_id = chain_id
+        self.trusted = trusted
+        self.source = source
+        self.log = logger
+        self.headers_verified = 0  # instrumentation for benchmarks
+
+    def verify(self, signed_header: SignedHeader) -> None:
+        """Reference dynamic_verifier.go:73 Verify."""
+        h = signed_header.height
+        # 1. make sure we have a trusted commit for h-1 or earlier, advancing
+        #    trust to exactly h-1 (bisection happens inside)
+        self._update_to_height(h - 1)
+        trusted = self.trusted.latest_full_commit(self.chain_id, 1, h - 1)
+        if trusted.height != h - 1:
+            raise MissingHeaderError(
+                f"could not advance trusted state to height {h - 1}"
+            )
+        # 2. the next-validators of h-1 must sign h
+        self._certify_with(trusted, signed_header)
+
+    def _certify_with(self, trusted: FullCommit, signed_header: SignedHeader) -> None:
+        signed_header.validate_basic(self.chain_id)
+        next_vals = trusted.next_validators
+        if signed_header.header.validators_hash != next_vals.hash():
+            raise LiteError(
+                f"header {signed_header.height} validators hash does not match "
+                f"trusted next-validators"
+            )
+        next_vals.verify_commit(
+            self.chain_id,
+            signed_header.commit.block_id,
+            signed_header.height,
+            signed_header.commit,
+        )
+        self.headers_verified += 1
+
+    def _update_to_height(self, h: int) -> None:
+        """Reference dynamic_verifier.go:211 updateToHeight +
+        :190 verifyAndSave bisection."""
+        trusted = self.trusted.latest_full_commit(self.chain_id, 1, h)
+        if trusted.height == h:
+            return
+        source_fc = self.source.latest_full_commit(self.chain_id, h, h)
+        source_fc.validate_full(self.chain_id)
+        self._verify_and_save(trusted, source_fc)
+
+    def _verify_and_save(self, trusted: FullCommit, source_fc: FullCommit) -> None:
+        """Try to jump from trusted directly to source_fc; on too much
+        validator change, bisect (reference dynamic_verifier.go:190)."""
+        if trusted.height >= source_fc.height:
+            raise LiteError("fullCommit height must be greater than trusted")
+        sh = source_fc.signed_header
+        try:
+            if sh.header.validators_hash == trusted.next_validators.hash():
+                # adjacent or unchanged set: normal verify
+                trusted.next_validators.verify_commit(
+                    self.chain_id, sh.commit.block_id, sh.height, sh.commit
+                )
+            else:
+                trusted.next_validators.verify_future_commit(
+                    source_fc.validators,
+                    self.chain_id,
+                    sh.commit.block_id,
+                    sh.height,
+                    sh.commit,
+                )
+            self.headers_verified += 1
+        except TooMuchChangeError:
+            # bisect: trust the midpoint first (recursively), then retry
+            mid_h = (trusted.height + source_fc.height) // 2
+            if mid_h == trusted.height:
+                raise
+            self.log.debug("lite bisect", lo=trusted.height, hi=source_fc.height, mid=mid_h)
+            mid_fc = self.source.latest_full_commit(self.chain_id, mid_h, mid_h)
+            mid_fc.validate_full(self.chain_id)
+            self._verify_and_save(trusted, mid_fc)
+            mid_trusted = self.trusted.latest_full_commit(self.chain_id, mid_h, mid_h)
+            self._verify_and_save(mid_trusted, source_fc)
+            return
+        self.trusted.save_full_commit(source_fc)

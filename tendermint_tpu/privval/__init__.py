@@ -1,0 +1,221 @@
+"""privval — validator key management with double-sign protection.
+
+Reference parity: privval/file.go:137 — FilePV is a key file plus a
+last-sign-state file; it refuses to sign if (height, round, step)
+regresses, and allows re-signing only of a message identical to the last
+one except for its timestamp (:86,282-361,379). The last-sign-state file is
+the anti-double-sign checkpoint and is fsynced before the signature is
+returned (sign-then-persist would allow double signing across a crash).
+
+The remote-signer protocol lives in tendermint_tpu/privval/remote.py.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+from tendermint_tpu.crypto import ed25519
+from tendermint_tpu.types.priv_validator import PrivValidator
+from tendermint_tpu.types.vote import Proposal, Vote
+
+# sign-state steps (reference privval/file.go:41-45)
+STEP_PROPOSE = 1
+STEP_PREVOTE = 2
+STEP_PRECOMMIT = 3
+
+_VOTE_TYPE_TO_STEP = {1: STEP_PREVOTE, 2: STEP_PRECOMMIT}
+
+
+class DoubleSignError(Exception):
+    pass
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    """Write + fsync + rename so the file is never half-written."""
+    d = os.path.dirname(path) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".tmp-privval-")
+    try:
+        os.write(fd, data)
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    os.replace(tmp, path)
+
+
+class FilePVKey:
+    """Reference privval/file.go FilePVKey."""
+
+    def __init__(self, priv_key: ed25519.PrivKeyEd25519) -> None:
+        self.priv_key = priv_key
+        self.pub_key = priv_key.pub_key()
+        self.address = self.pub_key.address()
+
+    def save(self, path: str) -> None:
+        doc = {
+            "address": self.address.hex(),
+            "pub_key": self.pub_key.bytes().hex(),
+            "priv_key": self.priv_key.bytes().hex(),
+        }
+        _atomic_write(path, json.dumps(doc, indent=2).encode())
+
+    @classmethod
+    def load(cls, path: str) -> "FilePVKey":
+        with open(path, "rb") as f:
+            doc = json.loads(f.read())
+        key = cls(ed25519.PrivKeyEd25519(bytes.fromhex(doc["priv_key"])))
+        if key.pub_key.bytes().hex() != doc["pub_key"]:
+            raise ValueError(f"corrupt key file {path}: pub_key mismatch")
+        return key
+
+
+class FilePVLastSignState:
+    """Reference privval/file.go:69-135 FilePVLastSignState."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self.height = 0
+        self.round = 0
+        self.step = 0
+        self.signature: bytes = b""
+        self.sign_bytes: bytes = b""
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                doc = json.loads(f.read())
+            self.height = doc["height"]
+            self.round = doc["round"]
+            self.step = doc["step"]
+            self.signature = bytes.fromhex(doc.get("signature", ""))
+            self.sign_bytes = bytes.fromhex(doc.get("sign_bytes", ""))
+
+    def check_hrs(self, height: int, round_: int, step: int) -> bool:
+        """Reference file.go:86 CheckHRS. Returns True if (H,R,S) equals the
+        last signed (H,R,S) AND we have the last signature — the caller must
+        then verify the message differs only by timestamp. Raises on any
+        regression."""
+        if self.height > height:
+            raise DoubleSignError(f"height regression: {self.height} > {height}")
+        if self.height == height:
+            if self.round > round_:
+                raise DoubleSignError(
+                    f"round regression at height {height}: {self.round} > {round_}"
+                )
+            if self.round == round_:
+                if self.step > step:
+                    raise DoubleSignError(
+                        f"step regression at {height}/{round_}: {self.step} > {step}"
+                    )
+                if self.step == step:
+                    if not self.sign_bytes:
+                        raise DoubleSignError("no last signature to compare against")
+                    return True
+        return False
+
+    def save(self, height: int, round_: int, step: int, signature: bytes, sign_bytes: bytes) -> None:
+        self.height = height
+        self.round = round_
+        self.step = step
+        self.signature = signature
+        self.sign_bytes = sign_bytes
+        doc = {
+            "height": height,
+            "round": round_,
+            "step": step,
+            "signature": signature.hex(),
+            "sign_bytes": sign_bytes.hex(),
+        }
+        _atomic_write(self.path, json.dumps(doc, indent=2).encode())
+
+
+def _same_except_timestamp(last: bytes, new: bytes, chain_id: str) -> tuple[bool, int]:
+    """Reference file.go:379 checkVotesOnlyDifferByTimestamp. The CBE
+    canonical layout (types/vote.py canonical_*_sign_bytes) ends with
+    `timestamp u64 | chain_id (u32 len + utf8)`, so the timestamp sits 8
+    bytes before the chain-id suffix. Returns (same_otherwise,
+    last_timestamp_ns)."""
+    suffix = 4 + len(chain_id.encode("utf-8"))
+    ts_start = len(last) - suffix - 8
+    if len(last) != len(new) or ts_start < 0:
+        return False, 0
+    if last[:ts_start] != new[:ts_start] or last[ts_start + 8:] != new[ts_start + 8:]:
+        return False, 0
+    return True, int.from_bytes(last[ts_start:ts_start + 8], "big")
+
+
+class FilePV(PrivValidator):
+    """Reference privval/file.go:137."""
+
+    def __init__(self, key: FilePVKey, last_sign_state: FilePVLastSignState, key_path: str) -> None:
+        self.key = key
+        self.last_sign_state = last_sign_state
+        self.key_path = key_path
+
+    @classmethod
+    def generate(cls, key_path: str, state_path: str) -> "FilePV":
+        key = FilePVKey(ed25519.gen_priv_key())
+        key.save(key_path)
+        pv = cls(key, FilePVLastSignState(state_path), key_path)
+        pv.last_sign_state.save(0, 0, 0, b"", b"")
+        return pv
+
+    @classmethod
+    def load(cls, key_path: str, state_path: str) -> "FilePV":
+        return cls(FilePVKey.load(key_path), FilePVLastSignState(state_path), key_path)
+
+    @classmethod
+    def load_or_generate(cls, key_path: str, state_path: str) -> "FilePV":
+        if os.path.exists(key_path):
+            return cls.load(key_path, state_path)
+        return cls.generate(key_path, state_path)
+
+    def get_pub_key(self):
+        return self.key.pub_key
+
+    def sign_vote(self, chain_id: str, vote: Vote) -> Vote:
+        """Reference file.go:282 signVote."""
+        step = _VOTE_TYPE_TO_STEP[int(vote.type)]
+        lss = self.last_sign_state
+        same_hrs = lss.check_hrs(vote.height, vote.round, step)
+        sb = vote.sign_bytes(chain_id)
+        if same_hrs:
+            if sb == lss.sign_bytes:
+                return vote.with_signature(lss.signature)
+            same, last_ts = _same_except_timestamp(lss.sign_bytes, sb, chain_id)
+            if same:
+                # re-sign the old message (old timestamp) — reference :331
+                from dataclasses import replace
+
+                old_vote = replace(vote, timestamp=last_ts)
+                return old_vote.with_signature(lss.signature)
+            raise DoubleSignError(
+                f"conflicting vote data at {vote.height}/{vote.round}/{step}"
+            )
+        sig = self.key.priv_key.sign(sb)
+        lss.save(vote.height, vote.round, step, sig, sb)  # persist BEFORE returning
+        return vote.with_signature(sig)
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> Proposal:
+        """Reference file.go:336 signProposal."""
+        lss = self.last_sign_state
+        same_hrs = lss.check_hrs(proposal.height, proposal.round, STEP_PROPOSE)
+        sb = proposal.sign_bytes(chain_id)
+        if same_hrs:
+            if sb == lss.sign_bytes:
+                return proposal.with_signature(lss.signature)
+            same, last_ts = _same_except_timestamp(lss.sign_bytes, sb, chain_id)
+            if same:
+                from dataclasses import replace
+
+                old = replace(proposal, timestamp=last_ts)
+                return old.with_signature(lss.signature)
+            raise DoubleSignError(
+                f"conflicting proposal data at {proposal.height}/{proposal.round}"
+            )
+        sig = self.key.priv_key.sign(sb)
+        lss.save(proposal.height, proposal.round, STEP_PROPOSE, sig, sb)
+        return proposal.with_signature(sig)
+
+    def reset(self) -> None:
+        """Unsafe: wipe the sign state (reference ResetAll; only for
+        unsafe_reset_all)."""
+        self.last_sign_state.save(0, 0, 0, b"", b"")

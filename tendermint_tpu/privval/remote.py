@@ -1,0 +1,264 @@
+"""Remote signer protocol — keep validator keys in a separate process (KMS).
+
+Reference parity: privval/messages.go (req/resp union),
+privval/signer_client.go:14,91 (validator side), signer_server.go (KMS
+side), signer_listener_endpoint.go:18,155 (the validator LISTENS on
+priv_validator_laddr and the KMS DIALS IN, with ping keepalive and
+reconnect). Framing: u32 length prefix + CBE tagged union. Transport: tcp
+(optionally upgraded to a SecretConnection) or unix socket.
+"""
+from __future__ import annotations
+
+import asyncio
+
+from tendermint_tpu.encoding import DecodeError, Reader, Writer
+from tendermint_tpu.libs.log import NOP, Logger
+from tendermint_tpu.libs.service import BaseService
+from tendermint_tpu.types.priv_validator import PrivValidator
+from tendermint_tpu.types.vote import Proposal, Vote
+from tendermint_tpu.crypto import ed25519
+
+PING_INTERVAL = 10.0
+READ_TIMEOUT = 5.0
+
+# message tags
+_PUBKEY_REQ = 1
+_PUBKEY_RESP = 2
+_SIGN_VOTE_REQ = 3
+_SIGNED_VOTE_RESP = 4
+_SIGN_PROPOSAL_REQ = 5
+_SIGNED_PROPOSAL_RESP = 6
+_PING_REQ = 7
+_PING_RESP = 8
+_ERROR_RESP = 9
+
+
+class RemoteSignerError(Exception):
+    pass
+
+
+def _frame(payload: bytes) -> bytes:
+    return Writer().u32(len(payload)).raw(payload).build()
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> bytes:
+    hdr = await reader.readexactly(4)
+    n = int.from_bytes(hdr, "big")
+    if n > (1 << 20):
+        raise DecodeError(f"remote signer frame too large: {n}")
+    return await reader.readexactly(n)
+
+
+def encode_signer_message(tag: int, chain_id: str = "", msg=None, err: str = "") -> bytes:
+    w = Writer().u8(tag)
+    if tag in (_SIGN_VOTE_REQ, _SIGN_PROPOSAL_REQ):
+        w.str(chain_id).bytes(msg.encode())
+    elif tag == _SIGNED_VOTE_RESP or tag == _SIGNED_PROPOSAL_RESP:
+        w.bytes(msg.encode())
+    elif tag == _PUBKEY_RESP:
+        w.bytes(msg.bytes())
+    elif tag == _ERROR_RESP:
+        w.str(err)
+    return w.build()
+
+
+def decode_signer_message(data: bytes):
+    """Returns (tag, payload) where payload depends on tag."""
+    r = Reader(data)
+    tag = r.u8()
+    if tag in (_SIGN_VOTE_REQ, _SIGN_PROPOSAL_REQ):
+        chain_id = r.str()
+        raw = r.bytes()
+        obj = Vote.decode(raw) if tag == _SIGN_VOTE_REQ else Proposal.decode(raw)
+        r.expect_done()
+        return tag, (chain_id, obj)
+    if tag in (_SIGNED_VOTE_RESP, _SIGNED_PROPOSAL_RESP):
+        raw = r.bytes()
+        obj = Vote.decode(raw) if tag == _SIGNED_VOTE_RESP else Proposal.decode(raw)
+        r.expect_done()
+        return tag, obj
+    if tag == _PUBKEY_RESP:
+        pk = ed25519.PubKeyEd25519(r.bytes())
+        r.expect_done()
+        return tag, pk
+    if tag == _ERROR_RESP:
+        err = r.str()
+        r.expect_done()
+        return tag, err
+    if tag in (_PUBKEY_REQ, _PING_REQ, _PING_RESP):
+        r.expect_done()
+        return tag, None
+    raise DecodeError(f"unknown signer message tag {tag}")
+
+
+class SignerListenerEndpoint(BaseService):
+    """Validator side: listens on an address, accepts ONE signer connection
+    at a time, and exposes a request/response API (reference
+    signer_listener_endpoint.go:18)."""
+
+    def __init__(self, host: str, port: int, logger: Logger = NOP) -> None:
+        super().__init__("SignerListenerEndpoint")
+        self.host, self.port = host, port
+        self.log = logger
+        self._server: asyncio.Server | None = None
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._connected = asyncio.Event()
+        self._io_lock = asyncio.Lock()
+
+    @property
+    def listen_port(self) -> int:
+        assert self._server is not None
+        return self._server.sockets[0].getsockname()[1]
+
+    async def on_start(self) -> None:
+        self._server = await asyncio.start_server(self._on_conn, self.host, self.port)
+
+    async def on_stop(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        if self._connected.is_set():
+            writer.close()  # single active signer connection
+            return
+        self.log.info("remote signer connected")
+        self._reader, self._writer = reader, writer
+        self._connected.set()
+
+    async def wait_for_conn(self, timeout: float = 30.0) -> None:
+        await asyncio.wait_for(self._connected.wait(), timeout)
+
+    async def request(self, payload: bytes) -> tuple[int, object]:
+        """Send one framed request, wait for the framed response."""
+        async with self._io_lock:
+            if not self._connected.is_set():
+                raise RemoteSignerError("no signer connected")
+            try:
+                self._writer.write(_frame(payload))
+                await self._writer.drain()
+                resp = await asyncio.wait_for(_read_frame(self._reader), READ_TIMEOUT)
+            except (asyncio.IncompleteReadError, asyncio.TimeoutError, OSError) as e:
+                self._connected.clear()
+                raise RemoteSignerError(f"signer connection failed: {e!r}") from e
+        tag, obj = decode_signer_message(resp)
+        if tag == _ERROR_RESP:
+            raise RemoteSignerError(str(obj))
+        return tag, obj
+
+
+class SignerClient(PrivValidator):
+    """The PrivValidator the node uses when keys are remote (reference
+    signer_client.go:91). Synchronous interface over the async endpoint —
+    consensus calls sign_vote/sign_proposal from within the event loop, so
+    these are async-under-the-hood via the endpoint's request()."""
+
+    def __init__(self, endpoint: SignerListenerEndpoint) -> None:
+        self.endpoint = endpoint
+        self._pub_key = None
+
+    async def fetch_pub_key(self):
+        tag, pk = await self.endpoint.request(encode_signer_message(_PUBKEY_REQ))
+        if tag != _PUBKEY_RESP:
+            raise RemoteSignerError(f"unexpected response tag {tag}")
+        self._pub_key = pk
+        return pk
+
+    def get_pub_key(self):
+        if self._pub_key is None:
+            raise RemoteSignerError("pub key not fetched yet (call fetch_pub_key)")
+        return self._pub_key
+
+    async def sign_vote_async(self, chain_id: str, vote: Vote) -> Vote:
+        tag, v = await self.endpoint.request(
+            encode_signer_message(_SIGN_VOTE_REQ, chain_id, vote)
+        )
+        if tag != _SIGNED_VOTE_RESP:
+            raise RemoteSignerError(f"unexpected response tag {tag}")
+        return v
+
+    async def sign_proposal_async(self, chain_id: str, proposal: Proposal) -> Proposal:
+        tag, p = await self.endpoint.request(
+            encode_signer_message(_SIGN_PROPOSAL_REQ, chain_id, proposal)
+        )
+        if tag != _SIGNED_PROPOSAL_RESP:
+            raise RemoteSignerError(f"unexpected response tag {tag}")
+        return p
+
+    async def ping(self) -> None:
+        tag, _ = await self.endpoint.request(encode_signer_message(_PING_REQ))
+        if tag != _PING_RESP:
+            raise RemoteSignerError(f"unexpected ping response tag {tag}")
+
+    # sync PrivValidator interface: only usable via the async variants;
+    # consensus detects and awaits these (see ConsensusState.sign_vote).
+    def sign_vote(self, chain_id: str, vote: Vote) -> Vote:
+        raise RemoteSignerError("use sign_vote_async for remote signers")
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> Proposal:
+        raise RemoteSignerError("use sign_proposal_async for remote signers")
+
+
+class SignerServer(BaseService):
+    """KMS side: dials the validator and serves signing requests from a
+    local PrivValidator (reference signer_server.go + signer_dialer_endpoint).
+    """
+
+    def __init__(
+        self, host: str, port: int, pv: PrivValidator, logger: Logger = NOP,
+        retry_interval: float = 0.5, max_retries: int = 20,
+    ) -> None:
+        super().__init__("SignerServer")
+        self.host, self.port = host, port
+        self.pv = pv
+        self.log = logger
+        self.retry_interval = retry_interval
+        self.max_retries = max_retries
+
+    async def on_start(self) -> None:
+        for attempt in range(self.max_retries):
+            try:
+                reader, writer = await asyncio.open_connection(self.host, self.port)
+                break
+            except OSError:
+                await asyncio.sleep(self.retry_interval)
+        else:
+            raise RemoteSignerError(f"cannot reach validator at {self.host}:{self.port}")
+        self._writer = writer
+        self.spawn(self._serve(reader, writer), "signer-serve")
+
+    async def on_stop(self) -> None:
+        self._writer.close()
+
+    async def _serve(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        while True:
+            try:
+                req = await _read_frame(reader)
+            except (asyncio.IncompleteReadError, OSError):
+                self.log.info("validator connection closed")
+                return
+            writer.write(_frame(self._handle(req)))
+            await writer.drain()
+
+    def _handle(self, req: bytes) -> bytes:
+        """Reference signer_requestHandler.go DefaultValidationRequestHandler."""
+        try:
+            tag, payload = decode_signer_message(req)
+            if tag == _PUBKEY_REQ:
+                return encode_signer_message(_PUBKEY_RESP, msg=self.pv.get_pub_key())
+            if tag == _PING_REQ:
+                return encode_signer_message(_PING_RESP)
+            if tag == _SIGN_VOTE_REQ:
+                chain_id, vote = payload
+                signed = self.pv.sign_vote(chain_id, vote)
+                return encode_signer_message(_SIGNED_VOTE_RESP, msg=signed)
+            if tag == _SIGN_PROPOSAL_REQ:
+                chain_id, proposal = payload
+                signed = self.pv.sign_proposal(chain_id, proposal)
+                return encode_signer_message(_SIGNED_PROPOSAL_RESP, msg=signed)
+            return encode_signer_message(_ERROR_RESP, err=f"unexpected request tag {tag}")
+        except Exception as e:
+            return encode_signer_message(_ERROR_RESP, err=str(e))

@@ -1,0 +1,185 @@
+"""Light-client tests — the reference's lite/dynamic_verifier_test.go
+pattern: a synthetic header chain with evolving validator sets, verified
+through bisection."""
+import pytest
+
+from tendermint_tpu.libs.db import MemDB
+from tendermint_tpu.lite import (
+    BaseVerifier,
+    DBProvider,
+    DynamicVerifier,
+    FullCommit,
+    LiteError,
+    MissingHeaderError,
+    MultiProvider,
+    UpdatingProvider,
+)
+from tendermint_tpu.types import BlockID, MockPV, PartSetHeader
+from tendermint_tpu.types.block import Commit, Header, SignedHeader
+from tendermint_tpu.types.validator import Validator
+from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.types.vote import Vote, VoteType
+
+CHAIN_ID = "lite-test-chain"
+
+
+class ChainBuilder:
+    """Synthetic chain: at each height, `churn` validators are replaced, so
+    jumping k heights loses ~k*churn/n of the signing power overlap."""
+
+    def __init__(self, n_vals: int = 4, churn: int = 0):
+        self.churn = churn
+        self.pvs = [MockPV() for _ in range(n_vals)]
+        self.heights: dict[int, FullCommit] = {}
+        self._valsets: dict[int, tuple[list, ValidatorSet]] = {}
+
+    def _vals_at(self, height: int) -> tuple[list, ValidatorSet]:
+        if height not in self._valsets:
+            if height == 1 or self.churn == 0:
+                pvs = list(self.pvs)
+            else:
+                prev_pvs, _ = self._vals_at(height - 1)
+                pvs = list(prev_pvs)
+                for i in range(self.churn):
+                    pvs[(height + i) % len(pvs)] = MockPV()
+            # keep pvs in validator-set order (sorted by address) so commit
+            # slot i is signed by validator i
+            pvs = sorted(pvs, key=lambda pv: pv.get_pub_key().address())
+            vs = ValidatorSet([Validator(pv.get_pub_key(), 10) for pv in pvs])
+            self._valsets[height] = (pvs, vs)
+        return self._valsets[height]
+
+    def build(self, max_height: int) -> None:
+        for h in range(1, max_height + 1):
+            pvs, vals = self._vals_at(h)
+            _, next_vals = self._vals_at(h + 1)
+            header = Header(
+                chain_id=CHAIN_ID,
+                height=h,
+                time=1_700_000_000_000_000_000 + h,
+                validators_hash=vals.hash(),
+                next_validators_hash=next_vals.hash(),
+                app_hash=b"\x01" * 32,
+                proposer_address=vals.validators[0].address,
+            )
+            bid = BlockID(header.hash(), PartSetHeader(1, b"\x77" * 32))
+            precommits = []
+            for i, pv in enumerate(pvs):
+                v = Vote(
+                    VoteType.PRECOMMIT, h, 0, bid, header.time + 1,
+                    pv.get_pub_key().address(), i,
+                )
+                precommits.append(pv.sign_vote(CHAIN_ID, v))
+            commit = Commit(bid, precommits)
+            self.heights[h] = FullCommit(SignedHeader(header, commit), vals, next_vals)
+
+    # -- Provider interface -------------------------------------------
+
+    def latest_full_commit(self, chain_id: str, min_height: int, max_height: int) -> FullCommit:
+        hs = [h for h in self.heights if min_height <= h <= (max_height or 1 << 62)]
+        if not hs:
+            raise MissingHeaderError(f"[{min_height},{max_height}]")
+        return self.heights[max(hs)]
+
+    def validator_set(self, chain_id: str, height: int):
+        fc = self.heights.get(height)
+        return fc.validators if fc else None
+
+
+class TestBaseVerifier:
+    def test_verifies_good_header(self):
+        chain = ChainBuilder()
+        chain.build(3)
+        fc = chain.heights[2]
+        BaseVerifier(CHAIN_ID, 1, fc.validators).verify(fc.signed_header)
+
+    def test_rejects_wrong_chain_and_valset(self):
+        chain = ChainBuilder()
+        chain.build(2)
+        fc = chain.heights[2]
+        other = ValidatorSet([Validator(MockPV().get_pub_key(), 10)])
+        with pytest.raises(LiteError):
+            BaseVerifier("other-chain", 1, fc.validators).verify(fc.signed_header)
+        with pytest.raises(LiteError):
+            BaseVerifier(CHAIN_ID, 1, other).verify(fc.signed_header)
+
+
+class TestDBProvider:
+    def test_save_latest_prune(self):
+        chain = ChainBuilder()
+        chain.build(6)
+        p = DBProvider("test", MemDB(), limit=3)
+        for h in range(1, 6):
+            p.save_full_commit(chain.heights[h])
+        got = p.latest_full_commit(CHAIN_ID, 1, 1 << 62)
+        assert got.height == 5
+        assert p.latest_full_commit(CHAIN_ID, 1, 4).height == 4
+        # pruned to 3: height 1 and 2 gone
+        with pytest.raises(MissingHeaderError):
+            p.latest_full_commit(CHAIN_ID, 1, 2)
+        # round-trip integrity
+        assert got.signed_header.header.hash() == chain.heights[5].signed_header.header.hash()
+        assert got.validators.hash() == chain.heights[5].validators.hash()
+
+    def test_multiprovider_prefers_highest(self):
+        chain = ChainBuilder()
+        chain.build(4)
+        a, b = DBProvider("a", MemDB()), DBProvider("b", MemDB())
+        a.save_full_commit(chain.heights[2])
+        b.save_full_commit(chain.heights[4])
+        mp = MultiProvider(a, b)
+        assert mp.latest_full_commit(CHAIN_ID, 1, 1 << 62).height == 4
+
+
+class TestDynamicVerifier:
+    def _setup(self, churn: int, max_height: int):
+        chain = ChainBuilder(n_vals=4, churn=churn)
+        chain.build(max_height)
+        trusted = DBProvider("trusted", MemDB())
+        trusted.save_full_commit(chain.heights[1])
+        dv = DynamicVerifier(CHAIN_ID, trusted, chain)
+        return chain, trusted, dv
+
+    def test_stable_valset_one_jump(self):
+        chain, trusted, dv = self._setup(churn=0, max_height=50)
+        dv.verify(chain.heights[50].signed_header)
+        # one jump to 49 + the target certify — no bisection needed
+        assert dv.headers_verified == 2
+
+    def test_bisection_through_churn(self):
+        # churn 1/4 per height: a >2-height jump drops below 2/3 overlap,
+        # forcing recursive bisection down to small steps
+        chain, trusted, dv = self._setup(churn=1, max_height=17)
+        dv.verify(chain.heights[17].signed_header)
+        assert dv.headers_verified > 2  # bisection happened
+        # the trusted store now holds height 16
+        assert trusted.latest_full_commit(CHAIN_ID, 1, 1 << 62).height == 16
+
+    def test_rejects_forged_header(self):
+        chain, trusted, dv = self._setup(churn=0, max_height=10)
+        good = chain.heights[10].signed_header
+        forged_header = Header(
+            chain_id=CHAIN_ID,
+            height=10,
+            time=good.header.time,
+            validators_hash=good.header.validators_hash,
+            next_validators_hash=good.header.next_validators_hash,
+            app_hash=b"\xFF" * 32,  # attacker changes the app hash
+            proposer_address=good.header.proposer_address,
+        )
+        forged = SignedHeader(forged_header, good.commit)
+        with pytest.raises((LiteError, ValueError)):
+            dv.verify(forged)
+
+    def test_rejects_insufficient_power(self):
+        chain, trusted, dv = self._setup(churn=0, max_height=5)
+        fc = chain.heights[5]
+        # strip signatures below quorum: keep only 2 of 4
+        stripped = Commit(
+            fc.signed_header.commit.block_id,
+            [p if i < 2 else None for i, p in enumerate(fc.signed_header.commit.precommits)],
+        )
+        from tendermint_tpu.types.validator_set import VerifyError
+
+        with pytest.raises(VerifyError):
+            dv.verify(SignedHeader(fc.signed_header.header, stripped))

@@ -1,0 +1,183 @@
+"""privval tests — the reference's privval/file_test.go double-sign matrix
+and a remote-signer round trip (signer_client_test.go pattern)."""
+import asyncio
+import os
+from dataclasses import replace
+
+import pytest
+
+from tendermint_tpu.privval import (
+    STEP_PRECOMMIT,
+    STEP_PREVOTE,
+    DoubleSignError,
+    FilePV,
+)
+from tendermint_tpu.privval.remote import (
+    SignerClient,
+    SignerListenerEndpoint,
+    SignerServer,
+)
+from tendermint_tpu.types import BlockID, MockPV, PartSetHeader
+from tendermint_tpu.types.vote import Proposal, Vote, VoteType
+
+CHAIN_ID = "pv-test-chain"
+BID = BlockID(b"\x11" * 32, PartSetHeader(1, b"\x22" * 32))
+BID2 = BlockID(b"\x33" * 32, PartSetHeader(1, b"\x44" * 32))
+
+
+def make_vote(height=1, round_=0, type_=VoteType.PREVOTE, bid=BID, ts=1000, pv=None):
+    addr = pv.get_pub_key().address() if pv else b"\x00" * 20
+    return Vote(type_, height, round_, bid, ts, addr, 0)
+
+
+class TestFilePV:
+    def _pv(self, tmp_path):
+        return FilePV.generate(
+            os.path.join(tmp_path, "priv_key.json"),
+            os.path.join(tmp_path, "priv_state.json"),
+        )
+
+    def test_generate_load_roundtrip(self, tmp_path):
+        pv = self._pv(tmp_path)
+        pv2 = FilePV.load(
+            os.path.join(tmp_path, "priv_key.json"),
+            os.path.join(tmp_path, "priv_state.json"),
+        )
+        assert pv.get_pub_key().bytes() == pv2.get_pub_key().bytes()
+
+    def test_sign_vote_and_persist(self, tmp_path):
+        pv = self._pv(tmp_path)
+        v = make_vote(pv=pv)
+        signed = pv.sign_vote(CHAIN_ID, v)
+        assert pv.get_pub_key().verify(v.sign_bytes(CHAIN_ID), signed.signature)
+        assert pv.last_sign_state.height == 1
+        assert pv.last_sign_state.step == STEP_PREVOTE
+        # state survives reload
+        pv2 = FilePV.load(
+            os.path.join(tmp_path, "priv_key.json"),
+            os.path.join(tmp_path, "priv_state.json"),
+        )
+        assert pv2.last_sign_state.height == 1
+        assert pv2.last_sign_state.signature == signed.signature
+
+    def test_height_round_step_regression_refused(self, tmp_path):
+        pv = self._pv(tmp_path)
+        pv.sign_vote(CHAIN_ID, make_vote(height=5, round_=3, type_=VoteType.PRECOMMIT, pv=pv))
+        with pytest.raises(DoubleSignError):
+            pv.sign_vote(CHAIN_ID, make_vote(height=4, round_=3, pv=pv))
+        with pytest.raises(DoubleSignError):
+            pv.sign_vote(CHAIN_ID, make_vote(height=5, round_=2, pv=pv))
+        with pytest.raises(DoubleSignError):  # step regression: precommit -> prevote
+            pv.sign_vote(CHAIN_ID, make_vote(height=5, round_=3, type_=VoteType.PREVOTE, pv=pv))
+
+    def test_conflicting_block_refused(self, tmp_path):
+        pv = self._pv(tmp_path)
+        pv.sign_vote(CHAIN_ID, make_vote(bid=BID, pv=pv))
+        with pytest.raises(DoubleSignError):
+            pv.sign_vote(CHAIN_ID, make_vote(bid=BID2, pv=pv))
+
+    def test_idempotent_resign_same_message(self, tmp_path):
+        pv = self._pv(tmp_path)
+        v = make_vote(pv=pv)
+        s1 = pv.sign_vote(CHAIN_ID, v)
+        s2 = pv.sign_vote(CHAIN_ID, v)
+        assert s1.signature == s2.signature
+
+    def test_timestamp_only_change_reuses_signature(self, tmp_path):
+        pv = self._pv(tmp_path)
+        v = make_vote(ts=1000, pv=pv)
+        s1 = pv.sign_vote(CHAIN_ID, v)
+        v2 = replace(v, timestamp=2000)
+        s2 = pv.sign_vote(CHAIN_ID, v2)
+        # reference behavior: re-sign the OLD message — old ts, old signature
+        assert s2.timestamp == 1000
+        assert s2.signature == s1.signature
+
+    def test_proposal_signing(self, tmp_path):
+        pv = self._pv(tmp_path)
+        p = Proposal(7, 0, -1, BID, 1234)
+        signed = pv.sign_proposal(CHAIN_ID, p)
+        assert pv.get_pub_key().verify(p.sign_bytes(CHAIN_ID), signed.signature)
+        # vote at same height/round is a later step: allowed
+        pv.sign_vote(CHAIN_ID, make_vote(height=7, round_=0, pv=pv))
+        # but another proposal at the same HRS with different block: refused
+        with pytest.raises(DoubleSignError):
+            pv.sign_proposal(CHAIN_ID, Proposal(7, 0, -1, BID2, 1234))
+
+
+class TestRemoteSigner:
+    def test_end_to_end_sign(self):
+        async def main():
+            endpoint = SignerListenerEndpoint("127.0.0.1", 0)
+            await endpoint.start()
+            server = SignerServer("127.0.0.1", endpoint.listen_port, MockPV())
+            await server.start()
+            try:
+                await endpoint.wait_for_conn(5.0)
+                client = SignerClient(endpoint)
+                pk = await client.fetch_pub_key()
+                assert client.get_pub_key().bytes() == pk.bytes()
+                await client.ping()
+
+                v = make_vote(ts=42)
+                v = replace(v, validator_address=pk.address())
+                signed = await client.sign_vote_async(CHAIN_ID, v)
+                assert pk.verify(v.sign_bytes(CHAIN_ID), signed.signature)
+
+                p = Proposal(1, 0, -1, BID, 42)
+                sp = await client.sign_proposal_async(CHAIN_ID, p)
+                assert pk.verify(p.sign_bytes(CHAIN_ID), sp.signature)
+            finally:
+                await server.stop()
+                await endpoint.stop()
+
+        asyncio.run(main())
+
+    def test_error_response(self):
+        async def main():
+            from tendermint_tpu.privval.remote import RemoteSignerError
+            from tendermint_tpu.types.priv_validator import ErroringMockPV
+
+            endpoint = SignerListenerEndpoint("127.0.0.1", 0)
+            await endpoint.start()
+            server = SignerServer("127.0.0.1", endpoint.listen_port, ErroringMockPV())
+            await server.start()
+            try:
+                await endpoint.wait_for_conn(5.0)
+                client = SignerClient(endpoint)
+                with pytest.raises(RemoteSignerError):
+                    await client.sign_vote_async(CHAIN_ID, make_vote())
+            finally:
+                await server.stop()
+                await endpoint.stop()
+
+        asyncio.run(main())
+
+    def test_consensus_with_remote_signer(self, tmp_path):
+        """A full consensus node whose validator key lives behind the remote
+        signer protocol (reference: node + tm-signer-harness)."""
+        import sys
+
+        sys.path.insert(0, os.path.dirname(__file__))
+        from test_consensus import Fixture
+
+        async def main():
+            endpoint = SignerListenerEndpoint("127.0.0.1", 0)
+            await endpoint.start()
+            local_pv = MockPV()
+            server = SignerServer("127.0.0.1", endpoint.listen_port, local_pv)
+            await server.start()
+            await endpoint.wait_for_conn(5.0)
+            client = SignerClient(endpoint)
+            await client.fetch_pub_key()
+
+            fx = Fixture(str(tmp_path), pvs=[client], use_wal=False)
+            await fx.start()
+            try:
+                await fx.wait_for_height(3)
+            finally:
+                await fx.stop()
+                await server.stop()
+                await endpoint.stop()
+
+        asyncio.run(main())
