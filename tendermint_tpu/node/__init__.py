@@ -1,0 +1,311 @@
+"""Node — the composition root.
+
+Reference parity: node/node.go:538 NewNode build order (DBs → state →
+proxyApp+handshake → EventBus/indexer → mempool/evidence/blockExec/
+blockchain/consensus reactors → transport+switch+addrbook+PEX → RPC) and
+node.go:729 OnStart order (RPC first so txs can arrive before p2p, then
+transport listen, switch start, dial persistent peers).
+"""
+from __future__ import annotations
+
+import os
+
+from tendermint_tpu import proxy
+from tendermint_tpu.blockchain.reactor import BlockchainReactor
+from tendermint_tpu.config import Config
+from tendermint_tpu.consensus.reactor import ConsensusReactor
+from tendermint_tpu.consensus.replay import Handshaker
+from tendermint_tpu.consensus.state import ConsensusState
+from tendermint_tpu.consensus.wal import WAL
+from tendermint_tpu.evidence import EvidencePool
+from tendermint_tpu.evidence.reactor import EvidenceReactor
+from tendermint_tpu.libs.db import DB, MemDB, SQLiteDB
+from tendermint_tpu.libs.log import NOP, Logger
+from tendermint_tpu.libs.service import BaseService
+from tendermint_tpu.mempool import CListMempool
+from tendermint_tpu.mempool.reactor import MempoolReactor
+from tendermint_tpu.p2p.key import NodeKey
+from tendermint_tpu.p2p.netaddress import NetAddress
+from tendermint_tpu.p2p.node_info import NodeInfo
+from tendermint_tpu.p2p.pex.addrbook import AddrBook
+from tendermint_tpu.p2p.pex.pex_reactor import PexReactor
+from tendermint_tpu.p2p.switch import Switch
+from tendermint_tpu.p2p.transport import Transport
+from tendermint_tpu.privval import FilePV
+from tendermint_tpu.rpc.core import Environment
+from tendermint_tpu.rpc.jsonrpc import JSONRPCServer
+from tendermint_tpu.state import StateStore, load_state_from_db_or_genesis
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.state.txindex import IndexerService, KVTxIndexer, NullTxIndexer
+from tendermint_tpu.store import BlockStore
+from tendermint_tpu.types.event_bus import EventBus
+from tendermint_tpu.types.genesis import GenesisDoc
+
+
+def parse_laddr(laddr: str) -> tuple[str, int]:
+    """'tcp://0.0.0.0:26656' -> ('0.0.0.0', 26656)."""
+    s = laddr.split("://", 1)[-1]
+    host, _, port = s.rpartition(":")
+    return host or "0.0.0.0", int(port)
+
+
+def _open_db(cfg: Config, name: str) -> DB:
+    if cfg.base.db_backend == "mem":
+        return MemDB()
+    os.makedirs(cfg.db_dir, exist_ok=True)
+    return SQLiteDB(os.path.join(cfg.db_dir, f"{name}.db"))
+
+
+class Node(BaseService):
+    """Reference node/node.go Node."""
+
+    def __init__(
+        self,
+        config: Config,
+        *,
+        genesis_doc: GenesisDoc | None = None,
+        priv_validator=None,
+        node_key: NodeKey | None = None,
+        app=None,
+        logger: Logger = NOP,
+    ) -> None:
+        super().__init__("Node")
+        self.config = config
+        self.log = logger
+        self.genesis_doc = genesis_doc or GenesisDoc.from_file(config.genesis_path)
+        self.genesis_doc.validate_and_complete()
+        if priv_validator is not None:
+            self.priv_validator = priv_validator
+        elif config.base.priv_validator_laddr:
+            self.priv_validator = None  # wired to a remote signer in on_start
+        else:
+            self.priv_validator = FilePV.load_or_generate(
+                config.priv_validator_key_path, config.priv_validator_state_path
+            )
+        self.node_key = node_key or NodeKey.load_or_gen(config.node_key_path)
+        self._app = app
+        self._built = False
+
+    # ------------------------------------------------------------------
+
+    async def build(self) -> None:
+        """The NewNode build sequence; async because the ABCI handshake
+        talks to the app."""
+        cfg = self.config
+        log = self.log
+
+        # 1. DBs
+        self.block_store_db = _open_db(cfg, "blockstore")
+        self.state_db = _open_db(cfg, "state")
+        self.block_store = BlockStore(self.block_store_db)
+        self.state_store = StateStore(self.state_db)
+
+        # 2. state
+        state = load_state_from_db_or_genesis(self.state_db, self.genesis_doc)
+
+        # 3. proxy app + handshake (replay to sync app with store)
+        creator = proxy.default_client_creator(cfg.base.proxy_app, app=self._app)
+        self.proxy_app = proxy.AppConns(creator)
+        await self.proxy_app.start()
+        handshaker = Handshaker(
+            self.state_store, state, self.block_store, self.genesis_doc, logger=log
+        )
+        state = await handshaker.handshake(self.proxy_app)
+        self.state = state
+
+        # 4. event bus + indexer
+        self.event_bus = EventBus()
+        await self.event_bus.start()
+        if cfg.tx_index.indexer == "kv":
+            self.tx_indexer = KVTxIndexer(_open_db(cfg, "txindex"))
+        else:
+            self.tx_indexer = NullTxIndexer()
+        self.indexer_service = IndexerService(self.tx_indexer, self.event_bus)
+        await self.indexer_service.start()
+
+        # 5. mempool, evidence
+        self.mempool = CListMempool(
+            self.proxy_app.mempool,
+            height=state.last_block_height,
+            max_txs=cfg.mempool.size,
+            max_txs_bytes=cfg.mempool.max_txs_bytes,
+            cache_size=cfg.mempool.cache_size,
+            recheck=cfg.mempool.recheck,
+            wal_path=os.path.join(cfg.root_dir, cfg.mempool.wal_dir)
+            if cfg.mempool.wal_dir
+            else None,
+            logger=log,
+        )
+        self.evidence_pool = EvidencePool(
+            _open_db(cfg, "evidence"), self.state_store, state, logger=log
+        )
+
+        # 6. block executor + reactors
+        self.block_exec = BlockExecutor(
+            self.state_store,
+            self.proxy_app.consensus,
+            mempool=self.mempool,
+            evidence_pool=self.evidence_pool,
+            event_bus=self.event_bus,
+            logger=log,
+        )
+
+        fast_sync = cfg.base.fast_sync and self._consensus_possible(state)
+        self.bc_reactor = BlockchainReactor(
+            state, self.block_exec, self.block_store, fast_sync=fast_sync, logger=log
+        )
+
+        wal_dir = os.path.dirname(cfg.wal_path)
+        os.makedirs(wal_dir, exist_ok=True)
+        self.consensus_state = ConsensusState(
+            cfg.consensus,
+            state,
+            self.block_exec,
+            self.block_store,
+            mempool=self.mempool,
+            evidence_pool=self.evidence_pool,
+            priv_validator=self.priv_validator,
+            wal=WAL(cfg.wal_path),
+            event_bus=self.event_bus,
+            logger=log,
+        )
+        self.consensus_reactor = ConsensusReactor(
+            self.consensus_state, fast_sync=fast_sync, logger=log
+        )
+        self.mempool_reactor = MempoolReactor(
+            self.mempool, broadcast=cfg.mempool.broadcast, logger=log
+        )
+        self.evidence_reactor = EvidenceReactor(self.evidence_pool, logger=log)
+
+        # 7. transport + switch + addrbook + pex
+        reactors = {
+            "MEMPOOL": self.mempool_reactor,
+            "BLOCKCHAIN": self.bc_reactor,
+            "CONSENSUS": self.consensus_reactor,
+            "EVIDENCE": self.evidence_reactor,
+        }
+        self.addr_book = AddrBook(
+            cfg._abs(cfg.p2p.addr_book_file), our_ids={self.node_key.id()}
+        )
+        if cfg.p2p.pex:
+            self.pex_reactor = PexReactor(self.addr_book, seed_mode=cfg.p2p.seed_mode)
+            reactors["PEX"] = self.pex_reactor
+
+        host, port = parse_laddr(cfg.p2p.laddr)
+        channels = bytes(d.id for r in reactors.values() for d in r.get_channels())
+        node_info = NodeInfo(
+            node_id=self.node_key.id(),
+            listen_addr=cfg.p2p.laddr,
+            network=self.genesis_doc.chain_id,
+            version="tendermint-tpu/0.1",
+            channels=channels,
+            moniker=cfg.base.moniker,
+        )
+        self.transport = Transport(
+            self.node_key, node_info, handshake_timeout=cfg.p2p.handshake_timeout
+        )
+        self.switch = Switch(
+            self.transport,
+            max_inbound_peers=cfg.p2p.max_num_inbound_peers,
+            max_outbound_peers=cfg.p2p.max_num_outbound_peers,
+        )
+        self.switch.addr_book = self.addr_book
+        for name, r in reactors.items():
+            self.switch.add_reactor(name, r)
+        self._p2p_host, self._p2p_port = host, port
+
+        # 8. RPC
+        pv_pub = None
+        if self.priv_validator is not None:
+            try:
+                pv_pub = self.priv_validator.get_pub_key()
+            except Exception:
+                pv_pub = None
+        self.rpc_env = Environment(
+            config=cfg,
+            state_store=self.state_store,
+            block_store=self.block_store,
+            consensus_state=self.consensus_state,
+            consensus_reactor=self.consensus_reactor,
+            mempool=self.mempool,
+            evidence_pool=self.evidence_pool,
+            p2p_switch=self.switch,
+            proxy_app_query=self.proxy_app.query,
+            tx_indexer=self.tx_indexer,
+            event_bus=self.event_bus,
+            genesis_doc=self.genesis_doc,
+            node_info=node_info,
+            priv_validator_pub_key=pv_pub,
+            logger=log,
+        )
+        rpc_host, rpc_port = parse_laddr(cfg.rpc.laddr)
+        self.rpc_server = JSONRPCServer(rpc_host, rpc_port, logger=log)
+        self.rpc_server.register_routes(self.rpc_env.routes())
+        self._built = True
+
+    def _consensus_possible(self, state) -> bool:
+        """Fast-sync only makes sense if we aren't the sole validator
+        (reference node.go:88 DefaultNewNode → consensus.go fastSync &&
+        !onlyValidatorIsUs)."""
+        if self.priv_validator is None:
+            return True
+        try:
+            addr = self.priv_validator.get_pub_key().address()
+        except Exception:
+            return True
+        vals = state.validators
+        if vals is None or vals.size() != 1:
+            return True
+        _, val = vals.get_by_address(addr)
+        return val is None
+
+    # ------------------------------------------------------------------
+
+    async def on_start(self) -> None:
+        if not self._built:
+            await self.build()
+        # RPC first (reference node.go:729 — receive txs before p2p is up)
+        await self.rpc_server.start()
+        await self.transport.listen(NetAddress("", self._p2p_host, self._p2p_port))
+        await self.switch.start()
+        if self.config.p2p.persistent_peers:
+            addrs = [
+                _parse_peer_addr(s)
+                for s in self.config.p2p.persistent_peers.split(",")
+                if s.strip()
+            ]
+            await self.switch.dial_peers_async(addrs, persistent=True)
+
+    async def on_stop(self) -> None:
+        await self.switch.stop()
+        await self.rpc_server.stop()
+        if self.consensus_state.is_running:
+            await self.consensus_state.stop()
+        await self.indexer_service.stop()
+        await self.event_bus.stop()
+        await self.proxy_app.stop()
+        self.consensus_state.wal.close()
+        self.addr_book.save()
+        for db in (self.block_store_db, self.state_db):
+            db.close()
+
+    # convenience accessors (reference node.go getters)
+
+    @property
+    def rpc_port(self) -> int:
+        return self.rpc_server.listen_port
+
+    @property
+    def p2p_addr(self) -> NetAddress | None:
+        return self.transport.listen_addr
+
+
+def _parse_peer_addr(s: str) -> NetAddress:
+    """'nodeid@host:port' -> NetAddress."""
+    s = s.strip()
+    if "@" in s:
+        node_id, hp = s.split("@", 1)
+    else:
+        node_id, hp = "", s
+    host, _, port = hp.rpartition(":")
+    return NetAddress(node_id, host, int(port))

@@ -1,0 +1,7 @@
+"""rpc — JSON-RPC 2.0 server/clients + the node's route table.
+
+Layout mirrors the reference:
+- jsonrpc.py  <- rpc/lib: transport-agnostic JSON-RPC over HTTP + WebSocket
+- core.py     <- rpc/core: the ~30 node methods over an Environment
+- client.py   <- rpc/client: HTTP and in-process Local clients
+"""

@@ -1,0 +1,171 @@
+"""RPC clients — HTTP, WebSocket, and in-process Local.
+
+Reference parity: rpc/client/interface.go (Client), httpclient.go (HTTP +
+WS subscriptions), localclient.go (direct Environment calls — used heavily
+by tests and tools).
+"""
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+
+from tendermint_tpu.rpc.jsonrpc import ConnContext, RPCError, _ws_frame, _ws_read_frame
+
+
+class RPCResponseError(RPCError):
+    pass
+
+
+class HTTPClient:
+    """Minimal asyncio JSON-RPC-over-HTTP client (one request per POST,
+    keep-alive)."""
+
+    def __init__(self, host: str, port: int) -> None:
+        self.host, self.port = host, port
+        self._ids = itertools.count(1)
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._lock = asyncio.Lock()
+
+    async def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+            self._reader = None
+
+    async def _ensure_conn(self) -> None:
+        if self._writer is None or self._writer.is_closing():
+            self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+
+    async def call(self, method: str, **params):
+        body = json.dumps(
+            {"jsonrpc": "2.0", "id": next(self._ids), "method": method, "params": params}
+        ).encode()
+        async with self._lock:
+            await self._ensure_conn()
+            req = (
+                f"POST / HTTP/1.1\r\nHost: {self.host}\r\n"
+                f"Content-Type: application/json\r\n"
+                f"Content-Length: {len(body)}\r\n\r\n"
+            ).encode() + body
+            self._writer.write(req)
+            await self._writer.drain()
+            status_line = await self._reader.readline()
+            headers = {}
+            while True:
+                line = await self._reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                k, _, v = line.decode("latin-1").partition(":")
+                headers[k.strip().lower()] = v.strip()
+            n = int(headers.get("content-length", "0"))
+            payload = await self._reader.readexactly(n)
+        resp = json.loads(payload)
+        if "error" in resp:
+            e = resp["error"]
+            raise RPCResponseError(e.get("code", -1), e.get("message", ""), e.get("data", ""))
+        return resp["result"]
+
+
+class WSClient:
+    """WebSocket JSON-RPC client with an event stream (reference
+    rpc/lib/client/ws_client.go)."""
+
+    def __init__(self, host: str, port: int) -> None:
+        self.host, self.port = host, port
+        self._ids = itertools.count(1)
+        self._pending: dict[object, asyncio.Future] = {}
+        self.events: asyncio.Queue[dict] = asyncio.Queue(maxsize=1024)
+        self._task: asyncio.Task | None = None
+
+    async def connect(self) -> None:
+        self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        self._writer.write(
+            (
+                f"GET /websocket HTTP/1.1\r\nHost: {self.host}\r\n"
+                "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                "Sec-WebSocket-Key: dGVzdGtleTEyMzQ1Njc4OQ==\r\n"
+                "Sec-WebSocket-Version: 13\r\n\r\n"
+            ).encode()
+        )
+        await self._writer.drain()
+        status = await self._reader.readline()
+        if b"101" not in status:
+            raise ConnectionError(f"websocket upgrade refused: {status!r}")
+        while True:
+            line = await self._reader.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+        self._task = asyncio.ensure_future(self._recv_loop())
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+        self._writer.close()
+
+    async def _recv_loop(self) -> None:
+        try:
+            while True:
+                opcode, payload = await _ws_read_frame(self._reader)
+                if opcode == 0x8:
+                    return
+                if opcode not in (0x1, 0x2):
+                    continue
+                msg = json.loads(payload)
+                msg_id = msg.get("id")
+                fut = self._pending.pop(msg_id, None)
+                if fut is not None and not fut.done():
+                    fut.set_result(msg)
+                elif isinstance(msg_id, str) and msg_id.endswith("#event"):
+                    try:
+                        self.events.put_nowait(msg.get("result", {}))
+                    except asyncio.QueueFull:
+                        pass
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError("websocket closed"))
+
+    async def call(self, method: str, **params):
+        msg_id = next(self._ids)
+        fut = asyncio.get_event_loop().create_future()
+        self._pending[msg_id] = fut
+        data = json.dumps(
+            {"jsonrpc": "2.0", "id": msg_id, "method": method, "params": params}
+        ).encode()
+        self._writer.write(_ws_frame(0x1, data, mask=True))
+        await self._writer.drain()
+        resp = await fut
+        if "error" in resp:
+            e = resp["error"]
+            raise RPCResponseError(e.get("code", -1), e.get("message", ""), e.get("data", ""))
+        return resp["result"]
+
+    async def subscribe(self, query: str) -> None:
+        await self.call("subscribe", query=query)
+
+    async def next_event(self, timeout: float = 10.0) -> dict:
+        async with asyncio.timeout(timeout):
+            return await self.events.get()
+
+
+class LocalClient:
+    """In-process client: calls the Environment directly (reference
+    rpc/client/localclient.go)."""
+
+    def __init__(self, env) -> None:
+        self.env = env
+        self._routes = env.routes()
+
+    async def call(self, method: str, **params):
+        fn = self._routes.get(method)
+        if fn is None:
+            raise RPCError(-32601, f"unknown method {method!r}")
+        return await fn(**params)
+
+    def __getattr__(self, name: str):
+        fn = self._routes.get(name)
+        if fn is None:
+            raise AttributeError(name)
+        return fn

@@ -1,0 +1,709 @@
+"""rpc/core — the node's JSON-RPC method table.
+
+Reference parity: rpc/core/routes.go:9-45 (~30 methods) with the global
+environment pattern of rpc/core/pipe.go replaced by an explicit
+Environment object wired by the node (node/node.go:831-849).
+
+JSON conventions: bytes are hex strings (lowercase, no 0x), heights are
+ints, times are ns-since-epoch ints.
+"""
+from __future__ import annotations
+
+import asyncio
+import base64
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.libs.log import NOP, Logger
+from tendermint_tpu.libs.pubsub import Query, SubscriptionCancelled
+from tendermint_tpu.mempool import MempoolError, TxInCacheError
+from tendermint_tpu.rpc.jsonrpc import INTERNAL_ERROR, INVALID_PARAMS, RPCError
+from tendermint_tpu.types import events as tmevents
+from tendermint_tpu.types.evidence import decode_evidence
+
+SUBSCRIPTION_BUFFER = 100
+
+
+def _hex(b: bytes) -> str:
+    return b.hex()
+
+
+def _unhex(s) -> bytes:
+    if isinstance(s, (bytes, bytearray)):
+        return bytes(s)
+    if not isinstance(s, str):
+        raise RPCError(INVALID_PARAMS, f"expected hex string, got {type(s).__name__}")
+    try:
+        return bytes.fromhex(s)
+    except ValueError as e:
+        raise RPCError(INVALID_PARAMS, f"bad hex: {e}")
+
+
+def _tx_arg(tx) -> bytes:
+    """Accept hex (our convention) or base64 (reference compat)."""
+    if isinstance(tx, (bytes, bytearray)):
+        return bytes(tx)
+    try:
+        return bytes.fromhex(tx)
+    except (ValueError, TypeError):
+        try:
+            return base64.b64decode(tx, validate=True)
+        except Exception:
+            raise RPCError(INVALID_PARAMS, "tx must be hex or base64")
+
+
+# -- JSON views of domain objects -------------------------------------------
+
+
+def header_json(h) -> dict:
+    return {
+        "chain_id": h.chain_id,
+        "height": h.height,
+        "time": h.time,
+        "num_txs": h.num_txs,
+        "total_txs": h.total_txs,
+        "last_block_id": block_id_json(h.last_block_id),
+        "last_commit_hash": _hex(h.last_commit_hash),
+        "data_hash": _hex(h.data_hash),
+        "validators_hash": _hex(h.validators_hash),
+        "next_validators_hash": _hex(h.next_validators_hash),
+        "consensus_hash": _hex(h.consensus_hash),
+        "app_hash": _hex(h.app_hash),
+        "last_results_hash": _hex(h.last_results_hash),
+        "evidence_hash": _hex(h.evidence_hash),
+        "proposer_address": _hex(h.proposer_address),
+        "hash": _hex(h.hash()),
+    }
+
+
+def block_id_json(bid) -> dict:
+    return {
+        "hash": _hex(bid.hash),
+        "parts": {"total": bid.parts.total, "hash": _hex(bid.parts.hash)},
+    }
+
+
+def vote_json(v) -> dict | None:
+    if v is None:
+        return None
+    return {
+        "type": int(v.type),
+        "height": v.height,
+        "round": v.round,
+        "block_id": block_id_json(v.block_id),
+        "timestamp": v.timestamp,
+        "validator_address": _hex(v.validator_address),
+        "validator_index": v.validator_index,
+        "signature": _hex(v.signature),
+    }
+
+
+def commit_json(c) -> dict | None:
+    if c is None:
+        return None
+    return {
+        "block_id": block_id_json(c.block_id),
+        "precommits": [vote_json(p) for p in c.precommits],
+    }
+
+
+def block_json(b) -> dict:
+    return {
+        "header": header_json(b.header),
+        "data": {"txs": [_hex(tx) for tx in b.data.txs]},
+        "evidence": [_hex(ev.encode()) for ev in b.evidence],
+        "last_commit": commit_json(b.last_commit),
+    }
+
+
+def validator_json(v) -> dict:
+    return {
+        "address": _hex(v.address),
+        "pub_key": _hex(v.pub_key.bytes()),
+        "voting_power": v.voting_power,
+        "proposer_priority": v.proposer_priority,
+    }
+
+
+def tx_response_json(r) -> dict:
+    return {
+        "code": r.code,
+        "data": _hex(r.data),
+        "log": r.log,
+        "info": r.info,
+        "gas_wanted": r.gas_wanted,
+        "gas_used": r.gas_used,
+        "events": r.events,
+        "codespace": r.codespace,
+    }
+
+
+class Environment:
+    """Everything the routes need (reference rpc/core/pipe.go globals)."""
+
+    def __init__(
+        self,
+        *,
+        config=None,
+        state_store=None,
+        block_store=None,
+        consensus_state=None,
+        consensus_reactor=None,
+        mempool=None,
+        evidence_pool=None,
+        p2p_switch=None,
+        proxy_app_query=None,
+        tx_indexer=None,
+        event_bus=None,
+        genesis_doc=None,
+        node_info=None,
+        priv_validator_pub_key=None,
+        logger: Logger = NOP,
+    ) -> None:
+        self.config = config
+        self.state_store = state_store
+        self.block_store = block_store
+        self.consensus_state = consensus_state
+        self.consensus_reactor = consensus_reactor
+        self.mempool = mempool
+        self.evidence_pool = evidence_pool
+        self.p2p_switch = p2p_switch
+        self.proxy_app_query = proxy_app_query
+        self.tx_indexer = tx_indexer
+        self.event_bus = event_bus
+        self.genesis_doc = genesis_doc
+        self.node_info = node_info
+        self.priv_validator_pub_key = priv_validator_pub_key
+        self.log = logger
+        self._subscriber_seq = 0
+
+    # ------------------------------------------------------------------
+    # info routes
+
+    async def health(self) -> dict:
+        return {}
+
+    async def status(self) -> dict:
+        """Reference rpc/core/status.go."""
+        store_height = self.block_store.height()
+        meta = self.block_store.load_block_meta(store_height) if store_height else None
+        state = self.state_store.load()
+        sync_info = {
+            "latest_block_hash": _hex(meta.block_id.hash) if meta else "",
+            "latest_app_hash": _hex(state.app_hash) if state else "",
+            "latest_block_height": store_height,
+            "latest_block_time": meta.header.time if meta else 0,
+            "catching_up": self._catching_up(),
+        }
+        validator_info = {}
+        if self.priv_validator_pub_key is not None:
+            pk = self.priv_validator_pub_key
+            power = 0
+            if state and state.validators:
+                _, val = state.validators.get_by_address(pk.address())
+                power = val.voting_power if val else 0
+            validator_info = {
+                "address": _hex(pk.address()),
+                "pub_key": _hex(pk.bytes()),
+                "voting_power": power,
+            }
+        ni = self.node_info
+        node_info = {}
+        if ni is not None:
+            node_info = {
+                "node_id": ni.node_id,
+                "listen_addr": ni.listen_addr,
+                "network": ni.network,
+                "version": ni.version,
+                "channels": _hex(ni.channels),
+                "moniker": ni.moniker,
+            }
+        return {
+            "node_info": node_info,
+            "sync_info": sync_info,
+            "validator_info": validator_info,
+        }
+
+    def _catching_up(self) -> bool:
+        r = self.consensus_reactor
+        return bool(r is not None and r.fast_sync)
+
+    async def net_info(self) -> dict:
+        sw = self.p2p_switch
+        peers = []
+        if sw is not None:
+            for p in sw.peers.list():
+                peers.append(
+                    {
+                        "node_id": p.id,
+                        "is_outbound": p.outbound,
+                        "moniker": p.node_info.moniker,
+                        "remote_ip": str(p.socket_addr) if p.socket_addr else "",
+                    }
+                )
+        return {
+            "listening": bool(sw is not None and sw.is_running),
+            "n_peers": len(peers),
+            "peers": peers,
+        }
+
+    async def genesis(self) -> dict:
+        import json as _json
+
+        return {"genesis": _json.loads(self.genesis_doc.to_json())}
+
+    # ------------------------------------------------------------------
+    # chain routes
+
+    def _normalize_height(self, height: int | None) -> int:
+        top = self.block_store.height()
+        if height is None or height <= 0:
+            return top
+        if height > top:
+            raise RPCError(INVALID_PARAMS, f"height {height} > store height {top}")
+        if height < self.block_store.base():
+            raise RPCError(INVALID_PARAMS, f"height {height} pruned (base {self.block_store.base()})")
+        return height
+
+    async def block(self, height: int = 0) -> dict:
+        h = self._normalize_height(height or None)
+        block = self.block_store.load_block(h)
+        meta = self.block_store.load_block_meta(h)
+        if block is None:
+            raise RPCError(INTERNAL_ERROR, f"no block at height {h}")
+        return {"block_id": block_id_json(meta.block_id), "block": block_json(block)}
+
+    async def blockchain(self, min_height: int = 0, max_height: int = 0) -> dict:
+        """Reference rpc/core/blocks.go BlockchainInfo: metas for a range,
+        newest first, max 20."""
+        top = self.block_store.height()
+        maxh = min(max_height or top, top)
+        minh = max(min_height or 1, self.block_store.base(), maxh - 19)
+        metas = []
+        for h in range(maxh, minh - 1, -1):
+            meta = self.block_store.load_block_meta(h)
+            if meta is not None:
+                metas.append(
+                    {
+                        "block_id": block_id_json(meta.block_id),
+                        "header": header_json(meta.header),
+                        "num_txs": meta.num_txs,
+                    }
+                )
+        return {"last_height": top, "block_metas": metas}
+
+    async def commit(self, height: int = 0) -> dict:
+        h = self._normalize_height(height or None)
+        meta = self.block_store.load_block_meta(h)
+        if meta is None:
+            raise RPCError(INTERNAL_ERROR, f"no block at height {h}")
+        commit = self.block_store.load_seen_commit(h)
+        canonical = False
+        if h < self.block_store.height():
+            commit = self.block_store.load_block_commit(h)
+            canonical = True
+        return {
+            "signed_header": {
+                "header": header_json(meta.header),
+                "commit": commit_json(commit),
+            },
+            "canonical": canonical,
+        }
+
+    async def block_results(self, height: int = 0) -> dict:
+        h = self._normalize_height(height or None)
+        resp = self.state_store.load_abci_responses(h)
+        if resp is None:
+            raise RPCError(INTERNAL_ERROR, f"no results for height {h}")
+        return {
+            "height": h,
+            "txs_results": [tx_response_json(r) for r in resp.deliver_txs],
+            "validator_updates": [
+                {"pub_key": _hex(vu.pub_key), "power": vu.power}
+                for vu in resp.end_block.validator_updates
+            ],
+        }
+
+    async def validators(self, height: int = 0, page: int = 1, per_page: int = 30) -> dict:
+        h = self._normalize_height(height or None)
+        vals = self.state_store.load_validators(h)
+        if vals is None:
+            raise RPCError(INTERNAL_ERROR, f"no validator set at height {h}")
+        per_page = max(1, min(per_page, 100))
+        start = (max(page, 1) - 1) * per_page
+        return {
+            "block_height": h,
+            "validators": [validator_json(v) for v in vals.validators[start:start + per_page]],
+            "count": len(vals.validators[start:start + per_page]),
+            "total": len(vals.validators),
+        }
+
+    async def consensus_params(self, height: int = 0) -> dict:
+        h = self._normalize_height(height or None)
+        params = self.state_store.load_consensus_params(h)
+        if params is None:
+            raise RPCError(INTERNAL_ERROR, f"no consensus params at height {h}")
+        return {
+            "block_height": h,
+            "consensus_params": {
+                "block": {
+                    "max_bytes": params.block.max_bytes,
+                    "max_gas": params.block.max_gas,
+                    "time_iota_ms": params.block.time_iota_ms,
+                },
+                "evidence": {"max_age": params.evidence.max_age},
+                "validator": {"pub_key_types": list(params.validator.pub_key_types)},
+            },
+        }
+
+    async def consensus_state_summary(self) -> dict:
+        """Reference rpc/core/consensus.go ConsensusState (the summary)."""
+        cs = self.consensus_state
+        rs = cs.rs
+        return {
+            "round_state": {
+                "height": rs.height,
+                "round": rs.round,
+                "step": rs.step.name,
+                "proposer": _hex(rs.validators.get_proposer().address)
+                if rs.validators
+                else "",
+            }
+        }
+
+    async def dump_consensus_state(self) -> dict:
+        cs = self.consensus_state
+        rs = cs.rs
+        votes = []
+        if rs.votes is not None:
+            for r in range(rs.round + 1):
+                pv = rs.votes.prevotes(r)
+                pc = rs.votes.precommits(r)
+                votes.append(
+                    {
+                        "round": r,
+                        "prevotes": str(pv) if pv else "",
+                        "precommits": str(pc) if pc else "",
+                    }
+                )
+        return {
+            "round_state": {
+                "height": rs.height,
+                "round": rs.round,
+                "step": rs.step.name,
+                "start_time": rs.start_time,
+                "commit_time": rs.commit_time,
+                "validators": [validator_json(v) for v in rs.validators.validators]
+                if rs.validators
+                else [],
+                "locked_round": rs.locked_round,
+                "valid_round": rs.valid_round,
+                "height_vote_set": votes,
+            }
+        }
+
+    # ------------------------------------------------------------------
+    # tx routes
+
+    async def broadcast_tx_async(self, tx) -> dict:
+        """CheckTx is NOT awaited (reference rpc/core/mempool.go)."""
+        raw = _tx_arg(tx)
+        asyncio.ensure_future(self._checktx_quiet(raw))
+        from tendermint_tpu.crypto import sum_sha256
+
+        return {"code": 0, "data": "", "log": "", "hash": _hex(sum_sha256(raw))}
+
+    async def _checktx_quiet(self, raw: bytes) -> None:
+        try:
+            await self.mempool.check_tx(raw)
+        except MempoolError:
+            pass
+
+    async def broadcast_tx_sync(self, tx) -> dict:
+        raw = _tx_arg(tx)
+        from tendermint_tpu.crypto import sum_sha256
+
+        try:
+            res = await self.mempool.check_tx(raw)
+        except TxInCacheError:
+            raise RPCError(INTERNAL_ERROR, "tx already in cache")
+        except MempoolError as e:
+            raise RPCError(INTERNAL_ERROR, str(e))
+        return {
+            "code": res.code,
+            "data": _hex(res.data),
+            "log": res.log,
+            "hash": _hex(sum_sha256(raw)),
+        }
+
+    async def broadcast_tx_commit(self, tx, timeout: float = 10.0) -> dict:
+        """Reference rpc/core/mempool.go BroadcastTxCommit: subscribe to the
+        tx event, CheckTx, wait for DeliverTx."""
+        raw = _tx_arg(tx)
+        from tendermint_tpu.crypto import sum_sha256
+
+        tx_hash = sum_sha256(raw)
+        self._subscriber_seq += 1
+        subscriber = f"broadcast_tx_commit-{self._subscriber_seq}"
+        sub = self.event_bus.subscribe(
+            subscriber, tmevents.query_for_tx(tx_hash.hex()), buffer=1
+        )
+        try:
+            try:
+                check_res = await self.mempool.check_tx(raw)
+            except MempoolError as e:
+                raise RPCError(INTERNAL_ERROR, str(e))
+            if not check_res.is_ok:
+                return {
+                    "check_tx": tx_response_json(check_res),
+                    "deliver_tx": {},
+                    "hash": _hex(tx_hash),
+                    "height": 0,
+                }
+            try:
+                async with asyncio.timeout(timeout):
+                    msg = await sub.next()
+            except (asyncio.TimeoutError, SubscriptionCancelled):
+                raise RPCError(INTERNAL_ERROR, "timed out waiting for tx to be committed")
+            data = msg.data
+            return {
+                "check_tx": tx_response_json(check_res),
+                "deliver_tx": tx_response_json(data["result"]),
+                "hash": _hex(tx_hash),
+                "height": data["height"],
+            }
+        finally:
+            self.event_bus.unsubscribe_all(subscriber)
+
+    async def unconfirmed_txs(self, limit: int = 30) -> dict:
+        txs = self.mempool.reap_max_txs(max(1, min(limit, 100)))
+        return {
+            "n_txs": len(txs),
+            "total": self.mempool.size(),
+            "total_bytes": self.mempool.txs_bytes(),
+            "txs": [_hex(t) for t in txs],
+        }
+
+    async def num_unconfirmed_txs(self) -> dict:
+        return {
+            "n_txs": self.mempool.size(),
+            "total": self.mempool.size(),
+            "total_bytes": self.mempool.txs_bytes(),
+        }
+
+    async def tx(self, hash: str, prove: bool = False) -> dict:
+        if self.tx_indexer is None:
+            raise RPCError(INTERNAL_ERROR, "tx indexing is disabled")
+        res = self.tx_indexer.get(_unhex(hash))
+        if res is None:
+            raise RPCError(INTERNAL_ERROR, f"tx {hash} not found")
+        out = {
+            "hash": hash,
+            "height": res.height,
+            "index": res.index,
+            "tx_result": tx_response_json(res.result),
+            "tx": _hex(res.tx),
+        }
+        if prove:
+            block = self.block_store.load_block(res.height)
+            if block is not None:
+                from tendermint_tpu.crypto import merkle
+
+                root, proofs = merkle.proofs_from_byte_slices(list(block.data.txs))
+                p = proofs[res.index]
+                out["proof"] = {
+                    "root_hash": _hex(root),
+                    "proof": {
+                        "total": p.total,
+                        "index": p.index,
+                        "leaf_hash": _hex(p.leaf_hash),
+                        "aunts": [_hex(a) for a in p.aunts],
+                    },
+                }
+        return out
+
+    async def tx_search(self, query: str, page: int = 1, per_page: int = 30) -> dict:
+        if self.tx_indexer is None:
+            raise RPCError(INTERNAL_ERROR, "tx indexing is disabled")
+        try:
+            q = Query.parse(query)
+        except Exception as e:
+            raise RPCError(INVALID_PARAMS, f"bad query: {e}")
+        results = self.tx_indexer.search(q)
+        per_page = max(1, min(per_page, 100))
+        start = (max(page, 1) - 1) * per_page
+        page_results = results[start:start + per_page]
+        from tendermint_tpu.crypto import sum_sha256
+
+        return {
+            "txs": [
+                {
+                    "hash": _hex(sum_sha256(r.tx)),
+                    "height": r.height,
+                    "index": r.index,
+                    "tx_result": tx_response_json(r.result),
+                    "tx": _hex(r.tx),
+                }
+                for r in page_results
+            ],
+            "total_count": len(results),
+        }
+
+    # ------------------------------------------------------------------
+    # abci routes
+
+    async def abci_info(self) -> dict:
+        res = await self.proxy_app_query.info(abci.RequestInfo())
+        return {
+            "response": {
+                "data": res.data,
+                "version": res.version,
+                "app_version": res.app_version,
+                "last_block_height": res.last_block_height,
+                "last_block_app_hash": _hex(res.last_block_app_hash),
+            }
+        }
+
+    async def abci_query(self, path: str = "", data: str = "", height: int = 0, prove: bool = False) -> dict:
+        res = await self.proxy_app_query.query(
+            abci.RequestQuery(data=_unhex(data), path=path, height=height, prove=prove)
+        )
+        return {
+            "response": {
+                "code": res.code,
+                "log": res.log,
+                "info": res.info,
+                "index": res.index,
+                "key": _hex(res.key),
+                "value": _hex(res.value),
+                "height": res.height,
+                "codespace": res.codespace,
+                "proof_ops": [
+                    {"type": op.type, "key": _hex(op.key), "data": _hex(op.data)}
+                    for op in res.proof_ops
+                ]
+                if res.proof_ops
+                else [],
+            }
+        }
+
+    # ------------------------------------------------------------------
+    # evidence
+
+    async def broadcast_evidence(self, evidence: str) -> dict:
+        ev = decode_evidence(_unhex(evidence))
+        self.evidence_pool.add_evidence(ev)
+        return {"hash": _hex(ev.hash())}
+
+    # ------------------------------------------------------------------
+    # events (websocket only)
+
+    async def subscribe(self, query: str, ctx=None) -> dict:
+        """Reference rpc/core/events.go Subscribe — websocket required; each
+        event is pushed as a JSON-RPC notification on the same socket."""
+        if ctx is None or not ctx.is_websocket:
+            raise RPCError(INVALID_PARAMS, "subscribe requires a websocket connection")
+        try:
+            q = Query.parse(query)
+        except Exception as e:
+            raise RPCError(INVALID_PARAMS, f"bad query: {e}")
+        subscriber = f"ws-{ctx.remote}"
+        sub = self.event_bus.subscribe(subscriber, q, buffer=SUBSCRIPTION_BUFFER)
+
+        async def pump():
+            try:
+                while True:
+                    msg = await sub.next()
+                    await ctx.ws_send(
+                        {
+                            "jsonrpc": "2.0",
+                            "id": f"{subscriber}#event",
+                            "result": {
+                                "query": query,
+                                "data": _event_data_json(msg.data),
+                                "events": msg.events,
+                            },
+                        }
+                    )
+            except (SubscriptionCancelled, ConnectionError, asyncio.CancelledError):
+                pass
+
+        task = asyncio.ensure_future(pump())
+        ctx.on_close.append(lambda: (task.cancel(), self.event_bus.unsubscribe_all(subscriber)))
+        return {}
+
+    async def unsubscribe(self, query: str, ctx=None) -> dict:
+        if ctx is None or not ctx.is_websocket:
+            raise RPCError(INVALID_PARAMS, "unsubscribe requires a websocket connection")
+        try:
+            q = Query.parse(query)
+        except Exception as e:
+            raise RPCError(INVALID_PARAMS, f"bad query: {e}")
+        self.event_bus.unsubscribe(f"ws-{ctx.remote}", q)
+        return {}
+
+    async def unsubscribe_all(self, ctx=None) -> dict:
+        if ctx is None or not ctx.is_websocket:
+            raise RPCError(INVALID_PARAMS, "unsubscribe_all requires a websocket connection")
+        self.event_bus.unsubscribe_all(f"ws-{ctx.remote}")
+        return {}
+
+    # ------------------------------------------------------------------
+
+    def routes(self) -> dict:
+        """Reference rpc/core/routes.go:9."""
+        return {
+            "health": self.health,
+            "status": self.status,
+            "net_info": self.net_info,
+            "genesis": self.genesis,
+            "block": self.block,
+            "blockchain": self.blockchain,
+            "commit": self.commit,
+            "block_results": self.block_results,
+            "validators": self.validators,
+            "consensus_params": self.consensus_params,
+            "consensus_state": self.consensus_state_summary,
+            "dump_consensus_state": self.dump_consensus_state,
+            "broadcast_tx_async": self.broadcast_tx_async,
+            "broadcast_tx_sync": self.broadcast_tx_sync,
+            "broadcast_tx_commit": self.broadcast_tx_commit,
+            "unconfirmed_txs": self.unconfirmed_txs,
+            "num_unconfirmed_txs": self.num_unconfirmed_txs,
+            "tx": self.tx,
+            "tx_search": self.tx_search,
+            "abci_info": self.abci_info,
+            "abci_query": self.abci_query,
+            "broadcast_evidence": self.broadcast_evidence,
+            "subscribe": self.subscribe,
+            "unsubscribe": self.unsubscribe,
+            "unsubscribe_all": self.unsubscribe_all,
+        }
+
+
+def _event_data_json(data) -> dict:
+    """Best-effort JSON rendering of EventBus payloads."""
+    if isinstance(data, dict):
+        out = {}
+        for k, v in data.items():
+            if k == "block" and v is not None:
+                out[k] = block_json(v)
+            elif k == "result" and hasattr(v, "code"):
+                out[k] = tx_response_json(v)
+            elif isinstance(v, bytes):
+                out[k] = _hex(v)
+            elif hasattr(v, "__dict__") and not isinstance(v, (int, str, float, bool)):
+                out[k] = {
+                    kk: (_hex(vv) if isinstance(vv, bytes) else vv)
+                    for kk, vv in vars(v).items()
+                    if isinstance(vv, (int, str, float, bool, bytes))
+                }
+            else:
+                out[k] = v
+        return out
+    if hasattr(data, "__dict__"):
+        return {
+            k: (_hex(v) if isinstance(v, bytes) else v)
+            for k, v in vars(data).items()
+            if isinstance(v, (int, str, float, bool, bytes))
+        }
+    return {"value": str(data)}

@@ -1,0 +1,335 @@
+"""JSON-RPC 2.0 over HTTP + WebSocket, asyncio-native, stdlib-only.
+
+Reference parity: rpc/lib — reflection-based handler registration with
+named params (rpc/lib/server/handlers.go), HTTP POST and GET (query-string
+params) transports, and a WebSocket endpoint for the same methods plus
+event subscriptions (http_server.go). The reference rides net/http +
+gorilla/websocket; here a minimal HTTP/1.1 + RFC6455 implementation runs
+directly on asyncio streams (no third-party servers in the image).
+"""
+from __future__ import annotations
+
+import asyncio
+import base64
+import hashlib
+import inspect
+import json
+import struct
+import urllib.parse
+
+from tendermint_tpu.libs.log import NOP, Logger
+from tendermint_tpu.libs.service import BaseService
+
+_WS_MAGIC = b"258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+# JSON-RPC error codes (spec + reference rpc/lib/types/types.go)
+PARSE_ERROR = -32700
+INVALID_REQUEST = -32600
+METHOD_NOT_FOUND = -32601
+INVALID_PARAMS = -32602
+INTERNAL_ERROR = -32603
+
+
+class RPCError(Exception):
+    def __init__(self, code: int, message: str, data: str = "") -> None:
+        super().__init__(message)
+        self.code = code
+        self.message = message
+        self.data = data
+
+
+def _resp_ok(req_id, result) -> dict:
+    return {"jsonrpc": "2.0", "id": req_id, "result": result}
+
+
+def _resp_err(req_id, code: int, message: str, data: str = "") -> dict:
+    err = {"code": code, "message": message}
+    if data:
+        err["data"] = data
+    return {"jsonrpc": "2.0", "id": req_id, "error": err}
+
+
+class Handler:
+    """One registered method: coroutine + parameter introspection."""
+
+    def __init__(self, fn) -> None:
+        self.fn = fn
+        sig = inspect.signature(fn)
+        self.params = [
+            p.name
+            for p in sig.parameters.values()
+            if p.name not in ("self", "ctx")
+        ]
+        self.defaults = {
+            p.name: p.default
+            for p in sig.parameters.values()
+            if p.default is not inspect.Parameter.empty
+        }
+        self.wants_ctx = "ctx" in sig.parameters
+
+    async def call(self, ctx, params) -> object:
+        kwargs = {}
+        if isinstance(params, dict):
+            for name in self.params:
+                if name in params:
+                    kwargs[name] = params[name]
+                elif name in self.defaults:
+                    kwargs[name] = self.defaults[name]
+                else:
+                    raise RPCError(INVALID_PARAMS, f"missing param {name!r}")
+            unknown = set(params) - set(self.params)
+            if unknown:
+                raise RPCError(INVALID_PARAMS, f"unknown params {sorted(unknown)}")
+        elif isinstance(params, list):
+            if len(params) > len(self.params):
+                raise RPCError(INVALID_PARAMS, "too many params")
+            kwargs = dict(zip(self.params, params))
+            for name in self.params[len(params):]:
+                if name in self.defaults:
+                    kwargs[name] = self.defaults[name]
+                else:
+                    raise RPCError(INVALID_PARAMS, f"missing param {name!r}")
+        elif params is None:
+            for name in self.params:
+                if name not in self.defaults:
+                    raise RPCError(INVALID_PARAMS, f"missing param {name!r}")
+                kwargs[name] = self.defaults[name]
+        else:
+            raise RPCError(INVALID_PARAMS, "params must be object or array")
+        if self.wants_ctx:
+            kwargs["ctx"] = ctx
+        out = self.fn(**kwargs)
+        if inspect.isawaitable(out):
+            out = await out
+        return out
+
+
+class ConnContext:
+    """Per-connection context handed to handlers (the subscribe methods
+    need a way to push events back over the originating websocket)."""
+
+    def __init__(self, remote: str, ws_send=None) -> None:
+        self.remote = remote
+        self.ws_send = ws_send  # async (dict) -> None, None on plain HTTP
+        self.on_close: list = []  # callbacks run when the ws conn dies
+
+    @property
+    def is_websocket(self) -> bool:
+        return self.ws_send is not None
+
+
+class JSONRPCServer(BaseService):
+    """HTTP POST + GET + WebSocket JSON-RPC server."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, logger: Logger = NOP) -> None:
+        super().__init__("JSONRPCServer")
+        self.host, self.port = host, port
+        self.log = logger
+        self.routes: dict[str, Handler] = {}
+        self._server: asyncio.Server | None = None
+
+    def register(self, name: str, fn) -> None:
+        self.routes[name] = Handler(fn)
+
+    def register_routes(self, routes: dict[str, object]) -> None:
+        for name, fn in routes.items():
+            self.register(name, fn)
+
+    @property
+    def listen_port(self) -> int:
+        assert self._server is not None
+        return self._server.sockets[0].getsockname()[1]
+
+    async def on_start(self) -> None:
+        self._server = await asyncio.start_server(self._handle_conn, self.host, self.port)
+
+    async def on_stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    # -- HTTP ---------------------------------------------------------
+
+    async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        peer = writer.get_extra_info("peername")
+        remote = f"{peer[0]}:{peer[1]}" if peer else "?"
+        try:
+            while True:
+                req_line = await reader.readline()
+                if not req_line:
+                    return
+                try:
+                    method, target, _version = req_line.decode("latin-1").split(" ", 2)
+                except ValueError:
+                    return
+                headers = {}
+                while True:
+                    line = await reader.readline()
+                    if line in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = line.decode("latin-1").partition(":")
+                    headers[k.strip().lower()] = v.strip()
+
+                if headers.get("upgrade", "").lower() == "websocket":
+                    await self._serve_websocket(reader, writer, headers, remote)
+                    return
+
+                body = b""
+                n = int(headers.get("content-length", "0") or "0")
+                if n:
+                    body = await reader.readexactly(n)
+
+                ctx = ConnContext(remote)
+                if method == "POST":
+                    resp = await self._dispatch_raw(ctx, body)
+                elif method == "GET":
+                    resp = await self._dispatch_uri(ctx, target)
+                else:
+                    self._write_http(writer, 405, b"method not allowed")
+                    await writer.drain()
+                    continue
+                payload = json.dumps(resp, separators=(",", ":")).encode()
+                self._write_http(writer, 200, payload, "application/json")
+                await writer.drain()
+                if headers.get("connection", "").lower() == "close":
+                    return
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+
+    def _write_http(self, writer, status: int, body: bytes, ctype: str = "text/plain") -> None:
+        reason = {200: "OK", 405: "Method Not Allowed", 400: "Bad Request"}.get(status, "?")
+        writer.write(
+            f"HTTP/1.1 {status} {reason}\r\n"
+            f"Content-Type: {ctype}\r\n"
+            f"Content-Length: {len(body)}\r\n\r\n".encode() + body
+        )
+
+    async def _dispatch_raw(self, ctx: ConnContext, body: bytes):
+        try:
+            req = json.loads(body)
+        except Exception as e:
+            return _resp_err(None, PARSE_ERROR, f"invalid JSON: {e}")
+        if isinstance(req, list):
+            return [await self._dispatch_one(ctx, r) for r in req]
+        return await self._dispatch_one(ctx, req)
+
+    async def _dispatch_uri(self, ctx: ConnContext, target: str):
+        """GET /method?param=value — the reference's URI transport. Values
+        arrive as strings; handlers accept them (ints are coerced)."""
+        parsed = urllib.parse.urlparse(target)
+        method = parsed.path.lstrip("/")
+        if not method:
+            return _resp_ok(-1, {"methods": sorted(self.routes)})
+        params = {}
+        for k, vs in urllib.parse.parse_qs(parsed.query).items():
+            v = vs[0]
+            if v.isdigit() or (v.startswith("-") and v[1:].isdigit()):
+                params[k] = int(v)
+            elif v in ("true", "false"):
+                params[k] = v == "true"
+            elif v.startswith('"') and v.endswith('"'):
+                params[k] = v[1:-1]
+            else:
+                params[k] = v
+        return await self._dispatch_one(
+            ctx, {"jsonrpc": "2.0", "id": -1, "method": method, "params": params}
+        )
+
+    async def _dispatch_one(self, ctx: ConnContext, req: dict):
+        if not isinstance(req, dict) or "method" not in req:
+            return _resp_err(None, INVALID_REQUEST, "not a JSON-RPC request")
+        req_id = req.get("id")
+        handler = self.routes.get(req["method"])
+        if handler is None:
+            return _resp_err(req_id, METHOD_NOT_FOUND, f"unknown method {req['method']!r}")
+        try:
+            result = await handler.call(ctx, req.get("params"))
+            return _resp_ok(req_id, result)
+        except RPCError as e:
+            return _resp_err(req_id, e.code, e.message, e.data)
+        except Exception as e:
+            self.log.error("rpc handler error", method=req["method"], err=repr(e))
+            return _resp_err(req_id, INTERNAL_ERROR, str(e))
+
+    # -- WebSocket ----------------------------------------------------
+
+    async def _serve_websocket(self, reader, writer, headers, remote) -> None:
+        key = headers.get("sec-websocket-key", "")
+        accept = base64.b64encode(hashlib.sha1(key.encode() + _WS_MAGIC).digest()).decode()
+        writer.write(
+            b"HTTP/1.1 101 Switching Protocols\r\n"
+            b"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            b"Sec-WebSocket-Accept: " + accept.encode() + b"\r\n\r\n"
+        )
+        await writer.drain()
+
+        send_lock = asyncio.Lock()
+
+        async def ws_send(obj: dict) -> None:
+            data = json.dumps(obj, separators=(",", ":")).encode()
+            async with send_lock:
+                writer.write(_ws_frame(0x1, data))
+                await writer.drain()
+
+        ctx = ConnContext(remote, ws_send=ws_send)
+        try:
+            while True:
+                opcode, payload = await _ws_read_frame(reader)
+                if opcode == 0x8:  # close
+                    return
+                if opcode == 0x9:  # ping -> pong
+                    async with send_lock:
+                        writer.write(_ws_frame(0xA, payload))
+                        await writer.drain()
+                    continue
+                if opcode not in (0x1, 0x2):
+                    continue
+                resp = await self._dispatch_raw(ctx, payload)
+                await ws_send(resp)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            for cb in ctx.on_close:
+                try:
+                    cb()
+                except Exception:
+                    pass
+            writer.close()
+
+
+def _ws_frame(opcode: int, payload: bytes, mask: bool = False) -> bytes:
+    """Encode one RFC6455 frame (FIN set)."""
+    head = bytes([0x80 | opcode])
+    n = len(payload)
+    mask_bit = 0x80 if mask else 0
+    if n < 126:
+        head += bytes([mask_bit | n])
+    elif n < (1 << 16):
+        head += bytes([mask_bit | 126]) + struct.pack(">H", n)
+    else:
+        head += bytes([mask_bit | 127]) + struct.pack(">Q", n)
+    if mask:
+        key = b"\x00\x01\x02\x03"  # test client; masking is anti-proxy, not security
+        masked = bytes(b ^ key[i % 4] for i, b in enumerate(payload))
+        return head + key + masked
+    return head + payload
+
+
+async def _ws_read_frame(reader) -> tuple[int, bytes]:
+    b0, b1 = await reader.readexactly(2)
+    opcode = b0 & 0x0F
+    masked = bool(b1 & 0x80)
+    n = b1 & 0x7F
+    if n == 126:
+        n = struct.unpack(">H", await reader.readexactly(2))[0]
+    elif n == 127:
+        n = struct.unpack(">Q", await reader.readexactly(8))[0]
+    if n > (1 << 24):
+        raise ConnectionError(f"websocket frame too large: {n}")
+    key = await reader.readexactly(4) if masked else None
+    payload = await reader.readexactly(n)
+    if key:
+        payload = bytes(b ^ key[i % 4] for i, b in enumerate(payload))
+    return opcode, payload

@@ -1,0 +1,201 @@
+"""Full-node + RPC tests — the reference's rpc/client/rpc_test.go pattern:
+boot a real Node (all reactors + RPC server), exercise it through the HTTP
+client, the WebSocket client, and the Local client."""
+import asyncio
+import os
+
+import pytest
+
+from tendermint_tpu.config import make_test_config
+from tendermint_tpu.node import Node, _parse_peer_addr, parse_laddr
+from tendermint_tpu.privval import FilePV
+from tendermint_tpu.rpc.client import HTTPClient, LocalClient, RPCResponseError, WSClient
+from tendermint_tpu.types import GenesisDoc
+from tendermint_tpu.types.genesis import GenesisValidator
+
+CHAIN_ID = "node-rpc-test-chain"
+
+
+def make_node(root: str, pv=None, genesis=None, persistent_peers: str = "") -> Node:
+    cfg = make_test_config(root)
+    cfg.base.chain_id = CHAIN_ID
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.p2p.persistent_peers = persistent_peers
+    if pv is None:
+        pv = FilePV.generate(
+            os.path.join(root, "config", "priv_key.json"),
+            os.path.join(root, "config", "priv_state.json"),
+        )
+    if genesis is None:
+        genesis = GenesisDoc(
+            chain_id=CHAIN_ID,
+            genesis_time=1_700_000_000_000_000_000,
+            validators=[GenesisValidator(pv.get_pub_key(), 10)],
+        )
+    return Node(cfg, genesis_doc=genesis, priv_validator=pv)
+
+
+class TestSingleNodeRPC:
+    def test_rpc_surface(self, tmp_path):
+        async def main():
+            node = make_node(str(tmp_path))
+            await node.start()
+            client = HTTPClient("127.0.0.1", node.rpc_port)
+            try:
+                # wait for some blocks
+                async with asyncio.timeout(30):
+                    while node.block_store.height() < 2:
+                        await asyncio.sleep(0.05)
+
+                st = await client.call("status")
+                assert st["sync_info"]["latest_block_height"] >= 2
+                assert st["node_info"]["network"] == CHAIN_ID
+                assert st["validator_info"]["voting_power"] == 10
+
+                assert await client.call("health") == {}
+
+                g = await client.call("genesis")
+                assert g["genesis"]["chain_id"] == CHAIN_ID
+
+                b = await client.call("block", height=1)
+                assert b["block"]["header"]["height"] == 1
+                chain_info = await client.call("blockchain")
+                assert chain_info["last_height"] >= 2
+                assert len(chain_info["block_metas"]) >= 2
+
+                c = await client.call("commit", height=1)
+                assert c["canonical"] is True
+                assert c["signed_header"]["header"]["height"] == 1
+
+                vals = await client.call("validators", height=1)
+                assert vals["total"] == 1
+                assert vals["validators"][0]["voting_power"] == 10
+
+                cp = await client.call("consensus_params", height=1)
+                assert cp["consensus_params"]["block"]["max_bytes"] > 0
+
+                cs = await client.call("consensus_state")
+                assert cs["round_state"]["height"] >= 1
+                dump = await client.call("dump_consensus_state")
+                assert dump["round_state"]["validators"]
+
+                ni = await client.call("net_info")
+                assert ni["listening"] is True
+                assert ni["n_peers"] == 0
+
+                ai = await client.call("abci_info")
+                assert ai["response"]["last_block_height"] >= 0
+
+                # tx lifecycle: commit a tx and query for it
+                tx = b"rpc-key=rpc-value"
+                res = await client.call("broadcast_tx_commit", tx=tx.hex())
+                assert res["deliver_tx"]["code"] == 0
+                assert res["height"] >= 1
+
+                aq = await client.call("abci_query", data=b"rpc-key".hex())
+                assert bytes.fromhex(aq["response"]["value"]) == b"rpc-value"
+
+                # the kv indexer saw it
+                found = await client.call("tx", hash=res["hash"])
+                assert bytes.fromhex(found["tx"]) == tx
+                sr = await client.call(
+                    "tx_search", query=f"tx.height={found['height']}"
+                )
+                assert sr["total_count"] >= 1
+
+                n_unconf = await client.call("num_unconfirmed_txs")
+                assert n_unconf["n_txs"] == 0
+
+                # error paths
+                with pytest.raises(RPCResponseError):
+                    await client.call("block", height=10_000)
+                with pytest.raises(RPCResponseError):
+                    await client.call("no_such_method")
+            finally:
+                await client.close()
+                await node.stop()
+
+        asyncio.run(main())
+
+    def test_websocket_subscription(self, tmp_path):
+        async def main():
+            node = make_node(str(tmp_path))
+            await node.start()
+            ws = WSClient("127.0.0.1", node.rpc_port)
+            try:
+                await ws.connect()
+                st = await ws.call("status")
+                assert st["node_info"]["network"] == CHAIN_ID
+                await ws.subscribe("tm.event='NewBlock'")
+                ev = await ws.next_event(timeout=30)
+                assert ev["query"] == "tm.event='NewBlock'"
+                assert ev["data"]["block"]["header"]["height"] >= 1
+            finally:
+                await ws.close()
+                await node.stop()
+
+        asyncio.run(main())
+
+    def test_local_client(self, tmp_path):
+        async def main():
+            node = make_node(str(tmp_path))
+            await node.start()
+            try:
+                client = LocalClient(node.rpc_env)
+                async with asyncio.timeout(30):
+                    while node.block_store.height() < 1:
+                        await asyncio.sleep(0.05)
+                st = await client.status()
+                assert st["sync_info"]["latest_block_height"] >= 1
+            finally:
+                await node.stop()
+
+        asyncio.run(main())
+
+
+class TestTwoNodeNet:
+    def test_persistent_peer_connects_and_syncs(self, tmp_path):
+        async def main():
+            pv = FilePV.generate(
+                os.path.join(tmp_path, "shared_key.json"),
+                os.path.join(tmp_path, "shared_state.json"),
+            )
+            genesis = GenesisDoc(
+                chain_id=CHAIN_ID,
+                genesis_time=1_700_000_000_000_000_000,
+                validators=[GenesisValidator(pv.get_pub_key(), 10)],
+            )
+            n1 = make_node(os.path.join(tmp_path, "n1"), pv=pv, genesis=genesis)
+            await n1.start()
+            addr = f"{n1.node_key.id()}@127.0.0.1:{n1.p2p_addr.port}"
+            # node 2 is a non-validator follower
+            n2 = make_node(
+                os.path.join(tmp_path, "n2"), genesis=genesis, persistent_peers=addr
+            )
+            await n2.start()
+            try:
+                async with asyncio.timeout(60):
+                    while len(n2.switch.peers) < 1:
+                        await asyncio.sleep(0.05)
+                    # follower replicates blocks (fast sync and/or consensus gossip)
+                    while n2.block_store.height() < 3:
+                        await asyncio.sleep(0.05)
+                h1 = n1.block_store.load_block_meta(2).block_id.hash
+                h2 = n2.block_store.load_block_meta(2).block_id.hash
+                assert h1 == h2
+            finally:
+                await n2.stop()
+                await n1.stop()
+
+        asyncio.run(main())
+
+
+class TestHelpers:
+    def test_parse_laddr(self):
+        assert parse_laddr("tcp://0.0.0.0:26656") == ("0.0.0.0", 26656)
+        assert parse_laddr("127.0.0.1:26657") == ("127.0.0.1", 26657)
+
+    def test_parse_peer_addr(self):
+        a = _parse_peer_addr("abcdef@1.2.3.4:26656")
+        assert (a.id, a.host, a.port) == ("abcdef", "1.2.3.4", 26656)
