@@ -1,0 +1,7 @@
+"""CLI — `python -m tendermint_tpu.cmd <command>`.
+
+Reference parity: cmd/tendermint/commands — init, node, testnet, lite,
+replay, gen_validator, show_node_id, show_validator, unsafe_reset_all,
+version (root.go + one file per command). cobra/viper flag layering is
+argparse + env (TM_* variables) + config.json, same precedence.
+"""

@@ -1,0 +1,7 @@
+"""tendermint-tpu CLI entry point."""
+import sys
+
+from tendermint_tpu.cmd.commands import main
+
+if __name__ == "__main__":
+    sys.exit(main())

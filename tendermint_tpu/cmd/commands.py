@@ -1,0 +1,307 @@
+"""CLI commands (reference cmd/tendermint/commands/*.go)."""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import shutil
+import signal
+import sys
+import time
+
+from tendermint_tpu.config import Config, make_test_config
+from tendermint_tpu.crypto import ed25519
+from tendermint_tpu.libs.log import new_logger
+from tendermint_tpu.p2p.key import NodeKey
+from tendermint_tpu.privval import FilePV
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+VERSION = "0.1.0"
+BLOCK_PROTOCOL = 1
+P2P_PROTOCOL = 1
+
+
+def _home(args) -> str:
+    return os.path.expanduser(args.home)
+
+
+def _load_config(args) -> Config:
+    cfg = Config.load(_home(args))
+    # env overrides (viper-style TM_SECTION_KEY)
+    for k, v in os.environ.items():
+        if not k.startswith("TM_"):
+            continue
+        parts = k[3:].lower().split("_", 1)
+        if len(parts) != 2:
+            continue
+        section, key = parts
+        sec = getattr(cfg, section, None)
+        if sec is not None and hasattr(sec, key):
+            cur = getattr(sec, key)
+            if isinstance(cur, bool):
+                v = v.lower() in ("1", "true", "yes")
+            elif isinstance(cur, int):
+                v = int(v)
+            elif isinstance(cur, float):
+                v = float(v)
+            setattr(sec, key, v)
+    return cfg
+
+
+# ---------------------------------------------------------------------------
+
+
+def cmd_init(args) -> int:
+    """Reference init.go: private validator, node key, genesis."""
+    root = _home(args)
+    cfg = Config(root_dir=root)
+    os.makedirs(os.path.join(root, "config"), exist_ok=True)
+    os.makedirs(os.path.join(root, "data"), exist_ok=True)
+
+    pv_key = cfg.priv_validator_key_path
+    if os.path.exists(pv_key):
+        print(f"found existing private validator at {pv_key}")
+        pv = FilePV.load(pv_key, cfg.priv_validator_state_path)
+    else:
+        pv = FilePV.generate(pv_key, cfg.priv_validator_state_path)
+        print(f"generated private validator at {pv_key}")
+
+    nk_path = cfg.node_key_path
+    if not os.path.exists(nk_path):
+        NodeKey.load_or_gen(nk_path)
+        print(f"generated node key at {nk_path}")
+
+    gen_path = cfg.genesis_path
+    if not os.path.exists(gen_path):
+        doc = GenesisDoc(
+            chain_id=args.chain_id or f"test-chain-{os.urandom(3).hex()}",
+            genesis_time=time.time_ns(),
+            validators=[GenesisValidator(pv.get_pub_key(), 10)],
+        )
+        doc.save_as(gen_path)
+        print(f"generated genesis at {gen_path}")
+    cfg.save()
+    return 0
+
+
+def cmd_node(args) -> int:
+    """Reference run_node.go."""
+    from tendermint_tpu.node import Node
+
+    cfg = _load_config(args)
+    if args.proxy_app:
+        cfg.base.proxy_app = args.proxy_app
+    if args.p2p_laddr:
+        cfg.p2p.laddr = args.p2p_laddr
+    if args.rpc_laddr:
+        cfg.rpc.laddr = args.rpc_laddr
+    if args.persistent_peers:
+        cfg.p2p.persistent_peers = args.persistent_peers
+    if args.fast_sync is not None:
+        cfg.base.fast_sync = args.fast_sync
+
+    log = new_logger(cfg.base.log_level)
+
+    async def run():
+        node = Node(cfg, logger=log)
+        await node.start()
+        log.info(
+            "node started",
+            node_id=node.node_key.id(),
+            rpc=cfg.rpc.laddr,
+            p2p=cfg.p2p.laddr,
+        )
+        stop = asyncio.Event()
+        loop = asyncio.get_event_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        log.info("shutting down")
+        await node.stop()
+
+    asyncio.run(run())
+    return 0
+
+
+def cmd_testnet(args) -> int:
+    """Reference testnet.go: generate N validator node directories."""
+    n = args.v
+    out = os.path.expanduser(args.o)
+    chain_id = args.chain_id or f"chain-{os.urandom(3).hex()}"
+    pvs, node_keys = [], []
+    for i in range(n):
+        root = os.path.join(out, f"node{i}")
+        cfg = Config(root_dir=root)
+        os.makedirs(os.path.join(root, "config"), exist_ok=True)
+        os.makedirs(os.path.join(root, "data"), exist_ok=True)
+        pvs.append(
+            FilePV.generate(cfg.priv_validator_key_path, cfg.priv_validator_state_path)
+        )
+        node_keys.append(NodeKey.load_or_gen(cfg.node_key_path))
+    genesis = GenesisDoc(
+        chain_id=chain_id,
+        genesis_time=time.time_ns(),
+        validators=[GenesisValidator(pv.get_pub_key(), 1) for pv in pvs],
+    )
+    base_p2p = args.starting_port
+    peers = ",".join(
+        f"{node_keys[i].id()}@127.0.0.1:{base_p2p + 2 * i}" for i in range(n)
+    )
+    for i in range(n):
+        root = os.path.join(out, f"node{i}")
+        cfg = Config(root_dir=root)
+        cfg.base.moniker = f"node{i}"
+        cfg.p2p.laddr = f"tcp://127.0.0.1:{base_p2p + 2 * i}"
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{base_p2p + 2 * i + 1}"
+        cfg.p2p.persistent_peers = peers
+        cfg.save()
+        genesis.save_as(cfg.genesis_path)
+    print(f"wrote {n} node configs to {out} (chain id {chain_id})")
+    return 0
+
+
+def cmd_gen_validator(args) -> int:
+    """Reference gen_validator.go: print a fresh FilePV key to stdout."""
+    priv = ed25519.gen_priv_key()
+    print(
+        json.dumps(
+            {
+                "address": priv.pub_key().address().hex(),
+                "pub_key": priv.pub_key().bytes().hex(),
+                "priv_key": priv.bytes().hex(),
+            },
+            indent=2,
+        )
+    )
+    return 0
+
+
+def cmd_show_node_id(args) -> int:
+    cfg = _load_config(args)
+    nk = NodeKey.load_or_gen(cfg.node_key_path)
+    print(nk.id())
+    return 0
+
+
+def cmd_show_validator(args) -> int:
+    cfg = _load_config(args)
+    pv = FilePV.load_or_generate(
+        cfg.priv_validator_key_path, cfg.priv_validator_state_path
+    )
+    pk = pv.get_pub_key()
+    print(json.dumps({"address": pk.address().hex(), "pub_key": pk.bytes().hex()}))
+    return 0
+
+
+def cmd_unsafe_reset_all(args) -> int:
+    """Reference reset_priv_validator.go: wipe data, keep keys."""
+    cfg = _load_config(args)
+    data = cfg.db_dir
+    if os.path.isdir(data):
+        shutil.rmtree(data)
+        os.makedirs(data, exist_ok=True)
+        print(f"removed all data in {data}")
+    pv = FilePV.load_or_generate(
+        cfg.priv_validator_key_path, cfg.priv_validator_state_path
+    )
+    pv.reset()
+    print(f"reset private validator state at {cfg.priv_validator_state_path}")
+    return 0
+
+
+def cmd_replay(args) -> int:
+    """Reference replay.go: replay the WAL through a fresh consensus state
+    (console mode of consensus/replay_file.go)."""
+    from tendermint_tpu.consensus.wal import WAL
+
+    cfg = _load_config(args)
+    wal = WAL(cfg.wal_path)
+    count = 0
+    for msg in wal.iter_all():
+        count += 1
+        if args.verbose:
+            print(msg)
+    print(f"replayed {count} WAL messages from {cfg.wal_path}")
+    wal.close()
+    return 0
+
+
+def cmd_lite(args) -> int:
+    """Reference lite.go: light-client proxy over a full node's RPC."""
+    from tendermint_tpu.lite.proxy import run_lite_proxy
+
+    async def run():
+        await run_lite_proxy(
+            chain_id=args.chain_id,
+            node_addr=args.node,
+            listen_addr=args.laddr,
+            home=_home(args),
+        )
+
+    asyncio.run(run())
+    return 0
+
+
+def cmd_version(args) -> int:
+    print(f"tendermint-tpu v{VERSION} (block protocol {BLOCK_PROTOCOL}, p2p {P2P_PROTOCOL})")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tendermint-tpu",
+        description="TPU-native BFT state-machine replication engine",
+    )
+    p.add_argument("--home", default=os.environ.get("TMHOME", "~/.tendermint-tpu"))
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("init", help="initialize a validator home directory")
+    sp.add_argument("--chain-id", default="")
+    sp.set_defaults(fn=cmd_init)
+
+    sp = sub.add_parser("node", help="run a node")
+    sp.add_argument("--proxy_app", default="")
+    sp.add_argument("--p2p.laddr", dest="p2p_laddr", default="")
+    sp.add_argument("--rpc.laddr", dest="rpc_laddr", default="")
+    sp.add_argument("--p2p.persistent_peers", dest="persistent_peers", default="")
+    sp.add_argument("--fast_sync", type=lambda s: s == "true", default=None)
+    sp.set_defaults(fn=cmd_node)
+
+    sp = sub.add_parser("testnet", help="generate a local testnet's configs")
+    sp.add_argument("--v", type=int, default=4, help="number of validators")
+    sp.add_argument("--o", default="./mytestnet", help="output directory")
+    sp.add_argument("--chain-id", default="")
+    sp.add_argument("--starting-port", type=int, default=26656)
+    sp.set_defaults(fn=cmd_testnet)
+
+    sp = sub.add_parser("gen_validator", help="generate a validator keypair")
+    sp.set_defaults(fn=cmd_gen_validator)
+
+    sp = sub.add_parser("show_node_id", help="print this node's p2p ID")
+    sp.set_defaults(fn=cmd_show_node_id)
+
+    sp = sub.add_parser("show_validator", help="print this node's validator info")
+    sp.set_defaults(fn=cmd_show_validator)
+
+    sp = sub.add_parser("unsafe_reset_all", help="wipe blockchain data and sign state")
+    sp.set_defaults(fn=cmd_unsafe_reset_all)
+
+    sp = sub.add_parser("replay", help="scan/replay the consensus WAL")
+    sp.add_argument("--verbose", action="store_true")
+    sp.set_defaults(fn=cmd_replay)
+
+    sp = sub.add_parser("lite", help="run a light-client proxy")
+    sp.add_argument("--chain-id", required=False, default="")
+    sp.add_argument("--node", default="tcp://127.0.0.1:26657")
+    sp.add_argument("--laddr", default="tcp://127.0.0.1:8888")
+    sp.set_defaults(fn=cmd_lite)
+
+    sp = sub.add_parser("version", help="print the version")
+    sp.set_defaults(fn=cmd_version)
+
+    args = p.parse_args(argv)
+    return args.fn(args)

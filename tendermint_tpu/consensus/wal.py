@@ -148,6 +148,15 @@ class WAL:
     def close(self) -> None:
         self.group.close()
 
+    def iter_all(self):
+        """Decode every readable message (stops at the first corrupt frame);
+        the `replay` CLI command and WAL repair tooling use this."""
+        try:
+            for tm in decode_frames(self.group.reader()):
+                yield tm
+        except WALCorruptionError:
+            return
+
     def search_for_end_height(self, height: int):
         """Return an iterator of messages AFTER #ENDHEIGHT for height, or
         None if not found (reference wal.go:213). height=0 with an empty WAL

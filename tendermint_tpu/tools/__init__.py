@@ -1,0 +1,6 @@
+"""tools — load generation and monitoring (reference tools/).
+
+- bench.py   <- tools/tm-bench: websocket-driven tx load generator with
+               Txs/sec and Blocks/sec statistics
+- monitor.py <- tools/tm-monitor: multi-node health over RPC events
+"""

@@ -1,0 +1,153 @@
+"""tm-bench analog — tx load generator + throughput statistics.
+
+Reference parity: tools/tm-bench (main.go, transacter.go, statistics.go):
+open C connections to the node, spray rate txs/sec of size S for T
+seconds over websocket broadcast_tx_async, subscribe to NewBlock, report
+avg/stddev/max Txs/sec and Blocks/sec.
+
+Usable as a library (`run_bench`) and CLI:
+    python -m tendermint_tpu.tools.bench --endpoint 127.0.0.1:26657 -T 10 -r 1000
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import math
+import os
+import time
+from dataclasses import dataclass, field
+
+from tendermint_tpu.rpc.client import WSClient
+
+
+@dataclass
+class Stats:
+    """Per-second buckets (reference statistics.go)."""
+
+    txs_buckets: dict[int, int] = field(default_factory=dict)
+    blocks_buckets: dict[int, int] = field(default_factory=dict)
+
+    def record_block(self, sec: int, num_txs: int) -> None:
+        self.blocks_buckets[sec] = self.blocks_buckets.get(sec, 0) + 1
+        self.txs_buckets[sec] = self.txs_buckets.get(sec, 0) + num_txs
+
+    @staticmethod
+    def _summary(buckets: dict[int, int], duration: int) -> dict:
+        vals = [buckets.get(s, 0) for s in range(duration)]
+        if not vals:
+            return {"avg": 0, "stddev": 0, "max": 0, "total": 0}
+        avg = sum(vals) / len(vals)
+        var = sum((v - avg) ** 2 for v in vals) / len(vals)
+        return {
+            "avg": round(avg, 1),
+            "stddev": round(math.sqrt(var), 1),
+            "max": max(vals),
+            "total": sum(vals),
+        }
+
+    def report(self, duration: int) -> dict:
+        return {
+            "txs_per_sec": self._summary(self.txs_buckets, duration),
+            "blocks_per_sec": self._summary(self.blocks_buckets, duration),
+        }
+
+
+class Transacter:
+    """One websocket connection spraying txs (reference transacter.go)."""
+
+    def __init__(self, host: str, port: int, rate: int, size: int, conn_idx: int) -> None:
+        self.host, self.port = host, port
+        self.rate = rate
+        self.size = max(size, 40)
+        self.conn_idx = conn_idx
+        self.sent = 0
+
+    async def run(self, duration: int, stop: asyncio.Event) -> None:
+        ws = WSClient(self.host, self.port)
+        await ws.connect()
+        try:
+            end = time.monotonic() + duration
+            while time.monotonic() < end and not stop.is_set():
+                batch_start = time.monotonic()
+                for _ in range(self.rate):
+                    tx = self._make_tx()
+                    # fire-and-forget: don't wait for the result frame
+                    await ws.call("broadcast_tx_async", tx=tx.hex())
+                    self.sent += 1
+                    if stop.is_set() or time.monotonic() >= end:
+                        return
+                # pace to 1s per batch
+                elapsed = time.monotonic() - batch_start
+                if elapsed < 1.0:
+                    await asyncio.sleep(1.0 - elapsed)
+        finally:
+            await ws.close()
+
+    def _make_tx(self) -> bytes:
+        # unique key=value so the kvstore app never dedups
+        prefix = f"bench-{self.conn_idx}-{self.sent}-".encode()
+        return prefix + os.urandom(max(1, (self.size - len(prefix)) // 2)).hex().encode()[: self.size - len(prefix)]
+
+
+async def run_bench(
+    host: str,
+    port: int,
+    duration: int = 10,
+    rate: int = 1000,
+    connections: int = 1,
+    tx_size: int = 250,
+) -> dict:
+    stats = Stats()
+    stop = asyncio.Event()
+
+    # block watcher
+    watcher = WSClient(host, port)
+    await watcher.connect()
+    await watcher.subscribe("tm.event='NewBlock'")
+    t0 = time.monotonic()
+
+    async def watch() -> None:
+        try:
+            while not stop.is_set():
+                ev = await watcher.next_event(timeout=duration + 30)
+                blk = ev["data"]["block"]
+                sec = int(time.monotonic() - t0)
+                stats.record_block(sec, len(blk["data"]["txs"]))
+        except (asyncio.TimeoutError, ConnectionError):
+            pass
+
+    watch_task = asyncio.ensure_future(watch())
+    transacters = [
+        Transacter(host, port, rate, tx_size, i) for i in range(connections)
+    ]
+    await asyncio.gather(*(t.run(duration, stop) for t in transacters))
+    await asyncio.sleep(1.0)  # drain the last block
+    stop.set()
+    watch_task.cancel()
+    await watcher.close()
+
+    report = stats.report(duration)
+    report["txs_submitted"] = sum(t.sent for t in transacters)
+    return report
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tm-bench")
+    p.add_argument("--endpoint", default="127.0.0.1:26657")
+    p.add_argument("-T", "--duration", type=int, default=10)
+    p.add_argument("-r", "--rate", type=int, default=1000)
+    p.add_argument("-c", "--connections", type=int, default=1)
+    p.add_argument("-s", "--size", type=int, default=250)
+    args = p.parse_args(argv)
+    host, _, port = args.endpoint.rpartition(":")
+    report = asyncio.run(
+        run_bench(host, int(port), args.duration, args.rate, args.connections, args.size)
+    )
+    import json
+
+    print(json.dumps(report, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,124 @@
+"""tm-monitor analog — multi-node health dashboard over RPC.
+
+Reference parity: tools/tm-monitor/monitor/ — per-node status polling +
+NewBlock subscription; aggregates network height, block latency, node
+up/down status.
+
+    python -m tendermint_tpu.tools.monitor 127.0.0.1:26657 127.0.0.1:26659
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+from dataclasses import dataclass, field
+
+from tendermint_tpu.rpc.client import HTTPClient, WSClient
+
+
+@dataclass
+class NodeStatus:
+    endpoint: str
+    online: bool = False
+    moniker: str = ""
+    height: int = 0
+    last_block_time: float = 0.0  # monotonic, local arrival
+    block_latencies: list[float] = field(default_factory=list)
+
+    def avg_block_latency(self) -> float:
+        if not self.block_latencies:
+            return 0.0
+        return sum(self.block_latencies) / len(self.block_latencies)
+
+
+class Monitor:
+    def __init__(self, endpoints: list[str]) -> None:
+        self.nodes = {e: NodeStatus(e) for e in endpoints}
+        self._tasks: list[asyncio.Task] = []
+
+    async def start(self) -> None:
+        for ep in self.nodes:
+            self._tasks.append(asyncio.ensure_future(self._watch(ep)))
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+
+    async def _watch(self, ep: str) -> None:
+        host, _, port = ep.rpartition(":")
+        ns = self.nodes[ep]
+        while True:
+            try:
+                client = HTTPClient(host, int(port))
+                st = await client.call("status")
+                ns.online = True
+                ns.moniker = st["node_info"].get("moniker", "")
+                ns.height = st["sync_info"]["latest_block_height"]
+                await client.close()
+
+                ws = WSClient(host, int(port))
+                await ws.connect()
+                await ws.subscribe("tm.event='NewBlock'")
+                try:
+                    while True:
+                        ev = await ws.next_event(timeout=60)
+                        now = time.monotonic()
+                        if ns.last_block_time:
+                            ns.block_latencies.append(now - ns.last_block_time)
+                            del ns.block_latencies[:-100]
+                        ns.last_block_time = now
+                        ns.height = ev["data"]["block"]["header"]["height"]
+                finally:
+                    await ws.close()
+            except (ConnectionError, OSError, asyncio.TimeoutError):
+                ns.online = False
+                await asyncio.sleep(2.0)
+            except asyncio.CancelledError:
+                return
+
+    def network_summary(self) -> dict:
+        online = [n for n in self.nodes.values() if n.online]
+        return {
+            "num_nodes": len(self.nodes),
+            "num_online": len(online),
+            "network_height": max((n.height for n in online), default=0),
+            "avg_block_time_s": round(
+                sum(n.avg_block_latency() for n in online) / len(online), 3
+            )
+            if online
+            else 0.0,
+            "nodes": [
+                {
+                    "endpoint": n.endpoint,
+                    "online": n.online,
+                    "moniker": n.moniker,
+                    "height": n.height,
+                }
+                for n in self.nodes.values()
+            ],
+        }
+
+
+async def _run(endpoints: list[str], interval: float) -> None:
+    mon = Monitor(endpoints)
+    await mon.start()
+    try:
+        while True:
+            await asyncio.sleep(interval)
+            print(json.dumps(mon.network_summary()))
+    finally:
+        await mon.stop()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tm-monitor")
+    p.add_argument("endpoints", nargs="+")
+    p.add_argument("--interval", type=float, default=5.0)
+    args = p.parse_args(argv)
+    asyncio.run(_run(args.endpoints, args.interval))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
