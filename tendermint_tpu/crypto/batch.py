@@ -40,6 +40,15 @@ def clear_backend(key_type: str) -> None:
     _BACKENDS.pop(key_type, None)
 
 
+# optional observability hook: fn(batch_size, seconds)
+_metrics_sink = None
+
+
+def set_metrics_sink(fn) -> None:
+    global _metrics_sink
+    _metrics_sink = fn
+
+
 class BatchVerifier:
     """Accumulate signatures, verify them all in grouped batches.
 
@@ -82,10 +91,15 @@ class BatchVerifier:
         g[3].append(sig)
 
     def verify_all(self) -> list[bool]:
+        import time as _time
+
+        t0 = _time.monotonic()
+        n_jobs = 0
         ok = [True] * self._n_items
         for idx in self._invalid_items:
             ok[idx] = False
         for key_type, (items, pubs, msgs, sigs) in self._groups.items():
+            n_jobs += len(items)
             backend = _BACKENDS.get(key_type)
             if backend is not None:
                 results = backend([p.bytes() for p in pubs], msgs, sigs)
@@ -95,6 +109,8 @@ class BatchVerifier:
                 if not res:
                     ok[item] = False
         self._reset()
+        if _metrics_sink is not None and n_jobs:
+            _metrics_sink(n_jobs, _time.monotonic() - t0)
         return ok
 
     def _reset(self) -> None:

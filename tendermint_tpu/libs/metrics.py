@@ -1,0 +1,243 @@
+"""Prometheus-style metrics — counters, gauges, histograms + text endpoint.
+
+Reference parity: the per-module Metrics structs (consensus/metrics.go,
+p2p/metrics.go, mempool/metrics.go, state/metrics.go backed by
+go-kit/prometheus) and the /metrics HTTP server wired in node/node.go:946.
+Exposition format: Prometheus text 0.0.4.
+"""
+from __future__ import annotations
+
+import asyncio
+import bisect
+import time
+
+
+class Collector:
+    """A registry of metrics for one process."""
+
+    def __init__(self, namespace: str = "tendermint") -> None:
+        self.namespace = namespace
+        self._metrics: list[_Metric] = []
+
+    def counter(self, subsystem: str, name: str, help_: str = "") -> "Counter":
+        m = Counter(self._full(subsystem, name), help_)
+        self._metrics.append(m)
+        return m
+
+    def gauge(self, subsystem: str, name: str, help_: str = "") -> "Gauge":
+        m = Gauge(self._full(subsystem, name), help_)
+        self._metrics.append(m)
+        return m
+
+    def histogram(
+        self, subsystem: str, name: str, help_: str = "", buckets: list[float] | None = None
+    ) -> "Histogram":
+        m = Histogram(self._full(subsystem, name), help_, buckets)
+        self._metrics.append(m)
+        return m
+
+    def _full(self, subsystem: str, name: str) -> str:
+        return f"{self.namespace}_{subsystem}_{name}"
+
+    def render(self) -> str:
+        """Prometheus text exposition."""
+        out = []
+        for m in self._metrics:
+            out.extend(m.render())
+        return "\n".join(out) + "\n"
+
+
+class _Metric:
+    kind = ""
+
+    def __init__(self, name: str, help_: str) -> None:
+        self.name = name
+        self.help = help_
+
+    def _head(self) -> list[str]:
+        lines = []
+        if self.help:
+            lines.append(f"# HELP {self.name} {self.help}")
+        lines.append(f"# TYPE {self.name} {self.kind}")
+        return lines
+
+    def render(self) -> list[str]:
+        raise NotImplementedError
+
+
+def _fmt_labels(labels: dict) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name: str, help_: str) -> None:
+        super().__init__(name, help_)
+        self._values: dict[tuple, float] = {}
+
+    def inc(self, value: float = 1.0, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        self._values[key] = self._values.get(key, 0.0) + value
+
+    def render(self) -> list[str]:
+        lines = self._head()
+        for key, v in sorted(self._values.items()):
+            lines.append(f"{self.name}{_fmt_labels(dict(key))} {v:g}")
+        if not self._values:
+            lines.append(f"{self.name} 0")
+        return lines
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name: str, help_: str) -> None:
+        super().__init__(name, help_)
+        self._values: dict[tuple, float] = {}
+
+    def set(self, value: float, **labels) -> None:
+        self._values[tuple(sorted(labels.items()))] = float(value)
+
+    def add(self, value: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        self._values[key] = self._values.get(key, 0.0) + value
+
+    def render(self) -> list[str]:
+        lines = self._head()
+        for key, v in sorted(self._values.items()):
+            lines.append(f"{self.name}{_fmt_labels(dict(key))} {v:g}")
+        if not self._values:
+            lines.append(f"{self.name} 0")
+        return lines
+
+
+DEFAULT_BUCKETS = [0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10]
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, help_: str, buckets: list[float] | None = None) -> None:
+        super().__init__(name, help_)
+        self.buckets = sorted(buckets or DEFAULT_BUCKETS)
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._n = 0
+
+    def observe(self, value: float) -> None:
+        idx = bisect.bisect_left(self.buckets, value)
+        self._counts[idx] += 1
+        self._sum += value
+        self._n += 1
+
+    def render(self) -> list[str]:
+        lines = self._head()
+        cum = 0
+        for b, c in zip(self.buckets, self._counts):
+            cum += c
+            lines.append(f'{self.name}_bucket{{le="{b:g}"}} {cum}')
+        lines.append(f'{self.name}_bucket{{le="+Inf"}} {self._n}')
+        lines.append(f"{self.name}_sum {self._sum:g}")
+        lines.append(f"{self.name}_count {self._n}")
+        return lines
+
+
+# ---------------------------------------------------------------------------
+# per-module metric sets (reference consensus/metrics.go etc.)
+
+
+class ConsensusMetrics:
+    def __init__(self, c: Collector) -> None:
+        self.height = c.gauge("consensus", "height", "Height of the chain")
+        self.rounds = c.gauge("consensus", "rounds", "Round of the current height")
+        self.validators = c.gauge("consensus", "validators", "Number of validators")
+        self.validators_power = c.gauge("consensus", "validators_power", "Total voting power")
+        self.missing_validators = c.gauge("consensus", "missing_validators", "Absent from commit")
+        self.byzantine_validators = c.gauge("consensus", "byzantine_validators", "Evidence count")
+        self.block_interval_seconds = c.histogram(
+            "consensus", "block_interval_seconds", "Time between blocks"
+        )
+        self.num_txs = c.gauge("consensus", "num_txs", "Txs in the latest block")
+        self.block_size_bytes = c.gauge("consensus", "block_size_bytes", "Latest block size")
+        self.total_txs = c.gauge("consensus", "total_txs", "Total txs committed")
+        self.fast_syncing = c.gauge("consensus", "fast_syncing", "1 while fast syncing")
+        # TPU data plane (no reference analog — the new framework's hot path)
+        self.batch_verify_seconds = c.histogram(
+            "consensus", "batch_verify_seconds", "Device batch verify latency",
+            [0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.5],
+        )
+        self.batch_verify_size = c.histogram(
+            "consensus", "batch_verify_size", "Signatures per device batch",
+            [1, 4, 16, 64, 256, 1024, 4096, 16384],
+        )
+
+
+class P2PMetrics:
+    def __init__(self, c: Collector) -> None:
+        self.peers = c.gauge("p2p", "peers", "Connected peers")
+        self.peer_receive_bytes_total = c.counter(
+            "p2p", "peer_receive_bytes_total", "Bytes received per channel"
+        )
+        self.peer_send_bytes_total = c.counter(
+            "p2p", "peer_send_bytes_total", "Bytes sent per channel"
+        )
+
+
+class MempoolMetrics:
+    def __init__(self, c: Collector) -> None:
+        self.size = c.gauge("mempool", "size", "Unconfirmed txs")
+        self.tx_size_bytes = c.histogram(
+            "mempool", "tx_size_bytes", "Tx sizes", [32, 128, 512, 2048, 8192, 65536]
+        )
+        self.failed_txs = c.counter("mempool", "failed_txs", "Rejected txs")
+        self.recheck_times = c.counter("mempool", "recheck_times", "Recheck count")
+
+
+class StateMetrics:
+    def __init__(self, c: Collector) -> None:
+        self.block_processing_time = c.histogram(
+            "state", "block_processing_time", "ApplyBlock seconds"
+        )
+
+
+class MetricsServer:
+    """Plain-HTTP /metrics endpoint (reference node.go:946)."""
+
+    def __init__(self, collector: Collector, host: str = "127.0.0.1", port: int = 0) -> None:
+        self.collector = collector
+        self.host, self.port = host, port
+        self._server: asyncio.Server | None = None
+
+    @property
+    def listen_port(self) -> int:
+        assert self._server is not None
+        return self._server.sockets[0].getsockname()[1]
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        try:
+            await reader.readline()  # request line
+            while (await reader.readline()) not in (b"\r\n", b"\n", b""):
+                pass
+            body = self.collector.render().encode()
+            writer.write(
+                b"HTTP/1.1 200 OK\r\nContent-Type: text/plain; version=0.0.4\r\n"
+                + f"Content-Length: {len(body)}\r\n\r\n".encode()
+                + body
+            )
+            await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            writer.close()

@@ -8,6 +8,7 @@ transport listen, switch start, dial persistent peers).
 """
 from __future__ import annotations
 
+import asyncio
 import os
 
 from tendermint_tpu import proxy
@@ -241,6 +242,29 @@ class Node(BaseService):
         rpc_host, rpc_port = parse_laddr(cfg.rpc.laddr)
         self.rpc_server = JSONRPCServer(rpc_host, rpc_port, logger=log)
         self.rpc_server.register_routes(self.rpc_env.routes())
+
+        # 9. metrics (reference node.go:124-138 providers + :946 server)
+        self.metrics_server = None
+        if cfg.instrumentation.prometheus:
+            from tendermint_tpu.libs import metrics as tmm
+
+            self.metrics = tmm.Collector(cfg.instrumentation.namespace)
+            self.consensus_metrics = tmm.ConsensusMetrics(self.metrics)
+            self.p2p_metrics = tmm.P2PMetrics(self.metrics)
+            self.mempool_metrics = tmm.MempoolMetrics(self.metrics)
+            self.state_metrics = tmm.StateMetrics(self.metrics)
+            from tendermint_tpu.crypto import batch as crypto_batch
+
+            cm = self.consensus_metrics
+
+            def _batch_sink(n, secs, _cm=cm):
+                _cm.batch_verify_size.observe(n)
+                _cm.batch_verify_seconds.observe(secs)
+
+            crypto_batch.set_metrics_sink(_batch_sink)
+            self.block_exec.metrics = self.state_metrics
+            mhost, mport = parse_laddr(cfg.instrumentation.prometheus_listen_addr)
+            self.metrics_server = tmm.MetricsServer(self.metrics, mhost, mport)
         self._built = True
 
     def _consensus_possible(self, state) -> bool:
@@ -266,6 +290,9 @@ class Node(BaseService):
             await self.build()
         # RPC first (reference node.go:729 — receive txs before p2p is up)
         await self.rpc_server.start()
+        if self.metrics_server is not None:
+            await self.metrics_server.start()
+            self.spawn(self._metrics_sampler(), "metrics-sampler")
         await self.transport.listen(NetAddress("", self._p2p_host, self._p2p_port))
         await self.switch.start()
         if self.config.p2p.persistent_peers:
@@ -279,6 +306,8 @@ class Node(BaseService):
     async def on_stop(self) -> None:
         await self.switch.stop()
         await self.rpc_server.stop()
+        if self.metrics_server is not None:
+            await self.metrics_server.stop()
         if self.consensus_state.is_running:
             await self.consensus_state.stop()
         await self.indexer_service.stop()
@@ -288,6 +317,47 @@ class Node(BaseService):
         self.addr_book.save()
         for db in (self.block_store_db, self.state_db):
             db.close()
+
+    async def _metrics_sampler(self) -> None:
+        """Sample gauges + observe block intervals (reference wires these
+        through go-kit at event sites; a 1s sampler keeps our call sites
+        clean while the histograms come from the event bus)."""
+        import time as _time
+
+        from tendermint_tpu.types import events as ev
+
+        sub = self.event_bus.subscribe("metrics-sampler", ev.EVENT_QUERY_NEW_BLOCK)
+        last_block_at = 0.0
+        cm, mm, pm = self.consensus_metrics, self.mempool_metrics, self.p2p_metrics
+        while True:
+            rs = self.consensus_state.rs
+            cm.height.set(self.block_store.height())
+            cm.rounds.set(rs.round)
+            if rs.validators is not None:
+                cm.validators.set(rs.validators.size())
+                cm.validators_power.set(rs.validators.total_voting_power())
+            cm.fast_syncing.set(1 if self.consensus_reactor.fast_sync else 0)
+            mm.size.set(self.mempool.size())
+            pm.peers.set(len(self.switch.peers))
+            # drain block events without blocking the sampling cadence
+            while True:
+                msg = sub.try_next()
+                if msg is None:
+                    break
+                block = msg.data["block"]
+                now = _time.monotonic()
+                if last_block_at:
+                    cm.block_interval_seconds.observe(now - last_block_at)
+                last_block_at = now
+                cm.num_txs.set(len(block.data.txs))
+                cm.total_txs.add(len(block.data.txs))
+                cm.block_size_bytes.set(len(block.encode()))
+                commit = block.last_commit
+                if commit is not None:
+                    missing = sum(1 for p in commit.precommits if p is None)
+                    cm.missing_validators.set(missing)
+                cm.byzantine_validators.set(len(block.evidence))
+            await asyncio.sleep(1.0)
 
     # convenience accessors (reference node.go getters)
 

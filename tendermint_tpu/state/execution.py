@@ -41,6 +41,7 @@ class BlockExecutor:
         self.app = app_conn
         self.mempool = mempool
         self.evidence_pool = evidence_pool
+        self.metrics = None  # optional StateMetrics
         self.event_bus = event_bus
         self.logger = logger
 
@@ -71,6 +72,9 @@ class BlockExecutor:
     # -- the apply pipeline (reference execution.go:117) --------------------
 
     async def apply_block(self, state: State, block_id: BlockID, block: Block) -> State:
+        import time as _time
+
+        _t0 = _time.monotonic()
         self.validate_block(state, block)
 
         abci_responses = await self._exec_block_on_proxy_app(state, block)
@@ -98,6 +102,8 @@ class BlockExecutor:
             self.evidence_pool.update(block, new_state)
         if self.event_bus is not None:
             await self._fire_events(block, abci_responses, validator_updates)
+        if self.metrics is not None:
+            self.metrics.block_processing_time.observe(_time.monotonic() - _t0)
         return new_state
 
     async def _exec_block_on_proxy_app(self, state: State, block: Block) -> ABCIResponses:

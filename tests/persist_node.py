@@ -1,0 +1,70 @@
+"""Subprocess driver for the crash-consistency suite (reference
+test/persist/test_failure_indices.sh): run a single-validator node on
+persistent (sqlite) storage until the block store reaches --height, then
+exit 0. With FAIL_TEST_INDEX set, the planted fail.fail() call kills the
+process with exit code 99 at the chosen durability boundary instead."""
+import argparse
+import asyncio
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tendermint_tpu.config import make_test_config
+from tendermint_tpu.node import Node
+from tendermint_tpu.privval import FilePV
+from tendermint_tpu.types import GenesisDoc
+from tendermint_tpu.types.genesis import GenesisValidator
+
+CHAIN_ID = "persist-test-chain"
+
+
+async def run(home: str, target_height: int, timeout: float) -> int:
+    cfg = make_test_config(home)
+    cfg.base.db_backend = "sqlite"  # crash consistency requires real disk
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    pv = FilePV.load_or_generate(
+        os.path.join(home, "config", "pv_key.json"),
+        os.path.join(home, "config", "pv_state.json"),
+    )
+    gen_path = os.path.join(home, "config", "genesis.json")
+    if os.path.exists(gen_path):
+        genesis = GenesisDoc.from_file(gen_path)
+    else:
+        genesis = GenesisDoc(
+            chain_id=CHAIN_ID,
+            genesis_time=1_700_000_000_000_000_000,
+            validators=[GenesisValidator(pv.get_pub_key(), 10)],
+        )
+        genesis.save_as(gen_path)
+    node = Node(cfg, genesis_doc=genesis, priv_validator=pv)
+    await node.start()
+    try:
+        async with asyncio.timeout(timeout):
+            while node.block_store.height() < target_height:
+                await asyncio.sleep(0.02)
+        # one committed tx proves app-state recovery too
+        print(f"reached height {node.block_store.height()}", flush=True)
+        return 0
+    finally:
+        await node.stop()
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--home", required=True)
+    p.add_argument("--height", type=int, default=5)
+    p.add_argument("--timeout", type=float, default=60.0)
+    args = p.parse_args()
+    return asyncio.run(run(args.home, args.height, args.timeout))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
