@@ -1,0 +1,72 @@
+// Batch verification C API — the native backend of crypto/batch.py.
+//
+// Layout matches the ctypes binding in tendermint_tpu/crypto/native.py:
+// fixed-stride pubkey/sig arrays, variable-length messages via a flat
+// buffer + offsets. Work is sharded across hardware threads; each
+// signature is independent so this is embarrassingly parallel.
+#include <cstdint>
+#include <cstddef>
+#include <thread>
+#include <vector>
+#include <algorithm>
+
+namespace tmnative {
+extern "C" int tm_ed25519_verify(const uint8_t*, const uint8_t*, size_t, const uint8_t*);
+extern "C" int tm_secp256k1_verify(const uint8_t*, const uint8_t*, size_t, const uint8_t*);
+}
+
+using tmnative::tm_ed25519_verify;
+using tmnative::tm_secp256k1_verify;
+
+namespace {
+
+template <typename F>
+void parallel_for(size_t n, F f) {
+    unsigned hw = std::thread::hardware_concurrency();
+    size_t workers = std::min<size_t>(std::max(1u, hw), n);
+    if (workers <= 1 || n < 16) {
+        for (size_t i = 0; i < n; i++) f(i);
+        return;
+    }
+    std::vector<std::thread> ts;
+    ts.reserve(workers);
+    size_t chunk = (n + workers - 1) / workers;
+    for (size_t w = 0; w < workers; w++) {
+        size_t lo = w * chunk, hi = std::min(n, lo + chunk);
+        if (lo >= hi) break;
+        ts.emplace_back([=] {
+            for (size_t i = lo; i < hi; i++) f(i);
+        });
+    }
+    for (auto& t : ts) t.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// pubs: n*32, sigs: n*64, msgs: flat buffer, offsets: n+1 entries
+void tm_ed25519_verify_batch(const uint8_t* pubs, const uint8_t* msgs,
+                             const uint64_t* offsets, const uint8_t* sigs,
+                             size_t n, uint8_t* out) {
+    parallel_for(n, [&](size_t i) {
+        out[i] = (uint8_t)tm_ed25519_verify(
+            pubs + 32 * i, msgs + offsets[i], (size_t)(offsets[i + 1] - offsets[i]),
+            sigs + 64 * i);
+    });
+}
+
+// pubs: n*33, sigs: n*64
+void tm_secp256k1_verify_batch(const uint8_t* pubs, const uint8_t* msgs,
+                               const uint64_t* offsets, const uint8_t* sigs,
+                               size_t n, uint8_t* out) {
+    parallel_for(n, [&](size_t i) {
+        out[i] = (uint8_t)tm_secp256k1_verify(
+            pubs + 33 * i, msgs + offsets[i], (size_t)(offsets[i + 1] - offsets[i]),
+            sigs + 64 * i);
+    });
+}
+
+int tm_native_abi_version(void) { return 1; }
+
+}  // extern "C"

@@ -1,0 +1,448 @@
+// Ed25519 signature verification (RFC 8032), clean-room C++.
+//
+// Role in the framework: the native-parity component demanded by the
+// reference's vendored C library (crypto/secp256k1/internal, SURVEY §2) —
+// the CPU fallback path of the batch verifier for builds without a TPU,
+// mirroring the reference's cgo/nocgo dual build. The TPU path lives in
+// tendermint_tpu/ops (JAX); this file shares no code with either.
+//
+// Field arithmetic: GF(2^255-19) as 5x51-bit limbs, products via unsigned
+// __int128. Points: extended twisted Edwards coordinates (a = -1), unified
+// add / dedicated double. Double-scalar mult: 4-bit windows, interleaved.
+#include <cstdint>
+#include <cstring>
+#include "sha2.h"
+
+namespace tmnative {
+
+typedef unsigned __int128 u128;
+
+struct Fe {
+    uint64_t v[5];  // value = sum v[i] * 2^(51 i), limbs < ~2^52 between carries
+};
+
+static const uint64_t MASK51 = (1ull << 51) - 1;
+
+static void fe_zero(Fe& o) { memset(o.v, 0, sizeof o.v); }
+static void fe_one(Fe& o) { fe_zero(o); o.v[0] = 1; }
+static void fe_copy(Fe& o, const Fe& a) { memcpy(o.v, a.v, sizeof o.v); }
+
+static void fe_add(Fe& o, const Fe& a, const Fe& b) {
+    for (int i = 0; i < 5; i++) o.v[i] = a.v[i] + b.v[i];
+}
+
+// o = a - b. Adds 2p first so limbs stay non-negative.
+static void fe_sub(Fe& o, const Fe& a, const Fe& b) {
+    // 2p = 2^256 - 38: per-limb constants 2*(2^51-19), 2*(2^51-1)...
+    o.v[0] = a.v[0] + 0xFFFFFFFFFFFDAull - b.v[0];
+    o.v[1] = a.v[1] + 0xFFFFFFFFFFFFEull - b.v[1];
+    o.v[2] = a.v[2] + 0xFFFFFFFFFFFFEull - b.v[2];
+    o.v[3] = a.v[3] + 0xFFFFFFFFFFFFEull - b.v[3];
+    o.v[4] = a.v[4] + 0xFFFFFFFFFFFFEull - b.v[4];
+}
+
+static void fe_carry(Fe& o) {
+    uint64_t c;
+    for (int r = 0; r < 2; r++) {
+        c = o.v[0] >> 51; o.v[0] &= MASK51; o.v[1] += c;
+        c = o.v[1] >> 51; o.v[1] &= MASK51; o.v[2] += c;
+        c = o.v[2] >> 51; o.v[2] &= MASK51; o.v[3] += c;
+        c = o.v[3] >> 51; o.v[3] &= MASK51; o.v[4] += c;
+        c = o.v[4] >> 51; o.v[4] &= MASK51; o.v[0] += c * 19;
+    }
+}
+
+static void fe_mul(Fe& o, const Fe& a, const Fe& b) {
+    u128 t0 = (u128)a.v[0] * b.v[0] + (u128)(19 * a.v[1]) * b.v[4] +
+              (u128)(19 * a.v[2]) * b.v[3] + (u128)(19 * a.v[3]) * b.v[2] +
+              (u128)(19 * a.v[4]) * b.v[1];
+    u128 t1 = (u128)a.v[0] * b.v[1] + (u128)a.v[1] * b.v[0] +
+              (u128)(19 * a.v[2]) * b.v[4] + (u128)(19 * a.v[3]) * b.v[3] +
+              (u128)(19 * a.v[4]) * b.v[2];
+    u128 t2 = (u128)a.v[0] * b.v[2] + (u128)a.v[1] * b.v[1] +
+              (u128)a.v[2] * b.v[0] + (u128)(19 * a.v[3]) * b.v[4] +
+              (u128)(19 * a.v[4]) * b.v[3];
+    u128 t3 = (u128)a.v[0] * b.v[3] + (u128)a.v[1] * b.v[2] +
+              (u128)a.v[2] * b.v[1] + (u128)a.v[3] * b.v[0] +
+              (u128)(19 * a.v[4]) * b.v[4];
+    u128 t4 = (u128)a.v[0] * b.v[4] + (u128)a.v[1] * b.v[3] +
+              (u128)a.v[2] * b.v[2] + (u128)a.v[3] * b.v[1] +
+              (u128)a.v[4] * b.v[0];
+    uint64_t c;
+    uint64_t r0, r1, r2, r3, r4;
+    r0 = (uint64_t)t0 & MASK51; c = (uint64_t)(t0 >> 51); t1 += c;
+    r1 = (uint64_t)t1 & MASK51; c = (uint64_t)(t1 >> 51); t2 += c;
+    r2 = (uint64_t)t2 & MASK51; c = (uint64_t)(t2 >> 51); t3 += c;
+    r3 = (uint64_t)t3 & MASK51; c = (uint64_t)(t3 >> 51); t4 += c;
+    r4 = (uint64_t)t4 & MASK51; c = (uint64_t)(t4 >> 51);
+    r0 += c * 19;
+    c = r0 >> 51; r0 &= MASK51; r1 += c;
+    o.v[0] = r0; o.v[1] = r1; o.v[2] = r2; o.v[3] = r3; o.v[4] = r4;
+}
+
+static void fe_sq(Fe& o, const Fe& a) { fe_mul(o, a, a); }
+
+// canonical little-endian 32 bytes
+static void fe_tobytes(uint8_t out[32], const Fe& a) {
+    Fe t;
+    fe_copy(t, a);
+    fe_carry(t);
+    // fully reduce: add 19, propagate, drop bit 255, then subtract the 19 trick
+    uint64_t q = (t.v[0] + 19) >> 51;
+    q = (t.v[1] + q) >> 51;
+    q = (t.v[2] + q) >> 51;
+    q = (t.v[3] + q) >> 51;
+    q = (t.v[4] + q) >> 51;
+    t.v[0] += 19 * q;
+    uint64_t c;
+    c = t.v[0] >> 51; t.v[0] &= MASK51; t.v[1] += c;
+    c = t.v[1] >> 51; t.v[1] &= MASK51; t.v[2] += c;
+    c = t.v[2] >> 51; t.v[2] &= MASK51; t.v[3] += c;
+    c = t.v[3] >> 51; t.v[3] &= MASK51; t.v[4] += c;
+    t.v[4] &= MASK51;
+    uint64_t w[4];
+    w[0] = t.v[0] | (t.v[1] << 51);
+    w[1] = (t.v[1] >> 13) | (t.v[2] << 38);
+    w[2] = (t.v[2] >> 26) | (t.v[3] << 25);
+    w[3] = (t.v[3] >> 39) | (t.v[4] << 12);
+    for (int i = 0; i < 4; i++)
+        for (int j = 0; j < 8; j++) out[8 * i + j] = uint8_t(w[i] >> (8 * j));
+}
+
+static void fe_frombytes(Fe& o, const uint8_t in[32]) {
+    uint64_t w[4];
+    for (int i = 0; i < 4; i++) {
+        w[i] = 0;
+        for (int j = 7; j >= 0; j--) w[i] = (w[i] << 8) | in[8 * i + j];
+    }
+    o.v[0] = w[0] & MASK51;
+    o.v[1] = ((w[0] >> 51) | (w[1] << 13)) & MASK51;
+    o.v[2] = ((w[1] >> 38) | (w[2] << 26)) & MASK51;
+    o.v[3] = ((w[2] >> 25) | (w[3] << 39)) & MASK51;
+    o.v[4] = (w[3] >> 12) & MASK51;  // top bit dropped (sign bit)
+}
+
+static bool fe_iszero(const Fe& a) {
+    uint8_t b[32];
+    fe_tobytes(b, a);
+    uint8_t r = 0;
+    for (int i = 0; i < 32; i++) r |= b[i];
+    return r == 0;
+}
+
+static bool fe_eq(const Fe& a, const Fe& b) {
+    uint8_t x[32], y[32];
+    fe_tobytes(x, a);
+    fe_tobytes(y, b);
+    return memcmp(x, y, 32) == 0;
+}
+
+static int fe_parity(const Fe& a) {
+    uint8_t b[32];
+    fe_tobytes(b, a);
+    return b[0] & 1;
+}
+
+static void fe_neg(Fe& o, const Fe& a) {
+    Fe z;
+    fe_zero(z);
+    fe_sub(o, z, a);
+    fe_carry(o);
+}
+
+// o = a^(2^n) by repeated squaring into o (a may alias o)
+static void fe_sqn(Fe& o, const Fe& a, int n) {
+    fe_copy(o, a);
+    for (int i = 0; i < n; i++) fe_sq(o, o);
+}
+
+// o = a^(p-2): inversion by Fermat (addition chain from the curve literature)
+static void fe_invert(Fe& o, const Fe& a) {
+    Fe t0, t1, t2, t3;
+    fe_sq(t0, a);               // a^2
+    fe_sq(t1, t0); fe_sq(t1, t1);  // a^8
+    fe_mul(t1, t1, a);          // a^9
+    fe_mul(t0, t0, t1);         // a^11
+    fe_sq(t2, t0);              // a^22
+    fe_mul(t1, t1, t2);         // a^31 = a^(2^5-1)
+    fe_sqn(t2, t1, 5); fe_mul(t1, t2, t1);   // 2^10-1
+    fe_sqn(t2, t1, 10); fe_mul(t2, t2, t1);  // 2^20-1
+    fe_sqn(t3, t2, 20); fe_mul(t2, t3, t2);  // 2^40-1
+    fe_sqn(t2, t2, 10); fe_mul(t1, t2, t1);  // 2^50-1
+    fe_sqn(t2, t1, 50); fe_mul(t2, t2, t1);  // 2^100-1
+    fe_sqn(t3, t2, 100); fe_mul(t2, t3, t2); // 2^200-1
+    fe_sqn(t2, t2, 50); fe_mul(t1, t2, t1);  // 2^250-1
+    fe_sqn(t1, t1, 5);
+    fe_mul(o, t1, t0);          // 2^255-21 = p-2
+}
+
+// o = a^((p-5)/8), used by the combined sqrt-ratio in decompression
+static void fe_pow22523(Fe& o, const Fe& a) {
+    Fe t0, t1, t2;
+    fe_sq(t0, a);
+    fe_sq(t1, t0); fe_sq(t1, t1);
+    fe_mul(t1, t1, a);          // a^9
+    fe_mul(t0, t0, t1);         // a^11
+    fe_sq(t0, t0);              // a^22
+    fe_mul(t0, t0, t1);         // a^31
+    fe_sqn(t1, t0, 5); fe_mul(t0, t1, t0);
+    fe_sqn(t1, t0, 10); fe_mul(t1, t1, t0);
+    fe_sqn(t2, t1, 20); fe_mul(t1, t2, t1);
+    fe_sqn(t1, t1, 10); fe_mul(t0, t1, t0);
+    fe_sqn(t1, t0, 50); fe_mul(t1, t1, t0);
+    fe_sqn(t2, t1, 100); fe_mul(t1, t2, t1);
+    fe_sqn(t1, t1, 50); fe_mul(t0, t1, t0);
+    fe_sq(t0, t0); fe_sq(t0, t0);
+    fe_mul(o, t0, a);
+}
+
+// curve constants
+static const Fe FE_D = {{0x34dca135978a3ull, 0x1a8283b156ebdull, 0x5e7a26001c029ull,
+                         0x739c663a03cbbull, 0x52036cee2b6ffull}};
+static const Fe FE_SQRTM1 = {{0x61b274a0ea0b0ull, 0xd5a5fc8f189dull, 0x7ef5e9cbd0c60ull,
+                              0x78595a6804c9eull, 0x2b8324804fc1dull}};
+
+struct Point {  // extended coordinates: x = X/Z, y = Y/Z, T = XY/Z
+    Fe X, Y, Z, T;
+};
+
+static void pt_identity(Point& o) {
+    fe_zero(o.X);
+    fe_one(o.Y);
+    fe_one(o.Z);
+    fe_zero(o.T);
+}
+
+// unified addition (RFC 8032 §5.1.4)
+static void pt_add(Point& o, const Point& p, const Point& q) {
+    Fe a, b, c, d, e, f, g, h, t;
+    fe_sub(t, p.Y, p.X); fe_carry(t);
+    fe_sub(a, q.Y, q.X); fe_carry(a);
+    fe_mul(a, t, a);                       // A = (Y1-X1)(Y2-X2)
+    fe_add(t, p.Y, p.X);
+    fe_add(b, q.Y, q.X);
+    fe_mul(b, t, b);                       // B = (Y1+X1)(Y2+X2)
+    fe_mul(c, p.T, q.T);
+    fe_mul(c, c, FE_D);
+    fe_add(c, c, c);                       // C = 2 d T1 T2
+    fe_carry(c);
+    fe_mul(d, p.Z, q.Z);
+    fe_add(d, d, d);                       // D = 2 Z1 Z2
+    fe_carry(d);
+    fe_sub(e, b, a); fe_carry(e);          // E = B - A
+    fe_sub(f, d, c); fe_carry(f);          // F = D - C
+    fe_add(g, d, c); fe_carry(g);          // G = D + C
+    fe_add(h, b, a); fe_carry(h);          // H = B + A
+    fe_mul(o.X, e, f);
+    fe_mul(o.Y, g, h);
+    fe_mul(o.T, e, h);
+    fe_mul(o.Z, f, g);
+}
+
+static void pt_double(Point& o, const Point& p) {
+    Fe a, b, c, e, f, g, h, t;
+    fe_sq(a, p.X);                         // A = X1^2
+    fe_sq(b, p.Y);                         // B = Y1^2
+    fe_sq(c, p.Z);
+    fe_add(c, c, c); fe_carry(c);          // C = 2 Z1^2
+    fe_add(h, a, b); fe_carry(h);          // H = A + B
+    fe_add(t, p.X, p.Y); fe_carry(t);
+    fe_sq(t, t);
+    fe_sub(e, h, t); fe_carry(e);          // E = H - (X1+Y1)^2
+    fe_sub(g, a, b); fe_carry(g);          // G = A - B
+    fe_add(f, c, g); fe_carry(f);          // F = C + G
+    fe_mul(o.X, e, f);
+    fe_mul(o.Y, g, h);
+    fe_mul(o.T, e, h);
+    fe_mul(o.Z, f, g);
+}
+
+static void pt_neg(Point& o, const Point& p) {
+    fe_neg(o.X, p.X);
+    fe_copy(o.Y, p.Y);
+    fe_copy(o.Z, p.Z);
+    fe_neg(o.T, p.T);
+}
+
+static void pt_tobytes(uint8_t out[32], const Point& p) {
+    Fe zi, x, y;
+    fe_invert(zi, p.Z);
+    fe_mul(x, p.X, zi);
+    fe_mul(y, p.Y, zi);
+    fe_tobytes(out, y);
+    out[31] ^= uint8_t(fe_parity(x) << 7);
+}
+
+// decompress per RFC 8032 §5.1.3; returns false on invalid encoding
+static bool pt_frombytes(Point& o, const uint8_t in[32]) {
+    // reject non-canonical y (y >= p)
+    static const uint8_t PBYTES[32] = {
+        0xed,0xff,0xff,0xff,0xff,0xff,0xff,0xff,0xff,0xff,0xff,0xff,0xff,0xff,
+        0xff,0xff,0xff,0xff,0xff,0xff,0xff,0xff,0xff,0xff,0xff,0xff,0xff,0xff,
+        0xff,0xff,0xff,0x7f};
+    uint8_t ycopy[32];
+    memcpy(ycopy, in, 32);
+    ycopy[31] &= 0x7f;
+    // compare little-endian ycopy >= p ?
+    bool ge = true;
+    for (int i = 31; i >= 0; i--) {
+        if (ycopy[i] < PBYTES[i]) { ge = false; break; }
+        if (ycopy[i] > PBYTES[i]) { break; }
+    }
+    if (ge) return false;
+
+    int sign = in[31] >> 7;
+    Fe y, y2, u, v, x, t, chk;
+    fe_frombytes(y, in);
+    fe_sq(y2, y);
+    Fe one;
+    fe_one(one);
+    fe_sub(u, y2, one); fe_carry(u);       // u = y^2 - 1
+    fe_mul(v, y2, FE_D);
+    fe_add(v, v, one); fe_carry(v);        // v = d y^2 + 1
+    // x = u v^3 (u v^7)^((p-5)/8)
+    Fe v3, v7;
+    fe_sq(v3, v); fe_mul(v3, v3, v);       // v^3
+    fe_sq(v7, v3); fe_mul(v7, v7, v);      // v^7
+    fe_mul(t, u, v7);
+    fe_pow22523(t, t);
+    fe_mul(x, u, v3);
+    fe_mul(x, x, t);
+    // check v x^2 == ±u
+    fe_sq(chk, x);
+    fe_mul(chk, chk, v);
+    Fe negu;
+    fe_neg(negu, u);
+    if (!fe_eq(chk, u)) {
+        if (!fe_eq(chk, negu)) return false;
+        fe_mul(x, x, FE_SQRTM1);
+    }
+    if (fe_iszero(x) && sign) return false;  // -0 is invalid
+    if (fe_parity(x) != sign) fe_neg(x, x);
+    fe_copy(o.X, x);
+    fe_copy(o.Y, y);
+    fe_one(o.Z);
+    fe_mul(o.T, x, y);
+    return true;
+}
+
+// ---------------------------------------------------------------- scalars
+
+// group order L = 2^252 + 27742317777372353535851937790883648493 (little-endian)
+static const uint8_t LBYTES[32] = {
+    0xed,0xd3,0xf5,0x5c,0x1a,0x63,0x12,0x58,0xd6,0x9c,0xf7,0xa2,0xde,0xf9,
+    0xde,0x14,0x00,0x00,0x00,0x00,0x00,0x00,0x00,0x00,0x00,0x00,0x00,0x00,
+    0x00,0x00,0x00,0x10};
+
+static bool sc_canonical(const uint8_t s[32]) {  // s < L ?
+    for (int i = 31; i >= 0; i--) {
+        if (s[i] < LBYTES[i]) return true;
+        if (s[i] > LBYTES[i]) return false;
+    }
+    return false;  // s == L
+}
+
+// 320-bit helper bignum for reducing SHA-512 output mod L
+struct B320 {
+    uint64_t v[5] = {0, 0, 0, 0, 0};
+};
+
+static int b320_cmp(const B320& a, const B320& b) {
+    for (int i = 4; i >= 0; i--) {
+        if (a.v[i] < b.v[i]) return -1;
+        if (a.v[i] > b.v[i]) return 1;
+    }
+    return 0;
+}
+
+static void b320_sub(B320& a, const B320& b) {
+    u128 borrow = 0;
+    for (int i = 0; i < 5; i++) {
+        u128 d = (u128)a.v[i] - b.v[i] - borrow;
+        a.v[i] = (uint64_t)d;
+        borrow = (d >> 64) ? 1 : 0;
+    }
+}
+
+static void b320_shl1(B320& a) {
+    for (int i = 4; i > 0; i--) a.v[i] = (a.v[i] << 1) | (a.v[i - 1] >> 63);
+    a.v[0] <<= 1;
+}
+
+// out = (64-byte little-endian h) mod L, as 32 little-endian bytes
+static void sc_reduce64(uint8_t out[32], const uint8_t h[64]) {
+    B320 L;
+    for (int i = 0; i < 4; i++)
+        for (int j = 0; j < 8; j++) L.v[i] |= (uint64_t)LBYTES[8 * i + j] << (8 * j);
+    B320 r;
+    for (int byte = 63; byte >= 0; byte--) {
+        // r = r * 256 + h[byte]
+        for (int k = 0; k < 8; k++) b320_shl1(r);
+        r.v[0] |= h[byte];
+        // r < 256 L after the shift; subtract L<<k greedily
+        for (int k = 8; k >= 0; k--) {
+            B320 Lk = L;
+            for (int s = 0; s < k; s++) b320_shl1(Lk);
+            if (b320_cmp(r, Lk) >= 0) b320_sub(r, Lk);
+        }
+    }
+    for (int i = 0; i < 4; i++)
+        for (int j = 0; j < 8; j++) out[8 * i + j] = uint8_t(r.v[i] >> (8 * j));
+}
+
+// ---------------------------------------------------------------- verify
+
+// o = [k]P, k = 32 little-endian bytes, 4-bit fixed windows
+static void pt_scalarmult(Point& o, const uint8_t k[32], const Point& P) {
+    Point table[16];
+    pt_identity(table[0]);
+    table[1] = P;
+    for (int i = 2; i < 16; i++) pt_add(table[i], table[i - 1], P);
+    pt_identity(o);
+    for (int i = 63; i >= 0; i--) {
+        for (int d = 0; d < 4; d++) pt_double(o, o);
+        int nib = (k[i / 2] >> ((i & 1) ? 4 : 0)) & 0xF;
+        if (nib) pt_add(o, o, table[nib]);
+    }
+}
+
+// base point B
+static bool basepoint(Point& B) {
+    static const uint8_t BBYTES[32] = {
+        0x58,0x66,0x66,0x66,0x66,0x66,0x66,0x66,0x66,0x66,0x66,0x66,0x66,0x66,
+        0x66,0x66,0x66,0x66,0x66,0x66,0x66,0x66,0x66,0x66,0x66,0x66,0x66,0x66,
+        0x66,0x66,0x66,0x66};
+    return pt_frombytes(B, BBYTES);
+}
+
+// public entry: 1 valid, 0 invalid
+extern "C" int tm_ed25519_verify(const uint8_t pub[32], const uint8_t* msg,
+                                 size_t msglen, const uint8_t sig[64]) {
+    if (!sc_canonical(sig + 32)) return 0;  // non-canonical s (malleability)
+    Point A, B;
+    if (!pt_frombytes(A, pub)) return 0;
+    Point Rpt;
+    if (!pt_frombytes(Rpt, sig)) return 0;  // R must be a valid point
+    if (!basepoint(B)) return 0;
+
+    // h = SHA512(R || A || M) mod L
+    uint8_t hfull[64], h[32];
+    Sha512 sh;
+    sh.update(sig, 32);
+    sh.update(pub, 32);
+    sh.update(msg, msglen);
+    sh.final(hfull);
+    sc_reduce64(h, hfull);
+
+    // check [s]B == R + [h]A  <=>  [s]B + [h](-A) == R  (sig = R || s)
+    Point negA, sB, hA, sum;
+    pt_neg(negA, A);
+    pt_scalarmult(sB, sig + 32, B);
+    pt_scalarmult(hA, h, negA);
+    pt_add(sum, sB, hA);
+    uint8_t enc[32];
+    pt_tobytes(enc, sum);
+    return memcmp(enc, sig, 32) == 0 ? 1 : 0;
+}
+
+}  // namespace tmnative

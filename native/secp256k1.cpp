@@ -1,0 +1,432 @@
+// secp256k1 ECDSA verification, clean-room C++.
+//
+// The native-parity replacement for the reference's vendored libsecp256k1
+// (crypto/secp256k1/internal, 17.5k LoC of C): this framework only needs
+// the verify path natively (signing stays in the Python key objects), in
+// tendermint's wire format — 33-byte compressed pubkey, 64-byte r||s
+// signature with the low-S rule (reference secp256k1_nocgo.go:40-50),
+// SHA-256 message digest.
+//
+// Field arithmetic: 4x64 limbs, reduction by p = 2^256 - 0x1000003D1.
+// Scalar arithmetic mod n: bit-serial reduction (verification is the CPU
+// fallback path; simplicity over speed). Points: Jacobian coordinates.
+#include <cstdint>
+#include <cstring>
+#include "sha2.h"
+
+namespace tmnative {
+
+typedef unsigned __int128 u128;
+
+// ------------------------------------------------------------- field (mod p)
+
+struct Fp {
+    uint64_t v[4];  // little-endian limbs
+};
+
+static const uint64_t P[4] = {0xFFFFFFFEFFFFFC2Full, 0xFFFFFFFFFFFFFFFFull,
+                              0xFFFFFFFFFFFFFFFFull, 0xFFFFFFFFFFFFFFFFull};
+static const uint64_t PC = 0x1000003D1ull;  // 2^256 mod p
+
+static int fp_cmp_raw(const uint64_t a[4], const uint64_t b[4]) {
+    for (int i = 3; i >= 0; i--) {
+        if (a[i] < b[i]) return -1;
+        if (a[i] > b[i]) return 1;
+    }
+    return 0;
+}
+
+static void fp_sub_p(uint64_t a[4]) {
+    u128 borrow = 0;
+    for (int i = 0; i < 4; i++) {
+        u128 d = (u128)a[i] - P[i] - borrow;
+        a[i] = (uint64_t)d;
+        borrow = (d >> 64) ? 1 : 0;
+    }
+}
+
+static void fp_norm(Fp& a) {
+    if (fp_cmp_raw(a.v, P) >= 0) fp_sub_p(a.v);
+}
+
+static void fp_add(Fp& o, const Fp& a, const Fp& b) {
+    u128 carry = 0;
+    for (int i = 0; i < 4; i++) {
+        u128 s = (u128)a.v[i] + b.v[i] + carry;
+        o.v[i] = (uint64_t)s;
+        carry = (uint64_t)(s >> 64);
+    }
+    if (carry) {  // wrapped 2^256: add PC
+        u128 c = PC;
+        for (int i = 0; i < 4 && c; i++) {
+            u128 s = (u128)o.v[i] + c;
+            o.v[i] = (uint64_t)s;
+            c = (uint64_t)(s >> 64);
+        }
+    }
+    fp_norm(o);
+}
+
+static void fp_sub(Fp& o, const Fp& a, const Fp& b) {
+    u128 borrow = 0;
+    for (int i = 0; i < 4; i++) {
+        u128 d = (u128)a.v[i] - b.v[i] - borrow;
+        o.v[i] = (uint64_t)d;
+        borrow = (d >> 64) ? 1 : 0;
+    }
+    if (borrow) {  // add p back
+        u128 carry = 0;
+        for (int i = 0; i < 4; i++) {
+            u128 s = (u128)o.v[i] + P[i] + carry;
+            o.v[i] = (uint64_t)s;
+            carry = (uint64_t)(s >> 64);
+        }
+    }
+}
+
+static void fp_mul(Fp& o, const Fp& a, const Fp& b) {
+    uint64_t t[8] = {0};
+    for (int i = 0; i < 4; i++) {
+        u128 carry = 0;
+        for (int j = 0; j < 4; j++) {
+            u128 cur = (u128)t[i + j] + (u128)a.v[i] * b.v[j] + carry;
+            t[i + j] = (uint64_t)cur;
+            carry = (uint64_t)(cur >> 64);
+        }
+        t[i + 4] += (uint64_t)carry;
+    }
+    // fold: value = lo + hi * 2^256 ≡ lo + hi * PC (twice)
+    uint64_t r[5] = {t[0], t[1], t[2], t[3], 0};
+    u128 carry = 0;
+    for (int i = 0; i < 4; i++) {
+        u128 cur = (u128)r[i] + (u128)t[4 + i] * PC + carry;
+        r[i] = (uint64_t)cur;
+        carry = (uint64_t)(cur >> 64);
+    }
+    r[4] = (uint64_t)carry;
+    // second fold of the (small) top limb
+    u128 c2 = (u128)r[4] * PC;
+    uint64_t res[4] = {r[0], r[1], r[2], r[3]};
+    for (int i = 0; i < 4 && c2; i++) {
+        u128 s = (u128)res[i] + (uint64_t)c2;
+        res[i] = (uint64_t)s;
+        c2 = (c2 >> 64) + (s >> 64);
+    }
+    memcpy(o.v, res, sizeof res);
+    fp_norm(o);
+}
+
+static void fp_sq(Fp& o, const Fp& a) { fp_mul(o, a, a); }
+
+static void fp_pow(Fp& o, const Fp& a, const uint64_t e[4]) {
+    Fp result = {{1, 0, 0, 0}}, base = a;
+    for (int i = 0; i < 256; i++) {
+        if ((e[i / 64] >> (i % 64)) & 1) fp_mul(result, result, base);
+        fp_sq(base, base);
+    }
+    o = result;
+}
+
+static void fp_invert(Fp& o, const Fp& a) {
+    uint64_t e[4];
+    memcpy(e, P, sizeof e);
+    e[0] -= 2;  // p - 2 (no borrow: low limb ends ...C2F)
+    fp_pow(o, a, e);
+}
+
+static bool fp_sqrt(Fp& o, const Fp& a) {  // p ≡ 3 (mod 4)
+    uint64_t e[4];
+    memcpy(e, P, sizeof e);
+    // (p+1)/4: add 1 then shift right 2
+    e[0] += 1;
+    for (int i = 0; i < 4; i++) {
+        e[i] >>= 2;
+        if (i < 3) e[i] |= e[i + 1] << 62;
+    }
+    fp_pow(o, a, e);
+    Fp chk;
+    fp_sq(chk, o);
+    return memcmp(chk.v, a.v, sizeof chk.v) == 0;
+}
+
+static bool fp_iszero(const Fp& a) {
+    return (a.v[0] | a.v[1] | a.v[2] | a.v[3]) == 0;
+}
+
+static void fp_frombytes_be(Fp& o, const uint8_t in[32]) {
+    for (int i = 0; i < 4; i++) {
+        o.v[3 - i] = 0;
+        for (int j = 0; j < 8; j++) o.v[3 - i] = (o.v[3 - i] << 8) | in[8 * i + j];
+    }
+}
+
+static void fp_tobytes_be(uint8_t out[32], const Fp& a) {
+    for (int i = 0; i < 4; i++)
+        for (int j = 0; j < 8; j++)
+            out[8 * i + j] = uint8_t(a.v[3 - i] >> (56 - 8 * j));
+}
+
+// ------------------------------------------------------------ scalars (mod n)
+
+static const uint64_t N[4] = {0xBFD25E8CD0364141ull, 0xBAAEDCE6AF48A03Bull,
+                              0xFFFFFFFFFFFFFFFEull, 0xFFFFFFFFFFFFFFFFull};
+// n/2 for the low-S rule
+static const uint64_t NHALF[4] = {0xDFE92F46681B20A0ull, 0x5D576E7357A4501Dull,
+                                  0xFFFFFFFFFFFFFFFFull, 0x7FFFFFFFFFFFFFFFull};
+
+struct Sc {
+    uint64_t v[4];
+};
+
+static int sc_cmp_raw(const uint64_t a[4], const uint64_t b[4]) {
+    for (int i = 3; i >= 0; i--) {
+        if (a[i] < b[i]) return -1;
+        if (a[i] > b[i]) return 1;
+    }
+    return 0;
+}
+
+static void sc_sub_n(uint64_t a[4]) {
+    u128 borrow = 0;
+    for (int i = 0; i < 4; i++) {
+        u128 d = (u128)a[i] - N[i] - borrow;
+        a[i] = (uint64_t)d;
+        borrow = (d >> 64) ? 1 : 0;
+    }
+}
+
+static bool sc_iszero(const Sc& a) {
+    return (a.v[0] | a.v[1] | a.v[2] | a.v[3]) == 0;
+}
+
+static void sc_frombytes_be(Sc& o, const uint8_t in[32]) {
+    for (int i = 0; i < 4; i++) {
+        o.v[3 - i] = 0;
+        for (int j = 0; j < 8; j++) o.v[3 - i] = (o.v[3 - i] << 8) | in[8 * i + j];
+    }
+    while (sc_cmp_raw(o.v, N) >= 0) sc_sub_n(o.v);
+}
+
+// o = a*b mod n — 512-bit product then bit-serial reduction (fallback path;
+// ~512 iterations of shift/cmp/sub on 4 limbs)
+static void sc_mul(Sc& o, const Sc& a, const Sc& b) {
+    uint64_t t[8] = {0};
+    for (int i = 0; i < 4; i++) {
+        u128 carry = 0;
+        for (int j = 0; j < 4; j++) {
+            u128 cur = (u128)t[i + j] + (u128)a.v[i] * b.v[j] + carry;
+            t[i + j] = (uint64_t)cur;
+            carry = (uint64_t)(cur >> 64);
+        }
+        t[i + 4] += (uint64_t)carry;
+    }
+    uint64_t r[4] = {0, 0, 0, 0};
+    for (int bit = 511; bit >= 0; bit--) {
+        // r <<= 1
+        uint64_t top = r[3] >> 63;
+        for (int i = 3; i > 0; i--) r[i] = (r[i] << 1) | (r[i - 1] >> 63);
+        r[0] <<= 1;
+        r[0] |= (t[bit / 64] >> (bit % 64)) & 1;
+        if (top || sc_cmp_raw(r, N) >= 0) sc_sub_n(r);
+    }
+    memcpy(o.v, r, sizeof r);
+}
+
+static void sc_invert(Sc& o, const Sc& a) {  // Fermat: a^(n-2)
+    uint64_t e[4];
+    memcpy(e, N, sizeof e);
+    e[0] -= 2;
+    Sc result = {{1, 0, 0, 0}}, base = a;
+    for (int i = 0; i < 256; i++) {
+        if ((e[i / 64] >> (i % 64)) & 1) sc_mul(result, result, base);
+        sc_mul(base, base, base);
+    }
+    o = result;
+}
+
+// --------------------------------------------------------------- points
+
+struct Jac {  // Jacobian: x = X/Z^2, y = Y/Z^3; Z = 0 => infinity
+    Fp X, Y, Z;
+};
+
+static const Fp FP_B = {{7, 0, 0, 0}};
+static const Fp GX = {{0x59F2815B16F81798ull, 0x029BFCDB2DCE28D9ull,
+                       0x55A06295CE870B07ull, 0x79BE667EF9DCBBACull}};
+static const Fp GY = {{0x9C47D08FFB10D4B8ull, 0xFD17B448A6855419ull,
+                       0x5DA4FBFC0E1108A8ull, 0x483ADA7726A3C465ull}};
+
+static void jac_infinity(Jac& o) {
+    memset(&o, 0, sizeof o);
+    o.X.v[0] = 1;
+    o.Y.v[0] = 1;
+}
+
+static bool jac_is_infinity(const Jac& p) { return fp_iszero(p.Z); }
+
+static void jac_double(Jac& o, const Jac& p) {
+    if (jac_is_infinity(p) || fp_iszero(p.Y)) {
+        jac_infinity(o);
+        return;
+    }
+    Fp A, B, C, D, X3, Y3, Z3, t;
+    fp_sq(A, p.X);                       // A = X^2
+    fp_sq(B, p.Y);                       // B = Y^2
+    fp_sq(C, B);                         // C = B^2
+    // D = 2((X+B)^2 - A - C)
+    fp_add(t, p.X, B);
+    fp_sq(t, t);
+    fp_sub(t, t, A);
+    fp_sub(t, t, C);
+    fp_add(D, t, t);
+    Fp E, F;
+    fp_add(E, A, A);
+    fp_add(E, E, A);                     // E = 3A (a = 0 curve)
+    fp_sq(F, E);                         // F = E^2
+    fp_sub(X3, F, D);
+    fp_sub(X3, X3, D);                   // X3 = F - 2D
+    fp_sub(t, D, X3);
+    fp_mul(t, E, t);
+    Fp C8;
+    fp_add(C8, C, C);
+    fp_add(C8, C8, C8);
+    fp_add(C8, C8, C8);                  // 8C
+    fp_sub(Y3, t, C8);                   // Y3 = E(D - X3) - 8C
+    fp_mul(Z3, p.Y, p.Z);
+    fp_add(Z3, Z3, Z3);                  // Z3 = 2 Y Z
+    o.X = X3; o.Y = Y3; o.Z = Z3;
+}
+
+static void jac_add(Jac& o, const Jac& p, const Jac& q) {
+    if (jac_is_infinity(p)) { o = q; return; }
+    if (jac_is_infinity(q)) { o = p; return; }
+    Fp Z1Z1, Z2Z2, U1, U2, S1, S2, t;
+    fp_sq(Z1Z1, p.Z);
+    fp_sq(Z2Z2, q.Z);
+    fp_mul(U1, p.X, Z2Z2);
+    fp_mul(U2, q.X, Z1Z1);
+    fp_mul(t, q.Z, Z2Z2);
+    fp_mul(S1, p.Y, t);
+    fp_mul(t, p.Z, Z1Z1);
+    fp_mul(S2, q.Y, t);
+    Fp H, R;
+    fp_sub(H, U2, U1);
+    fp_sub(R, S2, S1);
+    if (fp_iszero(H)) {
+        if (fp_iszero(R)) { jac_double(o, p); return; }
+        jac_infinity(o);  // P + (-P)
+        return;
+    }
+    Fp H2, H3, U1H2, X3, Y3, Z3;
+    fp_sq(H2, H);
+    fp_mul(H3, H2, H);
+    fp_mul(U1H2, U1, H2);
+    fp_sq(X3, R);
+    fp_sub(X3, X3, H3);
+    fp_sub(X3, X3, U1H2);
+    fp_sub(X3, X3, U1H2);                // X3 = R^2 - H^3 - 2 U1 H^2
+    fp_sub(t, U1H2, X3);
+    fp_mul(t, R, t);
+    Fp S1H3;
+    fp_mul(S1H3, S1, H3);
+    fp_sub(Y3, t, S1H3);                 // Y3 = R(U1 H^2 - X3) - S1 H^3
+    fp_mul(Z3, p.Z, q.Z);
+    fp_mul(Z3, Z3, H);                   // Z3 = Z1 Z2 H
+    o.X = X3; o.Y = Y3; o.Z = Z3;
+}
+
+static void jac_scalarmult(Jac& o, const Sc& k, const Jac& P) {
+    // 4-bit windows, MSB first
+    Jac table[16];
+    jac_infinity(table[0]);
+    table[1] = P;
+    for (int i = 2; i < 16; i++) jac_add(table[i], table[i - 1], P);
+    jac_infinity(o);
+    for (int nib = 63; nib >= 0; nib--) {
+        for (int d = 0; d < 4; d++) jac_double(o, o);
+        int idx = (k.v[nib / 16] >> (4 * (nib % 16))) & 0xF;
+        if (idx) jac_add(o, o, table[idx]);
+    }
+}
+
+// decompress 33-byte SEC1 pubkey
+static bool point_decompress(Jac& o, const uint8_t in[33]) {
+    if (in[0] != 0x02 && in[0] != 0x03) return false;
+    Fp x;
+    fp_frombytes_be(x, in + 1);
+    // reject x >= p
+    uint8_t canon[32];
+    fp_tobytes_be(canon, x);
+    if (memcmp(canon, in + 1, 32) != 0) return false;
+    Fp rhs, y;
+    fp_sq(rhs, x);
+    fp_mul(rhs, rhs, x);
+    fp_add(rhs, rhs, FP_B);  // x^3 + 7
+    if (!fp_sqrt(y, rhs)) return false;
+    // choose parity
+    if ((y.v[0] & 1) != (in[0] & 1)) {
+        Fp py = {{P[0], P[1], P[2], P[3]}};
+        fp_sub(y, py, y);
+    }
+    o.X = x;
+    o.Y = y;
+    memset(&o.Z, 0, sizeof o.Z);
+    o.Z.v[0] = 1;
+    return true;
+}
+
+// public entry: tendermint wire format — 33B compressed pubkey, 64B r||s,
+// low-S enforced; msg is hashed with SHA-256. Returns 1 valid / 0 invalid.
+extern "C" int tm_secp256k1_verify(const uint8_t pub[33], const uint8_t* msg,
+                                   size_t msglen, const uint8_t sig[64]) {
+    // parse r, s
+    uint64_t rraw[4], sraw[4];
+    for (int i = 0; i < 4; i++) {
+        rraw[3 - i] = 0;
+        sraw[3 - i] = 0;
+        for (int j = 0; j < 8; j++) {
+            rraw[3 - i] = (rraw[3 - i] << 8) | sig[8 * i + j];
+            sraw[3 - i] = (sraw[3 - i] << 8) | sig[32 + 8 * i + j];
+        }
+    }
+    Sc r, s;
+    memcpy(r.v, rraw, sizeof rraw);
+    memcpy(s.v, sraw, sizeof sraw);
+    if (sc_iszero(r) || sc_iszero(s)) return 0;
+    if (sc_cmp_raw(rraw, N) >= 0) return 0;
+    if (sc_cmp_raw(sraw, N) >= 0) return 0;
+    if (sc_cmp_raw(sraw, NHALF) > 0) return 0;  // high-S malleability
+
+    Jac Q;
+    if (!point_decompress(Q, pub)) return 0;
+
+    uint8_t digest[32];
+    sha256(msg, msglen, digest);
+    Sc z;
+    sc_frombytes_be(z, digest);
+
+    Sc w, u1, u2;
+    sc_invert(w, s);
+    sc_mul(u1, z, w);
+    sc_mul(u2, r, w);
+
+    Jac G = {GX, GY, {{1, 0, 0, 0}}};
+    Jac p1, p2, R;
+    jac_scalarmult(p1, u1, G);
+    jac_scalarmult(p2, u2, Q);
+    jac_add(R, p1, p2);
+    if (jac_is_infinity(R)) return 0;
+
+    // r' = R.x (affine) mod n
+    Fp zinv, zinv2, xaff;
+    fp_invert(zinv, R.Z);
+    fp_sq(zinv2, zinv);
+    fp_mul(xaff, R.X, zinv2);
+    uint8_t xb[32];
+    fp_tobytes_be(xb, xaff);
+    Sc rprime;
+    sc_frombytes_be(rprime, xb);
+    return sc_cmp_raw(rprime.v, r.v) == 0 ? 1 : 0;
+}
+
+}  // namespace tmnative

@@ -1,0 +1,125 @@
+"""ctypes binding for the native (C++) batch verify core.
+
+Reference parity: the cgo/nocgo dual build of crypto/secp256k1
+(secp256k1_cgo.go / secp256k1_nocgo.go) — the native path is used when the
+shared library is available (building it on first use if a toolchain is
+present), and everything degrades gracefully to the pure-Python key objects
+otherwise. Backend priority in crypto/batch.py: the TPU kernel (registered
+by tendermint_tpu.ops) wins for ed25519; this module registers the
+secp256k1 backend and serves as the ed25519 fallback for no-TPU builds.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libtmnative.so")
+
+_lib = None
+_load_error: str | None = None
+
+
+def _build() -> bool:
+    if not os.path.isdir(_NATIVE_DIR):
+        return False
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR],
+            check=True,
+            capture_output=True,
+            timeout=300,
+        )
+        return os.path.exists(_SO_PATH)
+    except (subprocess.SubprocessError, FileNotFoundError):
+        return False
+
+
+def load():
+    """Load (building if necessary) the shared library; returns None if the
+    native path is unavailable."""
+    global _lib, _load_error
+    if _lib is not None:
+        return _lib
+    if _load_error is not None:
+        return None
+    if not os.path.exists(_SO_PATH) and not _build():
+        _load_error = "no toolchain / build failed"
+        return None
+    try:
+        lib = ctypes.CDLL(_SO_PATH)
+    except OSError as e:
+        _load_error = str(e)
+        return None
+    for name, pub_stride in (("tm_ed25519_verify_batch", 32), ("tm_secp256k1_verify_batch", 33)):
+        fn = getattr(lib, name)
+        fn.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8),   # pubs
+            ctypes.POINTER(ctypes.c_uint8),   # msgs
+            ctypes.POINTER(ctypes.c_uint64),  # offsets
+            ctypes.POINTER(ctypes.c_uint8),   # sigs
+            ctypes.c_size_t,                  # n
+            ctypes.POINTER(ctypes.c_uint8),   # out
+        ]
+        fn.restype = None
+    _lib = lib
+    return lib
+
+
+def _run_batch(fn, pub_stride: int, pubs, msgs, sigs) -> list[bool]:
+    n = len(pubs)
+    pub_buf = bytearray(n * pub_stride)
+    sig_buf = bytearray(n * 64)
+    offsets = (ctypes.c_uint64 * (n + 1))()
+    bad = set()
+    flat = bytearray()
+    for i, (p, m, s) in enumerate(zip(pubs, msgs, sigs)):
+        if len(p) != pub_stride or len(s) != 64:
+            bad.add(i)
+            p = b"\x00" * pub_stride
+            s = b"\x00" * 64
+        pub_buf[i * pub_stride:(i + 1) * pub_stride] = p
+        sig_buf[i * 64:(i + 1) * 64] = s
+        offsets[i] = len(flat)
+        flat.extend(m)
+    offsets[n] = len(flat)
+    out = (ctypes.c_uint8 * n)()
+    msgs_buf = bytes(flat) or b"\x00"
+    fn(
+        (ctypes.c_uint8 * len(pub_buf)).from_buffer(pub_buf),
+        ctypes.cast(ctypes.create_string_buffer(msgs_buf, len(msgs_buf)), ctypes.POINTER(ctypes.c_uint8)),
+        offsets,
+        (ctypes.c_uint8 * len(sig_buf)).from_buffer(sig_buf),
+        n,
+        out,
+    )
+    return [bool(out[i]) and i not in bad for i in range(n)]
+
+
+def ed25519_verify_batch(pubs, msgs, sigs) -> list[bool]:
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    return _run_batch(lib.tm_ed25519_verify_batch, 32, pubs, msgs, sigs)
+
+
+def secp256k1_verify_batch(pubs, msgs, sigs) -> list[bool]:
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    return _run_batch(lib.tm_secp256k1_verify_batch, 33, pubs, msgs, sigs)
+
+
+def register(force: bool = False) -> bool:
+    """Register native backends with crypto.batch. secp256k1 always (the
+    only native impl, like the reference's cgo build); ed25519 only when no
+    TPU backend claimed the slot first (unless force)."""
+    if load() is None:
+        return False
+    from tendermint_tpu.crypto import batch
+
+    batch.register_backend("secp256k1", secp256k1_verify_batch)
+    if force or batch.get_backend("ed25519") is None:
+        batch.register_backend("ed25519", ed25519_verify_batch)
+    return True

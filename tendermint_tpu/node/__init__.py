@@ -95,6 +95,20 @@ class Node(BaseService):
         cfg = self.config
         log = self.log
 
+        # crypto backends: TPU kernel first (ops registers ed25519 on
+        # import), then the native C++ core (secp256k1 always; ed25519 only
+        # if the TPU path is absent) — the reference's cgo/nocgo gate.
+        try:
+            import tendermint_tpu.ops  # noqa: F401
+        except Exception as e:  # no jax / no device: pure-python still works
+            log.info("TPU batch backend unavailable", err=repr(e))
+        try:
+            from tendermint_tpu.crypto import native
+
+            native.register()
+        except Exception as e:
+            log.info("native batch backend unavailable", err=repr(e))
+
         # 1. DBs
         self.block_store_db = _open_db(cfg, "blockstore")
         self.state_db = _open_db(cfg, "state")

@@ -1,0 +1,141 @@
+"""Native (C++) batch verify core tests — cross-checked against the
+OpenSSL-backed Python key objects and RFC 8032 vectors, mirroring the
+reference's crypto test strategy (crypto/*/..._test.go)."""
+import os
+
+import pytest
+
+from tendermint_tpu.crypto import batch, ed25519, native, secp256k1
+
+pytestmark = pytest.mark.skipif(
+    native.load() is None, reason="native toolchain unavailable"
+)
+
+
+class TestNativeEd25519:
+    def test_valid_signatures(self):
+        pubs, msgs, sigs = [], [], []
+        for i in range(20):
+            pk = ed25519.gen_priv_key()
+            m = os.urandom(3 * i + 1)
+            pubs.append(pk.pub_key().bytes())
+            msgs.append(m)
+            sigs.append(pk.sign(m))
+        assert native.ed25519_verify_batch(pubs, msgs, sigs) == [True] * 20
+
+    def test_rejects_corruption(self):
+        pk = ed25519.gen_priv_key()
+        m = b"native-test"
+        sig = pk.sign(m)
+        pub = pk.pub_key().bytes()
+        assert native.ed25519_verify_batch([pub], [m], [sig]) == [True]
+        bad_sig = bytes([sig[0] ^ 1]) + sig[1:]
+        assert native.ed25519_verify_batch([pub], [m], [bad_sig]) == [False]
+        assert native.ed25519_verify_batch([pub], [m + b"x"], [sig]) == [False]
+        bad_pub = bytes([pub[0] ^ 1]) + pub[1:]
+        assert native.ed25519_verify_batch([bad_pub], [m], [sig]) == [False]
+
+    def test_rfc8032_vectors(self):
+        vectors = [
+            # (pub, msg, sig) — RFC 8032 §7.1 tests 1-3
+            (
+                "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+                "",
+                "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+                "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b",
+            ),
+            (
+                "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+                "72",
+                "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+                "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00",
+            ),
+            (
+                "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025",
+                "af82",
+                "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+                "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a",
+            ),
+        ]
+        for pub, msg, sig in vectors:
+            res = native.ed25519_verify_batch(
+                [bytes.fromhex(pub)], [bytes.fromhex(msg)], [bytes.fromhex(sig)]
+            )
+            assert res == [True], f"vector failed: {pub[:16]}"
+
+    def test_rejects_noncanonical_s(self):
+        # s >= L must be rejected (malleability)
+        pk = ed25519.gen_priv_key()
+        m = b"msg"
+        sig = pk.sign(m)
+        L = (1 << 252) + 27742317777372353535851937790883648493
+        s = int.from_bytes(sig[32:], "little")
+        forged = sig[:32] + (s + L).to_bytes(32, "little")
+        assert native.ed25519_verify_batch(
+            [pk.pub_key().bytes()], [m], [forged]
+        ) == [False]
+
+
+class TestNativeSecp256k1:
+    def test_valid_and_invalid(self):
+        pubs, msgs, sigs = [], [], []
+        for i in range(10):
+            pk = secp256k1.gen_priv_key()
+            m = os.urandom(5 * i + 1)
+            pubs.append(pk.pub_key().bytes())
+            msgs.append(m)
+            sigs.append(pk.sign(m))
+        assert native.secp256k1_verify_batch(pubs, msgs, sigs) == [True] * 10
+        bad = [bytes([s[0] ^ 1]) + s[1:] for s in sigs]
+        assert native.secp256k1_verify_batch(pubs, msgs, bad) == [False] * 10
+
+    def test_rejects_high_s(self):
+        pk = secp256k1.gen_priv_key()
+        m = b"high-s"
+        sig = pk.sign(m)
+        n = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        forged = r.to_bytes(32, "big") + (n - s).to_bytes(32, "big")
+        # (n - s) is the high-S twin: cryptographically valid, must be refused
+        assert native.secp256k1_verify_batch(
+            [pk.pub_key().bytes()], [m], [forged]
+        ) == [False]
+
+    def test_agrees_with_python_on_randomized_corpus(self):
+        import random
+
+        rng = random.Random(42)
+        for _ in range(20):
+            pk = secp256k1.gen_priv_key()
+            m = bytes(rng.randrange(256) for _ in range(rng.randrange(1, 100)))
+            sig = pk.sign(m)
+            corrupt = rng.random() < 0.5
+            if corrupt:
+                b = bytearray(sig)
+                b[rng.randrange(64)] ^= 1 << rng.randrange(8)
+                sig = bytes(b)
+            py = pk.pub_key().verify(m, sig)
+            nat = native.secp256k1_verify_batch([pk.pub_key().bytes()], [m], [sig])[0]
+            assert py == nat
+
+
+class TestBackendRegistration:
+    def test_register_and_batch_verifier_integration(self):
+        prev_ed = batch.get_backend("ed25519")
+        prev_secp = batch.get_backend("secp256k1")
+        try:
+            assert native.register(force=True)
+            bv = batch.BatchVerifier()
+            ed = ed25519.gen_priv_key()
+            sp = secp256k1.gen_priv_key()
+            bv.add(ed.pub_key(), b"m1", ed.sign(b"m1"))
+            bv.add(sp.pub_key(), b"m2", sp.sign(b"m2"))
+            bv.add(ed.pub_key(), b"m3", b"\x00" * 64)
+            assert bv.verify_all() == [True, True, False]
+        finally:
+            for kt, prev in (("ed25519", prev_ed), ("secp256k1", prev_secp)):
+                if prev is None:
+                    batch.clear_backend(kt)
+                else:
+                    batch.register_backend(kt, prev)
