@@ -53,15 +53,20 @@ def main() -> None:
     placed = {k: jax.device_put(v, dev) for k, v in inputs.items()}
 
     t0 = time.perf_counter()
-    out = jax.block_until_ready(ed25519_batch.verify_kernel(**placed))
+    out = np.asarray(ed25519_batch.verify_kernel(**placed))
     log(f"compile + first run: {time.perf_counter() - t0:.1f}s")
-    assert np.asarray(out)[:N_COMMIT].all(), "kernel rejected valid sigs"
+    assert out[:N_COMMIT].all(), "kernel rejected valid sigs"
 
-    iters = 10
+    # Honest pipeline timing: fresh host->device transfer of the packed
+    # words + kernel + device->host verdict fetch per iteration. (Under the
+    # axon tunnel, block_until_ready does not guarantee completion and
+    # repeat-identical launches can be result-cached — np.asarray of the
+    # output is the reliable sync point.)
+    iters = 5
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = ed25519_batch.verify_kernel(**placed)
-    jax.block_until_ready(out)
+        fresh = {k: jax.device_put(v, dev) for k, v in inputs.items()}
+        out = np.asarray(ed25519_batch.verify_kernel(**fresh))
     per_commit_s = (time.perf_counter() - t0) / iters
 
     rate = N_COMMIT / per_commit_s

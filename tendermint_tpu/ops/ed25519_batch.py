@@ -9,13 +9,19 @@ Split of work:
 - Host (cheap, per signature): SHA-512(R||A||M) and reduction mod L, scalar
   range check S < L, pubkey decompression to extended coordinates (cached
   per pubkey — validator keys are stable across heights, so steady-state
-  commits pay zero decompression), R parsed as (y_R canonical digits,
-  x parity) with a strict y_R < p check.
-- Device (the FLOPs): Straus/Shamir interleaved double-scalar multiplication
-  R' = [S]B + [h](-A) over 253 constant-time iterations (table
-  {O, B, -A, B-A} in cached form), one batched field inversion, canonical
-  encode, compare with R. Verdict bitmap (B,) comes back; host ANDs it with
-  the structural-validity mask.
+  commits pay zero decompression), R parsed with a strict y_R < p check.
+- Wire format host->device: everything is packed as (8, B) little-endian
+  32-bit words (~200 B/signature). Limb expansion (12-bit limbs for the
+  field core) and 2-bit digit extraction happen ON DEVICE — host->device
+  bandwidth, not FLOPs, is the scarce resource on a tunneled/PCIe path
+  (shipping pre-expanded bit arrays was 14x the bytes).
+- Device (the FLOPs): radix-4 joint Straus/Shamir double-scalar
+  multiplication R' = [S]B + [h](-A): 127 iterations of (2 doubles + 1
+  complete cached add), with a 16-entry table [i]B + [j](-A) (i,j in 0..3)
+  built once per launch (~1% of the loop cost) and selected per lane with a
+  4-level binary select tree. Then one batched field inversion, canonical
+  encode, compare with R. ~25% fewer field multiplies than the bit-serial
+  form (253 D + 253 A -> 254 D + 127 A).
 
 The verification equation is the strict cofactorless one used by Go's
 x/crypto/ed25519 (the reference's verifier): encode([S]B + [h](-A)) == R,
@@ -32,57 +38,173 @@ import numpy as np
 
 from tendermint_tpu.crypto import ed25519_math as em
 from tendermint_tpu.ops import curve, field
-from tendermint_tpu.ops.limbs import NLIMB, ints_to_limbs, scalars_to_bits
+from tendermint_tpu.ops.limbs import LIMB_BITS, LIMB_MASK, NLIMB
 
-NBITS = 253  # scalars are < L < 2^253
+NBITS = 253   # scalars are < L < 2^253
+NDIGITS = 127  # 2-bit digits (bit 253 is always 0)
+NWORDS = 8
 
 
-def _shamir_loop(neg_a: curve.Point, s_bits, h_bits) -> curve.Point:
-    """[S]B + [h]*negA, MSB-first, one double + one complete add per bit."""
-    b = s_bits.shape[1]
+# ---------------------------------------------------------------- device side
 
-    def bcast(c):  # (22,1) module constant -> (22,B)
+
+def words_to_limbs(w):
+    """(8, B) uint32 words -> (22, B) int32 12-bit limbs (static shifts)."""
+    w = w.astype(jnp.uint32)
+    limbs = []
+    for k in range(NLIMB):
+        lo_bit = LIMB_BITS * k
+        a, s = lo_bit // 32, lo_bit % 32
+        v = w[a] >> s
+        if s > 32 - LIMB_BITS and a + 1 < NWORDS:
+            v = v | (w[a + 1] << (32 - s))
+        limbs.append((v & LIMB_MASK).astype(jnp.int32))
+    return jnp.stack(limbs)
+
+
+def words_to_digits(w):
+    """(8, B) uint32 words -> (127, B) int32 2-bit digits, little-endian."""
+    w = w.astype(jnp.uint32)
+    digits = [
+        ((w[i // 16] >> (2 * (i % 16))) & 3).astype(jnp.int32)
+        for i in range(NDIGITS)
+    ]
+    return jnp.stack(digits)
+
+
+def _sel2(bit0, bit1, e0, e1, e2, e3) -> curve.CachedPoint:
+    """Select e[bit1*2 + bit0] with 3 cached-point selects."""
+    lo = curve.select_cached(bit0, e1, e0)
+    hi = curve.select_cached(bit0, e3, e2)
+    return curve.select_cached(bit1, hi, lo)
+
+
+def _build_table(neg_a: curve.Point, b: int) -> list[curve.CachedPoint]:
+    """table[s2*4 + h2] = [s2]B + [h2](-A) in cached form, s2,h2 in 0..3."""
+
+    def bcast(c):
         return jnp.broadcast_to(jnp.asarray(c), (NLIMB, b)).astype(jnp.int32)
 
-    t_base = curve.CachedPoint(*[bcast(c) for c in curve.BASE_CACHED])
-    t_nega = curve.to_cached(neg_a)
-    t_both = curve.to_cached(curve.add_cached(neg_a, t_base))
-    t_id = curve.CachedPoint(*[bcast(c) for c in curve.IDENTITY_CACHED])
+    # B multiples as broadcast constants (points + cached forms)
+    b_pts = [curve.Point(*[bcast(c) for c in p]) for p in _B_MULT_POINTS]
+    b_cached = [curve.CachedPoint(*[bcast(c) for c in p]) for p in _B_MULT_CACHED]
+
+    # A multiples per lane: -A, -2A, -3A
+    ca1 = curve.to_cached(neg_a)
+    a2 = curve.double(neg_a)
+    a3 = curve.add_cached(a2, ca1)
+    a_pts = [None, neg_a, a2, a3]
+
+    table: list[curve.CachedPoint] = []
+    for s2 in range(4):
+        for h2 in range(4):
+            if h2 == 0:
+                table.append(b_cached[s2])  # [s2]B (+ identity cached at s2=0)
+            elif s2 == 0:
+                table.append(curve.to_cached(a_pts[h2]))
+            else:
+                table.append(curve.to_cached(curve.add_cached(a_pts[h2], b_cached[s2])))
+    return table
+
+
+def _straus_loop(neg_a: curve.Point, s_digits, h_digits) -> curve.Point:
+    """[S]B + [h](-A), radix-4 joint digits MSB-first."""
+    b = s_digits.shape[1]
+    table = _build_table(neg_a, b)
+
+    def bcast(c):
+        return jnp.broadcast_to(jnp.asarray(c), (NLIMB, b)).astype(jnp.int32)
 
     p0 = curve.Point(*[bcast(c) for c in curve.IDENTITY])
+    # stack the table into 4 arrays of shape (16, 22, B) for traced select
+    # (kept as a python list of CachedPoints; select tree below indexes it)
 
     def body(i, p):
-        bit = NBITS - 1 - i
-        sb = jax.lax.dynamic_index_in_dim(s_bits, bit, 0, keepdims=False)
-        hb = jax.lax.dynamic_index_in_dim(h_bits, bit, 0, keepdims=False)
-        lo = curve.select_cached(sb, t_base, t_id)  # h=0: O or B
-        hi = curve.select_cached(sb, t_both, t_nega)  # h=1: -A or B-A
-        entry = curve.select_cached(hb, hi, lo)
-        return curve.add_cached(curve.double(p), entry)
+        d = NDIGITS - 1 - i
+        sd = jax.lax.dynamic_index_in_dim(s_digits, d, 0, keepdims=False)
+        hd = jax.lax.dynamic_index_in_dim(h_digits, d, 0, keepdims=False)
+        s0, s1 = sd & 1, sd >> 1
+        h0, h1 = hd & 1, hd >> 1
+        rows = [
+            _sel2(h0, h1, table[4 * s2 + 0], table[4 * s2 + 1],
+                  table[4 * s2 + 2], table[4 * s2 + 3])
+            for s2 in range(4)
+        ]
+        entry = _sel2(s0, s1, rows[0], rows[1], rows[2], rows[3])
+        p = curve.double(curve.double(p))
+        return curve.add_cached(p, entry)
 
-    return jax.lax.fori_loop(0, NBITS, body, p0)
+    return jax.lax.fori_loop(0, NDIGITS, body, p0)
 
 
 @partial(jax.jit, static_argnames=())
-def verify_kernel(neg_a_x, neg_a_y, neg_a_t, s_bits, h_bits, y_r, x_parity):
+def verify_kernel(a_x_w, a_y_w, a_t_w, s_w, h_w, yr_w, x_parity):
     """Batched verify core.
 
-    neg_a_{x,y,t}: (22, B) limbs of -A in affine extended form (Z=1).
-    s_bits, h_bits: (253, B) int32 bit arrays.
-    y_r: (22, B) canonical digits of R's y coordinate.
-    x_parity: (B,) int32 — R's sign bit.
+    a_{x,y,t}_w: (8, B) int32 words of -A's affine extended coords (Z=1).
+    s_w, h_w:    (8, B) int32 words of the scalars S and h (each < L).
+    yr_w:        (8, B) int32 words of R's y coordinate (canonical, < p).
+    x_parity:    (B,) int32 — R's sign bit.
     Returns (B,) bool.
     """
-    b = s_bits.shape[1]
-    one = jnp.broadcast_to(jnp.asarray(curve._ONE), (NLIMB, b)).astype(jnp.int32)
-    neg_a = curve.Point(neg_a_x, neg_a_y, one, neg_a_t)
-    rp = _shamir_loop(neg_a, s_bits, h_bits)
+    b = s_w.shape[1]
+    neg_a = curve.Point(
+        words_to_limbs(a_x_w),
+        words_to_limbs(a_y_w),
+        jnp.broadcast_to(jnp.asarray(curve._ONE), (NLIMB, b)).astype(jnp.int32),
+        words_to_limbs(a_t_w),
+    )
+    rp = _straus_loop(neg_a, words_to_digits(s_w), words_to_digits(h_w))
     x, y = curve.to_affine(rp)
+    y_r = field.canonicalize(words_to_limbs(yr_w))
     return field.eq(y, y_r) & (field.is_odd(x) == x_parity)
 
 
+# ------------------------------------------------- module constants ([i]B)
+
+
+def _b_mult_consts():
+    pts, cached = [], []
+    ident = (0, 1, 1, 0)
+    bx, by = em.BASE_X, em.BASE_Y
+    P = em.P
+    D2 = 2 * em.D % P
+
+    def to_col(v):
+        from tendermint_tpu.ops.limbs import int_to_limb_column
+
+        return int_to_limb_column(v % P)
+
+    cur = None
+    raw = [ident]
+    for _ in range(3):
+        if cur is None:
+            cur = (bx, by, 1, bx * by % P)
+        else:
+            cur = em.point_add(cur, (bx, by, 1, bx * by % P))
+        raw.append(cur)
+    for (x, y, z, t) in raw:
+        zi = pow(z, P - 2, P)
+        xa, ya = x * zi % P, y * zi % P
+        ta = xa * ya % P
+        pts.append(tuple(to_col(v) for v in (xa, ya, 1, ta)))
+        cached.append(
+            tuple(
+                to_col(v)
+                for v in ((ya - xa) % P, (ya + xa) % P, ta * D2 % P, 2)
+            )
+        )
+    return pts, cached
+
+
+_B_MULT_POINTS, _B_MULT_CACHED = _b_mult_consts()
+
+
+# ---------------------------------------------------------------- host side
+
+
 class _PubkeyCache:
-    """pubkey bytes -> np (3, 22) int32 limbs of -A (x, y, t), LRU-bounded."""
+    """pubkey bytes -> np (3, 8) uint32 words of -A (x, y, t), LRU-bounded."""
 
     def __init__(self, maxsize: int = 65536) -> None:
         self._d: dict[bytes, np.ndarray | None] = {}
@@ -96,7 +218,8 @@ class _PubkeyCache:
             entry = None
         else:
             nx, ny, _, nt = em.point_neg(pt)
-            entry = ints_to_limbs([nx, ny, nt]).T.copy()  # (3, 22)
+            buf = b"".join(v.to_bytes(32, "little") for v in (nx, ny, nt))
+            entry = np.frombuffer(buf, dtype=np.uint32).reshape(3, NWORDS).copy()
         if len(self._d) >= self._maxsize:
             self._d.pop(next(iter(self._d)))
         self._d[pub] = entry
@@ -107,11 +230,16 @@ _cache = _PubkeyCache()
 
 
 def _pad_to_bucket(n: int, min_bucket: int = 128) -> int:
-    """Pad batch sizes to power-of-two buckets to bound jit recompilations."""
+    """Bucket batch sizes to bound jit recompilations while capping padding
+    waste: powers of two up to 4096, then multiples of 4096 (batch sizes
+    that are small-multiples of large powers of two tile better on the TPU
+    vector unit than other composites — measured: 12288 beats 10240)."""
     b = min_bucket
-    while b < n:
+    while b < n and b < 4096:
         b *= 2
-    return b
+    if n <= b:
+        return b
+    return -(-n // 4096) * 4096
 
 
 def prepare_batch(pubs, msgs, sigs, min_bucket: int = 128):
@@ -122,11 +250,11 @@ def prepare_batch(pubs, msgs, sigs, min_bucket: int = 128):
     """
     n = len(pubs)
     mask = np.ones(n, dtype=bool)
-    neg_a = np.zeros((n, 3, NLIMB), dtype=np.int32)
-    y_r_int = [0] * n
+    a_words = np.zeros((n, 3, NWORDS), dtype=np.uint32)
+    s_words = np.zeros((n, NWORDS), dtype=np.uint32)
+    h_words = np.zeros((n, NWORDS), dtype=np.uint32)
+    yr_words = np.zeros((n, NWORDS), dtype=np.uint32)
     parity = np.zeros(n, dtype=np.int32)
-    s_int = [0] * n
-    h_int = [0] * n
     for i in range(n):
         pub, msg, sig = pubs[i], msgs[i], sigs[i]
         if len(pub) != 32 or len(sig) != 64:
@@ -146,27 +274,31 @@ def prepare_batch(pubs, msgs, sigs, min_bucket: int = 128):
         if y_r >= em.P:  # strict: reject non-canonical R encodings
             mask[i] = False
             continue
-        neg_a[i] = entry
-        y_r_int[i] = y_r
+        a_words[i] = entry
+        s_words[i] = np.frombuffer(s_bytes, dtype=np.uint32)
+        yr_words[i] = np.frombuffer(
+            y_r.to_bytes(32, "little"), dtype=np.uint32
+        )
         parity[i] = r_int >> 255
-        s_int[i] = s
-        h_int[i] = em.reduce_scalar(hashlib.sha512(r_bytes + pub + msg).digest())
+        h = em.reduce_scalar(hashlib.sha512(r_bytes + pub + msg).digest())
+        h_words[i] = np.frombuffer(h.to_bytes(32, "little"), dtype=np.uint32)
     if not mask.any():
         return None, mask
     padded = _pad_to_bucket(n, min_bucket)
     pad = padded - n
 
-    def padl(limbs):  # (22, n) -> (22, padded)
-        return np.pad(limbs, ((0, 0), (0, pad)))
+    def pack(a):  # (n, 8) -> (8, padded) int32 view
+        return np.ascontiguousarray(
+            np.pad(a, ((0, pad), (0, 0))).T.view(np.int32)
+        )
 
-    na = np.pad(neg_a, ((0, pad), (0, 0), (0, 0)))
     inputs = dict(
-        neg_a_x=np.ascontiguousarray(na[:, 0].T),
-        neg_a_y=np.ascontiguousarray(na[:, 1].T),
-        neg_a_t=np.ascontiguousarray(na[:, 2].T),
-        s_bits=np.pad(scalars_to_bits(s_int, NBITS), ((0, 0), (0, pad))),
-        h_bits=np.pad(scalars_to_bits(h_int, NBITS), ((0, 0), (0, pad))),
-        y_r=padl(ints_to_limbs(y_r_int)),
+        a_x_w=pack(a_words[:, 0]),
+        a_y_w=pack(a_words[:, 1]),
+        a_t_w=pack(a_words[:, 2]),
+        s_w=pack(s_words),
+        h_w=pack(h_words),
+        yr_w=pack(yr_words),
         x_parity=np.pad(parity, (0, pad)),
     )
     return inputs, mask

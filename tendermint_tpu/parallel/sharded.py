@@ -22,15 +22,15 @@ from tendermint_tpu.ops import ed25519_batch
 
 AXIS = "batch"
 
-# Positional layout of the kernel inputs; limb/bit arrays carry the batch on
-# axis 1, per-signature scalars on axis 0.
+# Positional layout of the kernel inputs; packed word arrays carry the
+# batch on axis 1 (words on axis 0), parity is per-signature.
 _INPUT_SPECS = {
-    "neg_a_x": P(None, AXIS),
-    "neg_a_y": P(None, AXIS),
-    "neg_a_t": P(None, AXIS),
-    "s_bits": P(None, AXIS),
-    "h_bits": P(None, AXIS),
-    "y_r": P(None, AXIS),
+    "a_x_w": P(None, AXIS),
+    "a_y_w": P(None, AXIS),
+    "a_t_w": P(None, AXIS),
+    "s_w": P(None, AXIS),
+    "h_w": P(None, AXIS),
+    "yr_w": P(None, AXIS),
     "x_parity": P(AXIS),
 }
 
@@ -59,7 +59,7 @@ def build_sharded_verifier(mesh: Mesh):
     in_shardings = tuple(
         NamedSharding(mesh, _INPUT_SPECS[k])
         for k in (
-            "neg_a_x", "neg_a_y", "neg_a_t", "s_bits", "h_bits", "y_r",
+            "a_x_w", "a_y_w", "a_t_w", "s_w", "h_w", "yr_w",
             "x_parity",
         )
     )
@@ -73,16 +73,16 @@ def build_sharded_verifier(mesh: Mesh):
 def build_commit_verifier(mesh: Mesh):
     """shard_map'd commit decision: per-chip verify + psum'd valid count.
 
-    Returns fn(neg_a_x, ..., x_parity) -> (ok_bitmap (B,), n_valid ()).
+    Returns fn(a_x_w, ..., x_parity) -> (ok_bitmap (B,), n_valid ()).
     The exact 2/3 voting-power quorum is computed on host from the bitmap
     (voting power is 63-bit in the reference — MaxTotalVotingPower = 2^60/8,
     types/validator_set.go:807-845 — which does not fit device int32 math);
     the psum here gives the fast all-chips-agree valid count over ICI.
     """
 
-    def local(neg_a_x, neg_a_y, neg_a_t, s_bits, h_bits, y_r, x_parity):
+    def local(a_x_w, a_y_w, a_t_w, s_w, h_w, yr_w, x_parity):
         ok = ed25519_batch.verify_kernel.__wrapped__(
-            neg_a_x, neg_a_y, neg_a_t, s_bits, h_bits, y_r, x_parity
+            a_x_w, a_y_w, a_t_w, s_w, h_w, yr_w, x_parity
         )
         n_valid = jax.lax.psum(jnp.sum(ok.astype(jnp.int32)), AXIS)
         return ok, n_valid
@@ -90,7 +90,7 @@ def build_commit_verifier(mesh: Mesh):
     spec_in = tuple(
         _INPUT_SPECS[k]
         for k in (
-            "neg_a_x", "neg_a_y", "neg_a_t", "s_bits", "h_bits", "y_r",
+            "a_x_w", "a_y_w", "a_t_w", "s_w", "h_w", "yr_w",
             "x_parity",
         )
     )
