@@ -219,7 +219,13 @@ class PeerState:
         if size == 0:
             return None
         height, round_, type_ = _votes_hrt(votes)
-        if not isinstance(votes, VoteSet):  # a Commit for catchup
+        # reference VoteSetReader.IsCommit: a Commit, or a precommit VoteSet
+        # that reached 2/3 (e.g. rs.last_commit) — the peer may be on a later
+        # round than the decision round, so track it as a catchup commit
+        is_commit = not isinstance(votes, VoteSet) or (
+            votes.type == VoteType.PRECOMMIT and votes.maj23 is not None
+        )
+        if is_commit:
             self.ensure_catchup_commit_round(height, round_, size)
         self.ensure_vote_bit_arrays(height, size)
         ps_votes = self._get_vote_bit_array(height, round_, type_)
@@ -252,6 +258,12 @@ class ConsensusReactor(BaseReactor):
     def __init__(self, cs: ConsensusState, fast_sync: bool = False, logger: Logger = NOP) -> None:
         super().__init__("ConsensusReactor")
         self.cs = cs
+        self.gossip_sleep = getattr(
+            cs.config, "peer_gossip_sleep_duration", PEER_GOSSIP_SLEEP
+        )
+        self.maj23_sleep = getattr(
+            cs.config, "peer_query_maj23_sleep_duration", PEER_QUERY_MAJ23_SLEEP
+        )
         self.fast_sync = fast_sync
         self.log = logger
         self._peer_tasks: dict[str, list[asyncio.Task]] = {}
@@ -489,7 +501,7 @@ class ConsensusReactor(BaseReactor):
             if 0 < prs.height < rs.height and prs.height >= cs.block_store.base():
                 if await self._gossip_catchup(peer, ps, prs):
                     continue
-                await asyncio.sleep(PEER_GOSSIP_SLEEP)
+                await asyncio.sleep(self.gossip_sleep)
                 continue
 
             # send the Proposal (and POL) if the peer doesn't have it
@@ -509,7 +521,7 @@ class ConsensusReactor(BaseReactor):
                         await peer.send(DATA_CHANNEL, m.encode_consensus_message(pol_msg))
                 continue
 
-            await asyncio.sleep(PEER_GOSSIP_SLEEP)
+            await asyncio.sleep(self.gossip_sleep)
 
     async def _gossip_catchup(self, peer, ps: PeerState, prs: PeerRoundState) -> bool:
         """Reference reactor.go:559 gossipDataForCatchup."""
@@ -565,7 +577,7 @@ class ConsensusReactor(BaseReactor):
                     sent = await ps.pick_send_vote(commit)
 
             if not sent:
-                await asyncio.sleep(PEER_GOSSIP_SLEEP)
+                await asyncio.sleep(self.gossip_sleep)
 
     async def _gossip_votes_for_height(self, rs: RoundState, prs: PeerRoundState, ps: PeerState) -> bool:
         """Reference reactor.go:673."""
@@ -603,24 +615,43 @@ class ConsensusReactor(BaseReactor):
         (fault-tolerance against vote withholding)."""
         cs = self.cs
         while True:
-            await asyncio.sleep(PEER_QUERY_MAJ23_SLEEP)
+            await asyncio.sleep(self.maj23_sleep)
             rs = cs.rs
             prs = ps.get_round_state()
-            if rs.height != prs.height or rs.votes is None:
-                continue
-            for type_, votes in (
-                (VoteType.PREVOTE, rs.votes.prevotes(prs.round)),
-                (VoteType.PRECOMMIT, rs.votes.precommits(prs.round)),
+            if rs.height == prs.height and rs.votes is not None:
+                for type_, votes in (
+                    (VoteType.PREVOTE, rs.votes.prevotes(prs.round)),
+                    (VoteType.PRECOMMIT, rs.votes.precommits(prs.round)),
+                ):
+                    if votes is None:
+                        continue
+                    block_id, ok = votes.two_thirds_majority()
+                    if not ok:
+                        continue
+                    msg = m.VoteSetMaj23Message(
+                        height=prs.height, round=prs.round, type=type_, block_id=block_id
+                    )
+                    await peer.send(STATE_CHANNEL, m.encode_consensus_message(msg))
+            # catchup hint (reference reactor.go:780): a lagging peer whose
+            # decision round we track gets told which block had 2/3 — this
+            # lets its VoteSet start counting a Byzantine validator's
+            # conflicting precommit toward the decided block
+            if (
+                prs.catchup_commit_round != -1
+                and 0 < prs.height < rs.height
+                and prs.height >= cs.block_store.base()
             ):
-                if votes is None:
-                    continue
-                block_id, ok = votes.two_thirds_majority()
-                if not ok:
-                    continue
-                msg = m.VoteSetMaj23Message(
-                    height=prs.height, round=prs.round, type=type_, block_id=block_id
-                )
-                await peer.send(STATE_CHANNEL, m.encode_consensus_message(msg))
+                commit = cs.block_store.load_block_commit(
+                    prs.height
+                ) or cs.block_store.load_seen_commit(prs.height)
+                if commit is not None and commit.size() > 0:
+                    msg = m.VoteSetMaj23Message(
+                        height=prs.height,
+                        round=commit.round(),
+                        type=VoteType.PRECOMMIT,
+                        block_id=commit.block_id,
+                    )
+                    await peer.send(STATE_CHANNEL, m.encode_consensus_message(msg))
 
 
 def _new_round_step_msg(rs: RoundState) -> m.NewRoundStepMessage:

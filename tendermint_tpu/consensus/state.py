@@ -677,6 +677,15 @@ class ConsensusState(BaseService):
                     self.log.info("added evidence for conflicting vote")
                 except Exception as err:
                     self.log.error("failed to add evidence", err=repr(err))
+            # the equivocating vote may still have been tallied under a
+            # peer-claimed maj23 block (vote_set peer_maj23 tracking) and
+            # pushed that block over 2/3 — re-run the step transitions,
+            # which are guard-idempotent, so the new majority is acted on
+            if vote.height == self.rs.height and self.rs.votes is not None:
+                if vote.type == VoteType.PRECOMMIT:
+                    await self._on_precommit_added(vote)
+                else:
+                    await self._on_prevote_added(vote)
             return False
 
     async def add_vote(self, vote: Vote, peer_id: str) -> bool:

@@ -111,12 +111,31 @@ class VoteSet:
             if not next(results):
                 raise VoteSetError(f"invalid signature for {v}")
             if conflict is not None:
-                # track under the peer-claimed block, then surface the
-                # equivocation for evidence (reference vote_set.go:217-240)
-                self.votes_by_block[v.block_id.key()].add_verified_vote(v, power)
+                # track under the peer-claimed block — the equivocating vote
+                # still counts toward that block's 2/3 (this is exactly how
+                # a node that saw the "wrong" vote first converges on the
+                # network's decision) — then surface the equivocation for
+                # evidence (reference vote_set.go:217-240)
+                by_block = self.votes_by_block[v.block_id.key()]
+                had = by_block.votes[v.validator_index] is not None
+                by_block.add_verified_vote(v, power)
+                if not had:
+                    self._maybe_promote_maj23(v.block_id)
                 raise ConflictingVoteError(conflict, v)
             out.append(self._apply_verified(v, power))
         return out
+
+    def _maybe_promote_maj23(self, block_id: BlockID) -> None:
+        """Quorum detection (reference vote_set.go:261-281): when a tracked
+        block crosses 2/3, it becomes THE majority and its votes win the
+        canonical slots."""
+        by_block = self.votes_by_block[block_id.key()]
+        quorum = self.val_set.total_voting_power() * 2 // 3 + 1
+        if by_block.sum >= quorum and self.maj23 is None:
+            self.maj23 = block_id
+            for i, v in enumerate(by_block.votes):
+                if v is not None:
+                    self.votes[i] = v
 
     def _precheck(self, vote: Vote) -> tuple[int, Vote | None] | None:
         """Structural validation. Returns (voting power, conflicting vote or
@@ -170,14 +189,7 @@ class VoteSet:
         by_block.add_verified_vote(vote, power)
         if had:
             return False
-        # quorum detection (reference vote_set.go:261-281)
-        quorum = self.val_set.total_voting_power() * 2 // 3 + 1
-        if by_block.sum >= quorum and self.maj23 is None:
-            self.maj23 = vote.block_id
-            # canonicalize: maj23 votes win the votes[] slots
-            for i, v in enumerate(by_block.votes):
-                if v is not None:
-                    self.votes[i] = v
+        self._maybe_promote_maj23(vote.block_id)
         return True
 
     # -- peer claims --------------------------------------------------------

@@ -1,0 +1,143 @@
+"""Byzantine validator test — the reference's consensus/byzantine_test.go:
+one of four validators double-proposes (different blocks + conflicting
+votes to different halves of the network). The honest majority must still
+commit one agreed block, and the equivocation must surface as
+DuplicateVoteEvidence."""
+import asyncio
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from tendermint_tpu.consensus import messages as m
+from tendermint_tpu.consensus.reactor import DATA_CHANNEL, VOTE_CHANNEL
+from tendermint_tpu.p2p.test_util import make_connected_switches, stop_switches
+from tendermint_tpu.types import BlockID, MockPV
+from tendermint_tpu.types.validator import Validator
+from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.types.vote import Proposal, Vote, VoteType, now_ns
+
+from test_reactors import CHAIN_ID, NetNode
+
+
+def _byzantine_decide_proposal(cs, get_switch):
+    """Returns an async decide_proposal that crafts TWO blocks and sends
+    proposal+parts+votes for block A to half the peers and block B to the
+    other half (reference byzantine_test.go byzantineDecideProposalFunc)."""
+
+    async def decide(height: int, round_: int) -> None:
+        # wait until the whole net is connected so the split is real
+        switch = None
+        for _ in range(400):
+            switch = get_switch()
+            if switch is not None and len(switch.peers) >= 3:
+                break
+            await asyncio.sleep(0.05)
+        state = cs.state
+        addr = cs.priv_validator.address
+        block_a = cs.block_exec.create_proposal_block(height, state, None, addr)
+        block_b = state.make_block(height, [b"byzantine-tx"], None, [], addr)
+        peers = sorted(switch.peers.list(), key=lambda p: p.id)
+        half = (len(peers) + 1) // 2
+        for i, peer in enumerate(peers):
+            block = block_a if i < half else block_b
+            parts = block.make_part_set()
+            bid = BlockID(block.hash(), parts.header())
+            proposal = cs.priv_validator.sign_proposal(
+                state.chain_id, Proposal(height, round_, -1, bid, now_ns())
+            )
+            await peer.send(
+                DATA_CHANNEL,
+                m.encode_consensus_message(m.ProposalMessage(proposal)),
+            )
+            for j in range(parts.total):
+                await peer.send(
+                    DATA_CHANNEL,
+                    m.encode_consensus_message(
+                        m.BlockPartMessage(height, round_, parts.get_part(j))
+                    ),
+                )
+            idx, _ = state.validators.get_by_address(addr)
+            for vtype in (VoteType.PREVOTE, VoteType.PRECOMMIT):
+                vote = Vote(vtype, height, round_, bid, now_ns(), addr, idx)
+                vote = cs.priv_validator.sign_vote(state.chain_id, vote)
+                await peer.send(
+                    VOTE_CHANNEL,
+                    m.encode_consensus_message(m.VoteMessage(vote)),
+                )
+
+    return decide
+
+
+class TestByzantine:
+    def test_double_proposer_net_still_commits_and_evidence_surfaces(self, tmp_path):
+        async def main():
+            pvs = [MockPV() for _ in range(4)]
+            # the byzantine node must be the height-1/round-0 proposer
+            vs = ValidatorSet([Validator(pv.get_pub_key(), 10) for pv in pvs])
+            proposer_addr = vs.get_proposer().address
+            byz_idx = next(
+                i for i, pv in enumerate(pvs)
+                if pv.get_pub_key().address() == proposer_addr
+            )
+            nodes = [
+                NetNode(os.path.join(tmp_path, f"node{i}"), pvs, i)
+                for i in range(4)
+            ]
+            reactor_sets = []
+            for i, node in enumerate(nodes):
+                # keep round 0 alive long enough for the attack to land
+                node.cfg.consensus.timeout_propose = 3.0
+                reactor_sets.append(await node.setup())
+            byz = nodes[byz_idx]
+            honest = [n for i, n in enumerate(nodes) if i != byz_idx]
+            # patch BEFORE the switches start so round 0 runs the attack
+            byz.cs.decide_proposal = _byzantine_decide_proposal(
+                byz.cs, lambda: byz.cons_reactor.switch
+            )
+            switches = await make_connected_switches(
+                4, lambda i: reactor_sets[i], network=CHAIN_ID
+            )
+            try:
+                # liveness: every honest node commits blocks
+                await asyncio.gather(*(n.wait_for_height(2, 120) for n in honest))
+                # agreement on height 1
+                hashes = {
+                    n.block_store.load_block_meta(1).block_id.hash for n in honest
+                }
+                assert len(hashes) == 1
+                # the equivocation must surface as duplicate-vote evidence on
+                # at least one honest node (pending or already committed)
+                byz_addr = pvs[byz_idx].get_pub_key().address()
+
+                def evidence_seen() -> bool:
+                    for n in honest:
+                        for ev in n.ev_pool.pending_evidence():
+                            if ev.address() == byz_addr:
+                                return True
+                        for h in range(1, n.block_store.height() + 1):
+                            blk = n.block_store.load_block(h)
+                            if blk and any(
+                                ev.address() == byz_addr for ev in blk.evidence
+                            ):
+                                return True
+                    return False
+
+                async with asyncio.timeout(60):
+                    while not evidence_seen():
+                        await asyncio.sleep(0.25)
+            finally:
+                await stop_net_quiet(nodes, switches)
+
+        asyncio.run(main())
+
+
+async def stop_net_quiet(nodes, switches):
+    await stop_switches(switches)
+    for node in nodes:
+        try:
+            await node.teardown()
+        except Exception:
+            pass

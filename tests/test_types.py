@@ -385,3 +385,47 @@ class TestGenesis:
         assert doc2.chain_id == doc.chain_id
         assert doc2.validator_set().hash() == doc.validator_set().hash()
         assert doc2.app_state == doc.app_state
+
+
+class TestPeerMaj23Convergence:
+    def test_equivocating_vote_counts_toward_claimed_block(self):
+        """A node that saw a Byzantine validator's 'wrong' vote first must
+        still converge once a peer claims 2/3 for the decided block and the
+        conflicting vote is re-delivered (reference vote_set.go:217-240 +
+        byzantine_test.go)."""
+        from tendermint_tpu.types import BlockID, MockPV, PartSetHeader
+        from tendermint_tpu.types.validator import Validator
+        from tendermint_tpu.types.validator_set import ValidatorSet
+        from tendermint_tpu.types.vote import Vote, VoteType
+        from tendermint_tpu.types.vote_set import ConflictingVoteError, VoteSet
+
+        import pytest
+
+        pvs = sorted(
+            [MockPV() for _ in range(4)], key=lambda pv: pv.get_pub_key().address()
+        )
+        vs = ValidatorSet([Validator(pv.get_pub_key(), 10) for pv in pvs])
+        bid_a = BlockID(b"\xAA" * 32, PartSetHeader(1, b"\x01" * 32))
+        bid_b = BlockID(b"\xBB" * 32, PartSetHeader(1, b"\x02" * 32))
+        s = VoteSet("c", 1, 0, VoteType.PRECOMMIT, vs)
+
+        def mk(i, bid):
+            v = Vote(
+                VoteType.PRECOMMIT, 1, 0, bid, 1000 + i,
+                pvs[i].get_pub_key().address(), i,
+            )
+            return pvs[i].sign_vote("c", v)
+
+        assert s.add_vote(mk(0, bid_b))  # byzantine vote seen first
+        assert s.add_vote(mk(1, bid_a))
+        assert s.add_vote(mk(2, bid_a))
+        with pytest.raises(ConflictingVoteError):
+            s.add_vote(mk(0, bid_a))  # rejected: no claim yet
+        assert s.maj23 is None
+        s.set_peer_maj23("peer-x", bid_a)
+        with pytest.raises(ConflictingVoteError):  # still surfaces evidence
+            s.add_vote(mk(0, bid_a))
+        # ...but the vote was tallied and the claimed block crossed 2/3
+        assert s.maj23 == bid_a
+        maj, ok = s.two_thirds_majority()
+        assert ok and maj == bid_a
