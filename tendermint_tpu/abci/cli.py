@@ -1,0 +1,131 @@
+"""abci-cli — exercise an ABCI application from the command line.
+
+Reference parity: abci/cmd/abci-cli — subcommands echo/info/deliver_tx/
+check_tx/commit/query against a running ABCI server, a batch/console mode
+reading commands from stdin (the reference's .abci script files under
+abci/tests/test_cli/), and `kvstore`/`counter` to serve the example apps.
+
+    python -m tendermint_tpu.abci.cli kvstore --address tcp://127.0.0.1:26658
+    python -m tendermint_tpu.abci.cli --address tcp://127.0.0.1:26658 console
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import shlex
+import sys
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.client import SocketClient
+from tendermint_tpu.abci.server import ABCIServer
+
+
+def _parse_bytes(s: str) -> bytes:
+    """The reference accepts 0x-hex or quoted strings."""
+    if s.startswith("0x") or s.startswith("0X"):
+        return bytes.fromhex(s[2:])
+    if len(s) >= 2 and s[0] == '"' and s[-1] == '"':
+        return s[1:-1].encode()
+    return s.encode()
+
+
+async def run_command(client: SocketClient, cmd: str, args: list[str]) -> str:
+    if cmd == "echo":
+        res = await client.echo(" ".join(args))
+        return f"-> data: {res.message}"
+    if cmd == "info":
+        res = await client.info(abci.RequestInfo())
+        return (
+            f"-> data: {res.data}\n-> last_block_height: {res.last_block_height}\n"
+            f"-> last_block_app_hash: 0x{res.last_block_app_hash.hex().upper()}"
+        )
+    if cmd == "deliver_tx":
+        res = await client.deliver_tx(abci.RequestDeliverTx(tx=_parse_bytes(args[0]) if args else b""))
+        return f"-> code: {res.code}" + (f"\n-> log: {res.log}" if res.log else "")
+    if cmd == "check_tx":
+        res = await client.check_tx(abci.RequestCheckTx(tx=_parse_bytes(args[0]) if args else b""))
+        return f"-> code: {res.code}" + (f"\n-> log: {res.log}" if res.log else "")
+    if cmd == "commit":
+        res = await client.commit()
+        return f"-> data.hex: 0x{res.data.hex().upper()}"
+    if cmd == "query":
+        res = await client.query(
+            abci.RequestQuery(data=_parse_bytes(args[0]) if args else b"")
+        )
+        out = [f"-> code: {res.code}"]
+        if res.log:
+            out.append(f"-> log: {res.log}")
+        if res.key:
+            out.append(f"-> key: {res.key.decode('utf-8', 'replace')}")
+        if res.value:
+            out.append(f"-> value: {res.value.decode('utf-8', 'replace')}")
+        return "\n".join(out)
+    if cmd == "set_option":
+        await client.set_option(abci.RequestSetOption(key=args[0] if args else "", value=args[1] if len(args) > 1 else ""))
+        return "-> code: 0"
+    raise ValueError(f"unknown command {cmd!r}")
+
+
+async def console(client: SocketClient, stream=sys.stdin) -> int:
+    """Reference abci-cli console / batch mode: one command per line."""
+    loop = asyncio.get_event_loop()
+    while True:
+        line = await loop.run_in_executor(None, stream.readline)
+        if not line:
+            return 0
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = shlex.split(line, posix=False)
+        print(f"> {line}")
+        try:
+            print(await run_command(client, parts[0], parts[1:]))
+        except Exception as e:
+            print(f"-> error: {e}")
+
+
+async def _amain(args) -> int:
+    if args.command in ("kvstore", "counter"):
+        if args.command == "kvstore":
+            from tendermint_tpu.abci.examples import KVStoreApplication
+
+            app = KVStoreApplication()
+        else:
+            from tendermint_tpu.abci.examples import CounterApplication
+
+            app = CounterApplication(serial=args.serial)
+        server = ABCIServer(app, args.address)
+        await server.start()
+        print(f"{args.command} ABCI app listening on {args.address}", file=sys.stderr)
+        await asyncio.Event().wait()
+        return 0
+
+    client = SocketClient(args.address)
+    await client.start()
+    try:
+        if args.command in ("console", "batch"):
+            return await console(client)
+        print(await run_command(client, args.command, args.args))
+        return 0
+    finally:
+        await client.stop()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="abci-cli")
+    p.add_argument("--address", default="tcp://127.0.0.1:26658")
+    p.add_argument("--serial", action="store_true", help="counter: enforce tx ordering")
+    p.add_argument(
+        "command",
+        choices=[
+            "echo", "info", "deliver_tx", "check_tx", "commit", "query",
+            "set_option", "console", "batch", "kvstore", "counter",
+        ],
+    )
+    p.add_argument("args", nargs="*")
+    args = p.parse_args(argv)
+    return asyncio.run(_amain(args))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,67 @@
+"""behaviour — peer-behaviour reporting (ADR-039).
+
+Reference parity: behaviour/peer_behaviour.go + reporter.go — reactors
+report good/bad peer behaviours through an interface instead of calling
+Switch.StopPeerForError directly, decoupling protocol logic from peer
+management. The SwitchReporter forwards errors to the switch; the
+MockReporter records for tests.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class PeerBehaviour:
+    peer_id: str
+    reason: str
+    is_error: bool
+
+    # constructors matching the reference's behaviour vocabulary
+    @classmethod
+    def bad_message(cls, peer_id: str, explanation: str) -> "PeerBehaviour":
+        return cls(peer_id, f"bad message: {explanation}", True)
+
+    @classmethod
+    def message_out_of_order(cls, peer_id: str, explanation: str) -> "PeerBehaviour":
+        return cls(peer_id, f"message out of order: {explanation}", True)
+
+    @classmethod
+    def consensus_vote(cls, peer_id: str, explanation: str = "") -> "PeerBehaviour":
+        return cls(peer_id, f"consensus vote: {explanation}", False)
+
+    @classmethod
+    def block_part(cls, peer_id: str, explanation: str = "") -> "PeerBehaviour":
+        return cls(peer_id, f"block part: {explanation}", False)
+
+
+class Reporter:
+    async def report(self, behaviour: PeerBehaviour) -> None:
+        raise NotImplementedError
+
+
+class SwitchReporter(Reporter):
+    """Forward error behaviours to the switch (reference reporter.go:17)."""
+
+    def __init__(self, switch) -> None:
+        self.switch = switch
+
+    async def report(self, behaviour: PeerBehaviour) -> None:
+        peer = self.switch.peers.get(behaviour.peer_id)
+        if peer is None:
+            return
+        if behaviour.is_error:
+            await self.switch.stop_peer_for_error(peer, behaviour.reason)
+
+
+class MockReporter(Reporter):
+    """Record behaviours for assertions (reference reporter.go MockReporter)."""
+
+    def __init__(self) -> None:
+        self.reports: dict[str, list[PeerBehaviour]] = {}
+
+    async def report(self, behaviour: PeerBehaviour) -> None:
+        self.reports.setdefault(behaviour.peer_id, []).append(behaviour)
+
+    def get_behaviours(self, peer_id: str) -> list[PeerBehaviour]:
+        return list(self.reports.get(peer_id, []))

@@ -1,0 +1,81 @@
+"""ASCII armor — OpenPGP-style text encoding of binary blobs.
+
+Reference parity: crypto/armor/armor.go (EncodeArmor/DecodeArmor over
+golang.org/x/crypto/openpgp/armor): base64 body with CRC-24 checksum,
+header key/value lines, BEGIN/END fencing. Used for exporting keys in a
+copy-paste-safe form.
+"""
+from __future__ import annotations
+
+import base64
+import textwrap
+
+CRC24_INIT = 0xB704CE
+CRC24_POLY = 0x1864CFB
+
+
+def _crc24(data: bytes) -> int:
+    crc = CRC24_INIT
+    for b in data:
+        crc ^= b << 16
+        for _ in range(8):
+            crc <<= 1
+            if crc & 0x1000000:
+                crc ^= CRC24_POLY
+    return crc & 0xFFFFFF
+
+
+class ArmorError(Exception):
+    pass
+
+
+def encode_armor(block_type: str, headers: dict[str, str], data: bytes) -> str:
+    lines = [f"-----BEGIN {block_type}-----"]
+    for k, v in sorted(headers.items()):
+        lines.append(f"{k}: {v}")
+    lines.append("")
+    body = base64.b64encode(data).decode()
+    lines.extend(textwrap.wrap(body, 64))
+    crc = base64.b64encode(_crc24(data).to_bytes(3, "big")).decode()
+    lines.append(f"={crc}")
+    lines.append(f"-----END {block_type}-----")
+    return "\n".join(lines) + "\n"
+
+
+def decode_armor(text: str) -> tuple[str, dict[str, str], bytes]:
+    """Returns (block_type, headers, data); raises ArmorError."""
+    lines = [ln.rstrip("\r") for ln in text.strip().split("\n")]
+    if not lines or not lines[0].startswith("-----BEGIN ") or not lines[0].endswith("-----"):
+        raise ArmorError("missing BEGIN line")
+    block_type = lines[0][len("-----BEGIN "):-len("-----")]
+    if lines[-1] != f"-----END {block_type}-----":
+        raise ArmorError("missing or mismatched END line")
+    headers: dict[str, str] = {}
+    i = 1
+    while i < len(lines) - 1 and lines[i].strip():
+        if ":" not in lines[i]:
+            break  # body began without a blank separator
+        k, _, v = lines[i].partition(":")
+        headers[k.strip()] = v.strip()
+        i += 1
+    if i < len(lines) - 1 and not lines[i].strip():
+        i += 1
+    body_lines = []
+    crc_line = None
+    for ln in lines[i:-1]:
+        if ln.startswith("="):
+            crc_line = ln[1:]
+        else:
+            body_lines.append(ln.strip())
+    try:
+        data = base64.b64decode("".join(body_lines), validate=True)
+    except Exception as e:
+        raise ArmorError(f"bad base64 body: {e}")
+    if crc_line is not None:
+        try:
+            want = int.from_bytes(base64.b64decode(crc_line, validate=True), "big")
+        except Exception as e:
+            raise ArmorError(f"bad checksum encoding: {e}")
+        if _crc24(data) != want:
+            raise ArmorError("checksum mismatch")
+    return block_type, headers, data

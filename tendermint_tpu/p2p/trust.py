@@ -1,0 +1,152 @@
+"""Peer trust metric — EWMA of good/bad events over time intervals.
+
+Reference parity: p2p/trust/metric.go — a sliding-interval metric mixing a
+proportional component (fraction of good events in recent history) with a
+derivative component, weighted ~0.8/0.2 (reference defaults), plus
+p2p/trust/store.go — a persistent store of metric values per peer with
+periodic saving.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+
+# reference metric.go defaults
+DEFAULT_PROPORTIONAL_WEIGHT = 0.8
+DEFAULT_INTEGRAL_WEIGHT = 0.2
+MAX_HISTORY = 16
+INTERVAL_SECONDS = 10.0
+
+
+class TrustMetric:
+    """Tracks good/bad events in the current interval; history of interval
+    scores feeds the aggregate value in [0, 1] (reference metric.go:14)."""
+
+    def __init__(
+        self,
+        proportional_weight: float = DEFAULT_PROPORTIONAL_WEIGHT,
+        integral_weight: float = DEFAULT_INTEGRAL_WEIGHT,
+        max_history: int = MAX_HISTORY,
+        interval: float = INTERVAL_SECONDS,
+        now=time.monotonic,
+    ) -> None:
+        self.pw = proportional_weight
+        self.iw = integral_weight
+        self.max_history = max_history
+        self.interval = interval
+        self._now = now
+        self.good = 0.0
+        self.bad = 0.0
+        self.history: list[float] = []
+        self._interval_start = now()
+        self.paused = False
+
+    def good_event(self, weight: float = 1.0) -> None:
+        self._tick()
+        self.paused = False
+        self.good += weight
+
+    def bad_event(self, weight: float = 1.0) -> None:
+        self._tick()
+        self.paused = False
+        self.bad += weight
+
+    def pause(self) -> None:
+        """Stop counting elapsed empty intervals against the peer
+        (reference metric.go Pause)."""
+        self.paused = True
+
+    def _tick(self) -> None:
+        """Roll over any completed intervals into history."""
+        now = self._now()
+        while now - self._interval_start >= self.interval:
+            self._interval_start += self.interval
+            score = self._interval_score()
+            self.good = 0.0
+            self.bad = 0.0
+            if not self.paused or score is not None:
+                self.history.append(1.0 if score is None else score)
+                del self.history[: -self.max_history]
+
+    def _interval_score(self) -> float | None:
+        total = self.good + self.bad
+        if total == 0:
+            return None  # empty interval: neutral
+        return self.good / total
+
+    def _history_value(self) -> float:
+        """Recency-weighted mean of history (reference weights via fading)."""
+        if not self.history:
+            return 1.0
+        num = 0.0
+        den = 0.0
+        for i, v in enumerate(reversed(self.history)):
+            w = math.pow(0.8, i)  # newer intervals matter more
+            num += w * v
+            den += w
+        return num / den
+
+    def trust_value(self) -> float:
+        """Current trust in [0, 1]."""
+        self._tick()
+        cur = self._interval_score()
+        hist = self._history_value()
+        if cur is None:
+            cur = hist
+        r = self.pw * cur + self.iw * hist
+        # derivative penalty: current worse than history hits immediately
+        d = cur - hist
+        if d < 0:
+            r += d * 0.5
+        return max(0.0, min(1.0, r))
+
+    def trust_score(self) -> int:
+        """0-100 integer (reference TrustScore)."""
+        return int(round(self.trust_value() * 100))
+
+
+class TrustMetricStore:
+    """Per-peer metrics with JSON persistence (reference store.go)."""
+
+    def __init__(self, file_path: str | None = None, **metric_kwargs) -> None:
+        self.file_path = file_path
+        self.metric_kwargs = metric_kwargs
+        self.metrics: dict[str, TrustMetric] = {}
+        self._saved_scores: dict[str, float] = {}
+        if file_path and os.path.exists(file_path):
+            try:
+                with open(file_path) as f:
+                    self._saved_scores = json.load(f)
+            except (OSError, ValueError):
+                self._saved_scores = {}
+
+    def get_peer_trust_metric(self, peer_id: str) -> TrustMetric:
+        tm = self.metrics.get(peer_id)
+        if tm is None:
+            tm = TrustMetric(**self.metric_kwargs)
+            saved = self._saved_scores.get(peer_id)
+            if saved is not None:
+                tm.history = [saved]
+            self.metrics[peer_id] = tm
+        return tm
+
+    def peer_disconnected(self, peer_id: str) -> None:
+        tm = self.metrics.get(peer_id)
+        if tm is not None:
+            tm.pause()
+
+    def save(self) -> None:
+        if not self.file_path:
+            return
+        scores = dict(self._saved_scores)
+        for pid, tm in self.metrics.items():
+            scores[pid] = tm.trust_value()
+        tmp = self.file_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(scores, f)
+        os.replace(tmp, self.file_path)
+
+    def size(self) -> int:
+        return len(self.metrics)

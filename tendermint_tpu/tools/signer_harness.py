@@ -1,0 +1,131 @@
+"""tm-signer-harness — acceptance tests for remote signer implementations.
+
+Reference parity: tools/tm-signer-harness/internal — a validator-side
+endpoint that a KMS-style remote signer dials into, then a checklist:
+pubkey retrieval, vote signing, proposal signing, ping, and the
+double-sign-refusal behaviors a production signer must implement.
+
+    python -m tendermint_tpu.tools.signer_harness run --laddr tcp://127.0.0.1:0
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+
+from tendermint_tpu.privval.remote import (
+    RemoteSignerError,
+    SignerClient,
+    SignerListenerEndpoint,
+)
+from tendermint_tpu.types import BlockID, PartSetHeader
+from tendermint_tpu.types.vote import Proposal, Vote, VoteType
+
+CHAIN_ID_DEFAULT = "signer-harness-chain"
+
+
+class HarnessFailure(Exception):
+    pass
+
+
+async def run_harness(
+    host: str, port: int, chain_id: str, accept_timeout: float = 60.0,
+    expect_double_sign_refusal: bool = True, log=print,
+) -> list[tuple[str, bool, str]]:
+    """Returns [(check name, passed, detail)]. Raises only on setup errors."""
+    endpoint = SignerListenerEndpoint(host, port)
+    await endpoint.start()
+    results: list[tuple[str, bool, str]] = []
+    try:
+        log(f"harness listening on {host}:{endpoint.listen_port}; waiting for signer...")
+        await endpoint.wait_for_conn(accept_timeout)
+        client = SignerClient(endpoint)
+
+        async def check(name, coro_fn):
+            try:
+                detail = await coro_fn()
+                results.append((name, True, detail or ""))
+                log(f"PASS {name}")
+            except Exception as e:
+                results.append((name, False, str(e)))
+                log(f"FAIL {name}: {e}")
+
+        pub = None
+
+        async def c_pubkey():
+            nonlocal pub
+            pub = await client.fetch_pub_key()
+            if len(pub.bytes()) != 32:
+                raise HarnessFailure("pubkey must be 32 bytes")
+            return pub.bytes().hex()[:16]
+
+        await check("pubkey", c_pubkey)
+        await check("ping", client.ping)
+
+        bid = BlockID(b"\x42" * 32, PartSetHeader(1, b"\x43" * 32))
+
+        async def c_sign_vote():
+            v = Vote(VoteType.PREVOTE, 1, 0, bid, 1000, pub.address(), 0)
+            signed = await client.sign_vote_async(chain_id, v)
+            if not pub.verify(v.sign_bytes(chain_id), signed.signature):
+                raise HarnessFailure("vote signature does not verify")
+
+        await check("sign_vote", c_sign_vote)
+
+        async def c_sign_proposal():
+            p = Proposal(2, 0, -1, bid, 2000)
+            signed = await client.sign_proposal_async(chain_id, p)
+            if not pub.verify(p.sign_bytes(chain_id), signed.signature):
+                raise HarnessFailure("proposal signature does not verify")
+
+        await check("sign_proposal", c_sign_proposal)
+
+        if expect_double_sign_refusal:
+            bid2 = BlockID(b"\x66" * 32, PartSetHeader(1, b"\x67" * 32))
+
+            async def c_refuse_conflicting_vote():
+                v1 = Vote(VoteType.PRECOMMIT, 3, 0, bid, 3000, pub.address(), 0)
+                await client.sign_vote_async(chain_id, v1)
+                v2 = Vote(VoteType.PRECOMMIT, 3, 0, bid2, 3000, pub.address(), 0)
+                try:
+                    await client.sign_vote_async(chain_id, v2)
+                except RemoteSignerError:
+                    return "refused as expected"
+                raise HarnessFailure("signer double-signed conflicting precommits")
+
+            await check("refuse_conflicting_vote", c_refuse_conflicting_vote)
+
+            async def c_refuse_height_regression():
+                v = Vote(VoteType.PREVOTE, 1, 0, bid, 4000, pub.address(), 0)
+                try:
+                    await client.sign_vote_async(chain_id, v)
+                except RemoteSignerError:
+                    return "refused as expected"
+                raise HarnessFailure("signer accepted a height regression")
+
+            await check("refuse_height_regression", c_refuse_height_regression)
+        return results
+    finally:
+        await endpoint.stop()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tm-signer-harness")
+    p.add_argument("command", choices=["run"])
+    p.add_argument("--laddr", default="tcp://127.0.0.1:0")
+    p.add_argument("--chain-id", default=CHAIN_ID_DEFAULT)
+    p.add_argument("--accept-timeout", type=float, default=60.0)
+    args = p.parse_args(argv)
+    from tendermint_tpu.node import parse_laddr
+
+    host, port = parse_laddr(args.laddr)
+    results = asyncio.run(
+        run_harness(host, port, args.chain_id, args.accept_timeout)
+    )
+    failed = [r for r in results if not r[1]]
+    print(f"{len(results) - len(failed)}/{len(results)} checks passed")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

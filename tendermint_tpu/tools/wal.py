@@ -1,0 +1,87 @@
+"""WAL repair tools — wal2json / json2wal.
+
+Reference parity: scripts/wal2json and scripts/json2wal (referenced from
+consensus/state.go:316-323 as the operator remedy for a corrupt WAL): dump
+the consensus WAL to a human-editable JSON-lines file and rebuild a valid
+WAL from it.
+
+    python -m tendermint_tpu.tools.wal wal2json <wal-path> > dump.jsonl
+    python -m tendermint_tpu.tools.wal json2wal <wal-path> < dump.jsonl
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tendermint_tpu.consensus.wal import (
+    WAL,
+    EndHeightMessage,
+    MsgInfo,
+    TimedWALMessage,
+    WALTimeoutInfo,
+    _decode_wal_msg,
+    _encode_wal_msg,
+    encode_frame,
+)
+
+
+def msg_to_json(tm: TimedWALMessage) -> dict:
+    payload = _encode_wal_msg(tm.msg)
+    return {
+        "time": tm.time_ns,
+        "type": type(tm.msg).__name__,
+        "msg": payload.hex(),
+    }
+
+
+def json_to_msg(d: dict) -> TimedWALMessage:
+    msg = _decode_wal_msg(bytes.fromhex(d["msg"]))
+    return TimedWALMessage(d["time"], msg)
+
+
+def wal2json(path: str, out=sys.stdout) -> int:
+    wal = WAL(path)
+    n = 0
+    try:
+        for tm in wal.iter_all():
+            out.write(json.dumps(msg_to_json(tm)) + "\n")
+            n += 1
+    finally:
+        wal.close()
+    print(f"decoded {n} WAL messages", file=sys.stderr)
+    return 0
+
+
+def json2wal(path: str, inp=sys.stdin) -> int:
+    import os
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    n = 0
+    with open(path, "wb") as f:
+        for line in inp:
+            line = line.strip()
+            if not line:
+                continue
+            tm = json_to_msg(json.loads(line))
+            f.write(encode_frame(tm))
+            n += 1
+    print(f"encoded {n} WAL messages to {path}", file=sys.stderr)
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tm-wal")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    s1 = sub.add_parser("wal2json")
+    s1.add_argument("path")
+    s2 = sub.add_parser("json2wal")
+    s2.add_argument("path")
+    args = p.parse_args(argv)
+    if args.cmd == "wal2json":
+        return wal2json(args.path)
+    return json2wal(args.path)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,188 @@
+"""Tests for the auxiliary parity components: abci-cli, WAL repair tools,
+signer harness, behaviour reporting, trust metric, ASCII armor."""
+import asyncio
+import io
+import json
+import os
+
+import pytest
+
+from tendermint_tpu.crypto.armor import ArmorError, decode_armor, encode_armor
+from tendermint_tpu.p2p.trust import TrustMetric, TrustMetricStore
+
+
+class TestABCICli:
+    def test_commands_against_socket_kvstore(self, capsys):
+        async def main():
+            from tendermint_tpu.abci.cli import console, run_command
+            from tendermint_tpu.abci.client import SocketClient
+            from tendermint_tpu.abci.examples import KVStoreApplication
+            from tendermint_tpu.abci.server import ABCIServer
+
+            server = ABCIServer(KVStoreApplication(), "tcp://127.0.0.1:0")
+            await server.start()
+            client = SocketClient(f"tcp://127.0.0.1:{server.port}")
+            await client.start()
+            try:
+                assert "data:" in await run_command(client, "echo", ["hello"])
+                assert "last_block_height" in await run_command(client, "info", [])
+                out = await run_command(client, "deliver_tx", ['"abc=def"'])
+                assert "code: 0" in out
+                out = await run_command(client, "commit", [])
+                assert "data.hex" in out
+                out = await run_command(client, "query", ['"abc"'])
+                assert "def" in out
+                out = await run_command(client, "check_tx", ["0x00"])
+                assert "code:" in out
+                # batch/console mode over a script (the .abci golden pattern)
+                script = io.StringIO('echo batchmode\ndeliver_tx "k=v"\ncommit\n')
+                await console(client, stream=script)
+            finally:
+                await client.stop()
+                await server.stop()
+
+        asyncio.run(main())
+        out = capsys.readouterr().out
+        assert "> echo batchmode" in out
+        assert "-> code: 0" in out
+
+
+class TestWalTools:
+    def test_wal2json_json2wal_roundtrip(self, tmp_path, capsys):
+        from tendermint_tpu.consensus import messages as m
+        from tendermint_tpu.consensus.wal import (
+            WAL,
+            EndHeightMessage,
+            MsgInfo,
+            WALTimeoutInfo,
+        )
+        from tendermint_tpu.tools.wal import json2wal, wal2json
+
+        wal_path = os.path.join(tmp_path, "data", "wal")
+        wal = WAL(wal_path)
+        wal.write(MsgInfo(m.HasVoteMessage(1, 0, 1, 2), "peer-a"))
+        wal.write(WALTimeoutInfo(1.5, 1, 0, 3))
+        wal.write_sync(EndHeightMessage(1))
+        wal.close()
+
+        out = io.StringIO()
+        assert wal2json(wal_path, out=out) == 0
+        dump = [json.loads(ln) for ln in out.getvalue().splitlines()]
+        assert len(dump) == 3
+        assert {d["type"] for d in dump} == {
+            "MsgInfo", "WALTimeoutInfo", "EndHeightMessage"
+        }
+
+        rebuilt_path = os.path.join(tmp_path, "data2", "wal")
+        inp = io.StringIO(out.getvalue())
+        assert json2wal(rebuilt_path, inp=inp) == 0
+        wal2 = WAL(rebuilt_path)
+        msgs = list(wal2.iter_all())
+        wal2.close()
+        assert len(msgs) == 3
+        assert isinstance(msgs[2].msg, EndHeightMessage)
+
+
+class TestSignerHarness:
+    def test_harness_passes_against_filepv(self, tmp_path):
+        async def main():
+            from tendermint_tpu.privval import FilePV
+            from tendermint_tpu.privval.remote import SignerServer
+            from tendermint_tpu.tools.signer_harness import run_harness
+
+            pv = FilePV.generate(
+                os.path.join(tmp_path, "key.json"), os.path.join(tmp_path, "state.json")
+            )
+            results_box = {}
+
+            async def harness():
+                results_box["r"] = await run_harness(
+                    "127.0.0.1", 18899, "harness-chain", accept_timeout=20.0,
+                    log=lambda *a: None,
+                )
+
+            task = asyncio.ensure_future(harness())
+            await asyncio.sleep(0.3)
+            server = SignerServer("127.0.0.1", 18899, pv)
+            await server.start()
+            try:
+                await asyncio.wait_for(task, 30.0)
+            finally:
+                await server.stop()
+            results = results_box["r"]
+            failed = [r for r in results if not r[1]]
+            assert not failed, failed
+            assert len(results) == 6
+
+        asyncio.run(main())
+
+
+class TestBehaviour:
+    def test_mock_reporter_records(self):
+        async def main():
+            from tendermint_tpu.behaviour import MockReporter, PeerBehaviour
+
+            rep = MockReporter()
+            await rep.report(PeerBehaviour.bad_message("p1", "garbage"))
+            await rep.report(PeerBehaviour.consensus_vote("p1"))
+            bs = rep.get_behaviours("p1")
+            assert len(bs) == 2
+            assert bs[0].is_error and not bs[1].is_error
+
+        asyncio.run(main())
+
+
+class TestTrustMetric:
+    def test_good_history_high_trust(self):
+        t = [0.0]
+        tm = TrustMetric(now=lambda: t[0])
+        for _ in range(50):
+            tm.good_event()
+            t[0] += 1.0
+        assert tm.trust_score() >= 95
+
+    def test_bad_events_drop_trust(self):
+        t = [0.0]
+        tm = TrustMetric(now=lambda: t[0])
+        for _ in range(30):
+            tm.good_event()
+            t[0] += 1.0
+        high = tm.trust_score()
+        for _ in range(60):
+            tm.bad_event()
+            t[0] += 1.0
+        assert tm.trust_score() < high - 30
+
+    def test_store_persistence(self, tmp_path):
+        path = os.path.join(tmp_path, "trust.json")
+        store = TrustMetricStore(path)
+        tm = store.get_peer_trust_metric("peer-1")
+        tm.good_event()
+        store.save()
+        store2 = TrustMetricStore(path)
+        tm2 = store2.get_peer_trust_metric("peer-1")
+        assert tm2.trust_value() > 0.5
+        assert store2.size() == 1
+
+
+class TestArmor:
+    def test_roundtrip(self):
+        data = os.urandom(200)
+        text = encode_armor("TENDERMINT PRIVATE KEY", {"kdf": "bcrypt"}, data)
+        bt, headers, out = decode_armor(text)
+        assert bt == "TENDERMINT PRIVATE KEY"
+        assert headers == {"kdf": "bcrypt"}
+        assert out == data
+
+    def test_checksum_detects_corruption(self):
+        text = encode_armor("T", {}, b"hello world payload")
+        # flip a char inside the base64 body
+        lines = text.split("\n")
+        body_idx = next(
+            i for i, ln in enumerate(lines)
+            if ln and not ln.startswith("-") and ":" not in ln and not ln.startswith("=")
+        )
+        ln = lines[body_idx]
+        lines[body_idx] = ("A" if ln[0] != "A" else "B") + ln[1:]
+        with pytest.raises(ArmorError):
+            decode_armor("\n".join(lines))
