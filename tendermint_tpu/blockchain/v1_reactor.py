@@ -1,0 +1,179 @@
+"""Fast-sync v1 reactor — drives the BcFSM over the v0 wire protocol.
+
+Reference parity: blockchain/v1/reactor.go — same BlockchainChannel and
+messages as v0; sync control flow delegated to the FSM; block
+verify+apply (batched commit verification) shared with v0.
+"""
+from __future__ import annotations
+
+import asyncio
+
+from tendermint_tpu.blockchain.reactor import (
+    BLOCKCHAIN_CHANNEL,
+    BlockRequestMessage,
+    BlockResponseMessage,
+    NoBlockResponseMessage,
+    StatusRequestMessage,
+    StatusResponseMessage,
+    decode_bc_message,
+    encode_bc_message,
+)
+from tendermint_tpu.blockchain.v1 import BcFSM, Event, State
+from tendermint_tpu.libs.log import NOP, Logger
+from tendermint_tpu.p2p.base_reactor import BaseReactor, ChannelDescriptor
+from tendermint_tpu.types import BlockID
+from tendermint_tpu.types.validator_set import VerifyError
+
+PROCESS_INTERVAL = 0.01
+TICK_INTERVAL = 1.0
+STATUS_INTERVAL = 10.0
+
+
+class BlockchainReactorV1(BaseReactor):
+    def __init__(self, state, block_exec, block_store, fast_sync: bool, logger: Logger = NOP) -> None:
+        super().__init__("BlockchainReactorV1")
+        self.state = state
+        self.block_exec = block_exec
+        self.block_store = block_store
+        self.fast_sync = fast_sync
+        self.log = logger
+        self.fsm = BcFSM(block_store.height() + 1, logger)
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        return [
+            ChannelDescriptor(
+                BLOCKCHAIN_CHANNEL, priority=10, send_queue_capacity=1000,
+                recv_message_capacity=1 << 22,
+            )
+        ]
+
+    async def on_start(self) -> None:
+        if self.fast_sync:
+            await self._run_effects(self.fsm.handle(Event.START))
+            self.spawn(self._process_routine(), "bcv1-process")
+            self.spawn(self._tick_routine(), "bcv1-tick")
+
+    # -- p2p ----------------------------------------------------------
+
+    async def add_peer(self, peer) -> None:
+        await peer.send(
+            BLOCKCHAIN_CHANNEL,
+            encode_bc_message(
+                StatusResponseMessage(self.block_store.base(), self.block_store.height())
+            ),
+        )
+
+    async def remove_peer(self, peer, reason) -> None:
+        if self.fsm.state != State.FINISHED:
+            await self._run_effects(self.fsm.handle(Event.PEER_REMOVE, peer_id=peer.id))
+
+    async def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
+        try:
+            msg = decode_bc_message(msg_bytes)
+        except Exception as e:
+            await self.switch.stop_peer_for_error(peer, e)
+            return
+        if isinstance(msg, BlockRequestMessage):
+            block = self.block_store.load_block(msg.height)
+            if block is not None:
+                await peer.send(
+                    BLOCKCHAIN_CHANNEL, encode_bc_message(BlockResponseMessage(block))
+                )
+            else:
+                await peer.send(
+                    BLOCKCHAIN_CHANNEL,
+                    encode_bc_message(NoBlockResponseMessage(msg.height)),
+                )
+            return
+        if isinstance(msg, StatusRequestMessage):
+            await peer.send(
+                BLOCKCHAIN_CHANNEL,
+                encode_bc_message(
+                    StatusResponseMessage(self.block_store.base(), self.block_store.height())
+                ),
+            )
+            return
+        if self.fsm.state == State.FINISHED:
+            return
+        if isinstance(msg, StatusResponseMessage):
+            await self._run_effects(
+                self.fsm.handle(
+                    Event.STATUS_RESPONSE, peer_id=peer.id, base=msg.base, height=msg.height
+                )
+            )
+        elif isinstance(msg, BlockResponseMessage):
+            await self._run_effects(
+                self.fsm.handle(Event.BLOCK_RESPONSE, peer_id=peer.id, block=msg.block)
+            )
+        elif isinstance(msg, NoBlockResponseMessage):
+            await self._run_effects(
+                self.fsm.handle(Event.NO_BLOCK_RESPONSE, peer_id=peer.id, height=msg.height)
+            )
+
+    # -- effects ------------------------------------------------------
+
+    async def _run_effects(self, effects: list) -> None:
+        for eff in effects:
+            kind = eff[0]
+            if kind == "request":
+                _, height, peer_id = eff
+                peer = self.switch.peers.get(peer_id) if self.switch else None
+                if peer is not None:
+                    await peer.send(
+                        BLOCKCHAIN_CHANNEL, encode_bc_message(BlockRequestMessage(height))
+                    )
+            elif kind == "error":
+                _, peer_id, reason = eff
+                peer = self.switch.peers.get(peer_id) if self.switch else None
+                if peer is not None:
+                    await self.switch.stop_peer_for_error(peer, reason)
+            elif kind == "switch_to_consensus":
+                self.log.info(
+                    "fast sync v1 complete", height=self.fsm.height,
+                    blocks=self.fsm.blocks_synced,
+                )
+                cons = self.switch.reactor("CONSENSUS") if self.switch else None
+                if cons is not None:
+                    await cons.switch_to_consensus(self.state, self.fsm.blocks_synced)
+
+    # -- routines -----------------------------------------------------
+
+    async def _process_routine(self) -> None:
+        """Verify+apply received block pairs (shared verify path with v0 —
+        one batched device verify per commit)."""
+        while self.fsm.state != State.FINISHED:
+            first, second = self.fsm.first_two_blocks()
+            if first is None or second is None:
+                await asyncio.sleep(PROCESS_INTERVAL)
+                continue
+            block = first.block
+            first_parts = block.make_part_set()
+            first_id = BlockID(block.hash(), first_parts.header())
+            err = None
+            try:
+                self.state.validators.verify_commit(
+                    self.state.chain_id, first_id, block.header.height,
+                    second.block.last_commit,
+                )
+            except VerifyError as e:
+                err = e
+                self.log.error("v1 block verify failed", height=block.header.height, err=str(e))
+            if err is None:
+                self.block_store.save_block(block, first_parts, second.block.last_commit)
+                self.state = await self.block_exec.apply_block(self.state, first_id, block)
+            await self._run_effects(
+                self.fsm.handle(Event.PROCESSED_BLOCK, err=err)
+            )
+
+    async def _tick_routine(self) -> None:
+        last_status = 0.0
+        while self.fsm.state != State.FINISHED:
+            await asyncio.sleep(TICK_INTERVAL)
+            now = asyncio.get_event_loop().time()
+            if now - last_status > STATUS_INTERVAL:
+                last_status = now
+                if self.switch is not None:
+                    await self.switch.broadcast(
+                        BLOCKCHAIN_CHANNEL, encode_bc_message(StatusRequestMessage())
+                    )
+            await self._run_effects(self.fsm.handle(Event.MAKE_REQUESTS))

@@ -1,0 +1,153 @@
+"""Fast sync v2 — the scheduler data-structure prototype.
+
+Reference parity: blockchain/v2/schedule.go (ADR-043): a pure scheduling
+data structure tracking per-height block states (New → Pending → Received
+→ Processed) and per-peer states (New → Ready → Removed), with explicit
+invariant-checked transitions. The reference shipped only this prototype
+(no reactor); mirrored here with the same scope.
+"""
+from __future__ import annotations
+
+import enum
+import time
+
+
+class BlockState(enum.Enum):
+    UNKNOWN = "Unknown"
+    NEW = "New"            # known height, no request yet
+    PENDING = "Pending"    # requested from a peer
+    RECEIVED = "Received"  # block arrived, not yet processed
+    PROCESSED = "Processed"
+
+
+class PeerState(enum.Enum):
+    NEW = "New"
+    READY = "Ready"
+    REMOVED = "Removed"
+
+
+class ScheduleError(Exception):
+    pass
+
+
+class Schedule:
+    """Reference schedule.go `schedule`."""
+
+    def __init__(self, initial_height: int) -> None:
+        self.initial_height = initial_height
+        self.block_states: dict[int, BlockState] = {}
+        self.pending_blocks: dict[int, str] = {}      # height -> peer
+        self.pending_time: dict[int, float] = {}
+        self.received_blocks: dict[int, str] = {}
+        self.peers: dict[str, PeerState] = {}
+        self.peer_heights: dict[str, int] = {}
+        self.max_height = initial_height - 1
+
+    # -- peers --------------------------------------------------------
+
+    def add_peer(self, peer_id: str) -> None:
+        if peer_id in self.peers and self.peers[peer_id] != PeerState.REMOVED:
+            raise ScheduleError(f"duplicate peer {peer_id}")
+        self.peers[peer_id] = PeerState.NEW
+
+    def touch_peer(self, peer_id: str) -> None:
+        if self.peers.get(peer_id) != PeerState.READY:
+            raise ScheduleError(f"peer {peer_id} not ready")
+
+    def remove_peer(self, peer_id: str) -> None:
+        state = self.peers.get(peer_id)
+        if state is None or state == PeerState.REMOVED:
+            return
+        self.peers[peer_id] = PeerState.REMOVED
+        # re-schedule its pending heights; forget its unprocessed blocks
+        for h in [h for h, p in self.pending_blocks.items() if p == peer_id]:
+            del self.pending_blocks[h]
+            self.pending_time.pop(h, None)
+            self.block_states[h] = BlockState.NEW
+        for h in [h for h, p in self.received_blocks.items() if p == peer_id]:
+            del self.received_blocks[h]
+            self.block_states[h] = BlockState.NEW
+        # shrink the height horizon if this was the tallest peer
+        self.peer_heights.pop(peer_id, None)
+        new_max = max(
+            (
+                h
+                for p, h in self.peer_heights.items()
+                if self.peers.get(p) == PeerState.READY
+            ),
+            default=self.initial_height - 1,
+        )
+        if new_max < self.max_height:
+            for h in [h for h in self.block_states if h > new_max]:
+                if self.block_states[h] != BlockState.PROCESSED:
+                    del self.block_states[h]
+            self.max_height = new_max
+
+    def set_peer_height(self, peer_id: str, height: int) -> None:
+        state = self.peers.get(peer_id)
+        if state is None or state == PeerState.REMOVED:
+            raise ScheduleError(f"cannot set height for peer {peer_id}")
+        self.peers[peer_id] = PeerState.READY
+        self.peer_heights[peer_id] = height
+        if height > self.max_height:
+            for h in range(self.max_height + 1, height + 1):
+                if h >= self.initial_height and h not in self.block_states:
+                    self.block_states[h] = BlockState.NEW
+            self.max_height = height
+
+    def ready_peers(self, min_height: int = 0) -> list[str]:
+        return sorted(
+            p
+            for p, s in self.peers.items()
+            if s == PeerState.READY and self.peer_heights.get(p, 0) >= min_height
+        )
+
+    # -- block transitions -------------------------------------------
+
+    def get_state_at_height(self, height: int) -> BlockState:
+        if height < self.initial_height:
+            return BlockState.PROCESSED
+        return self.block_states.get(height, BlockState.UNKNOWN)
+
+    def mark_pending(self, peer_id: str, height: int, now: float | None = None) -> None:
+        if self.get_state_at_height(height) != BlockState.NEW:
+            raise ScheduleError(f"height {height} not New")
+        if self.peers.get(peer_id) != PeerState.READY:
+            raise ScheduleError(f"peer {peer_id} not ready")
+        if self.peer_heights.get(peer_id, 0) < height:
+            raise ScheduleError(f"peer {peer_id} too short for {height}")
+        self.block_states[height] = BlockState.PENDING
+        self.pending_blocks[height] = peer_id
+        self.pending_time[height] = now if now is not None else time.monotonic()
+
+    def mark_received(self, peer_id: str, height: int) -> None:
+        if self.pending_blocks.get(height) != peer_id:
+            raise ScheduleError(f"height {height} not pending from {peer_id}")
+        self.block_states[height] = BlockState.RECEIVED
+        del self.pending_blocks[height]
+        self.pending_time.pop(height, None)
+        self.received_blocks[height] = peer_id
+
+    def mark_processed(self, height: int) -> None:
+        if self.get_state_at_height(height) != BlockState.RECEIVED:
+            raise ScheduleError(f"height {height} not Received")
+        self.block_states[height] = BlockState.PROCESSED
+        self.received_blocks.pop(height, None)
+
+    # -- queries ------------------------------------------------------
+
+    def next_height_to_schedule(self) -> int | None:
+        for h in sorted(self.block_states):
+            if self.block_states[h] == BlockState.NEW:
+                return h
+        return None
+
+    def height_of_first_pending_since(self, cutoff: float) -> list[int]:
+        """Heights whose requests have been outstanding since before cutoff
+        (stall detection)."""
+        return sorted(h for h, t in self.pending_time.items() if t < cutoff)
+
+    def all_blocks_processed(self) -> bool:
+        if not self.block_states:
+            return False
+        return all(s == BlockState.PROCESSED for s in self.block_states.values())

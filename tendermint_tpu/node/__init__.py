@@ -166,9 +166,16 @@ class Node(BaseService):
         )
 
         fast_sync = cfg.base.fast_sync and self._consensus_possible(state)
-        self.bc_reactor = BlockchainReactor(
-            state, self.block_exec, self.block_store, fast_sync=fast_sync, logger=log
-        )
+        if cfg.fast_sync.version == "v1":
+            from tendermint_tpu.blockchain.v1_reactor import BlockchainReactorV1
+
+            self.bc_reactor = BlockchainReactorV1(
+                state, self.block_exec, self.block_store, fast_sync=fast_sync, logger=log
+            )
+        else:
+            self.bc_reactor = BlockchainReactor(
+                state, self.block_exec, self.block_store, fast_sync=fast_sync, logger=log
+            )
 
         wal_dir = os.path.dirname(cfg.wal_path)
         os.makedirs(wal_dir, exist_ok=True)
@@ -256,6 +263,12 @@ class Node(BaseService):
         rpc_host, rpc_port = parse_laddr(cfg.rpc.laddr)
         self.rpc_server = JSONRPCServer(rpc_host, rpc_port, logger=log)
         self.rpc_server.register_routes(self.rpc_env.routes())
+        self.grpc_server = None
+        if cfg.rpc.grpc_laddr:
+            from tendermint_tpu.rpc.grpc import GRPCBroadcastServer
+
+            gh, gp = parse_laddr(cfg.rpc.grpc_laddr)
+            self.grpc_server = GRPCBroadcastServer(self.rpc_env, gh, gp, logger=log)
 
         # 9. metrics (reference node.go:124-138 providers + :946 server)
         self.metrics_server = None
@@ -304,6 +317,8 @@ class Node(BaseService):
             await self.build()
         # RPC first (reference node.go:729 — receive txs before p2p is up)
         await self.rpc_server.start()
+        if self.grpc_server is not None:
+            await self.grpc_server.start()
         if self.metrics_server is not None:
             await self.metrics_server.start()
             self.spawn(self._metrics_sampler(), "metrics-sampler")
@@ -320,6 +335,8 @@ class Node(BaseService):
     async def on_stop(self) -> None:
         await self.switch.stop()
         await self.rpc_server.stop()
+        if self.grpc_server is not None:
+            await self.grpc_server.stop()
         if self.metrics_server is not None:
             await self.metrics_server.stop()
         if self.consensus_state.is_running:

@@ -1,0 +1,110 @@
+"""Minimal gRPC broadcast API.
+
+Reference parity: rpc/grpc/api.go — a deliberately tiny gRPC surface next
+to the JSON-RPC server: `Ping` and `BroadcastTx` (CheckTx + DeliverTx
+result, i.e. broadcast_tx_commit semantics in the reference's
+BroadcastAPI). grpcio-tools (protoc codegen for python) is not in the
+image, so the service is registered with generic method handlers over a
+documented CBE wire format instead of compiled protobuf stubs — same
+method paths, so the service is discoverable at
+/tendermint.rpc.grpc.BroadcastAPI/{Ping,BroadcastTx}.
+"""
+from __future__ import annotations
+
+import grpc
+import grpc.aio
+
+from tendermint_tpu.encoding import Reader, Writer
+from tendermint_tpu.libs.log import NOP, Logger
+
+SERVICE = "tendermint.rpc.grpc.BroadcastAPI"
+
+
+def _encode_response_broadcast_tx(check: dict, deliver: dict) -> bytes:
+    w = Writer()
+    for res in (check, deliver):
+        w.u32(res.get("code", 0))
+        w.bytes(bytes.fromhex(res.get("data", "")) if res.get("data") else b"")
+        w.str(res.get("log", ""))
+    return w.build()
+
+
+def decode_response_broadcast_tx(data: bytes) -> tuple[dict, dict]:
+    r = Reader(data)
+    out = []
+    for _ in range(2):
+        out.append({"code": r.u32(), "data": r.bytes().hex(), "log": r.str()})
+    r.expect_done()
+    return out[0], out[1]
+
+
+class GRPCBroadcastServer:
+    """Serves BroadcastAPI next to the JSON-RPC server (reference
+    node/node.go startRPC grpc_laddr handling)."""
+
+    def __init__(self, env, host: str = "127.0.0.1", port: int = 0, logger: Logger = NOP) -> None:
+        self.env = env
+        self.host, self.port = host, port
+        self.log = logger
+        self._server: grpc.aio.Server | None = None
+        self.bound_port: int | None = None
+
+    async def start(self) -> None:
+        server = grpc.aio.server()
+
+        async def ping(request: bytes, context) -> bytes:
+            return b""
+
+        async def broadcast_tx(request: bytes, context) -> bytes:
+            r = Reader(request)
+            tx = r.bytes()
+            r.expect_done()
+            res = await self.env.broadcast_tx_commit(tx.hex())
+            return _encode_response_broadcast_tx(
+                res.get("check_tx", {}), res.get("deliver_tx", {})
+            )
+
+        identity = lambda b: b  # noqa: E731 — raw-bytes (de)serializers
+        handlers = {
+            "Ping": grpc.unary_unary_rpc_method_handler(
+                ping, request_deserializer=identity, response_serializer=identity
+            ),
+            "BroadcastTx": grpc.unary_unary_rpc_method_handler(
+                broadcast_tx, request_deserializer=identity, response_serializer=identity
+            ),
+        }
+        server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE, handlers),)
+        )
+        self.bound_port = server.add_insecure_port(f"{self.host}:{self.port}")
+        await server.start()
+        self._server = server
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            await self._server.stop(grace=1.0)
+
+
+class GRPCBroadcastClient:
+    def __init__(self, host: str, port: int) -> None:
+        self._channel = grpc.aio.insecure_channel(f"{host}:{port}")
+        identity = lambda b: b  # noqa: E731
+        self._ping = self._channel.unary_unary(
+            f"/{SERVICE}/Ping", request_serializer=identity, response_deserializer=identity
+        )
+        self._broadcast = self._channel.unary_unary(
+            f"/{SERVICE}/BroadcastTx",
+            request_serializer=identity,
+            response_deserializer=identity,
+        )
+
+    async def ping(self) -> None:
+        await self._ping(b"")
+
+    async def broadcast_tx(self, tx: bytes) -> tuple[dict, dict]:
+        req = Writer().bytes(tx).build()
+        resp = await self._broadcast(req)
+        return decode_response_broadcast_tx(resp)
+
+    async def close(self) -> None:
+        await self._channel.close()

@@ -1,0 +1,196 @@
+"""Fast sync v1 FSM + v2 scheduler tests — the reference's
+blockchain/v1/reactor_fsm_test.go and blockchain/v2/schedule_test.go
+patterns (pure data-structure tests), plus a live v1 sync through real
+sockets."""
+import asyncio
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from tendermint_tpu.blockchain.v1 import BcFSM, Event, FSMError, State
+from tendermint_tpu.blockchain.v2 import (
+    BlockState,
+    PeerState,
+    Schedule,
+    ScheduleError,
+)
+
+
+class FakeBlock:
+    def __init__(self, height):
+        class H:
+            pass
+
+        self.header = H()
+        self.header.height = height
+
+
+class TestBcFSM:
+    def test_happy_path(self):
+        fsm = BcFSM(start_height=1)
+        assert fsm.state == State.UNKNOWN
+        fsm.handle(Event.START)
+        assert fsm.state == State.WAIT_FOR_PEER
+
+        eff = fsm.handle(Event.STATUS_RESPONSE, peer_id="p1", height=3)
+        assert fsm.state == State.WAIT_FOR_BLOCK
+        reqs = [e for e in eff if e[0] == "request"]
+        assert [r[1] for r in reqs] == [1, 2, 3]
+
+        for h in (1, 2, 3):
+            fsm.handle(Event.BLOCK_RESPONSE, peer_id="p1", block=FakeBlock(h))
+        first, second = fsm.first_two_blocks()
+        assert first.block.header.height == 1
+        assert second.block.header.height == 2
+
+        fsm.handle(Event.PROCESSED_BLOCK, err=None)
+        assert fsm.height == 2
+        eff = fsm.handle(Event.PROCESSED_BLOCK, err=None)
+        # height 3 == max peer height: caught up
+        assert fsm.state == State.FINISHED
+        assert ("switch_to_consensus",) in eff
+
+    def test_unsolicited_block_errors_peer(self):
+        fsm = BcFSM(1)
+        fsm.handle(Event.START)
+        fsm.handle(Event.STATUS_RESPONSE, peer_id="p1", height=5)
+        eff = fsm.handle(Event.BLOCK_RESPONSE, peer_id="evil", block=FakeBlock(1))
+        assert ("error", "evil", "unsolicited block 1") in eff
+
+    def test_bad_block_drops_both_senders_and_refetches(self):
+        fsm = BcFSM(1)
+        fsm.handle(Event.START)
+        fsm.handle(Event.STATUS_RESPONSE, peer_id="p1", height=5)
+        fsm.handle(Event.STATUS_RESPONSE, peer_id="p2", height=5)
+        # route height 1 and 2 to whichever peers were picked
+        senders = {}
+        for h in (1, 2):
+            pid = fsm.pending[h]
+            senders[h] = pid
+            fsm.handle(Event.BLOCK_RESPONSE, peer_id=pid, block=FakeBlock(h))
+        eff = fsm.handle(Event.PROCESSED_BLOCK, err=ValueError("bad commit"))
+        errored = {e[1] for e in eff if e[0] == "error"}
+        assert set(senders.values()) <= errored
+        assert fsm.height == 1  # not advanced
+        for pid in senders.values():
+            assert pid not in fsm.peers
+
+    def test_peer_removal_rolls_back_to_wait_for_peer(self):
+        fsm = BcFSM(1)
+        fsm.handle(Event.START)
+        fsm.handle(Event.STATUS_RESPONSE, peer_id="p1", height=9)
+        assert fsm.state == State.WAIT_FOR_BLOCK
+        fsm.handle(Event.PEER_REMOVE, peer_id="p1")
+        assert fsm.state == State.WAIT_FOR_PEER
+        assert fsm.max_peer_height == 0
+
+    def test_invalid_event_in_unknown(self):
+        fsm = BcFSM(1)
+        with pytest.raises(FSMError):
+            fsm.handle(Event.BLOCK_RESPONSE, peer_id="p", block=FakeBlock(1))
+
+
+class TestScheduleV2:
+    def test_block_lifecycle(self):
+        s = Schedule(initial_height=1)
+        s.add_peer("p1")
+        s.set_peer_height("p1", 3)
+        assert s.get_state_at_height(1) == BlockState.NEW
+        assert s.get_state_at_height(4) == BlockState.UNKNOWN
+        assert s.get_state_at_height(0) == BlockState.PROCESSED
+
+        s.mark_pending("p1", 1, now=100.0)
+        assert s.get_state_at_height(1) == BlockState.PENDING
+        with pytest.raises(ScheduleError):
+            s.mark_pending("p1", 1)  # not New anymore
+        s.mark_received("p1", 1)
+        assert s.get_state_at_height(1) == BlockState.RECEIVED
+        s.mark_processed(1)
+        assert s.get_state_at_height(1) == BlockState.PROCESSED
+
+    def test_remove_peer_reschedules(self):
+        s = Schedule(1)
+        s.add_peer("p1")
+        s.add_peer("p2")
+        s.set_peer_height("p1", 5)
+        s.set_peer_height("p2", 3)
+        s.mark_pending("p1", 1)
+        s.mark_pending("p1", 2)
+        s.remove_peer("p1")
+        assert s.get_state_at_height(1) == BlockState.NEW
+        assert s.get_state_at_height(2) == BlockState.NEW
+        # horizon shrank to p2's height
+        assert s.max_height == 3
+        assert s.get_state_at_height(5) == BlockState.UNKNOWN
+        assert s.ready_peers() == ["p2"]
+
+    def test_short_peer_rejected(self):
+        s = Schedule(1)
+        s.add_peer("p1")
+        s.set_peer_height("p1", 2)
+        with pytest.raises(ScheduleError):
+            s.mark_pending("p1", 3)
+
+    def test_stall_detection(self):
+        s = Schedule(1)
+        s.add_peer("p1")
+        s.set_peer_height("p1", 2)
+        s.mark_pending("p1", 1, now=10.0)
+        s.mark_pending("p1", 2, now=50.0)
+        assert s.height_of_first_pending_since(20.0) == [1]
+
+
+class TestV1Live:
+    def test_v1_syncs_from_producer(self, tmp_path):
+        from test_blockchain import CHAIN_ID, SyncNode
+        from tendermint_tpu.blockchain.v1_reactor import BlockchainReactorV1
+        from tendermint_tpu.p2p.test_util import (
+            make_connected_switches,
+            make_switch,
+            stop_switches,
+        )
+        from tendermint_tpu.types import MockPV
+
+        async def main():
+            pv = MockPV()
+            producer = SyncNode(os.path.join(tmp_path, "producer"), pv, validator=True)
+            producer_reactors = await producer.setup()
+            switches = await make_connected_switches(
+                1, lambda i: producer_reactors, network=CHAIN_ID
+            )
+            syncer = None
+            try:
+                async with asyncio.timeout(60):
+                    while producer.block_store.height() < 8:
+                        await asyncio.sleep(0.05)
+                syncer = SyncNode(os.path.join(tmp_path, "syncer"), pv, validator=False)
+                reactors = await syncer.setup()
+                # swap in the v1 reactor
+                reactors["BLOCKCHAIN"] = BlockchainReactorV1(
+                    syncer.bc_reactor.initial_state,
+                    syncer.block_exec,
+                    syncer.block_store,
+                    fast_sync=True,
+                )
+                sw2 = await make_switch(reactors, network=CHAIN_ID)
+                await sw2.start()
+                switches.append(sw2)
+                await sw2.dial_peers_async([switches[0].transport.listen_addr])
+                async with asyncio.timeout(60):
+                    while syncer.block_store.height() < 8:
+                        await asyncio.sleep(0.05)
+                    while not syncer.cs.is_running:
+                        await asyncio.sleep(0.05)
+                h1 = producer.block_store.load_block_meta(5).block_id.hash
+                h2 = syncer.block_store.load_block_meta(5).block_id.hash
+                assert h1 == h2
+            finally:
+                await stop_switches(switches)
+                await producer.teardown()
+                if syncer is not None:
+                    await syncer.teardown()
+
+        asyncio.run(main())

@@ -1,0 +1,37 @@
+"""gRPC broadcast API tests (reference rpc/grpc/grpc_test.go pattern)."""
+import asyncio
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+grpc = pytest.importorskip("grpc")
+
+
+class TestGRPCBroadcast:
+    def test_ping_and_broadcast_tx(self, tmp_path):
+        from test_node_rpc import make_node
+        from tendermint_tpu.rpc.grpc import GRPCBroadcastClient
+
+        async def main():
+            node = make_node(str(tmp_path))
+            node.config.rpc.grpc_laddr = "tcp://127.0.0.1:0"
+            await node.start()
+            client = None
+            try:
+                async with asyncio.timeout(30):
+                    while node.block_store.height() < 1:
+                        await asyncio.sleep(0.05)
+                client = GRPCBroadcastClient("127.0.0.1", node.grpc_server.bound_port)
+                await client.ping()
+                check, deliver = await client.broadcast_tx(b"grpc-key=grpc-value")
+                assert check["code"] == 0
+                assert deliver["code"] == 0
+            finally:
+                if client is not None:
+                    await client.close()
+                await node.stop()
+
+        asyncio.run(main())
