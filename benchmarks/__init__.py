@@ -1,0 +1,1 @@
+"""Micro-benchmarks (reference benchmarks/ + per-package bench_test.go)."""

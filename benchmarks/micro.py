@@ -1,0 +1,165 @@
+"""Micro-benchmarks.
+
+Reference parity: the Go micro-bench inventory — crypto sign/verify/keygen
+(crypto/internal/benchmarking/bench.go, crypto/ed25519/bench_test.go),
+codec encode/decode (benchmarks/codec_test.go), mempool reap/check
+(mempool/bench_test.go), clist (libs/clist). Run:
+
+    python -m benchmarks.micro            # everything
+    python -m benchmarks.micro crypto     # one group
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+
+def _bench(name: str, fn, n: int, unit: str = "ops") -> dict:
+    t0 = time.perf_counter()
+    fn(n)
+    dt = time.perf_counter() - t0
+    rate = n / dt
+    line = {"bench": name, "n": n, "secs": round(dt, 4), f"{unit}_per_sec": round(rate, 1)}
+    print(json.dumps(line))
+    return line
+
+
+def bench_crypto() -> None:
+    from tendermint_tpu.crypto import ed25519, secp256k1
+
+    pk = ed25519.gen_priv_key()
+    msg = b"x" * 128
+    sig = pk.sign(msg)
+    pub = pk.pub_key()
+
+    _bench("ed25519_keygen", lambda n: [ed25519.gen_priv_key() for _ in range(n)], 2000)
+    _bench("ed25519_sign", lambda n: [pk.sign(msg) for _ in range(n)], 5000)
+    _bench("ed25519_verify_serial", lambda n: [pub.verify(msg, sig) for _ in range(n)], 5000)
+
+    sk = secp256k1.gen_priv_key()
+    ssig = sk.sign(msg)
+    spub = sk.pub_key()
+    _bench("secp256k1_sign", lambda n: [sk.sign(msg) for _ in range(n)], 2000)
+    _bench("secp256k1_verify_serial", lambda n: [spub.verify(msg, ssig) for _ in range(n)], 2000)
+
+    try:
+        from tendermint_tpu.crypto import native
+
+        if native.load() is not None:
+            _bench(
+                "ed25519_verify_native_batch",
+                lambda n: native.ed25519_verify_batch(
+                    [pub.bytes()] * n, [msg] * n, [sig] * n
+                ),
+                5000,
+                unit="verifies",
+            )
+            _bench(
+                "secp256k1_verify_native_batch",
+                lambda n: native.secp256k1_verify_batch(
+                    [spub.bytes()] * n, [msg] * n, [ssig] * n
+                ),
+                2000,
+                unit="verifies",
+            )
+    except Exception as e:
+        print(f"# native skipped: {e}", file=sys.stderr)
+
+    try:
+        from tendermint_tpu.ops import ed25519_batch
+
+        # warm up the 4096 bucket (jit compile is cached per shape)
+        ed25519_batch.verify_batch([pub.bytes()] * 4096, [msg] * 4096, [sig] * 4096)
+        _bench(
+            "ed25519_verify_device_batch",
+            lambda n: ed25519_batch.verify_batch([pub.bytes()] * n, [msg] * n, [sig] * n),
+            4096,
+            unit="verifies",
+        )
+    except Exception as e:
+        print(f"# device kernel skipped: {e}", file=sys.stderr)
+
+
+def bench_codec() -> None:
+    from tendermint_tpu.types import MockPV
+    from tendermint_tpu.types.block import Block
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    pv = MockPV()
+    gen = GenesisDoc(
+        chain_id="bench", genesis_time=1, validators=[GenesisValidator(pv.get_pub_key(), 10)]
+    )
+    state_vals = gen.validator_set()
+    from tendermint_tpu.types import make_block
+
+    block = make_block(
+        1, [b"tx-%d" % i for i in range(200)], None, [],
+        chain_id="bench", time=123,
+        validators_hash=state_vals.hash(), next_validators_hash=state_vals.hash(),
+        proposer_address=state_vals.get_proposer().address,
+    )
+    raw = block.encode()
+    print(f"# block with 200 txs encodes to {len(raw)} bytes", file=sys.stderr)
+    _bench("block_encode", lambda n: [block.encode() for _ in range(n)], 2000)
+    _bench("block_decode", lambda n: [Block.decode(raw) for _ in range(n)], 2000)
+
+
+def bench_mempool() -> None:
+    from tendermint_tpu import proxy
+    from tendermint_tpu.abci.examples import KVStoreApplication
+    from tendermint_tpu.mempool import CListMempool
+
+    async def run() -> None:
+        conns = proxy.AppConns(proxy.LocalClientCreator(KVStoreApplication()))
+        await conns.start()
+        mp = CListMempool(conns.mempool, max_txs=200_000)
+
+        async def check(n):
+            for i in range(n):
+                await mp.check_tx(b"bench-%d=v" % i)
+
+        n = 20_000
+        t0 = time.perf_counter()
+        await check(n)
+        dt = time.perf_counter() - t0
+        print(json.dumps({"bench": "mempool_check_tx", "n": n, "secs": round(dt, 4),
+                          "ops_per_sec": round(n / dt, 1)}))
+        _bench("mempool_reap_1000", lambda k: [mp.reap_max_bytes_max_gas(64 * 1024, -1) for _ in range(k)], 1000)
+        await conns.stop()
+
+    asyncio.run(run())
+
+
+def bench_clist() -> None:
+    from tendermint_tpu.libs.clist import CList
+
+    def pushes(n):
+        cl = CList()
+        for i in range(n):
+            cl.push_back(i)
+
+    _bench("clist_push_back", pushes, 100_000)
+
+
+GROUPS = {
+    "crypto": bench_crypto,
+    "codec": bench_codec,
+    "mempool": bench_mempool,
+    "clist": bench_clist,
+}
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    groups = argv or list(GROUPS)
+    for g in groups:
+        print(f"# --- {g} ---", file=sys.stderr)
+        GROUPS[g]()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

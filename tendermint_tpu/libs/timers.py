@@ -1,0 +1,115 @@
+"""Timer utilities — ThrottleTimer, RepeatTimer, CMap.
+
+Reference parity: libs/common/throttle_timer.go (fire at most once per
+interval no matter how often poked), repeat_timer.go (fire every interval
+until stopped), cmap.go (concurrent map — trivially safe under asyncio's
+single thread but kept for API parity and executor-thread use).
+"""
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Any, Callable
+
+
+class ThrottleTimer:
+    """`set()` arms the timer; the callback fires after `interval` at most
+    once per window regardless of how many set() calls arrive."""
+
+    def __init__(self, name: str, interval: float, cb: Callable[[], None]) -> None:
+        self.name = name
+        self.interval = interval
+        self.cb = cb
+        self._armed = False
+        self._handle: asyncio.TimerHandle | None = None
+
+    def set(self) -> None:
+        if self._armed:
+            return
+        self._armed = True
+        loop = asyncio.get_event_loop()
+        self._handle = loop.call_later(self.interval, self._fire)
+
+    def unset(self) -> None:
+        self._armed = False
+        if self._handle is not None:
+            self._handle.cancel()
+            self._handle = None
+
+    def _fire(self) -> None:
+        self._armed = False
+        self._handle = None
+        self.cb()
+
+    def stop(self) -> None:
+        self.unset()
+
+
+class RepeatTimer:
+    """Fires the callback every `interval` seconds until stopped
+    (reference repeat_timer.go)."""
+
+    def __init__(self, name: str, interval: float, cb: Callable[[], None]) -> None:
+        self.name = name
+        self.interval = interval
+        self.cb = cb
+        self._task: asyncio.Task | None = None
+
+    def start(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.ensure_future(self._run())
+
+    async def _run(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval)
+            self.cb()
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    def reset(self) -> None:
+        self.stop()
+        self.start()
+
+
+class CMap:
+    """Thread-safe map (reference cmap.go) — for state shared with executor
+    threads (hashing pools, native calls)."""
+
+    def __init__(self) -> None:
+        self._m: dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def set(self, key: str, value: Any) -> None:
+        with self._lock:
+            self._m[key] = value
+
+    def get(self, key: str) -> Any:
+        with self._lock:
+            return self._m.get(key)
+
+    def has(self, key: str) -> bool:
+        with self._lock:
+            return key in self._m
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._m.pop(key, None)
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._m)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._m.clear()
+
+    def keys(self) -> list[str]:
+        with self._lock:
+            return list(self._m)
+
+    def values(self) -> list[Any]:
+        with self._lock:
+            return list(self._m.values())

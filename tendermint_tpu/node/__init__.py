@@ -263,6 +263,10 @@ class Node(BaseService):
         rpc_host, rpc_port = parse_laddr(cfg.rpc.laddr)
         self.rpc_server = JSONRPCServer(rpc_host, rpc_port, logger=log)
         self.rpc_server.register_routes(self.rpc_env.routes())
+        if cfg.rpc.unsafe:
+            from tendermint_tpu.rpc.dev import DevRoutes
+
+            self.rpc_server.register_routes(DevRoutes(self.mempool).routes())
         self.grpc_server = None
         if cfg.rpc.grpc_laddr:
             from tendermint_tpu.rpc.grpc import GRPCBroadcastServer

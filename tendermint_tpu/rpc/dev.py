@@ -1,0 +1,70 @@
+"""Unsafe dev/profiling RPC routes.
+
+Reference parity: rpc/core/dev.go + routes.go:47-57 — runtime-controllable
+profiling behind the `unsafe` RPC flag, and net/http/pprof on prof_laddr
+(node/node.go:688). Go's pprof maps to Python's cProfile (CPU) and
+tracemalloc (heap); profiles are written where the caller asks.
+"""
+from __future__ import annotations
+
+import cProfile
+import io
+import pstats
+import tracemalloc
+
+from tendermint_tpu.rpc.jsonrpc import INTERNAL_ERROR, RPCError
+
+
+class DevRoutes:
+    """Mixed into the route table when config.rpc.unsafe is on."""
+
+    def __init__(self, mempool=None) -> None:
+        self._profiler: cProfile.Profile | None = None
+        self._mempool = mempool
+
+    async def unsafe_start_cpu_profiler(self, filename: str = "") -> dict:
+        if self._profiler is not None:
+            raise RPCError(INTERNAL_ERROR, "profiler already running")
+        self._profiler = cProfile.Profile()
+        self._profiler.enable()
+        self._cpu_filename = filename
+        return {}
+
+    async def unsafe_stop_cpu_profiler(self) -> dict:
+        if self._profiler is None:
+            raise RPCError(INTERNAL_ERROR, "profiler not running")
+        self._profiler.disable()
+        prof, self._profiler = self._profiler, None
+        if self._cpu_filename:
+            prof.dump_stats(self._cpu_filename)
+            return {}
+        out = io.StringIO()
+        pstats.Stats(prof, stream=out).sort_stats("cumulative").print_stats(40)
+        return {"profile": out.getvalue()}
+
+    async def unsafe_write_heap_profile(self, filename: str = "") -> dict:
+        if not tracemalloc.is_tracing():
+            tracemalloc.start()
+            return {"note": "heap tracing started; call again for a snapshot"}
+        snap = tracemalloc.take_snapshot()
+        top = snap.statistics("lineno")[:40]
+        lines = [str(s) for s in top]
+        if filename:
+            with open(filename, "w") as f:
+                f.write("\n".join(lines))
+            return {}
+        return {"top": lines}
+
+    async def unsafe_flush_mempool(self) -> dict:
+        if self._mempool is None:
+            raise RPCError(INTERNAL_ERROR, "no mempool")
+        self._mempool.flush()
+        return {}
+
+    def routes(self) -> dict:
+        return {
+            "unsafe_start_cpu_profiler": self.unsafe_start_cpu_profiler,
+            "unsafe_stop_cpu_profiler": self.unsafe_stop_cpu_profiler,
+            "unsafe_write_heap_profile": self.unsafe_write_heap_profile,
+            "unsafe_flush_mempool": self.unsafe_flush_mempool,
+        }

@@ -238,3 +238,51 @@ class TestLog:
     def test_parse_spec(self):
         lv = log.parse_log_level("consensus:debug,*:info")
         assert lv["consensus"] == 10 and lv["*"] == 20
+
+
+class TestTimers:
+    def test_throttle_timer_coalesces(self):
+        async def main():
+            from tendermint_tpu.libs.timers import ThrottleTimer
+
+            fires = []
+            t = ThrottleTimer("t", 0.05, lambda: fires.append(1))
+            for _ in range(10):
+                t.set()  # 10 pokes -> 1 fire
+            await asyncio.sleep(0.12)
+            assert len(fires) == 1
+            t.set()
+            await asyncio.sleep(0.08)
+            assert len(fires) == 2
+            t.stop()
+
+        asyncio.run(main())
+
+    def test_repeat_timer_fires_until_stopped(self):
+        async def main():
+            from tendermint_tpu.libs.timers import RepeatTimer
+
+            fires = []
+            t = RepeatTimer("r", 0.03, lambda: fires.append(1))
+            t.start()
+            await asyncio.sleep(0.2)
+            t.stop()
+            n = len(fires)
+            assert 3 <= n <= 9
+            await asyncio.sleep(0.1)
+            assert len(fires) == n  # stopped means stopped
+
+        asyncio.run(main())
+
+    def test_cmap(self):
+        from tendermint_tpu.libs.timers import CMap
+
+        m = CMap()
+        m.set("a", 1)
+        m.set("b", 2)
+        assert m.get("a") == 1 and m.has("b") and m.size() == 2
+        m.delete("a")
+        assert not m.has("a")
+        assert sorted(m.keys()) == ["b"]
+        m.clear()
+        assert m.size() == 0

@@ -199,3 +199,26 @@ class TestHelpers:
     def test_parse_peer_addr(self):
         a = _parse_peer_addr("abcdef@1.2.3.4:26656")
         assert (a.id, a.host, a.port) == ("abcdef", "1.2.3.4", 26656)
+
+
+class TestUnsafeDevRoutes:
+    def test_profiler_and_flush(self, tmp_path):
+        async def main():
+            node = make_node(str(tmp_path))
+            node.config.rpc.unsafe = True
+            await node.start()
+            client = HTTPClient("127.0.0.1", node.rpc_port)
+            try:
+                await client.call("unsafe_start_cpu_profiler")
+                async with asyncio.timeout(30):
+                    while node.block_store.height() < 1:
+                        await asyncio.sleep(0.05)
+                res = await client.call("unsafe_stop_cpu_profiler")
+                assert "cumulative" in res["profile"]
+                await client.call("unsafe_flush_mempool")
+                assert node.mempool.size() == 0
+            finally:
+                await client.close()
+                await node.stop()
+
+        asyncio.run(main())
