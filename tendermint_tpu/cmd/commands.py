@@ -211,11 +211,14 @@ def cmd_unsafe_reset_all(args) -> int:
 
 
 def cmd_replay(args) -> int:
-    """Reference replay.go: replay the WAL through a fresh consensus state
-    (console mode of consensus/replay_file.go)."""
+    """Reference replay.go + consensus/replay_file.go: scan the WAL, or
+    with --console step messages interactively through a fresh consensus
+    state machine built from this home's stores."""
+    cfg = _load_config(args)
+    if args.console:
+        return asyncio.run(_replay_console(cfg))
     from tendermint_tpu.consensus.wal import WAL
 
-    cfg = _load_config(args)
     wal = WAL(cfg.wal_path)
     count = 0
     for msg in wal.iter_all():
@@ -224,6 +227,68 @@ def cmd_replay(args) -> int:
             print(msg)
     print(f"replayed {count} WAL messages from {cfg.wal_path}")
     wal.close()
+    return 0
+
+
+async def _replay_console(cfg) -> int:
+    """Interactive WAL stepper (reference replay_file.go console:
+    next [N] / status / quit)."""
+    from tendermint_tpu import proxy
+    from tendermint_tpu.consensus.wal import MsgInfo, TimedWALMessage, WAL, WALTimeoutInfo
+    from tendermint_tpu.consensus.replay import Handshaker
+    from tendermint_tpu.consensus.state import ConsensusState
+    from tendermint_tpu.consensus.wal import NilWAL
+    from tendermint_tpu.node import _open_db
+    from tendermint_tpu.state import StateStore, load_state_from_db_or_genesis
+    from tendermint_tpu.state.execution import BlockExecutor
+    from tendermint_tpu.store import BlockStore
+    from tendermint_tpu.types.genesis import GenesisDoc
+
+    genesis = GenesisDoc.from_file(cfg.genesis_path)
+    state_db = _open_db(cfg, "state-replay")
+    state_store = StateStore(state_db)
+    block_store = BlockStore(_open_db(cfg, "blockstore-replay"))
+    state = load_state_from_db_or_genesis(state_db, genesis)
+    conns = proxy.AppConns(proxy.default_client_creator(cfg.base.proxy_app))
+    await conns.start()
+    state = await Handshaker(state_store, state, block_store, genesis).handshake(conns)
+    block_exec = BlockExecutor(state_store, conns.consensus)
+    cs = ConsensusState(cfg.consensus, state, block_exec, block_store, wal=NilWAL())
+
+    wal = WAL(cfg.wal_path)
+    msgs = list(wal.iter_all())
+    wal.close()
+    pos = 0
+    print(f"{len(msgs)} WAL messages; commands: next [N], status, quit")
+    loop = asyncio.get_event_loop()
+    while True:
+        line = (await loop.run_in_executor(None, input, "> ")).strip()
+        if line in ("q", "quit", "exit"):
+            break
+        if line in ("s", "status"):
+            rs = cs.rs
+            print(f"height={rs.height} round={rs.round} step={rs.step.name}")
+            continue
+        n = 1
+        if line.startswith("next"):
+            parts = line.split()
+            n = int(parts[1]) if len(parts) > 1 else 1
+        elif line:
+            print("commands: next [N], status, quit")
+            continue
+        for _ in range(n):
+            if pos >= len(msgs):
+                print("end of WAL")
+                break
+            tm = msgs[pos]
+            pos += 1
+            msg = tm.msg
+            print(f"[{pos}/{len(msgs)}] {type(msg).__name__}")
+            if isinstance(msg, MsgInfo):
+                await cs.handle_msg(msg)
+            elif isinstance(msg, WALTimeoutInfo):
+                pass  # timeouts replay as ordering markers only
+    await conns.stop()
     return 0
 
 
@@ -241,6 +306,18 @@ def cmd_lite(args) -> int:
 
     asyncio.run(run())
     return 0
+
+
+def cmd_probe_upnp(args) -> int:
+    """Reference probe_upnp.go."""
+    from tendermint_tpu.p2p import upnp
+
+    try:
+        print(json.dumps(upnp.probe(), indent=2))
+        return 0
+    except upnp.UPnPError as e:
+        print(f"probe failed: {e}", file=sys.stderr)
+        return 1
 
 
 def cmd_version(args) -> int:
@@ -292,7 +369,11 @@ def main(argv=None) -> int:
 
     sp = sub.add_parser("replay", help="scan/replay the consensus WAL")
     sp.add_argument("--verbose", action="store_true")
+    sp.add_argument("--console", action="store_true", help="interactive stepper")
     sp.set_defaults(fn=cmd_replay)
+
+    sp = sub.add_parser("probe_upnp", help="probe for a UPnP internet gateway")
+    sp.set_defaults(fn=cmd_probe_upnp)
 
     sp = sub.add_parser("lite", help="run a light-client proxy")
     sp.add_argument("--chain-id", required=False, default="")

@@ -1,0 +1,128 @@
+"""XSalsa20-Poly1305 symmetric encryption (pure Python).
+
+Reference parity: crypto/xsalsa20symmetric — secretbox-style
+EncryptSymmetric/DecryptSymmetric with a 32-byte key and a random 24-byte
+nonce prepended to the ciphertext; used for passphrase-encrypted key
+export (with the armor module). The `cryptography` package has no XSalsa20,
+so the cipher is implemented here; throughput is irrelevant for key files.
+"""
+from __future__ import annotations
+
+import os
+import struct
+
+from cryptography.hazmat.primitives.poly1305 import Poly1305
+
+NONCE_LEN = 24
+KEY_LEN = 32
+TAG_LEN = 16
+
+
+class DecryptError(Exception):
+    pass
+
+
+def _rotl(x: int, n: int) -> int:
+    return ((x << n) | (x >> (32 - n))) & 0xFFFFFFFF
+
+
+def _quarter(a, b, c, d):
+    b ^= _rotl((a + d) & 0xFFFFFFFF, 7)
+    c ^= _rotl((b + a) & 0xFFFFFFFF, 9)
+    d ^= _rotl((c + b) & 0xFFFFFFFF, 13)
+    a ^= _rotl((d + c) & 0xFFFFFFFF, 18)
+    return a, b, c, d
+
+
+_SIGMA = (0x61707865, 0x3320646E, 0x79622D32, 0x6B206574)  # "expand 32-byte k"
+
+
+def _salsa20_rounds(state: list[int], rounds: int = 20) -> list[int]:
+    x = list(state)
+    for _ in range(rounds // 2):
+        # column round
+        x[0], x[4], x[8], x[12] = _quarter(x[0], x[4], x[8], x[12])
+        x[5], x[9], x[13], x[1] = _quarter(x[5], x[9], x[13], x[1])
+        x[10], x[14], x[2], x[6] = _quarter(x[10], x[14], x[2], x[6])
+        x[15], x[3], x[7], x[11] = _quarter(x[15], x[3], x[7], x[11])
+        # row round
+        x[0], x[1], x[2], x[3] = _quarter(x[0], x[1], x[2], x[3])
+        x[5], x[6], x[7], x[4] = _quarter(x[5], x[6], x[7], x[4])
+        x[10], x[11], x[8], x[9] = _quarter(x[10], x[11], x[8], x[9])
+        x[15], x[12], x[13], x[14] = _quarter(x[15], x[12], x[13], x[14])
+    return x
+
+
+def _salsa20_block(key: bytes, nonce16: bytes, counter: int) -> bytes:
+    k = struct.unpack("<8I", key)
+    n = struct.unpack("<2I", nonce16[:8])
+    ctr = (counter & 0xFFFFFFFF, (counter >> 32) & 0xFFFFFFFF)
+    state = [
+        _SIGMA[0], k[0], k[1], k[2],
+        k[3], _SIGMA[1], n[0], n[1],
+        ctr[0], ctr[1], _SIGMA[2], k[4],
+        k[5], k[6], k[7], _SIGMA[3],
+    ]
+    x = _salsa20_rounds(state)
+    out = [(a + b) & 0xFFFFFFFF for a, b in zip(x, state)]
+    return struct.pack("<16I", *out)
+
+
+def _hsalsa20(key: bytes, nonce16: bytes) -> bytes:
+    """Derive a subkey from the first 16 nonce bytes (XSalsa20 extension)."""
+    k = struct.unpack("<8I", key)
+    n = struct.unpack("<4I", nonce16)
+    state = [
+        _SIGMA[0], k[0], k[1], k[2],
+        k[3], _SIGMA[1], n[0], n[1],
+        n[2], n[3], _SIGMA[2], k[4],
+        k[5], k[6], k[7], _SIGMA[3],
+    ]
+    x = _salsa20_rounds(state)
+    words = [x[0], x[5], x[10], x[15], x[6], x[7], x[8], x[9]]
+    return struct.pack("<8I", *words)
+
+
+def _xsalsa20_stream(key: bytes, nonce24: bytes, length: int, first_block_skip: int = 0) -> bytes:
+    subkey = _hsalsa20(key, nonce24[:16])
+    out = bytearray()
+    counter = 0
+    total = length + first_block_skip
+    while len(out) < total:
+        out.extend(_salsa20_block(subkey, nonce24[16:] + b"\x00" * 8, counter))
+        counter += 1
+    return bytes(out[first_block_skip:total])
+
+
+def encrypt_symmetric(plaintext: bytes, key: bytes, nonce: bytes | None = None) -> bytes:
+    """nonce(24) || tag(16) || ciphertext — secretbox layout with the nonce
+    prepended (reference EncryptSymmetric)."""
+    if len(key) != KEY_LEN:
+        raise ValueError("key must be 32 bytes")
+    nonce = nonce if nonce is not None else os.urandom(NONCE_LEN)
+    if len(nonce) != NONCE_LEN:
+        raise ValueError("nonce must be 24 bytes")
+    stream = _xsalsa20_stream(key, nonce, 32 + len(plaintext))
+    poly_key, ct_stream = stream[:32], stream[32:]
+    ct = bytes(p ^ s for p, s in zip(plaintext, ct_stream))
+    p = Poly1305(poly_key)
+    p.update(ct)
+    tag = p.finalize()
+    return nonce + tag + ct
+
+
+def decrypt_symmetric(box: bytes, key: bytes) -> bytes:
+    if len(key) != KEY_LEN:
+        raise ValueError("key must be 32 bytes")
+    if len(box) < NONCE_LEN + TAG_LEN:
+        raise DecryptError("ciphertext too short")
+    nonce, tag, ct = box[:NONCE_LEN], box[NONCE_LEN:NONCE_LEN + TAG_LEN], box[NONCE_LEN + TAG_LEN:]
+    stream = _xsalsa20_stream(key, nonce, 32 + len(ct))
+    poly_key, ct_stream = stream[:32], stream[32:]
+    p = Poly1305(poly_key)
+    p.update(ct)
+    try:
+        p.verify(tag)
+    except Exception:
+        raise DecryptError("authentication failed")
+    return bytes(c ^ s for c, s in zip(ct, ct_stream))

@@ -1,0 +1,152 @@
+"""UPnP NAT traversal — discover an internet gateway and map a port.
+
+Reference parity: p2p/upnp (Discover, AddPortMapping, DeletePortMapping,
+GetExternalAddress) used by `tendermint probe_upnp` and optional laddr
+mapping. SSDP discovery over UDP multicast + SOAP control over HTTP, all
+stdlib; everything degrades to UPnPError on networks without a gateway.
+"""
+from __future__ import annotations
+
+import re
+import socket
+import urllib.request
+from dataclasses import dataclass
+
+SSDP_ADDR = ("239.255.255.250", 1900)
+ST = "urn:schemas-upnp-org:device:InternetGatewayDevice:1"
+SERVICE_TYPES = (
+    "urn:schemas-upnp-org:service:WANIPConnection:1",
+    "urn:schemas-upnp-org:service:WANPPPConnection:1",
+)
+
+
+class UPnPError(Exception):
+    pass
+
+
+@dataclass
+class Gateway:
+    control_url: str
+    service_type: str
+    local_ip: str
+
+
+def discover(timeout: float = 3.0) -> Gateway:
+    """SSDP M-SEARCH for an internet gateway (reference upnp.Discover)."""
+    msg = (
+        "M-SEARCH * HTTP/1.1\r\n"
+        f"HOST: {SSDP_ADDR[0]}:{SSDP_ADDR[1]}\r\n"
+        'MAN: "ssdp:discover"\r\n'
+        "MX: 2\r\n"
+        f"ST: {ST}\r\n\r\n"
+    ).encode()
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.settimeout(timeout)
+    try:
+        s.sendto(msg, SSDP_ADDR)
+        data, addr = s.recvfrom(4096)
+        local_ip = _local_ip_towards(addr[0])
+    except OSError as e:
+        raise UPnPError(f"no UPnP gateway responded: {e}") from e
+    finally:
+        s.close()
+    m = re.search(rb"(?i)location:\s*(\S+)", data)
+    if not m:
+        raise UPnPError("SSDP response without LOCATION")
+    location = m.group(1).decode()
+    return _parse_device(location, local_ip)
+
+
+def _local_ip_towards(remote: str) -> str:
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect((remote, 1900))
+        return s.getsockname()[0]
+    finally:
+        s.close()
+
+
+def _parse_device(location: str, local_ip: str) -> Gateway:
+    with urllib.request.urlopen(location, timeout=5) as resp:
+        xml = resp.read().decode("utf-8", "replace")
+    for st in SERVICE_TYPES:
+        pat = (
+            rf"<serviceType>{re.escape(st)}</serviceType>.*?"
+            rf"<controlURL>([^<]+)</controlURL>"
+        )
+        m = re.search(pat, xml, re.S)
+        if m:
+            control = m.group(1)
+            if not control.startswith("http"):
+                base = re.match(r"(https?://[^/]+)", location).group(1)
+                control = base + control
+            return Gateway(control, st, local_ip)
+    raise UPnPError("gateway has no WAN connection service")
+
+
+def _soap(gw: Gateway, action: str, body_xml: str) -> str:
+    envelope = (
+        '<?xml version="1.0"?>'
+        '<s:Envelope xmlns:s="http://schemas.xmlsoap.org/soap/envelope/" '
+        's:encodingStyle="http://schemas.xmlsoap.org/soap/encoding/">'
+        f"<s:Body><u:{action} xmlns:u=\"{gw.service_type}\">{body_xml}"
+        f"</u:{action}></s:Body></s:Envelope>"
+    ).encode()
+    req = urllib.request.Request(
+        gw.control_url,
+        data=envelope,
+        headers={
+            "Content-Type": 'text/xml; charset="utf-8"',
+            "SOAPAction": f'"{gw.service_type}#{action}"',
+        },
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.read().decode("utf-8", "replace")
+    except OSError as e:
+        raise UPnPError(f"SOAP {action} failed: {e}") from e
+
+
+def get_external_address(gw: Gateway) -> str:
+    xml = _soap(gw, "GetExternalIPAddress", "")
+    m = re.search(r"<NewExternalIPAddress>([^<]+)</NewExternalIPAddress>", xml)
+    if not m:
+        raise UPnPError("no external address in response")
+    return m.group(1)
+
+
+def add_port_mapping(
+    gw: Gateway, external_port: int, internal_port: int,
+    protocol: str = "TCP", description: str = "tendermint-tpu", lease: int = 0,
+) -> None:
+    body = (
+        "<NewRemoteHost></NewRemoteHost>"
+        f"<NewExternalPort>{external_port}</NewExternalPort>"
+        f"<NewProtocol>{protocol}</NewProtocol>"
+        f"<NewInternalPort>{internal_port}</NewInternalPort>"
+        f"<NewInternalClient>{gw.local_ip}</NewInternalClient>"
+        "<NewEnabled>1</NewEnabled>"
+        f"<NewPortMappingDescription>{description}</NewPortMappingDescription>"
+        f"<NewLeaseDuration>{lease}</NewLeaseDuration>"
+    )
+    _soap(gw, "AddPortMapping", body)
+
+
+def delete_port_mapping(gw: Gateway, external_port: int, protocol: str = "TCP") -> None:
+    body = (
+        "<NewRemoteHost></NewRemoteHost>"
+        f"<NewExternalPort>{external_port}</NewExternalPort>"
+        f"<NewProtocol>{protocol}</NewProtocol>"
+    )
+    _soap(gw, "DeletePortMapping", body)
+
+
+def probe(timeout: float = 3.0) -> dict:
+    """Reference `tendermint probe_upnp`: capabilities report."""
+    gw = discover(timeout)
+    out = {"gateway": gw.control_url, "local_ip": gw.local_ip}
+    try:
+        out["external_ip"] = get_external_address(gw)
+    except UPnPError as e:
+        out["external_ip_error"] = str(e)
+    return out

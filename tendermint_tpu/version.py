@@ -1,0 +1,8 @@
+"""Version constants (reference version/version.go:23-39)."""
+
+VERSION = "0.1.0"  # framework semver (reference TMCoreSemVer)
+ABCI_SEM_VER = "0.16.1"
+
+# protocol versions: breaking changes to block/p2p semantics bump these
+BLOCK_PROTOCOL = 1
+P2P_PROTOCOL = 1

@@ -186,3 +186,54 @@ class TestArmor:
         lines[body_idx] = ("A" if ln[0] != "A" else "B") + ln[1:]
         with pytest.raises(ArmorError):
             decode_armor("\n".join(lines))
+
+
+class TestXSalsa20:
+    def test_secretbox_vector_and_roundtrip(self):
+        from tendermint_tpu.crypto.xsalsa20symmetric import (
+            DecryptError,
+            decrypt_symmetric,
+            encrypt_symmetric,
+        )
+
+        # libsodium secretbox known-answer vector
+        key = bytes.fromhex(
+            "1b27556473e985d462cd51197a9a46c76009549eac6474f206c4ee0844f68389"
+        )
+        nonce = bytes.fromhex("69696ee955b62b73cd62bda875fc73d68219e0036b7a0b37")
+        msg = bytes.fromhex(
+            "be075fc53c81f2d5cf141316ebeb0c7b5228c52a4c62cbd44b66849b64244ffce5e"
+            "cbaaf33bd751a1ac728d45e6c61296cdc3c01233561f41db66cce314adb310e3be8"
+            "250c46f06dceea3a7fa1348057e2f6556ad6b1318a024a838f21af1fde048977eb4"
+            "8f59ffd4924ca1c60902e52f0a089bc76897040e082f937763848645e0705"
+        )
+        want_ct = bytes.fromhex(
+            "f3ffc7703f9400e52a7dfb4b3d3305d98e993b9f48681273c29650ba32fc76ce483"
+            "32ea7164d96a4476fb8c531a1186ac0dfc17c98dce87b4da7f011ec48c97271d2c2"
+            "0f9b928fe2270d6fb863d51738b48eeee314a7cc8ab932164548e526ae902243685"
+            "17acfeabd6bb3732bc0e9da99832b61ca01b6de56244a9e88d5f9b37973f622a43d"
+            "14a6599b1f654cb45a74e355a5"
+        )
+        box = encrypt_symmetric(msg, key, nonce=nonce)
+        assert box[:24] == nonce and box[24:] == want_ct
+        assert decrypt_symmetric(box, key) == msg
+        with pytest.raises(DecryptError):
+            decrypt_symmetric(box[:30] + bytes([box[30] ^ 1]) + box[31:], key)
+
+    def test_armored_encrypted_key_flow(self):
+        """armor + xsalsa20: the reference's encrypted key export path."""
+        import os as _os
+
+        from tendermint_tpu.crypto.armor import decode_armor, encode_armor
+        from tendermint_tpu.crypto.xsalsa20symmetric import (
+            decrypt_symmetric,
+            encrypt_symmetric,
+        )
+
+        key = _os.urandom(32)
+        secret = b"super secret validator key bytes"
+        armored = encode_armor(
+            "TENDERMINT PRIVATE KEY", {"kdf": "none"}, encrypt_symmetric(secret, key)
+        )
+        _, _, box = decode_armor(armored)
+        assert decrypt_symmetric(box, key) == secret
