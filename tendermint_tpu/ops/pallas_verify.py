@@ -1,0 +1,323 @@
+"""Pallas TPU kernel for batched Ed25519 verification.
+
+Same math as ops/ed25519_batch.verify_kernel (radix-4 joint Straus over
+GF(2^255-19) in 12-bit limbs), but compiled as ONE Mosaic kernel per batch
+tile: the 127-iteration loop, its 16-entry table, and every field
+intermediate stay in VMEM for the whole verification instead of
+round-tripping HBM between XLA fusions. The field primitives here are
+written Mosaic-friendly — carries and limb shifts as concatenations, no
+pads or scatters.
+
+Falls back transparently: ops/__init__ prefers this kernel when pallas
+lowers on the current backend, else the XLA kernel.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tendermint_tpu.ops import curve, field
+from tendermint_tpu.ops.ed25519_batch import NDIGITS, NWORDS, _B_MULT_CACHED, _B_MULT_POINTS
+from tendermint_tpu.ops.limbs import LIMB_BITS, LIMB_MASK, NLIMB
+
+TILE = 128  # batch lanes per program instance
+
+FOLD = field.FOLD
+
+# Pallas kernels cannot capture (or create) non-scalar constants — every
+# curve/field constant is packed into ONE (22, 40) int32 operand, column
+# layout: 0 BIAS | 1 NEGP | 2 2d | 3 one | 4-7 identity(x,y,z,t) |
+# 8-23 [i]B points (4 coords each) | 24-39 [i]B cached forms.
+
+
+def _build_const_cols():
+    import numpy as np
+
+    cols = [field.BIAS, field.NEGP_LIMBS, curve._D2, curve._ONE]
+    cols += list(curve.IDENTITY)
+    for p in _B_MULT_POINTS:
+        cols += list(p)
+    for p in _B_MULT_CACHED:
+        cols += list(p)
+    return np.concatenate([np.asarray(c, dtype=np.int32).reshape(NLIMB, 1) for c in cols], axis=1)
+
+
+CONST_COLS = _build_const_cols()
+_C_BIAS, _C_NEGP, _C_D2, _C_ONE, _C_IDENT, _C_BPTS, _C_BCACHED = 0, 1, 2, 3, 4, 8, 24
+
+# set per-trace by the kernel body (tracing is single-threaded)
+_CST = None
+
+
+def _col(j):
+    return _CST[:, j:j + 1]
+
+
+# ------------------------------------------------------------- field (tile)
+
+
+def _carry(c):
+    """One carry pass with top fold (concat form of field.carry_pass)."""
+    cc = c >> LIMB_BITS
+    lo = c & LIMB_MASK
+    return lo + jnp.concatenate([cc[-1:] * FOLD, cc[:-1]], axis=0)
+
+
+def fmul(a, b):
+    """(22,T) x (22,T) -> (22,T), class-R out (mirrors field.mul)."""
+    rows = []
+    for k in range(2 * NLIMB - 1):
+        acc = None
+        for i in range(max(0, k - NLIMB + 1), min(NLIMB - 1, k) + 1):
+            t = a[i:i + 1] * b[k - i:k - i + 1]
+            acc = t if acc is None else acc + t
+        rows.append(acc)
+    c = jnp.concatenate(rows, axis=0)  # (43, T)
+    zero1 = jnp.zeros_like(c[0:1])
+    for _ in range(2):
+        cc = c >> LIMB_BITS
+        lo = c & LIMB_MASK
+        lo = lo + jnp.concatenate([zero1, cc[:-1]], axis=0)
+        # keep the top row lossless (no fold during wide passes)
+        c = jnp.concatenate([lo[:-1], lo[-1:] + (cc[-1:] << LIMB_BITS)], axis=0)
+    hi = jnp.concatenate([c[NLIMB:], zero1], axis=0)  # (22, T)
+    d = c[:NLIMB] + FOLD * hi
+    for _ in range(4):
+        d = _carry(d)
+    return d
+
+
+def fsq(a):
+    return fmul(a, a)
+
+
+def fadd(a, b):
+    return _carry(a + b)
+
+
+def fsub(a, b):
+    return _carry(a + (_col(_C_BIAS) - b))
+
+
+def fsel(cond, a, b):
+    """cond (1,T) int32 -> select between (22,T) arrays."""
+    return jnp.where(cond != 0, a, b)
+
+
+def _pow2k(a, k):
+    return jax.lax.fori_loop(0, k, lambda _, x: fsq(x), a)
+
+
+def finv(a):
+    t0 = fsq(a)
+    t1 = fsq(fsq(t0))
+    t1 = fmul(a, t1)
+    t0 = fmul(t0, t1)
+    t2 = fsq(t0)
+    t1 = fmul(t1, t2)
+    t2 = _pow2k(t1, 5); t1 = fmul(t2, t1)
+    t2 = _pow2k(t1, 10); t2 = fmul(t2, t1)
+    t3 = _pow2k(t2, 20); t2 = fmul(t3, t2)
+    t2 = _pow2k(t2, 10); t1 = fmul(t2, t1)
+    t2 = _pow2k(t1, 50); t2 = fmul(t2, t1)
+    t3 = _pow2k(t2, 100); t2 = fmul(t3, t2)
+    t2 = _pow2k(t2, 50); t1 = fmul(t2, t1)
+    t1 = _pow2k(t1, 5)
+    return fmul(t1, t0)
+
+
+def _seq_carry(a, topfold: bool):
+    for k in range(NLIMB - 1):
+        cc = a[k:k + 1] >> LIMB_BITS
+        a = jnp.concatenate(
+            [a[:k], a[k:k + 1] & LIMB_MASK, a[k + 1:k + 2] + cc, a[k + 2:]], axis=0
+        )
+    if topfold:
+        cc = a[-1:] >> LIMB_BITS
+        a = jnp.concatenate([a[:1] + cc * FOLD, a[1:-1], a[-1:] & LIMB_MASK], axis=0)
+    return a
+
+
+def fcanon(a):
+    """Exact canonical digits (mirrors field.canonicalize)."""
+    a = _carry(_carry(a))
+    a = _seq_carry(a, True)
+    a = _seq_carry(a, True)
+    for _ in range(2):
+        hi = a[-1:] >> 3
+        a = jnp.concatenate([a[:1] + hi * 19, a[1:-1], a[-1:] & 0x7], axis=0)
+        a = _seq_carry(a, False)
+    t = a + _col(_C_NEGP)
+    for k in range(NLIMB - 1):
+        cc = t[k:k + 1] >> LIMB_BITS
+        t = jnp.concatenate(
+            [t[:k], t[k:k + 1] & LIMB_MASK, t[k + 1:k + 2] + cc, t[k + 2:]], axis=0
+        )
+    overflow = t[-1:] >> LIMB_BITS
+    t = jnp.concatenate([t[:-1], t[-1:] & LIMB_MASK], axis=0)
+    return jnp.where(overflow > 0, t, a)
+
+
+# ------------------------------------------------------------- curve (tile)
+
+def to_cached(p):
+    x, y, z, t = p
+    d2 = jnp.broadcast_to(_col(_C_D2), t.shape)
+    return (fsub(y, x), fadd(y, x), fmul(t, d2), fadd(z, z))
+
+
+def add_cached(p, q):
+    x, y, z, t = p
+    ymx, ypx, t2d, z2 = q
+    a = fmul(fsub(y, x), ymx)
+    b = fmul(fadd(y, x), ypx)
+    c = fmul(t, t2d)
+    d = fmul(z, z2)
+    e = fsub(b, a)
+    f = fsub(d, c)
+    g = fadd(d, c)
+    h = fadd(b, a)
+    return (fmul(e, f), fmul(g, h), fmul(f, g), fmul(e, h))
+
+
+def pdouble(p):
+    x, y, z, _ = p
+    a = fsq(x)
+    b = fsq(y)
+    zz = fsq(z)
+    c = fadd(zz, zz)
+    h = fadd(a, b)
+    e = fsub(h, fsq(fadd(x, y)))
+    g = fsub(a, b)
+    f = fadd(c, g)
+    return (fmul(e, f), fmul(g, h), fmul(f, g), fmul(e, h))
+
+
+def csel(cond, a, b):
+    return tuple(fsel(cond, x, y) for x, y in zip(a, b))
+
+
+def _sel2(b0, b1, e0, e1, e2, e3):
+    lo = csel(b0, e1, e0)
+    hi = csel(b0, e3, e2)
+    return csel(b1, hi, lo)
+
+
+# ------------------------------------------------------------- the kernel
+
+
+def _words_to_limbs(w):
+    """(8, T) int32 -> (22, T); int32 shifts are fine (words are reassembled
+    from non-negative 12-bit fields; the sign bit only affects limb 21's
+    garbage bits above the mask)."""
+    uw = w.astype(jnp.uint32)
+    limbs = []
+    for k in range(NLIMB):
+        lo_bit = LIMB_BITS * k
+        a, s = lo_bit // 32, lo_bit % 32
+        v = uw[a:a + 1] >> s
+        if s > 32 - LIMB_BITS and a + 1 < NWORDS:
+            v = v | (uw[a + 1:a + 2] << (32 - s))
+        limbs.append((v & LIMB_MASK).astype(jnp.int32))
+    return jnp.concatenate(limbs, axis=0)
+
+
+def _words_to_digits(w):
+    uw = w.astype(jnp.uint32)
+    rows = [
+        ((uw[i // 16:i // 16 + 1] >> (2 * (i % 16))) & 3).astype(jnp.int32)
+        for i in range(NDIGITS)
+    ]
+    return jnp.concatenate(rows, axis=0)  # (127, T)
+
+
+def _bcol(j, t):
+    return jnp.broadcast_to(_col(j), (NLIMB, t))
+
+
+def _verify_tile_kernel(cst_ref, ax_ref, ay_ref, at_ref, s_ref, h_ref, yr_ref, par_ref, out_ref):
+    global _CST
+    _CST = cst_ref[:]
+    t = ax_ref.shape[1]
+    one = _bcol(_C_ONE, t)
+    neg_a = (_words_to_limbs(ax_ref[:]), _words_to_limbs(ay_ref[:]), one,
+             _words_to_limbs(at_ref[:]))
+    s_digits = _words_to_digits(s_ref[:])
+    h_digits = _words_to_digits(h_ref[:])
+
+    # 16-entry table [i]B + [j](-A)
+    b_pts = [
+        tuple(_bcol(_C_BPTS + 4 * i + j, t) for j in range(4)) for i in range(4)
+    ]
+    b_cached = [
+        tuple(_bcol(_C_BCACHED + 4 * i + j, t) for j in range(4)) for i in range(4)
+    ]
+    ca1 = to_cached(neg_a)
+    a2 = pdouble(neg_a)
+    a3 = add_cached(a2, ca1)
+    a_pts = [None, neg_a, a2, a3]
+    table = []
+    for s2 in range(4):
+        for h2 in range(4):
+            if h2 == 0:
+                table.append(b_cached[s2])
+            elif s2 == 0:
+                table.append(to_cached(a_pts[h2]))
+            else:
+                table.append(to_cached(add_cached(a_pts[h2], b_cached[s2])))
+
+    p0 = tuple(_bcol(_C_IDENT + j, t) for j in range(4))
+
+    def body(i, p):
+        d = NDIGITS - 1 - i
+        sd = jax.lax.dynamic_slice_in_dim(s_digits, d, 1, axis=0)
+        hd = jax.lax.dynamic_slice_in_dim(h_digits, d, 1, axis=0)
+        s0, s1 = sd & 1, sd >> 1
+        h0, h1 = hd & 1, hd >> 1
+        rows = [
+            _sel2(h0, h1, table[4 * s2 + 0], table[4 * s2 + 1],
+                  table[4 * s2 + 2], table[4 * s2 + 3])
+            for s2 in range(4)
+        ]
+        entry = _sel2(s0, s1, rows[0], rows[1], rows[2], rows[3])
+        return add_cached(pdouble(pdouble(p)), entry)
+
+    rp = jax.lax.fori_loop(0, NDIGITS, body, p0)
+
+    x, y, z, _ = rp
+    zi = finv(z)
+    xa = fcanon(fmul(x, zi))
+    ya = fcanon(fmul(y, zi))
+    y_r = fcanon(_words_to_limbs(yr_ref[:]))
+    y_eq = jnp.all(ya == y_r, axis=0, keepdims=True)
+    par_ok = (xa[0:1] & 1) == par_ref[:]
+    out_ref[:] = (y_eq & par_ok).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=())
+def pallas_verify_kernel(a_x_w, a_y_w, a_t_w, s_w, h_w, yr_w, x_parity):
+    """Drop-in for ed25519_batch.verify_kernel: same inputs, (B,) bool out.
+    B must be a multiple of TILE (prepare_batch buckets guarantee it for
+    min_bucket >= TILE)."""
+    b = s_w.shape[1]
+    assert b % TILE == 0, f"batch {b} not a multiple of {TILE}"
+    grid = (b // TILE,)
+    cst_spec = pl.BlockSpec((NLIMB, CONST_COLS.shape[1]), lambda i: (0, 0))
+    word_spec = pl.BlockSpec((NWORDS, TILE), lambda i: (0, i))
+    row_spec = pl.BlockSpec((1, TILE), lambda i: (0, i))
+    out = pl.pallas_call(
+        _verify_tile_kernel,
+        grid=grid,
+        in_specs=[cst_spec] + [word_spec] * 6 + [row_spec],
+        out_specs=row_spec,
+        out_shape=jax.ShapeDtypeStruct((1, b), jnp.int32),
+    )(
+        jnp.asarray(CONST_COLS),
+        a_x_w, a_y_w, a_t_w, s_w, h_w, yr_w,
+        x_parity.reshape(1, -1).astype(jnp.int32),
+    )
+    return out.reshape(-1) != 0
