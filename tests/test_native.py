@@ -139,3 +139,63 @@ class TestBackendRegistration:
                     batch.clear_backend(kt)
                 else:
                     batch.register_backend(kt, prev)
+
+
+class TestNativePrepareBatch:
+    """tm_ed25519_prepare_batch must agree bit-for-bit with the Python prep
+    loop in ops/ed25519_batch (same structural-check semantics, same device
+    wire format)."""
+
+    def test_parity_with_python_prep(self):
+        import numpy as np
+
+        from tendermint_tpu.ops import ed25519_batch as eb
+        from tendermint_tpu.utils import make_sig_batch
+
+        pubs, msgs, sigs = make_sig_batch(64, msg_prefix=b"prep parity ")
+        # structural rejects: S >= L, non-canonical R, bad pub, bad lengths
+        sigs[3] = sigs[3][:32] + b"\xff" * 32
+        sigs[5] = b"\xff" * 32 + sigs[5][32:]
+        pubs[7] = b"\x01" * 32
+        pubs[9] = b"\x00" * 31
+        sigs[11] = b"\x00" * 10
+        msgs[13] = msgs[13] + b"longer message " * 100
+
+        n = len(pubs)
+        padded = eb._pad_to_bucket(n)
+        prepped = native.ed25519_prepare_device_inputs(pubs, msgs, sigs, padded)
+        assert prepped is not None
+        inp_nat, mask_nat = prepped
+
+        # force the pure-Python path for the oracle
+        import tendermint_tpu.crypto.native as natmod
+
+        orig = natmod.ed25519_prepare_device_inputs
+        natmod.ed25519_prepare_device_inputs = lambda *a: None
+        try:
+            inp_py, mask_py = eb.prepare_batch(pubs, msgs, sigs)
+        finally:
+            natmod.ed25519_prepare_device_inputs = orig
+
+        assert (mask_nat == mask_py).all()
+        assert mask_nat.sum() == n - 4  # msgs[13] edit keeps structure valid
+        for k in inp_py:
+            a, b = np.asarray(inp_py[k]), np.asarray(inp_nat[k])
+            assert a.shape == b.shape and a.dtype == b.dtype, k
+            if k == "x_parity":
+                assert (a[:n][mask_nat] == b[:n][mask_nat]).all(), k
+            else:
+                assert (a[:, :n][:, mask_nat] == b[:, :n][:, mask_nat]).all(), k
+
+    def test_prepared_batch_verifies(self):
+        """End-to-end: native prep feeding the XLA kernel gives the same
+        verdicts as the serial OpenSSL path."""
+        from tendermint_tpu.ops import ed25519_batch as eb
+        from tendermint_tpu.utils import make_sig_batch
+
+        pubs, msgs, sigs = make_sig_batch(16, msg_prefix=b"prep e2e ")
+        sigs[4] = sigs[4][:63] + bytes([sigs[4][63] ^ 1])  # valid shape, bad sig
+        sigs[6] = sigs[6][:32] + b"\xff" * 32              # S >= L
+        expected = [True] * 16
+        expected[4] = expected[6] = False
+        assert eb.verify_batch(pubs, msgs, sigs) == expected
