@@ -16,6 +16,7 @@
 #include <thread>
 #include <unordered_map>
 #include <vector>
+#include <ctime>
 #include <dlfcn.h>
 #include "sha2.h"
 
@@ -583,11 +584,31 @@ void sha512_oneshot(const uint8_t* data, size_t len, uint8_t out[64]) {
     }
 }
 
+// Keyed mix over all 32 bytes: pubkey bytes are attacker-chosen (invalid
+// keys are cached too), so an unkeyed/truncated hash would let a peer
+// collide every cache entry into one chain (hash-flooding DoS).
+inline uint64_t pub_hash_seed() {
+    static const uint64_t seed = [] {
+        uint64_t s = 0x243F6A8885A308D3ull;  // fallback: pi digits
+        timespec t;
+        if (clock_gettime(CLOCK_MONOTONIC, &t) == 0)
+            s ^= ((uint64_t)t.tv_sec << 32) ^ (uint64_t)t.tv_nsec;
+        s ^= (uint64_t)(uintptr_t)&s;  // ASLR entropy
+        return s;
+    }();
+    return seed;
+}
+
 struct PubHash {
     size_t operator()(const std::array<uint8_t, 32>& k) const {
-        uint64_t v;
-        memcpy(&v, k.data(), 8);  // pubkeys are uniformly random
-        return (size_t)v;
+        uint64_t h = pub_hash_seed();
+        for (int i = 0; i < 4; i++) {
+            uint64_t w;
+            memcpy(&w, k.data() + 8 * i, 8);
+            h = (h ^ w) * 0x9E3779B97F4A7C15ull;  // splitmix64-style round
+            h ^= h >> 29;
+        }
+        return (size_t)h;
     }
 };
 
@@ -606,7 +627,8 @@ struct PubCache {
     bool get(const uint8_t pub[32], uint8_t out[96]) {
         std::array<uint8_t, 32> key;
         memcpy(key.data(), pub, 32);
-        PubCacheShard& sh = shards[pub[0] & (NSHARD - 1)];
+        // shard by the keyed hash, not raw bytes: pub[0] is attacker-chosen
+        PubCacheShard& sh = shards[PubHash{}(key) & (NSHARD - 1)];
         {
             std::lock_guard<std::mutex> g(sh.mtx);
             auto it = sh.map.find(key);

@@ -102,6 +102,20 @@ class Node(BaseService):
             import tendermint_tpu.ops  # noqa: F401
         except Exception as e:  # no jax / no device: pure-python still works
             log.info("TPU batch backend unavailable", err=repr(e))
+        else:
+            # Pre-compile the verify kernel for the buckets this node will
+            # actually hit (the singleton-gossip bucket and the bucket of
+            # its validator-set size) so the first commit pays no compile
+            # wait; a warm kcache makes this near-instant.
+            try:
+                from tendermint_tpu.ops import ed25519_batch, kcache
+
+                n_vals = len(self.genesis_doc.validators) or 1
+                kcache.prewarm(
+                    buckets={128, ed25519_batch._pad_to_bucket(n_vals)}
+                )
+            except Exception as e:  # noqa: BLE001
+                log.info("kernel prewarm skipped", err=repr(e))
         try:
             from tendermint_tpu.crypto import native
 

@@ -322,9 +322,24 @@ def prepare_batch(pubs, msgs, sigs, min_bucket: int = 128):
 
 
 def verify_batch(pubs, msgs, sigs) -> list[bool]:
-    """Full batched verification: host prep + one device launch."""
+    """Full batched verification: host prep + one device launch per chunk.
+
+    Batches above kcache.MAX_BUCKET are verified in chunks so the set of
+    compiled kernel variants stays bounded; the per-bucket callable comes
+    from kcache (export-blob fast path or the module jit kernel).
+    """
+    from tendermint_tpu.ops import kcache
+
+    n = len(pubs)
+    if n > kcache.MAX_BUCKET:
+        out: list[bool] = []
+        for lo in range(0, n, kcache.MAX_BUCKET):
+            hi = lo + kcache.MAX_BUCKET
+            out.extend(verify_batch(pubs[lo:hi], msgs[lo:hi], sigs[lo:hi]))
+        return out
     inputs, mask = prepare_batch(pubs, msgs, sigs)
     if inputs is None:
         return mask.tolist()
-    ok = np.asarray(verify_kernel(**inputs))[: len(pubs)]
+    fn = kcache.get_verify_fn(inputs["s_w"].shape[1])
+    ok = np.asarray(fn(**inputs))[:n]
     return (ok & mask).tolist()

@@ -1,0 +1,78 @@
+"""Kernel start-time cache (ops/kcache): export-blob roundtrip, bucket
+capping/chunking, and cache-dir wiring. Runs on the virtual CPU mesh."""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.ops import ed25519_batch as eb
+from tendermint_tpu.ops import kcache
+from tendermint_tpu.utils import make_sig_batch
+
+
+@pytest.fixture()
+def tmp_cache_dir(tmp_path, monkeypatch):
+    d = str(tmp_path / "kc")
+    monkeypatch.setattr(kcache, "_CACHE_DIR", d)
+    monkeypatch.setattr(kcache, "_fns", {})
+    monkeypatch.setattr(kcache, "_exports_scheduled", set())
+    return d
+
+
+def _join_export_threads(timeout=60):
+    for t in threading.enumerate():
+        if t.name.startswith("tmtpu-export"):
+            t.join(timeout)
+
+
+class TestKCache:
+    def test_verify_fn_works_and_writes_blob(self, tmp_cache_dir):
+        pubs, msgs, sigs = make_sig_batch(8, msg_prefix=b"kcache ")
+        out = eb.verify_batch(pubs, msgs, sigs)
+        assert out == [True] * 8
+        _join_export_threads()
+        blob_dir = os.path.join(tmp_cache_dir, "export")
+        assert os.path.isdir(blob_dir) and os.listdir(blob_dir)
+
+    def test_blob_reload_path(self, tmp_cache_dir):
+        pubs, msgs, sigs = make_sig_batch(8, msg_prefix=b"kcache2 ")
+        assert eb.verify_batch(pubs, msgs, sigs) == [True] * 8
+        _join_export_threads()
+        # simulate a fresh process: drop in-memory fns, keep the blob
+        kcache._fns.clear()
+        kcache._exports_scheduled.clear()
+        fn = kcache.get_verify_fn(128)
+        inputs, mask = eb.prepare_batch(pubs, msgs, sigs)
+        ok = np.asarray(fn(**inputs))[:8]
+        assert ok.all() and mask.all()
+
+    def test_corrupt_blob_falls_back(self, tmp_cache_dir):
+        platform = kcache._platform()
+        path = kcache._blob_path(platform, 128)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(b"not a jax export blob")
+        # pre-claim the export slot so no background re-export races the
+        # "blob removed" assertion below
+        kcache._exports_scheduled.add((platform, 128))
+        pubs, msgs, sigs = make_sig_batch(8, msg_prefix=b"kcache3 ")
+        assert eb.verify_batch(pubs, msgs, sigs) == [True] * 8
+        assert not os.path.exists(path)  # corrupt blob removed
+
+    def test_version_hash_in_blob_name(self, tmp_cache_dir):
+        p = kcache._blob_path("cpu", 256)
+        assert kcache._source_version() in p and "_256_" in p
+
+    def test_oversize_batch_chunks(self, tmp_cache_dir, monkeypatch):
+        monkeypatch.setattr(kcache, "MAX_BUCKET", 16)
+        pubs, msgs, sigs = make_sig_batch(40, msg_prefix=b"chunk ")
+        sigs[17] = sigs[17][:63] + bytes([sigs[17][63] ^ 1])
+        out = eb.verify_batch(pubs, msgs, sigs)
+        expected = [True] * 40
+        expected[17] = False
+        assert out == expected
+
+    def test_prewarm_foreground(self, tmp_cache_dir):
+        assert kcache.prewarm(buckets=(128,), background=False) is None
+        assert (kcache._platform(), 128) in kcache._fns
