@@ -1,0 +1,73 @@
+"""Head-to-head device benchmark: XLA verify kernel vs Pallas verify kernel.
+
+Usage: python benchmarks/kernel_compare.py [batch ...]
+Prints per-kernel wall times (fresh device_put + launch + fetch, the honest
+pipeline number bench.py uses) and agreement check.
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    from tendermint_tpu.ops import ed25519_batch, kcache
+    from tendermint_tpu.utils import make_sig_batch
+
+    kcache.enable_persistent_cache()
+    batches = [int(a) for a in sys.argv[1:]] or [1024, 10240]
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} ({dev.device_kind})", flush=True)
+
+    n_unique = 512
+    pubs, msgs, sigs = make_sig_batch(n_unique, msg_prefix=b"kcmp ")
+    for n in batches:
+        reps = -(-n // n_unique)
+        p = (pubs * reps)[:n]
+        m = (msgs * reps)[:n]
+        s = (sigs * reps)[:n]
+        # flip one signature bad so agreement check is non-trivial
+        s[1] = bytes([s[1][0] ^ 1]) + s[1][1:]
+        inputs, mask = ed25519_batch.prepare_batch(p, m, s)
+        assert inputs is not None
+
+        kernels = {"xla": ed25519_batch.verify_kernel}
+        try:
+            from tendermint_tpu.ops import pallas_verify
+
+            kernels["pallas"] = pallas_verify.pallas_verify_kernel
+        except Exception as e:  # noqa: BLE001
+            print(f"pallas import failed: {e!r}")
+
+        outs = {}
+        for name, fn in kernels.items():
+            try:
+                t0 = time.perf_counter()
+                placed = {k: jax.device_put(v, dev) for k, v in inputs.items()}
+                out = np.asarray(fn(**placed))
+                compile_s = time.perf_counter() - t0
+                iters = 5
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    placed = {k: jax.device_put(v, dev) for k, v in inputs.items()}
+                    out = np.asarray(fn(**placed))
+                dt = (time.perf_counter() - t0) / iters
+                outs[name] = out
+                print(
+                    f"B={n:6d} {name:7s} {dt * 1e3:9.2f} ms "
+                    f"({n / dt:>12,.0f} sigs/s)  [first: {compile_s:.1f}s]",
+                    flush=True,
+                )
+            except Exception as e:  # noqa: BLE001
+                print(f"B={n:6d} {name:7s} FAILED: {e!r}"[:500], flush=True)
+        if len(outs) == 2:
+            a, b = outs["xla"][:n], outs["pallas"][:n]
+            print(f"  agree: {bool((a == b).all())}  (valid: {int(a.sum())}/{n})")
+
+
+if __name__ == "__main__":
+    main()

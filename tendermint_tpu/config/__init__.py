@@ -97,6 +97,11 @@ class ConsensusConfig:
     create_empty_blocks_interval: float = 0.0
     peer_gossip_sleep_duration: float = 0.1
     peer_query_maj23_sleep_duration: float = 2.0
+    # vote micro-batching (SURVEY §7 hard part b): when a gossip burst is in
+    # flight, wait up to this long for more votes so one device batch
+    # verifies them all; 0 disables the wait (singletons never wait).
+    vote_batch_window: float = 0.0015
+    vote_batch_cap: int = 4096
 
     def propose_timeout(self, round_: int) -> float:
         return self.timeout_propose + self.timeout_propose_delta * round_

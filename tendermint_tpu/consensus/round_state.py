@@ -73,7 +73,11 @@ class HeightVoteSet:
         self.round = round_
 
     def add_vote(self, vote: Vote, peer_id: str = "") -> bool:
-        """Reference height_vote_set.go:111 AddVote."""
+        """Reference height_vote_set.go:111 AddVote. (The gossip
+        micro-batcher does NOT route through here: it targets existing
+        rounds via prevotes()/precommits() and falls back to this serial
+        path when a vote names a round we have not created, so the per-peer
+        catchup bounding below charges each vote's own peer.)"""
         if vote.round not in self._sets:
             rounds = self._peer_catchup_rounds.setdefault(peer_id, [])
             if len(rounds) >= self.MAX_PEER_CATCHUP_ROUNDS:

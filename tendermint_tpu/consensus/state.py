@@ -212,9 +212,7 @@ class ConsensusState(BaseService):
                     self.wal.write_sync(mi)  # our own msgs: fsync (:635)
                     await self.handle_msg(mi)
                 if peer_get in done:
-                    mi = peer_get.result()
-                    self.wal.write(mi)  # peer msgs: async write (:630)
-                    await self.handle_msg(mi)
+                    await self._handle_peer_batch(peer_get.result())
                 if tock_get in done:
                     ti = tock_get.result()
                     self.wal.write(
@@ -244,6 +242,74 @@ class ConsensusState(BaseService):
             await self.try_add_vote(msg.vote, peer_id)
         else:
             self.log.error("unknown consensus message", msg=type(msg).__name__)
+
+    def _drain_peer_queue(self, batch: list[MsgInfo]) -> None:
+        cap = self.config.vote_batch_cap
+        while len(batch) < cap:
+            try:
+                batch.append(self.peer_msg_queue.get_nowait())
+            except asyncio.QueueEmpty:
+                return
+
+    async def _handle_peer_batch(self, first: MsgInfo) -> None:
+        """Micro-batch peer messages (SURVEY §7 hard part b): drain the burst
+        already queued; if it contains 2+ votes, wait one short deadline
+        (config.vote_batch_window) for the rest of the burst to land, then
+        process — consecutive votes for the same (H, R, type) go through ONE
+        `VoteSet.add_votes` signature batch. A singleton vote takes the
+        serial path immediately, so small-validator-count latency does not
+        regress. Replaces the reference's strictly per-vote serial verify
+        (types/vote_set.go:189)."""
+        batch = [first]
+        self._drain_peer_queue(batch)
+        window = self.config.vote_batch_window
+        if (
+            window > 0
+            and len(batch) > 1
+            and sum(isinstance(mi.msg, m.VoteMessage) for mi in batch) > 1
+        ):
+            await asyncio.sleep(window)
+            self._drain_peer_queue(batch)
+        # WAL order = arrival order, written before any processing (:630)
+        for mi in batch:
+            self.wal.write(mi)
+        votes: list[MsgInfo] = []
+        for mi in batch:
+            if isinstance(mi.msg, m.VoteMessage):
+                votes.append(mi)
+                continue
+            await self._flush_vote_run(votes)
+            # per-message error isolation, as if each were its own loop turn
+            try:
+                await self.handle_msg(mi)
+            except (ConsensusHalt, asyncio.CancelledError):
+                raise
+            except Exception as e:
+                self.log.error("consensus error", err=repr(e))
+        await self._flush_vote_run(votes)
+
+    async def _flush_vote_run(self, votes: list[MsgInfo]) -> None:
+        """Group a run of consecutive VoteMessages by (height, round, type)
+        and bulk-add each group; preserves arrival order within and across
+        groups as far as the (commutative) VoteSet tally is concerned.
+        Each group is error-isolated like a serial loop turn would be."""
+        if not votes:
+            return
+        groups: dict[tuple, list[MsgInfo]] = {}
+        for mi in votes:
+            v = mi.msg.vote
+            groups.setdefault((v.height, v.round, int(v.type)), []).append(mi)
+        votes.clear()
+        for group in groups.values():
+            try:
+                if len(group) == 1:
+                    await self.try_add_vote(group[0].msg.vote, group[0].peer_id)
+                else:
+                    await self._try_add_vote_group(group)
+            except (ConsensusHalt, asyncio.CancelledError):
+                raise
+            except Exception as e:
+                self.log.error("consensus error", err=repr(e))
 
     async def handle_timeout(self, ti: TimeoutInfo) -> None:
         """Reference :692 handleTimeout."""
@@ -666,27 +732,101 @@ class ConsensusState(BaseService):
         try:
             return await self.add_vote(vote, peer_id)
         except ConflictingVoteError as e:
-            if self.priv_validator is not None and vote.validator_address == self.priv_validator.address:
-                self.log.error("found conflicting vote from ourselves; did you restart with a stale WAL?")
-                return False
-            _, val = self.rs.validators.get_by_address(vote.validator_address)
-            if val is not None and self.evidence_pool is not None:
-                ev = DuplicateVoteEvidence(val.pub_key, e.existing, e.conflicting)
-                try:
-                    self.evidence_pool.add_evidence(ev)
-                    self.log.info("added evidence for conflicting vote")
-                except Exception as err:
-                    self.log.error("failed to add evidence", err=repr(err))
-            # the equivocating vote may still have been tallied under a
-            # peer-claimed maj23 block (vote_set peer_maj23 tracking) and
-            # pushed that block over 2/3 — re-run the step transitions,
-            # which are guard-idempotent, so the new majority is acted on
-            if vote.height == self.rs.height and self.rs.votes is not None:
-                if vote.type == VoteType.PRECOMMIT:
-                    await self._on_precommit_added(vote)
-                else:
-                    await self._on_prevote_added(vote)
+            await self._handle_conflicting_vote(vote, e)
             return False
+
+    async def _handle_conflicting_vote(self, vote: Vote, e: ConflictingVoteError) -> None:
+        if self.priv_validator is not None and vote.validator_address == self.priv_validator.address:
+            self.log.error("found conflicting vote from ourselves; did you restart with a stale WAL?")
+            return
+        _, val = self.rs.validators.get_by_address(vote.validator_address)
+        if val is not None and self.evidence_pool is not None:
+            ev = DuplicateVoteEvidence(val.pub_key, e.existing, e.conflicting)
+            try:
+                self.evidence_pool.add_evidence(ev)
+                self.log.info("added evidence for conflicting vote")
+            except Exception as err:
+                self.log.error("failed to add evidence", err=repr(err))
+        # the equivocating vote may still have been tallied under a
+        # peer-claimed maj23 block (vote_set peer_maj23 tracking) and
+        # pushed that block over 2/3 — re-run the step transitions,
+        # which are guard-idempotent, so the new majority is acted on
+        if vote.height == self.rs.height and self.rs.votes is not None:
+            if vote.type == VoteType.PRECOMMIT:
+                await self._on_precommit_added(vote)
+            else:
+                await self._on_prevote_added(vote)
+
+    async def _try_add_vote_group(self, group: list[MsgInfo]) -> None:
+        """Bulk ingest of a gossip burst sharing one (height, round, type):
+        one `add_votes` call = one batched signature verification, then the
+        exact per-vote side effects (events, evidence, step transitions) a
+        serial add_vote sequence would have produced."""
+        rs = self.rs
+        votes = [mi.msg.vote for mi in group]
+        v0 = votes[0]
+        # precommits for the previous height (LastCommit catch-up, :1545)
+        if v0.height + 1 == rs.height and v0.type == VoteType.PRECOMMIT:
+            if rs.step != RoundStep.NEW_HEIGHT or rs.last_commit is None:
+                return
+            errors: list = []
+            added = rs.last_commit.add_votes(votes, errors=errors)
+            for vote, ok, err in zip(votes, added, errors):
+                if isinstance(err, ConflictingVoteError):
+                    # last-height equivocation still becomes evidence
+                    await self._handle_conflicting_vote(vote, err)
+                    continue
+                if err is not None:
+                    self.log.error("consensus error", err=repr(err))
+                if not ok:
+                    continue
+                self.log.debug("added vote to LastCommit")
+                if self.event_bus:
+                    await self.event_bus.publish_vote(vote)
+                self.event_switch.fire_event("vote", vote)
+            if any(added) and self.config.skip_timeout_commit and rs.last_commit.has_all():
+                await self.enter_new_round(rs.height, 0)
+            return
+        if v0.height != rs.height:
+            return
+        # route the whole group to one VoteSet. A round we have not created
+        # yet is the rare catchup case — take the serial path so the
+        # per-peer catchup-round accounting charges each vote's own peer
+        # (height_vote_set.go:111), not the group leader.
+        vs = (
+            rs.votes.prevotes(v0.round)
+            if v0.type == VoteType.PREVOTE
+            else rs.votes.precommits(v0.round)
+        )
+        if vs is None:
+            for mi in group:
+                await self.try_add_vote(mi.msg.vote, mi.peer_id)
+            return
+        errors = []
+        added = vs.add_votes(votes, errors=errors)
+        for vote, ok, err in zip(votes, added, errors):
+            if self.rs.height != v0.height:
+                # a vote earlier in this group completed a commit and moved
+                # us to the next height: the remaining votes are stale, and
+                # a serial add_vote would have dropped them here too
+                break
+            if ok:
+                await self._post_add_vote(vote)
+            elif isinstance(err, ConflictingVoteError):
+                await self._handle_conflicting_vote(vote, err)
+            elif err is not None:
+                # same visibility a serial add_vote raise would have had
+                self.log.error("consensus error", err=repr(err))
+
+    async def _post_add_vote(self, vote: Vote) -> None:
+        """Events + step transitions after a vote lands (reference :1582)."""
+        if self.event_bus:
+            await self.event_bus.publish_vote(vote)
+        self.event_switch.fire_event("vote", vote)
+        if vote.type == VoteType.PREVOTE:
+            await self._on_prevote_added(vote)
+        else:
+            await self._on_precommit_added(vote)
 
     async def add_vote(self, vote: Vote, peer_id: str) -> bool:
         """Reference :1534 addVote."""
@@ -710,14 +850,7 @@ class ConsensusState(BaseService):
         added = rs.votes.add_vote(vote, peer_id)
         if not added:
             return False
-        if self.event_bus:
-            await self.event_bus.publish_vote(vote)
-        self.event_switch.fire_event("vote", vote)
-
-        if vote.type == VoteType.PREVOTE:
-            await self._on_prevote_added(vote)
-        else:
-            await self._on_precommit_added(vote)
+        await self._post_add_vote(vote)
         return True
 
     async def _on_prevote_added(self, vote: Vote) -> None:

@@ -36,6 +36,22 @@ _fns: dict[tuple[str, int], object] = {}  # (platform, bucket) -> callable
 _exports_scheduled: set[tuple[str, int]] = set()
 _enabled = False
 
+# Background threads are non-daemon (daemon threads mid-XLA-compile caused
+# SIGABRTs at interpreter teardown), so interpreter shutdown joins them.
+# This flag bounds that join to at most the in-flight compile: it is set by
+# threading's shutdown hook BEFORE non-daemon threads are joined, and the
+# workers check it between compiles.
+_cancel = threading.Event()
+try:
+    threading._register_atexit(_cancel.set)  # runs before the join
+except Exception:  # noqa: BLE001 — private API (stable since 3.9). The
+    # atexit fallback runs AFTER non-daemon threads are joined, so it does
+    # not bound the exit delay — it only keeps later atexit-ordered cleanup
+    # (e.g. a second interpreter in the same process) from starting work.
+    import atexit
+
+    atexit.register(_cancel.set)
+
 
 def enable_persistent_cache() -> None:
     """Point JAX's compilation cache at our cache dir (idempotent)."""
@@ -121,6 +137,8 @@ def _write_export_blob(platform: str, bucket: int) -> None:
 
     path = _blob_path(platform, bucket)
     try:
+        if _cancel.is_set():
+            return
         exp = jax.export.export(ed25519_batch.verify_kernel)(
             **_input_shapes(bucket)
         )
@@ -134,6 +152,8 @@ def _write_export_blob(platform: str, bucket: int) -> None:
         # in-process jit path; run the artifact once now (still background)
         # so the export-keyed binary lands in the persistent cache and the
         # NEXT process skips both the trace and the compile.
+        if _cancel.is_set():
+            return
         import numpy as np
 
         reloaded = jax.export.deserialize(blob)
@@ -188,10 +208,14 @@ def get_verify_fn(bucket: int):
                 first = key not in _exports_scheduled
                 _exports_scheduled.add(key)
             if first:
+                # Non-daemon: interpreter shutdown joins the thread, so the
+                # process never tears down the XLA runtime mid-compile
+                # (daemon threads here caused SIGABRTs at exit — "FATAL:
+                # exception not rethrown" from the runtime's thread pools).
                 threading.Thread(
                     target=_write_export_blob,
                     args=(platform, bucket),
-                    daemon=True,
+                    daemon=False,
                     name=f"tmtpu-export-{bucket}",
                 ).start()
     if fn is None:
@@ -210,6 +234,8 @@ def prewarm(buckets=(128,), background: bool = True):
 
     def work():
         for b in sorted({min(b, MAX_BUCKET) for b in buckets}):
+            if _cancel.is_set():
+                return
             try:
                 fn = get_verify_fn(b)
                 inputs = {
@@ -221,7 +247,8 @@ def prewarm(buckets=(128,), background: bool = True):
                 pass
 
     if background:
-        t = threading.Thread(target=work, daemon=True, name="tmtpu-prewarm")
+        # Non-daemon for the same reason as the export thread above.
+        t = threading.Thread(target=work, daemon=False, name="tmtpu-prewarm")
         t.start()
         return t
     work()

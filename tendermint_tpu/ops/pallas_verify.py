@@ -68,7 +68,13 @@ def _carry(c):
 
 
 def fmul(a, b):
-    """(22,T) x (22,T) -> (22,T), class-R out (mirrors field.mul)."""
+    """(22,T) x (22,T) -> (22,T), class-R out (mirrors field.mul).
+
+    The accumulator is (44, T) — row 43 exists solely to receive the carry
+    out of row 42 during the wide passes. (A 43-row variant that kept row 42
+    unmasked overflowed int32 at the FOLD multiply for class-R inputs, where
+    limb 21 can reach ~4120: 4120^2 * 9728 > 2^31. Canonical inputs hid the
+    bug because a canonical limb 21 is <= 7.)"""
     rows = []
     for k in range(2 * NLIMB - 1):
         acc = None
@@ -76,16 +82,15 @@ def fmul(a, b):
             t = a[i:i + 1] * b[k - i:k - i + 1]
             acc = t if acc is None else acc + t
         rows.append(acc)
-    c = jnp.concatenate(rows, axis=0)  # (43, T)
-    zero1 = jnp.zeros_like(c[0:1])
+    zero1 = jnp.zeros_like(rows[0])
+    c = jnp.concatenate(rows + [zero1], axis=0)  # (44, T)
     for _ in range(2):
         cc = c >> LIMB_BITS
         lo = c & LIMB_MASK
         lo = lo + jnp.concatenate([zero1, cc[:-1]], axis=0)
-        # keep the top row lossless (no fold during wide passes)
+        # top row accumulates: restore its masked-off high bits
         c = jnp.concatenate([lo[:-1], lo[-1:] + (cc[-1:] << LIMB_BITS)], axis=0)
-    hi = jnp.concatenate([c[NLIMB:], zero1], axis=0)  # (22, T)
-    d = c[:NLIMB] + FOLD * hi
+    d = c[:NLIMB] + FOLD * c[NLIMB:]
     for _ in range(4):
         d = _carry(d)
     return d
@@ -130,15 +135,22 @@ def finv(a):
     return fmul(t1, t0)
 
 
+def _concat_rows(parts):
+    """concatenate, dropping zero-row operands (Mosaic rejects (0, T)
+    vector types that XLA silently folds away)."""
+    parts = [p for p in parts if p.shape[0] > 0]
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+
+
 def _seq_carry(a, topfold: bool):
     for k in range(NLIMB - 1):
         cc = a[k:k + 1] >> LIMB_BITS
-        a = jnp.concatenate(
-            [a[:k], a[k:k + 1] & LIMB_MASK, a[k + 1:k + 2] + cc, a[k + 2:]], axis=0
+        a = _concat_rows(
+            [a[:k], a[k:k + 1] & LIMB_MASK, a[k + 1:k + 2] + cc, a[k + 2:]]
         )
     if topfold:
         cc = a[-1:] >> LIMB_BITS
-        a = jnp.concatenate([a[:1] + cc * FOLD, a[1:-1], a[-1:] & LIMB_MASK], axis=0)
+        a = _concat_rows([a[:1] + cc * FOLD, a[1:-1], a[-1:] & LIMB_MASK])
     return a
 
 
@@ -154,8 +166,8 @@ def fcanon(a):
     t = a + _col(_C_NEGP)
     for k in range(NLIMB - 1):
         cc = t[k:k + 1] >> LIMB_BITS
-        t = jnp.concatenate(
-            [t[:k], t[k:k + 1] & LIMB_MASK, t[k + 1:k + 2] + cc, t[k + 2:]], axis=0
+        t = _concat_rows(
+            [t[:k], t[k:k + 1] & LIMB_MASK, t[k + 1:k + 2] + cc, t[k + 2:]]
         )
     overflow = t[-1:] >> LIMB_BITS
     t = jnp.concatenate([t[:-1], t[-1:] & LIMB_MASK], axis=0)
@@ -211,28 +223,42 @@ def _sel2(b0, b1, e0, e1, e2, e3):
 
 
 def _words_to_limbs(w):
-    """(8, T) int32 -> (22, T); int32 shifts are fine (words are reassembled
-    from non-negative 12-bit fields; the sign bit only affects limb 21's
-    garbage bits above the mask)."""
-    uw = w.astype(jnp.uint32)
+    """(8, T) int32 -> (22, T), all-int32 (Mosaic rejects uint ops): the
+    arithmetic right shift sign-extends, so when the limb straddles a word
+    boundary the low word's field is masked to its true width before OR-ing
+    in the high word's bits."""
     limbs = []
     for k in range(NLIMB):
         lo_bit = LIMB_BITS * k
         a, s = lo_bit // 32, lo_bit % 32
-        v = uw[a:a + 1] >> s
+        v = w[a:a + 1] >> s
         if s > 32 - LIMB_BITS and a + 1 < NWORDS:
-            v = v | (uw[a + 1:a + 2] << (32 - s))
-        limbs.append((v & LIMB_MASK).astype(jnp.int32))
+            v = (v & ((1 << (32 - s)) - 1)) | (w[a + 1:a + 2] << (32 - s))
+        limbs.append(v & LIMB_MASK)
     return jnp.concatenate(limbs, axis=0)
 
 
-def _words_to_digits(w):
-    uw = w.astype(jnp.uint32)
-    rows = [
-        ((uw[i // 16:i // 16 + 1] >> (2 * (i % 16))) & 3).astype(jnp.int32)
-        for i in range(NDIGITS)
-    ]
-    return jnp.concatenate(rows, axis=0)  # (127, T)
+def _word_rows(w):
+    """(8, T) int32 -> list of 8 (1, T) int32 rows (static slices)."""
+    return [w[i:i + 1] for i in range(NWORDS)]
+
+
+def _digit_at(w_rows, d):
+    """2-bit digit d (traced scalar) of scalars packed in 8 int32 rows.
+
+    Mosaic cannot lower a dynamic_slice over a (127, T) digit array inside
+    the loop (the round-1 dead-code failure mode), so the digit is computed
+    arithmetically: one-hot select of the word row (8 static rows, scalar
+    conditions) followed by a variable shift. All int32: the arithmetic
+    shift's sign extension only reaches bits >= 2 even at the maximum shift
+    of 30, and `& 3` discards them.
+    """
+    wi = d // 16
+    sh = 2 * (d % 16)
+    acc = w_rows[0]
+    for k in range(1, NWORDS):
+        acc = jnp.where(wi == k, w_rows[k], acc)
+    return (acc >> sh) & 3
 
 
 def _bcol(j, t):
@@ -240,14 +266,25 @@ def _bcol(j, t):
 
 
 def _verify_tile_kernel(cst_ref, ax_ref, ay_ref, at_ref, s_ref, h_ref, yr_ref, par_ref, out_ref):
+    out_ref[:] = verify_tile(
+        cst_ref[:], ax_ref[:], ay_ref[:], at_ref[:], s_ref[:], h_ref[:],
+        yr_ref[:], par_ref[:],
+    )
+
+
+def verify_tile(cst, ax, ay, at, s, h, yr, par):
+    """The whole per-tile verification as a pure array function: (22, NC)
+    constants + (8, T) word arrays + (1, T) parity -> (1, T) int32 verdicts.
+    The Pallas kernel wraps this with ref loads/stores; tests jit it directly
+    on CPU to validate the math without the (slow) Pallas interpreter."""
     global _CST
-    _CST = cst_ref[:]
-    t = ax_ref.shape[1]
+    _CST = cst
+    t = ax.shape[1]
     one = _bcol(_C_ONE, t)
-    neg_a = (_words_to_limbs(ax_ref[:]), _words_to_limbs(ay_ref[:]), one,
-             _words_to_limbs(at_ref[:]))
-    s_digits = _words_to_digits(s_ref[:])
-    h_digits = _words_to_digits(h_ref[:])
+    neg_a = (_words_to_limbs(ax), _words_to_limbs(ay), one,
+             _words_to_limbs(at))
+    s_rows = _word_rows(s)
+    h_rows = _word_rows(h)
 
     # 16-entry table [i]B + [j](-A)
     b_pts = [
@@ -274,8 +311,8 @@ def _verify_tile_kernel(cst_ref, ax_ref, ay_ref, at_ref, s_ref, h_ref, yr_ref, p
 
     def body(i, p):
         d = NDIGITS - 1 - i
-        sd = jax.lax.dynamic_slice_in_dim(s_digits, d, 1, axis=0)
-        hd = jax.lax.dynamic_slice_in_dim(h_digits, d, 1, axis=0)
+        sd = _digit_at(s_rows, d)
+        hd = _digit_at(h_rows, d)
         s0, s1 = sd & 1, sd >> 1
         h0, h1 = hd & 1, hd >> 1
         rows = [
@@ -292,17 +329,19 @@ def _verify_tile_kernel(cst_ref, ax_ref, ay_ref, at_ref, s_ref, h_ref, yr_ref, p
     zi = finv(z)
     xa = fcanon(fmul(x, zi))
     ya = fcanon(fmul(y, zi))
-    y_r = fcanon(_words_to_limbs(yr_ref[:]))
+    y_r = fcanon(_words_to_limbs(yr))
     y_eq = jnp.all(ya == y_r, axis=0, keepdims=True)
-    par_ok = (xa[0:1] & 1) == par_ref[:]
-    out_ref[:] = (y_eq & par_ok).astype(jnp.int32)
+    par_ok = (xa[0:1] & 1) == par
+    return (y_eq & par_ok).astype(jnp.int32)
 
 
-@partial(jax.jit, static_argnames=())
-def pallas_verify_kernel(a_x_w, a_y_w, a_t_w, s_w, h_w, yr_w, x_parity):
+@partial(jax.jit, static_argnames=("interpret",))
+def pallas_verify_kernel(a_x_w, a_y_w, a_t_w, s_w, h_w, yr_w, x_parity,
+                         interpret: bool = False):
     """Drop-in for ed25519_batch.verify_kernel: same inputs, (B,) bool out.
     B must be a multiple of TILE (prepare_batch buckets guarantee it for
-    min_bucket >= TILE)."""
+    min_bucket >= TILE). interpret=True runs the Pallas interpreter (any
+    backend) — the CPU test path."""
     b = s_w.shape[1]
     assert b % TILE == 0, f"batch {b} not a multiple of {TILE}"
     grid = (b // TILE,)
@@ -315,6 +354,7 @@ def pallas_verify_kernel(a_x_w, a_y_w, a_t_w, s_w, h_w, yr_w, x_parity):
         in_specs=[cst_spec] + [word_spec] * 6 + [row_spec],
         out_specs=row_spec,
         out_shape=jax.ShapeDtypeStruct((1, b), jnp.int32),
+        interpret=interpret,
     )(
         jnp.asarray(CONST_COLS),
         a_x_w, a_y_w, a_t_w, s_w, h_w, yr_w,

@@ -82,18 +82,47 @@ class VoteSet:
         """Single-vote ingest (arrival-driven consensus path)."""
         return self.add_votes([vote])[0]
 
-    def add_votes(self, votes: list[Vote]) -> list[bool]:
+    def add_votes(
+        self, votes: list[Vote], errors: list | None = None
+    ) -> list[bool]:
         """Bulk ingest: structural checks per vote, then ONE signature batch,
-        then application in order. Raises on the first hard error (bad index,
-        conflicting signature from the same validator, invalid signature) —
-        matching the reference's per-vote error semantics."""
+        then application in order.
+
+        With errors=None (the default), raises on the first hard error (bad
+        index, conflicting signature from the same validator, invalid
+        signature) — matching the reference's per-vote error semantics.
+
+        With errors=[] (the gossip micro-batch path), errors never abort the
+        rest of the batch: errors[i] is the exception for votes[i] (or None)
+        and the vote is reported False — each vote gets exactly the outcome
+        it would have gotten through a serial add_vote sequence.
+        """
+        collect = errors is not None
+        if collect:
+            errors.extend([None] * len(votes))
         bv = BatchVerifier()
         checked: list[tuple[Vote, int, Vote | None] | None] = []
-        for vote in votes:
-            prepared = self._precheck(vote)
+        in_batch: set[tuple[int, bytes, bytes]] = set()
+        for i, vote in enumerate(votes):
+            try:
+                prepared = self._precheck(vote)
+            except VoteSetError as e:  # incl. ConflictingVoteError
+                if not collect:
+                    raise
+                errors[i] = e
+                checked.append(None)
+                continue
             if prepared is None:
                 checked.append(None)  # duplicate — no signature work needed
                 continue
+            # gossip delivers the same vote via many peers: copies WITHIN
+            # this batch are invisible to _precheck (application happens
+            # later), so dedup here or each copy burns a verify lane
+            key = (vote.validator_index, vote.block_id.key(), vote.signature)
+            if key in in_batch:
+                checked.append(None)
+                continue
+            in_batch.add(key)
             power, conflict = prepared
             bv.add(
                 self.val_set.validators[vote.validator_index].pub_key,
@@ -103,13 +132,46 @@ class VoteSet:
             checked.append((vote, power, conflict))
         results = iter(bv.verify_all())
         out = []
-        for vote, item in zip(votes, checked):
+        for i, (vote, item) in enumerate(zip(votes, checked)):
             if item is None:
-                out.append(False)  # duplicate
+                out.append(False)  # duplicate or collected precheck error
                 continue
             v, power, conflict = item
             if not next(results):
-                raise VoteSetError(f"invalid signature for {v}")
+                err = VoteSetError(f"invalid signature for {v}")
+                if not collect:
+                    raise err
+                errors[i] = err
+                out.append(False)
+                continue
+            if conflict is None:
+                # re-evaluate against state mutated by EARLIER batch members:
+                # an equivocation wholly inside one burst is invisible to the
+                # precheck pass (application happens after all prechecks)
+                existing = self.votes[v.validator_index]
+                if existing is not None and existing.block_id != v.block_id:
+                    by_block = self.votes_by_block.get(v.block_id.key())
+                    if by_block is None or not by_block.peer_maj23:
+                        err = ConflictingVoteError(existing, v)
+                        if not collect:
+                            raise err
+                        errors[i] = err
+                        out.append(False)
+                        continue
+                    conflict = existing
+                elif (
+                    existing is not None
+                    and existing.signature != v.signature
+                ):
+                    err = VoteSetError(
+                        "non-deterministic signature from the same validator"
+                        " for the same block"
+                    )
+                    if not collect:
+                        raise err
+                    errors[i] = err
+                    out.append(False)
+                    continue
             if conflict is not None:
                 # track under the peer-claimed block — the equivocating vote
                 # still counts toward that block's 2/3 (this is exactly how
@@ -121,7 +183,12 @@ class VoteSet:
                 by_block.add_verified_vote(v, power)
                 if not had:
                     self._maybe_promote_maj23(v.block_id)
-                raise ConflictingVoteError(conflict, v)
+                err = ConflictingVoteError(conflict, v)
+                if not collect:
+                    raise err
+                errors[i] = err
+                out.append(False)
+                continue
             out.append(self._apply_verified(v, power))
         return out
 

@@ -30,7 +30,8 @@ class Fixture:
     """One in-process node (no networking)."""
 
     def __init__(self, root, pvs=None, pv_index=0, app=None, use_wal=True,
-                 state_db=None, block_db=None, app_factory=None):
+                 state_db=None, block_db=None, app_factory=None, start_cs=True):
+        self.start_cs = start_cs
         self.root = root
         self.cfg = make_test_config(root)
         self.pvs = pvs or [MockPV()]
@@ -83,11 +84,13 @@ class Fixture:
             wal=wal,
             event_bus=self.event_bus,
         )
-        await self.cs.start()
+        if self.start_cs:
+            await self.cs.start()
         return self
 
     async def stop(self):
-        await self.cs.stop()
+        if self.start_cs:
+            await self.cs.stop()
         await self.event_bus.stop()
         await self.conns.stop()
         self.cs.wal.close()

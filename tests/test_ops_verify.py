@@ -101,3 +101,109 @@ class TestVerifyBatch:
         bad = ed25519.gen_priv_key()
         bv.add(bad.pub_key(), b"m", b"\x01" * 64)
         assert bv.verify_all() == [True, True, True, False]
+
+
+class TestPallasKernelMath:
+    """Component parity of the Pallas (Mosaic-friendly) field/digit ops vs
+    ops.field — the round-1 dead-code kernel shipped an int32 overflow in
+    fmul that only class-R (weakly-reduced) inputs expose, so these run the
+    primitives on CHAINED values, not fresh canonical ones. The full-tile
+    function is cross-checked against the XLA kernel in
+    test_full_tile_matches_xla (slow compile; still CPU-only here — the
+    Mosaic lowering itself is exercised on real TPU by
+    benchmarks/kernel_compare.py)."""
+
+    def _setup(self):
+        import random
+
+        import jax.numpy as jnp
+
+        from tendermint_tpu.ops import field
+        from tendermint_tpu.ops import pallas_verify as pv
+        from tendermint_tpu.ops.limbs import ints_to_limbs
+
+        pv._CST = jnp.asarray(pv.CONST_COLS)
+        rng = random.Random(11)
+        vals = [rng.randrange(field.P) for _ in range(8)]
+        return pv, field, vals, jnp.asarray(ints_to_limbs(vals))
+
+    def _ints(self, x):
+        from tendermint_tpu.ops import field
+        from tendermint_tpu.ops.limbs import limbs_to_ints
+
+        return [v % field.P for v in limbs_to_ints(np.asarray(x))]
+
+    def test_field_ops_on_chained_inputs(self):
+        pv, field, vals, a = self._setup()
+        x, ref = a, list(vals)
+        for _ in range(8):  # class-R chaining: squarings feed squarings
+            x = pv.fsq(x)
+            ref = [v * v % field.P for v in ref]
+            assert self._ints(x) == ref
+        y = pv.fmul(x, a)
+        assert self._ints(y) == [r * v % field.P for r, v in zip(ref, vals)]
+        assert self._ints(pv.fadd(x, y)) == [
+            (r + s) % field.P for r, s in zip(ref, self._ints(y))
+        ]
+        assert self._ints(pv.fsub(x, y)) == [
+            (r - s) % field.P for r, s in zip(ref, self._ints(y))
+        ]
+        assert self._ints(pv.finv(x)) == [pow(r, field.P - 2, field.P) for r in ref]
+        import numpy as _np
+
+        canon = _np.asarray(pv.fcanon(pv.fmul(x, x)))
+        assert [int(v) for v in self._ints(canon)] == [r * r % field.P for r in ref]
+
+    def test_word_and_digit_extraction(self):
+        import random
+
+        import jax.numpy as jnp
+
+        pv, field, _, _ = self._setup()
+        rng = random.Random(12)
+        vals = [rng.randrange(field.P) for _ in range(8)]
+        w = np.zeros((8, 8), dtype=np.int32)
+        for i, v in enumerate(vals):
+            w[:, i] = np.frombuffer(v.to_bytes(32, "little"), dtype=np.uint32).view(
+                np.int32
+            )
+        from tendermint_tpu.ops.limbs import limbs_to_ints
+
+        assert limbs_to_ints(np.asarray(pv._words_to_limbs(jnp.asarray(w)))) == vals
+        scal = [rng.randrange(2**252) for _ in range(8)]
+        ws = np.zeros((8, 8), dtype=np.int32)
+        for i, v in enumerate(scal):
+            ws[:, i] = np.frombuffer(v.to_bytes(32, "little"), dtype=np.uint32).view(
+                np.int32
+            )
+        ref = np.asarray(ed25519_batch.words_to_digits(jnp.asarray(ws)))
+        rows = pv._word_rows(jnp.asarray(ws))
+        got = np.concatenate(
+            [np.asarray(pv._digit_at(rows, jnp.int32(d))) for d in range(127)], axis=0
+        )
+        assert (got == ref).all()
+
+    @pytest.mark.skipif(
+        not os.environ.get("TMTPU_SLOW_TESTS"),
+        reason="verify_tile XLA-compiles in ~4min on CPU; set TMTPU_SLOW_TESTS=1",
+    )
+    def test_full_tile_matches_xla(self):
+        import jax.numpy as jnp
+
+        from tendermint_tpu.ops import pallas_verify as pv
+
+        pubs, msgs, sigs = _make_sigs(64)
+        pubs, msgs, sigs = pubs * 2, msgs * 2, sigs * 2
+        sigs[1] = bytes([sigs[1][0] ^ 1]) + sigs[1][1:]
+        inputs, mask = ed25519_batch.prepare_batch(pubs, msgs, sigs)
+        ref = np.asarray(ed25519_batch.verify_kernel(**inputs))
+        out = np.asarray(
+            jax.jit(pv.verify_tile)(
+                jnp.asarray(pv.CONST_COLS),
+                inputs["a_x_w"], inputs["a_y_w"], inputs["a_t_w"],
+                inputs["s_w"], inputs["h_w"], inputs["yr_w"],
+                inputs["x_parity"].reshape(1, -1).astype(np.int32),
+            )
+        ).reshape(-1) != 0
+        assert (ref == out).all()
+        assert int(out[:128].sum()) == 127  # the one corrupted sig rejected

@@ -1,0 +1,152 @@
+"""Vote micro-batching (SURVEY §7 hard part b / round-1 VERDICT #3).
+
+Covers the two layers:
+- VoteSet.add_votes(errors=[]) error isolation — each vote in a gossip batch
+  gets exactly the outcome a serial add_vote sequence would have produced.
+- ConsensusState._handle_peer_batch — a burst of VoteMessages through the
+  peer queue becomes ONE batched signature verification (observed through
+  the crypto.batch metrics sink), replacing the reference's per-vote serial
+  verify (types/vote_set.go:189).
+"""
+import asyncio
+
+from tendermint_tpu.crypto import batch as crypto_batch
+from tendermint_tpu.types import BlockID, MockPV, ValidatorSet, Vote, VoteSet, VoteType
+from tendermint_tpu.types.validator_set import Validator
+from tendermint_tpu.types.vote import now_ns
+from tendermint_tpu.types.vote_set import ConflictingVoteError, VoteSetError
+
+CHAIN_ID = "vote-batch-chain"
+
+
+def make_valset(n):
+    pvs = sorted([MockPV() for _ in range(n)], key=lambda p: p.address)
+    vs = ValidatorSet([Validator(pv.get_pub_key(), 10) for pv in pvs])
+    return vs, pvs
+
+
+def rand_block_id(seed=b"x"):
+    import hashlib
+
+    from tendermint_tpu.types import PartSetHeader
+
+    h = hashlib.sha256(seed).digest()
+    return BlockID(h, PartSetHeader(1, h))
+
+
+def make_vote(pv, vs, height, round_, type_, block_id):
+    idx, _ = vs.get_by_address(pv.address)
+    v = Vote(type_, height, round_, block_id, now_ns(), pv.address, idx)
+    return pv.sign_vote(CHAIN_ID, v)
+
+
+class TestAddVotesErrorIsolation:
+    def test_mixed_batch_no_abort(self):
+        vs, pvs = make_valset(7)
+        bid = rand_block_id()
+        voteset = VoteSet(CHAIN_ID, 1, 0, VoteType.PREVOTE, vs)
+        good = [make_vote(pv, vs, 1, 0, VoteType.PREVOTE, bid) for pv in pvs]
+        # votes[1]: signature corrupted; votes[3]: wrong height
+        bad_sig = good[1].with_signature(b"\x00" * 64)
+        wrong_h = make_vote(pvs[3], vs, 2, 0, VoteType.PREVOTE, bid)
+        batch = [good[0], bad_sig, good[2], wrong_h, good[4], good[5], good[6]]
+        errors = []
+        added = voteset.add_votes(batch, errors=errors)
+        assert added == [True, False, True, False, True, True, True]
+        assert isinstance(errors[1], VoteSetError)
+        assert isinstance(errors[3], VoteSetError)
+        assert errors[0] is None and errors[2] is None
+        # the five valid votes (50 of 70 power) carry the quorum
+        maj, ok = voteset.two_thirds_majority()
+        assert ok and maj == bid
+
+    def test_conflict_collected_not_raised(self):
+        vs, pvs = make_valset(4)
+        voteset = VoteSet(CHAIN_ID, 1, 0, VoteType.PREVOTE, vs)
+        a = make_vote(pvs[0], vs, 1, 0, VoteType.PREVOTE, rand_block_id(b"a"))
+        b = make_vote(pvs[0], vs, 1, 0, VoteType.PREVOTE, rand_block_id(b"b"))
+        ok1 = make_vote(pvs[1], vs, 1, 0, VoteType.PREVOTE, rand_block_id(b"a"))
+        errors = []
+        added = voteset.add_votes([a, b, ok1], errors=errors)
+        assert added == [True, False, True]
+        assert isinstance(errors[1], ConflictingVoteError)
+        assert errors[1].existing == a and errors[1].conflicting == b
+
+    def test_duplicates_in_one_batch(self):
+        vs, pvs = make_valset(3)
+        bid = rand_block_id()
+        voteset = VoteSet(CHAIN_ID, 1, 0, VoteType.PREVOTE, vs)
+        v = make_vote(pvs[0], vs, 1, 0, VoteType.PREVOTE, bid)
+        errors = []
+        added = voteset.add_votes([v, v, v], errors=errors)
+        assert added == [True, False, False]
+        assert errors == [None, None, None]
+
+    def test_default_still_raises(self):
+        vs, pvs = make_valset(3)
+        bid = rand_block_id()
+        voteset = VoteSet(CHAIN_ID, 1, 0, VoteType.PREVOTE, vs)
+        bad = make_vote(pvs[0], vs, 1, 0, VoteType.PREVOTE, bid).with_signature(
+            b"\x01" * 64
+        )
+        try:
+            voteset.add_votes([bad])
+            raise AssertionError("expected VoteSetError")
+        except VoteSetError:
+            pass
+
+
+class TestGossipBurstBatching:
+    """A burst of peer votes produces ONE device batch (VERDICT #3 done
+    criterion), asserted through the crypto.batch metrics sink. The burst is
+    driven deterministically through ConsensusState._handle_peer_batch (the
+    receive_routine's batch path) with the consensus loop not running, so no
+    timing is involved; liveness non-regression at small validator counts is
+    covered by test_consensus.TestMultiValidatorOffline."""
+
+    def test_burst_becomes_one_device_batch(self, tmp_path):
+        from test_consensus import Fixture
+        from tendermint_tpu.consensus import messages as m
+        from tendermint_tpu.consensus.wal import MsgInfo
+
+        batch_sizes = []
+
+        async def main():
+            pvs = sorted([MockPV() for _ in range(10)], key=lambda p: p.address)
+            f = Fixture(
+                str(tmp_path), pvs=pvs, pv_index=0, use_wal=False, start_cs=False
+            )
+            await f.start()
+            try:
+                cs = f.cs
+                bid = rand_block_id(b"burst")
+                vs = cs.rs.validators
+                burst = []
+                for pv in pvs[1:]:
+                    idx, _ = vs.get_by_address(pv.address)
+                    v = Vote(
+                        VoteType.PREVOTE, cs.rs.height, 0, bid, now_ns(),
+                        pv.address, idx,
+                    )
+                    burst.append(pv.sign_vote(f.genesis.chain_id, v))
+                # 9 votes >= MIN_DEVICE_BATCH(8): the group must go through
+                # the device backend as a single signature batch
+                for v in burst[1:]:
+                    cs.peer_msg_queue.put_nowait(
+                        MsgInfo(m.VoteMessage(v), "peer")
+                    )
+                crypto_batch.set_metrics_sink(
+                    lambda n, secs: batch_sizes.append(n)
+                )
+                await cs._handle_peer_batch(MsgInfo(m.VoteMessage(burst[0]), "peer"))
+                prevotes = cs.rs.votes.prevotes(0)
+                # all 9 landed (90 of 100 power): quorum reached in one batch
+                maj, ok = prevotes.two_thirds_majority()
+                assert ok and maj == bid
+            finally:
+                crypto_batch.set_metrics_sink(None)
+                await f.stop()
+
+        asyncio.run(main())
+        assert batch_sizes, "no batches were verified"
+        assert max(batch_sizes) >= 9, f"burst not batched: {batch_sizes}"
