@@ -1,9 +1,18 @@
 """Driver benchmark: batched Ed25519 verification throughput per chip.
 
-Measures the end-to-end device verification of a 10,000-validator commit —
-the BASELINE.json north star (reference serial path: one `VerifyBytes` per
-CommitSig, types/validator_set.go:609-627, ~150 us each on modern x86 per
-x/crypto context in BASELINE.md → ~6.7k verifies/sec serial baseline).
+Measures HONEST end-to-end verification of 10,000-validator commits — the
+BASELINE.json north star (reference serial path: one `VerifyBytes` per
+CommitSig, types/validator_set.go:609-627, ~150us each on modern x86 per
+BASELINE.md → ~6.7k verifies/sec serial baseline).
+
+Honest = every cost included: host prep (SHA-512, scalar reduce, cached
+decompress, packing — native C++), host->device transfer, kernel, verdict
+fetch. Throughput is measured over K back-to-back commits with DISTINCT
+contents (prep runs serially in the loop; device launches pipeline, as they
+do in a syncing node), because the axon tunnel adds ~70ms of round-trip
+latency per synchronous fetch that a pipelined consumer does not pay.
+Single-commit latency (fully synchronous, tunnel included) is reported on
+stderr alongside cold/warm prep and the 100/1000-validator p50s.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 Diagnostics go to stderr.
@@ -11,14 +20,16 @@ Diagnostics go to stderr.
 from __future__ import annotations
 
 import json
+import statistics
 import sys
 import time
 
 import numpy as np
 
 N_COMMIT = 10_000         # validators in the north-star commit
-N_UNIQUE = 512            # unique real signatures; tiled to N_COMMIT
-# Serial Go x/crypto/ed25519 verify ~150us/op (BASELINE.md context) →
+N_UNIQUE = 512            # unique keypairs; messages differ per commit
+PIPELINE_K = 8            # back-to-back commits for the throughput number
+# Serial Go x/crypto/ed25519 verify ~150us/op (BASELINE.md context) ->
 # baseline verifies/sec for one CPU core, the reference's actual hot path.
 BASELINE_VERIFIES_PER_SEC = 1e6 / 150.0
 
@@ -30,54 +41,92 @@ def log(*a):
 def main() -> None:
     import jax
 
-    from tendermint_tpu.ops import ed25519_batch
+    from tendermint_tpu.crypto import ed25519
+    from tendermint_tpu.ops import ed25519_batch, kcache
     from tendermint_tpu.utils import make_sig_batch
 
+    kcache.enable_persistent_cache()
     dev = jax.devices()[0]
     log(f"device: {dev.platform} ({dev.device_kind})")
 
-    # Real signatures (unique keys + messages), tiled to commit size; device
-    # work per lane is data-independent so tiling measures true throughput.
-    pubs, msgs, sigs = make_sig_batch(N_UNIQUE, msg_prefix=b"bench vote ")
+    # N_UNIQUE real keypairs tiled to N_COMMIT (device work per lane is
+    # data-independent); K distinct per-commit messages, all pre-signed.
+    privs = [ed25519.gen_priv_key() for _ in range(N_UNIQUE)]
+    pubs_u = [p.pub_key().bytes() for p in privs]
     reps = -(-N_COMMIT // N_UNIQUE)
-    pubs = (pubs * reps)[:N_COMMIT]
-    msgs = (msgs * reps)[:N_COMMIT]
-    sigs = (sigs * reps)[:N_COMMIT]
+    pubs = (pubs_u * reps)[:N_COMMIT]
+    commits = []
+    for k in range(PIPELINE_K):
+        msg = b"bench vote h=%05d" % k
+        sigs = [p.sign(msg) for p in privs]
+        commits.append((pubs, [msg] * N_COMMIT, (sigs * reps)[:N_COMMIT]))
 
+    # -- host prep: cold valset (empty decompression cache) vs warm --------
+    ed25519_batch._cache._d.clear()
     t0 = time.perf_counter()
-    inputs, mask = ed25519_batch.prepare_batch(pubs, msgs, sigs)
-    host_prep_s = time.perf_counter() - t0
+    inputs, mask = ed25519_batch.prepare_batch(*commits[0])
+    cold_prep_s = time.perf_counter() - t0
     assert inputs is not None and mask.all()
-    log(f"host prep (hash+decompress+limbs) for {N_COMMIT}: {host_prep_s:.3f}s")
-
-    placed = {k: jax.device_put(v, dev) for k, v in inputs.items()}
-
     t0 = time.perf_counter()
-    out = np.asarray(ed25519_batch.verify_kernel(**placed))
+    inputs, _ = ed25519_batch.prepare_batch(*commits[0])
+    warm_prep_s = time.perf_counter() - t0
+    log(
+        f"host prep 10k (native): cold valset {cold_prep_s * 1e3:.1f} ms, "
+        f"warm {warm_prep_s * 1e3:.1f} ms"
+    )
+
+    fn = kcache.get_verify_fn(inputs["s_w"].shape[1])
+    t0 = time.perf_counter()
+    out = np.asarray(fn(**{k: jax.device_put(v, dev) for k, v in inputs.items()}))
     log(f"compile + first run: {time.perf_counter() - t0:.1f}s")
     assert out[:N_COMMIT].all(), "kernel rejected valid sigs"
 
-    # Honest pipeline timing: fresh host->device transfer of the packed
-    # words + kernel + device->host verdict fetch per iteration. (Under the
-    # axon tunnel, block_until_ready does not guarantee completion and
-    # repeat-identical launches can be result-cached — np.asarray of the
-    # output is the reliable sync point.)
-    iters = 5
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        fresh = {k: jax.device_put(v, dev) for k, v in inputs.items()}
-        out = np.asarray(ed25519_batch.verify_kernel(**fresh))
-    per_commit_s = (time.perf_counter() - t0) / iters
+    # -- single-commit latency (fully sync, includes tunnel round trip) ----
+    lat = []
+    for k in range(3):
+        t0 = time.perf_counter()
+        inputs, _ = ed25519_batch.prepare_batch(*commits[k])
+        placed = {k2: jax.device_put(v, dev) for k2, v in inputs.items()}
+        out = np.asarray(fn(**placed))
+        lat.append(time.perf_counter() - t0)
+    log(f"single 10k-commit latency (sync): {min(lat) * 1e3:.1f} ms")
 
+    # -- pipelined throughput: K distinct commits back-to-back -------------
+    t0 = time.perf_counter()
+    outs = []
+    for c in commits:
+        inputs, _ = ed25519_batch.prepare_batch(*c)
+        placed = {k2: jax.device_put(v, dev) for k2, v in inputs.items()}
+        outs.append(fn(**placed))
+    for o in outs:
+        assert np.asarray(o)[:N_COMMIT].all()
+    per_commit_s = (time.perf_counter() - t0) / PIPELINE_K
     rate = N_COMMIT / per_commit_s
+
+    # -- commit-verify p50 at small validator counts (latency metric) ------
+    for n in (100, 1000):
+        samples = []
+        for k in range(5):
+            p, m, s = commits[k % PIPELINE_K]
+            t0 = time.perf_counter()
+            inputs, _ = ed25519_batch.prepare_batch(p[:n], m[:n], s[:n])
+            fn_n = kcache.get_verify_fn(inputs["s_w"].shape[1])
+            placed = {k2: jax.device_put(v, dev) for k2, v in inputs.items()}
+            ok = np.asarray(fn_n(**placed))
+            samples.append(time.perf_counter() - t0)
+        log(
+            f"commit-verify p50 @ {n} validators: "
+            f"{statistics.median(samples) * 1e3:.1f} ms (sync, tunnel incl.)"
+        )
+
     log(
-        f"10k-validator commit verify: {per_commit_s * 1e3:.2f} ms "
+        f"10k-commit pipelined end-to-end: {per_commit_s * 1e3:.2f} ms/commit "
         f"({rate:,.0f} verifies/sec/chip; north star <5ms on v4-8)"
     )
     print(
         json.dumps(
             {
-                "metric": "ed25519_batch_verifies_per_sec_per_chip",
+                "metric": "ed25519_e2e_verifies_per_sec_per_chip",
                 "value": round(rate, 1),
                 "unit": "verifies/s",
                 "vs_baseline": round(rate / BASELINE_VERIFIES_PER_SEC, 2),

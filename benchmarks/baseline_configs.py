@@ -1,0 +1,259 @@
+"""The five BASELINE.json benchmark configs, measured end-to-end.
+
+Each config maps to a reference hot path (BASELINE.md table):
+  1. ed25519 single-sig VerifyBytes loop, 1k msgs      crypto/ed25519/ed25519.go:151
+  2. Commit.VerifyCommit, 100 validators               types/validator_set.go:591-633
+  3. validate_block, 1000 validators + evidence        state/validation.go:16,99,141
+  4. lite DynamicVerifier chain, H headers x V vals    lite/dynamic_verifier.go:73,211
+  5. mixed ed25519+secp256k1 multisig, streaming       types/vote_set.go:131,189
+     VoteSet.add_votes, 10k validators
+
+Usage: python -m benchmarks.baseline_configs [1 2 3 4 5] [--full]
+Config 4 defaults to 100 headers x 500 validators; --full runs the
+500 x 2000 BASELINE shape (~1M signatures to build, minutes of setup).
+
+Serial-reference context: one CPU-core VerifyBytes loop at the measured
+config-1 rate is the number every other config is compared against.
+"""
+from __future__ import annotations
+
+import statistics
+import sys
+import time
+
+
+def log(*a):
+    print(*a, flush=True)
+
+
+def _timeit(fn, repeat=3):
+    samples = []
+    for _ in range(repeat):
+        t0 = time.perf_counter()
+        fn()
+        samples.append(time.perf_counter() - t0)
+    return statistics.median(samples)
+
+
+def config1_serial_loop(n=1000):
+    """Serial one-at-a-time ed25519 verify — the reference's hot-path shape."""
+    from tendermint_tpu.crypto import ed25519
+
+    priv = ed25519.gen_priv_key()
+    pub = priv.pub_key()
+    msgs = [b"cfg1 %d" % i for i in range(n)]
+    sigs = [priv.sign(m) for m in msgs]
+
+    t0 = time.perf_counter()
+    ok = all(pub.verify(m, s) for m, s in zip(msgs, sigs))
+    dt = time.perf_counter() - t0
+    assert ok
+    rate = n / dt
+    log(f"[1] serial VerifyBytes loop: {dt * 1e3:8.1f} ms / {n} "
+        f"({rate:,.0f}/s)  <- baseline anchor")
+    return rate
+
+
+def _commit_fixture(n_vals, chain_id="bench-chain"):
+    from tendermint_tpu.types import MockPV, ValidatorSet, VoteSet, VoteType
+    from tendermint_tpu.types.validator_set import Validator
+    from tendermint_tpu.types.vote import BlockID, PartSetHeader, Vote, now_ns
+
+    pvs = sorted([MockPV() for _ in range(n_vals)], key=lambda p: p.address)
+    vs = ValidatorSet([Validator(pv.get_pub_key(), 10) for pv in pvs])
+    h = bytes(range(32))
+    bid = BlockID(h, PartSetHeader(1, h))
+    voteset = VoteSet(chain_id, 3, 0, VoteType.PRECOMMIT, vs)
+    votes = []
+    for pv in pvs:
+        idx, _ = vs.get_by_address(pv.address)
+        v = Vote(VoteType.PRECOMMIT, 3, 0, bid, now_ns(), pv.address, idx)
+        votes.append(pv.sign_vote(chain_id, v))
+    voteset.add_votes(votes)
+    return vs, voteset.make_commit(), bid, chain_id
+
+
+def config2_verify_commit(n_vals=100):
+    vs, commit, bid, chain_id = _commit_fixture(n_vals)
+    dt = _timeit(lambda: vs.verify_commit(chain_id, bid, 3, commit))
+    log(f"[2] Commit.VerifyCommit @ {n_vals} validators: {dt * 1e3:8.1f} ms")
+    return n_vals / dt
+
+
+def config3_validate_block_shape(n_vals=1000, n_evidence=20):
+    """The validate_block signature workload: LastCommit verify + per-
+    evidence sig checks, batched the way state/validation.py does it."""
+    from tendermint_tpu.crypto.batch import BatchVerifier
+    from tendermint_tpu.types import MockPV, ValidatorSet, VoteType
+    from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+    from tendermint_tpu.types.validator_set import Validator
+    from tendermint_tpu.types.vote import BlockID, PartSetHeader, Vote, now_ns
+
+    vs, commit, bid, chain_id = _commit_fixture(n_vals)
+    # evidence: n_evidence equivocating validators
+    pv_e = [MockPV() for _ in range(n_evidence)]
+    evs = []
+    for pv in pv_e:
+        h1, h2 = bytes(32), bytes(range(32))
+        v1 = Vote(VoteType.PREVOTE, 2, 0, BlockID(h1, PartSetHeader(1, h1)),
+                  now_ns(), pv.address, 0)
+        v2 = Vote(VoteType.PREVOTE, 2, 0, BlockID(h2, PartSetHeader(1, h2)),
+                  now_ns(), pv.address, 0)
+        evs.append(
+            DuplicateVoteEvidence(
+                pv.get_pub_key(), pv.sign_vote(chain_id, v1),
+                pv.sign_vote(chain_id, v2),
+            )
+        )
+
+    def run():
+        vs.verify_commit(chain_id, bid, 3, commit)
+        bv = BatchVerifier()
+        for ev in evs:
+            ev.add_to_batch(chain_id, ev.pub_key, bv)
+        ok = bv.verify_all()
+        assert all(ok)
+
+    dt = _timeit(run)
+    n_sigs = n_vals + 2 * n_evidence
+    log(f"[3] validate_block shape @ {n_vals} validators + {n_evidence} "
+        f"evidence: {dt * 1e3:8.1f} ms ({n_sigs} sigs)")
+    return n_sigs / dt
+
+
+def config4_lite_chain(n_headers=100, n_vals=500):
+    """Light-client header chain: every header's commit verified against a
+    (rotating) valset — the DynamicVerifier bisection workload."""
+    from tendermint_tpu.types import MockPV, ValidatorSet, VoteSet, VoteType
+    from tendermint_tpu.types.validator_set import Validator
+    from tendermint_tpu.types.vote import BlockID, PartSetHeader, Vote, now_ns
+
+    chain_id = "lite-bench"
+    pvs = sorted([MockPV() for _ in range(n_vals)], key=lambda p: p.address)
+    vs = ValidatorSet([Validator(pv.get_pub_key(), 10) for pv in pvs])
+    log(f"    building {n_headers} x {n_vals} signed commits "
+        f"({n_headers * n_vals:,} signatures)...")
+    commits = []
+    for height in range(1, n_headers + 1):
+        h = height.to_bytes(32, "big")
+        bid = BlockID(h, PartSetHeader(1, h))
+        voteset = VoteSet(chain_id, height, 0, VoteType.PRECOMMIT, vs)
+        votes = []
+        for pv in pvs:
+            idx, _ = vs.get_by_address(pv.address)
+            v = Vote(VoteType.PRECOMMIT, height, 0, bid, now_ns(), pv.address, idx)
+            votes.append(pv.sign_vote(chain_id, v))
+        voteset.add_votes(votes)
+        commits.append((bid, voteset.make_commit()))
+
+    t0 = time.perf_counter()
+    for height, (bid, commit) in enumerate(commits, start=1):
+        vs.verify_commit(chain_id, bid, height, commit)
+    dt = time.perf_counter() - t0
+    n_sigs = n_headers * n_vals
+    log(f"[4] lite chain {n_headers} x {n_vals}: {dt:8.2f} s "
+        f"({n_sigs:,} sigs, {n_sigs / dt:,.0f}/s)")
+    return n_sigs / dt
+
+
+def config5_mixed_streaming(n_vals=10_000, burst=256):
+    """Streaming VoteSet.add_votes with a mixed ed25519 + secp256k1 +
+    2-of-3 multisig validator set, ingested in gossip-sized bursts."""
+    from tendermint_tpu.crypto import ed25519 as ed
+    from tendermint_tpu.crypto import secp256k1 as sk
+    from tendermint_tpu.crypto.multisig import PubKeyMultisigThreshold
+    from tendermint_tpu.types import ValidatorSet, VoteSet, VoteType
+    from tendermint_tpu.types.priv_validator import MockPV
+    from tendermint_tpu.types.validator_set import Validator
+    from tendermint_tpu.types.vote import BlockID, PartSetHeader, Vote, now_ns
+
+    chain_id = "mixed-bench"
+
+    class SecpPV:
+        def __init__(self):
+            self.priv = sk.gen_priv_key()
+            self.address = self.priv.pub_key().address()
+
+        def get_pub_key(self):
+            return self.priv.pub_key()
+
+        def sign_vote(self, cid, vote):
+            return vote.with_signature(self.priv.sign(vote.sign_bytes(cid)))
+
+    class MultiPV:
+        """2-of-3 threshold (ed25519 x2 + secp256k1)."""
+
+        def __init__(self):
+            self.e1, self.e2 = ed.gen_priv_key(), ed.gen_priv_key()
+            self.s1 = sk.gen_priv_key()
+            self.pub = PubKeyMultisigThreshold(
+                2, [self.e1.pub_key(), self.e2.pub_key(), self.s1.pub_key()]
+            )
+            self.address = self.pub.address()
+
+        def get_pub_key(self):
+            return self.pub
+
+        def sign_vote(self, cid, vote):
+            from tendermint_tpu.crypto.multisig import Multisignature
+
+            msg = vote.sign_bytes(cid)
+            keys = [self.e1.pub_key(), self.e2.pub_key(), self.s1.pub_key()]
+            ms = Multisignature(3)
+            ms.add_signature_from_pubkey(self.e1.sign(msg), keys[0], keys)
+            ms.add_signature_from_pubkey(self.s1.sign(msg), keys[2], keys)
+            return vote.with_signature(ms.encode())
+
+    log(f"    building {n_vals} mixed-key validators...")
+    pvs = []
+    for i in range(n_vals):
+        if i % 3 == 0:
+            pvs.append(MockPV())
+        elif i % 3 == 1:
+            pvs.append(SecpPV())
+        else:
+            pvs.append(MultiPV())
+    pvs.sort(key=lambda p: p.address)
+    vs = ValidatorSet([Validator(pv.get_pub_key(), 10) for pv in pvs])
+    h = bytes(range(32))
+    bid = BlockID(h, PartSetHeader(1, h))
+    log("    signing...")
+    votes = []
+    for pv in pvs:
+        idx, _ = vs.get_by_address(pv.address)
+        v = Vote(VoteType.PRECOMMIT, 5, 0, bid, now_ns(), pv.address, idx)
+        votes.append(pv.sign_vote(chain_id, v))
+
+    voteset = VoteSet(chain_id, 5, 0, VoteType.PRECOMMIT, vs)
+    t0 = time.perf_counter()
+    for lo in range(0, n_vals, burst):
+        voteset.add_votes(votes[lo:lo + burst])
+    dt = time.perf_counter() - t0
+    assert voteset.has_two_thirds_majority()
+    # primitive sig count: 1/3 ed25519 + 1/3 secp + 1/3 * 2 multisig subs
+    n_sigs = sum(1 if i % 3 == 0 else 1 if i % 3 == 1 else 2 for i in range(n_vals))
+    log(f"[5] mixed streaming VoteSet @ {n_vals} validators (burst {burst}): "
+        f"{dt * 1e3:8.1f} ms ({n_sigs:,} primitive sigs, {n_sigs / dt:,.0f}/s)")
+    return n_sigs / dt
+
+
+def main(argv):
+    full = "--full" in argv
+    picks = [a for a in argv if a.isdigit()] or ["1", "2", "3", "4", "5"]
+    import jax
+
+    log(f"platform: {jax.default_backend()}")
+    if "1" in picks:
+        config1_serial_loop()
+    if "2" in picks:
+        config2_verify_commit()
+    if "3" in picks:
+        config3_validate_block_shape()
+    if "4" in picks:
+        config4_lite_chain(*((500, 2000) if full else (100, 500)))
+    if "5" in picks:
+        config5_mixed_streaming()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

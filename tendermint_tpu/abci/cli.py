@@ -94,13 +94,26 @@ async def _amain(args) -> int:
             from tendermint_tpu.abci.examples import CounterApplication
 
             app = CounterApplication(serial=args.serial)
-        server = ABCIServer(app, args.address)
+        if args.abci == "grpc":
+            from tendermint_tpu.abci.grpc import GRPCABCIServer
+
+            server = GRPCABCIServer(app, args.address)
+        else:
+            server = ABCIServer(app, args.address)
         await server.start()
-        print(f"{args.command} ABCI app listening on {args.address}", file=sys.stderr)
+        print(
+            f"{args.command} ABCI app listening on {args.address} ({args.abci})",
+            file=sys.stderr,
+        )
         await asyncio.Event().wait()
         return 0
 
-    client = SocketClient(args.address)
+    if args.abci == "grpc":
+        from tendermint_tpu.abci.grpc import GRPCClient
+
+        client = GRPCClient(args.address)
+    else:
+        client = SocketClient(args.address)
     await client.start()
     try:
         if args.command in ("console", "batch"):
@@ -114,6 +127,10 @@ async def _amain(args) -> int:
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="abci-cli")
     p.add_argument("--address", default="tcp://127.0.0.1:26658")
+    p.add_argument(
+        "--abci", default="socket", choices=["socket", "grpc"],
+        help="transport (reference abci-cli --abci)",
+    )
     p.add_argument("--serial", action="store_true", help="counter: enforce tx ordering")
     p.add_argument(
         "command",

@@ -1,0 +1,226 @@
+"""ABCI over gRPC — client, server, and the GRPCApplication adapter.
+
+Reference parity: abci/client/grpc_client.go, abci/server/grpc_server.go,
+abci/types/application.go:78 (GRPCApplication). Selectable exactly like the
+reference: `--abci grpc` on the node / `abci-cli --abci grpc`, or a
+`grpc://host:port` proxy_app address.
+
+Wire format: one unary gRPC method per ABCI call at
+/tendermint.abci.types.ABCIApplication/<Method>, message bodies in the
+repo's documented CBE encoding (the same tagged frames as the socket
+protocol — grpcio-tools/protoc codegen is not in the image, so generic
+method handlers replace compiled stubs; method paths match the reference's
+service so the surface is discoverable).
+"""
+from __future__ import annotations
+
+import asyncio
+
+import grpc
+import grpc.aio
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.client import ABCIClientError, Client
+from tendermint_tpu.abci.types import (
+    decode_request,
+    decode_response,
+    encode_request,
+    encode_response,
+)
+from tendermint_tpu.libs.service import BaseService
+
+SERVICE = "tendermint.abci.types.ABCIApplication"
+
+# method name -> request class (reference types.proto service methods)
+_METHODS = {
+    "Echo": abci.RequestEcho,
+    "Flush": abci.RequestFlush,
+    "Info": abci.RequestInfo,
+    "SetOption": abci.RequestSetOption,
+    "DeliverTx": abci.RequestDeliverTx,
+    "CheckTx": abci.RequestCheckTx,
+    "Query": abci.RequestQuery,
+    "Commit": abci.RequestCommit,
+    "InitChain": abci.RequestInitChain,
+    "BeginBlock": abci.RequestBeginBlock,
+    "EndBlock": abci.RequestEndBlock,
+}
+
+
+class GRPCApplication:
+    """Reference abci/types/application.go:78 — wraps an Application so
+    each ABCI call is a unary gRPC method. Echo/Flush are handled here (the
+    Application interface does not carry them)."""
+
+    def __init__(self, app: abci.Application) -> None:
+        self.app = app
+
+    def handle(self, req):
+        a = self.app
+        if isinstance(req, abci.RequestEcho):
+            return abci.ResponseEcho(req.message)
+        if isinstance(req, abci.RequestFlush):
+            return abci.ResponseFlush()
+        if isinstance(req, abci.RequestInfo):
+            return a.info(req)
+        if isinstance(req, abci.RequestSetOption):
+            return a.set_option(req)
+        if isinstance(req, abci.RequestInitChain):
+            return a.init_chain(req)
+        if isinstance(req, abci.RequestQuery):
+            return a.query(req)
+        if isinstance(req, abci.RequestBeginBlock):
+            return a.begin_block(req)
+        if isinstance(req, abci.RequestCheckTx):
+            return a.check_tx(req)
+        if isinstance(req, abci.RequestDeliverTx):
+            return a.deliver_tx(req)
+        if isinstance(req, abci.RequestEndBlock):
+            return a.end_block(req)
+        if isinstance(req, abci.RequestCommit):
+            return a.commit()
+        raise ValueError(f"unknown request {req!r}")
+
+
+class GRPCABCIServer(BaseService):
+    """Reference abci/server/grpc_server.go — serves a GRPCApplication."""
+
+    def __init__(self, app: abci.Application, address: str) -> None:
+        super().__init__("GRPCABCIServer")
+        self.wrapped = GRPCApplication(app)
+        self.address = address.replace("grpc://", "").replace("tcp://", "")
+        self._server: grpc.aio.Server | None = None
+        self.port: int | None = None
+
+    async def on_start(self) -> None:
+        self._server = grpc.aio.server()
+        handlers = {}
+        for name in _METHODS:
+            handlers[name] = grpc.unary_unary_rpc_method_handler(
+                self._make_handler(),
+                request_deserializer=None,
+                response_serializer=None,
+            )
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE, handlers),)
+        )
+        self.port = self._server.add_insecure_port(self.address)
+        await self._server.start()
+
+    def _make_handler(self):
+        wrapped = self.wrapped
+
+        async def handler(request: bytes, context) -> bytes:
+            try:
+                req = decode_request(request)
+                resp = wrapped.handle(req)
+            except Exception as e:  # noqa: BLE001 — app panic -> exception resp
+                resp = abci.ResponseException(str(e))
+            return encode_response(resp)
+
+        return handler
+
+    async def on_stop(self) -> None:
+        if self._server is not None:
+            await self._server.stop(grace=0.5)
+
+
+class GRPCClient(Client):
+    """Reference abci/client/grpc_client.go — the ABCI client over gRPC.
+
+    ABCI requires DeliverTx calls to reach the app in block order, and
+    grpc.aio gives no cross-RPC execution-order guarantee, so every request
+    goes through ONE ordered worker (the reference funnels through a single
+    request queue for the same reason, grpc_client.go). *_async returns a
+    future like the socket client's pipelined sends."""
+
+    def __init__(self, address: str) -> None:
+        super().__init__("GRPCABCIClient")
+        self.address = address.replace("grpc://", "").replace("tcp://", "")
+        self._channel: grpc.aio.Channel | None = None
+        self._fns: dict = {}
+        self._queue: asyncio.Queue = asyncio.Queue()
+
+    async def on_start(self) -> None:
+        self._channel = grpc.aio.insecure_channel(self.address)
+        for name in _METHODS:
+            self._fns[name] = self._channel.unary_unary(
+                f"/{SERVICE}/{name}",
+                request_serializer=None,
+                response_deserializer=None,
+            )
+        self.spawn(self._send_routine(), "grpc-abci-send")
+
+    async def on_stop(self) -> None:
+        if self._channel is not None:
+            await self._channel.close()
+
+    async def _send_routine(self) -> None:
+        """Ordered execution of queued requests."""
+        while True:
+            method, req, fut = await self._queue.get()
+            if fut.done():  # caller gave up
+                continue
+            try:
+                payload = await self._fns[method](encode_request(req))
+                resp = decode_response(payload)
+            except grpc.aio.AioRpcError as e:
+                fut.set_exception(
+                    ABCIClientError(f"grpc: {e.code().name}: {e.details()}")
+                )
+                continue
+            except Exception as e:  # noqa: BLE001
+                fut.set_exception(ABCIClientError(str(e)))
+                continue
+            if isinstance(resp, abci.ResponseException):
+                fut.set_exception(ABCIClientError(resp.error))
+            else:
+                fut.set_result(resp)
+
+    def _enqueue(self, method: str, req) -> asyncio.Future:
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._queue.put_nowait((method, req, fut))
+        return fut
+
+    async def _call(self, method: str, req) -> object:
+        return await self._enqueue(method, req)
+
+    async def echo(self, message: str):
+        return await self._call("Echo", abci.RequestEcho(message))
+
+    async def info(self, req):
+        return await self._call("Info", req)
+
+    async def set_option(self, req):
+        return await self._call("SetOption", req)
+
+    async def query(self, req):
+        return await self._call("Query", req)
+
+    async def check_tx(self, req):
+        return await self._call("CheckTx", req)
+
+    async def init_chain(self, req):
+        return await self._call("InitChain", req)
+
+    async def begin_block(self, req):
+        return await self._call("BeginBlock", req)
+
+    async def deliver_tx(self, req):
+        return await self._call("DeliverTx", req)
+
+    async def end_block(self, req):
+        return await self._call("EndBlock", req)
+
+    async def commit(self):
+        return await self._call("Commit", abci.RequestCommit())
+
+    async def flush(self) -> None:
+        """Wait for everything queued so far to have been executed."""
+        await self._call("Flush", abci.RequestFlush())
+
+    def deliver_tx_async(self, req) -> asyncio.Future:
+        return self._enqueue("DeliverTx", req)
+
+    def check_tx_async(self, req) -> asyncio.Future:
+        return self._enqueue("CheckTx", req)

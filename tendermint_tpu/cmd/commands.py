@@ -329,6 +329,20 @@ def cmd_version(args) -> int:
 
 
 def main(argv=None) -> int:
+    # When the operator pins a platform (JAX_PLATFORMS=cpu for a TPU-less
+    # run), make it authoritative: on some deployments (the axon plugin)
+    # the TPU plugin registers and spins up runtime threads regardless of
+    # the env var, and if its endpoint is unreachable those threads hang
+    # process exit forever. The config update BEFORE any backend query is
+    # the only reliable override.
+    if os.environ.get("JAX_PLATFORMS"):
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except Exception:  # noqa: BLE001 — CLI must work without jax too
+            pass
+
     p = argparse.ArgumentParser(
         prog="tendermint-tpu",
         description="TPU-native BFT state-machine replication engine",

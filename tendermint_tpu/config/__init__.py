@@ -21,7 +21,7 @@ class BaseConfig:
     db_backend: str = "sqlite"
     log_level: str = "info"
     proxy_app: str = "kvstore"
-    abci: str = "local"  # local | socket
+    abci: str = "local"  # local | socket | grpc (reference config.go ABCI)
     priv_validator_key_file: str = "config/priv_validator_key.json"
     priv_validator_state_file: str = "data/priv_validator_state.json"
     priv_validator_laddr: str = ""

@@ -1,0 +1,120 @@
+"""Pure-Python secp256k1 arithmetic — the readable oracle the TPU kernel is
+validated against, plus the host-side helpers batch prep needs (pubkey
+decompression, ECDSA scalar recovery).
+
+Reference parity: the verification math of crypto/secp256k1 (the reference
+delegates to btcec / vendored libsecp256k1; crypto/secp256k1/secp256k1_nocgo.go:21-50).
+This mirrors the same equation chain: w = s^-1 mod n, u1 = z*w, u2 = r*w,
+R' = u1*G + u2*Q, valid iff R'.x mod n == r. Not constant-time — it only
+ever processes public data (signature verification).
+"""
+from __future__ import annotations
+
+import hashlib
+
+P = 2**256 - 2**32 - 977
+N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+HALF_N = N // 2
+B = 7
+
+GX = 0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798
+GY = 0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8
+
+# projective (X, Y, Z); identity = (0, 1, 0)
+IDENTITY = (0, 1, 0)
+G = (GX, GY, 1)
+
+
+def point_add(p1, p2):
+    """Complete projective addition (Renes-Costello-Batina 2016, Alg 7 for
+    a=0, b3=3*7=21) — total: handles doubling and the identity."""
+    x1, y1, z1 = p1
+    x2, y2, z2 = p2
+    b3 = 3 * B
+    t0 = x1 * x2 % P
+    t1 = y1 * y2 % P
+    t2 = z1 * z2 % P
+    t3 = (x1 + y1) * (x2 + y2) % P
+    t4 = t0 + t1
+    t3 = (t3 - t4) % P
+    t4 = (y1 + z1) * (y2 + z2) % P
+    x3 = t1 + t2
+    t4 = (t4 - x3) % P
+    x3 = (x1 + z1) * (x2 + z2) % P
+    y3 = t0 + t2
+    y3 = (x3 - y3) % P
+    x3 = (t0 + t0 + t0) % P
+    t2 = b3 * t2 % P
+    z3 = (t1 + t2) % P
+    t1 = (t1 - t2) % P
+    y3 = b3 * y3 % P
+    x3_out = (t4 * y3 * -1 + t3 * t1) % P
+    y3_out = (y3 * x3 + t1 * z3) % P
+    z3_out = (z3 * t4 + x3 * t3) % P
+    return (x3_out % P, y3_out % P, z3_out % P)
+
+
+def point_double(p):
+    return point_add(p, p)
+
+
+def scalar_mult(k: int, p) -> tuple:
+    acc = IDENTITY
+    while k:
+        if k & 1:
+            acc = point_add(acc, p)
+        p = point_add(p, p)
+        k >>= 1
+    return acc
+
+
+def to_affine(p):
+    x, y, z = p
+    if z == 0:
+        return None  # identity
+    zi = pow(z, P - 2, P)
+    return (x * zi % P, y * zi % P)
+
+
+def decompress(pub: bytes):
+    """33-byte compressed SEC1 point -> (x, y) affine, or None."""
+    if len(pub) != 33 or pub[0] not in (2, 3):
+        return None
+    x = int.from_bytes(pub[1:], "big")
+    if x >= P:
+        return None
+    y2 = (pow(x, 3, P) + B) % P
+    y = pow(y2, (P + 1) // 4, P)  # p % 4 == 3
+    if y * y % P != y2:
+        return None  # not on curve
+    if (y & 1) != (pub[0] & 1):
+        y = P - y
+    return (x, y)
+
+
+def msg_scalar(msg: bytes) -> int:
+    """z = leftmost 256 bits of SHA-256(msg), as ECDSA prescribes."""
+    return int.from_bytes(hashlib.sha256(msg).digest(), "big") % N
+
+
+def verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
+    """Oracle ECDSA verify with the low-S rule — mirrors
+    crypto/secp256k1.PubKeySecp256k1.verify bit-for-bit."""
+    if len(sig) != 64:
+        return False
+    r = int.from_bytes(sig[:32], "big")
+    s = int.from_bytes(sig[32:], "big")
+    if not (0 < r < N and 0 < s <= HALF_N):
+        return False
+    q = decompress(pub)
+    if q is None:
+        return False
+    w = pow(s, N - 2, N)
+    z = msg_scalar(msg)
+    u1 = z * w % N
+    u2 = r * w % N
+    rp = point_add(scalar_mult(u1, G), scalar_mult(u2, (q[0], q[1], 1)))
+    aff = to_affine(rp)
+    if aff is None:
+        return False
+    return aff[0] % N == r

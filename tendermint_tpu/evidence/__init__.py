@@ -1,8 +1,19 @@
 """Evidence pool — pending/committed Byzantine evidence.
 
 Reference parity: evidence/pool.go:17 (validate via state.VerifyEvidence,
-clist for gossip, prune on block commit), evidence/store.go (pending/
-committed prefixes with priority keys).
+clist for gossip, prune on block commit) and evidence/store.go's keyed
+store: three key families,
+
+  EV:pending:<height><hash>              all uncommitted evidence
+  EV:outqueue:<inv-priority><height><hash>  broadcast queue, PRIORITY order
+  EV:committed:<hash>                    seen-on-chain marker
+
+where priority = the offending validator's voting power at the evidence
+height (store.go:13-24 "Schema for indexing evidence (note you need both
+height and hash to find a piece of evidence)" + priorityKey). Iterating the
+outqueue ascending yields highest-priority evidence first (the inverted
+big-endian priority), which is the order the gossip clist is seeded in on
+restart — the strongest equivocations travel first.
 """
 from __future__ import annotations
 
@@ -14,6 +25,8 @@ from tendermint_tpu.libs.log import NOP, Logger
 from tendermint_tpu.state import State, StateStore
 from tendermint_tpu.state.validation import ValidationError, verify_evidence
 from tendermint_tpu.types.evidence import Evidence, decode_evidence
+
+_MAX_U64 = (1 << 64) - 1
 
 
 class EvidenceError(Exception):
@@ -30,16 +43,45 @@ class EvidencePool:
         self.log = logger
         self.evidence_list = CList()  # gossip data structure
         self._in_list: dict[bytes, object] = {}
-        # load pending from disk
+        # Seed the gossip list from the outqueue: priority order (reference
+        # reactor broadcasts PriorityEvidence first on start), then any
+        # pending evidence already marked broadcasted, in height order.
+        for _, raw in self._db.iterate_prefix(b"EV:outqueue:"):
+            ev = decode_evidence(raw)
+            if ev.hash() not in self._in_list:
+                self._in_list[ev.hash()] = self.evidence_list.push_back(ev)
         for _, raw in self._db.iterate_prefix(b"EV:pending:"):
             ev = decode_evidence(raw)
-            self._in_list[ev.hash()] = self.evidence_list.push_back(ev)
+            if ev.hash() not in self._in_list:
+                self._in_list[ev.hash()] = self.evidence_list.push_back(ev)
+
+    # -- keys (reference evidence/store.go:37-57) --------------------------
 
     def _pending_key(self, ev: Evidence) -> bytes:
         return b"EV:pending:" + struct.pack(">Q", ev.height()) + ev.hash()
 
+    def _outqueue_key(self, ev: Evidence, priority: int) -> bytes:
+        return (
+            b"EV:outqueue:"
+            + struct.pack(">Q", _MAX_U64 - max(0, priority))
+            + struct.pack(">Q", ev.height())
+            + ev.hash()
+        )
+
     def _committed_key(self, ev: Evidence) -> bytes:
         return b"EV:committed:" + ev.hash()
+
+    def _priority_of(self, ev: Evidence) -> int:
+        """Offending validator's voting power at the evidence height
+        (reference pool.go AddEvidence computes evidenceParams priority)."""
+        try:
+            vals = self.state_store.load_validators(ev.height())
+            _, val = vals.get_by_address(ev.address())
+            return val.voting_power if val is not None else 0
+        except Exception:  # noqa: BLE001 — missing historical valset
+            return 0
+
+    # -- queries ------------------------------------------------------------
 
     def is_committed(self, ev: Evidence) -> bool:
         return self._db.has(self._committed_key(ev))
@@ -47,19 +89,8 @@ class EvidencePool:
     def is_pending(self, ev: Evidence) -> bool:
         return self._db.has(self._pending_key(ev))
 
-    def add_evidence(self, ev: Evidence) -> None:
-        """Verify and admit new evidence (reference pool.go AddEvidence)."""
-        if self.is_committed(ev) or self.is_pending(ev):
-            return
-        try:
-            verify_evidence(self.state, self.state_store, ev)
-        except ValidationError as e:
-            raise EvidenceError(str(e)) from e
-        self._db.set(self._pending_key(ev), ev.encode())
-        self._in_list[ev.hash()] = self.evidence_list.push_back(ev)
-        self.log.info("added evidence", evidence=str(ev))
-
     def pending_evidence(self, max_bytes: int = -1) -> list[Evidence]:
+        """Height-ordered pending evidence (block proposal reaping)."""
         out = []
         total = 0
         for _, raw in self._db.iterate_prefix(b"EV:pending:"):
@@ -70,13 +101,54 @@ class EvidencePool:
             out.append(ev)
         return out
 
+    def priority_evidence(self) -> list[Evidence]:
+        """Outqueue evidence, highest priority first (reference
+        store.go PriorityEvidence)."""
+        return [
+            decode_evidence(raw)
+            for _, raw in self._db.iterate_prefix(b"EV:outqueue:")
+        ]
+
+    # -- mutation -----------------------------------------------------------
+
+    def add_evidence(self, ev: Evidence) -> None:
+        """Verify and admit new evidence (reference pool.go AddEvidence)."""
+        if self.is_committed(ev) or self.is_pending(ev):
+            return
+        try:
+            verify_evidence(self.state, self.state_store, ev)
+        except ValidationError as e:
+            raise EvidenceError(str(e)) from e
+        priority = self._priority_of(ev)
+        self._db.set(self._pending_key(ev), ev.encode())
+        self._db.set(self._outqueue_key(ev, priority), ev.encode())
+        # remember the insertion-time priority so outqueue keys can be
+        # deleted exactly even after historical valsets are pruned
+        self._db.set(b"EV:prio:" + ev.hash(), struct.pack(">Q", priority))
+        self._in_list[ev.hash()] = self.evidence_list.push_back(ev)
+        self.log.info("added evidence", evidence=str(ev), priority=priority)
+
+    def _stored_priority(self, ev: Evidence) -> int:
+        raw = self._db.get(b"EV:prio:" + ev.hash())
+        return struct.unpack(">Q", raw)[0] if raw else self._priority_of(ev)
+
+    def mark_broadcasted(self, ev: Evidence) -> None:
+        """Reference store.go MarkEvidenceAsBroadcasted: drop from the
+        outqueue (it stays pending until committed)."""
+        self._db.delete(self._outqueue_key(ev, self._stored_priority(ev)))
+
     def mark_committed(self, evidence: list[Evidence]) -> None:
         for ev in evidence:
             self._db.set(self._committed_key(ev), b"1")
-            self._db.delete(self._pending_key(ev))
-            el = self._in_list.pop(ev.hash(), None)
-            if el is not None:
-                self.evidence_list.remove(el)
+            self._remove_pending(ev)
+
+    def _remove_pending(self, ev: Evidence) -> None:
+        self._db.delete(self._pending_key(ev))
+        self._db.delete(self._outqueue_key(ev, self._stored_priority(ev)))
+        self._db.delete(b"EV:prio:" + ev.hash())
+        el = self._in_list.pop(ev.hash(), None)
+        if el is not None:
+            self.evidence_list.remove(el)
 
     def update(self, block, state: State) -> None:
         """Reference pool.go Update: mark block evidence committed, prune
@@ -87,7 +159,4 @@ class EvidencePool:
         for _, raw in list(self._db.iterate_prefix(b"EV:pending:")):
             ev = decode_evidence(raw)
             if ev.height() < state.last_block_height - max_age:
-                self._db.delete(self._pending_key(ev))
-                el = self._in_list.pop(ev.hash(), None)
-                if el is not None:
-                    self.evidence_list.remove(el)
+                self._remove_pending(ev)

@@ -83,4 +83,8 @@ class EvidenceReactor(BaseReactor):
             if not ok:
                 await asyncio.sleep(0.1)
                 continue
+            # it has now been sent to at least one peer: off the priority
+            # outqueue (reference reactor.go broadcastEvidenceRoutine ->
+            # store MarkEvidenceAsBroadcasted); still pending until committed
+            self.pool.mark_broadcasted(ev)
             el = await el.next_wait()

@@ -86,11 +86,21 @@ class SQLiteDB(DB):
             self._conn.commit()
 
     def iterate_prefix(self, prefix: bytes) -> Iterator[tuple[bytes, bytes]]:
-        hi = prefix + b"\xff" * 8
+        # upper bound = the prefix's successor (rightmost non-0xff byte
+        # incremented) — an appended-0xff bound excludes keys whose suffix
+        # begins with 0xff bytes (e.g. inverted-priority evidence keys)
+        succ = bytearray(prefix)
+        while succ and succ[-1] == 0xFF:
+            succ.pop()
+        if succ:
+            succ[-1] += 1
+            q = "SELECT k, v FROM kv WHERE k >= ? AND k < ? ORDER BY k"
+            args = (prefix, bytes(succ))
+        else:
+            q = "SELECT k, v FROM kv WHERE k >= ? ORDER BY k"
+            args = (prefix,)
         with self._lock:
-            rows = self._conn.execute(
-                "SELECT k, v FROM kv WHERE k >= ? AND k <= ? ORDER BY k", (prefix, hi)
-            ).fetchall()
+            rows = self._conn.execute(q, args).fetchall()
         for k, v in rows:
             if bytes(k).startswith(prefix):
                 yield bytes(k), bytes(v)

@@ -248,6 +248,15 @@ class NopMempool:
     def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> list[bytes]:
         return []
 
+    def reap_max_txs(self, n: int) -> list[bytes]:
+        return []
+
+    def txs_bytes(self) -> int:
+        return 0
+
+    def flush(self) -> None:
+        pass
+
     async def update(self, height: int, txs: list[bytes], pre_check=None) -> None:
         pass
 

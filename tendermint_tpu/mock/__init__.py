@@ -1,0 +1,9 @@
+"""Mock implementations for tests and light node assemblies.
+
+Reference parity: mock/mempool.go — the no-op Mempool. The implementation
+lives next to the real one (mempool.NopMempool); this package mirrors the
+reference's import location.
+"""
+from tendermint_tpu.mempool import NopMempool as Mempool
+
+__all__ = ["Mempool"]

@@ -133,7 +133,9 @@ class Node(BaseService):
         state = load_state_from_db_or_genesis(self.state_db, self.genesis_doc)
 
         # 3. proxy app + handshake (replay to sync app with store)
-        creator = proxy.default_client_creator(cfg.base.proxy_app, app=self._app)
+        creator = proxy.default_client_creator(
+            cfg.base.proxy_app, app=self._app, transport=cfg.base.abci
+        )
         self.proxy_app = proxy.AppConns(creator)
         await self.proxy_app.start()
         handshaker = Handshaker(

@@ -341,5 +341,12 @@ def verify_batch(pubs, msgs, sigs) -> list[bool]:
     if inputs is None:
         return mask.tolist()
     fn = kcache.get_verify_fn(inputs["s_w"].shape[1])
-    ok = np.asarray(fn(**inputs))[:n]
+    try:
+        ok = np.asarray(fn(**inputs))[:n]
+    except Exception:  # noqa: BLE001 — e.g. a Mosaic lowering regression on
+        # a new backend: the preferred (pallas) kernel failing must degrade
+        # to the XLA kernel, never break verification
+        if kcache._kernel_for(kcache._platform())[0] == "xla":
+            raise  # the failing kernel IS the XLA kernel: nothing to try
+        ok = np.asarray(verify_kernel(**inputs))[:n]
     return (ok & mask).tolist()

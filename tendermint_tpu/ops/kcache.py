@@ -25,8 +25,30 @@ import hashlib
 import os
 import threading
 
+def _host_tag() -> str:
+    """Fingerprint of this host's CPU features. XLA:CPU AOT artifacts are
+    machine-feature-specific — loading a cache written on a different host
+    logs 'machine type ... doesn't match' and risks SIGILL, and a feature
+    mismatch forces multi-minute recompiles. Scoping the cache directory by
+    host keeps artifacts from ever crossing machines."""
+    import hashlib as _hl
+    import platform as _pf
+
+    probe = _pf.machine() + _pf.processor()
+    try:
+        with open("/proc/cpuinfo", encoding="utf-8") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    probe += line
+                    break
+    except OSError:
+        pass
+    return _hl.sha256(probe.encode()).hexdigest()[:10]
+
+
 _CACHE_DIR = os.environ.get(
-    "TMTPU_CACHE_DIR", os.path.expanduser("~/.cache/tendermint_tpu")
+    "TMTPU_CACHE_DIR",
+    os.path.expanduser(f"~/.cache/tendermint_tpu/{_host_tag()}"),
 )
 
 MAX_BUCKET = 16384
@@ -36,21 +58,68 @@ _fns: dict[tuple[str, int], object] = {}  # (platform, bucket) -> callable
 _exports_scheduled: set[tuple[str, int]] = set()
 _enabled = False
 
-# Background threads are non-daemon (daemon threads mid-XLA-compile caused
-# SIGABRTs at interpreter teardown), so interpreter shutdown joins them.
-# This flag bounds that join to at most the in-flight compile: it is set by
-# threading's shutdown hook BEFORE non-daemon threads are joined, and the
-# workers check it between compiles.
-_cancel = threading.Event()
-try:
-    threading._register_atexit(_cancel.set)  # runs before the join
-except Exception:  # noqa: BLE001 — private API (stable since 3.9). The
-    # atexit fallback runs AFTER non-daemon threads are joined, so it does
-    # not bound the exit delay — it only keeps later atexit-ordered cleanup
-    # (e.g. a second interpreter in the same process) from starting work.
-    import atexit
+# Background compiles run in DAEMON SUBPROCESSES, never threads in this
+# process: a daemon thread mid-XLA-compile SIGABRTs interpreter teardown
+# ("FATAL: exception not rethrown"), and a non-daemon thread turns shutdown
+# into a multi-minute join (an uninterruptible compile wedged a node holding
+# its RPC port). A daemon process is simply terminated at parent exit — a
+# separate address space cannot corrupt this one, and both the XLA
+# persistent cache and our export blobs are written atomically, so a killed
+# child just loses warm-up progress. The child populates the ON-DISK caches;
+# the first in-process use then loads from disk in seconds.
 
-    atexit.register(_cancel.set)
+
+def _warm_main(cache_dir: str, buckets) -> None:
+    """Subprocess entry: compile + export-blob each bucket into cache_dir."""
+    os.environ["TMTPU_CACHE_DIR"] = cache_dir
+    os.environ["TMTPU_WARM_CHILD"] = "1"  # never spawn grandchildren
+    os.environ.pop("TMTPU_NO_PREWARM", None)
+    os.environ.pop("TMTPU_NO_EXPORT_CACHE", None)
+    global _CACHE_DIR
+    _CACHE_DIR = cache_dir
+    try:
+        import numpy as np
+
+        enable_persistent_cache()
+        platform = _platform()
+        for b in sorted({min(int(b), MAX_BUCKET) for b in buckets}):
+            fn = get_verify_fn(b)
+            inputs = {
+                k: np.zeros(s.shape, s.dtype)
+                for k, s in _input_shapes(b).items()
+            }
+            np.asarray(fn(**inputs))
+            if not os.path.exists(_blob_path(platform, b)):
+                _write_export_blob(platform, b)
+    except Exception as e:  # noqa: BLE001 — warm-up must never crash loudly
+        import sys
+
+        print(f"tmtpu warm-up child failed: {e!r}", file=sys.stderr)
+
+
+def _spawn_warm_process(buckets):
+    """Launch the warmer as a daemon subprocess (terminated at exit).
+
+    Best-effort: where a second process cannot open the accelerator (local
+    exclusive libtpu), the child fails and only the export-blob layer is
+    lost — in-process compiles still populate and reuse the persistent XLA
+    cache, which carries the dominant (compile) cost."""
+    import multiprocessing as mp
+
+    if os.environ.get("TMTPU_NO_PREWARM") or os.environ.get("TMTPU_WARM_CHILD"):
+        return None
+    try:
+        ctx = mp.get_context("spawn")
+        p = ctx.Process(
+            target=_warm_main,
+            args=(_CACHE_DIR, tuple(buckets)),
+            daemon=True,
+            name="tmtpu-warm",
+        )
+        p.start()
+        return p
+    except Exception:  # noqa: BLE001 — warm-up is an optimization only
+        return None
 
 
 def enable_persistent_cache() -> None:
@@ -83,10 +152,10 @@ def _source_version() -> str:
         return _source_version_memo
     import jax
 
-    from tendermint_tpu.ops import curve, ed25519_batch, field, limbs
+    from tendermint_tpu.ops import curve, ed25519_batch, field, limb_field, limbs
 
     h = hashlib.sha256()
-    mods = [ed25519_batch, field, curve, limbs]
+    mods = [ed25519_batch, field, curve, limbs, limb_field]
     try:
         from tendermint_tpu.ops import pallas_verify
 
@@ -107,11 +176,29 @@ def _platform() -> str:
     return jax.devices()[0].platform
 
 
+def _kernel_for(platform: str):
+    """(name, callable) of the preferred verify kernel for a platform: the
+    Pallas/Mosaic kernel on TPU (1.7-2.2x the XLA kernel on v5e), the XLA
+    kernel elsewhere. TMTPU_KERNEL=xla|pallas overrides (benchmarking)."""
+    choice = os.environ.get("TMTPU_KERNEL")
+    if choice != "xla" and (platform == "tpu" or choice == "pallas"):
+        try:
+            from tendermint_tpu.ops import pallas_verify
+
+            return "pallas", pallas_verify.pallas_verify_kernel
+        except Exception:  # noqa: BLE001 — fall back to the XLA kernel
+            pass
+    from tendermint_tpu.ops import ed25519_batch
+
+    return "xla", ed25519_batch.verify_kernel
+
+
 def _blob_path(platform: str, bucket: int) -> str:
+    kname, _ = _kernel_for(platform)
     return os.path.join(
         _CACHE_DIR,
         "export",
-        f"ed25519_verify_{platform}_{bucket}_{_source_version()}.jaxexport",
+        f"ed25519_verify_{kname}_{platform}_{bucket}_{_source_version()}.jaxexport",
     )
 
 
@@ -133,15 +220,10 @@ def _write_export_blob(platform: str, bucket: int) -> None:
     lowering — always runs on a background thread)."""
     import jax
 
-    from tendermint_tpu.ops import ed25519_batch
-
     path = _blob_path(platform, bucket)
     try:
-        if _cancel.is_set():
-            return
-        exp = jax.export.export(ed25519_batch.verify_kernel)(
-            **_input_shapes(bucket)
-        )
+        _, kernel = _kernel_for(platform)
+        exp = jax.export.export(kernel)(**_input_shapes(bucket))
         blob = exp.serialize()
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = path + f".tmp{os.getpid()}"
@@ -149,11 +231,9 @@ def _write_export_blob(platform: str, bucket: int) -> None:
             f.write(blob)
         os.replace(tmp, path)
         # The export path compiles under a different XLA cache key than the
-        # in-process jit path; run the artifact once now (still background)
-        # so the export-keyed binary lands in the persistent cache and the
-        # NEXT process skips both the trace and the compile.
-        if _cancel.is_set():
-            return
+        # in-process jit path; run the artifact once now so the export-keyed
+        # binary lands in the persistent cache and the NEXT process skips
+        # both the trace and the compile.
         import numpy as np
 
         reloaded = jax.export.deserialize(blob)
@@ -182,8 +262,6 @@ def get_verify_fn(bucket: int):
 
     import jax
 
-    from tendermint_tpu.ops import ed25519_batch
-
     fn = None
     path = None
     if not os.environ.get("TMTPU_NO_EXPORT_CACHE"):
@@ -208,48 +286,36 @@ def get_verify_fn(bucket: int):
                 first = key not in _exports_scheduled
                 _exports_scheduled.add(key)
             if first:
-                # Non-daemon: interpreter shutdown joins the thread, so the
-                # process never tears down the XLA runtime mid-compile
-                # (daemon threads here caused SIGABRTs at exit — "FATAL:
-                # exception not rethrown" from the runtime's thread pools).
-                threading.Thread(
-                    target=_write_export_blob,
-                    args=(platform, bucket),
-                    daemon=False,
-                    name=f"tmtpu-export-{bucket}",
-                ).start()
+                # daemon subprocess: see the rationale above _warm_main
+                _spawn_warm_process([bucket])
     if fn is None:
-        fn = lambda **kw: ed25519_batch.verify_kernel(**kw)  # noqa: E731
+        _, kernel = _kernel_for(platform)
+        fn = lambda **kw: kernel(**kw)  # noqa: E731
     with _lock:
         _fns[key] = fn
     return fn
 
 
 def prewarm(buckets=(128,), background: bool = True):
-    """Compile + run the verify kernel on dummy inputs for each bucket so a
-    node's first real commit doesn't pay compile/dispatch warmup. Buckets
-    above MAX_BUCKET are clamped. Returns the worker thread when
-    background=True."""
+    """Warm the kernel caches for each bucket so a node's first real commit
+    doesn't pay compile/dispatch warmup. Buckets above MAX_BUCKET are
+    clamped. background=True warms the ON-DISK caches in a daemon
+    subprocess (terminated at exit — see _warm_main) and returns the
+    process; background=False compiles in-process (tests, bench)."""
     import numpy as np
 
-    def work():
-        for b in sorted({min(b, MAX_BUCKET) for b in buckets}):
-            if _cancel.is_set():
-                return
-            try:
-                fn = get_verify_fn(b)
-                inputs = {
-                    k: np.zeros(s.shape, s.dtype)
-                    for k, s in _input_shapes(b).items()
-                }
-                np.asarray(fn(**inputs))
-            except Exception:  # noqa: BLE001 — prewarm must never kill a node
-                pass
-
+    if os.environ.get("TMTPU_NO_PREWARM"):
+        return None
     if background:
-        # Non-daemon for the same reason as the export thread above.
-        t = threading.Thread(target=work, daemon=False, name="tmtpu-prewarm")
-        t.start()
-        return t
-    work()
+        return _spawn_warm_process(buckets)
+    for b in sorted({min(b, MAX_BUCKET) for b in buckets}):
+        try:
+            fn = get_verify_fn(b)
+            inputs = {
+                k: np.zeros(s.shape, s.dtype)
+                for k, s in _input_shapes(b).items()
+            }
+            np.asarray(fn(**inputs))
+        except Exception:  # noqa: BLE001 — prewarm must never kill a node
+            pass
     return None

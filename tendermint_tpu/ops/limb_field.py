@@ -1,0 +1,271 @@
+"""Generic batched prime-field arithmetic in 12-bit limbs, Mosaic-friendly.
+
+Factory producing the list-of-vregs field ops (see ops/pallas_verify.py's
+layout rationale) for ANY modulus p with 2^255 <= p < 2^264 whose fold
+constant K = 2^264 mod p has few nonzero base-4096 digits — true for the
+pseudo-Mersenne primes of ed25519 (K = 9728) and secp256k1
+(K = 2^40 + 250112). A field element is a python list of NLIMB int32
+arrays of identical shape; in-kernel each limb is one (8, 128) vreg.
+
+The carry/bound discipline mirrors ops/field.py: weakly-reduced "class R"
+values between ops, 2 wide passes + digit-fold + 4 narrow passes per
+multiply, value-tested on adversarial loose inputs (tests/test_ops_secp.py,
+tests/test_ops_verify.py pattern).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+
+import jax.numpy as jnp
+
+from tendermint_tpu.ops.limbs import LIMB_BITS, LIMB_MASK, NLIMB
+
+
+def _digits_of(v: int) -> list[tuple[int, int]]:
+    """Nonzero base-2^12 digits of v as (limb_index, digit)."""
+    out = []
+    k = 0
+    while v:
+        d = v & LIMB_MASK
+        if d:
+            out.append((k, d))
+        v >>= LIMB_BITS
+        k += 1
+    return out
+
+
+def _limbs_of(v: int) -> list[int]:
+    return [(v >> (LIMB_BITS * k)) & LIMB_MASK for k in range(NLIMB)]
+
+
+def _make_bias(p: int) -> list[int]:
+    """A multiple of p in non-canonical digits, every limb large enough to
+    dominate a class-R operand (same construction as ops/field._make_bias,
+    with the shift sized so 2^shift * p just fits the 264-bit capacity)."""
+    v = (1 << (NLIMB * LIMB_BITS - p.bit_length())) * p
+    digits = [(v >> (LIMB_BITS * i)) & LIMB_MASK for i in range(NLIMB)]
+    mins = [1 << 15] + [1 << 14] * (NLIMB - 2) + [0]
+    for i in range(NLIMB - 2, -1, -1):
+        while digits[i] < mins[i]:
+            digits[i] += 1 << LIMB_BITS
+            digits[i + 1] -= 1
+    assert all(d >= 0 for d in digits), digits
+    assert sum(d << (LIMB_BITS * i) for i, d in enumerate(digits)) == v
+    return [2 * d for d in digits]
+
+
+@dataclass
+class FieldOps:
+    p: int
+    fold_digits: list  # [(limb_index, digit)] of K = 2^264 mod p
+    bias: list
+    negp: list = dc_field(default_factory=list)
+
+    def limbs_of(self, v: int) -> list[int]:
+        return _limbs_of(v % self.p)
+
+    def const(self, v: int, like):
+        return [jnp.full_like(like, c) for c in self.limbs_of(v)]
+
+    # -- carries ---------------------------------------------------------
+
+    def _fold_into(self, rows, cc, src_weight: int):
+        """rows[src_weight + i] += digit_i * cc for K's digits (rows must be
+        long enough)."""
+        for k, d in self.fold_digits:
+            i = src_weight + k
+            rows[i] = cc * d if rows[i] is None else rows[i] + cc * d
+        return rows
+
+    def carry(self, c):
+        """One vectorized carry pass over NLIMB rows with top fold."""
+        cc = [x >> LIMB_BITS for x in c]
+        lo = [x & LIMB_MASK for x in c]
+        out = [lo[0]] + [lo[k] + cc[k - 1] for k in range(1, NLIMB)]
+        for k, d in self.fold_digits:
+            out[k] = out[k] + cc[NLIMB - 1] * d
+        return out
+
+    # -- mul/square ------------------------------------------------------
+
+    def _tail(self, c):
+        """Reduce 44 product columns -> class R (2 wide passes, two-level
+        digit fold, 4 narrow passes)."""
+        n2 = 2 * NLIMB
+        for _ in range(2):
+            cc = [x >> LIMB_BITS for x in c]
+            lo = [x & LIMB_MASK for x in c]
+            c = [lo[0]] + [lo[k] + cc[k - 1] for k in range(1, n2 - 1)] + [
+                lo[n2 - 1] + cc[n2 - 2] + (cc[n2 - 1] << LIMB_BITS)
+            ]
+        # first-level fold: c[22+j] (weight 2^(264+12j)) scatters K's digits
+        # into limbs j..j+max_digit; digits past limb 21 land in extra rows
+        max_k = self.fold_digits[-1][0]
+        ext: list = [None] * (NLIMB + max_k)
+        for k in range(NLIMB):
+            ext[k] = c[k]
+        for j in range(NLIMB):
+            hi = c[NLIMB + j]
+            for k, d in self.fold_digits:
+                i = j + k
+                ext[i] = hi * d if ext[i] is None else ext[i] + hi * d
+        # second-level fold: rows 22..22+max_k-1 are small; fold them back
+        d2 = ext[:NLIMB]
+        for j in range(max_k):
+            hi = ext[NLIMB + j]
+            if hi is None:
+                continue
+            for k, d in self.fold_digits:
+                d2[j + k] = d2[j + k] + hi * d
+        for _ in range(4):
+            d2 = self.carry(d2)
+        return d2
+
+    def mul(self, a, b):
+        n2 = 2 * NLIMB
+        c = [None] * n2
+        for i in range(NLIMB):
+            ai = a[i]
+            for j in range(NLIMB):
+                k = i + j
+                t = ai * b[j]
+                c[k] = t if c[k] is None else c[k] + t
+        c[n2 - 1] = jnp.zeros_like(a[0])
+        return self._tail(c)
+
+    def sq(self, a):
+        n2 = 2 * NLIMB
+        c = [None] * n2
+        for i in range(NLIMB):
+            ai = a[i]
+            for j in range(i + 1, NLIMB):
+                k = i + j
+                t = ai * a[j]
+                c[k] = t if c[k] is None else c[k] + t
+        for k in range(n2):
+            if c[k] is not None:
+                c[k] = c[k] + c[k]
+        for i in range(NLIMB):
+            k = 2 * i
+            t = a[i] * a[i]
+            c[k] = t if c[k] is None else c[k] + t
+        c[n2 - 1] = jnp.zeros_like(a[0])
+        return self._tail(c)
+
+    # -- add/sub/select --------------------------------------------------
+
+    def add(self, a, b):
+        return self.carry([x + y for x, y in zip(a, b)])
+
+    def sub(self, a, b):
+        return self.carry([x + (bk - y) for x, y, bk in zip(a, b, self.bias)])
+
+    def sel(self, cond, a, b):
+        return [jnp.where(cond, x, y) for x, y in zip(a, b)]
+
+    def mul_small(self, a, m: int):
+        """a * m for a small python int (m * classR limb must fit int32)."""
+        return self.carry(self.carry([x * m for x in a]))
+
+    # -- canonicalize / compare ------------------------------------------
+
+    def _seq_carry(self, a, topfold: bool):
+        a = list(a)
+        for k in range(NLIMB - 1):
+            cc = a[k] >> LIMB_BITS
+            a[k] = a[k] & LIMB_MASK
+            a[k + 1] = a[k + 1] + cc
+        if topfold:
+            cc = a[NLIMB - 1] >> LIMB_BITS
+            a[NLIMB - 1] = a[NLIMB - 1] & LIMB_MASK
+            for k, d in self.fold_digits:
+                a[k] = a[k] + cc * d
+        return a
+
+    def canon(self, a):
+        """Exact canonical digits of (a mod p), in [0, p).
+
+        top_bits = ceil(log2 p): 255 for 2^255-19, 256 for secp256k1's
+        2^256-2^32-977. Bits >= top_bits fold via 2^top_bits mod p (small
+        for both); the result is < 2^top_bits < 2p, so ONE conditional
+        subtract of p finishes."""
+        top_bits = self.p.bit_length()
+        top_limb_bits = top_bits - LIMB_BITS * (NLIMB - 1)  # bits in limb 21
+        c_small = (1 << top_bits) % self.p
+        a = self.carry(self.carry(a))
+        a = self._seq_carry(a, True)
+        a = self._seq_carry(a, True)
+        for _ in range(2):
+            hi = a[NLIMB - 1] >> top_limb_bits
+            a = list(a)
+            a[NLIMB - 1] = a[NLIMB - 1] & ((1 << top_limb_bits) - 1)
+            for k, d in _digits_of(c_small):
+                a[k] = a[k] + hi * d
+            a = self._seq_carry(a, False)
+        t = [x + nk for x, nk in zip(a, self.negp)]
+        for k in range(NLIMB - 1):
+            cc = t[k] >> LIMB_BITS
+            t[k] = t[k] & LIMB_MASK
+            t[k + 1] = t[k + 1] + cc
+        overflow = t[NLIMB - 1] >> LIMB_BITS
+        t[NLIMB - 1] = t[NLIMB - 1] & LIMB_MASK
+        return self.sel(overflow > 0, t, a)
+
+    def eq(self, a, b):
+        """Canonical-digit equality; inputs must be canonical."""
+        from functools import reduce
+
+        return reduce(jnp.logical_and, [x == y for x, y in zip(a, b)])
+
+    def is_zero(self, a):
+        """a == 0 for canonical digits."""
+        from functools import reduce
+
+        return reduce(jnp.logical_and, [x == 0 for x in a])
+
+
+NWORDS = 8
+
+
+def digit_at(w_rows, d):
+    """2-bit digit d (traced scalar) of scalars packed in 8 little-endian
+    int32 word arrays. Computed arithmetically — Mosaic cannot lower a
+    dynamic_slice over a per-digit array inside the loop. All int32: the
+    arithmetic shift's sign extension only reaches bits >= 2 even at the
+    maximum shift of 30, and `& 3` discards them."""
+    wi = d // 16
+    sh = 2 * (d % 16)
+    acc = w_rows[0]
+    for k in range(1, NWORDS):
+        acc = jnp.where(wi == k, w_rows[k], acc)
+    return (acc >> sh) & 3
+
+
+def words_to_limbs(w_rows):
+    """8 little-endian int32 word arrays -> 22-limb field element, full
+    256-bit range. The arithmetic right shift sign-extends, so (a) where a
+    limb straddles a word boundary the low word's field is masked to its
+    true width before OR-ing the high word's bits, and (b) the top limb is
+    masked to its 4 true bits — word 7's sign bit IS bit 255, which
+    secp256k1 coordinates can set."""
+    limbs = []
+    for k in range(NLIMB):
+        lo_bit = LIMB_BITS * k
+        a, s = lo_bit // 32, lo_bit % 32
+        v = w_rows[a] >> s
+        if s > 32 - LIMB_BITS:
+            if a + 1 < NWORDS:
+                v = (v & ((1 << (32 - s)) - 1)) | (w_rows[a + 1] << (32 - s))
+            else:
+                v = v & ((1 << (32 - s)) - 1)
+        limbs.append(v & LIMB_MASK)
+    return limbs
+
+
+def make_field(p: int) -> FieldOps:
+    assert 2**254 < p < 2**264
+    k = (1 << (NLIMB * LIMB_BITS)) % p
+    fold_digits = _digits_of(k)
+    ops = FieldOps(p=p, fold_digits=fold_digits, bias=_make_bias(p))
+    ops.negp = _limbs_of((1 << (NLIMB * LIMB_BITS)) - p)
+    return ops

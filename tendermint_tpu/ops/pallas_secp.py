@@ -1,0 +1,216 @@
+"""Pallas TPU kernel for batched secp256k1 ECDSA verification.
+
+R' = [u1]G + [u2]Q by joint radix-4 Straus (128 iterations of 2 doubles +
+1 complete add against a 16-entry table), over GF(2^256 - 2^32 - 977) in
+the 12-bit-limb list-of-vregs layout of ops/limb_field.py (see
+ops/pallas_verify.py for the layout rationale — every op is a whole
+(8, 128) vector register).
+
+Point arithmetic uses the COMPLETE projective a=0 formulas of
+Renes-Costello-Batina 2016 (Alg 7 add, Alg 9 double, b3 = 21): total on
+all inputs including identity and P == Q, so the constant-shape loop needs
+no branches and adversarially-crafted (u1, u2) cannot hit an exceptional
+case. Verdict: R' valid iff Z' != 0 and X' == t*Z' for t in {r, r+n}
+(x mod n == r admits both representatives when r + n < p).
+
+Replaces: /root/reference/crypto/secp256k1/secp256k1_nocgo.go:21-50 (and
+the vendored libsecp256k1's verify on the cgo path) — the reference
+verifies one signature at a time; this verifies a whole commit's worth per
+launch. Oracle: crypto/secp256k1_math.py + native/secp256k1.cpp.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from tendermint_tpu.crypto import secp256k1_math as sm
+from tendermint_tpu.ops.limb_field import (
+    NWORDS,
+    digit_at,
+    make_field,
+    words_to_limbs,
+)
+
+TILE = 1024
+SUB, LANE = 8, 128
+NDIGITS = 128  # 256-bit scalars, 2-bit joint digits
+
+F = make_field(sm.P)
+B3 = 3 * sm.B  # 21
+
+
+# ------------------------------------------------------------------- curve
+# Points: 3-tuples (X, Y, Z) of field elements, projective; (0, 1, 0) = O.
+
+
+def padd(p1, p2):
+    """Complete projective addition (RCB16 Alg 7, a=0)."""
+    x1, y1, z1 = p1
+    x2, y2, z2 = p2
+    t0 = F.mul(x1, x2)
+    t1 = F.mul(y1, y2)
+    t2 = F.mul(z1, z2)
+    t3 = F.mul(F.add(x1, y1), F.add(x2, y2))
+    t3 = F.sub(t3, F.add(t0, t1))          # X1Y2 + X2Y1
+    t4 = F.mul(F.add(y1, z1), F.add(y2, z2))
+    t4 = F.sub(t4, F.add(t1, t2))          # Y1Z2 + Y2Z1
+    t5 = F.mul(F.add(x1, z1), F.add(x2, z2))
+    t5 = F.sub(t5, F.add(t0, t2))          # X1Z2 + X2Z1
+    x3 = F.add(F.add(t0, t0), t0)          # 3*X1X2
+    t2 = F.mul_small(t2, B3)               # b3*Z1Z2
+    z3 = F.add(t1, t2)                     # Y1Y2 + b3Z1Z2
+    t1 = F.sub(t1, t2)                     # Y1Y2 - b3Z1Z2
+    y3 = F.mul_small(t5, B3)               # b3*(X1Z2+X2Z1)
+    xo = F.sub(F.mul(t3, t1), F.mul(t4, y3))
+    yo = F.add(F.mul(y3, x3), F.mul(t1, z3))
+    zo = F.add(F.mul(z3, t4), F.mul(x3, t3))
+    return (xo, yo, zo)
+
+
+def pdbl(p):
+    """Complete projective doubling (RCB16 Alg 9, a=0)."""
+    x, y, z = p
+    t0 = F.sq(y)
+    z3 = F.add(F.add(t0, t0), F.add(t0, t0))
+    z3 = F.add(z3, z3)                     # 8Y^2
+    t1 = F.mul(y, z)
+    t2 = F.mul_small(F.sq(z), B3)          # b3*Z^2
+    x3 = F.mul(t2, z3)
+    y3 = F.add(t0, t2)
+    z3 = F.mul(t1, z3)
+    t1 = F.add(t2, t2)
+    t2 = F.add(t1, t2)
+    t0 = F.sub(t0, t2)                     # Y^2 - 3*b3*Z^2
+    y3 = F.add(x3, F.mul(t0, y3))
+    m = F.mul(t0, F.mul(x, y))
+    x3 = F.add(m, m)
+    return (x3, y3, z3)
+
+
+def psel(cond, a, b):
+    return tuple(F.sel(cond, x, y) for x, y in zip(a, b))
+
+
+def _sel2(b0, b1, e0, e1, e2, e3):
+    lo = psel(b0, e1, e0)
+    hi = psel(b0, e3, e2)
+    return psel(b1, hi, lo)
+
+
+# -------------------------------------------- compile-time [i]G constants
+
+_G_MULTS = [
+    sm.IDENTITY,
+    sm.G,
+    sm.to_affine(sm.point_double(sm.G)) + (1,),
+    sm.to_affine(sm.scalar_mult(3, sm.G)) + (1,),
+]
+
+
+def _const_pt(pt, like):
+    return tuple(F.const(c, like) for c in pt)
+
+
+# ------------------------------------------------------------- the kernel
+
+
+def verify_tile(u1, u2, qx, qy, t1, t2):
+    """Per-tile verification as a pure array function.
+
+    u1/u2/qx/qy/t1/t2: (NWORDS, *S) int32 little-endian words. Returns
+    (*S,) int32 verdicts. (No parity/y check: ECDSA's verdict depends only
+    on x(R').)
+    """
+    u1_r = [u1[i] for i in range(NWORDS)]
+    u2_r = [u2[i] for i in range(NWORDS)]
+    like = u1_r[0]
+
+    q = (
+        words_to_limbs([qx[i] for i in range(NWORDS)]),
+        words_to_limbs([qy[i] for i in range(NWORDS)]),
+        F.const(1, like),
+    )
+
+    # 16-entry table [i]G + [j]Q (i = u1 digit, j = u2 digit)
+    g_pts = [_const_pt(pt, like) for pt in _G_MULTS]
+    q2 = pdbl(q)
+    q3 = padd(q2, q)
+    q_pts = [None, q, q2, q3]
+    table = []
+    for i in range(4):
+        for j in range(4):
+            if j == 0:
+                table.append(g_pts[i])
+            elif i == 0:
+                table.append(q_pts[j])
+            else:
+                table.append(padd(g_pts[i], q_pts[j]))
+    ident = _const_pt(sm.IDENTITY, like)
+
+    def body(it, p):
+        d = NDIGITS - 1 - it
+        sd = digit_at(u1_r, d)
+        hd = digit_at(u2_r, d)
+        s0, s1 = (sd & 1) != 0, (sd >> 1) != 0
+        h0, h1 = (hd & 1) != 0, (hd >> 1) != 0
+        rows = [
+            _sel2(h0, h1, table[4 * i + 0], table[4 * i + 1],
+                  table[4 * i + 2], table[4 * i + 3])
+            for i in range(4)
+        ]
+        entry = _sel2(s0, s1, rows[0], rows[1], rows[2], rows[3])
+        r = padd(pdbl(pdbl(p)), entry)
+        return tuple(tuple(e) for e in r)
+
+    p0 = tuple(tuple(e) for e in ident)
+    rx, ry, rz = (list(e) for e in jax.lax.fori_loop(0, NDIGITS, body, p0))
+
+    cz = F.canon(rz)
+    cx = F.canon(rx)
+    t1_fe = words_to_limbs([t1[i] for i in range(NWORDS)])
+    t2_fe = words_to_limbs([t2[i] for i in range(NWORDS)])
+    m1 = F.canon(F.mul(t1_fe, rz))
+    m2 = F.canon(F.mul(t2_fe, rz))
+    ok = (~F.is_zero(cz)) & (F.eq(cx, m1) | F.eq(cx, m2))
+    return ok.astype(jnp.int32)
+
+
+def _verify_tile_kernel(u1_ref, u2_ref, qx_ref, qy_ref, t1_ref, t2_ref, out_ref):
+    out_ref[:] = verify_tile(
+        u1_ref[:], u2_ref[:], qx_ref[:], qy_ref[:], t1_ref[:], t2_ref[:]
+    )
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def secp_verify_kernel(u1_w, u2_w, qx_w, qy_w, t1_w, t2_w,
+                       interpret: bool = False):
+    """Batched ECDSA verify: (8, B)-word inputs -> (B,) bool. B is padded
+    on device to a TILE multiple; padded lanes compute garbage verdicts
+    that are sliced off (complete formulas: junk inputs cannot fault)."""
+    b = u1_w.shape[1]
+    padded = -(-b // TILE) * TILE
+    pad = padded - b
+
+    def shape(w):
+        if pad:
+            w = jnp.pad(w, ((0, 0), (0, pad)))
+        return w.reshape(NWORDS, padded // LANE, LANE)
+
+    grid = (padded // TILE,)
+    word_spec = pl.BlockSpec((NWORDS, SUB, LANE), lambda i: (0, i, 0))
+    row_spec = pl.BlockSpec((SUB, LANE), lambda i: (i, 0))
+    out = pl.pallas_call(
+        _verify_tile_kernel,
+        grid=grid,
+        in_specs=[word_spec] * 6,
+        out_specs=row_spec,
+        out_shape=jax.ShapeDtypeStruct((padded // LANE, LANE), jnp.int32),
+        interpret=interpret,
+    )(
+        shape(u1_w), shape(u2_w), shape(qx_w), shape(qy_w), shape(t1_w),
+        shape(t2_w),
+    )
+    return out.reshape(-1)[:b] != 0

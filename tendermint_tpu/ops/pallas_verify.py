@@ -2,122 +2,151 @@
 
 Same math as ops/ed25519_batch.verify_kernel (radix-4 joint Straus over
 GF(2^255-19) in 12-bit limbs), but compiled as ONE Mosaic kernel per batch
-tile: the 127-iteration loop, its 16-entry table, and every field
-intermediate stay in VMEM for the whole verification instead of
-round-tripping HBM between XLA fusions. The field primitives here are
-written Mosaic-friendly — carries and limb shifts as concatenations, no
-pads or scatters.
+tile so the 127-iteration loop, its 16-entry table, and every field
+intermediate stay in VMEM instead of round-tripping HBM between XLA fusions.
 
-Falls back transparently: ops/__init__ prefers this kernel when pallas
-lowers on the current backend, else the XLA kernel.
+Layout (the perf-critical choice): a field element is a python list of
+NLIMB arrays, each shaped (8, 128) — one full TPU vector register per limb
+(sublanes x lanes = 1024 batch elements per tile). The first kernel kept
+elements as (22, T=128) and every schoolbook product was a (1, 128) row op
+using 1 of 8 sublanes; measured on v5e that left >2x on the floor. In this
+layout every multiply/add/select is a whole-vreg op.
+
+Field/curve constants are baked in as per-limb python-int immediates
+(Mosaic folds scalar splats); there is no constants operand.
+
+Falls back transparently: ops/kcache prefers this kernel on TPU when it
+lowers, else the XLA kernel. CPU tests jit `verify_tile` directly (the
+Pallas interpreter is far too slow for a 127-iteration loop).
+
+Replaces the reference's serial verify loops: types/vote_set.go:189,
+types/validator_set.go:609-627, state/validation.go:99.
 """
 from __future__ import annotations
 
-from functools import partial
+from functools import partial, reduce
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
-from tendermint_tpu.ops import curve, field
-from tendermint_tpu.ops.ed25519_batch import NDIGITS, NWORDS, _B_MULT_CACHED, _B_MULT_POINTS
+from tendermint_tpu.crypto import ed25519_math as em
+from tendermint_tpu.ops import field
+from tendermint_tpu.ops.ed25519_batch import NDIGITS, NWORDS
 from tendermint_tpu.ops.limbs import LIMB_BITS, LIMB_MASK, NLIMB
 
-TILE = 128  # batch lanes per program instance
+TILE = 1024          # batch lanes per kernel instance: 8 sublanes x 128 lanes
+SUB, LANE = 8, 128
 
 FOLD = field.FOLD
-
-# Pallas kernels cannot capture (or create) non-scalar constants — every
-# curve/field constant is packed into ONE (22, 40) int32 operand, column
-# layout: 0 BIAS | 1 NEGP | 2 2d | 3 one | 4-7 identity(x,y,z,t) |
-# 8-23 [i]B points (4 coords each) | 24-39 [i]B cached forms.
+P = field.P
 
 
-def _build_const_cols():
-    import numpy as np
-
-    cols = [field.BIAS, field.NEGP_LIMBS, curve._D2, curve._ONE]
-    cols += list(curve.IDENTITY)
-    for p in _B_MULT_POINTS:
-        cols += list(p)
-    for p in _B_MULT_CACHED:
-        cols += list(p)
-    return np.concatenate([np.asarray(c, dtype=np.int32).reshape(NLIMB, 1) for c in cols], axis=1)
+def _limbs_of(v: int) -> list[int]:
+    return [(v >> (LIMB_BITS * k)) & LIMB_MASK for k in range(NLIMB)]
 
 
-CONST_COLS = _build_const_cols()
-_C_BIAS, _C_NEGP, _C_D2, _C_ONE, _C_IDENT, _C_BPTS, _C_BCACHED = 0, 1, 2, 3, 4, 8, 24
-
-# set per-trace by the kernel body (tracing is single-threaded)
-_CST = None
+BIAS_LIMBS = [int(x) for x in field.BIAS.reshape(-1)]
+NEGP_LIMBS = _limbs_of((1 << (NLIMB * LIMB_BITS)) - P)
+D2_LIMBS = _limbs_of(2 * em.D % P)
 
 
-def _col(j):
-    return _CST[:, j:j + 1]
+def _const_fe(v_limbs, like):
+    """Per-limb scalar constants -> field element broadcast to like's shape."""
+    return [jnp.full_like(like, c) for c in v_limbs]
 
 
-# ------------------------------------------------------------- field (tile)
+# ------------------------------------------------------------------- field
+# A field element is a list of NLIMB int32 arrays of identical shape
+# (one vreg each in-kernel). All ops mirror ops/field.py bit-for-bit.
 
 
 def _carry(c):
-    """One carry pass with top fold (concat form of field.carry_pass)."""
-    cc = c >> LIMB_BITS
-    lo = c & LIMB_MASK
-    return lo + jnp.concatenate([cc[-1:] * FOLD, cc[:-1]], axis=0)
+    """One carry pass with top fold (mirrors field.carry_pass)."""
+    cc = [x >> LIMB_BITS for x in c]
+    lo = [x & LIMB_MASK for x in c]
+    return [lo[0] + cc[NLIMB - 1] * FOLD] + [
+        lo[k] + cc[k - 1] for k in range(1, NLIMB)
+    ]
 
 
-def fmul(a, b):
-    """(22,T) x (22,T) -> (22,T), class-R out (mirrors field.mul).
-
-    The accumulator is (44, T) — row 43 exists solely to receive the carry
-    out of row 42 during the wide passes. (A 43-row variant that kept row 42
-    unmasked overflowed int32 at the FOLD multiply for class-R inputs, where
-    limb 21 can reach ~4120: 4120^2 * 9728 > 2^31. Canonical inputs hid the
-    bug because a canonical limb 21 is <= 7.)"""
-    rows = []
-    for k in range(2 * NLIMB - 1):
-        acc = None
-        for i in range(max(0, k - NLIMB + 1), min(NLIMB - 1, k) + 1):
-            t = a[i:i + 1] * b[k - i:k - i + 1]
-            acc = t if acc is None else acc + t
-        rows.append(acc)
-    zero1 = jnp.zeros_like(rows[0])
-    c = jnp.concatenate(rows + [zero1], axis=0)  # (44, T)
+def _mul_tail(c):
+    """Reduce 44 product columns: two wide passes (column 43 exists to
+    receive the carry out of column 42 — keeping 42 unmasked overflows int32
+    at the FOLD multiply for class-R inputs), fold, four narrow passes.
+    Mirrors field.mul's bound contract exactly."""
+    n2 = 2 * NLIMB
     for _ in range(2):
-        cc = c >> LIMB_BITS
-        lo = c & LIMB_MASK
-        lo = lo + jnp.concatenate([zero1, cc[:-1]], axis=0)
-        # top row accumulates: restore its masked-off high bits
-        c = jnp.concatenate([lo[:-1], lo[-1:] + (cc[-1:] << LIMB_BITS)], axis=0)
-    d = c[:NLIMB] + FOLD * c[NLIMB:]
+        cc = [x >> LIMB_BITS for x in c]
+        lo = [x & LIMB_MASK for x in c]
+        c = [lo[0]] + [lo[k] + cc[k - 1] for k in range(1, n2 - 1)] + [
+            lo[n2 - 1] + cc[n2 - 2] + (cc[n2 - 1] << LIMB_BITS)
+        ]
+    d = [c[k] + FOLD * c[NLIMB + k] for k in range(NLIMB)]
     for _ in range(4):
         d = _carry(d)
     return d
 
 
+def fmul(a, b):
+    """Schoolbook 22x22 -> 43 columns + the _mul_tail reduction."""
+    n2 = 2 * NLIMB
+    c = [None] * n2
+    for i in range(NLIMB):
+        ai = a[i]
+        for j in range(NLIMB):
+            k = i + j
+            p = ai * b[j]
+            c[k] = p if c[k] is None else c[k] + p
+    c[n2 - 1] = jnp.zeros_like(a[0])
+    return _mul_tail(c)
+
+
 def fsq(a):
-    return fmul(a, a)
+    """Squaring: cross products counted once then doubled (253 multiplies
+    vs fmul's 484). Column bound check vs class R (limb0 <= ~24k, others
+    <= ~4120): 2*cross + diag <= 2*(a0*ak + 9*4120^2) + 4120^2 ~= 5.3e8,
+    column 0 = a0^2 <= 5.6e8 — all under 2^31 like fmul's columns."""
+    n2 = 2 * NLIMB
+    c = [None] * n2
+    for i in range(NLIMB):
+        ai = a[i]
+        for j in range(i + 1, NLIMB):
+            k = i + j
+            p = ai * a[j]
+            c[k] = p if c[k] is None else c[k] + p
+    for k in range(n2):
+        if c[k] is not None:
+            c[k] = c[k] + c[k]
+    for i in range(NLIMB):
+        k = 2 * i
+        d = a[i] * a[i]
+        c[k] = d if c[k] is None else c[k] + d
+    c[n2 - 1] = jnp.zeros_like(a[0])
+    return _mul_tail(c)
 
 
 def fadd(a, b):
-    return _carry(a + b)
+    return _carry([x + y for x, y in zip(a, b)])
 
 
 def fsub(a, b):
-    return _carry(a + (_col(_C_BIAS) - b))
+    return _carry([x + (bk - y) for x, y, bk in zip(a, b, BIAS_LIMBS)])
 
 
 def fsel(cond, a, b):
-    """cond (1,T) int32 -> select between (22,T) arrays."""
-    return jnp.where(cond != 0, a, b)
+    """cond: boolean array of the limb shape."""
+    return [jnp.where(cond, x, y) for x, y in zip(a, b)]
 
 
 def _pow2k(a, k):
-    return jax.lax.fori_loop(0, k, lambda _, x: fsq(x), a)
+    return list(
+        jax.lax.fori_loop(0, k, lambda _, x: tuple(fsq(list(x))), tuple(a))
+    )
 
 
 def finv(a):
+    """a^(p-2), standard 25519 chain (mirrors field.inv)."""
     t0 = fsq(a)
     t1 = fsq(fsq(t0))
     t1 = fmul(a, t1)
@@ -135,54 +164,55 @@ def finv(a):
     return fmul(t1, t0)
 
 
-def _concat_rows(parts):
-    """concatenate, dropping zero-row operands (Mosaic rejects (0, T)
-    vector types that XLA silently folds away)."""
-    parts = [p for p in parts if p.shape[0] > 0]
-    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
-
-
 def _seq_carry(a, topfold: bool):
+    a = list(a)
     for k in range(NLIMB - 1):
-        cc = a[k:k + 1] >> LIMB_BITS
-        a = _concat_rows(
-            [a[:k], a[k:k + 1] & LIMB_MASK, a[k + 1:k + 2] + cc, a[k + 2:]]
-        )
+        cc = a[k] >> LIMB_BITS
+        a[k] = a[k] & LIMB_MASK
+        a[k + 1] = a[k + 1] + cc
     if topfold:
-        cc = a[-1:] >> LIMB_BITS
-        a = _concat_rows([a[:1] + cc * FOLD, a[1:-1], a[-1:] & LIMB_MASK])
+        cc = a[NLIMB - 1] >> LIMB_BITS
+        a[NLIMB - 1] = a[NLIMB - 1] & LIMB_MASK
+        a[0] = a[0] + cc * FOLD
     return a
 
 
 def fcanon(a):
-    """Exact canonical digits (mirrors field.canonicalize)."""
+    """Exact canonical digits of (a mod p) (mirrors field.canonicalize)."""
     a = _carry(_carry(a))
     a = _seq_carry(a, True)
     a = _seq_carry(a, True)
     for _ in range(2):
-        hi = a[-1:] >> 3
-        a = jnp.concatenate([a[:1] + hi * 19, a[1:-1], a[-1:] & 0x7], axis=0)
+        hi = a[NLIMB - 1] >> 3
+        a = list(a)
+        a[NLIMB - 1] = a[NLIMB - 1] & 0x7
+        a[0] = a[0] + hi * 19
         a = _seq_carry(a, False)
-    t = a + _col(_C_NEGP)
+    t = [x + nk for x, nk in zip(a, NEGP_LIMBS)]
     for k in range(NLIMB - 1):
-        cc = t[k:k + 1] >> LIMB_BITS
-        t = _concat_rows(
-            [t[:k], t[k:k + 1] & LIMB_MASK, t[k + 1:k + 2] + cc, t[k + 2:]]
-        )
-    overflow = t[-1:] >> LIMB_BITS
-    t = jnp.concatenate([t[:-1], t[-1:] & LIMB_MASK], axis=0)
-    return jnp.where(overflow > 0, t, a)
+        cc = t[k] >> LIMB_BITS
+        t[k] = t[k] & LIMB_MASK
+        t[k + 1] = t[k + 1] + cc
+    overflow = t[NLIMB - 1] >> LIMB_BITS
+    t[NLIMB - 1] = t[NLIMB - 1] & LIMB_MASK
+    return fsel(overflow > 0, t, a)
 
 
-# ------------------------------------------------------------- curve (tile)
+# ------------------------------------------------------------------- curve
+# Points: 4-tuples (X, Y, Z, T) of field elements (RFC 8032 §5.1.4 complete
+# a=-1 twisted-Edwards formulas); cached addends: (Y-X, Y+X, 2d*T, 2Z).
+
 
 def to_cached(p):
     x, y, z, t = p
-    d2 = jnp.broadcast_to(_col(_C_D2), t.shape)
+    d2 = _const_fe(D2_LIMBS, t[0])
     return (fsub(y, x), fadd(y, x), fmul(t, d2), fadd(z, z))
 
 
-def add_cached(p, q):
+def add_cached(p, q, need_t: bool = True):
+    """P + Q with Q cached. The Straus loop's adds pass need_t=False: the
+    result's T is consumed by nothing (doubles don't read T), saving the
+    e*h multiply."""
     x, y, z, t = p
     ymx, ypx, t2d, z2 = q
     a = fmul(fsub(y, x), ymx)
@@ -193,10 +223,13 @@ def add_cached(p, q):
     f = fsub(d, c)
     g = fadd(d, c)
     h = fadd(b, a)
-    return (fmul(e, f), fmul(g, h), fmul(f, g), fmul(e, h))
+    t_out = fmul(e, h) if need_t else None
+    return (fmul(e, f), fmul(g, h), fmul(f, g), t_out)
 
 
-def pdouble(p):
+def pdouble(p, need_t: bool = True):
+    """Doubling never reads P's T; the first of two chained doubles also
+    skips producing it (only the cached-add consumes T)."""
     x, y, z, _ = p
     a = fsq(x)
     b = fsq(y)
@@ -206,7 +239,8 @@ def pdouble(p):
     e = fsub(h, fsq(fadd(x, y)))
     g = fsub(a, b)
     f = fadd(c, g)
-    return (fmul(e, f), fmul(g, h), fmul(f, g), fmul(e, h))
+    t_out = fmul(e, h) if need_t else None
+    return (fmul(e, f), fmul(g, h), fmul(f, g), t_out)
 
 
 def csel(cond, a, b):
@@ -219,40 +253,47 @@ def _sel2(b0, b1, e0, e1, e2, e3):
     return csel(b1, hi, lo)
 
 
+# -------------------------------------------- compile-time [i]B constants
+
+
+def _b_mult_limbs():
+    """[0..3]B as per-limb python ints: (points affine-extended, cached)."""
+    pts, cached = [], []
+    bx, by = em.BASE_X, em.BASE_Y
+    d2 = 2 * em.D % P
+    cur = None
+    raw = [(0, 1, 1, 0)]
+    for _ in range(3):
+        nxt = (bx, by, 1, bx * by % P)
+        cur = nxt if cur is None else em.point_add(cur, nxt)
+        raw.append(cur)
+    for (x, y, z, t) in raw:
+        zi = pow(z, P - 2, P)
+        xa, ya = x * zi % P, y * zi % P
+        ta = xa * ya % P
+        pts.append(tuple(_limbs_of(v) for v in (xa, ya, 1, ta)))
+        cached.append(
+            tuple(
+                _limbs_of(v)
+                for v in ((ya - xa) % P, (ya + xa) % P, ta * d2 % P, 2)
+            )
+        )
+    return pts, cached
+
+
+_B_PTS_LIMBS, _B_CACHED_LIMBS = _b_mult_limbs()
+IDENT_LIMBS = tuple(_limbs_of(v) for v in (0, 1, 1, 0))
+
+
 # ------------------------------------------------------------- the kernel
 
 
-def _words_to_limbs(w):
-    """(8, T) int32 -> (22, T), all-int32 (Mosaic rejects uint ops): the
-    arithmetic right shift sign-extends, so when the limb straddles a word
-    boundary the low word's field is masked to its true width before OR-ing
-    in the high word's bits."""
-    limbs = []
-    for k in range(NLIMB):
-        lo_bit = LIMB_BITS * k
-        a, s = lo_bit // 32, lo_bit % 32
-        v = w[a:a + 1] >> s
-        if s > 32 - LIMB_BITS and a + 1 < NWORDS:
-            v = (v & ((1 << (32 - s)) - 1)) | (w[a + 1:a + 2] << (32 - s))
-        limbs.append(v & LIMB_MASK)
-    return jnp.concatenate(limbs, axis=0)
-
-
-def _word_rows(w):
-    """(8, T) int32 -> list of 8 (1, T) int32 rows (static slices)."""
-    return [w[i:i + 1] for i in range(NWORDS)]
-
-
 def _digit_at(w_rows, d):
-    """2-bit digit d (traced scalar) of scalars packed in 8 int32 rows.
-
-    Mosaic cannot lower a dynamic_slice over a (127, T) digit array inside
-    the loop (the round-1 dead-code failure mode), so the digit is computed
-    arithmetically: one-hot select of the word row (8 static rows, scalar
-    conditions) followed by a variable shift. All int32: the arithmetic
-    shift's sign extension only reaches bits >= 2 even at the maximum shift
-    of 30, and `& 3` discards them.
-    """
+    """2-bit digit d (traced scalar) of scalars packed in 8 little-endian
+    int32 word arrays. Computed arithmetically — Mosaic cannot lower a
+    dynamic_slice over a (127, ...) digit array inside the loop. All int32:
+    the arithmetic shift's sign extension only reaches bits >= 2 even at
+    the maximum shift of 30, and `& 3` discards them."""
     wi = d // 16
     sh = 2 * (d % 16)
     acc = w_rows[0]
@@ -261,37 +302,43 @@ def _digit_at(w_rows, d):
     return (acc >> sh) & 3
 
 
-def _bcol(j, t):
-    return jnp.broadcast_to(_col(j), (NLIMB, t))
+def _words_to_limbs(w_rows):
+    """8 int32 word arrays -> 22-limb field element. The arithmetic right
+    shift sign-extends, so where a limb straddles a word boundary the low
+    word's field is masked to its true width before OR-ing the high word."""
+    limbs = []
+    for k in range(NLIMB):
+        lo_bit = LIMB_BITS * k
+        a, s = lo_bit // 32, lo_bit % 32
+        v = w_rows[a] >> s
+        if s > 32 - LIMB_BITS and a + 1 < NWORDS:
+            v = (v & ((1 << (32 - s)) - 1)) | (w_rows[a + 1] << (32 - s))
+        limbs.append(v & LIMB_MASK)
+    return limbs
 
 
-def _verify_tile_kernel(cst_ref, ax_ref, ay_ref, at_ref, s_ref, h_ref, yr_ref, par_ref, out_ref):
-    out_ref[:] = verify_tile(
-        cst_ref[:], ax_ref[:], ay_ref[:], at_ref[:], s_ref[:], h_ref[:],
-        yr_ref[:], par_ref[:],
-    )
+def verify_tile(ax, ay, at, s, h, yr, par):
+    """The whole per-tile verification as a pure array function.
 
+    ax/ay/at/s/h/yr: (NWORDS, *S) int32 little-endian words (-A affine
+    extended coords with Z=1, scalars S and h, R's y); par: (*S,) int32 sign
+    bits. *S is any array shape — (8, 128) in-kernel, (1, T) in CPU tests.
+    Returns (*S,) int32 verdicts. Mirrors ed25519_batch.verify_kernel.
+    """
+    ax_r = [ax[i] for i in range(NWORDS)]
+    ay_r = [ay[i] for i in range(NWORDS)]
+    at_r = [at[i] for i in range(NWORDS)]
+    s_rows = [s[i] for i in range(NWORDS)]
+    h_rows = [h[i] for i in range(NWORDS)]
+    like = ax_r[0]
 
-def verify_tile(cst, ax, ay, at, s, h, yr, par):
-    """The whole per-tile verification as a pure array function: (22, NC)
-    constants + (8, T) word arrays + (1, T) parity -> (1, T) int32 verdicts.
-    The Pallas kernel wraps this with ref loads/stores; tests jit it directly
-    on CPU to validate the math without the (slow) Pallas interpreter."""
-    global _CST
-    _CST = cst
-    t = ax.shape[1]
-    one = _bcol(_C_ONE, t)
-    neg_a = (_words_to_limbs(ax), _words_to_limbs(ay), one,
-             _words_to_limbs(at))
-    s_rows = _word_rows(s)
-    h_rows = _word_rows(h)
+    one = _const_fe(_limbs_of(1), like)
+    neg_a = (_words_to_limbs(ax_r), _words_to_limbs(ay_r), one,
+             _words_to_limbs(at_r))
 
-    # 16-entry table [i]B + [j](-A)
-    b_pts = [
-        tuple(_bcol(_C_BPTS + 4 * i + j, t) for j in range(4)) for i in range(4)
-    ]
+    # 16-entry table [s2]B + [h2](-A), cached form
     b_cached = [
-        tuple(_bcol(_C_BCACHED + 4 * i + j, t) for j in range(4)) for i in range(4)
+        tuple(_const_fe(l, like) for l in c) for c in _B_CACHED_LIMBS
     ]
     ca1 = to_cached(neg_a)
     a2 = pdouble(neg_a)
@@ -307,57 +354,83 @@ def verify_tile(cst, ax, ay, at, s, h, yr, par):
             else:
                 table.append(to_cached(add_cached(a_pts[h2], b_cached[s2])))
 
-    p0 = tuple(_bcol(_C_IDENT + j, t) for j in range(4))
+    # loop carry is (X, Y, Z) only: T of the running point is produced by
+    # the second double and consumed inside the same iteration's add
+    p0 = tuple(tuple(_const_fe(l, like)) for l in IDENT_LIMBS[:3])
 
     def body(i, p):
         d = NDIGITS - 1 - i
         sd = _digit_at(s_rows, d)
         hd = _digit_at(h_rows, d)
-        s0, s1 = sd & 1, sd >> 1
-        h0, h1 = hd & 1, hd >> 1
+        s0, s1 = (sd & 1) != 0, (sd >> 1) != 0
+        h0, h1 = (hd & 1) != 0, (hd >> 1) != 0
         rows = [
             _sel2(h0, h1, table[4 * s2 + 0], table[4 * s2 + 1],
                   table[4 * s2 + 2], table[4 * s2 + 3])
             for s2 in range(4)
         ]
         entry = _sel2(s0, s1, rows[0], rows[1], rows[2], rows[3])
-        return add_cached(pdouble(pdouble(p)), entry)
+        x, y, z = p
+        d1 = pdouble((list(x), list(y), list(z), None), need_t=False)
+        d2 = pdouble(d1, need_t=True)
+        r = add_cached(d2, entry, need_t=False)
+        return tuple(tuple(e) for e in r[:3])
 
     rp = jax.lax.fori_loop(0, NDIGITS, body, p0)
 
-    x, y, z, _ = rp
+    x, y, z = (list(e) for e in rp)
     zi = finv(z)
     xa = fcanon(fmul(x, zi))
     ya = fcanon(fmul(y, zi))
-    y_r = fcanon(_words_to_limbs(yr))
-    y_eq = jnp.all(ya == y_r, axis=0, keepdims=True)
-    par_ok = (xa[0:1] & 1) == par
+    y_r = fcanon(_words_to_limbs([yr[i] for i in range(NWORDS)]))
+    y_eq = reduce(
+        jnp.logical_and, [p == q for p, q in zip(ya, y_r)]
+    )
+    par_ok = (xa[0] & 1) == par
     return (y_eq & par_ok).astype(jnp.int32)
+
+
+def _verify_tile_kernel(ax_ref, ay_ref, at_ref, s_ref, h_ref, yr_ref,
+                        par_ref, out_ref):
+    out_ref[:] = verify_tile(
+        ax_ref[:], ay_ref[:], at_ref[:], s_ref[:], h_ref[:], yr_ref[:],
+        par_ref[:],
+    )
 
 
 @partial(jax.jit, static_argnames=("interpret",))
 def pallas_verify_kernel(a_x_w, a_y_w, a_t_w, s_w, h_w, yr_w, x_parity,
                          interpret: bool = False):
-    """Drop-in for ed25519_batch.verify_kernel: same inputs, (B,) bool out.
-    B must be a multiple of TILE (prepare_batch buckets guarantee it for
-    min_bucket >= TILE). interpret=True runs the Pallas interpreter (any
-    backend) — the CPU test path."""
+    """Drop-in for ed25519_batch.verify_kernel: same (8, B)-word inputs,
+    (B,) bool out. B is padded on device to a TILE multiple; padded lanes
+    compute garbage verdicts that are sliced off (the formulas are complete,
+    so junk inputs cannot fault)."""
     b = s_w.shape[1]
-    assert b % TILE == 0, f"batch {b} not a multiple of {TILE}"
-    grid = (b // TILE,)
-    cst_spec = pl.BlockSpec((NLIMB, CONST_COLS.shape[1]), lambda i: (0, 0))
-    word_spec = pl.BlockSpec((NWORDS, TILE), lambda i: (0, i))
-    row_spec = pl.BlockSpec((1, TILE), lambda i: (0, i))
+    padded = -(-b // TILE) * TILE
+    pad = padded - b
+
+    def shape(w):  # (8, B) -> (8, rows, 128): row-major, so lanes stay put
+        if pad:
+            w = jnp.pad(w, ((0, 0), (0, pad)))
+        return w.reshape(NWORDS, padded // LANE, LANE)
+
+    par = x_parity.astype(jnp.int32)
+    if pad:
+        par = jnp.pad(par, (0, pad))
+    par = par.reshape(padded // LANE, LANE)
+
+    grid = (padded // TILE,)
+    word_spec = pl.BlockSpec((NWORDS, SUB, LANE), lambda i: (0, i, 0))
+    row_spec = pl.BlockSpec((SUB, LANE), lambda i: (i, 0))
     out = pl.pallas_call(
         _verify_tile_kernel,
         grid=grid,
-        in_specs=[cst_spec] + [word_spec] * 6 + [row_spec],
+        in_specs=[word_spec] * 6 + [row_spec],
         out_specs=row_spec,
-        out_shape=jax.ShapeDtypeStruct((1, b), jnp.int32),
+        out_shape=jax.ShapeDtypeStruct((padded // LANE, LANE), jnp.int32),
         interpret=interpret,
     )(
-        jnp.asarray(CONST_COLS),
-        a_x_w, a_y_w, a_t_w, s_w, h_w, yr_w,
-        x_parity.reshape(1, -1).astype(jnp.int32),
+        shape(a_x_w), shape(a_y_w), shape(a_t_w), shape(s_w), shape(h_w),
+        shape(yr_w), par,
     )
-    return out.reshape(-1) != 0
+    return out.reshape(-1)[:b] != 0

@@ -1,17 +1,28 @@
-"""Address book: known peer addresses with quality tracking.
+"""Address book: hashed-bucket peer address manager.
 
-Reference parity: p2p/pex/addrbook.go — file-backed book of peer addresses
-split into "new" (heard about) and "old" (vetted: we connected at least once)
-buckets, with attempt counting, bias-toward-vetted random picking for dialing,
-and random selections for PEX responses. The reference's 256/64 hashed bucket
-scheme exists to bound memory and resist address-flooding; here the same
-goals are met with two flat dicts capped in size (the eviction policy —
-drop the unvetted address with the most failed dial attempts — matches the
-reference's spirit without the per-bucket bookkeeping).
+Reference parity: p2p/pex/addrbook.go (btcd lineage) — addresses live in
+256 "new" buckets (heard about) and 64 "old" buckets (vetted: we connected
+at least once), 64 entries each. Placement is keyed by a per-book random
+key and the /16 network group:
+
+  new bucket = H(key + group(addr) + group(src)) % 32 -> H(key + group(src)
+               + that) % 256   (addrbook.go:731 calcNewBucket)
+  old bucket = H(key + addr) % 4 -> H(key + group(addr) + that) % 64
+               (addrbook.go:750 calcOldBucket)
+
+so one source group can influence at most 32 of the 256 new buckets and an
+address group at most 4 of the 64 old buckets — the eclipse-resistance
+property a flat dict cannot give. A new address may be added from up to 4
+sources (maxNewBucketsPerAddress, probabilistically decayed); full new
+buckets expire bad entries then the oldest (expireNew, addrbook.go:674);
+promoting into a full old bucket demotes that bucket's oldest back to a new
+bucket (moveToOld, addrbook.go:692).
 """
 from __future__ import annotations
 
+import hashlib
 import json
+import math
 import os
 import random
 import time
@@ -19,130 +30,370 @@ from dataclasses import dataclass, field
 
 from tendermint_tpu.p2p.netaddress import NetAddress
 
-MAX_NEW_ADDRS = 1024
-MAX_OLD_ADDRS = 512
-GET_SELECTION_MAX = 32
+# reference p2p/pex/params.go
+NEW_BUCKET_COUNT = 256
+NEW_BUCKET_SIZE = 64
+NEW_BUCKETS_PER_GROUP = 32
+OLD_BUCKET_COUNT = 64
+OLD_BUCKET_SIZE = 64
+OLD_BUCKETS_PER_GROUP = 4
+MAX_NEW_BUCKETS_PER_ADDRESS = 4
+NEED_ADDRESS_THRESHOLD = 1000
+GET_SELECTION_PERCENT = 23
+MIN_GET_SELECTION = 32
+MAX_GET_SELECTION = 250
+NUM_MISSING_DAYS = 7
+NUM_RETRIES = 3
+MAX_FAILURES = 10
+MIN_BAD_DAYS = 7
+
+BUCKET_TYPE_NEW = 1
+BUCKET_TYPE_OLD = 2
+
+
+def _double_sha256(data: bytes) -> bytes:
+    return hashlib.sha256(hashlib.sha256(data).digest()).digest()
 
 
 @dataclass
 class _KnownAddress:
     addr: NetAddress
-    src_id: str = ""
+    src: NetAddress | None = None
     attempts: int = 0
     last_attempt: float = 0.0
     last_success: float = 0.0
-    is_old: bool = False  # vetted: connected successfully at least once
+    bucket_type: int = BUCKET_TYPE_NEW
+    buckets: list = field(default_factory=list)
+
+    @property
+    def is_old(self) -> bool:
+        return self.bucket_type == BUCKET_TYPE_OLD
+
+    def is_bad(self, now: float | None = None) -> bool:
+        """Reference known_address.go:99 isBad."""
+        if self.is_old:
+            return False
+        now = time.time() if now is None else now
+        if self.last_attempt > now - 60:
+            return False  # attempted in the last minute
+        if self.last_attempt < now - NUM_MISSING_DAYS * 86400:
+            return True  # not seen in a week
+        if self.last_success == 0.0 and self.attempts >= NUM_RETRIES:
+            return True  # never succeeded
+        if (
+            self.last_success < now - MIN_BAD_DAYS * 86400
+            and self.attempts >= MAX_FAILURES
+        ):
+            return True
+        return False
 
     def to_json(self) -> dict:
         return {
             "addr": str(self.addr),
-            "src_id": self.src_id,
+            "src": str(self.src) if self.src else "",
             "attempts": self.attempts,
             "last_attempt": self.last_attempt,
             "last_success": self.last_success,
-            "is_old": self.is_old,
+            "bucket_type": self.bucket_type,
+            "buckets": self.buckets,
         }
 
     @classmethod
     def from_json(cls, d: dict) -> "_KnownAddress":
         return cls(
             addr=NetAddress.parse(d["addr"]),
-            src_id=d.get("src_id", ""),
+            src=NetAddress.parse(d["src"]) if d.get("src") else None,
             attempts=d.get("attempts", 0),
             last_attempt=d.get("last_attempt", 0.0),
             last_success=d.get("last_success", 0.0),
-            is_old=d.get("is_old", False),
+            bucket_type=d.get("bucket_type", BUCKET_TYPE_NEW),
+            buckets=list(d.get("buckets", [])),
         )
 
 
 class AddrBook:
-    def __init__(self, file_path: str | None = None, our_ids: set[str] | None = None):
-        self._addrs: dict[str, _KnownAddress] = {}  # node_id -> entry
+    def __init__(self, file_path: str | None = None, our_ids: set[str] | None = None,
+                 routability_strict: bool = False):
         self.file_path = file_path
         self.our_ids = our_ids or set()
+        self.routability_strict = routability_strict
+        self.key = os.urandom(12).hex()  # bucket-placement key
+        self._lookup: dict[str, _KnownAddress] = {}  # node_id -> entry
+        self._new: list[dict[str, _KnownAddress]] = [
+            {} for _ in range(NEW_BUCKET_COUNT)
+        ]
+        self._old: list[dict[str, _KnownAddress]] = [
+            {} for _ in range(OLD_BUCKET_COUNT)
+        ]
+        self.n_new = 0
+        self.n_old = 0
         if file_path and os.path.exists(file_path):
             self.load(file_path)
 
     def __len__(self) -> int:
-        return len(self._addrs)
+        return self.n_new + self.n_old
 
-    def add_address(self, addr: NetAddress, src_id: str = "") -> bool:
-        """Record a heard-about address; returns True if newly added."""
-        if not addr.id or addr.id in self.our_ids or addr.port == 0:
+    # --- bucket placement (reference addrbook.go:731-767) ----------------
+
+    def group_key(self, addr: NetAddress) -> str:
+        """/16 network group for IPv4, host otherwise (addrbook.go:771;
+        "local"/"unroutable" classes only matter with routability_strict)."""
+        parts = addr.host.split(".")
+        if len(parts) == 4 and all(p.isdigit() and int(p) < 256 for p in parts):
+            if self.routability_strict and (
+                parts[0] == "127" or parts[0] == "10" or addr.host == "0.0.0.0"
+            ):
+                return "local"
+            return f"{parts[0]}.{parts[1]}"
+        return addr.host
+
+    def _calc_new_bucket(self, addr: NetAddress, src: NetAddress | None) -> int:
+        key = self.key.encode()
+        src_group = self.group_key(src if src is not None else addr).encode()
+        h1 = _double_sha256(key + self.group_key(addr).encode() + src_group)
+        h64 = int.from_bytes(h1[:8], "big") % NEW_BUCKETS_PER_GROUP
+        h2 = _double_sha256(key + src_group + h64.to_bytes(8, "big"))
+        return int.from_bytes(h2[:8], "big") % NEW_BUCKET_COUNT
+
+    def _calc_old_bucket(self, addr: NetAddress) -> int:
+        key = self.key.encode()
+        h1 = _double_sha256(key + str(addr).encode())
+        h64 = int.from_bytes(h1[:8], "big") % OLD_BUCKETS_PER_GROUP
+        h2 = _double_sha256(
+            key + self.group_key(addr).encode() + h64.to_bytes(8, "big")
+        )
+        return int.from_bytes(h2[:8], "big") % OLD_BUCKET_COUNT
+
+    # --- bucket mutation --------------------------------------------------
+
+    def _add_to_new_bucket(self, ka: _KnownAddress, idx: int) -> None:
+        """Reference addrbook.go:469."""
+        if ka.is_old:
+            return
+        if idx in ka.buckets:
+            return
+        if len(self._new[idx]) >= NEW_BUCKET_SIZE:
+            self._expire_new(idx)
+        if not ka.buckets:
+            self.n_new += 1
+            self._lookup[ka.addr.id] = ka
+        ka.buckets.append(idx)
+        self._new[idx][ka.addr.id] = ka
+
+    def _add_to_old_bucket(self, ka: _KnownAddress, idx: int) -> bool:
+        """Reference addrbook.go:502 — False when the bucket is full."""
+        if ka.buckets:
             return False
-        known = self._addrs.get(addr.id)
-        if known is not None:
-            if not known.is_old:
-                known.addr = addr  # refresh endpoint for unvetted entries
+        if len(self._old[idx]) >= OLD_BUCKET_SIZE:
             return False
-        self._evict_if_full()
-        self._addrs[addr.id] = _KnownAddress(addr=addr, src_id=src_id)
+        self._old[idx][ka.addr.id] = ka
+        ka.buckets = [idx]
+        self.n_old += 1
+        self._lookup[ka.addr.id] = ka
         return True
 
-    def _evict_if_full(self) -> None:
-        new = [k for k in self._addrs.values() if not k.is_old]
-        if len(new) >= MAX_NEW_ADDRS:
-            victim = max(new, key=lambda k: k.attempts)
-            del self._addrs[victim.addr.id]
+    def _remove_from_bucket(self, ka: _KnownAddress, idx: int) -> None:
+        bucket = self._old[idx] if ka.is_old else self._new[idx]
+        bucket.pop(ka.addr.id, None)
+        if idx in ka.buckets:
+            ka.buckets.remove(idx)
+        if not ka.buckets:
+            self._lookup.pop(ka.addr.id, None)
+            if ka.is_old:
+                self.n_old -= 1
+            else:
+                self.n_new -= 1
+
+    def _remove_from_all_buckets(self, ka: _KnownAddress) -> None:
+        for idx in list(ka.buckets):
+            self._remove_from_bucket(ka, idx)
+
+    def _pick_oldest(self, buckets, idx: int) -> _KnownAddress | None:
+        bucket = buckets[idx]
+        oldest = None
+        for ka in bucket.values():
+            if oldest is None or ka.last_attempt < oldest.last_attempt:
+                oldest = ka
+        return oldest
+
+    def _expire_new(self, idx: int) -> None:
+        """Reference addrbook.go:674 — drop a bad entry, else the oldest."""
+        for ka in list(self._new[idx].values()):
+            if ka.is_bad():
+                self._remove_from_bucket(ka, idx)
+                return
+        oldest = self._pick_oldest(self._new, idx)
+        if oldest is not None:
+            self._remove_from_bucket(oldest, idx)
+
+    def _move_to_old(self, ka: _KnownAddress) -> None:
+        """Reference addrbook.go:692 — promote; a full old bucket demotes
+        its oldest entry back to a new bucket."""
+        if ka.is_old:
+            return
+        self._remove_from_all_buckets(ka)
+        ka.bucket_type = BUCKET_TYPE_OLD
+        idx = self._calc_old_bucket(ka.addr)
+        if not self._add_to_old_bucket(ka, idx):
+            oldest = self._pick_oldest(self._old, idx)
+            if oldest is not None:
+                self._remove_from_bucket(oldest, idx)
+                oldest.bucket_type = BUCKET_TYPE_NEW
+                oldest.buckets = []
+                self._add_to_new_bucket(
+                    oldest, self._calc_new_bucket(oldest.addr, oldest.src)
+                )
+            self._add_to_old_bucket(ka, idx)
+
+    # --- public API -------------------------------------------------------
+
+    def add_address(
+        self, addr: NetAddress, src: NetAddress | None = None, src_id: str = ""
+    ) -> bool:
+        """Record a heard-about address (reference addrbook.go:587
+        addAddress). Returns True if the book gained a new entry."""
+        if not addr.id or addr.id in self.our_ids or addr.port == 0:
+            return False
+        if src is None and src_id:
+            src = NetAddress(src_id, addr.host, addr.port)
+        ka = self._lookup.get(addr.id)
+        if ka is not None:
+            if ka.is_old:
+                return False
+            # a reappearing unvetted node may have moved: refresh endpoint
+            if ka.addr != addr:
+                ka.addr = addr
+            # already in max new buckets, or probabilistic decay
+            if len(ka.buckets) >= MAX_NEW_BUCKETS_PER_ADDRESS:
+                return False
+            if random.randrange(2 * len(ka.buckets)) != 0:
+                return False
+        else:
+            ka = _KnownAddress(addr=addr, src=src, last_attempt=time.time())
+        before = addr.id in self._lookup
+        # bucket keyed by THIS call's reporting source (addrbook.go:640):
+        # each new reporter can land the address in a different new bucket,
+        # which is where the multi-source redundancy comes from
+        self._add_to_new_bucket(ka, self._calc_new_bucket(addr, src))
+        return not before
 
     def remove_address(self, addr: NetAddress) -> None:
-        self._addrs.pop(addr.id, None)
+        ka = self._lookup.get(addr.id)
+        if ka is not None:
+            self._remove_from_all_buckets(ka)
 
     def mark_attempt(self, addr: NetAddress) -> None:
-        k = self._addrs.get(addr.id)
-        if k is not None:
-            k.attempts += 1
-            k.last_attempt = time.time()
+        ka = self._lookup.get(addr.id)
+        if ka is not None:
+            ka.attempts += 1
+            ka.last_attempt = time.time()
 
     def mark_good(self, addr: NetAddress) -> None:
-        """Successful connection: promote to the vetted ("old") set."""
-        k = self._addrs.get(addr.id)
-        if k is None:
+        """Successful connection: reset counters and promote to old
+        (reference MarkGood -> moveToOld)."""
+        ka = self._lookup.get(addr.id)
+        if ka is None:
             if not addr.id or addr.id in self.our_ids or addr.port == 0:
                 return
-            k = _KnownAddress(addr=addr)
-            self._addrs[addr.id] = k
-        k.attempts = 0
-        k.last_success = time.time()
-        k.is_old = True
-        old = [a for a in self._addrs.values() if a.is_old]
-        if len(old) > MAX_OLD_ADDRS:
-            victim = min(old, key=lambda a: a.last_success)
-            del self._addrs[victim.addr.id]
+            ka = _KnownAddress(addr=addr, last_attempt=time.time())
+            self._add_to_new_bucket(ka, self._calc_new_bucket(addr, None))
+        now = time.time()
+        ka.attempts = 0
+        ka.last_attempt = now
+        ka.last_success = now
+        if not ka.is_old:
+            self._move_to_old(ka)
 
     def mark_bad(self, addr: NetAddress) -> None:
         self.remove_address(addr)
 
+    def need_more_addrs(self) -> bool:
+        return len(self) < NEED_ADDRESS_THRESHOLD
+
     def pick_address(self, new_bias_pct: int = 30, exclude: set[str] | None = None
                      ) -> NetAddress | None:
-        """Random address to dial; biased toward vetted addresses
-        (reference addrbook.go PickAddress: bias is % chance of a new addr)."""
+        """Random address to dial: random non-empty bucket, then random
+        entry, sqrt-weighted between old and new by the bias (reference
+        addrbook.go:249 PickAddress; `exclude` is our addition for the
+        dialing loop, handled by restricting to available buckets)."""
         exclude = exclude or set()
-        cands = [k for k in self._addrs.values() if k.addr.id not in exclude]
-        if not cands:
+        new_bias_pct = max(0, min(100, new_bias_pct))
+        # buckets that still contain a non-excluded candidate
+        avail_new: dict[int, list] = {}
+        avail_old: dict[int, list] = {}
+        n_new_avail = n_old_avail = 0
+        for ka in self._lookup.values():
+            if ka.addr.id in exclude:
+                continue
+            tgt = avail_old if ka.is_old else avail_new
+            tgt.setdefault(ka.buckets[0] if ka.buckets else 0, []).append(ka)
+            if ka.is_old:
+                n_old_avail += 1
+            else:
+                n_new_avail += 1
+        if n_new_avail + n_old_avail == 0:
             return None
-        new = [k for k in cands if not k.is_old]
-        old = [k for k in cands if k.is_old]
-        pool = new if (not old or (new and random.random() * 100 < new_bias_pct)) else old
-        return random.choice(pool).addr if pool else None
+        old_cor = math.sqrt(n_old_avail) * (100.0 - new_bias_pct)
+        new_cor = math.sqrt(n_new_avail) * new_bias_pct
+        pick_old = (new_cor + old_cor) * random.random() < old_cor
+        if pick_old and not avail_old:
+            pick_old = False
+        if not pick_old and not avail_new:
+            pick_old = True
+        buckets = avail_old if pick_old else avail_new
+        bucket = random.choice(list(buckets.values()))
+        return random.choice(bucket).addr
 
-    def get_selection(self, max_n: int = GET_SELECTION_MAX) -> list[NetAddress]:
-        """Random subset for a PEX response."""
-        addrs = [k.addr for k in self._addrs.values()]
+    def get_selection(self, max_n: int = MAX_GET_SELECTION) -> list[NetAddress]:
+        """Random subset for a PEX response (reference GetSelection:
+        23% of the book, clamped to [32, 250])."""
+        size = len(self)
+        if size == 0:
+            return []
+        n = max(min(MIN_GET_SELECTION, size), size * GET_SELECTION_PERCENT // 100)
+        n = min(n, max_n, MAX_GET_SELECTION)
+        addrs = [ka.addr for ka in self._lookup.values()]
         random.shuffle(addrs)
-        return addrs[:max_n]
+        return addrs[:n]
+
+    def get_selection_with_bias(self, new_bias_pct: int = 30) -> list[NetAddress]:
+        """Reference GetSelectionWithBias (addrbook.go:384) — seed nodes
+        answer crawls with a controlled new/old mix."""
+        size = len(self)
+        if size == 0:
+            return []
+        new_bias_pct = max(0, min(100, new_bias_pct))
+        n = max(min(MIN_GET_SELECTION, size), size * GET_SELECTION_PERCENT // 100)
+        n = min(n, MAX_GET_SELECTION)
+        required_new = max(n * new_bias_pct // 100, n - self.n_old)
+        new_addrs = [
+            ka.addr for b in self._new for ka in b.values()
+        ]
+        old_addrs = [
+            ka.addr for b in self._old for ka in b.values()
+        ]
+        random.shuffle(new_addrs)
+        random.shuffle(old_addrs)
+        sel = new_addrs[:required_new]
+        sel += old_addrs[: n - len(sel)]
+        if len(sel) < n:  # not enough old: top up with more new
+            sel += new_addrs[required_new : required_new + n - len(sel)]
+        return sel
 
     def is_good(self, addr: NetAddress) -> bool:
-        k = self._addrs.get(addr.id)
-        return bool(k and k.is_old)
+        ka = self._lookup.get(addr.id)
+        return bool(ka and ka.is_old)
 
-    # --- persistence -----------------------------------------------------
+    # --- persistence ------------------------------------------------------
 
     def save(self, path: str | None = None) -> None:
         path = path or self.file_path
         if not path:
             return
-        doc = {"addrs": [k.to_json() for k in self._addrs.values()]}
+        doc = {
+            "key": self.key,
+            "addrs": [ka.to_json() for ka in self._lookup.values()],
+        }
         tmp = path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(doc, f)
@@ -151,7 +402,29 @@ class AddrBook:
     def load(self, path: str) -> None:
         with open(path, encoding="utf-8") as f:
             doc = json.load(f)
+        self.key = doc.get("key", self.key)
         for d in doc.get("addrs", []):
-            k = _KnownAddress.from_json(d)
-            if k.addr.id not in self.our_ids:
-                self._addrs[k.addr.id] = k
+            ka = _KnownAddress.from_json(d)
+            if ka.addr.id in self.our_ids:
+                continue
+            # stored indices come from an untrusted file: out-of-range ones
+            # (corruption, changed bucket-count params) are re-derived
+            buckets = [
+                idx
+                for idx in ka.buckets
+                if isinstance(idx, int)
+                and 0 <= idx < (OLD_BUCKET_COUNT if ka.is_old else NEW_BUCKET_COUNT)
+            ]
+            ka.buckets = []
+            if ka.is_old:
+                restored = False
+                for idx in buckets[:1] or [self._calc_old_bucket(ka.addr)]:
+                    restored = self._add_to_old_bucket(ka, idx)
+                if not restored:
+                    ka.bucket_type = BUCKET_TYPE_NEW
+                    self._add_to_new_bucket(
+                        ka, self._calc_new_bucket(ka.addr, ka.src)
+                    )
+            else:
+                for idx in buckets or [self._calc_new_bucket(ka.addr, ka.src)]:
+                    self._add_to_new_bucket(ka, idx)

@@ -84,7 +84,9 @@ class PexReactor(BaseReactor):
             # ask peers we chose to dial (reference pex_reactor.go AddPeer)
             await self._request_addrs(peer)
         elif peer.socket_addr is not None and peer.socket_addr.id:
-            self.book.add_address(peer.socket_addr, src_id=peer.id)
+            self.book.add_address(
+                peer.socket_addr, src=peer.socket_addr, src_id=peer.id
+            )
 
     async def remove_peer(self, peer, reason) -> None:
         self._last_request_from.pop(peer.id, None)
@@ -111,7 +113,14 @@ class PexReactor(BaseReactor):
                 )
                 return
             self._last_request_from[peer.id] = now
-            await peer.send(PEX_CHANNEL, encode_addrs(self.book.get_selection()))
+            # seeds answer crawls with a controlled new/old mix (reference
+            # pex_reactor.go SendAddrs + GetSelectionWithBias)
+            sel = (
+                self.book.get_selection_with_bias(30)
+                if self.seed_mode
+                else self.book.get_selection()
+            )
+            await peer.send(PEX_CHANNEL, encode_addrs(sel))
             if self.seed_mode:
                 await self.switch.stop_peer_gracefully(peer)
         else:  # addrs
@@ -120,7 +129,9 @@ class PexReactor(BaseReactor):
                 return
             self._requested_of.discard(peer.id)
             for addr in payload:
-                self.book.add_address(addr, src_id=peer.id)
+                # src = the peer that told us: keys the hashed-bucket
+                # placement so one source group maps to few buckets
+                self.book.add_address(addr, src=peer.socket_addr, src_id=peer.id)
 
     async def _ensure_peers_routine(self) -> None:
         while True:

@@ -33,17 +33,27 @@ class LocalClientCreator(ClientCreator):
 
 
 class RemoteClientCreator(ClientCreator):
-    """Socket connection to an external app process (reference
-    NewRemoteClientCreator)."""
+    """Connection to an external app process: socket framing by default,
+    gRPC for `grpc://` addresses or transport="grpc" (reference
+    NewRemoteClientCreator's socket/grpc transport switch)."""
 
-    def __init__(self, address: str) -> None:
+    def __init__(self, address: str, transport: str = "socket") -> None:
         self.address = address
+        self.transport = "grpc" if address.startswith("grpc://") else transport
 
     def new_client(self) -> Client:
+        if self.transport == "grpc":
+            from tendermint_tpu.abci.grpc import GRPCClient
+
+            return GRPCClient(self.address)
         return SocketClient(self.address)
 
 
-def default_client_creator(proxy_app: str, app: abci.Application | None = None) -> ClientCreator:
+def default_client_creator(
+    proxy_app: str,
+    app: abci.Application | None = None,
+    transport: str = "socket",
+) -> ClientCreator:
     """Reference proxy/client.go:66 DefaultClientCreator."""
     if app is not None:
         return LocalClientCreator(app)
@@ -61,7 +71,7 @@ def default_client_creator(proxy_app: str, app: abci.Application | None = None) 
         return LocalClientCreator(CounterApplication(serial=True))
     if proxy_app == "noop":
         return LocalClientCreator(abci.BaseApplication())
-    return RemoteClientCreator(proxy_app)
+    return RemoteClientCreator(proxy_app, transport)
 
 
 class AppConnConsensus:

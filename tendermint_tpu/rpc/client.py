@@ -17,6 +17,13 @@ class RPCResponseError(RPCError):
     pass
 
 
+def _swallow_result(fut: asyncio.Future) -> None:
+    """Consume a future's outcome so a failed fire-and-forget send never
+    surfaces as an 'exception was never retrieved' warning."""
+    if not fut.cancelled():
+        fut.exception()
+
+
 class HTTPClient:
     """Minimal asyncio JSON-RPC-over-HTTP client (one request per POST,
     keep-alive)."""
@@ -69,17 +76,41 @@ class HTTPClient:
 
 
 class WSClient:
-    """WebSocket JSON-RPC client with an event stream (reference
-    rpc/lib/client/ws_client.go)."""
+    """WebSocket JSON-RPC client with an event stream and automatic
+    reconnection (reference rpc/lib/client/ws_client.go:47-60): when the
+    connection drops, in-flight calls fail fast, then the client redials
+    with jittered exponential backoff and re-issues every active
+    subscription. Events published while disconnected are lost — same
+    contract as the reference (callers resync from state)."""
 
-    def __init__(self, host: str, port: int) -> None:
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        reconnect: bool = True,
+        max_reconnect_attempts: int = 25,
+        backoff_base: float = 0.2,
+        backoff_cap: float = 10.0,
+    ) -> None:
         self.host, self.port = host, port
+        self.reconnect = reconnect
+        self.max_reconnect_attempts = max_reconnect_attempts
+        self.backoff_base = backoff_base
+        self.backoff_cap = backoff_cap
         self._ids = itertools.count(1)
         self._pending: dict[object, asyncio.Future] = {}
         self.events: asyncio.Queue[dict] = asyncio.Queue(maxsize=1024)
         self._task: asyncio.Task | None = None
+        self._subs: set[str] = set()
+        self._closed = False
+        self._connected = asyncio.Event()
+        self.reconnects = 0  # observability: times a redial succeeded
 
     async def connect(self) -> None:
+        await self._dial()
+        self._task = asyncio.ensure_future(self._run())
+
+    async def _dial(self) -> None:
         self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
         self._writer.write(
             (
@@ -97,14 +128,26 @@ class WSClient:
             line = await self._reader.readline()
             if line in (b"\r\n", b"\n", b""):
                 break
-        self._task = asyncio.ensure_future(self._recv_loop())
+        self._connected.set()
 
     async def close(self) -> None:
+        self._closed = True
         if self._task is not None:
             self._task.cancel()
         self._writer.close()
 
-    async def _recv_loop(self) -> None:
+    async def _run(self) -> None:
+        """recv loop + reconnect supervisor (ws_client.go reconnectRoutine)."""
+        while True:
+            await self._recv_until_closed()
+            self._connected.clear()
+            self._fail_pending(ConnectionError("websocket closed"))
+            if self._closed or not self.reconnect:
+                return
+            if not await self._reconnect():
+                return
+
+    async def _recv_until_closed(self) -> None:
         try:
             while True:
                 opcode, payload = await _ws_read_frame(self._reader)
@@ -122,12 +165,47 @@ class WSClient:
                         self.events.put_nowait(msg.get("result", {}))
                     except asyncio.QueueFull:
                         pass
-        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
-            for fut in self._pending.values():
-                if not fut.done():
-                    fut.set_exception(ConnectionError("websocket closed"))
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            return
+        except asyncio.CancelledError:
+            self._fail_pending(ConnectionError("websocket closed"))
+            raise
 
-    async def call(self, method: str, **params):
+    def _fail_pending(self, err: Exception) -> None:
+        pending, self._pending = self._pending, {}
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_exception(err)
+
+    async def _reconnect(self) -> bool:
+        """Jittered exponential backoff redial + resubscribe. Returns False
+        when attempts are exhausted (ws_client.go:47 maxReconnectAttempts)."""
+        import random
+
+        for attempt in range(self.max_reconnect_attempts):
+            delay = min(self.backoff_cap, self.backoff_base * (2 ** attempt))
+            await asyncio.sleep(delay * (0.5 + random.random() / 2))
+            try:
+                await self._dial()
+            except OSError:
+                continue
+            self.reconnects += 1
+            # Re-issue subscriptions WITHOUT awaiting the responses: the
+            # recv loop that would deliver them only resumes after this
+            # coroutine returns (awaiting here deadlocks). The responses are
+            # drained and discarded by the loop.
+            try:
+                for query in list(self._subs):
+                    fut = self._send_nowait("subscribe", {"query": query})
+                    fut.add_done_callback(_swallow_result)
+                await self._writer.drain()
+            except (ConnectionError, OSError):
+                self._connected.clear()
+                continue
+            return True
+        return False
+
+    def _send_nowait(self, method: str, params: dict) -> asyncio.Future:
         msg_id = next(self._ids)
         fut = asyncio.get_event_loop().create_future()
         self._pending[msg_id] = fut
@@ -135,6 +213,12 @@ class WSClient:
             {"jsonrpc": "2.0", "id": msg_id, "method": method, "params": params}
         ).encode()
         self._writer.write(_ws_frame(0x1, data, mask=True))
+        return fut
+
+    async def _send_call(self, method: str, params: dict):
+        if not self._connected.is_set():
+            raise ConnectionError("websocket not connected")
+        fut = self._send_nowait(method, params)
         await self._writer.drain()
         resp = await fut
         if "error" in resp:
@@ -142,8 +226,20 @@ class WSClient:
             raise RPCResponseError(e.get("code", -1), e.get("message", ""), e.get("data", ""))
         return resp["result"]
 
+    async def call(self, method: str, **params):
+        return await self._send_call(method, params)
+
+    async def wait_connected(self, timeout: float = 30.0) -> None:
+        async with asyncio.timeout(timeout):
+            await self._connected.wait()
+
     async def subscribe(self, query: str) -> None:
         await self.call("subscribe", query=query)
+        self._subs.add(query)
+
+    async def unsubscribe(self, query: str) -> None:
+        self._subs.discard(query)
+        await self.call("unsubscribe", query=query)
 
     async def next_event(self, timeout: float = 10.0) -> dict:
         async with asyncio.timeout(timeout):

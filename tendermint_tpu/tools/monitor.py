@@ -1,10 +1,21 @@
 """tm-monitor analog — multi-node health dashboard over RPC.
 
-Reference parity: tools/tm-monitor/monitor/ — per-node status polling +
-NewBlock subscription; aggregates network height, block latency, node
-up/down status.
+Reference parity: tools/tm-monitor/monitor/ — one watcher per node
+(status poll + NewBlock subscription, tools/tm-monitor/monitor/node.go),
+aggregated into a Network model (network.go) with:
 
-    python -m tendermint_tpu.tools.monitor 127.0.0.1:26657 127.0.0.1:26659
+- health: FULL (every validator's node online) / MODERATE (some online,
+  still making blocks) / DEAD (nothing online)    network.go:26-31,161-175
+- network uptime %: share of wall time at full health, via wentDown /
+  totalDownTime accounting                         network.go:100-139
+- per-node uptime %, avg block time (ms), avg tx throughput (tx/s), block
+  latency over the last samples                    node.go / network.go:84-97
+
+Serves the live summary as JSON over HTTP with --listen (the reference's
+webserver), and prints it periodically to stdout.
+
+    python -m tendermint_tpu.tools.monitor 127.0.0.1:26657 127.0.0.1:26659 \
+        --listen 127.0.0.1:26670
 """
 from __future__ import annotations
 
@@ -16,6 +27,10 @@ from dataclasses import dataclass, field
 
 from tendermint_tpu.rpc.client import HTTPClient, WSClient
 
+FULL_HEALTH = "full"
+MODERATE_HEALTH = "moderate"
+DEAD = "dead"
+
 
 @dataclass
 class NodeStatus:
@@ -23,27 +38,109 @@ class NodeStatus:
     online: bool = False
     moniker: str = ""
     height: int = 0
+    start_time: float = field(default_factory=time.monotonic)
+    went_down: float = 0.0
+    total_down: float = 0.0
     last_block_time: float = 0.0  # monotonic, local arrival
     block_latencies: list[float] = field(default_factory=list)
+    txs_seen: list[tuple[float, int]] = field(default_factory=list)
 
-    def avg_block_latency(self) -> float:
-        if not self.block_latencies:
+    def mark_online(self) -> None:
+        if not self.online:
+            self.online = True
+            if self.went_down:
+                self.total_down += time.monotonic() - self.went_down
+                self.went_down = 0.0
+
+    def mark_down(self) -> None:
+        if self.online or self.went_down == 0.0:
+            self.online = False
+            self.went_down = time.monotonic()
+
+    def uptime_pct(self) -> float:
+        since = time.monotonic() - self.start_time
+        if since <= 0:
+            return 100.0
+        down = self.total_down
+        if not self.online and self.went_down:
+            down += time.monotonic() - self.went_down
+        return round(100.0 * max(0.0, since - down) / since, 2)
+
+    def avg_block_time_ms(self) -> float:
+        if len(self.block_latencies) == 0:
             return 0.0
-        return sum(self.block_latencies) / len(self.block_latencies)
+        return round(
+            1000.0 * sum(self.block_latencies) / len(self.block_latencies), 1
+        )
+
+    def tx_throughput(self, window: float = 60.0) -> float:
+        now = time.monotonic()
+        recent = [(t, n) for t, n in self.txs_seen if now - t <= window]
+        if not recent:
+            return 0.0
+        span = max(now - recent[0][0], 1e-6)
+        return round(sum(n for _, n in recent) / span, 2)
+
+    def record_block(self, height: int, num_txs: int) -> None:
+        now = time.monotonic()
+        if self.last_block_time:
+            self.block_latencies.append(now - self.last_block_time)
+            del self.block_latencies[:-100]
+        self.last_block_time = now
+        self.height = max(self.height, height)
+        self.txs_seen.append((now, num_txs))
+        del self.txs_seen[:-600]
 
 
 class Monitor:
+    """The Network model (reference monitor/network.go) + node watchers."""
+
     def __init__(self, endpoints: list[str]) -> None:
         self.nodes = {e: NodeStatus(e) for e in endpoints}
+        self.num_validators = 0
+        self.start_time = time.monotonic()
+        self.went_unhealthy = 0.0  # monotonic time we left full health
+        self.total_unhealthy = 0.0
         self._tasks: list[asyncio.Task] = []
 
     async def start(self) -> None:
+        self._recalc_health()
         for ep in self.nodes:
             self._tasks.append(asyncio.ensure_future(self._watch(ep)))
 
     async def stop(self) -> None:
         for t in self._tasks:
             t.cancel()
+
+    # -- health / uptime (network.go:100-175) ------------------------------
+
+    def health(self) -> str:
+        online = sum(1 for n in self.nodes.values() if n.online)
+        if self.num_validators != 0 and online >= self.num_validators:
+            return FULL_HEALTH
+        if online > 0:
+            return MODERATE_HEALTH
+        return DEAD
+
+    def _recalc_health(self) -> None:
+        now = time.monotonic()
+        if self.health() == FULL_HEALTH:
+            if self.went_unhealthy:
+                self.total_unhealthy += now - self.went_unhealthy
+                self.went_unhealthy = 0.0
+        elif not self.went_unhealthy:
+            self.went_unhealthy = now
+
+    def network_uptime_pct(self) -> float:
+        since = time.monotonic() - self.start_time
+        if since <= 0:
+            return 100.0
+        down = self.total_unhealthy
+        if self.went_unhealthy:
+            down += time.monotonic() - self.went_unhealthy
+        return round(100.0 * max(0.0, since - down) / since, 2)
+
+    # -- watchers ----------------------------------------------------------
 
     async def _watch(self, ep: str) -> None:
         host, _, port = ep.rpartition(":")
@@ -52,71 +149,132 @@ class Monitor:
             try:
                 client = HTTPClient(host, int(port))
                 st = await client.call("status")
-                ns.online = True
                 ns.moniker = st["node_info"].get("moniker", "")
-                ns.height = st["sync_info"]["latest_block_height"]
+                ns.height = int(st["sync_info"]["latest_block_height"])
+                await self._refresh_validators(client)
                 await client.close()
+                ns.mark_online()
+                self._recalc_health()
 
-                ws = WSClient(host, int(port))
+                ws = WSClient(host, int(port), reconnect=False)
                 await ws.connect()
                 await ws.subscribe("tm.event='NewBlock'")
                 try:
+                    n_events = 0
                     while True:
                         ev = await ws.next_event(timeout=60)
-                        now = time.monotonic()
-                        if ns.last_block_time:
-                            ns.block_latencies.append(now - ns.last_block_time)
-                            del ns.block_latencies[:-100]
-                        ns.last_block_time = now
-                        ns.height = ev["data"]["block"]["header"]["height"]
+                        header = ev["data"]["block"]["header"]
+                        ns.record_block(
+                            int(header["height"]),
+                            int(header.get("num_txs", 0) or 0),
+                        )
+                        n_events += 1
+                        # at start the node may not have stored a valset
+                        # yet; refresh until known, then once a minute-ish
+                        if self.num_validators == 0 or n_events % 60 == 0:
+                            c2 = HTTPClient(host, int(port))
+                            await self._refresh_validators(c2)
+                            await c2.close()
+                            self._recalc_health()
                 finally:
                     await ws.close()
-            except (ConnectionError, OSError, asyncio.TimeoutError):
-                ns.online = False
+            except (ConnectionError, OSError, asyncio.TimeoutError, KeyError):
+                ns.mark_down()
+                self._recalc_health()
                 await asyncio.sleep(2.0)
             except asyncio.CancelledError:
                 return
 
+    async def _refresh_validators(self, client: HTTPClient) -> None:
+        try:
+            vals = await client.call("validators")
+            n = len(vals.get("validators", []))
+            if n:  # track the CURRENT set size — it can shrink (a max-
+                # accumulated value would block FULL health forever after
+                # a validator-set reduction)
+                self.num_validators = n
+        except Exception:  # noqa: BLE001 — no valset stored yet
+            pass
+
+    # -- aggregates --------------------------------------------------------
+
     def network_summary(self) -> dict:
         online = [n for n in self.nodes.values() if n.online]
         return {
-            "num_nodes": len(self.nodes),
-            "num_online": len(online),
+            "health": self.health(),
+            "uptime_pct": self.network_uptime_pct(),
+            "num_validators": self.num_validators,
+            "num_nodes_monitored": len(self.nodes),
+            "num_nodes_online": len(online),
             "network_height": max((n.height for n in online), default=0),
-            "avg_block_time_s": round(
-                sum(n.avg_block_latency() for n in online) / len(online), 3
+            "avg_block_time_ms": round(
+                sum(n.avg_block_time_ms() for n in online) / len(online), 1
             )
             if online
             else 0.0,
+            "avg_tx_throughput": round(
+                sum(n.tx_throughput() for n in online), 2
+            ),
             "nodes": [
                 {
                     "endpoint": n.endpoint,
                     "online": n.online,
                     "moniker": n.moniker,
                     "height": n.height,
+                    "uptime_pct": n.uptime_pct(),
+                    "avg_block_time_ms": n.avg_block_time_ms(),
+                    "tx_throughput": n.tx_throughput(),
                 }
                 for n in self.nodes.values()
             ],
         }
 
 
-async def _run(endpoints: list[str], interval: float) -> None:
+async def _serve_http(mon: Monitor, listen: str) -> asyncio.AbstractServer:
+    """Tiny status webserver (the reference tm-monitor's HTTP endpoint)."""
+    host, _, port = listen.rpartition(":")
+
+    async def handle(reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            await reader.readline()
+            while (await reader.readline()) not in (b"\r\n", b"\n", b""):
+                pass
+            body = json.dumps(mon.network_summary()).encode()
+            writer.write(
+                b"HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+                + f"Content-Length: {len(body)}\r\n\r\n".encode()
+                + body
+            )
+            await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            writer.close()
+
+    return await asyncio.start_server(handle, host, int(port))
+
+
+async def _run(endpoints: list[str], interval: float, listen: str | None) -> None:
     mon = Monitor(endpoints)
     await mon.start()
+    server = await _serve_http(mon, listen) if listen else None
     try:
         while True:
             await asyncio.sleep(interval)
-            print(json.dumps(mon.network_summary()))
+            print(json.dumps(mon.network_summary()), flush=True)
     finally:
         await mon.stop()
+        if server is not None:
+            server.close()
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="tm-monitor")
     p.add_argument("endpoints", nargs="+")
     p.add_argument("--interval", type=float, default=5.0)
+    p.add_argument("--listen", default=None, help="serve summary JSON here")
     args = p.parse_args(argv)
-    asyncio.run(_run(args.endpoints, args.interval))
+    asyncio.run(_run(args.endpoints, args.interval, args.listen))
     return 0
 
 

@@ -9,6 +9,12 @@ import os
 # Force CPU even when the ambient environment points at a real TPU
 # (JAX_PLATFORMS=axon): the suite needs 8 virtual devices for sharding tests.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# No background kernel compiles during tests: export-blob writer threads and
+# node prewarm each cost minutes of XLA:CPU compile, saturate the CPU, and
+# are joined at process exit (non-daemon). The in-process jit path still
+# uses the persistent XLA cache, which the suite warms on first use.
+os.environ.setdefault("TMTPU_NO_EXPORT_CACHE", "1")
+os.environ.setdefault("TMTPU_NO_PREWARM", "1")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -201,3 +201,120 @@ class TestProxy:
         assert isinstance(
             proxy.default_client_creator("tcp://127.0.0.1:1234"), proxy.RemoteClientCreator
         )
+
+
+class TestGRPC:
+    """gRPC transport parity (reference abci/client/grpc_client.go,
+    abci/server/grpc_server.go, GRPCApplication at application.go:78):
+    the kvstore conformance flow must behave identically over gRPC."""
+
+    def test_kvstore_conformance_over_grpc(self):
+        from tendermint_tpu.abci.grpc import GRPCABCIServer, GRPCClient
+
+        async def main():
+            app = KVStoreApplication()
+            server = GRPCABCIServer(app, "127.0.0.1:0")
+            await server.start()
+            try:
+                client = GRPCClient(f"127.0.0.1:{server.port}")
+                await client.start()
+                echo = await client.echo("ping")
+                assert echo.message == "ping"
+                info = await client.info(abci.RequestInfo())
+                assert info.last_block_height == 0
+                futs = [
+                    client.deliver_tx_async(
+                        abci.RequestDeliverTx(f"k{i}=v{i}".encode())
+                    )
+                    for i in range(20)
+                ]
+                await client.flush()
+                for f in futs:
+                    assert (await f).is_ok
+                await client.end_block(abci.RequestEndBlock(1))
+                commit = await client.commit()
+                assert commit.data == app.app_hash
+                q = await client.query(abci.RequestQuery(data=b"k3"))
+                assert q.value == b"v3"
+                await client.stop()
+            finally:
+                await server.stop()
+
+        run(main())
+
+    def test_exception_over_grpc(self):
+        from tendermint_tpu.abci.client import ABCIClientError
+        from tendermint_tpu.abci.grpc import GRPCABCIServer, GRPCClient
+
+        class BadApp(abci.BaseApplication):
+            def deliver_tx(self, req):
+                raise RuntimeError("app exploded")
+
+        async def main():
+            server = GRPCABCIServer(BadApp(), "127.0.0.1:0")
+            await server.start()
+            try:
+                client = GRPCClient(f"127.0.0.1:{server.port}")
+                await client.start()
+                with pytest.raises(ABCIClientError):
+                    await client.deliver_tx(abci.RequestDeliverTx(b"x"))
+                await client.stop()
+            finally:
+                await server.stop()
+
+        run(main())
+
+    def test_proxy_over_grpc(self):
+        """The node's three app connections work over the gRPC transport."""
+        from tendermint_tpu.abci.grpc import GRPCABCIServer
+
+        async def main():
+            app = KVStoreApplication()
+            server = GRPCABCIServer(app, "127.0.0.1:0")
+            await server.start()
+            try:
+                conns = proxy.AppConns(
+                    proxy.default_client_creator(f"grpc://127.0.0.1:{server.port}")
+                )
+                await conns.start()
+                info = await conns.query.info(abci.RequestInfo())
+                assert info.last_block_height == 0
+                fut = conns.consensus.deliver_tx_async(b"x=y")
+                await conns.consensus.flush()
+                assert (await fut).is_ok
+                assert (await conns.consensus.commit()).data
+                assert (await conns.mempool.check_tx(b"z")).is_ok
+                await conns.stop()
+            finally:
+                await server.stop()
+
+        run(main())
+
+    def test_ordered_delivery_over_grpc(self):
+        """ABCI requires DeliverTx to reach the app in order; the serial
+        counter app rejects any reordering, so 50 pipelined async delivers
+        must all land sequentially (the client's ordered-worker guarantee)."""
+        from tendermint_tpu.abci.grpc import GRPCABCIServer, GRPCClient
+
+        async def main():
+            app = CounterApplication(serial=True)
+            server = GRPCABCIServer(app, "127.0.0.1:0")
+            await server.start()
+            try:
+                client = GRPCClient(f"127.0.0.1:{server.port}")
+                await client.start()
+                futs = [
+                    client.deliver_tx_async(
+                        abci.RequestDeliverTx(i.to_bytes(8, "big"))
+                    )
+                    for i in range(50)
+                ]
+                await client.flush()
+                for f in futs:
+                    assert (await f).is_ok
+                assert app.tx_count == 50
+                await client.stop()
+            finally:
+                await server.stop()
+
+        run(main())

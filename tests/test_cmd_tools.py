@@ -153,7 +153,7 @@ class TestLiveTestnet:
                 async with asyncio.timeout(30):
                     while True:
                         s = mon.network_summary()
-                        if s["num_online"] == 1 and s["network_height"] >= 2:
+                        if s["num_nodes_online"] == 1 and s["network_height"] >= 2:
                             break
                         await asyncio.sleep(0.2)
             finally:

@@ -1,0 +1,123 @@
+"""Evidence pool + priority-keyed store tests (reference evidence/store.go,
+evidence/pool.go, store_test.go's priority/broadcast patterns)."""
+from __future__ import annotations
+
+from tendermint_tpu.evidence import EvidencePool
+from tendermint_tpu.libs.db import MemDB
+from tendermint_tpu.state import State, StateStore
+from tendermint_tpu.types import MockPV, ValidatorSet, VoteType
+from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+from tendermint_tpu.types.genesis import ConsensusParams
+from tendermint_tpu.types.validator_set import Validator
+from tendermint_tpu.types.vote import BlockID, PartSetHeader, Vote, now_ns
+
+CHAIN_ID = "evidence-test-chain"
+
+
+def _bid(seed: bytes) -> BlockID:
+    import hashlib
+
+    h = hashlib.sha256(seed).digest()
+    return BlockID(h, PartSetHeader(1, h))
+
+
+def make_fixture(powers=(10, 20, 30)):
+    pvs = sorted([MockPV() for _ in powers], key=lambda p: p.address)
+    vs = ValidatorSet(
+        [Validator(pv.get_pub_key(), p) for pv, p in zip(pvs, powers)]
+    )
+    state = State(
+        chain_id=CHAIN_ID,
+        last_block_height=5,
+        validators=vs,
+        next_validators=vs,
+        last_validators=vs,
+        consensus_params=ConsensusParams(),
+    )
+    store = StateStore(MemDB())
+    store.save_validators(5, vs)
+    for h in range(1, 7):
+        store.save_validators(h, vs)
+    return pvs, vs, state, store
+
+
+def make_evidence(pv, vs, height=5):
+    idx, _ = vs.get_by_address(pv.address)
+    v1 = Vote(VoteType.PREVOTE, height, 0, _bid(b"a"), now_ns(), pv.address, idx)
+    v2 = Vote(VoteType.PREVOTE, height, 0, _bid(b"b"), now_ns(), pv.address, idx)
+    return DuplicateVoteEvidence(
+        pv.get_pub_key(), pv.sign_vote(CHAIN_ID, v1), pv.sign_vote(CHAIN_ID, v2)
+    )
+
+
+class TestPriorityStore:
+    def test_priority_order_is_voting_power(self):
+        pvs, vs, state, store = make_fixture(powers=(10, 20, 30))
+        pool = EvidencePool(MemDB(), store, state)
+        # add in arbitrary order
+        evs = {pv.address: make_evidence(pv, vs) for pv in pvs}
+        for pv in pvs:
+            pool.add_evidence(evs[pv.address])
+        prio = pool.priority_evidence()
+        powers = []
+        for ev in prio:
+            _, val = vs.get_by_address(ev.address())
+            powers.append(val.voting_power)
+        assert powers == sorted(powers, reverse=True) == [30, 20, 10]
+
+    def test_mark_broadcasted_leaves_pending(self):
+        pvs, vs, state, store = make_fixture()
+        pool = EvidencePool(MemDB(), store, state)
+        ev = make_evidence(pvs[0], vs)
+        pool.add_evidence(ev)
+        assert len(pool.priority_evidence()) == 1
+        pool.mark_broadcasted(ev)
+        assert pool.priority_evidence() == []
+        assert pool.is_pending(ev)
+        assert pool.pending_evidence() == [ev]
+
+    def test_committed_removes_everywhere(self):
+        pvs, vs, state, store = make_fixture()
+        pool = EvidencePool(MemDB(), store, state)
+        ev = make_evidence(pvs[0], vs)
+        pool.add_evidence(ev)
+        pool.mark_committed([ev])
+        assert pool.is_committed(ev)
+        assert not pool.is_pending(ev)
+        assert pool.priority_evidence() == []
+        assert len(pool.evidence_list) == 0
+        # re-adding committed evidence is a no-op
+        pool.add_evidence(ev)
+        assert not pool.is_pending(ev)
+
+    def test_restart_seeds_gossip_in_priority_order(self):
+        pvs, vs, state, store = make_fixture(powers=(10, 20, 30))
+        db = MemDB()
+        pool = EvidencePool(db, store, state)
+        for pv in pvs:
+            pool.add_evidence(make_evidence(pv, vs))
+        # restart: a new pool over the same DB
+        pool2 = EvidencePool(db, store, state)
+        listed = [el.value for el in pool2.evidence_list]
+        powers = []
+        for ev in listed:
+            _, val = vs.get_by_address(ev.address())
+            powers.append(val.voting_power)
+        assert powers == [30, 20, 10]
+        assert len(pool2.evidence_list) == 3
+
+    def test_prune_expired_on_update(self):
+        pvs, vs, state, store = make_fixture()
+        pool = EvidencePool(MemDB(), store, state)
+        old_ev = make_evidence(pvs[0], vs, height=1)
+        pool.add_evidence(old_ev)
+
+        class _Blk:
+            evidence = []
+
+        new_state = state.copy()
+        new_state.last_block_height = 1 + state.consensus_params.evidence.max_age + 5
+        pool.update(_Blk(), new_state)
+        assert not pool.is_pending(old_ev)
+        assert pool.priority_evidence() == []
+        assert len(pool.evidence_list) == 0

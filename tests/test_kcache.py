@@ -17,28 +17,29 @@ def tmp_cache_dir(tmp_path, monkeypatch):
     monkeypatch.setattr(kcache, "_CACHE_DIR", d)
     monkeypatch.setattr(kcache, "_fns", {})
     monkeypatch.setattr(kcache, "_exports_scheduled", set())
+    # conftest disables the blob/prewarm machinery suite-wide (background
+    # compile cost); these tests exist to exercise it
+    monkeypatch.delenv("TMTPU_NO_EXPORT_CACHE", raising=False)
     return d
-
-
-def _join_export_threads(timeout=60):
-    for t in threading.enumerate():
-        if t.name.startswith("tmtpu-export"):
-            t.join(timeout)
 
 
 class TestKCache:
     def test_verify_fn_works_and_writes_blob(self, tmp_cache_dir):
+        # background export runs in a daemon subprocess in production;
+        # exercise the blob writer foreground here
+        kcache._exports_scheduled.add((kcache._platform(), 128))
         pubs, msgs, sigs = make_sig_batch(8, msg_prefix=b"kcache ")
         out = eb.verify_batch(pubs, msgs, sigs)
         assert out == [True] * 8
-        _join_export_threads()
+        kcache._write_export_blob(kcache._platform(), 128)
         blob_dir = os.path.join(tmp_cache_dir, "export")
         assert os.path.isdir(blob_dir) and os.listdir(blob_dir)
 
     def test_blob_reload_path(self, tmp_cache_dir):
+        kcache._exports_scheduled.add((kcache._platform(), 128))
         pubs, msgs, sigs = make_sig_batch(8, msg_prefix=b"kcache2 ")
         assert eb.verify_batch(pubs, msgs, sigs) == [True] * 8
-        _join_export_threads()
+        kcache._write_export_blob(kcache._platform(), 128)
         # simulate a fresh process: drop in-memory fns, keep the blob
         kcache._fns.clear()
         kcache._exports_scheduled.clear()
@@ -73,6 +74,9 @@ class TestKCache:
         expected[17] = False
         assert out == expected
 
-    def test_prewarm_foreground(self, tmp_cache_dir):
+    def test_prewarm_foreground(self, tmp_cache_dir, monkeypatch):
+        # conftest disables prewarm suite-wide (background compiles); this
+        # test exercises it explicitly
+        monkeypatch.delenv("TMTPU_NO_PREWARM", raising=False)
         assert kcache.prewarm(buckets=(128,), background=False) is None
         assert (kcache._platform(), 128) in kcache._fns

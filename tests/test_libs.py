@@ -286,3 +286,22 @@ class TestTimers:
         assert sorted(m.keys()) == ["b"]
         m.clear()
         assert m.size() == 0
+
+
+class TestDBPrefixIteration:
+    """iterate_prefix must include keys whose suffix begins with 0xff bytes
+    (the inverted-priority evidence outqueue keys are exactly that shape) —
+    an appended-0xff upper bound silently excludes them."""
+
+    def test_ff_suffix_keys_iterate(self, tmp_path):
+        from tendermint_tpu.libs.db import MemDB, SQLiteDB
+
+        for db in (MemDB(), SQLiteDB(str(tmp_path / "t.db"))):
+            prefix = b"EV:outqueue:"
+            k_ff = prefix + b"\xff" * 8 + b"\x00\x01tail"  # priority 0
+            k_mid = prefix + b"\x7f" * 8 + b"rest"
+            db.set(k_ff, b"a")
+            db.set(k_mid, b"b")
+            db.set(b"EV:outqueuf", b"no")  # past the prefix
+            got = {k for k, _ in db.iterate_prefix(prefix)}
+            assert got == {k_ff, k_mid}, type(db).__name__

@@ -137,6 +137,42 @@ class TestSingleNodeRPC:
 
         asyncio.run(main())
 
+    def test_websocket_reconnect_and_resubscribe(self, tmp_path):
+        """Reference ws_client.go:47-60 — on connection loss the client
+        redials with backoff and re-issues active subscriptions; calls and
+        the event stream keep working afterwards."""
+
+        async def main():
+            node = make_node(str(tmp_path))
+            await node.start()
+            ws = WSClient("127.0.0.1", node.rpc_port, backoff_base=0.05)
+            try:
+                await ws.connect()
+                await ws.subscribe("tm.event='NewBlock'")
+                ev = await ws.next_event(timeout=30)
+                assert ev["data"]["block"]["header"]["height"] >= 1
+                # simulate network failure: hard-abort the transport
+                ws._writer.transport.abort()
+                # the supervisor redials and re-subscribes on its own
+                async with asyncio.timeout(30):
+                    while ws.reconnects < 1:
+                        await asyncio.sleep(0.02)
+                await ws.wait_connected()
+                st = await ws.call("status")
+                assert st["node_info"]["network"] == CHAIN_ID
+                # the re-issued subscription still delivers events
+                h0 = int(st["sync_info"]["latest_block_height"])
+                async with asyncio.timeout(30):
+                    while True:
+                        ev = await ws.next_event(timeout=30)
+                        if ev["data"]["block"]["header"]["height"] > h0:
+                            break
+            finally:
+                await ws.close()
+                await node.stop()
+
+        asyncio.run(main())
+
     def test_local_client(self, tmp_path):
         async def main():
             node = make_node(str(tmp_path))

@@ -1,0 +1,253 @@
+"""secp256k1 batch verification tests.
+
+Layers: the generic limb field (ops/limb_field.py) on both supported
+primes including adversarial loose inputs; the complete projective point
+ops against the pure-Python oracle (crypto/secp256k1_math.py, itself
+cross-checked against OpenSSL in test_crypto-style tests below); host batch
+prep structural checks; and the full tile (slow compile — gated)."""
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from tendermint_tpu.crypto import secp256k1 as sk  # noqa: E402
+from tendermint_tpu.crypto import secp256k1_math as sm  # noqa: E402
+from tendermint_tpu.ops import pallas_secp, secp_batch  # noqa: E402
+from tendermint_tpu.ops.limb_field import make_field  # noqa: E402
+from tendermint_tpu.ops.limbs import NLIMB, ints_to_limbs, limbs_to_ints  # noqa: E402
+
+
+def _fe(vals):
+    arr = ints_to_limbs(vals)
+    return [jnp.asarray(arr[k]) for k in range(NLIMB)]
+
+
+def _ints(x, p):
+    return [v % p for v in limbs_to_ints(np.asarray(x))]
+
+
+class TestOracle:
+    def test_matches_openssl(self):
+        for i in range(8):
+            priv = sk.gen_priv_key(seed=bytes([i, 7]))
+            pub = priv.pub_key().bytes()
+            msg = b"oracle %d" % i
+            sig = priv.sign(msg)
+            assert sm.verify(pub, msg, sig)
+            bad = sig[:10] + bytes([sig[10] ^ 1]) + sig[11:]
+            assert not sm.verify(pub, msg, bad)
+            assert not sm.verify(pub, msg + b"!", sig)
+
+    def test_high_s_rejected(self):
+        priv = sk.gen_priv_key(seed=b"hs2")
+        msg = b"m"
+        sig = priv.sign(msg)
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        hs = r.to_bytes(32, "big") + (sm.N - s).to_bytes(32, "big")
+        assert not sm.verify(priv.pub_key().bytes(), msg, hs)
+
+    def test_point_ops(self):
+        # against the double-and-add ladder and known group facts
+        g2 = sm.point_double(sm.G)
+        g3 = sm.point_add(g2, sm.G)
+        assert sm.to_affine(g3) == sm.to_affine(sm.scalar_mult(3, sm.G))
+        assert sm.to_affine(sm.point_add(sm.G, sm.IDENTITY)) == sm.to_affine(sm.G)
+        # n*G = identity
+        assert sm.to_affine(sm.scalar_mult(sm.N, sm.G)) is None
+
+
+class TestLimbFieldBothPrimes:
+    @pytest.mark.parametrize("p", [2**255 - 19, sm.P], ids=["ed25519", "secp"])
+    def test_ops_and_loose_bounds(self, p):
+        import random
+
+        F = make_field(p)
+        rng = random.Random(5)
+        va = [rng.randrange(p) for _ in range(8)]
+        vb = [rng.randrange(p) for _ in range(8)]
+        la, lb = _fe(va), _fe(vb)
+        assert _ints(F.mul(la, lb), p) == [a * b % p for a, b in zip(va, vb)]
+        assert _ints(F.sq(la), p) == [a * a % p for a in va]
+        assert _ints(F.add(la, lb), p) == [(a + b) % p for a, b in zip(va, vb)]
+        assert _ints(F.sub(la, lb), p) == [(a - b) % p for a, b in zip(va, vb)]
+        assert _ints(F.mul_small(la, 21), p) == [a * 21 % p for a in va]
+        x, ref = la, list(va)
+        for _ in range(8):
+            x = F.sq(x)
+            ref = [v * v % p for v in ref]
+            assert _ints(x, p) == ref
+        loose = np.full((NLIMB, 4), 4104, dtype=np.int32)
+        loose[0] = 23551
+        loose[NLIMB - 1] = 4100
+        lv = [v % p for v in limbs_to_ints(loose)]
+        ll = [jnp.asarray(loose[k]) for k in range(NLIMB)]
+        assert _ints(F.mul(ll, ll), p) == [v * v % p for v in lv]
+        assert _ints(F.sq(ll), p) == [v * v % p for v in lv]
+        edge = [p - 1, p, p + 1, 2 ** p.bit_length() - 1, 0, 1]
+        ce = F.canon(_fe(edge))
+        arr = np.asarray(ce)
+        assert limbs_to_ints(arr) == [v % p for v in edge]
+        assert (arr <= 0xFFF).all() and (arr >= 0).all()
+
+
+class TestDevicePointOps:
+    """padd/pdbl (complete RCB formulas) vs the oracle, including the
+    exceptional inputs completeness exists for: P+P, P+(-P), P+O, O+O."""
+
+    def _pts(self, seeds):
+        return [
+            sm.scalar_mult(int.from_bytes(bytes([s, 1, s]), "big") + 1, sm.G)
+            for s in seeds
+        ]
+
+    def _batch(self, pts):
+        return tuple(
+            _fe([p[i] for p in pts]) for i in range(3)
+        )
+
+    def _affine(self, dev_pt):
+        xs = _ints(dev_pt[0], sm.P)
+        ys = _ints(dev_pt[1], sm.P)
+        zs = _ints(dev_pt[2], sm.P)
+        return [sm.to_affine((x, y, z)) for x, y, z in zip(xs, ys, zs)]
+
+    def test_add_matrix(self):
+        a = self._pts([1, 2, 3, 4])
+        b = self._pts([5, 2, 9, 8])
+        neg = (a[2][0], (sm.P - a[2][1]) % sm.P, a[2][2])
+        b[2] = neg  # P + (-P) = O
+        b[3] = sm.IDENTITY  # P + O = P
+        got = self._affine(pallas_secp.padd(self._batch(a), self._batch(b)))
+        want = [sm.to_affine(sm.point_add(p, q)) for p, q in zip(a, b)]
+        assert got == want
+        assert got[2] is None  # identity
+
+    def test_double_and_o(self):
+        pts = self._pts([1, 7]) + [sm.IDENTITY]
+        got = self._affine(pallas_secp.pdbl(self._batch(pts)))
+        want = [sm.to_affine(sm.point_double(p)) for p in pts]
+        assert got == want
+
+
+class TestHostPrep:
+    def test_structural_rejections(self):
+        priv = sk.gen_priv_key(seed=b"hp")
+        pub = priv.pub_key().bytes()
+        msg = b"msg"
+        sig = priv.sign(msg)
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        high_s = sig[:32] + (sm.N - s).to_bytes(32, "big")
+        zero_r = b"\x00" * 32 + sig[32:]
+        big_r = sm.N.to_bytes(32, "big") + sig[32:]
+        bad_pub = b"\x02" + b"\xff" * 32
+        pubs = [pub, pub, pub, pub, bad_pub, pub]
+        msgs = [msg] * 6
+        sigs = [sig, high_s, zero_r, big_r, sig, b"short"]
+        inputs, mask = secp_batch.prepare_batch(pubs, msgs, sigs)
+        assert mask.tolist() == [True, False, False, False, False, False]
+        assert inputs is not None
+
+    def test_backend_registered(self):
+        import tendermint_tpu.ops  # noqa: F401
+        from tendermint_tpu.crypto import batch
+
+        assert batch.get_backend("secp256k1") is not None
+
+    def test_small_batch_serial_path(self):
+        from tendermint_tpu.ops import _secp256k1_backend
+
+        priv = sk.gen_priv_key(seed=b"sp")
+        pub = priv.pub_key().bytes()
+        msgs = [b"a", b"b", b"c"]
+        sigs = [priv.sign(m) for m in msgs]
+        sigs[1] = sigs[2]
+        assert _secp256k1_backend([pub] * 3, msgs, sigs) == [True, False, True]
+
+
+class TestFullTile:
+    """On the suite's CPU platform verify_batch routes to the serial
+    OpenSSL path (the nocgo analog), so this always runs; on a TPU it
+    exercises the Mosaic kernel end-to-end."""
+
+    def test_verify_batch_matches_serial(self):
+        pubs, msgs, sigs = [], [], []
+        for i in range(24):
+            priv = sk.gen_priv_key(seed=bytes([i, 3]))
+            msg = b"full tile %d" % i
+            pubs.append(priv.pub_key().bytes())
+            msgs.append(msg)
+            sigs.append(priv.sign(msg))
+        expected = [True] * 24
+        sigs[3] = sigs[3][:33] + bytes([sigs[3][33] ^ 1]) + sigs[3][34:]
+        expected[3] = False
+        msgs[5] = msgs[5] + b"!"
+        expected[5] = False
+        assert secp_batch.verify_batch(pubs, msgs, sigs) == expected
+
+
+class TestStrausAlgorithmMirror:
+    """Pure-python mirror of the kernel's exact algorithm — joint radix-4
+    digits, the 16-entry [i]G+[j]Q table, 2-double+1-add loop, and the
+    projective X == t*Z target compare — validated against the oracle's
+    straightforward u1*G + u2*Q. Catches algorithmic bugs independent of
+    the limb lifting (which TestLimbFieldBothPrimes/TestDevicePointOps
+    cover)."""
+
+    def _mirror_verify(self, pub, msg, sig) -> bool:
+        if len(sig) != 64:
+            return False
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        if not (0 < r < sm.N and 0 < s <= sm.HALF_N):
+            return False
+        q_aff = sm.decompress(pub)
+        if q_aff is None:
+            return False
+        w = pow(s, -1, sm.N)
+        z = sm.msg_scalar(msg)
+        u1 = z * w % sm.N
+        u2 = r * w % sm.N
+        q = (q_aff[0], q_aff[1], 1)
+        # table exactly as pallas_secp.verify_tile builds it
+        g_mults = pallas_secp._G_MULTS
+        q2 = sm.point_add(q, q)
+        q3 = sm.point_add(q2, q)
+        q_pts = [None, q, q2, q3]
+        table = []
+        for i in range(4):
+            for j in range(4):
+                if j == 0:
+                    table.append(g_mults[i])
+                elif i == 0:
+                    table.append(q_pts[j])
+                else:
+                    table.append(sm.point_add(g_mults[i], q_pts[j]))
+        p = sm.IDENTITY
+        for it in range(pallas_secp.NDIGITS):
+            d = pallas_secp.NDIGITS - 1 - it
+            sd = (u1 >> (2 * d)) & 3
+            hd = (u2 >> (2 * d)) & 3
+            p = sm.point_add(sm.point_add(p, p), sm.point_add(p, p))
+            # ^ 2 doublings, complete formulas (as pdbl(pdbl(p)))
+            p = sm.point_add(p, table[4 * sd + hd])
+        x, y, zc = p
+        if zc % sm.P == 0:
+            return False
+        t2 = r + sm.N if r + sm.N < sm.P else r
+        return x % sm.P in (r * zc % sm.P, t2 * zc % sm.P)
+
+    def test_mirror_matches_oracle(self):
+        for i in range(12):
+            priv = sk.gen_priv_key(seed=bytes([i, 55]))
+            pub = priv.pub_key().bytes()
+            msg = b"mirror %d" % i
+            sig = priv.sign(msg)
+            assert self._mirror_verify(pub, msg, sig) == sm.verify(pub, msg, sig)
+            bad = sig[:20] + bytes([sig[20] ^ 1]) + sig[21:]
+            assert self._mirror_verify(pub, msg, bad) == sm.verify(pub, msg, bad)
+            assert self._mirror_verify(pub, msg + b"x", sig) is False

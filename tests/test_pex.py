@@ -95,3 +95,111 @@ class TestPexReactor:
             assert sa.peers.list()[0].id == sb.node_id()
         finally:
             await stop_switches([sa, sb])
+
+
+class TestHashedBuckets:
+    """The 256/64 hashed-bucket scheme (reference p2p/pex/addrbook.go:23-24,
+    85, 93-94 and addrbook_test.go's distribution/eviction patterns)."""
+
+    def _rand_addr(self, i: int, group: int) -> NetAddress:
+        return NetAddress(
+            ("%04x" % i) * 10, f"{group % 250 + 1}.{(group * 7) % 250}.0.{i % 250 + 1}", 26656
+        )
+
+    def test_new_addresses_spread_over_buckets(self):
+        """1k addresses from many source groups land in many distinct new
+        buckets, none overfull."""
+        book = AddrBook()
+        for i in range(1000):
+            src = self._rand_addr(10_000 + i, group=i % 50)
+            book.add_address(self._rand_addr(i, group=i % 97), src=src)
+        used = [b for b in book._new if b]
+        assert len(used) > 100  # spread, not clustered
+        assert max(len(b) for b in used) <= 64
+        assert book.n_new == 1000
+
+    def test_single_source_group_limited_buckets(self):
+        """All addresses from ONE source group may influence at most 32 new
+        buckets (newBucketsPerGroup) — the eclipse-resistance bound."""
+        book = AddrBook()
+        src = self._rand_addr(9999, group=7)  # one source
+        for i in range(2000):
+            book.add_address(self._rand_addr(i, group=i % 83), src=src)
+        used = [i for i, b in enumerate(book._new) if b]
+        assert len(used) <= 32
+
+    def test_old_bucket_promotion_and_demotion(self):
+        """Promoting into a full old bucket demotes that bucket's oldest
+        entry back to a new bucket (reference moveToOld)."""
+        book = AddrBook()
+        # force every address into the same old bucket by stubbing the calc
+        book._calc_old_bucket = lambda addr: 0
+        n = 70  # > OLD_BUCKET_SIZE
+        addrs = [self._rand_addr(i, group=i) for i in range(n)]
+        for a in addrs:
+            book.add_address(a, src=self._rand_addr(5000, group=3))
+            book.mark_good(a)
+        assert len(book._old[0]) == 64
+        assert book.n_old == 64
+        assert book.n_new == n - 64  # demoted back to new, not dropped
+        assert len(book) == n
+
+    def test_full_new_bucket_evicts_bad_then_oldest(self):
+        """A full new bucket expires bad entries first, else the oldest."""
+        import time as _time
+
+        book = AddrBook()
+        book._calc_new_bucket = lambda addr, src: 0
+        for i in range(64):
+            book.add_address(self._rand_addr(i, group=i))
+        assert len(book._new[0]) == 64
+        # make entry 0 "bad": never succeeded, 3+ attempts, stale
+        bad = book._lookup[self._rand_addr(0, group=0).id]
+        bad.attempts = 5
+        bad.last_attempt = _time.time() - 3600
+        book.add_address(self._rand_addr(100, group=100))
+        assert len(book._new[0]) == 64
+        assert self._rand_addr(0, group=0).id not in book._lookup
+        assert self._rand_addr(100, group=100).id in book._lookup
+
+    def test_max_new_buckets_per_address(self):
+        """An address heard from many sources occupies at most 4 new
+        buckets (maxNewBucketsPerAddress)."""
+        book = AddrBook()
+        target = self._rand_addr(1, group=1)
+        for s in range(200):
+            book.add_address(target, src=self._rand_addr(1000 + s, group=s))
+        ka = book._lookup[target.id]
+        assert 1 <= len(ka.buckets) <= 4
+        assert book.n_new == 1  # still ONE address
+
+    def test_selection_with_bias_mix(self):
+        book = AddrBook()
+        for i in range(100):
+            a = self._rand_addr(i, group=i)
+            book.add_address(a, src=self._rand_addr(7000 + i, group=i % 9))
+            if i < 50:
+                book.mark_good(a)
+        sel = book.get_selection_with_bias(30)
+        assert len(sel) >= 32
+        old_ids = {ka.addr.id for b in book._old for ka in b.values()}
+        n_new_sel = sum(1 for a in sel if a.id not in old_ids)
+        # ~30% new requested; allow slack for rounding/fill
+        assert n_new_sel >= len(sel) * 30 // 100
+
+    def test_save_load_preserves_buckets(self, tmp_path):
+        path = str(tmp_path / "book.json")
+        book = AddrBook(file_path=path)
+        for i in range(50):
+            a = self._rand_addr(i, group=i % 5)
+            book.add_address(a, src=self._rand_addr(300 + i, group=2))
+            if i % 2:
+                book.mark_good(a)
+        book.save()
+        book2 = AddrBook(file_path=path)
+        assert len(book2) == 50
+        assert book2.n_old == book.n_old and book2.n_new == book.n_new
+        assert book2.key == book.key
+        for i in range(0, 50, 7):
+            a = self._rand_addr(i, group=i % 5)
+            assert book2.is_good(a) == book.is_good(a)
