@@ -1,0 +1,65 @@
+"""Decompose e2e commit-verify time: host prep / transfer / launch / fetch.
+
+Usage: python -m benchmarks.device_profile [n_sigs]
+
+Separates the costs that bench.py's end-to-end numbers aggregate, so a
+regression can be attributed: pure device time per launch (inputs already
+resident, K launches, sync at the end), the single packed host->device
+transfer, and the launch+fetch round trip. On a tunneled device
+(JAX_PLATFORMS=axon) expect a ~65 ms fixed cost per execute/fetch RPC that
+does NOT pipeline — see benchmarks/tunnel_probe.py for the raw tunnel
+characterization that motivated the (49, B) single-array wire format.
+"""
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+
+    from tendermint_tpu.ops import ed25519_batch, kcache
+    from tendermint_tpu.utils import make_sig_batch
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000
+    kcache.enable_persistent_cache()
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform} ({dev.device_kind})")
+
+    pubs, msgs, sigs = make_sig_batch(min(n, 512))
+    reps = -(-n // len(pubs))
+    pubs, msgs, sigs = ((x * reps)[:n] for x in (pubs, msgs, sigs))
+    t0 = time.perf_counter()
+    packed, mask = ed25519_batch.prepare_batch(pubs, msgs, sigs)
+    log(f"host prep: {(time.perf_counter() - t0) * 1e3:.1f} ms")
+    assert mask.all()
+    log(f"bucket: {packed.shape[1]}  ({packed.nbytes / 1e6:.2f} MB packed)")
+
+    fn = kcache.get_verify_fn(packed.shape[1])
+    t0 = time.perf_counter()
+    placed = jax.device_put(packed, dev)
+    out = np.asarray(fn(placed))
+    log(f"first run (compile/cache load): {time.perf_counter() - t0:.1f}s")
+    assert out[:n].all()
+
+    t0 = time.perf_counter()
+    placed = jax.device_put(packed, dev)
+    placed.block_until_ready()
+    log(f"h2d transfer (one packed put): {(time.perf_counter() - t0) * 1e3:.1f} ms")
+
+    for K in (1, 4):
+        t0 = time.perf_counter()
+        outs = [fn(placed) for _ in range(K)]
+        for o in outs:
+            np.asarray(o)
+        dt = time.perf_counter() - t0
+        log(f"device-resident x{K}: {dt / K * 1e3:.1f} ms/launch+fetch")
+
+
+if __name__ == "__main__":
+    main()

@@ -32,8 +32,8 @@ def main() -> None:
         s = (sigs * reps)[:n]
         # flip one signature bad so agreement check is non-trivial
         s[1] = bytes([s[1][0] ^ 1]) + s[1][1:]
-        inputs, mask = ed25519_batch.prepare_batch(p, m, s)
-        assert inputs is not None
+        packed, mask = ed25519_batch.prepare_batch(p, m, s)
+        assert packed is not None
 
         kernels = {"xla": ed25519_batch.verify_kernel}
         try:
@@ -47,14 +47,12 @@ def main() -> None:
         for name, fn in kernels.items():
             try:
                 t0 = time.perf_counter()
-                placed = {k: jax.device_put(v, dev) for k, v in inputs.items()}
-                out = np.asarray(fn(**placed))
+                out = np.asarray(fn(jax.device_put(packed, dev)))
                 compile_s = time.perf_counter() - t0
                 iters = 5
                 t0 = time.perf_counter()
                 for _ in range(iters):
-                    placed = {k: jax.device_put(v, dev) for k, v in inputs.items()}
-                    out = np.asarray(fn(**placed))
+                    out = np.asarray(fn(jax.device_put(packed, dev)))
                 dt = (time.perf_counter() - t0) / iters
                 outs[name] = out
                 print(

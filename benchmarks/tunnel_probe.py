@@ -1,0 +1,63 @@
+"""Characterize the axon tunnel: per-op latency vs bandwidth, pipelining."""
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform} ({dev.device_kind})")
+
+    # bandwidth: single device_put of increasing size
+    for mb in (0.01, 2.4, 9.6, 19.2, 76.8):
+        n = int(mb * 1e6 / 4)
+        x = np.arange(n, dtype=np.int32)
+        a = jax.device_put(x, dev); a.block_until_ready()  # warm path
+        t0 = time.perf_counter()
+        a = jax.device_put(x, dev)
+        a.block_until_ready()
+        dt = time.perf_counter() - t0
+        log(f"h2d single {mb:6.2f} MB: {dt*1e3:7.1f} ms ({mb/dt:7.1f} MB/s)")
+
+    # trivial execute latency + pipelining
+    f = jax.jit(lambda x: x * 2 + 1)
+    x = jax.device_put(np.arange(1024, dtype=np.int32), dev)
+    np.asarray(f(x))
+    t0 = time.perf_counter()
+    np.asarray(f(x))
+    log(f"trivial exec sync: {(time.perf_counter()-t0)*1e3:.1f} ms")
+    for K in (4, 16):
+        t0 = time.perf_counter()
+        outs = [f(x) for _ in range(K)]
+        for o in outs:
+            o.block_until_ready()
+        dt = time.perf_counter() - t0
+        log(f"trivial exec x{K} queued: {dt*1e3:.1f} ms total, {dt/K*1e3:.2f} ms/op")
+
+    # d2h fetch latency
+    t0 = time.perf_counter()
+    np.asarray(x)
+    log(f"d2h fetch 4KB: {(time.perf_counter()-t0)*1e3:.1f} ms")
+
+    # does put overlap with exec? queue put(A2), exec(A1), put(A3), exec...
+    big = np.arange(int(2.4e6 / 4), dtype=np.int32)
+    t0 = time.perf_counter()
+    seq = []
+    for _ in range(4):
+        a = jax.device_put(big, dev)
+        seq.append(f(a))
+    for o in seq:
+        o.block_until_ready()
+    dt = time.perf_counter() - t0
+    log(f"interleaved put(2.4MB)+exec x4: {dt*1e3:.1f} ms total, {dt/4*1e3:.1f} ms/pair")
+
+
+if __name__ == "__main__":
+    main()

@@ -137,11 +137,13 @@ def ed25519_prepare_device_inputs(pubs, msgs, sigs, padded: int):
     """Native host-side batch prep for the TPU kernel (the round-1 Python
     loop in ops/ed25519_batch.prepare_batch was 22us/sig — VERDICT weak #2).
 
-    Writes the kernel wire format directly: word-transposed (8, padded)
-    int32 planes with zero pad lanes, so there is no numpy repack step.
-    Returns (device_inputs dict, mask (n,) bool) or None when the native
-    library is unavailable. Entries with wrong-length pub/sig come back
-    mask=False.
+    Writes the kernel wire format directly: the six word-transposed
+    (8, padded) int32 planes and the parity row are VIEWS into one
+    contiguous (49, padded) packed array (ops/ed25519_batch.py row layout),
+    so there is no numpy repack step and the device transfer is a single
+    copy. Returns (packed (49, padded) int32, mask (n,) bool) or None when
+    the native library is unavailable. Entries with wrong-length pub/sig
+    come back mask=False.
     """
     lib = load()
     if lib is None or not hasattr(lib, "tm_ed25519_prepare_batch"):
@@ -166,15 +168,19 @@ def ed25519_prepare_device_inputs(pubs, msgs, sigs, padded: int):
         np.fromiter((len(m) for m in msgs), dtype=np.uint64, count=n),
         out=offsets[1:],
     )
-    planes = {
-        k: np.zeros((8, padded), dtype=np.int32)
-        for k in ("a_x_w", "a_y_w", "a_t_w", "s_w", "h_w", "yr_w")
-    }
-    out_parity = np.zeros(padded, dtype=np.int32)
+    from tendermint_tpu.ops.ed25519_batch import (
+        ROW_AT, ROW_AX, ROW_AY, ROW_H, ROW_PARITY, ROW_S, ROW_YR, ROWS,
+    )
+
+    packed = np.zeros((ROWS, padded), dtype=np.int32)
     out_mask = np.zeros(n, dtype=np.uint8)
 
     u8p = ctypes.POINTER(ctypes.c_uint8)
     u32p = ctypes.POINTER(ctypes.c_uint32)
+
+    def row_ptr(row):  # contiguous view into the packed array
+        return packed[row:row + 8].ctypes.data_as(u32p)
+
     lib.tm_ed25519_prepare_batch(
         ctypes.cast(ctypes.c_char_p(pub_cat), u8p),
         ctypes.cast(ctypes.c_char_p(msg_cat or b"\x00"), u8p),
@@ -182,16 +188,16 @@ def ed25519_prepare_device_inputs(pubs, msgs, sigs, padded: int):
         ctypes.cast(ctypes.c_char_p(sig_cat), u8p),
         n,
         padded,
-        *[planes[k].ctypes.data_as(u32p)
-          for k in ("a_x_w", "a_y_w", "a_t_w", "s_w", "h_w", "yr_w")],
-        out_parity.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        *[row_ptr(r) for r in (ROW_AX, ROW_AY, ROW_AT, ROW_S, ROW_H, ROW_YR)],
+        packed[ROW_PARITY:ROW_PARITY + 1].ctypes.data_as(
+            ctypes.POINTER(ctypes.c_int32)
+        ),
         out_mask.ctypes.data_as(u8p),
     )
     mask = out_mask.astype(bool)
     if bad:
         mask[bad] = False
-    planes["x_parity"] = out_parity
-    return planes, mask
+    return packed, mask
 
 
 def register(force: bool = False) -> bool:

@@ -28,7 +28,7 @@ class Monitor:
 
     def __init__(self, sample_period: float = 0.1, window: float = 1.0) -> None:
         self._period = sample_period
-        self._window = window
+        self.window = window
         self._start = time.monotonic()
         self._last = self._start
         self._sample_start = self._start
@@ -45,7 +45,7 @@ class Monitor:
         elapsed = now - self._sample_start
         if elapsed >= self._period:
             rate = self._sample_bytes / elapsed
-            alpha = min(1.0, elapsed / self._window)
+            alpha = min(1.0, elapsed / self.window)
             self._cur_rate = self._cur_rate * (1 - alpha) + rate * alpha
             self._peak = max(self._peak, self._cur_rate)
             self._samples += 1
@@ -54,13 +54,19 @@ class Monitor:
         self._last = now
 
     def limit(self, want: int, rate_limit: float) -> int:
-        """How many of `want` bytes may be sent now under rate_limit B/s."""
+        """How many of `want` bytes may be sent now under rate_limit B/s.
+
+        Token bucket with burst credit bounded at one window's worth, so a
+        long-idle connection cannot bank hours of credit and defeat the cap
+        on its next burst (flowrate.go caps with its sliding sample window
+        the same way)."""
         if rate_limit <= 0:
             return want
         now = time.monotonic()
         elapsed = max(now - self._start, 1e-9)
-        allowed = rate_limit * elapsed - self._total
-        return max(0, min(want, int(allowed)))
+        credit = rate_limit * elapsed - self._total
+        credit = min(credit, rate_limit * self.window)
+        return max(0, min(want, int(credit)))
 
     def status(self) -> Status:
         now = time.monotonic()

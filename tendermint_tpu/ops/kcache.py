@@ -17,7 +17,9 @@ first in-process compile so the foreground path never pays the ~12s
 re-trace that `jax.export` needs.
 
 The bucket set is capped (`MAX_BUCKET`) — larger batches are verified in
-chunks — so the number of compiled variants is bounded (9 buckets).
+chunks — so the number of compiled variants is bounded (21 buckets: powers
+of two 128..4096 plus multiples of 4096 up to 65536; only the buckets a
+process actually hits are compiled).
 """
 from __future__ import annotations
 
@@ -51,7 +53,13 @@ _CACHE_DIR = os.environ.get(
     os.path.expanduser(f"~/.cache/tendermint_tpu/{_host_tag()}"),
 )
 
-MAX_BUCKET = 16384
+# Cap on lanes per launch. Big enough that a launch's fixed dispatch cost
+# (65 ms per execute on a tunneled device; ~100 us locally) amortizes over
+# many signatures — a fast-syncing node verifying a stream of 10k-validator
+# commits merges ~6 commits into each launch. VMEM per Mosaic tile is
+# constant (the grid streams tiles), HBM for a 65536-lane packed input is
+# 12.8 MB, so the bound is compile-variant count, not memory.
+MAX_BUCKET = 65536
 
 _lock = threading.Lock()
 _fns: dict[tuple[str, int], object] = {}  # (platform, bucket) -> callable
@@ -84,11 +92,8 @@ def _warm_main(cache_dir: str, buckets) -> None:
         platform = _platform()
         for b in sorted({min(int(b), MAX_BUCKET) for b in buckets}):
             fn = get_verify_fn(b)
-            inputs = {
-                k: np.zeros(s.shape, s.dtype)
-                for k, s in _input_shapes(b).items()
-            }
-            np.asarray(fn(**inputs))
+            s = _input_shape(b)
+            np.asarray(fn(np.zeros(s.shape, s.dtype)))
             if not os.path.exists(_blob_path(platform, b)):
                 _write_export_blob(platform, b)
     except Exception as e:  # noqa: BLE001 — warm-up must never crash loudly
@@ -202,17 +207,13 @@ def _blob_path(platform: str, bucket: int) -> str:
     )
 
 
-def _input_shapes(bucket: int):
+def _input_shape(bucket: int):
     import jax
     import numpy as np
 
-    from tendermint_tpu.ops.ed25519_batch import NWORDS
+    from tendermint_tpu.ops.ed25519_batch import ROWS
 
-    word = jax.ShapeDtypeStruct((NWORDS, bucket), np.int32)
-    return dict(
-        a_x_w=word, a_y_w=word, a_t_w=word, s_w=word, h_w=word, yr_w=word,
-        x_parity=jax.ShapeDtypeStruct((bucket,), np.int32),
-    )
+    return jax.ShapeDtypeStruct((ROWS, bucket), np.int32)
 
 
 def _write_export_blob(platform: str, bucket: int) -> None:
@@ -223,7 +224,7 @@ def _write_export_blob(platform: str, bucket: int) -> None:
     path = _blob_path(platform, bucket)
     try:
         _, kernel = _kernel_for(platform)
-        exp = jax.export.export(kernel)(**_input_shapes(bucket))
+        exp = jax.export.export(kernel)(_input_shape(bucket))
         blob = exp.serialize()
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = path + f".tmp{os.getpid()}"
@@ -237,11 +238,8 @@ def _write_export_blob(platform: str, bucket: int) -> None:
         import numpy as np
 
         reloaded = jax.export.deserialize(blob)
-        inputs = {
-            k: np.zeros(s.shape, s.dtype)
-            for k, s in _input_shapes(bucket).items()
-        }
-        np.asarray(reloaded.call(**inputs))
+        s = _input_shape(bucket)
+        np.asarray(reloaded.call(np.zeros(s.shape, s.dtype)))
     except Exception:  # noqa: BLE001 — export is an optimization only
         pass
 
@@ -273,7 +271,7 @@ def get_verify_fn(bucket: int):
         try:
             with open(path, "rb") as f:
                 exp = jax.export.deserialize(f.read())
-            fn = lambda **kw: exp.call(**kw)  # noqa: E731
+            fn = lambda packed: exp.call(packed)  # noqa: E731
         except FileNotFoundError:
             pass
         except Exception:  # noqa: BLE001 — corrupt/stale blob: fall through
@@ -290,7 +288,7 @@ def get_verify_fn(bucket: int):
                 _spawn_warm_process([bucket])
     if fn is None:
         _, kernel = _kernel_for(platform)
-        fn = lambda **kw: kernel(**kw)  # noqa: E731
+        fn = lambda packed: kernel(packed)  # noqa: E731
     with _lock:
         _fns[key] = fn
     return fn
@@ -311,11 +309,8 @@ def prewarm(buckets=(128,), background: bool = True):
     for b in sorted({min(b, MAX_BUCKET) for b in buckets}):
         try:
             fn = get_verify_fn(b)
-            inputs = {
-                k: np.zeros(s.shape, s.dtype)
-                for k, s in _input_shapes(b).items()
-            }
-            np.asarray(fn(**inputs))
+            s = _input_shape(b)
+            np.asarray(fn(np.zeros(s.shape, s.dtype)))
         except Exception:  # noqa: BLE001 — prewarm must never kill a node
             pass
     return None

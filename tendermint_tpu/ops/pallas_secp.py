@@ -178,39 +178,43 @@ def verify_tile(u1, u2, qx, qy, t1, t2):
     return ok.astype(jnp.int32)
 
 
-def _verify_tile_kernel(u1_ref, u2_ref, qx_ref, qy_ref, t1_ref, t2_ref, out_ref):
+def _verify_tile_kernel(packed_ref, out_ref):
+    blk = packed_ref[:]  # (ROWS, SUB, LANE)
+    from tendermint_tpu.ops.secp_batch import (
+        ROW_QX, ROW_QY, ROW_T1, ROW_T2, ROW_U1, ROW_U2,
+    )
+
+    def plane(row):
+        return blk[row:row + NWORDS]
+
     out_ref[:] = verify_tile(
-        u1_ref[:], u2_ref[:], qx_ref[:], qy_ref[:], t1_ref[:], t2_ref[:]
+        plane(ROW_U1), plane(ROW_U2), plane(ROW_QX), plane(ROW_QY),
+        plane(ROW_T1), plane(ROW_T2),
     )
 
 
 @partial(jax.jit, static_argnames=("interpret",))
-def secp_verify_kernel(u1_w, u2_w, qx_w, qy_w, t1_w, t2_w,
-                       interpret: bool = False):
-    """Batched ECDSA verify: (8, B)-word inputs -> (B,) bool. B is padded
-    on device to a TILE multiple; padded lanes compute garbage verdicts
-    that are sliced off (complete formulas: junk inputs cannot fault)."""
-    b = u1_w.shape[1]
+def secp_verify_kernel(packed, interpret: bool = False):
+    """Batched ECDSA verify: (48, B) packed wire array in, (B,) bool out.
+    B is padded on device to a TILE multiple; padded lanes compute garbage
+    verdicts that are sliced off (complete formulas: junk inputs cannot
+    fault)."""
+    from tendermint_tpu.ops.secp_batch import ROWS
+
+    b = packed.shape[1]
     padded = -(-b // TILE) * TILE
     pad = padded - b
-
-    def shape(w):
-        if pad:
-            w = jnp.pad(w, ((0, 0), (0, pad)))
-        return w.reshape(NWORDS, padded // LANE, LANE)
+    if pad:
+        packed = jnp.pad(packed, ((0, 0), (0, pad)))
+    packed = packed.reshape(ROWS, padded // LANE, LANE)
 
     grid = (padded // TILE,)
-    word_spec = pl.BlockSpec((NWORDS, SUB, LANE), lambda i: (0, i, 0))
-    row_spec = pl.BlockSpec((SUB, LANE), lambda i: (i, 0))
     out = pl.pallas_call(
         _verify_tile_kernel,
         grid=grid,
-        in_specs=[word_spec] * 6,
-        out_specs=row_spec,
+        in_specs=[pl.BlockSpec((ROWS, SUB, LANE), lambda i: (0, i, 0))],
+        out_specs=pl.BlockSpec((SUB, LANE), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((padded // LANE, LANE), jnp.int32),
         interpret=interpret,
-    )(
-        shape(u1_w), shape(u2_w), shape(qx_w), shape(qy_w), shape(t1_w),
-        shape(t2_w),
-    )
+    )(packed)
     return out.reshape(-1)[:b] != 0

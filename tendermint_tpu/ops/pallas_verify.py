@@ -390,47 +390,44 @@ def verify_tile(ax, ay, at, s, h, yr, par):
     return (y_eq & par_ok).astype(jnp.int32)
 
 
-def _verify_tile_kernel(ax_ref, ay_ref, at_ref, s_ref, h_ref, yr_ref,
-                        par_ref, out_ref):
+def _verify_tile_kernel(packed_ref, out_ref):
+    blk = packed_ref[:]  # (ROWS, SUB, LANE)
+    from tendermint_tpu.ops.ed25519_batch import (
+        ROW_AT, ROW_AX, ROW_AY, ROW_H, ROW_PARITY, ROW_S, ROW_YR,
+    )
+
+    def plane(row):
+        return blk[row:row + NWORDS]
+
     out_ref[:] = verify_tile(
-        ax_ref[:], ay_ref[:], at_ref[:], s_ref[:], h_ref[:], yr_ref[:],
-        par_ref[:],
+        plane(ROW_AX), plane(ROW_AY), plane(ROW_AT), plane(ROW_S),
+        plane(ROW_H), plane(ROW_YR), blk[ROW_PARITY],
     )
 
 
 @partial(jax.jit, static_argnames=("interpret",))
-def pallas_verify_kernel(a_x_w, a_y_w, a_t_w, s_w, h_w, yr_w, x_parity,
-                         interpret: bool = False):
-    """Drop-in for ed25519_batch.verify_kernel: same (8, B)-word inputs,
-    (B,) bool out. B is padded on device to a TILE multiple; padded lanes
-    compute garbage verdicts that are sliced off (the formulas are complete,
-    so junk inputs cannot fault)."""
-    b = s_w.shape[1]
+def pallas_verify_kernel(packed, interpret: bool = False):
+    """Drop-in for ed25519_batch.verify_kernel: same (49, B) packed wire
+    array in, (B,) bool out. B is padded on device to a TILE multiple;
+    padded lanes compute garbage verdicts that are sliced off (the formulas
+    are complete, so junk inputs cannot fault)."""
+    from tendermint_tpu.ops.ed25519_batch import ROWS
+
+    b = packed.shape[1]
     padded = -(-b // TILE) * TILE
     pad = padded - b
-
-    def shape(w):  # (8, B) -> (8, rows, 128): row-major, so lanes stay put
-        if pad:
-            w = jnp.pad(w, ((0, 0), (0, pad)))
-        return w.reshape(NWORDS, padded // LANE, LANE)
-
-    par = x_parity.astype(jnp.int32)
     if pad:
-        par = jnp.pad(par, (0, pad))
-    par = par.reshape(padded // LANE, LANE)
+        packed = jnp.pad(packed, ((0, 0), (0, pad)))
+    # (ROWS, B) -> (ROWS, rows, 128): row-major, so lanes stay put
+    packed = packed.reshape(ROWS, padded // LANE, LANE)
 
     grid = (padded // TILE,)
-    word_spec = pl.BlockSpec((NWORDS, SUB, LANE), lambda i: (0, i, 0))
-    row_spec = pl.BlockSpec((SUB, LANE), lambda i: (i, 0))
     out = pl.pallas_call(
         _verify_tile_kernel,
         grid=grid,
-        in_specs=[word_spec] * 6 + [row_spec],
-        out_specs=row_spec,
+        in_specs=[pl.BlockSpec((ROWS, SUB, LANE), lambda i: (0, i, 0))],
+        out_specs=pl.BlockSpec((SUB, LANE), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((padded // LANE, LANE), jnp.int32),
         interpret=interpret,
-    )(
-        shape(a_x_w), shape(a_y_w), shape(a_t_w), shape(s_w), shape(h_w),
-        shape(yr_w), par,
-    )
+    )(packed)
     return out.reshape(-1)[:b] != 0

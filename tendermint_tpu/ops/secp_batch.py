@@ -12,8 +12,11 @@ cgo path). Work split mirrors ops/ed25519_batch.py:
   complete projective a=0 formulas; valid iff Z' != 0 and X' == t*Z' for a
   target t. See ops/pallas_secp.py.
 
-Wire format: (8, B) little-endian int32 words per 256-bit value — u1, u2,
-Qx, Qy, t1, t2 — ~192 B/signature.
+Wire format: ONE (48, B) int32 array per batch — six (8, B) little-endian
+word planes stacked (u1, u2, Qx, Qy, t1, t2; ~192 B/signature). A single
+array means a single host->device transfer per batch: on a tunneled/remote
+device every separate `device_put` pays a full RPC round trip (see
+ops/ed25519_batch.py — same design, measured there).
 """
 from __future__ import annotations
 
@@ -22,6 +25,9 @@ import numpy as np
 from tendermint_tpu.crypto import secp256k1_math as sm
 
 NWORDS = 8
+# Packed wire-format rows: u1, u2, Qx, Qy, t1, t2 word planes.
+ROW_U1, ROW_U2, ROW_QX, ROW_QY, ROW_T1, ROW_T2 = (8 * k for k in range(6))
+ROWS = 48
 
 
 class _PubkeyCache:
@@ -59,7 +65,7 @@ def _pad_to_bucket(n: int, min_bucket: int = 128) -> int:
 
 
 def prepare_batch(pubs, msgs, sigs, min_bucket: int = 128):
-    """Returns (device_inputs dict | None, valid_mask).
+    """Returns (packed (48, B) int32 array | None, valid_mask).
 
     valid_mask marks signatures already known invalid from structural checks
     (bad lengths, r/s out of range, high-S, bad pubkey) — final False.
@@ -119,18 +125,13 @@ def prepare_batch(pubs, msgs, sigs, min_bucket: int = 128):
         t2 = r + sm.N if r + sm.N < sm.P else r
         t2_w[i] = np.frombuffer(t2.to_bytes(32, "little"), dtype=np.uint32)
     padded = _pad_to_bucket(n, min_bucket)
-    pad = padded - n
-
-    def pack(a):
-        return np.ascontiguousarray(np.pad(a, ((0, pad), (0, 0))).T.view(np.int32))
-
-    return (
-        dict(
-            u1_w=pack(u1_w), u2_w=pack(u2_w), qx_w=pack(qx_w),
-            qy_w=pack(qy_w), t1_w=pack(t1_w), t2_w=pack(t2_w),
-        ),
-        mask,
-    )
+    packed = np.zeros((ROWS, padded), dtype=np.int32)
+    for row, a in (
+        (ROW_U1, u1_w), (ROW_U2, u2_w), (ROW_QX, qx_w),
+        (ROW_QY, qy_w), (ROW_T1, t1_w), (ROW_T2, t2_w),
+    ):
+        packed[row:row + NWORDS, :n] = a.T.view(np.int32)
+    return packed, mask
 
 
 def _device_fn():
@@ -155,24 +156,34 @@ def _serial_verify(pubs, msgs, sigs) -> list[bool]:
 
 
 def verify_batch(pubs, msgs, sigs) -> list[bool]:
-    """Full batched verification: host prep + one device launch per chunk."""
-    n = len(pubs)
-    max_bucket = 16384
-    if n > max_bucket:
-        out: list[bool] = []
-        for lo in range(0, n, max_bucket):
-            hi = lo + max_bucket
-            out.extend(verify_batch(pubs[lo:hi], msgs[lo:hi], sigs[lo:hi]))
-        return out
+    """Full batched verification: host prep + one device launch per chunk.
+
+    Chunk launches are dispatched asynchronously and collected at the end
+    (one device transfer + one execute each — see ed25519_batch.verify_batch
+    for the dispatch-cost rationale)."""
+    from tendermint_tpu.ops import kcache
+
     fn = _device_fn()
     if fn is None:
         return _serial_verify(pubs, msgs, sigs)
-    inputs, mask = prepare_batch(pubs, msgs, sigs)
-    if inputs is None:
-        return mask.tolist()
-    try:
-        ok = np.asarray(fn(**inputs))[:n]
-    except Exception:  # noqa: BLE001 — kernel failure degrades to serial,
-        # never breaks verification
-        return _serial_verify(pubs, msgs, sigs)
-    return (ok & mask).tolist()
+    n = len(pubs)
+    pending: list[tuple[int, int, object, np.ndarray]] = []
+    out = np.zeros(n, dtype=bool)
+    for lo in range(0, n, kcache.MAX_BUCKET):
+        hi = min(lo + kcache.MAX_BUCKET, n)
+        packed, mask = prepare_batch(pubs[lo:hi], msgs[lo:hi], sigs[lo:hi])
+        if packed is None:
+            continue
+        try:
+            dev_out = fn(packed)
+        except Exception:  # noqa: BLE001 — kernel failure degrades to
+            # serial, never breaks verification
+            out[lo:hi] = _serial_verify(pubs[lo:hi], msgs[lo:hi], sigs[lo:hi])
+            continue
+        pending.append((lo, hi, dev_out, mask))
+    for lo, hi, dev_out, mask in pending:
+        try:
+            out[lo:hi] = np.asarray(dev_out)[: hi - lo] & mask
+        except Exception:  # noqa: BLE001 — async failure surfaces at fetch
+            out[lo:hi] = _serial_verify(pubs[lo:hi], msgs[lo:hi], sigs[lo:hi])
+    return out.tolist()

@@ -94,6 +94,7 @@ class MConnection(BaseService):
         self._send_wake = asyncio.Event()
         self._pong_pending = 0
         self._last_pong = time.monotonic()
+        self._last_flush = time.monotonic()
         self._send_monitor = Monitor()
         self._recv_monitor = Monitor()
         self._errored = False
@@ -161,7 +162,16 @@ class MConnection(BaseService):
                     w = Writer().u8(_PKT_MSG).u8(ch.desc.id).bool(eof).bytes(chunk)
                     await self._write_packet(w.build())
                     ch.recently_sent += len(chunk)
+                    # flush-throttled mid-burst drain (connection.go:74
+                    # flushThrottle, default 100ms): a long burst flushes
+                    # every flush_throttle seconds — batching writes —
+                    # while bounding how stale buffered packets can get
+                    now = time.monotonic()
+                    if now - self._last_flush >= self.config.flush_throttle:
+                        await self._conn.drain()
+                        self._last_flush = now
                 await self._conn.drain()
+                self._last_flush = time.monotonic()
                 # decay so bursts don't starve low-priority channels forever
                 for c in self._channels.values():
                     c.recently_sent = int(c.recently_sent * 0.8)
@@ -171,12 +181,23 @@ class MConnection(BaseService):
             await self._fail(e)
 
     async def _write_packet(self, pkt: bytes) -> None:
+        # flowrate cap (config/config.go:473 SendRate, default 5 MB/s):
+        # wait until the token bucket admits the packet, so sustained
+        # throughput converges on send_rate instead of oscillating. When
+        # the configured rate is so low that one packet exceeds a full
+        # window of credit, admit at a full bucket — the debt recorded by
+        # update() still paces the long-run rate — so progress is always
+        # made (a send_rate below ~1 KB/s must throttle, never wedge).
+        rate = self.config.send_rate
+        if rate > 0:
+            target = min(len(pkt), max(1, int(rate * self._send_monitor.window)))
+            while True:
+                allowed = self._send_monitor.limit(len(pkt), rate)
+                if allowed >= target:
+                    break
+                await asyncio.sleep((target - allowed) / rate)
         await self._conn.write(pkt)
         self._send_monitor.update(len(pkt))
-        # crude rate limit: sleep off any excess over send_rate
-        st = self._send_monitor.status()
-        if st.cur_rate > self.config.send_rate > 0:
-            await asyncio.sleep(len(pkt) / self.config.send_rate)
 
     # --- receiving -------------------------------------------------------
 

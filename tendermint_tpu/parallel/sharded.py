@@ -22,17 +22,9 @@ from tendermint_tpu.ops import ed25519_batch
 
 AXIS = "batch"
 
-# Positional layout of the kernel inputs; packed word arrays carry the
-# batch on axis 1 (words on axis 0), parity is per-signature.
-_INPUT_SPECS = {
-    "a_x_w": P(None, AXIS),
-    "a_y_w": P(None, AXIS),
-    "a_t_w": P(None, AXIS),
-    "s_w": P(None, AXIS),
-    "h_w": P(None, AXIS),
-    "yr_w": P(None, AXIS),
-    "x_parity": P(AXIS),
-}
+# The packed (49, B) wire array carries the batch on axis 1 (wire rows on
+# axis 0): shard the batch, replicate nothing — every row is per-signature.
+_PACKED_SPEC = P(None, AXIS)
 
 
 def make_batch_mesh(devices=None) -> Mesh:
@@ -42,30 +34,20 @@ def make_batch_mesh(devices=None) -> Mesh:
     return Mesh(np.asarray(devices), (AXIS,))
 
 
-def shard_inputs(mesh: Mesh, inputs: dict) -> dict:
-    """Place a `prepare_batch` input dict onto the mesh, batch-sharded.
+def shard_inputs(mesh: Mesh, packed):
+    """Place a `prepare_batch` packed array onto the mesh, batch-sharded.
 
     The batch dim must be divisible by the mesh size; `prepare_batch` pads to
     power-of-two buckets, so any power-of-two mesh divides it.
     """
-    out = {}
-    for k, v in inputs.items():
-        out[k] = jax.device_put(v, NamedSharding(mesh, _INPUT_SPECS[k]))
-    return out
+    return jax.device_put(packed, NamedSharding(mesh, _PACKED_SPEC))
 
 
 def build_sharded_verifier(mesh: Mesh):
     """jit the verify kernel with explicit batch shardings over `mesh`."""
-    in_shardings = tuple(
-        NamedSharding(mesh, _INPUT_SPECS[k])
-        for k in (
-            "a_x_w", "a_y_w", "a_t_w", "s_w", "h_w", "yr_w",
-            "x_parity",
-        )
-    )
     return jax.jit(
-        ed25519_batch.verify_kernel.__wrapped__,
-        in_shardings=in_shardings,
+        lambda packed: ed25519_batch.verify_core(*ed25519_batch.unpack(packed)),
+        in_shardings=(NamedSharding(mesh, _PACKED_SPEC),),
         out_shardings=NamedSharding(mesh, P(AXIS)),
     )
 
@@ -73,32 +55,23 @@ def build_sharded_verifier(mesh: Mesh):
 def build_commit_verifier(mesh: Mesh):
     """shard_map'd commit decision: per-chip verify + psum'd valid count.
 
-    Returns fn(a_x_w, ..., x_parity) -> (ok_bitmap (B,), n_valid ()).
+    Returns fn(packed) -> (ok_bitmap (B,), n_valid ()).
     The exact 2/3 voting-power quorum is computed on host from the bitmap
     (voting power is 63-bit in the reference — MaxTotalVotingPower = 2^60/8,
     types/validator_set.go:807-845 — which does not fit device int32 math);
     the psum here gives the fast all-chips-agree valid count over ICI.
     """
 
-    def local(a_x_w, a_y_w, a_t_w, s_w, h_w, yr_w, x_parity):
-        ok = ed25519_batch.verify_kernel.__wrapped__(
-            a_x_w, a_y_w, a_t_w, s_w, h_w, yr_w, x_parity
-        )
+    def local(packed):
+        ok = ed25519_batch.verify_core(*ed25519_batch.unpack(packed))
         n_valid = jax.lax.psum(jnp.sum(ok.astype(jnp.int32)), AXIS)
         return ok, n_valid
 
-    spec_in = tuple(
-        _INPUT_SPECS[k]
-        for k in (
-            "a_x_w", "a_y_w", "a_t_w", "s_w", "h_w", "yr_w",
-            "x_parity",
-        )
-    )
     # check_vma=False: the Shamir fori_loop carry starts from broadcast
     # module constants (identity point), which trips the varying-axes check
     # even though every lane's compute is genuinely per-shard.
     mapped = jax.shard_map(
-        local, mesh=mesh, in_specs=spec_in, out_specs=(P(AXIS), P()),
+        local, mesh=mesh, in_specs=(_PACKED_SPEC,), out_specs=(P(AXIS), P()),
         check_vma=False,
     )
     return jax.jit(mapped)

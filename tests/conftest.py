@@ -7,8 +7,14 @@ These env vars must be set before jax is imported anywhere.
 import os
 
 # Force CPU even when the ambient environment points at a real TPU
-# (JAX_PLATFORMS=axon): the suite needs 8 virtual devices for sharding tests.
-os.environ["JAX_PLATFORMS"] = "cpu"
+# (JAX_PLATFORMS=axon): the suite needs 8 virtual devices for sharding
+# tests. Exception: TMTPU_TPU_TESTS=1 keeps the real device so the
+# device-gated kernel tests (tests/test_ops_verify.py) exercise the actual
+# Mosaic/TPU lowering — run ONLY those files in that mode (the sharding
+# tests need the 8-device CPU mesh and will fail on a single real chip).
+_TPU_MODE = bool(os.environ.get("TMTPU_TPU_TESTS"))
+if not _TPU_MODE:
+    os.environ["JAX_PLATFORMS"] = "cpu"
 # No background kernel compiles during tests: export-blob writer threads and
 # node prewarm each cost minutes of XLA:CPU compile, saturate the CPU, and
 # are joined at process exit (non-daemon). The in-process jit path still
@@ -25,7 +31,8 @@ import jax  # noqa: E402
 
 # The axon TPU plugin registers itself regardless of JAX_PLATFORMS; the
 # config update is the authoritative override.
-jax.config.update("jax_platforms", "cpu")
+if not _TPU_MODE:
+    jax.config.update("jax_platforms", "cpu")
 
 import asyncio  # noqa: E402
 import inspect  # noqa: E402

@@ -44,8 +44,8 @@ class TestKCache:
         kcache._fns.clear()
         kcache._exports_scheduled.clear()
         fn = kcache.get_verify_fn(128)
-        inputs, mask = eb.prepare_batch(pubs, msgs, sigs)
-        ok = np.asarray(fn(**inputs))[:8]
+        packed, mask = eb.prepare_batch(pubs, msgs, sigs)
+        ok = np.asarray(fn(packed))[:8]
         assert ok.all() and mask.all()
 
     def test_corrupt_blob_falls_back(self, tmp_cache_dir):

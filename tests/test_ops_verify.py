@@ -217,14 +217,11 @@ class TestPallasKernelMath:
         pubs, msgs, sigs = _make_sigs(64)
         pubs, msgs, sigs = pubs * 2, msgs * 2, sigs * 2
         sigs[1] = bytes([sigs[1][0] ^ 1]) + sigs[1][1:]
-        inputs, mask = ed25519_batch.prepare_batch(pubs, msgs, sigs)
-        ref = np.asarray(ed25519_batch.verify_kernel(**inputs))
+        packed, mask = ed25519_batch.prepare_batch(pubs, msgs, sigs)
+        ref = np.asarray(ed25519_batch.verify_kernel(packed))
+        ax, ay, at, s_w, h_w, yr, par = ed25519_batch.unpack(packed)
         out = np.asarray(
-            jax.jit(pv.verify_tile)(
-                inputs["a_x_w"], inputs["a_y_w"], inputs["a_t_w"],
-                inputs["s_w"], inputs["h_w"], inputs["yr_w"],
-                inputs["x_parity"].astype(np.int32),
-            )
+            jax.jit(pv.verify_tile)(ax, ay, at, s_w, h_w, yr, par)
         ).reshape(-1) != 0
         assert (ref == out).all()
         assert int(out[:128].sum()) == 127  # the one corrupted sig rejected

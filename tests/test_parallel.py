@@ -14,22 +14,22 @@ from tendermint_tpu.utils import make_sig_batch as _batch
 
 def test_sharded_verifier_matches_single_chip():
     pubs, msgs, sigs = _batch(16, tamper={3, 11})
-    inputs, mask = ed25519_batch.prepare_batch(pubs, msgs, sigs, min_bucket=16)
+    packed, mask = ed25519_batch.prepare_batch(pubs, msgs, sigs, min_bucket=16)
     mesh = make_batch_mesh()
     fn = build_sharded_verifier(mesh)
-    placed = shard_inputs(mesh, inputs)
-    ok = np.asarray(fn(*[placed[k] for k in ge._ARG_ORDER]))[:16]
+    placed = shard_inputs(mesh, packed)
+    ok = np.asarray(fn(placed))[:16]
     expected = [i not in {3, 11} for i in range(16)]
     assert (ok & mask[:16]).tolist() == expected
 
 
 def test_commit_verifier_psum_quorum():
     pubs, msgs, sigs = _batch(8, tamper={5})
-    inputs, _ = ed25519_batch.prepare_batch(pubs, msgs, sigs, min_bucket=8)
+    packed, _ = ed25519_batch.prepare_batch(pubs, msgs, sigs, min_bucket=8)
     mesh = make_batch_mesh()
     fn = build_commit_verifier(mesh)
-    placed = shard_inputs(mesh, inputs)
-    ok, n_valid = fn(*[placed[k] for k in ge._ARG_ORDER])
+    placed = shard_inputs(mesh, packed)
+    ok, n_valid = fn(placed)
     assert int(n_valid) == 7
     assert np.asarray(ok)[:8].tolist() == [i != 5 for i in range(8)]
 
