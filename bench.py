@@ -52,6 +52,14 @@ def main() -> None:
     kcache.enable_persistent_cache()
     dev = jax.devices()[0]
     log(f"device: {dev.platform} ({dev.device_kind})")
+    # Pre-claim the export-blob slots for every bucket this run touches so
+    # no background warm-up subprocess spawns mid-measurement: on a
+    # tunneled device a second process's compile CONTENDS with the
+    # foreground RPC stream (measured: a 20 s stall on the first verify).
+    # A node wants that background warm-up (it saves the NEXT process
+    # minutes of compile); a benchmark wants clean steady-state numbers.
+    for b in (128, 1024, 12288, 16384, 65536, 81920, kcache.MAX_BUCKET):
+        kcache._exports_scheduled.add((dev.platform, b))
 
     # N_UNIQUE real keypairs tiled to N_COMMIT (device work per lane is
     # data-independent); K distinct per-commit messages, all pre-signed.
@@ -81,34 +89,62 @@ def main() -> None:
 
     fn = kcache.get_verify_fn(packed.shape[1])
     t0 = time.perf_counter()
-    out = np.asarray(fn(jax.device_put(packed, dev)))
+    out = np.asarray(fn(*(jax.device_put(b, dev) for b in ed25519_batch.split(packed))))
     log(f"compile + first run: {time.perf_counter() - t0:.1f}s")
     assert out[:N_COMMIT].all(), "kernel rejected valid sigs"
 
     # -- single-commit latency (fully sync, includes tunnel round trip) ----
-    lat = []
-    for k in range(3):
-        t0 = time.perf_counter()
-        packed, _ = ed25519_batch.prepare_batch(*commits[k])
-        out = np.asarray(fn(jax.device_put(packed, dev)))
-        lat.append(time.perf_counter() - t0)
-    log(f"single 10k-commit latency (sync): {min(lat) * 1e3:.1f} ms")
+    # verify_batch end to end: prep + device-key-cache lookup + launch +
+    # fetch. First call is the cold-valset path (key block transferred);
+    # repeats hit the resident key block like a live validator does.
+    for label, ks in (("cold", range(1)), ("warm keys", range(1, 3))):
+        lat = []
+        for k in ks:
+            t0 = time.perf_counter()
+            ok = ed25519_batch.verify_batch(*commits[k % PIPELINE_K])
+            lat.append(time.perf_counter() - t0)
+            assert all(ok)
+        log(
+            f"single 10k-commit latency ({label}, sync): "
+            f"{min(lat) * 1e3:.1f} ms"
+        )
 
     # -- stream throughput: K distinct commits through verify_batch --------
     # (compile the stream chunk buckets outside the timed region; a node
     # prewarms them the same way at start — kcache.prewarm)
     merged = [sum((c[i] for c in commits), []) for i in range(3)]
     n_total = len(merged[0])
-    tail = n_total % kcache.MAX_BUCKET
-    warm_buckets = {kcache.MAX_BUCKET} if n_total >= kcache.MAX_BUCKET else set()
-    if tail:
-        warm_buckets.add(ed25519_batch._pad_to_bucket(tail))
+    warm_buckets = set()
+    for lo in range(0, n_total, kcache.MAX_BUCKET):
+        warm_buckets.add(
+            ed25519_batch._pad_to_bucket(min(kcache.MAX_BUCKET, n_total - lo))
+        )
     kcache.prewarm(sorted(warm_buckets), background=False)
 
+    # cold stream: key blocks transferred; warm stream: keys device-resident
+    # (the fast-sync steady state — the same valset signs every height)
+    ed25519_batch._dev_keys._d.clear()
     t0 = time.perf_counter()
     ok = ed25519_batch.verify_batch(*merged)
-    stream_s = time.perf_counter() - t0
+    cold_stream_s = time.perf_counter() - t0
     assert all(ok), "stream verify rejected valid sigs"
+    merged2 = list(merged)
+    merged2[1] = [b"bench vote warm %05d" % (i // N_COMMIT) for i in range(n_total)]
+    # re-sign under the warm messages so the warm stream is distinct work
+    warm_sigs = []
+    for k in range(PIPELINE_K):
+        msg = b"bench vote warm %05d" % k
+        sigs_k = [p.sign(msg) for p in privs]
+        warm_sigs.extend((sigs_k * reps)[:N_COMMIT])
+    merged2[2] = warm_sigs
+    t0 = time.perf_counter()
+    ok = ed25519_batch.verify_batch(*merged2)
+    stream_s = time.perf_counter() - t0
+    assert all(ok), "warm stream verify rejected valid sigs"
+    log(
+        f"{PIPELINE_K}x10k-commit stream, cold valset: "
+        f"{cold_stream_s * 1e3:.1f} ms ({n_total / cold_stream_s:,.0f}/s)"
+    )
     per_commit_s = stream_s / PIPELINE_K
     rate = n_total / stream_s
 
@@ -118,17 +154,16 @@ def main() -> None:
         for k in range(5):
             p, m, s = commits[k % PIPELINE_K]
             t0 = time.perf_counter()
-            packed_n, _ = ed25519_batch.prepare_batch(p[:n], m[:n], s[:n])
-            fn_n = kcache.get_verify_fn(packed_n.shape[1])
-            ok_n = np.asarray(fn_n(jax.device_put(packed_n, dev)))
+            ok_n = ed25519_batch.verify_batch(p[:n], m[:n], s[:n])
             samples.append(time.perf_counter() - t0)
+            assert all(ok_n)
         log(
             f"commit-verify p50 @ {n} validators: "
             f"{statistics.median(samples) * 1e3:.1f} ms (sync, tunnel incl.)"
         )
 
     log(
-        f"{PIPELINE_K}x10k-commit stream end-to-end: {stream_s * 1e3:.1f} ms "
+        f"{PIPELINE_K}x10k-commit stream, warm valset: {stream_s * 1e3:.1f} ms "
         f"({per_commit_s * 1e3:.2f} ms/commit, {rate:,.0f} verifies/sec/chip; "
         f"north star <5ms/commit on v4-8)"
     )

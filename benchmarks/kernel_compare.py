@@ -45,14 +45,20 @@ def main() -> None:
 
         outs = {}
         for name, fn in kernels.items():
+            keys_np, sigs_np = ed25519_batch.split(packed)
             try:
                 t0 = time.perf_counter()
-                out = np.asarray(fn(jax.device_put(packed, dev)))
+                out = np.asarray(
+                    fn(jax.device_put(keys_np, dev), jax.device_put(sigs_np, dev))
+                )
                 compile_s = time.perf_counter() - t0
                 iters = 5
                 t0 = time.perf_counter()
                 for _ in range(iters):
-                    out = np.asarray(fn(jax.device_put(packed, dev)))
+                    out = np.asarray(
+                        fn(jax.device_put(keys_np, dev),
+                           jax.device_put(sigs_np, dev))
+                    )
                 dt = (time.perf_counter() - t0) / iters
                 outs[name] = out
                 print(

@@ -220,6 +220,21 @@ class BlockPool(BaseService):
             second.block if second else None,
         )
 
+    # NOTE: peek_two_blocks is kept for reference-API parity (PeekTwoBlocks)
+    # even though the v0 reactor now drives peek_window.
+
+    def peek_window(self, max_blocks: int) -> "list[Block]":
+        """Contiguous downloaded blocks from pool.height up (verify-ahead
+        window: the reactor batches the commits of every pending pair into
+        one device launch instead of one launch per height)."""
+        out = []
+        for h in range(self.height, self.height + max_blocks):
+            req = self.requesters.get(h)
+            if req is None or req.block is None:
+                break
+            out.append(req.block)
+        return out
+
     def pop_request(self) -> None:
         """First block verified+applied: advance (reference PopRequest)."""
         self.requesters.pop(self.height, None)

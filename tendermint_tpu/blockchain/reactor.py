@@ -17,13 +17,16 @@ from tendermint_tpu.libs.log import NOP, Logger
 from tendermint_tpu.p2p.base_reactor import BaseReactor, ChannelDescriptor
 from tendermint_tpu.types import BlockID
 from tendermint_tpu.types.block import Block
-from tendermint_tpu.types.validator_set import VerifyError
+from tendermint_tpu.types.validator_set import verify_commits
 
 BLOCKCHAIN_CHANNEL = 0x40
 
 TRY_SYNC_INTERVAL = 0.01  # reference reactor.go trySyncTicker 10ms
 STATUS_UPDATE_INTERVAL = 10.0
 SWITCH_TO_CONSENSUS_INTERVAL = 1.0
+# verify-ahead window: pending heights whose commits are fused into one
+# device batch (per-launch dispatch cost amortizes over the window)
+VERIFY_AHEAD_WINDOW = 16
 
 
 @dataclass
@@ -111,6 +114,18 @@ class BlockchainReactor(BaseReactor):
             logger=logger,
         )
         self.blocks_synced = 0
+        # verify-ahead caches: (height, block_hash, valset_hash) -> verdict.
+        # Pass/fail is only meaningful under the valset it was checked with;
+        # a failed ahead-check is NOT evidence of a bad peer (an intervening
+        # block may rotate the validator set), so failures are cached to
+        # avoid re-verifying every loop but punished only at the head where
+        # the current valset is authoritative.
+        self._verified_ahead: set[tuple[int, bytes, bytes]] = set()
+        self._failed_ahead: set[tuple[int, bytes, bytes]] = set()
+        # ValidatorSet.hash() merkle-hashes every validator; memoize per
+        # valset object so the 10ms sync tick doesn't recompute it
+        self._vs_hash_src: object | None = None
+        self._vs_hash = b""
 
     def get_channels(self) -> list[ChannelDescriptor]:
         return [
@@ -220,21 +235,57 @@ class BlockchainReactor(BaseReactor):
             if not await self._try_sync_one():
                 await asyncio.sleep(TRY_SYNC_INTERVAL)
 
+    def _verify_ahead(self, blocks: "list[Block]", vs_hash: bytes) -> None:
+        """Fuse the unverified (block, next.last_commit) pairs of the window
+        into ONE device batch (hot loop #3 across heights — the reference
+        verifies serially per height, reactor.go:313)."""
+        entries, keys = [], []
+        for blk, nxt in zip(blocks, blocks[1:]):
+            key = (blk.header.height, blk.hash(), vs_hash)
+            if key in self._verified_ahead or key in self._failed_ahead:
+                continue
+            parts = blk.make_part_set()
+            entries.append(
+                (
+                    self.state.validators,
+                    self.state.chain_id,
+                    BlockID(blk.hash(), parts.header()),
+                    blk.header.height,
+                    nxt.last_commit,
+                )
+            )
+            keys.append(key)
+        if not entries:
+            return
+        for key, err in zip(keys, verify_commits(entries)):
+            (self._verified_ahead if err is None else self._failed_ahead).add(key)
+        if len(entries) > 1:
+            self.log.debug(
+                "verify-ahead batch", heights=len(entries),
+                from_height=keys[0][0],
+            )
+
     async def _try_sync_one(self) -> bool:
         """Verify+apply the first block using the second's LastCommit
         (reference reactor.go:271-330). Returns True if a block was applied."""
-        first, second = self.pool.peek_two_blocks()
-        if first is None or second is None:
+        blocks = self.pool.peek_window(VERIFY_AHEAD_WINDOW)
+        if len(blocks) < 2:
             return False
+        first, second = blocks[0], blocks[1]
+        if self._vs_hash_src is not self.state.validators:
+            self._vs_hash_src = self.state.validators
+            self._vs_hash = self.state.validators.hash()
+        vs_hash = self._vs_hash
+        self._verify_ahead(blocks, vs_hash)
         first_parts = first.make_part_set()
         first_id = BlockID(first.hash(), first_parts.header())
-        try:
-            # hot loop #3: one batched device verify per commit
-            self.state.validators.verify_commit(
-                self.state.chain_id, first_id, first.header.height, second.last_commit
+        head_key = (first.header.height, first.hash(), vs_hash)
+        if head_key not in self._verified_ahead:
+            # at the head the current valset IS authoritative: a failure
+            # here means a bad block/commit, not a stale-valset artifact
+            self.log.error(
+                "fast-sync block verify failed", height=first.header.height
             )
-        except VerifyError as e:
-            self.log.error("fast-sync block verify failed", height=first.header.height, err=str(e))
             # disconnect both senders (reference reactor.go poolRoutine
             # StopPeerForError) — pool removal alone lets a Byzantine peer
             # rejoin on the next status broadcast and stall sync forever
@@ -244,11 +295,24 @@ class BlockchainReactor(BaseReactor):
             ):
                 if bad is not None:
                     await self._on_pool_peer_error(bad, "sent invalid block")
+            self._failed_ahead.discard(head_key)  # re-verify the redo
             return False
         self.pool.pop_request()
         self.block_store.save_block(first, first_parts, second.last_commit)
         self.state = await self.block_exec.apply_block(self.state, first_id, first)
         self.blocks_synced += 1
+        # applying a block can rotate the valset for subsequent heights;
+        # cached verdicts under the old valset hash are then unreachable —
+        # prune everything below the new sync head (and stale hashes decay
+        # naturally because lookups are keyed by the current valset hash)
+        if self._verified_ahead or self._failed_ahead:
+            floor = self.pool.height
+            self._verified_ahead = {
+                k for k in self._verified_ahead if k[0] >= floor
+            }
+            self._failed_ahead = {
+                k for k in self._failed_ahead if k[0] >= floor
+            }
         if self.blocks_synced % 100 == 0:
             self.log.info(
                 "fast sync progress", height=self.pool.height,

@@ -14,7 +14,6 @@ ticker; verification/apply is shared with v0 (batched commit verify).
 """
 from __future__ import annotations
 
-import asyncio
 import enum
 import time
 from dataclasses import dataclass, field

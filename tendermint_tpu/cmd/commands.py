@@ -10,7 +10,7 @@ import signal
 import sys
 import time
 
-from tendermint_tpu.config import Config, make_test_config
+from tendermint_tpu.config import Config
 from tendermint_tpu.crypto import ed25519
 from tendermint_tpu.libs.log import new_logger
 from tendermint_tpu.p2p.key import NodeKey
@@ -234,7 +234,7 @@ async def _replay_console(cfg) -> int:
     """Interactive WAL stepper (reference replay_file.go console:
     next [N] / status / quit)."""
     from tendermint_tpu import proxy
-    from tendermint_tpu.consensus.wal import MsgInfo, TimedWALMessage, WAL, WALTimeoutInfo
+    from tendermint_tpu.consensus.wal import MsgInfo, WAL, WALTimeoutInfo
     from tendermint_tpu.consensus.replay import Handshaker
     from tendermint_tpu.consensus.state import ConsensusState
     from tendermint_tpu.consensus.wal import NilWAL

@@ -7,7 +7,7 @@ framing. Tagged-union CBE encoding.
 """
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from tendermint_tpu.consensus.round_state import RoundStep
 from tendermint_tpu.encoding import DecodeError, Reader, Writer

@@ -22,7 +22,7 @@ from tendermint_tpu.consensus.state import ConsensusState
 from tendermint_tpu.libs.bit_array import BitArray
 from tendermint_tpu.libs.log import NOP, Logger
 from tendermint_tpu.p2p.base_reactor import BaseReactor, ChannelDescriptor
-from tendermint_tpu.types import BlockID, PartSetHeader, Vote, VoteType
+from tendermint_tpu.types import PartSetHeader, Vote, VoteType
 from tendermint_tpu.types.vote_set import VoteSet
 
 STATE_CHANNEL = 0x20

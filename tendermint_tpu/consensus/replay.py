@@ -22,7 +22,7 @@ from tendermint_tpu.consensus.wal import (
     WALTimeoutInfo,
 )
 from tendermint_tpu.libs.log import NOP, Logger
-from tendermint_tpu.state import State, StateStore, state_from_genesis
+from tendermint_tpu.state import State, StateStore
 from tendermint_tpu.state.execution import BlockExecutor
 from tendermint_tpu.types import BlockID, GenesisDoc, ValidatorSet
 from tendermint_tpu.types.validator import Validator

@@ -8,13 +8,12 @@ consensus/types/peer_round_state.go.
 from __future__ import annotations
 
 import enum
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from tendermint_tpu.libs.bit_array import BitArray
 from tendermint_tpu.types import (
     Block,
     BlockID,
-    Commit,
     PartSet,
     PartSetHeader,
     Proposal,
@@ -23,7 +22,6 @@ from tendermint_tpu.types import (
     VoteSet,
     VoteType,
 )
-from tendermint_tpu.types.vote_set import ConflictingVoteError
 
 
 class RoundStep(enum.IntEnum):

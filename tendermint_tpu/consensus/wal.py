@@ -18,7 +18,6 @@ from tendermint_tpu.consensus.messages import (
     decode_consensus_message,
     encode_consensus_message,
 )
-from tendermint_tpu.consensus.round_state import RoundStep
 from tendermint_tpu.encoding import DecodeError, Reader, Writer
 from tendermint_tpu.libs.autofile import Group
 

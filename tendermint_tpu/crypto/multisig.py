@@ -13,7 +13,7 @@ config #5: mixed-key 10k-validator streaming AddVote).
 from __future__ import annotations
 
 from tendermint_tpu import crypto as _crypto
-from tendermint_tpu.crypto import PrivKey, PubKey, sum_truncated
+from tendermint_tpu.crypto import PubKey, sum_truncated
 from tendermint_tpu.encoding import Reader, Writer
 
 TYPE = "multisig-threshold"

@@ -7,7 +7,6 @@ chaining, tmfmt/JSON output, per-module level filter
 from __future__ import annotations
 
 import json
-import logging
 import sys
 import time
 from typing import Any

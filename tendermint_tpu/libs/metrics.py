@@ -9,7 +9,6 @@ from __future__ import annotations
 
 import asyncio
 import bisect
-import time
 
 
 class Collector:

@@ -17,9 +17,9 @@ first in-process compile so the foreground path never pays the ~12s
 re-trace that `jax.export` needs.
 
 The bucket set is capped (`MAX_BUCKET`) — larger batches are verified in
-chunks — so the number of compiled variants is bounded (21 buckets: powers
-of two 128..4096 plus multiples of 4096 up to 65536; only the buckets a
-process actually hits are compiled).
+chunks — so the number of compiled variants is bounded (25 buckets: powers
+of two 128..4096, multiples of 4096 to 65536, multiples of 16384 to
+131072; only the buckets a process actually hits are compiled).
 """
 from __future__ import annotations
 
@@ -56,10 +56,12 @@ _CACHE_DIR = os.environ.get(
 # Cap on lanes per launch. Big enough that a launch's fixed dispatch cost
 # (65 ms per execute on a tunneled device; ~100 us locally) amortizes over
 # many signatures — a fast-syncing node verifying a stream of 10k-validator
-# commits merges ~6 commits into each launch. VMEM per Mosaic tile is
-# constant (the grid streams tiles), HBM for a 65536-lane packed input is
-# 12.8 MB, so the bound is compile-variant count, not memory.
-MAX_BUCKET = 65536
+# commits merges ~13 commits into each launch (measured: a 61440-lane
+# launch is ~82 ms launch+fetch vs ~70 ms for 16384, so lanes are nearly
+# free next to the dispatch floor). VMEM per Mosaic tile is constant (the
+# grid streams tiles), HBM for a 131072-lane packed input is 25.7 MB, so
+# the bound is compile-variant count, not memory.
+MAX_BUCKET = 131072
 
 _lock = threading.Lock()
 _fns: dict[tuple[str, int], object] = {}  # (platform, bucket) -> callable
@@ -92,8 +94,10 @@ def _warm_main(cache_dir: str, buckets) -> None:
         platform = _platform()
         for b in sorted({min(int(b), MAX_BUCKET) for b in buckets}):
             fn = get_verify_fn(b)
-            s = _input_shape(b)
-            np.asarray(fn(np.zeros(s.shape, s.dtype)))
+            ks, ss = _input_shapes(b)
+            np.asarray(
+                fn(np.zeros(ks.shape, ks.dtype), np.zeros(ss.shape, ss.dtype))
+            )
             if not os.path.exists(_blob_path(platform, b)):
                 _write_export_blob(platform, b)
     except Exception as e:  # noqa: BLE001 — warm-up must never crash loudly
@@ -207,13 +211,16 @@ def _blob_path(platform: str, bucket: int) -> str:
     )
 
 
-def _input_shape(bucket: int):
+def _input_shapes(bucket: int):
     import jax
     import numpy as np
 
-    from tendermint_tpu.ops.ed25519_batch import ROWS
+    from tendermint_tpu.ops.ed25519_batch import KEY_ROWS, SIG_ROWS
 
-    return jax.ShapeDtypeStruct((ROWS, bucket), np.int32)
+    return (
+        jax.ShapeDtypeStruct((KEY_ROWS, bucket), np.int32),
+        jax.ShapeDtypeStruct((SIG_ROWS, bucket), np.int32),
+    )
 
 
 def _write_export_blob(platform: str, bucket: int) -> None:
@@ -224,7 +231,7 @@ def _write_export_blob(platform: str, bucket: int) -> None:
     path = _blob_path(platform, bucket)
     try:
         _, kernel = _kernel_for(platform)
-        exp = jax.export.export(kernel)(_input_shape(bucket))
+        exp = jax.export.export(kernel)(*_input_shapes(bucket))
         blob = exp.serialize()
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = path + f".tmp{os.getpid()}"
@@ -238,8 +245,12 @@ def _write_export_blob(platform: str, bucket: int) -> None:
         import numpy as np
 
         reloaded = jax.export.deserialize(blob)
-        s = _input_shape(bucket)
-        np.asarray(reloaded.call(np.zeros(s.shape, s.dtype)))
+        ks, ss = _input_shapes(bucket)
+        np.asarray(
+            reloaded.call(
+                np.zeros(ks.shape, ks.dtype), np.zeros(ss.shape, ss.dtype)
+            )
+        )
     except Exception:  # noqa: BLE001 — export is an optimization only
         pass
 
@@ -271,7 +282,7 @@ def get_verify_fn(bucket: int):
         try:
             with open(path, "rb") as f:
                 exp = jax.export.deserialize(f.read())
-            fn = lambda packed: exp.call(packed)  # noqa: E731
+            fn = lambda keys, sigs: exp.call(keys, sigs)  # noqa: E731
         except FileNotFoundError:
             pass
         except Exception:  # noqa: BLE001 — corrupt/stale blob: fall through
@@ -288,7 +299,7 @@ def get_verify_fn(bucket: int):
                 _spawn_warm_process([bucket])
     if fn is None:
         _, kernel = _kernel_for(platform)
-        fn = lambda packed: kernel(packed)  # noqa: E731
+        fn = lambda keys, sigs: kernel(keys, sigs)  # noqa: E731
     with _lock:
         _fns[key] = fn
     return fn
@@ -309,8 +320,10 @@ def prewarm(buckets=(128,), background: bool = True):
     for b in sorted({min(b, MAX_BUCKET) for b in buckets}):
         try:
             fn = get_verify_fn(b)
-            s = _input_shape(b)
-            np.asarray(fn(np.zeros(s.shape, s.dtype)))
+            ks, ss = _input_shapes(b)
+            np.asarray(
+                fn(np.zeros(ks.shape, ks.dtype), np.zeros(ss.shape, ss.dtype))
+            )
         except Exception:  # noqa: BLE001 — prewarm must never kill a node
             pass
     return None

@@ -390,44 +390,45 @@ def verify_tile(ax, ay, at, s, h, yr, par):
     return (y_eq & par_ok).astype(jnp.int32)
 
 
-def _verify_tile_kernel(packed_ref, out_ref):
-    blk = packed_ref[:]  # (ROWS, SUB, LANE)
-    from tendermint_tpu.ops.ed25519_batch import (
-        ROW_AT, ROW_AX, ROW_AY, ROW_H, ROW_PARITY, ROW_S, ROW_YR,
-    )
-
-    def plane(row):
-        return blk[row:row + NWORDS]
+def _verify_tile_kernel(keys_ref, sigs_ref, out_ref):
+    keys = keys_ref[:]  # (KEY_ROWS, SUB, LANE)
+    sigs = sigs_ref[:]  # (SIG_ROWS, SUB, LANE)
 
     out_ref[:] = verify_tile(
-        plane(ROW_AX), plane(ROW_AY), plane(ROW_AT), plane(ROW_S),
-        plane(ROW_H), plane(ROW_YR), blk[ROW_PARITY],
+        keys[0:NWORDS], keys[NWORDS:2 * NWORDS], keys[2 * NWORDS:3 * NWORDS],
+        sigs[0:NWORDS], sigs[NWORDS:2 * NWORDS], sigs[2 * NWORDS:3 * NWORDS],
+        sigs[3 * NWORDS],
     )
 
 
 @partial(jax.jit, static_argnames=("interpret",))
-def pallas_verify_kernel(packed, interpret: bool = False):
-    """Drop-in for ed25519_batch.verify_kernel: same (49, B) packed wire
-    array in, (B,) bool out. B is padded on device to a TILE multiple;
+def pallas_verify_kernel(keys, sigs, interpret: bool = False):
+    """Drop-in for ed25519_batch.verify_kernel: keys (24, B) + sigs (25, B)
+    wire blocks in, (B,) bool out. B is padded on device to a TILE multiple;
     padded lanes compute garbage verdicts that are sliced off (the formulas
     are complete, so junk inputs cannot fault)."""
-    from tendermint_tpu.ops.ed25519_batch import ROWS
+    from tendermint_tpu.ops.ed25519_batch import KEY_ROWS, SIG_ROWS
 
-    b = packed.shape[1]
+    b = sigs.shape[1]
     padded = -(-b // TILE) * TILE
     pad = padded - b
     if pad:
-        packed = jnp.pad(packed, ((0, 0), (0, pad)))
-    # (ROWS, B) -> (ROWS, rows, 128): row-major, so lanes stay put
-    packed = packed.reshape(ROWS, padded // LANE, LANE)
+        keys = jnp.pad(keys, ((0, 0), (0, pad)))
+        sigs = jnp.pad(sigs, ((0, 0), (0, pad)))
+    # (R, B) -> (R, rows, 128): row-major, so lanes stay put
+    keys = keys.reshape(KEY_ROWS, padded // LANE, LANE)
+    sigs = sigs.reshape(SIG_ROWS, padded // LANE, LANE)
 
     grid = (padded // TILE,)
     out = pl.pallas_call(
         _verify_tile_kernel,
         grid=grid,
-        in_specs=[pl.BlockSpec((ROWS, SUB, LANE), lambda i: (0, i, 0))],
+        in_specs=[
+            pl.BlockSpec((KEY_ROWS, SUB, LANE), lambda i: (0, i, 0)),
+            pl.BlockSpec((SIG_ROWS, SUB, LANE), lambda i: (0, i, 0)),
+        ],
         out_specs=pl.BlockSpec((SUB, LANE), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((padded // LANE, LANE), jnp.int32),
         interpret=interpret,
-    )(packed)
+    )(keys, sigs)
     return out.reshape(-1)[:b] != 0

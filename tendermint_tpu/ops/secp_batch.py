@@ -55,13 +55,8 @@ class _PubkeyCache:
 _cache = _PubkeyCache()
 
 
-def _pad_to_bucket(n: int, min_bucket: int = 128) -> int:
-    b = min_bucket
-    while b < n and b < 4096:
-        b *= 2
-    if n <= b:
-        return b
-    return -(-n // 4096) * 4096
+# one bucketing policy for both curves (see ed25519_batch._pad_to_bucket)
+from tendermint_tpu.ops.ed25519_batch import _pad_to_bucket  # noqa: E402
 
 
 def prepare_batch(pubs, msgs, sigs, min_bucket: int = 128):

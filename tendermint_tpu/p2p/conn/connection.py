@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from tendermint_tpu.encoding import DecodeError, Reader, Writer
 from tendermint_tpu.libs.flowrate import Monitor

@@ -10,7 +10,7 @@ import asyncio
 import itertools
 import json
 
-from tendermint_tpu.rpc.jsonrpc import ConnContext, RPCError, _ws_frame, _ws_read_frame
+from tendermint_tpu.rpc.jsonrpc import RPCError, _ws_frame, _ws_read_frame
 
 
 class RPCResponseError(RPCError):

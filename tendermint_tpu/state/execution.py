@@ -17,9 +17,9 @@ from tendermint_tpu.libs import fail
 from tendermint_tpu.libs.log import NOP, Logger
 from tendermint_tpu.state import ABCIResponses, State, StateStore
 from tendermint_tpu.state.validation import validate_block
-from tendermint_tpu.types import Block, BlockID, ValidatorSet
+from tendermint_tpu.types import Block, BlockID
 from tendermint_tpu.types.event_bus import EventBus
-from tendermint_tpu.types.params import BlockParams, ConsensusParams
+from tendermint_tpu.types.params import ConsensusParams
 from tendermint_tpu.types.validator import Validator
 
 

@@ -6,7 +6,7 @@ into a KV store (kv/kv.go); `null` indexer is the no-op default.
 """
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from tendermint_tpu.abci.types import ResponseDeliverTx
 from tendermint_tpu.crypto import sum_sha256

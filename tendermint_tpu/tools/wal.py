@@ -16,10 +16,7 @@ import sys
 
 from tendermint_tpu.consensus.wal import (
     WAL,
-    EndHeightMessage,
-    MsgInfo,
     TimedWALMessage,
-    WALTimeoutInfo,
     _decode_wal_msg,
     _encode_wal_msg,
     encode_frame,

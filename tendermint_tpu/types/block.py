@@ -8,12 +8,12 @@ nullable), :710 (SignedHeader). CommitSig is represented by Vote directly
 """
 from __future__ import annotations
 
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, field
 
-from tendermint_tpu.crypto import merkle, sum_sha256
-from tendermint_tpu.encoding import DecodeError, Reader, Writer
+from tendermint_tpu.crypto import merkle
+from tendermint_tpu.encoding import Reader, Writer
 from tendermint_tpu.libs.bit_array import BitArray
-from tendermint_tpu.types.part_set import PartSet, PartSetHeader
+from tendermint_tpu.types.part_set import PartSet
 from tendermint_tpu.types.tx import Tx, txs_hash
 from tendermint_tpu.types.vote import BlockID, Vote, VoteType, canonical_vote_sign_bytes
 

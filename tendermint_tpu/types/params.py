@@ -3,7 +3,7 @@ evidence aging, allowed validator key types; hashed into Header.ConsensusHash
 and amendable by the application via EndBlock."""
 from __future__ import annotations
 
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass
 
 from tendermint_tpu.crypto import sum_sha256
 from tendermint_tpu.encoding import Reader, Writer

@@ -8,7 +8,7 @@ message exceeds the part size.
 """
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from tendermint_tpu.crypto import merkle
 from tendermint_tpu.encoding import Reader, Writer

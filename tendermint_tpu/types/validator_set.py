@@ -215,12 +215,18 @@ class ValidatorSet:
 
     # -- commit verification (batch-first hot paths) -------------------------
 
-    def verify_commit(
+    def _commit_precheck(
         self, chain_id: str, block_id: BlockID, height: int, commit: "Commit"
-    ) -> None:
-        """Reference validator_set.go:591-633 — hot loop #2. All precommit
-        signatures are verified in ONE device batch. Raises VerifyError."""
-        commit.validate_basic()
+    ) -> list:
+        """Structural checks + (pub_key, sign_bytes, sig, val, idx) items
+        for the signature batch. Raises VerifyError on structural failure —
+        including malformed peer-supplied commits (validate_basic raises
+        ValueError; fast sync feeds unvalidated peer blocks through here and
+        must get a per-commit verdict, never a task-killing exception)."""
+        try:
+            commit.validate_basic()
+        except ValueError as e:
+            raise VerifyError(f"invalid commit: {e}") from e
         if self.size() != len(commit.precommits):
             raise VerifyError(
                 f"invalid commit: {len(commit.precommits)} precommits for {self.size()} validators"
@@ -231,17 +237,28 @@ class ValidatorSet:
             raise VerifyError(
                 f"invalid commit: wrong block id {commit.block_id} != {block_id}"
             )
-        bv = BatchVerifier()
-        indexed = []
+        items = []
         for idx, precommit in enumerate(commit.precommits):
             if precommit is None:
                 continue
             _, val = self.get_by_index(idx)
-            bv.add(val.pub_key, commit.vote_sign_bytes(chain_id, idx), precommit.signature)
-            indexed.append((idx, precommit, val))
-        results = bv.verify_all()
+            items.append(
+                (
+                    val.pub_key,
+                    commit.vote_sign_bytes(chain_id, idx),
+                    precommit.signature,
+                    val,
+                    idx,
+                    precommit,
+                )
+            )
+        return items
+
+    def _commit_tally(self, block_id: BlockID, items, results) -> None:
+        """Consume per-signature verdicts: raise on any bad signature, then
+        enforce the > 2/3 voting-power quorum."""
         tallied = 0
-        for ok, (idx, precommit, val) in zip(results, indexed):
+        for ok, (_pk, _sb, _sig, val, idx, precommit) in zip(results, items):
             if not ok:
                 raise VerifyError(f"invalid commit: invalid signature at index {idx}")
             if block_id == precommit.block_id:
@@ -251,6 +268,17 @@ class ValidatorSet:
                 f"insufficient voting power: got {tallied}, "
                 f"needed > {self.total_voting_power() * 2 // 3}"
             )
+
+    def verify_commit(
+        self, chain_id: str, block_id: BlockID, height: int, commit: "Commit"
+    ) -> None:
+        """Reference validator_set.go:591-633 — hot loop #2. All precommit
+        signatures are verified in ONE device batch. Raises VerifyError."""
+        items = self._commit_precheck(chain_id, block_id, height, commit)
+        bv = BatchVerifier()
+        for pk, sb, sig, _val, _idx, _pc in items:
+            bv.add(pk, sb, sig)
+        self._commit_tally(block_id, items, bv.verify_all())
 
     def verify_future_commit(
         self,
@@ -332,3 +360,48 @@ class ValidatorSet:
 
 def new_validator_set(pubkeys_powers: list[tuple[PubKey, int]]) -> ValidatorSet:
     return ValidatorSet([Validator(pk, p) for pk, p in pubkeys_powers])
+
+
+def verify_commits(
+    entries: "list[tuple[ValidatorSet, str, BlockID, int, object]]",
+) -> "list[Exception | None]":
+    """Batch-verify MANY commits in one device launch.
+
+    entries: (valset, chain_id, block_id, height, commit) per commit.
+    Returns one entry per input: None on success, the VerifyError /
+    TooMuchChangeError otherwise — callers decide per-commit consequences
+    (fast-sync verify-ahead must not punish a peer for a commit that only
+    fails because an intervening block rotates the validator set).
+
+    This is the cross-height generalization of `verify_commit`: where the
+    reference verifies each height's commit serially as it applies blocks
+    (blockchain/v0/reactor.go:313 inside poolRoutine), a syncing node here
+    fuses a whole window of pending heights into one signature batch, so
+    the per-launch device dispatch cost amortizes over the window.
+    """
+    bv = BatchVerifier()
+    per_entry: list = []
+    errs: list[Exception | None] = [None] * len(entries)
+    for e_i, (vs, chain_id, block_id, height, commit) in enumerate(entries):
+        try:
+            items = vs._commit_precheck(chain_id, block_id, height, commit)
+        except VerifyError as ex:
+            errs[e_i] = ex
+            per_entry.append(None)
+            continue
+        for pk, sb, sig, _val, _idx, _pc in items:
+            bv.add(pk, sb, sig)
+        per_entry.append(items)
+    results = bv.verify_all()
+    pos = 0
+    for e_i, items in enumerate(per_entry):
+        if items is None:
+            continue
+        chunk = results[pos:pos + len(items)]
+        pos += len(items)
+        vs, _chain_id, block_id, _height, _commit = entries[e_i]
+        try:
+            vs._commit_tally(block_id, items, chunk)
+        except VerifyError as ex:
+            errs[e_i] = ex
+    return errs

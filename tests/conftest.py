@@ -49,13 +49,46 @@ def cpu_mesh_devices():
 
 def pytest_pyfunc_call(pyfuncitem):
     """Run `async def` tests under asyncio.run (pytest-asyncio isn't in the
-    image; this is the minimal equivalent)."""
+    image; this is the minimal equivalent), with a task-leak assertion —
+    the analog of the reference's goroutine leaktest tier (SURVEY §5 race
+    detection: leaktest assertions in p2p tests, go.mod:10). A test that
+    returns while tasks it spawned are still pending has leaked them:
+    services must be stopped and fire-and-forget tasks awaited. Tests that
+    legitimately hand cleanup to asyncio.run's cancellation sweep mark
+    themselves with @pytest.mark.allow_task_leak."""
     fn = pyfuncitem.obj
     if inspect.iscoroutinefunction(fn):
         kwargs = {
             name: pyfuncitem.funcargs[name]
             for name in pyfuncitem._fixtureinfo.argnames
         }
-        asyncio.run(fn(**kwargs))
+        allow_leak = pyfuncitem.get_closest_marker("allow_task_leak")
+
+        async def runner():
+            await fn(**kwargs)
+            if allow_leak is None:
+                cur = asyncio.current_task()
+                # one settle pass: tasks already cancelled/finishing get to
+                # run their CancelledError handlers before the check
+                await asyncio.sleep(0)
+                leaked = [
+                    t for t in asyncio.all_tasks()
+                    if t is not cur and not t.done()
+                ]
+                assert not leaked, (
+                    f"leaked asyncio tasks (stop your services or await "
+                    f"your tasks; mark allow_task_leak if intended): "
+                    f"{[t.get_name() for t in leaked]}"
+                )
+
+        asyncio.run(runner())
         return True
     return None
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "allow_task_leak: test intentionally leaves asyncio tasks pending "
+        "at return (cleaned up by asyncio.run cancellation)",
+    )

@@ -5,7 +5,7 @@ import asyncio
 import pytest
 
 from tendermint_tpu.abci import types as abci
-from tendermint_tpu.abci.client import LocalClient, SocketClient
+from tendermint_tpu.abci.client import SocketClient
 from tendermint_tpu.abci.examples import (
     CounterApplication,
     KVStoreApplication,

@@ -4,14 +4,12 @@ fast-syncs from it then switches to consensus."""
 import asyncio
 import os
 
-import pytest
 
 from tendermint_tpu import proxy
 from tendermint_tpu.blockchain import BlockPool
 from tendermint_tpu.blockchain.reactor import (
     BlockchainReactor,
     BlockRequestMessage,
-    BlockResponseMessage,
     NoBlockResponseMessage,
     StatusRequestMessage,
     StatusResponseMessage,

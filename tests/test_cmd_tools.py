@@ -5,7 +5,6 @@ import asyncio
 import json
 import os
 
-import pytest
 
 from tendermint_tpu.cmd.commands import main as cli_main
 from tendermint_tpu.config import Config, make_test_config

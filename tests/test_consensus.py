@@ -4,7 +4,6 @@ assertions over the EventBus, WAL crash recovery."""
 import asyncio
 import os
 
-import pytest
 
 from tendermint_tpu import proxy
 from tendermint_tpu.config import make_test_config
@@ -159,7 +158,7 @@ class TestSingleNodeConsensus:
             await f.stop()
             stopped_height = f.state_store.load().last_block_height
             # WAL contains height barriers
-            from tendermint_tpu.consensus.wal import WAL, EndHeightMessage
+            from tendermint_tpu.consensus.wal import WAL
 
             wal = WAL(os.path.join(str(tmp_path), "data", "cs.wal", "wal"))
             msgs_after = wal.search_for_end_height(stopped_height)
@@ -188,7 +187,7 @@ class TestMultiValidatorOffline:
     def test_four_validators_progress(self, tmp_path):
         async def main():
             from tendermint_tpu.consensus import messages as m
-            from tendermint_tpu.types import Vote, VoteType
+            from tendermint_tpu.types import Vote
             from tendermint_tpu.types.vote import now_ns
 
             pvs = sorted([MockPV() for _ in range(4)], key=lambda p: p.address)

@@ -1,7 +1,6 @@
 """Crypto-core tests (mirrors reference crypto/*/..._test.go)."""
 import os
 
-import pytest
 
 from tendermint_tpu import crypto
 from tendermint_tpu.crypto import batch, ed25519, ed25519_math, merkle, multisig, secp256k1

@@ -11,12 +11,7 @@ import pytest
 sys.path.insert(0, os.path.dirname(__file__))
 
 from tendermint_tpu.blockchain.v1 import BcFSM, Event, FSMError, State
-from tendermint_tpu.blockchain.v2 import (
-    BlockState,
-    PeerState,
-    Schedule,
-    ScheduleError,
-)
+from tendermint_tpu.blockchain.v2 import BlockState, Schedule, ScheduleError
 
 
 class FakeBlock:

@@ -1,7 +1,6 @@
 """Kernel start-time cache (ops/kcache): export-blob roundtrip, bucket
 capping/chunking, and cache-dir wiring. Runs on the virtual CPU mesh."""
 import os
-import threading
 
 import numpy as np
 import pytest
@@ -45,7 +44,7 @@ class TestKCache:
         kcache._exports_scheduled.clear()
         fn = kcache.get_verify_fn(128)
         packed, mask = eb.prepare_batch(pubs, msgs, sigs)
-        ok = np.asarray(fn(packed))[:8]
+        ok = np.asarray(fn(*eb.split(packed)))[:8]
         assert ok.all() and mask.all()
 
     def test_corrupt_blob_falls_back(self, tmp_cache_dir):

@@ -1,6 +1,5 @@
 """libs substrate tests (mirrors reference libs/*/..._test.go)."""
 import asyncio
-import os
 
 import pytest
 

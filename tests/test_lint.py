@@ -1,0 +1,121 @@
+"""Self-contained static checks — the lint/type-gate tier.
+
+The reference wires `-race`, coverage, and linters into CI (SURVEY §5:
+test/test_cover.sh, Makefile test_race); this image ships no Python
+linters, so the equivalent gate is implemented here with ast/compileall:
+
+- every module byte-compiles (catches syntax errors in rarely-imported
+  corners),
+- every module under tendermint_tpu imports cleanly on the CPU backend
+  (catches import-time regressions in modules no other test pulls in),
+- no unused imports (the most common Python dead-code rot; `# noqa`
+  or an `__init__.py` re-export opts out),
+- no bare `except:` (swallows KeyboardInterrupt/SystemExit; every handler
+  names what it catches — asyncio.CancelledError discipline),
+- no mutable default arguments.
+"""
+from __future__ import annotations
+
+import ast
+import compileall
+import importlib
+import pkgutil
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+PKG = REPO / "tendermint_tpu"
+SCAN_DIRS = [PKG, REPO / "tests", REPO / "benchmarks"]
+SCAN_FILES = [REPO / "bench.py", REPO / "__graft_entry__.py"]
+
+
+def _py_files():
+    for d in SCAN_DIRS:
+        yield from sorted(d.rglob("*.py"))
+    yield from SCAN_FILES
+
+
+def test_byte_compile_all():
+    for d in SCAN_DIRS:
+        assert compileall.compile_dir(
+            str(d), quiet=2, force=False
+        ), f"syntax error under {d}"
+    for f in SCAN_FILES:
+        assert compileall.compile_file(str(f), quiet=2), f
+
+
+def test_import_every_module():
+    import tendermint_tpu
+
+    failures = []
+    for mod in pkgutil.walk_packages(
+        tendermint_tpu.__path__, prefix="tendermint_tpu."
+    ):
+        try:
+            importlib.import_module(mod.name)
+        except Exception as e:  # noqa: BLE001 — collecting all failures
+            failures.append((mod.name, repr(e)))
+    assert not failures, failures
+
+
+class _ImportUse(ast.NodeVisitor):
+    def __init__(self) -> None:
+        self.imported: dict[str, int] = {}  # bound name -> lineno
+        self.used: set[str] = set()
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            name = a.asname or a.name.split(".")[0]
+            self.imported[name] = node.lineno
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        for a in node.names:
+            if a.name == "*":
+                continue
+            self.imported[a.asname or a.name] = node.lineno
+
+    def visit_Name(self, node: ast.Name) -> None:
+        self.used.add(node.id)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        self.generic_visit(node)
+
+    def visit_Constant(self, node: ast.Constant) -> None:
+        # "name" strings in __all__ / getattr count as uses
+        if isinstance(node.value, str) and node.value.isidentifier():
+            self.used.add(node.value)
+
+
+def test_no_unused_imports():
+    offenders = []
+    for f in _py_files():
+        if f.name == "__init__.py":
+            continue  # re-export surface
+        src = f.read_text(encoding="utf-8")
+        lines = src.splitlines()
+        tree = ast.parse(src)
+        v = _ImportUse()
+        v.visit(tree)
+        for name, lineno in v.imported.items():
+            if name in v.used or name == "annotations":
+                continue
+            if "noqa" in lines[lineno - 1]:
+                continue
+            offenders.append(f"{f.relative_to(REPO)}:{lineno}: {name}")
+    assert not offenders, "unused imports:\n" + "\n".join(offenders)
+
+
+def test_no_bare_except_and_no_mutable_defaults():
+    bare, mutable = [], []
+    for f in _py_files():
+        tree = ast.parse(f.read_text(encoding="utf-8"))
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ExceptHandler) and node.type is None:
+                bare.append(f"{f.relative_to(REPO)}:{node.lineno}")
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for d in node.args.defaults + node.args.kw_defaults:
+                    if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                        mutable.append(
+                            f"{f.relative_to(REPO)}:{node.lineno}: {node.name}"
+                        )
+    assert not bare, "bare except:\n" + "\n".join(bare)
+    assert not mutable, "mutable default args:\n" + "\n".join(mutable)

@@ -12,7 +12,6 @@ from tendermint_tpu.lite import (
     LiteError,
     MissingHeaderError,
     MultiProvider,
-    UpdatingProvider,
 )
 from tendermint_tpu.types import BlockID, MockPV, PartSetHeader
 from tendermint_tpu.types.block import Commit, Header, SignedHeader

@@ -2,7 +2,6 @@
 endpoint (reference node.go:946 + consensus/metrics.go)."""
 import asyncio
 
-import pytest
 
 from tendermint_tpu.libs.metrics import Collector, MetricsServer
 

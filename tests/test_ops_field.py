@@ -14,7 +14,6 @@ jax = pytest.importorskip("jax")
 from tendermint_tpu.crypto import ed25519_math as em
 from tendermint_tpu.ops import curve, field
 from tendermint_tpu.ops.limbs import (
-    LIMB_BITS,
     NLIMB,
     ints_to_limbs,
     limbs_to_ints,

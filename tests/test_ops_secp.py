@@ -5,7 +5,6 @@ primes including adversarial loose inputs; the complete projective point
 ops against the pure-Python oracle (crypto/secp256k1_math.py, itself
 cross-checked against OpenSSL in test_crypto-style tests below); host batch
 prep structural checks; and the full tile (slow compile — gated)."""
-import os
 
 import numpy as np
 import pytest

@@ -218,7 +218,7 @@ class TestPallasKernelMath:
         pubs, msgs, sigs = pubs * 2, msgs * 2, sigs * 2
         sigs[1] = bytes([sigs[1][0] ^ 1]) + sigs[1][1:]
         packed, mask = ed25519_batch.prepare_batch(pubs, msgs, sigs)
-        ref = np.asarray(ed25519_batch.verify_kernel(packed))
+        ref = np.asarray(ed25519_batch.verify_kernel(*ed25519_batch.split(packed)))
         ax, ay, at, s_w, h_w, yr, par = ed25519_batch.unpack(packed)
         out = np.asarray(
             jax.jit(pv.verify_tile)(ax, ay, at, s_w, h_w, yr, par)

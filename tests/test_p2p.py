@@ -13,7 +13,6 @@ import pytest
 from tendermint_tpu.crypto import ed25519
 from tendermint_tpu.p2p.base_reactor import BaseReactor, ChannelDescriptor
 from tendermint_tpu.p2p.conn.secret_connection import SecretConnection
-from tendermint_tpu.p2p.key import NodeKey
 from tendermint_tpu.p2p.netaddress import AddressError, NetAddress
 from tendermint_tpu.p2p.node_info import NodeInfo, NodeInfoError
 from tendermint_tpu.p2p.test_util import (

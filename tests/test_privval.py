@@ -6,12 +6,7 @@ from dataclasses import replace
 
 import pytest
 
-from tendermint_tpu.privval import (
-    STEP_PRECOMMIT,
-    STEP_PREVOTE,
-    DoubleSignError,
-    FilePV,
-)
+from tendermint_tpu.privval import STEP_PREVOTE, DoubleSignError, FilePV
 from tendermint_tpu.privval.remote import (
     SignerClient,
     SignerListenerEndpoint,

@@ -5,7 +5,6 @@ make_connected_switches, asserting liveness and tx/evidence propagation."""
 import asyncio
 import os
 
-import pytest
 
 from tendermint_tpu import proxy
 from tendermint_tpu.abci import types as abci

@@ -6,19 +6,13 @@ import pytest
 from tendermint_tpu import proxy
 from tendermint_tpu.abci import types as abci
 from tendermint_tpu.abci.examples import KVStoreApplication
-from tendermint_tpu.libs.db import MemDB, SQLiteDB
+from tendermint_tpu.libs.db import MemDB
 from tendermint_tpu.mempool import CListMempool, TxInCacheError
 from tendermint_tpu.state import StateStore, state_from_genesis
 from tendermint_tpu.state.execution import BlockExecutor
 from tendermint_tpu.state.validation import ValidationError, validate_block
 from tendermint_tpu.store import BlockStore
-from tendermint_tpu.types import (
-    BlockID,
-    GenesisDoc,
-    MockPV,
-    VoteSet,
-    VoteType,
-)
+from tendermint_tpu.types import GenesisDoc, MockPV, VoteSet, VoteType
 from tendermint_tpu.types.genesis import GenesisValidator
 from tendermint_tpu.types.vote import Vote
 
@@ -119,7 +113,6 @@ class TestBlockExecutor:
             state, state_store, block_store, pvs, _ = await make_chain(2)
             good = block_store.load_block(2)
             # wrong height
-            import dataclasses
 
             state2 = state  # state is after block 2 -> expects height 3
             bad = block_store.load_block(1)

@@ -1,17 +1,14 @@
 """Domain-model tests (mirrors reference types/*_test.go)."""
 import pytest
 
-from tendermint_tpu.crypto import ed25519
 from tendermint_tpu.types import (
     Block,
     BlockID,
     Commit,
-    Data,
     Header,
     MockPV,
     PartSet,
     PartSetHeader,
-    Proposal,
     ValidatorSet,
     Vote,
     VoteSet,
@@ -429,3 +426,77 @@ class TestPeerMaj23Convergence:
         assert s.maj23 == bid_a
         maj, ok = s.two_thirds_majority()
         assert ok and maj == bid_a
+
+
+class TestVerifyCommitsBatch:
+    """Cross-height multi-commit batching (fast-sync verify-ahead):
+    tendermint_tpu.types.validator_set.verify_commits fuses the reference's
+    per-height serial VerifyCommit (blockchain/v0/reactor.go:313) into one
+    device batch and reports per-commit verdicts."""
+
+    def test_mixed_verdicts_match_per_commit_verify(self):
+        from tendermint_tpu.types.validator_set import verify_commits
+
+        vs, pvs = make_valset(4)
+        entries, expect_ok = [], []
+        for h in range(1, 6):
+            bid = rand_block_id(b"h%d" % h)
+            commit = build_commit(vs, pvs, h, 0, bid)
+            if h == 2:  # corrupt one signature
+                import dataclasses
+
+                idx = next(
+                    i for i, p in enumerate(commit.precommits) if p is not None
+                )
+                commit.precommits[idx] = dataclasses.replace(
+                    commit.precommits[idx], signature=b"\x13" * 64
+                )
+            if h == 4:  # strip to below quorum
+                commit.precommits[0] = None
+                commit.precommits[1] = None
+            entries.append((vs, CHAIN_ID, bid, h, commit))
+            expect_ok.append(h not in (2, 4))
+        errs = verify_commits(entries)
+        assert [e is None for e in errs] == expect_ok
+        assert isinstance(errs[1], VerifyError)
+        assert isinstance(errs[3], TooMuchChangeError)
+        # verdicts agree with the single-commit path
+        for (vsx, cid, bid, h, commit), ok in zip(entries, expect_ok):
+            if ok:
+                vsx.verify_commit(cid, bid, h, commit)
+            else:
+                with pytest.raises(VerifyError):
+                    vsx.verify_commit(cid, bid, h, commit)
+
+    def test_structural_failure_isolated(self):
+        from tendermint_tpu.types.validator_set import verify_commits
+
+        vs, pvs = make_valset(4)
+        bid1, bid2 = rand_block_id(b"a"), rand_block_id(b"b")
+        good = build_commit(vs, pvs, 1, 0, bid1)
+        wrong_height = build_commit(vs, pvs, 2, 0, bid2)
+        errs = verify_commits(
+            [
+                (vs, CHAIN_ID, bid1, 1, good),
+                (vs, CHAIN_ID, bid2, 9, wrong_height),  # height mismatch
+            ]
+        )
+        assert errs[0] is None and isinstance(errs[1], VerifyError)
+
+    def test_mixed_validator_sets(self):
+        from tendermint_tpu.types.validator_set import verify_commits
+
+        vs_a, pvs_a = make_valset(4)
+        vs_b, pvs_b = make_valset(6)
+        vs_c, pvs_c = make_valset(4)  # same size as vs_a, different keys
+        bid_a, bid_b = rand_block_id(b"a"), rand_block_id(b"b")
+        errs = verify_commits(
+            [
+                (vs_a, CHAIN_ID, bid_a, 1, build_commit(vs_a, pvs_a, 1, 0, bid_a)),
+                (vs_b, CHAIN_ID, bid_b, 7, build_commit(vs_b, pvs_b, 7, 0, bid_b)),
+                # commit signed by the WRONG (same-size) valset's keys
+                (vs_a, CHAIN_ID, bid_b, 2, build_commit(vs_c, pvs_c, 2, 0, bid_b)),
+            ]
+        )
+        assert errs[0] is None and errs[1] is None
+        assert isinstance(errs[2], VerifyError)
