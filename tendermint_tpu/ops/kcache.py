@@ -12,9 +12,11 @@ cache is operationally disqualifying. Two layers fix it:
    platform + batch bucket, so stale blobs die with any kernel edit.
 
 Measured second-process start-to-first-verify: 37.7s (no caches) -> 7.7s
-(both layers warm). Blobs are written by a background thread after the
-first in-process compile so the foreground path never pays the ~12s
-re-trace that `jax.export` needs.
+(both layers warm) on CPU; on the tunneled TPU v5e, 95-120s (cold compile)
+-> 2.2s with both layers warm (blob hit for the 12288 bucket). Blobs are
+written by a background subprocess after the first in-process compile so
+the foreground path never pays the ~50s re-trace+re-compile that
+`jax.export` needs.
 
 The bucket set is capped (`MAX_BUCKET`) — larger batches are verified in
 chunks — so the number of compiled variants is bounded (25 buckets: powers
