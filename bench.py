@@ -52,14 +52,12 @@ def main() -> None:
     kcache.enable_persistent_cache()
     dev = jax.devices()[0]
     log(f"device: {dev.platform} ({dev.device_kind})")
-    # Pre-claim the export-blob slots for every bucket this run touches so
-    # no background warm-up subprocess spawns mid-measurement: on a
-    # tunneled device a second process's compile CONTENDS with the
-    # foreground RPC stream (measured: a 20 s stall on the first verify).
-    # A node wants that background warm-up (it saves the NEXT process
-    # minutes of compile); a benchmark wants clean steady-state numbers.
-    for b in (128, 1024, 12288, 16384, 65536, 81920, kcache.MAX_BUCKET):
-        kcache._exports_scheduled.add((dev.platform, b))
+    # No background warm-up subprocess mid-measurement: on a tunneled
+    # device a second process's compile CONTENDS with the foreground RPC
+    # stream (measured: a 20 s stall on the first verify). A node wants
+    # that background warm-up (it saves the NEXT process minutes of
+    # compile); a benchmark wants clean steady-state numbers.
+    kcache.suppress_background_warm()
 
     # N_UNIQUE real keypairs tiled to N_COMMIT (device work per lane is
     # data-independent); K distinct per-commit messages, all pre-signed.

@@ -146,14 +146,40 @@ def config4_lite_chain(n_headers=100, n_vals=500):
         voteset.add_votes(votes)
         commits.append((bid, voteset.make_commit()))
 
+    n_sigs = n_headers * n_vals
     t0 = time.perf_counter()
     for height, (bid, commit) in enumerate(commits, start=1):
         vs.verify_commit(chain_id, bid, height, commit)
     dt = time.perf_counter() - t0
-    n_sigs = n_headers * n_vals
-    log(f"[4] lite chain {n_headers} x {n_vals}: {dt:8.2f} s "
+    log(f"[4] lite chain {n_headers} x {n_vals}, per-header: {dt:8.2f} s "
         f"({n_sigs:,} sigs, {n_sigs / dt:,.0f}/s)")
-    return n_sigs / dt
+
+    # the fused span path (DynamicVerifier.verify_chain): every header's
+    # commit in ONE cross-height batch (tendermint_tpu beats the
+    # reference's per-height loop, lite/dynamic_verifier.go:73)
+    from tendermint_tpu.ops import kcache
+    from tendermint_tpu.ops.ed25519_batch import _pad_to_bucket
+    from tendermint_tpu.types.validator_set import verify_commits
+
+    # compile every chunk bucket outside the timed region (nodes prewarm
+    # the same way) — with --full the 1M-sig span chunks at MAX_BUCKET
+    # plus a remainder bucket
+    buckets = set()
+    for lo in range(0, n_sigs, kcache.MAX_BUCKET):
+        buckets.add(_pad_to_bucket(min(kcache.MAX_BUCKET, n_sigs - lo)))
+    kcache.prewarm(sorted(buckets), background=False)
+    t0 = time.perf_counter()
+    errs = verify_commits(
+        [
+            (vs, chain_id, bid, height, commit)
+            for height, (bid, commit) in enumerate(commits, start=1)
+        ]
+    )
+    dt_fused = time.perf_counter() - t0
+    assert not any(errs)
+    log(f"[4] lite chain {n_headers} x {n_vals}, fused span: {dt_fused:8.2f} s "
+        f"({n_sigs / dt_fused:,.0f}/s)")
+    return n_sigs / dt_fused
 
 
 def config5_mixed_streaming(n_vals=10_000, burst=256):
@@ -242,6 +268,17 @@ def main(argv):
     picks = [a for a in argv if a.isdigit()] or ["1", "2", "3", "4", "5"]
     import jax
 
+    # register the batch backends exactly as a node does (node/__init__):
+    # without this every config silently measures the serial fallback
+    import tendermint_tpu.ops  # noqa: F401 — registers device backends
+    from tendermint_tpu.crypto import native
+    from tendermint_tpu.ops import kcache
+
+    native.register()
+    kcache.enable_persistent_cache()
+    # measurements, not warm-up: no background warm child contending with
+    # the tunnel (see bench.py)
+    kcache.suppress_background_warm()
     log(f"platform: {jax.default_backend()}")
     if "1" in picks:
         config1_serial_loop()

@@ -201,14 +201,20 @@ def ed25519_prepare_device_inputs(pubs, msgs, sigs, padded: int):
 
 
 def register(force: bool = False) -> bool:
-    """Register native backends with crypto.batch. secp256k1 always (the
-    only native impl, like the reference's cgo build); ed25519 only when no
-    TPU backend claimed the slot first (unless force)."""
+    """Register native backends with crypto.batch — for BOTH curves only
+    when no richer backend claimed the slot first (unless force). The ops
+    backends already route small batches through a probed native-vs-serial
+    choice and large ones to the device; overriding them with the raw
+    native call would pin every batch to the portable C++ core, which on a
+    single-vCPU host is ~2x slower than the serial OpenSSL path."""
     if load() is None:
         return False
     from tendermint_tpu.crypto import batch
 
-    batch.register_backend("secp256k1", secp256k1_verify_batch)
-    if force or batch.get_backend("ed25519") is None:
-        batch.register_backend("ed25519", ed25519_verify_batch)
+    for key_type, fn in (
+        ("secp256k1", secp256k1_verify_batch),
+        ("ed25519", ed25519_verify_batch),
+    ):
+        if force or batch.get_backend(key_type) is None:
+            batch.register_backend(key_type, fn)
     return True

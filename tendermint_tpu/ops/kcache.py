@@ -69,6 +69,17 @@ _lock = threading.Lock()
 _fns: dict[tuple[str, int], object] = {}  # (platform, bucket) -> callable
 _exports_scheduled: set[tuple[str, int]] = set()
 _enabled = False
+_warm_suppressed = False
+
+
+def suppress_background_warm() -> None:
+    """Disable background warm-child spawns for this process. Benchmarks
+    call this: a warm child's compile CONTENDS with the foreground tunnel
+    stream (measured ~20 s stall on first verify), which a node accepts
+    once to save the next process minutes of compile but a measurement
+    process must not."""
+    global _warm_suppressed
+    _warm_suppressed = True
 
 # Background compiles run in DAEMON SUBPROCESSES, never threads in this
 # process: a daemon thread mid-XLA-compile SIGABRTs interpreter teardown
@@ -117,7 +128,11 @@ def _spawn_warm_process(buckets):
     cache, which carries the dominant (compile) cost."""
     import multiprocessing as mp
 
-    if os.environ.get("TMTPU_NO_PREWARM") or os.environ.get("TMTPU_WARM_CHILD"):
+    if (
+        _warm_suppressed
+        or os.environ.get("TMTPU_NO_PREWARM")
+        or os.environ.get("TMTPU_WARM_CHILD")
+    ):
         return None
     try:
         ctx = mp.get_context("spawn")

@@ -182,3 +182,102 @@ class TestDynamicVerifier:
 
         with pytest.raises(VerifyError):
             dv.verify(SignedHeader(fc.signed_header.header, stripped))
+
+
+class TestVerifyChain:
+    """Batched consecutive-span verification (DynamicVerifier.verify_chain):
+    hot loop #4 fused across heights, same trust semantics as per-header
+    verify (lite/dynamic_verifier.go:73)."""
+
+    def _setup(self, churn: int, max_height: int):
+        chain = ChainBuilder(n_vals=4, churn=churn)
+        chain.build(max_height)
+        trusted = DBProvider("trusted", MemDB())
+        trusted.save_full_commit(chain.heights[1])
+        dv = DynamicVerifier(CHAIN_ID, trusted, chain)
+        return chain, trusted, dv
+
+    def test_span_verifies_and_trusts(self):
+        chain, trusted, dv = self._setup(churn=0, max_height=30)
+        span = [chain.heights[h].signed_header for h in range(2, 31)]
+        dv.verify_chain(span)
+        assert dv.headers_verified == 29
+        assert trusted.latest_full_commit(CHAIN_ID, 1, 1 << 62).height == 30
+        # everything re-verifiable per header from the trusted store
+        dv2 = DynamicVerifier(CHAIN_ID, trusted, chain)
+        dv2.verify(chain.heights[30].signed_header)
+
+    def test_span_with_churn_falls_back(self):
+        # churn rotates one validator per height: adjacent steps still match
+        # next_validators exactly, so the batch path handles them; verify
+        # the result matches the sequential path's trust state
+        chain, trusted, dv = self._setup(churn=1, max_height=12)
+        span = [chain.heights[h].signed_header for h in range(2, 13)]
+        dv.verify_chain(span)
+        assert trusted.latest_full_commit(CHAIN_ID, 1, 1 << 62).height == 12
+
+    def test_bad_link_stops_trust_at_prefix(self):
+        chain, trusted, dv = self._setup(churn=0, max_height=10)
+        span = [chain.heights[h].signed_header for h in range(2, 11)]
+        # corrupt height 6's commit (below quorum)
+        sh6 = span[4]
+        stripped = Commit(
+            sh6.commit.block_id,
+            [p if i < 2 else None for i, p in enumerate(sh6.commit.precommits)],
+        )
+        from tendermint_tpu.types.validator_set import VerifyError
+
+        span[4] = SignedHeader(sh6.header, stripped)
+        with pytest.raises(VerifyError):
+            dv.verify_chain(span)
+        # trust advanced exactly to the last good predecessor (height 5)
+        assert trusted.latest_full_commit(CHAIN_ID, 1, 1 << 62).height == 5
+
+    def test_non_consecutive_rejected(self):
+        chain, _, dv = self._setup(churn=0, max_height=8)
+        with pytest.raises(LiteError):
+            dv.verify_chain(
+                [chain.heights[2].signed_header, chain.heights[4].signed_header]
+            )
+
+    def test_rotation_fallback_path(self):
+        """A mid-span header whose validators_hash breaks the adjacent
+        link leaves the batch path; the remainder goes through per-header
+        verify, which rejects it — trust keeps the verified prefix."""
+        chain, trusted, dv = self._setup(churn=0, max_height=10)
+        span = [chain.heights[h].signed_header for h in range(2, 11)]
+        good6 = span[4]
+        bad_header = Header(
+            chain_id=CHAIN_ID,
+            height=good6.header.height,
+            time=good6.header.time,
+            validators_hash=b"\x42" * 32,  # breaks the adjacent-link rule
+            next_validators_hash=good6.header.next_validators_hash,
+            app_hash=good6.header.app_hash,
+            proposer_address=good6.header.proposer_address,
+        )
+        # properly signed over the tampered header so validate_basic
+        # passes and the rotation branch (not the structural check) fires
+        bid = BlockID(bad_header.hash(), PartSetHeader(1, b"\x77" * 32))
+        pvs, _ = chain._vals_at(6)
+        precommits = []
+        for i, pv in enumerate(pvs):
+            v = Vote(
+                VoteType.PRECOMMIT, 6, 0, bid, bad_header.time + 1,
+                pv.get_pub_key().address(), i,
+            )
+            precommits.append(pv.sign_vote(CHAIN_ID, v))
+        span[4] = SignedHeader(bad_header, Commit(bid, precommits))
+        with pytest.raises((LiteError, ValueError)):
+            dv.verify_chain(span)
+        assert trusted.latest_full_commit(CHAIN_ID, 1, 1 << 62).height == 5
+
+    def test_source_failure_keeps_prefix(self):
+        """Source missing a mid-span FullCommit: the verified prefix is
+        still committed before the error surfaces."""
+        chain, trusted, dv = self._setup(churn=0, max_height=10)
+        span = [chain.heights[h].signed_header for h in range(2, 11)]
+        del chain.heights[7]  # source no longer serves height 7
+        with pytest.raises(MissingHeaderError):
+            dv.verify_chain(span)
+        assert trusted.latest_full_commit(CHAIN_ID, 1, 1 << 62).height == 6
