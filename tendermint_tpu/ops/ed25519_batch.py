@@ -393,7 +393,7 @@ class _DeviceKeyCache:
         self._d: dict[tuple[bytes, int], object] = {}
         self._maxsize = maxsize
 
-    def get(self, chunk_pubs, keys_np):
+    def get(self, chunk_pubs, keys_np, sharding=None):
         import hashlib as _hl
 
         import jax
@@ -404,7 +404,8 @@ class _DeviceKeyCache:
         key = (h.digest(), keys_np.shape[1])
         dev = self._d.pop(key, None)
         if dev is None:
-            dev = jax.device_put(keys_np)
+            # device_put treats sharding=None as default placement
+            dev = jax.device_put(keys_np, sharding)
         self._d[key] = dev  # re-insert: LRU order
         while len(self._d) > self._maxsize:
             self._d.pop(next(iter(self._d)))
@@ -412,6 +413,43 @@ class _DeviceKeyCache:
 
 
 _dev_keys = _DeviceKeyCache()
+
+# Multi-device dispatch: when more than one device is visible (a real TPU
+# slice, or the test suite's 8-virtual-CPU mesh) every chunk is
+# batch-sharded across the mesh via shard_map instead of running on one
+# chip (jit respecializes the one memoized callable per bucket shape).
+# The single-device path keeps kcache's export-blob fast start (exports
+# don't carry shardings).
+_sharded = None  # (fn, NamedSharding) | None, built once
+
+
+def _multi_device_fn():
+    import jax
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        return None, None
+    global _sharded
+    if _sharded is None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tendermint_tpu.ops import kcache
+        from tendermint_tpu.parallel import sharded as shard_mod
+
+        # the sharded program has no export-blob layer; the persistent XLA
+        # cache is what saves the next process the cold compile
+        kcache.enable_persistent_cache()
+        # largest power-of-two device prefix (capped at the minimum bucket,
+        # 128): every bucket is a power of two or a multiple of 4096, so a
+        # power-of-two mesh always divides the batch — a 6-device host
+        # meshes 4, not a shard_map shape error
+        p = 1 << (len(devices).bit_length() - 1)
+        mesh = shard_mod.make_batch_mesh(devices[: min(p, 128)])
+        _sharded = (
+            shard_mod.build_stream_verifier(mesh),
+            NamedSharding(mesh, P(None, shard_mod.AXIS)),
+        )
+    return _sharded
 
 
 def verify_batch(pubs, msgs, sigs) -> list[bool]:
@@ -437,16 +475,34 @@ def verify_batch(pubs, msgs, sigs) -> list[bool]:
         if packed is None:
             continue
         keys_np, sigs_np = split(packed)
-        keys_dev = _dev_keys.get(pubs[lo:hi], keys_np)
-        fn = kcache.get_verify_fn(packed.shape[1])
-        try:
-            dev_out = fn(keys_dev, sigs_np)
-        except Exception:  # noqa: BLE001 — e.g. a Mosaic lowering regression
-            # on a new backend: the preferred (pallas) kernel failing must
-            # degrade to the XLA kernel, never break verification
-            if kcache._kernel_for(kcache._platform())[0] == "xla":
-                raise  # the failing kernel IS the XLA kernel: nothing to try
-            dev_out = verify_kernel(keys_np, sigs_np)
+        mfn, sharding = _multi_device_fn()
+        dev_out = None
+        if mfn is not None:
+            import jax
+
+            keys_dev = _dev_keys.get(pubs[lo:hi], keys_np, sharding)
+            try:
+                dev_out = mfn(keys_dev, jax.device_put(sigs_np, sharding))
+            except Exception:  # noqa: BLE001 — a sharding/mesh failure is
+                # not a kernel failure: degrade to the single-device path
+                dev_out = None
+        if dev_out is None:
+            try:
+                fn = kcache.get_verify_fn(packed.shape[1])
+                # after a failed sharded attempt the cache holds a
+                # mesh-placed key block: feed host arrays, don't reuse it
+                keys_arg = (
+                    keys_np if mfn is not None
+                    else _dev_keys.get(pubs[lo:hi], keys_np)
+                )
+                dev_out = fn(keys_arg, sigs_np)
+            except Exception:  # noqa: BLE001 — e.g. a Mosaic lowering
+                # regression on a new backend: the preferred (pallas)
+                # kernel failing must degrade to the XLA kernel, never
+                # break verification
+                if kcache._kernel_for(kcache._platform())[0] == "xla":
+                    raise  # the failing kernel IS the XLA kernel
+                dev_out = verify_kernel(keys_np, sigs_np)
         pending.append((lo, hi, dev_out, (keys_np, sigs_np), mask))
     for lo, hi, dev_out, blocks, mask in pending:
         try:

@@ -334,13 +334,32 @@ def prewarm(buckets=(128,), background: bool = True):
         return None
     if background:
         return _spawn_warm_process(buckets)
+    # warm the path verify_batch will actually take: the shard_map'd
+    # program on a multi-device host (no export-blob layer there — the
+    # persistent XLA cache carries it), the kcache per-bucket kernel
+    # otherwise
+    try:
+        from tendermint_tpu.ops import ed25519_batch
+
+        mfn, sharding = ed25519_batch._multi_device_fn()
+    except Exception:  # noqa: BLE001 — prewarm must never kill a node
+        mfn, sharding = None, None
     for b in sorted({min(b, MAX_BUCKET) for b in buckets}):
         try:
-            fn = get_verify_fn(b)
             ks, ss = _input_shapes(b)
-            np.asarray(
-                fn(np.zeros(ks.shape, ks.dtype), np.zeros(ss.shape, ss.dtype))
-            )
+            zk = np.zeros(ks.shape, ks.dtype)
+            zs = np.zeros(ss.shape, ss.dtype)
+            if mfn is not None:
+                import jax
+
+                np.asarray(
+                    mfn(
+                        jax.device_put(zk, sharding),
+                        jax.device_put(zs, sharding),
+                    )
+                )
+            else:
+                np.asarray(get_verify_fn(b)(zk, zs))
         except Exception:  # noqa: BLE001 — prewarm must never kill a node
             pass
     return None

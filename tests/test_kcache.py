@@ -57,7 +57,13 @@ class TestKCache:
         # "blob removed" assertion below
         kcache._exports_scheduled.add((platform, 128))
         pubs, msgs, sigs = make_sig_batch(8, msg_prefix=b"kcache3 ")
-        assert eb.verify_batch(pubs, msgs, sigs) == [True] * 8
+        # the blob layer serves the single-device path: exercise
+        # get_verify_fn directly (on the multi-device suite verify_batch
+        # routes through the sharded mesh and never consults blobs)
+        packed, mask = eb.prepare_batch(pubs, msgs, sigs)
+        fn = kcache.get_verify_fn(packed.shape[1])
+        ok = np.asarray(fn(*eb.split(packed)))[:8]
+        assert ok.all() and mask.all()
         assert not os.path.exists(path)  # corrupt blob removed
 
     def test_version_hash_in_blob_name(self, tmp_cache_dir):
@@ -75,7 +81,14 @@ class TestKCache:
 
     def test_prewarm_foreground(self, tmp_cache_dir, monkeypatch):
         # conftest disables prewarm suite-wide (background compiles); this
-        # test exercises it explicitly
+        # test exercises it explicitly. On this multi-device suite prewarm
+        # warms the shard_map'd program (the path verify_batch takes);
+        # single-device hosts would populate kcache._fns instead.
         monkeypatch.delenv("TMTPU_NO_PREWARM", raising=False)
         assert kcache.prewarm(buckets=(128,), background=False) is None
-        assert (kcache._platform(), 128) in kcache._fns
+        import jax
+
+        if len(jax.devices()) > 1:
+            assert eb._sharded is not None
+        else:
+            assert (kcache._platform(), 128) in kcache._fns
