@@ -98,28 +98,36 @@ class RPCProvider(Provider):
     """Light-client source over a full node's RPC (reference
     lite/client/provider.go)."""
 
+    CACHE_LIMIT = 512  # FullCommits are header + two valsets: bound them
+
     def __init__(self, client: HTTPClient) -> None:
         self.client = client
         self._cache: dict[int, FullCommit] = {}
+
+    def _remember(self, height: int, fc: FullCommit) -> None:
+        self._cache[height] = fc
+        while len(self._cache) > self.CACHE_LIMIT:
+            self._cache.pop(next(iter(self._cache)))
+
+    async def valset_at(self, height: int) -> ValidatorSet:
+        return _valset_from_json(
+            (await self.client.call("validators", height=height, per_page=100))[
+                "validators"
+            ]
+        )
 
     async def full_commit_at(self, height: int) -> FullCommit:
         if height in self._cache:
             return self._cache[height]
         commit_resp = await self.client.call("commit", height=height)
-        vals_resp = await self.client.call("validators", height=height, per_page=100)
-        next_vals_resp = await self.client.call(
-            "validators", height=height + 1, per_page=100
-        )
         sh = SignedHeader(
             _header_from_json(commit_resp["signed_header"]["header"]),
             _commit_from_json(commit_resp["signed_header"]["commit"]),
         )
         fc = FullCommit(
-            sh,
-            _valset_from_json(vals_resp["validators"]),
-            _valset_from_json(next_vals_resp["validators"]),
+            sh, await self.valset_at(height), await self.valset_at(height + 1)
         )
-        self._cache[height] = fc
+        self._remember(height, fc)
         return fc
 
     # The sync Provider interface is bridged by AsyncSourceAdapter below.
@@ -135,9 +143,16 @@ class _PrefetchSource(Provider):
     requests from a commit cache, and records the height of any miss so the
     async caller can fetch it over RPC and retry."""
 
+    CACHE_LIMIT = 512  # bound bulk span prefetches (insertion-order evict)
+
     def __init__(self) -> None:
         self.commits: dict[int, FullCommit] = {}
         self.last_missing: int | None = None
+
+    def remember(self, height: int, fc: FullCommit) -> None:
+        self.commits[height] = fc
+        while len(self.commits) > self.CACHE_LIMIT:
+            self.commits.pop(next(iter(self.commits)))
 
     def latest_full_commit(self, chain_id: str, min_height: int, max_height: int) -> FullCommit:
         hs = [h for h in self.commits if min_height <= h <= max_height]
@@ -195,7 +210,61 @@ class LiteProxy:
         await self._verify_header(sh)
         return resp
 
+    async def verified_range(self, start: int, end: int) -> list[dict]:
+        """Fetch + verify the commits for consecutive heights [start, end]
+        with the whole span's signatures fused into one device batch
+        (DynamicVerifier.verify_chain — the catch-up shape: a client
+        auditing a chain segment pays one launch, not one per height).
+        Returns the raw RPC jsons after verification passes."""
+        if end < start:
+            raise ValueError(f"bad range [{start}, {end}]")
+        resps, shs = [], []
+        for h in range(start, end + 1):
+            resp = await self.client.call("commit", height=h)
+            shs.append(
+                SignedHeader(
+                    _header_from_json(resp["signed_header"]["header"]),
+                    _commit_from_json(resp["signed_header"]["commit"]),
+                )
+            )
+            resps.append(resp)
+        # The span verify consumes source FullCommits for every height in
+        # the range (valset links + trusted saves). Build them from the
+        # commit responses already fetched — each height then costs ONE
+        # extra validators call (the h+1 set of one height is the h set of
+        # the next), not a commit + two validators refetch.
+        vals: dict[int, ValidatorSet] = {}
+
+        async def valset(h: int) -> ValidatorSet:
+            if h not in vals:
+                vals[h] = await self.source.valset_at(h)
+            return vals[h]
+
+        for h in range(max(1, start - 1), end + 1):
+            if h in self._prefetch.commits:
+                continue
+            if start <= h <= end:
+                sh = shs[h - start]
+            else:  # start-1 anchor link: not in the fetched span
+                fc = await self.source.full_commit_at(h)
+                fc.validate_full(self.chain_id)
+                self._prefetch.remember(h, fc)
+                continue
+            fc = FullCommit(sh, await valset(h), await valset(h + 1))
+            fc.validate_full(self.chain_id)
+            self._prefetch.remember(h, fc)
+        await self._retry_missing(
+            lambda: self.verifier.verify_chain(shs),
+            f"range [{start}, {end}]",
+        )
+        return resps
+
     async def _verify_header(self, sh: SignedHeader) -> None:
+        await self._retry_missing(
+            lambda: self.verifier.verify(sh), f"height {sh.height}"
+        )
+
+    async def _retry_missing(self, attempt, what: str) -> None:
         # The sync verifier runs against a commit cache; on a cache miss it
         # records the height it needed, we fetch that over RPC and retry.
         # Each retry makes strict progress (one more height cached), and
@@ -203,7 +272,7 @@ class LiteProxy:
         for _ in range(256):
             self._prefetch.last_missing = None
             try:
-                self.verifier.verify(sh)
+                attempt()
                 return
             except MissingHeaderError:
                 missing = self._prefetch.last_missing
@@ -211,8 +280,8 @@ class LiteProxy:
                     raise
                 fc = await self.source.full_commit_at(missing)
                 fc.validate_full(self.chain_id)
-                self._prefetch.commits[missing] = fc
-        raise LiteError(f"bisection did not converge for height {sh.height}")
+                self._prefetch.remember(missing, fc)
+        raise LiteError(f"trust advance did not converge for {what}")
 
 
 async def run_lite_proxy(

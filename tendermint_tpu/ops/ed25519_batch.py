@@ -393,7 +393,13 @@ class _DeviceKeyCache:
         self._d: dict[tuple[bytes, int], object] = {}
         self._maxsize = maxsize
 
-    def get(self, chunk_pubs, keys_np, sharding=None):
+    def get(self, chunk_pubs, keys_np, sharding=None, cacheable=True):
+        """cacheable must be False unless every lane passed its structural
+        checks: prep zeroes the key planes of lanes whose SIGNATURE failed
+        (not just bad pubkeys), so a partially-invalid batch's key block is
+        not a pure function of the pubkey list and caching it would poison
+        later batches that share the pubs with then-valid signatures.
+        Lookup is always safe — cached blocks were built all-valid."""
         import hashlib as _hl
 
         import jax
@@ -406,6 +412,8 @@ class _DeviceKeyCache:
         if dev is None:
             # device_put treats sharding=None as default placement
             dev = jax.device_put(keys_np, sharding)
+            if not cacheable:
+                return dev
         self._d[key] = dev  # re-insert: LRU order
         while len(self._d) > self._maxsize:
             self._d.pop(next(iter(self._d)))
@@ -480,7 +488,9 @@ def verify_batch(pubs, msgs, sigs) -> list[bool]:
         if mfn is not None:
             import jax
 
-            keys_dev = _dev_keys.get(pubs[lo:hi], keys_np, sharding)
+            keys_dev = _dev_keys.get(
+                pubs[lo:hi], keys_np, sharding, cacheable=bool(mask.all())
+            )
             try:
                 dev_out = mfn(keys_dev, jax.device_put(sigs_np, sharding))
             except Exception:  # noqa: BLE001 — a sharding/mesh failure is
@@ -493,7 +503,9 @@ def verify_batch(pubs, msgs, sigs) -> list[bool]:
                 # mesh-placed key block: feed host arrays, don't reuse it
                 keys_arg = (
                     keys_np if mfn is not None
-                    else _dev_keys.get(pubs[lo:hi], keys_np)
+                    else _dev_keys.get(
+                        pubs[lo:hi], keys_np, cacheable=bool(mask.all())
+                    )
                 )
                 dev_out = fn(keys_arg, sigs_np)
             except Exception:  # noqa: BLE001 — e.g. a Mosaic lowering

@@ -178,43 +178,45 @@ def verify_tile(u1, u2, qx, qy, t1, t2):
     return ok.astype(jnp.int32)
 
 
-def _verify_tile_kernel(packed_ref, out_ref):
-    blk = packed_ref[:]  # (ROWS, SUB, LANE)
-    from tendermint_tpu.ops.secp_batch import (
-        ROW_QX, ROW_QY, ROW_T1, ROW_T2, ROW_U1, ROW_U2,
-    )
-
-    def plane(row):
-        return blk[row:row + NWORDS]
+def _verify_tile_kernel(sigs_ref, keys_ref, out_ref):
+    sigs = sigs_ref[:]  # (SIG_ROWS, SUB, LANE): u1, u2, t1, t2
+    keys = keys_ref[:]  # (KEY_ROWS, SUB, LANE): Qx, Qy
 
     out_ref[:] = verify_tile(
-        plane(ROW_U1), plane(ROW_U2), plane(ROW_QX), plane(ROW_QY),
-        plane(ROW_T1), plane(ROW_T2),
+        sigs[0:NWORDS], sigs[NWORDS:2 * NWORDS],
+        keys[0:NWORDS], keys[NWORDS:2 * NWORDS],
+        sigs[2 * NWORDS:3 * NWORDS], sigs[3 * NWORDS:4 * NWORDS],
     )
 
 
 @partial(jax.jit, static_argnames=("interpret",))
-def secp_verify_kernel(packed, interpret: bool = False):
-    """Batched ECDSA verify: (48, B) packed wire array in, (B,) bool out.
-    B is padded on device to a TILE multiple; padded lanes compute garbage
-    verdicts that are sliced off (complete formulas: junk inputs cannot
-    fault)."""
-    from tendermint_tpu.ops.secp_batch import ROWS
+def secp_verify_kernel(sigs, keys, interpret: bool = False):
+    """Batched ECDSA verify: sigs (32, B) + keys (16, B) wire blocks in,
+    (B,) bool out (two arguments so the valset-dependent Q block can stay
+    device-resident). B is padded on device to a TILE multiple; padded
+    lanes compute garbage verdicts that are sliced off (complete formulas:
+    junk inputs cannot fault)."""
+    from tendermint_tpu.ops.secp_batch import KEY_ROWS, SIG_ROWS
 
-    b = packed.shape[1]
+    b = sigs.shape[1]
     padded = -(-b // TILE) * TILE
     pad = padded - b
     if pad:
-        packed = jnp.pad(packed, ((0, 0), (0, pad)))
-    packed = packed.reshape(ROWS, padded // LANE, LANE)
+        sigs = jnp.pad(sigs, ((0, 0), (0, pad)))
+        keys = jnp.pad(keys, ((0, 0), (0, pad)))
+    sigs = sigs.reshape(SIG_ROWS, padded // LANE, LANE)
+    keys = keys.reshape(KEY_ROWS, padded // LANE, LANE)
 
     grid = (padded // TILE,)
     out = pl.pallas_call(
         _verify_tile_kernel,
         grid=grid,
-        in_specs=[pl.BlockSpec((ROWS, SUB, LANE), lambda i: (0, i, 0))],
+        in_specs=[
+            pl.BlockSpec((SIG_ROWS, SUB, LANE), lambda i: (0, i, 0)),
+            pl.BlockSpec((KEY_ROWS, SUB, LANE), lambda i: (0, i, 0)),
+        ],
         out_specs=pl.BlockSpec((SUB, LANE), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((padded // LANE, LANE), jnp.int32),
         interpret=interpret,
-    )(packed)
+    )(sigs, keys)
     return out.reshape(-1)[:b] != 0

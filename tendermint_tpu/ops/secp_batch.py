@@ -13,10 +13,13 @@ cgo path). Work split mirrors ops/ed25519_batch.py:
   target t. See ops/pallas_secp.py.
 
 Wire format: ONE (48, B) int32 array per batch — six (8, B) little-endian
-word planes stacked (u1, u2, Qx, Qy, t1, t2; ~192 B/signature). A single
-array means a single host->device transfer per batch: on a tunneled/remote
-device every separate `device_put` pays a full RPC round trip (see
-ops/ed25519_batch.py — same design, measured there).
+word planes stacked (~192 B/signature). A single array means a single
+host->device transfer per batch: on a tunneled/remote device every
+separate `device_put` pays a full RPC round trip (see ops/ed25519_batch.py
+— same design, measured there). The per-signature planes (u1, u2, t1, t2)
+come first and the pubkey planes (Qx, Qy) last, so `split()` yields the
+two as zero-copy views and a stable valset's key block stays
+device-resident between batches, exactly like the ed25519 path.
 """
 from __future__ import annotations
 
@@ -25,9 +28,16 @@ import numpy as np
 from tendermint_tpu.crypto import secp256k1_math as sm
 
 NWORDS = 8
-# Packed wire-format rows: u1, u2, Qx, Qy, t1, t2 word planes.
-ROW_U1, ROW_U2, ROW_QX, ROW_QY, ROW_T1, ROW_T2 = (8 * k for k in range(6))
+# Packed wire-format rows: sig-dependent planes then the pubkey planes.
+ROW_U1, ROW_U2, ROW_T1, ROW_T2, ROW_QX, ROW_QY = (8 * k for k in range(6))
 ROWS = 48
+SIG_ROWS = 32   # u1, u2, t1, t2
+KEY_ROWS = 16   # Qx, Qy
+
+
+def split(packed):
+    """(48, B) packed -> (sigs (32, B), keys (16, B)) zero-copy row views."""
+    return packed[:SIG_ROWS], packed[SIG_ROWS:]
 
 
 class _PubkeyCache:
@@ -55,8 +65,14 @@ class _PubkeyCache:
 _cache = _PubkeyCache()
 
 
-# one bucketing policy for both curves (see ed25519_batch._pad_to_bucket)
-from tendermint_tpu.ops.ed25519_batch import _pad_to_bucket  # noqa: E402
+
+# one bucketing policy and one device-key-cache type for both curves
+from tendermint_tpu.ops.ed25519_batch import (  # noqa: E402
+    _DeviceKeyCache,
+    _pad_to_bucket,
+)
+
+_dev_keys = _DeviceKeyCache()  # content-addressed device-resident Q blocks
 
 
 def prepare_batch(pubs, msgs, sigs, min_bucket: int = 128):
@@ -122,8 +138,8 @@ def prepare_batch(pubs, msgs, sigs, min_bucket: int = 128):
     padded = _pad_to_bucket(n, min_bucket)
     packed = np.zeros((ROWS, padded), dtype=np.int32)
     for row, a in (
-        (ROW_U1, u1_w), (ROW_U2, u2_w), (ROW_QX, qx_w),
-        (ROW_QY, qy_w), (ROW_T1, t1_w), (ROW_T2, t2_w),
+        (ROW_U1, u1_w), (ROW_U2, u2_w), (ROW_T1, t1_w),
+        (ROW_T2, t2_w), (ROW_QX, qx_w), (ROW_QY, qy_w),
     ):
         packed[row:row + NWORDS, :n] = a.T.view(np.int32)
     return packed, mask
@@ -169,8 +185,12 @@ def verify_batch(pubs, msgs, sigs) -> list[bool]:
         packed, mask = prepare_batch(pubs[lo:hi], msgs[lo:hi], sigs[lo:hi])
         if packed is None:
             continue
+        sigs_np, keys_np = split(packed)
+        keys_dev = _dev_keys.get(
+            pubs[lo:hi], keys_np, cacheable=bool(mask.all())
+        )
         try:
-            dev_out = fn(packed)
+            dev_out = fn(sigs_np, keys_dev)
         except Exception:  # noqa: BLE001 — kernel failure degrades to
             # serial, never breaks verification
             out[lo:hi] = _serial_verify(pubs[lo:hi], msgs[lo:hi], sigs[lo:hi])

@@ -186,6 +186,16 @@ class TestLiteProxyLive:
                 resp = await proxy.verified_commit(5)
                 assert resp["signed_header"]["header"]["height"] == 5
                 assert proxy.verifier.headers_verified >= 1
+                # span catch-up: the whole range in one fused batch (the
+                # span's last height needs its next-validators queryable,
+                # so wait for the chain to pass it)
+                async with asyncio.timeout(30):
+                    while node.block_store.height() < 8:
+                        await asyncio.sleep(0.05)
+                resps = await proxy.verified_range(3, 6)
+                assert [
+                    r["signed_header"]["header"]["height"] for r in resps
+                ] == [3, 4, 5, 6]
             finally:
                 await client.close()
                 await node.stop()
