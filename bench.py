@@ -91,22 +91,6 @@ def main() -> None:
     log(f"compile + first run: {time.perf_counter() - t0:.1f}s")
     assert out[:N_COMMIT].all(), "kernel rejected valid sigs"
 
-    # -- single-commit latency (fully sync, includes tunnel round trip) ----
-    # verify_batch end to end: prep + device-key-cache lookup + launch +
-    # fetch. First call is the cold-valset path (key block transferred);
-    # repeats hit the resident key block like a live validator does.
-    for label, ks in (("cold", range(1)), ("warm keys", range(1, 3))):
-        lat = []
-        for k in ks:
-            t0 = time.perf_counter()
-            ok = ed25519_batch.verify_batch(*commits[k % PIPELINE_K])
-            lat.append(time.perf_counter() - t0)
-            assert all(ok)
-        log(
-            f"single 10k-commit latency ({label}, sync): "
-            f"{min(lat) * 1e3:.1f} ms"
-        )
-
     # -- stream throughput: K distinct commits through verify_batch --------
     # (compile the stream chunk buckets outside the timed region; a node
     # prewarms them the same way at start — kcache.prewarm)
@@ -145,6 +129,44 @@ def main() -> None:
     )
     per_commit_s = stream_s / PIPELINE_K
     rate = n_total / stream_s
+    log(
+        f"{PIPELINE_K}x10k-commit stream, warm valset: {stream_s * 1e3:.1f} ms "
+        f"({per_commit_s * 1e3:.2f} ms/commit, {rate:,.0f} verifies/sec/chip; "
+        f"north star <5ms/commit on v4-8)"
+    )
+    # the ONE stdout line goes out as soon as the headline number exists:
+    # the tunnel can wedge mid-run (jax RPCs then hang forever — it died
+    # between sections once in round 2), and the remaining measurements
+    # below are stderr diagnostics that must not be able to cost the
+    # recorded result
+    print(
+        json.dumps(
+            {
+                "metric": "ed25519_e2e_verifies_per_sec_per_chip",
+                "value": round(rate, 1),
+                "unit": "verifies/s",
+                "vs_baseline": round(rate / BASELINE_VERIFIES_PER_SEC, 2),
+            }
+        ),
+        flush=True,
+    )
+
+    # -- single-commit latency (fully sync, includes tunnel round trip) ----
+    # verify_batch end to end: prep + device-key-cache lookup + launch +
+    # fetch. First call is the cold-valset path (key block transferred);
+    # repeats hit the resident key block like a live validator does.
+    ed25519_batch._dev_keys._d.clear()
+    for label, ks in (("cold", range(1)), ("warm keys", range(1, 3))):
+        lat = []
+        for k in ks:
+            t0 = time.perf_counter()
+            ok = ed25519_batch.verify_batch(*commits[k % PIPELINE_K])
+            lat.append(time.perf_counter() - t0)
+            assert all(ok)
+        log(
+            f"single 10k-commit latency ({label}, sync): "
+            f"{min(lat) * 1e3:.1f} ms"
+        )
 
     # -- commit-verify p50 at small validator counts (latency metric) ------
     for n in (100, 1000):
@@ -159,22 +181,6 @@ def main() -> None:
             f"commit-verify p50 @ {n} validators: "
             f"{statistics.median(samples) * 1e3:.1f} ms (sync, tunnel incl.)"
         )
-
-    log(
-        f"{PIPELINE_K}x10k-commit stream, warm valset: {stream_s * 1e3:.1f} ms "
-        f"({per_commit_s * 1e3:.2f} ms/commit, {rate:,.0f} verifies/sec/chip; "
-        f"north star <5ms/commit on v4-8)"
-    )
-    print(
-        json.dumps(
-            {
-                "metric": "ed25519_e2e_verifies_per_sec_per_chip",
-                "value": round(rate, 1),
-                "unit": "verifies/s",
-                "vs_baseline": round(rate / BASELINE_VERIFIES_PER_SEC, 2),
-            }
-        )
-    )
 
 
 if __name__ == "__main__":

@@ -218,6 +218,19 @@ class LiteProxy:
         Returns the raw RPC jsons after verification passes."""
         if end < start:
             raise ValueError(f"bad range [{start}, {end}]")
+        # long spans go in windows that fit the prefetch cache with room
+        # for anchor/bisection entries — a span larger than the cache
+        # would evict its own prefetches and never converge
+        window = max(64, _PrefetchSource.CACHE_LIMIT - 128)
+        if end - start + 1 > window:
+            resps = []
+            h = start
+            while h <= end:
+                resps.extend(
+                    await self.verified_range(h, min(end, h + window - 1))
+                )
+                h += window
+            return resps
         resps, shs = [], []
         for h in range(start, end + 1):
             resp = await self.client.call("commit", height=h)
@@ -232,7 +245,9 @@ class LiteProxy:
         # the range (valset links + trusted saves). Build them from the
         # commit responses already fetched — each height then costs ONE
         # extra validators call (the h+1 set of one height is the h set of
-        # the next), not a commit + two validators refetch.
+        # the next), not a commit + two validators refetch. Fetches are
+        # sequential by design: HTTPClient is one keep-alive connection
+        # with a lock, so gathering would not overlap them.
         vals: dict[int, ValidatorSet] = {}
 
         async def valset(h: int) -> ValidatorSet:
@@ -249,6 +264,8 @@ class LiteProxy:
                 fc = await self.source.full_commit_at(h)
                 fc.validate_full(self.chain_id)
                 self._prefetch.remember(h, fc)
+                # the anchor already carries the valset of `start`
+                vals[h + 1] = fc.next_validators
                 continue
             fc = FullCommit(sh, await valset(h), await valset(h + 1))
             fc.validate_full(self.chain_id)

@@ -488,26 +488,34 @@ def verify_batch(pubs, msgs, sigs) -> list[bool]:
         if mfn is not None:
             import jax
 
-            keys_dev = _dev_keys.get(
-                pubs[lo:hi], keys_np, sharding, cacheable=bool(mask.all())
-            )
             try:
+                keys_dev = _dev_keys.get(
+                    pubs[lo:hi], keys_np, sharding, cacheable=bool(mask.all())
+                )
                 dev_out = mfn(keys_dev, jax.device_put(sigs_np, sharding))
-            except Exception:  # noqa: BLE001 — a sharding/mesh failure is
-                # not a kernel failure: degrade to the single-device path
+            except Exception:  # noqa: BLE001 — a sharding/mesh/transfer
+                # failure is not a kernel failure: degrade to the
+                # single-device path
                 dev_out = None
         if dev_out is None:
             try:
+                import jax
+
                 fn = kcache.get_verify_fn(packed.shape[1])
                 # after a failed sharded attempt the cache holds a
-                # mesh-placed key block: feed host arrays, don't reuse it
+                # mesh-placed key block: re-place plainly, don't reuse it
                 keys_arg = (
-                    keys_np if mfn is not None
+                    jax.device_put(keys_np) if mfn is not None
                     else _dev_keys.get(
                         pubs[lo:hi], keys_np, cacheable=bool(mask.all())
                     )
                 )
-                dev_out = fn(keys_arg, sigs_np)
+                # commit the sig block explicitly: a committed/uncommitted
+                # argument mix is a different jit cache key than the
+                # all-committed prewarm call, and the re-trace+lowering of
+                # the 127-iteration kernel costs ~20s (measured) even with
+                # the compiled executable already cached
+                dev_out = fn(keys_arg, jax.device_put(sigs_np))
             except Exception:  # noqa: BLE001 — e.g. a Mosaic lowering
                 # regression on a new backend: the preferred (pallas)
                 # kernel failing must degrade to the XLA kernel, never

@@ -344,14 +344,14 @@ def prewarm(buckets=(128,), background: bool = True):
         mfn, sharding = ed25519_batch._multi_device_fn()
     except Exception:  # noqa: BLE001 — prewarm must never kill a node
         mfn, sharding = None, None
+    import jax
+
     for b in sorted({min(b, MAX_BUCKET) for b in buckets}):
         try:
             ks, ss = _input_shapes(b)
             zk = np.zeros(ks.shape, ks.dtype)
             zs = np.zeros(ss.shape, ss.dtype)
             if mfn is not None:
-                import jax
-
                 np.asarray(
                     mfn(
                         jax.device_put(zk, sharding),
@@ -359,7 +359,11 @@ def prewarm(buckets=(128,), background: bool = True):
                     )
                 )
             else:
-                np.asarray(get_verify_fn(b)(zk, zs))
+                # committed args: the SAME jit cache key verify_batch uses
+                # (a committed/uncommitted mix re-traces the kernel, ~20s)
+                np.asarray(
+                    get_verify_fn(b)(jax.device_put(zk), jax.device_put(zs))
+                )
         except Exception:  # noqa: BLE001 — prewarm must never kill a node
             pass
     return None

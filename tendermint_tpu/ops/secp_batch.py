@@ -186,11 +186,15 @@ def verify_batch(pubs, msgs, sigs) -> list[bool]:
         if packed is None:
             continue
         sigs_np, keys_np = split(packed)
-        keys_dev = _dev_keys.get(
-            pubs[lo:hi], keys_np, cacheable=bool(mask.all())
-        )
         try:
-            dev_out = fn(sigs_np, keys_dev)
+            import jax
+
+            keys_dev = _dev_keys.get(
+                pubs[lo:hi], keys_np, cacheable=bool(mask.all())
+            )
+            # commit both args: a committed/uncommitted mix is a separate
+            # jit cache key and re-traces the kernel (see ed25519_batch)
+            dev_out = fn(jax.device_put(sigs_np), keys_dev)
         except Exception:  # noqa: BLE001 — kernel failure degrades to
             # serial, never breaks verification
             out[lo:hi] = _serial_verify(pubs[lo:hi], msgs[lo:hi], sigs[lo:hi])
