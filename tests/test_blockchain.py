@@ -126,18 +126,50 @@ class TestFastSync:
                 syncer_reactors = await syncer.setup()
                 from tendermint_tpu.p2p.test_util import make_switch
 
-                sw2 = await make_switch(syncer_reactors, network=CHAIN_ID)
-                await sw2.start()
-                switches.append(sw2)
-                await sw2.dial_peers_async([switches[0].transport.listen_addr])
+                # instrument verify-ahead: the pool must fill ahead of the
+                # apply loop so the reactor fuses multiple heights' commits
+                # into one batch. Slowing apply_block slightly makes that
+                # deterministic (downloads from the prebuilt chain are
+                # instant; applies pace the window build-up).
+                import tendermint_tpu.blockchain.reactor as bc_mod
 
-                # the syncer must fast-sync the chain and switch to consensus
-                async with asyncio.timeout(60):
-                    while syncer.block_store.height() < 8:
-                        await asyncio.sleep(0.05)
-                    while not syncer.cs.is_running:
-                        await asyncio.sleep(0.05)
+                batch_sizes = []
+                orig_vc = bc_mod.verify_commits
+                orig_apply = syncer.block_exec.apply_block
+
+                def counting_verify_commits(entries):
+                    batch_sizes.append(len(entries))
+                    return orig_vc(entries)
+
+                async def slow_apply(*a, **kw):
+                    await asyncio.sleep(0.05)
+                    return await orig_apply(*a, **kw)
+
+                try:
+                    bc_mod.verify_commits = counting_verify_commits
+                    syncer.block_exec.apply_block = slow_apply
+
+                    sw2 = await make_switch(syncer_reactors, network=CHAIN_ID)
+                    await sw2.start()
+                    switches.append(sw2)
+                    await sw2.dial_peers_async(
+                        [switches[0].transport.listen_addr]
+                    )
+                    # the syncer must fast-sync and switch to consensus
+                    async with asyncio.timeout(60):
+                        while syncer.block_store.height() < 8:
+                            await asyncio.sleep(0.05)
+                        while not syncer.cs.is_running:
+                            await asyncio.sleep(0.05)
+                finally:
+                    bc_mod.verify_commits = orig_vc
+                    syncer.block_exec.apply_block = orig_apply
                 assert syncer.bc_reactor.blocks_synced >= 5
+                assert batch_sizes and max(batch_sizes) >= 2, batch_sizes
+                # the cache must prevent re-verification: total commits
+                # batched stays within the heights synced plus the pending
+                # window (no per-loop re-verification of cached heights)
+                assert sum(batch_sizes) <= syncer.bc_reactor.blocks_synced + 32
                 # after switching, the syncer keeps following new blocks
                 target = producer.block_store.height() + 2
                 async with asyncio.timeout(60):
