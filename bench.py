@@ -43,7 +43,31 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def _probe_device(timeout_s: float = 150.0) -> None:
+    """Fail fast if the device link is wedged. A dead axon tunnel makes
+    every jax RPC — including jax.devices() — hang FOREVER with no error
+    (it died mid-run once in round 2); probing in a subprocess with a
+    timeout turns an indefinite hang into a quick, diagnosable failure."""
+    import subprocess
+
+    try:
+        subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            check=True,
+            capture_output=True,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        log(f"FATAL: device probe hung >{timeout_s:.0f}s — tunnel down?")
+        raise SystemExit(3)
+    except subprocess.CalledProcessError as e:
+        log(f"FATAL: device probe failed: {e.stderr[-500:]}")
+        raise SystemExit(3)
+
+
 def main() -> None:
+    _probe_device()
     import jax
 
     from tendermint_tpu.crypto import ed25519
