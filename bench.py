@@ -43,27 +43,43 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def _probe_device(timeout_s: float = 150.0) -> None:
+def _probe_device(timeout_s: float = 150.0, attempts: int = 3) -> None:
     """Fail fast if the device link is wedged. A dead axon tunnel makes
     every jax RPC — including jax.devices() — hang FOREVER with no error
     (it died mid-run once in round 2); probing in a subprocess with a
-    timeout turns an indefinite hang into a quick, diagnosable failure."""
+    timeout turns an indefinite hang into a quick, diagnosable failure.
+
+    Retries with backoff (round-2 lesson: one transient wedge zeroed the
+    whole round's record) — a tunnel that recovers within ~10 min still
+    yields a bench number; only a persistently dead link exits."""
     import subprocess
 
-    try:
-        subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s,
-            check=True,
-            capture_output=True,
-            text=True,
-        )
-    except subprocess.TimeoutExpired:
-        log(f"FATAL: device probe hung >{timeout_s:.0f}s — tunnel down?")
-        raise SystemExit(3)
-    except subprocess.CalledProcessError as e:
-        log(f"FATAL: device probe failed: {e.stderr[-500:]}")
-        raise SystemExit(3)
+    for attempt in range(1, attempts + 1):
+        try:
+            subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=timeout_s,
+                check=True,
+                capture_output=True,
+                text=True,
+            )
+            return
+        except subprocess.TimeoutExpired:
+            log(
+                f"device probe {attempt}/{attempts} hung >{timeout_s:.0f}s "
+                f"— tunnel down?"
+            )
+        except subprocess.CalledProcessError as e:
+            log(
+                f"device probe {attempt}/{attempts} failed: "
+                f"{e.stderr[-500:]}"
+            )
+        if attempt < attempts:
+            backoff = 30.0 * attempt
+            log(f"retrying probe in {backoff:.0f}s")
+            time.sleep(backoff)
+    log("FATAL: device probe exhausted retries")
+    raise SystemExit(3)
 
 
 def main() -> None:

@@ -114,14 +114,22 @@ class BlockchainReactor(BaseReactor):
             logger=logger,
         )
         self.blocks_synced = 0
-        # verify-ahead caches: (height, block_hash, valset_hash) -> verdict.
+        # verify-ahead caches, keyed (height, block_hash, successor_hash,
+        # valset_hash). The verdict is computed from the SUCCESSOR's
+        # last_commit, so the successor's identity is part of the key: if
+        # block h+1 is replaced in the pool (peer timeout/redo), verdicts
+        # computed against the old h+1 must not survive — a stale cached
+        # failure would disconnect now-honest senders at the head.
         # Pass/fail is only meaningful under the valset it was checked with;
         # a failed ahead-check is NOT evidence of a bad peer (an intervening
         # block may rotate the validator set), so failures are cached to
         # avoid re-verifying every loop but punished only at the head where
-        # the current valset is authoritative.
-        self._verified_ahead: set[tuple[int, bytes, bytes]] = set()
-        self._failed_ahead: set[tuple[int, bytes, bytes]] = set()
+        # the current valset is authoritative. Failures keep str(err) — not
+        # the exception, whose __traceback__ would pin the whole
+        # verify_commits frame graph across sync ticks — so the
+        # head-failure log can name the cause.
+        self._verified_ahead: set[tuple[int, bytes, bytes, bytes]] = set()
+        self._failed_ahead: dict[tuple[int, bytes, bytes, bytes], str] = {}
         # ValidatorSet.hash() merkle-hashes every validator; memoize per
         # valset object so the 10ms sync tick doesn't recompute it
         self._vs_hash_src: object | None = None
@@ -241,7 +249,7 @@ class BlockchainReactor(BaseReactor):
         verifies serially per height, reactor.go:313)."""
         entries, keys = [], []
         for blk, nxt in zip(blocks, blocks[1:]):
-            key = (blk.header.height, blk.hash(), vs_hash)
+            key = (blk.header.height, blk.hash(), nxt.hash(), vs_hash)
             if key in self._verified_ahead or key in self._failed_ahead:
                 continue
             parts = blk.make_part_set()
@@ -258,7 +266,10 @@ class BlockchainReactor(BaseReactor):
         if not entries:
             return
         for key, err in zip(keys, verify_commits(entries)):
-            (self._verified_ahead if err is None else self._failed_ahead).add(key)
+            if err is None:
+                self._verified_ahead.add(key)
+            else:
+                self._failed_ahead[key] = str(err)
         if len(entries) > 1:
             self.log.debug(
                 "verify-ahead batch", heights=len(entries),
@@ -279,12 +290,13 @@ class BlockchainReactor(BaseReactor):
         self._verify_ahead(blocks, vs_hash)
         first_parts = first.make_part_set()
         first_id = BlockID(first.hash(), first_parts.header())
-        head_key = (first.header.height, first.hash(), vs_hash)
+        head_key = (first.header.height, first.hash(), second.hash(), vs_hash)
         if head_key not in self._verified_ahead:
             # at the head the current valset IS authoritative: a failure
             # here means a bad block/commit, not a stale-valset artifact
             self.log.error(
-                "fast-sync block verify failed", height=first.header.height
+                "fast-sync block verify failed", height=first.header.height,
+                err=self._failed_ahead.get(head_key, ""),
             )
             # disconnect both senders (reference reactor.go poolRoutine
             # StopPeerForError) — pool removal alone lets a Byzantine peer
@@ -295,7 +307,7 @@ class BlockchainReactor(BaseReactor):
             ):
                 if bad is not None:
                     await self._on_pool_peer_error(bad, "sent invalid block")
-            self._failed_ahead.discard(head_key)  # re-verify the redo
+            self._failed_ahead.pop(head_key, None)  # re-verify the redo
             return False
         self.pool.pop_request()
         self.block_store.save_block(first, first_parts, second.last_commit)
@@ -311,7 +323,7 @@ class BlockchainReactor(BaseReactor):
                 k for k in self._verified_ahead if k[0] >= floor
             }
             self._failed_ahead = {
-                k for k in self._failed_ahead if k[0] >= floor
+                k: e for k, e in self._failed_ahead.items() if k[0] >= floor
             }
         if self.blocks_synced % 100 == 0:
             self.log.info(

@@ -1,0 +1,96 @@
+"""XChaCha20-Poly1305 AEAD (24-byte nonces).
+
+Parity with the reference's crypto/xchacha20poly1305/xchachapoly.go:1 —
+HChaCha20 subkey derivation (draft-irtf-cfrg-xchacha §2.2) in front of the
+IETF ChaCha20-Poly1305 AEAD: the first 16 nonce bytes derive a one-use
+subkey, the last 8 become the tail of the 12-byte inner nonce (4 zero-byte
+prefix). The long random nonce is what the reference uses it for: safe
+random-nonce encryption without a per-key counter.
+
+The 20-round HChaCha20 core runs in pure Python — it is key *derivation*
+(one block per seal/open, ~30 µs); the bulk AEAD work is the C-backed
+ChaCha20Poly1305 from `cryptography`, mirroring how the reference fronts
+golang.org/x/crypto/chacha20poly1305 with its own HChaCha20.
+"""
+from __future__ import annotations
+
+import struct
+
+from cryptography.exceptions import InvalidTag
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+KEY_SIZE = 32
+NONCE_SIZE = 24
+TAG_SIZE = 16
+
+_SIGMA = (0x61707865, 0x3320646E, 0x79622D32, 0x6B206574)  # "expand 32-byte k"
+_MASK = 0xFFFFFFFF
+
+
+def _rotl(v: int, c: int) -> int:
+    return ((v << c) | (v >> (32 - c))) & _MASK
+
+
+def _quarter(st: list[int], a: int, b: int, c: int, d: int) -> None:
+    st[a] = (st[a] + st[b]) & _MASK
+    st[d] = _rotl(st[d] ^ st[a], 16)
+    st[c] = (st[c] + st[d]) & _MASK
+    st[b] = _rotl(st[b] ^ st[c], 12)
+    st[a] = (st[a] + st[b]) & _MASK
+    st[d] = _rotl(st[d] ^ st[a], 8)
+    st[c] = (st[c] + st[d]) & _MASK
+    st[b] = _rotl(st[b] ^ st[c], 7)
+
+
+def hchacha20(key: bytes, nonce16: bytes) -> bytes:
+    """HChaCha20: (32-byte key, 16-byte nonce) -> 32-byte subkey.
+
+    20 rounds over the ChaCha state; the output is words 0-3 and 12-15
+    WITHOUT the feed-forward addition (draft-irtf-cfrg-xchacha §2.2).
+    """
+    if len(key) != KEY_SIZE:
+        raise ValueError("hchacha20: key must be 32 bytes")
+    if len(nonce16) != 16:
+        raise ValueError("hchacha20: nonce must be 16 bytes")
+    st = list(_SIGMA)
+    st += list(struct.unpack("<8I", key))
+    st += list(struct.unpack("<4I", nonce16))
+    for _ in range(10):  # 10 double rounds = 20 rounds
+        _quarter(st, 0, 4, 8, 12)
+        _quarter(st, 1, 5, 9, 13)
+        _quarter(st, 2, 6, 10, 14)
+        _quarter(st, 3, 7, 11, 15)
+        _quarter(st, 0, 5, 10, 15)
+        _quarter(st, 1, 6, 11, 12)
+        _quarter(st, 2, 7, 8, 13)
+        _quarter(st, 3, 4, 9, 14)
+    return struct.pack("<8I", *(st[0:4] + st[12:16]))
+
+
+class XChaCha20Poly1305:
+    """AEAD with 24-byte nonces (reference xchachapoly.go New/Seal/Open)."""
+
+    def __init__(self, key: bytes) -> None:
+        if len(key) != KEY_SIZE:
+            raise ValueError("xchacha20poly1305: bad key length")
+        self._key = bytes(key)
+
+    def _inner(self, nonce: bytes) -> tuple[ChaCha20Poly1305, bytes]:
+        if len(nonce) != NONCE_SIZE:
+            raise ValueError("xchacha20poly1305: bad nonce length")
+        subkey = hchacha20(self._key, nonce[:16])
+        return ChaCha20Poly1305(subkey), b"\x00\x00\x00\x00" + nonce[16:]
+
+    def seal(self, nonce: bytes, plaintext: bytes, aad: bytes = b"") -> bytes:
+        """Encrypt+authenticate; returns ciphertext || 16-byte tag."""
+        aead, inner_nonce = self._inner(nonce)
+        return aead.encrypt(inner_nonce, plaintext, aad or None)
+
+    def open(self, nonce: bytes, ciphertext: bytes, aad: bytes = b"") -> bytes:
+        """Verify+decrypt; raises ValueError on forgery (reference returns
+        an error from Open — callers treat both uniformly)."""
+        aead, inner_nonce = self._inner(nonce)
+        try:
+            return aead.decrypt(inner_nonce, ciphertext, aad or None)
+        except InvalidTag as e:
+            raise ValueError("xchacha20poly1305: message authentication failed") from e

@@ -285,8 +285,18 @@ class LiteProxy:
         # The sync verifier runs against a commit cache; on a cache miss it
         # records the height it needed, we fetch that over RPC and retry.
         # Each retry makes strict progress (one more height cached), and
-        # bisection touches O(log N * sets-changed) heights.
-        for _ in range(256):
+        # bisection touches O(log N * sets-changed) heights. The loop is
+        # bounded by that strict-progress invariant, not a fixed count: a
+        # cold cache under a wide verified_range window (up to 384 heights,
+        # plus bisection slack) legitimately needs more retries than any
+        # fixed small cap. A re-miss of a height the cache still HOLDS is a
+        # verifier bug (raised below); a re-miss of a height the bounded
+        # prefetch cache EVICTED mid-loop is legitimate and re-fetched —
+        # but only a small number of times, so pathological cache thrash
+        # (a single attempt needing more live heights than the cache can
+        # hold) terminates instead of looping forever.
+        fetches: dict[int, int] = {}
+        while True:
             self._prefetch.last_missing = None
             try:
                 attempt()
@@ -295,10 +305,17 @@ class LiteProxy:
                 missing = self._prefetch.last_missing
                 if missing is None or missing in self._prefetch.commits:
                     raise
+                n = fetches.get(missing, 0) + 1
+                fetches[missing] = n
+                if n > 3:  # evicted and re-fetched repeatedly: not converging
+                    raise LiteError(
+                        f"trust advance did not converge for {what} "
+                        f"(height {missing} fetched {n - 1}x but evicted "
+                        f"each time — span exceeds the prefetch cache)"
+                    )
                 fc = await self.source.full_commit_at(missing)
                 fc.validate_full(self.chain_id)
                 self._prefetch.remember(missing, fc)
-        raise LiteError(f"trust advance did not converge for {what}")
 
 
 async def run_lite_proxy(

@@ -18,6 +18,18 @@ MIN_DEVICE_BATCH = int(os.environ.get("TMTPU_MIN_DEVICE_BATCH", "8"))
 
 _min_batch_probed: int | None = None
 
+# Serial OpenSSL-backed verify cost per signature: the break-even unit the
+# dispatch probe divides by.
+_SERIAL_VERIFY_S = 120e-6
+
+
+def _threshold_for_dispatch(dispatch_s: float) -> int:
+    """Measured device round-trip cost -> routing threshold: batches at or
+    above it win on device. A ~1ms local chip stays at the MIN_DEVICE_BATCH
+    floor (8); a ~65ms tunnel yields ~540; clamped at 4096 so a pathological
+    probe can never push everything onto the serial path."""
+    return min(4096, max(MIN_DEVICE_BATCH, int(dispatch_s / _SERIAL_VERIFY_S)))
+
 
 def effective_min_batch() -> int:
     """Routing threshold between the serial/native CPU path and the device.
@@ -50,9 +62,7 @@ def effective_min_batch() -> int:
         t0 = time.perf_counter()
         np.asarray(f(jax.device_put(np.full(8, 3), dev)))
         dispatch_s = time.perf_counter() - t0
-        _min_batch_probed = min(
-            4096, max(MIN_DEVICE_BATCH, int(dispatch_s / 120e-6))
-        )
+        _min_batch_probed = _threshold_for_dispatch(dispatch_s)
     except Exception:  # noqa: BLE001 — no device: serial fallback anyway
         pass
     return _min_batch_probed
@@ -103,10 +113,16 @@ def _probe_small_path(curve: str, native_fn, serial_fn, sample) -> str:
 
         t_native, ok_n = best_of_two(native_fn)
         t_serial, ok_s = best_of_two(serial_fn)
-        choice = (
-            "native" if all(ok_n) and t_native <= t_serial else "serial"
-        )
-        assert all(ok_s)
+        if not all(ok_s):
+            # the serial path mis-verified a known-good sample: never
+            # select the path that just failed — prefer native if IT
+            # verified, else fall through to serial anyway (it keeps
+            # per-signature error isolation; nothing better exists)
+            choice = "native" if all(ok_n) else "serial"
+        else:
+            choice = (
+                "native" if all(ok_n) and t_native <= t_serial else "serial"
+            )
     except Exception:  # noqa: BLE001 — native missing/broken: serial path
         choice = "serial"
     _small_choice[curve] = choice

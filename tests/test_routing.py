@@ -1,0 +1,106 @@
+"""Small-batch routing: device-vs-serial threshold behavior.
+
+Pins the README claim that "on a local chip the device threshold falls to
+8": the probed dispatch cost decides routing (reference analog: the serial
+small-N loop of types/validator_set.go:591 — our build replaces it with a
+measured break-even). VERDICT r2 weak #4: this logic previously rested on
+prose, not a test.
+"""
+import pytest
+
+import tendermint_tpu.ops as ops
+from tendermint_tpu.utils import make_sig_batch
+
+
+def test_threshold_fast_local_dispatch_floor():
+    # ~1 ms local-chip dispatch: every batch >= the floor (8) goes to device
+    assert ops._threshold_for_dispatch(0.001) == ops.MIN_DEVICE_BATCH
+
+
+def test_threshold_tunnel_dispatch():
+    # ~65 ms tunnel round trip: break-even at ~540 signatures
+    assert ops._threshold_for_dispatch(0.065) == 541
+
+
+def test_threshold_clamped():
+    assert ops._threshold_for_dispatch(10.0) == 4096
+    assert ops._threshold_for_dispatch(0.0) == ops.MIN_DEVICE_BATCH
+
+
+def test_env_override_wins(monkeypatch):
+    monkeypatch.setenv("TMTPU_MIN_DEVICE_BATCH", "8")
+    monkeypatch.setattr(ops, "MIN_DEVICE_BATCH", 8)
+    monkeypatch.setattr(ops, "_min_batch_probed", 12345)
+    assert ops.effective_min_batch() == 8
+
+
+def test_cpu_backend_stays_at_floor(monkeypatch):
+    # the suite runs on the forced-CPU mesh: the probe must not inflate the
+    # threshold (jax.default_backend() == "cpu" short-circuits)
+    monkeypatch.delenv("TMTPU_MIN_DEVICE_BATCH", raising=False)
+    monkeypatch.setattr(ops, "_min_batch_probed", None)
+    assert ops.effective_min_batch() == ops.MIN_DEVICE_BATCH
+
+
+@pytest.mark.parametrize(
+    "threshold,n,expect_device",
+    [
+        (8, 8, True),     # local chip: an 8-sig commit chunk hits the device
+        (8, 7, False),    # below the floor: serial/native CPU path
+        (541, 256, False),  # tunnel: a 256-vote burst stays off the sync path
+        (541, 600, True),   # past break-even: device
+    ],
+)
+def test_routing_respects_threshold(monkeypatch, threshold, n, expect_device):
+    from tendermint_tpu.ops import ed25519_batch
+
+    monkeypatch.delenv("TMTPU_MIN_DEVICE_BATCH", raising=False)
+    monkeypatch.setattr(ops, "_min_batch_probed", threshold)
+    calls = {"device": 0, "small": 0}
+
+    def fake_device(pubs, msgs, sigs):
+        calls["device"] += 1
+        return [True] * len(pubs)
+
+    def fake_small(pubs, msgs, sigs):
+        calls["small"] += 1
+        return [True] * len(pubs)
+
+    monkeypatch.setattr(ed25519_batch, "verify_batch", fake_device)
+    monkeypatch.setattr(ops, "_ed25519_small", fake_small)
+    pubs, msgs, sigs = make_sig_batch(n)
+    assert all(ops._ed25519_backend(pubs, msgs, sigs))
+    assert calls["device"] == (1 if expect_device else 0)
+    assert calls["small"] == (0 if expect_device else 1)
+
+
+def test_device_routing_verifies_correctly_at_floor(monkeypatch):
+    # end-to-end: with the local-chip floor (8), an 8-sig batch runs the
+    # REAL device path (CPU mesh here) and a tampered signature is caught
+    monkeypatch.delenv("TMTPU_MIN_DEVICE_BATCH", raising=False)
+    monkeypatch.setattr(ops, "_min_batch_probed", 8)
+    pubs, msgs, sigs = make_sig_batch(8)
+    ok = ops._ed25519_backend(pubs, msgs, sigs)
+    assert ok == [True] * 8
+    bad = list(sigs)
+    bad[3] = bytes(bad[3][:-1]) + bytes([bad[3][-1] ^ 1])
+    ok = ops._ed25519_backend(pubs, msgs, bad)
+    assert ok == [True, True, True, False, True, True, True, True]
+
+
+def test_probe_small_path_serial_misverify_prefers_native(monkeypatch):
+    # ADVICE r2 low #4: if the serial path mis-verifies the known-good
+    # sample, the choice must not be the path that just failed
+    monkeypatch.setattr(ops, "_small_choice", {})
+
+    def sample():
+        return make_sig_batch(4, msg_prefix=b"probe ")
+
+    def native_ok(p, m, s):
+        return [True] * len(p)
+
+    def serial_bad(p, m, s):
+        return [True, False, True, True]
+
+    choice = ops._probe_small_path("testcurve", native_ok, serial_bad, sample)
+    assert choice == "native"

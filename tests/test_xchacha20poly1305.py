@@ -1,0 +1,109 @@
+"""XChaCha20-Poly1305 KATs + behavior tests.
+
+Vectors from draft-irtf-cfrg-xchacha-03 (§2.2.1 HChaCha20, §A.3 AEAD) —
+the same vectors the reference tests against
+(crypto/xchacha20poly1305/xchachapoly_test.go).
+"""
+import pytest
+
+from tendermint_tpu.crypto.xchacha20poly1305 import (
+    KEY_SIZE,
+    NONCE_SIZE,
+    XChaCha20Poly1305,
+    hchacha20,
+)
+
+
+def test_hchacha20_draft_vector():
+    key = bytes.fromhex(
+        "000102030405060708090a0b0c0d0e0f"
+        "101112131415161718191a1b1c1d1e1f"
+    )
+    nonce = bytes.fromhex("000000090000004a0000000031415927")
+    assert hchacha20(key, nonce).hex() == (
+        "82413b4227b27bfed30e42508a877d73"
+        "a0f9e4d58a74a853c12ec41326d3ecdc"
+    )
+
+
+_KEY = bytes.fromhex(
+    "808182838485868788898a8b8c8d8e8f909192939495969798999a9b9c9d9e9f"
+)
+_NONCE = bytes.fromhex("404142434445464748494a4b4c4d4e4f5051525354555657")
+_AAD = bytes.fromhex("50515253c0c1c2c3c4c5c6c7")
+_PLAINTEXT = (
+    b"Ladies and Gentlemen of the class of '99: If I could offer you "
+    b"only one tip for the future, sunscreen would be it."
+)
+_CIPHERTEXT = bytes.fromhex(
+    "bd6d179d3e83d43b9576579493c0e939572a1700252bfaccbed2902c21396cbb"
+    "731c7f1b0b4aa6440bf3a82f4eda7e39ae64c6708c54c216cb96b72e1213b452"
+    "2f8c9ba40db5d945b11b69b982c1bb9e3f3fac2bc369488f76b2383565d3fff9"
+    "21f9664c97637da9768812f615c68b13b52e"
+)
+_TAG = bytes.fromhex("c0875924c1c7987947deafd8780acf49")
+
+
+def test_aead_draft_vector_seal():
+    sealed = XChaCha20Poly1305(_KEY).seal(_NONCE, _PLAINTEXT, _AAD)
+    assert sealed == _CIPHERTEXT + _TAG
+
+
+def test_aead_draft_vector_open():
+    assert (
+        XChaCha20Poly1305(_KEY).open(_NONCE, _CIPHERTEXT + _TAG, _AAD)
+        == _PLAINTEXT
+    )
+
+
+def test_roundtrip_empty_and_no_aad():
+    a = XChaCha20Poly1305(b"\x01" * KEY_SIZE)
+    n = b"\x02" * NONCE_SIZE
+    assert a.open(n, a.seal(n, b"")) == b""
+    assert a.open(n, a.seal(n, b"hello")) == b"hello"
+
+
+def test_tampered_ciphertext_rejected():
+    a = XChaCha20Poly1305(_KEY)
+    sealed = bytearray(a.seal(_NONCE, _PLAINTEXT, _AAD))
+    sealed[0] ^= 1
+    with pytest.raises(ValueError):
+        a.open(_NONCE, bytes(sealed), _AAD)
+
+
+def test_tampered_tag_rejected():
+    a = XChaCha20Poly1305(_KEY)
+    sealed = bytearray(a.seal(_NONCE, _PLAINTEXT, _AAD))
+    sealed[-1] ^= 1
+    with pytest.raises(ValueError):
+        a.open(_NONCE, bytes(sealed), _AAD)
+
+
+def test_wrong_aad_rejected():
+    a = XChaCha20Poly1305(_KEY)
+    sealed = a.seal(_NONCE, _PLAINTEXT, _AAD)
+    with pytest.raises(ValueError):
+        a.open(_NONCE, sealed, b"different aad")
+
+
+def test_wrong_nonce_rejected():
+    a = XChaCha20Poly1305(_KEY)
+    sealed = a.seal(_NONCE, _PLAINTEXT, _AAD)
+    with pytest.raises(ValueError):
+        a.open(bytes(NONCE_SIZE), sealed, _AAD)
+
+
+def test_distinct_nonces_distinct_streams():
+    a = XChaCha20Poly1305(_KEY)
+    n2 = bytes([_NONCE[0] ^ 0xFF]) + _NONCE[1:]
+    assert a.seal(_NONCE, _PLAINTEXT) != a.seal(n2, _PLAINTEXT)
+
+
+def test_bad_lengths():
+    with pytest.raises(ValueError):
+        XChaCha20Poly1305(b"short")
+    a = XChaCha20Poly1305(_KEY)
+    with pytest.raises(ValueError):
+        a.seal(b"short nonce", b"x")
+    with pytest.raises(ValueError):
+        hchacha20(_KEY, b"short")
