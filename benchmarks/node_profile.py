@@ -1,0 +1,119 @@
+"""End-to-end node throughput profile (r2 VERDICT weak #7 / next #5).
+
+Boots an in-process single-validator kvstore node, drives it with the
+tm-bench analog for DURATION seconds, and cProfiles the whole process —
+the tx pipeline (RPC ingest -> mempool CheckTx -> proposal -> parts ->
+consensus steps -> ABCI deliver -> commit) shares one event loop, so one
+profile sees every cost a commit round pays. Prints the tx/blocks rates
+and the top profile rows by self-time, grouped into subsystem buckets so
+"the top three costs" is a direct read-off.
+
+Usage: JAX_PLATFORMS=cpu python -m benchmarks.node_profile [duration] [rate]
+"""
+from __future__ import annotations
+
+import asyncio
+import cProfile
+import os
+import pstats
+import sys
+import tempfile
+import time
+
+
+def _bucket(path_line: str) -> str:
+    """Map a profile row to a subsystem bucket."""
+    buckets = [
+        ("encoding.py", "cbe-encode"),
+        ("merkle", "merkle/sha"),
+        ("hashlib", "merkle/sha"),
+        ("_hashlib", "merkle/sha"),
+        ("part_set", "part-set"),
+        ("jsonrpc", "rpc"),
+        ("rpc/", "rpc"),
+        ("json", "rpc-json"),
+        ("mempool", "mempool"),
+        ("consensus", "consensus"),
+        ("abci", "abci"),
+        ("asyncio", "asyncio"),
+        ("selectors", "asyncio"),
+        ("ssl", "net"),
+        ("socket", "net"),
+        ("crypto", "crypto"),
+        ("cryptography", "crypto"),
+        ("types/", "types"),
+        ("state/", "state-exec"),
+        ("store", "store"),
+        ("p2p", "p2p"),
+    ]
+    for frag, name in buckets:
+        if frag in path_line:
+            return name
+    return "other"
+
+
+def main() -> None:
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    duration = int(sys.argv[1]) if len(sys.argv) > 1 else 15
+    rate = int(sys.argv[2]) if len(sys.argv) > 2 else 2000
+
+    from tests.test_node_rpc import make_node
+    from tendermint_tpu.abci.examples import KVStoreApplication
+    from tendermint_tpu.tools.bench import run_bench
+
+    async def drive() -> dict:
+        with tempfile.TemporaryDirectory() as root:
+            # provable=False = the reference-parity O(1)-app-hash kvstore
+            # (kvstore.go:111) — the app the reference's tm-bench numbers
+            # are measured against
+            node = make_node(root, app=KVStoreApplication(provable=False))
+            await node.start()
+            try:
+                async with asyncio.timeout(60):
+                    while node.block_store.height() < 1:
+                        await asyncio.sleep(0.05)
+                report = await run_bench(
+                    "127.0.0.1", node.rpc_port,
+                    duration=duration, rate=rate, connections=1,
+                )
+                report["height"] = node.block_store.height()
+                return report
+            finally:
+                await node.stop()
+
+    pr = cProfile.Profile()
+    t0 = time.perf_counter()
+    pr.enable()
+    report = asyncio.run(drive())
+    pr.disable()
+    wall = time.perf_counter() - t0
+
+    print(f"== tm-bench report (duration={duration}s rate={rate}/s) ==")
+    print(f"txs/sec:    {report['txs_per_sec']}")
+    print(f"blocks/sec: {report['blocks_per_sec']}")
+    print(f"final height: {report['height']}, wall {wall:.1f}s")
+
+    stats = pstats.Stats(pr)
+    rows = []
+    for (path, line, fn), (cc, nc, tt, ct, _) in stats.stats.items():
+        rows.append((tt, ct, nc, f"{path}:{line}({fn})"))
+    rows.sort(reverse=True)
+
+    agg: dict[str, float] = {}
+    for tt, _, _, where in rows:
+        agg[_bucket(where)] = agg.get(_bucket(where), 0.0) + tt
+    print("\n== self-time by subsystem ==")
+    for name, tt in sorted(agg.items(), key=lambda kv: -kv[1])[:14]:
+        print(f"{tt:8.2f}s  {name}")
+
+    print("\n== top 25 functions by self-time ==")
+    for tt, ct, nc, where in rows[:25]:
+        print(f"{tt:8.2f}s self {ct:8.2f}s cum {nc:>9} calls  {where}")
+
+
+if __name__ == "__main__":
+    main()

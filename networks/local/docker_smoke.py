@@ -1,0 +1,41 @@
+"""Docker-compose testnet smoke: all 4 containerized nodes reach height 3
+(reference test/p2p/basic/test.sh). Run via `make -C networks/local
+test-docker` on a host with a docker daemon; RPC ports per
+docker-compose.yml."""
+from __future__ import annotations
+
+import json
+import sys
+import time
+import urllib.request
+
+RPC_PORTS = [26657, 26660, 26662, 26664]  # per docker-compose.yml: each
+# node maps host (p2p, rpc) pairs 26656-7, 26659-60, 26661-2, 26663-4
+
+
+def height(port: int) -> int | None:
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/status", timeout=2
+        ) as r:
+            st = json.loads(r.read())
+        return int(st["result"]["sync_info"]["latest_block_height"])
+    except Exception:  # noqa: BLE001 — container still booting
+        return None
+
+
+def main() -> int:
+    deadline = time.monotonic() + 300
+    heights = {p: None for p in RPC_PORTS}
+    while time.monotonic() < deadline:
+        heights = {p: height(p) for p in RPC_PORTS}
+        if all(h is not None and h >= 3 for h in heights.values()):
+            print(f"docker testnet live: {heights}")
+            return 0
+        time.sleep(2)
+    print(f"docker testnet failed to reach height 3: {heights}")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,237 @@
+"""Multi-node testnet scenarios over real OS processes + real TCP.
+
+The reference's tier-3 integration harness runs N dockerized nodes on one
+machine and asserts liveness through failures (test/p2p/basic/test.sh,
+test/p2p/fast_sync/test.sh, test/p2p/kill_all/test.sh). This is the same
+tier without the container runtime (none exists in the CI image): node
+directories come from the real `testnet` CLI generator, each node is a
+separate `python -m tendermint_tpu.cmd node` process on 127.0.0.1 ports,
+and every assertion goes through the public RPC — so config writing,
+genesis distribution, CLI flag handling, p2p dialing, WAL recovery and
+fast sync are all exercised exactly as a deployment would.
+
+Scenarios:
+  basic     — N nodes, all reach height >= 3 and stay within 1 height.
+  fast_sync — stop one node; the rest advance; restart it; it catches up.
+  kill_all  — SIGKILL every node; restart; chain resumes past the old head.
+
+Usage:
+  python -m networks.local.proc_testnet            # all scenarios, n=4
+  python -m networks.local.proc_testnet basic      # one scenario
+(The docker-compose path for hosts that have docker is networks/local/
+docker-compose.yml; `make -C networks/local test` prefers docker and falls
+back to this driver.)
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _free_port_base(n_nodes: int) -> int:
+    """Find a base port with 2*n consecutive free ports."""
+    for base in range(21000, 60000, 64):
+        try:
+            socks = []
+            for off in range(2 * n_nodes):
+                s = socket.socket()
+                s.bind(("127.0.0.1", base + off))
+                socks.append(s)
+            for s in socks:
+                s.close()
+            return base
+        except OSError:
+            for s in socks:
+                s.close()
+    raise RuntimeError("no free port range")
+
+
+class ProcTestnet:
+    def __init__(self, n: int = 4, root: str | None = None) -> None:
+        self.n = n
+        self.root = root or tempfile.mkdtemp(prefix="tmtpu-testnet-")
+        self._own_root = root is None
+        self.base_port = _free_port_base(n)
+        self.procs: dict[int, subprocess.Popen | None] = {}
+        self.logs: dict[int, object] = {}
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def generate(self) -> None:
+        """Run the real `testnet` CLI generator (reference testnet.go)."""
+        subprocess.run(
+            [
+                sys.executable, "-m", "tendermint_tpu.cmd", "testnet",
+                "--v", str(self.n), "--o", self.root,
+                "--starting-port", str(self.base_port),
+            ],
+            check=True, cwd=REPO_ROOT, env=self._env(), capture_output=True,
+        )
+
+    def _env(self) -> dict:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"       # consensus plane is host-side
+        env["TMTPU_NO_PREWARM"] = "1"      # no background compiles in CI
+        env["TMTPU_NO_EXPORT_CACHE"] = "1"
+        env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        return env
+
+    def home(self, i: int) -> str:
+        return os.path.join(self.root, f"node{i}")
+
+    def rpc_port(self, i: int) -> int:
+        return self.base_port + 2 * i + 1
+
+    def start(self, i: int) -> None:
+        assert self.procs.get(i) is None, f"node{i} already running"
+        log = open(os.path.join(self.root, f"node{i}.log"), "ab")
+        self.logs[i] = log
+        self.procs[i] = subprocess.Popen(
+            [sys.executable, "-m", "tendermint_tpu.cmd",
+             "--home", self.home(i), "node"],
+            cwd=REPO_ROOT, env=self._env(), stdout=log, stderr=log,
+        )
+
+    def start_all(self) -> None:
+        for i in range(self.n):
+            self.start(i)
+
+    def kill(self, i: int, sig: int = signal.SIGKILL) -> None:
+        p = self.procs.get(i)
+        if p is not None:
+            p.send_signal(sig)
+            p.wait(timeout=30)
+            self.procs[i] = None
+
+    def kill_all(self) -> None:
+        for i in range(self.n):
+            if self.procs.get(i) is not None:
+                self.kill(i)
+
+    def stop(self) -> None:
+        for i in range(self.n):
+            p = self.procs.get(i)
+            if p is not None:
+                p.terminate()
+        for i in range(self.n):
+            p = self.procs.get(i)
+            if p is not None:
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait(timeout=10)
+                self.procs[i] = None
+        for log in self.logs.values():
+            log.close()
+        self.logs.clear()
+        if self._own_root:
+            shutil.rmtree(self.root, ignore_errors=True)
+
+    # -- queries --------------------------------------------------------------
+
+    def height(self, i: int, timeout: float = 2.0) -> int | None:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{self.rpc_port(i)}/status", timeout=timeout
+            ) as r:
+                st = json.loads(r.read())
+            return int(st["result"]["sync_info"]["latest_block_height"])
+        except Exception:  # noqa: BLE001 — booting/killed node: no height yet
+            return None
+
+    def wait_height(self, i: int, h: int, timeout: float = 180.0) -> int:
+        """Block until node i reports height >= h; returns the height."""
+        deadline = time.monotonic() + timeout
+        last = None
+        while time.monotonic() < deadline:
+            last = self.height(i)
+            if last is not None and last >= h:
+                return last
+            p = self.procs.get(i)
+            if p is not None and p.poll() is not None:
+                raise AssertionError(
+                    f"node{i} exited rc={p.returncode} before height {h}; "
+                    f"see {self.root}/node{i}.log"
+                )
+            time.sleep(0.5)
+        raise AssertionError(
+            f"node{i} stuck at height {last}, wanted {h} "
+            f"(see {self.root}/node{i}.log)"
+        )
+
+    def wait_all(self, h: int, timeout: float = 180.0) -> list[int]:
+        return [self.wait_height(i, h, timeout) for i in range(self.n)]
+
+
+# -- scenarios (reference test/p2p/{basic,fast_sync,kill_all}/test.sh) -------
+
+
+def scenario_basic(net: ProcTestnet) -> None:
+    """All nodes alive and in consensus: everyone reaches height 3."""
+    heights = net.wait_all(3)
+    assert max(heights) - min(heights) <= 2, f"nodes diverged: {heights}"
+    print(f"basic: all {net.n} nodes at heights {heights}")
+
+
+def scenario_fast_sync(net: ProcTestnet) -> None:
+    """Stop one node; the others keep committing (BFT with n-1 >= 2/3);
+    restart it; it fast-syncs back to the head."""
+    victim = net.n - 1
+    base = net.wait_height(0, 3)
+    net.kill(victim)
+    target = base + 3
+    for i in range(net.n - 1):
+        net.wait_height(i, target)
+    net.start(victim)
+    head = net.height(0) or target
+    got = net.wait_height(victim, head)
+    print(f"fast_sync: node{victim} killed at ~{base}, net advanced to "
+          f"{head}, node{victim} caught up to {got}")
+
+
+def scenario_kill_all(net: ProcTestnet) -> None:
+    """SIGKILL every node mid-consensus, restart, chain must resume —
+    WAL replay + handshake recovery on every node at once."""
+    net.wait_all(3)
+    heights = [net.height(i) or 3 for i in range(net.n)]
+    old_head = max(heights)
+    net.kill_all()
+    net.start_all()
+    net.wait_all(old_head + 2)
+    print(f"kill_all: restarted all {net.n} nodes from {old_head}, "
+          f"advanced past {old_head + 2}")
+
+
+SCENARIOS = {
+    "basic": scenario_basic,
+    "fast_sync": scenario_fast_sync,
+    "kill_all": scenario_kill_all,
+}
+
+
+def run(names=None, n: int = 4) -> None:
+    names = list(names or SCENARIOS)
+    for name in names:
+        net = ProcTestnet(n=n)
+        try:
+            net.generate()
+            net.start_all()
+            SCENARIOS[name](net)
+        finally:
+            net.stop()
+
+
+if __name__ == "__main__":
+    run(sys.argv[1:] or None)
+    print("proc testnet: all scenarios passed")

@@ -19,16 +19,36 @@ VALIDATOR_TX_PREFIX = b"val:"
 
 
 class KVStoreApplication(abci.BaseApplication):
-    def __init__(self) -> None:
+    """provable=True (default) roots the app hash in a merkle map of the
+    state so Query(prove=True) proofs chain to the verified header — a
+    feature the reference's kvstore lacks (its Query TODOs the proof out).
+    The map root costs O(state) tree folding per Commit; provable=False is
+    the reference-parity app (kvstore.go:111 — app hash is just the
+    encoded tx count, O(1)), the right mode for throughput benchmarking."""
+
+    def __init__(self, provable: bool = True) -> None:
+        self.provable = provable
         self.state: dict[str, bytes] = {}
         self.height = 0
         self.app_hash = b""
         self.tx_count = 0
+        # encoded leaf per key, maintained on writes: Commit re-folds the
+        # tree over cached leaves instead of re-encoding + re-sha-ing every
+        # value (the naive recompute was O(state) of redundant hashing per
+        # block and the single biggest cost of a loaded node's commit round)
+        self._leaves: dict[str, bytes] = {}
 
     # -- helpers ------------------------------------------------------------
 
+    def _leaf(self, key: str) -> bytes:
+        return Writer().str(key).bytes(sum_sha256(self.state[key])).build()
+
     def _compute_app_hash(self) -> bytes:
-        return merkle.hash_from_map({k: sum_sha256(v) for k, v in self.state.items()})
+        if not self.provable:
+            return self.tx_count.to_bytes(8, "big")
+        return merkle.hash_from_byte_slices(
+            [self._leaves[k] for k in sorted(self._leaves)]
+        )
 
     @staticmethod
     def _parse_tx(tx: bytes) -> tuple[str, bytes]:
@@ -54,6 +74,8 @@ class KVStoreApplication(abci.BaseApplication):
     def deliver_tx(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx:
         key, value = self._parse_tx(req.tx)
         self.state[key] = value
+        if self.provable:  # non-provable mode must not pay per-tx hashing
+            self._leaves[key] = self._leaf(key)
         self.tx_count += 1
         return abci.ResponseDeliverTx(
             code=abci.CODE_TYPE_OK,
@@ -78,11 +100,10 @@ class KVStoreApplication(abci.BaseApplication):
             height=self.height,
             log="exists" if value is not None else "does not exist",
         )
-        if req.prove and value is not None:
+        if req.prove and value is not None and self.provable:
             # merkle proof of (key, sha256(value)) in the sorted state map
-            items, keys = [], sorted(self.state)
-            for k in keys:
-                items.append(Writer().str(k).bytes(sum_sha256(self.state[k])).build())
+            keys = sorted(self._leaves)
+            items = [self._leaves[k] for k in keys]
             root, proofs = merkle.proofs_from_byte_slices(items)
             idx = keys.index(key)
             op = merkle.SimpleValueOp(req.data, proofs[idx])
@@ -108,6 +129,7 @@ class PersistentKVStoreApplication(KVStoreApplication):
             with open(self._db_path) as f:
                 d = json.load(f)
             self.state = {k: bytes.fromhex(v) for k, v in d["state"].items()}
+            self._leaves = {k: self._leaf(k) for k in self.state}
             self.height = d["height"]
             self.app_hash = bytes.fromhex(d["app_hash"])
             self.validators = d.get("validators", {})

@@ -49,6 +49,28 @@ def set_metrics_sink(fn) -> None:
     _metrics_sink = fn
 
 
+# Streaming-accumulation hint: how many queued signatures make a batch
+# worth flushing to the registered backend. The ops package registers a
+# probe-driven value (a multiple of the device routing threshold) when a
+# device is present; the default suits the CPU paths. Consumers: VoteStream
+# (types/vote_set.py) and any bulk-ingest loop that wants to batch.
+_accum_hint: Callable[[], int] | None = None
+
+
+def set_accumulation_hint(fn: Callable[[], int]) -> None:
+    global _accum_hint
+    _accum_hint = fn
+
+
+def accumulation_hint() -> int:
+    if _accum_hint is not None:
+        try:
+            return max(1, int(_accum_hint()))
+        except Exception:  # noqa: BLE001 — a failing probe must not break ingest
+            pass
+    return 2048
+
+
 class BatchVerifier:
     """Accumulate signatures, verify them all in grouped batches.
 

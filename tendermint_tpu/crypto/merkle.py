@@ -40,7 +40,24 @@ def _split_point(n: int) -> int:
 
 
 def hash_from_byte_slices(items: list[bytes]) -> bytes:
-    """Reference merkle.SimpleHashFromByteSlices (simple_tree.go)."""
+    """Reference merkle.SimpleHashFromByteSlices (simple_tree.go).
+
+    Trees of 8+ leaves run through the native C++ core (tm_merkle_root,
+    native/merkle.cpp — bit-exact, ~20x the Python recursion); smaller
+    trees stay in Python where the ctypes marshalling would dominate."""
+    n = len(items)
+    if n >= 8:
+        from tendermint_tpu.crypto import native
+
+        root = native.merkle_root(items)
+        if root is not None:
+            return root
+    return _py_hash_from_byte_slices(items)
+
+
+def _py_hash_from_byte_slices(items: list[bytes]) -> bytes:
+    """Pure-Python tree — the no-native fallback and the parity oracle the
+    native core is tested against."""
     n = len(items)
     if n == 0:
         return _hash(b"")
@@ -48,7 +65,7 @@ def hash_from_byte_slices(items: list[bytes]) -> bytes:
         return leaf_hash(items[0])
     k = _split_point(n)
     return inner_hash(
-        hash_from_byte_slices(items[:k]), hash_from_byte_slices(items[k:])
+        _py_hash_from_byte_slices(items[:k]), _py_hash_from_byte_slices(items[k:])
     )
 
 

@@ -72,6 +72,17 @@ def load():
         ]
         fn.restype = None
     try:
+        mr = lib.tm_merkle_root
+        mr.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8),   # concatenated items
+            ctypes.POINTER(ctypes.c_uint64),  # offsets (n+1)
+            ctypes.c_size_t,                  # n
+            ctypes.POINTER(ctypes.c_uint8),   # out (32)
+        ]
+        mr.restype = None
+    except AttributeError:
+        pass  # stale .so predating the merkle entry; Python path remains
+    try:
         prep = lib.tm_ed25519_prepare_batch
         prep.argtypes = [ctypes.POINTER(ctypes.c_uint8)] * 2 + [
             ctypes.POINTER(ctypes.c_uint64),
@@ -131,6 +142,34 @@ def secp256k1_verify_batch(pubs, msgs, sigs) -> list[bool]:
     if lib is None:
         raise RuntimeError("native library unavailable")
     return _run_batch(lib.tm_secp256k1_verify_batch, 33, pubs, msgs, sigs)
+
+
+def merkle_root(items) -> bytes | None:
+    """RFC-6962 tree root over byte slices via the C++ core; None when the
+    native library (or a fresh-enough build) is unavailable — callers fall
+    back to the Python tree (crypto/merkle.hash_from_byte_slices)."""
+    lib = load()
+    if lib is None or not hasattr(lib, "tm_merkle_root"):
+        return None
+    n = len(items)
+    offsets = (ctypes.c_uint64 * (n + 1))()
+    total = 0
+    for i, it in enumerate(items):
+        offsets[i] = total
+        total += len(it)
+    offsets[n] = total
+    flat = b"".join(items) or b"\x00"
+    out = (ctypes.c_uint8 * 32)()
+    lib.tm_merkle_root(
+        ctypes.cast(
+            ctypes.create_string_buffer(flat, len(flat)),
+            ctypes.POINTER(ctypes.c_uint8),
+        ),
+        offsets,
+        n,
+        out,
+    )
+    return bytes(out)
 
 
 def ed25519_prepare_device_inputs(pubs, msgs, sigs, padded: int):

@@ -30,7 +30,11 @@ class Group:
 
     def flush_sync(self) -> None:
         self._head.flush()
-        os.fsync(self._head.fileno())
+        # fdatasync: data + the metadata needed to read it (file size) hit
+        # the disk; skipping the mtime/atime journal write measurably cuts
+        # the per-height WAL barrier cost (the commit round pays ~5 of
+        # these, profiled at 8ms each as full fsync on a slow disk)
+        os.fdatasync(self._head.fileno())
 
     def maybe_rotate(self) -> None:
         """Rotate head to the next numbered chunk if over the size limit."""

@@ -41,6 +41,13 @@ def effective_min_batch() -> int:
     cost), clamped to [MIN_DEVICE_BATCH, 4096] — a 65ms link yields ~540,
     a local chip stays at the floor. TMTPU_MIN_DEVICE_BATCH always wins
     when set.
+
+    With NO accelerator (jax backend == cpu) the "device" kernel is the
+    XLA:CPU lowering of the limb-arithmetic Straus loop — measured ~30x
+    SLOWER per signature than the serial OpenSSL path on a 1-vCPU host
+    (it exists for testing, not speed) — so routing returns never-device
+    and every batch takes the native/serial CPU paths, mirroring the
+    reference's nocgo build (crypto/secp256k1/secp256k1_nocgo.go:21).
     """
     global _min_batch_probed
     if "TMTPU_MIN_DEVICE_BATCH" in os.environ:
@@ -55,6 +62,7 @@ def effective_min_batch() -> int:
         import numpy as np
 
         if jax.default_backend() == "cpu":
+            _min_batch_probed = 1 << 30  # no accelerator: CPU paths win
             return _min_batch_probed
         dev = jax.devices()[0]
         f = jax.jit(lambda x: x + 1)
@@ -192,6 +200,20 @@ def _secp256k1_backend(pubs, msgs, sigs):
     return secp_batch.verify_batch(pubs, msgs, sigs)
 
 
+def _accumulation_hint() -> int:
+    """Streaming flush point: far enough past the routing threshold that a
+    flush amortizes its launch over several thresholds' worth of work (a
+    sub-threshold flush would serialize behind the dispatch floor), floor
+    2048 so CPU/local hosts still batch big enough to beat per-call
+    overhead. The never-device sentinel (no accelerator) must NOT leak
+    into the hint — with no launch to amortize, the floor is the right
+    flush point and auto-flush must keep working."""
+    t = effective_min_batch()
+    if t >= 1 << 30:
+        return 2048
+    return max(8 * t, 2048)
+
+
 def register() -> bool:
     """Register device-backed batch verification. Returns True if enabled."""
     if os.environ.get("TMTPU_NO_ACCEL"):
@@ -200,6 +222,7 @@ def register() -> bool:
 
     batch.register_backend("ed25519", _ed25519_backend)
     batch.register_backend("secp256k1", _secp256k1_backend)
+    batch.set_accumulation_hint(_accumulation_hint)
     return True
 
 

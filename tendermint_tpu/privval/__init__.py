@@ -32,12 +32,15 @@ class DoubleSignError(Exception):
 
 
 def _atomic_write(path: str, data: bytes) -> None:
-    """Write + fsync + rename so the file is never half-written."""
+    """Write + sync + rename so the file is never half-written. fdatasync
+    (data + size metadata — everything needed to read it back) rather than
+    full fsync: the last-sign state is written 3x per height on the sign
+    path, and the timestamp journal write is pure overhead there."""
     d = os.path.dirname(path) or "."
     fd, tmp = tempfile.mkstemp(dir=d, prefix=".tmp-privval-")
     try:
         os.write(fd, data)
-        os.fsync(fd)
+        os.fdatasync(fd)
     finally:
         os.close(fd)
     os.replace(tmp, path)

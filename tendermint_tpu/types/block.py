@@ -242,9 +242,15 @@ class Data:
     """Block transaction payload (reference types/block.go Data)."""
 
     txs: list[Tx] = field(default_factory=list)
+    _hash: bytes | None = field(default=None, repr=False, compare=False)
 
     def hash(self) -> bytes:
-        return txs_hash(self.txs)
+        # memoized: the txs root is re-read by validation, header checks
+        # and event serving several times per block, and txs never mutate
+        # after block construction
+        if self._hash is None:
+            self._hash = txs_hash(self.txs)
+        return self._hash
 
     def encode(self) -> bytes:
         w = Writer().u32(len(self.txs))

@@ -195,3 +195,42 @@ class TestNativePrepareBatch:
         expected = [True] * 16
         expected[4] = expected[6] = False
         assert eb.verify_batch(pubs, msgs, sigs) == expected
+
+
+class TestNativeMerkle:
+    """native/merkle.cpp parity with the Python tree — the oracle contract
+    stated in crypto/merkle.hash_from_byte_slices. Everything >= 8 leaves
+    (tx roots, app hashes) routes native, so a split/offset bug there
+    would desync Query proof roots from committed app hashes."""
+
+    def test_root_parity_with_python_oracle(self):
+        import random
+
+        from tendermint_tpu.crypto import merkle, native
+
+        if native.load() is None or not hasattr(native.load(), "tm_merkle_root"):
+            import pytest
+
+            pytest.skip("native library unavailable")
+        rnd = random.Random(20260730)
+        for n in (0, 1, 2, 3, 5, 7, 8, 9, 16, 31, 64, 100, 513, 2000):
+            items = [
+                rnd.randbytes(rnd.randrange(0, 128)) for _ in range(n)
+            ]
+            assert native.merkle_root(items) == merkle._py_hash_from_byte_slices(
+                items
+            ), f"native/python root mismatch at n={n}"
+            # the public entry must agree with the oracle on BOTH sides of
+            # the native cutoff
+            assert merkle.hash_from_byte_slices(items) == (
+                merkle._py_hash_from_byte_slices(items)
+            )
+
+    def test_proofs_chain_to_native_root(self):
+        from tendermint_tpu.crypto import merkle
+
+        items = [b"item-%d" % i for i in range(23)]
+        root, proofs = merkle.proofs_from_byte_slices(items)
+        assert root == merkle.hash_from_byte_slices(items)
+        for i, p in enumerate(proofs):
+            p.verify(root, items[i])

@@ -16,7 +16,9 @@ from tendermint_tpu.types.genesis import GenesisValidator
 CHAIN_ID = "node-rpc-test-chain"
 
 
-def make_node(root: str, pv=None, genesis=None, persistent_peers: str = "") -> Node:
+def make_node(
+    root: str, pv=None, genesis=None, persistent_peers: str = "", app=None
+) -> Node:
     cfg = make_test_config(root)
     cfg.base.chain_id = CHAIN_ID
     cfg.rpc.laddr = "tcp://127.0.0.1:0"
@@ -33,7 +35,7 @@ def make_node(root: str, pv=None, genesis=None, persistent_peers: str = "") -> N
             genesis_time=1_700_000_000_000_000_000,
             validators=[GenesisValidator(pv.get_pub_key(), 10)],
         )
-    return Node(cfg, genesis_doc=genesis, priv_validator=pv)
+    return Node(cfg, genesis_doc=genesis, priv_validator=pv, app=app)
 
 
 class TestSingleNodeRPC:

