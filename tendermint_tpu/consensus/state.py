@@ -256,10 +256,16 @@ class ConsensusState(BaseService):
         already queued; if it contains 2+ votes, wait one short deadline
         (config.vote_batch_window) for the rest of the burst to land, then
         process — consecutive votes for the same (H, R, type) go through ONE
-        `VoteSet.add_votes` signature batch. A singleton vote takes the
-        serial path immediately, so small-validator-count latency does not
-        regress. Replaces the reference's strictly per-vote serial verify
-        (types/vote_set.go:189)."""
+        `VoteSet.add_votes` signature batch. While votes KEEP ARRIVING and
+        the batch is still under the signature backend's accumulation hint,
+        the wait extends window-by-window up to vote_batch_max_window, so a
+        large-validator-set vote storm accumulates past the device routing
+        threshold instead of serializing as sub-threshold windows (the same
+        accumulate-to-hint policy as types.VoteStream). A singleton vote
+        takes the serial path immediately and an idle queue stops the
+        accumulation after one empty window, so small-validator-count
+        latency does not regress. Replaces the reference's strictly
+        per-vote serial verify (types/vote_set.go:189)."""
         batch = [first]
         self._drain_peer_queue(batch)
         window = self.config.vote_batch_window
@@ -268,8 +274,24 @@ class ConsensusState(BaseService):
             and len(batch) > 1
             and sum(isinstance(mi.msg, m.VoteMessage) for mi in batch) > 1
         ):
-            await asyncio.sleep(window)
-            self._drain_peer_queue(batch)
+            from tendermint_tpu.crypto import batch as _cb
+
+            hint = _cb.accumulation_hint()
+            cap = self.config.vote_batch_cap
+            deadline = (
+                asyncio.get_event_loop().time()
+                + max(self.config.vote_batch_max_window, window)
+            )
+            while True:
+                before = len(batch)
+                await asyncio.sleep(window)
+                self._drain_peer_queue(batch)
+                if (
+                    len(batch) == before  # queue went idle
+                    or len(batch) >= min(hint, cap)
+                    or asyncio.get_event_loop().time() >= deadline
+                ):
+                    break
         # WAL order = arrival order, written before any processing (:630)
         for mi in batch:
             self.wal.write(mi)

@@ -1,0 +1,58 @@
+"""Remote-deploy harness: the local (no-ssh) half — inventory parsing and
+the testnet-config rewrite for a remote topology (reference
+networks/remote/ ansible config playbook). The ssh/rsync half is exercised
+against stubs (no remote hosts in CI)."""
+import json
+import os
+import subprocess
+
+from networks.remote import deploy
+
+
+def test_inventory_parse(tmp_path):
+    p = tmp_path / "hosts.txt"
+    p.write_text("# comment\n\nalice@10.0.0.1\nbob@10.0.0.2\n")
+    hosts = deploy.read_inventory(str(p))
+    assert hosts == ["alice@10.0.0.1", "bob@10.0.0.2"]
+    assert deploy._bare_host(hosts[0]) == "10.0.0.1"
+    assert deploy._bare_host("just-a-host") == "just-a-host"
+
+
+def test_init_rewrites_configs_for_remote_topology(tmp_path, monkeypatch):
+    hosts = ["alice@10.0.0.1", "bob@10.0.0.2"]
+    pushed = []
+    orig_run = subprocess.run
+
+    def fake_run(cmd, **kw):
+        if cmd[0] in ("rsync", "ssh"):
+            pushed.append(tuple(cmd[:1]))
+
+            class R:
+                returncode = 0
+                stdout = ""
+                stderr = ""
+
+            return R()
+        return orig_run(cmd, **kw)
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setattr(deploy, "ssh", lambda *a, **k: None)
+    build = str(tmp_path / "build")
+    deploy.cmd_init(hosts, build)
+
+    for i, host in enumerate(hosts):
+        with open(
+            os.path.join(build, f"node{i}", "config", "config.json"),
+            encoding="utf-8",
+        ) as f:
+            cfg = json.load(f)
+        assert cfg["p2p"]["laddr"] == f"tcp://0.0.0.0:{deploy.P2P_PORT}"
+        assert cfg["rpc"]["laddr"] == f"tcp://0.0.0.0:{deploy.RPC_PORT}"
+        peers = cfg["p2p"]["persistent_peers"].split(",")
+        assert len(peers) == 2
+        for p, h in zip(peers, hosts):
+            node_id, addr = p.split("@", 1)
+            assert len(node_id) == 40  # hex address of the node key
+            assert addr == f"{deploy._bare_host(h)}:{deploy.P2P_PORT}"
+    # one code push + one config push per host
+    assert pushed.count(("rsync",)) == 2 * len(hosts)

