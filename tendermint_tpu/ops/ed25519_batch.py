@@ -528,20 +528,39 @@ def verify_batch(pubs, msgs, sigs) -> list[bool]:
         pending.append(
             (lo, hi, dev_out, (keys_np, sigs_np), mask, from_sharded)
         )
-    for lo, hi, dev_out, blocks, mask, from_sharded in pending:
+    def fetch(d):
         try:
-            ok = np.asarray(dev_out)[: hi - lo]
-        except Exception:  # noqa: BLE001 — async dispatch surfaces kernel
-            # runtime failures at fetch time; same degradation contract.
-            # A sharded-path failure may be a mesh/transfer problem rather
-            # than a kernel defect, so it degrades to the single-device XLA
-            # kernel even when XLA is the platform kernel ('degrade, never
-            # break verification'); only a single-device XLA failure — a
-            # genuine kernel defect — re-raises.
+            return np.asarray(d)
+        except Exception as e:  # noqa: BLE001 — handled at apply time on
+            # the main thread (the degrade path may compile)
+            return e
+
+    if len(pending) > 1:
+        # fetch all chunks' verdict arrays CONCURRENTLY: each fetch is a
+        # full RPC round trip on a tunneled device (~65 ms), and a ready
+        # result's transfer doesn't need the (serialized) execute queue —
+        # threads collapse K round trips toward one.
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=min(8, len(pending))) as ex:
+            fetched = list(ex.map(fetch, [p[2] for p in pending]))
+    else:
+        fetched = [fetch(p[2]) for p in pending]
+    for (lo, hi, _, blocks, mask, from_sharded), got in zip(pending, fetched):
+        if isinstance(got, Exception):
+            # async dispatch surfaces kernel runtime failures at fetch
+            # time; same degradation contract. A sharded-path failure may
+            # be a mesh/transfer problem rather than a kernel defect, so
+            # it degrades to the single-device XLA kernel even when XLA is
+            # the platform kernel ('degrade, never break verification');
+            # only a single-device XLA failure — a genuine kernel defect —
+            # re-raises.
             if not from_sharded and (
                 kcache._kernel_for(kcache._platform())[0] == "xla"
             ):
-                raise
+                raise got
             ok = np.asarray(verify_kernel(*blocks))[: hi - lo]
+        else:
+            ok = got[: hi - lo]
         out[lo:hi] = ok & mask
     return out.tolist()
