@@ -11,9 +11,11 @@ genesis distribution, CLI flag handling, p2p dialing, WAL recovery and
 fast sync are all exercised exactly as a deployment would.
 
 Scenarios:
-  basic     — N nodes, all reach height >= 3 and stay within 1 height.
-  fast_sync — stop one node; the rest advance; restart it; it catches up.
-  kill_all  — SIGKILL every node; restart; chain resumes past the old head.
+  basic            — N nodes, all reach height >= 3 and stay within 1 height.
+  fast_sync        — stop one node; the rest advance; restart it; it catches up.
+  kill_all         — SIGKILL every node; restart; chain resumes past the old head.
+  atomic_broadcast — a tx sent to one node commits and is queryable on ALL.
+  pex              — a node given only ONE peer discovers the rest via PEX.
 
 Usage:
   python -m networks.local.proc_testnet            # all scenarios, n=4
@@ -140,15 +142,32 @@ class ProcTestnet:
 
     # -- queries --------------------------------------------------------------
 
-    def height(self, i: int, timeout: float = 2.0) -> int | None:
+    def rpc(self, i: int, path: str, timeout: float = 3.0) -> dict | None:
+        """Result dict, or None (booting/killed node, or an RPC error —
+        errors are printed so a failing scenario names the real cause
+        instead of an undiagnosable None)."""
         try:
             with urllib.request.urlopen(
-                f"http://127.0.0.1:{self.rpc_port(i)}/status", timeout=timeout
+                f"http://127.0.0.1:{self.rpc_port(i)}/{path}", timeout=timeout
             ) as r:
-                st = json.loads(r.read())
-            return int(st["result"]["sync_info"]["latest_block_height"])
-        except Exception:  # noqa: BLE001 — booting/killed node: no height yet
+                body = json.loads(r.read())
+        except (OSError, ValueError):  # conn refused/timeout/bad body
             return None
+        if "result" not in body:
+            print(f"node{i} rpc {path.split('?')[0]} error: "
+                  f"{body.get('error')}", file=sys.stderr)
+            return None
+        return body["result"]
+
+    def height(self, i: int, timeout: float = 2.0) -> int | None:
+        st = self.rpc(i, "status", timeout)
+        if st is None:
+            return None
+        return int(st["sync_info"]["latest_block_height"])
+
+    def n_peers(self, i: int) -> int:
+        ni = self.rpc(i, "net_info")
+        return int(ni["n_peers"]) if ni else 0
 
     def wait_height(self, i: int, h: int, timeout: float = 180.0) -> int:
         """Block until node i reports height >= h; returns the height."""
@@ -213,10 +232,77 @@ def scenario_kill_all(net: ProcTestnet) -> None:
           f"advanced past {old_head + 2}")
 
 
+def scenario_atomic_broadcast(net: ProcTestnet) -> None:
+    """A tx submitted to one node is committed and queryable on every
+    node (reference test/p2p/atomic_broadcast): mempool gossip + consensus
+    + ABCI delivery end to end."""
+    net.wait_all(2)
+    key, value = f"ab{os.getpid()}", "committed"
+    # 0x pins the value as hex for the URI transport (digit-only hex
+    # would otherwise coerce to int)
+    tx = "0x" + f"{key}={value}".encode().hex()
+    res = net.rpc(0, f"broadcast_tx_commit?tx={tx}", timeout=30.0)
+    assert res is not None and res.get("deliver_tx", {}).get("code", 1) == 0, res
+    q = "0x" + key.encode().hex()
+    deadline = time.monotonic() + 60
+    missing = set(range(net.n))
+    while missing and time.monotonic() < deadline:
+        for i in sorted(missing):
+            r = net.rpc(i, f"abci_query?data={q}")
+            if r and r["response"].get("value"):
+                missing.discard(i)
+        time.sleep(0.5)
+    assert not missing, f"tx not visible on nodes {sorted(missing)}"
+    print(f"atomic_broadcast: tx committed at height "
+          f"{res['height']}, visible on all {net.n} nodes")
+
+
+def scenario_pex(net: ProcTestnet) -> None:
+    """Peer discovery strictly via PEX (reference test/p2p/pex). The
+    topology is rewritten BEFORE any node starts, on fresh address books:
+    the loner's persistent_peers is ONLY node0, and every other node's
+    list excludes the loner — so no config-driven dial can ever connect
+    the loner to node1..n-2. Any peer beyond node0 exists only because
+    addresses propagated through peer exchange (the loner learning others
+    from node0's addrbook, or others learning the loner)."""
+    assert not any(net.procs.values()), "pex scenario owns node startup"
+    loner = net.n - 1
+    for i in range(net.n):
+        cfg_path = os.path.join(net.home(i), "config", "config.json")
+        with open(cfg_path, encoding="utf-8") as f:
+            cfg = json.load(f)
+        peers = cfg["p2p"]["persistent_peers"].split(",")
+        if i == loner:
+            cfg["p2p"]["persistent_peers"] = peers[0]  # node0 only
+        else:
+            cfg["p2p"]["persistent_peers"] = ",".join(peers[:loner])
+        with open(cfg_path, "w", encoding="utf-8") as f:
+            json.dump(cfg, f, indent=1, sort_keys=True)
+    net.start_all()
+    deadline = time.monotonic() + 150
+    peers_n = 0
+    while time.monotonic() < deadline:
+        peers_n = net.n_peers(loner)
+        if peers_n >= net.n - 1:
+            break
+        time.sleep(1)
+    assert peers_n >= 2, (
+        f"node{loner} only reached {peers_n} peers with 1 configured and "
+        f"no other config path to it — PEX discovery failed "
+        f"(see {net.root}/node{loner}.log)"
+    )
+    net.wait_height(loner, 3)
+    print(f"pex: node{loner} reached {peers_n} peers from 1 configured")
+
+
+scenario_pex.self_start = True  # rewrites configs before any node starts
+
 SCENARIOS = {
     "basic": scenario_basic,
     "fast_sync": scenario_fast_sync,
     "kill_all": scenario_kill_all,
+    "atomic_broadcast": scenario_atomic_broadcast,
+    "pex": scenario_pex,
 }
 
 
@@ -226,7 +312,8 @@ def run(names=None, n: int = 4) -> None:
         net = ProcTestnet(n=n)
         try:
             net.generate()
-            net.start_all()
+            if not getattr(SCENARIOS[name], "self_start", False):
+                net.start_all()
             SCENARIOS[name](net)
         finally:
             net.stop()

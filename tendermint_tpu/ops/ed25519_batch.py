@@ -422,6 +422,19 @@ class _DeviceKeyCache:
 
 _dev_keys = _DeviceKeyCache()
 
+_fetch_executor = None  # shared verdict-fetch pool, created on first use
+
+
+def _fetch_pool():
+    global _fetch_executor
+    if _fetch_executor is None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        _fetch_executor = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="tmtpu-fetch"
+        )
+    return _fetch_executor
+
 # Multi-device dispatch: when more than one device is visible (a real TPU
 # slice, or the test suite's 8-virtual-CPU mesh) every chunk is
 # batch-sharded across the mesh via shard_map instead of running on one
@@ -539,11 +552,11 @@ def verify_batch(pubs, msgs, sigs) -> list[bool]:
         # fetch all chunks' verdict arrays CONCURRENTLY: each fetch is a
         # full RPC round trip on a tunneled device (~65 ms), and a ready
         # result's transfer doesn't need the (serialized) execute queue —
-        # threads collapse K round trips toward one.
-        from concurrent.futures import ThreadPoolExecutor
-
-        with ThreadPoolExecutor(max_workers=min(8, len(pending))) as ex:
-            fetched = list(ex.map(fetch, [p[2] for p in pending]))
+        # threads collapse K round trips toward one. The executor is
+        # module-shared: verify_batch is the per-commit hot path and
+        # per-call thread spawn/teardown would cost more than the
+        # serialization it saves on a local (microsecond-fetch) device.
+        fetched = list(_fetch_pool().map(fetch, [p[2] for p in pending]))
     else:
         fetched = [fetch(p[2]) for p in pending]
     for (lo, hi, _, blocks, mask, from_sharded), got in zip(pending, fetched):

@@ -217,7 +217,11 @@ class JSONRPCServer(BaseService):
 
     async def _dispatch_uri(self, ctx: ConnContext, target: str):
         """GET /method?param=value — the reference's URI transport. Values
-        arrive as strings; handlers accept them (ints are coerced)."""
+        arrive as strings; handlers accept them (ints are coerced). A
+        `0x` prefix pins a value as a hex STRING (the reference's raw-
+        bytes convention, rpc/lib/server/handlers.go) — without it, a
+        digit-only hex value like 61623136 would be coerced to int and
+        rejected by byte-taking handlers."""
         parsed = urllib.parse.urlparse(target)
         method = parsed.path.lstrip("/")
         if not method:
@@ -225,7 +229,9 @@ class JSONRPCServer(BaseService):
         params = {}
         for k, vs in urllib.parse.parse_qs(parsed.query).items():
             v = vs[0]
-            if v.isdigit() or (v.startswith("-") and v[1:].isdigit()):
+            if v.startswith("0x"):
+                params[k] = v[2:]
+            elif v.isdigit() or (v.startswith("-") and v[1:].isdigit()):
                 params[k] = int(v)
             elif v in ("true", "false"):
                 params[k] = v == "true"

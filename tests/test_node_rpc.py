@@ -98,6 +98,30 @@ class TestSingleNodeRPC:
                 aq = await client.call("abci_query", data=b"rpc-key".hex())
                 assert bytes.fromhex(aq["response"]["value"]) == b"rpc-value"
 
+                # URI (GET) transport: a 0x prefix pins digit-only hex as
+                # a hex string (b"1234" -> "31323334" would otherwise be
+                # coerced to int and rejected by _unhex)
+                r2 = await client.call(
+                    "broadcast_tx_commit", tx=b"1234=uri-value".hex()
+                )
+                assert r2["deliver_tx"]["code"] == 0
+                import json as _json
+
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", node.rpc_port
+                )
+                writer.write(
+                    b"GET /abci_query?data=0x" + b"1234".hex().encode()
+                    + b" HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n"
+                )
+                await writer.drain()
+                raw = await reader.read()
+                writer.close()
+                await writer.wait_closed()
+                body = _json.loads(raw.split(b"\r\n\r\n", 1)[1])
+                got = bytes.fromhex(body["result"]["response"]["value"])
+                assert got == b"uri-value"
+
                 # the kv indexer saw it
                 found = await client.call("tx", hash=res["hash"])
                 assert bytes.fromhex(found["tx"]) == tx

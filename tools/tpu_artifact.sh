@@ -1,0 +1,29 @@
+#!/bin/bash
+# Produce the real-TPU correctness artifact (r2 VERDICT next #4):
+# device-gated kernel parity tests + the XLA-vs-Pallas kernel comparison,
+# logged to TPUTEST_r<N>.log for the judge. Run only with a live tunnel
+# (probe first: timeout 90 python -c 'import jax; print(jax.devices())').
+#
+# Usage: bash tools/tpu_artifact.sh [round]   (default round: 03)
+set -u
+cd "$(dirname "$0")/.."
+ROUND="${1:-03}"
+LOG="TPUTEST_r${ROUND}.log"
+
+{
+  echo "== TPU correctness artifact, round ${ROUND} =="
+  date -u +"%Y-%m-%dT%H:%M:%SZ"
+  python - <<'EOF'
+import jax
+d = jax.devices()[0]
+print(f"device: {d.platform} ({d.device_kind})")
+EOF
+  echo
+  echo "== device-gated kernel parity tests (TMTPU_TPU_TESTS=1) =="
+  TMTPU_TPU_TESTS=1 python -m pytest tests/test_ops_verify.py tests/test_ops_secp.py -v 2>&1 | tail -40
+  echo "pytest rc=$?"
+  echo
+  echo "== XLA vs Pallas kernel comparison on device =="
+  python benchmarks/kernel_compare.py 1024 10240 2>&1 | tail -30
+  echo "kernel_compare rc=$?"
+} | tee "$LOG"
