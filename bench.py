@@ -38,10 +38,12 @@ import numpy as np
 
 N_COMMIT = 10_000         # validators in the north-star commit
 N_UNIQUE = 512            # unique keypairs; messages differ per commit
-PIPELINE_K = 32           # back-to-back commits for the throughput number:
-# 320k signatures span three MAX_BUCKET chunks, so the stream actually
+PIPELINE_K = 39           # back-to-back commits for the throughput number:
+# 390k signatures span three MAX_BUCKET chunks, so the stream actually
 # exercises the prep/execute overlap (8 commits fit one launch and
-# serialize prep in front of it)
+# serialize prep in front of it). 39 is chosen so the REMAINDER chunk
+# (127,856 lanes) pads to the same 131072 bucket as the full chunks —
+# one compiled variant, half the cold-compile exposure on a fresh host.
 
 if os.environ.get("TMTPU_BENCH_SMOKE"):
     # logic smoke test on CPU (the full shapes take minutes of XLA:CPU
