@@ -205,18 +205,26 @@ def scenario_basic(net: ProcTestnet) -> None:
 
 def scenario_fast_sync(net: ProcTestnet) -> None:
     """Stop one node; the others keep committing (BFT with n-1 >= 2/3);
-    restart it; it fast-syncs back to the head."""
+    restart it; it fast-syncs back to the head. The restart flips the
+    victim to the v1 FSM reactor (config fast_sync.version), so one
+    scenario exercises both sync implementations against live peers."""
     victim = net.n - 1
     base = net.wait_height(0, 3)
     net.kill(victim)
     target = base + 3
     for i in range(net.n - 1):
         net.wait_height(i, target)
+    cfg_path = os.path.join(net.home(victim), "config", "config.json")
+    with open(cfg_path, encoding="utf-8") as f:
+        cfg = json.load(f)
+    cfg["fast_sync"]["version"] = "v1"
+    with open(cfg_path, "w", encoding="utf-8") as f:
+        json.dump(cfg, f, indent=1, sort_keys=True)
     net.start(victim)
     head = net.height(0) or target
     got = net.wait_height(victim, head)
     print(f"fast_sync: node{victim} killed at ~{base}, net advanced to "
-          f"{head}, node{victim} caught up to {got}")
+          f"{head}, node{victim} caught up to {got} via the v1 reactor")
 
 
 def scenario_kill_all(net: ProcTestnet) -> None:
