@@ -59,6 +59,15 @@ class BlockID:
 ZERO_BLOCK_ID = BlockID()
 
 
+# Template cache for canonical_vote_sign_bytes: within one batch (a
+# VoteSet burst, a commit's precommits, a light-client span) every vote's
+# sign-bytes differ ONLY by timestamp — the u64 sits between a fixed
+# (type, height, round, block_id) prefix and a fixed chain-id suffix, so
+# the encode collapses to one bytes concat (~20x the full Writer path;
+# sign-bytes encoding was ~25% of the streamed-ingest host time).
+_SB_TMPL: dict[tuple, tuple[bytes, bytes]] = {}
+
+
 def canonical_vote_sign_bytes(
     chain_id: str,
     vote_type: int,
@@ -69,12 +78,20 @@ def canonical_vote_sign_bytes(
 ) -> bytes:
     """The deterministic byte string validators sign (reference
     types/canonical.go CanonicalizeVote). Field order is fixed and
-    documented; chain_id is included to prevent cross-chain replay."""
-    w = Writer().u8(vote_type).u64(height).u32(round_)
-    block_id.encode_into(w)
-    w.u64(timestamp_ns)
-    w.str(chain_id)
-    return w.build()
+    documented; chain_id is included to prevent cross-chain replay.
+    Layout: u8(type) u64(height) u32(round) BlockID u64(timestamp_ns)
+    str(chain_id) — see docs/encoding.md (consensus-critical)."""
+    key = (chain_id, vote_type, height, round_, block_id.key())
+    tmpl = _SB_TMPL.get(key)
+    if tmpl is None:
+        w = Writer().u8(vote_type).u64(height).u32(round_)
+        block_id.encode_into(w)
+        if len(_SB_TMPL) >= 1024:  # bounded; entries are cheap to rebuild
+            _SB_TMPL.clear()
+        tmpl = (w.build(), Writer().str(chain_id).build())
+        _SB_TMPL[key] = tmpl
+    prefix, suffix = tmpl
+    return prefix + timestamp_ns.to_bytes(8, "big") + suffix
 
 
 def canonical_proposal_sign_bytes(

@@ -86,6 +86,38 @@ class TestVote:
         v2 = Vote.decode(vote.encode())
         assert v2 == vote
 
+    def test_sign_bytes_template_matches_direct_encode(self):
+        """The template-cached encode (prefix + u64(ts) + suffix) must be
+        byte-identical to a from-scratch Writer construction of the
+        documented layout — sign-bytes are consensus-critical."""
+        import random
+
+        from tendermint_tpu.encoding import Writer
+        from tendermint_tpu.types.vote import (
+            BlockID,
+            PartSetHeader,
+            canonical_vote_sign_bytes,
+        )
+
+        rnd = random.Random(20260730)
+        for _ in range(200):
+            cid = f"chain-{rnd.randrange(50)}"
+            vt = rnd.choice([1, 2])
+            h = rnd.randrange(1, 2**40)
+            r = rnd.randrange(0, 1000)
+            bid = BlockID(
+                rnd.randbytes(rnd.choice([0, 32])),
+                PartSetHeader(
+                    rnd.randrange(0, 100), rnd.randbytes(rnd.choice([0, 32]))
+                ),
+            )
+            ts = rnd.randrange(0, 2**63)
+            w = Writer().u8(vt).u64(h).u32(r)
+            bid.encode_into(w)
+            w.u64(ts)
+            w.str(cid)
+            assert canonical_vote_sign_bytes(cid, vt, h, r, bid, ts) == w.build()
+
     def test_sign_bytes_deterministic_and_distinct(self):
         bid = rand_block_id()
         v = Vote(VoteType.PREVOTE, 1, 0, bid, 42, b"\x01" * 20, 0)
