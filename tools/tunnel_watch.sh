@@ -1,0 +1,54 @@
+#!/bin/bash
+# Unattended TPU measurement pipeline: poll for the tunnel; the moment a
+# device answers, run the full round-3 measurement sequence and log
+# everything. Decouples measurement from operator attention — a brief
+# tunnel window still yields the bench number, the TPU correctness
+# artifact and the baseline table.
+#
+# Usage: nohup bash tools/tunnel_watch.sh &   (logs under tunnel_watch/)
+set -u
+cd "$(dirname "$0")/.."
+OUT=tunnel_watch
+mkdir -p "$OUT"
+log() { echo "[$(date -u +%H:%M:%S)] $*" | tee -a "$OUT/watch.log"; }
+
+probe() {
+    timeout 90 python -c "import jax; print(jax.devices())" >/dev/null 2>&1
+}
+
+log "watch started"
+while true; do
+    if probe; then
+        log "TUNNEL UP — starting measurement sequence"
+        # 1. warm the kernel caches for the bench bucket so the headline
+        #    run (and the driver's later run) hits warm compiles
+        log "prewarm (cold compile ~2-4 min on a fresh cache)"
+        timeout 900 python - >"$OUT/prewarm.log" 2>&1 <<'EOF'
+from tendermint_tpu.ops import kcache
+kcache.enable_persistent_cache()
+kcache.suppress_background_warm()
+kcache.prewarm([131072], background=False)
+print("prewarm done")
+EOF
+        log "prewarm rc=$?"
+        # 2. the headline bench (twice: first may still pay residual
+        #    warmup; the second is the steady-state number)
+        for i in 1 2; do
+            timeout 1800 python bench.py \
+                >"$OUT/bench_$i.json" 2>"$OUT/bench_$i.log"
+            log "bench run $i rc=$? -> $(cat "$OUT/bench_$i.json" 2>/dev/null)"
+        done
+        # 3. the real-TPU correctness artifact
+        timeout 2700 bash tools/tpu_artifact.sh 03 >"$OUT/artifact.log" 2>&1
+        log "tpu_artifact rc=$? (TPUTEST_r03.log written)"
+        # 4. baseline configs over the tunnel (1=anchor 2=commit
+        #    3=validate_block 5=streamed voteset; 4 is slow to build)
+        timeout 2700 python -m benchmarks.baseline_configs 1 2 3 5 \
+            >"$OUT/baseline.log" 2>&1
+        log "baseline_configs rc=$?"
+        log "sequence complete — logs in $OUT/"
+        exit 0
+    fi
+    log "tunnel still down"
+    sleep 120
+done
