@@ -43,7 +43,7 @@ import numpy as np
 
 from tendermint_tpu.crypto import ed25519_math as em
 from tendermint_tpu.ops import curve, field
-from tendermint_tpu.ops.limbs import LIMB_BITS, LIMB_MASK, NLIMB
+from tendermint_tpu.ops.limbs import LIMB_BITS, NLIMB
 
 NBITS = 253   # scalars are < L < 2^253
 NDIGITS = 127  # 2-bit digits (bit 253 is always 0)
@@ -65,28 +65,32 @@ SIG_ROWS = 25   # s, h, yr planes + parity row
 # ---------------------------------------------------------------- device side
 
 
-def words_to_limbs(w):
-    """(8, B) uint32 words -> (22, B) int32 12-bit limbs (static shifts)."""
+def _extract_chunks(w, width: int, count: int):
+    """(8, B) uint32 words -> (count, B) int32 little-endian `width`-bit
+    chunks (static shifts; chunks may straddle 32-bit word boundaries).
+    The one extractor behind limb (12-bit), radix-4 digit (2-bit) and
+    radix-8 digit (3-bit) decompositions."""
     w = w.astype(jnp.uint32)
-    limbs = []
-    for k in range(NLIMB):
-        lo_bit = LIMB_BITS * k
-        a, s = lo_bit // 32, lo_bit % 32
+    mask = (1 << width) - 1
+    out = []
+    for k in range(count):
+        p = width * k
+        a, s = p // 32, p % 32
         v = w[a] >> s
-        if s > 32 - LIMB_BITS and a + 1 < NWORDS:
+        if s > 32 - width and a + 1 < NWORDS:
             v = v | (w[a + 1] << (32 - s))
-        limbs.append((v & LIMB_MASK).astype(jnp.int32))
-    return jnp.stack(limbs)
+        out.append((v & mask).astype(jnp.int32))
+    return jnp.stack(out)
+
+
+def words_to_limbs(w):
+    """(8, B) uint32 words -> (22, B) int32 12-bit limbs."""
+    return _extract_chunks(w, LIMB_BITS, NLIMB)
 
 
 def words_to_digits(w):
     """(8, B) uint32 words -> (127, B) int32 2-bit digits, little-endian."""
-    w = w.astype(jnp.uint32)
-    digits = [
-        ((w[i // 16] >> (2 * (i % 16))) & 3).astype(jnp.int32)
-        for i in range(NDIGITS)
-    ]
-    return jnp.stack(digits)
+    return _extract_chunks(w, 2, NDIGITS)
 
 
 def _sel2(bit0, bit1, e0, e1, e2, e3) -> curve.CachedPoint:
@@ -154,6 +158,114 @@ def _straus_loop(neg_a: curve.Point, s_digits, h_digits) -> curve.Point:
     return jax.lax.fori_loop(0, NDIGITS, body, p0)
 
 
+# ------------------------------------------------ radix-8 variant (A/B)
+# Measures the larger-radix Straus loop suggested in review: 85 3-bit
+# digits of (3 doubles + 1 add) over a 64-entry table vs 127 2-bit
+# digits of (2 doubles + 1 add) over 16. Counting field ops predicts
+# ~parity, not a win: the joint table depends on A, so it is built PER
+# LANE — the 64-entry build costs ~52 adds vs ~10 for 16 entries, which
+# exactly cancels the loop's 42 saved adds (doubles stay ~255 either
+# way), while the select tree grows 2.8x (63 vs 15 cached-point selects
+# per iteration). The variant exists so benchmarks/kernel_compare.py can
+# RECORD that answer on real hardware instead of arguing it; production
+# stays radix-4 unless the measurement disagrees with the count.
+
+NDIGITS8 = 85  # ceil(255 / 3); scalars are < L < 2^253
+
+
+def words_to_digits3(w):
+    """(8, B) uint32 words -> (85, B) int32 3-bit digits, little-endian
+    (3-bit chunks straddle 32-bit word boundaries)."""
+    return _extract_chunks(w, 3, NDIGITS8)
+
+
+def _sel3(b0, b1, b2, entries) -> curve.CachedPoint:
+    """Select entries[b2*4 + b1*2 + b0] with 7 cached-point selects."""
+    q = [curve.select_cached(b0, entries[2 * k + 1], entries[2 * k])
+         for k in range(4)]
+    lo = curve.select_cached(b1, q[1], q[0])
+    hi = curve.select_cached(b1, q[3], q[2])
+    return curve.select_cached(b2, hi, lo)
+
+
+def _build_table8(neg_a: curve.Point, b: int) -> list[curve.CachedPoint]:
+    """table[s3*8 + h3] = [s3]B + [h3](-A), s3,h3 in 0..7."""
+
+    def bcast(c):
+        return jnp.broadcast_to(jnp.asarray(c), (NLIMB, b)).astype(jnp.int32)
+
+    b_cached = [curve.CachedPoint(*[bcast(c) for c in p]) for p in _B8_CACHED]
+    # A multiples 1..7: chains of doubles + cached adds
+    ca1 = curve.to_cached(neg_a)
+    a2 = curve.double(neg_a)
+    a3 = curve.add_cached(a2, ca1)
+    a4 = curve.double(a2)
+    a5 = curve.add_cached(a4, ca1)
+    a6 = curve.double(a3)
+    a7 = curve.add_cached(a6, ca1)
+    a_pts = [None, neg_a, a2, a3, a4, a5, a6, a7]
+
+    table: list[curve.CachedPoint] = []
+    for s3 in range(8):
+        for h3 in range(8):
+            if h3 == 0:
+                table.append(b_cached[s3])
+            elif s3 == 0:
+                table.append(curve.to_cached(a_pts[h3]))
+            else:
+                table.append(
+                    curve.to_cached(curve.add_cached(a_pts[h3], b_cached[s3]))
+                )
+    return table
+
+
+def _straus_loop8(neg_a: curve.Point, s_digits, h_digits) -> curve.Point:
+    """[S]B + [h](-A), radix-8 joint digits MSB-first."""
+    b = s_digits.shape[1]
+    table = _build_table8(neg_a, b)
+
+    def bcast(c):
+        return jnp.broadcast_to(jnp.asarray(c), (NLIMB, b)).astype(jnp.int32)
+
+    p0 = curve.Point(*[bcast(c) for c in curve.IDENTITY])
+
+    def body(i, p):
+        d = NDIGITS8 - 1 - i
+        sd = jax.lax.dynamic_index_in_dim(s_digits, d, 0, keepdims=False)
+        hd = jax.lax.dynamic_index_in_dim(h_digits, d, 0, keepdims=False)
+        s0, s1, s2 = sd & 1, (sd >> 1) & 1, sd >> 2
+        h0, h1, h2 = hd & 1, (hd >> 1) & 1, hd >> 2
+        rows = [
+            _sel3(h0, h1, h2, table[8 * s3:8 * s3 + 8]) for s3 in range(8)
+        ]
+        entry = _sel3(s0, s1, s2, rows)
+        p = curve.double(curve.double(curve.double(p)))
+        return curve.add_cached(p, entry)
+
+    return jax.lax.fori_loop(0, NDIGITS8, body, p0)
+
+
+def verify_core_r8(a_x_w, a_y_w, a_t_w, s_w, h_w, yr_w, x_parity):
+    """Radix-8 variant of verify_core — identical contract."""
+    b = s_w.shape[1]
+    neg_a = curve.Point(
+        words_to_limbs(a_x_w),
+        words_to_limbs(a_y_w),
+        jnp.broadcast_to(jnp.asarray(curve._ONE), (NLIMB, b)).astype(jnp.int32),
+        words_to_limbs(a_t_w),
+    )
+    rp = _straus_loop8(neg_a, words_to_digits3(s_w), words_to_digits3(h_w))
+    x, y = curve.to_affine(rp)
+    y_r = field.canonicalize(words_to_limbs(yr_w))
+    return field.eq(y, y_r) & (field.is_odd(x) == x_parity)
+
+
+@partial(jax.jit, static_argnames=())
+def verify_kernel_r8(keys, sigs):
+    """Radix-8 batched verify, split wire format (A/B experiments only)."""
+    return verify_core_r8(*unpack_pair(keys, sigs))
+
+
 def unpack(packed):
     """(49, B) packed wire array -> the seven logical views (static slices,
     free under jit). Rows: -A.x/-A.y/-A.t/S/h/y_R word planes + parity."""
@@ -219,7 +331,8 @@ def verify_kernel(keys, sigs):
 # ------------------------------------------------- module constants ([i]B)
 
 
-def _b_mult_consts():
+def _b_mult_consts(count: int = 4):
+    """Limb columns for [0..count-1]B as points + cached forms."""
     pts, cached = [], []
     ident = (0, 1, 1, 0)
     bx, by = em.BASE_X, em.BASE_Y
@@ -233,7 +346,7 @@ def _b_mult_consts():
 
     cur = None
     raw = [ident]
-    for _ in range(3):
+    for _ in range(count - 1):
         if cur is None:
             cur = (bx, by, 1, bx * by % P)
         else:
@@ -253,7 +366,10 @@ def _b_mult_consts():
     return pts, cached
 
 
-_B_MULT_POINTS, _B_MULT_CACHED = _b_mult_consts()
+# one pass builds [0..7]B; the radix-4 kernel uses the first 4 entries,
+# the radix-8 A/B variant the full cached list
+_B8_POINTS, _B8_CACHED = _b_mult_consts(8)
+_B_MULT_POINTS, _B_MULT_CACHED = _B8_POINTS[:4], _B8_CACHED[:4]
 
 
 # ---------------------------------------------------------------- host side

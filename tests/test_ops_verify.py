@@ -225,3 +225,47 @@ class TestPallasKernelMath:
         ).reshape(-1) != 0
         assert (ref == out).all()
         assert int(out[:128].sum()) == 127  # the one corrupted sig rejected
+
+
+class TestRadix8Variant:
+    """The radix-8 A/B kernel (verify_core_r8) must agree bit-exactly with
+    the production radix-4 kernel — same strict cofactorless equation,
+    different digit decomposition. Promoted only on a recorded on-device
+    win (benchmarks/kernel_compare.py)."""
+
+    def test_r8_matches_r4(self):
+        import numpy as np
+
+        from tendermint_tpu.ops import ed25519_batch as eb
+        from tendermint_tpu.utils import make_sig_batch
+
+        pubs, msgs, sigs = make_sig_batch(16, msg_prefix=b"r8 parity ")
+        sigs[3] = sigs[3][:63] + bytes([sigs[3][63] ^ 1])
+        sigs[9] = sigs[9][:32] + b"\x11" * 32
+        msgs[12] = msgs[12] + b"!"  # h mismatch
+        packed, mask = eb.prepare_batch(pubs, msgs, sigs, min_bucket=16)
+        keys, sg = eb.split(packed)
+        r4 = np.asarray(eb.verify_kernel(keys, sg))[:16]
+        r8 = np.asarray(eb.verify_kernel_r8(keys, sg))[:16]
+        assert (r4 == r8).all()
+        expected = np.ones(16, bool)
+        expected[3] = expected[9] = expected[12] = False
+        assert ((r4 & mask) == expected).all()
+
+    def test_digits3_reconstruct(self):
+        import numpy as np
+
+        from tendermint_tpu.ops import ed25519_batch as eb
+
+        rng = np.random.default_rng(8)
+        w = rng.integers(0, 2**32, size=(8, 5), dtype=np.uint32)
+        w[:, 0] = 0
+        w[7] &= (1 << 29) - 1  # scalars < 2^253
+        digits = np.asarray(eb.words_to_digits3(w))
+        for lane in range(5):
+            val = sum(int(d) << (3 * i) for i, d in enumerate(digits[:, lane]))
+            want = int.from_bytes(
+                b"".join(int(x).to_bytes(4, "little") for x in w[:, lane]),
+                "little",
+            )
+            assert val == want
