@@ -113,6 +113,21 @@ def _warm_main(cache_dir: str, buckets) -> None:
             )
             if not os.path.exists(_blob_path(platform, b)):
                 _write_export_blob(platform, b)
+            # mixed-curve valsets also hit the secp kernel (TPU-only; its
+            # compile lands in the persistent XLA cache, no blob layer)
+            try:
+                from tendermint_tpu.ops import secp_batch
+
+                sfn = secp_batch._device_fn()
+                if sfn is not None:
+                    np.asarray(
+                        sfn(
+                            np.zeros((secp_batch.SIG_ROWS, b), np.int32),
+                            np.zeros((secp_batch.KEY_ROWS, b), np.int32),
+                        )
+                    )
+            except Exception:  # noqa: BLE001 — secp warm is best-effort
+                pass
     except Exception as e:  # noqa: BLE001 — warm-up must never crash loudly
         import sys
 
