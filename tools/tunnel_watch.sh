@@ -1,14 +1,23 @@
 #!/bin/bash
 # Unattended TPU measurement pipeline: poll for the tunnel; the moment a
-# device answers, run the full round-3 measurement sequence and log
-# everything. Decouples measurement from operator attention — a brief
-# tunnel window still yields the bench number, the TPU correctness
-# artifact and the baseline table.
+# device answers, run the round-4 measurement sequence and log everything.
+# Decouples measurement from operator attention — a brief tunnel window
+# still yields the bench number, the TPU correctness artifact, the kernel
+# A/B and the device-only timing artifact (DEVICE_PROFILE).
+#
+# Steps run in priority order and each leaves a marker on success, so a
+# tunnel that dies mid-sequence costs at most one step's timeout: the next
+# window resumes at the first incomplete step instead of repeating finished
+# work. The tunnel is re-probed before every step, and each step runs in
+# its own process GROUP with a watchdog that kills the whole group on
+# timeout — a hung jax RPC (a dead tunnel hangs forever, it never errors)
+# cannot orphan a python that holds the device connection.
 #
 # Usage: nohup bash tools/tunnel_watch.sh &   (logs under tunnel_watch/)
 set -u
 cd "$(dirname "$0")/.."
 OUT=tunnel_watch
+ROUND=04
 mkdir -p "$OUT"
 log() { echo "[$(date -u +%H:%M:%S)] $*" | tee -a "$OUT/watch.log"; }
 
@@ -16,39 +25,105 @@ probe() {
     timeout 90 python -c "import jax; print(jax.devices())" >/dev/null 2>&1
 }
 
-log "watch started"
-while true; do
-    if probe; then
-        log "TUNNEL UP — starting measurement sequence"
-        # 1. warm the kernel caches for the bench bucket so the headline
-        #    run (and the driver's later run) hits warm compiles
-        log "prewarm (cold compile ~2-4 min on a fresh cache)"
-        timeout 900 python - >"$OUT/prewarm.log" 2>&1 <<'EOF'
+# run_step <name> <timeout_s> <cmd...>
+# stdout -> $OUT/<name>.out, stderr -> $OUT/<name>.log. Skips if the done
+# marker exists; re-probes first; marks done only on rc=0 so a failed step
+# retries on the next tunnel window. Returns 1 only when the tunnel is
+# gone (caller goes back to polling).
+run_step() {
+    local name="$1" tmo="$2"; shift 2
+    [ -e "$OUT/done.$name" ] && return 0
+    if ! probe; then
+        log "$name: tunnel gone — back to polling"
+        return 1
+    fi
+    log "$name: starting (timeout ${tmo}s)"
+    setsid "$@" >"$OUT/$name.out" 2>"$OUT/$name.log" &
+    local pid=$! rc waited=0
+    while kill -0 "$pid" 2>/dev/null && [ "$waited" -lt "$tmo" ]; do
+        sleep 5
+        waited=$((waited + 5))
+    done
+    if kill -0 "$pid" 2>/dev/null; then
+        # timeout: kill the whole process group (setsid made pgid=pid)
+        kill -TERM -- "-$pid" 2>/dev/null
+        sleep 10
+        kill -KILL -- "-$pid" 2>/dev/null
+        wait "$pid" 2>/dev/null   # reap: no zombie per timed-out step
+        rc=124
+        log "$name: TIMED OUT after ${tmo}s — process group killed"
+    else
+        wait "$pid"
+        rc=$?
+        log "$name: rc=$rc"
+    fi
+    [ "$rc" -eq 0 ] && touch "$OUT/done.$name"
+    return 0
+}
+
+PREWARM_PY='
 from tendermint_tpu.ops import kcache
 kcache.enable_persistent_cache()
 kcache.suppress_background_warm()
 kcache.prewarm([131072], background=False)
 print("prewarm done")
-EOF
-        log "prewarm rc=$?"
-        # 2. the headline bench (twice: first may still pay residual
-        #    warmup; the second is the steady-state number)
+'
+
+all_done() {
+    for s in prewarm bench1 bench2 artifact kernel_ab device_time baseline; do
+        [ -e "$OUT/done.$s" ] || return 1
+    done
+    return 0
+}
+
+log "watch started (round $ROUND)"
+while true; do
+    if probe; then
+        log "TUNNEL UP — running sequence (resumes at first incomplete step)"
+        # 1. warm kernel caches for the bench bucket (cold compile ~2-4 min)
+        run_step prewarm 900 python -c "$PREWARM_PY" || continue
+        # 2. headline bench twice: first may pay residual warmup; the
+        #    second is the steady-state number. JSON lands in benchN.out.
         for i in 1 2; do
-            timeout 1800 python bench.py \
-                >"$OUT/bench_$i.json" 2>"$OUT/bench_$i.log"
-            log "bench run $i rc=$? -> $(cat "$OUT/bench_$i.json" 2>/dev/null)"
+            run_step "bench$i" 1800 python bench.py || continue 2
+            [ -e "$OUT/done.bench$i" ] && \
+                log "bench$i JSON: $(cat "$OUT/bench$i.out" 2>/dev/null)"
         done
-        # 3. the real-TPU correctness artifact
-        timeout 2700 bash tools/tpu_artifact.sh 03 >"$OUT/artifact.log" 2>&1
-        log "tpu_artifact rc=$? (TPUTEST_r03.log written)"
-        # 4. baseline configs over the tunnel (1=anchor 2=commit
-        #    3=validate_block 5=streamed voteset; 4 is slow to build)
-        timeout 2700 python -m benchmarks.baseline_configs 1 2 3 5 \
-            >"$OUT/baseline.log" 2>&1
-        log "baseline_configs rc=$?"
-        log "sequence complete — logs in $OUT/"
-        exit 0
+        # 3. real-TPU correctness artifact (device-gated kernel parity
+        #    tests + kernel_compare 1024/10240) -> TPUTEST_r04.log
+        run_step artifact 2700 bash tools/tpu_artifact.sh "$ROUND" || continue
+        # 4. kernel A/B at the one shape the artifact doesn't cover —
+        #    the radix-4/radix-8 promotion decision input (VERDICT r3 #1)
+        run_step kernel_ab 1800 python -m benchmarks.kernel_compare 131072 || continue
+        if [ -e "$OUT/done.kernel_ab" ] && [ ! -e "KERNEL_AB_r${ROUND}.log" ]; then
+            # commit-able evidence: must not live only in the gitignored
+            # watch dir (1024/10240 shapes are in TPUTEST_r04.log already)
+            { echo "== kernel_compare 131072 (A/B promotion input) =="
+              date -u +"%Y-%m-%dT%H:%M:%SZ"
+              cat "$OUT/kernel_ab.out"; } >"KERNEL_AB_r${ROUND}.log"
+        fi
+        # 5. tunnel-independent device-only timing per bucket x kernel
+        #    variant (VERDICT r3 #2) -> DEVICE_PROFILE_r04.md.
+        #    device_time exits nonzero if no variant produced a number, so
+        #    the done-marker/mv can't enshrine a stub.
+        run_step device_time 3600 python -u -m benchmarks.device_time 1024 2560 10240 131072 || continue
+        if [ -e "$OUT/done.device_time" ] && [ ! -e "DEVICE_PROFILE_r${ROUND}.md" ]; then
+            { echo "# DEVICE_PROFILE — round $ROUND"
+              echo
+              date -u +"%Y-%m-%dT%H:%M:%SZ"
+              echo
+              cat "$OUT/device_time.out"; } >"DEVICE_PROFILE_r${ROUND}.md"
+        fi
+        # 6. baseline configs (1=anchor 2=commit 3=validate_block
+        #    5=streamed voteset; 4 is slow to build)
+        run_step baseline 2700 python -m benchmarks.baseline_configs 1 2 3 5 || continue
+        if all_done; then
+            log "sequence complete — logs in $OUT/"
+            exit 0
+        fi
+        log "window ended with incomplete/failed steps — will retry"
+    else
+        log "tunnel still down"
     fi
-    log "tunnel still down"
     sleep 120
 done
