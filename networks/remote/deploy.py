@@ -115,16 +115,28 @@ def cmd_start(hosts: list[str]) -> None:
     for host in hosts:
         ssh(
             host,
-            f"cd {REMOTE_DIR}/code && "
+            # `;` separators: `&` must background ONLY the node command so
+            # $! is the python PID, not a wrapper subshell's
+            f"cd {REMOTE_DIR}/code; "
             f"nohup python -m tendermint_tpu.cmd --home {REMOTE_DIR}/home node "
-            f"> {REMOTE_DIR}/node.log 2>&1 & echo started",
+            f"> {REMOTE_DIR}/node.log 2>&1 & "
+            f"echo $! > {REMOTE_DIR}/node.pid; echo started",
         )
         print(f"{host}: started")
 
 
 def cmd_stop(hosts: list[str]) -> None:
+    # kill exactly the PID recorded at start — a pkill pattern would match
+    # ANY process whose command line mentions the node module (editors,
+    # tails, unrelated checkouts)  (ADVICE r3)
     for host in hosts:
-        ssh(host, "pkill -f 'tendermint_tpu.cmd.*node' || true", check=False)
+        ssh(
+            host,
+            f"[ -f {REMOTE_DIR}/node.pid ] && "
+            f"kill $(cat {REMOTE_DIR}/node.pid) 2>/dev/null; "
+            f"rm -f {REMOTE_DIR}/node.pid; true",
+            check=False,
+        )
         print(f"{host}: stopped")
 
 

@@ -296,6 +296,7 @@ class LiteProxy:
         # (a single attempt needing more live heights than the cache can
         # hold) terminates instead of looping forever.
         fetches: dict[int, int] = {}
+        total = 0
         while True:
             self._prefetch.last_missing = None
             try:
@@ -305,6 +306,19 @@ class LiteProxy:
                 missing = self._prefetch.last_missing
                 if missing is None or missing in self._prefetch.commits:
                     raise
+                # total ceiling (ADVICE r3): the per-height cap below only
+                # bounds repeats of the SAME height — a buggy/malicious
+                # verifier reporting a fresh missing height every attempt
+                # must also terminate (each fetch is a live RPC). 4096 is
+                # an order of magnitude above the widest legitimate span
+                # (384-height window + bisection slack).
+                total += 1
+                if total > 4096:
+                    raise LiteError(
+                        f"trust advance did not converge for {what} "
+                        f"({total - 1} fetches without success — verifier "
+                        "reported an unbounded stream of missing heights)"
+                    )
                 n = fetches.get(missing, 0) + 1
                 fetches[missing] = n
                 if n > 3:  # evicted and re-fetched repeatedly: not converging

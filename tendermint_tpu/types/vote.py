@@ -42,7 +42,30 @@ class BlockID:
         return len(self.hash) == 32 and self.parts.total > 0 and len(self.parts.hash) == 32
 
     def key(self) -> bytes:
-        return self.hash + self.parts.hash + self.parts.total.to_bytes(4, "big")
+        # length-prefixed: a crafted (hash, parts.hash) split can never
+        # collide with a different BlockID's key (votes_by_block and the
+        # sign-bytes template cache both key on this). u32 prefixes match
+        # the wire decoder's length range — key() is reachable with
+        # peer-controlled BlockIDs before any validate_basic (peer maj23
+        # bookkeeping), so it must not be able to raise.
+        return (
+            len(self.hash).to_bytes(4, "big")
+            + self.hash
+            + len(self.parts.hash).to_bytes(4, "big")
+            + self.parts.hash
+            + self.parts.total.to_bytes(4, "big")
+        )
+
+    def validate_basic(self) -> None:
+        """Reference types/vote.go ValidateBasic: a vote's BlockID must be
+        either zero (nil vote) or complete — 32-byte hashes and a positive
+        part count. Anything in between is malformed and must be rejected
+        before it can reach sign-bytes encoding or conflict bookkeeping."""
+        if not (self.is_zero() or self.is_complete()):
+            raise ValueError(
+                f"BlockID must be zero or complete: hash={self.hash.hex()} "
+                f"parts.hash={self.parts.hash.hex()} parts.total={self.parts.total}"
+            )
 
     def encode_into(self, w: Writer) -> None:
         w.bytes(self.hash)
@@ -81,7 +104,13 @@ def canonical_vote_sign_bytes(
     documented; chain_id is included to prevent cross-chain replay.
     Layout: u8(type) u64(height) u32(round) BlockID u64(timestamp_ns)
     str(chain_id) — see docs/encoding.md (consensus-critical)."""
-    key = (chain_id, vote_type, height, round_, block_id.key())
+    # unambiguous tuple key — the raw components, never a concatenation
+    # (a malformed BlockID whose concat collides with a legitimate block's
+    # must not be able to poison the template; see BlockID.validate_basic)
+    key = (
+        chain_id, vote_type, height, round_,
+        block_id.hash, block_id.parts.hash, block_id.parts.total,
+    )
     tmpl = _SB_TMPL.get(key)
     if tmpl is None:
         w = Writer().u8(vote_type).u64(height).u32(round_)
@@ -121,6 +150,29 @@ class Vote:
     validator_address: bytes
     validator_index: int
     signature: bytes = b""
+
+    def validate_basic(self) -> None:
+        """Structural validation of an untrusted vote (reference
+        types/vote.go ValidateBasic): height/round/index in range, a
+        20-byte validator address, a present signature, and a zero-or-
+        complete BlockID — the last rule is security-critical, as a
+        half-formed BlockID could otherwise reach sign-bytes encoding and
+        conflict bookkeeping with attacker-chosen ambiguity."""
+        if self.height < 0:
+            raise ValueError("negative height")
+        if self.round < 0:
+            raise ValueError("negative round")
+        if self.validator_index < 0:
+            raise ValueError("negative validator index")
+        if len(self.validator_address) != 20:
+            raise ValueError(
+                f"validator address must be 20 bytes, got {len(self.validator_address)}"
+            )
+        if not self.signature:
+            raise ValueError("vote has no signature")
+        if len(self.signature) > 96:
+            raise ValueError("oversized signature")
+        self.block_id.validate_basic()
 
     def sign_bytes(self, chain_id: str) -> bytes:
         return canonical_vote_sign_bytes(

@@ -209,10 +209,13 @@ class VoteSet:
         None), or None for an exact duplicate. Raises VoteSetError /
         ConflictingVoteError."""
         idx = vote.validator_index
-        if idx < 0:
-            raise VoteSetError("negative validator index")
-        if not vote.signature:
-            raise VoteSetError("vote has no signature")
+        try:
+            # zero-or-complete BlockID, 20-byte address, signature present
+            # (reference types/vote.go ValidateBasic; ADVICE r3: a crafted
+            # BlockID must never reach sign-bytes or conflict keying)
+            vote.validate_basic()
+        except ValueError as e:
+            raise VoteSetError(str(e)) from None
         if (vote.height, vote.round, vote.type) != (self.height, self.round, self.type):
             raise VoteSetError(
                 f"expected {self.height}/{self.round}/{self.type}, got "

@@ -259,6 +259,51 @@ class TestVoteSetAndCommit:
         with pytest.raises(ConflictingVoteError):
             voteset.add_vote(make_vote(pvs[0], vs, 1, 0, VoteType.PREVOTE, bid_b))
 
+    def test_malformed_block_id_rejected(self):
+        """ADVICE r3 (high): a gossiped vote whose BlockID is neither zero
+        nor complete (e.g. hash=b'' with parts.hash = real_hash||real_parts
+        crafted so the un-prefixed concat collides with a legitimate
+        block's key) must be rejected by _precheck before it can poison
+        the sign-bytes template cache or votes_by_block keying."""
+        vs, pvs = make_valset(4)
+        legit = rand_block_id(b"target")
+        # craft the pre-fix key collision: old key() was
+        # hash + parts.hash + total -> (b"", legit.hash||legit.parts.hash)
+        crafted = BlockID(
+            b"", PartSetHeader(legit.parts.total, legit.hash + legit.parts.hash)
+        )
+        # keys must be unambiguous now even before validation
+        assert crafted.key() != legit.key()
+        with pytest.raises(VoteSetError, match="zero or complete"):
+            voteset = VoteSet(CHAIN_ID, 1, 0, VoteType.PREVOTE, vs)
+            voteset.add_vote(make_vote(pvs[0], vs, 1, 0, VoteType.PREVOTE, crafted))
+        # honest votes for the real block still verify end to end
+        voteset = VoteSet(CHAIN_ID, 1, 0, VoteType.PREVOTE, vs)
+        for pv in pvs[:3]:
+            assert voteset.add_vote(make_vote(pv, vs, 1, 0, VoteType.PREVOTE, legit))
+        maj, ok = voteset.two_thirds_majority()
+        assert ok and maj == legit
+
+    def test_vote_validate_basic(self):
+        vs, pvs = make_valset(1)
+        ok = make_vote(pvs[0], vs, 1, 0, VoteType.PREVOTE, rand_block_id())
+        ok.validate_basic()  # complete BlockID: fine
+        make_vote(pvs[0], vs, 1, 0, VoteType.PREVOTE, BlockID()).validate_basic()  # nil
+        import dataclasses
+
+        for bad in (
+            BlockID(b"\x01" * 31, PartSetHeader(1, b"\x02" * 32)),  # short hash
+            BlockID(b"\x01" * 32, PartSetHeader(0, b"\x02" * 32)),  # no parts
+            BlockID(b"\x01" * 32, PartSetHeader(1, b"")),  # missing parts hash
+            BlockID(b"", PartSetHeader(1, b"\x02" * 32)),  # hash missing
+        ):
+            with pytest.raises(ValueError, match="zero or complete"):
+                dataclasses.replace(ok, block_id=bad).validate_basic()
+        with pytest.raises(ValueError, match="20 bytes"):
+            dataclasses.replace(ok, validator_address=b"\x01" * 8).validate_basic()
+        with pytest.raises(ValueError, match="no signature"):
+            dataclasses.replace(ok, signature=b"").validate_basic()
+
     def test_make_commit_and_verify(self):
         vs, pvs = make_valset(4)
         bid = rand_block_id()
