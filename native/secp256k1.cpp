@@ -8,11 +8,18 @@
 // SHA-256 message digest.
 //
 // Field arithmetic: 4x64 limbs, reduction by p = 2^256 - 0x1000003D1.
-// Scalar arithmetic mod n: bit-serial reduction (verification is the CPU
-// fallback path; simplicity over speed). Points: Jacobian coordinates.
+// Scalar arithmetic mod n: folding reduction by c = 2^256 - n (129 bits).
+// Points: Jacobian coordinates; verification runs one interleaved
+// Strauss double-scalar multiplication (wNAF(8) over a static affine
+// G table + wNAF(5) over a per-key Jacobian table) and compares R.x
+// against r in Jacobian coordinates, so the whole verify needs no field
+// inversion. Measured 2.6x the OpenSSL generic-EC path this backs up
+// (OpenSSL has no specialized secp256k1 code) on one core — README's
+// round-4 native-core table has the numbers.
 #include <cstdint>
 #include <cstring>
 #include "sha2.h"
+#include "wnaf.h"
 
 namespace tmnative {
 
@@ -207,8 +214,47 @@ static void sc_frombytes_be(Sc& o, const uint8_t in[32]) {
     while (sc_cmp_raw(o.v, N) >= 0) sc_sub_n(o.v);
 }
 
-// o = a*b mod n — 512-bit product then bit-serial reduction (fallback path;
-// ~512 iterations of shift/cmp/sub on 4 limbs)
+// c = 2^256 - n = 0x1_45512319_50B75FC4_402DA173_2FC9BEBF (129 bits):
+// value mod n folds as lo + hi*c, shrinking ~127 bits per fold.
+static const uint64_t NC[3] = {0x402DA1732FC9BEBFull, 0x4551231950B75FC4ull,
+                               1ull};
+
+// reduce an 8-limb (512-bit) value mod n into o
+static void sc_reduce_wide(Sc& o, const uint64_t t[8]) {
+    // working value: up to 7 limbs across folds
+    uint64_t v[8];
+    memcpy(v, t, sizeof v);
+    int top = 8;  // limbs in use
+    while (top > 4) {
+        int hi_limbs = top - 4;
+        uint64_t hi[4] = {0, 0, 0, 0};
+        memcpy(hi, v + 4, hi_limbs * sizeof(uint64_t));
+        // v = v[0..3] + hi * c   (hi*c has at most hi_limbs+3 limbs)
+        uint64_t acc[8] = {v[0], v[1], v[2], v[3], 0, 0, 0, 0};
+        for (int i = 0; i < hi_limbs; i++) {
+            u128 carry = 0;
+            for (int j = 0; j < 3; j++) {
+                u128 cur = (u128)acc[i + j] + (u128)hi[i] * NC[j] + carry;
+                acc[i + j] = (uint64_t)cur;
+                carry = (uint64_t)(cur >> 64);
+            }
+            int k = i + 3;
+            while (carry) {
+                u128 cur = (u128)acc[k] + carry;
+                acc[k] = (uint64_t)cur;
+                carry = (uint64_t)(cur >> 64);
+                k++;
+            }
+        }
+        memcpy(v, acc, sizeof v);
+        top = 8;
+        while (top > 4 && v[top - 1] == 0) top--;
+    }
+    while (sc_cmp_raw(v, N) >= 0) sc_sub_n(v);
+    memcpy(o.v, v, 4 * sizeof(uint64_t));
+}
+
+// o = a*b mod n — 512-bit schoolbook product + folding reduction
 static void sc_mul(Sc& o, const Sc& a, const Sc& b) {
     uint64_t t[8] = {0};
     for (int i = 0; i < 4; i++) {
@@ -220,26 +266,30 @@ static void sc_mul(Sc& o, const Sc& a, const Sc& b) {
         }
         t[i + 4] += (uint64_t)carry;
     }
-    uint64_t r[4] = {0, 0, 0, 0};
-    for (int bit = 511; bit >= 0; bit--) {
-        // r <<= 1
-        uint64_t top = r[3] >> 63;
-        for (int i = 3; i > 0; i--) r[i] = (r[i] << 1) | (r[i - 1] >> 63);
-        r[0] <<= 1;
-        r[0] |= (t[bit / 64] >> (bit % 64)) & 1;
-        if (top || sc_cmp_raw(r, N) >= 0) sc_sub_n(r);
-    }
-    memcpy(o.v, r, sizeof r);
+    sc_reduce_wide(o, t);
 }
 
-static void sc_invert(Sc& o, const Sc& a) {  // Fermat: a^(n-2)
+static void sc_invert(Sc& o, const Sc& a) {  // Fermat: a^(n-2), 4-bit windows
+    Sc table[16];  // table[i] = a^i (i >= 1)
+    table[1] = a;
+    for (int i = 2; i < 16; i++) sc_mul(table[i], table[i - 1], a);
     uint64_t e[4];
     memcpy(e, N, sizeof e);
     e[0] -= 2;
-    Sc result = {{1, 0, 0, 0}}, base = a;
-    for (int i = 0; i < 256; i++) {
-        if ((e[i / 64] >> (i % 64)) & 1) sc_mul(result, result, base);
-        sc_mul(base, base, base);
+    Sc result = {{1, 0, 0, 0}};
+    bool started = false;
+    for (int nib = 63; nib >= 0; nib--) {
+        if (started)
+            for (int d = 0; d < 4; d++) sc_mul(result, result, result);
+        int idx = (e[nib / 16] >> (4 * (nib % 16))) & 0xF;
+        if (idx) {
+            if (started)
+                sc_mul(result, result, table[idx]);
+            else {
+                result = table[idx];
+                started = true;
+            }
+        }
     }
     o = result;
 }
@@ -335,18 +385,96 @@ static void jac_add(Jac& o, const Jac& p, const Jac& q) {
     o.X = X3; o.Y = Y3; o.Z = Z3;
 }
 
-static void jac_scalarmult(Jac& o, const Sc& k, const Jac& P) {
-    // 4-bit windows, MSB first
-    Jac table[16];
-    jac_infinity(table[0]);
-    table[1] = P;
-    for (int i = 2; i < 16; i++) jac_add(table[i], table[i - 1], P);
-    jac_infinity(o);
-    for (int nib = 63; nib >= 0; nib--) {
-        for (int d = 0; d < 4; d++) jac_double(o, o);
-        int idx = (k.v[nib / 16] >> (4 * (nib % 16))) & 0xF;
-        if (idx) jac_add(o, o, table[idx]);
+struct Aff {  // affine point (never infinity in the tables below)
+    Fp x, y;
+};
+
+// mixed addition: o = p + q with q affine (8 mul + 3 sq vs jac_add's 12+4)
+static void jac_madd(Jac& o, const Jac& p, const Aff& q) {
+    if (jac_is_infinity(p)) {
+        o.X = q.x;
+        o.Y = q.y;
+        memset(&o.Z, 0, sizeof o.Z);
+        o.Z.v[0] = 1;
+        return;
     }
+    Fp Z1Z1, U2, S2, t;
+    fp_sq(Z1Z1, p.Z);
+    fp_mul(U2, q.x, Z1Z1);
+    fp_mul(t, p.Z, Z1Z1);
+    fp_mul(S2, q.y, t);
+    Fp H, R;
+    fp_sub(H, U2, p.X);
+    fp_sub(R, S2, p.Y);
+    if (fp_iszero(H)) {
+        if (fp_iszero(R)) {
+            jac_double(o, p);
+            return;
+        }
+        jac_infinity(o);
+        return;
+    }
+    Fp H2, H3, V, X3, Y3, Z3;
+    fp_sq(H2, H);
+    fp_mul(H3, H2, H);
+    fp_mul(V, p.X, H2);
+    fp_sq(X3, R);
+    fp_sub(X3, X3, H3);
+    fp_sub(X3, X3, V);
+    fp_sub(X3, X3, V);                  // X3 = R^2 - H^3 - 2V
+    fp_sub(t, V, X3);
+    fp_mul(t, R, t);
+    Fp YH3;
+    fp_mul(YH3, p.Y, H3);
+    fp_sub(Y3, t, YH3);                 // Y3 = R(V - X3) - Y1 H^3
+    fp_mul(Z3, p.Z, H);                 // Z3 = Z1 H
+    o.X = X3; o.Y = Y3; o.Z = Z3;
+}
+
+static int wnaf(int8_t out[257], const Sc& k, int w) {
+    return wnaf_digits(out, k.v, w);
+}
+
+// static wNAF(8) table of odd multiples of G: [1,3,...,127]G, affine.
+// Built once at first verify (generic code; ~50us) and reused forever.
+static Aff G_TAB[64];
+
+static void build_g_table() {
+    Jac G = {GX, GY, {{1, 0, 0, 0}}};
+    Jac G2;
+    jac_double(G2, G);
+    Jac cur = G;
+    Jac jtab[64];
+    jtab[0] = G;
+    for (int i = 1; i < 64; i++) {
+        jac_add(cur, cur, G2);
+        jtab[i] = cur;
+    }
+    // batch-normalize to affine (Montgomery trick: one inversion)
+    Fp prods[64], acc = {{1, 0, 0, 0}};
+    for (int i = 0; i < 64; i++) {
+        prods[i] = acc;                     // prod of Z[0..i-1]
+        fp_mul(acc, acc, jtab[i].Z);
+    }
+    Fp inv;
+    fp_invert(inv, acc);
+    for (int i = 63; i >= 0; i--) {
+        Fp zinv;
+        fp_mul(zinv, inv, prods[i]);        // 1/Z[i]
+        fp_mul(inv, inv, jtab[i].Z);        // strip Z[i] from the chain
+        Fp zi2, zi3;
+        fp_sq(zi2, zinv);
+        fp_mul(zi3, zi2, zinv);
+        fp_mul(G_TAB[i].x, jtab[i].X, zi2);
+        fp_mul(G_TAB[i].y, jtab[i].Y, zi3);
+    }
+}
+
+static void ensure_g_table() {
+    // C++11 magic static: thread-safe one-time init (the batch entry
+    // point fans verifies out across a thread pool)
+    static const bool ready = (build_g_table(), true);
+    (void)ready;
 }
 
 // decompress 33-byte SEC1 pubkey
@@ -410,23 +538,75 @@ extern "C" int tm_secp256k1_verify(const uint8_t pub[33], const uint8_t* msg,
     sc_mul(u1, z, w);
     sc_mul(u2, r, w);
 
-    Jac G = {GX, GY, {{1, 0, 0, 0}}};
-    Jac p1, p2, R;
-    jac_scalarmult(p1, u1, G);
-    jac_scalarmult(p2, u2, Q);
-    jac_add(R, p1, p2);
+    ensure_g_table();
+
+    // per-key wNAF(5) table: odd multiples [1,3,...,15]Q, Jacobian (a
+    // batch normalization to affine would cost a field inversion — the
+    // general jac_add in the ~43 table hits is cheaper than that)
+    Jac q_tab[8];
+    {
+        Jac Q2;
+        jac_double(Q2, Q);
+        q_tab[0] = Q;
+        for (int i = 1; i < 8; i++) jac_add(q_tab[i], q_tab[i - 1], Q2);
+    }
+
+    int8_t n1[257], n2[257];
+    int l1 = wnaf(n1, u1, 8);
+    int l2 = wnaf(n2, u2, 5);
+    int top = (l1 > l2 ? l1 : l2) - 1;
+    if (top < 0) return 0;  // u1 = u2 = 0 cannot yield x(R) = r != 0
+
+    // interleaved Strauss: one shared doubling chain, table hits per digit
+    Jac R;
+    jac_infinity(R);
+    for (int i = top; i >= 0; i--) {
+        jac_double(R, R);
+        int d1 = n1[i];
+        if (d1 > 0) {
+            jac_madd(R, R, G_TAB[(d1 - 1) >> 1]);
+        } else if (d1 < 0) {
+            Aff neg = G_TAB[(-d1 - 1) >> 1];
+            Fp py = {{P[0], P[1], P[2], P[3]}};
+            fp_sub(neg.y, py, neg.y);
+            jac_madd(R, R, neg);
+        }
+        int d2 = n2[i];
+        if (d2 > 0) {
+            jac_add(R, R, q_tab[(d2 - 1) >> 1]);
+        } else if (d2 < 0) {
+            Jac neg = q_tab[(-d2 - 1) >> 1];
+            Fp py = {{P[0], P[1], P[2], P[3]}};
+            fp_sub(neg.Y, py, neg.Y);
+            jac_add(R, R, neg);
+        }
+    }
     if (jac_is_infinity(R)) return 0;
 
-    // r' = R.x (affine) mod n
-    Fp zinv, zinv2, xaff;
-    fp_invert(zinv, R.Z);
-    fp_sq(zinv2, zinv);
-    fp_mul(xaff, R.X, zinv2);
-    uint8_t xb[32];
-    fp_tobytes_be(xb, xaff);
-    Sc rprime;
-    sc_frombytes_be(rprime, xb);
-    return sc_cmp_raw(rprime.v, r.v) == 0 ? 1 : 0;
+    // r' == R.x (affine) mod n, compared in Jacobian coordinates: check
+    // X == cand * Z^2 for cand in {r, r+n} (no field inversion). r < n
+    // so r+n < 2n < 2^257; the r+n candidate only exists when r+n < p.
+    Fp z2;
+    fp_sq(z2, R.Z);
+    for (int cand = 0; cand < 2; cand++) {
+        uint64_t c[5] = {r.v[0], r.v[1], r.v[2], r.v[3], 0};
+        if (cand == 1) {
+            u128 carry = 0;
+            for (int i = 0; i < 4; i++) {
+                u128 s2 = (u128)c[i] + N[i] + carry;
+                c[i] = (uint64_t)s2;
+                carry = (uint64_t)(s2 >> 64);
+            }
+            c[4] = (uint64_t)carry;
+            // candidate must be a canonical field element: r + n < p
+            if (c[4] || fp_cmp_raw(c, P) >= 0) break;
+        }
+        Fp cf = {{c[0], c[1], c[2], c[3]}};
+        Fp t;
+        fp_mul(t, cf, z2);
+        if (memcmp(t.v, R.X.v, sizeof t.v) == 0) return 1;
+    }
+    return 0;
 }
 
 }  // namespace tmnative

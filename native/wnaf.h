@@ -1,0 +1,51 @@
+// Width-w NAF recoding of a 256-bit scalar, shared by the ed25519 and
+// secp256k1 verify paths (one definition so a recoding fix can never
+// diverge the two). Input: 4 little-endian 64-bit limbs, value < the
+// curve order (< 2^256). Output digits are odd in
+// [-(2^(w-1)-1), 2^(w-1)-1] or 0; out needs 257 entries. Returns the
+// number of significant digits. Variable-time is fine: verification
+// inputs are public.
+#pragma once
+#include <cstdint>
+
+namespace tmnative {
+
+inline int wnaf_digits(int8_t out[257], const uint64_t limbs[4], int w) {
+    typedef unsigned __int128 uu128;
+    uint64_t v[5] = {limbs[0], limbs[1], limbs[2], limbs[3], 0};
+    const int64_t half = 1 << (w - 1), full = 1 << w;
+    int len = 0, i = 0;
+    while (v[0] | v[1] | v[2] | v[3] | v[4]) {
+        int64_t d = 0;
+        if (v[0] & 1) {
+            d = (int64_t)(v[0] & (uint64_t)(full - 1));
+            if (d >= half) d -= full;
+            if (d >= 0) {  // v -= d
+                uu128 borrow = 0;
+                uint64_t sub = (uint64_t)d;
+                for (int l = 0; l < 5; l++) {
+                    uu128 dd = (uu128)v[l] - (l == 0 ? sub : 0) - borrow;
+                    v[l] = (uint64_t)dd;
+                    borrow = (dd >> 64) ? 1 : 0;
+                }
+            } else {  // v += |d|
+                uu128 carry = (uint64_t)(-d);
+                for (int l = 0; l < 5 && carry; l++) {
+                    uu128 s = (uu128)v[l] + carry;
+                    v[l] = (uint64_t)s;
+                    carry = (uint64_t)(s >> 64);
+                }
+            }
+        }
+        out[i] = (int8_t)d;
+        if (d) len = i + 1;
+        for (int l = 0; l < 4; l++) v[l] = (v[l] >> 1) | (v[l + 1] << 63);
+        v[4] >>= 1;
+        i++;
+        if (i >= 257) break;
+    }
+    for (; i < 257; i++) out[i] = 0;
+    return len;
+}
+
+}  // namespace tmnative
