@@ -98,6 +98,8 @@ async def _amain(args) -> int:
             from tendermint_tpu.abci.grpc import GRPCABCIServer
 
             server = GRPCABCIServer(app, args.address)
+        elif args.abci == "proto":
+            server = ABCIServer(app, args.address, codec="proto")
         else:
             server = ABCIServer(app, args.address)
         await server.start()
@@ -112,6 +114,8 @@ async def _amain(args) -> int:
         from tendermint_tpu.abci.grpc import GRPCClient
 
         client = GRPCClient(args.address)
+    elif args.abci == "proto":
+        client = SocketClient(args.address, codec="proto")
     else:
         client = SocketClient(args.address)
     await client.start()
@@ -128,8 +132,9 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="abci-cli")
     p.add_argument("--address", default="tcp://127.0.0.1:26658")
     p.add_argument(
-        "--abci", default="socket", choices=["socket", "grpc"],
-        help="transport (reference abci-cli --abci)",
+        "--abci", default="socket", choices=["socket", "grpc", "proto"],
+        help="transport (reference abci-cli --abci); proto = the "
+        "reference's protobuf socket wire, for cross-implementation apps",
     )
     p.add_argument("--serial", action="store_true", help="counter: enforce tx ordering")
     p.add_argument(

@@ -16,6 +16,7 @@ import asyncio
 import struct
 
 from tendermint_tpu.abci import types as abci
+from tendermint_tpu.encoding import DecodeError
 from tendermint_tpu.abci.types import (
     decode_response,
     encode_request,
@@ -109,9 +110,35 @@ class SocketClient(Client):
     requests are written immediately, responses matched FIFO
     (reference socket_client.go:122,154)."""
 
-    def __init__(self, address: str) -> None:
+    def __init__(self, address: str, codec: str = "cbe") -> None:
         super().__init__("SocketABCIClient")
         self.address = address
+        # codec="proto": reference-compatible zigzag-varint-framed protobuf
+        # — this node can drive any existing Go/Rust ABCI app (abci/proto.py).
+        # Resolved ONCE here into (encode_frame, read_one) so the wire
+        # format is a single-point decision, not a per-call branch.
+        self.codec = codec
+        if codec == "proto":
+            from tendermint_tpu.abci import proto as pb
+
+            self._encode_frame = lambda req: pb.frame(pb.encode_request(req))
+
+            async def read_one():
+                return pb.decode_response(await pb.read_frame(self._reader))
+        else:
+
+            def _encode_cbe(req):
+                payload = encode_request(req)
+                return struct.pack(">I", len(payload)) + payload
+
+            self._encode_frame = _encode_cbe
+
+            async def read_one():
+                hdr = await self._reader.readexactly(4)
+                (ln,) = struct.unpack(">I", hdr)
+                return decode_response(await self._reader.readexactly(ln))
+
+        self._read_one = read_one
         self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
         self._pending: asyncio.Queue[asyncio.Future] = asyncio.Queue()
@@ -134,16 +161,18 @@ class SocketClient(Client):
     async def _recv_routine(self) -> None:
         try:
             while True:
-                hdr = await self._reader.readexactly(4)
-                (ln,) = struct.unpack(">I", hdr)
-                payload = await self._reader.readexactly(ln)
-                resp = decode_response(payload)
+                resp = await self._read_one()
                 fut = self._pending.get_nowait()
                 if isinstance(resp, abci.ResponseException):
                     fut.set_exception(ABCIClientError(resp.error))
                 else:
                     fut.set_result(resp)
-        except (asyncio.IncompleteReadError, ConnectionError, asyncio.QueueEmpty) as e:
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionError,
+            asyncio.QueueEmpty,
+            DecodeError,  # malformed wire data (e.g. wrong-codec peer)
+        ) as e:
             self._conn_err = e
             while not self._pending.empty():
                 fut = self._pending.get_nowait()
@@ -155,8 +184,7 @@ class SocketClient(Client):
     def _send(self, req) -> asyncio.Future:
         if self._conn_err is not None:
             raise ABCIClientError(f"connection lost: {self._conn_err}")
-        payload = encode_request(req)
-        self._writer.write(struct.pack(">I", len(payload)) + payload)
+        self._writer.write(self._encode_frame(req))
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._pending.put_nowait(fut)
         return fut

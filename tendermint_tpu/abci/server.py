@@ -16,10 +16,18 @@ from tendermint_tpu.libs.service import BaseService
 
 
 class ABCIServer(BaseService):
-    def __init__(self, app: abci.Application, address: str) -> None:
+    """codec="cbe" (native framing, 4-byte length) or codec="proto"
+    (reference-compatible: zigzag-varint-framed protobuf — lets existing
+    Go/Rust ABCI clients, i.e. a stock tendermint node, drive this app;
+    see abci/proto.py)."""
+
+    def __init__(
+        self, app: abci.Application, address: str, codec: str = "cbe"
+    ) -> None:
         super().__init__("ABCIServer")
         self.app = app
         self.address = address
+        self.codec = codec
         self._server: asyncio.AbstractServer | None = None
 
     async def on_start(self) -> None:
@@ -41,17 +49,37 @@ class ABCIServer(BaseService):
         return self._server.sockets[0].getsockname()[1]
 
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        if self.codec == "proto":
+            from tendermint_tpu.abci import proto as pb
+
+            read = pb.read_frame
+
+            def decode(data):
+                return pb.decode_request(data)
+
+            def encode(resp):
+                return pb.frame(pb.encode_response(resp))
+        else:
+
+            async def read(r):
+                hdr = await r.readexactly(4)
+                (ln,) = struct.unpack(">I", hdr)
+                return await r.readexactly(ln)
+
+            decode = decode_request
+
+            def encode(resp):
+                payload = encode_response(resp)
+                return struct.pack(">I", len(payload)) + payload
+
         try:
             while True:
-                hdr = await reader.readexactly(4)
-                (ln,) = struct.unpack(">I", hdr)
-                req = decode_request(await reader.readexactly(ln))
+                req = decode(await read(reader))
                 try:
                     resp = self._dispatch(req)
                 except Exception as e:  # app panic -> exception response
                     resp = abci.ResponseException(str(e))
-                payload = encode_response(resp)
-                writer.write(struct.pack(">I", len(payload)) + payload)
+                writer.write(encode(resp))
                 if isinstance(req, abci.RequestFlush):
                     await writer.drain()
         except (asyncio.IncompleteReadError, ConnectionError):

@@ -35,7 +35,10 @@ class LocalClientCreator(ClientCreator):
 class RemoteClientCreator(ClientCreator):
     """Connection to an external app process: socket framing by default,
     gRPC for `grpc://` addresses or transport="grpc" (reference
-    NewRemoteClientCreator's socket/grpc transport switch)."""
+    NewRemoteClientCreator's socket/grpc transport switch). The "proto"
+    transport speaks the reference's zigzag-varint-framed protobuf socket
+    protocol (abci/proto.py), so this node can drive an existing Go/Rust
+    ABCI app unchanged."""
 
     def __init__(self, address: str, transport: str = "socket") -> None:
         self.address = address
@@ -46,6 +49,8 @@ class RemoteClientCreator(ClientCreator):
             from tendermint_tpu.abci.grpc import GRPCClient
 
             return GRPCClient(self.address)
+        if self.transport == "proto":
+            return SocketClient(self.address, codec="proto")
         return SocketClient(self.address)
 
 
