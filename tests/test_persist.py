@@ -13,8 +13,10 @@ DRIVER = os.path.join(os.path.dirname(__file__), "persist_node.py")
 
 # 10 planted crash points: 5 in finalizeCommit (consensus/state.py) and 5 in
 # the ApplyBlock/Commit pipeline (state/execution.py); indexes are call
-# order, and by index ~9 the counter wraps multiple heights.
-CRASH_INDEXES = [0, 2, 4, 6, 8]
+# order, and by index ~9 the counter wraps multiple heights. All 10 run
+# (r3 VERDICT weak #5: the even-only subset left half the durability
+# boundaries uncrashed).
+CRASH_INDEXES = list(range(10))
 
 
 def _run(home: str, height: int, fail_index: int | None, timeout: float = 120.0):
