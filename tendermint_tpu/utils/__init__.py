@@ -1,4 +1,8 @@
 """Shared utilities."""
-from tendermint_tpu.utils.sigbatch import make_sig_batch
+from tendermint_tpu.utils.sigbatch import (
+    make_sig_batch,
+    straddle_tampers,
+    tiled_tampered_batch,
+)
 
-__all__ = ["make_sig_batch"]
+__all__ = ["make_sig_batch", "straddle_tampers", "tiled_tampered_batch"]

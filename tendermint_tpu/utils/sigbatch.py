@@ -29,3 +29,32 @@ def make_sig_batch(
         msgs.append(msg)
         sigs.append(bytes(sig))
     return pubs, msgs, sigs
+
+
+def straddle_tampers(n: int, n_shards: int) -> set[int]:
+    """Tamper indexes at every shard boundary of an n-lane batch split
+    n_shards ways (last lane of shard k, first lane of shard k+1) plus
+    both batch edges — the lanes a wrong PartitionSpec or off-by-one
+    shard split would misattribute. Shared by tests/test_parallel.py and
+    __graft_entry__.dryrun_multichip."""
+    per = n // n_shards
+    t = {0, n - 1}
+    for k in range(1, n_shards):
+        t.add(k * per - 1)
+        t.add(k * per)
+    return t
+
+
+def tiled_tampered_batch(n: int, tampers: set[int], n_unique: int = 512):
+    """n triples tiled from n_unique real keypairs, with the signatures at
+    `tampers` flipped in the scalar S (the low bit of byte 32): the
+    corruption survives structural prechecks and must be caught by the
+    curve equation itself."""
+    pubs, msgs, sigs = make_sig_batch(min(n, n_unique))
+    reps = -(-n // len(pubs))
+    pubs, msgs, sigs = ((x * reps)[:n] for x in (pubs, msgs, sigs))
+    sigs = [
+        s[:32] + bytes([s[32] ^ 1]) + s[33:] if i in tampers else s
+        for i, s in enumerate(sigs)
+    ]
+    return pubs, msgs, sigs
