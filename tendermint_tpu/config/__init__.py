@@ -134,6 +134,11 @@ class InstrumentationConfig:
     prometheus: bool = False
     prometheus_listen_addr: str = ":26660"
     namespace: str = "tendermint"
+    # Event-loop liveness watchdog (libs/watchdog.py — the deadlock-mutex
+    # analog, SURVEY §5): ping every `watchdog_interval` s, dump all task
+    # + thread stacks when unserviced for `watchdog_grace` s. 0 = off.
+    watchdog_interval: float = 0.0
+    watchdog_grace: float = 10.0
 
 
 @dataclass
@@ -235,6 +240,11 @@ def make_test_config(root_dir: str) -> Config:
         peer_gossip_sleep_duration=0.01,
         peer_query_maj23_sleep_duration=0.25,
     )
+    # every test node runs the loop watchdog (SURVEY §5 deadlock tooling:
+    # the reference runs all tests under -race + a deadlock mutex; here a
+    # stalled loop dumps task stacks instead of timing out opaquely)
+    cfg.instrumentation.watchdog_interval = 2.0
+    cfg.instrumentation.watchdog_grace = 30.0
     os.makedirs(os.path.join(root_dir, "data"), exist_ok=True)
     os.makedirs(os.path.join(root_dir, "config"), exist_ok=True)
     return cfg

@@ -282,7 +282,12 @@ class ConsensusState(BaseService):
                 asyncio.get_event_loop().time()
                 + max(self.config.vote_batch_max_window, window)
             )
-            target = min(hint, cap)
+            # the accumulation target can never exceed what the net can
+            # produce: a (height, round) has at most validator-set-size
+            # votes per type (x2 for prevote+precommit interleave), so a
+            # small net's batch completes at set size instead of chasing
+            # the device hint it can never reach
+            target = min(hint, cap, max(2 * self.rs.validators.size(), 8))
             while True:
                 before = len(batch)
                 await asyncio.sleep(window)
@@ -294,10 +299,11 @@ class ConsensusState(BaseService):
                     or now >= deadline
                 ):
                     break
-                # a steady sub-hint trickle must not pin every batch to the
-                # full max window (ADVICE r3): stop early when the observed
-                # arrival rate cannot plausibly reach the hint by the
-                # deadline — the trickle is the workload, not a burst edge
+                # a steady sub-target trickle must not pin every batch to
+                # the full max window (ADVICE r3): stop early when the
+                # observed arrival rate cannot plausibly reach the target
+                # by the deadline — the trickle is the workload, not a
+                # burst edge
                 arrived = len(batch) - before
                 projected = arrived * max((deadline - now) / window, 0.0)
                 if len(batch) + projected < target:

@@ -1,0 +1,156 @@
+"""Liveness watchdog + thread-hygiene checks — the framework's analog of
+the reference's race/deadlock tooling.
+
+The reference runs every unit test under Go's race detector
+(test/test_cover.sh:9), swaps sync.Mutex for a deadlock-detecting mutex
+in a dedicated CI target (Makefile:330), and asserts goroutine leaks with
+leaktest. CPython has no data-race detector, and this codebase is
+deliberately single-loop asyncio — the few real threads (kcache export
+writers, the verdict-fetch pool, native batch workers inside C++) never
+share Python mutable state without a lock. The equivalent hazards here
+are:
+
+1. **Event-loop stalls / deadlocks** — a blocking call or lock cycle on
+   the one loop freezes the whole node silently. `LoopWatchdog` pings the
+   loop from a daemon thread; if a ping isn't serviced within the grace
+   window it dumps every task's stack (the "deadlock mutex" analog:
+   you get WHERE it is stuck, not a hang).
+2. **Thread leaks** — a non-daemon thread spawned during a test or a
+   node run that outlives its scope (the leaktest analog).
+   `thread_snapshot`/`assert_no_new_threads` are wired into the test
+   suite as an autouse fixture (tests/conftest.py).
+
+`LoopWatchdog` is mounted by the node when
+`config.instrumentation.watchdog_interval > 0` and always in the
+subprocess testnet tier, so CI catches deadlocks as stack dumps instead
+of opaque timeouts.
+"""
+from __future__ import annotations
+
+import asyncio
+import sys
+import threading
+import traceback
+
+
+class LoopWatchdog:
+    """Detects a stalled/deadlocked event loop and dumps task stacks.
+
+    A daemon thread schedules a no-op on the loop every `interval`
+    seconds; if the loop fails to run it within `grace` seconds, the
+    watchdog writes every asyncio task's stack plus every thread's stack
+    to `out` (stderr by default) — once per stall episode — and keeps
+    watching (the loop may recover; a node-level policy can choose to
+    halt instead via `on_stall`).
+    """
+
+    def __init__(
+        self,
+        loop: asyncio.AbstractEventLoop,
+        interval: float = 2.0,
+        grace: float = 10.0,
+        out=None,
+        on_stall=None,
+    ) -> None:
+        self.loop = loop
+        self.interval = interval
+        self.grace = grace
+        self.out = out if out is not None else sys.stderr
+        self.on_stall = on_stall
+        self.stalls = 0  # stall episodes observed (monotonic)
+        self._pong = threading.Event()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._in_stall = False
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="loop-watchdog", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.grace)
+            self._thread = None
+
+    # ------------------------------------------------------------ internals
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            self._pong.clear()
+            try:
+                self.loop.call_soon_threadsafe(self._pong.set)
+            except RuntimeError:
+                return  # loop closed: nothing left to watch
+            if self._pong.wait(self.grace):
+                self._in_stall = False
+                continue
+            if self._stop.is_set():
+                return
+            if not self._in_stall:  # report once per episode
+                self._in_stall = True
+                self.stalls += 1
+                self._dump()
+                if self.on_stall is not None:
+                    try:
+                        self.on_stall()
+                    except Exception:  # noqa: BLE001 — diagnostics only
+                        pass
+
+    def _dump(self) -> None:
+        w = self.out.write
+        w(
+            f"\n=== loop-watchdog: event loop unresponsive for "
+            f">{self.grace:.0f}s — task stacks ===\n"
+        )
+        try:
+            tasks = asyncio.all_tasks(self.loop)
+        except RuntimeError:
+            tasks = set()
+        for task in tasks:
+            w(f"--- task {task.get_name()} ---\n")
+            for frame in task.get_stack(limit=12):
+                for line in traceback.format_stack(frame, limit=1):
+                    w(line)
+        w("=== thread stacks ===\n")
+        frames = sys._current_frames()
+        for t in threading.enumerate():
+            frame = frames.get(t.ident)
+            if frame is None or t is threading.current_thread():
+                continue
+            w(f"--- thread {t.name} ---\n")
+            w("".join(traceback.format_stack(frame, limit=12)))
+        w("=== end watchdog dump ===\n")
+        try:
+            self.out.flush()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+# ------------------------------------------------------- thread hygiene
+
+
+def thread_snapshot() -> set[int]:
+    """Idents of currently-live threads (leaktest-style baseline)."""
+    return {t.ident for t in threading.enumerate()}
+
+
+def new_threads_since(baseline: set[int], include_daemon: bool = False):
+    """Threads that appeared since `baseline` and are still alive.
+
+    Non-daemon leaks are always reported; daemon threads only with
+    `include_daemon` (the kcache/native pools are deliberately daemon —
+    they must never block process exit, which is exactly what this check
+    enforces for everything else)."""
+    out = []
+    for t in threading.enumerate():
+        if t.ident in baseline or not t.is_alive():
+            continue
+        if t.daemon and not include_daemon:
+            continue
+        out.append(t)
+    return out

@@ -335,6 +335,30 @@ class Node(BaseService):
     async def on_start(self) -> None:
         if not self._built:
             await self.build()
+        # Eager tasks (3.12+): a spawned coroutine that finishes without
+        # suspending never touches the scheduler. The node's hot path
+        # (WS batch dispatch -> CheckTx against a local app) is exactly
+        # that shape — profile r4: ~4 task creations per tx were pure
+        # event-loop overhead on a 1-vCPU host.
+        loop = asyncio.get_running_loop()
+        self._installed_task_factory = False
+        if hasattr(asyncio, "eager_task_factory") and (
+            loop.get_task_factory() is None
+        ):
+            loop.set_task_factory(asyncio.eager_task_factory)
+            self._installed_task_factory = True
+        # Liveness watchdog (SURVEY §5 deadlock-tooling analog): a stalled
+        # loop dumps every task/thread stack instead of hanging silently
+        self.watchdog = None
+        if self.config.instrumentation.watchdog_interval > 0:
+            from tendermint_tpu.libs.watchdog import LoopWatchdog
+
+            self.watchdog = LoopWatchdog(
+                loop,
+                interval=self.config.instrumentation.watchdog_interval,
+                grace=self.config.instrumentation.watchdog_grace,
+            )
+            self.watchdog.start()
         # RPC first (reference node.go:729 — receive txs before p2p is up)
         await self.rpc_server.start()
         if self.grpc_server is not None:
@@ -353,6 +377,14 @@ class Node(BaseService):
             await self.switch.dial_peers_async(addrs, persistent=True)
 
     async def on_stop(self) -> None:
+        if getattr(self, "watchdog", None) is not None:
+            self.watchdog.stop()
+            self.watchdog = None
+        if getattr(self, "_installed_task_factory", False):
+            # undo the process-global side effect: code sharing this loop
+            # after the node stops must not inherit eager semantics
+            asyncio.get_running_loop().set_task_factory(None)
+            self._installed_task_factory = False
         await self.switch.stop()
         await self.rpc_server.stop()
         if self.grpc_server is not None:

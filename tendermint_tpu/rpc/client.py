@@ -229,6 +229,18 @@ class WSClient:
     async def call(self, method: str, **params):
         return await self._send_call(method, params)
 
+    def call_nowait(self, method: str, **params) -> "asyncio.Future":
+        """Pipelined call: queue the frame, return the response future
+        without draining. Callers batch `drain()` across many sends —
+        the reference tm-bench's continuous-flood pattern
+        (tools/tm-bench/transacter.go)."""
+        if not self._connected.is_set():
+            raise ConnectionError("websocket not connected")
+        return self._send_nowait(method, params)
+
+    async def drain(self) -> None:
+        await self._writer.drain()
+
     async def wait_connected(self, timeout: float = 30.0) -> None:
         async with asyncio.timeout(timeout):
             await self._connected.wait()

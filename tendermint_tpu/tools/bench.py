@@ -62,25 +62,51 @@ class Transacter:
         self.conn_idx = conn_idx
         self.sent = 0
 
+    WINDOW = 256  # in-flight responses per connection
+    DRAIN_EVERY = 32  # frames queued between writer drains
+
     async def run(self, duration: int, stop: asyncio.Event) -> None:
+        from collections import deque
+
         ws = WSClient(self.host, self.port)
         await ws.connect()
+        window: deque = deque()
         try:
             end = time.monotonic() + duration
             while time.monotonic() < end and not stop.is_set():
                 batch_start = time.monotonic()
-                for _ in range(self.rate):
+                for i in range(self.rate):
                     tx = self._make_tx()
-                    # fire-and-forget: don't wait for the result frame
-                    await ws.call("broadcast_tx_async", tx=tx.hex())
+                    # pipelined: queue the frame and keep going — the
+                    # reference tm-bench floods its websocket without
+                    # waiting per tx (transacter.go); a closed per-tx
+                    # request loop measures round-trip latency, not node
+                    # throughput
+                    window.append(
+                        ws.call_nowait("broadcast_tx_async", tx=tx.hex())
+                    )
                     self.sent += 1
+                    if len(window) % self.DRAIN_EVERY == 0:
+                        await ws.drain()
+                    while len(window) >= self.WINDOW:
+                        await window.popleft()
                     if stop.is_set() or time.monotonic() >= end:
                         return
+                await ws.drain()
                 # pace to 1s per batch
                 elapsed = time.monotonic() - batch_start
                 if elapsed < 1.0:
                     await asyncio.sleep(1.0 - elapsed)
         finally:
+            if window:
+                try:
+                    # a node whose loop stalled (socket open, no answers)
+                    # must not hang the benchmark report forever
+                    async with asyncio.timeout(10.0):
+                        await asyncio.gather(*window, return_exceptions=True)
+                except TimeoutError:
+                    for f in window:
+                        f.cancel()
             await ws.close()
 
     def _make_tx(self) -> bytes:

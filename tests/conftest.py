@@ -92,3 +92,28 @@ def pytest_configure(config):
         "allow_task_leak: test intentionally leaves asyncio tasks pending "
         "at return (cleaned up by asyncio.run cancellation)",
     )
+
+
+@pytest.fixture(autouse=True)
+def no_thread_leaks():
+    """leaktest analog for OS threads (SURVEY §5 race tooling; the task
+    version lives in pytest_pyfunc_call): a test must not leave new
+    NON-daemon threads alive — they would block process exit, which is
+    the exact hang class the reference's leaktest exists to catch.
+    Daemon pool threads (kcache export writers, verdict-fetch pool) are
+    exempt by design: they are allowed to outlive a test but can never
+    block exit."""
+    from tendermint_tpu.libs.watchdog import new_threads_since, thread_snapshot
+
+    before = thread_snapshot()
+    yield
+    leaked = new_threads_since(before)
+    if leaked:
+        # one join pass: a thread mid-teardown gets 2s to finish
+        for t in leaked:
+            t.join(timeout=2.0)
+        leaked = new_threads_since(before)
+    assert not leaked, (
+        f"leaked non-daemon threads: {[t.name for t in leaked]} "
+        "(join your threads, or make deliberately-outliving pools daemon)"
+    )

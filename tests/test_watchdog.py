@@ -1,0 +1,134 @@
+"""Liveness watchdog tests (libs/watchdog.py — SURVEY §5 race/deadlock
+tooling analog; reference: Makefile:330 deadlock-mutex target, leaktest)."""
+import asyncio
+import io
+import threading
+import time
+
+from tendermint_tpu.libs.watchdog import (
+    LoopWatchdog,
+    new_threads_since,
+    thread_snapshot,
+)
+
+
+class TestLoopWatchdog:
+    def test_healthy_loop_never_fires(self):
+        async def main():
+            out = io.StringIO()
+            wd = LoopWatchdog(
+                asyncio.get_running_loop(), interval=0.05, grace=0.5, out=out
+            )
+            wd.start()
+            try:
+                await asyncio.sleep(0.4)
+            finally:
+                wd.stop()
+            assert wd.stalls == 0
+            assert out.getvalue() == ""
+
+        asyncio.run(main())
+
+    def test_blocked_loop_dumps_task_stacks(self):
+        async def main():
+            out = io.StringIO()
+            wd = LoopWatchdog(
+                asyncio.get_running_loop(), interval=0.05, grace=0.3, out=out
+            )
+            wd.start()
+
+            async def innocent_bystander():
+                await asyncio.sleep(30)
+
+            task = asyncio.ensure_future(innocent_bystander())
+            task.set_name("bystander-task")
+            await asyncio.sleep(0.1)  # let the watchdog see a healthy loop
+            try:
+                # a deadlock stand-in: block the loop thread outright
+                time.sleep(1.0)
+                await asyncio.sleep(0.2)  # let the watchdog thread report
+            finally:
+                wd.stop()
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+            dump = out.getvalue()
+            assert wd.stalls >= 1
+            assert "event loop unresponsive" in dump
+            assert "bystander-task" in dump  # the stuck task is identified
+            assert "innocent_bystander" in dump  # with its stack frame
+
+        asyncio.run(main())
+
+    def test_stall_callback_fires_once_per_episode(self):
+        async def main():
+            hits = []
+            wd = LoopWatchdog(
+                asyncio.get_running_loop(),
+                interval=0.05,
+                grace=0.25,
+                out=io.StringIO(),
+                on_stall=lambda: hits.append(1),
+            )
+            wd.start()
+            try:
+                time.sleep(0.8)  # one long stall episode
+                await asyncio.sleep(0.2)
+            finally:
+                wd.stop()
+            assert len(hits) == 1, hits
+
+        asyncio.run(main())
+
+    def test_node_mounts_watchdog_from_config(self, tmp_path):
+        """config.instrumentation.watchdog_interval > 0 -> the node runs a
+        watchdog; it is torn down on stop."""
+        from tendermint_tpu.config import make_test_config
+
+        cfg = make_test_config(str(tmp_path))
+        assert cfg.instrumentation.watchdog_interval > 0  # on for tests
+
+        from test_node_rpc import make_node
+
+        async def main():
+            node = make_node(str(tmp_path))
+            await node.start()
+            try:
+                assert node.watchdog is not None
+                assert node.watchdog._thread is not None
+            finally:
+                await node.stop()
+            assert node.watchdog is None
+
+        asyncio.run(main())
+
+
+class TestThreadHygiene:
+    def test_snapshot_detects_new_nondaemon_thread(self):
+        before = thread_snapshot()
+        stop = threading.Event()
+        t = threading.Thread(target=stop.wait, name="leak-me")
+        t.start()
+        try:
+            leaked = new_threads_since(before)
+            assert [x.name for x in leaked] == ["leak-me"]
+        finally:
+            stop.set()
+            t.join()
+        assert new_threads_since(before) == []
+
+    def test_daemon_threads_exempt_by_default(self):
+        before = thread_snapshot()
+        stop = threading.Event()
+        t = threading.Thread(target=stop.wait, name="daemon-pool", daemon=True)
+        t.start()
+        try:
+            assert new_threads_since(before) == []
+            assert [x.name for x in new_threads_since(before, include_daemon=True)] == [
+                "daemon-pool"
+            ]
+        finally:
+            stop.set()
+            t.join()
