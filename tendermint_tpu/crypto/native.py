@@ -52,7 +52,23 @@ def load():
         return _lib
     if _load_error is not None:
         return None
-    if not os.path.exists(_SO_PATH) and not _build():
+    so_exists = os.path.exists(_SO_PATH)
+    if so_exists:
+        # rebuild a STALE .so (any source newer than it): the library is
+        # gitignored, so after a pull the existing binary may silently
+        # predate the sources — running verification through old code
+        srcs = [
+            os.path.join(_NATIVE_DIR, f)
+            for f in os.listdir(_NATIVE_DIR)
+            if f.endswith((".cpp", ".h"))
+        ]
+        try:
+            so_mtime = os.path.getmtime(_SO_PATH)
+            if any(os.path.getmtime(s) > so_mtime for s in srcs):
+                _build()  # failure keeps the old .so: degraded, not broken
+        except OSError:
+            pass
+    elif not _build():
         _load_error = "no toolchain / build failed"
         return None
     try:

@@ -179,6 +179,35 @@ class TestStraussEdgeCases:
             nat = native.secp256k1_verify_batch([pub.bytes()], [m], [sig])[0]
             assert py == nat, (sig.hex(), py, nat)
 
+    def test_secp_glv_constants_validated(self):
+        """The GLV endomorphism path must have passed its startup
+        self-checks (lambda order, basis rows, split algebra sweep,
+        phi(G) == [lambda]G) — a silent fallback to the 2-stream loop
+        would be a perf regression masquerading as success."""
+        lib = native.load()
+        assert lib.tm_secp256k1_glv_active() == 1
+
+    def test_secp_glv_parity_large_corpus(self):
+        """256 randomized verifies (valid/corrupt mixed) through the GLV
+        4-stream path vs the OpenSSL oracle."""
+        import random
+
+        rng = random.Random(20260731)
+        pubs, msgs, sigs, expect = [], [], [], []
+        for _ in range(256):
+            pk = secp256k1.gen_priv_key()
+            m = bytes(rng.randrange(256) for _ in range(rng.randrange(1, 48)))
+            sig = pk.sign(m)
+            if rng.random() < 0.4:
+                b = bytearray(sig)
+                b[rng.randrange(64)] ^= 1 << rng.randrange(8)
+                sig = bytes(b)
+            pubs.append(pk.pub_key().bytes())
+            msgs.append(m)
+            sigs.append(sig)
+            expect.append(pk.pub_key().verify(m, sig))
+        assert native.secp256k1_verify_batch(pubs, msgs, sigs) == expect
+
     def test_ed25519_identity_edge(self):
         # s = 0, h arbitrary: P = [0]B + [h](-A); verify must simply
         # return False for a zero signature, never crash in the wNAF.
