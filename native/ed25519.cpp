@@ -16,6 +16,7 @@
 #include <thread>
 #include <unordered_map>
 #include "wnaf.h"
+#include "pubcache.h"
 #include <vector>
 #include <ctime>
 #include <dlfcn.h>
@@ -636,86 +637,24 @@ void sha512_oneshot(const uint8_t* data, size_t len, uint8_t out[64]) {
     }
 }
 
-// Keyed mix over all 32 bytes: pubkey bytes are attacker-chosen (invalid
-// keys are cached too), so an unkeyed/truncated hash would let a peer
-// collide every cache entry into one chain (hash-flooding DoS).
-inline uint64_t pub_hash_seed() {
-    static const uint64_t seed = [] {
-        uint64_t s = 0x243F6A8885A308D3ull;  // fallback: pi digits
-        timespec t;
-        if (clock_gettime(CLOCK_MONOTONIC, &t) == 0)
-            s ^= ((uint64_t)t.tv_sec << 32) ^ (uint64_t)t.tv_nsec;
-        s ^= (uint64_t)(uintptr_t)&s;  // ASLR entropy
-        return s;
-    }();
-    return seed;
-}
-
-struct PubHash {
-    size_t operator()(const std::array<uint8_t, 32>& k) const {
-        uint64_t h = pub_hash_seed();
-        for (int i = 0; i < 4; i++) {
-            uint64_t w;
-            memcpy(&w, k.data() + 8 * i, 8);
-            h = (h ^ w) * 0x9E3779B97F4A7C15ull;  // splitmix64-style round
-            h ^= h >> 29;
-        }
-        return (size_t)h;
-    }
-};
-
-struct PubCacheShard {
-    std::mutex mtx;
-    // pubkey -> 96-byte x||y||t of -A (canonical LE) + valid flag
-    std::unordered_map<std::array<uint8_t, 32>, std::array<uint8_t, 97>,
-                       PubHash> map;
-};
-
+// pubkey -> 96-byte x||y||t of -A (canonical LE); the sharded keyed-hash
+// pattern (incl. junk-key eviction priority) lives in pubcache.h, shared
+// with the secp256k1 core.
 struct PubCache {
-    static const size_t NSHARD = 16, SHARD_CAP = 8192;
-    PubCacheShard shards[NSHARD];
+    ShardedPubCache<32, 96> inner;
 
     // returns true if key decompresses; writes 96 bytes of -A into out
     bool get(const uint8_t pub[32], uint8_t out[96]) {
-        std::array<uint8_t, 32> key;
-        memcpy(key.data(), pub, 32);
-        // shard by the keyed hash, not raw bytes: pub[0] is attacker-chosen
-        PubCacheShard& sh = shards[PubHash{}(key) & (NSHARD - 1)];
-        {
-            std::lock_guard<std::mutex> g(sh.mtx);
-            auto it = sh.map.find(key);
-            if (it != sh.map.end()) {
-                if (!it->second[96]) return false;
-                memcpy(out, it->second.data(), 96);
-                return true;
-            }
-        }
-        std::array<uint8_t, 97> entry{};
-        Point A;
-        bool ok = pt_frombytes(A, pub);
-        if (ok) {
+        return inner.get(pub, out, [](const uint8_t* k, uint8_t* v) {
+            Point A;
+            if (!pt_frombytes(A, k)) return false;
             Point negA;
             pt_neg(negA, A);
-            Fe t;
-            fe_tobytes(entry.data(), negA.X);
-            fe_tobytes(entry.data() + 32, negA.Y);
-            fe_copy(t, negA.T);
-            fe_tobytes(entry.data() + 64, t);
-            entry[96] = 1;
-            memcpy(out, entry.data(), 96);
-        }
-        std::lock_guard<std::mutex> g(sh.mtx);
-        if (sh.map.size() >= SHARD_CAP) {
-            // Evict failed-decompression (junk-key) entries first so a peer
-            // spraying invalid pubkeys can't flush the hot validator keys.
-            for (auto it = sh.map.begin(); it != sh.map.end();) {
-                if (!it->second[96]) it = sh.map.erase(it);
-                else ++it;
-            }
-            if (sh.map.size() >= SHARD_CAP) sh.map.clear();
-        }
-        sh.map.emplace(key, entry);
-        return ok;
+            fe_tobytes(v, negA.X);
+            fe_tobytes(v + 32, negA.Y);
+            fe_tobytes(v + 64, negA.T);
+            return true;
+        });
     }
 };
 
@@ -812,8 +751,16 @@ extern "C" void tm_ed25519_prepare_batch(
 extern "C" int tm_ed25519_verify(const uint8_t pub[32], const uint8_t* msg,
                                  size_t msglen, const uint8_t sig[64]) {
     if (!sc_canonical(sig + 32)) return 0;  // non-canonical s (malleability)
-    Point A;
-    if (!pt_frombytes(A, pub)) return 0;
+    // -A via the decompression cache: a stable validator set pays the
+    // sqrt once per key, not once per vote (g_pub_cache is shared with
+    // the TPU batch-prep path, which caches the same -A representation)
+    uint8_t nega_b[96];
+    if (!g_pub_cache.get(pub, nega_b)) return 0;
+    Point negA;
+    fe_frombytes(negA.X, nega_b);
+    fe_frombytes(negA.Y, nega_b + 32);
+    fe_one(negA.Z);
+    fe_frombytes(negA.T, nega_b + 64);
     Point Rpt;
     if (!pt_frombytes(Rpt, sig)) return 0;  // R must be a valid point
     ensure_b_table();
@@ -829,8 +776,7 @@ extern "C" int tm_ed25519_verify(const uint8_t pub[32], const uint8_t* msg,
 
     // check [s]B == R + [h]A  <=>  [s]B + [h](-A) == R  (sig = R || s)
     // wNAF(5) table of odd multiples [1,3,...,15](-A), extended coords
-    Point negA, nA2;
-    pt_neg(negA, A);
+    Point nA2;
     pt_double(nA2, negA);
     Point a_tab[8];
     a_tab[0] = negA;

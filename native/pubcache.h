@@ -1,0 +1,107 @@
+// Sharded keyed-hash cache of per-pubkey decompression results, shared by
+// the ed25519 and secp256k1 cores. In production the same validator set
+// verifies every height, so the point-decompression square root (~10-14us
+// of every verify) amortizes to a cache hit.
+//
+// Security notes carried over from the original ed25519 cache:
+// - the hash is KEYED with per-process entropy: cache keys are
+//   attacker-chosen bytes (a gossip peer controls pubkeys it claims), so
+//   an unkeyed hash would allow hash-flooding one shard's chain;
+// - failed-decompression (junk-key) entries are evicted first when a
+//   shard fills, so spraying invalid pubkeys cannot flush the hot
+//   validator keys.
+#pragma once
+#include <array>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+#include <mutex>
+#include <unordered_map>
+
+namespace tmnative {
+
+inline uint64_t pubcache_hash_seed() {
+    static const uint64_t seed = [] {
+        uint64_t s = 0x243F6A8885A308D3ull;  // fallback: pi digits
+        timespec t;
+        if (clock_gettime(CLOCK_MONOTONIC, &t) == 0)
+            s ^= ((uint64_t)t.tv_sec << 32) ^ (uint64_t)t.tv_nsec;
+        s ^= (uint64_t)(uintptr_t)&s;  // ASLR entropy
+        return s;
+    }();
+    return seed;
+}
+
+template <size_t KEY_LEN, size_t VAL_LEN>
+struct ShardedPubCache {
+    using Key = std::array<uint8_t, KEY_LEN>;
+    using Val = std::array<uint8_t, VAL_LEN + 1>;  // +1: valid flag
+
+    struct Hash {
+        size_t operator()(const Key& k) const {
+            uint64_t h = pubcache_hash_seed();
+            size_t i = 0;
+            for (; i + 8 <= KEY_LEN; i += 8) {
+                uint64_t w;
+                memcpy(&w, k.data() + i, 8);
+                h = (h ^ w) * 0x9E3779B97F4A7C15ull;  // splitmix64 round
+                h ^= h >> 29;
+            }
+            if (i < KEY_LEN) {
+                uint64_t w = 0;
+                memcpy(&w, k.data() + i, KEY_LEN - i);
+                h = (h ^ w) * 0x9E3779B97F4A7C15ull;
+                h ^= h >> 29;
+            }
+            return (size_t)h;
+        }
+    };
+
+    static const size_t NSHARD = 16;
+    struct Shard {
+        std::mutex mtx;
+        std::unordered_map<Key, Val, Hash> map;
+    };
+    Shard shards[NSHARD];
+    size_t shard_cap;
+
+    explicit ShardedPubCache(size_t cap = 8192) : shard_cap(cap) {}
+
+    // compute: bool(const uint8_t* key, uint8_t* out_val) — runs outside
+    // the shard lock on a miss; its result (incl. failure) is cached.
+    // Returns compute's verdict; on success `out` holds VAL_LEN bytes.
+    template <typename Fn>
+    bool get(const uint8_t* key_bytes, uint8_t* out, Fn&& compute) {
+        Key key;
+        memcpy(key.data(), key_bytes, KEY_LEN);
+        // shard by the keyed hash, not raw bytes: byte 0 is attacker-chosen
+        Shard& sh = shards[Hash{}(key) & (NSHARD - 1)];
+        {
+            std::lock_guard<std::mutex> g(sh.mtx);
+            auto it = sh.map.find(key);
+            if (it != sh.map.end()) {
+                if (!it->second[VAL_LEN]) return false;
+                memcpy(out, it->second.data(), VAL_LEN);
+                return true;
+            }
+        }
+        Val entry{};
+        bool ok = compute(key_bytes, entry.data());
+        if (ok) {
+            entry[VAL_LEN] = 1;
+            memcpy(out, entry.data(), VAL_LEN);
+        }
+        std::lock_guard<std::mutex> g(sh.mtx);
+        if (sh.map.size() >= shard_cap) {
+            for (auto it = sh.map.begin(); it != sh.map.end();) {
+                if (!it->second[VAL_LEN]) it = sh.map.erase(it);
+                else ++it;
+            }
+            if (sh.map.size() >= shard_cap) sh.map.clear();
+        }
+        sh.map.emplace(key, entry);
+        return ok;
+    }
+};
+
+}  // namespace tmnative

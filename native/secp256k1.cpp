@@ -18,6 +18,7 @@
 // round-4 native-core table has the numbers.
 #include <cstdint>
 #include <cstring>
+#include "pubcache.h"
 #include "sha2.h"
 #include "wnaf.h"
 
@@ -829,8 +830,23 @@ extern "C" int tm_secp256k1_verify(const uint8_t pub[33], const uint8_t* msg,
     if (sc_cmp_raw(sraw, N) >= 0) return 0;
     if (sc_cmp_raw(sraw, NHALF) > 0) return 0;  // high-S malleability
 
+    // decompressed Q via the per-pubkey cache: a stable validator set
+    // pays the sqrt once per key, not once per signature
+    static ShardedPubCache<33, 64> q_cache;
+    uint8_t q_b[64];
+    if (!q_cache.get(pub, q_b, [](const uint8_t* k, uint8_t* v) {
+            Jac P0;
+            if (!point_decompress(P0, k)) return false;
+            fp_tobytes_be(v, P0.X);       // Z = 1 at decompression
+            fp_tobytes_be(v + 32, P0.Y);
+            return true;
+        }))
+        return 0;
     Jac Q;
-    if (!point_decompress(Q, pub)) return 0;
+    fp_frombytes_be(Q.X, q_b);
+    fp_frombytes_be(Q.Y, q_b + 32);
+    memset(&Q.Z, 0, sizeof Q.Z);
+    Q.Z.v[0] = 1;
 
     uint8_t digest[32];
     sha256(msg, msglen, digest);
