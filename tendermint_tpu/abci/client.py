@@ -134,9 +134,9 @@ class SocketClient(Client):
             self._encode_frame = _encode_cbe
 
             async def read_one():
-                hdr = await self._reader.readexactly(4)
-                (ln,) = struct.unpack(">I", hdr)
-                return decode_response(await self._reader.readexactly(ln))
+                return decode_response(
+                    await abci.read_cbe_frame(self._reader)
+                )
 
         self._read_one = read_one
         self._reader: asyncio.StreamReader | None = None

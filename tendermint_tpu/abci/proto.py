@@ -37,9 +37,8 @@ from dataclasses import dataclass, field
 from typing import Any, Callable
 
 from tendermint_tpu.abci import types as abci
-from tendermint_tpu.encoding import DecodeError
-
-MAX_MSG_SIZE = 104857600  # reference abci/types/messages.go maxMsgSize
+from tendermint_tpu.abci.types import MAX_MSG_SIZE
+from tendermint_tpu.encoding import DecodeError, as_decode_error
 
 
 # ---------------------------------------------------------------- varints
@@ -72,9 +71,15 @@ def decode_uvarint(data: bytes, pos: int) -> tuple[int, int]:
         pos += 1
         val |= (b & 0x7F) << shift
         if not b & 0x80:
+            # Go's binary.ReadUvarint overflow rule: a varint must fit
+            # uint64. Without this, 2^64+k decodes as a silent wrong value
+            # for int fields and an out-of-range int for u64 fields that
+            # only explodes later, outside the wire seam's normalization.
+            if val >= 1 << 64:
+                raise DecodeError("varint overflows 64 bits")
             return val, pos
         shift += 7
-        if shift > 70:
+        if shift >= 70:  # > 10 bytes is malformed even if the value fits
             raise DecodeError("varint too long")
 
 
@@ -170,19 +175,29 @@ class Desc:
                     raise DecodeError(f"{self.name}: truncated field {num}")
                 payload = data[pos : pos + ln]
                 pos += ln
-            elif wt == 5:  # fixed32 (not in this schema; skip)
-                payload = data[pos : pos + 4]
-                pos += 4
-                continue
-            elif wt == 1:  # fixed64 (not in this schema; skip)
-                payload = data[pos : pos + 8]
-                pos += 8
-                continue
+            elif wt in (5, 1):  # fixed32 / fixed64: no field in this
+                # schema uses them — skippable only when UNKNOWN, and the
+                # payload must actually be present (a frame cut mid-field
+                # is malformed, not a default value)
+                n = 4 if wt == 5 else 8
+                if pos + n > len(data):
+                    raise DecodeError(f"{self.name}: truncated field {num}")
+                pos += n
+                payload = None
             else:
                 raise DecodeError(f"{self.name}: bad wire type {wt}")
             if num not in by_num:
                 continue  # unknown field: forward compat
             attr, kind, sub = by_num[num]
+            # wire type must agree with the declared kind: a varint (or
+            # fixed) payload for a length-delimited field — or vice versa —
+            # is malformed bytes, not a value to coerce or silently drop
+            # (fuzz-found: .decode() on int; review-found: known i64 sent
+            # as fixed64 decoded to its default)
+            if wt != (2 if kind in ("str", "bytes", "msg", "rep_msg", "rep_str") else 0):
+                raise DecodeError(
+                    f"{self.name}: field {num} kind {kind} got wire type {wt}"
+                )
             if kind in ("i64", "i32"):
                 v[attr] = _to_signed64(payload)
             elif kind == "u64":
@@ -935,7 +950,7 @@ def encode_request(req) -> bytes:
 
 
 def decode_request(data: bytes):
-    return _decode_oneof(data, _REQ_MAP)
+    return as_decode_error(lambda d: _decode_oneof(d, _REQ_MAP), data, "request")
 
 
 def encode_response(resp) -> bytes:
@@ -943,7 +958,7 @@ def encode_response(resp) -> bytes:
 
 
 def decode_response(data: bytes):
-    return _decode_oneof(data, _RESP_MAP)
+    return as_decode_error(lambda d: _decode_oneof(d, _RESP_MAP), data, "response")
 
 
 # ---------------------------------------------------------------- framing

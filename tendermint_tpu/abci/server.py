@@ -12,6 +12,7 @@ import struct
 
 from tendermint_tpu.abci import types as abci
 from tendermint_tpu.abci.types import decode_request, encode_response
+from tendermint_tpu.encoding import DecodeError
 from tendermint_tpu.libs.service import BaseService
 
 
@@ -61,11 +62,7 @@ class ABCIServer(BaseService):
                 return pb.frame(pb.encode_response(resp))
         else:
 
-            async def read(r):
-                hdr = await r.readexactly(4)
-                (ln,) = struct.unpack(">I", hdr)
-                return await r.readexactly(ln)
-
+            read = abci.read_cbe_frame
             decode = decode_request
 
             def encode(resp):
@@ -83,6 +80,12 @@ class ABCIServer(BaseService):
                 if isinstance(req, abci.RequestFlush):
                     await writer.drain()
         except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        except DecodeError:
+            # malformed client bytes (wrong codec, fuzzer, attacker): drop
+            # this connection; the server keeps serving others — the
+            # reference socket server likewise kills only the offending
+            # conn (abci/server/socket_server.go waitForError path)
             pass
         finally:
             writer.close()

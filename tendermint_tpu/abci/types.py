@@ -10,7 +10,26 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-from tendermint_tpu.encoding import DecodeError, Reader, Writer
+from tendermint_tpu.encoding import DecodeError, Reader, Writer, as_decode_error
+
+# One bound for every ABCI transport (CBE and proto framing): the
+# reference abci/types/messages.go maxMsgSize. A length prefix above this
+# is malformed framing — reject BEFORE waiting on the payload, or one
+# garbage header pins a connection handler forever.
+MAX_MSG_SIZE = 104857600
+
+
+async def read_cbe_frame(reader) -> bytes:
+    """Read one 4-byte-length-prefixed CBE message from an asyncio stream
+    — both ends of the socket protocol (server.py / client.py) use this.
+    Raises asyncio.IncompleteReadError at clean EOF."""
+    import struct
+
+    hdr = await reader.readexactly(4)
+    (ln,) = struct.unpack(">I", hdr)
+    if ln > MAX_MSG_SIZE:
+        raise DecodeError(f"frame length {ln} > max {MAX_MSG_SIZE}")
+    return await reader.readexactly(ln)
 
 CODE_TYPE_OK = 0
 
@@ -451,10 +470,14 @@ def encode_request(req) -> bytes:
 
 
 def decode_request(data: bytes):
+    if not data:
+        raise DecodeError("empty request")
     tag = data[0]
     for t, cls in _REQ_TAGS:
         if t == tag:
-            return _decode_msg(cls, data[1:])
+            return as_decode_error(
+                lambda d: _decode_msg(cls, d), data[1:], "request"
+            )
     raise DecodeError(f"unknown request tag {tag}")
 
 
@@ -466,8 +489,12 @@ def encode_response(resp) -> bytes:
 
 
 def decode_response(data: bytes):
+    if not data:
+        raise DecodeError("empty response")
     tag = data[0]
     for t, cls in _RESP_TAGS:
         if t == tag:
-            return _decode_msg(cls, data[1:])
+            return as_decode_error(
+                lambda d: _decode_msg(cls, d), data[1:], "response"
+            )
     raise DecodeError(f"unknown response tag {tag}")

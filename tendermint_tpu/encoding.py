@@ -71,6 +71,24 @@ class DecodeError(Exception):
     pass
 
 
+def as_decode_error(fn, data, what: str):
+    """Run decoder `fn(data)` normalizing every conversion fault to
+    DecodeError. Malformed (or adversarial) bytes must surface as
+    DecodeError, never a raw fault: str fields can hold invalid UTF-8
+    (UnicodeDecodeError ⊂ ValueError), dict->dataclass converters index
+    into nested messages, and re-packing through Writer raises
+    struct.error on out-of-range ints. Transport loops key their
+    drop-the-connection handling on DecodeError alone and treat anything
+    else as a bug."""
+    try:
+        return fn(data)
+    except DecodeError:
+        raise
+    except (ValueError, KeyError, IndexError, TypeError, OverflowError,
+            struct.error) as e:
+        raise DecodeError(f"malformed {what}: {e!r}") from e
+
+
 class Reader:
     __slots__ = ("_buf", "_pos")
 
