@@ -161,6 +161,11 @@ def main() -> None:
         warm_buckets.add(
             ed25519_batch._pad_to_bucket(min(kcache.MAX_BUCKET, n_total - lo))
         )
+    # also the single-commit / small-commit latency buckets measured below:
+    # without these, their first call pays a ~20s compile inside the timed
+    # region and the "cold valset" label lies (it should measure the key
+    # transfer, not XLA)
+    warm_buckets |= {ed25519_batch._pad_to_bucket(n) for n in (100, 1000, N_COMMIT)}
     kcache.prewarm(sorted(warm_buckets), background=False)
 
     # cold stream: key blocks transferred; warm stream: keys device-resident

@@ -49,10 +49,13 @@ def _repeat_fn(core, k_iters: int):
 
 
 def _time_call(fn, *args) -> float:
-    import jax
+    import numpy as np
 
     t0 = time.perf_counter()
-    jax.block_until_ready(fn(*args))
+    # fetch the (scalar) result: on the axon tunnel block_until_ready
+    # returns without waiting for completion (measured r4: every slope
+    # read 0.0 ms), so the only trustworthy sync is an actual value fetch
+    np.asarray(fn(*args))
     return time.perf_counter() - t0
 
 
@@ -62,6 +65,7 @@ def measure(bucket: int, k_lo: int = 1, k_hi: int = 9):
     prepare_batch pads to its bucket ladder (2560 -> 4096 etc.), so the
     actual on-device shape is returned alongside the timings."""
     import jax
+    import numpy as np
 
     from tendermint_tpu.ops import ed25519_batch
     from tendermint_tpu.utils import make_sig_batch
@@ -78,8 +82,14 @@ def measure(bucket: int, k_lo: int = 1, k_hi: int = 9):
     # and report the shape that actually runs on device
     bucket = packed.shape[1]
     keys_np, sigs_np = ed25519_batch.split(packed)
-    keys_d = jax.device_put(keys_np, dev)
     sigs_d = jax.device_put(sigs_np, dev)
+    # distinct key blocks per repeat (rolled along the batch axis): the
+    # tunnel can result-cache a repeat-identical execute, which would let
+    # min() pick a cached non-measurement
+    keys_reps = [
+        jax.device_put(np.roll(keys_np, r, axis=1), dev) for r in range(3)
+    ]
+    keys_d = keys_reps[0]
 
     variants = {
         "xla-r4": ed25519_batch.verify_core,
@@ -111,8 +121,8 @@ def measure(bucket: int, k_lo: int = 1, k_hi: int = 9):
             _time_call(lo, keys_d, sigs_d)
             _time_call(hi, keys_d, sigs_d)
             compile_s = time.perf_counter() - c0
-            t_lo = min(_time_call(lo, keys_d, sigs_d) for _ in range(3))
-            t_hi = min(_time_call(hi, keys_d, sigs_d) for _ in range(3))
+            t_lo = min(_time_call(lo, k, sigs_d) for k in keys_reps)
+            t_hi = min(_time_call(hi, k, sigs_d) for k in keys_reps)
             per = (t_hi - t_lo) / (k_hi - k_lo)
             if per <= 0:
                 # timing jitter swamped the slope (tiny bucket / noisy
