@@ -38,6 +38,8 @@ import numpy as np
 
 N_COMMIT = 10_000         # validators in the north-star commit
 N_UNIQUE = 512            # unique keypairs; messages differ per commit
+LATENCY_NS = (100, 1000)  # small-validator-count p50 latency sizes; shared
+# by the prewarm set and the measurement loop so they cannot drift apart
 PIPELINE_K = 39           # back-to-back commits for the throughput number:
 # 390k signatures span three MAX_BUCKET chunks, so the stream actually
 # exercises the prep/execute overlap (8 commits fit one launch and
@@ -165,7 +167,9 @@ def main() -> None:
     # without these, their first call pays a ~20s compile inside the timed
     # region and the "cold valset" label lies (it should measure the key
     # transfer, not XLA)
-    warm_buckets |= {ed25519_batch._pad_to_bucket(n) for n in (100, 1000, N_COMMIT)}
+    warm_buckets |= {
+        ed25519_batch._pad_to_bucket(n) for n in (*LATENCY_NS, N_COMMIT)
+    }
     kcache.prewarm(sorted(warm_buckets), background=False)
 
     # cold stream: key blocks transferred; warm stream: keys device-resident
@@ -234,7 +238,7 @@ def main() -> None:
         )
 
     # -- commit-verify p50 at small validator counts (latency metric) ------
-    for n in (100, 1000):
+    for n in LATENCY_NS:
         samples = []
         for k in range(5):
             p, m, s = commits[k % PIPELINE_K]

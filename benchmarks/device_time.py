@@ -87,9 +87,12 @@ def measure(bucket: int, k_lo: int = 1, k_hi: int = 9):
     # tunnel can result-cache a repeat-identical execute, which would let
     # min() pick a cached non-measurement
     keys_reps = [
-        jax.device_put(np.roll(keys_np, r, axis=1), dev) for r in range(3)
+        jax.device_put(np.roll(keys_np, r, axis=1), dev) for r in range(4)
     ]
-    keys_d = keys_reps[0]
+    # warmup-only block: the timed min() below must never see an
+    # (executable, inputs) pair that already executed, or a result-cache
+    # hit masquerades as the measurement
+    warm_keys = keys_reps.pop()
 
     variants = {
         "xla-r4": ed25519_batch.verify_core,
@@ -118,8 +121,8 @@ def measure(bucket: int, k_lo: int = 1, k_hi: int = 9):
             hi = _repeat_fn(core_call, k_hi)
             # compile both outside the timed region
             c0 = time.perf_counter()
-            _time_call(lo, keys_d, sigs_d)
-            _time_call(hi, keys_d, sigs_d)
+            _time_call(lo, warm_keys, sigs_d)
+            _time_call(hi, warm_keys, sigs_d)
             compile_s = time.perf_counter() - c0
             t_lo = min(_time_call(lo, k, sigs_d) for k in keys_reps)
             t_hi = min(_time_call(hi, k, sigs_d) for k in keys_reps)

@@ -62,15 +62,28 @@ run_step() {
 }
 
 PREWARM_PY='
+import sys, time
 from tendermint_tpu.ops import kcache
 kcache.enable_persistent_cache()
 kcache.suppress_background_warm()
-kcache.prewarm([131072], background=False)
-print("prewarm done")
+b = int(sys.argv[1])
+t0 = time.time()
+kcache.prewarm([b], background=False)
+print(f"bucket {b} warm in {time.time()-t0:.1f}s", flush=True)
 '
 
+# Every bucket the sequence compiles, ascending: bench needs 128 (100-val
+# commit), 1024 (1000-val), 12288 (pad of one 10k commit), 131072 (stream
+# chunks); baseline config 3 adds 2048 (1040 sigs). Small buckets compile
+# in well under a minute, so a brief window banks several.
+PREWARM_BUCKETS="128 1024 2048 12288 131072"
+
 all_done() {
-    for s in prewarm bench1 bench2 artifact kernel_ab device_time baseline; do
+    local s
+    for b in $PREWARM_BUCKETS; do
+        [ -e "$OUT/done.prewarm_$b" ] || return 1
+    done
+    for s in bench1 bench2 artifact kernel_ab device_time baseline; do
         [ -e "$OUT/done.$s" ] || return 1
     done
     return 0
@@ -80,8 +93,16 @@ log "watch started (round $ROUND)"
 while true; do
     if probe; then
         log "TUNNEL UP — running sequence (resumes at first incomplete step)"
-        # 1. warm kernel caches for the bench bucket (cold compile ~2-4 min)
-        run_step prewarm 900 python -c "$PREWARM_PY" || continue
+        # 1. warm kernel caches INCREMENTALLY, smallest bucket first: each
+        #    completed compile lands in the persistent XLA cache + export
+        #    blobs immediately, so a window that dies mid-sequence still
+        #    banks every finished bucket (the 03:16 r4 window died inside
+        #    a monolithic 131072 prewarm and banked nothing). The driver's
+        #    end-of-round `python bench.py` reads the same on-disk cache.
+        for b in $PREWARM_BUCKETS; do
+            tmo=600; [ "$b" -ge 65536 ] && tmo=1500
+            run_step "prewarm_$b" "$tmo" python -c "$PREWARM_PY" "$b" || continue 2
+        done
         # 2. headline bench twice: first may pay residual warmup; the
         #    second is the steady-state number. JSON lands in benchN.out.
         for i in 1 2; do
