@@ -544,11 +544,12 @@ _fetch_executor = None  # shared verdict-fetch pool, created on first use
 def _fetch_pool():
     global _fetch_executor
     if _fetch_executor is None:
-        from concurrent.futures import ThreadPoolExecutor
+        # daemon workers (libs.pool): a verdict fetch against a dead
+        # tunnel hangs forever, and ThreadPoolExecutor's non-daemon
+        # workers would then hang interpreter exit too
+        from tendermint_tpu.libs.pool import DaemonPool
 
-        _fetch_executor = ThreadPoolExecutor(
-            max_workers=8, thread_name_prefix="tmtpu-fetch"
-        )
+        _fetch_executor = DaemonPool(max_workers=8, name_prefix="tmtpu-fetch")
     return _fetch_executor
 
 # Multi-device dispatch: when more than one device is visible (a real TPU
@@ -672,7 +673,7 @@ def verify_batch(pubs, msgs, sigs) -> list[bool]:
         # module-shared: verify_batch is the per-commit hot path and
         # per-call thread spawn/teardown would cost more than the
         # serialization it saves on a local (microsecond-fetch) device.
-        fetched = list(_fetch_pool().map(fetch, [p[2] for p in pending]))
+        fetched = _fetch_pool().map(fetch, [p[2] for p in pending])
     else:
         fetched = [fetch(p[2]) for p in pending]
     for (lo, hi, _, blocks, mask, from_sharded), got in zip(pending, fetched):

@@ -132,3 +132,56 @@ class TestThreadHygiene:
         finally:
             stop.set()
             t.join()
+
+
+class TestDaemonPool:
+    def test_map_preserves_order_and_concurrency(self):
+        import threading
+        import time
+
+        from tendermint_tpu.libs.pool import DaemonPool
+
+        pool = DaemonPool(max_workers=4, name_prefix="test-pool")
+        gate = threading.Barrier(4, timeout=5.0)
+
+        def work(i):
+            gate.wait()  # deadlocks unless 4 items truly run concurrently
+            return i * 10
+
+        t0 = time.monotonic()
+        assert pool.map(work, range(4)) == [0, 10, 20, 30]
+        assert time.monotonic() - t0 < 5.0
+
+    def test_map_raises_task_exception(self):
+        import pytest
+
+        from tendermint_tpu.libs.pool import DaemonPool
+
+        pool = DaemonPool(max_workers=2, name_prefix="test-pool-exc")
+
+        def work(i):
+            if i == 1:
+                raise ValueError("boom")
+            return i
+
+        with pytest.raises(ValueError, match="boom"):
+            pool.map(work, range(3))
+
+    def test_workers_are_daemon(self):
+        import threading
+
+        from tendermint_tpu.libs.pool import DaemonPool
+
+        DaemonPool(max_workers=2, name_prefix="test-pool-daemon")
+        named = [
+            t for t in threading.enumerate()
+            if t.name.startswith("test-pool-daemon")
+        ]
+        assert len(named) == 2 and all(t.daemon for t in named)
+
+    def test_empty_and_single_item(self):
+        from tendermint_tpu.libs.pool import DaemonPool
+
+        pool = DaemonPool(max_workers=2, name_prefix="test-pool-edge")
+        assert pool.map(lambda x: x, []) == []
+        assert pool.map(lambda x: x + 1, [41]) == [42]
