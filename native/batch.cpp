@@ -13,12 +13,42 @@
 namespace tmnative {
 extern "C" int tm_ed25519_verify(const uint8_t*, const uint8_t*, size_t, const uint8_t*);
 extern "C" int tm_secp256k1_verify(const uint8_t*, const uint8_t*, size_t, const uint8_t*);
+extern "C" void tm_secp256k1_verify_range(const uint8_t*, const uint8_t*,
+                                          const uint64_t*, const uint8_t*,
+                                          size_t, size_t, uint8_t*);
+extern "C" void tm_ed25519_verify_range(const uint8_t*, const uint8_t*,
+                                        const uint64_t*, const uint8_t*,
+                                        size_t, size_t, uint8_t*);
 }
 
 using tmnative::tm_ed25519_verify;
+using tmnative::tm_ed25519_verify_range;
 using tmnative::tm_secp256k1_verify;
+using tmnative::tm_secp256k1_verify_range;
 
 namespace {
+
+// shard [0, n) into one contiguous range per worker; f(lo, hi) owns its
+// range exclusively (the secp batched core amortizes two inversions per
+// 64-signature sub-chunk, so work must arrive as ranges, not indices)
+template <typename F>
+void parallel_ranges(size_t n, F f) {
+    unsigned hw = std::thread::hardware_concurrency();
+    size_t workers = std::min<size_t>(std::max(1u, hw), (n + 63) / 64);
+    if (workers <= 1) {
+        f((size_t)0, n);
+        return;
+    }
+    std::vector<std::thread> ts;
+    ts.reserve(workers);
+    size_t chunk = (n + workers - 1) / workers;
+    for (size_t w = 0; w < workers; w++) {
+        size_t lo = w * chunk, hi = std::min(n, lo + chunk);
+        if (lo >= hi) break;
+        ts.emplace_back([=] { f(lo, hi); });
+    }
+    for (auto& t : ts) t.join();
+}
 
 template <typename F>
 void parallel_for(size_t n, F f) {
@@ -49,10 +79,17 @@ extern "C" {
 void tm_ed25519_verify_batch(const uint8_t* pubs, const uint8_t* msgs,
                              const uint64_t* offsets, const uint8_t* sigs,
                              size_t n, uint8_t* out) {
-    parallel_for(n, [&](size_t i) {
-        out[i] = (uint8_t)tm_ed25519_verify(
-            pubs + 32 * i, msgs + offsets[i], (size_t)(offsets[i + 1] - offsets[i]),
-            sigs + 64 * i);
+    if (n < 4) {
+        // the batched core pays one shared inversion ladder per
+        // sub-chunk; below ~4 signatures the single-shot path wins
+        for (size_t i = 0; i < n; i++)
+            out[i] = (uint8_t)tm_ed25519_verify(
+                pubs + 32 * i, msgs + offsets[i],
+                (size_t)(offsets[i + 1] - offsets[i]), sigs + 64 * i);
+        return;
+    }
+    parallel_ranges(n, [&](size_t lo, size_t hi) {
+        tm_ed25519_verify_range(pubs, msgs, offsets, sigs, lo, hi, out);
     });
 }
 
@@ -60,10 +97,17 @@ void tm_ed25519_verify_batch(const uint8_t* pubs, const uint8_t* msgs,
 void tm_secp256k1_verify_batch(const uint8_t* pubs, const uint8_t* msgs,
                                const uint64_t* offsets, const uint8_t* sigs,
                                size_t n, uint8_t* out) {
-    parallel_for(n, [&](size_t i) {
-        out[i] = (uint8_t)tm_secp256k1_verify(
-            pubs + 33 * i, msgs + offsets[i], (size_t)(offsets[i + 1] - offsets[i]),
-            sigs + 64 * i);
+    if (n < 4) {
+        // the batched core pays one scalar + one field inversion ladder
+        // per sub-chunk; below ~4 signatures the single-shot path wins
+        for (size_t i = 0; i < n; i++)
+            out[i] = (uint8_t)tm_secp256k1_verify(
+                pubs + 33 * i, msgs + offsets[i],
+                (size_t)(offsets[i + 1] - offsets[i]), sigs + 64 * i);
+        return;
+    }
+    parallel_ranges(n, [&](size_t lo, size_t hi) {
+        tm_secp256k1_verify_range(pubs, msgs, offsets, sigs, lo, hi, out);
     });
 }
 

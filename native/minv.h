@@ -1,0 +1,37 @@
+// Montgomery-trick batch inversion, shared by the ed25519 and secp256k1
+// cores: out[i] = 1 / *elems[i] for n field elements, at the cost of ONE
+// field inversion + 3(n-1) multiplications. The forward prefix-product /
+// invert / backward-unwind index discipline lives HERE once — five call
+// sites used to hand-roll it, and a one-line transposition in any copy
+// silently couples results across elements (for the verify paths, across
+// signatures' verdicts).
+//
+// Requirements: every *elems[i] is nonzero (callers guard — a zero
+// poisons the whole chain); n == 0 is a no-op. Mul must tolerate output
+// aliasing either input (all three field muls in this repo do).
+#pragma once
+#include <cstddef>
+
+namespace tmnative {
+
+// Mul: void(T&, const T&, const T&); Inv: void(T&, const T&).
+template <typename T, typename Mul, typename Inv>
+inline void batch_invert(T* const* elems, T* out, size_t n, const T& one,
+                         Mul&& mul, Inv&& inv) {
+    if (n == 0) return;
+    T acc = one;
+    for (size_t i = 0; i < n; i++) {
+        out[i] = acc;  // product of elems[0..i-1]
+        mul(acc, acc, *elems[i]);
+    }
+    T accinv;
+    inv(accinv, acc);
+    for (size_t i = n; i-- > 0;) {
+        T t;
+        mul(t, accinv, out[i]);          // 1 / *elems[i]
+        mul(accinv, accinv, *elems[i]);  // strip elems[i] from the chain
+        out[i] = t;
+    }
+}
+
+}  // namespace tmnative

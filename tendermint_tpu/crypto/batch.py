@@ -120,13 +120,30 @@ class BatchVerifier:
         ok = [True] * self._n_items
         for idx in self._invalid_items:
             ok[idx] = False
-        for key_type, (items, pubs, msgs, sigs) in self._groups.items():
-            n_jobs += len(items)
+
+        def run_group(entry):
+            key_type, (items, pubs, msgs, sigs) = entry
             backend = _BACKENDS.get(key_type)
             if backend is not None:
-                results = backend([p.bytes() for p in pubs], msgs, sigs)
-            else:
-                results = [p.verify(m, s) for p, m, s in zip(pubs, msgs, sigs)]
+                return backend([p.bytes() for p in pubs], msgs, sigs)
+            return [p.verify(m, s) for p, m, s in zip(pubs, msgs, sigs)]
+
+        groups = list(self._groups.items())
+        if len(groups) > 1:
+            # mixed-curve batches run their per-curve backends
+            # CONCURRENTLY: a device-routed ed25519 group spends most of
+            # its wall time waiting on the accelerator RPC while a native
+            # secp group burns CPU with the GIL released — serializing
+            # them (the reference shape: one sig at a time,
+            # types/vote_set.go:189) would add the two instead of
+            # overlapping them. Single-group batches skip the pool hop.
+            from tendermint_tpu.libs.pool import shared_pool
+
+            all_results = shared_pool("tmtpu-vgrp", 4).map(run_group, groups)
+        else:
+            all_results = [run_group(g) for g in groups]  # 0 or 1 group
+        for (_, (items, _p, _m, _s)), results in zip(groups, all_results):
+            n_jobs += len(items)
             for item, res in zip(items, results):
                 if not res:
                     ok[item] = False

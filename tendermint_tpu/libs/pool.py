@@ -19,6 +19,26 @@ from typing import Callable, Iterable, TypeVar
 T = TypeVar("T")
 R = TypeVar("R")
 
+_pools: dict[str, "DaemonPool"] = {}
+_pools_lock = threading.Lock()
+
+
+def shared_pool(name_prefix: str, max_workers: int) -> "DaemonPool":
+    """Process-wide named pool, created once under a lock.
+
+    The obvious module-global `if _pool is None: _pool = DaemonPool(...)`
+    is a data race: two threads hitting first use together each build a
+    pool and the loser's workers park on an unreferenced queue forever.
+    """
+    pool = _pools.get(name_prefix)
+    if pool is None:
+        with _pools_lock:
+            pool = _pools.get(name_prefix)
+            if pool is None:
+                pool = DaemonPool(max_workers, name_prefix)
+                _pools[name_prefix] = pool
+    return pool
+
 
 class DaemonPool:
     """Process-long pool; submit work via :meth:`map` only.

@@ -538,19 +538,13 @@ class _DeviceKeyCache:
 
 _dev_keys = _DeviceKeyCache()
 
-_fetch_executor = None  # shared verdict-fetch pool, created on first use
-
-
 def _fetch_pool():
-    global _fetch_executor
-    if _fetch_executor is None:
-        # daemon workers (libs.pool): a verdict fetch against a dead
-        # tunnel hangs forever, and ThreadPoolExecutor's non-daemon
-        # workers would then hang interpreter exit too
-        from tendermint_tpu.libs.pool import DaemonPool
+    # daemon workers (libs.pool): a verdict fetch against a dead tunnel
+    # hangs forever, and ThreadPoolExecutor's non-daemon workers would
+    # then hang interpreter exit too; shared_pool serializes first-use
+    from tendermint_tpu.libs.pool import shared_pool
 
-        _fetch_executor = DaemonPool(max_workers=8, name_prefix="tmtpu-fetch")
-    return _fetch_executor
+    return shared_pool("tmtpu-fetch", 8)
 
 # Multi-device dispatch: when more than one device is visible (a real TPU
 # slice, or the test suite's 8-virtual-CPU mesh) every chunk is

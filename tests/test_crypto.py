@@ -244,3 +244,31 @@ class TestBatchVerifier:
                 batch.register_backend("ed25519", prev)
             else:
                 batch.clear_backend("ed25519")
+
+    def test_concurrent_group_dispatch_preserves_item_order(self):
+        # >1 curve group routes through the shared daemon pool
+        # (crypto/batch.py verify_all); verdicts must land on the right
+        # item index regardless of which group finishes first
+        import random
+
+        from tendermint_tpu.crypto import ed25519, secp256k1
+        from tendermint_tpu.crypto.batch import BatchVerifier
+
+        rng = random.Random(42)
+        bv = BatchVerifier()
+        expect = []
+        for i in range(60):
+            msg = b"order %02d" % i
+            if i % 2 == 0:
+                pk = ed25519.gen_priv_key()
+            else:
+                pk = secp256k1.gen_priv_key()
+            sig = pk.sign(msg)
+            good = rng.random() < 0.7
+            if not good:
+                b = bytearray(sig)
+                b[rng.randrange(len(b))] ^= 1 << rng.randrange(8)
+                sig = bytes(b)
+            bv.add(pk.pub_key(), msg, sig)
+            expect.append(good)
+        assert bv.verify_all() == expect

@@ -335,3 +335,102 @@ class TestNativeMerkle:
         assert root == merkle.hash_from_byte_slices(items)
         for i, p in enumerate(proofs):
             p.verify(root, items[i])
+
+
+class TestNativeSecpBatchedCore:
+    """The chunk-batched range core (native/secp256k1.cpp
+    tm_secp256k1_verify_range): shared Montgomery inversions must not let
+    one signature's validity leak into another's verdict, including at
+    sub-chunk boundaries and when a chunk has zero parseable signatures."""
+
+    def test_all_parse_fail_batch(self):
+        # zero-s signatures fail parse before either inversion chain is
+        # built: the empty-chain edge (inverting the empty product = 1)
+        pk = secp256k1.gen_priv_key()
+        pubs = [pk.pub_key().bytes()] * 5
+        msgs = [b"m%d" % i for i in range(5)]
+        sigs = [bytes(64)] * 5
+        assert native.secp256k1_verify_batch(pubs, msgs, sigs) == [False] * 5
+
+    def test_invalids_at_chunk_boundaries(self):
+        # 130 sigs spans three 64-wide sub-chunks; corrupt lanes 0, 63,
+        # 64, 129 (both edges of each boundary) plus a parse-reject at 70
+        rng = __import__("random").Random(77)
+        pks = [secp256k1.gen_priv_key() for _ in range(13)]
+        pubs, msgs, sigs = [], [], []
+        for i in range(130):
+            pk = pks[i % 13]
+            m = b"boundary %03d" % i
+            pubs.append(pk.pub_key().bytes())
+            msgs.append(m)
+            sigs.append(pk.sign(m))
+        expect = [True] * 130
+        for lane in (0, 63, 64, 129):
+            b = bytearray(sigs[lane])
+            b[rng.randrange(64)] ^= 1 << rng.randrange(8)
+            sigs[lane] = bytes(b)
+            expect[lane] = False
+        sigs[70] = bytes(64)  # parse-reject inside a chunk of valids
+        expect[70] = False
+        assert native.secp256k1_verify_batch(pubs, msgs, sigs) == expect
+
+    def test_batch_agrees_with_single(self):
+        # the batched core must be verdict-identical to the single-shot
+        # entry on the same inputs (mixed valid / corrupt / junk-pubkey)
+        rng = __import__("random").Random(78)
+        pubs, msgs, sigs = [], [], []
+        for i in range(40):
+            pk = secp256k1.gen_priv_key()
+            m = bytes(rng.randrange(256) for _ in range(rng.randrange(1, 40)))
+            sig = pk.sign(m)
+            pub = pk.pub_key().bytes()
+            mode = rng.randrange(3)
+            if mode == 1:
+                b = bytearray(sig)
+                b[rng.randrange(64)] ^= 1 << rng.randrange(8)
+                sig = bytes(b)
+            elif mode == 2:
+                pub = bytes([0x02]) + os.urandom(32)
+            pubs.append(pub)
+            msgs.append(m)
+            sigs.append(sig)
+        batched = native.secp256k1_verify_batch(pubs, msgs, sigs)
+        singles = [
+            native.secp256k1_verify_batch([p], [m], [s])[0]
+            for p, m, s in zip(pubs, msgs, sigs)
+        ]
+        assert batched == singles
+
+
+class TestNativeEdBatchedCore:
+    """tm_ed25519_verify_range: the shared final-encode inversion must not
+    couple verdicts, including all-structural-reject chunks and sub-chunk
+    boundaries (64-wide)."""
+
+    def test_all_structural_reject_batch(self):
+        # s >= L is rejected before the Strauss loop: the empty-chain edge
+        pk = ed25519.gen_priv_key()
+        pubs = [pk.pub_key().bytes()] * 5
+        msgs = [b"e%d" % i for i in range(5)]
+        sigs = [bytes(32) + b"\xff" * 32] * 5
+        assert native.ed25519_verify_batch(pubs, msgs, sigs) == [False] * 5
+
+    def test_invalids_at_chunk_boundaries(self):
+        rng = __import__("random").Random(79)
+        pks = [ed25519.gen_priv_key() for _ in range(13)]
+        pubs, msgs, sigs = [], [], []
+        for i in range(130):
+            pk = pks[i % 13]
+            m = b"edge %03d" % i
+            pubs.append(pk.pub_key().bytes())
+            msgs.append(m)
+            sigs.append(pk.sign(m))
+        expect = [True] * 130
+        for lane in (0, 63, 64, 129):
+            b = bytearray(sigs[lane])
+            b[rng.randrange(64)] ^= 1 << rng.randrange(8)
+            sigs[lane] = bytes(b)
+            expect[lane] = False
+        sigs[70] = bytes(32) + b"\xff" * 32  # structural reject mid-chunk
+        expect[70] = False
+        assert native.ed25519_verify_batch(pubs, msgs, sigs) == expect
