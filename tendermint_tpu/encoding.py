@@ -22,6 +22,15 @@ from __future__ import annotations
 
 import struct
 
+# Prebound Struct.pack methods: encoding is a node-profile hot spot
+# (~1.1M field appends under tm-bench load), and `struct.pack(">I", v)`
+# pays a format-cache lookup per call that `Struct.pack` does not.
+_PACK_B = struct.Struct(">B").pack
+_PACK_H = struct.Struct(">H").pack
+_PACK_I = struct.Struct(">I").pack
+_PACK_Q = struct.Struct(">Q").pack
+_PACK_q = struct.Struct(">q").pack
+
 
 class Writer:
     __slots__ = ("_parts",)
@@ -30,35 +39,40 @@ class Writer:
         self._parts: list[bytes] = []
 
     def u8(self, v: int) -> "Writer":
-        self._parts.append(struct.pack(">B", v))
+        self._parts.append(_PACK_B(v))
         return self
 
     def u16(self, v: int) -> "Writer":
-        self._parts.append(struct.pack(">H", v))
+        self._parts.append(_PACK_H(v))
         return self
 
     def u32(self, v: int) -> "Writer":
-        self._parts.append(struct.pack(">I", v))
+        self._parts.append(_PACK_I(v))
         return self
 
     def u64(self, v: int) -> "Writer":
-        self._parts.append(struct.pack(">Q", v))
+        self._parts.append(_PACK_Q(v))
         return self
 
     def i64(self, v: int) -> "Writer":
-        self._parts.append(struct.pack(">q", v))
+        self._parts.append(_PACK_q(v))
         return self
 
     def bool(self, v: bool) -> "Writer":
-        return self.u8(1 if v else 0)
+        self._parts.append(b"\x01" if v else b"\x00")
+        return self
 
     def raw(self, b: bytes) -> "Writer":
-        self._parts.append(bytes(b))
+        self._parts.append(b if type(b) is bytes else bytes(b))
         return self
 
     def bytes(self, b: bytes) -> "Writer":
-        self.u32(len(b))
-        return self.raw(b)
+        # flattened u32(len)+raw: this pair is the single hottest encode
+        # call (one per tx field, per header field, per commit sig)
+        p = self._parts
+        p.append(_PACK_I(len(b)))
+        p.append(b if type(b) is bytes else bytes(b))
+        return self
 
     def str(self, s: str) -> "Writer":
         return self.bytes(s.encode("utf-8"))

@@ -10,7 +10,7 @@ import asyncio
 import itertools
 import json
 
-from tendermint_tpu.rpc.jsonrpc import RPCError, _ws_frame, _ws_read_frame
+from tendermint_tpu.rpc.jsonrpc import RPCError, WSFrameReader, _ws_frame
 
 
 class RPCResponseError(RPCError):
@@ -128,6 +128,7 @@ class WSClient:
             line = await self._reader.readline()
             if line in (b"\r\n", b"\n", b""):
                 break
+        self._fb = WSFrameReader(self._reader)
         self._connected.set()
 
     async def close(self) -> None:
@@ -150,7 +151,7 @@ class WSClient:
     async def _recv_until_closed(self) -> None:
         try:
             while True:
-                opcode, payload = await _ws_read_frame(self._reader)
+                opcode, payload = await self._fb.read_frame()
                 if opcode == 0x8:
                     return
                 if opcode not in (0x1, 0x2):

@@ -175,6 +175,8 @@ class Environment:
         self.priv_validator_pub_key = priv_validator_pub_key
         self.log = logger
         self._subscriber_seq = 0
+        self._async_txs: list[bytes] = []
+        self._async_drainer_active = False
 
     # ------------------------------------------------------------------
     # info routes
@@ -405,18 +407,36 @@ class Environment:
     # tx routes
 
     async def broadcast_tx_async(self, tx) -> dict:
-        """CheckTx is NOT awaited (reference rpc/core/mempool.go)."""
+        """CheckTx is NOT awaited (reference rpc/core/mempool.go).
+
+        Queued txs drain through ONE background task per burst instead of
+        one task per tx: under tm-bench flood every tx paid a Task object
+        and scheduler pass here (a top node-profile cost)."""
         raw = _tx_arg(tx)
-        asyncio.ensure_future(self._checktx_quiet(raw))
+        self._async_txs.append(raw)
+        if not self._async_drainer_active:
+            self._async_drainer_active = True
+            asyncio.ensure_future(self._drain_async_txs())
         from tendermint_tpu.crypto import sum_sha256
 
         return {"code": 0, "data": "", "log": "", "hash": _hex(sum_sha256(raw))}
 
-    async def _checktx_quiet(self, raw: bytes) -> None:
+    async def _drain_async_txs(self) -> None:
         try:
-            await self.mempool.check_tx(raw)
-        except MempoolError:
-            pass
+            while self._async_txs:
+                pending, self._async_txs = self._async_txs, []
+                for raw in pending:
+                    try:
+                        await self.mempool.check_tx(raw)
+                    except Exception:  # noqa: BLE001 — failure isolation:
+                        # any one tx's failure (MempoolError, or a remote
+                        # ABCI transport error) must not kill the shared
+                        # drainer and strand the rest of the burst — the
+                        # old one-task-per-tx design confined failures to
+                        # their own tx, and so does this
+                        pass
+        finally:
+            self._async_drainer_active = False
 
     async def broadcast_tx_sync(self, tx) -> dict:
         raw = _tx_arg(tx)

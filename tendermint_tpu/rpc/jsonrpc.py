@@ -280,9 +280,10 @@ class JSONRPCServer(BaseService):
                 await writer.drain()
 
         ctx = ConnContext(remote, ws_send=ws_send)
+        fb = WSFrameReader(reader)
         try:
             while True:
-                opcode, payload = await _ws_read_frame(reader)
+                opcode, payload = await fb.read_frame()
                 closing = False
                 batch: list[bytes] = []
                 # drain-all-pending (r3 profile: asyncio per-message
@@ -304,10 +305,10 @@ class JSONRPCServer(BaseService):
                         batch.append(payload)
                     if closing or len(batch) >= 128:
                         break
-                    buf = getattr(reader, "_buffer", b"")
-                    if _buffered_frame_size(buf) is None:
+                    nxt = fb.buffered_frame()
+                    if nxt is None:
                         break  # nothing complete buffered: dispatch now
-                    opcode, payload = await _ws_read_frame(reader)
+                    opcode, payload = nxt
                 if batch:
                     if len(batch) == 1:  # no task-creation for the 1-frame case
                         await ws_send(await self._dispatch_raw(ctx, batch[0]))
@@ -382,46 +383,67 @@ def _ws_frame(opcode: int, payload: bytes, mask: bool = False) -> bytes:
     return head + payload
 
 
-def _buffered_frame_size(buf) -> int | None:
-    """Total byte length of the websocket frame at the head of `buf`, or
-    None if the buffered bytes don't yet contain one complete frame.
-    Used by the server's collect loop to batch ONLY frames that can be
-    read without suspending."""
-    if len(buf) < 2:
-        return None
-    b1 = buf[1]
-    masked = bool(b1 & 0x80)
-    n = b1 & 0x7F
-    pos = 2
-    if n == 126:
-        if len(buf) < pos + 2:
-            return None
-        n = int.from_bytes(buf[pos:pos + 2], "big")
-        pos += 2
-    elif n == 127:
-        if len(buf) < pos + 8:
-            return None
-        n = int.from_bytes(buf[pos:pos + 8], "big")
-        pos += 8
-    if masked:
-        pos += 4
-    total = pos + n
-    return total if len(buf) >= total else None
+class WSFrameReader:
+    """Buffered RFC6455 frame parser.
 
+    `_ws_read_frame` costs 2-4 `readexactly` coroutine hops per frame —
+    at tm-bench load that was ~430k awaits for 60k transactions, the #1
+    self-time row of the node profile. This parser does ONE
+    `reader.read()` per TCP segment into its own buffer and slices every
+    complete frame out synchronously; `buffered_frame()` doubles as the
+    server's drain-batch probe (no reaching into StreamReader internals,
+    and frames this parser has already buffered — which `reader._buffer`
+    can't see — still batch).
+    """
 
-async def _ws_read_frame(reader) -> tuple[int, bytes]:
-    b0, b1 = await reader.readexactly(2)
-    opcode = b0 & 0x0F
-    masked = bool(b1 & 0x80)
-    n = b1 & 0x7F
-    if n == 126:
-        n = struct.unpack(">H", await reader.readexactly(2))[0]
-    elif n == 127:
-        n = struct.unpack(">Q", await reader.readexactly(8))[0]
-    if n > (1 << 24):
-        raise ConnectionError(f"websocket frame too large: {n}")
-    key = await reader.readexactly(4) if masked else None
-    payload = await reader.readexactly(n)
-    if key:
-        payload = _ws_mask(payload, key)
-    return opcode, payload
+    __slots__ = ("_reader", "_buf", "max_frame")
+
+    def __init__(self, reader, max_frame: int = 1 << 24) -> None:
+        self._reader = reader
+        self._buf = bytearray()
+        self.max_frame = max_frame
+
+    def buffered_frame(self) -> tuple[int, bytes] | None:
+        """Parse one complete frame already in the buffer, else None."""
+        buf = self._buf
+        blen = len(buf)
+        if blen < 2:
+            return None
+        b1 = buf[1]
+        n = b1 & 0x7F
+        pos = 2
+        if n == 126:
+            if blen < 4:
+                return None
+            n = (buf[2] << 8) | buf[3]
+            pos = 4
+        elif n == 127:
+            if blen < 10:
+                return None
+            n = int.from_bytes(buf[2:10], "big")
+            pos = 10
+        if n > self.max_frame:
+            raise ConnectionError(f"websocket frame too large: {n}")
+        key = None
+        if b1 & 0x80:
+            key = bytes(buf[pos:pos + 4])
+            pos += 4
+        total = pos + n
+        if blen < total:
+            return None
+        opcode = buf[0] & 0x0F
+        payload = bytes(buf[pos:total])
+        del buf[:total]
+        if key:
+            payload = _ws_mask(payload, key)
+        return opcode, payload
+
+    async def read_frame(self) -> tuple[int, bytes]:
+        while True:
+            fr = self.buffered_frame()
+            if fr is not None:
+                return fr
+            chunk = await self._reader.read(1 << 16)
+            if not chunk:
+                raise asyncio.IncompleteReadError(bytes(self._buf), None)
+            self._buf += chunk
