@@ -206,15 +206,35 @@ class WSClient:
             return True
         return False
 
-    def _send_nowait(self, method: str, params: dict) -> asyncio.Future:
-        msg_id = next(self._ids)
+    def _send_frame(self, data: bytes) -> asyncio.Future:
+        """Register a pending future for the id just embedded in `data`
+        and queue the frame (shared tail of the nowait senders)."""
+        msg_id = self._last_id
         fut = asyncio.get_event_loop().create_future()
         self._pending[msg_id] = fut
+        self._writer.write(_ws_frame(0x1, data, mask=True))
+        return fut
+
+    def _send_nowait(self, method: str, params: dict) -> asyncio.Future:
+        self._last_id = msg_id = next(self._ids)
         data = json.dumps(
             {"jsonrpc": "2.0", "id": msg_id, "method": method, "params": params}
         ).encode()
-        self._writer.write(_ws_frame(0x1, data, mask=True))
-        return fut
+        return self._send_frame(data)
+
+    def call_nowait_raw(self, method: str, params_json: str) -> "asyncio.Future":
+        """`call_nowait` with the params object ALREADY serialized
+        (caller guarantees valid JSON) — the flood path skips the dict
+        build + generic encode per request (tools/bench precomputes its
+        one-key tx object around a hex string)."""
+        if not self._connected.is_set():
+            raise ConnectionError("websocket not connected")
+        self._last_id = msg_id = next(self._ids)
+        data = (
+            b'{"jsonrpc":"2.0","id":%d,"method":"%s","params":%s}'
+            % (msg_id, method.encode(), params_json.encode())
+        )
+        return self._send_frame(data)
 
     async def _send_call(self, method: str, params: dict):
         if not self._connected.is_set():

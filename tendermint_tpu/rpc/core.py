@@ -419,7 +419,9 @@ class Environment:
             asyncio.ensure_future(self._drain_async_txs())
         from tendermint_tpu.crypto import sum_sha256
 
-        return {"code": 0, "data": "", "log": "", "hash": _hex(sum_sha256(raw))}
+        # flat str/int dict: the wire layer's template fast path renders
+        # it without the generic JSON encoder (jsonrpc._encode_flat_obj)
+        return {"code": 0, "data": "", "log": "", "hash": sum_sha256(raw).hex()}
 
     async def _drain_async_txs(self) -> None:
         try:
@@ -428,13 +430,16 @@ class Environment:
                 for raw in pending:
                     try:
                         await self.mempool.check_tx(raw)
-                    except Exception:  # noqa: BLE001 — failure isolation:
-                        # any one tx's failure (MempoolError, or a remote
-                        # ABCI transport error) must not kill the shared
-                        # drainer and strand the rest of the burst — the
-                        # old one-task-per-tx design confined failures to
-                        # their own tx, and so does this
-                        pass
+                    except MempoolError:
+                        pass  # per-tx outcome; async acks never surface it
+                    except Exception as e:  # noqa: BLE001 — failure
+                        # isolation: one tx's transport/app failure must
+                        # not kill the shared drainer and strand the rest
+                        # of the burst — but unlike MempoolError it is
+                        # unexpected, so it gets a log line (the old
+                        # task-per-tx design surfaced it via the loop's
+                        # unhandled-exception handler)
+                        self.log.error("async CheckTx failed", err=repr(e))
         finally:
             self._async_drainer_active = False
 

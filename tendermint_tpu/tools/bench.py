@@ -83,7 +83,9 @@ class Transacter:
                     # request loop measures round-trip latency, not node
                     # throughput
                     window.append(
-                        ws.call_nowait("broadcast_tx_async", tx=tx.hex())
+                        ws.call_nowait_raw(
+                            "broadcast_tx_async", '{"tx":"%s"}' % tx.hex()
+                        )
                     )
                     self.sent += 1
                     if len(window) % self.DRAIN_EVERY == 0:
