@@ -775,51 +775,75 @@ extern "C" void tm_ed25519_prepare_batch(
     });
 }
 
-// Everything up to (not including) the final encode-compare: structural
-// checks, h = SHA512(R||A||M) mod L, and the interleaved Strauss
-// double-scalar multiplication P = [s]B + [h](-A). Returns false on a
-// structural reject (P untouched); on true the caller still must compare
-// encode(P) against R — the single-shot entry inverts P.Z itself, the
-// batched range below amortizes ONE field inversion across a sub-chunk.
-static bool ed_verify_core(const uint8_t pub[32], const uint8_t* msg,
-                           size_t msglen, const uint8_t sig[64], Point& P) {
+// Structural checks + h = SHA512(R||A||M) mod L. False => reject. The
+// pubkey is NOT decompressed here: h hashes the raw A bytes, and a
+// per-key table-cache hit (fetch only happens on a miss, fetch_nega)
+// never needs the point at all.
+static bool ed_parse(const uint8_t pub[32], const uint8_t* msg,
+                     size_t msglen, const uint8_t sig[64], uint8_t h[32]) {
     if (!sc_canonical(sig + 32)) return false;  // non-canonical s
-    // -A via the decompression cache: a stable validator set pays the
-    // sqrt once per key, not once per vote (g_pub_cache is shared with
-    // the TPU batch-prep path, which caches the same -A representation)
-    uint8_t nega_b[96];
-    if (!g_pub_cache.get(pub, nega_b)) return false;
-    Point negA;
-    fe_frombytes(negA.X, nega_b);
-    fe_frombytes(negA.Y, nega_b + 32);
-    fe_one(negA.Z);
-    fe_frombytes(negA.T, nega_b + 64);
     Point Rpt;
     if (!pt_frombytes(Rpt, sig)) return false;  // R must be a valid point
-    ensure_b_table();
 
-    // h = SHA512(R || A || M) mod L
-    uint8_t hfull[64], h[32];
+    uint8_t hfull[64];
     Sha512 sh;
     sh.update(sig, 32);
     sh.update(pub, 32);
     sh.update(msg, msglen);
     sh.final(hfull);
     sc_reduce64_fast(h, hfull);
+    return true;
+}
 
-    // check [s]B == R + [h]A  <=>  [s]B + [h](-A) == R  (sig = R || s)
-    // wNAF(5) table of odd multiples [1,3,...,15](-A), extended coords
-    Point nA2;
-    pt_double(nA2, negA);
-    Point a_tab[8];
-    a_tab[0] = negA;
-    for (int i = 1; i < 8; i++) pt_add(a_tab[i], a_tab[i - 1], nA2);
+// -A via the decompression cache (a stable validator set pays the sqrt
+// once per key, not once per vote — g_pub_cache is shared with the TPU
+// batch-prep path, which caches the same -A representation)
+static bool fetch_nega(const uint8_t pub[32], Point& negA) {
+    uint8_t nega_b[96];
+    if (!g_pub_cache.get(pub, nega_b)) return false;
+    fe_frombytes(negA.X, nega_b);
+    fe_frombytes(negA.Y, nega_b + 32);
+    fe_one(negA.Z);
+    fe_frombytes(negA.T, nega_b + 64);
+    return true;
+}
 
+// per-pubkey Niels (affine precomputed) wNAF table cache: 8 odd
+// multiples of -A, 960 B/key. Steady-state validators hit it every
+// height, skipping the table build AND switching the A stream from
+// unified extended adds to mixed adds. Filled only by the batched core
+// (affine normalization comes ~free there, from the shared inversion).
+static ShardedPubCache<32, 8 * sizeof(Niels)> a_tab_cache(1024);
+
+// A-stream table application, generic over table representation
+static void a_apply(Point& P, const Point* tab, int e) {
+    if (e > 0) {
+        pt_add(P, P, tab[(e - 1) >> 1]);
+    } else if (e < 0) {
+        Point n;
+        pt_neg(n, tab[(-e - 1) >> 1]);
+        pt_add(P, P, n);
+    }
+}
+
+static void a_apply(Point& P, const Niels* tab, int e) {
+    if (e > 0) {
+        pt_madd(P, P, tab[(e - 1) >> 1]);
+    } else if (e < 0) {
+        pt_msub(P, P, tab[(-e - 1) >> 1]);
+    }
+}
+
+// P = [s]B + [h](-A): interleaved Strauss, wNAF(8) over the static
+// Niels B table + wNAF(5) over the per-key table (extended coords when
+// built one-off; cached Niels on the steady-state path).
+template <typename AT>
+static void ed_strauss(Point& P, const uint8_t s_bytes[32],
+                       const uint8_t h[32], const AT a_tab[8]) {
     int8_t ns[257], nh[257];
-    int ls = wnaf_le(ns, sig + 32, 8);
+    int ls = wnaf_le(ns, s_bytes, 8);
     int lh = wnaf_le(nh, h, 5);
     int top = (ls > lh ? ls : lh) - 1;
-
     pt_identity(P);
     for (int i = top; i >= 0; i--) {
         pt_double(P, P);
@@ -829,16 +853,16 @@ static bool ed_verify_core(const uint8_t pub[32], const uint8_t* msg,
         } else if (d < 0) {
             pt_msub(P, P, B_TAB[(-d - 1) >> 1]);
         }
-        int e = nh[i];
-        if (e > 0) {
-            pt_add(P, P, a_tab[(e - 1) >> 1]);
-        } else if (e < 0) {
-            Point n;
-            pt_neg(n, a_tab[(-e - 1) >> 1]);
-            pt_add(P, P, n);
-        }
+        a_apply(P, a_tab, nh[i]);
     }
-    return true;
+}
+
+// wNAF(5) table of odd multiples [1,3,...,15](-A), extended coords
+static void build_a_tab(Point a_tab[8], const Point& negA) {
+    Point nA2;
+    pt_double(nA2, negA);
+    a_tab[0] = negA;
+    for (int i = 1; i < 8; i++) pt_add(a_tab[i], a_tab[i - 1], nA2);
 }
 
 // public entry: 1 valid, 0 invalid. Strict RFC 8032 check, evaluated as
@@ -847,52 +871,111 @@ static bool ed_verify_core(const uint8_t pub[32], const uint8_t* msg,
 extern "C" int tm_ed25519_verify(const uint8_t pub[32], const uint8_t* msg,
                                  size_t msglen, const uint8_t sig[64]) {
     Point P;
-    if (!ed_verify_core(pub, msg, msglen, sig, P)) return 0;
+    uint8_t h[32];
+    if (!ed_parse(pub, msg, msglen, sig, h)) return 0;
+    ensure_b_table();
+    Niels cached[8];
+    if (a_tab_cache.lookup(pub, reinterpret_cast<uint8_t*>(cached))) {
+        // steady-state key: the point is never even decompressed
+        ed_strauss(P, sig + 32, h, cached);
+    } else {
+        Point negA, a_tab[8];
+        if (!fetch_nega(pub, negA)) return 0;
+        build_a_tab(a_tab, negA);
+        ed_strauss(P, sig + 32, h, a_tab);
+    }
     uint8_t enc[32];
     pt_tobytes(enc, P);
     return memcmp(enc, sig, 32) == 0 ? 1 : 0;
 }
 
-// Batched range core (batch.cpp shards [lo,hi) across threads): runs the
-// per-signature Strauss loops, then amortizes the final encode's field
-// inversion — ONE Montgomery-trick fe_invert per 64-signature sub-chunk
-// instead of one per signature. Verdicts are bit-identical to the
-// single-shot entry: same reject set, same strict encode-compare.
+// Batched range core (batch.cpp shards [lo,hi) across threads), phased
+// like the secp one:
+//   A. parse + per-key Niels-table cache lookup;
+//   B. for missed keys, build the extended table and batch-normalize all
+//      of them to Niels form with ONE shared inversion (minv.h), then
+//      cache. The unified Edwards addition law is complete for ed25519's
+//      parameters (d non-square), so no table entry can have Z = 0 — the
+//      inversion chain cannot be poisoned;
+//   C. Strauss loops, all A streams on Niels tables (mixed adds);
+//   D. final encode-compare with its own shared inversion.
+// Verdicts are bit-identical to the single-shot entry.
 extern "C" void tm_ed25519_verify_range(const uint8_t* pubs,
                                         const uint8_t* msgs,
                                         const uint64_t* offsets,
                                         const uint8_t* sigs, size_t lo,
                                         size_t hi, uint8_t* out) {
+    ensure_b_table();
     constexpr size_t CH = 64;
     Point P[CH];
-    bool valid[CH];
+    Point a_ext[CH][8];
+    Niels a_niels[CH][8];
+    uint8_t hbuf[CH][32];
+    bool valid[CH], tab_hit[CH];
+    Fe zinvs[CH * 8];
+    Fe* zptr[CH * 8];
     for (size_t base = lo; base < hi; base += CH) {
         const size_t m = (hi - base < CH) ? (hi - base) : CH;
+        // ---- A: parse + table-cache probe (decompression is lazy)
         for (size_t i = 0; i < m; i++) {
             const size_t g = base + i;
-            valid[i] = ed_verify_core(
-                pubs + 32 * g, msgs + offsets[g],
-                (size_t)(offsets[g + 1] - offsets[g]), sigs + 64 * g, P[i]);
-            // The unified Edwards addition law is complete for ed25519's
-            // parameters (d non-square), so P.Z is never 0 for any input
-            // that reaches the loop; guard anyway — a zero Z would poison
-            // the shared inversion chain. Zero mod p has canonical
-            // all-zero bytes, so test via the canonical encoding.
-            if (valid[i]) {
-                uint8_t zb[32];
-                fe_tobytes(zb, P[i].Z);
-                uint8_t acc = 0;
-                for (int b = 0; b < 32; b++) acc |= zb[b];
-                if (acc == 0) valid[i] = false;
-            }
+            valid[i] = ed_parse(pubs + 32 * g, msgs + offsets[g],
+                                (size_t)(offsets[g + 1] - offsets[g]),
+                                sigs + 64 * g, hbuf[i]);
+            if (valid[i])
+                tab_hit[i] = a_tab_cache.lookup(
+                    pubs + 32 * g, reinterpret_cast<uint8_t*>(a_niels[i]));
         }
-        Fe* zptr[CH];
-        Fe zinvs[CH];
+        // ---- B: decompress + build + batch-normalize missed tables
+        size_t nz = 0;
+        for (size_t i = 0; i < m; i++) {
+            if (!valid[i] || tab_hit[i]) continue;
+            Point negA;  // lazy: only missed keys decompress
+            if (!fetch_nega(pubs + 32 * (base + i), negA)) {
+                valid[i] = false;
+                continue;
+            }
+            build_a_tab(a_ext[i], negA);
+            for (int j = 0; j < 8; j++) zptr[nz++] = &a_ext[i][j].Z;
+        }
+        Fe one;
+        fe_one(one);
+        batch_invert(zptr, zinvs, nz, one, fe_mul, fe_invert);
+        nz = 0;
+        for (size_t i = 0; i < m; i++) {
+            if (!valid[i] || tab_hit[i]) continue;
+            for (int j = 0; j < 8; j++) {
+                Fe x, y, xy;
+                fe_mul(x, a_ext[i][j].X, zinvs[nz]);
+                fe_mul(y, a_ext[i][j].Y, zinvs[nz]);
+                nz++;
+                Niels& e = a_niels[i][j];
+                fe_add(e.yplusx, y, x);
+                fe_carry(e.yplusx);
+                fe_sub(e.yminusx, y, x);
+                fe_carry(e.yminusx);
+                fe_mul(xy, x, y);
+                fe_mul(xy, xy, FE_D);
+                fe_add(e.t2d, xy, xy);
+                fe_carry(e.t2d);
+            }
+            a_tab_cache.put(pubs + 32 * (base + i),
+                            reinterpret_cast<const uint8_t*>(a_niels[i]));
+        }
+        // ---- C: Strauss loops (all-Niels A streams)
+        for (size_t i = 0; i < m; i++) {
+            if (!valid[i]) continue;
+            const size_t g = base + i;
+            ed_strauss(P[i], sigs + 64 * g + 32, hbuf[i], a_niels[i]);
+            // final-encode chain guard (see range-core note: Z is never
+            // 0 for complete Edwards addition; cheap canonical check
+            // keeps the shared inversion below unpoisonable regardless)
+            if (fe_iszero(P[i].Z)) valid[i] = false;
+        }
+        // ---- D: batch encode-compare (one shared inversion)
         size_t nv = 0;
         for (size_t i = 0; i < m; i++)
             if (valid[i]) zptr[nv++] = &P[i].Z;
-        Fe one;
-        fe_one(one);
         batch_invert(zptr, zinvs, nv, one, fe_mul, fe_invert);
         nv = 0;
         for (size_t i = 0; i < m; i++) {

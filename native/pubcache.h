@@ -67,6 +67,48 @@ struct ShardedPubCache {
 
     explicit ShardedPubCache(size_t cap = 8192) : shard_cap(cap) {}
 
+    // lookup WITHOUT a compute step: true + VAL_LEN bytes in `out` on a
+    // positive hit; false on a miss or a cached-failure entry. Pairs
+    // with put() for values produced by batch-amortized computations
+    // (e.g. affine tables normalized by one shared inversion) that the
+    // per-key compute callback of get() cannot express.
+    bool lookup(const uint8_t* key_bytes, uint8_t* out) {
+        Key key;
+        memcpy(key.data(), key_bytes, KEY_LEN);
+        Shard& sh = shards[Hash{}(key) & (NSHARD - 1)];
+        std::lock_guard<std::mutex> g(sh.mtx);
+        auto it = sh.map.find(key);
+        if (it == sh.map.end() || !it->second[VAL_LEN]) return false;
+        memcpy(out, it->second.data(), VAL_LEN);
+        return true;
+    }
+
+    // Make room in a full shard: failed-decompression (junk-key) entries
+    // go first; if every entry is valid, evict ONE arbitrary entry —
+    // random replacement bounds an attacker streaming fresh VALID keys
+    // to linear churn instead of whole-shard flushes of the hot
+    // validator entries (the keyed hash keeps the victim untargetable).
+    void evict_for_insert(Shard& sh) {
+        if (sh.map.size() < shard_cap) return;
+        for (auto it = sh.map.begin(); it != sh.map.end();) {
+            if (!it->second[VAL_LEN]) it = sh.map.erase(it);
+            else ++it;
+        }
+        if (sh.map.size() >= shard_cap) sh.map.erase(sh.map.begin());
+    }
+
+    void put(const uint8_t* key_bytes, const uint8_t* val_bytes) {
+        Key key;
+        memcpy(key.data(), key_bytes, KEY_LEN);
+        Val entry{};
+        memcpy(entry.data(), val_bytes, VAL_LEN);
+        entry[VAL_LEN] = 1;
+        Shard& sh = shards[Hash{}(key) & (NSHARD - 1)];
+        std::lock_guard<std::mutex> g(sh.mtx);
+        evict_for_insert(sh);
+        sh.map.insert_or_assign(key, entry);
+    }
+
     // compute: bool(const uint8_t* key, uint8_t* out_val) — runs outside
     // the shard lock on a miss; its result (incl. failure) is cached.
     // Returns compute's verdict; on success `out` holds VAL_LEN bytes.
@@ -92,13 +134,7 @@ struct ShardedPubCache {
             memcpy(out, entry.data(), VAL_LEN);
         }
         std::lock_guard<std::mutex> g(sh.mtx);
-        if (sh.map.size() >= shard_cap) {
-            for (auto it = sh.map.begin(); it != sh.map.end();) {
-                if (!it->second[VAL_LEN]) it = sh.map.erase(it);
-                else ++it;
-            }
-            if (sh.map.size() >= shard_cap) sh.map.clear();
-        }
+        evict_for_insert(sh);
         sh.map.emplace(key, entry);
         return ok;
     }

@@ -856,7 +856,6 @@ extern "C" int tm_secp256k1_glv_active(void) {
 
 struct SigPre {
     Sc r, s, z;  // signature scalars + message digest mod n
-    Jac Q;       // decompressed pubkey, Z = 1
 };
 
 // per-pubkey decompression cache shared by the single-shot and batched
@@ -864,11 +863,21 @@ struct SigPre {
 // per signature
 static ShardedPubCache<33, 64> q_cache;
 
-// parse + range checks + pubkey decompression + message digest; false =>
-// definitively invalid (exact early-reject set of the original verify:
-// zero/overflowing r or s, high-S, bad pubkey encoding)
-static bool sig_parse(const uint8_t pub[33], const uint8_t* msg,
-                      size_t msglen, const uint8_t sig[64], SigPre& o) {
+// per-pubkey AFFINE wNAF table cache (8 odd multiples, 512 B/key): in
+// steady state the same validator keys verify every height, so the
+// table build AND its share of the batch normalization disappear on a
+// hit — and even the single-shot path gets all-affine streams. Filled
+// only by the batched core (affine tables come ~free there, from the
+// shared inversion); 1024 entries/shard x 16 shards = 8 MB cap.
+static_assert(sizeof(Aff[8]) == 512, "qtab cache value layout");
+static ShardedPubCache<33, 8 * sizeof(Aff)> qtab_cache(1024);
+
+// parse + range checks + message digest; false => definitively invalid
+// (zero/overflowing r or s, high-S). Pubkey decompression happens
+// LAZILY via fetch_q — a per-key table-cache hit implies a valid pubkey
+// and never needs the decompressed point at all.
+static bool sig_parse(const uint8_t* msg, size_t msglen,
+                      const uint8_t sig[64], SigPre& o) {
     uint64_t rraw[4], sraw[4];
     for (int i = 0; i < 4; i++) {
         rraw[3 - i] = 0;
@@ -885,6 +894,14 @@ static bool sig_parse(const uint8_t pub[33], const uint8_t* msg,
     if (sc_cmp_raw(sraw, N) >= 0) return false;
     if (sc_cmp_raw(sraw, NHALF) > 0) return false;  // high-S malleability
 
+    uint8_t digest[32];
+    sha256(msg, msglen, digest);
+    sc_frombytes_be(o.z, digest);
+    return true;
+}
+
+// decompressed pubkey (Z = 1) via the per-key cache; false on a bad key
+static bool fetch_q(const uint8_t pub[33], Jac& Q) {
     uint8_t q_b[64];
     if (!q_cache.get(pub, q_b, [](const uint8_t* k, uint8_t* v) {
             Jac P0;
@@ -894,14 +911,10 @@ static bool sig_parse(const uint8_t pub[33], const uint8_t* msg,
             return true;
         }))
         return false;
-    fp_frombytes_be(o.Q.X, q_b);
-    fp_frombytes_be(o.Q.Y, q_b + 32);
-    memset(&o.Q.Z, 0, sizeof o.Q.Z);
-    o.Q.Z.v[0] = 1;
-
-    uint8_t digest[32];
-    sha256(msg, msglen, digest);
-    sc_frombytes_be(o.z, digest);
+    fp_frombytes_be(Q.X, q_b);
+    fp_frombytes_be(Q.Y, q_b + 32);
+    memset(&Q.Z, 0, sizeof Q.Z);
+    Q.Z.v[0] = 1;
     return true;
 }
 
@@ -1042,7 +1055,7 @@ static void build_q_tab(Jac q_tab[8], const Jac& Q) {
 extern "C" int tm_secp256k1_verify(const uint8_t pub[33], const uint8_t* msg,
                                    size_t msglen, const uint8_t sig[64]) {
     SigPre p;
-    if (!sig_parse(pub, msg, msglen, sig, p)) return 0;
+    if (!sig_parse(msg, msglen, sig, p)) return 0;
 
     Sc w, u1, u2;
     sc_invert(w, p.s);
@@ -1050,15 +1063,24 @@ extern "C" int tm_secp256k1_verify(const uint8_t pub[33], const uint8_t* msg,
     sc_mul(u2, p.r, w);
 
     ensure_g_table();
-    // Jacobian per-key table: a batch normalization to affine would cost
-    // a field inversion — for ONE signature the general adds it saves are
-    // cheaper than that (the batched path below amortizes the inversion
-    // across a whole sub-chunk and gets the affine tables ~free)
-    Jac q_tab[8];
-    build_q_tab(q_tab, p.Q);
-
     Jac R;
-    if (!strauss_double_mul(R, u1, u2, q_tab)) return 0;
+    Aff qa[8];
+    if (qtab_cache.lookup(pub, reinterpret_cast<uint8_t*>(qa))) {
+        // steady-state key: cached affine table, all four streams mixed
+        // adds — the decompressed point is never even fetched
+        if (!strauss_double_mul(R, u1, u2, qa)) return 0;
+    } else {
+        // Jacobian per-key table: a one-off normalization to affine
+        // would cost a field inversion — for ONE signature the general
+        // adds it saves are cheaper than that (the batched path below
+        // amortizes the inversion across a whole sub-chunk, gets the
+        // affine tables ~free, and populates the cache above)
+        Jac Q;
+        if (!fetch_q(pub, Q)) return 0;
+        Jac q_tab[8];
+        build_q_tab(q_tab, Q);
+        if (!strauss_double_mul(R, u1, u2, q_tab)) return 0;
+    }
     if (jac_is_infinity(R)) return 0;
     return rx_matches(R, p.r);
 }
@@ -1093,7 +1115,7 @@ extern "C" void tm_secp256k1_verify_range(const uint8_t* pubs,
         const size_t m = (hi - base < CH) ? (hi - base) : CH;
         for (size_t i = 0; i < m; i++) {
             const size_t g = base + i;
-            valid[i] = sig_parse(pubs + 33 * g, msgs + offsets[g],
+            valid[i] = sig_parse(msgs + offsets[g],
                                  (size_t)(offsets[g + 1] - offsets[g]),
                                  sigs + 64 * g, pre[i]);
         }
@@ -1110,10 +1132,20 @@ extern "C" void tm_secp256k1_verify_range(const uint8_t* pubs,
             for (size_t i = 0; i < m; i++)
                 if (valid[i]) w[i] = winv[nv++];
         }
-        // ---- per-key tables, batch-normalized to affine
+        // ---- per-key tables: cached affine where the key was seen
+        // before, else built Jacobian and batch-normalized to affine
+        bool tab_hit[CH];
         for (size_t i = 0; i < m; i++) {
             if (!valid[i]) continue;
-            build_q_tab(qt[i], pre[i].Q);
+            tab_hit[i] = qtab_cache.lookup(
+                pubs + 33 * (base + i), reinterpret_cast<uint8_t*>(qa[i]));
+            if (tab_hit[i]) continue;
+            Jac Q;  // lazy: only missed keys decompress
+            if (!fetch_q(pubs + 33 * (base + i), Q)) {
+                valid[i] = false;
+                continue;
+            }
+            build_q_tab(qt[i], Q);
             // a prime-order group has no small-order points, so no table
             // entry can be infinity; guard anyway — a zero Z would poison
             // the shared inversion chain below
@@ -1126,13 +1158,13 @@ extern "C" void tm_secp256k1_verify_range(const uint8_t* pubs,
         size_t nz = 0;
         Fp* zptr[CH * 8];
         for (size_t i = 0; i < m; i++) {
-            if (!valid[i]) continue;
+            if (!valid[i] || tab_hit[i]) continue;
             for (int j = 0; j < 8; j++) zptr[nz++] = &qt[i][j].Z;
         }
         batch_invert(zptr, zinvs, nz, FP_ONE, fp_mul, fp_invert);
         nz = 0;
         for (size_t i = 0; i < m; i++) {
-            if (!valid[i]) continue;
+            if (!valid[i] || tab_hit[i]) continue;
             for (int j = 0; j < 8; j++) {
                 Fp zi2, zi3;
                 fp_sq(zi2, zinvs[nz]);
@@ -1141,6 +1173,8 @@ extern "C" void tm_secp256k1_verify_range(const uint8_t* pubs,
                 fp_mul(qa[i][j].x, qt[i][j].X, zi2);
                 fp_mul(qa[i][j].y, qt[i][j].Y, zi3);
             }
+            qtab_cache.put(pubs + 33 * (base + i),
+                           reinterpret_cast<const uint8_t*>(qa[i]));
         }
         // ---- main loops (all four streams on affine tables)
         for (size_t i = 0; i < m; i++) {
