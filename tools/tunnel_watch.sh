@@ -83,7 +83,10 @@ all_done() {
     for b in $PREWARM_BUCKETS; do
         [ -e "$OUT/done.prewarm_$b" ] || return 1
     done
-    for s in bench1 bench2 artifact kernel_ab device_time baseline; do
+    for b in 1024 2560 10240 131072; do
+        [ -e "$OUT/done.device_time_$b" ] || return 1
+    done
+    for s in bench1 bench2 artifact kernel_ab baseline; do
         [ -e "$OUT/done.$s" ] || return 1
     done
     return 0
@@ -124,16 +127,31 @@ while true; do
               cat "$OUT/kernel_ab.out"; } >"KERNEL_AB_r${ROUND}.log"
         fi
         # 5. tunnel-independent device-only timing per bucket x kernel
-        #    variant (VERDICT r3 #2) -> DEVICE_PROFILE_r04.md.
-        #    device_time exits nonzero if no variant produced a number, so
-        #    the done-marker/mv can't enshrine a stub.
-        run_step device_time 3600 python -u -m benchmarks.device_time 1024 2560 10240 131072 || continue
-        if [ -e "$OUT/done.device_time" ] && [ ! -e "DEVICE_PROFILE_r${ROUND}.md" ]; then
+        #    variant (VERDICT r3 #2) -> DEVICE_PROFILE_r04.md. One step
+        #    PER BUCKET so a window that dies mid-sequence still banks
+        #    every completed bucket's numbers; the artifact assembles
+        #    from whatever buckets have finished so far (and re-assembles
+        #    as later windows add more). device_time exits nonzero if no
+        #    variant produced a number, so a done marker can't enshrine
+        #    a stub.
+        for b in 1024 2560 10240 131072; do
+            run_step "device_time_$b" 1500 \
+                python -u -m benchmarks.device_time "$b" || continue 2
+        done
+        dt_done=""
+        for b in 1024 2560 10240 131072; do
+            [ -e "$OUT/done.device_time_$b" ] && dt_done="$dt_done $b"
+        done
+        if [ -n "$dt_done" ]; then
             { echo "# DEVICE_PROFILE — round $ROUND"
               echo
               date -u +"%Y-%m-%dT%H:%M:%SZ"
+              echo "buckets completed:$dt_done"
               echo
-              cat "$OUT/device_time.out"; } >"DEVICE_PROFILE_r${ROUND}.md"
+              for b in $dt_done; do
+                  cat "$OUT/device_time_$b.out"
+                  echo
+              done; } >"DEVICE_PROFILE_r${ROUND}.md"
         fi
         # 6. baseline configs (1=anchor 2=commit 3=validate_block
         #    5=streamed voteset; 4 is slow to build)
