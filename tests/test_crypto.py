@@ -272,3 +272,32 @@ class TestBatchVerifier:
             bv.add(pk.pub_key(), msg, sig)
             expect.append(good)
         assert bv.verify_all() == expect
+
+
+class TestGroupDispatchFailure:
+    def test_failing_backend_propagates_like_serial(self):
+        # one curve's backend raising must surface from verify_all (same
+        # contract as the serial path), not hang or corrupt ordering
+        import pytest
+
+        from tendermint_tpu.crypto import batch as cbatch
+        from tendermint_tpu.crypto import ed25519, secp256k1
+
+        def boom(pubs, msgs, sigs):
+            raise RuntimeError("backend down")
+
+        old = cbatch.get_backend("ed25519")
+        cbatch.register_backend("ed25519", boom)
+        try:
+            bv = cbatch.BatchVerifier()
+            for i in range(4):
+                pk = ed25519.gen_priv_key() if i % 2 == 0 else secp256k1.gen_priv_key()
+                m = b"gd %d" % i
+                bv.add(pk.pub_key(), m, pk.sign(m))
+            with pytest.raises(RuntimeError, match="backend down"):
+                bv.verify_all()
+        finally:
+            if old is not None:
+                cbatch.register_backend("ed25519", old)
+            else:
+                cbatch.clear_backend("ed25519")

@@ -1,0 +1,173 @@
+"""WSFrameReader + JSON fast-path unit tests.
+
+The buffered frame parser and the flat-dict template encoder replaced
+profile-hot stdlib paths (rpc/jsonrpc.py); these tests pin byte-exact
+equivalence so the fast paths can never drift from the generic ones.
+Reference analog: the reference leans on gorilla/websocket's own suite;
+this repo's RFC6455 implementation is in-tree, so its edge cases are too.
+"""
+import asyncio
+import json
+import random
+
+import pytest
+
+from tendermint_tpu.rpc.jsonrpc import (
+    WSFrameReader,
+    _encode_flat_obj,
+    _encode_response,
+    _ws_frame,
+    _ws_mask,
+)
+
+
+class _FeedReader:
+    """StreamReader stand-in delivering a byte script in chosen chunks."""
+
+    def __init__(self, chunks):
+        self._chunks = list(chunks)
+
+    async def read(self, n):
+        if not self._chunks:
+            return b""
+        return self._chunks.pop(0)
+
+
+def _frames_bytes(frames, mask=False):
+    return b"".join(_ws_frame(op, payload, mask=mask) for op, payload in frames)
+
+
+class TestWSFrameReader:
+    def _roundtrip(self, frames, split_points, mask=False):
+        data = _frames_bytes(frames, mask=mask)
+        chunks = []
+        prev = 0
+        for p in sorted(split_points):
+            chunks.append(data[prev:p])
+            prev = p
+        chunks.append(data[prev:])
+        fb = WSFrameReader(_FeedReader([c for c in chunks if c]))
+
+        async def run():
+            out = []
+            for _ in frames:
+                out.append(await fb.read_frame())
+            return out
+
+        assert asyncio.run(run()) == frames
+
+    def test_every_split_point_single_frame(self):
+        frame = (0x1, b"hello websocket")
+        data = _frames_bytes([frame])
+        for p in range(1, len(data)):
+            self._roundtrip([frame], [p])
+
+    def test_every_split_point_masked(self):
+        frame = (0x1, b"masked payload!")
+        data = _frames_bytes([frame], mask=True)
+        for p in range(1, len(data)):
+            self._roundtrip([frame], [p], mask=True)
+
+    def test_extended_16bit_and_tiny_frames_coalesced(self):
+        frames = [
+            (0x1, b"x" * 200),       # 126-length form
+            (0x2, b""),              # empty payload
+            (0x9, b"ping"),
+            (0x1, b"y" * 65600),     # 127-length (64-bit) form
+        ]
+        # one big chunk: all frames parse from a single read
+        self._roundtrip(frames, [])
+        # split inside the 64-bit length header of the last frame
+        data = _frames_bytes(frames)
+        self._roundtrip(frames, [len(data) - 65600 - 4])
+
+    def test_random_splits_random_frames(self):
+        rng = random.Random(7)
+        frames = [
+            (0x1, bytes(rng.randrange(256) for _ in range(rng.randrange(0, 300))))
+            for _ in range(12)
+        ]
+        data = _frames_bytes(frames)
+        for _ in range(20):
+            k = rng.randrange(1, 6)
+            points = sorted(rng.randrange(1, len(data)) for _ in range(k))
+            self._roundtrip(frames, points)
+
+    def test_oversize_frame_rejected(self):
+        fb = WSFrameReader(_FeedReader([]), max_frame=1024)
+        fb._buf += _ws_frame(0x1, b"z" * 2000)
+        with pytest.raises(ConnectionError, match="too large"):
+            fb.buffered_frame()
+
+    def test_eof_mid_frame_raises_incomplete(self):
+        data = _ws_frame(0x1, b"truncated payload")[:-5]
+        fb = WSFrameReader(_FeedReader([data]))
+
+        async def run():
+            await fb.read_frame()
+
+        with pytest.raises(asyncio.IncompleteReadError):
+            asyncio.run(run())
+
+    def test_nonzero_mask_key_still_unmasked(self):
+        # the identity-key fast path must not break real masked peers
+        payload = b"gorilla-style client frame"
+        key = b"\x12\x34\x56\x78"
+        head = bytes([0x81, 0x80 | len(payload)]) + key + _ws_mask(payload, key)
+        fb = WSFrameReader(_FeedReader([head]))
+
+        async def run():
+            return await fb.read_frame()
+
+        assert asyncio.run(run()) == (0x1, payload)
+
+
+class TestFlatObjEncoder:
+    def test_matches_json_dumps_on_flat_dicts(self):
+        rng = random.Random(11)
+        safe = "".join(
+            chr(c) for c in range(0x20, 0x7F) if chr(c) not in ('"', "\\")
+        )
+        for _ in range(200):
+            d = {}
+            for k in range(rng.randrange(0, 6)):
+                key = "".join(rng.choice(safe) for _ in range(rng.randrange(1, 9)))
+                if rng.random() < 0.5:
+                    d[key] = rng.randrange(-(10**12), 10**12)
+                else:
+                    d[key] = "".join(
+                        rng.choice(safe) for _ in range(rng.randrange(0, 40))
+                    )
+            enc = _encode_flat_obj(d)
+            assert enc == json.dumps(d, separators=(",", ":")).encode()
+
+    @pytest.mark.parametrize(
+        "d",
+        [
+            {"a": True},                # bool is not int here
+            {"a": 1.5},                 # float
+            {"a": None},
+            {"a": {"nested": 1}},
+            {"a": [1, 2]},
+            {"a": 'quote"inside'},
+            {"a": "back\\slash"},
+            {"a": "unicode ☃"},
+            {"a": "ctrl\x01char"},
+        ],
+    )
+    def test_bails_to_generic_encoder(self, d):
+        assert _encode_flat_obj(d) is None
+        # and the response encoder still produces correct JSON for them
+        resp = {"jsonrpc": "2.0", "id": 1, "result": d}
+        assert json.loads(_encode_response(resp)) == resp
+
+    def test_response_envelope_fast_path_is_byte_identical(self):
+        for rid in (7, -1, "sub#event"):
+            resp = {
+                "jsonrpc": "2.0",
+                "id": rid,
+                "result": {"code": 0, "data": "", "log": "", "hash": "ab" * 32},
+            }
+            assert _encode_response(resp) == json.dumps(
+                resp, separators=(",", ":")
+            ).encode()
