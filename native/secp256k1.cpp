@@ -1,11 +1,23 @@
 // secp256k1 ECDSA verification, clean-room C++.
 //
 // The native-parity replacement for the reference's vendored libsecp256k1
-// (crypto/secp256k1/internal, 17.5k LoC of C): this framework only needs
-// the verify path natively (signing stays in the Python key objects), in
-// tendermint's wire format — 33-byte compressed pubkey, 64-byte r||s
-// signature with the low-S rule (reference secp256k1_nocgo.go:40-50),
-// SHA-256 message digest.
+// (crypto/secp256k1/internal, 17.5k LoC of C): this framework implements
+// the VERIFY path natively, in tendermint's wire format — 33-byte
+// compressed pubkey, 64-byte r||s signature with the low-S rule
+// (reference secp256k1_nocgo.go:40-50), SHA-256 message digest.
+//
+// Signing is deliberately NOT reimplemented here. Every scalar
+// multiplication in this file is VARIABLE-TIME (wNAF recoding, digit-
+// indexed table loads, data-dependent branches) — safe for verification,
+// whose inputs are public, and where variable-time is the whole speed
+// story. A signer runs the same math on SECRET nonces and keys, where
+// those exact properties are a timing/cache side channel; doing it right
+// means constant-time ladders and cmov table scans — a different,
+// hardened codebase (what libsecp256k1's signing half actually is).
+// Signing therefore stays on the vetted OpenSSL path behind the Python
+// key objects (crypto/secp256k1.py), where it is nowhere near a hot
+// loop: a validator signs ONE vote per consensus step and verifies
+// hundreds to thousands.
 //
 // Field arithmetic: 4x64 limbs, reduction by p = 2^256 - 0x1000003D1.
 // Scalar arithmetic mod n: folding reduction by c = 2^256 - n (129 bits).
