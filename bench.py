@@ -103,7 +103,7 @@ def _probe_device(timeout_s: float = 150.0, attempts: int = 3) -> None:
     raise SystemExit(3)
 
 
-def _supervised() -> None:
+def _supervised(started_at: float) -> None:
     """Run the measurement in a watchdogged CHILD process group.
 
     A tunnel that answers the probe can still wedge during the first
@@ -132,11 +132,15 @@ def _supervised() -> None:
         # the child runs in its own session, outside any process-group
         # kill aimed at THIS process (tunnel_watch run_step sends TERM to
         # the group on step timeout): forward it or the wedged-jax child
-        # survives orphaned, holding the tunnel against every retry
+        # survives orphaned, holding the tunnel against every retry.
+        # A result captured before the external kill still counts.
         try:
             os.killpg(child.pid, signal.SIGKILL)
         except ProcessLookupError:
             pass
+        if json_line:
+            print(json_line[0], flush=True)
+            raise SystemExit(0)
         raise SystemExit(128 + signum)
 
     signal.signal(signal.SIGTERM, _forward_kill)
@@ -152,16 +156,17 @@ def _supervised() -> None:
 
     t = threading.Thread(target=_reader, daemon=True)
     t.start()
-    # pre-headline budget covers a fully cold compile of every bucket;
-    # once the JSON exists only a short grace for diagnostics remains.
-    # Probe (<=180s) + deadline + grace must stay INSIDE the smallest
-    # external step timeout (tunnel_watch gives bench 1800s): the
-    # internal watchdog must fire first or the external group-kill
-    # discards an already-captured JSON line.
-    deadline = time.monotonic() + float(
-        os.environ.get("TMTPU_BENCH_DEADLINE_S", 20 * 60)
-    )
+    # The WHOLE process — probe (worst case 3x150s + backoffs = 540s),
+    # compile, measurement, post-JSON grace — must finish inside the
+    # smallest external step timeout (tunnel_watch gives bench 1800s),
+    # or the external group-kill discards an already-captured JSON line.
+    # The deadline is therefore anchored at process START, not here: a
+    # slow probe eats compile budget instead of overrunning the window.
     grace_after_json = float(os.environ.get("TMTPU_BENCH_JSON_GRACE_S", 120))
+    total_budget = float(os.environ.get("TMTPU_BENCH_TOTAL_S", 1700))
+    deadline = started_at + max(60.0, total_budget - grace_after_json)
+    if "TMTPU_BENCH_DEADLINE_S" in os.environ:  # test hook
+        deadline = time.monotonic() + float(os.environ["TMTPU_BENCH_DEADLINE_S"])
     json_seen_at = None
     while True:
         if child.poll() is not None:
@@ -200,9 +205,10 @@ def main() -> None:
     # FORCE_SUPERVISE exercises the watchdog wrapper on CPU (tests)
     if not smoke or os.environ.get("TMTPU_BENCH_FORCE_SUPERVISE"):
         if not os.environ.get("TMTPU_BENCH_CHILD"):
+            started_at = time.monotonic()
             if not smoke:
                 _probe_device()
-            _supervised()
+            _supervised(started_at)
             return  # unreachable (SystemExit above); keeps intent clear
     if os.environ.get("TMTPU_BENCH_TEST_HANG") == "pre":
         time.sleep(3600)  # watchdog test hook: wedged-compile simulation

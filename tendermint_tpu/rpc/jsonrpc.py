@@ -89,6 +89,19 @@ def _encode_response(resp) -> bytes:
     return json.dumps(resp, separators=(",", ":")).encode()
 
 
+# the flood-path request shape (our own pipelined client emits exactly
+# this, rpc/client.py call_nowait_raw): one int id, one hex tx param. A
+# match parses without the generic JSON decoder; anything else — other
+# methods, escapes, base64 txs, batches — falls back to json.loads and
+# MUST behave identically (hex strings contain no JSON escapes, so the
+# fast parse is byte-equivalent on its accepted subset).
+_REQ_FAST = re.compile(
+    rb'^\{"jsonrpc":"2\.0","id":(0|[1-9]\d{0,17}),'
+    rb'"method":"([A-Za-z0-9_]{1,64})",'
+    rb'"params":\{"tx":"([0-9a-fA-F]*)"\}\}$'
+)
+
+
 def _resp_ok(req_id, result) -> dict:
     return {"jsonrpc": "2.0", "id": req_id, "result": result}
 
@@ -258,6 +271,15 @@ class JSONRPCServer(BaseService):
         )
 
     async def _dispatch_raw(self, ctx: ConnContext, body: bytes):
+        m = _REQ_FAST.match(body)
+        if m is not None:
+            req = {
+                "jsonrpc": "2.0",
+                "id": int(m.group(1)),
+                "method": m.group(2).decode(),
+                "params": {"tx": m.group(3).decode()},
+            }
+            return await self._dispatch_one(ctx, req)
         try:
             req = json.loads(body)
         except Exception as e:

@@ -171,3 +171,48 @@ class TestFlatObjEncoder:
             assert _encode_response(resp) == json.dumps(
                 resp, separators=(",", ":")
             ).encode()
+
+
+class TestRequestFastParse:
+    def test_fast_path_equivalent_to_json_loads(self):
+        import json as _json
+
+        from tendermint_tpu.rpc.jsonrpc import _REQ_FAST
+
+        cases = [
+            b'{"jsonrpc":"2.0","id":7,"method":"broadcast_tx_async","params":{"tx":"deadBEEF00"}}',
+            b'{"jsonrpc":"2.0","id":123456,"method":"broadcast_tx_sync","params":{"tx":""}}',
+        ]
+        for body in cases:
+            m = _REQ_FAST.match(body)
+            assert m is not None
+            fast = {
+                "jsonrpc": "2.0",
+                "id": int(m.group(1)),
+                "method": m.group(2).decode(),
+                "params": {"tx": m.group(3).decode()},
+            }
+            assert fast == _json.loads(body)
+
+    def test_everything_else_falls_through(self):
+        from tendermint_tpu.rpc.jsonrpc import _REQ_FAST
+
+        for body in [
+            b'{"jsonrpc":"2.0","id":"s1","method":"status","params":{}}',   # str id
+            b'{"jsonrpc":"2.0","id":1,"method":"subscribe","params":{"query":"x"}}',
+            b'{"jsonrpc":"2.0","id":1,"method":"broadcast_tx_async","params":{"tx":"zz"}}',  # non-hex
+            b'{"jsonrpc":"2.0","id":1,"method":"broadcast_tx_async","params":{"tx":"ab"},"x":1}',
+            b'[{"jsonrpc":"2.0","id":1,"method":"health","params":{}}]',
+            b'{"jsonrpc": "2.0", "id": 1, "method": "health", "params": {}}',  # spaces
+        ]:
+            assert _REQ_FAST.match(body) is None
+
+    def test_leading_zero_id_falls_through(self):
+        # 007 is invalid JSON: the fast path must not accept what
+        # json.loads rejects (PARSE_ERROR parity on adversarial bytes)
+        from tendermint_tpu.rpc.jsonrpc import _REQ_FAST
+
+        body = b'{"jsonrpc":"2.0","id":007,"method":"broadcast_tx_async","params":{"tx":"ab"}}'
+        assert _REQ_FAST.match(body) is None
+        ok = b'{"jsonrpc":"2.0","id":0,"method":"broadcast_tx_async","params":{"tx":"ab"}}'
+        assert _REQ_FAST.match(ok) is not None
