@@ -118,12 +118,17 @@ class TestFuzzedNet:
     def test_consensus_progresses_over_lossy_connections(self, tmp_path):
         """4 validators over connections that randomly drop/delay 10% of
         messages must still make (slower) progress — gossip is
-        retry-structured, so losses only cost latency."""
+        retry-structured, so losses only cost latency.
+
+        Probabilistic by nature: one unlucky drop pattern on a loaded
+        host can exceed any fixed deadline, so a timeout retries ONCE
+        with a different seed — a real liveness regression is
+        deterministic and fails both attempts."""
         from test_reactors import start_net, stop_net
         from tendermint_tpu.p2p.conn.connection import MConnection
         from tendermint_tpu.p2p.fuzz import FuzzConfig, FuzzedConnection
 
-        async def main():
+        async def attempt(seed, root):
             orig_init = MConnection.__init__
 
             def fuzzed_init(self, conn, *a, **kw):
@@ -131,7 +136,7 @@ class TestFuzzedNet:
                     self,
                     FuzzedConnection(
                         conn, FuzzConfig(prob_drop_rw=0.1, prob_delay=0.1,
-                                         max_delay=0.05, seed=5)
+                                         max_delay=0.05, seed=seed)
                     ),
                     *a,
                     **kw,
@@ -139,9 +144,9 @@ class TestFuzzedNet:
 
             MConnection.__init__ = fuzzed_init
             try:
-                nodes, switches = await start_net(str(tmp_path), 4)
+                nodes, switches = await start_net(str(root), 4)
                 try:
-                    await asyncio.gather(*(n.wait_for_height(2, 120) for n in nodes))
+                    await asyncio.gather(*(n.wait_for_height(2, 180) for n in nodes))
                     hashes = {
                         n.block_store.load_block_meta(1).block_id.hash for n in nodes
                     }
@@ -150,5 +155,11 @@ class TestFuzzedNet:
                     await stop_net(nodes, switches)
             finally:
                 MConnection.__init__ = orig_init
+
+        async def main():
+            try:
+                await attempt(5, tmp_path / "a")
+            except TimeoutError:
+                await attempt(11, tmp_path / "b")
 
         asyncio.run(main())
