@@ -13,7 +13,7 @@ from collections import OrderedDict
 from dataclasses import dataclass
 
 from tendermint_tpu.abci import types as abci
-from tendermint_tpu.crypto import sum_sha256
+from tendermint_tpu.types.tx import tx_hash
 from tendermint_tpu.libs.clist import CList
 from tendermint_tpu.libs.log import NOP, Logger
 
@@ -48,7 +48,7 @@ class TxCache:
         self._map: OrderedDict[bytes, None] = OrderedDict()
 
     def push(self, tx: bytes) -> bool:
-        key = sum_sha256(tx)
+        key = tx_hash(tx)
         if key in self._map:
             self._map.move_to_end(key)
             return False
@@ -58,7 +58,7 @@ class TxCache:
         return True
 
     def remove(self, tx: bytes) -> None:
-        self._map.pop(sum_sha256(tx), None)
+        self._map.pop(tx_hash(tx), None)
 
     def reset(self) -> None:
         self._map.clear()
@@ -124,7 +124,7 @@ class CListMempool:
             raise MempoolFullError(f"mempool full: {len(self.txs)} txs")
         if not self.cache.push(tx):
             # record the extra sender for no-echo gossip, then reject
-            el = self._tx_map.get(sum_sha256(tx))
+            el = self._tx_map.get(tx_hash(tx))
             if el is not None and sender is not None:
                 el.value.senders.add(sender)
             raise TxInCacheError("tx already in cache")
@@ -143,7 +143,7 @@ class CListMempool:
     def _add_tx(self, tx: bytes, gas_wanted: int, sender: str | None) -> None:
         mtx = MempoolTx(tx, self.height, gas_wanted, {sender} if sender else set())
         el = self.txs.push_back(mtx)
-        self._tx_map[sum_sha256(tx)] = el
+        self._tx_map[tx_hash(tx)] = el
         self._txs_bytes += len(tx)
         self._notify_tx_available()
 
@@ -193,7 +193,7 @@ class CListMempool:
         self._tx_available.clear()
         for tx in txs:
             self.cache.push(tx)  # committed txs stay in cache
-            el = self._tx_map.pop(sum_sha256(tx), None)
+            el = self._tx_map.pop(tx_hash(tx), None)
             if el is not None:
                 self._txs_bytes -= len(el.value.tx)
                 self.txs.remove(el)
@@ -214,7 +214,7 @@ class CListMempool:
                 tx = el.value.tx
                 self._txs_bytes -= len(tx)
                 self.txs.remove(el)
-                self._tx_map.pop(sum_sha256(tx), None)
+                self._tx_map.pop(tx_hash(tx), None)
                 if not self._keep_invalid_in_cache:
                     self.cache.remove(tx)
 

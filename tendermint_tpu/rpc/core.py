@@ -19,6 +19,7 @@ from tendermint_tpu.mempool import MempoolError, TxInCacheError
 from tendermint_tpu.rpc.jsonrpc import INTERNAL_ERROR, INVALID_PARAMS, RPCError
 from tendermint_tpu.types import events as tmevents
 from tendermint_tpu.types.evidence import decode_evidence
+from tendermint_tpu.types.tx import tx_hash
 
 SUBSCRIPTION_BUFFER = 100
 
@@ -417,11 +418,9 @@ class Environment:
         if not self._async_drainer_active:
             self._async_drainer_active = True
             asyncio.ensure_future(self._drain_async_txs())
-        from tendermint_tpu.crypto import sum_sha256
-
         # flat str/int dict: the wire layer's template fast path renders
         # it without the generic JSON encoder (jsonrpc._encode_flat_obj)
-        return {"code": 0, "data": "", "log": "", "hash": sum_sha256(raw).hex()}
+        return {"code": 0, "data": "", "log": "", "hash": tx_hash(raw).hex()}
 
     async def _drain_async_txs(self) -> None:
         try:
@@ -464,13 +463,11 @@ class Environment:
         """Reference rpc/core/mempool.go BroadcastTxCommit: subscribe to the
         tx event, CheckTx, wait for DeliverTx."""
         raw = _tx_arg(tx)
-        from tendermint_tpu.crypto import sum_sha256
-
-        tx_hash = sum_sha256(raw)
+        txh = tx_hash(raw)
         self._subscriber_seq += 1
         subscriber = f"broadcast_tx_commit-{self._subscriber_seq}"
         sub = self.event_bus.subscribe(
-            subscriber, tmevents.query_for_tx(tx_hash.hex()), buffer=1
+            subscriber, tmevents.query_for_tx(txh.hex()), buffer=1
         )
         try:
             try:
@@ -481,7 +478,7 @@ class Environment:
                 return {
                     "check_tx": tx_response_json(check_res),
                     "deliver_tx": {},
-                    "hash": _hex(tx_hash),
+                    "hash": _hex(txh),
                     "height": 0,
                 }
             try:
@@ -493,7 +490,7 @@ class Environment:
             return {
                 "check_tx": tx_response_json(check_res),
                 "deliver_tx": tx_response_json(data["result"]),
-                "hash": _hex(tx_hash),
+                "hash": _hex(txh),
                 "height": data["height"],
             }
         finally:

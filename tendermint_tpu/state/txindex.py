@@ -9,7 +9,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 from tendermint_tpu.abci.types import ResponseDeliverTx
-from tendermint_tpu.crypto import sum_sha256
+from tendermint_tpu.types.tx import tx_hash
 from tendermint_tpu.encoding import Reader, Writer
 from tendermint_tpu.libs.db import DB
 from tendermint_tpu.libs.pubsub import Query
@@ -71,7 +71,7 @@ class KVTxIndexer(TxIndexer):
         self._db = db
 
     def index(self, result: TxResult) -> None:
-        h = sum_sha256(result.tx)
+        h = tx_hash(result.tx)
         self._db.set(b"TX:h:" + h, result.encode())
         for key, values in result.result.events.items():
             for v in values:

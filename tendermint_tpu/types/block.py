@@ -243,6 +243,7 @@ class Data:
 
     txs: list[Tx] = field(default_factory=list)
     _hash: bytes | None = field(default=None, repr=False, compare=False)
+    _enc: bytes | None = field(default=None, repr=False, compare=False)
 
     def hash(self) -> bytes:
         # memoized: the txs root is re-read by validation, header checks
@@ -253,10 +254,15 @@ class Data:
         return self._hash
 
     def encode(self) -> bytes:
-        w = Writer().u32(len(self.txs))
-        for tx in self.txs:
-            w.bytes(tx)
-        return w.build()
+        # memoized for the same reason: proposal creation, part-set
+        # split, and block-store save each encode the (immutable) payload
+        # — at tm-bench block sizes that tripled the hottest CBE path
+        if self._enc is None:
+            w = Writer().u32(len(self.txs))
+            for tx in self.txs:
+                w.bytes(tx)
+            self._enc = w.build()
+        return self._enc
 
     @classmethod
     def read(cls, r: Reader) -> "Data":

@@ -2,13 +2,38 @@
 merkle root over tx hashes."""
 from __future__ import annotations
 
+from collections import OrderedDict
+
 from tendermint_tpu.crypto import merkle, sum_sha256
 
 Tx = bytes
 
+# Memo: the same tx bytes are hashed ~9 times across a node lifetime
+# (mempool LRU key, tx-map key x2, post-commit update, RPC ack, indexer
+# key, block data root) — a dict hit costs ~10x less than SHA-256 of a
+# 250-byte tx, and the profile showed hashing as a top per-tx cost.
+# Bounds are by BYTES, not entries (keys pin the raw tx bytes: an
+# entry-count cap alone would let near-max-size txs pin gigabytes), with
+# oversize txs never memoized (hashing dominates dict costs there
+# anyway) and FIFO single eviction — no recompute cliff at the cap.
+_MEMO_MAX_TX = 4096
+_MEMO_MAX_BYTES = 32 * 1024 * 1024
+_memo: OrderedDict[bytes, bytes] = OrderedDict()
+_memo_bytes = 0
+
 
 def tx_hash(tx: Tx) -> bytes:
-    return sum_sha256(tx)
+    h = _memo.get(tx)
+    if h is None:
+        h = sum_sha256(tx)
+        if len(tx) <= _MEMO_MAX_TX:
+            global _memo_bytes
+            while _memo_bytes > _MEMO_MAX_BYTES - len(tx):
+                old, _ = _memo.popitem(last=False)
+                _memo_bytes -= len(old)
+            _memo[tx] = h
+            _memo_bytes += len(tx)
+    return h
 
 
 def txs_hash(txs: list[Tx]) -> bytes:
