@@ -75,6 +75,9 @@ class Writer:
         return self
 
     def str(self, s: str) -> "Writer":
+        if not s:  # empty strings dominate ABCI response fields
+            self._parts.append(b"\x00\x00\x00\x00")
+            return self
         return self.bytes(s.encode("utf-8"))
 
     def build(self) -> bytes:

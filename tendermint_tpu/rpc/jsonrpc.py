@@ -45,6 +45,21 @@ class RPCError(Exception):
 _JSON_PLAIN = re.compile(r'^[ !#-\[\]-~]*$')
 
 
+# keys are handler-authored constants that repeat every response: a
+# membership probe replaces the per-call regex after first sight
+_SAFE_KEYS: set = set()
+
+
+def _key_ok(k) -> bool:
+    if k in _SAFE_KEYS:
+        return True
+    if type(k) is str and _JSON_PLAIN.match(k):
+        if len(_SAFE_KEYS) < 4096:
+            _SAFE_KEYS.add(k)
+        return True
+    return False
+
+
 def _encode_flat_obj(d: dict) -> bytes | None:
     """Render a flat {str: str|int} dict without the generic JSON encoder
     (bools and nested/float/None values bail to the generic path). Output
@@ -53,11 +68,11 @@ def _encode_flat_obj(d: dict) -> bytes | None:
     for k, v in d.items():
         t = type(v)
         if t is str:
-            if not _JSON_PLAIN.match(v) or not _JSON_PLAIN.match(k):
+            if not _JSON_PLAIN.match(v) or not _key_ok(k):
                 return None
             parts.append('"%s":"%s"' % (k, v))
         elif t is int:
-            if not _JSON_PLAIN.match(k):
+            if not _key_ok(k):
                 return None
             parts.append('"%s":%d' % (k, v))
         else:
