@@ -1,0 +1,219 @@
+"""Fast-sync throughput benchmark — the analog of the reference's
+`benchmarks/blockchain/localsync.sh` (fast-sync wall-clock harness), run
+fully in-process over the real p2p stack.
+
+Usage: python -m benchmarks.fastsync_bench [heights] [validators] [txs/block]
+       (defaults 300 4 20)
+
+Builds an H-block chain offline (V validators sign every commit — the
+commit-verify work that dominates real fast sync, SURVEY §3.5 hot loop
+#3), then boots a fresh node that fast-syncs it from a serving peer over
+loopback TCP through the full SecretConnection/MConnection stack. The
+syncing side's BlockchainReactor routes commit verification through the
+batched verify-ahead path, so this measures the end-to-end pipeline:
+gossip, decode, batched signature verification, ApplyBlock, store.
+
+Reference path being modeled: blockchain/v0/pool.go + reactor.go:211
+(verify second.LastCommit against first's validators, then ApplyBlock).
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+import tempfile
+import time
+
+CHAIN_ID = "fastsync-bench"
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+async def build_chain(genesis, pvs, height: int, txs_per_block: int):
+    """Offline chain construction: fabricate + apply H blocks, returning
+    (state_db_snapshot, block_store, final_state) sources for serving."""
+    from tendermint_tpu.abci import types as abci
+    from tendermint_tpu.abci.examples import KVStoreApplication
+    from tendermint_tpu.libs.db import MemDB
+    from tendermint_tpu import proxy
+    from tendermint_tpu.state import StateStore, state_from_genesis
+    from tendermint_tpu.state.execution import BlockExecutor
+    from tendermint_tpu.types import VoteSet, VoteType
+    from tendermint_tpu.types.vote import Vote
+
+    state = state_from_genesis(genesis)
+    state_db, block_db = MemDB(), MemDB()
+    state_store, block_store = StateStore(state_db), BlockStore_open(block_db)
+    conns = proxy.AppConns(proxy.LocalClientCreator(KVStoreApplication(provable=False)))
+    await conns.start()
+    await conns.consensus.init_chain(abci.RequestInitChain(chain_id=CHAIN_ID))
+    executor = BlockExecutor(state_store, conns.consensus)
+    commit = None
+    t0 = time.perf_counter()
+    for h in range(1, height + 1):
+        txs = [b"h%d-k%d=v" % (h, i) for i in range(txs_per_block)]
+        proposer = state.validators.get_proposer().address
+        block = state.make_block(
+            h, txs, commit, [], proposer,
+            time_ns=genesis.genesis_time + h,
+        )
+        block_id = block.block_id()
+        voteset = VoteSet(CHAIN_ID, h, 0, VoteType.PRECOMMIT, state.validators)
+        votes = []
+        for pv in pvs:
+            idx, _ = state.validators.get_by_address(pv.address)
+            vote = Vote(
+                VoteType.PRECOMMIT, h, 0, block_id,
+                block.header.time + 1, pv.address, idx,
+            )
+            votes.append(pv.sign_vote(CHAIN_ID, vote))
+        voteset.add_votes(votes)
+        seen_commit = voteset.make_commit()
+        block_store.save_block(block, block.make_part_set(), seen_commit)
+        state = await executor.apply_block(state, block_id, block)
+        commit = seen_commit
+    await conns.stop()
+    log(f"chain built: {height} blocks x {len(pvs)} sigs "
+        f"in {time.perf_counter() - t0:.1f}s")
+    return state_db, block_store, state
+
+
+def BlockStore_open(db):
+    from tendermint_tpu.store import BlockStore
+
+    return BlockStore(db)
+
+
+async def run(height: int, n_vals: int, txs_per_block: int) -> float:
+    from tendermint_tpu.blockchain.reactor import BlockchainReactor
+    from tendermint_tpu.consensus.reactor import ConsensusReactor
+    from tendermint_tpu.consensus.state import ConsensusState
+    from tendermint_tpu.consensus.wal import NilWAL
+    from tendermint_tpu.config import make_test_config
+    from tendermint_tpu.libs.db import MemDB
+    from tendermint_tpu.p2p import test_util
+    from tendermint_tpu import proxy
+    from tendermint_tpu.abci import types as abci
+    from tendermint_tpu.abci.examples import KVStoreApplication
+    from tendermint_tpu.state import (
+        StateStore,
+        load_state_from_db_or_genesis,
+        state_from_genesis,
+    )
+    from tendermint_tpu.state.execution import BlockExecutor
+    from tendermint_tpu.types.event_bus import EventBus
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from tendermint_tpu.types.priv_validator import MockPV
+
+    pvs = sorted((MockPV() for _ in range(n_vals)), key=lambda p: p.address)
+    genesis = GenesisDoc(
+        chain_id=CHAIN_ID,
+        genesis_time=1_700_000_000_000_000_000,
+        validators=[GenesisValidator(pv.get_pub_key(), 10) for pv in pvs],
+    )
+
+    src_state_db, src_block_store, src_state = await build_chain(
+        genesis, pvs, height, txs_per_block
+    )
+
+    # serving side: a BlockchainReactor over the prebuilt store (no
+    # consensus — it only answers BlockRequests, like a caught-up peer)
+    src_exec = BlockExecutor(StateStore(src_state_db), None)
+    src_reactor = BlockchainReactor(
+        src_state, src_exec, src_block_store, fast_sync=False
+    )
+
+    # syncing side: fresh everything, boots in fast-sync mode
+    with tempfile.TemporaryDirectory() as root:
+        cfg = make_test_config(root)
+        conns = proxy.AppConns(
+            proxy.LocalClientCreator(KVStoreApplication(provable=False))
+        )
+        await conns.start()
+        await conns.consensus.init_chain(abci.RequestInitChain(chain_id=CHAIN_ID))
+        state_db = MemDB()
+        state_store = StateStore(state_db)
+        block_store = BlockStore_open(MemDB())
+        state = load_state_from_db_or_genesis(state_db, genesis)
+        event_bus = EventBus()
+        await event_bus.start()
+        from tendermint_tpu.mempool import CListMempool
+
+        mempool = CListMempool(conns.mempool)
+        block_exec = BlockExecutor(state_store, conns.consensus, mempool=mempool,
+                                   event_bus=event_bus)
+        cs = ConsensusState(
+            cfg.consensus, state, block_exec, block_store,
+            mempool=mempool, priv_validator=None, wal=NilWAL(),
+            event_bus=event_bus,
+        )
+        cons_reactor = ConsensusReactor(cs, fast_sync=True)
+        sync_reactor = BlockchainReactor(
+            state, block_exec, block_store, fast_sync=True
+        )
+        reactor_sets = [
+            {"BLOCKCHAIN": src_reactor},
+            {"BLOCKCHAIN": sync_reactor, "CONSENSUS": cons_reactor},
+        ]
+        switches = await test_util.make_connected_switches(
+            2, lambda i: reactor_sets[i], network=CHAIN_ID
+        )
+        # fast sync can only apply up to H-1: verifying block h needs
+        # block h+1's LastCommit (reference reactor.go:211 PeekTwoBlocks),
+        # and the tip's successor doesn't exist — a live node gets the
+        # final block by switching to consensus. Measure to H-1.
+        target = height - 1
+        try:
+            t0 = time.perf_counter()
+            deadline = t0 + 300.0
+            last_report = t0
+            while block_store.height() < target:
+                now = time.perf_counter()
+                if now > deadline:
+                    raise SystemExit(
+                        f"fast sync stalled at {block_store.height()}/{target}"
+                    )
+                if os.environ.get("FSB_DEBUG") and now - last_report > 2.0:
+                    last_report = now
+                    log(f"  debug: synced={block_store.height()} "
+                        f"peers={[len(sw.peers.list()) for sw in switches]} "
+                        f"pool_h={getattr(sync_reactor.pool, 'height', '?')} "
+                        f"ranges={getattr(sync_reactor.pool, '_peers', '?')}")
+                await asyncio.sleep(0.02)
+            dt = time.perf_counter() - t0
+        finally:
+            await test_util.stop_switches(switches)
+            await event_bus.stop()
+            await conns.stop()
+            await cs.stop() if hasattr(cs, "stop") else None
+    synced = height - 1
+    sigs = synced * n_vals
+    log(
+        f"fast-synced {synced} blocks ({txs_per_block} txs, {n_vals} commit "
+        f"sigs each) in {dt:.2f}s: {synced / dt:,.1f} blocks/s, "
+        f"{sigs / dt:,.0f} commit-sigs/s verified through the batched "
+        f"verify-ahead path"
+    )
+    return synced / dt
+
+
+def main(argv):
+    height = int(argv[1]) if len(argv) > 1 else 300
+    n_vals = int(argv[2]) if len(argv) > 2 else 4
+    txs = int(argv[3]) if len(argv) > 3 else 20
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    # the batch-verify backends register on ops import (a full node does
+    # this in its composition root); without it every commit signature
+    # falls back to the serial OpenSSL path
+    import tendermint_tpu.ops  # noqa: F401
+
+    asyncio.run(run(height, n_vals, txs))
+
+
+if __name__ == "__main__":
+    main(sys.argv)

@@ -306,3 +306,15 @@ class TestMonitor:
                     pass
 
         asyncio.run(main())
+
+
+class TestFastSyncBench:
+    def test_small_run_completes(self):
+        # the localsync.sh-analog harness (benchmarks/fastsync_bench):
+        # build a 8-block chain, fast-sync it over the real p2p stack
+        import asyncio
+
+        from benchmarks.fastsync_bench import run
+
+        rate = asyncio.run(run(8, 2, 3))
+        assert rate > 0
