@@ -44,8 +44,10 @@ async def build_chain(genesis, pvs, height: int, txs_per_block: int):
     from tendermint_tpu.types.vote import Vote
 
     state = state_from_genesis(genesis)
+    from tendermint_tpu.store import BlockStore
+
     state_db, block_db = MemDB(), MemDB()
-    state_store, block_store = StateStore(state_db), BlockStore_open(block_db)
+    state_store, block_store = StateStore(state_db), BlockStore(block_db)
     conns = proxy.AppConns(proxy.LocalClientCreator(KVStoreApplication(provable=False)))
     await conns.start()
     await conns.consensus.init_chain(abci.RequestInitChain(chain_id=CHAIN_ID))
@@ -80,12 +82,6 @@ async def build_chain(genesis, pvs, height: int, txs_per_block: int):
     return state_db, block_store, state
 
 
-def BlockStore_open(db):
-    from tendermint_tpu.store import BlockStore
-
-    return BlockStore(db)
-
-
 async def run(height: int, n_vals: int, txs_per_block: int) -> float:
     from tendermint_tpu.blockchain.reactor import BlockchainReactor
     from tendermint_tpu.consensus.reactor import ConsensusReactor
@@ -97,11 +93,7 @@ async def run(height: int, n_vals: int, txs_per_block: int) -> float:
     from tendermint_tpu import proxy
     from tendermint_tpu.abci import types as abci
     from tendermint_tpu.abci.examples import KVStoreApplication
-    from tendermint_tpu.state import (
-        StateStore,
-        load_state_from_db_or_genesis,
-        state_from_genesis,
-    )
+    from tendermint_tpu.state import StateStore, load_state_from_db_or_genesis
     from tendermint_tpu.state.execution import BlockExecutor
     from tendermint_tpu.types.event_bus import EventBus
     from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
@@ -135,7 +127,9 @@ async def run(height: int, n_vals: int, txs_per_block: int) -> float:
         await conns.consensus.init_chain(abci.RequestInitChain(chain_id=CHAIN_ID))
         state_db = MemDB()
         state_store = StateStore(state_db)
-        block_store = BlockStore_open(MemDB())
+        from tendermint_tpu.store import BlockStore
+
+        block_store = BlockStore(MemDB())
         state = load_state_from_db_or_genesis(state_db, genesis)
         event_bus = EventBus()
         await event_bus.start()
@@ -187,7 +181,7 @@ async def run(height: int, n_vals: int, txs_per_block: int) -> float:
             await test_util.stop_switches(switches)
             await event_bus.stop()
             await conns.stop()
-            await cs.stop() if hasattr(cs, "stop") else None
+            await cs.stop()
     synced = height - 1
     sigs = synced * n_vals
     log(
