@@ -294,6 +294,11 @@ async def _replay_console(cfg) -> int:
 
 def cmd_lite(args) -> int:
     """Reference lite.go: light-client proxy over a full node's RPC."""
+    # batch-verify backends register on ops import (the node command gets
+    # this via the composition root); the lite proxy's header-chain
+    # verification is BASELINE hot loop #4 and must not silently fall
+    # back to the serial path
+    import tendermint_tpu.ops  # noqa: F401
     from tendermint_tpu.lite.proxy import run_lite_proxy
 
     async def run():
