@@ -40,6 +40,38 @@ def clear_backend(key_type: str) -> None:
     _BACKENDS.pop(key_type, None)
 
 
+_auto_ops_tried = False
+_auto_ops_jobs_seen = 0
+
+
+def _maybe_register_default_backends(n_jobs: int) -> None:
+    """Backends register when `tendermint_tpu.ops` is imported (the node
+    does this in its composition root), but standalone consumers — the
+    lite proxy, benches, scripts — can forget and silently verify big
+    batches one signature at a time (the fast-sync bench lost 40% to
+    exactly this). Once enough verification work has flowed through with
+    no backend registered — one big batch, or a stream of smaller ones —
+    register ops' backends once, via its idempotent register() (NOT the
+    import side effect, which is a no-op if ops was imported earlier).
+    Genuinely tiny one-off uses never pay the import.
+    Set TMTPU_NO_AUTO_OPS=1 to opt out."""
+    global _auto_ops_tried, _auto_ops_jobs_seen
+    _auto_ops_jobs_seen += n_jobs
+    if _auto_ops_tried or (n_jobs < 128 and _auto_ops_jobs_seen < 512):
+        return
+    import os
+
+    _auto_ops_tried = True
+    if os.environ.get("TMTPU_NO_AUTO_OPS"):
+        return
+    try:
+        import tendermint_tpu.ops as _ops
+
+        _ops.register()  # idempotent; honors TMTPU_NO_ACCEL
+    except Exception:  # noqa: BLE001 — acceleration is optional
+        pass
+
+
 # optional observability hook: fn(batch_size, seconds)
 _metrics_sink = None
 
@@ -120,6 +152,10 @@ class BatchVerifier:
         ok = [True] * self._n_items
         for idx in self._invalid_items:
             ok[idx] = False
+        if not _BACKENDS and not _auto_ops_tried:
+            _maybe_register_default_backends(
+                sum(len(g[0]) for g in self._groups.values())
+            )
 
         def run_group(entry):
             key_type, (items, pubs, msgs, sigs) = entry

@@ -301,3 +301,64 @@ class TestGroupDispatchFailure:
                 cbatch.register_backend("ed25519", old)
             else:
                 cbatch.clear_backend("ed25519")
+
+
+class TestAutoBackendRegistration:
+    def test_large_batch_triggers_registration_once(self, monkeypatch):
+        from tendermint_tpu.crypto import batch as cbatch
+
+        saved = dict(cbatch._BACKENDS)
+        cbatch._BACKENDS.clear()
+        monkeypatch.setattr(cbatch, "_auto_ops_tried", False)
+        monkeypatch.setattr(cbatch, "_auto_ops_jobs_seen", 0)
+        monkeypatch.delenv("TMTPU_NO_AUTO_OPS", raising=False)
+        monkeypatch.delenv("TMTPU_NO_ACCEL", raising=False)
+        try:
+            # small batch: no attempt yet
+            cbatch._maybe_register_default_backends(8)
+            assert not cbatch._auto_ops_tried and not cbatch._BACKENDS
+            # one large batch registers via ops.register() — explicitly,
+            # so it works even though ops is already in sys.modules
+            cbatch._maybe_register_default_backends(2048)
+            assert cbatch._auto_ops_tried
+            assert cbatch.get_backend("ed25519") is not None
+        finally:
+            cbatch._BACKENDS.clear()
+            cbatch._BACKENDS.update(saved)
+
+    def test_cumulative_small_batches_trigger(self, monkeypatch):
+        from tendermint_tpu.crypto import batch as cbatch
+
+        saved = dict(cbatch._BACKENDS)
+        cbatch._BACKENDS.clear()
+        monkeypatch.setattr(cbatch, "_auto_ops_tried", False)
+        monkeypatch.setattr(cbatch, "_auto_ops_jobs_seen", 0)
+        monkeypatch.delenv("TMTPU_NO_AUTO_OPS", raising=False)
+        monkeypatch.delenv("TMTPU_NO_ACCEL", raising=False)
+        try:
+            # a 100-validator chain's steady stream of sub-128 batches
+            # must still cross the cumulative threshold
+            for _ in range(6):
+                cbatch._maybe_register_default_backends(100)
+                if cbatch._auto_ops_tried:
+                    break
+            assert cbatch._auto_ops_tried
+            assert cbatch.get_backend("ed25519") is not None
+        finally:
+            cbatch._BACKENDS.clear()
+            cbatch._BACKENDS.update(saved)
+
+    def test_opt_out_env(self, monkeypatch):
+        from tendermint_tpu.crypto import batch as cbatch
+
+        saved = dict(cbatch._BACKENDS)
+        cbatch._BACKENDS.clear()
+        monkeypatch.setattr(cbatch, "_auto_ops_tried", False)
+        monkeypatch.setattr(cbatch, "_auto_ops_jobs_seen", 0)
+        monkeypatch.setenv("TMTPU_NO_AUTO_OPS", "1")
+        try:
+            cbatch._maybe_register_default_backends(2048)
+            assert cbatch._auto_ops_tried and not cbatch._BACKENDS
+        finally:
+            cbatch._BACKENDS.clear()
+            cbatch._BACKENDS.update(saved)
