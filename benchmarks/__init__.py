@@ -1,1 +1,17 @@
-"""Micro-benchmarks (reference benchmarks/ + per-package bench_test.go)."""
+"""Benchmark harnesses (reference `benchmarks/` + per-package bench_test.go).
+
+| module | what it measures | where it runs |
+|---|---|---|
+| `micro` | crypto sign/verify (serial vs native vs device), codec, mempool, clist | CPU (device rows when present) |
+| `baseline_configs` | the five BASELINE.json configs (reference hot paths 1-5) | CPU or device |
+| `node_profile` | end-to-end kvstore tx/s under the tm-bench analog + whole-process cProfile, by subsystem | CPU |
+| `fastsync_bench` | fast-sync blocks/s over the real p2p stack (localsync.sh analog) | CPU or device |
+| `kernel_compare` | XLA vs Pallas vs radix-8 verify kernels at given buckets | device |
+| `device_time` | device-only ms/launch via fori-loop slope (cancels tunnel RPC cost) | device |
+| `device_profile` | transfer/launch/fetch breakdown of one verify | device |
+| `tunnel_probe` | axon tunnel latency/bandwidth/pipelining characterization | device |
+
+Root-level `bench.py` is the driver's headline benchmark (10k-validator
+commit verify stream); `tools/tunnel_watch.sh` sequences the device-side
+harnesses unattended whenever the TPU tunnel answers.
+"""
