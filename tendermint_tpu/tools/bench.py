@@ -55,11 +55,13 @@ class Stats:
 class Transacter:
     """One websocket connection spraying txs (reference transacter.go)."""
 
-    def __init__(self, host: str, port: int, rate: int, size: int, conn_idx: int) -> None:
+    def __init__(self, host: str, port: int, rate: int, size: int, conn_idx: int,
+                 method: str = "broadcast_tx_async") -> None:
         self.host, self.port = host, port
         self.rate = rate
         self.size = max(size, 40)
         self.conn_idx = conn_idx
+        self.method = method  # async|sync|commit, reference -broadcast-tx-method
         self.sent = 0
 
     WINDOW = 256  # in-flight responses per connection
@@ -83,9 +85,7 @@ class Transacter:
                     # request loop measures round-trip latency, not node
                     # throughput
                     window.append(
-                        ws.call_nowait_raw(
-                            "broadcast_tx_async", '{"tx":"%s"}' % tx.hex()
-                        )
+                        ws.call_nowait_raw(self.method, '{"tx":"%s"}' % tx.hex())
                     )
                     self.sent += 1
                     if len(window) % self.DRAIN_EVERY == 0:
@@ -124,7 +124,13 @@ async def run_bench(
     rate: int = 1000,
     connections: int = 1,
     tx_size: int = 250,
+    method: str = "async",
 ) -> dict:
+    method_route = {
+        "async": "broadcast_tx_async",
+        "sync": "broadcast_tx_sync",
+        "commit": "broadcast_tx_commit",
+    }[method]
     stats = Stats()
     stop = asyncio.Event()
 
@@ -146,7 +152,8 @@ async def run_bench(
 
     watch_task = asyncio.ensure_future(watch())
     transacters = [
-        Transacter(host, port, rate, tx_size, i) for i in range(connections)
+        Transacter(host, port, rate, tx_size, i, method=method_route)
+        for i in range(connections)
     ]
     await asyncio.gather(*(t.run(duration, stop) for t in transacters))
     await asyncio.sleep(1.0)  # drain the last block
@@ -166,10 +173,17 @@ def main(argv=None) -> int:
     p.add_argument("-r", "--rate", type=int, default=1000)
     p.add_argument("-c", "--connections", type=int, default=1)
     p.add_argument("-s", "--size", type=int, default=250)
+    p.add_argument(
+        "--broadcast-tx-method",
+        choices=("async", "sync", "commit"),
+        default="async",
+        help="reference tm-bench -broadcast-tx-method",
+    )
     args = p.parse_args(argv)
     host, _, port = args.endpoint.rpartition(":")
     report = asyncio.run(
-        run_bench(host, int(port), args.duration, args.rate, args.connections, args.size)
+        run_bench(host, int(port), args.duration, args.rate, args.connections,
+                  args.size, method=args.broadcast_tx_method)
     )
     import json
 
