@@ -63,6 +63,7 @@ class Transacter:
         self.conn_idx = conn_idx
         self.method = method  # async|sync|commit, reference -broadcast-tx-method
         self.sent = 0
+        self.rejected = 0  # error responses / nonzero CheckTx codes
 
     WINDOW = 256  # in-flight responses per connection
     DRAIN_EVERY = 32  # frames queued between writer drains
@@ -91,7 +92,7 @@ class Transacter:
                     if len(window) % self.DRAIN_EVERY == 0:
                         await ws.drain()
                     while len(window) >= self.WINDOW:
-                        await window.popleft()
+                        self._tally(await window.popleft())
                     if stop.is_set() or time.monotonic() >= end:
                         return
                 await ws.drain()
@@ -105,11 +106,26 @@ class Transacter:
                     # a node whose loop stalled (socket open, no answers)
                     # must not hang the benchmark report forever
                     async with asyncio.timeout(10.0):
-                        await asyncio.gather(*window, return_exceptions=True)
+                        for resp in await asyncio.gather(
+                            *window, return_exceptions=True
+                        ):
+                            self._tally(resp)
                 except TimeoutError:
                     for f in window:
                         f.cancel()
             await ws.close()
+
+    def _tally(self, resp) -> None:
+        """Sync/commit mode exists to OBSERVE acceptance: count error
+        responses and nonzero CheckTx codes instead of discarding them
+        (async acks are always code 0 by construction)."""
+        if isinstance(resp, BaseException) or "error" in resp:
+            self.rejected += 1
+            return
+        result = resp.get("result") or {}
+        code = result.get("code", result.get("check_tx", {}).get("code", 0))
+        if code:
+            self.rejected += 1
 
     def _make_tx(self) -> bytes:
         # unique key=value so the kvstore app never dedups
@@ -126,11 +142,13 @@ async def run_bench(
     tx_size: int = 250,
     method: str = "async",
 ) -> dict:
-    method_route = {
-        "async": "broadcast_tx_async",
-        "sync": "broadcast_tx_sync",
-        "commit": "broadcast_tx_commit",
-    }[method]
+    short = method.removeprefix("broadcast_tx_")
+    if short not in ("async", "sync", "commit"):
+        raise ValueError(
+            f"method must be async|sync|commit (or the broadcast_tx_ "
+            f"route name), got {method!r}"
+        )
+    method_route = "broadcast_tx_" + short
     stats = Stats()
     stop = asyncio.Event()
 
@@ -163,6 +181,7 @@ async def run_bench(
 
     report = stats.report(duration)
     report["txs_submitted"] = sum(t.sent for t in transacters)
+    report["txs_rejected"] = sum(t.rejected for t in transacters)
     return report
 
 
