@@ -92,7 +92,13 @@ class Transacter:
                     if len(window) % self.DRAIN_EVERY == 0:
                         await ws.drain()
                     while len(window) >= self.WINDOW:
-                        self._tally(await window.popleft())
+                        try:
+                            resp = await window.popleft()
+                        except Exception as e:  # connection died: stop
+                            # this transacter but keep the report alive
+                            self._tally(e)
+                            return
+                        self._tally(resp)
                     if stop.is_set() or time.monotonic() >= end:
                         return
                 await ws.drain()
@@ -123,7 +129,11 @@ class Transacter:
             self.rejected += 1
             return
         result = resp.get("result") or {}
-        code = result.get("code", result.get("check_tx", {}).get("code", 0))
+        code = result.get("code")
+        if code is None:
+            # commit mode: a tx is only accepted if BOTH phases are ok
+            code = (result.get("check_tx", {}).get("code", 0)
+                    or result.get("deliver_tx", {}).get("code", 0))
         if code:
             self.rejected += 1
 
@@ -173,11 +183,13 @@ async def run_bench(
         Transacter(host, port, rate, tx_size, i, method=method_route)
         for i in range(connections)
     ]
-    await asyncio.gather(*(t.run(duration, stop) for t in transacters))
-    await asyncio.sleep(1.0)  # drain the last block
-    stop.set()
-    watch_task.cancel()
-    await watcher.close()
+    try:
+        await asyncio.gather(*(t.run(duration, stop) for t in transacters))
+        await asyncio.sleep(1.0)  # drain the last block
+    finally:
+        stop.set()
+        watch_task.cancel()
+        await watcher.close()
 
     report = stats.report(duration)
     report["txs_submitted"] = sum(t.sent for t in transacters)

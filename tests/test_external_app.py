@@ -1,0 +1,94 @@
+"""Full node driving an EXTERNAL ABCI application process over a TCP
+socket — the reference's `test/app/test.sh` tier (kvstore over the
+socket transport, tx committed, state queried back), in BOTH wire
+codecs: this framework's CBE framing and the reference's protobuf
+framing (`--abci proto`), which is what an existing Go/Rust app speaks.
+"""
+import asyncio
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from test_node_rpc import make_node
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_app(codec: str, port: int, log_path: str) -> subprocess.Popen:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.setdefault("TMTPU_NO_PREWARM", "1")
+    # stderr to a FILE, not a pipe: nobody drains a pipe during the test,
+    # so a chatty app would block on a full pipe buffer and stall the
+    # node's ABCI calls
+    with open(log_path, "wb") as logf:
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "tendermint_tpu.abci.cli",
+                "--abci", codec,
+                "--address", f"tcp://127.0.0.1:{port}",
+                "kvstore",
+            ],
+            stdout=subprocess.DEVNULL,
+            stderr=logf,
+            env=env,
+        )
+    # wait for the listener
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port), timeout=0.5).close()
+            return proc
+        except OSError:
+            if proc.poll() is not None:
+                with open(log_path, "rb") as f:
+                    raise RuntimeError(f"app died: {f.read().decode()[-500:]}")
+            time.sleep(0.1)
+    proc.kill()
+    raise RuntimeError("external app never listened")
+
+
+class TestExternalSocketApp:
+    @pytest.mark.parametrize("codec", ["socket", "proto"])
+    def test_node_commits_tx_through_external_app(self, tmp_path, codec):
+        port = _free_port()
+        app_proc = _spawn_app(codec, port, str(tmp_path / "app.log"))
+        try:
+            async def main():
+                node = make_node(str(tmp_path))
+                node.config.base.proxy_app = f"tcp://127.0.0.1:{port}"
+                node.config.base.abci = codec
+                await node.start()
+                try:
+                    from tendermint_tpu.rpc.client import LocalClient
+
+                    client = LocalClient(node.rpc_env)
+                    res = await client.broadcast_tx_commit(
+                        tx=b"extkey=extval".hex(), timeout=30.0
+                    )
+                    assert res["deliver_tx"].get("code", 0) == 0, res
+                    assert res["height"] > 0
+                    # query the committed key back THROUGH the app
+                    q = await client.abci_query(data=b"extkey".hex())
+                    value = bytes.fromhex(q["response"]["value"])
+                    assert value == b"extval", q
+                finally:
+                    await node.stop()
+
+            asyncio.run(main())
+        finally:
+            app_proc.terminate()
+            try:
+                app_proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                app_proc.kill()
