@@ -59,7 +59,7 @@ def _spawn_app(codec: str, port: int, log_path: str) -> subprocess.Popen:
 
 
 class TestExternalSocketApp:
-    @pytest.mark.parametrize("codec", ["socket", "proto"])
+    @pytest.mark.parametrize("codec", ["socket", "proto", "grpc"])
     def test_node_commits_tx_through_external_app(self, tmp_path, codec):
         port = _free_port()
         app_proc = _spawn_app(codec, port, str(tmp_path / "app.log"))
@@ -82,6 +82,71 @@ class TestExternalSocketApp:
                     q = await client.abci_query(data=b"extkey".hex())
                     value = bytes.fromhex(q["response"]["value"])
                     assert value == b"extval", q
+                finally:
+                    await node.stop()
+
+            asyncio.run(main())
+        finally:
+            app_proc.terminate()
+            try:
+                app_proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                app_proc.kill()
+
+    def test_counter_app_serial_nonces_over_proto_wire(self, tmp_path):
+        """The reference test.sh's counter scenario: serial nonces commit
+        in order through an external app on the protobuf wire; an
+        out-of-order nonce is rejected by the app (not by this node)."""
+        port = _free_port()
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env.setdefault("TMTPU_NO_PREWARM", "1")
+        with open(tmp_path / "counter.log", "wb") as logf:
+            app_proc = subprocess.Popen(
+                [
+                    sys.executable, "-m", "tendermint_tpu.abci.cli",
+                    "--abci", "proto",
+                    "--address", f"tcp://127.0.0.1:{port}",
+                    "--serial", "counter",
+                ],
+                stdout=subprocess.DEVNULL,
+                stderr=logf,
+                env=env,
+            )
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            try:
+                socket.create_connection(("127.0.0.1", port), timeout=0.5).close()
+                break
+            except OSError:
+                assert app_proc.poll() is None, "counter app died"
+                time.sleep(0.1)
+        try:
+            async def main():
+                node = make_node(str(tmp_path))
+                node.config.base.proxy_app = f"tcp://127.0.0.1:{port}"
+                node.config.base.abci = "proto"
+                await node.start()
+                try:
+                    from tendermint_tpu.rpc.client import LocalClient
+
+                    client = LocalClient(node.rpc_env)
+                    for n in range(3):  # nonces must land in order
+                        res = await client.broadcast_tx_commit(
+                            tx=n.to_bytes(8, "big").hex(), timeout=30.0
+                        )
+                        assert res["deliver_tx"].get("code", 0) == 0, res
+                    # replayed nonce: the app rejects it at CheckTx
+                    from tendermint_tpu.rpc.jsonrpc import RPCError
+
+                    try:
+                        res = await client.broadcast_tx_commit(
+                            tx=(0).to_bytes(8, "big").hex(), timeout=30.0
+                        )
+                        code = res["check_tx"].get("code", 0)
+                        assert code != 0, res
+                    except RPCError:
+                        pass  # CheckTx rejection surfaced as an RPC error
                 finally:
                     await node.stop()
 
