@@ -1,0 +1,115 @@
+"""Banked-measurement plumbing: quick_bench escalation + bench.py replay.
+
+Round-5 capture redesign (VERDICT r4 weak #1): tunnel windows are rare and
+short, so the first window action banks the smallest meaningful number
+(benchmarks/quick_bench.py), and the driver's end-of-round bench.py —
+which for three rounds hit a dead tunnel and recorded parsed=null —
+replays the banked REAL-TPU number with an explicit "_banked" label
+instead of recording nothing.
+"""
+from __future__ import annotations
+
+import json
+
+import pytest
+
+import bench
+from benchmarks import quick_bench
+
+
+def _tpu_record(value=123456.7):
+    return {
+        "metric": "ed25519_e2e_verifies_per_sec_per_chip",
+        "value": value,
+        "unit": "verifies/s",
+        "vs_baseline": 18.5,
+        "platform": "tpu",
+        "device_kind": "TPU v5 lite",
+        "measured_at_utc": "2026-07-31T12:00:00Z",
+        "source": "test",
+    }
+
+
+class TestReplayBanked:
+    def test_replays_headline_with_banked_label(self, tmp_path, capsys):
+        quick_bench.bank(_tpu_record(), str(tmp_path / "banked_headline.json"))
+        with pytest.raises(SystemExit) as e:
+            bench._replay_banked_or_exit(str(tmp_path))
+        assert e.value.code == 0
+        out = json.loads(capsys.readouterr().out.strip())
+        assert out["metric"].endswith("_banked")
+        assert out["value"] == 123456.7
+        assert out["vs_baseline"] == 18.5
+        assert "2026-07-31T12:00:00Z" in out["note"]
+
+    def test_headline_preferred_over_quick(self, tmp_path, capsys):
+        quick_bench.bank(
+            _tpu_record(1.0) | {"metric": "quick"},
+            str(tmp_path / "banked_quick.json"),
+        )
+        quick_bench.bank(_tpu_record(2.0), str(tmp_path / "banked_headline.json"))
+        with pytest.raises(SystemExit) as e:
+            bench._replay_banked_or_exit(str(tmp_path))
+        assert e.value.code == 0
+        assert json.loads(capsys.readouterr().out.strip())["value"] == 2.0
+
+    def test_quick_fallback_when_no_headline(self, tmp_path, capsys):
+        quick_bench.bank(
+            _tpu_record() | {"metric": "ed25519_commit_verify_10000v_per_sec"},
+            str(tmp_path / "banked_quick.json"),
+        )
+        with pytest.raises(SystemExit) as e:
+            bench._replay_banked_or_exit(str(tmp_path))
+        assert e.value.code == 0
+        out = json.loads(capsys.readouterr().out.strip())
+        assert out["metric"] == "ed25519_commit_verify_10000v_per_sec_banked"
+
+    def test_no_bank_exits_3(self, tmp_path):
+        with pytest.raises(SystemExit) as e:
+            bench._replay_banked_or_exit(str(tmp_path))
+        assert e.value.code == 3
+
+    def test_non_tpu_record_rejected(self, tmp_path):
+        # a CPU smoke run must never masquerade as a TPU measurement
+        quick_bench.bank(
+            _tpu_record() | {"platform": "cpu"},
+            str(tmp_path / "banked_headline.json"),
+        )
+        with pytest.raises(SystemExit) as e:
+            bench._replay_banked_or_exit(str(tmp_path))
+        assert e.value.code == 3
+
+    def test_corrupt_bank_file_rejected(self, tmp_path):
+        (tmp_path / "banked_headline.json").write_text("{not json")
+        with pytest.raises(SystemExit) as e:
+            bench._replay_banked_or_exit(str(tmp_path))
+        assert e.value.code == 3
+
+
+class TestQuickBench:
+    def test_escalates_and_prints_json_per_size(self, capsys):
+        # tiny sizes on CPU: same code path, bucket 128 (shared with the
+        # rest of the suite's compile cache); platform!=tpu so no banking
+        quick_bench.main(sizes=(4, 8))
+        lines = [
+            json.loads(ln)
+            for ln in capsys.readouterr().out.splitlines()
+            if ln.startswith("{")
+        ]
+        assert [r["metric"] for r in lines] == [
+            "ed25519_commit_verify_4v_per_sec",
+            "ed25519_commit_verify_8v_per_sec",
+        ]
+        for r in lines:
+            assert r["platform"] == "cpu"
+            assert r["value"] > 0
+            # vs_baseline legitimately rounds to 0.0 at these tiny sizes
+            assert r["vs_baseline"] >= 0
+            assert r["measured_at_utc"].endswith("Z")
+
+    def test_bank_atomic_overwrite(self, tmp_path):
+        path = str(tmp_path / "banked_quick.json")
+        quick_bench.bank({"a": 1}, path)
+        quick_bench.bank({"a": 2}, path)
+        assert json.load(open(path)) == {"a": 2}
+        assert list(tmp_path.iterdir()) == [tmp_path / "banked_quick.json"]
