@@ -1,0 +1,261 @@
+"""AOT pre-baking of TPU executables WITHOUT a live device.
+
+The round-2..4 postmortems all have one shape: the tunnel to the real TPU
+answers rarely and briefly, and the first device action of a cold process
+is a 100+-second XLA:TPU compile — so short windows bank nothing. This
+module removes the compile from the window entirely:
+
+- ``libtpu`` is installed locally (compile-only use is supported via PJRT
+  topology descriptions), so ``jax.jit(...).lower(...).compile()`` against
+  a ``jax.experimental.topologies`` description runs the REAL XLA:TPU +
+  Mosaic compiler on this host with no device and no tunnel.
+- The compiled executable is serialized (``jax.experimental
+  .serialize_executable``) and cached on disk, keyed by kernel-source
+  hash + jax/libtpu versions + bucket.
+- On a live device, ``load_verify_fn`` deserializes the executable into
+  the real client — an upload, not a compile — so the first verify of a
+  tunnel window costs seconds, not minutes.
+
+Version skew between the local compiler (libtpu 0.0.34 here) and the
+device runtime is handled by treating every load failure as a cache miss:
+callers fall through to the export-blob/jit path exactly as before.
+
+Bake offline:  JAX_PLATFORMS=cpu python -m tendermint_tpu.ops.aot [bucket ...]
+
+The topology name targets the tunnel device (``TPU v5 lite`` = v5e; the
+2x2 topology is the smallest the local libtpu accepts — executables are
+compiled single-device against its device 0, which matches the 1-chip
+client's device id).
+
+Reference anchor: this replaces the warm-up cost in front of the batched
+commit-verify loop at /root/reference/types/validator_set.go:591-633.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import sys
+
+TOPOLOGY = "v5e:2x2"
+_DEVICE_KIND = "TPU v5 lite"
+
+
+def _aot_dir() -> str:
+    from tendermint_tpu.ops import kcache
+
+    return os.path.join(kcache._CACHE_DIR, "aot")
+
+
+def _versions() -> str:
+    import jax
+
+    try:
+        from importlib.metadata import version
+
+        ltv = version("libtpu")
+    except Exception:  # noqa: BLE001 — absent metadata just widens the key
+        ltv = "unknown"
+    return f"jax{jax.__version__}_libtpu{ltv}"
+
+
+def _path(kname: str, bucket: int) -> str:
+    from tendermint_tpu.ops import kcache
+
+    return os.path.join(
+        _aot_dir(),
+        f"ed25519_verify_{kname}_{bucket}_{kcache._source_version()}"
+        f"_{_versions()}.aotexec",
+    )
+
+
+def _secp_version() -> str:
+    import hashlib
+
+    from tendermint_tpu.ops import pallas_secp, secp_batch
+
+    h = hashlib.sha256()
+    for m in (pallas_secp, secp_batch):
+        with open(m.__file__, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def _secp_path(bucket: int) -> str:
+    from tendermint_tpu.ops import kcache  # noqa: F401 — cache dir init
+
+    return os.path.join(
+        _aot_dir(),
+        f"secp_verify_{bucket}_{_secp_version()}_{_versions()}.aotexec",
+    )
+
+
+def _kernel_plain(kname: str):
+    """The un-jitted (keys, sigs) -> verdicts callable for a kernel name
+    (re-jitted here with explicit shardings for the topology compile)."""
+    if kname == "pallas":
+        from tendermint_tpu.ops import pallas_verify
+
+        def fn(keys, sigs):
+            return pallas_verify.pallas_verify_kernel.__wrapped__(keys, sigs)
+
+        return fn
+    from tendermint_tpu.ops import ed25519_batch
+
+    return ed25519_batch.verify_kernel.__wrapped__
+
+
+def bake(buckets, kernels=("pallas", "xla"), secp: bool = True) -> list[str]:
+    """Compile + serialize each (kernel, bucket) against the local v5e
+    topology. Returns the list of paths written. Requires NO device: run
+    under JAX_PLATFORMS=cpu so jax never dials the tunnel."""
+    import jax
+    from jax.experimental import serialize_executable, topologies
+    from jax.sharding import SingleDeviceSharding
+
+    from tendermint_tpu.ops import ed25519_batch, kcache
+
+    topo = topologies.get_topology_desc(TOPOLOGY, "tpu")
+    sharding = SingleDeviceSharding(topo.devices[0])
+    written = []
+    for b in sorted({min(int(b), kcache.MAX_BUCKET) for b in buckets}):
+        ks, ss = kcache._input_shapes(b)
+        for kname in kernels:
+            if kname == "xla" and b > 4096:
+                # the XLA kernel's serialized executable grows with the
+                # bucket (119 MB at 2048 vs pallas's constant ~20 MB —
+                # pallas streams grid tiles); at stream shapes the blob
+                # would cost more tunnel time to upload than it saves,
+                # and pallas is the preferred TPU kernel anyway
+                continue
+            if _bake_one(
+                _path(kname, b), _kernel_plain(kname), (ks, ss), sharding,
+                f"{kname} bucket {b}",
+            ):
+                written.append(_path(kname, b))
+        if secp:
+            _bake_secp(b, sharding)
+    return written
+
+
+def _bake_one(path: str, plain_fn, arg_shapes, sharding, label: str) -> bool:
+    """Compile `plain_fn` at `arg_shapes` against the topology sharding,
+    serialize, and atomically persist to `path`. Best-effort: a failure is
+    logged and skipped (bake the rest). Returns True when newly written."""
+    import jax
+    from jax.experimental import serialize_executable
+
+    if os.path.exists(path):
+        return False
+    try:
+        jitted = jax.jit(
+            plain_fn,
+            in_shardings=tuple(sharding for _ in arg_shapes),
+            out_shardings=sharding,
+        )
+        compiled = jitted.lower(*arg_shapes).compile()
+        payload, in_tree, out_tree = serialize_executable.serialize(compiled)
+        _write(path, (payload, in_tree, out_tree))
+        print(
+            f"baked {label}: {os.path.getsize(path):,} bytes",
+            file=sys.stderr,
+            flush=True,
+        )
+        return True
+    except Exception as e:  # noqa: BLE001 — bake the rest anyway
+        print(f"bake FAILED {label}: {e!r}", file=sys.stderr, flush=True)
+        return False
+
+
+def _bake_secp(bucket: int, sharding) -> None:
+    """Bake the secp256k1 verify kernel for one bucket (best-effort: the
+    kernel is TPU-only; a lowering failure just means no AOT entry)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tendermint_tpu.ops import pallas_secp, secp_batch
+
+    ss = jax.ShapeDtypeStruct((secp_batch.SIG_ROWS, bucket), jnp.int32)
+    ks = jax.ShapeDtypeStruct((secp_batch.KEY_ROWS, bucket), jnp.int32)
+
+    def plain(sigs, keys):
+        return pallas_secp.secp_verify_kernel.__wrapped__(sigs, keys)
+
+    _bake_one(_secp_path(bucket), plain, (ss, ks), sharding,
+              f"secp bucket {bucket}")
+
+
+def _write(path: str, obj) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + f".tmp{os.getpid()}"
+    with open(tmp, "wb") as f:
+        pickle.dump(obj, f)
+    os.replace(tmp, path)
+
+
+def _load(path: str):
+    """Deserialize one cached executable into the live client; returns the
+    jax.stages.Compiled or None. Any failure (missing file, version skew,
+    client without deserialize support) is a cache miss."""
+    try:
+        with open(path, "rb") as f:
+            payload, in_tree, out_tree = pickle.load(f)
+    except (OSError, ValueError, pickle.UnpicklingError, EOFError):
+        return None
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        if dev.device_kind != _DEVICE_KIND:
+            # executables are target-specific; don't rely on the client
+            # rejecting a wrong-generation binary — a skewed accept would
+            # run a wrong-target program undetected
+            print(
+                f"aot: skipping {path} — baked for {_DEVICE_KIND!r}, "
+                f"device is {dev.device_kind!r}",
+                file=sys.stderr,
+            )
+            return None
+        from jax.experimental import serialize_executable
+
+        return serialize_executable.deserialize_and_load(
+            payload, in_tree, out_tree, backend=dev.client
+        )
+    except Exception as e:  # noqa: BLE001 — runtime/compiler skew: miss
+        print(f"aot load failed ({path}): {e!r}", file=sys.stderr)
+        return None
+
+
+def load_verify_fn(bucket: int):
+    """Pre-baked ed25519 verify executable for the preferred kernel on the
+    live TPU client, or None. Tries the preferred kernel first, then the
+    other one (a baked-but-unpreferred kernel still beats a cold compile)."""
+    from tendermint_tpu.ops import kcache
+
+    preferred, _ = kcache._kernel_for("tpu")
+    for kname in (preferred, "xla" if preferred == "pallas" else "pallas"):
+        if os.environ.get("TMTPU_KERNEL") and kname != preferred:
+            break  # an explicit kernel choice must not silently switch
+        compiled = _load(_path(kname, bucket))
+        if compiled is not None:
+            print(
+                f"aot: loaded pre-baked {kname} executable, bucket {bucket}",
+                file=sys.stderr,
+            )
+            return lambda keys, sigs: compiled(keys, sigs)
+    return None
+
+
+def load_secp_fn(bucket: int):
+    """Pre-baked secp verify executable on the live client, or None."""
+    compiled = _load(_secp_path(bucket))
+    if compiled is None:
+        return None
+    return lambda sigs, keys: compiled(sigs, keys)
+
+
+if __name__ == "__main__":
+    # bake must never dial the tunnel: force CPU before jax initializes
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    wanted = [int(a) for a in sys.argv[1:]] or [128, 1024, 2048, 12288, 131072]
+    paths = bake(wanted)
+    print(f"baked {len(paths)} new executables under {_aot_dir()}")

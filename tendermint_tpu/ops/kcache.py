@@ -304,6 +304,22 @@ def get_verify_fn(bucket: int):
     import jax
 
     fn = None
+    if platform == "tpu" and not os.environ.get("TMTPU_NO_AOT_CACHE"):
+        # pre-baked AOT executable (compiled OFFLINE against the v5e
+        # topology — see ops/aot.py): deserializing into the live client
+        # is an upload, not a compile, so a cold tunnel window's first
+        # verify costs seconds instead of minutes. Load failure (version
+        # skew, client without deserialize support) falls through.
+        try:
+            from tendermint_tpu.ops import aot
+
+            fn = aot.load_verify_fn(bucket)
+        except Exception:  # noqa: BLE001 — AOT layer is best-effort
+            fn = None
+        if fn is not None:
+            with _lock:
+                _fns[key] = fn
+            return fn
     path = None
     if not os.environ.get("TMTPU_NO_EXPORT_CACHE"):
         try:

@@ -1,0 +1,96 @@
+"""AOT pre-bake machinery (ops/aot.py): compile-only TPU topologies.
+
+The point of the layer (VERDICT r4 #1b): tunnel windows must execute, not
+compile — executables are baked offline with the local libtpu compiler
+against a v5e topology and deserialized into the live client at window
+time. These tests exercise the machinery with a trivial function (the
+real kernels bake in ~minutes; the round's bake log is AOT_r05.md) and
+pin the guards that keep a wrong artifact from loading.
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.ops import aot
+
+
+@pytest.fixture()
+def topo_sharding():
+    # compile-only topology: requires local libtpu, no device, no tunnel
+    try:
+        from jax.experimental import topologies
+    except ImportError:
+        pytest.skip("no topologies module")
+    from jax.sharding import SingleDeviceSharding
+
+    try:
+        topo = topologies.get_topology_desc(aot.TOPOLOGY, "tpu")
+    except Exception as e:  # noqa: BLE001 — no local TPU compiler
+        pytest.skip(f"no compile-only TPU topology: {e!r}")
+    return SingleDeviceSharding(topo.devices[0])
+
+
+class TestBakeOne:
+    def test_trivial_fn_bakes_and_parses(self, tmp_path, topo_sharding):
+        import jax
+
+        path = str(tmp_path / "trivial.aotexec")
+        shapes = (
+            jax.ShapeDtypeStruct((8, 128), np.int32),
+            jax.ShapeDtypeStruct((8, 128), np.int32),
+        )
+        wrote = aot._bake_one(
+            path, lambda a, b: (a + b).sum(axis=0), shapes, topo_sharding,
+            "trivial",
+        )
+        assert wrote
+        with open(path, "rb") as f:
+            payload, in_tree, out_tree = pickle.load(f)
+        assert isinstance(payload, bytes) and len(payload) > 1000
+        # idempotent: an existing artifact is never re-baked
+        assert aot._bake_one(path, None, shapes, topo_sharding, "x") is False
+
+    def test_bake_failure_is_logged_not_raised(self, tmp_path, topo_sharding):
+        path = str(tmp_path / "bad.aotexec")
+        wrote = aot._bake_one(
+            path, lambda a: undefined_name,  # noqa: F821 — deliberate
+            (np.zeros(4),), topo_sharding, "bad",
+        )
+        assert wrote is False
+        import os
+
+        assert not os.path.exists(path)
+
+
+class TestLoadGuards:
+    def test_load_rejects_wrong_device_kind(self, tmp_path, topo_sharding):
+        """On a non-v5e client (this CPU test process) a baked artifact
+        must be a cache MISS, never an attempted load of a wrong-target
+        binary."""
+        import jax
+
+        path = str(tmp_path / "t.aotexec")
+        shapes = (jax.ShapeDtypeStruct((4,), np.int32),)
+        assert aot._bake_one(
+            path, lambda a: a * 2, shapes, topo_sharding, "t"
+        )
+        assert jax.devices()[0].device_kind != aot._DEVICE_KIND
+        assert aot._load(path) is None
+
+    def test_load_missing_or_corrupt_is_miss(self, tmp_path):
+        assert aot._load(str(tmp_path / "absent.aotexec")) is None
+        p = tmp_path / "corrupt.aotexec"
+        p.write_bytes(b"\x00\x01 not a pickle")
+        assert aot._load(str(p)) is None
+
+    def test_versioned_paths(self):
+        # any kernel-source edit or jax/libtpu bump must invalidate blobs
+        p = aot._path("pallas", 128)
+        from tendermint_tpu.ops import kcache
+
+        assert kcache._source_version() in p
+        assert aot._versions() in p
+        assert aot._secp_version() in aot._secp_path(128)
