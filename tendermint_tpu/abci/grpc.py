@@ -5,12 +5,19 @@ abci/types/application.go:78 (GRPCApplication). Selectable exactly like the
 reference: `--abci grpc` on the node / `abci-cli --abci grpc`, or a
 `grpc://host:port` proxy_app address.
 
-Wire format: one unary gRPC method per ABCI call at
-/tendermint.abci.types.ABCIApplication/<Method>, message bodies in the
-repo's documented CBE encoding (the same tagged frames as the socket
-protocol — grpcio-tools/protoc codegen is not in the image, so generic
-method handlers replace compiled stubs; method paths match the reference's
-service so the surface is discoverable).
+Wire format — the server registers BOTH services (generic raw-bytes
+method handlers; grpcio-tools/protoc codegen is not in the image):
+
+- /types.ABCIApplication/<Method> — the reference's actual service path
+  (types.proto `package types`, service at abci/types/types.proto:332)
+  with bare per-method PROTOBUF bodies (`rpc Echo(RequestEcho) returns
+  (ResponseEcho)` — no oneof envelope), via abci/proto.py's codec. An
+  unmodified reference-built gRPC app/client connects here.
+- /tendermint.abci.types.ABCIApplication/<Method> — this repo's earlier
+  CBE-bodied surface, kept for in-repo compatibility.
+
+The client picks by `codec`: "proto" (default — talks to either this
+server or a reference one) or "cbe" (legacy path).
 """
 from __future__ import annotations
 
@@ -19,6 +26,7 @@ import asyncio
 import grpc
 import grpc.aio
 
+from tendermint_tpu.abci import proto as pb
 from tendermint_tpu.abci import types as abci
 from tendermint_tpu.abci.client import ABCIClientError, Client
 from tendermint_tpu.abci.types import (
@@ -29,7 +37,8 @@ from tendermint_tpu.abci.types import (
 )
 from tendermint_tpu.libs.service import BaseService
 
-SERVICE = "tendermint.abci.types.ABCIApplication"
+SERVICE = "tendermint.abci.types.ABCIApplication"  # legacy CBE bodies
+SERVICE_PROTO = "types.ABCIApplication"  # reference path, protobuf bodies
 
 # method name -> request class (reference types.proto service methods)
 _METHODS = {
@@ -94,15 +103,26 @@ class GRPCABCIServer(BaseService):
 
     async def on_start(self) -> None:
         self._server = grpc.aio.server()
-        handlers = {}
+        cbe_handlers = {}
+        proto_handlers = {}
         for name in _METHODS:
-            handlers[name] = grpc.unary_unary_rpc_method_handler(
+            cbe_handlers[name] = grpc.unary_unary_rpc_method_handler(
                 self._make_handler(),
                 request_deserializer=None,
                 response_serializer=None,
             )
+            proto_handlers[name] = grpc.unary_unary_rpc_method_handler(
+                self._make_proto_handler(name),
+                request_deserializer=None,
+                response_serializer=None,
+            )
         self._server.add_generic_rpc_handlers(
-            (grpc.method_handlers_generic_handler(SERVICE, handlers),)
+            (
+                grpc.method_handlers_generic_handler(SERVICE, cbe_handlers),
+                grpc.method_handlers_generic_handler(
+                    SERVICE_PROTO, proto_handlers
+                ),
+            )
         )
         self.port = self._server.add_insecure_port(self.address)
         await self._server.start()
@@ -120,6 +140,29 @@ class GRPCABCIServer(BaseService):
 
         return handler
 
+    def _make_proto_handler(self, name: str):
+        """Reference-wire handler: bare protobuf bodies. The method name
+        fixes the request type (RequestEcho for Echo, ...); app faults
+        become gRPC status errors — the proto service has no
+        ResponseException arm per method (types.proto:332)."""
+        wrapped = self.wrapped
+        req_name = f"Request{name}"
+
+        async def handler(request: bytes, context) -> bytes:
+            try:
+                req = pb.decode_bare(req_name, request)
+            except Exception as e:  # noqa: BLE001 — malformed bytes
+                await context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT, f"bad {req_name}: {e}"
+                )
+            try:
+                resp = wrapped.handle(req)
+            except Exception as e:  # noqa: BLE001 — app panic
+                await context.abort(grpc.StatusCode.UNKNOWN, str(e))
+            return pb.encode_bare(resp)
+
+        return handler
+
     async def on_stop(self) -> None:
         if self._server is not None:
             await self._server.stop(grace=0.5)
@@ -134,18 +177,22 @@ class GRPCClient(Client):
     request queue for the same reason, grpc_client.go). *_async returns a
     future like the socket client's pipelined sends."""
 
-    def __init__(self, address: str) -> None:
+    def __init__(self, address: str, codec: str = "proto") -> None:
         super().__init__("GRPCABCIClient")
         self.address = address.replace("grpc://", "").replace("tcp://", "")
+        if codec not in ("proto", "cbe"):
+            raise ValueError(f"unknown grpc codec {codec!r}")
+        self.codec = codec
         self._channel: grpc.aio.Channel | None = None
         self._fns: dict = {}
         self._queue: asyncio.Queue = asyncio.Queue()
 
     async def on_start(self) -> None:
         self._channel = grpc.aio.insecure_channel(self.address)
+        service = SERVICE_PROTO if self.codec == "proto" else SERVICE
         for name in _METHODS:
             self._fns[name] = self._channel.unary_unary(
-                f"/{SERVICE}/{name}",
+                f"/{service}/{name}",
                 request_serializer=None,
                 response_deserializer=None,
             )
@@ -162,8 +209,12 @@ class GRPCClient(Client):
             if fut.done():  # caller gave up
                 continue
             try:
-                payload = await self._fns[method](encode_request(req))
-                resp = decode_response(payload)
+                if self.codec == "proto":
+                    payload = await self._fns[method](pb.encode_bare(req))
+                    resp = pb.decode_bare(f"Response{method}", payload)
+                else:
+                    payload = await self._fns[method](encode_request(req))
+                    resp = decode_response(payload)
             except grpc.aio.AioRpcError as e:
                 fut.set_exception(
                     ABCIClientError(f"grpc: {e.code().name}: {e.details()}")

@@ -945,6 +945,44 @@ def _decode_oneof(data: bytes, mapping):
     return result
 
 
+# --------------------------------------------------------- bare messages
+#
+# The reference's gRPC services carry the per-method messages DIRECTLY
+# (service ABCIApplication in abci/types/types.proto:332 — `rpc
+# Echo(RequestEcho) returns (ResponseEcho)`), not the Request/Response
+# oneof envelope the socket protocol frames. These helpers (de)serialize
+# that bare form so the gRPC transport can reuse this codec.
+
+_BARE: dict[str, tuple[type, "Desc", Callable, Callable]] = {}
+for _mapping in (_REQ_MAP, _RESP_MAP):
+    for _num, _cls, _desc, _to, _from in _mapping:
+        _BARE[_desc.name] = (_cls, _desc, _to, _from)
+del _mapping, _num, _cls, _desc, _to, _from
+
+
+def encode_bare(obj) -> bytes:
+    """Serialize one Request*/Response* object as a bare protobuf message
+    (gRPC body format — no oneof envelope, no length framing)."""
+    name = type(obj).__name__
+    entry = _BARE.get(name)
+    if entry is None or not isinstance(obj, entry[0]):
+        raise DecodeError(f"no bare proto mapping for {name}")
+    _, desc, to_dict, _ = entry
+    return desc.encode(
+        {k: v for k, v in to_dict(obj).items() if v is not None}
+    )
+
+
+def decode_bare(name: str, data: bytes):
+    """Decode a bare protobuf message by its schema name (e.g.
+    "RequestEcho") into the corresponding abci types object."""
+    entry = _BARE.get(name)
+    if entry is None:
+        raise DecodeError(f"unknown bare message {name}")
+    _, desc, _, from_dict = entry
+    return as_decode_error(lambda d: from_dict(desc.decode(d)), data, name)
+
+
 def encode_request(req) -> bytes:
     return _encode_oneof(req, _REQ_MAP)
 

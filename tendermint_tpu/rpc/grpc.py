@@ -4,20 +4,55 @@ Reference parity: rpc/grpc/api.go — a deliberately tiny gRPC surface next
 to the JSON-RPC server: `Ping` and `BroadcastTx` (CheckTx + DeliverTx
 result, i.e. broadcast_tx_commit semantics in the reference's
 BroadcastAPI). grpcio-tools (protoc codegen for python) is not in the
-image, so the service is registered with generic method handlers over a
-documented CBE wire format instead of compiled protobuf stubs — same
-method paths, so the service is discoverable at
-/tendermint.rpc.grpc.BroadcastAPI/{Ping,BroadcastTx}.
+image, so both services are registered with generic raw-bytes method
+handlers:
+
+- /core_grpc.BroadcastAPI/{Ping,BroadcastTx} — the reference's actual
+  service path (rpc/grpc/types.proto `package core_grpc`) with PROTOBUF
+  bodies (RequestBroadcastTx{tx}, ResponseBroadcastTx{check_tx,
+  deliver_tx}), so a reference-built gRPC client connects unmodified.
+- /tendermint.rpc.grpc.BroadcastAPI/{Ping,BroadcastTx} — this repo's
+  earlier CBE-bodied surface, kept for in-repo compatibility.
 """
 from __future__ import annotations
 
 import grpc
 import grpc.aio
 
+from tendermint_tpu.abci import proto as pb
 from tendermint_tpu.encoding import Reader, Writer
 from tendermint_tpu.libs.log import NOP, Logger
 
-SERVICE = "tendermint.rpc.grpc.BroadcastAPI"
+SERVICE = "tendermint.rpc.grpc.BroadcastAPI"  # legacy CBE bodies
+SERVICE_PROTO = "core_grpc.BroadcastAPI"  # reference path, protobuf bodies
+
+# rpc/grpc/types.proto message schemas (field numbers verbatim)
+REQ_BROADCAST_TX = pb.Desc("RequestBroadcastTx", [(1, "tx", "bytes", None)])
+RESP_BROADCAST_TX = pb.Desc(
+    "ResponseBroadcastTx",
+    [
+        (1, "check_tx", "msg", pb.RESP_CHECK_TX),
+        (2, "deliver_tx", "msg", pb.RESP_DELIVER_TX),
+    ],
+)
+
+
+def _txres_to_proto(d: dict) -> dict:
+    """RPC-side tx-result dict (hex data) -> protobuf field dict."""
+    return {
+        "code": d.get("code", 0),
+        "data": bytes.fromhex(d["data"]) if d.get("data") else b"",
+        "log": d.get("log", ""),
+    }
+
+
+def _txres_from_proto(v: dict | None) -> dict:
+    v = v or {}
+    return {
+        "code": v.get("code", 0),
+        "data": v.get("data", b"").hex(),
+        "log": v.get("log", ""),
+    }
 
 
 def _encode_response_broadcast_tx(check: dict, deliver: dict) -> bytes:
@@ -64,17 +99,40 @@ class GRPCBroadcastServer:
                 res.get("check_tx", {}), res.get("deliver_tx", {})
             )
 
+        async def broadcast_tx_proto(request: bytes, context) -> bytes:
+            try:
+                tx = REQ_BROADCAST_TX.decode(request).get("tx", b"")
+            except Exception as e:  # noqa: BLE001 — malformed bytes
+                await context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"bad RequestBroadcastTx: {e}",
+                )
+            res = await self.env.broadcast_tx_commit(tx.hex())
+            return RESP_BROADCAST_TX.encode(
+                {
+                    "check_tx": _txres_to_proto(res.get("check_tx", {})),
+                    "deliver_tx": _txres_to_proto(res.get("deliver_tx", {})),
+                }
+            )
+
         identity = lambda b: b  # noqa: E731 — raw-bytes (de)serializers
-        handlers = {
-            "Ping": grpc.unary_unary_rpc_method_handler(
-                ping, request_deserializer=identity, response_serializer=identity
-            ),
-            "BroadcastTx": grpc.unary_unary_rpc_method_handler(
-                broadcast_tx, request_deserializer=identity, response_serializer=identity
-            ),
-        }
+
+        def _h(fn):
+            return grpc.unary_unary_rpc_method_handler(
+                fn, request_deserializer=identity, response_serializer=identity
+            )
+
         server.add_generic_rpc_handlers(
-            (grpc.method_handlers_generic_handler(SERVICE, handlers),)
+            (
+                grpc.method_handlers_generic_handler(
+                    SERVICE, {"Ping": _h(ping), "BroadcastTx": _h(broadcast_tx)}
+                ),
+                grpc.method_handlers_generic_handler(
+                    SERVICE_PROTO,
+                    # Ping bodies are empty messages in both codecs
+                    {"Ping": _h(ping), "BroadcastTx": _h(broadcast_tx_proto)},
+                ),
+            )
         )
         self.bound_port = server.add_insecure_port(f"{self.host}:{self.port}")
         await server.start()
@@ -86,14 +144,18 @@ class GRPCBroadcastServer:
 
 
 class GRPCBroadcastClient:
-    def __init__(self, host: str, port: int) -> None:
+    def __init__(self, host: str, port: int, codec: str = "proto") -> None:
+        if codec not in ("proto", "cbe"):
+            raise ValueError(f"unknown grpc codec {codec!r}")
+        self.codec = codec
+        service = SERVICE_PROTO if codec == "proto" else SERVICE
         self._channel = grpc.aio.insecure_channel(f"{host}:{port}")
         identity = lambda b: b  # noqa: E731
         self._ping = self._channel.unary_unary(
-            f"/{SERVICE}/Ping", request_serializer=identity, response_deserializer=identity
+            f"/{service}/Ping", request_serializer=identity, response_deserializer=identity
         )
         self._broadcast = self._channel.unary_unary(
-            f"/{SERVICE}/BroadcastTx",
+            f"/{service}/BroadcastTx",
             request_serializer=identity,
             response_deserializer=identity,
         )
@@ -102,6 +164,13 @@ class GRPCBroadcastClient:
         await self._ping(b"")
 
     async def broadcast_tx(self, tx: bytes) -> tuple[dict, dict]:
+        if self.codec == "proto":
+            resp = await self._broadcast(REQ_BROADCAST_TX.encode({"tx": tx}))
+            v = RESP_BROADCAST_TX.decode(resp)
+            return (
+                _txres_from_proto(v.get("check_tx")),
+                _txres_from_proto(v.get("deliver_tx")),
+            )
         req = Writer().bytes(tx).build()
         resp = await self._broadcast(req)
         return decode_response_broadcast_tx(resp)

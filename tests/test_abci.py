@@ -208,7 +208,11 @@ class TestGRPC:
     abci/server/grpc_server.go, GRPCApplication at application.go:78):
     the kvstore conformance flow must behave identically over gRPC."""
 
-    def test_kvstore_conformance_over_grpc(self):
+    @pytest.mark.parametrize("codec", ["proto", "cbe"])
+    def test_kvstore_conformance_over_grpc(self, codec):
+        # "proto" = the reference wire: /types.ABCIApplication with bare
+        # protobuf bodies (types.proto:332); "cbe" = the legacy in-repo
+        # path. One server serves both.
         from tendermint_tpu.abci.grpc import GRPCABCIServer, GRPCClient
 
         async def main():
@@ -216,7 +220,7 @@ class TestGRPC:
             server = GRPCABCIServer(app, "127.0.0.1:0")
             await server.start()
             try:
-                client = GRPCClient(f"127.0.0.1:{server.port}")
+                client = GRPCClient(f"127.0.0.1:{server.port}", codec=codec)
                 await client.start()
                 echo = await client.echo("ping")
                 assert echo.message == "ping"

@@ -506,3 +506,50 @@ class TestDecoderEdgeCases:
         blob = b"\xaa\x01" + bytes([len(inner)]) + inner
         with pytest.raises(DecodeError):
             pb.decode_request(blob)
+
+
+class TestOracleBareGRPC:
+    """gRPC body format (VERDICT r4 missing #1): the reference's gRPC
+    service carries BARE per-method messages (types.proto:332 — `rpc
+    Echo(RequestEcho) returns (ResponseEcho)`), not the oneof envelope.
+    encode_bare/decode_bare must interop with protoc's serialization of
+    those standalone messages."""
+
+    @pytest.mark.parametrize(
+        "arm,req",
+        TestOracleInterop.REQUESTS,
+        ids=[a for a, _ in TestOracleInterop.REQUESTS],
+    )
+    def test_bare_request_roundtrip(self, oracle, arm, req):
+        name = type(req).__name__
+        om = getattr(oracle, name)()
+        om.ParseFromString(pb.encode_bare(req))
+        back = pb.decode_bare(name, om.SerializeToString())
+        assert back == req
+
+    @pytest.mark.parametrize(
+        "arm,resp",
+        TestOracleInterop.RESPONSES,
+        ids=[a for a, _ in TestOracleInterop.RESPONSES],
+    )
+    def test_bare_response_roundtrip(self, oracle, arm, resp):
+        name = type(resp).__name__
+        om = getattr(oracle, name)()
+        om.ParseFromString(pb.encode_bare(resp))
+        back = pb.decode_bare(name, om.SerializeToString())
+        assert back == resp
+
+    def test_bare_echo_golden_frame(self):
+        # RequestEcho{message:"hello"} bare = 0a 05 "hello" — exactly the
+        # gRPC message body a reference client sends (no envelope)
+        assert pb.encode_bare(abci.RequestEcho("hello")) == bytes.fromhex(
+            "0a0568656c6c6f"
+        )
+
+    def test_bare_unknown_name_raises(self):
+        from tendermint_tpu.encoding import DecodeError
+
+        with pytest.raises(DecodeError):
+            pb.decode_bare("RequestNope", b"")
+        with pytest.raises(DecodeError):
+            pb.encode_bare(object())

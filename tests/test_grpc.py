@@ -11,7 +11,11 @@ grpc = pytest.importorskip("grpc")
 
 
 class TestGRPCBroadcast:
-    def test_ping_and_broadcast_tx(self, tmp_path):
+    @pytest.mark.parametrize("codec", ["proto", "cbe"])
+    def test_ping_and_broadcast_tx(self, tmp_path, codec):
+        # "proto" = the reference wire (/core_grpc.BroadcastAPI, protobuf
+        # bodies per rpc/grpc/types.proto); "cbe" = legacy in-repo path.
+        # The node serves both simultaneously.
         from test_node_rpc import make_node
         from tendermint_tpu.rpc.grpc import GRPCBroadcastClient
 
@@ -24,9 +28,13 @@ class TestGRPCBroadcast:
                 async with asyncio.timeout(30):
                     while node.block_store.height() < 1:
                         await asyncio.sleep(0.05)
-                client = GRPCBroadcastClient("127.0.0.1", node.grpc_server.bound_port)
+                client = GRPCBroadcastClient(
+                    "127.0.0.1", node.grpc_server.bound_port, codec=codec
+                )
                 await client.ping()
-                check, deliver = await client.broadcast_tx(b"grpc-key=grpc-value")
+                check, deliver = await client.broadcast_tx(
+                    f"grpc-key-{codec}=grpc-value".encode()
+                )
                 assert check["code"] == 0
                 assert deliver["code"] == 0
             finally:
@@ -35,3 +43,31 @@ class TestGRPCBroadcast:
                 await node.stop()
 
         asyncio.run(main())
+
+    def test_proto_broadcast_body_schema(self):
+        """The proto-codec bodies follow rpc/grpc/types.proto exactly:
+        RequestBroadcastTx{1:tx}, ResponseBroadcastTx{1:check_tx,
+        2:deliver_tx} with nested abci ResponseCheckTx/ResponseDeliverTx."""
+        from tendermint_tpu.rpc.grpc import (
+            REQ_BROADCAST_TX,
+            RESP_BROADCAST_TX,
+            _txres_from_proto,
+            _txres_to_proto,
+        )
+
+        assert REQ_BROADCAST_TX.encode({"tx": b"abc"}) == b"\x0a\x03abc"
+        body = RESP_BROADCAST_TX.encode(
+            {
+                "check_tx": _txres_to_proto({"code": 0, "data": "", "log": "ok"}),
+                "deliver_tx": _txres_to_proto(
+                    {"code": 5, "data": "beef", "log": ""}
+                ),
+            }
+        )
+        v = RESP_BROADCAST_TX.decode(body)
+        assert _txres_from_proto(v.get("check_tx")) == {
+            "code": 0, "data": "", "log": "ok",
+        }
+        assert _txres_from_proto(v.get("deliver_tx")) == {
+            "code": 5, "data": "beef", "log": "",
+        }
