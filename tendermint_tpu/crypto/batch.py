@@ -16,10 +16,17 @@ possible (BASELINE.json config #5).
 """
 from __future__ import annotations
 
+import os
 from typing import Callable, Sequence
 
 from tendermint_tpu.crypto import PubKey
 from tendermint_tpu.crypto.multisig import PubKeyMultisigThreshold
+
+# Whole-dispatch bound on the concurrent per-curve group map (ADVICE r4:
+# wedged daemon workers are never replaced, so an unbounded wait blocks
+# the verify caller forever once the device link dies). Must exceed a
+# legitimate cold in-group kernel compile on a loaded host.
+_GROUP_TIMEOUT_S = float(os.environ.get("TMTPU_GROUP_TIMEOUT_S", 900.0))
 
 # A backend verifies a homogeneous batch of primitive signatures:
 #   fn(pubs: list[bytes], msgs: list[bytes], sigs: list[bytes]) -> list[bool]
@@ -175,7 +182,19 @@ class BatchVerifier:
             # overlapping them. Single-group batches skip the pool hop.
             from tendermint_tpu.libs.pool import shared_pool
 
-            all_results = shared_pool("tmtpu-vgrp", 4).map(run_group, groups)
+            try:
+                # bounded (ADVICE r4): a device-routed group against a
+                # wedged tunnel otherwise hangs this caller forever. The
+                # budget covers a cold in-group kernel compile; on expiry
+                # every group recomputes on the device-free serial path.
+                all_results = shared_pool("tmtpu-vgrp", 4).map(
+                    run_group, groups, timeout=_GROUP_TIMEOUT_S
+                )
+            except TimeoutError:
+                all_results = [
+                    [p.verify(m, s) for p, m, s in zip(pubs_, msgs_, sigs_)]
+                    for _, (_, pubs_, msgs_, sigs_) in groups
+                ]
         else:
             all_results = [run_group(g) for g in groups]  # 0 or 1 group
         for (_, (items, _p, _m, _s)), results in zip(groups, all_results):

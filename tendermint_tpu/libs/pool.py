@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, Iterable, TypeVar
 
 T = TypeVar("T")
@@ -67,24 +68,47 @@ class DaemonPool:
                 out[idx] = (False, e)
             done.release()
 
-    def map(self, fn: Callable[[T], R], items: Iterable[T]) -> list[R]:
+    def map(
+        self,
+        fn: Callable[[T], R],
+        items: Iterable[T],
+        timeout: float | None = None,
+    ) -> list[R]:
         """Apply fn to every item concurrently; returns results in order.
 
         The first failing item's exception is re-raised (after all items
         finished), matching `list(ThreadPoolExecutor.map(...))` semantics
         closely enough for callers that treat any raise as batch failure.
+
+        `timeout` (seconds, whole batch) bounds the wait: workers lost to
+        permanently wedged tasks — the dead-tunnel fetch scenario that
+        motivated this pool — are never replaced, so once max_workers
+        tasks wedge, an unbounded map() would block its caller forever
+        with queued work and no diagnostics (ADVICE r4). On expiry a
+        TimeoutError names the unfinished-item count; wedged workers
+        remain daemon threads and cannot block process exit.
         """
         seq = list(items)
         if not seq:
             return []
-        if len(seq) == 1:  # no cross-thread hop for the trivial case
+        if len(seq) == 1 and timeout is None:
+            # no cross-thread hop for the trivial case
             return [fn(seq[0])]
         out: list = [None] * len(seq)
         done = threading.Semaphore(0)
         for i, item in enumerate(seq):
             self._q.put((fn, item, out, i, done))
-        for _ in seq:
-            done.acquire()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for k in range(len(seq)):
+            if deadline is None:
+                done.acquire()
+            elif not done.acquire(timeout=max(0.0, deadline - time.monotonic())):
+                pending = len(seq) - k
+                raise TimeoutError(
+                    f"DaemonPool.map: {pending}/{len(seq)} items unfinished "
+                    f"after {timeout}s — workers wedged on earlier tasks? "
+                    "(wedged daemon workers are not replaced)"
+                )
         results = []
         for ok, val in out:
             if not ok:

@@ -91,9 +91,15 @@ class WSClient:
         max_reconnect_attempts: int = 25,
         backoff_base: float = 0.2,
         backoff_cap: float = 10.0,
+        random_mask: bool = False,
     ) -> None:
         self.host, self.port = host, port
         self.reconnect = reconnect
+        # False = identity (all-zero) masking key, measurably faster and
+        # fine for trusted/loopback endpoints; True = RFC 6455 §5.3
+        # unpredictable per-frame keys — set it when dialing third-party
+        # nodes through possibly-caching intermediaries (ADVICE r4)
+        self.random_mask = random_mask
         self.max_reconnect_attempts = max_reconnect_attempts
         self.backoff_base = backoff_base
         self.backoff_cap = backoff_cap
@@ -212,7 +218,9 @@ class WSClient:
         msg_id = self._last_id
         fut = asyncio.get_event_loop().create_future()
         self._pending[msg_id] = fut
-        self._writer.write(_ws_frame(0x1, data, mask=True))
+        self._writer.write(
+            _ws_frame(0x1, data, mask=True, random_mask=self.random_mask)
+        )
         return fut
 
     def _send_nowait(self, method: str, params: dict) -> asyncio.Future:

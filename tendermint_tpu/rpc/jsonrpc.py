@@ -14,6 +14,7 @@ import base64
 import hashlib
 import inspect
 import json
+import os
 import re
 import struct
 import urllib.parse
@@ -89,7 +90,16 @@ def _encode_response(resp) -> bytes:
     if isinstance(resp, list):
         return b"[" + b",".join(_encode_response(r) for r in resp) + b"]"
     result = resp.get("result")
-    if type(result) is dict and len(resp) == 3:
+    # template guard (ADVICE r4): the fast path must only fire for an
+    # actual {jsonrpc, id, result} envelope — a future 3-key dict with
+    # 'result' and some other third key would otherwise be silently
+    # rewritten (extra key dropped, jsonrpc injected)
+    if (
+        type(result) is dict
+        and len(resp) == 3
+        and resp.get("jsonrpc") == "2.0"
+        and "id" in resp
+    ):
         enc = _encode_flat_obj(result)
         if enc is not None:
             rid = resp["id"]
@@ -449,8 +459,22 @@ def _ws_mask(payload: bytes, key: bytes) -> bytes:
     return x.to_bytes(reps * 4, "little")[:n]
 
 
-def _ws_frame(opcode: int, payload: bytes, mask: bool = False) -> bytes:
-    """Encode one RFC6455 frame (FIN set)."""
+def _ws_frame(
+    opcode: int,
+    payload: bytes,
+    mask: bool = False,
+    random_mask: bool = False,
+) -> bytes:
+    """Encode one RFC6455 frame (FIN set).
+
+    mask=True, random_mask=False emits the identity (all-zero) masking
+    key: RFC-compliant framing (mask bit set, key present) whose XOR
+    transform is a no-op, so neither side runs it. Client masking exists
+    to defeat intermediary cache poisoning; for a client talking to a
+    TRUSTED endpoint over loopback the XOR was measurable at tm-bench
+    flood rates on both ends. random_mask=True restores RFC 6455 §5.3
+    unpredictable-per-frame keys for clients dialing third-party nodes
+    through possibly-caching intermediaries (ADVICE r4)."""
     head = bytes([0x80 | opcode])
     n = len(payload)
     mask_bit = 0x80 if mask else 0
@@ -461,11 +485,9 @@ def _ws_frame(opcode: int, payload: bytes, mask: bool = False) -> bytes:
     else:
         head += bytes([mask_bit | 127]) + struct.pack(">Q", n)
     if mask:
-        # Zero mask key: RFC-compliant framing (mask bit set, key
-        # present) whose XOR transform is the identity, so neither side
-        # runs it. Client masking exists to defeat intermediary cache
-        # poisoning; this client talks to trusted endpoints and the XOR
-        # was measurable at tm-bench flood rates on both ends.
+        if random_mask:
+            key = os.urandom(4)
+            return head + key + _ws_mask(payload, key)
         return head + b"\x00\x00\x00\x00" + payload
     return head + payload
 

@@ -167,6 +167,36 @@ class TestDaemonPool:
         with pytest.raises(ValueError, match="boom"):
             pool.map(work, range(3))
 
+    def test_map_timeout_names_wedged_workers(self):
+        import threading
+        import time
+
+        import pytest
+
+        from tendermint_tpu.libs.pool import DaemonPool
+
+        pool = DaemonPool(max_workers=2, name_prefix="test-pool-wedge")
+        wedge = threading.Event()
+
+        def work(i):
+            if i < 2:
+                wedge.wait(30.0)  # both workers wedge; items 2,3 starve
+            return i
+
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError, match="unfinished"):
+            pool.map(work, range(4), timeout=0.3)
+        assert time.monotonic() - t0 < 5.0
+        wedge.set()  # release the workers so the leak gate sees idle pool
+
+    def test_map_timeout_unused_when_batch_completes(self):
+        from tendermint_tpu.libs.pool import DaemonPool
+
+        pool = DaemonPool(max_workers=2, name_prefix="test-pool-tmo-ok")
+        assert pool.map(lambda i: i + 1, range(5), timeout=10.0) == [
+            1, 2, 3, 4, 5,
+        ]
+
     def test_workers_are_daemon(self):
         import threading
 

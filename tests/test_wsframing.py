@@ -121,6 +121,26 @@ class TestWSFrameReader:
 
         assert asyncio.run(run()) == (0x1, payload)
 
+    def test_random_mask_frame_roundtrips(self):
+        # RFC 6455 §5.3 opt-in (ADVICE r4): random per-frame key, and the
+        # server-side reader recovers the exact payload
+        from tendermint_tpu.rpc.jsonrpc import _ws_frame
+
+        payload = b'{"jsonrpc":"2.0","id":9,"method":"status","params":{}}'
+        frames = [
+            _ws_frame(0x1, payload, mask=True, random_mask=True)
+            for _ in range(8)
+        ]
+        keys = {f[2:6] for f in frames}
+        assert len(keys) > 1, "mask keys must vary per frame"
+        for f in frames:
+            fb = WSFrameReader(_FeedReader([f]))
+
+            async def run(fb=fb):
+                return await fb.read_frame()
+
+            assert asyncio.run(run()) == (0x1, payload)
+
 
 class TestFlatObjEncoder:
     def test_matches_json_dumps_on_flat_dicts(self):
@@ -171,6 +191,19 @@ class TestFlatObjEncoder:
             assert _encode_response(resp) == json.dumps(
                 resp, separators=(",", ":")
             ).encode()
+
+    @pytest.mark.parametrize(
+        "resp",
+        [
+            # 3 keys + dict 'result' but NOT a {jsonrpc, id, result}
+            # envelope: the template must not rewrite these (ADVICE r4)
+            {"result": {"a": 1}, "id": 1, "extra": "keep-me"},
+            {"result": {"a": 1}, "jsonrpc": "1.0", "id": 1},
+            {"result": {"a": 1}, "jsonrpc": "2.0", "other": 2},
+        ],
+    )
+    def test_non_envelope_three_key_dicts_pass_through(self, resp):
+        assert json.loads(_encode_response(resp)) == resp
 
 
 class TestRequestFastParse:
