@@ -35,6 +35,7 @@ with S < L enforced and non-canonical R encodings rejected.
 from __future__ import annotations
 
 import hashlib
+import os
 from functools import partial
 
 import jax
@@ -546,6 +547,40 @@ def _fetch_pool():
 
     return shared_pool("tmtpu-fetch", 8)
 
+
+# Whole-batch bound on the concurrent verdict fetches. Normal fetches are
+# ~65 ms RPCs (tunneled) or microseconds (local); the bound only fires
+# when the device link is wedged — where without it the caller blocks
+# forever (ADVICE r4). Generous enough for a tunnel hiccup + execute
+# backlog; a stream that legitimately needs longer has already amortized
+# its work across chunks and will recompute on the CPU path below.
+_FETCH_TIMEOUT_S = float(os.environ.get("TMTPU_FETCH_TIMEOUT_S", 300.0))
+
+
+def fetch_verdicts(arrays) -> list:
+    """Fetch dispatched device verdict arrays, BOUNDED: every entry comes
+    back as an np.ndarray or the Exception that fetching it raised —
+    TimeoutError for all of them when the whole batch exceeded
+    _FETCH_TIMEOUT_S (the wedged-device-link case, where an inline
+    np.asarray would block forever). Every fetch — including a single
+    chunk, which is every normal-sized commit — goes through the daemon
+    pool so the bound always applies. Shared by both curves' batch
+    verifiers."""
+
+    def fetch(d):
+        try:
+            return np.asarray(d)
+        except Exception as e:  # noqa: BLE001 — applied at caller's
+            # degrade step (the recompute path may itself compile)
+            return e
+
+    if not arrays:
+        return []
+    try:
+        return _fetch_pool().map(fetch, arrays, timeout=_FETCH_TIMEOUT_S)
+    except TimeoutError as e:
+        return [e] * len(arrays)
+
 # Multi-device dispatch: when more than one device is visible (a real TPU
 # slice, or the test suite's 8-virtual-CPU mesh) every chunk is
 # batch-sharded across the mesh via shard_map instead of running on one
@@ -652,26 +687,26 @@ def verify_batch(pubs, msgs, sigs) -> list[bool]:
         pending.append(
             (lo, hi, dev_out, (keys_np, sigs_np), mask, from_sharded)
         )
-    def fetch(d):
-        try:
-            return np.asarray(d)
-        except Exception as e:  # noqa: BLE001 — handled at apply time on
-            # the main thread (the degrade path may compile)
-            return e
-
-    if len(pending) > 1:
-        # fetch all chunks' verdict arrays CONCURRENTLY: each fetch is a
-        # full RPC round trip on a tunneled device (~65 ms), and a ready
-        # result's transfer doesn't need the (serialized) execute queue —
-        # threads collapse K round trips toward one. The executor is
-        # module-shared: verify_batch is the per-commit hot path and
-        # per-call thread spawn/teardown would cost more than the
-        # serialization it saves on a local (microsecond-fetch) device.
-        fetched = _fetch_pool().map(fetch, [p[2] for p in pending])
-    else:
-        fetched = [fetch(p[2]) for p in pending]
+    # fetch all chunks' verdict arrays CONCURRENTLY and BOUNDED
+    # (fetch_verdicts): each fetch is a full RPC round trip on a tunneled
+    # device (~65 ms) — threads collapse K round trips toward one — and a
+    # dead tunnel makes every fetch hang forever, so on expiry every
+    # chunk degrades to the local recompute below instead of blocking
+    # the node indefinitely (ADVICE r4).
+    fetched = fetch_verdicts([p[2] for p in pending])
     for (lo, hi, _, blocks, mask, from_sharded), got in zip(pending, fetched):
-        if isinstance(got, Exception):
+        if isinstance(got, TimeoutError):
+            # wedged device link: every further jax call — including the
+            # local-recompute degrade below — would hang the same way.
+            # Recompute this chunk on the device-free crypto path (native
+            # C++ batch core, serial OpenSSL behind it).
+            from tendermint_tpu import ops as _ops
+
+            ok = np.asarray(
+                _ops._ed25519_small(pubs[lo:hi], msgs[lo:hi], sigs[lo:hi]),
+                dtype=bool,
+            )
+        elif isinstance(got, Exception):
             # async dispatch surfaces kernel runtime failures at fetch
             # time; same degradation contract. A sharded-path failure may
             # be a mesh/transfer problem rather than a kernel defect, so

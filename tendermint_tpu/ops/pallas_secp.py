@@ -189,6 +189,29 @@ def _verify_tile_kernel(sigs_ref, keys_ref, out_ref):
     )
 
 
+@jax.jit
+def secp_verify_xla(sigs, keys):
+    """XLA (non-Pallas) variant of `secp_verify_kernel`: identical (32, B)
+    sigs + (16, B) keys wire blocks in, (B,) bool out, but `verify_tile`
+    runs as a plain array program — no Mosaic. TPU-TARGET ONLY in
+    practice: the 12-bit-limb program (16-entry table of complete RCB
+    adds + 128-iteration loop) is pathological for XLA:CPU's scalar
+    codegen — >18 min compile measured on the CI host, vs ~1 min for
+    Mosaic. It exists as the A/B variant and Mosaic-regression fallback
+    on real TPU; non-TPU meshes use the host-callback body instead
+    (parallel/sharded.py, secp_batch.host_verify_blocks). Reference
+    analog: /root/reference/crypto/secp256k1/secp256k1_nocgo.go:21-50."""
+    from tendermint_tpu.ops.secp_batch import KEY_ROWS, SIG_ROWS
+
+    assert sigs.shape[0] == SIG_ROWS and keys.shape[0] == KEY_ROWS
+    ok = verify_tile(
+        sigs[0:NWORDS], sigs[NWORDS:2 * NWORDS],
+        keys[0:NWORDS], keys[NWORDS:2 * NWORDS],
+        sigs[2 * NWORDS:3 * NWORDS], sigs[3 * NWORDS:4 * NWORDS],
+    )
+    return ok != 0
+
+
 @partial(jax.jit, static_argnames=("interpret",))
 def secp_verify_kernel(sigs, keys, interpret: bool = False):
     """Batched ECDSA verify: sigs (32, B) + keys (16, B) wire blocks in,

@@ -23,6 +23,8 @@ device-resident between batches, exactly like the ed25519 path.
 """
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from tendermint_tpu.crypto import secp256k1_math as sm
@@ -156,7 +158,101 @@ def _device_fn():
         return None
     from tendermint_tpu.ops import pallas_secp
 
-    return pallas_secp.secp_verify_kernel
+    if os.environ.get("TMTPU_NO_AOT_CACHE"):
+        return pallas_secp.secp_verify_kernel
+
+    def dispatch(sigs, keys):
+        # per-bucket pre-baked executable (ops/aot.py) when one exists —
+        # an upload instead of a cold-window compile; the jit kernel is
+        # the fallback for unbaked shapes and load failures
+        b = int(sigs.shape[1])
+        fn = _aot_fns.get(b, _AOT_UNTRIED)
+        if fn is _AOT_UNTRIED:
+            try:
+                from tendermint_tpu.ops import aot
+
+                fn = aot.load_secp_fn(b)
+            except Exception:  # noqa: BLE001 — AOT layer is best-effort
+                fn = None
+            _aot_fns[b] = fn
+        if fn is not None:
+            return fn(sigs, keys)
+        return pallas_secp.secp_verify_kernel(sigs, keys)
+
+    return dispatch
+
+
+_AOT_UNTRIED = object()
+_aot_fns: dict[int, object] = {}
+
+# Multi-device dispatch (SURVEY §7: both curves shard across chips). Same
+# shape as ed25519_batch._multi_device_fn: a batch-sharded shard_map over
+# the largest power-of-two device prefix. Gated to TPU by default — on a
+# CPU host the serial OpenSSL path beats a jitted limb kernel (see
+# _device_fn) — with TMTPU_SECP_MESH=1 forcing it on for the virtual-mesh
+# routing tests and dryruns.
+_sharded = None  # (fn, NamedSharding) | None, built once
+
+
+def _multi_device_fn():
+    import jax
+
+    if jax.default_backend() != "tpu" and not os.environ.get(
+        "TMTPU_SECP_MESH"
+    ):
+        return None, None
+    devices = jax.devices()
+    if len(devices) < 2:
+        return None, None
+    global _sharded
+    if _sharded is None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tendermint_tpu.ops import kcache
+        from tendermint_tpu.parallel import sharded as shard_mod
+
+        kcache.enable_persistent_cache()
+        p = 1 << (len(devices).bit_length() - 1)
+        mesh = shard_mod.make_batch_mesh(devices[: min(p, 128)])
+        _sharded = (
+            shard_mod.build_secp_stream_verifier(mesh),
+            NamedSharding(mesh, P(None, shard_mod.AXIS)),
+        )
+    return _sharded
+
+
+def host_verify_blocks(sigs_blk, keys_blk) -> np.ndarray:
+    """Reference-semantics verification of packed wire blocks on the HOST
+    (python ints, crypto/secp256k1_math): sigs (32, B) + keys (16, B)
+    int32 word planes in, (B,) bool out — the exact verdict contract of
+    `pallas_secp.secp_verify_kernel`/`secp_verify_xla`, computed without
+    any device program. Used as the per-shard body on non-TPU meshes
+    (ops/pallas_secp.py documents why the limb kernels are not viable on
+    XLA:CPU) and usable as an oracle anywhere. All-zero (padded) lanes
+    yield False, matching the kernels' garbage-lane contract."""
+    sigs_w = np.ascontiguousarray(np.asarray(sigs_blk)).view(np.uint32)
+    keys_w = np.ascontiguousarray(np.asarray(keys_blk)).view(np.uint32)
+    b = sigs_w.shape[1]
+    out = np.zeros(b, dtype=bool)
+
+    def word_int(plane, col):
+        return int.from_bytes(plane[:, col].astype("<u4").tobytes(), "little")
+
+    for i in range(b):
+        u1 = word_int(sigs_w[0:NWORDS], i)
+        u2 = word_int(sigs_w[NWORDS:2 * NWORDS], i)
+        t1 = word_int(sigs_w[2 * NWORDS:3 * NWORDS], i)
+        t2 = word_int(sigs_w[3 * NWORDS:4 * NWORDS], i)
+        qx = word_int(keys_w[0:NWORDS], i)
+        qy = word_int(keys_w[NWORDS:2 * NWORDS], i)
+        r = sm.point_add(
+            sm.scalar_mult(u1, sm.G), sm.scalar_mult(u2, (qx, qy, 1))
+        )
+        x, _, z = r
+        if z % sm.P == 0:
+            continue
+        out[i] = x % sm.P in (t1 * z % sm.P, t2 * z % sm.P)
+    return out
 
 
 def _serial_verify(pubs, msgs, sigs) -> list[bool]:
@@ -175,7 +271,8 @@ def verify_batch(pubs, msgs, sigs) -> list[bool]:
     from tendermint_tpu.ops import kcache
 
     fn = _device_fn()
-    if fn is None:
+    mfn, sharding = _multi_device_fn()
+    if fn is None and mfn is None:
         return _serial_verify(pubs, msgs, sigs)
     n = len(pubs)
     pending: list[tuple[int, int, object, np.ndarray]] = []
@@ -186,23 +283,49 @@ def verify_batch(pubs, msgs, sigs) -> list[bool]:
         if packed is None:
             continue
         sigs_np, keys_np = split(packed)
-        try:
-            import jax
+        import jax
 
-            keys_dev = _dev_keys.get(
-                pubs[lo:hi], keys_np, cacheable=bool(mask.all())
-            )
-            # commit both args: a committed/uncommitted mix is a separate
-            # jit cache key and re-traces the kernel (see ed25519_batch)
-            dev_out = fn(jax.device_put(sigs_np), keys_dev)
-        except Exception:  # noqa: BLE001 — kernel failure degrades to
-            # serial, never breaks verification
+        dev_out = None
+        if mfn is not None:
+            try:
+                keys_dev = _dev_keys.get(
+                    pubs[lo:hi], keys_np, sharding, cacheable=bool(mask.all())
+                )
+                dev_out = mfn(jax.device_put(sigs_np, sharding), keys_dev)
+            except Exception:  # noqa: BLE001 — a sharding/mesh/transfer
+                # failure is not a kernel failure: degrade to the
+                # single-device path (or serial below)
+                dev_out = None
+        if dev_out is None and fn is not None:
+            try:
+                # after a failed sharded attempt the cache may hold a
+                # mesh-placed key block: re-place plainly, don't reuse it
+                keys_dev = (
+                    jax.device_put(keys_np) if mfn is not None
+                    else _dev_keys.get(
+                        pubs[lo:hi], keys_np, cacheable=bool(mask.all())
+                    )
+                )
+                # commit both args: a committed/uncommitted mix is a
+                # separate jit cache key and re-traces the kernel (see
+                # ed25519_batch)
+                dev_out = fn(jax.device_put(sigs_np), keys_dev)
+            except Exception:  # noqa: BLE001 — kernel failure degrades to
+                # serial, never breaks verification
+                dev_out = None
+        if dev_out is None:
             out[lo:hi] = _serial_verify(pubs[lo:hi], msgs[lo:hi], sigs[lo:hi])
             continue
         pending.append((lo, hi, dev_out, mask))
-    for lo, hi, dev_out, mask in pending:
-        try:
-            out[lo:hi] = np.asarray(dev_out)[: hi - lo] & mask
-        except Exception:  # noqa: BLE001 — async failure surfaces at fetch
+    # concurrent, BOUNDED fetches (shared helper): a wedged device link
+    # degrades every chunk to the serial path instead of blocking the
+    # caller forever
+    from tendermint_tpu.ops.ed25519_batch import fetch_verdicts
+
+    fetched = fetch_verdicts([p[2] for p in pending])
+    for (lo, hi, _, mask), got in zip(pending, fetched):
+        if isinstance(got, Exception):
             out[lo:hi] = _serial_verify(pubs[lo:hi], msgs[lo:hi], sigs[lo:hi])
+        else:
+            out[lo:hi] = got[: hi - lo] & mask
     return out.tolist()

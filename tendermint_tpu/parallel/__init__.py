@@ -11,6 +11,7 @@ riding ICI.
 """
 from tendermint_tpu.parallel.sharded import (
     build_commit_verifier,
+    build_secp_stream_verifier,
     build_sharded_verifier,
     build_stream_verifier,
     make_batch_mesh,
@@ -19,6 +20,7 @@ from tendermint_tpu.parallel.sharded import (
 
 __all__ = [
     "build_commit_verifier",
+    "build_secp_stream_verifier",
     "build_sharded_verifier",
     "build_stream_verifier",
     "make_batch_mesh",

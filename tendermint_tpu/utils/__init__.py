@@ -1,8 +1,14 @@
 """Shared utilities."""
 from tendermint_tpu.utils.sigbatch import (
+    make_secp_batch,
     make_sig_batch,
     straddle_tampers,
     tiled_tampered_batch,
 )
 
-__all__ = ["make_sig_batch", "straddle_tampers", "tiled_tampered_batch"]
+__all__ = [
+    "make_secp_batch",
+    "make_sig_batch",
+    "straddle_tampers",
+    "tiled_tampered_batch",
+]

@@ -31,6 +31,40 @@ def make_sig_batch(
     return pubs, msgs, sigs
 
 
+def make_secp_batch(
+    n: int,
+    tamper: set[int] | tuple[int, ...] = (),
+    n_unique: int = 128,
+) -> tuple[list[bytes], list[bytes], list[bytes]]:
+    """n secp256k1-ECDSA triples tiled from n_unique seeded keys (ECDSA
+    signing is ~100x slower than tiling; device work per lane is
+    data-independent). Tampered indices get the LOW BIT of s flipped
+    (sig[63] on the 64-byte r||s encoding): the corruption survives every
+    structural precheck — length, r/s range, low-s — and must be caught by
+    the curve check itself. Reference analog of the serial loop this
+    feeds: /root/reference/crypto/secp256k1/secp256k1_nocgo.go:21-50."""
+    from tendermint_tpu.crypto.secp256k1 import gen_priv_key
+
+    tamper = set(tamper)
+    uniq = min(n, n_unique)
+    pubs: list[bytes] = []
+    msgs: list[bytes] = []
+    sigs: list[bytes] = []
+    for i in range(uniq):
+        priv = gen_priv_key(seed=i.to_bytes(4, "big") * 8)
+        msg = b"secp vote %d" % i
+        pubs.append(priv.pub_key().bytes())
+        msgs.append(msg)
+        sigs.append(priv.sign(msg))
+    reps = -(-n // uniq)
+    pubs, msgs, sigs = ((x * reps)[:n] for x in (pubs, msgs, sigs))
+    sigs = [
+        s[:63] + bytes([s[63] ^ 1]) if i in tamper else s
+        for i, s in enumerate(sigs)
+    ]
+    return pubs, msgs, sigs
+
+
 def straddle_tampers(n: int, n_shards: int) -> set[int]:
     """Tamper indexes at every shard boundary of an n-lane batch split
     n_shards ways (last lane of shard k, first lane of shard k+1) plus
