@@ -305,17 +305,118 @@ def scenario_pex(net: ProcTestnet) -> None:
 
 scenario_pex.self_start = True  # rewrites configs before any node starts
 
+
+def _rss_kb(pid: int) -> int | None:
+    try:
+        with open(f"/proc/{pid}/status", encoding="ascii") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1])
+    except (OSError, ValueError, IndexError):
+        pass
+    return None
+
+
+def scenario_soak(net: ProcTestnet, duration: float = 600.0) -> None:
+    """Long-horizon stability (reference test/p2p/kill_all + the multi-day
+    testnet class, p2p/fuzz.go:14): every peer link runs through
+    FuzzedConnection (config p2p.test_fuzz — 5% drops, 10% delays after a
+    10s grace), one random node is SIGKILLed and restarted every ~45s,
+    and for `duration` seconds the net must (a) keep committing, (b)
+    never diverge — block hashes at shared heights are compared across
+    every node pair each cycle — and (c) hold RSS bounded (< 3x the
+    minute-one footprint per node). TMTPU_SOAK_DURATION overrides the
+    duration (the committed run log uses the full 600s)."""
+    import random as _random
+
+    assert not any(net.procs.values()), "soak scenario owns node startup"
+    duration = float(os.environ.get("TMTPU_SOAK_DURATION", duration))
+    rng = _random.Random(1234)
+    for i in range(net.n):
+        cfg_path = os.path.join(net.home(i), "config", "config.json")
+        with open(cfg_path, encoding="utf-8") as f:
+            cfg = json.load(f)
+        cfg["p2p"]["test_fuzz"] = True
+        with open(cfg_path, "w", encoding="utf-8") as f:
+            json.dump(cfg, f, indent=1, sort_keys=True)
+    net.start_all()
+    net.wait_all(2)
+    t0 = time.monotonic()
+    base_rss: dict[int, int] = {}
+    last_height = 2
+    kills = 0
+    checks = 0
+    while time.monotonic() - t0 < duration:
+        cycle_end = time.monotonic() + 45.0
+        # progress: the live majority must advance while one node may lag
+        target = last_height + 2
+        live = [i for i in range(net.n) if net.procs.get(i) is not None]
+        last_height = max(
+            net.wait_height(i, target, timeout=120.0) for i in live
+        )
+        # divergence: block hash at a shared committed height must be
+        # identical on every node that has it
+        probe_h = max(1, last_height - 2)
+        hashes = {}
+        for i in live:
+            r = net.rpc(i, f"block?height={probe_h}", timeout=5.0)
+            if r is not None:
+                hashes[i] = r["block_id"]["hash"]
+        assert len(set(hashes.values())) <= 1, (
+            f"DIVERGENCE at height {probe_h}: {hashes}"
+        )
+        checks += 1
+        # memory: bounded growth per node
+        for i in live:
+            p = net.procs.get(i)
+            if p is None:
+                continue
+            rss = _rss_kb(p.pid)
+            if rss is None:
+                continue
+            if time.monotonic() - t0 > 60 and i not in base_rss:
+                base_rss[i] = rss
+            if i in base_rss:
+                assert rss < 3 * base_rss[i], (
+                    f"node{i} RSS {rss}kB >= 3x minute-one {base_rss[i]}kB"
+                )
+        # churn: SIGKILL one random node, let the rest commit, restart it
+        if time.monotonic() - t0 + 30 < duration:
+            victim = rng.randrange(net.n)
+            if net.procs.get(victim) is not None:
+                net.kill(victim)
+                kills += 1
+                time.sleep(5)
+                net.start(victim)
+        while time.monotonic() < cycle_end and (
+            time.monotonic() - t0 < duration
+        ):
+            time.sleep(1)
+    # closing: every node (restarted ones included) converges to the head
+    head = last_height
+    finals = net.wait_all(head, timeout=240.0)
+    print(
+        f"soak: {duration:.0f}s, {kills} kill/restart cycles, "
+        f"{checks} divergence checks (all identical), heights {finals}, "
+        f"fuzzed links, RSS bounded (<3x) on all nodes"
+    )
+
+
+scenario_soak.self_start = True  # rewrites configs before any node starts
+
 SCENARIOS = {
     "basic": scenario_basic,
     "fast_sync": scenario_fast_sync,
     "kill_all": scenario_kill_all,
     "atomic_broadcast": scenario_atomic_broadcast,
     "pex": scenario_pex,
+    "soak": scenario_soak,
 }
 
 
 def run(names=None, n: int = 4) -> None:
-    names = list(names or SCENARIOS)
+    # the default sweep excludes the 10-minute soak; ask for it by name
+    names = list(names or [s for s in SCENARIOS if s != "soak"])
     for name in names:
         net = ProcTestnet(n=n)
         try:

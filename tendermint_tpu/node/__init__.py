@@ -242,10 +242,21 @@ class Node(BaseService):
         self.transport = Transport(
             self.node_key, node_info, handshake_timeout=cfg.p2p.handshake_timeout
         )
+        fuzz_config = None
+        if cfg.p2p.test_fuzz:
+            from tendermint_tpu.p2p.fuzz import FuzzConfig
+
+            # reference node wiring of config.P2P.TestFuzz: mild fault
+            # rates, 10s grace so dial/handshake/reactor-init are clean
+            fuzz_config = FuzzConfig(
+                prob_drop_rw=0.05, prob_delay=0.1, max_delay=0.1,
+                start_after=10.0,
+            )
         self.switch = Switch(
             self.transport,
             max_inbound_peers=cfg.p2p.max_num_inbound_peers,
             max_outbound_peers=cfg.p2p.max_num_outbound_peers,
+            fuzz_config=fuzz_config,
         )
         self.switch.addr_book = self.addr_book
         for name, r in reactors.items():

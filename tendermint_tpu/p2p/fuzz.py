@@ -18,6 +18,11 @@ class FuzzConfig:
     prob_delay: float = 0.2  # chance an op is delayed
     max_delay: float = 0.3  # seconds
     seed: int | None = None
+    # grace period before any fault fires (reference FuzzConnAfter,
+    # p2p/test_util.go:232 uses 10s): lets the NodeInfo handshake and
+    # reactor init land on a clean link so fuzz exercises steady-state
+    # gossip, not connection setup
+    start_after: float = 0.0
 
 
 class FuzzedConnection:
@@ -25,18 +30,24 @@ class FuzzedConnection:
         self._conn = conn
         self.config = config or FuzzConfig()
         self._rng = random.Random(self.config.seed)
+        self._armed_at = (
+            asyncio.get_event_loop().time() + self.config.start_after
+        )
 
     @property
     def remote_pubkey(self):
         return self._conn.remote_pubkey
 
+    def _active(self) -> bool:
+        return asyncio.get_event_loop().time() >= self._armed_at
+
     async def _maybe_delay(self) -> None:
-        if self._rng.random() < self.config.prob_delay:
+        if self._active() and self._rng.random() < self.config.prob_delay:
             await asyncio.sleep(self._rng.random() * self.config.max_delay)
 
     async def write(self, data: bytes) -> None:
         await self._maybe_delay()
-        if self._rng.random() < self.config.prob_drop_rw:
+        if self._active() and self._rng.random() < self.config.prob_drop_rw:
             return  # dropped on the floor
         await self._conn.write(data)
 

@@ -55,9 +55,11 @@ class Switch(BaseService):
         transport: Transport,
         max_inbound_peers: int = 40,
         max_outbound_peers: int = 10,
+        fuzz_config=None,  # p2p.fuzz.FuzzConfig | None (config.p2p.test_fuzz)
     ) -> None:
         super().__init__(name="Switch")
         self.transport = transport
+        self.fuzz_config = fuzz_config
         self.peers = PeerSet()
         self.reactors: dict[str, object] = {}
         self._chan_descs: list = []
@@ -190,6 +192,13 @@ class Switch(BaseService):
         if self.peers.has(ni.node_id):
             raise SwitchError(f"already connected to {ni.node_id}")
         persistent = persistent or ni.node_id in self._persistent_addrs
+        if self.fuzz_config is not None:
+            # config.p2p.test_fuzz (reference p2p/test_util.go:229-232):
+            # wrap the authenticated conn so every peer link drops/delays
+            # probabilistically AFTER the start_after grace
+            from tendermint_tpu.p2p.fuzz import FuzzedConnection
+
+            conn = FuzzedConnection(conn, self.fuzz_config)
         peer = Peer(
             conn,
             ni,
