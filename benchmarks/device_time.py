@@ -48,6 +48,90 @@ def _repeat_fn(core, k_iters: int):
     return rep
 
 
+def _devtime_tag(variant: str, bucket: int, k: int) -> str:
+    from tendermint_tpu.ops import kcache
+
+    return f"devtime_{variant}_{bucket}_k{k}_{kcache._source_version()}"
+
+
+def _get_rep_fn(core_call, variant: str, bucket: int, k: int):
+    """The K-repeat executable: the pre-baked AOT artifact on a live TPU
+    when one exists (compiled offline — see ops/aot.py; these 6 per-bucket
+    compiles are what burned every prior DEVICE_PROFILE window), else the
+    jit program."""
+    import jax
+
+    if jax.devices()[0].platform == "tpu":
+        try:
+            from tendermint_tpu.ops import aot
+
+            compiled = aot._load(aot.artifact_path(_devtime_tag(variant, bucket, k)))
+            if compiled is not None:
+                print(f"  (aot: pre-baked {variant} B={bucket} K={k})",
+                      file=sys.stderr, flush=True)
+                return lambda keys, sigs: compiled(keys, sigs)
+        except Exception:  # noqa: BLE001 — AOT layer is best-effort
+            pass
+    return _repeat_fn(core_call, k)
+
+
+def _variants():
+    """{name: core_call} — the kernels the artifact compares. Shared by
+    measure() and bake() so the baked set can never drift from the
+    measured set."""
+    import sys as _sys
+
+    from tendermint_tpu.ops import ed25519_batch
+
+    def core_of(fn):
+        return lambda keys, sigs: fn(*ed25519_batch.unpack_pair(keys, sigs))
+
+    # pallas FIRST: it is the headline kernel AND the only pre-baked
+    # variant, so a short window banks it before any in-window compile
+    variants = {}
+    try:
+        from tendermint_tpu.ops import pallas_verify
+
+        def _pallas_core(keys, sigs):
+            return pallas_verify.pallas_verify_kernel(keys, sigs)
+
+        variants["pallas"] = _pallas_core
+    except Exception as e:  # noqa: BLE001 — pallas unavailable off-TPU
+        print(f"  (pallas unavailable: {e!r})", file=_sys.stderr, flush=True)
+    variants["xla-r4"] = core_of(ed25519_batch.verify_core)
+    variants["xla-r8"] = core_of(ed25519_batch.verify_core_r8)
+    return variants
+
+
+def bake(buckets, k_lo: int = 1, k_hi: int = 9) -> None:
+    """Offline-compile every (variant, bucket, K) repeat program against
+    the v5e topology (no device, no tunnel) so a live window spends its
+    seconds measuring. Run: JAX_PLATFORMS=cpu python -m benchmarks.device_time --bake [buckets]"""
+    from tendermint_tpu.ops import aot, ed25519_batch, kcache
+
+    sharding = aot.topology_sharding()
+    for b in buckets:
+        b = ed25519_batch._pad_to_bucket(min(int(b), kcache.MAX_BUCKET))
+        shapes = kcache._input_shapes(b)
+        for name, core_call in _variants().items():
+            if name.startswith("xla"):
+                # XLA-variant K-repeat executables are upload-prohibitive
+                # (93-176 MB at bucket 1024, growing with bucket) — the
+                # tunnel upload would cost more than the in-window compile
+                # it saves. Only the pallas variant (constant ~20 MB,
+                # grid-streamed tiles — and the headline kernel) is baked;
+                # XLA variants compile in-window only if the window
+                # affords them (measure() orders pallas first).
+                continue
+            for k in (k_lo, k_hi):
+                rep = _repeat_fn(core_call, k)
+                aot._bake_one(
+                    aot.artifact_path(_devtime_tag(name, b, k)),
+                    rep.__wrapped__, shapes, sharding,
+                    f"devtime {name} B={b} K={k}",
+                )
+
+
 def _time_call(fn, *args) -> float:
     import numpy as np
 
@@ -94,31 +178,11 @@ def measure(bucket: int, k_lo: int = 1, k_hi: int = 9):
     # hit masquerades as the measurement
     warm_keys = keys_reps.pop()
 
-    variants = {
-        "xla-r4": ed25519_batch.verify_core,
-        "xla-r8": ed25519_batch.verify_core_r8,
-    }
-    try:
-        from tendermint_tpu.ops import pallas_verify
-
-        def _pallas_core(keys, sigs):
-            return pallas_verify.pallas_verify_kernel(keys, sigs)
-
-        variants["pallas"] = _pallas_core
-    except Exception as e:  # noqa: BLE001 — pallas unavailable off-TPU
-        print(f"  (pallas unavailable: {e!r})", file=sys.stderr, flush=True)
-
-    def core_of(fn):
-        return lambda keys, sigs: fn(*ed25519_batch.unpack_pair(keys, sigs))
-
     out = {}
-    for name, core in variants.items():
-        core_call = (
-            core if name == "pallas" else core_of(core)
-        )
+    for name, core_call in _variants().items():
         try:
-            lo = _repeat_fn(core_call, k_lo)
-            hi = _repeat_fn(core_call, k_hi)
+            lo = _get_rep_fn(core_call, name, bucket, k_lo)
+            hi = _get_rep_fn(core_call, name, bucket, k_hi)
             # compile both outside the timed region
             c0 = time.perf_counter()
             _time_call(lo, warm_keys, sigs_d)
@@ -227,7 +291,15 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-    buckets = [int(a) for a in sys.argv[1:]] or [1024, 2560, 10240, 131072]
+    args = sys.argv[1:]
+    if args and args[0] == "--bake":
+        # offline pre-compile (no device needed): run under
+        # JAX_PLATFORMS=cpu; a later live window then loads executables
+        # instead of compiling — see ops/aot.py
+        buckets = [int(a) for a in args[1:]] or [1024, 2560, 10240, 131072]
+        bake(buckets)
+        return
+    buckets = [int(a) for a in args] or [1024, 2560, 10240, 131072]
     body, n_measured = report(buckets)
     print(body, flush=True)
     # exit nonzero when nothing was measured: callers gate artifact
