@@ -89,6 +89,23 @@ def _secp_path(bucket: int) -> str:
     )
 
 
+def topology_sharding():
+    """SingleDeviceSharding on device 0 of the local compile-only v5e
+    topology — the target every artifact in this cache is baked for."""
+    from jax.experimental import topologies
+    from jax.sharding import SingleDeviceSharding
+
+    topo = topologies.get_topology_desc(TOPOLOGY, "tpu")
+    return SingleDeviceSharding(topo.devices[0])
+
+
+def artifact_path(tag: str) -> str:
+    """Cache path for a caller-tagged artifact (e.g. the device-time
+    K-repeat programs). The tag must already encode any source-version
+    dependence; jax/libtpu versions are appended here."""
+    return os.path.join(_aot_dir(), f"{tag}_{_versions()}.aotexec")
+
+
 def _kernel_plain(kname: str):
     """The un-jitted (keys, sigs) -> verdicts callable for a kernel name
     (re-jitted here with explicit shardings for the topology compile)."""
@@ -254,8 +271,15 @@ def load_secp_fn(bucket: int):
 
 
 if __name__ == "__main__":
-    # bake must never dial the tunnel: force CPU before jax initializes
+    # bake must never dial the tunnel: force CPU before jax initializes.
+    # The env var alone is NOT enough — the axon plugin registers itself
+    # regardless and a dead tunnel hangs backend init for ~25 min before
+    # erroring; the config update is the authoritative override
+    # (tests/conftest.py pattern).
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     wanted = [int(a) for a in sys.argv[1:]] or [128, 1024, 2048, 12288, 131072]
     paths = bake(wanted)
     print(f"baked {len(paths)} new executables under {_aot_dir()}")
