@@ -337,6 +337,10 @@ def scenario_soak(net: ProcTestnet, duration: float = 600.0) -> None:
         with open(cfg_path, encoding="utf-8") as f:
             cfg = json.load(f)
         cfg["p2p"]["test_fuzz"] = True
+        # the loop watchdog dumps task stacks if a node's loop stalls —
+        # without it a soak-found wedge is an undiagnosable silent node
+        cfg["instrumentation"]["watchdog_interval"] = 2.0
+        cfg["instrumentation"]["watchdog_grace"] = 30.0
         with open(cfg_path, "w", encoding="utf-8") as f:
             json.dump(cfg, f, indent=1, sort_keys=True)
     net.start_all()
@@ -424,6 +428,22 @@ def run(names=None, n: int = 4) -> None:
             if not getattr(SCENARIOS[name], "self_start", False):
                 net.start_all()
             SCENARIOS[name](net)
+        except BaseException as exc:
+            # the temp root is deleted in stop(): surface each node's log
+            # tail NOW or the failure is undiagnosable after cleanup
+            err = getattr(exc, "stderr", None)  # generator CalledProcessError
+            if err:
+                print(f"--- generator stderr ---\n{err.decode(errors='replace')[-1500:]}",
+                      file=sys.stderr)
+            for i in range(net.n):
+                try:
+                    with open(os.path.join(net.root, f"node{i}.log"), "rb") as f:
+                        f.seek(max(0, os.fstat(f.fileno()).st_size - 1500))
+                        tail = f.read().decode(errors="replace")
+                    print(f"--- node{i}.log tail ---\n{tail}", file=sys.stderr)
+                except OSError:
+                    pass
+            raise
         finally:
             net.stop()
 

@@ -55,9 +55,23 @@ class BaseService:
         try:
             await self.on_stop()
         finally:
-            for t in self._tasks:
+            # A service may be stopped FROM one of its own tasks — e.g. a
+            # reactor's receive path calling switch.stop_peer_for_error,
+            # which stops the peer whose recv routine is running the call
+            # (the reference does the same from recvRoutine goroutines,
+            # p2p/switch.go StopPeerForError). Cancelling the CURRENT
+            # task here would abort this very stop() midway (tasks left
+            # uncancelled, _quit never set, the caller's continuation —
+            # reconnect scheduling — killed); skip it. It exits on its
+            # own when the call chain returns into the stopped service's
+            # loop. Soak-found: fuzz-corrupted links stranded a node
+            # peerless because every stop_peer_for_error self-cancelled
+            # before scheduling the redial.
+            cur = asyncio.current_task()
+            others = [t for t in self._tasks if t is not cur]
+            for t in others:
                 t.cancel()
-            for t in self._tasks:
+            for t in others:
                 try:
                     await t
                 except (asyncio.CancelledError, Exception):

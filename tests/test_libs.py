@@ -43,6 +43,39 @@ class TestService:
 
         run(main())
 
+    def test_stop_from_own_task_completes(self):
+        """A service stopped FROM one of its own spawned tasks (the
+        reactor-receive -> stop_peer_for_error shape) must complete the
+        stop — other tasks cancelled, _quit set, the calling task's
+        continuation allowed to run — instead of self-cancelling midway.
+        Soak-found: the half-done stop stranded a node peerless because
+        the redial scheduling after stop() never ran."""
+
+        async def main():
+            svc = BaseService("t")
+            await svc.start()
+            continued = asyncio.Event()
+
+            async def other():
+                while True:
+                    await asyncio.sleep(10)
+
+            t_other = svc.spawn(other())
+
+            async def self_stopper():
+                await svc.stop()
+                # the continuation AFTER stop must still run (this is
+                # where the switch schedules the reconnect)
+                continued.set()
+
+            svc.spawn(self_stopper())
+            await asyncio.wait_for(continued.wait(), 5.0)
+            await asyncio.wait_for(svc.wait(), 5.0)  # _quit was set
+            assert not svc.is_running
+            assert t_other.cancelled() or t_other.done()
+
+        run(main())
+
 
 class TestBitArray:
     def test_basic(self):
