@@ -128,6 +128,14 @@ def encode_consensus_message(msg) -> bytes:
     return w.build()
 
 
+# Decode-time bit-array caps (the post-v0.32 reference added the same as
+# a DoS fix; v0.32.3 itself lacked them). A part-set of a max-size block
+# is < 1,601 parts; validator sets are bounded well under 10,000 in
+# practice (BASELINE config 5's 10k shape is the inclusive ceiling).
+MAX_BLOCK_PARTS_COUNT = 1601
+MAX_VOTES_COUNT = 10_000
+
+
 def decode_consensus_message(data: bytes):
     r = Reader(data)
     tag = r.u8()
@@ -135,12 +143,15 @@ def decode_consensus_message(data: bytes):
         return NewRoundStepMessage(r.u64(), r.u32(), RoundStep(r.u8()), r.u64(), r.i64())
     if tag == 2:
         return NewValidBlockMessage(
-            r.u64(), r.u32(), PartSetHeader.read(r), BitArray.read(r), r.bool()
+            r.u64(), r.u32(), PartSetHeader.read(r),
+            BitArray.read(r, max_size=MAX_BLOCK_PARTS_COUNT), r.bool()
         )
     if tag == 3:
         return ProposalMessage(Proposal.decode(r.bytes()))
     if tag == 4:
-        return ProposalPOLMessage(r.u64(), r.i64(), BitArray.read(r))
+        return ProposalPOLMessage(
+            r.u64(), r.i64(), BitArray.read(r, max_size=MAX_VOTES_COUNT)
+        )
     if tag == 5:
         return BlockPartMessage(r.u64(), r.u32(), Part.decode(r.bytes()))
     if tag == 6:
@@ -151,6 +162,45 @@ def decode_consensus_message(data: bytes):
         return VoteSetMaj23Message(r.u64(), r.u32(), VoteType(r.u8()), BlockID.read(r))
     if tag == 9:
         return VoteSetBitsMessage(
-            r.u64(), r.u32(), VoteType(r.u8()), BlockID.read(r), BitArray.read(r)
+            r.u64(), r.u32(), VoteType(r.u8()), BlockID.read(r),
+            BitArray.read(r, max_size=MAX_VOTES_COUNT)
         )
     raise DecodeError(f"unknown consensus message tag {tag}")
+
+
+def validate_consensus_message(msg) -> None:
+    """ValidateBasic for wire-received consensus messages (reference
+    reactor.go:1406-1640): structural bounds the DECODER cannot know —
+    above all, that an advertised bit array's size agrees with the part
+    count it claims to describe. Soak-found: a corrupted-but-decodable
+    NewValidBlock whose bit array disagrees with its header poisons
+    PeerState so set_has_proposal_block_part can never mark progress and
+    the data-gossip routine re-sends the same part forever (the reference
+    rejects exactly this at ValidateBasic, reactor.go:1456-1460). Raises
+    DecodeError; the reactor's receive treats it like malformed bytes
+    (peer stopped).
+
+    Unsigned wire fields (height/round/index decode as u64/u32) cannot be
+    negative, so the reference's negative-value checks reduce here to the
+    two genuinely signed fields. Zero-size VoteSetBits is legal — a node
+    answering VoteSetMaj23 without a matching vote set replies with an
+    empty array (reactor.py:431), exactly as the reference permits."""
+    if isinstance(msg, NewValidBlockMessage):
+        if msg.block_parts.size != msg.block_parts_header.total:
+            raise DecodeError(
+                f"NewValidBlock: bit array size {msg.block_parts.size} != "
+                f"header total {msg.block_parts_header.total}"
+            )
+    elif isinstance(msg, ProposalPOLMessage):
+        if msg.proposal_pol_round < 0:
+            raise DecodeError("ProposalPOL: negative proposal_pol_round")
+        if msg.proposal_pol.size == 0:
+            raise DecodeError("ProposalPOL: empty bit array")
+    elif isinstance(msg, NewRoundStepMessage):
+        if (msg.height == 1 and msg.last_commit_round != -1) or (
+            msg.height > 1 and msg.last_commit_round < -1
+        ):
+            raise DecodeError(
+                f"NewRoundStep: invalid last_commit_round "
+                f"{msg.last_commit_round} at height {msg.height}"
+            )

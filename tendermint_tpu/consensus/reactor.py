@@ -379,6 +379,7 @@ class ConsensusReactor(BaseReactor):
     async def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
         try:
             msg = m.decode_consensus_message(msg_bytes)
+            m.validate_consensus_message(msg)
         except Exception as e:
             self.log.error("bad consensus message", peer=peer.id, err=repr(e))
             await self.switch.stop_peer_for_error(peer, e)
@@ -495,6 +496,19 @@ class ConsensusReactor(BaseReactor):
                     msg = m.BlockPartMessage(height=rs.height, round=rs.round, part=part)
                     if await peer.send(DATA_CHANNEL, m.encode_consensus_message(msg)):
                         ps.set_has_proposal_block_part(prs.height, prs.round, index)
+                        if not (
+                            prs.proposal_block_parts is None
+                            or prs.proposal_block_parts.get_index(index)
+                        ):
+                            # the mark didn't take. With message
+                            # validation in place the only way here is a
+                            # benign race (prs swapped during the awaited
+                            # send — e.g. NewValidBlock for a later
+                            # round), so don't punish the peer; but DO
+                            # yield before re-evaluating, so no state can
+                            # ever turn this loop into the soak-found
+                            # re-send-forever starvation.
+                            await asyncio.sleep(self.gossip_sleep)
                     continue
 
             # catchup: peer is on an older height we have in the store

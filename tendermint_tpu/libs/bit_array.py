@@ -93,9 +93,23 @@ class BitArray:
         return Writer().u32(self.size).bytes(self._bits.to_bytes(nbytes, "little")).build()
 
     @classmethod
-    def read(cls, r) -> "BitArray":
+    def read(cls, r, max_size: int | None = None) -> "BitArray":
+        from tendermint_tpu.encoding import DecodeError
+
         size = r.u32()
         raw = r.bytes()
+        # coherence BEFORE construction: __init__ computes (1 << size),
+        # so an attacker-chosen size with a tiny payload would allocate
+        # a ~2^size-bit int at decode (u32 size -> ~512 MB). encode()
+        # always writes exactly ceil(size/8) bytes; anything else is
+        # malformed, and the check bounds the allocation by the actual
+        # payload length (itself bounded by channel message capacity).
+        if len(raw) != (size + 7) // 8:
+            raise DecodeError(
+                f"bit array size {size} disagrees with {len(raw)} payload bytes"
+            )
+        if max_size is not None and size > max_size:
+            raise DecodeError(f"bit array size {size} > cap {max_size}")
         return cls(size, int.from_bytes(raw, "little"))
 
     @classmethod

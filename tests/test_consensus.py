@@ -4,6 +4,7 @@ assertions over the EventBus, WAL crash recovery."""
 import asyncio
 import os
 
+import pytest
 
 from tendermint_tpu import proxy
 from tendermint_tpu.config import make_test_config
@@ -228,3 +229,110 @@ class TestMultiValidatorOffline:
                 await f.stop()
 
         asyncio.run(main())
+
+
+class TestConsensusMessageValidation:
+    """Wire-message ValidateBasic + decode-time bit-array bounds
+    (soak-found: a corrupted-but-decodable NewValidBlock whose bit array
+    disagrees with its part-set header wedged the data-gossip loop into
+    re-sending one part forever; reference reactor.go:1406-1640)."""
+
+    def _nvb(self, ba_size: int, total: int):
+        from tendermint_tpu.consensus import messages as m
+        from tendermint_tpu.libs.bit_array import BitArray
+        from tendermint_tpu.types import PartSetHeader
+
+        return m.NewValidBlockMessage(
+            height=5, round=0,
+            block_parts_header=PartSetHeader(total, b"\xab" * 32),
+            block_parts=BitArray(ba_size),
+            is_commit=False,
+        )
+
+    def test_new_valid_block_size_mismatch_rejected(self):
+        from tendermint_tpu.consensus import messages as m
+        from tendermint_tpu.encoding import DecodeError
+
+        m.validate_consensus_message(self._nvb(4, 4))  # coherent: passes
+        with pytest.raises(DecodeError, match="not equal|!="):
+            m.validate_consensus_message(self._nvb(3, 4))
+        # and the full wire round trip rejects it too (receive() order)
+        blob = m.encode_consensus_message(self._nvb(3, 4))
+        msg = m.decode_consensus_message(blob)
+        with pytest.raises(DecodeError):
+            m.validate_consensus_message(msg)
+
+    def test_empty_vote_set_bits_is_legal(self):
+        # a node without a matching vote set answers VoteSetMaj23 with an
+        # EMPTY bit array — must not be punished as malformed
+        from tendermint_tpu.consensus import messages as m
+        from tendermint_tpu.libs.bit_array import BitArray
+        from tendermint_tpu.types import BlockID, PartSetHeader, VoteType
+
+        msg = m.VoteSetBitsMessage(
+            height=5, round=0, type=VoteType.PREVOTE,
+            block_id=BlockID(b"\xcd" * 32, PartSetHeader(1, b"\xcd" * 32)),
+            votes=BitArray(0),
+        )
+        m.validate_consensus_message(
+            m.decode_consensus_message(m.encode_consensus_message(msg))
+        )
+
+    def test_proposal_pol_validation(self):
+        from tendermint_tpu.consensus import messages as m
+        from tendermint_tpu.encoding import DecodeError
+        from tendermint_tpu.libs.bit_array import BitArray
+
+        good = m.ProposalPOLMessage(5, 0, BitArray(4, 0b1010))
+        m.validate_consensus_message(good)
+        with pytest.raises(DecodeError, match="empty"):
+            m.validate_consensus_message(m.ProposalPOLMessage(5, 0, BitArray(0)))
+        with pytest.raises(DecodeError, match="negative"):
+            m.validate_consensus_message(
+                m.ProposalPOLMessage(5, -1, BitArray(4, 1))
+            )
+
+    def test_new_round_step_last_commit_round(self):
+        from tendermint_tpu.consensus import messages as m
+        from tendermint_tpu.consensus.round_state import RoundStep
+        from tendermint_tpu.encoding import DecodeError
+
+        ok = m.NewRoundStepMessage(1, 0, RoundStep.NEW_HEIGHT, 0, -1)
+        m.validate_consensus_message(ok)
+        with pytest.raises(DecodeError, match="last_commit_round"):
+            m.validate_consensus_message(
+                m.NewRoundStepMessage(1, 0, RoundStep.NEW_HEIGHT, 0, 0)
+            )
+        with pytest.raises(DecodeError, match="last_commit_round"):
+            m.validate_consensus_message(
+                m.NewRoundStepMessage(2, 0, RoundStep.NEW_HEIGHT, 0, -2)
+            )
+
+    def test_decode_rejects_incoherent_bit_array_size(self):
+        """A ~20-byte message claiming a 2^32-bit array must die at
+        DECODE — before BitArray.__init__ can allocate a ~512 MB int."""
+        from tendermint_tpu.consensus import messages as m
+        from tendermint_tpu.encoding import DecodeError, Writer
+
+        w = Writer()
+        w.u8(2).u64(5).u32(0)                 # NewValidBlock h=5 r=0
+        w.u32(4).bytes(b"\xab" * 32)          # header: total=4
+        w.u32(0xFFFFFFFF).bytes(b"")          # bit array: huge size, no payload
+        w.bool(False)
+        with pytest.raises(DecodeError, match="disagrees"):
+            m.decode_consensus_message(w.build())
+
+    def test_decode_rejects_oversize_bit_array(self):
+        """Even a coherent array above the protocol cap is rejected
+        (post-v0.32 reference DoS fix: MaxBlockPartsCount)."""
+        from tendermint_tpu.consensus import messages as m
+        from tendermint_tpu.encoding import DecodeError, Writer
+
+        size = m.MAX_BLOCK_PARTS_COUNT + 8
+        w = Writer()
+        w.u8(2).u64(5).u32(0)
+        w.u32(size).bytes(b"\xab" * 32)
+        w.u32(size).bytes(b"\x00" * ((size + 7) // 8))
+        w.bool(False)
+        with pytest.raises(DecodeError, match="cap"):
+            m.decode_consensus_message(w.build())
