@@ -430,19 +430,26 @@ def run(names=None, n: int = 4) -> None:
             SCENARIOS[name](net)
         except BaseException as exc:
             # the temp root is deleted in stop(): surface each node's log
-            # tail NOW or the failure is undiagnosable after cleanup
+            # tail NOW and preserve the full logs for post-mortem
             err = getattr(exc, "stderr", None)  # generator CalledProcessError
             if err:
                 print(f"--- generator stderr ---\n{err.decode(errors='replace')[-1500:]}",
                       file=sys.stderr)
+            keep = tempfile.mkdtemp(prefix=f"tmtpu-{name}-failed-")
             for i in range(net.n):
+                src = os.path.join(net.root, f"node{i}.log")
                 try:
-                    with open(os.path.join(net.root, f"node{i}.log"), "rb") as f:
+                    shutil.copy(src, keep)
+                except OSError:
+                    pass  # a failed copy must not suppress the tail print
+                try:
+                    with open(src, "rb") as f:
                         f.seek(max(0, os.fstat(f.fileno()).st_size - 1500))
                         tail = f.read().decode(errors="replace")
                     print(f"--- node{i}.log tail ---\n{tail}", file=sys.stderr)
                 except OSError:
                     pass
+            print(f"--- full node logs preserved in {keep} ---", file=sys.stderr)
             raise
         finally:
             net.stop()

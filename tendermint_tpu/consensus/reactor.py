@@ -509,6 +509,18 @@ class ConsensusReactor(BaseReactor):
                             # ever turn this loop into the soak-found
                             # re-send-forever starvation.
                             await asyncio.sleep(self.gossip_sleep)
+                    else:
+                        # send refused — above all `not mconn.is_running`
+                        # during a peer teardown, which returns False
+                        # SYNCHRONOUSLY: without this sleep the loop has
+                        # no suspension point at all, and an un-yielding
+                        # coroutine starves the whole event loop — it
+                        # even blocks the remove_peer() that would cancel
+                        # this very task (soak-found: watchdog dumps
+                        # showed the loop wedged in this branch's
+                        # pick_random; Go's preemptive goroutines never
+                        # needed the yield, asyncio does).
+                        await asyncio.sleep(self.gossip_sleep)
                     continue
 
             # catchup: peer is on an older height we have in the store
@@ -524,15 +536,25 @@ class ConsensusReactor(BaseReactor):
                 msg = m.ProposalMessage(proposal=proposal)
                 if await peer.send(DATA_CHANNEL, m.encode_consensus_message(msg)):
                     ps.set_has_proposal(proposal)
-                if proposal.pol_round >= 0 and rs.votes is not None:
-                    pol = rs.votes.prevotes(proposal.pol_round)
-                    if pol is not None:
-                        pol_msg = m.ProposalPOLMessage(
-                            height=rs.height,
-                            proposal_pol_round=rs.proposal.pol_round,
-                            proposal_pol=pol.bit_array(),
-                        )
-                        await peer.send(DATA_CHANNEL, m.encode_consensus_message(pol_msg))
+                    # use the SNAPSHOT, not live rs: a round change during
+                    # the awaited send sets rs.proposal = None in place
+                    # (state.py enter_new_round) and a live dereference
+                    # would kill this gossip task with AttributeError
+                    if proposal.pol_round >= 0 and rs.votes is not None:
+                        pol = rs.votes.prevotes(proposal.pol_round)
+                        if pol is not None:
+                            pol_msg = m.ProposalPOLMessage(
+                                height=proposal.height,
+                                proposal_pol_round=proposal.pol_round,
+                                proposal_pol=pol.bit_array(),
+                            )
+                            await peer.send(
+                                DATA_CHANNEL, m.encode_consensus_message(pol_msg)
+                            )
+                else:
+                    # same synchronous-False teardown race as the part
+                    # send above: yield or the retry loop starves the loop
+                    await asyncio.sleep(self.gossip_sleep)
                 continue
 
             await asyncio.sleep(self.gossip_sleep)

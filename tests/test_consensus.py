@@ -336,3 +336,89 @@ class TestConsensusMessageValidation:
         w.bool(False)
         with pytest.raises(DecodeError, match="cap"):
             m.decode_consensus_message(w.build())
+
+
+class TestGossipTeardownYield:
+    """The data-gossip loop must keep a suspension point when peer.send
+    returns False synchronously (mconn stopped mid-teardown): without it
+    the coroutine never yields, starving the event loop — including the
+    remove_peer() that would cancel the task (soak-found livelock)."""
+
+    def test_send_false_path_sleeps(self):
+        from tendermint_tpu.consensus.reactor import ConsensusReactor, PeerState
+
+        class FakePart:
+            index = 0
+
+            def encode(self):
+                return b"p"
+
+        class FakePartSet:
+            def header(self):
+                from tendermint_tpu.types import PartSetHeader
+
+                return PartSetHeader(1, b"\xab" * 32)
+
+            def bit_array(self):
+                from tendermint_tpu.libs.bit_array import BitArray
+
+                return BitArray(1, 0b1)
+
+            def get_part(self, i):
+                return FakePart()
+
+        class FakeRS:
+            height, round = 5, 0
+            proposal = None
+            proposal_block_parts = FakePartSet()
+            votes = None
+
+        class FakeCS:
+            rs = FakeRS()
+
+            class block_store:
+                @staticmethod
+                def base():
+                    return 1
+
+        class DeadPeer:
+            id = "deadbeef" * 5
+
+            async def send(self, ch, msg):
+                return False  # synchronous refusal: teardown in progress
+
+        sends = []
+
+        class CountingPeer(DeadPeer):
+            async def send(self, ch, msg):
+                sends.append(ch)
+                return False
+
+        reactor = ConsensusReactor.__new__(ConsensusReactor)
+        reactor.cs = FakeCS()
+        reactor.gossip_sleep = 0.01
+        peer = CountingPeer()
+        ps = PeerState(peer)
+        ps.prs.height, ps.prs.round = 5, 0
+        ps.init_proposal_block_parts(FakePartSet().header())
+
+        async def main():
+            task = asyncio.create_task(
+                reactor._gossip_data_routine(peer, ps)
+            )
+            # heartbeat coroutine: starves (never increments) if the
+            # gossip loop spins without yielding
+            beats = 0
+            for _ in range(10):
+                await asyncio.sleep(0.005)
+                beats += 1
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+            return beats
+
+        beats = asyncio.run(asyncio.wait_for(main(), 10.0))
+        assert beats == 10, "event loop starved by the gossip loop"
+        assert len(sends) >= 2, "loop did not keep retrying (it must), just yielding between tries"
