@@ -23,8 +23,13 @@ def log(*a):
 def main():
     import jax
 
+    from tendermint_tpu.libs import trace as tmtrace
     from tendermint_tpu.ops import ed25519_batch, kcache
     from tendermint_tpu.utils import make_sig_batch
+
+    # same trace-JSONL hook as bench.py: TMTPU_TRACE_JSONL=<path> exports
+    # every profiled launch as a span line (docs/observability.md schema)
+    tmtrace.install_export_from_env()
 
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000
     kcache.enable_persistent_cache()
@@ -59,11 +64,13 @@ def main():
         )
 
     for K in (1, 4):
-        t0 = time.perf_counter()
-        outs = [fn(keys_dev, sigs_dev) for _ in range(K)]
-        for o in outs:
-            np.asarray(o)
-        dt = time.perf_counter() - t0
+        with tmtrace.span("device_profile", n=n, launches=K) as sp:
+            t0 = time.perf_counter()
+            outs = [fn(keys_dev, sigs_dev) for _ in range(K)]
+            for o in outs:
+                np.asarray(o)
+            dt = time.perf_counter() - t0
+            sp.set(ms_per_launch=round(dt / K * 1e3, 3))
         log(f"device-resident x{K}: {dt / K * 1e3:.1f} ms/launch+fetch")
 
 

@@ -139,6 +139,16 @@ class InstrumentationConfig:
     # + thread stacks when unserviced for `watchdog_grace` s. 0 = off.
     watchdog_interval: float = 0.0
     watchdog_grace: float = 10.0
+    # Consensus timeline tracing (libs/trace.py): one trace per height
+    # with per-step + device spans, served by the debug_consensus_trace
+    # RPC route. Default-off — the disabled path adds no measurable
+    # overhead to the verify hot loop.
+    tracing: bool = False
+    # completed height traces kept in memory for debug_consensus_trace
+    trace_ring: int = 64
+    # non-empty = also export every completed trace as one JSONL line
+    # through a rotating autofile.Group at this path (relative to root)
+    trace_jsonl_file: str = ""
 
 
 @dataclass

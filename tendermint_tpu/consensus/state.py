@@ -31,6 +31,7 @@ from tendermint_tpu.consensus.wal import (
     WALTimeoutInfo,
 )
 from tendermint_tpu.libs import fail
+from tendermint_tpu.libs import trace as tmtrace
 from tendermint_tpu.libs.events import EventSwitch
 from tendermint_tpu.libs.log import NOP, Logger
 from tendermint_tpu.libs.service import BaseService
@@ -75,9 +76,15 @@ class ConsensusState(BaseService):
         wal: WAL | None = None,
         event_bus=None,
         logger: Logger = NOP,
+        tracer: tmtrace.Tracer | None = None,
     ) -> None:
         super().__init__("ConsensusState")
         self.config = config
+        # consensus timeline tracing (libs/trace): one trace per height,
+        # child spans per round step; default-off NOP tracer
+        self.tracer = tracer or tmtrace.NOP
+        self._height_span: tmtrace.Span | None = None
+        self._step_span: tmtrace.Span | None = None
         self.block_exec = block_exec
         self.block_store = block_store
         self.mempool = mempool
@@ -167,6 +174,7 @@ class ConsensusState(BaseService):
             commit_round=-1,
         )
         self.state = state
+        self._trace_new_height()
 
     def _commit_start_time(self) -> float:
         return time.monotonic() + self.config.commit_time()
@@ -398,6 +406,7 @@ class ConsensusState(BaseService):
             rs.proposal_block_parts = None
         rs.votes.set_round(round_)
         rs.triggered_timeout_precommit = False
+        self._trace_step()
         if self.event_bus:
             await self.event_bus.publish_new_round(self.round_state_event())
         self.event_switch.fire_event("new_round_step", self.rs)
@@ -687,9 +696,10 @@ class ConsensusState(BaseService):
         fail.fail()  # crash point (reference :1318)
 
         state_copy = self.state.copy()
-        new_state = await self.block_exec.apply_block(
-            state_copy, BlockID(block.hash(), parts.header()), block
-        )
+        with tmtrace.span("apply_block", height=height, txs=len(block.data.txs)):
+            new_state = await self.block_exec.apply_block(
+                state_copy, BlockID(block.hash(), parts.header()), block
+            )
         fail.fail()  # crash point (reference :1336)
         self.update_to_state(new_state)
         fail.fail()  # crash point (reference :1344)
@@ -701,9 +711,41 @@ class ConsensusState(BaseService):
     def _new_step(self) -> None:
         rsd = self.round_state_event()
         self.wal.write(rsd)
+        self._trace_step()
         self.event_switch.fire_event("new_round_step", self.rs)
         if self.event_bus:
             asyncio.ensure_future(self.event_bus.publish_new_round_step(rsd))
+
+    # ------------------------------------------------------------------
+    # timeline tracing (libs/trace): one root span per height, one child
+    # span per round step. Steps are open-ended — a step span ends when
+    # the NEXT step begins — so this uses the tracer's manual API; spans
+    # recorded deeper in the call stack (batch_verify, ed25519_batch,
+    # apply_block) attach to the active step via the trace contextvar.
+
+    def _trace_new_height(self) -> None:
+        t = self.tracer
+        if not t.enabled:
+            return
+        if self._step_span is not None:
+            t.finish(self._step_span)
+            self._step_span = None
+        if self._height_span is not None:
+            t.finish(self._height_span)
+        self._height_span = t.begin("height", height=self.rs.height)
+
+    def _trace_step(self) -> None:
+        t, hs = self.tracer, self._height_span
+        if hs is None or not t.enabled:
+            return
+        rs = self.rs
+        name = rs.step.name.lower()
+        prev = self._step_span
+        if prev is not None:
+            if prev.name == name and prev.attrs.get("round") == rs.round:
+                return  # same step re-announced (e.g. precommit_wait)
+            t.finish(prev)
+        self._step_span = t.child(hs, name, height=rs.height, round=rs.round)
 
     # ------------------------------------------------------------------
     # proposal handling

@@ -154,6 +154,13 @@ class BatchVerifier:
     def verify_all(self) -> list[bool]:
         import time as _time
 
+        from tendermint_tpu.libs import trace as _trace
+
+        with _trace.span("batch_verify", items=self._n_items) as sp:
+            return self._verify_all(_time, _trace, sp)
+
+    def _verify_all(self, _time, _trace, sp) -> list[bool]:
+        """verify_all body under an open `batch_verify` span `sp`."""
         t0 = _time.monotonic()
         n_jobs = 0
         ok = [True] * self._n_items
@@ -191,6 +198,8 @@ class BatchVerifier:
                     run_group, groups, timeout=_GROUP_TIMEOUT_S
                 )
             except TimeoutError:
+                _trace.DEVICE.record_fallback("group_timeout")
+                sp.set(group_timeout=True)
                 all_results = [
                     [p.verify(m, s) for p, m, s in zip(pubs_, msgs_, sigs_)]
                     for _, (_, pubs_, msgs_, sigs_) in groups
@@ -203,8 +212,10 @@ class BatchVerifier:
                 if not res:
                     ok[item] = False
         self._reset()
+        secs = _time.monotonic() - t0
+        sp.set(jobs=n_jobs, groups=len(groups), ms=round(secs * 1e3, 3))
         if _metrics_sink is not None and n_jobs:
-            _metrics_sink(n_jobs, _time.monotonic() - t0)
+            _metrics_sink(n_jobs, secs)
         return ok
 
     def _reset(self) -> None:

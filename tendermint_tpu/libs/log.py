@@ -13,6 +13,17 @@ from typing import Any
 
 _LEVELS = {"debug": 10, "info": 20, "error": 40, "none": 100}
 
+# Optional ambient-context hook (libs/trace.py installs one): a callable
+# returning a dict merged into every record, so the active consensus trace
+# (height/round/step) tags every line without threading a Logger through
+# each call site. Explicit with_/kv keys win over provided ones.
+_context_provider = None
+
+
+def set_context_provider(fn) -> None:
+    global _context_provider
+    _context_provider = fn
+
 
 class Logger:
     def __init__(self, module: str = "main", context: dict[str, Any] | None = None,
@@ -39,6 +50,11 @@ class Logger:
         if not self._enabled(lvl_num):
             return
         rec = {"ts": round(time.time(), 3), "level": level, "module": self.module, "msg": msg}
+        if _context_provider is not None:
+            try:
+                rec.update(_context_provider())
+            except Exception:  # noqa: BLE001 — ambient context must never
+                pass  # break logging
         rec.update(self._ctx)
         rec.update({k: _render(v) for k, v in kv.items()})
         try:

@@ -203,6 +203,48 @@ class StateMetrics:
         )
 
 
+class DeviceMetrics:
+    """The TPU data plane's device-health bundle (no reference analog).
+
+    Fed by libs/trace.DEVICE (ops/ed25519_batch, ops/secp_batch record
+    into the singleton; the node mirrors it here when Prometheus is on).
+    Answers: how full are the device batches, how much padding is wasted,
+    how long do dispatch->fetch round trips take, is the link wedged.
+    """
+
+    def __init__(self, c: Collector) -> None:
+        self.dispatches_total = c.counter(
+            "device", "dispatches_total", "Device batch dispatches"
+        )
+        self.batch_size = c.histogram(
+            "device", "batch_size", "Valid signatures per dispatched batch",
+            [1, 4, 16, 64, 256, 1024, 4096, 16384, 65536],
+        )
+        self.batch_occupancy = c.histogram(
+            "device", "batch_occupancy", "Valid lanes / padded bucket size",
+            [0.1, 0.25, 0.5, 0.75, 0.9, 1.0],
+        )
+        self.pad_lanes_total = c.counter(
+            "device", "pad_lanes_total", "Padding lanes dispatched (bucket - batch)"
+        )
+        self.fetch_seconds = c.histogram(
+            "device", "fetch_seconds", "Dispatch-to-verdict-fetch latency",
+            [0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 1, 5, 30, 120],
+        )
+        self.fetch_timeouts_total = c.counter(
+            "device", "fetch_timeouts_total", "Verdict fetches that timed out"
+        )
+        self.cpu_fallbacks_total = c.counter(
+            "device", "cpu_fallbacks_total", "Batches degraded to the CPU path"
+        )
+        self.breaker_tripped = c.gauge(
+            "device", "breaker_tripped", "1 while the wedged-device circuit breaker is open"
+        )
+        self.breaker_trips_total = c.counter(
+            "device", "breaker_trips_total", "Circuit-breaker trips"
+        )
+
+
 class MetricsServer:
     """Plain-HTTP /metrics endpoint (reference node.go:946)."""
 

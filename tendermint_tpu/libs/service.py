@@ -11,6 +11,18 @@ import asyncio
 import logging
 
 
+# When stop() is called from one of the service's own tasks, the caller's
+# task gets this long to finish its continuation (e.g. a reactor's
+# remove_peer + redial scheduling after a peer self-stop) before it is
+# cancelled as orphaned (ADVICE r5: clearing it from _tasks uncancelled
+# let it run forever if it never returned into the stopped service).
+# Generous on purpose: a continuation legitimately awaits (remove_peer
+# across reactors) before scheduling the redial, and cancelling it
+# mid-cleanup would re-strand the peer — a continuation still running
+# after this long is watchdog territory, not normal slowness.
+SELF_STOP_GRACE = 30.0
+
+
 class AlreadyStarted(Exception):
     pass
 
@@ -60,14 +72,14 @@ class BaseService:
             # which stops the peer whose recv routine is running the call
             # (the reference does the same from recvRoutine goroutines,
             # p2p/switch.go StopPeerForError). Cancelling the CURRENT
-            # task here would abort this very stop() midway (tasks left
-            # uncancelled, _quit never set, the caller's continuation —
-            # reconnect scheduling — killed); skip it. It exits on its
-            # own when the call chain returns into the stopped service's
-            # loop. Soak-found: fuzz-corrupted links stranded a node
-            # peerless because every stop_peer_for_error self-cancelled
-            # before scheduling the redial.
+            # task inline here would abort this very stop() midway (tasks
+            # left uncancelled, _quit never set, the caller's continuation
+            # — reconnect scheduling — killed); skip it in the sweep.
+            # Soak-found: fuzz-corrupted links stranded a node peerless
+            # because every stop_peer_for_error self-cancelled before
+            # scheduling the redial.
             cur = asyncio.current_task()
+            self_stop = cur is not None and cur in self._tasks
             others = [t for t in self._tasks if t is not cur]
             for t in others:
                 t.cancel()
@@ -77,6 +89,19 @@ class BaseService:
                 except (asyncio.CancelledError, Exception):
                     pass
             self._tasks.clear()
+            if self_stop:
+                # Don't drop the caller's own task uncancelled either
+                # (ADVICE r5): if it never returns into the stopped
+                # service's loop it runs orphaned forever. An immediate
+                # cancel would kill the caller's legitimate continuation
+                # (remove_peer + redial scheduling in the peer-self-stop
+                # path awaits BEFORE scheduling the redial), so give it a
+                # bounded grace, then cancel only if still running.
+                def _reap(task=cur):
+                    if not task.done():
+                        task.cancel()
+
+                asyncio.get_running_loop().call_later(SELF_STOP_GRACE, _reap)
             self._quit.set()
 
     async def wait(self) -> None:

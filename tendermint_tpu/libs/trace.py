@@ -1,0 +1,409 @@
+"""Consensus timeline tracing + device telemetry.
+
+The control plane's hot path (VoteSet.add_votes -> Commit verify ->
+ops/ed25519_batch device dispatch) was a black box: a wedged device link
+stalls every commit verify with zero diagnostics (BENCH_r05 rc=3, ADVICE
+r5). This module is the measurement substrate every later perf PR reports
+against:
+
+- `Span` / `Tracer`: a monotonic-clock span tree. `Tracer.span(name,
+  **attrs)` is a context manager; the manual `begin`/`child`/`finish` API
+  serves open-ended timelines (a consensus step ends when the NEXT step
+  begins). Completed root spans land in a bounded ring buffer and,
+  optionally, as one JSONL line per trace through a rotating
+  `libs/autofile.Group`.
+- Span context propagates through a `contextvars.ContextVar`, so device
+  spans recorded deep inside ops/ attach to the consensus step that
+  triggered them — and `libs/log.py` lines auto-attach the active trace
+  context (install_log_context).
+- `DeviceTelemetry` (module singleton `DEVICE`): always-on process-wide
+  device-health counters — dispatches, pad waste, fetch latency, fetch
+  timeouts, CPU fallbacks, circuit-breaker state — behind the
+  `debug_device` RPC route, optionally mirrored into a
+  `libs/metrics.DeviceMetrics` bundle when the node runs Prometheus.
+
+Tracing is default-off: the module-level `span()` helper costs one
+contextvar read + one attribute check when no tracer is installed, so the
+instrumented hot paths add no measurable overhead to quick_bench.
+"""
+from __future__ import annotations
+
+import contextvars
+import json
+import threading
+import time
+from collections import deque
+from typing import Any
+
+_current: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
+    "tmtpu_trace_span", default=None
+)
+
+
+class Span:
+    """One timed operation. `attrs` are free-form JSON-able tags."""
+
+    __slots__ = ("name", "attrs", "start", "end", "parent", "children")
+
+    def __init__(self, name: str, attrs: dict, start: float, parent: "Span | None" = None):
+        self.name = name
+        self.attrs = attrs
+        self.start = start
+        self.end: float | None = None
+        self.parent = parent
+        self.children: list[Span] = []
+
+    def set(self, **attrs) -> None:
+        self.attrs.update(attrs)
+
+    @property
+    def duration(self) -> float:
+        return (self.end if self.end is not None else time.monotonic()) - self.start
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = {
+            "name": self.name,
+            "t0": round(self.start, 6),
+            "dur_ms": round(self.duration * 1e3, 3),
+        }
+        if self.attrs:
+            d["attrs"] = self.attrs
+        if self.children:
+            d["spans"] = [c.to_dict() for c in self.children]
+        return d
+
+
+class _NullSpan:
+    """Shared no-op span/context-manager — the disabled-tracing fast path."""
+
+    __slots__ = ()
+
+    def set(self, **attrs) -> None:
+        pass
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+NULL_SPAN = _NullSpan()
+
+
+class _SpanCtx:
+    """Context manager: open a span as a child of the active span (or as a
+    root trace on `tracer` when nothing is active)."""
+
+    __slots__ = ("_tracer", "_name", "_attrs", "_span", "_token")
+
+    def __init__(self, tracer: "Tracer | None", name: str, attrs: dict):
+        self._tracer = tracer
+        self._name = name
+        self._attrs = attrs
+
+    def __enter__(self) -> Span:
+        parent = _current.get()
+        if parent is not None and parent.end is not None:
+            # stale context: a task can inherit a contextvar pointing at a
+            # span another task finished long ago (e.g. a reactor task
+            # created while height 1 was active). Attaching would grow a
+            # completed trace unboundedly — root this span instead.
+            parent = None
+        self._span = Span(self._name, self._attrs, time.monotonic(), parent)
+        self._token = _current.set(self._span)
+        return self._span
+
+    def __exit__(self, *exc) -> bool:
+        span = self._span
+        span.end = time.monotonic()
+        try:
+            _current.reset(self._token)
+        except ValueError:
+            # reset from a different context (e.g. the span leaked across an
+            # executor boundary): fall back to restoring the parent directly
+            _current.set(span.parent)
+        parent = span.parent
+        if parent is not None and parent.end is None:
+            parent.children.append(span)
+        elif self._tracer is not None:
+            span.parent = None
+            self._tracer._complete(span)
+        return False
+
+
+class Tracer:
+    """Bounded ring of completed traces + optional JSONL export.
+
+    Thread-safe for completion/reads: device spans may finish in pool
+    threads while an RPC route reads the ring.
+    """
+
+    def __init__(
+        self,
+        max_traces: int = 64,
+        enabled: bool = True,
+        export_group=None,
+    ) -> None:
+        self.enabled = enabled
+        self._ring: deque[Span] = deque(maxlen=max_traces)
+        self._group = export_group
+        self._lock = threading.Lock()
+
+    # -- context-manager API ------------------------------------------------
+
+    def span(self, name: str, **attrs):
+        """Open a span: child of the active span, else a new root trace."""
+        if not self.enabled:
+            return NULL_SPAN
+        return _SpanCtx(self, name, attrs)
+
+    # -- manual API (open-ended timelines) ----------------------------------
+
+    def begin(self, name: str, **attrs) -> Span | None:
+        """Start a root span and make it the active context. Pair with
+        `finish`. Returns None when disabled (callers guard on it)."""
+        if not self.enabled:
+            return None
+        s = Span(name, attrs, time.monotonic(), parent=None)
+        _current.set(s)
+        return s
+
+    def child(self, parent: Span | None, name: str, **attrs) -> Span | None:
+        """Start a child span under `parent` and make it active."""
+        if not self.enabled or parent is None:
+            return None
+        s = Span(name, attrs, time.monotonic(), parent)
+        _current.set(s)
+        return s
+
+    def finish(self, span: Span | None) -> None:
+        """End a manually-begun span. Roots complete into the ring; the
+        active context moves back to the span's parent."""
+        if span is None:
+            return
+        span.end = time.monotonic()
+        if _current.get() is span:
+            _current.set(span.parent)
+        parent = span.parent
+        if parent is not None and parent.end is None:
+            parent.children.append(span)
+        else:
+            span.parent = None
+            self._complete(span)
+
+    # -- completion / reads -------------------------------------------------
+
+    def _complete(self, root: Span) -> None:
+        with self._lock:
+            self._ring.append(root)
+            if self._group is not None:
+                try:
+                    self._group.write(
+                        (json.dumps(root.to_dict(), default=str) + "\n").encode()
+                    )
+                    self._group.maybe_rotate()
+                except Exception:  # noqa: BLE001 — export must never break
+                    pass  # the traced operation
+
+    def traces(self, limit: int | None = None, name: str | None = None) -> list[dict]:
+        """Completed traces as dicts, newest first."""
+        with self._lock:
+            items = list(self._ring)
+        items.reverse()
+        if name is not None:
+            items = [s for s in items if s.name == name]
+        if limit is not None:
+            items = items[:limit]
+        return [s.to_dict() for s in items]
+
+    def flush(self) -> None:
+        if self._group is not None:
+            self._group.flush()
+
+    def close(self) -> None:
+        if self._group is not None:
+            self._group.close()
+            self._group = None
+
+
+NOP = Tracer(enabled=False)
+
+_global: Tracer = NOP
+
+
+def set_global(tracer: Tracer | None) -> None:
+    """Install the process tracer used by spans opened with no active
+    parent (ops/ device spans outside a node, bench scripts)."""
+    global _global
+    _global = tracer if tracer is not None else NOP
+    if _global.enabled:
+        install_log_context()
+
+
+def get_global() -> Tracer:
+    return _global
+
+
+def install_export_from_env(env_var: str = "TMTPU_TRACE_JSONL") -> Tracer | None:
+    """Bench/profile hook: when `env_var` names a path, install a global
+    tracer exporting every completed trace as one JSONL line there (same
+    schema a node writes — docs/observability.md), so bench and
+    production traces are diffable. Returns the tracer, or None."""
+    import os
+
+    path = os.environ.get(env_var)
+    if not path:
+        return None
+    from tendermint_tpu.libs.autofile import Group
+
+    tracer = Tracer(export_group=Group(path))
+    set_global(tracer)
+    return tracer
+
+
+def current() -> Span | None:
+    return _current.get()
+
+
+def span(name: str, **attrs):
+    """Module-level span helper for instrumented hot paths: attaches to the
+    active span when one exists, else roots on the global tracer, else is a
+    no-op. The no-op path is one contextvar read + one attribute check."""
+    cur = _current.get()
+    if cur is not None:
+        return _SpanCtx(_global if _global.enabled else None, name, attrs)
+    if _global.enabled:
+        return _SpanCtx(_global, name, attrs)
+    return NULL_SPAN
+
+
+# ---------------------------------------------------------------------------
+# log integration
+
+
+def _log_context() -> dict:
+    """Active trace context for every log line: `trace` is a compact
+    "height/round/span" tag gathered from the nearest ancestors."""
+    s = _current.get()
+    if s is None:
+        return {}
+    height = round_ = None
+    node = s
+    while node is not None and (height is None or round_ is None):
+        if height is None:
+            height = node.attrs.get("height")
+        if round_ is None:
+            round_ = node.attrs.get("round")
+        node = node.parent
+    return {"trace": f"{height}/{round_}/{s.name}"}
+
+
+def install_log_context() -> None:
+    """Make `libs/log.py` attach the active trace context to every line."""
+    from tendermint_tpu.libs import log
+
+    log.set_context_provider(_log_context)
+
+
+# ---------------------------------------------------------------------------
+# device telemetry
+
+
+class DeviceTelemetry:
+    """Always-on process-wide device-health counters (plain int math — no
+    dependence on tracing or Prometheus being enabled).
+
+    Updated by ops/ed25519_batch, ops/secp_batch and crypto/batch;
+    `snapshot()` backs the `debug_device` RPC route; `set_metrics()`
+    mirrors events into a `libs/metrics.DeviceMetrics` bundle when the
+    node serves Prometheus.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.dispatches = 0
+        self.lanes_dispatched = 0
+        self.lanes_padded = 0
+        self.fetch_timeouts = 0
+        self.cpu_fallbacks = 0
+        self.fallback_reasons: dict[str, int] = {}
+        self.breaker_trips = 0
+        self.breaker_tripped = False
+        self.breaker_retry_in_s = 0.0
+        self.last_batch: dict = {}
+        self._metrics = None
+
+    def set_metrics(self, dm) -> None:
+        self._metrics = dm
+        if dm is not None:
+            dm.breaker_tripped.set(1.0 if self.breaker_tripped else 0.0)
+
+    def record_dispatch(self, n: int, bucket: int, curve: str = "ed25519") -> None:
+        with self._lock:
+            self.dispatches += 1
+            self.lanes_dispatched += n
+            self.lanes_padded += max(0, bucket - n)
+            self.last_batch = {"curve": curve, "size": n, "bucket": bucket}
+        dm = self._metrics
+        if dm is not None:
+            dm.dispatches_total.inc(curve=curve)
+            dm.batch_size.observe(n)
+            if bucket > 0:
+                dm.batch_occupancy.observe(n / bucket)
+            dm.pad_lanes_total.inc(max(0, bucket - n), curve=curve)
+
+    def record_fetch(self, seconds: float, curve: str = "ed25519") -> None:
+        with self._lock:
+            self.last_batch = dict(self.last_batch, fetch_ms=round(seconds * 1e3, 3))
+        dm = self._metrics
+        if dm is not None:
+            dm.fetch_seconds.observe(seconds)
+
+    def record_timeout(self, curve: str = "ed25519") -> None:
+        with self._lock:
+            self.fetch_timeouts += 1
+        dm = self._metrics
+        if dm is not None:
+            dm.fetch_timeouts_total.inc(curve=curve)
+
+    def record_fallback(self, reason: str, curve: str = "ed25519") -> None:
+        with self._lock:
+            self.cpu_fallbacks += 1
+            self.fallback_reasons[reason] = self.fallback_reasons.get(reason, 0) + 1
+        dm = self._metrics
+        if dm is not None:
+            dm.cpu_fallbacks_total.inc(reason=reason, curve=curve)
+
+    def record_breaker(self, tripped: bool, retry_in_s: float = 0.0) -> None:
+        with self._lock:
+            newly = tripped and not self.breaker_tripped
+            self.breaker_tripped = tripped
+            self.breaker_retry_in_s = retry_in_s
+            if newly:
+                self.breaker_trips += 1
+        dm = self._metrics
+        if dm is not None:
+            dm.breaker_tripped.set(1.0 if tripped else 0.0)
+            if newly:
+                dm.breaker_trips_total.inc()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "dispatches": self.dispatches,
+                "lanes_dispatched": self.lanes_dispatched,
+                "lanes_padded": self.lanes_padded,
+                "fetch_timeouts": self.fetch_timeouts,
+                "cpu_fallbacks": self.cpu_fallbacks,
+                "fallback_reasons": dict(self.fallback_reasons),
+                "breaker": {
+                    "tripped": self.breaker_tripped,
+                    "trips": self.breaker_trips,
+                    "retry_in_s": round(self.breaker_retry_in_s, 3),
+                },
+                "last_batch": dict(self.last_batch),
+            }
+
+
+DEVICE = DeviceTelemetry()

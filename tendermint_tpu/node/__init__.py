@@ -193,6 +193,24 @@ class Node(BaseService):
                 state, self.block_exec, self.block_store, fast_sync=fast_sync, logger=log
             )
 
+        # consensus timeline tracer (default-off; debug_consensus_trace +
+        # optional JSONL export through a rotating autofile group)
+        self.tracer = None
+        if cfg.instrumentation.tracing:
+            from tendermint_tpu.libs import trace as tmtrace
+            from tendermint_tpu.libs.autofile import Group
+
+            export_group = None
+            if cfg.instrumentation.trace_jsonl_file:
+                export_group = Group(cfg._abs(cfg.instrumentation.trace_jsonl_file))
+            self.tracer = tmtrace.Tracer(
+                max_traces=cfg.instrumentation.trace_ring,
+                export_group=export_group,
+            )
+            # device spans opened outside an active consensus span (pool
+            # threads, benches sharing the process) root here too
+            tmtrace.set_global(self.tracer)
+
         wal_dir = os.path.dirname(cfg.wal_path)
         os.makedirs(wal_dir, exist_ok=True)
         self.consensus_state = ConsensusState(
@@ -206,6 +224,7 @@ class Node(BaseService):
             wal=WAL(cfg.wal_path),
             event_bus=self.event_bus,
             logger=log,
+            tracer=self.tracer,
         )
         self.consensus_reactor = ConsensusReactor(
             self.consensus_state, fast_sync=fast_sync, logger=log
@@ -321,6 +340,12 @@ class Node(BaseService):
 
             crypto_batch.set_metrics_sink(_batch_sink)
             self.block_exec.metrics = self.state_metrics
+            # device data plane: mirror the process-wide telemetry
+            # singleton into the tm_device_* series
+            from tendermint_tpu.libs import trace as tmtrace
+
+            self.device_metrics = tmm.DeviceMetrics(self.metrics)
+            tmtrace.DEVICE.set_metrics(self.device_metrics)
             mhost, mport = parse_laddr(cfg.instrumentation.prometheus_listen_addr)
             self.metrics_server = tmm.MetricsServer(self.metrics, mhost, mport)
         self._built = True
@@ -407,6 +432,16 @@ class Node(BaseService):
         await self.indexer_service.stop()
         await self.event_bus.stop()
         await self.proxy_app.stop()
+        if getattr(self, "tracer", None) is not None:
+            from tendermint_tpu.libs import trace as tmtrace
+
+            if tmtrace.get_global() is self.tracer:
+                tmtrace.set_global(None)
+            self.tracer.close()
+        if getattr(self, "metrics_server", None) is not None:
+            from tendermint_tpu.libs import trace as tmtrace
+
+            tmtrace.DEVICE.set_metrics(None)
         self.consensus_state.wal.close()
         self.addr_book.save()
         for db in (self.block_store_db, self.state_db):

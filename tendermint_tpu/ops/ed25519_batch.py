@@ -36,6 +36,8 @@ from __future__ import annotations
 
 import hashlib
 import os
+import threading
+import time
 from functools import partial
 
 import jax
@@ -43,6 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tendermint_tpu.crypto import ed25519_math as em
+from tendermint_tpu.libs import trace as _trace
 from tendermint_tpu.ops import curve, field
 from tendermint_tpu.ops.limbs import LIMB_BITS, NLIMB
 
@@ -556,6 +559,84 @@ def _fetch_pool():
 # its work across chunks and will recompute on the CPU path below.
 _FETCH_TIMEOUT_S = float(os.environ.get("TMTPU_FETCH_TIMEOUT_S", 300.0))
 
+# After a fetch timeout (wedged link), how long later calls skip the device
+# entirely before ONE half-open probe is allowed through again.
+_BREAKER_RETRY_S = float(os.environ.get("TMTPU_BREAKER_RETRY_S", 600.0))
+
+
+class _CircuitBreaker:
+    """Wedged-device circuit breaker (ADVICE r5 medium).
+
+    Without it, the first fetch TimeoutError is paid AGAIN by every later
+    verify_batch call: the daemon fetch workers stay wedged and each commit
+    verify blocks the full _FETCH_TIMEOUT_S before degrading — a
+    multi-minute stall per height, forever, which is a consensus-liveness
+    failure even though nothing hangs indefinitely. After the first
+    timeout the breaker trips: later calls route straight to the CPU path
+    with no device wait until `retry_after` has elapsed, then exactly one
+    call probes the device again (half-open) — re-tripping on timeout,
+    closing on success. State is mirrored into libs/trace.DEVICE for the
+    debug_device route and the DeviceMetrics gauge.
+    """
+
+    def __init__(self, retry_after: float = _BREAKER_RETRY_S) -> None:
+        self.retry_after = retry_after
+        self.tripped = False
+        self.retry_at = 0.0
+        self._lock = threading.Lock()
+
+    def allow(self) -> bool:
+        """True when the device may be tried: closed, or half-open. The
+        half-open probe is CLAIMED atomically — granting it advances
+        retry_at a full window, so exactly one caller per window reaches
+        the (possibly still wedged) device and blocks on its fetch
+        timeout; concurrent callers keep routing to CPU instead of all
+        piling onto the dead link at once."""
+        with self._lock:
+            if not self.tripped:
+                return True
+            now = time.monotonic()
+            if now >= self.retry_at:
+                self.retry_at = now + self.retry_after
+                return True
+            return False
+
+    def trip(self) -> None:
+        with self._lock:
+            self.tripped = True
+            self.retry_at = time.monotonic() + self.retry_after
+        _trace.DEVICE.record_breaker(True, self.retry_after)
+
+    def reset(self) -> None:
+        with self._lock:
+            was = self.tripped
+            self.tripped = False
+            self.retry_at = 0.0
+        if was:
+            _trace.DEVICE.record_breaker(False, 0.0)
+
+    def release_probe(self) -> None:
+        """Return an unused half-open claim: a caller that passed allow()
+        but never actually reached the device (no valid lanes to dispatch,
+        or no device kernel for its curve) must not burn the window's one
+        probe — re-arm it for the next caller. No-op when closed."""
+        with self._lock:
+            if self.tripped:
+                self.retry_at = time.monotonic()
+
+    def state(self) -> dict:
+        with self._lock:
+            return {
+                "tripped": self.tripped,
+                "retry_in_s": round(max(0.0, self.retry_at - time.monotonic()), 3)
+                if self.tripped
+                else 0.0,
+                "retry_after_s": self.retry_after,
+            }
+
+
+breaker = _CircuitBreaker()
+
 
 def fetch_verdicts(arrays) -> list:
     """Fetch dispatched device verdict arrays, BOUNDED: every entry comes
@@ -630,10 +711,31 @@ def verify_batch(pubs, msgs, sigs) -> list[bool]:
     the fast sync / light client shape — keeps the device queue full
     instead of paying a round trip per chunk. Pubkey blocks are served
     from the device-resident cache when the valset repeats.
+
+    Observability: the whole call is one `ed25519_batch` trace span
+    (batch size, bucket, dispatch and fetch latency, timeout/fallback
+    tags) attached to whatever consensus span is active, and every
+    dispatch/fetch/degrade event updates libs/trace.DEVICE. A tripped
+    circuit breaker short-circuits to the device-free crypto path.
     """
+    n = len(pubs)
+    if not breaker.allow():
+        # wedged device link: route straight to the CPU path instead of
+        # re-blocking _FETCH_TIMEOUT_S on every commit verify (ADVICE r5)
+        from tendermint_tpu import ops as _ops
+
+        _trace.DEVICE.record_fallback("breaker_open")
+        with _trace.span("ed25519_cpu_fallback", batch_size=n, reason="breaker_open"):
+            return list(_ops._ed25519_small(pubs, msgs, sigs))
     from tendermint_tpu.ops import kcache
 
-    n = len(pubs)
+    with _trace.span("ed25519_batch", batch_size=n) as sp:
+        return _verify_batch_device(pubs, msgs, sigs, n, kcache, sp)
+
+
+def _verify_batch_device(pubs, msgs, sigs, n, kcache, sp) -> list[bool]:
+    """verify_batch body under an open `ed25519_batch` span `sp`."""
+    t_dispatch0 = time.monotonic()
     pending: list[tuple[int, int, object, tuple, np.ndarray, bool]] = []
     out = np.zeros(n, dtype=bool)
     for lo in range(0, n, kcache.MAX_BUCKET):
@@ -641,6 +743,8 @@ def verify_batch(pubs, msgs, sigs) -> list[bool]:
         packed, mask = prepare_batch(pubs[lo:hi], msgs[lo:hi], sigs[lo:hi])
         if packed is None:
             continue
+        _trace.DEVICE.record_dispatch(int(mask.sum()), packed.shape[1])
+        sp.set(bucket=packed.shape[1])
         keys_np, sigs_np = split(packed)
         mfn, sharding = _multi_device_fn()
         dev_out = None
@@ -693,9 +797,17 @@ def verify_batch(pubs, msgs, sigs) -> list[bool]:
     # dead tunnel makes every fetch hang forever, so on expiry every
     # chunk degrades to the local recompute below instead of blocking
     # the node indefinitely (ADVICE r4).
+    sp.set(chunks=len(pending),
+           dispatch_ms=round((time.monotonic() - t_dispatch0) * 1e3, 3))
+    t_fetch0 = time.monotonic()
     fetched = fetch_verdicts([p[2] for p in pending])
+    fetch_s = time.monotonic() - t_fetch0
+    sp.set(fetch_ms=round(fetch_s * 1e3, 3))
+    timed_out = False
     for (lo, hi, _, blocks, mask, from_sharded), got in zip(pending, fetched):
         if isinstance(got, TimeoutError):
+            timed_out = True
+            _trace.DEVICE.record_fallback("fetch_timeout")
             # wedged device link: every further jax call — including the
             # local-recompute degrade below — would hang the same way.
             # Recompute this chunk on the device-free crypto path (native
@@ -718,8 +830,23 @@ def verify_batch(pubs, msgs, sigs) -> list[bool]:
                 kcache._kernel_for(kcache._platform())[0] == "xla"
             ):
                 raise got
+            _trace.DEVICE.record_fallback("kernel_error")
             ok = np.asarray(verify_kernel(*blocks))[: hi - lo]
         else:
             ok = got[: hi - lo]
         out[lo:hi] = ok & mask
+    if timed_out:
+        # first wedge observation trips the breaker: later calls skip the
+        # device until the retry deadline (the half-open probe re-enters
+        # here and either re-trips or closes the breaker below)
+        breaker.trip()
+        _trace.DEVICE.record_timeout()
+        sp.set(timeout=True)
+    elif pending:
+        breaker.reset()
+        _trace.DEVICE.record_fetch(fetch_s)
+    else:
+        # nothing dispatched (all lanes structurally invalid): don't burn
+        # a claimed half-open probe on a call that never hit the device
+        breaker.release_probe()
     return out.tolist()

@@ -28,6 +28,7 @@ import os
 import numpy as np
 
 from tendermint_tpu.crypto import secp256k1_math as sm
+from tendermint_tpu.libs import trace as _trace
 
 NWORDS = 8
 # Packed wire-format rows: sig-dependent planes then the pubkey planes.
@@ -267,14 +268,36 @@ def verify_batch(pubs, msgs, sigs) -> list[bool]:
 
     Chunk launches are dispatched asynchronously and collected at the end
     (one device transfer + one execute each — see ed25519_batch.verify_batch
-    for the dispatch-cost rationale)."""
+    for the dispatch-cost rationale). Shares ed25519_batch's wedged-device
+    circuit breaker — both curves dispatch over the same link — and records
+    the same `secp_batch` device span + DEVICE telemetry."""
+    from tendermint_tpu.ops import ed25519_batch as _edb
     from tendermint_tpu.ops import kcache
 
+    n = len(pubs)
     fn = _device_fn()
     mfn, sharding = _multi_device_fn()
     if fn is None and mfn is None:
+        # no secp device kernel: serial path, and crucially WITHOUT
+        # consulting the breaker — allow() claims the one half-open probe
+        # per retry window, and a caller that can never reach the device
+        # must not starve ed25519's actual recovery probe
         return _serial_verify(pubs, msgs, sigs)
-    n = len(pubs)
+    if not _edb.breaker.allow():
+        _trace.DEVICE.record_fallback("breaker_open", curve="secp256k1")
+        with _trace.span("secp_cpu_fallback", batch_size=n, reason="breaker_open"):
+            return _serial_verify(pubs, msgs, sigs)
+    with _trace.span("secp_batch", batch_size=n) as sp:
+        return _verify_batch_device(pubs, msgs, sigs, n, fn, mfn, sharding, kcache, sp)
+
+
+def _verify_batch_device(pubs, msgs, sigs, n, fn, mfn, sharding, kcache, sp) -> list[bool]:
+    """verify_batch body under an open `secp_batch` span `sp`."""
+    import time as _time
+
+    from tendermint_tpu.ops import ed25519_batch as _edb
+
+    t_dispatch0 = _time.monotonic()
     pending: list[tuple[int, int, object, np.ndarray]] = []
     out = np.zeros(n, dtype=bool)
     for lo in range(0, n, kcache.MAX_BUCKET):
@@ -282,6 +305,10 @@ def verify_batch(pubs, msgs, sigs) -> list[bool]:
         packed, mask = prepare_batch(pubs[lo:hi], msgs[lo:hi], sigs[lo:hi])
         if packed is None:
             continue
+        _trace.DEVICE.record_dispatch(
+            int(mask.sum()), packed.shape[1], curve="secp256k1"
+        )
+        sp.set(bucket=packed.shape[1])
         sigs_np, keys_np = split(packed)
         import jax
 
@@ -322,10 +349,31 @@ def verify_batch(pubs, msgs, sigs) -> list[bool]:
     # caller forever
     from tendermint_tpu.ops.ed25519_batch import fetch_verdicts
 
+    sp.set(chunks=len(pending),
+           dispatch_ms=round((_time.monotonic() - t_dispatch0) * 1e3, 3))
+    t_fetch0 = _time.monotonic()
     fetched = fetch_verdicts([p[2] for p in pending])
+    fetch_s = _time.monotonic() - t_fetch0
+    sp.set(fetch_ms=round(fetch_s * 1e3, 3))
+    timed_out = False
     for (lo, hi, _, mask), got in zip(pending, fetched):
         if isinstance(got, Exception):
+            if isinstance(got, TimeoutError):
+                timed_out = True
+                _trace.DEVICE.record_fallback("fetch_timeout", curve="secp256k1")
+            else:
+                _trace.DEVICE.record_fallback("kernel_error", curve="secp256k1")
             out[lo:hi] = _serial_verify(pubs[lo:hi], msgs[lo:hi], sigs[lo:hi])
         else:
             out[lo:hi] = got[: hi - lo] & mask
+    if timed_out:
+        _edb.breaker.trip()
+        _trace.DEVICE.record_timeout(curve="secp256k1")
+        sp.set(timeout=True)
+    elif pending:
+        _edb.breaker.reset()
+        _trace.DEVICE.record_fetch(fetch_s, curve="secp256k1")
+    else:
+        # nothing dispatched: return the claimed half-open probe unused
+        _edb.breaker.release_probe()
     return out.tolist()

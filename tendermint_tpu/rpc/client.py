@@ -91,14 +91,15 @@ class WSClient:
         max_reconnect_attempts: int = 25,
         backoff_base: float = 0.2,
         backoff_cap: float = 10.0,
-        random_mask: bool = False,
+        random_mask: bool = True,
     ) -> None:
         self.host, self.port = host, port
         self.reconnect = reconnect
-        # False = identity (all-zero) masking key, measurably faster and
-        # fine for trusted/loopback endpoints; True = RFC 6455 §5.3
-        # unpredictable per-frame keys — set it when dialing third-party
-        # nodes through possibly-caching intermediaries (ADVICE r4)
+        # True (default) = RFC 6455 §5.3 unpredictable per-frame masking
+        # keys — required for any client that may dial a third-party node
+        # through possibly-caching intermediaries. False = identity
+        # (all-zero) key, measurably faster: an explicit opt-in for
+        # trusted/loopback flood benchmarking only (ADVICE r5).
         self.random_mask = random_mask
         self.max_reconnect_attempts = max_reconnect_attempts
         self.backoff_base = backoff_base

@@ -405,6 +405,43 @@ class Environment:
         }
 
     # ------------------------------------------------------------------
+    # debug/observability routes (no reference analog — the TPU data
+    # plane's "why was height H slow" surface; see docs/observability.md)
+
+    async def debug_consensus_trace(self, n: int = 10) -> dict:
+        """Last N completed height traces from the consensus tracer: one
+        span tree per height (propose/prevote/precommit/commit/... steps
+        with nested batch_verify / ed25519_batch / apply_block spans)."""
+        cs = self.consensus_state
+        tracer = getattr(cs, "tracer", None)
+        if tracer is None or not tracer.enabled:
+            return {"enabled": False, "traces": []}
+        try:
+            n = max(1, min(int(n), 100))
+        except (TypeError, ValueError):
+            raise RPCError(INVALID_PARAMS, "n must be an int")
+        out = {"enabled": True, "traces": tracer.traces(limit=n, name="height")}
+        active = getattr(cs, "_height_span", None)
+        if active is not None and active.end is None:
+            out["active"] = active.to_dict()
+        return out
+
+    async def debug_device(self) -> dict:
+        """Device data-plane health: dispatch/pad/fetch counters, CPU
+        fallbacks, and the wedged-device circuit breaker state."""
+        import sys as _sys
+
+        from tendermint_tpu.libs import trace as tmtrace
+
+        snap = tmtrace.DEVICE.snapshot()
+        # live breaker read when ops is loaded; never import it here (that
+        # would drag jax into a CPU-only node serving a debug call)
+        edb = _sys.modules.get("tendermint_tpu.ops.ed25519_batch")
+        if edb is not None:
+            snap["breaker"] = dict(snap["breaker"], **edb.breaker.state())
+        return snap
+
+    # ------------------------------------------------------------------
     # tx routes
 
     async def broadcast_tx_async(self, tx) -> dict:
@@ -686,6 +723,8 @@ class Environment:
             "consensus_params": self.consensus_params,
             "consensus_state": self.consensus_state_summary,
             "dump_consensus_state": self.dump_consensus_state,
+            "debug_consensus_trace": self.debug_consensus_trace,
+            "debug_device": self.debug_device,
             "broadcast_tx_async": self.broadcast_tx_async,
             "broadcast_tx_sync": self.broadcast_tx_sync,
             "broadcast_tx_commit": self.broadcast_tx_commit,

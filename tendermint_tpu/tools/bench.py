@@ -71,7 +71,9 @@ class Transacter:
     async def run(self, duration: int, stop: asyncio.Event) -> None:
         from collections import deque
 
-        ws = WSClient(self.host, self.port)
+        # zero-mask fast path: explicit opt-in, this flooder only targets
+        # trusted/loopback bench nodes (WSClient defaults to RFC masking)
+        ws = WSClient(self.host, self.port, random_mask=False)
         await ws.connect()
         window: deque = deque()
         try:
@@ -163,7 +165,7 @@ async def run_bench(
     stop = asyncio.Event()
 
     # block watcher
-    watcher = WSClient(host, port)
+    watcher = WSClient(host, port, random_mask=False)
     await watcher.connect()
     await watcher.subscribe("tm.event='NewBlock'")
     t0 = time.monotonic()

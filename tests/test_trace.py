@@ -1,0 +1,390 @@
+"""Tracing + device-telemetry tests (ISSUE 2 observability tentpole).
+
+Covers: span nesting/timing, ring-buffer eviction, JSONL export
+round-trip, DeviceMetrics rendering through the /metrics endpoint, the
+debug_consensus_trace / debug_device RPC routes, and the wedged-device
+circuit breaker trip/recover path. The full-node trace integration
+(height traces with consensus step spans) lives at the bottom and skips
+cleanly when the crypto stack is unavailable.
+"""
+import asyncio
+import json
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from tendermint_tpu.libs import trace as tmtrace
+from tendermint_tpu.libs.autofile import Group
+from tendermint_tpu.libs.metrics import Collector, DeviceMetrics, MetricsServer
+
+
+class TestSpans:
+    def test_nesting_and_timing(self):
+        tr = tmtrace.Tracer(max_traces=4)
+        with tr.span("root", height=7) as root:
+            time.sleep(0.01)
+            with tr.span("child") as c:
+                c.set(x=1)
+                time.sleep(0.005)
+        assert root.end is not None
+        traces = tr.traces()
+        assert len(traces) == 1
+        d = traces[0]
+        assert d["name"] == "root"
+        assert d["attrs"] == {"height": 7}
+        (child,) = d["spans"]
+        assert child["name"] == "child"
+        assert child["attrs"] == {"x": 1}
+        # parent covers the child, both positive
+        assert d["dur_ms"] >= child["dur_ms"] > 0
+
+    def test_module_span_attaches_to_active(self):
+        tr = tmtrace.Tracer()
+        with tr.span("outer"):
+            with tmtrace.span("inner", k="v"):
+                pass
+        d = tr.traces()[0]
+        assert d["spans"][0]["name"] == "inner"
+
+    def test_module_span_nop_without_tracer(self):
+        # no active span, no global tracer: the helper is a no-op ctx
+        assert tmtrace.get_global() is tmtrace.NOP
+        with tmtrace.span("orphan") as sp:
+            sp.set(anything=1)  # NULL span swallows attrs
+        assert sp is tmtrace.NULL_SPAN
+
+    def test_global_tracer_roots_orphans(self):
+        tr = tmtrace.Tracer()
+        tmtrace.set_global(tr)
+        try:
+            with tmtrace.span("orphan", a=1):
+                pass
+            assert tr.traces()[0]["name"] == "orphan"
+        finally:
+            tmtrace.set_global(None)
+
+    def test_ring_eviction(self):
+        tr = tmtrace.Tracer(max_traces=4)
+        for i in range(10):
+            with tr.span("t", i=i):
+                pass
+        got = tr.traces()
+        assert len(got) == 4
+        # newest first
+        assert [t["attrs"]["i"] for t in got] == [9, 8, 7, 6]
+        assert tr.traces(limit=2)[0]["attrs"]["i"] == 9
+
+    def test_manual_timeline(self):
+        tr = tmtrace.Tracer()
+        h = tr.begin("height", height=3)
+        s1 = tr.child(h, "propose", height=3, round=0)
+        # a context-manager span opened while a manual span is active
+        # nests under it (the ops device-span shape)
+        with tmtrace.span("ed25519_batch", batch_size=10):
+            pass
+        tr.finish(s1)
+        s2 = tr.child(h, "prevote", height=3, round=0)
+        tr.finish(s2)
+        tr.finish(h)
+        d = tr.traces(name="height")[0]
+        names = [s["name"] for s in d["spans"]]
+        assert names == ["propose", "prevote"]
+        assert d["spans"][0]["spans"][0]["name"] == "ed25519_batch"
+        assert tmtrace.current() is None
+
+    def test_disabled_tracer_is_nop(self):
+        tr = tmtrace.Tracer(enabled=False)
+        with tr.span("x") as sp:
+            assert sp is tmtrace.NULL_SPAN
+        assert tr.begin("x") is None
+        tr.finish(None)  # no-op
+        assert tr.traces() == []
+
+    def test_stale_parent_not_grown(self):
+        # a span finished long ago must not accumulate children from
+        # tasks that inherited its contextvar (leak guard)
+        tr = tmtrace.Tracer()
+        tmtrace.set_global(tr)
+        try:
+            h = tr.begin("height", height=1)
+            tr.finish(h)
+            # _current still points at h in this context; a new span must
+            # root itself instead of attaching to the finished trace
+            with tmtrace.span("late"):
+                pass
+            assert h.children == []
+            assert tr.traces()[0]["name"] == "late"
+        finally:
+            tmtrace.set_global(None)
+            tmtrace._current.set(None)
+
+    def test_jsonl_export_roundtrip(self, tmp_path):
+        group = Group(str(tmp_path / "trace.jsonl"), head_size_limit=1 << 20)
+        tr = tmtrace.Tracer(export_group=group)
+        with tr.span("height", height=1):
+            with tr.span("propose", round=0):
+                pass
+        with tr.span("height", height=2):
+            pass
+        tr.close()
+        lines = (tmp_path / "trace.jsonl").read_text().splitlines()
+        assert len(lines) == 2
+        recs = [json.loads(line) for line in lines]
+        assert recs[0]["name"] == "height"
+        assert recs[0]["attrs"]["height"] == 1
+        assert recs[0]["spans"][0]["name"] == "propose"
+        # file content matches the in-memory ring (same to_dict schema)
+        assert recs == list(reversed(tr.traces()))
+
+    def test_install_export_from_env(self, tmp_path, monkeypatch):
+        path = str(tmp_path / "t.jsonl")
+        monkeypatch.setenv("TMTPU_TRACE_JSONL", path)
+        tr = tmtrace.install_export_from_env()
+        try:
+            assert tr is not None and tmtrace.get_global() is tr
+            with tmtrace.span("x"):
+                pass
+            tr.close()
+            assert json.loads(open(path).read())["name"] == "x"
+        finally:
+            tmtrace.set_global(None)
+        monkeypatch.delenv("TMTPU_TRACE_JSONL")
+        assert tmtrace.install_export_from_env() is None
+
+    def test_log_context_attaches_trace(self):
+        import io
+
+        from tendermint_tpu.libs import log as tmlog
+
+        tr = tmtrace.Tracer()
+        tmtrace.set_global(tr)  # installs the provider
+        sink = io.StringIO()
+        logger = tmlog.Logger("consensus", sink=sink)
+        try:
+            h = tr.begin("height", height=5)
+            s = tr.child(h, "prevote", height=5, round=2)
+            logger.info("hello")
+            tr.finish(s)
+            tr.finish(h)
+            rec = json.loads(sink.getvalue())
+            assert rec["trace"] == "5/2/prevote"
+        finally:
+            tmtrace.set_global(None)
+            tmlog.set_context_provider(None)
+
+
+class TestDeviceTelemetry:
+    def test_snapshot_and_metrics_sink(self):
+        c = Collector("tm")
+        dm = DeviceMetrics(c)
+        dt = tmtrace.DeviceTelemetry()
+        dt.set_metrics(dm)
+        dt.record_dispatch(100, 128)
+        dt.record_fetch(0.012)
+        dt.record_timeout()
+        dt.record_fallback("fetch_timeout")
+        dt.record_breaker(True, 600.0)
+        snap = dt.snapshot()
+        assert snap["dispatches"] == 1
+        assert snap["lanes_dispatched"] == 100
+        assert snap["lanes_padded"] == 28
+        assert snap["fetch_timeouts"] == 1
+        assert snap["cpu_fallbacks"] == 1
+        assert snap["fallback_reasons"] == {"fetch_timeout": 1}
+        assert snap["breaker"]["tripped"] is True
+        assert snap["breaker"]["trips"] == 1
+        assert snap["last_batch"]["size"] == 100
+        text = c.render()
+        assert 'tm_device_dispatches_total{curve="ed25519"} 1' in text
+        assert "tm_device_batch_size_count 1" in text
+        assert 'tm_device_pad_lanes_total{curve="ed25519"} 28' in text
+        assert "tm_device_fetch_seconds_count 1" in text
+        assert "tm_device_fetch_timeouts_total" in text
+        assert "tm_device_breaker_tripped 1" in text
+        assert "tm_device_breaker_trips_total 1" in text
+        dt.record_breaker(False)
+        assert "tm_device_breaker_tripped 0" in c.render()
+
+    def test_device_metrics_served_over_http(self):
+        async def main():
+            c = Collector("tm")
+            DeviceMetrics(c)  # all series render even with zero samples
+            srv = MetricsServer(c, "127.0.0.1", 0)
+            await srv.start()
+            try:
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", srv.listen_port
+                )
+                writer.write(b"GET /metrics HTTP/1.1\r\n\r\n")
+                await writer.drain()
+                data = await reader.read(65536)
+                assert b"tm_device_batch_size_bucket" in data
+                assert b"tm_device_breaker_tripped 0" in data
+                assert b"tm_device_cpu_fallbacks_total 0" in data
+                writer.close()
+            finally:
+                await srv.stop()
+
+        asyncio.run(main())
+
+
+class TestDebugRoutes:
+    def _environment(self):
+        # rpc.core's import chain reaches the crypto stack
+        pytest.importorskip("cryptography", reason="crypto stack unavailable")
+        from tendermint_tpu.rpc.core import Environment
+
+        return Environment
+
+    def test_debug_consensus_trace_route(self):
+        Environment = self._environment()
+
+        tr = tmtrace.Tracer()
+        h = tr.begin("height", height=1)
+        s = tr.child(h, "propose", height=1, round=0)
+        tr.finish(s)
+        tr.finish(h)
+        active = tr.begin("height", height=2)
+        cs = SimpleNamespace(tracer=tr, _height_span=active)
+        env = Environment(consensus_state=cs)
+
+        async def main():
+            out = await env.debug_consensus_trace(n=5)
+            assert out["enabled"] is True
+            assert out["traces"][0]["attrs"]["height"] == 1
+            assert out["traces"][0]["spans"][0]["name"] == "propose"
+            assert out["active"]["attrs"]["height"] == 2
+            # disabled tracer reports cleanly
+            env2 = Environment(
+                consensus_state=SimpleNamespace(tracer=tmtrace.NOP)
+            )
+            out2 = await env2.debug_consensus_trace()
+            assert out2 == {"enabled": False, "traces": []}
+
+        try:
+            asyncio.run(main())
+        finally:
+            tr.finish(active)
+            tmtrace._current.set(None)
+
+    def test_debug_device_route(self):
+        Environment = self._environment()
+        env = Environment(consensus_state=None)
+
+        async def main():
+            out = await env.debug_device()
+            assert "dispatches" in out
+            assert "breaker" in out and "tripped" in out["breaker"]
+
+        asyncio.run(main())
+
+
+class TestCircuitBreaker:
+    def _edb(self):
+        return pytest.importorskip(
+            "tendermint_tpu.ops.ed25519_batch",
+            reason="crypto/jax stack unavailable",
+        )
+
+    def test_trip_half_open_recover(self):
+        edb = self._edb()
+        br = edb._CircuitBreaker(retry_after=0.05)
+        assert br.allow()
+        br.trip()
+        assert not br.allow()
+        st = br.state()
+        assert st["tripped"] and 0 < st["retry_in_s"] <= 0.05
+        time.sleep(0.06)
+        assert br.allow()  # half-open probe permitted — and CLAIMED:
+        assert not br.allow()  # concurrent callers keep routing to CPU
+        br.trip()  # probe failed: re-trip
+        assert not br.allow()
+        time.sleep(0.06)
+        assert br.allow()  # claimed again...
+        br.release_probe()  # ...but never reached the device: re-armed
+        assert br.allow()
+        br.reset()
+        assert br.allow() and not br.state()["tripped"]
+
+    def test_tripped_breaker_routes_to_cpu(self, monkeypatch):
+        edb = self._edb()
+        from tendermint_tpu.utils import make_sig_batch
+
+        pubs, msgs, sigs = make_sig_batch(8, msg_prefix=b"breaker ")
+        br = edb._CircuitBreaker(retry_after=3600.0)
+        br.trip()
+        monkeypatch.setattr(edb, "breaker", br)
+        before = tmtrace.DEVICE.snapshot()["cpu_fallbacks"]
+        ok = edb.verify_batch(pubs, msgs, sigs)
+        assert ok == [True] * 8
+        bad = edb.verify_batch(pubs, msgs, [b"\x00" * 64] * 8)
+        assert bad == [False] * 8
+        snap = tmtrace.DEVICE.snapshot()
+        assert snap["cpu_fallbacks"] >= before + 2
+        assert snap["fallback_reasons"].get("breaker_open", 0) >= 2
+
+    def test_device_span_records_batch_and_fetch(self):
+        edb = self._edb()
+        from tendermint_tpu.utils import make_sig_batch
+
+        pubs, msgs, sigs = make_sig_batch(16, msg_prefix=b"span ")
+        tr = tmtrace.Tracer()
+        with tr.span("height", height=9):
+            ok = edb.verify_batch(pubs, msgs, sigs)
+        assert all(ok)
+        d = tr.traces()[0]
+        dev = [s for s in d.get("spans", []) if s["name"] == "ed25519_batch"]
+        assert dev, d
+        attrs = dev[0]["attrs"]
+        assert attrs["batch_size"] == 16
+        assert attrs["bucket"] >= 16
+        assert "fetch_ms" in attrs and "dispatch_ms" in attrs
+
+
+class TestNodeIntegration:
+    def test_node_height_traces_and_debug_routes(self, tmp_path):
+        pytest.importorskip("cryptography", reason="crypto stack unavailable")
+
+        async def main():
+            import os
+            import sys
+
+            sys.path.insert(0, os.path.dirname(__file__))
+            from test_node_rpc import make_node
+
+            from tendermint_tpu.rpc.client import HTTPClient
+
+            node = make_node(str(tmp_path))
+            node.config.instrumentation.tracing = True
+            node.config.instrumentation.prometheus = True
+            node.config.instrumentation.prometheus_listen_addr = (
+                "tcp://127.0.0.1:0"
+            )
+            node.config.instrumentation.trace_jsonl_file = "data/trace.jsonl"
+            await node.start()
+            client = HTTPClient("127.0.0.1", node.rpc_port)
+            try:
+                async with asyncio.timeout(30):
+                    while node.block_store.height() < 3:
+                        await asyncio.sleep(0.05)
+                out = await client.call("debug_consensus_trace", n=5)
+                assert out["enabled"] is True
+                assert out["traces"], "no completed height traces"
+                trace = out["traces"][0]
+                assert trace["name"] == "height"
+                names = {s["name"] for s in trace.get("spans", [])}
+                assert {"propose", "prevote", "precommit", "commit"} <= names
+                dev = await client.call("debug_device")
+                assert "breaker" in dev
+                # tm_device_* series present on /metrics
+                assert "tendermint_device_batch_size" in node.metrics.render()
+                # JSONL export wrote one line per completed height
+                path = os.path.join(str(tmp_path), "data", "trace.jsonl")
+                lines = open(path).read().splitlines()
+                assert lines and json.loads(lines[0])["name"] == "height"
+                await client.close()
+            finally:
+                await node.stop()
+
+        asyncio.run(main())
