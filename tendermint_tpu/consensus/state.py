@@ -34,7 +34,7 @@ from tendermint_tpu.libs import fail
 from tendermint_tpu.libs import trace as tmtrace
 from tendermint_tpu.libs.events import EventSwitch
 from tendermint_tpu.libs.log import NOP, Logger
-from tendermint_tpu.libs.service import BaseService
+from tendermint_tpu.libs.service import BaseService, spawn_logged
 from tendermint_tpu.state import State
 from tendermint_tpu.state.execution import BlockExecutor
 from tendermint_tpu.types import (
@@ -215,14 +215,16 @@ class ConsensusState(BaseService):
             for p in pending:
                 p.cancel()
             try:
+                # .result() below is non-blocking: asyncio.wait just
+                # reported these futures done
                 if internal_get in done:
-                    mi = internal_get.result()
+                    mi = internal_get.result()  # tmlint: disable=TM101
                     self.wal.write_sync(mi)  # our own msgs: fsync (:635)
                     await self.handle_msg(mi)
                 if peer_get in done:
-                    await self._handle_peer_batch(peer_get.result())
+                    await self._handle_peer_batch(peer_get.result())  # tmlint: disable=TM101
                 if tock_get in done:
-                    ti = tock_get.result()
+                    ti = tock_get.result()  # tmlint: disable=TM101
                     self.wal.write(
                         WALTimeoutInfo(ti.duration, ti.height, ti.round, int(ti.step))
                     )
@@ -714,7 +716,11 @@ class ConsensusState(BaseService):
         self._trace_step()
         self.event_switch.fire_event("new_round_step", self.rs)
         if self.event_bus:
-            asyncio.ensure_future(self.event_bus.publish_new_round_step(rsd))
+            spawn_logged(
+                self.event_bus.publish_new_round_step(rsd),
+                logger=self.log,
+                name="event-bus-new-round-step",
+            )
 
     # ------------------------------------------------------------------
     # timeline tracing (libs/trace): one root span per height, one child

@@ -135,7 +135,9 @@ class WAL:
         self.group = Group(path, head_size_limit=head_size_limit)
 
     def write(self, msg) -> None:
-        self.group.write(encode_frame(TimedWALMessage(time.time_ns(), msg)))
+        # WAL timestamps are operator-facing replay metadata, never hashed
+        # or compared across replicas — wall time is the point here
+        self.group.write(encode_frame(TimedWALMessage(time.time_ns(), msg)))  # tmlint: disable=TM201
 
     def write_sync(self, msg) -> None:
         self.write(msg)

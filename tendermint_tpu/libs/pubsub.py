@@ -171,7 +171,8 @@ class Subscription:
         for p in pending:
             p.cancel()
         if get in done:
-            return get.result()
+            # non-blocking: asyncio.wait just reported it done
+            return get.result()  # tmlint: disable=TM101
         raise SubscriptionCancelled(self.cancel_reason or "cancelled")
 
     def try_next(self) -> Message | None:

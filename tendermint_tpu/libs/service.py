@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import traceback
 
 
 # When stop() is called from one of the service's own tasks, the caller's
@@ -21,6 +22,56 @@ import logging
 # mid-cleanup would re-strand the peer — a continuation still running
 # after this long is watchdog territory, not normal slowness.
 SELF_STOP_GRACE = 30.0
+
+
+def _log_task_exception(task: asyncio.Task, logger=None) -> None:
+    """Done-callback: surface exceptions from background tasks.
+
+    Accepts both stdlib ``logging.Logger`` and libs.log ``Logger`` (the
+    message is pre-formatted, so ``.error(msg)`` works on either).
+    Cancellation is the normal shutdown path and is not logged.
+    """
+    if task.cancelled():
+        return
+    exc = task.exception()
+    if exc is None:
+        return
+    # full traceback, not just repr: this replaces asyncio's GC-time
+    # "Task exception was never retrieved" report, which included one
+    tb = "".join(traceback.format_exception(type(exc), exc, exc.__traceback__))
+    msg = f"background task {task.get_name()!r} crashed: {exc!r}\n{tb}"
+    try:
+        (logger or logging.getLogger("service")).error(msg)
+    except Exception:  # noqa: BLE001 — logging must never re-raise here
+        logging.getLogger("service").error(msg)
+
+
+# Strong refs to in-flight spawn_logged tasks: the event loop holds only
+# weak references, and a done-callback stored ON the task is not an
+# external root — without this set a discarded handle is still
+# collectible mid-flight (the asyncio-docs background-task pattern).
+_BACKGROUND_TASKS: set[asyncio.Task] = set()
+
+
+def spawn_logged(coro, *, logger=None, name: str | None = None) -> asyncio.Task:
+    """`asyncio.create_task` that never drops an exception silently.
+
+    The tmlint TM102 remedy: fire-and-forget `ensure_future` keeps no
+    reference (the loop may GC the task mid-flight) and its exception
+    is reported only at GC time, if ever. This pins the task in a
+    module-level set until done and logs any crash. The task is
+    returned, so callers that *do* await it still can — the callback's
+    ``exception()`` read doesn't interfere with ``await``.
+    """
+    task = asyncio.create_task(coro, name=name)
+    _BACKGROUND_TASKS.add(task)
+
+    def _done(t: asyncio.Task) -> None:
+        _BACKGROUND_TASKS.discard(t)
+        _log_task_exception(t, logger)
+
+    task.add_done_callback(_done)
+    return task
 
 
 class AlreadyStarted(Exception):
@@ -111,7 +162,7 @@ class BaseService:
     def spawn(self, coro, name: str | None = None) -> asyncio.Task:
         """Track a background task; cancelled automatically on stop
         (the analog of a goroutine tied to the service's quit channel)."""
-        task = asyncio.create_task(coro, name=name or self.name)
+        task = spawn_logged(coro, logger=self.logger, name=name or self.name)
         self._tasks.append(task)
         self._tasks = [t for t in self._tasks if not t.done()]
         return task

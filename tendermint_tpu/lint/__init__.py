@@ -1,0 +1,39 @@
+"""tmlint — consensus-aware static analysis for the tendermint_tpu tree.
+
+The hot path's correctness story (deterministic consensus, non-blocking
+event loop, bounded jit recompilation) rests on invariants that ordinary
+linters don't know about. tmlint is an AST pass with four rule families:
+
+- TM1xx  async hygiene: blocking calls / fire-and-forget tasks /
+         awaits under a threading lock inside ``async def``
+- TM2xx  consensus determinism: wall-clock reads, shared unseeded
+         ``random``, set-ordered iteration feeding hashing
+- TM3xx  JAX tracing hygiene in ops/ and crypto/batch.py: Python
+         branches on tracers, host syncs, concrete shapes from tracers
+- TM4xx  service lifecycle: threads neither daemon nor joined
+
+Run it with ``python -m tendermint_tpu.lint``; see docs/lint.md for the
+rule catalogue, suppression syntax and the baseline ratchet.
+"""
+from tendermint_tpu.lint.config import LintConfig, load_config
+from tendermint_tpu.lint.engine import (
+    all_rules,
+    lint_paths,
+    lint_source,
+)
+from tendermint_tpu.lint.findings import (
+    Baseline,
+    Finding,
+    suppressed_codes,
+)
+
+__all__ = [
+    "Baseline",
+    "Finding",
+    "LintConfig",
+    "all_rules",
+    "lint_paths",
+    "lint_source",
+    "load_config",
+    "suppressed_codes",
+]

@@ -1,0 +1,84 @@
+"""CLI: ``python -m tendermint_tpu.lint [options] [paths...]``.
+
+Exit codes: 0 — clean (every finding baselined or suppressed),
+1 — new findings, 2 — usage/config error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from tendermint_tpu.lint.config import load_config
+from tendermint_tpu.lint.engine import all_rules, lint_paths
+from tendermint_tpu.lint.findings import JSON_SCHEMA_VERSION, Baseline
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tendermint_tpu.lint",
+        description="consensus-aware static analysis (see docs/lint.md)",
+    )
+    ap.add_argument("paths", nargs="*", help="files/dirs (default: [tool.tmlint] paths)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--root", default=".", help="repo root (pyproject + baseline live here)")
+    ap.add_argument("--baseline", default=None, help="baseline file (default from config)")
+    ap.add_argument("--no-baseline", action="store_true", help="report grandfathered findings too")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from the current findings and exit 0")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    root = Path(args.root).resolve()
+    if not root.is_dir():
+        print(f"error: --root {args.root} is not a directory", file=sys.stderr)
+        return 2
+    config = load_config(root)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.code}  {rule.name}\n    {rule.help}")
+        return 0
+
+    baseline_path = Path(args.baseline) if args.baseline else root / config.baseline
+    baseline = Baseline() if args.no_baseline else Baseline.load(baseline_path)
+
+    findings = lint_paths(
+        paths=args.paths or None, root=root, config=config, baseline=baseline
+    )
+    new = [f for f in findings if not f.baselined]
+
+    if args.write_baseline:
+        Baseline.from_findings(findings).save(baseline_path)
+        print(f"wrote {len(findings)} finding(s) to {baseline_path}")
+        return 0
+
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "version": JSON_SCHEMA_VERSION,
+                    "findings": [f.to_json() for f in findings],
+                    "new": len(new),
+                    "baselined": len(findings) - len(new),
+                },
+                indent=1,
+            )
+        )
+    else:
+        for f in new:
+            print(f.render())
+        n_base = len(findings) - len(new)
+        print(
+            f"tmlint: {len(new)} new finding(s), {n_base} baselined"
+            + ("" if new else " — clean")
+        )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # e.g. `... | head`
+        sys.exit(0)

@@ -1,0 +1,171 @@
+"""tmlint configuration: the ``[tool.tmlint]`` block in pyproject.toml.
+
+The container's Python is 3.10 (no stdlib tomllib), so when tomllib is
+absent this falls back to a deliberately tiny reader that understands
+exactly the subset tmlint's own block uses: one ``[tool.tmlint]`` table
+of ``key = value`` lines where value is a string, bool, int, or a
+single-line array of strings. Anything fancier belongs in real TOML
+territory — keep the block simple.
+"""
+from __future__ import annotations
+
+import ast
+import sys
+from dataclasses import dataclass, field
+from pathlib import Path
+
+try:  # 3.11+
+    import tomllib  # noqa: F401
+except ImportError:
+    tomllib = None
+
+# Paths whose code feeds block hashes / canonical encodings / the
+# consensus state machine: wall-clock reads and unseeded randomness
+# here diverge replicas (TM2xx).
+DEFAULT_DETERMINISM_PATHS = (
+    "tendermint_tpu/consensus",
+    "tendermint_tpu/state",
+    "tendermint_tpu/types",
+    "tendermint_tpu/crypto/merkle.py",
+    "tendermint_tpu/encoding.py",
+)
+# Paths holding jitted kernels where tracing hygiene matters (TM3xx).
+DEFAULT_JAX_PATHS = (
+    "tendermint_tpu/ops",
+    "tendermint_tpu/crypto/batch.py",
+)
+
+
+@dataclass
+class LintConfig:
+    paths: list[str] = field(default_factory=lambda: ["tendermint_tpu"])
+    exclude: list[str] = field(
+        default_factory=lambda: ["__pycache__", ".git", ".venv", "node_modules"]
+    )
+    baseline: str = "tmlint_baseline.json"
+    disable: list[str] = field(default_factory=list)  # rule codes off globally
+    determinism_paths: list[str] = field(
+        default_factory=lambda: list(DEFAULT_DETERMINISM_PATHS)
+    )
+    jax_paths: list[str] = field(default_factory=lambda: list(DEFAULT_JAX_PATHS))
+
+    def in_determinism_scope(self, rel_path: str) -> bool:
+        return _in_scope(rel_path, self.determinism_paths)
+
+    def in_jax_scope(self, rel_path: str) -> bool:
+        return _in_scope(rel_path, self.jax_paths)
+
+
+def _in_scope(rel_path: str, prefixes: list[str]) -> bool:
+    rel = rel_path.replace("\\", "/")
+    for p in prefixes:
+        p = p.rstrip("/")
+        if rel == p or rel.startswith(p + "/"):
+            return True
+    return False
+
+
+_KEY_MAP = {
+    "paths": "paths",
+    "exclude": "exclude",
+    "baseline": "baseline",
+    "disable": "disable",
+    "determinism-paths": "determinism_paths",
+    "determinism_paths": "determinism_paths",
+    "jax-paths": "jax_paths",
+    "jax_paths": "jax_paths",
+}
+
+
+def _strip_trailing_comment(val: str) -> str:
+    """Drop a trailing comment outside quotes/brackets (good enough for
+    the flat values this table allows)."""
+    if "#" not in val or val.startswith(("'", '"')):
+        return val
+    depth = 0
+    in_str: str | None = None
+    for i, ch in enumerate(val):
+        if in_str is not None:
+            if ch == in_str:
+                in_str = None
+        elif ch in "'\"":
+            in_str = ch
+        elif ch in "[(":
+            depth += 1
+        elif ch in ")]":
+            depth -= 1
+        elif ch == "#" and depth == 0:
+            return val[:i].strip()
+    return val
+
+
+def _mini_toml_table(text: str, table: str) -> dict:
+    """Parse one [table] of key = value lines (3.10 fallback).
+
+    Values may be strings, bools, ints, or arrays of strings — arrays
+    may span lines (continuation until brackets balance). A value this
+    reader cannot parse is reported on stderr rather than silently
+    dropped: the CI gate pins 3.10, so THIS is the enforcing parser and
+    a swallowed `paths` key would quietly shrink the lint scope.
+    """
+    out: dict = {}
+    in_table = False
+    lines = text.splitlines()
+    i = 0
+    while i < len(lines):
+        line = lines[i].strip()
+        i += 1
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("["):
+            in_table = line == f"[{table}]"
+            continue
+        if not in_table or "=" not in line:
+            continue
+        key, _, val = line.partition("=")
+        key, val = key.strip(), _strip_trailing_comment(val.strip())
+        # multi-line array: accumulate until brackets balance
+        while val.count("[") > val.count("]") and i < len(lines):
+            nxt = _strip_trailing_comment(lines[i].strip())
+            i += 1
+            val += " " + nxt
+        if val in ("true", "false"):
+            out[key] = val == "true"
+            continue
+        try:
+            out[key] = ast.literal_eval(val)
+        except (ValueError, SyntaxError):
+            print(
+                f"tmlint: warning: [{table}] {key} = {val!r} is not in the "
+                "supported TOML subset (string/bool/int/array-of-strings); "
+                "key ignored, defaults apply",
+                file=sys.stderr,
+            )
+    return out
+
+
+def load_config(root: str | Path = ".") -> LintConfig:
+    cfg = LintConfig()
+    pyproject = Path(root) / "pyproject.toml"
+    if not pyproject.exists():
+        return cfg
+    text = pyproject.read_text(encoding="utf-8")
+    if tomllib is not None:
+        doc = tomllib.loads(text)
+        table = doc.get("tool", {}).get("tmlint", {})
+    else:
+        table = _mini_toml_table(text, "tool.tmlint")
+    for toml_key, attr in _KEY_MAP.items():
+        if toml_key in table:
+            val = table[toml_key]
+            if isinstance(getattr(cfg, attr), list):
+                # a bare string is a one-element list, never assigned
+                # as-is (iterating a str linted per-character: CI would
+                # go green having scanned zero files)
+                if isinstance(val, (list, tuple)):
+                    setattr(cfg, attr, [str(v) for v in val])
+                elif isinstance(val, str):
+                    setattr(cfg, attr, [val])
+            elif isinstance(val, str):
+                setattr(cfg, attr, val)
+    return cfg

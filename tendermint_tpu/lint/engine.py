@@ -1,0 +1,305 @@
+"""tmlint engine: one AST pass per file, rules subscribe to node types.
+
+A rule is a class with a ``code``/``name``/``help`` and any number of
+``visit_<NodeType>(ctx, node)`` handlers; the engine walks each module
+tree exactly once and fans every node out to the handlers registered
+for its type, so adding a rule never adds a pass. The shared
+:class:`Context` tracks what most rules need positionally — the
+enclosing function stack (sync/async), whether that function is jitted
+and which of its parameters are static — so rules stay ~30 lines.
+
+``visit_Module`` handlers run first and may do their own sub-walk; the
+lifecycle rule (TM401) uses that for its two-phase
+"created here, joined there?" analysis.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from tendermint_tpu.lint.config import LintConfig
+from tendermint_tpu.lint.findings import Baseline, Finding, is_suppressed
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """`a.b.c` for Name/Attribute chains, None for anything dynamic."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def attr_tail(node: ast.AST) -> str | None:
+    """The final attribute of a call target: `x.y.result` -> "result"."""
+    return node.attr if isinstance(node, ast.Attribute) else None
+
+
+# --- jit decorator analysis -------------------------------------------------
+
+_JIT_NAMES = {"jit", "jax.jit", "pjit", "jax.pjit"}
+_PARTIAL_NAMES = {"partial", "functools.partial"}
+
+
+def jit_static_names(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> set[str] | None:
+    """None if the function is not jitted, else its static parameter names.
+
+    Handles ``@jax.jit``, ``@jit``, ``@jax.jit(static_argnames=...)``,
+    and ``@partial(jax.jit, static_argnames=..., static_argnums=...)``.
+    """
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    for dec in fn.decorator_list:
+        call = None
+        if isinstance(dec, ast.Call):
+            target = dotted_name(dec.func)
+            if target in _JIT_NAMES:
+                call = dec
+            elif target in _PARTIAL_NAMES and dec.args:
+                if dotted_name(dec.args[0]) in _JIT_NAMES:
+                    call = dec
+            if call is None:
+                continue
+        elif dotted_name(dec) in _JIT_NAMES:
+            return set()
+        else:
+            continue
+        static: set[str] = set()
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                static |= _str_elements(kw.value)
+            elif kw.arg == "static_argnums":
+                for i in _int_elements(kw.value):
+                    if 0 <= i < len(params):
+                        static.add(params[i])
+        return static
+    return None
+
+
+def _str_elements(node: ast.AST) -> set[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return {
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        }
+    return set()
+
+
+def _int_elements(node: ast.AST) -> list[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, int)
+        ]
+    return []
+
+
+# --- context ----------------------------------------------------------------
+
+
+@dataclass
+class FuncInfo:
+    node: ast.FunctionDef | ast.AsyncFunctionDef
+    is_async: bool
+    params: set[str]
+    jit_static: set[str] | None  # None = not jitted
+
+
+@dataclass
+class Context:
+    rel_path: str
+    config: LintConfig
+    lines: list[str]
+    findings: list[Finding] = field(default_factory=list)
+    func_stack: list[FuncInfo] = field(default_factory=list)
+    node_stack: list[ast.AST] = field(default_factory=list)  # ancestors
+
+    @property
+    def parent(self) -> ast.AST | None:
+        """Parent of the node currently being dispatched (rules use it
+        e.g. to tell `await q.join()` from a bare blocking `t.join()`)."""
+        return self.node_stack[-1] if self.node_stack else None
+
+    @property
+    def in_async(self) -> bool:
+        return bool(self.func_stack) and self.func_stack[-1].is_async
+
+    @property
+    def jit_func(self) -> FuncInfo | None:
+        """Innermost enclosing jitted function (nested defs are traced too)."""
+        for fi in reversed(self.func_stack):
+            if fi.jit_static is not None:
+                return fi
+        return None
+
+    def report(self, code: str, node: ast.AST, message: str, hint: str = "") -> None:
+        self.findings.append(
+            Finding(
+                code=code,
+                path=self.rel_path,
+                line=getattr(node, "lineno", 1),
+                col=getattr(node, "col_offset", 0),
+                message=message,
+                hint=hint,
+            )
+        )
+
+
+class Rule:
+    """Base class; subclasses define visit_<NodeType>(ctx, node) handlers."""
+
+    code = "TM000"
+    name = ""
+    help = ""
+
+
+def all_rules() -> list[Rule]:
+    # imported here, not at module top: the rule modules import engine
+    from tendermint_tpu.lint import (  # noqa: F401
+        rules_async,
+        rules_determinism,
+        rules_jax,
+        rules_lifecycle,
+    )
+
+    rules: list[Rule] = []
+    for mod in (rules_async, rules_determinism, rules_jax, rules_lifecycle):
+        rules.extend(r() for r in mod.RULES)
+    return rules
+
+
+# --- the single pass --------------------------------------------------------
+
+
+class _Walker(ast.NodeVisitor):
+    def __init__(self, ctx: Context, rules: list[Rule]):
+        self.ctx = ctx
+        self.dispatch: dict[str, list] = {}
+        for rule in rules:
+            for name in dir(rule):
+                if name.startswith("visit_"):
+                    self.dispatch.setdefault(name[6:], []).append(
+                        getattr(rule, name)
+                    )
+
+    def visit(self, node: ast.AST) -> None:
+        for handler in self.dispatch.get(type(node).__name__, ()):
+            handler(self.ctx, node)
+        self.ctx.node_stack.append(node)
+        try:
+            self._descend(node)
+        finally:
+            self.ctx.node_stack.pop()
+
+    def _descend(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            args = node.args
+            params = {
+                a.arg
+                for a in (
+                    args.posonlyargs + args.args + args.kwonlyargs
+                    + ([args.vararg] if args.vararg else [])
+                    + ([args.kwarg] if args.kwarg else [])
+                )
+            }
+            self.ctx.func_stack.append(
+                FuncInfo(
+                    node=node,
+                    is_async=isinstance(node, ast.AsyncFunctionDef),
+                    params=params,
+                    jit_static=jit_static_names(node),
+                )
+            )
+            try:
+                self.generic_visit(node)
+            finally:
+                self.ctx.func_stack.pop()
+        else:
+            self.generic_visit(node)
+
+
+def lint_source(
+    source: str,
+    rel_path: str,
+    config: LintConfig | None = None,
+    rules: list[Rule] | None = None,
+) -> list[Finding]:
+    """Lint one module's source. Suppressions applied, baseline not."""
+    config = config or LintConfig()
+    rules = rules if rules is not None else all_rules()
+    rules = [r for r in rules if r.code not in config.disable]
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [
+            Finding(
+                code="TM001",
+                path=rel_path,
+                line=e.lineno or 1,
+                col=e.offset or 0,
+                message=f"syntax error: {e.msg}",
+            )
+        ]
+    lines = source.splitlines()
+    ctx = Context(rel_path=rel_path, config=config, lines=lines)
+    _Walker(ctx, rules).visit(tree)
+    out = [f for f in ctx.findings if not is_suppressed(f, lines)]
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return out
+
+
+def iter_py_files(paths: list[str], root: Path, exclude: list[str]):
+    """Yield .py files under `paths`, skipping excluded directory names
+    (notably __pycache__) and hidden directories."""
+    excluded = set(exclude)
+    for p in paths:
+        path = (root / p) if not Path(p).is_absolute() else Path(p)
+        if path.is_file():
+            if path.suffix == ".py":
+                yield path
+            continue
+        if not path.is_dir():
+            continue
+        for f in sorted(path.rglob("*.py")):
+            parts = f.relative_to(path).parts
+            if any(part in excluded or part.startswith(".") for part in parts[:-1]):
+                continue
+            yield f
+
+
+def lint_paths(
+    paths: list[str] | None = None,
+    root: str | Path = ".",
+    config: LintConfig | None = None,
+    baseline: Baseline | None = None,
+    rules: list[Rule] | None = None,
+) -> list[Finding]:
+    """Lint a tree. Findings present in `baseline` come back with
+    ``baselined=True`` (the CLI/gate ignores them); new ones are live."""
+    root = Path(root).resolve()
+    config = config or LintConfig()
+    paths = paths or config.paths
+    baseline = baseline or Baseline()
+    rules = rules if rules is not None else all_rules()
+    findings: list[Finding] = []
+    for f in iter_py_files(paths, root, config.exclude):
+        try:
+            rel = f.resolve().relative_to(root).as_posix()
+        except ValueError:
+            rel = f.as_posix()
+        source = f.read_text(encoding="utf-8")
+        for finding in lint_source(source, rel, config, rules):
+            if finding in baseline:
+                finding = dataclasses.replace(finding, baselined=True)
+            findings.append(finding)
+    return findings

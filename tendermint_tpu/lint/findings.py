@@ -1,0 +1,122 @@
+"""Finding model, inline suppressions, and the baseline ratchet.
+
+A finding is (code, path, line, message, hint). Two escape hatches keep
+the gate green without losing the signal:
+
+- inline: ``# tmlint: disable=TM101`` (comma-separated codes, or
+  ``all``) on the flagged line suppresses it forever — for sites a
+  human has judged safe (e.g. ``.result()`` on a future that
+  ``asyncio.wait`` just reported done).
+- baseline: a committed JSON file of grandfathered findings. The gate
+  fails only on findings NOT in the baseline, so new violations are
+  blocked while old ones ratchet down as they're fixed.
+
+Baseline entries match on (code, path, line). Line drift from unrelated
+edits shows up as one "new" + one "stale" entry — regenerate with
+``python -m tendermint_tpu.lint --write-baseline`` after verifying the
+new finding is the old one moved, not a regression.
+"""
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import asdict, dataclass, field
+from pathlib import Path
+
+_SUPPRESS_RE = re.compile(r"#\s*tmlint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+JSON_SCHEMA_VERSION = 1
+
+
+@dataclass(frozen=True)
+class Finding:
+    code: str  # e.g. "TM101"
+    path: str  # repo-relative posix path
+    line: int  # 1-based
+    col: int  # 0-based
+    message: str
+    hint: str = ""  # how to fix (or suppress) it
+    baselined: bool = field(default=False, compare=False)
+
+    @property
+    def key(self) -> tuple[str, str, int]:
+        return (self.code, self.path, self.line)
+
+    def render(self) -> str:
+        tag = " [baselined]" if self.baselined else ""
+        out = f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}{tag}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+    def to_json(self) -> dict:
+        d = asdict(self)
+        del d["baselined"]
+        d["baselined"] = self.baselined  # stable key order: flags last
+        return d
+
+
+def suppressed_codes(source_line: str) -> set[str] | None:
+    """Codes disabled by an inline comment on this line.
+
+    Returns None when there is no tmlint comment, the set of codes
+    otherwise ({"all"} disables every rule on the line).
+    """
+    m = _SUPPRESS_RE.search(source_line)
+    if m is None:
+        return None
+    return {c.strip().upper() if c.strip() != "all" else "all"
+            for c in m.group(1).split(",") if c.strip()}
+
+
+def is_suppressed(finding: Finding, lines: list[str]) -> bool:
+    if not (1 <= finding.line <= len(lines)):
+        return False
+    codes = suppressed_codes(lines[finding.line - 1])
+    if codes is None:
+        return False
+    return "all" in codes or finding.code in codes
+
+
+class Baseline:
+    """Committed set of grandfathered findings."""
+
+    def __init__(self, entries: set[tuple[str, str, int]] | None = None):
+        self.entries = entries or set()
+
+    def __contains__(self, finding: Finding) -> bool:
+        return finding.key in self.entries
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def codes(self) -> set[str]:
+        return {code for code, _, _ in self.entries}
+
+    @classmethod
+    def load(cls, path: str | Path) -> "Baseline":
+        p = Path(path)
+        if not p.exists():
+            return cls()
+        doc = json.loads(p.read_text(encoding="utf-8"))
+        entries = {
+            (e["code"], e["path"], int(e["line"]))
+            for e in doc.get("findings", [])
+        }
+        return cls(entries)
+
+    @classmethod
+    def from_findings(cls, findings: list[Finding]) -> "Baseline":
+        return cls({f.key for f in findings})
+
+    def save(self, path: str | Path) -> None:
+        doc = {
+            "version": JSON_SCHEMA_VERSION,
+            "findings": [
+                {"code": c, "path": p, "line": n}
+                for c, p, n in sorted(self.entries)
+            ],
+        }
+        Path(path).write_text(
+            json.dumps(doc, indent=1) + "\n", encoding="utf-8"
+        )

@@ -1,0 +1,191 @@
+"""TM1xx — async hygiene.
+
+The consensus hot path is a single event loop; one blocking call in an
+``async def`` stalls every height/round timer and peer connection at
+once, and a fire-and-forget task is a place where exceptions vanish
+(the proposer silently stops proposing and nothing logs why).
+"""
+from __future__ import annotations
+
+import ast
+
+from tendermint_tpu.lint.engine import Context, Rule, attr_tail, dotted_name
+
+# Call targets that block the thread. Matched against the full dotted
+# name (`time.sleep`) so `asyncio.sleep` never trips it.
+BLOCKING_DOTTED = {
+    "time.sleep": "use `await asyncio.sleep(...)`",
+    "subprocess.run": "use `await asyncio.create_subprocess_exec(...)`",
+    "subprocess.call": "use `await asyncio.create_subprocess_exec(...)`",
+    "subprocess.check_call": "use `await asyncio.create_subprocess_exec(...)`",
+    "subprocess.check_output": "use `await asyncio.create_subprocess_exec(...)`",
+    "subprocess.getoutput": "use `await asyncio.create_subprocess_exec(...)`",
+    "socket.create_connection": "use `await asyncio.open_connection(...)`",
+    "socket.getaddrinfo": "use `await loop.getaddrinfo(...)`",
+    "urllib.request.urlopen": "move to a thread: `await asyncio.to_thread(...)`",
+    "os.system": "use `await asyncio.create_subprocess_shell(...)`",
+}
+
+# Method tails that block regardless of receiver type. `.result()` on a
+# concurrent Future blocks the loop; on an asyncio Future it's only
+# valid after done() — suppress inline where a wait() just proved that.
+BLOCKING_TAILS = {
+    "block_until_ready": "host-syncs the device; await the fetch helper "
+    "or move off the loop",
+    "result": "blocks (concurrent Future) or raises (asyncio, pre-done); "
+    "await the future instead",
+}
+
+SPAWN_NAMES = {
+    "asyncio.create_task",
+    "asyncio.ensure_future",
+    "create_task",
+    "ensure_future",
+}
+
+
+def _is_blocking_wait_call(node: ast.Call) -> bool:
+    """No args, a lone `timeout=` kwarg, or a lone numeric positional —
+    the wait-call signatures of Future.result / Thread.join /
+    block_until_ready. `.result(timeout=30)` blocks the loop for up to
+    30s just like the bare form; `",".join(parts)` (non-numeric arg)
+    does not match."""
+    if not node.args and not node.keywords:
+        return True
+    if len(node.args) + len(node.keywords) != 1:
+        return False
+    if node.keywords:
+        return node.keywords[0].arg == "timeout"
+    arg = node.args[0]
+    return isinstance(arg, ast.Constant) and isinstance(arg.value, (int, float))
+
+
+class TM101BlockingCallInAsync(Rule):
+    code = "TM101"
+    name = "blocking-call-in-async"
+    help = (
+        "A blocking call inside `async def` stalls the whole event loop — "
+        "consensus timers, peer IO, RPC — for its full duration."
+    )
+
+    def visit_Call(self, ctx: Context, node: ast.Call) -> None:
+        if not ctx.in_async:
+            return
+        dotted = dotted_name(node.func)
+        if dotted in BLOCKING_DOTTED:
+            ctx.report(
+                self.code,
+                node,
+                f"blocking call `{dotted}(...)` inside async def",
+                BLOCKING_DOTTED[dotted],
+            )
+            return
+        tail = attr_tail(node.func)
+        if isinstance(ctx.parent, ast.Await):
+            # `await q.join()` / awaited wrappers: yields to the loop by
+            # definition — the opposite of the stall this rule catches
+            return
+        if tail in BLOCKING_TAILS and _is_blocking_wait_call(node):
+            ctx.report(
+                self.code,
+                node,
+                f"blocking call `.{tail}(...)` inside async def",
+                BLOCKING_TAILS[tail],
+            )
+        elif tail == "join" and _is_blocking_wait_call(node):
+            # a no-arg/timeout-only .join() is a thread/process join
+            # (str.join always takes the iterable); joining inside
+            # async blocks the loop — timeout or not
+            ctx.report(
+                self.code,
+                node,
+                "blocking `.join(...)` inside async def",
+                "use `await asyncio.to_thread(t.join)` or restructure",
+            )
+
+
+class TM102FireAndForgetTask(Rule):
+    code = "TM102"
+    name = "fire-and-forget-task"
+    help = (
+        "A task whose handle is discarded keeps no reference (the loop may "
+        "GC it mid-flight) and its exception is silently dropped at GC time."
+    )
+
+    def visit_Expr(self, ctx: Context, node: ast.Expr) -> None:
+        call = node.value
+        if not isinstance(call, ast.Call):
+            return
+        dotted = dotted_name(call.func)
+        # any receiver counts: asyncio.create_task, loop.create_task,
+        # self._loop.create_task, getattr(...)-style dynamic receivers
+        if dotted in SPAWN_NAMES or attr_tail(call.func) in (
+            "create_task",
+            "ensure_future",
+        ):
+            what = dotted or f".{attr_tail(call.func)}"
+            ctx.report(
+                self.code,
+                node,
+                f"fire-and-forget `{what}(...)`: result discarded, "
+                "exceptions vanish",
+                "route through libs.service.spawn_logged (keeps the handle, "
+                "logs the exception) or keep the task and await it",
+            )
+
+
+_LOCKISH = ("lock", "mutex")
+
+
+def _find_await(node: ast.AST) -> ast.Await | None:
+    """First Await in this subtree, pruning deferred bodies (nested
+    defs/lambdas run later, not under the lock)."""
+    if isinstance(node, ast.Await):
+        return node
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        found = _find_await(child)
+        if found is not None:
+            return found
+    return None
+
+
+def _is_threading_lock_expr(expr: ast.AST) -> bool:
+    dotted = dotted_name(expr)
+    if dotted is None:
+        return False
+    tail = dotted.rsplit(".", 1)[-1].lower()
+    return any(s in tail for s in _LOCKISH)
+
+
+class TM103AwaitUnderThreadLock(Rule):
+    code = "TM103"
+    name = "await-under-thread-lock"
+    help = (
+        "`await` while holding a threading.Lock parks the coroutine with "
+        "the lock held; any thread (or the loop itself via an executor "
+        "callback) that wants the lock then deadlocks the process."
+    )
+
+    def visit_With(self, ctx: Context, node: ast.With) -> None:
+        # sync `with` only — asyncio.Lock supports only `async with`, so a
+        # sync with-statement on a lock-named object is a threading lock
+        if not ctx.in_async:
+            return
+        if not any(_is_threading_lock_expr(i.context_expr) for i in node.items):
+            return
+        for child in node.body:
+            sub = _find_await(child)
+            if sub is not None:
+                ctx.report(
+                    self.code,
+                    sub,
+                    "await while holding a threading lock",
+                    "shrink the critical section to pure-sync code, or "
+                    "switch to asyncio.Lock if only the loop contends",
+                )
+                return
+
+
+RULES = [TM101BlockingCallInAsync, TM102FireAndForgetTask, TM103AwaitUnderThreadLock]

@@ -1,0 +1,171 @@
+"""TM2xx — consensus determinism.
+
+Replicas must compute byte-identical state from the same block stream.
+Wall-clock reads, process-global randomness, and set-ordered iteration
+are the three ways Python code silently diverges across nodes (or
+across restarts of the same node). Scope is the determinism paths from
+``[tool.tmlint] determinism-paths`` — consensus/, state/, types/,
+merkle, canonical encoding — where divergence is a consensus failure,
+not a cosmetic one.
+
+Protocol fields that are *defined* as wall time (BFT time in vote
+timestamps, block Time) are the legitimate exception: suppress those
+sites inline with a comment saying so.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from tendermint_tpu.lint.engine import Context, Rule, dotted_name
+
+WALL_CLOCK_CALLS = {
+    "time.time",
+    "time.time_ns",
+    "datetime.now",
+    "datetime.utcnow",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+}
+
+# module-level functions of the shared, seed-ambient `random` RNG
+GLOBAL_RANDOM_FNS = {
+    "random",
+    "randrange",
+    "randint",
+    "randbytes",
+    "getrandbits",
+    "choice",
+    "choices",
+    "shuffle",
+    "sample",
+    "uniform",
+}
+
+# function names whose output feeds hashing / canonical bytes: set
+# iteration here changes the hash across processes (PYTHONHASHSEED)
+_HASH_CONTEXT = re.compile(
+    r"hash|merkle|digest|encode|canonical|sign_bytes|root", re.IGNORECASE
+)
+
+
+class TM201WallClockInConsensus(Rule):
+    code = "TM201"
+    name = "wall-clock-in-consensus"
+    help = (
+        "Wall time jumps (NTP slew, leap smearing) and differs across "
+        "replicas; interval math on it misfires timeouts and anything "
+        "hashed from it diverges nodes. Use time.monotonic() for "
+        "intervals and an injected clock for protocol time."
+    )
+
+    def visit_Call(self, ctx: Context, node: ast.Call) -> None:
+        if not ctx.config.in_determinism_scope(ctx.rel_path):
+            return
+        dotted = dotted_name(node.func)
+        if dotted in WALL_CLOCK_CALLS:
+            ctx.report(
+                self.code,
+                node,
+                f"wall-clock `{dotted}()` in a determinism-scoped path",
+                "use time.monotonic() for intervals, an injectable clock "
+                "for protocol timestamps; suppress inline where the field "
+                "is protocol-defined wall time (BFT time)",
+            )
+
+
+class TM202UnseededRandom(Rule):
+    code = "TM202"
+    name = "unseeded-global-random"
+    help = (
+        "The module-level `random` RNG is seeded from OS entropy per "
+        "process: any consensus-visible choice made with it differs "
+        "per replica. Use a random.Random(seed) instance derived from "
+        "deterministic state, or move the choice out of consensus scope."
+    )
+
+    def visit_Call(self, ctx: Context, node: ast.Call) -> None:
+        if not ctx.config.in_determinism_scope(ctx.rel_path):
+            return
+        dotted = dotted_name(node.func)
+        if (
+            dotted is not None
+            and dotted.startswith("random.")
+            and dotted.split(".", 1)[1] in GLOBAL_RANDOM_FNS
+        ):
+            ctx.report(
+                self.code,
+                node,
+                f"process-global `{dotted}(...)` in a determinism-scoped path",
+                "inject a seeded random.Random (or derive the choice from "
+                "block state)",
+            )
+
+
+def _set_like(expr: ast.AST) -> str | None:
+    if isinstance(expr, ast.Set):
+        return "set literal"
+    if isinstance(expr, ast.Call):
+        dotted = dotted_name(expr.func)
+        if dotted in ("set", "frozenset"):
+            return f"{dotted}(...)"
+    return None
+
+
+def _dict_view(expr: ast.AST) -> str | None:
+    if (
+        isinstance(expr, ast.Call)
+        and isinstance(expr.func, ast.Attribute)
+        and expr.func.attr in ("keys", "values", "items")
+        and not expr.args
+    ):
+        return f".{expr.func.attr}()"
+    return None
+
+
+class TM203UnorderedIterFeedsHash(Rule):
+    code = "TM203"
+    name = "unordered-iteration-feeds-hash"
+    help = (
+        "Set iteration order depends on PYTHONHASHSEED — two replicas "
+        "hashing the 'same' set produce different canonical bytes. Sort "
+        "before hashing. Dict views are insertion-ordered, which is only "
+        "deterministic if every replica inserted in the same order; "
+        "inside hash/encode functions that assumption must be explicit."
+    )
+
+    def visit_For(self, ctx: Context, node: ast.For) -> None:
+        self._check(ctx, node.iter)
+
+    def visit_comprehension(self, ctx: Context, node: ast.comprehension) -> None:
+        self._check(ctx, node.iter)
+
+    def _check(self, ctx: Context, iter_expr: ast.AST) -> None:
+        if not ctx.config.in_determinism_scope(ctx.rel_path):
+            return
+        what = _set_like(iter_expr)
+        if what is not None:
+            ctx.report(
+                self.code,
+                iter_expr,
+                f"iteration over {what} in a determinism-scoped path",
+                "wrap in sorted(...) with a total key before feeding "
+                "hashing or canonical encoding",
+            )
+            return
+        # dict views: only inside functions whose name says the output
+        # is hashed/encoded (insertion order is per-replica state)
+        if ctx.func_stack and _HASH_CONTEXT.search(ctx.func_stack[-1].node.name):
+            what = _dict_view(iter_expr)
+            if what is not None:
+                ctx.report(
+                    self.code,
+                    iter_expr,
+                    f"dict {what} iteration inside "
+                    f"`{ctx.func_stack[-1].node.name}` feeds hashing",
+                    "sort by key (or document why insertion order is "
+                    "replica-identical) before hashing",
+                )
+
+
+RULES = [TM201WallClockInConsensus, TM202UnseededRandom, TM203UnorderedIterFeedsHash]

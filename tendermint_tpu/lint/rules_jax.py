@@ -1,0 +1,192 @@
+"""TM3xx — JAX tracing hygiene (ops/ and crypto/batch.py).
+
+Inside a jitted function arguments are tracers: Python `if`/`while` on
+them either throws at trace time or — worse — bakes one branch into
+the compiled kernel; `.item()`/`float()` force a device→host sync that
+serializes the pipelined dispatch; and building shapes from traced
+values re-specializes the kernel per call, defeating the bucketed-batch
+cache that bounds compilations. Scope is ``[tool.tmlint] jax-paths``.
+
+Parameters named in ``static_argnames``/``static_argnums`` are concrete
+Python values at trace time — branching on them is the intended idiom
+and is not flagged.
+"""
+from __future__ import annotations
+
+import ast
+
+from tendermint_tpu.lint.engine import Context, FuncInfo, Rule, attr_tail, dotted_name
+
+_SHAPE_BUILDERS = {
+    "arange",
+    "zeros",
+    "ones",
+    "empty",
+    "full",
+    "eye",
+    "tri",
+    "linspace",
+}
+_ARRAY_MODULES = ("jnp", "np", "jax.numpy", "numpy")
+
+
+_STATIC_ATTRS = ("shape", "ndim", "dtype", "size")
+
+
+def _traced_names_in(ctx: Context, fi: FuncInfo, expr: ast.AST) -> set[str]:
+    """Parameter names of the jitted function referenced by `expr` that
+    are NOT static (i.e. tracers at trace time).
+
+    `x.shape` / `x.ndim` / `x.dtype` / `x.size` and `len(x)` ARE
+    trace-time constants — the recommended way to derive sizes — so
+    names reached only through those are not counted.
+    """
+    traced = fi.params - (fi.jit_static or set())
+    found: set[str] = set()
+
+    def rec(node: ast.AST) -> None:
+        if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+            return  # x.shape[...] etc: static metadata, prune the receiver
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "len"
+        ):
+            return  # len(tracer) is its static leading dim
+        if isinstance(node, ast.Name) and node.id in traced:
+            found.add(node.id)
+            return
+        for child in ast.iter_child_nodes(node):
+            rec(child)
+
+    rec(expr)
+    return found
+
+
+def _in_jax_scope(ctx: Context) -> FuncInfo | None:
+    if not ctx.config.in_jax_scope(ctx.rel_path):
+        return None
+    return ctx.jit_func
+
+
+class TM301PythonBranchOnTracer(Rule):
+    code = "TM301"
+    name = "python-branch-on-tracer"
+    help = (
+        "`if`/`while` on a traced argument inside jit either raises "
+        "ConcretizationTypeError or silently specializes the kernel on "
+        "the tracing-time value. Use jax.lax.cond/select/while_loop, or "
+        "declare the argument static."
+    )
+
+    def visit_If(self, ctx: Context, node: ast.If) -> None:
+        self._check(ctx, node, "if")
+
+    def visit_While(self, ctx: Context, node: ast.While) -> None:
+        self._check(ctx, node, "while")
+
+    def _check(self, ctx: Context, node: ast.AST, kind: str) -> None:
+        fi = _in_jax_scope(ctx)
+        if fi is None:
+            return
+        names = _traced_names_in(ctx, fi, node.test)
+        if names:
+            ctx.report(
+                self.code,
+                node,
+                f"Python `{kind}` on traced argument(s) "
+                f"{', '.join(sorted(names))} inside a jitted function",
+                "use jax.lax.cond / jnp.where / lax.while_loop, or add the "
+                "argument to static_argnames",
+            )
+
+
+class TM302HostSyncInJit(Rule):
+    code = "TM302"
+    name = "host-sync-in-jit"
+    help = (
+        "`.item()` / `float()` / `device_get` inside jit forces the value "
+        "to the host: a trace-time error at best, a per-call device sync "
+        "that stalls the dispatch pipeline at worst. Keep values on "
+        "device; convert only outside the jitted boundary."
+    )
+
+    def visit_Call(self, ctx: Context, node: ast.Call) -> None:
+        fi = _in_jax_scope(ctx)
+        if fi is None:
+            return
+        tail = attr_tail(node.func)
+        if tail in ("item", "block_until_ready") and not node.args:
+            ctx.report(
+                self.code,
+                node,
+                f"host sync `.{tail}()` inside a jitted function",
+                "return the array and convert at the call site",
+            )
+            return
+        dotted = dotted_name(node.func)
+        if dotted in ("jax.device_get", "jax.block_until_ready"):
+            ctx.report(
+                self.code,
+                node,
+                f"host sync `{dotted}(...)` inside a jitted function",
+                "fetch outside the jitted boundary",
+            )
+            return
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id in ("float", "int", "bool")
+            and len(node.args) == 1
+            and _traced_names_in(ctx, fi, node.args[0])
+        ):
+            ctx.report(
+                self.code,
+                node,
+                f"`{node.func.id}(...)` on a traced argument inside a "
+                "jitted function",
+                "keep it as an array (jnp.float32(...)/astype) or make "
+                "the argument static",
+            )
+
+
+class TM303RuntimeShapeInJit(Rule):
+    code = "TM303"
+    name = "runtime-shape-in-jit"
+    help = (
+        "Array shapes inside jit must be trace-time constants; sizing one "
+        "from a traced value either throws or re-specializes the kernel "
+        "per distinct value — exactly the recompilation storm the "
+        "bucketed-batch cache exists to prevent. Derive sizes from "
+        "static args or `x.shape`."
+    )
+
+    def visit_Call(self, ctx: Context, node: ast.Call) -> None:
+        fi = _in_jax_scope(ctx)
+        if fi is None:
+            return
+        builder = None
+        if isinstance(node.func, ast.Name) and node.func.id == "range":
+            builder = "range"
+        else:
+            dotted = dotted_name(node.func)
+            if dotted is not None and "." in dotted:
+                mod, _, fn = dotted.rpartition(".")
+                if fn in _SHAPE_BUILDERS and mod in _ARRAY_MODULES:
+                    builder = dotted
+        if builder is None:
+            return
+        names = set()
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            names |= _traced_names_in(ctx, fi, arg)
+        if names:
+            ctx.report(
+                self.code,
+                node,
+                f"`{builder}(...)` sized from traced argument(s) "
+                f"{', '.join(sorted(names))} inside a jitted function",
+                "size from static_argnames values or a .shape, and bucket "
+                "dynamic batch sizes before entering jit",
+            )
+
+
+RULES = [TM301PythonBranchOnTracer, TM302HostSyncInJit, TM303RuntimeShapeInJit]

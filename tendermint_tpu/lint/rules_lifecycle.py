@@ -1,0 +1,111 @@
+"""TM4xx — service lifecycle.
+
+A thread that is neither daemon nor joined outlives `stop()`: the
+process hangs at exit (non-daemon threads block interpreter shutdown)
+or the "stopped" service keeps mutating state from a ghost thread —
+the Python analog of the goroutine leaks Tendermint's service
+lifecycle (BaseService OnStop) exists to prevent.
+
+This is a whole-module rule: creations are collected in one walk and
+matched against every ``<target>.join(...)`` seen anywhere in the same
+module, so create-in-start / join-in-stop pairs resolve correctly.
+"""
+from __future__ import annotations
+
+import ast
+
+from tendermint_tpu.lint.engine import Context, Rule, dotted_name
+
+_THREAD_CTORS = {"threading.Thread", "Thread", "threading.Timer", "Timer"}
+
+
+def _daemon_kwarg(call: ast.Call):
+    for kw in call.keywords:
+        if kw.arg == "daemon":
+            return kw.value
+    return None
+
+
+class TM401ThreadNeitherDaemonNorJoined(Rule):
+    code = "TM401"
+    name = "thread-neither-daemon-nor-joined"
+    help = (
+        "Pass daemon=True for background workers that may die with the "
+        "process, or keep the handle and join it in stop(); anything "
+        "else leaks a ghost thread past service shutdown."
+    )
+
+    def visit_Module(self, ctx: Context, node: ast.Module) -> None:
+        # (call, every name the handle is bound to — `a = b = Thread()`
+        # is safe if EITHER a or b is joined)
+        creations: list[tuple[ast.Call, list[str]]] = []
+        joined: set[str] = set()
+        assigned_call_ids: set[int] = set()
+
+        def bind(call: ast.AST, names: list[str]) -> None:
+            if not isinstance(call, ast.Call):
+                return
+            assigned_call_ids.add(id(call))
+            if _is_thread_ctor(call):
+                creations.append((call, names))
+
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign):
+                if isinstance(sub.value, ast.Call):
+                    names = [n for n in map(dotted_name, sub.targets) if n]
+                    bind(sub.value, names)
+                elif isinstance(sub.value, (ast.Tuple, ast.List)):
+                    # self.t1, self.t2 = Thread(...), Thread(...)
+                    for tgt in sub.targets:
+                        if isinstance(tgt, (ast.Tuple, ast.List)) and len(
+                            tgt.elts
+                        ) == len(sub.value.elts):
+                            for t_el, v_el in zip(tgt.elts, sub.value.elts):
+                                name = dotted_name(t_el)
+                                bind(v_el, [name] if name else [])
+            elif isinstance(sub, ast.AnnAssign) and isinstance(sub.value, ast.Call):
+                name = dotted_name(sub.target)
+                bind(sub.value, [name] if name else [])
+            elif isinstance(sub, ast.Call):
+                if (
+                    isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "join"
+                ):
+                    recv = dotted_name(sub.func.value)
+                    if recv is not None:
+                        joined.add(recv)
+
+        # unnamed creations: `threading.Thread(...).start()` and bare
+        # expression statements — no handle, can never be joined
+        for sub in ast.walk(node):
+            if (
+                isinstance(sub, ast.Call)
+                and _is_thread_ctor(sub)
+                and id(sub) not in assigned_call_ids
+            ):
+                creations.append((sub, []))
+
+        for call, targets in creations:
+            daemon = _daemon_kwarg(call)
+            if daemon is not None:
+                if isinstance(daemon, ast.Constant) and daemon.value is False:
+                    pass  # explicit daemon=False: must be joined
+                else:
+                    continue  # daemon=True or dynamic: trusted
+            if any(t in joined for t in targets):
+                continue
+            where = f"`{targets[0]}`" if targets else "an unnamed handle"
+            ctx.report(
+                self.code,
+                call,
+                f"thread assigned to {where} is neither daemon=True nor "
+                "joined anywhere in this module",
+                self.help,
+            )
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    return dotted_name(call.func) in _THREAD_CTORS
+
+
+RULES = [TM401ThreadNeitherDaemonNorJoined]

@@ -69,11 +69,23 @@ class _KnownAddress:
     def is_old(self) -> bool:
         return self.bucket_type == BUCKET_TYPE_OLD
 
-    def is_bad(self, now: float | None = None) -> bool:
-        """Reference known_address.go:99 isBad."""
+    def is_bad(self, now: float) -> bool:
+        """Reference known_address.go:99 isBad.
+
+        `now` is REQUIRED and must come from the owning book's clock
+        (``book.now()``): timestamps here live on that injectable
+        monotonic clock, so a defaulted ``time.monotonic()`` would
+        silently compare against the wrong timeline whenever a fake
+        clock is injected.
+        """
         if self.is_old:
             return False
-        now = time.time() if now is None else now
+        if self.last_attempt == 0.0:
+            # never attempted (epoch sentinel): same verdict the
+            # wall-clock epoch-0 value used to get ("not seen in a week").
+            # Negative values are fine — a restored entry older than the
+            # process's monotonic origin — and use the normal math below.
+            return True
         if self.last_attempt > now - 60:
             return False  # attempted in the last minute
         if self.last_attempt < now - NUM_MISSING_DAYS * 86400:
@@ -112,11 +124,21 @@ class _KnownAddress:
 
 
 class AddrBook:
+    """In-memory timestamps (`last_attempt`/`last_success`) live on an
+    injectable MONOTONIC clock: backoff and staleness math must not
+    jump when NTP slews the wall clock (tmlint TM201 class of bug).
+    Wall time appears only in the persisted JSON, where it is both
+    human-readable and meaningful across restarts; save/load convert
+    between the two clocks, preserving ages."""
+
     def __init__(self, file_path: str | None = None, our_ids: set[str] | None = None,
-                 routability_strict: bool = False):
+                 routability_strict: bool = False,
+                 clock=None, wall=None):
         self.file_path = file_path
         self.our_ids = our_ids or set()
         self.routability_strict = routability_strict
+        self._clock = clock or time.monotonic  # interval/backoff math
+        self._wall = wall or time.time  # persisted, human-readable fields
         self.key = os.urandom(12).hex()  # bucket-placement key
         self._lookup: dict[str, _KnownAddress] = {}  # node_id -> entry
         self._new: list[dict[str, _KnownAddress]] = [
@@ -132,6 +154,24 @@ class AddrBook:
 
     def __len__(self) -> int:
         return self.n_new + self.n_old
+
+    # --- clocks -----------------------------------------------------------
+
+    def now(self) -> float:
+        """Current time on the book's monotonic clock (what every
+        `last_attempt`/`last_success` in memory is compared against)."""
+        return self._clock()
+
+    def _mono_to_wall(self, t: float) -> float:
+        # only exact 0.0 is the "never" sentinel — NEGATIVE monotonic
+        # values are legitimate (restored entries older than this
+        # process's clock origin) and must keep their age on save
+        return 0.0 if t == 0.0 else self._wall() - (self._clock() - t)
+
+    def _wall_to_mono(self, t: float) -> float:
+        # clamp: a stored timestamp "from the future" (clock skew across
+        # restarts) must not become newer than now on the monotonic clock
+        return 0.0 if t == 0.0 else self._clock() - max(0.0, self._wall() - t)
 
     # --- bucket placement (reference addrbook.go:731-767) ----------------
 
@@ -219,7 +259,7 @@ class AddrBook:
     def _expire_new(self, idx: int) -> None:
         """Reference addrbook.go:674 — drop a bad entry, else the oldest."""
         for ka in list(self._new[idx].values()):
-            if ka.is_bad():
+            if ka.is_bad(self._clock()):
                 self._remove_from_bucket(ka, idx)
                 return
         oldest = self._pick_oldest(self._new, idx)
@@ -269,7 +309,7 @@ class AddrBook:
             if random.randrange(2 * len(ka.buckets)) != 0:
                 return False
         else:
-            ka = _KnownAddress(addr=addr, src=src, last_attempt=time.time())
+            ka = _KnownAddress(addr=addr, src=src, last_attempt=self._clock())
         before = addr.id in self._lookup
         # bucket keyed by THIS call's reporting source (addrbook.go:640):
         # each new reporter can land the address in a different new bucket,
@@ -286,7 +326,7 @@ class AddrBook:
         ka = self._lookup.get(addr.id)
         if ka is not None:
             ka.attempts += 1
-            ka.last_attempt = time.time()
+            ka.last_attempt = self._clock()
 
     def mark_good(self, addr: NetAddress) -> None:
         """Successful connection: reset counters and promote to old
@@ -295,9 +335,9 @@ class AddrBook:
         if ka is None:
             if not addr.id or addr.id in self.our_ids or addr.port == 0:
                 return
-            ka = _KnownAddress(addr=addr, last_attempt=time.time())
+            ka = _KnownAddress(addr=addr, last_attempt=self._clock())
             self._add_to_new_bucket(ka, self._calc_new_bucket(addr, None))
-        now = time.time()
+        now = self._clock()
         ka.attempts = 0
         ka.last_attempt = now
         ka.last_success = now
@@ -390,10 +430,15 @@ class AddrBook:
         path = path or self.file_path
         if not path:
             return
-        doc = {
-            "key": self.key,
-            "addrs": [ka.to_json() for ka in self._lookup.values()],
-        }
+        addrs = []
+        for ka in self._lookup.values():
+            d = ka.to_json()
+            # persisted timestamps are wall time: readable by operators
+            # and still meaningful after a restart (monotonic isn't)
+            d["last_attempt"] = self._mono_to_wall(ka.last_attempt)
+            d["last_success"] = self._mono_to_wall(ka.last_success)
+            addrs.append(d)
+        doc = {"key": self.key, "addrs": addrs}
         tmp = path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(doc, f)
@@ -407,6 +452,10 @@ class AddrBook:
             ka = _KnownAddress.from_json(d)
             if ka.addr.id in self.our_ids:
                 continue
+            # stored wall timestamps -> this process's monotonic clock,
+            # preserving each entry's age
+            ka.last_attempt = self._wall_to_mono(ka.last_attempt)
+            ka.last_success = self._wall_to_mono(ka.last_success)
             # stored indices come from an untrusted file: out-of-range ones
             # (corruption, changed bucket-count params) are re-derived
             buckets = [

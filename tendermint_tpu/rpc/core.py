@@ -15,6 +15,7 @@ import base64
 from tendermint_tpu.abci import types as abci
 from tendermint_tpu.libs.log import NOP, Logger
 from tendermint_tpu.libs.pubsub import Query, SubscriptionCancelled
+from tendermint_tpu.libs.service import spawn_logged
 from tendermint_tpu.mempool import MempoolError, TxInCacheError
 from tendermint_tpu.rpc.jsonrpc import INTERNAL_ERROR, INVALID_PARAMS, RPCError
 from tendermint_tpu.types import events as tmevents
@@ -454,7 +455,9 @@ class Environment:
         self._async_txs.append(raw)
         if not self._async_drainer_active:
             self._async_drainer_active = True
-            asyncio.ensure_future(self._drain_async_txs())
+            spawn_logged(
+                self._drain_async_txs(), logger=self.log, name="rpc-async-tx-drain"
+            )
         # flat str/int dict: the wire layer's template fast path renders
         # it without the generic JSON encoder (jsonrpc._encode_flat_obj)
         return {"code": 0, "data": "", "log": "", "hash": tx_hash(raw).hex()}
@@ -686,7 +689,7 @@ class Environment:
             except (SubscriptionCancelled, ConnectionError, asyncio.CancelledError):
                 pass
 
-        task = asyncio.ensure_future(pump())
+        task = spawn_logged(pump(), logger=self.log, name=f"rpc-sub-pump-{subscriber}")
         ctx.on_close.append(lambda: (task.cancel(), self.event_bus.unsubscribe_all(subscriber)))
         return {}
 

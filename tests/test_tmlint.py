@@ -1,0 +1,629 @@
+"""tmlint framework tests: per-rule true-positive + clean-pass fixtures,
+inline suppressions, the baseline ratchet round-trip, JSON output
+schema, config parsing, and the CLI.
+
+Each rule gets at least one fixture proving it fires and one proving it
+stays quiet on the idiomatic alternative — the rules are heuristics, so
+these fixtures ARE the spec of what they catch.
+"""
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+from tendermint_tpu.lint import Baseline, LintConfig, lint_source, load_config
+from tendermint_tpu.lint.config import _mini_toml_table
+from tendermint_tpu.lint.engine import jit_static_names, lint_paths
+from tendermint_tpu.lint.findings import suppressed_codes
+
+REPO = Path(__file__).resolve().parent.parent
+
+# rel paths that land in each rule scope (see [tool.tmlint] in pyproject)
+ANY = "tendermint_tpu/libs/x.py"
+CONS = "tendermint_tpu/consensus/x.py"
+OPS = "tendermint_tpu/ops/x.py"
+
+
+def codes(src: str, path: str = ANY) -> list[str]:
+    return [f.code for f in lint_source(textwrap.dedent(src), path, LintConfig())]
+
+
+# --- TM101 blocking-call-in-async -----------------------------------------
+
+
+def test_tm101_fires_on_time_sleep_in_async():
+    assert codes(
+        """
+        import time
+        async def f():
+            time.sleep(1)
+        """
+    ) == ["TM101"]
+
+
+def test_tm101_fires_on_result_and_subprocess():
+    found = codes(
+        """
+        import subprocess
+        async def f(fut):
+            subprocess.run(["x"])
+            return fut.result()
+        """
+    )
+    assert found == ["TM101", "TM101"]
+
+
+def test_tm101_clean_on_sync_def_and_asyncio_sleep():
+    assert (
+        codes(
+            """
+            import asyncio, time
+            def g():
+                time.sleep(1)  # sync context: allowed
+            async def f():
+                await asyncio.sleep(1)
+            """
+        )
+        == []
+    )
+
+
+def test_tm101_zero_arg_join_flagged_str_join_not():
+    assert codes(
+        """
+        async def f(t, parts):
+            s = ",".join(parts)
+            t.join()
+        """
+    ) == ["TM101"]
+
+
+def test_tm101_awaited_join_is_not_blocking():
+    # asyncio.Queue.join / awaited wrappers yield to the loop
+    assert codes(
+        """
+        async def f(q):
+            await q.join()
+        """
+    ) == []
+
+
+def test_tm101_timeout_arg_still_blocks():
+    # .result(timeout=30) / .join(5) block the loop just like the bare
+    # forms — a timeout must not exit the gate
+    assert codes(
+        """
+        async def f(fut, t):
+            fut.result(timeout=30)
+            t.join(5)
+        """
+    ) == ["TM101", "TM101"]
+
+
+# --- TM102 fire-and-forget-task -------------------------------------------
+
+
+def test_tm102_fires_on_discarded_task():
+    assert codes(
+        """
+        import asyncio
+        async def f():
+            asyncio.ensure_future(g())
+            asyncio.create_task(g())
+        """
+    ) == ["TM102", "TM102"]
+
+
+def test_tm102_fires_on_any_receiver():
+    # loop.create_task / self._loop.ensure_future are the same bug
+    assert codes(
+        """
+        async def f(self, loop):
+            loop.create_task(g())
+            self._loop.ensure_future(g())
+        """
+    ) == ["TM102", "TM102"]
+
+
+def test_tm102_clean_when_kept_or_spawn_logged():
+    assert (
+        codes(
+            """
+            import asyncio
+            from tendermint_tpu.libs.service import spawn_logged
+            async def f():
+                t = asyncio.create_task(g())
+                spawn_logged(g(), name="bg")
+                await t
+            """
+        )
+        == []
+    )
+
+
+# --- TM103 await-under-thread-lock ----------------------------------------
+
+
+def test_tm103_fires_on_await_under_sync_lock():
+    assert codes(
+        """
+        async def f(self):
+            with self._lock:
+                await g()
+        """
+    ) == ["TM103"]
+
+
+def test_tm103_clean_on_async_lock_or_sync_body():
+    assert (
+        codes(
+            """
+            async def f(self):
+                async with self._lock:
+                    await g()
+                with self._state_lock:
+                    self.x += 1
+                with self._lock:
+                    def later():
+                        pass  # deferred body: runs after release
+            """
+        )
+        == []
+    )
+
+
+# --- TM201 wall-clock-in-consensus ----------------------------------------
+
+
+def test_tm201_fires_only_in_determinism_scope():
+    src = """
+        import time
+        def interval():
+            return time.time()
+        """
+    assert codes(src, CONS) == ["TM201"]
+    assert codes(src, ANY) == []  # out of scope
+
+
+def test_tm201_clean_on_monotonic():
+    assert (
+        codes(
+            """
+            import time
+            def interval():
+                return time.monotonic()
+            """,
+            CONS,
+        )
+        == []
+    )
+
+
+# --- TM202 unseeded-global-random -----------------------------------------
+
+
+def test_tm202_fires_on_global_random_in_scope():
+    src = """
+        import random
+        def pick(xs):
+            return random.choice(xs)
+        """
+    assert codes(src, CONS) == ["TM202"]
+    assert codes(src, ANY) == []
+
+
+def test_tm202_clean_on_seeded_instance():
+    assert (
+        codes(
+            """
+            import random
+            def pick(xs, seed):
+                rng = random.Random(seed)
+                return rng.choice(xs)
+            """,
+            CONS,
+        )
+        == []
+    )
+
+
+# --- TM203 unordered-iteration-feeds-hash ---------------------------------
+
+
+def test_tm203_fires_on_set_iteration_in_scope():
+    src = """
+        def canonical(vals):
+            out = []
+            for v in set(vals):
+                out.append(v)
+            return out
+        """
+    assert codes(src, CONS) == ["TM203"]
+    assert codes(src, ANY) == []
+
+
+def test_tm203_fires_on_dict_view_in_hash_func_only():
+    hashed = """
+        def merkle_root(m, h):
+            for v in m.values():
+                h.update(v)
+        """
+    plain = """
+        def route(m):
+            for v in m.values():
+                v.ping()
+        """
+    assert codes(hashed, CONS) == ["TM203"]
+    assert codes(plain, CONS) == []
+
+
+def test_tm203_clean_on_sorted_set():
+    assert (
+        codes(
+            """
+            def canonical(vals):
+                return [v for v in sorted(set(vals))]
+            """,
+            CONS,
+        )
+        == []
+    )
+
+
+# --- TM301 python-branch-on-tracer ----------------------------------------
+
+_JIT_PRELUDE = (
+    "from functools import partial\n"
+    "import jax\n"
+    "import jax.numpy as jnp\n"
+)
+
+
+def jit_src(body: str) -> str:
+    return _JIT_PRELUDE + textwrap.dedent(body)
+
+
+def test_tm301_fires_on_branch_on_traced_arg():
+    src = jit_src("""
+        @partial(jax.jit, static_argnames=("n",))
+        def k(x, n):
+            if x > 0:
+                return x
+            return -x
+        """)
+    assert codes(src, OPS) == ["TM301"]
+
+
+def test_tm301_clean_on_static_arg_shape_or_unjitted():
+    src = jit_src("""
+        @partial(jax.jit, static_argnames=("n",))
+        def k(x, n):
+            if n > 0:  # static: concrete at trace time
+                return x
+            if x.shape[0] > 8:  # shapes are trace-time constants
+                return x
+            return -x
+        def plain(x):
+            if x > 0:  # not jitted: plain Python
+                return x
+        """)
+    assert codes(src, OPS) == []
+
+
+def test_tm301_out_of_scope_path_is_clean():
+    src = jit_src("""
+        @jax.jit
+        def k(x):
+            if x > 0:
+                return x
+        """)
+    assert codes(src, ANY) == []
+
+
+# --- TM302 host-sync-in-jit -----------------------------------------------
+
+
+def test_tm302_fires_on_item_and_float_of_tracer():
+    src = jit_src("""
+        @jax.jit
+        def k(x):
+            y = x.sum().item()
+            return float(x)
+        """)
+    assert codes(src, OPS) == ["TM302", "TM302"]
+
+
+def test_tm302_clean_outside_jit_and_on_static_metadata():
+    src = jit_src("""
+        def host(x):
+            return x.sum().item()  # outside jit: fine
+        @jax.jit
+        def k(x):
+            return x * float(x.shape[0])  # shape: static
+        """)
+    assert codes(src, OPS) == []
+
+
+# --- TM303 runtime-shape-in-jit -------------------------------------------
+
+
+def test_tm303_fires_on_shape_from_traced_value():
+    src = jit_src("""
+        @jax.jit
+        def k(x, n):
+            return jnp.zeros(n) + x
+        """)
+    assert codes(src, OPS) == ["TM303"]
+
+
+def test_tm303_clean_on_static_or_shape_derived_sizes():
+    src = jit_src("""
+        @partial(jax.jit, static_argnames=("n",))
+        def k(x, n):
+            a = jnp.zeros(n)          # static arg
+            b = jnp.ones(x.shape[0])  # shape-derived
+            c = jnp.arange(len(x))    # len() is the static leading dim
+            return a + b + c
+        """)
+    assert codes(src, OPS) == []
+
+
+# --- jit decorator parsing -------------------------------------------------
+
+
+def test_jit_static_names_decorator_forms():
+    import ast as _ast
+
+    tree = _ast.parse(
+        textwrap.dedent(
+            """
+            import jax
+            from functools import partial
+            @jax.jit
+            def a(x): pass
+            @partial(jax.jit, static_argnames=("n", "m"))
+            def b(x, n, m): pass
+            @partial(jax.jit, static_argnums=(1,))
+            def c(x, n): pass
+            @jax.jit(static_argnames="n")
+            def d(x, n): pass
+            def e(x): pass
+            """
+        )
+    )
+    fns = {
+        n.name: n for n in tree.body if isinstance(n, _ast.FunctionDef)
+    }
+    assert jit_static_names(fns["a"]) == set()
+    assert jit_static_names(fns["b"]) == {"n", "m"}
+    assert jit_static_names(fns["c"]) == {"n"}
+    assert jit_static_names(fns["d"]) == {"n"}
+    assert jit_static_names(fns["e"]) is None
+
+
+# --- suppressions ----------------------------------------------------------
+
+
+def test_inline_suppression_by_code_and_all():
+    base = """
+        import time
+        async def f():
+            time.sleep(1){comment}
+        """
+    assert codes(base.format(comment="")) == ["TM101"]
+    assert codes(base.format(comment="  # tmlint: disable=TM101")) == []
+    assert codes(base.format(comment="  # tmlint: disable=all")) == []
+    # suppressing a DIFFERENT code does not hide the finding
+    assert codes(base.format(comment="  # tmlint: disable=TM102")) == ["TM101"]
+
+
+def test_suppression_comment_parsing():
+    assert suppressed_codes("x = 1") is None
+    assert suppressed_codes("x = 1  # tmlint: disable=TM101,TM102") == {
+        "TM101",
+        "TM102",
+    }
+    assert suppressed_codes("x = 1  # tmlint: disable=all") == {"all"}
+
+
+# --- baseline ratchet ------------------------------------------------------
+
+_VIOLATION = "import time\nasync def f():\n    time.sleep(1)\n"
+
+
+def _write_tree(tmp_path: Path) -> Path:
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "a.py").write_text(_VIOLATION, encoding="utf-8")
+    # __pycache__ must be invisible to the walker
+    pyc = pkg / "__pycache__"
+    pyc.mkdir()
+    (pyc / "junk.py").write_text(_VIOLATION, encoding="utf-8")
+    return pkg
+
+
+def test_baseline_round_trip(tmp_path):
+    _write_tree(tmp_path)
+    cfg = LintConfig(paths=["pkg"], baseline="base.json")
+
+    first = lint_paths(root=tmp_path, config=cfg)
+    assert [f.code for f in first] == ["TM101"]  # __pycache__ skipped too
+
+    # generate -> re-run is clean
+    Baseline.from_findings(first).save(tmp_path / "base.json")
+    again = lint_paths(
+        root=tmp_path, config=cfg, baseline=Baseline.load(tmp_path / "base.json")
+    )
+    assert all(f.baselined for f in again)
+
+    # a NEW finding still fails while the old one stays grandfathered
+    (tmp_path / "pkg" / "b.py").write_text(
+        "import asyncio\nasync def g():\n    asyncio.ensure_future(h())\n",
+        encoding="utf-8",
+    )
+    third = lint_paths(
+        root=tmp_path, config=cfg, baseline=Baseline.load(tmp_path / "base.json")
+    )
+    new = [f for f in third if not f.baselined]
+    assert [f.code for f in new] == ["TM102"]
+
+
+def test_baseline_missing_file_is_empty():
+    assert len(Baseline.load("/nonexistent/base.json")) == 0
+
+
+# --- config ----------------------------------------------------------------
+
+
+def test_tm401_fires_on_leaked_thread():
+    assert codes(
+        """
+        import threading
+        class S:
+            def start(self):
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+        """
+    ) == ["TM401"]
+
+
+def test_tm401_clean_on_daemon_or_joined():
+    assert (
+        codes(
+            """
+            import threading
+            class S:
+                def start(self):
+                    self._bg = threading.Thread(target=run, daemon=True)
+                    self._t = threading.Thread(target=run)
+                    self._t.start()
+                def stop(self):
+                    self._t.join(timeout=5)
+            """
+        )
+        == []
+    )
+
+
+def test_tm401_tuple_and_chained_assign_resolve_joins():
+    # self.t1, self.t2 = Thread(...), Thread(...) with both joined, and
+    # a = b = Thread(...) with ONE alias joined, are both correct code
+    assert (
+        codes(
+            """
+            import threading
+            class S:
+                def start(self):
+                    self.t1, self.t2 = threading.Thread(target=r), threading.Thread(target=r)
+                    a = b = threading.Thread(target=r)
+                    a.join()
+                def stop(self):
+                    self.t1.join()
+                    self.t2.join()
+            """
+        )
+        == []
+    )
+
+
+def test_tm401_unnamed_thread_flagged():
+    assert codes(
+        """
+        import threading
+        def kick():
+            threading.Thread(target=run).start()
+        """
+    ) == ["TM401"]
+
+
+def test_mini_toml_parser_subset():
+    table = _mini_toml_table(
+        textwrap.dedent(
+            """
+            [tool.other]
+            paths = ["nope"]
+            [tool.tmlint]
+            # comment line
+            paths = ["a", "b"]  # trailing comment
+            baseline = "base.json"
+            flag = true
+            [tool.after]
+            baseline = "other.json"
+            """
+        ),
+        "tool.tmlint",
+    )
+    assert table == {"paths": ["a", "b"], "baseline": "base.json", "flag": True}
+
+
+def test_mini_toml_multiline_array_and_loud_failure(tmp_path, capsys):
+    table = _mini_toml_table(
+        '[tool.tmlint]\npaths = [\n  "a",\n  "b",\n]  # comment\n'
+        "weird = { nested = 1 }\n",
+        "tool.tmlint",
+    )
+    assert table["paths"] == ["a", "b"]
+    assert "weird" not in table
+    # unsupported shapes are reported, never silently dropped — on 3.10
+    # this fallback IS the enforcing parser for the CI gate
+    assert "weird" in capsys.readouterr().err
+
+
+def test_load_config_bare_string_wraps_into_list(tmp_path):
+    # `paths = "pkg"` must become ["pkg"], not a str that would be
+    # iterated per-character (zero files scanned, CI green)
+    (tmp_path / "pyproject.toml").write_text(
+        '[tool.tmlint]\npaths = "pkg"\ndisable = "TM101"\nbaseline = "b.json"\n',
+        encoding="utf-8",
+    )
+    cfg = load_config(tmp_path)
+    assert cfg.paths == ["pkg"]
+    assert cfg.disable == ["TM101"]
+    assert cfg.baseline == "b.json"
+
+
+def test_load_config_reads_repo_pyproject():
+    cfg = load_config(REPO)
+    assert cfg.paths == ["tendermint_tpu"]
+    assert cfg.baseline == "tmlint_baseline.json"
+    assert cfg.in_determinism_scope("tendermint_tpu/consensus/state.py")
+    assert not cfg.in_determinism_scope("tendermint_tpu/rpc/core.py")
+    assert cfg.in_jax_scope("tendermint_tpu/crypto/batch.py")
+    assert not cfg.in_jax_scope("tendermint_tpu/crypto/merkle.py")
+
+
+# --- JSON output schema and CLI -------------------------------------------
+
+
+def _run_cli(*args: str, cwd: Path) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-m", "tendermint_tpu.lint", *args],
+        cwd=cwd,
+        capture_output=True,
+        text=True,
+        env={"PYTHONPATH": str(REPO), "PATH": "/usr/bin:/bin:/usr/local/bin"},
+    )
+
+
+def test_cli_json_schema_and_exit_codes(tmp_path):
+    _write_tree(tmp_path)
+    (tmp_path / "pyproject.toml").write_text(
+        '[tool.tmlint]\npaths = ["pkg"]\nbaseline = "base.json"\n',
+        encoding="utf-8",
+    )
+    dirty = _run_cli("--format", "json", cwd=tmp_path)
+    assert dirty.returncode == 1, dirty.stderr
+    doc = json.loads(dirty.stdout)
+    assert doc["version"] == 1 and doc["new"] == 1
+    f = doc["findings"][0]
+    assert set(f) == {"code", "path", "line", "col", "message", "hint", "baselined"}
+    assert f["code"] == "TM101" and f["path"] == "pkg/a.py" and f["line"] == 3
+
+    wrote = _run_cli("--write-baseline", cwd=tmp_path)
+    assert wrote.returncode == 0, wrote.stderr
+    clean = _run_cli(cwd=tmp_path)
+    assert clean.returncode == 0, clean.stdout
+    assert "0 new finding(s), 1 baselined" in clean.stdout
