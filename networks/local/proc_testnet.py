@@ -306,6 +306,80 @@ def scenario_pex(net: ProcTestnet) -> None:
 scenario_pex.self_start = True  # rewrites configs before any node starts
 
 
+def scenario_metrics(net: ProcTestnet) -> None:
+    """Observability acceptance (ISSUE 5): under real traffic the
+    live-path telemetry tells the truth — /metrics serves nonzero
+    tm_consensus_height, per-channel tm_p2p_peer_send_bytes_total and
+    tm_mempool_size, and health/debug_flight_recorder answer from a
+    live node."""
+    assert not any(net.procs.values()), "metrics scenario owns node startup"
+    mports = {}
+    for i in range(net.n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        mports[i] = s.getsockname()[1]
+        s.close()
+        cfg_path = os.path.join(net.home(i), "config", "config.json")
+        with open(cfg_path, encoding="utf-8") as f:
+            cfg = json.load(f)
+        cfg["instrumentation"]["prometheus"] = True
+        cfg["instrumentation"]["prometheus_listen_addr"] = (
+            f"tcp://127.0.0.1:{mports[i]}"
+        )
+        with open(cfg_path, "w", encoding="utf-8") as f:
+            json.dump(cfg, f, indent=1, sort_keys=True)
+    net.start_all()
+    net.wait_all(2)
+    # traffic: one committed tx (mempool admission + gossip + consensus)
+    tx = "0x" + f"mx{os.getpid()}=1".encode().hex()
+    res = net.rpc(0, f"broadcast_tx_commit?tx={tx}", timeout=30.0)
+    assert res is not None and res.get("deliver_tx", {}).get("code", 1) == 0, res
+    net.wait_all(int(res["height"]) + 1)
+
+    def scrape(i: int) -> str:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{mports[i]}/metrics", timeout=5
+        ) as r:
+            return r.read().decode()
+
+    def sample(text: str, prefix: str) -> float:
+        vals = [
+            float(line.rsplit(" ", 1)[1])
+            for line in text.splitlines()
+            if line.startswith(prefix) and not line.startswith("#")
+        ]
+        assert vals, f"no sample for {prefix}"
+        return max(vals)
+
+    deadline = time.monotonic() + 30
+    while True:  # the height gauge is sampled at 1 Hz; poll briefly
+        text = scrape(0)
+        if sample(text, "tendermint_consensus_height") >= 2:
+            break
+        assert time.monotonic() < deadline, "height gauge never moved"
+        time.sleep(0.5)
+    # p2p byte counters are per-channel and nonzero after gossip
+    assert sample(text, 'tendermint_p2p_peer_send_bytes_total{channel="') > 0
+    assert sample(text, 'tendermint_p2p_peer_receive_bytes_total{channel="') > 0
+    sample(text, "tendermint_mempool_size")  # live series present
+    assert sample(text, "tendermint_state_block_processing_time_count") > 0
+    # health is real: ready, at height, no crashed tasks
+    h = net.rpc(0, "health")
+    assert h is not None and h["ready"] is True and h["height"] >= 2, h
+    assert h["task_crashes"] == 0, h
+    fr = net.rpc(0, "debug_flight_recorder?n=500")
+    assert fr is not None, "debug_flight_recorder RPC failed"
+    kinds = {(e["sub"], e["kind"]) for e in fr["events"]}
+    assert ("consensus", "commit") in kinds and ("p2p", "peer_connected") in kinds
+    print(
+        f"metrics: height gauge moved, per-channel p2p byte counters live, "
+        f"health ok on node0 ({len(fr['events'])} black-box events)"
+    )
+
+
+scenario_metrics.self_start = True  # rewrites configs before any node starts
+
+
 def _rss_kb(pid: int) -> int | None:
     try:
         with open(f"/proc/{pid}/status", encoding="ascii") as f:
@@ -414,6 +488,7 @@ SCENARIOS = {
     "kill_all": scenario_kill_all,
     "atomic_broadcast": scenario_atomic_broadcast,
     "pex": scenario_pex,
+    "metrics": scenario_metrics,
     "soak": scenario_soak,
 }
 

@@ -149,6 +149,13 @@ class InstrumentationConfig:
     # non-empty = also export every completed trace as one JSONL line
     # through a rotating autofile.Group at this path (relative to root)
     trace_jsonl_file: str = ""
+    # Flight recorder (libs/recorder.py): bounded black-box event ring,
+    # always on (appends are one GIL-atomic deque op). Dumps — on watchdog
+    # stall, task crash, SIGUSR1, and stop-after-crash — are appended as
+    # JSONL to this rotating file next to the trace export; empty disables
+    # dumping (the ring and the debug_flight_recorder route stay live).
+    flight_recorder_ring: int = 4096
+    flight_recorder_dump_file: str = "data/flight_recorder.jsonl"
 
 
 @dataclass

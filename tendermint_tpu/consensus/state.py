@@ -32,6 +32,7 @@ from tendermint_tpu.consensus.wal import (
 )
 from tendermint_tpu.libs import fail
 from tendermint_tpu.libs import trace as tmtrace
+from tendermint_tpu.libs.recorder import RECORDER
 from tendermint_tpu.libs.events import EventSwitch
 from tendermint_tpu.libs.log import NOP, Logger
 from tendermint_tpu.libs.service import BaseService, spawn_logged
@@ -85,6 +86,10 @@ class ConsensusState(BaseService):
         self.tracer = tracer or tmtrace.NOP
         self._height_span: tmtrace.Span | None = None
         self._step_span: tmtrace.Span | None = None
+        # live-path Prometheus (libs/metrics.ConsensusMetrics), set by the
+        # node when instrumentation.prometheus is on; taps guard on None
+        self.metrics = None
+        self._last_commit_mono = 0.0
         self.block_exec = block_exec
         self.block_store = block_store
         self.mempool = mempool
@@ -174,6 +179,11 @@ class ConsensusState(BaseService):
             commit_round=-1,
         )
         self.state = state
+        RECORDER.record("consensus", "new_height", height=height)
+        m = self.metrics
+        if m is not None and state.validators is not None:
+            m.validators.set(state.validators.size())
+            m.validators_power.set(state.validators.total_voting_power())
         self._trace_new_height()
 
     def _commit_start_time(self) -> float:
@@ -408,6 +418,10 @@ class ConsensusState(BaseService):
             rs.proposal_block_parts = None
         rs.votes.set_round(round_)
         rs.triggered_timeout_precommit = False
+        RECORDER.record("consensus", "step", height=height, round=round_,
+                        step=rs.step.name)
+        if self.metrics is not None:
+            self.metrics.rounds.set(round_)
         self._trace_step()
         if self.event_bus:
             await self.event_bus.publish_new_round(self.round_state_event())
@@ -703,6 +717,7 @@ class ConsensusState(BaseService):
                 state_copy, BlockID(block.hash(), parts.header()), block
             )
         fail.fail()  # crash point (reference :1336)
+        self._observe_commit(height, block, parts)
         self.update_to_state(new_state)
         fail.fail()  # crash point (reference :1344)
         self._last_vote_time = 0
@@ -710,9 +725,36 @@ class ConsensusState(BaseService):
         self.schedule_round_0()
         self.event_switch.fire_event("new_round_step", self.rs)
 
+    def _observe_commit(self, height: int, block, parts) -> None:
+        """Black-box + Prometheus tap at the commit boundary: the block
+        stats the reference feeds from consensus/metrics.go call sites."""
+        now = time.monotonic()
+        interval = now - self._last_commit_mono if self._last_commit_mono else 0.0
+        self._last_commit_mono = now
+        RECORDER.record(
+            "consensus", "commit", height=height, round=self.rs.commit_round,
+            txs=len(block.data.txs), interval_ms=round(interval * 1e3, 1),
+        )
+        m = self.metrics
+        if m is None:
+            return
+        m.height.set(height)
+        m.num_txs.set(len(block.data.txs))
+        m.total_txs.add(len(block.data.txs))
+        m.block_size_bytes.set(parts.byte_size())
+        if interval:
+            m.block_interval_seconds.observe(interval)
+        if block.last_commit is not None:
+            m.missing_validators.set(
+                sum(1 for p in block.last_commit.precommits if p is None)
+            )
+        m.byzantine_validators.set(len(block.evidence))
+
     def _new_step(self) -> None:
         rsd = self.round_state_event()
         self.wal.write(rsd)
+        RECORDER.record("consensus", "step", height=rsd.height, round=rsd.round,
+                        step=rsd.step)
         self._trace_step()
         self.event_switch.fire_event("new_round_step", self.rs)
         if self.event_bus:
@@ -773,6 +815,8 @@ class ConsensusState(BaseService):
         rs.proposal = proposal
         if rs.proposal_block_parts is None:
             rs.proposal_block_parts = PartSet(proposal.block_id.parts)
+        RECORDER.record("consensus", "proposal", height=proposal.height,
+                        round=proposal.round)
         self.log.info("received proposal", height=proposal.height, round=proposal.round)
 
     async def add_proposal_block_part(self, msg: m.BlockPartMessage, peer_id: str) -> bool:

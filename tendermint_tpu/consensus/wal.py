@@ -20,6 +20,7 @@ from tendermint_tpu.consensus.messages import (
 )
 from tendermint_tpu.encoding import DecodeError, Reader, Writer
 from tendermint_tpu.libs.autofile import Group
+from tendermint_tpu.libs.recorder import RECORDER
 
 MAX_WAL_MSG_SIZE = 1024 * 1024  # 1MB per message hard cap (reference wal.go)
 
@@ -138,10 +139,17 @@ class WAL:
         # WAL timestamps are operator-facing replay metadata, never hashed
         # or compared across replicas — wall time is the point here
         self.group.write(encode_frame(TimedWALMessage(time.time_ns(), msg)))  # tmlint: disable=TM201
+        if isinstance(msg, EndHeightMessage):
+            # the height barrier is the WAL event a postmortem reads for
+            RECORDER.record("wal", "end_height", height=msg.height)
 
     def write_sync(self, msg) -> None:
         self.write(msg)
+        t0 = time.monotonic()
         self.group.flush_sync()
+        # fsync barriers are the commit round's dominant disk cost: a slow
+        # disk shows up in the black box as stretched wal/fsync events
+        RECORDER.record("wal", "fsync", ms=round((time.monotonic() - t0) * 1e3, 3))
 
     def flush(self) -> None:
         self.group.flush()

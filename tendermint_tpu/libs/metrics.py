@@ -64,11 +64,31 @@ class _Metric:
         raise NotImplementedError
 
 
+def _esc_label(v) -> str:
+    """Prometheus text 0.0.4 label-value escaping: backslash first, then
+    quote and newline (the format's only escape sequences)."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
 def _fmt_labels(labels: dict) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    inner = ",".join(f'{k}="{_esc_label(v)}"' for k, v in sorted(labels.items()))
     return "{" + inner + "}"
+
+
+class _BoundCounter:
+    """A counter pre-bound to one label set: `inc` is a dict-get + add,
+    no per-call label sorting — for per-message hot paths (p2p bytes)."""
+
+    __slots__ = ("_values", "_key")
+
+    def __init__(self, values: dict, key: tuple) -> None:
+        self._values = values
+        self._key = key
+
+    def inc(self, value: float = 1.0) -> None:
+        self._values[self._key] = self._values.get(self._key, 0.0) + value
 
 
 class Counter(_Metric):
@@ -81,6 +101,11 @@ class Counter(_Metric):
     def inc(self, value: float = 1.0, **labels) -> None:
         key = tuple(sorted(labels.items()))
         self._values[key] = self._values.get(key, 0.0) + value
+
+    def bind(self, **labels) -> _BoundCounter:
+        """Resolve the label key once; the returned handle increments the
+        same series without rebuilding it per call."""
+        return _BoundCounter(self._values, tuple(sorted(labels.items())))
 
     def render(self) -> list[str]:
         lines = self._head()
@@ -194,12 +219,27 @@ class MempoolMetrics:
         )
         self.failed_txs = c.counter("mempool", "failed_txs", "Rejected txs")
         self.recheck_times = c.counter("mempool", "recheck_times", "Recheck count")
+        self.residency_seconds = c.histogram(
+            "mempool", "residency_seconds", "Admission-to-commit residency",
+            [0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60],
+        )
 
 
 class StateMetrics:
     def __init__(self, c: Collector) -> None:
         self.block_processing_time = c.histogram(
             "state", "block_processing_time", "ApplyBlock seconds"
+        )
+
+
+class RuntimeMetrics:
+    """Process-runtime health (no reference analog): the asyncio/task layer
+    the flight recorder (libs/recorder.py) watches."""
+
+    def __init__(self, c: Collector) -> None:
+        self.task_crashes_total = c.counter(
+            "runtime", "task_crashes_total",
+            "Background tasks that died with an exception (spawn_logged)",
         )
 
 
@@ -268,15 +308,26 @@ class MetricsServer:
 
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
         try:
-            await reader.readline()  # request line
+            req = await reader.readline()  # e.g. b"GET /metrics HTTP/1.1\r\n"
             while (await reader.readline()) not in (b"\r\n", b"\n", b""):
                 pass
-            body = self.collector.render().encode()
-            writer.write(
-                b"HTTP/1.1 200 OK\r\nContent-Type: text/plain; version=0.0.4\r\n"
-                + f"Content-Length: {len(body)}\r\n\r\n".encode()
-                + body
-            )
+            parts = req.decode("latin-1").split()
+            method = parts[0].upper() if parts else ""
+            path = parts[1].split("?", 1)[0] if len(parts) > 1 else ""
+            if path != "/metrics":
+                body = b"not found\n"
+                writer.write(
+                    b"HTTP/1.1 404 Not Found\r\nContent-Type: text/plain\r\n"
+                    + f"Content-Length: {len(body)}\r\n\r\n".encode()
+                    + (b"" if method == "HEAD" else body)
+                )
+            else:
+                body = self.collector.render().encode()
+                writer.write(
+                    b"HTTP/1.1 200 OK\r\nContent-Type: text/plain; version=0.0.4\r\n"
+                    + f"Content-Length: {len(body)}\r\n\r\n".encode()
+                    + (b"" if method == "HEAD" else body)
+                )
             await writer.drain()
         except (ConnectionError, asyncio.IncompleteReadError):
             pass

@@ -1,0 +1,177 @@
+"""Node black box — a bounded structured-event flight recorder.
+
+PR 1's trace ring answers "why was height H slow" and the device
+telemetry answers "is the TPU link healthy", but when a node wedges or
+crashes there is still no postmortem record of what the *reactors* were
+doing. This module is that record: every layer that matters (p2p
+switch/peer lifecycle, mempool admission, consensus step transitions,
+state execution, WAL barriers, the ops dispatch path) appends one
+structured event per interesting transition into a process-wide bounded
+ring, and on failure the whole ring is dumped as JSONL — the black-box
+counterpart of the Dapper-style spans in `libs/trace.py`.
+
+Events are `(mono_ns, subsystem, kind, fields)` tuples. Appends are one
+C-level `deque.append` call — atomic under the GIL — so the event-loop
+thread records without taking a lock and worker threads (verdict-fetch
+pool, watchdog) are safe concurrently; `deque.copy()` gives readers the
+same atomicity. The monotonic clock keeps the recorder out of the
+consensus determinism surface (tmlint TM201): nothing here is hashed,
+compared across replicas, or fed back into the protocol.
+
+Dump triggers (all automatic, wired by the node):
+- `LoopWatchdog` stall — alongside the task/thread stack dump;
+- `spawn_logged` task crash (`record_crash`), which also feeds the
+  `tm_runtime_task_crashes_total` Prometheus counter;
+- `SIGUSR1` — operator-requested snapshot of a live node;
+- node stop after a recorded crash (stop-on-error postmortem).
+
+Dumps append to a rotating `libs/autofile.Group` (same scheme as the
+WAL and the trace JSONL export) so repeated failures never grow the
+file unboundedly; `debug_flight_recorder` serves the live ring over
+RPC. Schema: docs/observability.md.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+
+DEFAULT_RING = 4096
+
+
+class FlightRecorder:
+    def __init__(self, maxlen: int = DEFAULT_RING) -> None:
+        self._ring: deque = deque(maxlen=maxlen)
+        self.crashes = 0  # task crashes recorded (monotonic counter)
+        self.dumps = 0  # JSONL dumps written
+        self._dump_path: str | None = None
+        self._group = None  # lazy autofile.Group — no file until a dump
+        self._dump_lock = threading.Lock()
+        self._metrics = None  # libs/metrics.RuntimeMetrics | None
+        self._last_crash_dump = 0.0  # monotonic; crash-dump debounce
+
+    # -- recording ----------------------------------------------------------
+
+    def record(self, subsystem: str, kind: str, **fields) -> None:
+        """Append one event. Safe from any thread; never raises."""
+        self._ring.append((time.monotonic_ns(), subsystem, kind, fields))
+
+    # A crash-looping task (e.g. a reactor dying on every redial) must not
+    # turn the black box into a write amplifier: every crash is counted and
+    # recorded, but full-ring dumps within this window coalesce — the later
+    # crashes are IN the ring the next dump writes anyway.
+    CRASH_DUMP_MIN_INTERVAL = 5.0
+
+    def record_crash(self, task_name: str, exc: BaseException) -> None:
+        """A background task died (libs/service.spawn_logged done-callback):
+        count it, record it, feed Prometheus, and dump the black box."""
+        self.crashes += 1
+        self.record("runtime", "task_crash", task=str(task_name), err=repr(exc))
+        m = self._metrics
+        if m is not None:
+            m.task_crashes_total.inc()
+        now = time.monotonic()
+        if now - self._last_crash_dump >= self.CRASH_DUMP_MIN_INTERVAL:
+            self._last_crash_dump = now
+            self.dump_async("task_crash")
+
+    def set_metrics(self, rm) -> None:
+        self._metrics = rm
+
+    def resize(self, maxlen: int) -> None:
+        if maxlen > 0 and maxlen != self._ring.maxlen:
+            self._ring = deque(self._ring, maxlen=maxlen)
+
+    # -- reads --------------------------------------------------------------
+
+    @property
+    def dump_path(self) -> str | None:
+        return self._dump_path
+
+    def snapshot(self, limit: int | None = None, subsystem: str | None = None) -> list[dict]:
+        """Ring contents as dicts, oldest first (chronological — the last
+        entries of a dump are the events nearest the failure)."""
+        events = list(self._ring.copy())
+        if subsystem is not None:
+            events = [e for e in events if e[1] == subsystem]
+        if limit is not None and limit >= 0:
+            events = events[-limit:] if limit else []  # [-0:] is the whole list
+        return [self._to_dict(e) for e in events]
+
+    @staticmethod
+    def _to_dict(e: tuple) -> dict:
+        t, sub, kind, fields = e
+        d: dict = {"t_mono_ns": t, "sub": sub, "kind": kind}
+        if fields:
+            d["fields"] = fields
+        return d
+
+    # -- dumping ------------------------------------------------------------
+
+    def set_dump_path(self, path: str | None) -> None:
+        """Install (or clear) the JSONL dump sink. The file is only created
+        on the first actual dump."""
+        with self._dump_lock:
+            if self._group is not None:
+                try:
+                    self._group.close()
+                except Exception:  # noqa: BLE001 — teardown must not raise
+                    pass
+                self._group = None
+            self._dump_path = path
+        self._last_crash_dump = 0.0  # a fresh sink gets its first crash dump
+
+    def dump_async(self, reason: str) -> threading.Thread:
+        """`dump` on a short-lived daemon thread. The crash callback and the
+        SIGUSR1 handler run ON the event loop: serializing the ring and
+        hitting the disk there is exactly the blocking-call-in-async stall
+        TM101 exists to prevent (worse on the slow disks dumps diagnose,
+        and `_dump_lock` could be held by a concurrent watchdog dump).
+        Daemon so a wedged disk can never block process exit; returned so
+        callers that must observe completion can join."""
+        t = threading.Thread(
+            target=self.dump, args=(reason,), name="flight-recorder-dump",
+            daemon=True,
+        )
+        t.start()
+        return t
+
+    def dump(self, reason: str) -> int:
+        """Write a header line + every ring event as JSONL to the configured
+        sink. Returns the number of events written, or -1 when no sink is
+        installed / the write failed. Never raises — this runs from failure
+        paths (watchdog thread, crash callbacks, signal handlers)."""
+        events = self.snapshot()
+        header = {
+            "flight_recorder_dump": reason,
+            "t_mono_ns": time.monotonic_ns(),
+            # operator-facing postmortem timestamp; never consensus input
+            "t_wall": time.time(),
+            "events": len(events),
+            "crashes": self.crashes,
+        }
+        lines = [json.dumps(header, default=str)]
+        lines.extend(json.dumps(e, default=str) for e in events)
+        payload = ("\n".join(lines) + "\n").encode()
+        with self._dump_lock:
+            if self._dump_path is None:
+                return -1
+            try:
+                if self._group is None:
+                    from tendermint_tpu.libs.autofile import Group
+
+                    self._group = Group(self._dump_path)
+                self._group.write(payload)
+                self._group.flush()
+                self._group.maybe_rotate()
+            except Exception:  # noqa: BLE001 — diagnostics only
+                return -1
+            self.dumps += 1
+            return len(events)
+
+
+# Process-wide singleton, like trace.DEVICE: taps in p2p/mempool/consensus/
+# state/wal/ops record here without plumbing; the node configures ring size
+# and dump sink from config.instrumentation.
+RECORDER = FlightRecorder()

@@ -11,6 +11,8 @@ import asyncio
 import logging
 import traceback
 
+from tendermint_tpu.libs.recorder import RECORDER
+
 
 # When stop() is called from one of the service's own tasks, the caller's
 # task gets this long to finish its continuation (e.g. a reactor's
@@ -44,6 +46,12 @@ def _log_task_exception(task: asyncio.Task, logger=None) -> None:
         (logger or logging.getLogger("service")).error(msg)
     except Exception:  # noqa: BLE001 — logging must never re-raise here
         logging.getLogger("service").error(msg)
+    try:
+        # black box: count the death (tm_runtime_task_crashes_total), record
+        # the event, and dump the ring — telemetry, not just a log line
+        RECORDER.record_crash(task.get_name(), exc)
+    except Exception:  # noqa: BLE001 — diagnostics must never re-raise
+        pass
 
 
 # Strong refs to in-flight spawn_logged tasks: the event loop holds only

@@ -35,6 +35,8 @@ import time
 from collections import deque
 from typing import Any
 
+from tendermint_tpu.libs import recorder as _recorder
+
 _current: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
     "tmtpu_trace_span", default=None
 )
@@ -345,6 +347,7 @@ class DeviceTelemetry:
             self.lanes_dispatched += n
             self.lanes_padded += max(0, bucket - n)
             self.last_batch = {"curve": curve, "size": n, "bucket": bucket}
+        _recorder.RECORDER.record("device", "dispatch", curve=curve, n=n, bucket=bucket)
         dm = self._metrics
         if dm is not None:
             dm.dispatches_total.inc(curve=curve)
@@ -363,6 +366,7 @@ class DeviceTelemetry:
     def record_timeout(self, curve: str = "ed25519") -> None:
         with self._lock:
             self.fetch_timeouts += 1
+        _recorder.RECORDER.record("device", "fetch_timeout", curve=curve)
         dm = self._metrics
         if dm is not None:
             dm.fetch_timeouts_total.inc(curve=curve)
@@ -371,17 +375,21 @@ class DeviceTelemetry:
         with self._lock:
             self.cpu_fallbacks += 1
             self.fallback_reasons[reason] = self.fallback_reasons.get(reason, 0) + 1
+        _recorder.RECORDER.record("device", "cpu_fallback", reason=reason, curve=curve)
         dm = self._metrics
         if dm is not None:
             dm.cpu_fallbacks_total.inc(reason=reason, curve=curve)
 
     def record_breaker(self, tripped: bool, retry_in_s: float = 0.0) -> None:
         with self._lock:
+            changed = tripped != self.breaker_tripped
             newly = tripped and not self.breaker_tripped
             self.breaker_tripped = tripped
             self.breaker_retry_in_s = retry_in_s
             if newly:
                 self.breaker_trips += 1
+        if changed:
+            _recorder.RECORDER.record("device", "breaker", tripped=tripped)
         dm = self._metrics
         if dm is not None:
             dm.breaker_tripped.set(1.0 if tripped else 0.0)

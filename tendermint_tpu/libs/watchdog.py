@@ -30,6 +30,7 @@ from __future__ import annotations
 import asyncio
 import sys
 import threading
+import time
 import traceback
 
 
@@ -51,17 +52,24 @@ class LoopWatchdog:
         grace: float = 10.0,
         out=None,
         on_stall=None,
+        recorder=None,  # libs/recorder.FlightRecorder | None: black-box dump
     ) -> None:
         self.loop = loop
         self.interval = interval
         self.grace = grace
         self.out = out if out is not None else sys.stderr
         self.on_stall = on_stall
+        self.recorder = recorder
         self.stalls = 0  # stall episodes observed (monotonic)
+        self.loop_lag = 0.0  # last observed ping->pong latency (health())
         self._pong = threading.Event()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._in_stall = False
+
+    @property
+    def in_stall(self) -> bool:
+        return self._in_stall
 
     def start(self) -> None:
         if self._thread is not None:
@@ -82,19 +90,33 @@ class LoopWatchdog:
     def _run(self) -> None:
         while not self._stop.wait(self.interval):
             self._pong.clear()
+            t_ping = time.monotonic()
             try:
                 self.loop.call_soon_threadsafe(self._pong.set)
             except RuntimeError:
                 return  # loop closed: nothing left to watch
             if self._pong.wait(self.grace):
+                self.loop_lag = time.monotonic() - t_ping
                 self._in_stall = False
                 continue
+            self.loop_lag = time.monotonic() - t_ping  # >= grace while stalled
             if self._stop.is_set():
                 return
             if not self._in_stall:  # report once per episode
                 self._in_stall = True
                 self.stalls += 1
                 self._dump()
+                if self.recorder is not None:
+                    # black box alongside the stack dump: the stacks say
+                    # WHERE it is stuck, the event ring says what led there
+                    try:
+                        self.recorder.record(
+                            "runtime", "loop_stall",
+                            grace_s=self.grace, stalls=self.stalls,
+                        )
+                        self.recorder.dump("loop_stall")
+                    except Exception:  # noqa: BLE001 — diagnostics only
+                        pass
                 if self.on_stall is not None:
                     try:
                         self.on_stall()

@@ -9,13 +9,15 @@ in tendermint_tpu/mempool/reactor.py.
 from __future__ import annotations
 
 import asyncio
+import time
 from collections import OrderedDict
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from tendermint_tpu.abci import types as abci
 from tendermint_tpu.types.tx import tx_hash
 from tendermint_tpu.libs.clist import CList
 from tendermint_tpu.libs.log import NOP, Logger
+from tendermint_tpu.libs.recorder import RECORDER
 
 
 class MempoolError(Exception):
@@ -38,6 +40,7 @@ class MempoolTx:
     height: int  # height at which the tx was validated
     gas_wanted: int
     senders: set  # peer ids that sent us this tx (no-echo)
+    added_mono: float = field(default=0.0, compare=False)  # admission time
 
 
 class TxCache:
@@ -91,6 +94,9 @@ class CListMempool:
         self._tx_available = asyncio.Event()
         self._notified_available = False
         self.logger = logger
+        # live-path Prometheus (libs/metrics.MempoolMetrics), set by the
+        # node when instrumentation.prometheus is on; taps guard on None
+        self.metrics = None
         self._wal = None
         if wal_path:
             from tendermint_tpu.libs.autofile import Group
@@ -121,6 +127,8 @@ class CListMempool:
     async def check_tx(self, tx: bytes, sender: str | None = None) -> abci.ResponseCheckTx:
         """Reference clist_mempool.go:211 CheckTx + resCbFirstTime (:363)."""
         if len(self.txs) >= self.max_txs or self._txs_bytes + len(tx) > self.max_txs_bytes:
+            RECORDER.record("mempool", "full", size=len(self.txs),
+                            bytes=self._txs_bytes)
             raise MempoolFullError(f"mempool full: {len(self.txs)} txs")
         if not self.cache.push(tx):
             # record the extra sender for no-echo gossip, then reject
@@ -137,14 +145,25 @@ class CListMempool:
         else:
             if not self._keep_invalid_in_cache:
                 self.cache.remove(tx)
+            RECORDER.record("mempool", "reject", code=res.code, bytes=len(tx))
+            if self.metrics is not None:
+                self.metrics.failed_txs.inc()
             self.logger.debug("rejected bad tx", code=res.code, log=res.log)
         return res
 
     def _add_tx(self, tx: bytes, gas_wanted: int, sender: str | None) -> None:
-        mtx = MempoolTx(tx, self.height, gas_wanted, {sender} if sender else set())
+        mtx = MempoolTx(
+            tx, self.height, gas_wanted, {sender} if sender else set(),
+            added_mono=time.monotonic(),
+        )
         el = self.txs.push_back(mtx)
         self._tx_map[tx_hash(tx)] = el
         self._txs_bytes += len(tx)
+        RECORDER.record("mempool", "add", bytes=len(tx), size=len(self.txs))
+        m = self.metrics
+        if m is not None:
+            m.size.set(len(self.txs))
+            m.tx_size_bytes.observe(len(tx))
         self._notify_tx_available()
 
     def _notify_tx_available(self) -> None:
@@ -191,14 +210,23 @@ class CListMempool:
         self.height = height
         self._notified_available = False
         self._tx_available.clear()
+        now = time.monotonic()
+        removed = 0
         for tx in txs:
             self.cache.push(tx)  # committed txs stay in cache
             el = self._tx_map.pop(tx_hash(tx), None)
             if el is not None:
+                removed += 1
+                if self.metrics is not None and el.value.added_mono:
+                    self.metrics.residency_seconds.observe(now - el.value.added_mono)
                 self._txs_bytes -= len(el.value.tx)
                 self.txs.remove(el)
         if self.recheck and len(self.txs) > 0:
             await self._recheck_txs()
+        RECORDER.record("mempool", "update", height=height, committed=removed,
+                        size=len(self.txs))
+        if self.metrics is not None:
+            self.metrics.size.set(len(self.txs))
         self._notify_tx_available()
 
     async def _recheck_txs(self) -> None:
@@ -208,15 +236,20 @@ class CListMempool:
             self.app_conn.check_tx_async(el.value.tx, new_check=False) for el in els
         ]
         await self.app_conn.flush()
+        dropped = 0
         for el, fut in zip(els, futs):
             res = await fut
             if not res.is_ok:
+                dropped += 1
                 tx = el.value.tx
                 self._txs_bytes -= len(tx)
                 self.txs.remove(el)
                 self._tx_map.pop(tx_hash(tx), None)
                 if not self._keep_invalid_in_cache:
                     self.cache.remove(tx)
+        RECORDER.record("mempool", "recheck", txs=len(els), dropped=dropped)
+        if self.metrics is not None:
+            self.metrics.recheck_times.inc(len(els))
 
     def flush(self) -> None:
         """Remove everything (reference Flush)."""
@@ -225,6 +258,9 @@ class CListMempool:
         self._tx_map.clear()
         self.cache.reset()
         self._txs_bytes = 0
+        RECORDER.record("mempool", "flush")
+        if self.metrics is not None:
+            self.metrics.size.set(0)
 
 
 class NopMempool:

@@ -12,6 +12,7 @@ import asyncio
 
 from tendermint_tpu.encoding import Reader, Writer
 from tendermint_tpu.libs.log import NOP, Logger
+from tendermint_tpu.libs.recorder import RECORDER
 from tendermint_tpu.mempool import CListMempool, MempoolError
 from tendermint_tpu.p2p.base_reactor import BaseReactor, ChannelDescriptor
 
@@ -58,6 +59,7 @@ class MempoolReactor(BaseReactor):
         try:
             tx = decode_tx_message(msg_bytes)
         except Exception as e:
+            RECORDER.record("mempool", "bad_peer_msg", peer=peer.id, err=repr(e))
             self.log.error("bad mempool message", peer=peer.id, err=repr(e))
             await self.switch.stop_peer_for_error(peer, e)
             return

@@ -22,6 +22,7 @@ from tendermint_tpu.evidence import EvidencePool
 from tendermint_tpu.evidence.reactor import EvidenceReactor
 from tendermint_tpu.libs.db import DB, MemDB, SQLiteDB
 from tendermint_tpu.libs.log import NOP, Logger
+from tendermint_tpu.libs.recorder import RECORDER
 from tendermint_tpu.libs.service import BaseService
 from tendermint_tpu.mempool import CListMempool
 from tendermint_tpu.mempool.reactor import MempoolReactor
@@ -94,6 +95,18 @@ class Node(BaseService):
         talks to the app."""
         cfg = self.config
         log = self.log
+
+        # black box (libs/recorder.py): always-on bounded event ring; dumps
+        # (watchdog stall / task crash / SIGUSR1 / stop-after-crash) append
+        # to a rotating JSONL file next to the trace export
+        RECORDER.resize(cfg.instrumentation.flight_recorder_ring)
+        self._recorder_dump_path = None
+        if cfg.instrumentation.flight_recorder_dump_file:
+            self._recorder_dump_path = cfg._abs(
+                cfg.instrumentation.flight_recorder_dump_file
+            )
+            RECORDER.set_dump_path(self._recorder_dump_path)
+        self._crash_baseline = RECORDER.crashes
 
         # crypto backends: TPU kernel first (ops registers ed25519 on
         # import), then the native C++ core (secp256k1 always; ed25519 only
@@ -340,14 +353,29 @@ class Node(BaseService):
 
             crypto_batch.set_metrics_sink(_batch_sink)
             self.block_exec.metrics = self.state_metrics
+            # live-path taps: the reactor/mempool/consensus event sites feed
+            # their bundles directly (reference go-kit metrics call sites);
+            # the 1 Hz sampler below covers only gauges with no event site
+            self.consensus_state.metrics = self.consensus_metrics
+            self.mempool.metrics = self.mempool_metrics
+            self.switch.metrics = self.p2p_metrics
+            for p in self.switch.peers.list():
+                p.metrics = self.p2p_metrics
+            # event-fed gauges render no sample until their first event;
+            # seed them so dashboards see 0, not an absent series
+            self.p2p_metrics.peers.set(len(self.switch.peers))
+            self.mempool_metrics.size.set(self.mempool.size())
             # device data plane: mirror the process-wide telemetry
             # singleton into the tm_device_* series
             from tendermint_tpu.libs import trace as tmtrace
 
             self.device_metrics = tmm.DeviceMetrics(self.metrics)
             tmtrace.DEVICE.set_metrics(self.device_metrics)
+            self.runtime_metrics = tmm.RuntimeMetrics(self.metrics)
+            RECORDER.set_metrics(self.runtime_metrics)
             mhost, mport = parse_laddr(cfg.instrumentation.prometheus_listen_addr)
             self.metrics_server = tmm.MetricsServer(self.metrics, mhost, mport)
+        self.rpc_env.crash_baseline = self._crash_baseline
         self._built = True
 
     def _consensus_possible(self, state) -> bool:
@@ -393,8 +421,23 @@ class Node(BaseService):
                 loop,
                 interval=self.config.instrumentation.watchdog_interval,
                 grace=self.config.instrumentation.watchdog_grace,
+                recorder=RECORDER,  # black-box dump alongside the stack dump
             )
             self.watchdog.start()
+        self.rpc_env.watchdog = self.watchdog  # health() loop-lag reading
+        # SIGUSR1 = dump the flight recorder of a live node (operators'
+        # snapshot trigger; best-effort — unavailable off the main thread)
+        self._sigusr1_installed = False
+        try:
+            import signal as _signal
+
+            loop.add_signal_handler(
+                _signal.SIGUSR1, lambda: RECORDER.dump_async("sigusr1")
+            )
+            self._sigusr1_installed = True
+        except (NotImplementedError, ValueError, RuntimeError, AttributeError):
+            pass
+        RECORDER.record("node", "start", moniker=self.config.base.moniker)
         # RPC first (reference node.go:729 — receive txs before p2p is up)
         await self.rpc_server.start()
         if self.grpc_server is not None:
@@ -413,6 +456,15 @@ class Node(BaseService):
             await self.switch.dial_peers_async(addrs, persistent=True)
 
     async def on_stop(self) -> None:
+        RECORDER.record("node", "stop")
+        if getattr(self, "_sigusr1_installed", False):
+            import signal as _signal
+
+            try:
+                asyncio.get_running_loop().remove_signal_handler(_signal.SIGUSR1)
+            except (NotImplementedError, RuntimeError, ValueError):
+                pass
+            self._sigusr1_installed = False
         if getattr(self, "watchdog", None) is not None:
             self.watchdog.stop()
             self.watchdog = None
@@ -442,50 +494,38 @@ class Node(BaseService):
             from tendermint_tpu.libs import trace as tmtrace
 
             tmtrace.DEVICE.set_metrics(None)
+            RECORDER.set_metrics(None)
+        # stop-on-error postmortem: if any task died during this node's
+        # run, the black box goes to disk before the sink is detached
+        # (off-loop: a slow disk must not stall the remaining teardown)
+        if RECORDER.crashes > getattr(self, "_crash_baseline", 0):
+            await asyncio.to_thread(RECORDER.dump, "node_stop_after_crash")
+        if (
+            getattr(self, "_recorder_dump_path", None) is not None
+            and RECORDER.dump_path == self._recorder_dump_path
+        ):
+            RECORDER.set_dump_path(None)
         self.consensus_state.wal.close()
         self.addr_book.save()
         for db in (self.block_store_db, self.state_db):
             db.close()
 
     async def _metrics_sampler(self) -> None:
-        """Sample gauges + observe block intervals (reference wires these
-        through go-kit at event sites; a 1s sampler keeps our call sites
-        clean while the histograms come from the event bus)."""
-        import time as _time
-
-        from tendermint_tpu.types import events as ev
-
-        sub = self.event_bus.subscribe("metrics-sampler", ev.EVENT_QUERY_NEW_BLOCK)
-        last_block_at = 0.0
-        cm, mm, pm = self.consensus_metrics, self.mempool_metrics, self.p2p_metrics
+        """The few gauges with no natural event site. Everything else —
+        block stats, rounds, mempool size, peer count, byte counters — is
+        fed at the live path itself (consensus/mempool/switch/peer taps,
+        the reference's go-kit call-site pattern). What stays sampled:
+        height doubles as the fast-sync catch-all (blocks applied by the
+        blockchain reactor bypass the consensus commit tap), and the
+        fast_syncing flag flips inside the reactor."""
+        cm = self.consensus_metrics
         while True:
-            rs = self.consensus_state.rs
             cm.height.set(self.block_store.height())
-            cm.rounds.set(rs.round)
+            rs = self.consensus_state.rs
             if rs.validators is not None:
                 cm.validators.set(rs.validators.size())
                 cm.validators_power.set(rs.validators.total_voting_power())
             cm.fast_syncing.set(1 if self.consensus_reactor.fast_sync else 0)
-            mm.size.set(self.mempool.size())
-            pm.peers.set(len(self.switch.peers))
-            # drain block events without blocking the sampling cadence
-            while True:
-                msg = sub.try_next()
-                if msg is None:
-                    break
-                block = msg.data["block"]
-                now = _time.monotonic()
-                if last_block_at:
-                    cm.block_interval_seconds.observe(now - last_block_at)
-                last_block_at = now
-                cm.num_txs.set(len(block.data.txs))
-                cm.total_txs.add(len(block.data.txs))
-                cm.block_size_bytes.set(len(block.encode()))
-                commit = block.last_commit
-                if commit is not None:
-                    missing = sum(1 for p in commit.precommits if p is None)
-                    cm.missing_validators.set(missing)
-                cm.byzantine_validators.set(len(block.evidence))
             await asyncio.sleep(1.0)
 
     # convenience accessors (reference node.go getters)

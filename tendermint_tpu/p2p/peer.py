@@ -30,8 +30,20 @@ class Peer(BaseService):
         self.persistent = persistent
         self.socket_addr = socket_addr  # NetAddress dialed/accepted from
         self._data: dict[str, object] = {}
+        # libs/metrics.P2PMetrics | None, set by the switch: per-channel
+        # byte counters at the message layer (reference p2p/peer.go wraps
+        # onReceive/send the same way). Counters are bound per channel on
+        # first use so the per-message cost is one dict-get + add.
+        self.metrics = None
+        self._send_ctrs: dict[int, object] = {}
+        self._recv_ctrs: dict[int, object] = {}
 
         async def _recv(ch_id: int, msg: bytes) -> None:
+            if self.metrics is not None:
+                self._count(
+                    self._recv_ctrs, self.metrics.peer_receive_bytes_total,
+                    ch_id, len(msg),
+                )
             await on_receive(ch_id, self, msg)
 
         async def _err(e: Exception) -> None:
@@ -49,11 +61,27 @@ class Peer(BaseService):
     async def on_stop(self) -> None:
         await self.mconn.stop()
 
+    @staticmethod
+    def _count(cache: dict, counter, ch_id: int, n: int) -> None:
+        ctr = cache.get(ch_id)
+        if ctr is None:
+            ctr = counter.bind(channel=f"{ch_id:#04x}")
+            cache[ch_id] = ctr
+        ctr.inc(n)
+
     async def send(self, ch_id: int, msg: bytes) -> bool:
-        return await self.mconn.send(ch_id, msg)
+        ok = await self.mconn.send(ch_id, msg)
+        if ok and self.metrics is not None:
+            self._count(self._send_ctrs, self.metrics.peer_send_bytes_total,
+                        ch_id, len(msg))
+        return ok
 
     def try_send(self, ch_id: int, msg: bytes) -> bool:
-        return self.mconn.try_send(ch_id, msg)
+        ok = self.mconn.try_send(ch_id, msg)
+        if ok and self.metrics is not None:
+            self._count(self._send_ctrs, self.metrics.peer_send_bytes_total,
+                        ch_id, len(msg))
+        return ok
 
     def set(self, key: str, value) -> None:
         self._data[key] = value

@@ -10,6 +10,7 @@ from __future__ import annotations
 import asyncio
 import random
 
+from tendermint_tpu.libs.recorder import RECORDER
 from tendermint_tpu.libs.service import BaseService
 from tendermint_tpu.p2p.netaddress import NetAddress
 from tendermint_tpu.p2p.peer import Peer
@@ -70,6 +71,9 @@ class Switch(BaseService):
         self._reconnecting: set[str] = set()
         self._persistent_addrs: dict[str, NetAddress] = {}
         self.addr_book = None  # optional, set by PEX wiring
+        # libs/metrics.P2PMetrics | None, set by the node when Prometheus
+        # is on; propagated to each Peer for per-channel byte counters
+        self.metrics = None
 
     def node_id(self) -> str:
         return self.transport.node_key.id()
@@ -209,6 +213,7 @@ class Switch(BaseService):
             persistent=persistent,
             socket_addr=socket_addr,
         )
+        peer.metrics = self.metrics  # per-channel byte counters from byte 0
         for reactor in self.reactors.values():
             reactor.init_peer(peer)
         self.peers.add(peer)
@@ -220,6 +225,9 @@ class Switch(BaseService):
             self.peers.remove(peer)
             await peer.stop()
             raise
+        RECORDER.record("p2p", "peer_connected", peer=peer.id, outbound=outbound)
+        if self.metrics is not None:
+            self.metrics.peers.set(len(self.peers))
         self.logger.info("added peer %s (%s)", peer, "out" if outbound else "in")
         return peer
 
@@ -236,6 +244,7 @@ class Switch(BaseService):
     async def stop_peer_for_error(self, peer: Peer, reason) -> None:
         if not self.peers.has(peer.id):
             return
+        RECORDER.record("p2p", "peer_error", peer=peer.id, err=str(reason)[:200])
         self.logger.info("stopping peer %s: %s", peer, reason)
         await self._stop_and_remove(peer, reason)
         if peer.persistent and self.is_running:
@@ -248,6 +257,10 @@ class Switch(BaseService):
 
     async def _stop_and_remove(self, peer: Peer, reason) -> None:
         self.peers.remove(peer)
+        RECORDER.record("p2p", "peer_disconnected", peer=peer.id,
+                        reason=str(reason)[:200])
+        if self.metrics is not None:
+            self.metrics.peers.set(len(self.peers))
         await peer.stop()
         for reactor in self.reactors.values():
             await reactor.remove_peer(peer, reason)

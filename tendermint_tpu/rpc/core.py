@@ -176,6 +176,11 @@ class Environment:
         self.node_info = node_info
         self.priv_validator_pub_key = priv_validator_pub_key
         self.log = logger
+        # set by the node after on_start: LoopWatchdog for health()'s
+        # loop-lag reading, and the flight-recorder crash count at boot so
+        # health reports crashes of THIS node run, not process history
+        self.watchdog = None
+        self.crash_baseline = 0
         self._subscriber_seq = 0
         self._async_txs: list[bytes] = []
         self._async_drainer_active = False
@@ -184,7 +189,59 @@ class Environment:
     # info routes
 
     async def health(self) -> dict:
-        return {}
+        """Real liveness/readiness (the reference's health.go returns {}):
+
+        - `ready` is the orchestrator-facing readiness bit — the node is
+          past fast sync and can serve consistent reads / accept txs;
+        - `status` degrades on wedge signals (stalled event loop, open
+          device circuit breaker, background-task crashes) with the
+          triggering reasons listed in `degraded`.
+        """
+        import time as _time
+
+        from tendermint_tpu.libs.recorder import RECORDER
+
+        height = self.block_store.height() if self.block_store is not None else 0
+        last_commit_age = None
+        if self.block_store is not None and height > 0:
+            meta = self.block_store.load_block_meta(height)
+            if meta is not None:
+                # header.time is ns-since-epoch; wall-clock age is exactly
+                # what an operator dashboard wants (never consensus input)
+                last_commit_age = round(
+                    max(0.0, _time.time() - meta.header.time / 1e9), 3
+                )
+        catching_up = self._catching_up()
+        peers = len(self.p2p_switch.peers) if self.p2p_switch is not None else 0
+        loop = None
+        wd = self.watchdog
+        if wd is not None:
+            loop = {
+                "lag_s": round(getattr(wd, "loop_lag", 0.0), 4),
+                "stalls": wd.stalls,
+                "in_stall": wd.in_stall,
+            }
+        breaker = self._device_snapshot()["breaker"]
+        crashes = max(0, RECORDER.crashes - self.crash_baseline)
+        degraded = []
+        if loop is not None and loop["in_stall"]:
+            degraded.append("loop_stalled")
+        if breaker.get("tripped"):
+            degraded.append("device_breaker_open")
+        if crashes:
+            degraded.append("task_crashes")
+        return {
+            "status": "degraded" if degraded else "ok",
+            "degraded": degraded,
+            "ready": not catching_up,
+            "height": height,
+            "last_commit_age_s": last_commit_age,
+            "catching_up": catching_up,
+            "peers": peers,
+            "loop": loop,
+            "breaker": breaker,
+            "task_crashes": crashes,
+        }
 
     async def status(self) -> dict:
         """Reference rpc/core/status.go."""
@@ -427,9 +484,7 @@ class Environment:
             out["active"] = active.to_dict()
         return out
 
-    async def debug_device(self) -> dict:
-        """Device data-plane health: dispatch/pad/fetch counters, CPU
-        fallbacks, and the wedged-device circuit breaker state."""
+    def _device_snapshot(self) -> dict:
         import sys as _sys
 
         from tendermint_tpu.libs import trace as tmtrace
@@ -441,6 +496,27 @@ class Environment:
         if edb is not None:
             snap["breaker"] = dict(snap["breaker"], **edb.breaker.state())
         return snap
+
+    async def debug_device(self) -> dict:
+        """Device data-plane health: dispatch/pad/fetch counters, CPU
+        fallbacks, and the wedged-device circuit breaker state."""
+        return self._device_snapshot()
+
+    async def debug_flight_recorder(self, n: int = 200, subsystem: str | None = None) -> dict:
+        """The black box (libs/recorder.py): the last N structured events
+        across p2p/mempool/consensus/state/wal/device/runtime, oldest
+        first, plus crash/dump counters. Always available."""
+        from tendermint_tpu.libs.recorder import RECORDER
+
+        try:
+            n = max(1, min(int(n), 2000))
+        except (TypeError, ValueError):
+            raise RPCError(INVALID_PARAMS, "n must be an int")
+        return {
+            "crashes": RECORDER.crashes,
+            "dumps": RECORDER.dumps,
+            "events": RECORDER.snapshot(limit=n, subsystem=subsystem),
+        }
 
     # ------------------------------------------------------------------
     # tx routes
@@ -728,6 +804,7 @@ class Environment:
             "dump_consensus_state": self.dump_consensus_state,
             "debug_consensus_trace": self.debug_consensus_trace,
             "debug_device": self.debug_device,
+            "debug_flight_recorder": self.debug_flight_recorder,
             "broadcast_tx_async": self.broadcast_tx_async,
             "broadcast_tx_sync": self.broadcast_tx_sync,
             "broadcast_tx_commit": self.broadcast_tx_commit,

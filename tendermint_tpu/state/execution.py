@@ -15,6 +15,7 @@ from tendermint_tpu.abci import types as abci
 from tendermint_tpu import crypto
 from tendermint_tpu.libs import fail
 from tendermint_tpu.libs.log import NOP, Logger
+from tendermint_tpu.libs.recorder import RECORDER
 from tendermint_tpu.state import ABCIResponses, State, StateStore
 from tendermint_tpu.state.validation import validate_block
 from tendermint_tpu.types import Block, BlockID
@@ -102,8 +103,11 @@ class BlockExecutor:
             self.evidence_pool.update(block, new_state)
         if self.event_bus is not None:
             await self._fire_events(block, abci_responses, validator_updates)
+        elapsed = _time.monotonic() - _t0
+        RECORDER.record("state", "apply_block", height=block.header.height,
+                        txs=len(block.data.txs), ms=round(elapsed * 1e3, 1))
         if self.metrics is not None:
-            self.metrics.block_processing_time.observe(_time.monotonic() - _t0)
+            self.metrics.block_processing_time.observe(elapsed)
         return new_state
 
     async def _exec_block_on_proxy_app(self, state: State, block: Block) -> ABCIResponses:

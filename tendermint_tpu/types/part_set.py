@@ -119,6 +119,11 @@ class PartSet:
         self._count += 1
         return True
 
+    def byte_size(self) -> int:
+        """Serialized-block bytes held so far (== len(get_data()) when
+        complete) — lets telemetry report block size without re-encoding."""
+        return sum(len(p.bytes_) for p in self._parts if p is not None)
+
     def get_data(self) -> bytes:
         if not self.is_complete():
             raise ValueError("incomplete part set")

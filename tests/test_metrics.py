@@ -27,6 +27,55 @@ class TestPrimitives:
         assert 'tm_state_secs_bucket{le="+Inf"} 3' in text
         assert "tm_state_secs_count 3" in text
 
+    def test_label_value_escaping(self):
+        # Prometheus text 0.0.4: backslash, quote and newline must be
+        # escaped in label values (backslash first)
+        c = Collector("tm")
+        ctr = c.counter("p2p", "errs_total")
+        ctr.inc(peer='say "hi"')
+        ctr.inc(reason="a\\b")
+        ctr.inc(reason="line1\nline2")
+        text = c.render()
+        assert 'tm_p2p_errs_total{peer="say \\"hi\\""} 1' in text
+        assert 'tm_p2p_errs_total{reason="a\\\\b"} 1' in text
+        assert 'tm_p2p_errs_total{reason="line1\\nline2"} 1' in text
+        assert "\nline2" not in text  # no raw newline inside a sample line
+
+    def test_histogram_buckets_are_cumulative_with_inf_sum_count(self):
+        c = Collector("tm")
+        h = c.histogram("state", "t", buckets=[1, 2, 4])
+        for v in [0.5, 1.5, 1.7, 3, 100]:
+            h.observe(v)
+        text = c.render()
+        assert 'tm_state_t_bucket{le="1"} 1' in text
+        assert 'tm_state_t_bucket{le="2"} 3' in text
+        assert 'tm_state_t_bucket{le="4"} 4' in text
+        assert 'tm_state_t_bucket{le="+Inf"} 5' in text
+        assert "tm_state_t_sum 106.7" in text
+        assert "tm_state_t_count 5" in text
+
+    def test_bound_counter_hits_same_series_as_inc(self):
+        # peer byte counters bind once per channel (hot path); the bound
+        # handle and the kwargs form must feed the identical series
+        c = Collector("tm")
+        ctr = c.counter("p2p", "bytes_total")
+        bound = ctr.bind(channel="0x30")
+        bound.inc(10)
+        ctr.inc(5, channel="0x30")
+        bound.inc()
+        assert 'tm_p2p_bytes_total{channel="0x30"} 16' in c.render()
+
+    def test_labeled_counter_series_sorted_and_independent(self):
+        c = Collector("tm")
+        ctr = c.counter("p2p", "bytes_total")
+        ctr.inc(7, channel="0x30")
+        ctr.inc(3, channel="0x20")
+        ctr.inc(2, channel="0x30")
+        text = c.render()
+        i20 = text.index('tm_p2p_bytes_total{channel="0x20"} 3')
+        i30 = text.index('tm_p2p_bytes_total{channel="0x30"} 9')
+        assert i20 < i30  # deterministic ordering
+
     def test_endpoint_serves_text(self):
         async def main():
             c = Collector("tm")
@@ -46,9 +95,52 @@ class TestPrimitives:
 
         asyncio.run(main())
 
+    def test_endpoint_404_for_other_paths_and_head_without_body(self):
+        async def request(port, raw):
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            writer.write(raw)
+            await writer.drain()
+            data = await reader.read(65536)
+            writer.close()
+            return data
+
+        async def main():
+            c = Collector("tm")
+            c.gauge("test", "x").set(7)
+            srv = MetricsServer(c, "127.0.0.1", 0)
+            await srv.start()
+            try:
+                port = srv.listen_port
+                data = await request(port, b"GET / HTTP/1.1\r\nHost: x\r\n\r\n")
+                assert data.startswith(b"HTTP/1.1 404")
+                data = await request(port, b"GET /metricsz HTTP/1.1\r\n\r\n")
+                assert data.startswith(b"HTTP/1.1 404")
+                # query strings target the same resource
+                data = await request(port, b"GET /metrics?x=1 HTTP/1.1\r\n\r\n")
+                assert data.startswith(b"HTTP/1.1 200") and b"tm_test_x 7" in data
+                # HEAD answers with GET's headers and no body
+                data = await request(port, b"HEAD /metrics HTTP/1.1\r\n\r\n")
+                head, _, body = data.partition(b"\r\n\r\n")
+                assert head.startswith(b"HTTP/1.1 200 OK")
+                assert body == b""
+                clen = next(
+                    int(ln.split(b":")[1])
+                    for ln in head.split(b"\r\n")
+                    if ln.lower().startswith(b"content-length")
+                )
+                assert clen == len(c.render().encode())
+            finally:
+                await srv.stop()
+
+        asyncio.run(main())
+
 
 class TestNodeMetrics:
     def test_live_node_exports_consensus_metrics(self, tmp_path):
+        import pytest
+
+        pytest.importorskip("cryptography", reason="crypto stack unavailable")
+
         async def main():
             import sys, os
 

@@ -55,7 +55,10 @@ class TestSingleNodeRPC:
                 assert st["node_info"]["network"] == CHAIN_ID
                 assert st["validator_info"]["voting_power"] == 10
 
-                assert await client.call("health") == {}
+                h = await client.call("health")
+                assert h["ready"] is True and h["catching_up"] is False
+                assert h["height"] >= 2 and h["task_crashes"] == 0
+                assert h["last_commit_age_s"] is not None
 
                 g = await client.call("genesis")
                 assert g["genesis"]["chain_id"] == CHAIN_ID

@@ -539,6 +539,65 @@ def test_tm401_unnamed_thread_flagged():
     ) == ["TM401"]
 
 
+# --- flight-recorder taps in rule scopes (libs/recorder, ISSUE 5) ----------
+
+
+def test_recorder_tap_monotonic_clean_in_consensus_path():
+    # the WAL/consensus tap idiom: monotonic timing + RECORDER.record is
+    # not a determinism hazard — nothing recorded feeds the protocol
+    assert (
+        codes(
+            """
+            import time
+            from tendermint_tpu.libs.recorder import RECORDER
+            def write_sync(group, msg):
+                t0 = time.monotonic()
+                group.flush_sync()
+                RECORDER.record("wal", "fsync", ms=(time.monotonic() - t0) * 1e3)
+            """,
+            CONS,
+        )
+        == []
+    )
+
+
+def test_recorder_tap_wall_clock_still_flagged_in_consensus_path():
+    # the recorder API is no TM201 exemption: stamping events with wall
+    # time inside a determinism path stays a finding
+    assert codes(
+        """
+        import time
+        from tendermint_tpu.libs.recorder import RECORDER
+        def write_sync(group, msg):
+            RECORDER.record("wal", "fsync", at=time.time())
+        """,
+        CONS,
+    ) == ["TM201"]
+
+
+def test_recorder_tap_outside_jit_body_clean_in_ops_path():
+    # device-dispatch taps live OUTSIDE the jitted kernel: no TM302 host
+    # sync, no TM301 tracer branch
+    assert (
+        codes(
+            """
+            import jax
+            from tendermint_tpu.libs.recorder import RECORDER
+
+            @jax.jit
+            def kernel(x):
+                return x + 1
+
+            def dispatch(x, n, bucket):
+                RECORDER.record("device", "dispatch", n=n, bucket=bucket)
+                return kernel(x)
+            """,
+            OPS,
+        )
+        == []
+    )
+
+
 def test_mini_toml_parser_subset():
     table = _mini_toml_table(
         textwrap.dedent(
