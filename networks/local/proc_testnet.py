@@ -16,6 +16,9 @@ Scenarios:
   kill_all         — SIGKILL every node; restart; chain resumes past the old head.
   atomic_broadcast — a tx sent to one node commits and is queryable on ALL.
   pex              — a node given only ONE peer discovers the rest via PEX.
+  metrics          — live-path telemetry tells the truth under traffic.
+  timeline         — the fleet collector stitches a cross-node per-height
+                     timeline with a complete vote-arrival matrix.
 
 Usage:
   python -m networks.local.proc_testnet            # all scenarios, n=4
@@ -380,6 +383,94 @@ def scenario_metrics(net: ProcTestnet) -> None:
 scenario_metrics.self_start = True  # rewrites configs before any node starts
 
 
+def scenario_timeline(net: ProcTestnet) -> None:
+    """Fleet-observability acceptance (ISSUE 6): the collector stitches a
+    cross-node per-height timeline from a live 4-node net — ≥1 height
+    with a COMPLETE vote-arrival matrix (every validator × every
+    observing node × prevote+precommit), per-phase latency percentiles,
+    nonzero device-occupancy (or explicit cpu-route) accounting — and
+    the cross-node invariants hold (all validators commit each stitched
+    height within the bound; no stale-round votes in flight). The report
+    is written to <root>/fleet_report.json (preserved on failure for the
+    CI artifact upload)."""
+    assert not any(net.procs.values()), "timeline scenario owns node startup"
+    mports = {}
+    for i in range(net.n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        mports[i] = s.getsockname()[1]
+        s.close()
+        cfg_path = os.path.join(net.home(i), "config", "config.json")
+        with open(cfg_path, encoding="utf-8") as f:
+            cfg = json.load(f)
+        cfg["instrumentation"]["tracing"] = True
+        cfg["instrumentation"]["prometheus"] = True
+        cfg["instrumentation"]["prometheus_listen_addr"] = (
+            f"tcp://127.0.0.1:{mports[i]}"
+        )
+        with open(cfg_path, "w", encoding="utf-8") as f:
+            json.dump(cfg, f, indent=1, sort_keys=True)
+    net.start_all()
+    net.wait_all(2)
+    # traffic: one committed tx, then a couple more heights of timeline
+    tx = "0x" + f"tl{os.getpid()}=1".encode().hex()
+    res = net.rpc(0, f"broadcast_tx_commit?tx={tx}", timeout=30.0)
+    assert res is not None and res.get("deliver_tx", {}).get("code", 1) == 0, res
+    net.wait_all(int(res["height"]) + 2)
+
+    from tendermint_tpu.tools.collector import FleetCollector, render_text
+
+    endpoints = [f"http://127.0.0.1:{net.rpc_port(i)}" for i in range(net.n)]
+    metrics = [f"http://127.0.0.1:{mports[i]}" for i in range(net.n)]
+    fc = FleetCollector(endpoints, metrics=metrics, timeout=10.0)
+    fc.poll()
+    # second incremental poll: exercises the since_ns cursor path end to
+    # end (the second read returns only events newer than the first)
+    time.sleep(1.0)
+    fc.poll()
+    report = fc.report(commit_spread_s=5.0)
+    report_path = os.path.join(net.root, "fleet_report.json")
+    with open(report_path, "w", encoding="utf-8") as f:
+        json.dump(report, f, indent=1, sort_keys=True, default=str)
+
+    assert len(report["observers"]) == net.n, report["observers"]
+    assert report["n_validators"] == net.n, report["n_validators"]
+    stitched = report["stitched_heights"]
+    assert stitched, (
+        f"no height with a complete {net.n}x(prevote+precommit) "
+        f"vote-arrival matrix; see {report_path}"
+    )
+    # per-phase latencies measured across the fleet
+    for phase in ("propose_to_prevote_maj23_ms", "precommit_maj23_to_commit_ms",
+                  "propose_to_commit_ms"):
+        assert report["phases"].get(phase, {}).get("n", 0) > 0, (phase, report["phases"])
+    # vote propagation observed by 2+ nodes
+    assert report["propagation"]["vote_spread"]["precommit"]["n"] > 0
+    # device-occupancy accounting: real dispatches, or the explicit
+    # cpu-route tally (this testnet pins JAX_PLATFORMS=cpu, so routing
+    # sends every batch to the host paths — and must SAY so)
+    for node, dev in report["device"].items():
+        occ = dev["occupancy"]
+        assert (
+            occ.get("busy_windows", 0) > 0
+            or occ.get("cpu_route", {}).get("sigs", 0) > 0
+        ), (node, dev)
+    # occupancy series are live on /metrics too
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{mports[0]}/metrics", timeout=5
+    ) as r:
+        text = r.read().decode()
+    assert "tendermint_device_occupancy_cpu_route_signatures_total" in text
+    assert not report["violations"], report["violations"]
+    print(render_text(report))
+    print(f"timeline: {len(stitched)} stitched heights "
+          f"{stitched[:5]}, complete {net.n}x2 vote matrices, "
+          f"invariants clean")
+
+
+scenario_timeline.self_start = True  # rewrites configs before any node starts
+
+
 def _rss_kb(pid: int) -> int | None:
     try:
         with open(f"/proc/{pid}/status", encoding="ascii") as f:
@@ -489,6 +580,7 @@ SCENARIOS = {
     "atomic_broadcast": scenario_atomic_broadcast,
     "pex": scenario_pex,
     "metrics": scenario_metrics,
+    "timeline": scenario_timeline,
     "soak": scenario_soak,
 }
 
@@ -511,6 +603,12 @@ def run(names=None, n: int = 4) -> None:
                 print(f"--- generator stderr ---\n{err.decode(errors='replace')[-1500:]}",
                       file=sys.stderr)
             keep = tempfile.mkdtemp(prefix=f"tmtpu-{name}-failed-")
+            # the collector's fleet report (timeline scenario) rides with
+            # the logs so CI can upload it as a failure artifact
+            try:
+                shutil.copy(os.path.join(net.root, "fleet_report.json"), keep)
+            except OSError:
+                pass
             for i in range(net.n):
                 src = os.path.join(net.root, f"node{i}.log")
                 try:

@@ -21,6 +21,7 @@ from tendermint_tpu.consensus.round_state import PeerRoundState, RoundState, Rou
 from tendermint_tpu.consensus.state import ConsensusState
 from tendermint_tpu.libs.bit_array import BitArray
 from tendermint_tpu.libs.log import NOP, Logger
+from tendermint_tpu.libs.recorder import RECORDER
 from tendermint_tpu.p2p.base_reactor import BaseReactor, ChannelDescriptor
 from tendermint_tpu.types import PartSetHeader, Vote, VoteType
 from tendermint_tpu.types.vote_set import VoteSet
@@ -452,6 +453,15 @@ class ConsensusReactor(BaseReactor):
                 rs.height - 1, rs.last_commit.size() if rs.last_commit else 0
             )
             v = msg.vote
+            # fleet-timeline tap: gossip RECEIPT time, per delivering
+            # peer — paired with the VoteSet "vote" (counted) event this
+            # gives the collector gossip-vs-verify attribution for every
+            # vote (the same vote arriving via several peers records one
+            # receipt each; only the first COUNTS)
+            RECORDER.record(
+                "consensus", "vote_recv", height=v.height, round=v.round,
+                type=int(v.type), val=v.validator_index, peer=peer.id,
+            )
             ps.set_has_vote(v.height, v.round, v.type, v.validator_index)
             await cs.send_peer_msg(msg, peer.id)
 

@@ -283,6 +283,31 @@ class DeviceMetrics:
         self.breaker_trips_total = c.counter(
             "device", "breaker_trips_total", "Circuit-breaker trips"
         )
+        # occupancy accounting (ISSUE 6): is the device actually kept
+        # busy — the admission data the unified dispatch scheduler
+        # (ROADMAP item 1) will consume. Fed by DEVICE.record_busy /
+        # record_cpu_route from the ops dispatch path.
+        self.occ_busy_seconds_total = c.counter(
+            "device_occupancy", "busy_seconds_total",
+            "Wall seconds with verify work outstanding on the device",
+        )
+        self.occ_busy_frac = c.gauge(
+            "device_occupancy", "busy_frac",
+            "Device-busy fraction of wall time since the first dispatch",
+        )
+        self.occ_queue_depth = c.gauge(
+            "device_occupancy", "queue_depth",
+            "Chunks in flight in the last dispatch window",
+        )
+        self.occ_fill_ratio = c.gauge(
+            "device_occupancy", "fill_ratio",
+            "Cumulative valid lanes / dispatched lanes (1.0 = no pad waste)",
+        )
+        self.occ_cpu_route_sigs_total = c.counter(
+            "device_occupancy", "cpu_route_signatures_total",
+            "Signatures the router verified on the host paths "
+            "(below device threshold or no accelerator)",
+        )
 
 
 class MetricsServer:

@@ -10,13 +10,22 @@ structured event per interesting transition into a process-wide bounded
 ring, and on failure the whole ring is dumped as JSONL — the black-box
 counterpart of the Dapper-style spans in `libs/trace.py`.
 
-Events are `(mono_ns, subsystem, kind, fields)` tuples. Appends are one
-C-level `deque.append` call — atomic under the GIL — so the event-loop
-thread records without taking a lock and worker threads (verdict-fetch
-pool, watchdog) are safe concurrently; `deque.copy()` gives readers the
-same atomicity. The monotonic clock keeps the recorder out of the
-consensus determinism surface (tmlint TM201): nothing here is hashed,
-compared across replicas, or fed back into the protocol.
+Events are `(seq, mono_ns, subsystem, kind, fields)` tuples. Appends are
+one C-level `deque.append` call — atomic under the GIL — so the
+event-loop thread records without taking a lock and worker threads
+(verdict-fetch pool, watchdog) are safe concurrently; `deque.copy()`
+gives readers the same atomicity. `seq` is a process-monotonic event
+number (`itertools.count` — its `next()` is a single C call, so the
+numbering is race-free without a lock) that lets an incremental reader
+(the fleet collector scraping `debug_flight_recorder` with a `since_ns`
+cursor) detect ring overrun: `total_dropped = last_seq - len(ring)`
+events have been evicted unseen. The monotonic clock keeps the recorder
+out of the consensus determinism surface (tmlint TM201): nothing here is
+hashed, compared across replicas, or fed back into the protocol — the
+wall clock appears only in dump headers and clock-anchor events, which
+exist precisely so an OFF-node reader can map each node's private
+monotonic timebase onto shared wall time (docs/observability.md "Fleet
+view").
 
 Dump triggers (all automatic, wired by the node):
 - `LoopWatchdog` stall — alongside the task/thread stack dump;
@@ -32,6 +41,7 @@ RPC. Schema: docs/observability.md.
 """
 from __future__ import annotations
 
+import itertools
 import json
 import threading
 import time
@@ -40,11 +50,23 @@ from collections import deque
 DEFAULT_RING = 4096
 
 
+def clock_anchor() -> dict:
+    """One mono↔wall correspondence, sampled now. The pair is read
+    back-to-back (sub-microsecond skew) so `wall_ns - mono_ns` is a
+    per-process offset an external reader can apply to every monotonic
+    timestamp this process ever emitted. Telemetry only — never
+    consensus input."""
+    return {"mono_ns": time.monotonic_ns(), "wall_ns": time.time_ns()}
+
+
 class FlightRecorder:
     def __init__(self, maxlen: int = DEFAULT_RING) -> None:
         self._ring: deque = deque(maxlen=maxlen)
+        self._seq = itertools.count(1)  # race-free event numbering
+        self._last_seq = 0  # highest seq handed out (approximate under races)
         self.crashes = 0  # task crashes recorded (monotonic counter)
         self.dumps = 0  # JSONL dumps written
+        self.moniker = ""  # node identity stamped on dumps + RPC reads
         self._dump_path: str | None = None
         self._group = None  # lazy autofile.Group — no file until a dump
         self._dump_lock = threading.Lock()
@@ -55,7 +77,16 @@ class FlightRecorder:
 
     def record(self, subsystem: str, kind: str, **fields) -> None:
         """Append one event. Safe from any thread; never raises."""
-        self._ring.append((time.monotonic_ns(), subsystem, kind, fields))
+        seq = next(self._seq)
+        self._last_seq = seq
+        self._ring.append((seq, time.monotonic_ns(), subsystem, kind, fields))
+
+    def record_anchor(self, **fields) -> None:
+        """Append a mono↔wall clock-anchor event (node start, dump time):
+        the in-band timebase reference that lets a fleet collector merge
+        this node's monotonic timestamps with other nodes' on one wall
+        axis even when it never saw the live RPC anchor."""
+        self.record("node", "clock_anchor", wall_ns=time.time_ns(), **fields)
 
     # A crash-looping task (e.g. a reactor dying on every redial) must not
     # turn the black box into a write amplifier: every crash is counted and
@@ -79,6 +110,9 @@ class FlightRecorder:
     def set_metrics(self, rm) -> None:
         self._metrics = rm
 
+    def set_moniker(self, moniker: str) -> None:
+        self.moniker = moniker or ""
+
     def resize(self, maxlen: int) -> None:
         if maxlen > 0 and maxlen != self._ring.maxlen:
             self._ring = deque(self._ring, maxlen=maxlen)
@@ -89,20 +123,51 @@ class FlightRecorder:
     def dump_path(self) -> str | None:
         return self._dump_path
 
-    def snapshot(self, limit: int | None = None, subsystem: str | None = None) -> list[dict]:
+    @property
+    def total(self) -> int:
+        """Events ever recorded (the highest seq handed out)."""
+        ring = self._ring
+        try:
+            newest = ring[-1][0] if ring else 0
+        except IndexError:  # concurrent pop-through-eviction
+            newest = 0
+        return max(self._last_seq, newest)
+
+    @property
+    def total_dropped(self) -> int:
+        """Events evicted from the ring, ever. An incremental reader whose
+        cursor predates `total - len(ring)` has a gap it can report."""
+        return max(0, self.total - len(self._ring))
+
+    def snapshot(
+        self,
+        limit: int | None = None,
+        subsystem: str | None = None,
+        since_ns: int | None = None,
+        since_seq: int | None = None,
+    ) -> list[dict]:
         """Ring contents as dicts, oldest first (chronological — the last
-        entries of a dump are the events nearest the failure)."""
+        entries of a dump are the events nearest the failure). `since_ns`
+        / `since_seq` are incremental-scrape cursors: only events
+        strictly after them are returned. Prefer `since_seq` (the last
+        `seq` seen): seq strictly increases per event, while a coarse
+        monotonic clock can stamp several events with one tick — a
+        time cursor silently skips the later ones."""
         events = list(self._ring.copy())
+        if since_ns is not None:
+            events = [e for e in events if e[1] > since_ns]
+        if since_seq is not None:
+            events = [e for e in events if e[0] > since_seq]
         if subsystem is not None:
-            events = [e for e in events if e[1] == subsystem]
+            events = [e for e in events if e[2] == subsystem]
         if limit is not None and limit >= 0:
             events = events[-limit:] if limit else []  # [-0:] is the whole list
         return [self._to_dict(e) for e in events]
 
     @staticmethod
     def _to_dict(e: tuple) -> dict:
-        t, sub, kind, fields = e
-        d: dict = {"t_mono_ns": t, "sub": sub, "kind": kind}
+        seq, t, sub, kind, fields = e
+        d: dict = {"seq": seq, "t_mono_ns": t, "sub": sub, "kind": kind}
         if fields:
             d["fields"] = fields
         return d
@@ -148,7 +213,13 @@ class FlightRecorder:
             "t_mono_ns": time.monotonic_ns(),
             # operator-facing postmortem timestamp; never consensus input
             "t_wall": time.time(),
+            # the dump-time mono↔wall anchor + node identity: merged
+            # multi-node dumps stay attributable and re-timebasable
+            "anchor": clock_anchor(),
+            "moniker": self.moniker,
             "events": len(events),
+            "total": self.total,
+            "total_dropped": self.total_dropped,
             "crashes": self.crashes,
         }
         lines = [json.dumps(header, default=str)]

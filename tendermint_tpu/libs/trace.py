@@ -146,8 +146,13 @@ class Tracer:
         max_traces: int = 64,
         enabled: bool = True,
         export_group=None,
+        moniker: str = "",
     ) -> None:
         self.enabled = enabled
+        # node identity stamped on every completed root span: merged
+        # multi-node trace JSONL stays attributable per line
+        self.moniker = moniker
+        self.completed = 0  # root spans ever completed (ring may evict)
         self._ring: deque[Span] = deque(maxlen=max_traces)
         self._group = export_group
         self._lock = threading.Lock()
@@ -197,7 +202,10 @@ class Tracer:
     # -- completion / reads -------------------------------------------------
 
     def _complete(self, root: Span) -> None:
+        if self.moniker and "node" not in root.attrs:
+            root.attrs["node"] = self.moniker
         with self._lock:
+            self.completed += 1
             self._ring.append(root)
             if self._group is not None:
                 try:
@@ -208,16 +216,38 @@ class Tracer:
                 except Exception:  # noqa: BLE001 — export must never break
                     pass  # the traced operation
 
-    def traces(self, limit: int | None = None, name: str | None = None) -> list[dict]:
-        """Completed traces as dicts, newest first."""
+    def traces(
+        self,
+        limit: int | None = None,
+        name: str | None = None,
+        since_ns: int | None = None,
+    ) -> list[dict]:
+        """Completed traces as dicts, newest first. `since_ns` is the
+        incremental-scrape cursor (monotonic ns, same timebase as the
+        flight recorder): only traces that COMPLETED strictly after it
+        are returned. Completion — not start — is when a trace becomes
+        readable here, so a trace in flight across a poll boundary is
+        still returned to the next poll instead of vanishing between
+        cursors (pollers use the response anchor's `mono_ns` as the
+        cursor)."""
         with self._lock:
             items = list(self._ring)
         items.reverse()
         if name is not None:
             items = [s for s in items if s.name == name]
+        if since_ns is not None:
+            items = [
+                s for s in items
+                if s.end is not None and s.end * 1e9 > since_ns
+            ]
         if limit is not None:
             items = items[:limit]
         return [s.to_dict() for s in items]
+
+    @property
+    def dropped(self) -> int:
+        """Completed traces evicted from the ring, ever."""
+        return max(0, self.completed - len(self._ring))
 
     def flush(self) -> None:
         if self._group is not None:
@@ -335,6 +365,24 @@ class DeviceTelemetry:
         self.breaker_retry_in_s = 0.0
         self.last_batch: dict = {}
         self._metrics = None
+        # occupancy accounting (ISSUE 6): how busy is the device actually
+        # kept — the admission data the unified dispatch scheduler
+        # (ROADMAP item 1) will consume. busy time is the wall span each
+        # verify call spends with work outstanding on the device
+        # (dispatch start -> last verdict fetched); idle is everything
+        # else since the first dispatch. queue depth is chunks in flight
+        # per call (today one caller dispatches at a time; the scheduler
+        # will make this a real admission queue).
+        self._occ_origin_ns = 0  # mono ns of the first dispatch window
+        self.busy_ns = 0
+        self.busy_windows = 0
+        self.queue_depth = 0
+        self.peak_queue_depth = 0
+        # work verified on the host because routing said the device
+        # would lose (below threshold / no accelerator) — distinct from
+        # cpu_fallbacks, which are device FAILURES
+        self.cpu_route_batches = 0
+        self.cpu_route_sigs = 0
 
     def set_metrics(self, dm) -> None:
         self._metrics = dm
@@ -380,6 +428,49 @@ class DeviceTelemetry:
         if dm is not None:
             dm.cpu_fallbacks_total.inc(reason=reason, curve=curve)
 
+    def record_busy(self, seconds: float, queue_depth: int = 1) -> None:
+        """One verify call's device-busy window: `seconds` of wall time
+        with work outstanding (dispatch + fetch), `queue_depth` chunks in
+        flight. Feeds the occupancy snapshot and the
+        `tm_device_occupancy_*` series."""
+        ns = max(0, int(seconds * 1e9))
+        with self._lock:
+            if self._occ_origin_ns == 0:
+                self._occ_origin_ns = time.monotonic_ns() - ns
+            self.busy_ns += ns
+            self.busy_windows += 1
+            self.queue_depth = queue_depth
+            self.peak_queue_depth = max(self.peak_queue_depth, queue_depth)
+            frac = self._busy_frac_locked()
+        dm = self._metrics
+        if dm is not None:
+            dm.occ_busy_seconds_total.inc(seconds)
+            dm.occ_queue_depth.set(queue_depth)
+            dm.occ_busy_frac.set(frac)
+            dm.occ_fill_ratio.set(self._fill_ratio())
+
+    def record_cpu_route(self, n: int, curve: str = "ed25519") -> None:
+        """A batch the router sent to the HOST paths (below the device
+        threshold, or no accelerator at all): counted so an all-CPU node
+        still reports explicit work accounting instead of an ambiguous
+        all-zero device snapshot."""
+        with self._lock:
+            self.cpu_route_batches += 1
+            self.cpu_route_sigs += n
+        dm = self._metrics
+        if dm is not None:
+            dm.occ_cpu_route_sigs_total.inc(n, curve=curve)
+
+    def _busy_frac_locked(self) -> float:
+        elapsed = time.monotonic_ns() - self._occ_origin_ns
+        if self._occ_origin_ns == 0 or elapsed <= 0:
+            return 0.0
+        return min(1.0, self.busy_ns / elapsed)
+
+    def _fill_ratio(self) -> float:
+        lanes = self.lanes_dispatched + self.lanes_padded
+        return self.lanes_dispatched / lanes if lanes else 0.0
+
     def record_breaker(self, tripped: bool, retry_in_s: float = 0.0) -> None:
         with self._lock:
             changed = tripped != self.breaker_tripped
@@ -398,6 +489,11 @@ class DeviceTelemetry:
 
     def snapshot(self) -> dict:
         with self._lock:
+            elapsed_ns = (
+                time.monotonic_ns() - self._occ_origin_ns
+                if self._occ_origin_ns
+                else 0
+            )
             return {
                 "dispatches": self.dispatches,
                 "lanes_dispatched": self.lanes_dispatched,
@@ -411,6 +507,20 @@ class DeviceTelemetry:
                     "retry_in_s": round(self.breaker_retry_in_s, 3),
                 },
                 "last_batch": dict(self.last_batch),
+                "occupancy": {
+                    "busy_s": round(self.busy_ns / 1e9, 6),
+                    "elapsed_s": round(elapsed_ns / 1e9, 6),
+                    "busy_frac": round(self._busy_frac_locked(), 6),
+                    "busy_windows": self.busy_windows,
+                    "queue_depth": self.queue_depth,
+                    "peak_queue_depth": self.peak_queue_depth,
+                    "fill_ratio": round(self._fill_ratio(), 6),
+                    "pad_lanes": self.lanes_padded,
+                    "cpu_route": {
+                        "batches": self.cpu_route_batches,
+                        "sigs": self.cpu_route_sigs,
+                    },
+                },
             }
 
 

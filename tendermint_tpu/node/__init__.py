@@ -100,6 +100,9 @@ class Node(BaseService):
         # (watchdog stall / task crash / SIGUSR1 / stop-after-crash) append
         # to a rotating JSONL file next to the trace export
         RECORDER.resize(cfg.instrumentation.flight_recorder_ring)
+        # node identity on every dump header / debug RPC read: merged
+        # multi-node captures stay attributable (ISSUE 6 satellite)
+        RECORDER.set_moniker(cfg.base.moniker)
         self._recorder_dump_path = None
         if cfg.instrumentation.flight_recorder_dump_file:
             self._recorder_dump_path = cfg._abs(
@@ -219,6 +222,7 @@ class Node(BaseService):
             self.tracer = tmtrace.Tracer(
                 max_traces=cfg.instrumentation.trace_ring,
                 export_group=export_group,
+                moniker=cfg.base.moniker,
             )
             # device spans opened outside an active consensus span (pool
             # threads, benches sharing the process) root here too
@@ -438,6 +442,10 @@ class Node(BaseService):
         except (NotImplementedError, ValueError, RuntimeError, AttributeError):
             pass
         RECORDER.record("node", "start", moniker=self.config.base.moniker)
+        # startup mono↔wall anchor: the in-band timebase reference the
+        # fleet collector uses to merge this node's monotonic timestamps
+        # with other nodes' (another anchor rides every dump header)
+        RECORDER.record_anchor(moniker=self.config.base.moniker)
         # RPC first (reference node.go:729 — receive txs before p2p is up)
         await self.rpc_server.start()
         if self.grpc_server is not None:

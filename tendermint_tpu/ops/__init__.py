@@ -161,6 +161,12 @@ def _ed25519_small(pubs, msgs, sigs):
 
 def _ed25519_backend(pubs, msgs, sigs):
     if len(pubs) < effective_min_batch():
+        # explicit occupancy accounting for the host route: an all-CPU
+        # node (no accelerator, or every batch sub-threshold) reports
+        # WHY the device counters are zero instead of an ambiguous blank
+        from tendermint_tpu.libs import trace as _trace
+
+        _trace.DEVICE.record_cpu_route(len(pubs))
         return _ed25519_small(pubs, msgs, sigs)
     from tendermint_tpu.ops import ed25519_batch
 
@@ -194,6 +200,9 @@ def _secp256k1_small(pubs, msgs, sigs):
 
 def _secp256k1_backend(pubs, msgs, sigs):
     if len(pubs) < effective_min_batch():
+        from tendermint_tpu.libs import trace as _trace
+
+        _trace.DEVICE.record_cpu_route(len(pubs), curve="secp256k1")
         return _secp256k1_small(pubs, msgs, sigs)
     from tendermint_tpu.ops import secp_batch
 

@@ -835,6 +835,12 @@ def _verify_batch_device(pubs, msgs, sigs, n, kcache, sp) -> list[bool]:
         else:
             ok = got[: hi - lo]
         out[lo:hi] = ok & mask
+    if pending:
+        # occupancy: this call held the device busy from first dispatch
+        # to last verdict fetched, with len(pending) chunks in flight
+        _trace.DEVICE.record_busy(
+            (time.monotonic() - t_dispatch0), queue_depth=len(pending)
+        )
     if timed_out:
         # first wedge observation trips the breaker: later calls skip the
         # device until the retry deadline (the half-open probe re-enters

@@ -366,6 +366,11 @@ def _verify_batch_device(pubs, msgs, sigs, n, fn, mfn, sharding, kcache, sp) -> 
             out[lo:hi] = _serial_verify(pubs[lo:hi], msgs[lo:hi], sigs[lo:hi])
         else:
             out[lo:hi] = got[: hi - lo] & mask
+    if pending:
+        # occupancy: dispatch-to-last-verdict wall span, chunks in flight
+        _trace.DEVICE.record_busy(
+            (_time.monotonic() - t_dispatch0), queue_depth=len(pending)
+        )
     if timed_out:
         _edb.breaker.trip()
         _trace.DEVICE.record_timeout(curve="secp256k1")

@@ -40,6 +40,17 @@ def _unhex(s) -> bytes:
         raise RPCError(INVALID_PARAMS, f"bad hex: {e}")
 
 
+def _cursor_arg(since_ns) -> int | None:
+    """Validate an incremental-scrape cursor (monotonic ns int, 0/None =
+    full window). The URI transport delivers ints as strings."""
+    if since_ns in (None, "", 0, "0"):
+        return None
+    try:
+        return int(since_ns)
+    except (TypeError, ValueError):
+        raise RPCError(INVALID_PARAMS, "since_ns must be an int")
+
+
 def _tx_arg(tx) -> bytes:
     """Accept hex (our convention) or base64 (reference compat)."""
     if isinstance(tx, (bytes, bytearray)):
@@ -466,10 +477,20 @@ class Environment:
     # debug/observability routes (no reference analog — the TPU data
     # plane's "why was height H slow" surface; see docs/observability.md)
 
-    async def debug_consensus_trace(self, n: int = 10) -> dict:
+    async def debug_consensus_trace(
+        self, n: int = 10, since_ns: int | None = None
+    ) -> dict:
         """Last N completed height traces from the consensus tracer: one
         span tree per height (propose/prevote/precommit/commit/... steps
-        with nested batch_verify / ed25519_batch / apply_block spans)."""
+        with nested batch_verify / ed25519_batch / apply_block spans).
+
+        Incremental scrape: `since_ns` (monotonic ns, this node's
+        timebase) returns only traces that STARTED after the cursor, and
+        `total`/`total_dropped` let the caller detect ring overrun. The
+        `anchor` is a fresh mono↔wall pair so an off-node reader (the
+        fleet collector) can place every monotonic `t0` on wall time."""
+        from tendermint_tpu.libs.recorder import clock_anchor
+
         cs = self.consensus_state
         tracer = getattr(cs, "tracer", None)
         if tracer is None or not tracer.enabled:
@@ -478,7 +499,15 @@ class Environment:
             n = max(1, min(int(n), 100))
         except (TypeError, ValueError):
             raise RPCError(INVALID_PARAMS, "n must be an int")
-        out = {"enabled": True, "traces": tracer.traces(limit=n, name="height")}
+        since_ns = _cursor_arg(since_ns)
+        out = {
+            "enabled": True,
+            "moniker": tracer.moniker,
+            "anchor": clock_anchor(),
+            "total": tracer.completed,
+            "total_dropped": tracer.dropped,
+            "traces": tracer.traces(limit=n, name="height", since_ns=since_ns),
+        }
         active = getattr(cs, "_height_span", None)
         if active is not None and active.end is None:
             out["active"] = active.to_dict()
@@ -499,14 +528,35 @@ class Environment:
 
     async def debug_device(self) -> dict:
         """Device data-plane health: dispatch/pad/fetch counters, CPU
-        fallbacks, and the wedged-device circuit breaker state."""
-        return self._device_snapshot()
+        fallbacks, occupancy (busy/idle, queue depth, fill ratio,
+        host-route work), and the wedged-device circuit breaker state."""
+        from tendermint_tpu.libs.recorder import RECORDER, clock_anchor
 
-    async def debug_flight_recorder(self, n: int = 200, subsystem: str | None = None) -> dict:
+        snap = self._device_snapshot()
+        snap["moniker"] = RECORDER.moniker
+        snap["anchor"] = clock_anchor()
+        return snap
+
+    async def debug_flight_recorder(
+        self,
+        n: int = 200,
+        subsystem: str | None = None,
+        since_ns: int | None = None,
+        since_seq: int | None = None,
+    ) -> dict:
         """The black box (libs/recorder.py): the last N structured events
         across p2p/mempool/consensus/state/wal/device/runtime, oldest
-        first, plus crash/dump counters. Always available."""
-        from tendermint_tpu.libs.recorder import RECORDER
+        first, plus crash/dump counters. Always available.
+
+        Incremental scrape: pass the last `seq` seen as `since_seq`
+        (exact — seq strictly increases per event) or the newest
+        `t_mono_ns` as `since_ns`, and only newer events come back
+        (capped at n<=2000, so a poller re-reads a bounded window, never
+        the whole ring); `total`/`total_dropped` let the caller detect
+        events evicted between polls. `anchor` is a fresh mono↔wall pair
+        for cross-node timebase normalization; `moniker` disambiguates
+        merged multi-node captures."""
+        from tendermint_tpu.libs.recorder import RECORDER, clock_anchor
 
         try:
             n = max(1, min(int(n), 2000))
@@ -515,7 +565,16 @@ class Environment:
         return {
             "crashes": RECORDER.crashes,
             "dumps": RECORDER.dumps,
-            "events": RECORDER.snapshot(limit=n, subsystem=subsystem),
+            "moniker": RECORDER.moniker,
+            "anchor": clock_anchor(),
+            "total": RECORDER.total,
+            "total_dropped": RECORDER.total_dropped,
+            "events": RECORDER.snapshot(
+                limit=n,
+                subsystem=subsystem,
+                since_ns=_cursor_arg(since_ns),
+                since_seq=_cursor_arg(since_seq),
+            ),
         }
 
     # ------------------------------------------------------------------

@@ -1,0 +1,161 @@
+"""bench_compare — make bench records comparable across rounds (ISSUE 6).
+
+The bench trajectory has been empty because no tool ever compared two
+records: `BENCH_r01.json` carries a driver wrapper (`{"parsed": {...}}`),
+`benchmarks/quick_bench.py` prints bare record lines and banks the latest
+to `tunnel_watch/banked_quick.json`, and degraded rounds carry
+`"parsed": null`. This tool loads any two of those shapes, joins records
+by metric name, prints per-config deltas, and exits nonzero when any
+metric regressed by more than the threshold (default 10%) — so CI can
+gate on it whenever two comparable records exist.
+
+Record shapes accepted per file:
+- driver wrapper: `{"parsed": {"metric": ..., "value": ...}, ...}`
+  (`parsed: null` = a degraded round with nothing to compare);
+- bare record: `{"metric": ..., "value": ..., "unit": ...}`
+  (quick_bench output line / `banked_quick.json`);
+- JSONL / concatenated JSON lines of bare records (a quick_bench run
+  with several sizes).
+
+All tracked metrics are rates (verifies/s, tx/s) — higher is better.
+`--lower-is-better` flips the direction for latency-style records.
+
+Usage:
+    python -m tendermint_tpu.tools.bench_compare OLD NEW [--threshold 0.10]
+Exit codes: 0 ok / no overlap, 1 regression past threshold, 2 bad input.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _records_from_obj(obj) -> list[dict]:
+    if obj is None:
+        return []
+    if isinstance(obj, list):
+        out = []
+        for item in obj:
+            out.extend(_records_from_obj(item))
+        return out
+    if not isinstance(obj, dict):
+        return []
+    if "metric" in obj and "value" in obj:
+        return [obj]
+    if "parsed" in obj:  # driver wrapper; parsed may be null (degraded run)
+        return _records_from_obj(obj["parsed"])
+    return []
+
+
+def load_records(path: str) -> dict[str, dict]:
+    """{metric name: record} from any accepted shape. The whole file is
+    tried as one JSON document first, then line-by-line as JSONL."""
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    try:
+        records = _records_from_obj(json.loads(text))
+    except ValueError:
+        records = []
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.extend(_records_from_obj(json.loads(line)))
+            except ValueError:
+                continue
+    out = {}
+    for r in records:
+        try:
+            out[str(r["metric"])] = dict(r, value=float(r["value"]))
+        except (KeyError, TypeError, ValueError):
+            continue
+    return out
+
+
+def compare(old: dict[str, dict], new: dict[str, dict],
+            threshold: float = 0.10, lower_is_better: bool = False) -> dict:
+    """Per-metric deltas over the intersection. A regression is a change
+    past `threshold` in the bad direction."""
+    rows = []
+    regressions = []
+    for metric in sorted(set(old) & set(new)):
+        ov, nv = old[metric]["value"], new[metric]["value"]
+        if ov == 0:
+            continue
+        delta = (nv - ov) / abs(ov)
+        regressed = (delta < -threshold) if not lower_is_better else (
+            delta > threshold
+        )
+        rows.append({
+            "metric": metric,
+            "old": ov,
+            "new": nv,
+            "delta_pct": round(delta * 100.0, 2),
+            "regressed": regressed,
+            "unit": new[metric].get("unit") or old[metric].get("unit") or "",
+        })
+        if regressed:
+            regressions.append(metric)
+    return {
+        "rows": rows,
+        "regressions": regressions,
+        "only_old": sorted(set(old) - set(new)),
+        "only_new": sorted(set(new) - set(old)),
+        "threshold_pct": round(threshold * 100.0, 2),
+    }
+
+
+def render(result: dict) -> str:
+    lines = []
+    for r in result["rows"]:
+        flag = "REGRESSED" if r["regressed"] else "ok"
+        lines.append(
+            f"{r['metric']:<48} {r['old']:>14,.1f} -> {r['new']:>14,.1f} "
+            f"{r['unit']:<12} {r['delta_pct']:>+8.2f}%  {flag}"
+        )
+    for m in result["only_old"]:
+        lines.append(f"{m:<48} (dropped from new record)")
+    for m in result["only_new"]:
+        lines.append(f"{m:<48} (new metric, no baseline)")
+    if not result["rows"]:
+        lines.append("no overlapping metrics to compare "
+                     "(degraded round or disjoint configs)")
+    elif result["regressions"]:
+        lines.append(
+            f"FAIL: {len(result['regressions'])} metric(s) regressed "
+            f">{result['threshold_pct']}%: {', '.join(result['regressions'])}"
+        )
+    else:
+        lines.append(f"ok: no regression past {result['threshold_pct']}%")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tendermint_tpu.tools.bench_compare",
+        description="compare two bench records; nonzero exit on regression",
+    )
+    ap.add_argument("old", help="baseline record (BENCH_*.json / "
+                                "banked_quick.json / quick_bench JSONL)")
+    ap.add_argument("new", help="candidate record, same shapes")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="regression threshold as a fraction (default 0.10)")
+    ap.add_argument("--lower-is-better", action="store_true",
+                    help="treat increases as regressions (latency records)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the comparison as JSON instead of text")
+    args = ap.parse_args(argv)
+    try:
+        old, new = load_records(args.old), load_records(args.new)
+    except OSError as e:
+        print(f"bench_compare: {e}", file=sys.stderr)
+        return 2
+    result = compare(old, new, args.threshold, args.lower_is_better)
+    print(json.dumps(result, indent=1) if args.json else render(result))
+    return 1 if result["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,726 @@
+"""Fleet collector — the cross-node observability plane (ISSUE 6).
+
+PRs 1 and 3 gave each node a rich but strictly per-process view (trace
+spans, flight-recorder ring, live Prometheus series). This tool answers
+the questions no single node can: where does commit latency go BETWEEN
+validators, how fast do votes propagate, and how busy is the device
+actually kept.
+
+It concurrently scrapes every node's `status` / `health` / `validators` /
+`debug_consensus_trace` / `debug_flight_recorder` / `debug_device` routes
+(plus `/metrics` when the Prometheus endpoints are given), normalizes
+each node's private monotonic timebase onto shared wall time using the
+mono↔wall anchors every response carries (`libs/recorder.clock_anchor`;
+the same anchors ride node-start events and dump headers), and stitches
+**per-height distributed timelines**:
+
+    proposal origin
+      → per-peer vote-arrival matrix   (validator index × observing node,
+                                        prevote + precommit, from the
+                                        VoteSet "vote" tap)
+      → 2/3 threshold per node          (the VoteSet "maj23" tap)
+      → commit per node                 (the "commit" tap)
+
+with per-phase and gossip-propagation percentiles, plus a per-node
+device-occupancy summary (busy/idle, queue depth, batch fill ratio, pad
+waste, host-route work) from `debug_device`.
+
+Incremental scrape: `FleetCollector.poll()` passes each node's newest
+`t_mono_ns` back as the `since_ns` cursor, so repeated polls read only
+new events instead of the whole ring, and detects ring overrun via
+`total_dropped`/`seq` gaps.
+
+Usage:
+    python -m tendermint_tpu.tools.collector --report \
+        http://127.0.0.1:26657 http://127.0.0.1:26659 [...]
+        [--metrics http://127.0.0.1:26660 ...] [--json fleet.json]
+        [--check] [--commit-spread-s 2.0]
+
+`--check` exits nonzero when a cross-node invariant is violated (all
+validators commit each stitched height within the bound; no vote older
+than one round in flight) — `networks/local/proc_testnet.py`'s
+`timeline` scenario drives exactly this end to end.
+
+The stitching core (`normalize_events`, `stitch`, `build_report`) is
+pure dict→dict so canned multi-node captures (tests/test_collector.py's
+skewed-clock fixture) exercise it without any live node.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+PREVOTE, PRECOMMIT = 1, 2  # types.vote.VoteType values
+TYPE_NAMES = {PREVOTE: "prevote", PRECOMMIT: "precommit"}
+
+# RPC routes scraped per node, with their query args
+ROUTES = ("status", "health", "validators", "debug_device",
+          "debug_consensus_trace", "debug_flight_recorder")
+
+
+# ---------------------------------------------------------------- scraping
+
+
+def _get_json(url: str, timeout: float) -> dict:
+    """GET one URI-transport RPC; raises on transport/RPC errors."""
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        body = json.loads(r.read())
+    if "result" not in body:
+        raise RuntimeError(f"rpc error: {body.get('error')}")
+    return body["result"]
+
+
+def scrape_node(endpoint: str, cursor: dict | None = None,
+                timeout: float = 5.0) -> dict:
+    """Scrape every observability route of one node. Each route fails
+    independently (a half-up node still contributes what it can); the
+    result always carries `endpoint` and `ok` (True when the recorder
+    route — the one the stitcher needs — answered). `cursor` carries the
+    incremental-scrape positions: `seq` (exact recorder cursor — seq
+    strictly increases per event, where a coarse monotonic clock can
+    stamp several events with one tick), `ns` (time fallback for nodes
+    whose events carry no seq), `trace_ns` (trace-completion cursor)."""
+    ep = endpoint.rstrip("/")
+    cursor = cursor or {}
+    out: dict = {"endpoint": ep, "ok": False, "errors": {}}
+    args = {
+        "debug_consensus_trace": f"?n=100&since_ns={cursor.get('trace_ns', 0)}",
+        "debug_flight_recorder": (
+            f"?n=2000&since_seq={cursor.get('seq', 0)}"
+            f"&since_ns={cursor.get('ns', 0)}"
+        ),
+    }
+    for route in ROUTES:
+        try:
+            out[route] = _get_json(f"{ep}/{route}{args.get(route, '')}", timeout)
+        except Exception as e:  # noqa: BLE001 — per-route isolation
+            out[route] = None
+            out["errors"][route] = repr(e)
+    out["ok"] = out["debug_flight_recorder"] is not None
+    return out
+
+
+def scrape_metrics(endpoint: str, timeout: float = 5.0) -> dict[str, float]:
+    """Parse a Prometheus text 0.0.4 exposition into {series: value}."""
+    with urllib.request.urlopen(
+        f"{endpoint.rstrip('/')}/metrics", timeout=timeout
+    ) as r:
+        text = r.read().decode()
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, _, val = line.rpartition(" ")
+        try:
+            out[name] = float(val)
+        except ValueError:
+            continue
+    return out
+
+
+def scrape_fleet(endpoints: list[str], metrics: list[str] | None = None,
+                 cursors: dict[str, dict] | None = None,
+                 timeout: float = 5.0) -> list[dict]:
+    """Concurrently scrape every node (one worker per node; each worker
+    walks its node's routes). Returns one scrape dict per endpoint, in
+    input order, with `metrics` attached when a matching Prometheus
+    endpoint was given."""
+    cursors = cursors or {}
+    with ThreadPoolExecutor(max_workers=max(1, len(endpoints))) as pool:
+        futs = [
+            pool.submit(scrape_node, ep, cursors.get(ep), timeout)
+            for ep in endpoints
+        ]
+        mfuts = [
+            pool.submit(scrape_metrics, mep, timeout)
+            for mep in (metrics or [])
+        ]
+        scrapes = [f.result() for f in futs]
+        for i, mf in enumerate(mfuts):
+            if i >= len(scrapes):
+                break
+            try:
+                scrapes[i]["metrics"] = mf.result()
+            except Exception as e:  # noqa: BLE001 — metrics are optional
+                scrapes[i]["metrics"] = None
+                scrapes[i]["errors"]["metrics"] = repr(e)
+    return scrapes
+
+
+# ------------------------------------------------- timebase normalization
+
+
+def node_name(scrape: dict) -> str:
+    """Stable display name: recorder moniker, else status moniker, else
+    the endpoint."""
+    fr = scrape.get("debug_flight_recorder") or {}
+    if fr.get("moniker"):
+        return fr["moniker"]
+    st = scrape.get("status") or {}
+    moniker = (st.get("node_info") or {}).get("moniker")
+    return moniker or scrape.get("endpoint", "?")
+
+
+def wall_offset_ns(scrape: dict) -> int | None:
+    """wall_ns - mono_ns for this node, from the freshest anchor in the
+    scrape (every debug route answers with one); falls back to in-band
+    `clock_anchor` events (node start / dump headers) for canned
+    captures that never saw a live RPC anchor."""
+    for route in ("debug_flight_recorder", "debug_consensus_trace",
+                  "debug_device"):
+        part = scrape.get(route) or {}
+        a = part.get("anchor")
+        if a and "wall_ns" in a and "mono_ns" in a:
+            return int(a["wall_ns"]) - int(a["mono_ns"])
+    # in-band fallback: the newest clock_anchor event in the ring
+    events = (scrape.get("debug_flight_recorder") or {}).get("events") or []
+    for e in reversed(events):
+        if e.get("kind") == "clock_anchor" and "wall_ns" in e.get("fields", {}):
+            return int(e["fields"]["wall_ns"]) - int(e["t_mono_ns"])
+    return None
+
+
+def normalize_events(scrape: dict) -> list[dict]:
+    """Recorder events with a `t_wall_ns` stamp on the shared wall
+    timebase. Nodes with no usable anchor contribute nothing (their
+    monotonic origins are arbitrary — mixing them in would corrupt every
+    cross-node latency)."""
+    off = wall_offset_ns(scrape)
+    if off is None:
+        return []
+    out = []
+    for e in (scrape.get("debug_flight_recorder") or {}).get("events") or []:
+        d = dict(e)
+        d["t_wall_ns"] = int(e["t_mono_ns"]) + off
+        out.append(d)
+    return out
+
+
+# ------------------------------------------------------ timeline stitching
+
+
+def _pctl(sorted_xs: list[float], q: float) -> float:
+    if not sorted_xs:
+        return 0.0
+    idx = min(len(sorted_xs) - 1, max(0, int(round(q * (len(sorted_xs) - 1)))))
+    return sorted_xs[idx]
+
+
+def percentiles_ms(xs_ns: list[int]) -> dict:
+    """{p50, p90, max} in ms from a list of ns durations."""
+    xs = sorted(x / 1e6 for x in xs_ns)
+    return {
+        "n": len(xs),
+        "p50_ms": round(_pctl(xs, 0.5), 3),
+        "p90_ms": round(_pctl(xs, 0.9), 3),
+        "max_ms": round(xs[-1], 3) if xs else 0.0,
+    }
+
+
+def stitch(scrapes: list[dict],
+           extra_events: dict[str, list[dict]] | None = None) -> dict:
+    """Merge normalized per-node event streams into per-height
+    distributed timelines. `extra_events` maps node name → events
+    accumulated by earlier incremental polls (FleetCollector)."""
+    heights: dict[int, dict] = {}
+
+    def h_entry(h: int) -> dict:
+        return heights.setdefault(h, {
+            "proposal": None,          # {"t_wall_ns", "node", "round"}
+            "rounds": {},              # r -> type name -> votes/maj23/recv
+            "commit": {},              # node -> {"t_wall_ns", "round", ...}
+            "new_height": {},          # node -> t_wall_ns
+        })
+
+    def r_entry(h: int, r: int, tname: str) -> dict:
+        rounds = h_entry(h)["rounds"]
+        return rounds.setdefault(r, {}).setdefault(tname, {
+            "votes": {},   # val idx -> node -> t_wall_ns (first COUNT)
+            "recv": {},    # val idx -> node -> t_wall_ns (first gossip receipt)
+            "maj23": {},   # node -> t_wall_ns
+        })
+
+    observers = []
+    for scrape in scrapes:
+        node = node_name(scrape)
+        events = normalize_events(scrape)
+        if extra_events and node in extra_events:
+            events = extra_events[node] + events
+        if not events:
+            continue
+        observers.append(node)
+        for e in events:
+            if e.get("sub") != "consensus":
+                continue
+            f = e.get("fields") or {}
+            kind, t = e.get("kind"), e["t_wall_ns"]
+            h = f.get("height")
+            if h is None:
+                continue
+            if kind == "proposal":
+                cur = h_entry(h)["proposal"]
+                if cur is None or t < cur["t_wall_ns"]:
+                    h_entry(h)["proposal"] = {
+                        "t_wall_ns": t, "node": node, "round": f.get("round", 0),
+                    }
+            elif kind in ("vote", "vote_recv"):
+                tname = TYPE_NAMES.get(f.get("type"))
+                if tname is None:
+                    continue
+                slot = "votes" if kind == "vote" else "recv"
+                cell = r_entry(h, f.get("round", 0), tname)[slot]
+                per_node = cell.setdefault(f.get("val", -1), {})
+                if node not in per_node or t < per_node[node]:
+                    per_node[node] = t
+            elif kind == "maj23":
+                tname = TYPE_NAMES.get(f.get("type"))
+                if tname is None:
+                    continue
+                m = r_entry(h, f.get("round", 0), tname)["maj23"]
+                if node not in m or t < m[node]:
+                    m[node] = t
+            elif kind == "commit":
+                c = h_entry(h)["commit"]
+                if node not in c or t < c[node]["t_wall_ns"]:
+                    c[node] = {
+                        "t_wall_ns": t, "round": f.get("round", 0),
+                        "txs": f.get("txs"),
+                    }
+            elif kind == "new_height":
+                nh = h_entry(h)["new_height"]
+                if node not in nh or t < nh[node]:
+                    nh[node] = t
+    return {"heights": heights, "observers": observers}
+
+
+def analyze_height(h: int, entry: dict, observers: list[str],
+                   n_validators: int) -> dict:
+    """Derived view of one stitched height: matrix completeness, phase
+    latencies (earliest observation across nodes per edge), commit
+    spread."""
+    commits = entry["commit"]
+    commit_round = max((c["round"] for c in commits.values()), default=0)
+    rd = entry["rounds"].get(commit_round, {})
+    matrix_complete = {}
+    for tname in ("prevote", "precommit"):
+        votes = rd.get(tname, {}).get("votes", {})
+        matrix_complete[tname] = bool(observers) and n_validators > 0 and all(
+            set(votes.get(v, {})) >= set(observers)
+            for v in range(n_validators)
+        )
+    first = {}
+    prop = entry["proposal"]
+    if prop:
+        first["proposal"] = prop["t_wall_ns"]
+    for tname in ("prevote", "precommit"):
+        m = rd.get(tname, {}).get("maj23", {})
+        if m:
+            first[f"{tname}_maj23"] = min(m.values())
+    if commits:
+        first["commit"] = min(c["t_wall_ns"] for c in commits.values())
+    phases = {}
+    edges = [("proposal", "prevote_maj23", "propose_to_prevote_maj23_ms"),
+             ("prevote_maj23", "precommit_maj23",
+              "prevote_maj23_to_precommit_maj23_ms"),
+             ("precommit_maj23", "commit", "precommit_maj23_to_commit_ms"),
+             ("proposal", "commit", "propose_to_commit_ms")]
+    for a, b, label in edges:
+        if a in first and b in first:
+            phases[label] = round((first[b] - first[a]) / 1e6, 3)
+    commit_spread_ms = 0.0
+    if len(commits) > 1:
+        ts = [c["t_wall_ns"] for c in commits.values()]
+        commit_spread_ms = round((max(ts) - min(ts)) / 1e6, 3)
+    return {
+        "height": h,
+        "commit_round": commit_round,
+        "committed_on": sorted(commits),
+        "commit_spread_ms": commit_spread_ms,
+        "matrix_complete": matrix_complete,
+        "stitched": bool(commits) and all(matrix_complete.values()),
+        "phases": phases,
+    }
+
+
+def propagation_stats(heights: dict) -> dict:
+    """Gossip-propagation percentiles: for every vote observed by 2+
+    nodes, the spread between its first and last COUNT across the fleet
+    — the cross-node cost the <5 ms north star has to beat. `recv_lag`
+    is gossip-vs-verify attribution: receipt (reactor tap) to counted
+    (VoteSet tap) on the same node."""
+    spreads = {"prevote": [], "precommit": []}
+    recv_lags = {"prevote": [], "precommit": []}
+    for entry in heights.values():
+        for rd in entry["rounds"].values():
+            for tname, cell in rd.items():
+                for val, per_node in cell.get("votes", {}).items():
+                    ts = list(per_node.values())
+                    if len(ts) > 1:
+                        spreads[tname].append(max(ts) - min(ts))
+                    for node, t_recv in cell.get("recv", {}).get(val, {}).items():
+                        t_count = per_node.get(node)
+                        if t_count is not None and t_count >= t_recv:
+                            recv_lags[tname].append(t_count - t_recv)
+    return {
+        "vote_spread": {t: percentiles_ms(v) for t, v in spreads.items()},
+        "recv_to_count": {t: percentiles_ms(v) for t, v in recv_lags.items()},
+    }
+
+
+def phase_stats(analyzed: list[dict]) -> dict:
+    """Per-phase percentiles across all analyzed heights."""
+    acc: dict[str, list[int]] = {}
+    for a in analyzed:
+        for label, ms in a["phases"].items():
+            acc.setdefault(label, []).append(int(ms * 1e6))
+    return {label: percentiles_ms(v) for label, v in acc.items()}
+
+
+# ------------------------------------------------------------- the report
+
+
+def device_summary(scrapes: list[dict]) -> dict:
+    out = {}
+    for s in scrapes:
+        dev = s.get("debug_device")
+        if dev is None:
+            continue
+        occ = dev.get("occupancy", {})
+        out[node_name(s)] = {
+            "dispatches": dev.get("dispatches", 0),
+            "lanes_dispatched": dev.get("lanes_dispatched", 0),
+            "cpu_fallbacks": dev.get("cpu_fallbacks", 0),
+            "breaker_tripped": dev.get("breaker", {}).get("tripped", False),
+            "occupancy": occ,
+        }
+    return out
+
+
+def trace_summary(scrapes: list[dict]) -> dict:
+    """Per-node local step durations from the consensus tracer (when
+    enabled): height -> {step: dur_ms} — the single-node attribution
+    that complements the cross-node timeline."""
+    out: dict[str, dict] = {}
+    for s in scrapes:
+        tr = s.get("debug_consensus_trace") or {}
+        if not tr.get("enabled"):
+            continue
+        per_h = {}
+        for t in tr.get("traces", []):
+            h = (t.get("attrs") or {}).get("height")
+            if h is None:
+                continue
+            per_h[h] = {
+                sp["name"]: sp.get("dur_ms")
+                for sp in t.get("spans", [])
+            }
+        out[node_name(s)] = per_h
+    return out
+
+
+def check_invariants(report: dict, commit_spread_s: float = 2.0) -> list[str]:
+    """Cross-node invariants a healthy fleet must satisfy; returns human-
+    readable violations (empty = clean)."""
+    violations = []
+    # the highest height each node is KNOWN to have committed — a node
+    # that merely hasn't reached H yet (or whose commit event postdates
+    # the scrape) is in progress, not in violation; a node whose commit
+    # record skips H while later heights exist is
+    node_max_commit: dict[str, int] = {}
+    for h_str, entry in report["heights"].items():
+        for node in (entry.get("commit") or {}):
+            node_max_commit[node] = max(node_max_commit.get(node, 0), int(h_str))
+    for a in report["height_analysis"]:
+        if not a["committed_on"]:
+            continue
+        missing = {
+            node for node in set(report["observers"]) - set(a["committed_on"])
+            if node_max_commit.get(node, 0) > a["height"]
+        }
+        if missing and a["stitched"]:
+            violations.append(
+                f"height {a['height']}: nodes {sorted(missing)} skipped commit"
+            )
+        if a["commit_spread_ms"] > commit_spread_s * 1e3:
+            violations.append(
+                f"height {a['height']}: commit spread "
+                f"{a['commit_spread_ms']}ms > bound {commit_spread_s * 1e3}ms"
+            )
+    # no vote older than one round in flight: every observed vote for a
+    # height must be within one round of that height's decision round
+    for h_str, entry in report["heights"].items():
+        commits = entry.get("commit") or {}
+        if not commits:
+            continue
+        decision = max(c["round"] for c in commits.values())
+        for r_str, rd in (entry.get("rounds") or {}).items():
+            r = int(r_str)
+            if r < decision - 1:
+                n_votes = sum(
+                    len(per_node)
+                    for cell in rd.values()
+                    for per_node in cell.get("votes", {}).values()
+                )
+                if n_votes:
+                    violations.append(
+                        f"height {h_str}: {n_votes} votes for stale round {r} "
+                        f"in flight (decision round {decision})"
+                    )
+    return violations
+
+
+def build_report(scrapes: list[dict],
+                 extra_events: dict[str, list[dict]] | None = None,
+                 commit_spread_s: float = 2.0) -> dict:
+    """The fleet report: node inventory, stitched per-height timelines,
+    phase + propagation percentiles, device occupancy, invariants."""
+    stitched = stitch(scrapes, extra_events)
+    heights, observers = stitched["heights"], stitched["observers"]
+    # validator-set size: the validators route, else the widest vote
+    # matrix actually observed
+    n_validators = 0
+    for s in scrapes:
+        vals = s.get("validators")
+        if vals and vals.get("total"):
+            n_validators = max(n_validators, int(vals["total"]))
+    if n_validators == 0:
+        for entry in heights.values():
+            for rd in entry["rounds"].values():
+                for cell in rd.values():
+                    for val in cell.get("votes", {}):
+                        n_validators = max(n_validators, val + 1)
+    analyzed = [
+        analyze_height(h, entry, observers, n_validators)
+        for h, entry in sorted(heights.items())
+    ]
+    node_rows = []
+    min_common = None
+    for s in scrapes:
+        st, hl = s.get("status") or {}, s.get("health") or {}
+        height = (st.get("sync_info") or {}).get("latest_block_height")
+        if s["ok"] and height is not None:
+            min_common = height if min_common is None else min(min_common, height)
+        node_rows.append({
+            "endpoint": s["endpoint"],
+            "moniker": node_name(s),
+            "ok": s["ok"],
+            "height": height,
+            "status": hl.get("status"),
+            "ready": hl.get("ready"),
+            "peers": hl.get("peers"),
+            "task_crashes": hl.get("task_crashes"),
+            "recorder_total_dropped":
+                (s.get("debug_flight_recorder") or {}).get("total_dropped"),
+            "errors": s.get("errors") or {},
+        })
+    report = {
+        # wall-clock report stamp: operator-facing, never consensus input
+        "generated_at_wall_ns": time.time_ns(),
+        "nodes": node_rows,
+        "observers": observers,
+        "n_validators": n_validators,
+        "min_common_height": min_common or 0,
+        "heights": {str(h): heights[h] for h in sorted(heights)},
+        "height_analysis": analyzed,
+        "stitched_heights": [a["height"] for a in analyzed if a["stitched"]],
+        "phases": phase_stats(analyzed),
+        "propagation": propagation_stats(heights),
+        "device": device_summary(scrapes),
+        "traces": trace_summary(scrapes),
+    }
+    report["violations"] = check_invariants(report, commit_spread_s)
+    return report
+
+
+def render_text(report: dict) -> str:
+    """Human-readable fleet report."""
+    lines = []
+    lines.append(f"fleet: {len(report['nodes'])} nodes, "
+                 f"{report['n_validators']} validators, "
+                 f"{len(report['stitched_heights'])} fully-stitched heights")
+    for n in report["nodes"]:
+        lines.append(
+            f"  {n['moniker']:<12} h={n['height']} status={n['status']} "
+            f"ready={n['ready']} peers={n['peers']} "
+            f"{'OK' if n['ok'] else 'SCRAPE-FAILED'}"
+        )
+    for a in report["height_analysis"]:
+        if not a["committed_on"]:
+            continue
+        mc = a["matrix_complete"]
+        lines.append(
+            f"height {a['height']} (round {a['commit_round']}): "
+            f"committed on {len(a['committed_on'])} nodes, "
+            f"spread {a['commit_spread_ms']}ms, matrix "
+            f"pv={'full' if mc.get('prevote') else 'partial'}/"
+            f"pc={'full' if mc.get('precommit') else 'partial'}"
+        )
+        for label, ms in a["phases"].items():
+            lines.append(f"    {label:<40} {ms:>10.3f}")
+    if report["phases"]:
+        lines.append("phase percentiles (ms):")
+        for label, p in report["phases"].items():
+            lines.append(f"  {label:<42} p50={p['p50_ms']:<9} "
+                         f"p90={p['p90_ms']:<9} max={p['max_ms']}")
+    prop = report["propagation"]["vote_spread"]
+    for t in ("prevote", "precommit"):
+        p = prop[t]
+        lines.append(f"{t} fleet spread: n={p['n']} p50={p['p50_ms']}ms "
+                     f"p90={p['p90_ms']}ms max={p['max_ms']}ms")
+    for node, dev in report["device"].items():
+        occ = dev["occupancy"]
+        if dev["dispatches"]:
+            lines.append(
+                f"device[{node}]: {dev['dispatches']} dispatches, "
+                f"busy {occ.get('busy_frac', 0):.1%} of "
+                f"{occ.get('elapsed_s', 0):.1f}s, fill "
+                f"{occ.get('fill_ratio', 0):.1%}, queue depth "
+                f"{occ.get('peak_queue_depth', 0)} peak"
+            )
+        else:
+            cpu = occ.get("cpu_route", {})
+            lines.append(
+                f"device[{node}]: 0 dispatches (cpu route: "
+                f"{cpu.get('sigs', 0)} sigs in {cpu.get('batches', 0)} batches)"
+            )
+    if report["violations"]:
+        lines.append("VIOLATIONS:")
+        lines.extend(f"  - {v}" for v in report["violations"])
+    else:
+        lines.append("invariants: clean")
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------- incremental poll
+
+
+class FleetCollector:
+    """Stateful poller: each `poll()` scrapes incrementally (seq/ns
+    cursors per node) and accumulates normalized events + completed
+    traces, so a long-lived collector never re-reads a node's whole ring
+    and `report()` still stitches the full observed history — including
+    a node's, even if it went down before the final poll."""
+
+    def __init__(self, endpoints: list[str], metrics: list[str] | None = None,
+                 timeout: float = 5.0) -> None:
+        # normalized once: cursors/accumulators are keyed by exactly the
+        # endpoint string scrape_node reports back
+        self.endpoints = [ep.rstrip("/") for ep in endpoints]
+        self.metrics = metrics
+        self.timeout = timeout
+        self.cursors: dict[str, dict] = {}
+        self._events: dict[str, list[dict]] = {}  # endpoint -> wall events
+        self._traces: dict[str, dict] = {}  # endpoint -> height -> trace
+        self._names: dict[str, str] = {}  # endpoint -> last-known moniker
+        self._last_scrapes: list[dict] = []
+
+    def poll(self) -> list[dict]:
+        scrapes = scrape_fleet(self.endpoints, self.metrics, self.cursors,
+                               self.timeout)
+        for s in scrapes:
+            ep = s["endpoint"]
+            if s["ok"]:
+                self._names[ep] = node_name(s)
+            events = normalize_events(s)
+            if events:
+                cur = self.cursors.setdefault(ep, {})
+                cur["seq"] = max(
+                    (e.get("seq", 0) for e in events), default=cur.get("seq", 0)
+                ) or cur.get("seq", 0)
+                cur["ns"] = max(e["t_mono_ns"] for e in events)
+                self._events.setdefault(ep, []).extend(events)
+            tr = s.get("debug_consensus_trace") or {}
+            if tr.get("enabled"):
+                a = tr.get("anchor") or {}
+                if "mono_ns" in a:
+                    # the trace route filters on COMPLETION time, so the
+                    # response-time anchor is a safe high-water cursor
+                    self.cursors.setdefault(ep, {})["trace_ns"] = a["mono_ns"]
+                acc = self._traces.setdefault(ep, {})
+                for t in tr.get("traces", []):
+                    key = (t.get("attrs") or {}).get("height") or t.get("t0")
+                    acc[key] = t
+        self._last_scrapes = scrapes
+        return scrapes
+
+    def report(self, commit_spread_s: float = 2.0) -> dict:
+        # the accumulated history IS the event/trace stream; the last
+        # scrape contributes the non-event surfaces (status/health/device)
+        scrapes = []
+        extra: dict[str, list[dict]] = {}
+        for s in self._last_scrapes:
+            s = dict(s)
+            ep = s["endpoint"]
+            # a node that went down keeps its last-known identity, so its
+            # accumulated history stays attributed to the same observer
+            known = self._names.get(ep)
+            if known and not (s.get("debug_flight_recorder") or {}).get(
+                "moniker"
+            ) and not ((s.get("status") or {}).get("node_info") or {}).get(
+                "moniker"
+            ):
+                s["status"] = {"node_info": {"moniker": known}}
+            fr = dict(s.get("debug_flight_recorder") or {})
+            fr["events"] = []  # events come from the accumulator instead
+            s["debug_flight_recorder"] = fr
+            if self._traces.get(ep):
+                tr = dict(s.get("debug_consensus_trace") or {})
+                tr["enabled"] = True
+                tr["traces"] = list(self._traces[ep].values())
+                s["debug_consensus_trace"] = tr
+            extra[node_name(s)] = self._events.get(ep, [])
+            scrapes.append(s)
+        return build_report(scrapes, extra_events=extra,
+                            commit_spread_s=commit_spread_s)
+
+
+# ------------------------------------------------------------------- CLI
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tendermint_tpu.tools.collector",
+        description="cross-node fleet collector: stitched per-height "
+                    "timelines, vote-propagation percentiles, device "
+                    "occupancy (docs/observability.md 'Fleet view')",
+    )
+    ap.add_argument("endpoints", nargs="+",
+                    help="node RPC endpoints, e.g. http://127.0.0.1:26657")
+    ap.add_argument("--metrics", nargs="*", default=None,
+                    help="Prometheus endpoints, matched to nodes by position")
+    ap.add_argument("--report", action="store_true",
+                    help="print the text rendering (JSON goes to --json)")
+    ap.add_argument("--json", default=None,
+                    help="write the JSON fleet report to this path")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 when a cross-node invariant is violated")
+    ap.add_argument("--commit-spread-s", type=float, default=2.0,
+                    help="bound on cross-node commit spread per height")
+    ap.add_argument("--poll", type=int, default=1,
+                    help="incremental polls to take (cursor-based)")
+    ap.add_argument("--poll-interval", type=float, default=1.0)
+    ap.add_argument("--timeout", type=float, default=5.0)
+    args = ap.parse_args(argv)
+
+    fc = FleetCollector(args.endpoints, args.metrics, args.timeout)
+    for i in range(max(1, args.poll)):
+        fc.poll()
+        if i + 1 < args.poll:
+            time.sleep(args.poll_interval)
+    report = fc.report(commit_spread_s=args.commit_spread_s)
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+    if args.report or not args.json:
+        print(render_text(report))
+    if args.check and report["violations"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

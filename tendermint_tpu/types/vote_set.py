@@ -17,6 +17,7 @@ from dataclasses import dataclass
 
 from tendermint_tpu.crypto.batch import BatchVerifier
 from tendermint_tpu.libs.bit_array import BitArray
+from tendermint_tpu.libs.recorder import RECORDER
 from tendermint_tpu.types.validator_set import ValidatorSet
 from tendermint_tpu.types.vote import BlockID, Vote, VoteType
 
@@ -203,6 +204,15 @@ class VoteSet:
             for i, v in enumerate(by_block.votes):
                 if v is not None:
                     self.votes[i] = v
+            # fleet-timeline tap (docs/observability.md "Fleet view"): the
+            # instant THIS node's tally crossed 2/3 for (height, round,
+            # type) — the per-node quorum edge the collector stitches
+            # into cross-node phase latencies. Monotonic-stamped by the
+            # recorder; telemetry only, never consensus input.
+            RECORDER.record(
+                "consensus", "maj23", height=self.height, round=self.round,
+                type=int(self.type), power=by_block.sum,
+            )
 
     def _precheck(self, vote: Vote) -> tuple[int, Vote | None] | None:
         """Structural validation. Returns (voting power, conflicting vote or
@@ -249,6 +259,14 @@ class VoteSet:
             self.votes[idx] = vote
             self.votes_bit_array.set_index(idx, True)
             self.sum += power
+            # fleet-timeline tap: first time validator `idx`'s (height,
+            # round, type) vote COUNTED on this node — one cell of the
+            # collector's per-peer vote-arrival matrix. Fires once per
+            # (vote, observing node): duplicates never reach here.
+            RECORDER.record(
+                "consensus", "vote", height=vote.height, round=vote.round,
+                type=int(vote.type), val=idx,
+            )
         by_block = self.votes_by_block.get(key)
         if by_block is None:
             if existing is not None:

@@ -1,0 +1,693 @@
+"""Fleet-collector tests (ISSUE 6 observability tentpole).
+
+The stitching core is pure dict→dict, so a canned 4-node capture with
+WILDLY skewed monotonic clocks exercises anchor-based timebase
+normalization, timeline stitching, vote-matrix assembly, phase/
+propagation percentiles, and the cross-node invariants without any live
+node. The live end-to-end path (`timeline` proc_testnet scenario) rides
+in tests/test_testnet_procs.py under importorskip("cryptography").
+
+Also here: the incremental-scrape RPC surface (since_ns cursors +
+total_dropped on debug_flight_recorder / debug_consensus_trace) and
+tools/bench_compare.
+"""
+import json
+
+import pytest
+
+from tendermint_tpu.libs.recorder import FlightRecorder, clock_anchor
+from tendermint_tpu.tools import bench_compare
+from tendermint_tpu.tools.collector import (
+    FleetCollector,
+    build_report,
+    check_invariants,
+    node_name,
+    normalize_events,
+    render_text,
+    stitch,
+    wall_offset_ns,
+)
+
+MS = 1_000_000  # ns
+N_VALS = 4
+# one shared wall timeline; each node's monotonic origin is skewed by a
+# huge, distinct amount (node restarts at different times => unrelated
+# monotonic origins) so any stitching that forgets the anchors produces
+# garbage orderings instead of accidentally-right ones
+WALL0 = 1_754_000_000_000_000_000
+SKEWS = {0: 0, 1: 7_200 * 10**9, 2: -3_600 * 10**9, 3: 123_456_789_012}
+
+
+def _node_scrape(i: int, events_wall: list[tuple[int, str, str, dict]],
+                 height: int = 3) -> dict:
+    """A canned scrape for node i: events given in WALL time are stored
+    in the node's own (skewed) monotonic timebase, with the matching
+    anchor — exactly what a live debug_flight_recorder answer carries."""
+    off = SKEWS[i]  # mono = wall - (wall_ns - mono_ns) = wall - off_wall
+    # choose: mono_ns = wall_ns - WALLOFF_i where WALLOFF_i = WALL0 - SKEWS[i]
+    walloff = WALL0 - SKEWS[i]
+    events = []
+    for seq, (t_wall, sub, kind, fields) in enumerate(events_wall, start=1):
+        events.append({
+            "seq": seq,
+            "t_mono_ns": t_wall - walloff,
+            "sub": sub,
+            "kind": kind,
+            "fields": fields,
+        })
+    return {
+        "endpoint": f"http://127.0.0.1:{26657 + 2 * i}",
+        "ok": True,
+        "errors": {},
+        "status": {
+            "node_info": {"moniker": f"node{i}"},
+            "sync_info": {"latest_block_height": height},
+        },
+        "health": {"status": "ok", "ready": True, "peers": 3,
+                   "task_crashes": 0},
+        "validators": {"total": N_VALS},
+        "debug_device": {
+            "dispatches": 0,
+            "lanes_dispatched": 0,
+            "cpu_fallbacks": 0,
+            "breaker": {"tripped": False},
+            "occupancy": {
+                "busy_s": 0.0, "busy_frac": 0.0, "busy_windows": 0,
+                "queue_depth": 0, "peak_queue_depth": 0, "fill_ratio": 0.0,
+                "pad_lanes": 0,
+                "cpu_route": {"batches": 6, "sigs": 6 * N_VALS},
+            },
+        },
+        "debug_consensus_trace": {"enabled": False, "traces": []},
+        "debug_flight_recorder": {
+            "crashes": 0,
+            "dumps": 0,
+            "moniker": f"node{i}",
+            "anchor": {"mono_ns": 1_000_000, "wall_ns": walloff + 1_000_000},
+            "total": len(events),
+            "total_dropped": 0,
+            "events": events,
+        },
+    }
+
+
+def _height_events(h: int, t0: int, observer: int,
+                   commit_round: int = 0) -> list[tuple[int, str, str, dict]]:
+    """One node's consensus events for height h on the shared wall
+    timeline: proposal at t0(+gossip), votes arriving per validator with
+    per-observer gossip delay, maj23, commit."""
+    delay = observer * 2 * MS  # gossip reaches each node a bit later
+    ev = [(t0 + delay, "consensus", "proposal",
+           {"height": h, "round": commit_round})]
+    for tname, base in (("prevote", 10), ("precommit", 30)):
+        tcode = 1 if tname == "prevote" else 2
+        for val in range(N_VALS):
+            t = t0 + (base + val) * MS + delay
+            ev.append((t, "consensus", "vote_recv",
+                       {"height": h, "round": commit_round, "type": tcode,
+                        "val": val, "peer": f"peer{val}"}))
+            ev.append((t + MS, "consensus", "vote",
+                       {"height": h, "round": commit_round, "type": tcode,
+                        "val": val}))
+        ev.append((t0 + (base + N_VALS + 1) * MS + delay, "consensus",
+                   "maj23", {"height": h, "round": commit_round,
+                             "type": tcode, "power": 3}))
+    ev.append((t0 + 50 * MS + delay, "consensus", "commit",
+               {"height": h, "round": commit_round, "txs": 0}))
+    ev.append((t0 + 55 * MS + delay, "consensus", "new_height",
+               {"height": h + 1}))
+    return ev
+
+
+def _fleet_scrapes(n_heights: int = 3) -> list[dict]:
+    scrapes = []
+    for i in range(4):
+        ev = [(WALL0 + 1 * MS, "node", "clock_anchor",
+               {"wall_ns": WALL0 + 1 * MS, "moniker": f"node{i}"})]
+        for h in range(1, n_heights + 1):
+            ev.extend(_height_events(h, WALL0 + h * 1000 * MS, observer=i))
+        scrapes.append(_node_scrape(i, ev, height=n_heights))
+    return scrapes
+
+
+class TestNormalization:
+    def test_offset_from_live_anchor(self):
+        s = _fleet_scrapes()[1]
+        off = wall_offset_ns(s)
+        assert off == WALL0 - SKEWS[1]
+
+    def test_offset_falls_back_to_inband_anchor_event(self):
+        s = _fleet_scrapes()[2]
+        del s["debug_flight_recorder"]["anchor"]
+        s["debug_consensus_trace"] = None
+        s["debug_device"] = None
+        off = wall_offset_ns(s)
+        assert off == WALL0 - SKEWS[2]
+
+    def test_no_anchor_contributes_nothing(self):
+        s = _fleet_scrapes()[0]
+        del s["debug_flight_recorder"]["anchor"]
+        s["debug_consensus_trace"] = None
+        s["debug_device"] = None
+        s["debug_flight_recorder"]["events"] = [
+            e for e in s["debug_flight_recorder"]["events"]
+            if e["kind"] != "clock_anchor"
+        ]
+        assert normalize_events(s) == []
+
+    def test_skew_removed(self):
+        # the same wall instant must normalize identically on every node
+        # despite hours of monotonic skew
+        scrapes = _fleet_scrapes(n_heights=1)
+        commits = {}
+        for s in scrapes:
+            for e in normalize_events(s):
+                if e["kind"] == "commit":
+                    commits[node_name(s)] = e["t_wall_ns"]
+        assert len(commits) == 4
+        spread = max(commits.values()) - min(commits.values())
+        assert spread == 3 * 2 * MS  # exactly the modeled gossip delay
+
+
+class TestStitching:
+    def test_full_matrix_and_phases(self):
+        report = build_report(_fleet_scrapes())
+        assert report["n_validators"] == N_VALS
+        assert len(report["observers"]) == 4
+        assert report["stitched_heights"] == [1, 2, 3]
+        a = report["height_analysis"][0]
+        assert a["matrix_complete"] == {"prevote": True, "precommit": True}
+        # phase latencies reconstruct the modeled timeline (earliest
+        # observation wins each edge): proposal t0 -> prevote maj23 at
+        # t0+15ms -> precommit maj23 at t0+35ms -> commit at t0+50ms
+        assert a["phases"]["propose_to_prevote_maj23_ms"] == pytest.approx(15.0)
+        assert a["phases"]["prevote_maj23_to_precommit_maj23_ms"] == (
+            pytest.approx(20.0)
+        )
+        assert a["phases"]["precommit_maj23_to_commit_ms"] == pytest.approx(15.0)
+        assert a["phases"]["propose_to_commit_ms"] == pytest.approx(50.0)
+        assert a["commit_spread_ms"] == pytest.approx(6.0)  # 3 * 2ms delay
+        assert report["violations"] == []
+
+    def test_vote_matrix_cells(self):
+        stitched = stitch(_fleet_scrapes(n_heights=1))
+        cell = stitched["heights"][1]["rounds"][0]["prevote"]["votes"]
+        assert set(cell) == set(range(N_VALS))
+        for val in range(N_VALS):
+            assert set(cell[val]) == {f"node{i}" for i in range(4)}
+            # arrival order across nodes follows the modeled gossip delay
+            ts = [cell[val][f"node{i}"] for i in range(4)]
+            assert ts == sorted(ts)
+
+    def test_propagation_percentiles(self):
+        report = build_report(_fleet_scrapes())
+        prop = report["propagation"]["vote_spread"]
+        # every vote is observed by all 4 nodes, spread = 6ms exactly
+        for tname in ("prevote", "precommit"):
+            assert prop[tname]["n"] == 3 * N_VALS
+            assert prop[tname]["max_ms"] == pytest.approx(6.0)
+        lag = report["propagation"]["recv_to_count"]["prevote"]
+        assert lag["n"] > 0
+        assert lag["p50_ms"] == pytest.approx(1.0)  # modeled verify lag
+
+    def test_incomplete_matrix_not_stitched(self):
+        scrapes = _fleet_scrapes(n_heights=1)
+        # node3 never counted validator 2's precommit
+        fr = scrapes[3]["debug_flight_recorder"]
+        fr["events"] = [
+            e for e in fr["events"]
+            if not (e["kind"] == "vote" and e["fields"].get("type") == 2
+                    and e["fields"].get("val") == 2)
+        ]
+        report = build_report(scrapes)
+        a = report["height_analysis"][0]
+        assert a["matrix_complete"]["prevote"] is True
+        assert a["matrix_complete"]["precommit"] is False
+        assert report["stitched_heights"] == []
+
+    def test_commit_spread_violation(self):
+        scrapes = _fleet_scrapes(n_heights=1)
+        report = build_report(scrapes, commit_spread_s=0.001)  # 1ms bound
+        assert any("commit spread" in v for v in report["violations"])
+
+    def test_stale_round_votes_flagged(self):
+        # the height decides at round 2, but round-0 votes are still in
+        # flight — older than one round, the gossip-hygiene invariant
+        scrapes = []
+        for i in range(4):
+            ev = [(WALL0 + 1 * MS, "node", "clock_anchor",
+                   {"wall_ns": WALL0 + 1 * MS})]
+            ev.extend(_height_events(1, WALL0 + 1000 * MS, observer=i,
+                                     commit_round=2))
+            ev.append((WALL0 + 1100 * MS, "consensus", "vote",
+                       {"height": 1, "round": 0, "type": 1, "val": 0}))
+            scrapes.append(_node_scrape(i, ev, height=1))
+        report = build_report(scrapes)
+        assert any("stale round" in v for v in report["violations"])
+
+    def test_device_summary_reports_cpu_route(self):
+        report = build_report(_fleet_scrapes(n_heights=1))
+        for node, dev in report["device"].items():
+            assert dev["occupancy"]["cpu_route"]["sigs"] > 0, node
+
+    def test_render_text_mentions_key_facts(self):
+        report = build_report(_fleet_scrapes())
+        text = render_text(report)
+        assert "4 nodes" in text and "4 validators" in text
+        assert "height 1" in text and "invariants: clean" in text
+
+    def test_report_is_json_serializable(self):
+        report = build_report(_fleet_scrapes())
+        parsed = json.loads(json.dumps(report, default=str))
+        assert parsed["stitched_heights"] == [1, 2, 3]
+
+    def test_invariants_survive_json_roundtrip(self):
+        # rounds keys become strings after a dump/load cycle; the checker
+        # must handle both (it re-reads the report's raw heights)
+        report = build_report(_fleet_scrapes())
+        rt = json.loads(json.dumps(report, default=str))
+        assert check_invariants(rt) == []
+
+
+class TestRecorderCursorDirect:
+    """Cursor semantics at the library layer — runs even without the
+    crypto stack (the Environment-route variants below need it for the
+    rpc.core import chain)."""
+
+    def test_snapshot_since_ns_and_totals(self):
+        r = FlightRecorder(maxlen=8)
+        for i in range(12):
+            r.record("t", "k", i=i)
+        assert r.total == 12 and r.total_dropped == 4
+        snap = r.snapshot()
+        assert [e["seq"] for e in snap] == list(range(5, 13))
+        cursor = snap[-3]["t_mono_ns"]
+        newer = r.snapshot(since_ns=cursor)
+        assert [e["fields"]["i"] for e in newer] == [10, 11]
+        # cursor composes with subsystem filter and limit
+        r.record("other", "k", i=99)
+        assert r.snapshot(subsystem="other", since_ns=cursor)[0]["seq"] == 13
+        assert len(r.snapshot(limit=1, since_ns=cursor)) == 1
+
+    def test_snapshot_since_seq_exact_under_coarse_clock(self):
+        # several events can share one monotonic tick (coarse clocksource)
+        # — the seq cursor must still split them exactly where the time
+        # cursor cannot
+        r = FlightRecorder(maxlen=16)
+        r.record("t", "k", i=0)
+        r.record("t", "k", i=1)
+        snap = r.snapshot()
+        # force the same-tick shape regardless of the host clock
+        r._ring.clear()
+        t0 = snap[0]["t_mono_ns"]
+        for seq, i in ((1, 0), (2, 1), (3, 2)):
+            r._ring.append((seq, t0, "t", "k", {"i": i}))
+        assert [e["fields"]["i"] for e in r.snapshot(since_seq=2)] == [2]
+        # the time cursor on the shared tick drops everything — exactly
+        # why the collector prefers since_seq
+        assert r.snapshot(since_ns=t0) == []
+
+    def test_tracer_since_ns(self):
+        import time
+
+        from tendermint_tpu.libs.trace import Tracer
+
+        t = Tracer(max_traces=4)
+        with t.span("height", height=1):
+            pass
+        cursor = time.monotonic_ns()  # poll-time cursor (response anchor)
+        with t.span("height", height=2):
+            pass
+        got = t.traces(since_ns=cursor)
+        assert [x["attrs"]["height"] for x in got] == [2]
+        assert t.completed == 2
+
+    def test_tracer_cursor_keeps_inflight_trace(self):
+        # a trace STARTED before the cursor but completed after must be
+        # returned: completion is when it became readable
+        import time
+
+        from tendermint_tpu.libs.trace import Tracer
+
+        t = Tracer(max_traces=4)
+        span = t.begin("height", height=7)
+        cursor = time.monotonic_ns()  # poll happens mid-height
+        t.finish(span)
+        got = t.traces(since_ns=cursor)
+        assert [x["attrs"]["height"] for x in got] == [7]
+
+
+class TestIncrementalScrapeRPC:
+    """The rpc/core.py cursor surface over the process-global RECORDER,
+    without a full node: Environment's debug routes only touch the
+    recorder/tracer singletons. (rpc.core's import chain pulls in the
+    crypto stack, hence the skip.)"""
+
+    @pytest.fixture(autouse=True)
+    def _needs_crypto(self):
+        pytest.importorskip(
+            "cryptography", reason="rpc.core import chain needs the crypto stack"
+        )
+
+    def test_flight_recorder_since_ns_and_drop_accounting(self):
+        import asyncio
+
+        from tendermint_tpu.libs import recorder as rec_mod
+        from tendermint_tpu.rpc.core import Environment
+
+        env = Environment()
+        saved = rec_mod.RECORDER
+        r = FlightRecorder(maxlen=8)
+        rec_mod.RECORDER = r
+        try:
+            r.set_moniker("nodeX")
+            for i in range(12):
+                r.record("t", "k", i=i)
+
+            async def go():
+                first = await env.debug_flight_recorder(n=100)
+                cursor = first["events"][-1]["t_mono_ns"]
+                r.record("t", "k", i=99)
+                second = await env.debug_flight_recorder(
+                    n=100, since_ns=cursor
+                )
+                return first, second
+
+            first, second = asyncio.run(go())
+        finally:
+            rec_mod.RECORDER = saved
+        assert first["moniker"] == "nodeX"
+        assert first["anchor"]["wall_ns"] > 0
+        assert first["total"] == 12
+        assert first["total_dropped"] == 4  # ring of 8, 12 recorded
+        assert len(first["events"]) == 8
+        # the incremental read returns ONLY the new event
+        assert [e["fields"]["i"] for e in second["events"]] == [99]
+        assert second["total"] == 13
+        # seq is monotonic across reads — gap detection for the collector
+        assert second["events"][0]["seq"] == 13
+
+    def test_uri_transport_string_cursor_accepted(self):
+        import asyncio
+
+        from tendermint_tpu.libs import recorder as rec_mod
+        from tendermint_tpu.rpc.core import Environment
+
+        env = Environment()
+        saved = rec_mod.RECORDER
+        r = FlightRecorder(maxlen=8)
+        rec_mod.RECORDER = r
+        try:
+            r.record("t", "k")
+            cursor = str(r.snapshot()[-1]["t_mono_ns"])
+
+            async def go():
+                return await env.debug_flight_recorder(n=10, since_ns=cursor)
+
+            out = asyncio.run(go())
+        finally:
+            rec_mod.RECORDER = saved
+        assert out["events"] == []
+
+    def test_consensus_trace_cursor(self):
+        import asyncio
+
+        from tendermint_tpu.libs.trace import Tracer
+        from tendermint_tpu.rpc.core import Environment
+
+        class CS:
+            tracer = Tracer(max_traces=4, moniker="nodeY")
+            _height_span = None
+
+        env = Environment(consensus_state=CS())
+        with CS.tracer.span("height", height=1):
+            pass
+
+        async def go():
+            first = await env.debug_consensus_trace(n=10)
+            cursor = first["anchor"]["mono_ns"]
+            with CS.tracer.span("height", height=2):
+                pass
+            second = await env.debug_consensus_trace(n=10, since_ns=cursor)
+            return first, second
+
+        first, second = asyncio.run(go())
+        assert first["moniker"] == "nodeY"
+        assert [t["attrs"]["height"] for t in first["traces"]] == [1]
+        assert first["traces"][0]["attrs"]["node"] == "nodeY"
+        assert first["total"] == 1 and first["total_dropped"] == 0
+        assert [t["attrs"]["height"] for t in second["traces"]] == [2]
+
+
+class TestAnchors:
+    def test_clock_anchor_pair_is_consistent(self):
+        import time
+
+        a = clock_anchor()
+        assert abs((a["wall_ns"] - a["mono_ns"])
+                   - (time.time_ns() - time.monotonic_ns())) < 50_000_000
+
+    def test_dump_header_carries_anchor_and_moniker(self, tmp_path):
+        r = FlightRecorder(maxlen=8)
+        r.set_moniker("node7")
+        r.set_dump_path(str(tmp_path / "fr.jsonl"))
+        r.record("t", "k")
+        r.record_anchor()
+        assert r.dump("unit") == 2
+        lines = [json.loads(s)
+                 for s in open(tmp_path / "fr.jsonl").read().splitlines()]
+        header = lines[0]
+        assert header["moniker"] == "node7"
+        assert header["anchor"]["wall_ns"] - header["anchor"]["mono_ns"] != 0
+        assert header["total"] == 2 and header["total_dropped"] == 0
+        anchor_ev = lines[-1]
+        assert anchor_ev["kind"] == "clock_anchor"
+        assert anchor_ev["fields"]["wall_ns"] > 0
+        r.set_dump_path(None)
+
+
+class TestScrapeHTTP:
+    """scrape_node/scrape_fleet over a real HTTP server serving canned
+    URI-transport bodies — the wire path the proc-testnet timeline
+    scenario uses, minus the node."""
+
+    def test_scrape_and_report_over_http(self):
+        import http.server
+        import threading
+        import urllib.parse
+
+        fixture = _fleet_scrapes(n_heights=1)[0]
+        seen_since: list[str] = []
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                path = urllib.parse.urlparse(self.path)
+                route = path.path.lstrip("/")
+                q = urllib.parse.parse_qs(path.query)
+                if "since_seq" in q:
+                    seen_since.append((route, q["since_seq"][0]))
+                elif "since_ns" in q:
+                    seen_since.append((route, q["since_ns"][0]))
+                result = fixture.get(route)
+                if result is None:
+                    body = json.dumps(
+                        {"jsonrpc": "2.0", "id": 1,
+                         "error": {"code": -32601, "message": "no route"}}
+                    ).encode()
+                else:
+                    body = json.dumps(
+                        {"jsonrpc": "2.0", "id": 1, "result": result}
+                    ).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # keep the test output quiet
+                pass
+
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            ep = f"http://127.0.0.1:{srv.server_address[1]}"
+            fc = FleetCollector([ep], timeout=5.0)
+            scrapes = fc.poll()
+            assert scrapes[0]["ok"] and node_name(scrapes[0]) == "node0"
+            # the cursor rides the query string
+            assert ("debug_flight_recorder", "0") in seen_since
+            fc.poll()
+            cursor = str(fc.cursors[ep]["seq"])
+            assert ("debug_flight_recorder", cursor) in seen_since
+            report = fc.report()
+            assert report["nodes"][0]["height"] == 1
+            assert report["device"]["node0"]["occupancy"]["cpu_route"]["sigs"] > 0
+        finally:
+            srv.shutdown()
+            t.join()
+
+
+class TestFleetCollectorPolling:
+    @staticmethod
+    def _fake_fleet(all_scrapes, down=()):
+        """scrape_fleet stand-in honoring the seq cursor; endpoints in
+        `down` answer like a dead node (every route failed)."""
+
+        def fake_scrape_fleet(endpoints, metrics, cursors, timeout):
+            out = []
+            for ep in endpoints:
+                if ep in down:
+                    out.append({"endpoint": ep, "ok": False,
+                                "errors": {"status": "ConnectionError()"},
+                                **{r: None for r in (
+                                    "status", "health", "validators",
+                                    "debug_device", "debug_consensus_trace",
+                                    "debug_flight_recorder")}})
+                    continue
+                s = next(
+                    dict(x) for x in all_scrapes if x["endpoint"] == ep
+                )
+                fr = dict(s["debug_flight_recorder"])
+                since = ((cursors or {}).get(ep) or {}).get("seq")
+                if since is not None:
+                    fr = dict(fr, events=[
+                        e for e in fr["events"] if e["seq"] > since
+                    ])
+                s["debug_flight_recorder"] = fr
+                out.append(s)
+            return out
+
+        return fake_scrape_fleet
+
+    def test_cursor_advances_and_accumulates(self, monkeypatch):
+        """poll() twice: the second scrape is served only newer events
+        (cursor honored), and report() stitches BOTH polls' events."""
+        all_scrapes = _fleet_scrapes(n_heights=2)
+        from tendermint_tpu.tools import collector as col
+
+        monkeypatch.setattr(col, "scrape_fleet", self._fake_fleet(all_scrapes))
+        fc = FleetCollector([s["endpoint"] for s in all_scrapes])
+        fc.poll()
+        assert len(fc.cursors) == 4
+        second = fc.poll()
+        # everything was already seen: the incremental read is empty
+        assert all(
+            s["debug_flight_recorder"]["events"] == [] for s in second
+        )
+        report = fc.report()
+        assert report["stitched_heights"] == [1, 2]
+
+    def test_trailing_slash_endpoint_still_incremental(self, monkeypatch):
+        all_scrapes = _fleet_scrapes(n_heights=1)
+        from tendermint_tpu.tools import collector as col
+
+        monkeypatch.setattr(col, "scrape_fleet", self._fake_fleet(all_scrapes))
+        fc = FleetCollector([s["endpoint"] + "/" for s in all_scrapes])
+        fc.poll()
+        n_acc = {ep: len(ev) for ep, ev in fc._events.items()}
+        second = fc.poll()
+        # cursor honored despite the trailing slash: nothing re-read,
+        # nothing double-accumulated
+        assert all(
+            s["debug_flight_recorder"]["events"] == [] for s in second
+        )
+        assert {ep: len(ev) for ep, ev in fc._events.items()} == n_acc
+
+    def test_down_node_keeps_accumulated_history(self, monkeypatch):
+        """A node that dies between polls still contributes everything it
+        reported while alive — that history is exactly the postmortem."""
+        all_scrapes = _fleet_scrapes(n_heights=1)
+        eps = [s["endpoint"] for s in all_scrapes]
+        from tendermint_tpu.tools import collector as col
+
+        monkeypatch.setattr(col, "scrape_fleet", self._fake_fleet(all_scrapes))
+        fc = FleetCollector(eps)
+        fc.poll()
+        # node3 goes down before the final poll
+        monkeypatch.setattr(
+            col, "scrape_fleet", self._fake_fleet(all_scrapes, down={eps[3]})
+        )
+        fc.poll()
+        report = fc.report()
+        assert "node3" in report["observers"]
+        assert report["stitched_heights"] == [1]
+        row = next(n for n in report["nodes"] if n["endpoint"] == eps[3])
+        assert row["moniker"] == "node3" and row["ok"] is False
+
+    def test_trace_history_accumulates_across_polls(self, monkeypatch):
+        """Height traces scraped in an early poll must survive into the
+        final report even though later polls' cursors exclude them."""
+        all_scrapes = _fleet_scrapes(n_heights=1)
+        for s in all_scrapes:
+            s["debug_consensus_trace"] = {
+                "enabled": True,
+                "moniker": node_name(s),
+                "anchor": s["debug_flight_recorder"]["anchor"],
+                "total": 1, "total_dropped": 0,
+                "traces": [{"name": "height", "t0": 1.0, "dur_ms": 50.0,
+                            "attrs": {"height": 1},
+                            "spans": [{"name": "propose", "t0": 1.0,
+                                       "dur_ms": 10.0}]}],
+            }
+        from tendermint_tpu.tools import collector as col
+
+        monkeypatch.setattr(col, "scrape_fleet", self._fake_fleet(all_scrapes))
+        fc = FleetCollector([s["endpoint"] for s in all_scrapes])
+        fc.poll()
+        # later poll returns no traces (cursor excludes the old one)
+        for s in all_scrapes:
+            s["debug_consensus_trace"] = dict(
+                s["debug_consensus_trace"], traces=[]
+            )
+        fc.poll()
+        report = fc.report()
+        assert report["traces"]["node0"][1]["propose"] == 10.0
+
+
+class TestBenchCompare:
+    def _write(self, tmp_path, name, obj):
+        p = tmp_path / name
+        p.write_text(json.dumps(obj))
+        return str(p)
+
+    def test_regression_detected(self, tmp_path):
+        old = self._write(tmp_path, "old.json",
+                          {"metric": "m", "value": 100.0, "unit": "x/s"})
+        new = self._write(tmp_path, "new.json",
+                          {"metric": "m", "value": 89.0, "unit": "x/s"})
+        assert bench_compare.main([old, new]) == 1
+        assert bench_compare.main([old, new, "--threshold", "0.2"]) == 0
+
+    def test_improvement_and_wrapper_shape(self, tmp_path):
+        old = self._write(tmp_path, "old.json",
+                          {"parsed": {"metric": "m", "value": 100.0}})
+        new = self._write(tmp_path, "new.json",
+                          {"parsed": {"metric": "m", "value": 150.0}})
+        assert bench_compare.main([old, new]) == 0
+
+    def test_degraded_round_is_not_a_failure(self, tmp_path):
+        old = self._write(tmp_path, "old.json",
+                          {"parsed": {"metric": "m", "value": 100.0}})
+        new = self._write(tmp_path, "new.json", {"parsed": None, "rc": 3})
+        assert bench_compare.main([old, new]) == 0
+
+    def test_quick_bench_jsonl(self, tmp_path):
+        lines = "\n".join(
+            json.dumps({"metric": f"ed25519_commit_verify_{n}v_per_sec",
+                        "value": v, "unit": "verifies/s"})
+            for n, v in ((100, 5e4), (1000, 1e5))
+        )
+        old = tmp_path / "old.jsonl"
+        old.write_text(lines)
+        recs = bench_compare.load_records(str(old))
+        assert len(recs) == 2
+        res = bench_compare.compare(recs, recs)
+        assert res["rows"] and not res["regressions"]
+
+    def test_lower_is_better(self, tmp_path):
+        old = self._write(tmp_path, "old.json",
+                          {"metric": "lat_ms", "value": 10.0})
+        new = self._write(tmp_path, "new.json",
+                          {"metric": "lat_ms", "value": 12.0})
+        assert bench_compare.main([old, new, "--lower-is-better"]) == 1
+        assert bench_compare.main([old, new]) == 0
