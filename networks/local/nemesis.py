@@ -1,0 +1,522 @@
+"""Nemesis scenario matrix — adversarial faults over real node processes.
+
+`proc_testnet.py` proves liveness through *benign* failures (restarts,
+kill-all, fuzzed links). This module is the adversarial tier the soak
+round asked for (ROADMAP item 5): Byzantine validators, partitions,
+asymmetric delay, mempool floods, a flapping device, and deterministic
+crash-point sweeps — with every scenario asserting through the
+observability planes (flight recorder events over `debug_flight_recorder`,
+stitched fleet-collector timelines, `health`), so an observability
+regression fails the same run that needed it.
+
+Fault surface (all driven over public RPC, no process introspection):
+- per-link faults via the `debug_fault` route (libs/fault.py): partition,
+  asymmetric delay, probabilistic drop, heal;
+- device-breaker control via the same route (`trip_breaker` /
+  `reset_breaker` — ops/ed25519_batch's wedged-device circuit breaker);
+- process schedules via signals (SIGSTOP/SIGCONT/SIGKILL — ProcTestnet
+  pause/resume/kill);
+- crash points via `FAIL_TEST_INDEX` (libs/fail.py), armed per node
+  through ProcTestnet.start(env_extra=...);
+- mempool floods via `broadcast_tx_async`.
+
+Scenarios (catalogue with invariants: docs/nemesis.md):
+  nemesis_byzantine       — an equivocating voter; DuplicateVoteEvidence
+                            must gossip, verify, and land COMMITTED in a
+                            block on every honest node.
+  nemesis_partition       — isolate one validator; majority advances;
+                            heal; zero divergence, same app hash.
+  nemesis_delay_proposer  — asymmetric outbound delay on the proposer;
+                            chain keeps committing, no divergence.
+  nemesis_flood           — mempool flood + recheck storm under load.
+  nemesis_flapping_device — trip/reset the device breaker mid-consensus
+                            on one validator; health degrades truthfully
+                            and consensus never stalls.
+  nemesis_crash_sweep     — crash at EVERY fail.fail() index during
+                            commit/replay; restart and verify (parity
+                            with reference test/persist/
+                            test_failure_indices.sh, networked).
+
+Usage:
+  python -m networks.local.nemesis                 # fast scenarios
+  python -m networks.local.nemesis nemesis_crash_sweep
+  python -m networks.local.proc_testnet nemesis_byzantine  # same registry
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import urllib.parse
+import urllib.request
+
+from networks.local.proc_testnet import (
+    ProcTestnet,
+    configure_nodes,
+    enable_prometheus,
+    run as _run,
+)
+
+# fail.fail() call sites per commit: 5 in consensus/state.py finalize_commit
+# + 5 in state/execution.py (apply pipeline + Commit) — see tests/
+# test_persist.py CRASH_INDEXES, which sweeps the same 10 on one node
+N_CRASH_INDEXES = 10
+
+
+# --------------------------------------------------------------- plumbing
+
+
+def _enable_fault_control(i: int, cfg: dict) -> None:
+    cfg["p2p"]["test_fault_control"] = True
+
+
+class Nemesis:
+    """Fault-injection driver over a running ProcTestnet: every action
+    goes through public RPC, exactly like an external chaos controller."""
+
+    def __init__(self, net: ProcTestnet) -> None:
+        self.net = net
+
+    def fault(self, i: int, action: str, timeout: float = 10.0, **params) -> dict:
+        parts = [f"action={action}"]
+        for k, v in params.items():
+            if isinstance(v, (int, float)):
+                parts.append(f"{k}={v}")
+            else:
+                # explicit quotes pin the value as a STRING through the
+                # URI transport (an all-digit peer id must not coerce)
+                parts.append(f"{k}={urllib.parse.quote(chr(34) + str(v) + chr(34))}")
+        res = self.net.rpc(i, f"debug_fault?{'&'.join(parts)}", timeout=timeout)
+        assert res is not None, f"debug_fault {action} failed on node{i}"
+        return res
+
+    # -- link faults --------------------------------------------------------
+
+    def isolate(self, victim: int) -> None:
+        """Blackhole every link between `victim` and the rest, BOTH sides
+        (a one-sided partition still leaks via the unfaulted direction)."""
+        vid = self.net.node_id(victim)
+        assert vid, f"node{victim} has no node_id"
+        self.fault(victim, "partition", peers="*")
+        for i in range(self.net.n):
+            if i != victim and self.net.procs.get(i) is not None:
+                self.fault(i, "partition", peers=vid)
+
+    def delay(self, i: int, ms: float, direction: str = "send") -> None:
+        self.fault(i, "delay", peers="*", ms=ms, direction=direction)
+
+    def heal_all(self) -> None:
+        for i in range(self.net.n):
+            if self.net.procs.get(i) is not None:
+                self.fault(i, "heal")
+
+    # -- device breaker -----------------------------------------------------
+
+    def trip_breaker(self, i: int) -> dict:
+        return self.fault(i, "trip_breaker")
+
+    def reset_breaker(self, i: int) -> dict:
+        return self.fault(i, "reset_breaker")
+
+    # -- load ---------------------------------------------------------------
+
+    def flood(self, n_txs: int, prefix: str) -> list[str]:
+        """`broadcast_tx_async` n_txs unique txs round-robin across all
+        live nodes; returns the kv keys used."""
+        keys = []
+        live = [i for i in range(self.net.n) if self.net.procs.get(i) is not None]
+        for k in range(n_txs):
+            key = f"{prefix}{k}"
+            tx = "0x" + f"{key}=v{k}".encode().hex()
+            i = live[k % len(live)]
+            res = self.net.rpc(i, f"broadcast_tx_async?tx={tx}", timeout=10.0)
+            assert res is not None, f"broadcast_tx_async failed on node{i}"
+            keys.append(key)
+        return keys
+
+    # -- observability reads ------------------------------------------------
+
+    def recorder_events(self, i: int, subsystem: str | None = None,
+                        n: int = 2000) -> list[dict]:
+        q = f"debug_flight_recorder?n={n}"
+        if subsystem:
+            q += f"&subsystem={subsystem}"
+        fr = self.net.rpc(i, q, timeout=10.0)
+        return fr["events"] if fr else []
+
+    def recorder_kinds(self, i: int, subsystem: str | None = None) -> set:
+        return {(e["sub"], e["kind"]) for e in self.recorder_events(i, subsystem)}
+
+    def health(self, i: int) -> dict:
+        h = self.net.rpc(i, "health", timeout=10.0)
+        assert h is not None, f"health failed on node{i}"
+        return h
+
+    def assert_no_crashes(self, nodes=None) -> None:
+        """The ISSUE 7 standing invariant: tm_runtime_task_crashes_total
+        stays 0 through every scenario (health serves the same counter)."""
+        for i in nodes if nodes is not None else range(self.net.n):
+            if self.net.procs.get(i) is None:
+                continue
+            h = self.health(i)
+            assert h["task_crashes"] == 0, f"node{i} task crashes: {h}"
+
+    def assert_agreement(self, height: int, nodes=None) -> None:
+        """Block hash AND app hash identical on every live node that has
+        `height` (the zero-divergence gate)."""
+        blk, app = {}, {}
+        for i in nodes if nodes is not None else range(self.net.n):
+            if self.net.procs.get(i) is None:
+                continue
+            b = self.net.block_hash(i, height)
+            a = self.net.app_hash(i, height)
+            if b is not None:
+                blk[i] = b
+            if a is not None:
+                app[i] = a
+        assert len(set(blk.values())) <= 1, f"block divergence @{height}: {blk}"
+        assert len(set(app.values())) <= 1, f"app-hash divergence @{height}: {app}"
+
+    def fleet_report(self, commit_spread_s: float = 20.0) -> dict:
+        """One collector pass over the whole net (recorder taps are
+        always on, so stitching works without the tracing config)."""
+        from tendermint_tpu.tools.collector import FleetCollector
+
+        endpoints = [
+            f"http://127.0.0.1:{self.net.rpc_port(i)}"
+            for i in range(self.net.n)
+            if self.net.procs.get(i) is not None
+        ]
+        fc = FleetCollector(endpoints, timeout=10.0)
+        fc.poll()
+        report = fc.report(commit_spread_s=commit_spread_s)
+        path = os.path.join(self.net.root, "fleet_report.json")
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=1, sort_keys=True, default=str)
+        return report
+
+
+# -------------------------------------------------------------- scenarios
+
+
+def scenario_byzantine(net: ProcTestnet) -> None:
+    """(a) A Byzantine validator double-signs every vote (conflicting
+    BlockIDs to different peer halves, consensus/byzantine.py). The
+    honest 3/4 majority must keep committing, and the equivocation must
+    come back as DuplicateVoteEvidence — verified by honest pools,
+    gossiped through evidence/reactor.py, reaped into a proposal, and
+    COMMITTED in a block that every honest node stores. Asserted through
+    the flight recorder (evidence added/committed events), the block
+    store over RPC, and a fleet-collector invariant pass."""
+    configure_nodes(net, _enable_fault_control)
+    byz = net.n - 1
+    for i in range(net.n):
+        if i == byz:
+            net.start(i, env_extra={"TMTPU_BYZANTINE": "voter"})
+        else:
+            net.start(i)
+    honest = [i for i in range(net.n) if i != byz]
+    net.wait_all(2)
+
+    # the byzantine node's own recorder proves the attack actually ran
+    nem = Nemesis(net)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if ("byzantine", "equivocate") in nem.recorder_kinds(byz, "byzantine"):
+            break
+        time.sleep(0.5)
+    else:
+        raise AssertionError("byzantine voter never equivocated")
+
+    # evidence must land in a committed block on some honest node...
+    ev_height = None
+    deadline = time.monotonic() + 120
+    scanned = 0  # highest height READ successfully with no evidence
+    while ev_height is None and time.monotonic() < deadline:
+        top = net.height(honest[0]) or 1
+        h = scanned + 1
+        while h <= top:
+            r = net.rpc(honest[0], f"block?height={h}", timeout=5.0)
+            if r is None:
+                break  # transient RPC failure: retry this height next pass
+            if r["block"]["evidence"]:
+                ev_height = h
+                break
+            scanned = h
+            h += 1
+        if ev_height is None:
+            time.sleep(1.0)
+    assert ev_height is not None, "no DuplicateVoteEvidence committed in 120s"
+
+    # ...and the SAME evidence block on every other honest node
+    for i in honest[1:]:
+        net.wait_height(i, ev_height)
+        r = net.rpc(i, f"block?height={ev_height}", timeout=5.0)
+        assert r is not None and r["block"]["evidence"], (
+            f"node{i} has no evidence at height {ev_height}"
+        )
+    nem.assert_agreement(ev_height, nodes=honest)
+
+    # flight-recorder truth: honest nodes saw the evidence lifecycle
+    kinds = nem.recorder_kinds(honest[0], "evidence")
+    assert ("evidence", "added") in kinds, kinds
+    assert ("evidence", "committed") in kinds, kinds
+    nem.assert_no_crashes(honest)
+
+    # fleet invariants (app-hash agreement, no skipped commits, no stale
+    # votes, no task crashes) across honest AND byzantine observers
+    report = nem.fleet_report()
+    assert not report["violations"], report["violations"]
+    print(
+        f"nemesis_byzantine: evidence committed at height {ev_height} on all "
+        f"{len(honest)} honest nodes; fleet invariants clean"
+    )
+
+
+scenario_byzantine.self_start = True
+
+
+def scenario_partition(net: ProcTestnet) -> None:
+    """(b) Partition one validator away; the 3/4 majority keeps
+    committing while the victim freezes; heal; the victim re-converges
+    with ZERO divergence (block + app hash). Fault windows are read back
+    from the victim's flight recorder."""
+    configure_nodes(net, _enable_fault_control)
+    net.start_all()
+    net.wait_all(3)
+    nem = Nemesis(net)
+    victim = net.n - 1
+    rest = [i for i in range(net.n) if i != victim]
+
+    nem.isolate(victim)
+    h_cut = net.height(victim) or 3
+    base = max(net.height(i) or 3 for i in rest)
+    for i in rest:
+        net.wait_height(i, base + 3)
+    h_victim = net.height(victim)
+    assert h_victim is not None and h_victim <= h_cut + 1, (
+        f"victim advanced {h_cut}->{h_victim} while partitioned"
+    )
+
+    nem.heal_all()
+    head = max(net.height(i) or base for i in rest)
+    got = net.wait_height(victim, head, timeout=180.0)
+    # zero divergence at shared heights spanning the partition window
+    for probe in (max(1, h_cut - 1), h_cut, head):
+        nem.assert_agreement(probe)
+    kinds = nem.recorder_kinds(victim, "fault")
+    assert ("fault", "partition") in kinds and ("fault", "heal") in kinds, kinds
+    nem.assert_no_crashes()
+    print(
+        f"nemesis_partition: victim froze at {h_victim} while majority "
+        f"reached {base + 3}+, healed and re-converged to {got} with zero "
+        f"divergence"
+    )
+
+
+scenario_partition.self_start = True
+
+
+def scenario_delay_proposer(net: ProcTestnet) -> None:
+    """(c) Asymmetric delay on the CURRENT PROPOSER's outbound links
+    only: its proposals/parts/votes arrive late everywhere while its
+    inbound stays fast. Consensus must absorb the skew (extra rounds are
+    fine) and keep committing with zero divergence."""
+    configure_nodes(net, _enable_fault_control)
+    net.start_all()
+    net.wait_all(2)
+    nem = Nemesis(net)
+
+    # map the live proposer to a node index via each node's validator addr
+    cs = net.rpc(0, "consensus_state")
+    assert cs is not None, "consensus_state failed"
+    proposer_addr = cs["round_state"]["proposer"]
+    target = 0
+    for i in range(net.n):
+        st = net.rpc(i, "status")
+        if st and st["validator_info"].get("address") == proposer_addr:
+            target = i
+            break
+    nem.delay(target, ms=400, direction="send")
+
+    base = max(net.height(i) or 2 for i in range(net.n))
+    net.wait_all(base + 3, timeout=240.0)
+    nem.heal_all()
+    nem.assert_agreement(base + 2)
+    kinds = nem.recorder_kinds(target, "fault")
+    assert ("fault", "delay") in kinds, kinds
+    nem.assert_no_crashes()
+    print(
+        f"nemesis_delay_proposer: node{target} (proposer) delayed 400ms "
+        f"outbound; chain advanced {base}->{base + 3}+ with zero divergence"
+    )
+
+
+scenario_delay_proposer.self_start = True
+
+
+def scenario_flood(net: ProcTestnet) -> None:
+    """(d) Mempool flood + recheck storm: a burst of async txs across
+    every node forces multi-block commits with a non-empty mempool at
+    each boundary — the recheck path — while gossip fans the burst out.
+    Telemetry must tell the truth: mempool add/recheck events in the
+    black box, a live tm_mempool_size series, and a drained mempool with
+    every tx committed by the end."""
+    mports = enable_prometheus(net)
+    net.start_all()
+    net.wait_all(2)
+    nem = Nemesis(net)
+    # waves, not one burst: later waves land while earlier ones are being
+    # committed, so the post-commit mempool is non-empty and the recheck
+    # sweep actually runs (one mega-burst can fit a single block)
+    keys: list[str] = []
+    for wave in range(4):
+        keys += nem.flood(60, prefix=f"nf{os.getpid()}w{wave}-")
+        time.sleep(0.4)
+
+    # every tx commits: mempools drain and a sample is queryable anywhere
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        sizes = [
+            (net.rpc(i, "num_unconfirmed_txs") or {}).get("n_txs", -1)
+            for i in range(net.n)
+        ]
+        if all(s == 0 for s in sizes):
+            break
+        time.sleep(1.0)
+    else:
+        raise AssertionError(f"mempools never drained: {sizes}")
+    for key in (keys[0], keys[len(keys) // 2], keys[-1]):
+        q = "0x" + key.encode().hex()
+        for i in range(net.n):
+            r = net.rpc(i, f"abci_query?data={q}")
+            assert r and r["response"].get("value"), (key, i)
+
+    kinds = nem.recorder_kinds(0, "mempool")
+    assert ("mempool", "add") in kinds, kinds
+    assert ("mempool", "recheck") in kinds, (
+        f"no recheck storm observed: {kinds}"
+    )
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{mports[0]}/metrics", timeout=5
+    ) as r:
+        text = r.read().decode()
+    assert "tendermint_mempool_size" in text
+    assert "tendermint_runtime_task_crashes_total 0" in text
+    nem.assert_no_crashes()
+    print(
+        f"nemesis_flood: {len(keys)} txs committed through the storm, "
+        f"mempools drained, recheck events recorded"
+    )
+
+
+scenario_flood.self_start = True
+
+
+def scenario_flapping_device(net: ProcTestnet) -> None:
+    """(e) A wedged/FLAPPING device on one validator mid-consensus: the
+    circuit breaker is tripped and reset repeatedly over RPC. Consensus
+    must never stall (the breaker routes verification to the CPU path),
+    health must report the degradation truthfully while open and recover
+    after reset, and the breaker transitions must appear in the flight
+    recorder — multi-node coverage for the PR 1 breaker."""
+    configure_nodes(net, _enable_fault_control)
+    net.start_all()
+    net.wait_all(2)
+    nem = Nemesis(net)
+    victim = 0
+    for cycle in range(3):
+        res = nem.trip_breaker(victim)
+        assert res["breaker"].get("tripped") is True, res
+        h = nem.health(victim)
+        assert h["status"] == "degraded" and "device_breaker_open" in h["degraded"], h
+        # consensus must advance WHILE the breaker is open
+        base = net.height(victim) or 2
+        net.wait_height(victim, base + 1, timeout=90.0)
+        res = nem.reset_breaker(victim)
+        assert res["breaker"].get("tripped") is False, res
+        h = nem.health(victim)
+        assert "device_breaker_open" not in h["degraded"], h
+    head = max(net.height(i) or 2 for i in range(net.n))
+    net.wait_all(head)
+    nem.assert_agreement(max(1, head - 1))
+    kinds = nem.recorder_kinds(victim, "device")
+    assert ("device", "breaker") in kinds, kinds
+    nem.assert_no_crashes()
+    print(
+        "nemesis_flapping_device: 3 trip/reset cycles, health degraded/"
+        "recovered truthfully, consensus never stalled"
+    )
+
+
+scenario_flapping_device.self_start = True
+
+
+def scenario_crash_sweep(net: ProcTestnet) -> None:
+    """(f) Crash-at-every-fail.fail()-index, networked (parity with the
+    reference's test/persist/test_failure_indices.sh, but against live
+    peers): node0 restarts with FAIL_TEST_INDEX=i, dies with rc=99 at
+    the i-th durability boundary (during live commit, WAL catchup
+    replay, or fast-sync apply — whichever its restart path hits first),
+    restarts clean, and must re-converge with the SAME app hash as the
+    fleet — for every index. TMTPU_CRASH_INDEXES=a,b,... narrows the
+    sweep (CI smoke); default is all 10."""
+    net.start_all()
+    net.wait_all(2)
+    nem = Nemesis(net)
+    spec = os.environ.get("TMTPU_CRASH_INDEXES")
+    indexes = (
+        [int(x) for x in spec.split(",") if x != ""]
+        if spec else list(range(N_CRASH_INDEXES))
+    )
+    for idx in indexes:
+        net.kill(0)
+        net.start(0, env_extra={"FAIL_TEST_INDEX": idx})
+        rc = net.wait_exit(0, timeout=150.0)
+        assert rc == 99, f"index {idx}: expected crash rc=99, got {rc}"
+        net.start(0)
+        target = max(net.height(i) or 2 for i in range(1, net.n)) + 1
+        got = net.wait_height(0, target, timeout=150.0)
+        nem.assert_agreement(target - 1)
+        print(f"  crash index {idx}: died at boundary, recovered to {got}, "
+              f"app hash agrees", flush=True)
+    h = nem.health(0)
+    assert h["ready"] is True and h["task_crashes"] == 0, h
+    kinds = nem.recorder_kinds(0)
+    assert ("consensus", "commit") in kinds and ("wal", "end_height") in kinds, (
+        kinds
+    )
+    nem.assert_no_crashes()
+    print(
+        f"nemesis_crash_sweep: {len(indexes)} crash indexes swept, every "
+        f"restart recovered with app-hash agreement"
+    )
+
+
+scenario_crash_sweep.self_start = True
+
+
+SCENARIOS = {
+    "nemesis_byzantine": scenario_byzantine,
+    "nemesis_partition": scenario_partition,
+    "nemesis_delay_proposer": scenario_delay_proposer,
+    "nemesis_flood": scenario_flood,
+    "nemesis_flapping_device": scenario_flapping_device,
+    "nemesis_crash_sweep": scenario_crash_sweep,
+}
+
+# the sub-10-minute set the CI nemesis job and tier-1 wrappers draw from
+FAST = ["nemesis_byzantine", "nemesis_partition", "nemesis_delay_proposer",
+        "nemesis_flood", "nemesis_flapping_device"]
+
+
+def run(names=None, n: int = 4) -> None:
+    """Run nemesis scenarios through proc_testnet's harness (same failure
+    artifacts: node log tails + preserved logs + fleet_report.json)."""
+    _run(list(names or FAST), n=n)
+
+
+if __name__ == "__main__":
+    run(sys.argv[1:] or None)
+    print("nemesis: all scenarios passed")

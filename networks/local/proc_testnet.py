@@ -97,14 +97,20 @@ class ProcTestnet:
     def rpc_port(self, i: int) -> int:
         return self.base_port + 2 * i + 1
 
-    def start(self, i: int) -> None:
+    def start(self, i: int, env_extra: dict | None = None) -> None:
+        """Start node i; `env_extra` adds per-node environment (the
+        nemesis scenarios arm FAIL_TEST_INDEX / TMTPU_BYZANTINE on one
+        node only)."""
         assert self.procs.get(i) is None, f"node{i} already running"
         log = open(os.path.join(self.root, f"node{i}.log"), "ab")
         self.logs[i] = log
+        env = self._env()
+        if env_extra:
+            env.update({k: str(v) for k, v in env_extra.items()})
         self.procs[i] = subprocess.Popen(
             [sys.executable, "-m", "tendermint_tpu.cmd",
              "--home", self.home(i), "node"],
-            cwd=REPO_ROOT, env=self._env(), stdout=log, stderr=log,
+            cwd=REPO_ROOT, env=env, stdout=log, stderr=log,
         )
 
     def start_all(self) -> None:
@@ -122,6 +128,27 @@ class ProcTestnet:
         for i in range(self.n):
             if self.procs.get(i) is not None:
                 self.kill(i)
+
+    def pause(self, i: int) -> None:
+        """SIGSTOP: freeze the process without killing it (a wedged, not
+        dead, node — the scheduler keeps its sockets open)."""
+        p = self.procs.get(i)
+        assert p is not None, f"node{i} not running"
+        p.send_signal(signal.SIGSTOP)
+
+    def resume(self, i: int) -> None:
+        p = self.procs.get(i)
+        assert p is not None, f"node{i} not running"
+        p.send_signal(signal.SIGCONT)
+
+    def wait_exit(self, i: int, timeout: float = 120.0) -> int:
+        """Block until node i's process exits ON ITS OWN (crash-point
+        scenarios); returns the exit code and clears the slot."""
+        p = self.procs.get(i)
+        assert p is not None, f"node{i} not running"
+        rc = p.wait(timeout=timeout)
+        self.procs[i] = None
+        return rc
 
     def stop(self) -> None:
         for i in range(self.n):
@@ -172,6 +199,19 @@ class ProcTestnet:
         ni = self.rpc(i, "net_info")
         return int(ni["n_peers"]) if ni else 0
 
+    def node_id(self, i: int) -> str | None:
+        st = self.rpc(i, "status")
+        return st["node_info"]["node_id"] if st else None
+
+    def app_hash(self, i: int, height: int) -> str | None:
+        """header.app_hash at `height` (state agreement probe)."""
+        r = self.rpc(i, f"block?height={height}", timeout=5.0)
+        return r["block"]["header"]["app_hash"] if r else None
+
+    def block_hash(self, i: int, height: int) -> str | None:
+        r = self.rpc(i, f"block?height={height}", timeout=5.0)
+        return r["block_id"]["hash"] if r else None
+
     def wait_height(self, i: int, h: int, timeout: float = 180.0) -> int:
         """Block until node i reports height >= h; returns the height."""
         deadline = time.monotonic() + timeout
@@ -194,6 +234,42 @@ class ProcTestnet:
 
     def wait_all(self, h: int, timeout: float = 180.0) -> list[int]:
         return [self.wait_height(i, h, timeout) for i in range(self.n)]
+
+
+# -- config helpers shared by the scenarios (metrics/timeline/nemesis) -------
+
+
+def configure_nodes(net: ProcTestnet, mutate) -> None:
+    """Rewrite every node's config.json BEFORE any node starts;
+    `mutate(i, cfg)` edits the parsed config in place."""
+    assert not any(net.procs.values()), "configs must be rewritten pre-start"
+    for i in range(net.n):
+        cfg_path = os.path.join(net.home(i), "config", "config.json")
+        with open(cfg_path, encoding="utf-8") as f:
+            cfg = json.load(f)
+        mutate(i, cfg)
+        with open(cfg_path, "w", encoding="utf-8") as f:
+            json.dump(cfg, f, indent=1, sort_keys=True)
+
+
+def enable_prometheus(net: ProcTestnet) -> dict[int, int]:
+    """Enable the /metrics server on a free port per node; returns
+    {node index: port}."""
+    mports: dict[int, int] = {}
+    for i in range(net.n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        mports[i] = s.getsockname()[1]
+        s.close()
+
+    def mutate(i: int, cfg: dict) -> None:
+        cfg["instrumentation"]["prometheus"] = True
+        cfg["instrumentation"]["prometheus_listen_addr"] = (
+            f"tcp://127.0.0.1:{mports[i]}"
+        )
+
+    configure_nodes(net, mutate)
+    return mports
 
 
 # -- scenarios (reference test/p2p/{basic,fast_sync,kill_all}/test.sh) -------
@@ -276,19 +352,16 @@ def scenario_pex(net: ProcTestnet) -> None:
     the loner to node1..n-2. Any peer beyond node0 exists only because
     addresses propagated through peer exchange (the loner learning others
     from node0's addrbook, or others learning the loner)."""
-    assert not any(net.procs.values()), "pex scenario owns node startup"
     loner = net.n - 1
-    for i in range(net.n):
-        cfg_path = os.path.join(net.home(i), "config", "config.json")
-        with open(cfg_path, encoding="utf-8") as f:
-            cfg = json.load(f)
+
+    def mutate(i: int, cfg: dict) -> None:
         peers = cfg["p2p"]["persistent_peers"].split(",")
         if i == loner:
             cfg["p2p"]["persistent_peers"] = peers[0]  # node0 only
         else:
             cfg["p2p"]["persistent_peers"] = ",".join(peers[:loner])
-        with open(cfg_path, "w", encoding="utf-8") as f:
-            json.dump(cfg, f, indent=1, sort_keys=True)
+
+    configure_nodes(net, mutate)
     net.start_all()
     deadline = time.monotonic() + 150
     peers_n = 0
@@ -315,22 +388,7 @@ def scenario_metrics(net: ProcTestnet) -> None:
     tm_consensus_height, per-channel tm_p2p_peer_send_bytes_total and
     tm_mempool_size, and health/debug_flight_recorder answer from a
     live node."""
-    assert not any(net.procs.values()), "metrics scenario owns node startup"
-    mports = {}
-    for i in range(net.n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        mports[i] = s.getsockname()[1]
-        s.close()
-        cfg_path = os.path.join(net.home(i), "config", "config.json")
-        with open(cfg_path, encoding="utf-8") as f:
-            cfg = json.load(f)
-        cfg["instrumentation"]["prometheus"] = True
-        cfg["instrumentation"]["prometheus_listen_addr"] = (
-            f"tcp://127.0.0.1:{mports[i]}"
-        )
-        with open(cfg_path, "w", encoding="utf-8") as f:
-            json.dump(cfg, f, indent=1, sort_keys=True)
+    mports = enable_prometheus(net)
     net.start_all()
     net.wait_all(2)
     # traffic: one committed tx (mempool admission + gossip + consensus)
@@ -393,23 +451,10 @@ def scenario_timeline(net: ProcTestnet) -> None:
     height within the bound; no stale-round votes in flight). The report
     is written to <root>/fleet_report.json (preserved on failure for the
     CI artifact upload)."""
-    assert not any(net.procs.values()), "timeline scenario owns node startup"
-    mports = {}
-    for i in range(net.n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        mports[i] = s.getsockname()[1]
-        s.close()
-        cfg_path = os.path.join(net.home(i), "config", "config.json")
-        with open(cfg_path, encoding="utf-8") as f:
-            cfg = json.load(f)
-        cfg["instrumentation"]["tracing"] = True
-        cfg["instrumentation"]["prometheus"] = True
-        cfg["instrumentation"]["prometheus_listen_addr"] = (
-            f"tcp://127.0.0.1:{mports[i]}"
-        )
-        with open(cfg_path, "w", encoding="utf-8") as f:
-            json.dump(cfg, f, indent=1, sort_keys=True)
+    mports = enable_prometheus(net)
+    configure_nodes(
+        net, lambda i, cfg: cfg["instrumentation"].update(tracing=True)
+    )
     net.start_all()
     net.wait_all(2)
     # traffic: one committed tx, then a couple more heights of timeline
@@ -494,20 +539,17 @@ def scenario_soak(net: ProcTestnet, duration: float = 600.0) -> None:
     duration (the committed run log uses the full 600s)."""
     import random as _random
 
-    assert not any(net.procs.values()), "soak scenario owns node startup"
     duration = float(os.environ.get("TMTPU_SOAK_DURATION", duration))
     rng = _random.Random(1234)
-    for i in range(net.n):
-        cfg_path = os.path.join(net.home(i), "config", "config.json")
-        with open(cfg_path, encoding="utf-8") as f:
-            cfg = json.load(f)
+
+    def mutate(i: int, cfg: dict) -> None:
         cfg["p2p"]["test_fuzz"] = True
         # the loop watchdog dumps task stacks if a node's loop stalls —
         # without it a soak-found wedge is an undiagnosable silent node
         cfg["instrumentation"]["watchdog_interval"] = 2.0
         cfg["instrumentation"]["watchdog_grace"] = 30.0
-        with open(cfg_path, "w", encoding="utf-8") as f:
-            json.dump(cfg, f, indent=1, sort_keys=True)
+
+    configure_nodes(net, mutate)
     net.start_all()
     net.wait_all(2)
     t0 = time.monotonic()
@@ -585,16 +627,27 @@ SCENARIOS = {
 }
 
 
+def all_scenarios() -> dict:
+    """Core scenarios + the adversarial nemesis matrix (lazy import —
+    nemesis.py imports this module)."""
+    from networks.local import nemesis
+
+    return {**SCENARIOS, **nemesis.SCENARIOS}
+
+
 def run(names=None, n: int = 4) -> None:
-    # the default sweep excludes the 10-minute soak; ask for it by name
+    # the default sweep excludes the 10-minute soak and the nemesis
+    # matrix; ask for those by name (or via networks.local.nemesis)
+    registry = SCENARIOS if names is None else all_scenarios()
     names = list(names or [s for s in SCENARIOS if s != "soak"])
     for name in names:
+        scenario = registry[name]
         net = ProcTestnet(n=n)
         try:
             net.generate()
-            if not getattr(SCENARIOS[name], "self_start", False):
+            if not getattr(scenario, "self_start", False):
                 net.start_all()
-            SCENARIOS[name](net)
+            scenario(net)
         except BaseException as exc:
             # the temp root is deleted in stop(): surface each node's log
             # tail NOW and preserve the full logs for post-mortem

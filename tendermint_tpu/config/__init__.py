@@ -63,6 +63,11 @@ class P2PConfig:
     handshake_timeout: float = 20.0
     dial_timeout: float = 3.0
     test_fuzz: bool = False
+    # Nemesis fault control (libs/fault.py): wrap every peer link in a
+    # runtime-controllable fault injector driven by the `debug_fault`
+    # RPC route (partition / asymmetric delay / drop, and device-breaker
+    # tripping). Test harness only — leave off in production.
+    test_fault_control: bool = False
 
 
 @dataclass

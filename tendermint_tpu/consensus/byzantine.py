@@ -1,0 +1,91 @@
+"""Byzantine behaviour harness — a validator that equivocates on purpose.
+
+The in-process byzantine tests (tests/test_byzantine.py, reference
+consensus/byzantine_test.go) patch a ConsensusState inside one pytest
+process. The nemesis scenario matrix needs the same attacker as a REAL
+node process in a real testnet, so the equivocation travels over real
+TCP gossip and the resulting `DuplicateVoteEvidence` exercises
+`evidence/reactor.py` end to end — verified, gossiped, reaped into a
+proposal, and committed in a block on every honest node.
+
+`install_byzantine_voter(node)` replaces the node's `sign_add_vote`
+with one that signs TWO conflicting votes per step (the honest target
+and a fabricated BlockID) and sends each directly to a different half
+of the connected peers, bypassing the node's own state machine — the
+byzantine VOTER shape. The honest 3/4 majority keeps committing; gossip
+relay brings both conflicting votes together on honest nodes, whose
+`ConflictingVoteError` handler mints the evidence.
+
+Double-sign protection: `FilePV.sign_vote` would (correctly) refuse the
+second signature, so the harness signs the raw sign-bytes with the
+underlying key — exactly what real Byzantine hardware would do.
+
+Armed ONLY when both hold (networks/local/nemesis.py sets both):
+- env `TMTPU_BYZANTINE=voter`
+- config `p2p.test_fault_control` is true (the nemesis master switch)
+"""
+from __future__ import annotations
+
+from tendermint_tpu.libs.recorder import RECORDER
+from tendermint_tpu.types import BlockID
+from tendermint_tpu.types.vote import Vote, now_ns
+
+
+def _raw_sign(pv, sign_bytes: bytes) -> bytes:
+    """Sign bypassing any double-sign guard: FilePV keeps the key at
+    .key.priv_key, MockPV at ._priv."""
+    key = getattr(getattr(pv, "key", None), "priv_key", None)
+    if key is None:
+        key = getattr(pv, "_priv", None)
+    if key is None:
+        raise TypeError(f"cannot extract signing key from {type(pv).__name__}")
+    return key.sign(sign_bytes)
+
+
+def install_byzantine_voter(node) -> None:
+    """Patch `node.consensus_state.sign_add_vote` into the equivocating
+    voter. Must be called after the node's switch + consensus state are
+    built (node/__init__.py build step 10)."""
+    import hashlib
+
+    from tendermint_tpu.consensus import messages as m
+    from tendermint_tpu.consensus.reactor import VOTE_CHANNEL
+    from tendermint_tpu.types import PartSetHeader
+
+    cs = node.consensus_state
+
+    async def sign_add_vote(type_, hash_, parts_header):
+        rs = cs.rs
+        pv = cs.priv_validator
+        if pv is None:
+            return None
+        addr = pv.address
+        idx, val = rs.validators.get_by_address(addr)
+        if val is None:
+            return None
+        real_bid = BlockID(hash_, parts_header or PartSetHeader())
+        seed = b"equivocate-%d-%d" % (rs.height, rs.round)
+        fake_h = hashlib.sha256(seed).digest()
+        fake_bid = BlockID(fake_h, PartSetHeader(1, hashlib.sha256(fake_h).digest()))
+        ts = now_ns()
+        votes = []
+        for bid in (real_bid, fake_bid):
+            v = Vote(type_, rs.height, rs.round, bid, ts, addr, idx)
+            votes.append(
+                v.with_signature(_raw_sign(pv, v.sign_bytes(cs.state.chain_id)))
+            )
+        peers = sorted(node.switch.peers.list(), key=lambda p: p.id)
+        half = (len(peers) + 1) // 2
+        for i, peer in enumerate(peers):
+            v = votes[0] if i < half else votes[1]
+            await peer.send(
+                VOTE_CHANNEL, m.encode_consensus_message(m.VoteMessage(v))
+            )
+        RECORDER.record(
+            "byzantine", "equivocate", height=rs.height, round=rs.round,
+            type=int(type_), peers=len(peers),
+        )
+        return None
+
+    cs.sign_add_vote = sign_add_vote
+    RECORDER.record("byzantine", "armed", mode="voter")

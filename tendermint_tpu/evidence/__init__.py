@@ -22,6 +22,7 @@ import struct
 from tendermint_tpu.libs.clist import CList
 from tendermint_tpu.libs.db import DB
 from tendermint_tpu.libs.log import NOP, Logger
+from tendermint_tpu.libs.recorder import RECORDER
 from tendermint_tpu.state import State, StateStore
 from tendermint_tpu.state.validation import ValidationError, verify_evidence
 from tendermint_tpu.types.evidence import Evidence, decode_evidence
@@ -126,6 +127,10 @@ class EvidencePool:
         # deleted exactly even after historical valsets are pruned
         self._db.set(b"EV:prio:" + ev.hash(), struct.pack(">Q", priority))
         self._in_list[ev.hash()] = self.evidence_list.push_back(ev)
+        RECORDER.record(
+            "evidence", "added", height=ev.height(),
+            addr=ev.address().hex(), priority=priority,
+        )
         self.log.info("added evidence", evidence=str(ev), priority=priority)
 
     def _stored_priority(self, ev: Evidence) -> int:
@@ -141,6 +146,10 @@ class EvidencePool:
         for ev in evidence:
             self._db.set(self._committed_key(ev), b"1")
             self._remove_pending(ev)
+            RECORDER.record(
+                "evidence", "committed", height=ev.height(),
+                addr=ev.address().hex(),
+            )
 
     def _remove_pending(self, ev: Evidence) -> None:
         self._db.delete(self._pending_key(ev))
@@ -156,7 +165,14 @@ class EvidencePool:
         self.state = state
         self.mark_committed(block.evidence)
         max_age = state.consensus_params.evidence.max_age
+        pruned = 0
         for _, raw in list(self._db.iterate_prefix(b"EV:pending:")):
             ev = decode_evidence(raw)
             if ev.height() < state.last_block_height - max_age:
                 self._remove_pending(ev)
+                pruned += 1
+        if pruned:
+            RECORDER.record(
+                "evidence", "pruned", count=pruned,
+                height=state.last_block_height, max_age=max_age,
+            )

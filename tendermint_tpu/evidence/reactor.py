@@ -12,6 +12,7 @@ import asyncio
 from tendermint_tpu.encoding import Reader, Writer
 from tendermint_tpu.evidence import EvidenceError, EvidencePool
 from tendermint_tpu.libs.log import NOP, Logger
+from tendermint_tpu.libs.recorder import RECORDER
 from tendermint_tpu.p2p.base_reactor import BaseReactor, ChannelDescriptor
 from tendermint_tpu.types.evidence import decode_evidence
 
@@ -71,6 +72,10 @@ class EvidenceReactor(BaseReactor):
                 # valid evidence unverifiable here (too old for us, or from a
                 # height we haven't stored validators for). Reject the
                 # evidence, keep the peer.
+                RECORDER.record(
+                    "evidence", "rejected", peer=peer.id,
+                    height=ev.height(), err=str(e)[:200],
+                )
                 self.log.info("rejected evidence from peer", peer=peer.id, err=str(e))
 
     async def _broadcast_routine(self, peer) -> None:
@@ -87,4 +92,7 @@ class EvidenceReactor(BaseReactor):
             # outqueue (reference reactor.go broadcastEvidenceRoutine ->
             # store MarkEvidenceAsBroadcasted); still pending until committed
             self.pool.mark_broadcasted(ev)
+            RECORDER.record(
+                "evidence", "gossip_sent", peer=peer.id, height=ev.height(),
+            )
             el = await el.next_wait()

@@ -1,0 +1,181 @@
+"""Runtime-controllable per-link fault injection (the nemesis plane).
+
+Lives in libs/ beside its sibling `libs/fail.py` (deterministic crash
+points): both are test-harness fault surfaces with no dependency on the
+crypto stack, so the unit tier can exercise them in any environment.
+
+`p2p/fuzz.py` injects *probabilistic, static* faults configured at boot
+(reference p2p/fuzz.go). This module is the complement the adversarial
+scenario matrix needs: *deterministic, per-link* faults that an external
+driver (networks/local/nemesis.py) flips at runtime over the
+`debug_fault` RPC route — partition a link entirely, add asymmetric
+delay toward a specific peer, drop a fraction of messages — and heal
+them again, all without restarting the node.
+
+The plan is a process-wide singleton (like `libs/recorder.RECORDER`):
+the switch wraps every authenticated connection in a `FaultedConnection`
+keyed by the remote peer id when `config.p2p.test_fault_control` is on,
+and every wrapper consults `FAULTS` per operation. With no faults
+installed the per-op cost is one attribute read and one dict lookup.
+
+Semantics:
+- partition: every message to AND from the peer is silently dropped
+  (a blackhole, not a disconnect — the TCP link stays up, which is the
+  harder case for the reactors: no error, just silence). Pings are
+  dropped too, so a long partition may also surface as peer-timeout
+  disconnect + redial churn, exactly like a real one.
+- delay: each matching operation sleeps `ms` before proceeding;
+  `direction` chooses send, recv, or both (asymmetric delay targets
+  the proposer's outbound gossip without touching its inbound).
+- drop: per-message drop probability (deterministically seeded rng so
+  a scenario re-run sees the same loss pattern).
+
+Every mutation records a `("fault", kind)` event in the flight
+recorder, so a scenario's fault windows are part of the same black-box
+timeline its assertions read (docs/nemesis.md).
+
+Test-only by construction: nothing here is reachable unless
+`p2p.test_fault_control` is explicitly enabled in the node config.
+"""
+from __future__ import annotations
+
+import asyncio
+import random
+
+from tendermint_tpu.libs.recorder import RECORDER
+
+ALL = "*"  # wildcard peer key: the fault applies to every link
+
+
+class FaultPlan:
+    """Current fault rules, keyed by remote peer id (or `ALL`)."""
+
+    def __init__(self) -> None:
+        self._partition: set[str] = set()
+        self._delay: dict[str, dict] = {}  # peer -> {"ms": float, "direction": str}
+        self._drop: dict[str, float] = {}  # peer -> probability
+        self._rng = random.Random(0xFA17)
+        self.generation = 0  # bumps on every mutation (debug visibility)
+        self.dropped = 0  # messages blackholed/dropped since boot
+
+    # -- mutation (driven by the debug_fault RPC route) ---------------------
+
+    def _bump(self, kind: str, **fields) -> None:
+        self.generation += 1
+        RECORDER.record("fault", kind, generation=self.generation, **fields)
+
+    def partition(self, peers: list[str]) -> None:
+        self._partition.update(peers)
+        self._bump("partition", peers=sorted(self._partition))
+
+    def delay(self, peers: list[str], ms: float, direction: str = "both") -> None:
+        if direction not in ("send", "recv", "both"):
+            raise ValueError(f"bad direction {direction!r}")
+        for p in peers:
+            self._delay[p] = {"ms": float(ms), "direction": direction}
+        self._bump("delay", peers=sorted(peers), ms=float(ms),
+                   direction=direction)
+
+    def drop(self, peers: list[str], prob: float) -> None:
+        prob = min(1.0, max(0.0, float(prob)))
+        for p in peers:
+            self._drop[p] = prob
+        self._bump("drop", peers=sorted(peers), prob=prob)
+
+    def heal(self) -> None:
+        self._partition.clear()
+        self._delay.clear()
+        self._drop.clear()
+        self._bump("heal")
+
+    @property
+    def active(self) -> bool:
+        return bool(self._partition or self._delay or self._drop)
+
+    # -- per-operation queries (hot path) -----------------------------------
+
+    def _match(self, table, peer_id: str):
+        if peer_id in table:
+            return peer_id
+        if ALL in table:
+            return ALL
+        return None
+
+    def should_drop(self, peer_id: str) -> bool:
+        """True when a message on this link must vanish (counted)."""
+        if peer_id in self._partition or ALL in self._partition:
+            self.dropped += 1
+            return True
+        key = self._match(self._drop, peer_id)
+        if key is not None and self._rng.random() < self._drop[key]:
+            self.dropped += 1
+            return True
+        return False
+
+    def delay_s(self, peer_id: str, direction: str) -> float:
+        key = self._match(self._delay, peer_id)
+        if key is None:
+            return 0.0
+        rule = self._delay[key]
+        if rule["direction"] in (direction, "both"):
+            return rule["ms"] / 1e3
+        return 0.0
+
+    def snapshot(self) -> dict:
+        return {
+            "generation": self.generation,
+            "dropped": self.dropped,
+            "partition": sorted(self._partition),
+            "delay": dict(self._delay),
+            "drop": dict(self._drop),
+        }
+
+
+class FaultedConnection:
+    """Wraps a SecretConnection-shaped object (write/drain/read_msg/close)
+    and applies the live `FaultPlan` for one remote peer. Composes with
+    `FuzzedConnection` (this wrapper goes outermost, so a partition
+    blackholes the link regardless of what the fuzz layer would do)."""
+
+    def __init__(self, conn, peer_id: str, plan: FaultPlan | None = None) -> None:
+        self._conn = conn
+        self.peer_id = peer_id
+        self.plan = plan if plan is not None else FAULTS
+
+    @property
+    def remote_pubkey(self):
+        return self._conn.remote_pubkey
+
+    async def write(self, data: bytes) -> None:
+        plan = self.plan
+        if plan.active:
+            d = plan.delay_s(self.peer_id, "send")
+            if d > 0:
+                await asyncio.sleep(d)
+            if plan.should_drop(self.peer_id):
+                return  # blackholed
+        await self._conn.write(data)
+
+    async def drain(self) -> None:
+        await self._conn.drain()
+
+    async def read_msg(self) -> bytes:
+        while True:
+            msg = await self._conn.read_msg()
+            plan = self.plan
+            if not plan.active:
+                return msg
+            if plan.should_drop(self.peer_id):
+                continue  # inbound blackhole: discard, keep reading
+            d = plan.delay_s(self.peer_id, "recv")
+            if d > 0:
+                await asyncio.sleep(d)
+            return msg
+
+    def close(self) -> None:
+        self._conn.close()
+
+
+# Process-wide singleton (like RECORDER / trace.DEVICE): the switch's
+# wrappers and the debug_fault RPC route share it without plumbing.
+FAULTS = FaultPlan()

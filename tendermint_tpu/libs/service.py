@@ -139,14 +139,29 @@ class BaseService:
             # scheduling the redial.
             cur = asyncio.current_task()
             self_stop = cur is not None and cur in self._tasks
-            others = [t for t in self._tasks if t is not cur]
-            for t in others:
-                t.cancel()
-            for t in others:
-                try:
-                    await t
-                except (asyncio.CancelledError, Exception):
-                    pass
+            # Sweep until quiescent: awaiting a cancelled task yields the
+            # loop, and a continuation running in that window may spawn()
+            # a NEW task (e.g. a reactor scheduling a redial) — the old
+            # single-pass sweep left it in _tasks and then clear()ed the
+            # reference uncancelled, orphaning it forever (ADVICE r5
+            # leftover). Re-scan until no live task remains; the rounds
+            # bound keeps a pathological spawn-on-cancel loop from
+            # wedging stop() (leftovers are still cancelled, just not
+            # awaited).
+            for _ in range(8):
+                others = [t for t in self._tasks if t is not cur and not t.done()]
+                if not others:
+                    break
+                for t in others:
+                    t.cancel()
+                for t in others:
+                    try:
+                        await t
+                    except (asyncio.CancelledError, Exception):
+                        pass
+            for t in self._tasks:
+                if t is not cur and not t.done():
+                    t.cancel()
             self._tasks.clear()
             if self_stop:
                 # Don't drop the caller's own task uncancelled either

@@ -293,6 +293,7 @@ class Node(BaseService):
             max_inbound_peers=cfg.p2p.max_num_inbound_peers,
             max_outbound_peers=cfg.p2p.max_num_outbound_peers,
             fuzz_config=fuzz_config,
+            fault_control=cfg.p2p.test_fault_control,
         )
         self.switch.addr_book = self.addr_book
         for name, r in reactors.items():
@@ -380,6 +381,20 @@ class Node(BaseService):
             mhost, mport = parse_laddr(cfg.instrumentation.prometheus_listen_addr)
             self.metrics_server = tmm.MetricsServer(self.metrics, mhost, mport)
         self.rpc_env.crash_baseline = self._crash_baseline
+
+        # 10. nemesis byzantine harness: an env-armed equivocating voter
+        # for the adversarial scenario matrix (consensus/byzantine.py).
+        # Requires BOTH the env var and the fault-control master switch,
+        # so a stray env var on a production node is inert.
+        if (
+            cfg.p2p.test_fault_control
+            and os.environ.get("TMTPU_BYZANTINE") == "voter"
+            and self.priv_validator is not None
+        ):
+            from tendermint_tpu.consensus.byzantine import install_byzantine_voter
+
+            install_byzantine_voter(self)
+            log.info("BYZANTINE VOTER ARMED (TMTPU_BYZANTINE=voter)")
         self._built = True
 
     def _consensus_possible(self, state) -> bool:

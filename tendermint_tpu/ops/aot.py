@@ -32,8 +32,8 @@ commit-verify loop at /root/reference/types/validator_set.go:591-633.
 """
 from __future__ import annotations
 
+import json
 import os
-import pickle
 import sys
 
 TOPOLOGY = "v5e:2x2"
@@ -171,7 +171,7 @@ def _bake_one(path: str, plain_fn, arg_shapes, sharding, label: str) -> bool:
         )
         compiled = jitted.lower(*arg_shapes).compile()
         payload, in_tree, out_tree = serialize_executable.serialize(compiled)
-        _write(path, (payload, in_tree, out_tree))
+        _write(path, payload, in_tree, out_tree)
         print(
             f"baked {label}: {os.path.getsize(path):,} bytes",
             file=sys.stderr,
@@ -201,22 +201,110 @@ def _bake_secp(bucket: int, sharding) -> None:
               f"secp bucket {bucket}")
 
 
-def _write(path: str, obj) -> None:
+# -- on-disk format ----------------------------------------------------------
+#
+# Two files per artifact: `<path>` holds the RAW serialized-executable
+# bytes exactly as XLA produced them, and `<path>.tree.json` is a JSON
+# sidecar describing the call-signature pytrees. The previous format was
+# one pickle of (payload, in_tree, out_tree) — but unpickling a cache
+# file is an arbitrary-code-execution surface (ROADMAP item 1 / ADVICE):
+# anyone who can write to the cache dir owns the process at the next
+# load. Raw bytes + JSON can encode no behaviour; a legacy pickle file
+# simply has no sidecar and is a cache miss (re-bake to migrate).
+
+
+def _sidecar(path: str) -> str:
+    return path + ".tree.json"
+
+
+def _treedef_to_spec(treedef):
+    """PyTreeDef -> JSON-able spec. Only the stdlib containers jax call
+    signatures are made of (tuple/list/dict/None + leaves) are supported;
+    anything else fails the bake loudly rather than silently pickling."""
+    import jax
+
+    leaf = object()  # unique marker: None is itself a pytree node in jax
+    skeleton = jax.tree_util.tree_unflatten(
+        treedef, [leaf] * treedef.num_leaves
+    )
+
+    def conv(obj):
+        if obj is leaf:
+            return "*"
+        if isinstance(obj, tuple):
+            if hasattr(obj, "_fields"):  # namedtuple: distinct treedef
+                raise ValueError("unsupported pytree node namedtuple")
+            return {"t": [conv(x) for x in obj]}
+        if isinstance(obj, list):
+            return {"l": [conv(x) for x in obj]}
+        if isinstance(obj, dict):
+            if not all(isinstance(k, str) for k in obj):
+                raise ValueError("unsupported pytree: non-string dict key")
+            return {"d": {k: conv(v) for k, v in obj.items()}}
+        if obj is None:
+            return {"n": True}  # structural None node (zero leaves)
+        raise ValueError(f"unsupported pytree node {type(obj).__name__}")
+
+    return conv(skeleton)
+
+
+def _spec_to_treedef(spec):
+    """JSON spec -> PyTreeDef (inverse of `_treedef_to_spec`)."""
+    import jax
+
+    def conv(s):
+        if s == "*":
+            return 0  # any non-container object is a leaf
+        if isinstance(s, dict):
+            if "t" in s:
+                return tuple(conv(x) for x in s["t"])
+            if "l" in s:
+                return [conv(x) for x in s["l"]]
+            if "d" in s:
+                return {k: conv(v) for k, v in s["d"].items()}
+            if "n" in s:
+                return None
+        raise ValueError(f"bad tree spec {s!r}")
+
+    return jax.tree_util.tree_structure(conv(spec))
+
+
+def _write(path: str, payload: bytes, in_tree, out_tree) -> None:
     os.makedirs(os.path.dirname(path), exist_ok=True)
+    spec = json.dumps({
+        "format": 1,
+        "in_tree": _treedef_to_spec(in_tree),
+        "out_tree": _treedef_to_spec(out_tree),
+    })
     tmp = path + f".tmp{os.getpid()}"
     with open(tmp, "wb") as f:
-        pickle.dump(obj, f)
+        f.write(payload)
     os.replace(tmp, path)
+    # sidecar last: a crash in between leaves payload-without-sidecar,
+    # which the loader treats as a miss
+    stmp = _sidecar(path) + f".tmp{os.getpid()}"
+    with open(stmp, "w", encoding="utf-8") as f:
+        f.write(spec)
+    os.replace(stmp, _sidecar(path))
 
 
 def _load(path: str):
     """Deserialize one cached executable into the live client; returns the
-    jax.stages.Compiled or None. Any failure (missing file, version skew,
-    client without deserialize support) is a cache miss."""
+    jax.stages.Compiled or None. Any failure (missing file/sidecar,
+    version skew, client without deserialize support) is a cache miss."""
     try:
+        with open(_sidecar(path), encoding="utf-8") as f:
+            spec = json.load(f)
         with open(path, "rb") as f:
-            payload, in_tree, out_tree = pickle.load(f)
-    except (OSError, ValueError, pickle.UnpicklingError, EOFError):
+            payload = f.read()
+    except (OSError, ValueError):
+        # missing sidecar also covers legacy pickle-era artifacts, which
+        # are deliberately never unpickled
+        return None
+    try:
+        in_tree = _spec_to_treedef(spec["in_tree"])
+        out_tree = _spec_to_treedef(spec["out_tree"])
+    except (KeyError, TypeError, ValueError):
         return None
     try:
         import jax

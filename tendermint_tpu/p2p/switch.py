@@ -57,10 +57,12 @@ class Switch(BaseService):
         max_inbound_peers: int = 40,
         max_outbound_peers: int = 10,
         fuzz_config=None,  # p2p.fuzz.FuzzConfig | None (config.p2p.test_fuzz)
+        fault_control: bool = False,  # config.p2p.test_fault_control
     ) -> None:
         super().__init__(name="Switch")
         self.transport = transport
         self.fuzz_config = fuzz_config
+        self.fault_control = fault_control
         self.peers = PeerSet()
         self.reactors: dict[str, object] = {}
         self._chan_descs: list = []
@@ -203,6 +205,13 @@ class Switch(BaseService):
             from tendermint_tpu.p2p.fuzz import FuzzedConnection
 
             conn = FuzzedConnection(conn, self.fuzz_config)
+        if self.fault_control:
+            # nemesis plane (config.p2p.test_fault_control): per-link
+            # runtime faults keyed by the remote peer id, outermost so a
+            # partition blackholes the link below any fuzz layer
+            from tendermint_tpu.libs.fault import FaultedConnection
+
+            conn = FaultedConnection(conn, ni.node_id)
         peer = Peer(
             conn,
             ni,

@@ -577,6 +577,65 @@ class Environment:
             ),
         }
 
+    async def debug_fault(
+        self,
+        action: str = "state",
+        peers: str = "*",
+        ms: float = 0.0,
+        prob: float = 0.0,
+        direction: str = "both",
+    ) -> dict:
+        """Nemesis fault control (libs/fault.py + the device breaker),
+        driven by networks/local/nemesis.py. Gated on
+        `config.p2p.test_fault_control` — on a normal node every action
+        is an error. Actions:
+
+        - `state` — current fault plan + breaker state (always allowed
+          when the gate is on);
+        - `partition` — blackhole the links to `peers` (comma-separated
+          peer ids, or `*` for every link);
+        - `delay` — add `ms` latency toward `peers` in `direction`
+          (send | recv | both);
+        - `drop` — drop messages to/from `peers` with probability `prob`;
+        - `heal` — clear every link fault;
+        - `trip_breaker` / `reset_breaker` — force the wedged-device
+          circuit breaker open/closed (multi-node breaker scenarios).
+        """
+        cfg = self.config
+        if cfg is None or not cfg.p2p.test_fault_control:
+            raise RPCError(
+                INVALID_PARAMS,
+                "fault control disabled (config p2p.test_fault_control)",
+            )
+        from tendermint_tpu.libs.fault import FAULTS
+
+        peer_list = [p for p in str(peers).split(",") if p]
+        try:
+            if action == "partition":
+                FAULTS.partition(peer_list)
+            elif action == "delay":
+                FAULTS.delay(peer_list, float(ms), str(direction))
+            elif action == "drop":
+                FAULTS.drop(peer_list, float(prob))
+            elif action == "heal":
+                FAULTS.heal()
+            elif action in ("trip_breaker", "reset_breaker"):
+                try:
+                    from tendermint_tpu.ops import ed25519_batch
+                except Exception as e:  # noqa: BLE001 — no jax/ops stack
+                    raise RPCError(INTERNAL_ERROR, f"ops unavailable: {e!r}")
+                if action == "trip_breaker":
+                    ed25519_batch.breaker.trip()
+                else:
+                    ed25519_batch.breaker.reset()
+            elif action != "state":
+                raise RPCError(INVALID_PARAMS, f"unknown action {action!r}")
+        except ValueError as e:
+            raise RPCError(INVALID_PARAMS, str(e))
+        out = {"action": action, "faults": FAULTS.snapshot()}
+        out["breaker"] = self._device_snapshot()["breaker"]
+        return out
+
     # ------------------------------------------------------------------
     # tx routes
 
@@ -864,6 +923,7 @@ class Environment:
             "debug_consensus_trace": self.debug_consensus_trace,
             "debug_device": self.debug_device,
             "debug_flight_recorder": self.debug_flight_recorder,
+            "debug_fault": self.debug_fault,
             "broadcast_tx_async": self.broadcast_tx_async,
             "broadcast_tx_sync": self.broadcast_tx_sync,
             "broadcast_tx_commit": self.broadcast_tx_commit,

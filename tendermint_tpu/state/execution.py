@@ -104,8 +104,11 @@ class BlockExecutor:
         if self.event_bus is not None:
             await self._fire_events(block, abci_responses, validator_updates)
         elapsed = _time.monotonic() - _t0
+        # app_hash rides the event so the fleet collector can assert
+        # cross-node state agreement per height (nemesis divergence gate)
         RECORDER.record("state", "apply_block", height=block.header.height,
-                        txs=len(block.data.txs), ms=round(elapsed * 1e3, 1))
+                        txs=len(block.data.txs), ms=round(elapsed * 1e3, 1),
+                        app_hash=app_hash.hex())
         if self.metrics is not None:
             self.metrics.block_processing_time.observe(elapsed)
         return new_state

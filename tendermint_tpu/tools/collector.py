@@ -234,6 +234,7 @@ def stitch(scrapes: list[dict],
             "rounds": {},              # r -> type name -> votes/maj23/recv
             "commit": {},              # node -> {"t_wall_ns", "round", ...}
             "new_height": {},          # node -> t_wall_ns
+            "app_hash": {},            # node -> hex app hash (apply_block tap)
         })
 
     def r_entry(h: int, r: int, tname: str) -> dict:
@@ -254,9 +255,16 @@ def stitch(scrapes: list[dict],
             continue
         observers.append(node)
         for e in events:
+            f = e.get("fields") or {}
+            if e.get("sub") == "state" and e.get("kind") == "apply_block":
+                # per-node app hash at each height: the cross-node state-
+                # agreement surface (nemesis divergence invariant)
+                h, ah = f.get("height"), f.get("app_hash")
+                if h is not None and ah:
+                    h_entry(h)["app_hash"].setdefault(node, ah)
+                continue
             if e.get("sub") != "consensus":
                 continue
-            f = e.get("fields") or {}
             kind, t = e.get("kind"), e["t_wall_ns"]
             h = f.get("height")
             if h is None:
@@ -470,6 +478,23 @@ def check_invariants(report: dict, commit_spread_s: float = 2.0) -> list[str]:
                         f"height {h_str}: {n_votes} votes for stale round {r} "
                         f"in flight (decision round {decision})"
                     )
+    # state agreement: every node that applied a height must have computed
+    # the same app hash (the apply_block tap carries it) — the nemesis
+    # partition/crash scenarios' zero-divergence gate
+    for h_str, entry in report["heights"].items():
+        hashes = entry.get("app_hash") or {}
+        if len(set(hashes.values())) > 1:
+            violations.append(
+                f"height {h_str}: app-hash divergence {hashes}"
+            )
+    # no background task died anywhere in the fleet
+    # (tm_runtime_task_crashes_total must stay 0 through every scenario)
+    for n in report.get("nodes", []):
+        if n.get("task_crashes"):
+            violations.append(
+                f"node {n['moniker']}: {n['task_crashes']} background "
+                f"task crash(es)"
+            )
     return violations
 
 

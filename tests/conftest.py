@@ -92,6 +92,11 @@ def pytest_configure(config):
         "allow_task_leak: test intentionally leaves asyncio tasks pending "
         "at return (cleaned up by asyncio.run cancellation)",
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 budget (ROADMAP verify runs "
+        "-m 'not slow'; the CI nemesis/nightly tiers run them)",
+    )
 
 
 @pytest.fixture(autouse=True)

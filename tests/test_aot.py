@@ -6,9 +6,16 @@ against a v5e topology and deserialized into the live client at window
 time. These tests exercise the machinery with a trivial function (the
 real kernels bake in ~minutes; the round's bake log is AOT_r05.md) and
 pin the guards that keep a wrong artifact from loading.
+
+On-disk format (ISSUE 7 satellite): raw serialized-executable bytes +
+a JSON tree-spec sidecar. The previous single-pickle format was an
+arbitrary-code-execution surface; the tests below prove a legacy (or
+malicious) pickle is a plain cache miss that never executes.
 """
 from __future__ import annotations
 
+import json
+import os
 import pickle
 
 import numpy as np
@@ -33,6 +40,104 @@ def topo_sharding():
     return SingleDeviceSharding(topo.devices[0])
 
 
+class TestTreeSpec:
+    """The JSON pytree spec that replaced pickled PyTreeDefs: a lossless
+    round trip for every container shape a jax call signature uses."""
+
+    @pytest.mark.parametrize("tree", [
+        ((0, 0), {}),
+        (((0, 0), {}),),
+        ([0, {"a": 0, "b": (0, None)}],),
+        (None,),
+        (0,),
+        ({},),
+    ])
+    def test_roundtrip(self, tree):
+        import jax
+
+        td = jax.tree_util.tree_structure(tree)
+        spec = aot._treedef_to_spec(td)
+        json.dumps(spec)  # must be pure JSON
+        assert aot._spec_to_treedef(spec) == td
+
+    def test_unsupported_node_fails_loudly(self):
+        import collections
+        import jax
+
+        Point = collections.namedtuple("Point", "x y")
+        td = jax.tree_util.tree_structure(Point(0, 0))
+        with pytest.raises(ValueError):
+            aot._treedef_to_spec(td)
+
+    def test_bad_spec_rejected(self):
+        with pytest.raises(ValueError):
+            aot._spec_to_treedef({"quux": []})
+
+
+class TestWriteLoadFormat:
+    def test_cpu_serialized_executable_roundtrips(self, tmp_path, monkeypatch):
+        """Full write→load→execute cycle against the CPU client (the
+        guard is relaxed to this host's device kind): proves the sidecar
+        reconstruction feeds deserialize_and_load correctly."""
+        import jax
+
+        try:
+            from jax.experimental import serialize_executable
+        except ImportError:
+            pytest.skip("no serialize_executable")
+
+        def f(a, b):
+            return (a * 2 + b).sum(axis=0)
+
+        a = np.arange(8, dtype=np.float32).reshape(2, 4)
+        b = np.ones((2, 4), np.float32)
+        compiled = jax.jit(f).lower(a, b).compile()
+        try:
+            payload, in_tree, out_tree = serialize_executable.serialize(compiled)
+        except Exception as e:  # noqa: BLE001 — backend without serialization
+            pytest.skip(f"backend cannot serialize: {e!r}")
+        path = str(tmp_path / "t.aotexec")
+        aot._write(path, payload, in_tree, out_tree)
+        # raw bytes on disk, JSON beside them — nothing executable
+        with open(path, "rb") as fh:
+            assert fh.read() == payload
+        side = json.load(open(aot._sidecar(path), encoding="utf-8"))
+        assert side["format"] == 1 and "in_tree" in side and "out_tree" in side
+        monkeypatch.setattr(aot, "_DEVICE_KIND", jax.devices()[0].device_kind)
+        loaded = aot._load(path)
+        assert loaded is not None
+        assert np.allclose(np.asarray(loaded(a, b)), f(a, b))
+
+    def test_payload_without_sidecar_is_miss(self, tmp_path):
+        p = tmp_path / "orphan.aotexec"
+        p.write_bytes(b"\x00" * 64)
+        assert aot._load(str(p)) is None
+
+    def test_legacy_pickle_is_inert_miss(self, tmp_path):
+        """A pickle-era artifact (or a malicious plant) must be a cache
+        miss WITHOUT being unpickled — unpickling is the arbitrary-code-
+        execution surface this format change closes."""
+        fired = tmp_path / "pickle-executed"
+
+        class Boom:
+            def __reduce__(self):
+                return (os.mkdir, (str(fired),))
+
+        p = tmp_path / "legacy.aotexec"
+        with open(p, "wb") as fh:
+            pickle.dump((Boom(), 1, 2), fh)
+        assert aot._load(str(p)) is None
+        assert not fired.exists(), "cache load executed pickled code"
+
+    def test_corrupt_sidecar_is_miss(self, tmp_path):
+        p = tmp_path / "c.aotexec"
+        p.write_bytes(b"\x01" * 32)
+        (tmp_path / "c.aotexec.tree.json").write_text("{not json")
+        assert aot._load(str(p)) is None
+        (tmp_path / "c.aotexec.tree.json").write_text('{"format": 1}')
+        assert aot._load(str(p)) is None
+
+
 class TestBakeOne:
     def test_trivial_fn_bakes_and_parses(self, tmp_path, topo_sharding):
         import jax
@@ -48,8 +153,10 @@ class TestBakeOne:
         )
         assert wrote
         with open(path, "rb") as f:
-            payload, in_tree, out_tree = pickle.load(f)
-        assert isinstance(payload, bytes) and len(payload) > 1000
+            payload = f.read()
+        assert len(payload) > 1000
+        side = json.load(open(aot._sidecar(path), encoding="utf-8"))
+        assert side["format"] == 1
         # idempotent: an existing artifact is never re-baked
         assert aot._bake_one(path, None, shapes, topo_sharding, "x") is False
 
@@ -60,8 +167,6 @@ class TestBakeOne:
             (np.zeros(4),), topo_sharding, "bad",
         )
         assert wrote is False
-        import os
-
         assert not os.path.exists(path)
 
 
@@ -80,11 +185,8 @@ class TestLoadGuards:
         assert jax.devices()[0].device_kind != aot._DEVICE_KIND
         assert aot._load(path) is None
 
-    def test_load_missing_or_corrupt_is_miss(self, tmp_path):
+    def test_load_missing_is_miss(self, tmp_path):
         assert aot._load(str(tmp_path / "absent.aotexec")) is None
-        p = tmp_path / "corrupt.aotexec"
-        p.write_bytes(b"\x00\x01 not a pickle")
-        assert aot._load(str(p)) is None
 
     def test_versioned_paths(self):
         # any kernel-source edit or jax/libtpu bump must invalidate blobs

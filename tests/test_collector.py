@@ -112,6 +112,9 @@ def _height_events(h: int, t0: int, observer: int,
         ev.append((t0 + (base + N_VALS + 1) * MS + delay, "consensus",
                    "maj23", {"height": h, "round": commit_round,
                              "type": tcode, "power": 3}))
+    ev.append((t0 + 48 * MS + delay, "state", "apply_block",
+               {"height": h, "txs": 0, "ms": 1.0,
+                "app_hash": f"{h:02d}" * 4}))
     ev.append((t0 + 50 * MS + delay, "consensus", "commit",
                {"height": h, "round": commit_round, "txs": 0}))
     ev.append((t0 + 55 * MS + delay, "consensus", "new_height",
@@ -229,6 +232,36 @@ class TestStitching:
         scrapes = _fleet_scrapes(n_heights=1)
         report = build_report(scrapes, commit_spread_s=0.001)  # 1ms bound
         assert any("commit spread" in v for v in report["violations"])
+
+    def test_app_hash_agreement_is_stitched_and_clean(self):
+        report = build_report(_fleet_scrapes())
+        # every node's apply_block hash is collected per height...
+        entry = report["heights"]["1"]
+        assert len(entry["app_hash"]) == 4
+        assert len(set(entry["app_hash"].values())) == 1
+        # ...and agreement means no violation
+        assert not any("app-hash" in v for v in report["violations"])
+
+    def test_app_hash_divergence_flagged(self):
+        scrapes = _fleet_scrapes(n_heights=2)
+        # node3 computed a different app hash at height 2: the nemesis
+        # zero-divergence gate must name it
+        for e in scrapes[3]["debug_flight_recorder"]["events"]:
+            if e["kind"] == "apply_block" and e["fields"]["height"] == 2:
+                e["fields"]["app_hash"] = "deadbeef"
+        report = build_report(scrapes)
+        assert any(
+            "app-hash divergence" in v and "deadbeef" in v
+            for v in report["violations"]
+        ), report["violations"]
+
+    def test_task_crashes_flagged(self):
+        scrapes = _fleet_scrapes(n_heights=1)
+        scrapes[2]["health"]["task_crashes"] = 3
+        report = build_report(scrapes)
+        assert any(
+            "task crash" in v and "node2" in v for v in report["violations"]
+        ), report["violations"]
 
     def test_stale_round_votes_flagged(self):
         # the height decides at round 2, but round-0 votes are still in

@@ -121,3 +121,122 @@ class TestPriorityStore:
         assert not pool.is_pending(old_ev)
         assert pool.priority_evidence() == []
         assert len(pool.evidence_list) == 0
+
+    def test_expiry_boundary_is_exclusive(self):
+        """Evidence exactly AT the max-age horizon stays pending; only
+        strictly-older evidence is pruned (pool.update: height <
+        last_block_height - max_age)."""
+        pvs, vs, state, store = make_fixture()
+        pool = EvidencePool(MemDB(), store, state)
+        max_age = state.consensus_params.evidence.max_age
+        at_horizon = make_evidence(pvs[0], vs, height=5)
+        pool.add_evidence(at_horizon)
+
+        class _Blk:
+            evidence = []
+
+        new_state = state.copy()
+        new_state.last_block_height = 5 + max_age  # horizon: 5 == lbh - max_age
+        pool.update(_Blk(), new_state)
+        assert pool.is_pending(at_horizon)
+        new_state.last_block_height = 5 + max_age + 1  # one past: pruned
+        pool.update(_Blk(), new_state)
+        assert not pool.is_pending(at_horizon)
+
+    def test_duplicate_submission_is_single_entry(self):
+        """Re-adding pending evidence (double RPC submit, gossip echo) is
+        a no-op: one pending record, one outqueue entry, one gossip
+        element — never duplicate broadcast work."""
+        pvs, vs, state, store = make_fixture()
+        pool = EvidencePool(MemDB(), store, state)
+        ev = make_evidence(pvs[0], vs)
+        pool.add_evidence(ev)
+        pool.add_evidence(ev)
+        pool.add_evidence(ev)
+        assert pool.pending_evidence() == [ev]
+        assert len(pool.priority_evidence()) == 1
+        assert len(pool.evidence_list) == 1
+
+
+class _StubPeer:
+    def __init__(self, pid="peer0"):
+        self.id = pid
+        self.sent = []
+
+    async def send(self, ch, msg):
+        self.sent.append((ch, msg))
+        return True
+
+
+class _StubSwitch:
+    def __init__(self):
+        self.stopped = []
+
+    async def stop_peer_for_error(self, peer, err):
+        self.stopped.append((peer.id, err))
+
+
+class TestReactorReceive:
+    """Receive-path coverage the nemesis scenarios don't isolate: the
+    reactor's handling of gossip for evidence we already know about, and
+    of garbage frames (reference evidence/reactor.go Receive)."""
+
+    def _reactor(self):
+        from tendermint_tpu.evidence.reactor import (
+            EvidenceReactor,
+            encode_evidence_message,
+        )
+
+        pvs, vs, state, store = make_fixture()
+        pool = EvidencePool(MemDB(), store, state)
+        r = EvidenceReactor(pool)
+        r.set_switch(_StubSwitch())
+        return r, pool, pvs, vs, encode_evidence_message
+
+    def test_gossip_of_committed_evidence_is_noop_and_keeps_peer(self):
+        import asyncio
+
+        r, pool, pvs, vs, enc = self._reactor()
+        ev = make_evidence(pvs[0], vs)
+        pool.add_evidence(ev)
+        pool.mark_committed([ev])
+        peer = _StubPeer()
+        asyncio.run(r.receive(0x38, peer, enc([ev])))
+        # committed evidence is recognized, never re-admitted, and the
+        # relaying peer is NOT punished (it may legitimately lag)
+        assert not pool.is_pending(ev)
+        assert pool.is_committed(ev)
+        assert len(pool.evidence_list) == 0
+        assert r.switch.stopped == []
+
+    def test_gossip_of_pending_evidence_is_idempotent(self):
+        import asyncio
+
+        r, pool, pvs, vs, enc = self._reactor()
+        ev = make_evidence(pvs[0], vs)
+        pool.add_evidence(ev)
+        asyncio.run(r.receive(0x38, _StubPeer(), enc([ev])))
+        assert pool.pending_evidence() == [ev]
+        assert len(pool.evidence_list) == 1
+        assert r.switch.stopped == []
+
+    def test_unverifiable_evidence_rejected_peer_kept(self):
+        import asyncio
+
+        r, pool, pvs, vs, enc = self._reactor()
+        # evidence signed by a validator the receiving pool's state store
+        # has never seen: verification fails (not-a-validator), which is
+        # the honest height-skew shape — reject it, keep the peer
+        other_pvs, other_vs, _, _ = make_fixture(powers=(7, 7, 7))
+        alien = make_evidence(other_pvs[0], other_vs)
+        asyncio.run(r.receive(0x38, _StubPeer(), enc([alien])))
+        assert not pool.is_pending(alien)
+        assert r.switch.stopped == []  # height skew is not Byzantine
+
+    def test_garbage_frame_stops_peer(self):
+        import asyncio
+
+        r, pool, pvs, vs, enc = self._reactor()
+        peer = _StubPeer("badpeer")
+        asyncio.run(r.receive(0x38, peer, b"\xff\x00garbage"))
+        assert [pid for pid, _ in r.switch.stopped] == ["badpeer"]

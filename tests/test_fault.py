@@ -1,0 +1,182 @@
+"""Nemesis fault-injection layer (libs/fault.py): plan semantics and the
+per-link connection wrapper. Pure asyncio — no crypto stack needed, so
+this runs in every environment (the process-level scenarios that drive
+the same plan over RPC live in tests/test_nemesis_procs.py)."""
+from __future__ import annotations
+
+import asyncio
+
+from tendermint_tpu.libs.recorder import RECORDER
+from tendermint_tpu.libs.fault import ALL, FaultedConnection, FaultPlan
+
+
+class StubConn:
+    """SecretConnection-shaped counter: records writes, serves reads."""
+
+    def __init__(self, reads=()) -> None:
+        self.writes: list[bytes] = []
+        self.reads = list(reads)
+        self.closed = False
+        self.remote_pubkey = b"pk"
+
+    async def write(self, data: bytes) -> None:
+        self.writes.append(data)
+
+    async def drain(self) -> None:
+        pass
+
+    async def read_msg(self) -> bytes:
+        if not self.reads:
+            raise ConnectionError("out of canned reads")
+        return self.reads.pop(0)
+
+    def close(self) -> None:
+        self.closed = True
+
+
+class TestFaultPlan:
+    def test_empty_plan_is_inert(self):
+        plan = FaultPlan()
+        assert not plan.active
+        assert not plan.should_drop("peerA")
+        assert plan.delay_s("peerA", "send") == 0.0
+
+    def test_partition_drops_named_peer_only(self):
+        plan = FaultPlan()
+        plan.partition(["peerA"])
+        assert plan.active
+        assert plan.should_drop("peerA")
+        assert not plan.should_drop("peerB")
+
+    def test_partition_wildcard_drops_everyone(self):
+        plan = FaultPlan()
+        plan.partition([ALL])
+        assert plan.should_drop("anyone")
+        assert plan.dropped >= 1
+
+    def test_delay_direction_is_asymmetric(self):
+        plan = FaultPlan()
+        plan.delay(["peerA"], ms=250, direction="send")
+        assert plan.delay_s("peerA", "send") == 0.25
+        assert plan.delay_s("peerA", "recv") == 0.0
+        assert plan.delay_s("peerB", "send") == 0.0
+
+    def test_drop_probability_bounds_and_determinism(self):
+        plan = FaultPlan()
+        plan.drop([ALL], prob=1.0)
+        assert all(plan.should_drop("x") for _ in range(20))
+        plan2 = FaultPlan()
+        plan2.drop([ALL], prob=0.0)
+        assert not any(plan2.should_drop("x") for _ in range(20))
+
+    def test_heal_clears_everything(self):
+        plan = FaultPlan()
+        plan.partition([ALL])
+        plan.delay(["p"], ms=10)
+        plan.drop(["p"], prob=0.5)
+        plan.heal()
+        assert not plan.active
+        assert not plan.should_drop("p")
+        snap = plan.snapshot()
+        assert snap["partition"] == [] and snap["delay"] == {} and snap["drop"] == {}
+
+    def test_bad_direction_rejected(self):
+        plan = FaultPlan()
+        try:
+            plan.delay(["p"], ms=10, direction="sideways")
+        except ValueError:
+            pass
+        else:
+            raise AssertionError("bad direction accepted")
+
+    def test_mutations_hit_the_flight_recorder(self):
+        plan = FaultPlan()
+        before = RECORDER.total
+        plan.partition(["peerZ"])
+        plan.heal()
+        kinds = {
+            (e["sub"], e["kind"])
+            for e in RECORDER.snapshot()
+            if e["seq"] > before
+        }
+        assert ("fault", "partition") in kinds and ("fault", "heal") in kinds
+
+
+class TestFaultedConnection:
+    def test_passthrough_when_inert(self):
+        async def go():
+            conn = StubConn(reads=[b"m1"])
+            fc = FaultedConnection(conn, "peerA", plan=FaultPlan())
+            await fc.write(b"out")
+            assert conn.writes == [b"out"]
+            assert await fc.read_msg() == b"m1"
+            assert fc.remote_pubkey == b"pk"
+            fc.close()
+            assert conn.closed
+
+        asyncio.run(go())
+
+    def test_partition_blackholes_both_directions(self):
+        async def go():
+            plan = FaultPlan()
+            plan.partition(["peerA"])
+            conn = StubConn(reads=[b"m1", b"m2"])
+            fc = FaultedConnection(conn, "peerA", plan=plan)
+            await fc.write(b"out")
+            assert conn.writes == []  # swallowed
+            # inbound frames are discarded until the canned reads run out
+            try:
+                await fc.read_msg()
+            except ConnectionError:
+                pass
+            else:
+                raise AssertionError("partitioned read returned a message")
+            assert plan.dropped >= 3
+
+        asyncio.run(go())
+
+    def test_heal_restores_traffic(self):
+        async def go():
+            plan = FaultPlan()
+            plan.partition([ALL])
+            conn = StubConn(reads=[b"m1"])
+            fc = FaultedConnection(conn, "peerA", plan=plan)
+            await fc.write(b"dropped")
+            plan.heal()
+            await fc.write(b"delivered")
+            assert conn.writes == [b"delivered"]
+            assert await fc.read_msg() == b"m1"
+
+        asyncio.run(go())
+
+    def test_unrelated_peer_unaffected(self):
+        async def go():
+            plan = FaultPlan()
+            plan.partition(["peerB"])
+            plan.delay(["peerB"], ms=500, direction="both")
+            conn = StubConn(reads=[b"m1"])
+            fc = FaultedConnection(conn, "peerA", plan=plan)
+            t0 = asyncio.get_event_loop().time()
+            await fc.write(b"out")
+            assert await fc.read_msg() == b"m1"
+            assert asyncio.get_event_loop().time() - t0 < 0.2
+            assert conn.writes == [b"out"]
+
+        asyncio.run(go())
+
+    def test_send_delay_applies_on_write(self):
+        async def go():
+            plan = FaultPlan()
+            plan.delay(["peerA"], ms=50, direction="send")
+            conn = StubConn(reads=[b"m1"])
+            fc = FaultedConnection(conn, "peerA", plan=plan)
+            t0 = asyncio.get_event_loop().time()
+            await fc.write(b"out")
+            assert asyncio.get_event_loop().time() - t0 >= 0.045
+            assert conn.writes == [b"out"]
+            # recv direction stays fast (asymmetric)
+            t0 = asyncio.get_event_loop().time()
+            assert await fc.read_msg() == b"m1"
+            assert asyncio.get_event_loop().time() - t0 < 0.04
+
+        asyncio.run(go())

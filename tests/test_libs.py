@@ -76,6 +76,45 @@ class TestService:
 
         run(main())
 
+    def test_task_spawned_during_cancel_sweep_is_reaped(self):
+        """The remaining stop() orphan edge (ISSUE 7 satellite): a task
+        whose cancellation handler spawns ANOTHER task — the redial-
+        scheduling shape — lands in _tasks between the cancel sweep and
+        teardown. The old single-pass sweep clear()ed it uncancelled
+        (orphaned forever); the sweep must loop until quiescent."""
+
+        async def main():
+            svc = BaseService("t")
+            await svc.start()
+            late: list[asyncio.Task] = []
+            started = asyncio.Event()
+
+            async def late_runner():
+                while True:
+                    await asyncio.sleep(10)
+
+            async def spawner():
+                started.set()
+                try:
+                    while True:
+                        await asyncio.sleep(10)
+                except asyncio.CancelledError:
+                    # the continuation a real reactor runs on peer-stop:
+                    # schedule follow-up work on the (stopping) service
+                    late.append(svc.spawn(late_runner(), "late"))
+                    raise
+
+            svc.spawn(spawner())
+            await started.wait()
+            await asyncio.wait_for(svc.stop(), 5.0)
+            assert late, "cancellation handler never ran"
+            # the late task was REAPED by stop(), not dropped: it must be
+            # done/cancelled once the loop settles, not running orphaned
+            await asyncio.sleep(0)
+            assert late[0].cancelled() or late[0].done(), late
+
+        run(main())
+
 
 class TestBitArray:
     def test_basic(self):
