@@ -13,7 +13,8 @@ Fault surface (all driven over public RPC, no process introspection):
 - per-link faults via the `debug_fault` route (libs/fault.py): partition,
   asymmetric delay, probabilistic drop, heal;
 - device-breaker control via the same route (`trip_breaker` /
-  `reset_breaker` — ops/ed25519_batch's wedged-device circuit breaker);
+  `reset_breaker` — the DeviceScheduler's wedged-device circuit breaker,
+  reached through the deprecated `ops.ed25519_batch.breaker` alias);
 - process schedules via signals (SIGSTOP/SIGCONT/SIGKILL — ProcTestnet
   pause/resume/kill);
 - crash points via `FAIL_TEST_INDEX` (libs/fail.py), armed per node
@@ -32,6 +33,9 @@ Scenarios (catalogue with invariants: docs/nemesis.md):
   nemesis_flapping_device — trip/reset the device breaker mid-consensus
                             on one validator; health degrades truthfully
                             and consensus never stalls.
+  nemesis_sched_priority  — recheck storm across commit boundaries; the
+                            device scheduler's per-class accounting must
+                            show commit verify never waited behind it.
   nemesis_crash_sweep     — crash at EVERY fail.fail() index during
                             commit/replay; restart and verify (parity
                             with reference test/persist/
@@ -453,6 +457,61 @@ def scenario_flapping_device(net: ProcTestnet) -> None:
 scenario_flapping_device.self_start = True
 
 
+def scenario_sched_priority(net: ProcTestnet) -> None:
+    """(g) A mempool recheck flood may not delay commit verify (ISSUE 8):
+    waves of async txs keep the recheck path busy across several commit
+    boundaries while the chain advances. The device scheduler's per-class
+    admission accounting must show consensus-commit verification flowing
+    with bounded queue wait the whole time, the admission queue never
+    stalls (health carries no `device_queue_stalled`), and the per-class
+    series are live on /metrics."""
+    mports = enable_prometheus(net)
+    net.start_all()
+    net.wait_all(2)
+    nem = Nemesis(net)
+    keys: list[str] = []
+    for wave in range(4):
+        keys += nem.flood(60, prefix=f"sp{os.getpid()}w{wave}-")
+        time.sleep(0.4)
+    base = max(net.height(i) or 2 for i in range(net.n))
+    net.wait_all(base + 3, timeout=240.0)  # commits DURING the storm
+
+    for i in range(net.n):
+        dev = net.rpc(i, "debug_device", timeout=10.0)
+        assert dev is not None, f"debug_device failed on node{i}"
+        sched = dev.get("scheduler") or {}
+        classes = sched.get("classes") or {}
+        cc = classes.get("consensus_commit")
+        assert cc and cc["submitted"] > 0, (
+            f"node{i}: no consensus_commit admissions: {classes}"
+        )
+        # the flood must not have delayed commit verification at the
+        # scheduler: every commit-class dispatch waited under the bound
+        assert cc["wait_s_max"] < 2.0, f"node{i}: commit verify delayed: {cc}"
+        queues = sched.get("queues") or {}
+        assert not queues.get("stalled", False), f"node{i}: queue stalled: {queues}"
+        h = nem.health(i)
+        assert "device_queue_stalled" not in h["degraded"], h
+
+    kinds = nem.recorder_kinds(0, "mempool")
+    assert ("mempool", "recheck") in kinds, f"no recheck storm: {kinds}"
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{mports[0]}/metrics", timeout=5
+    ) as r:
+        text = r.read().decode()
+    assert "tendermint_device_queue_depth" in text
+    assert "tendermint_device_queue_wait_seconds" in text
+    nem.assert_no_crashes()
+    print(
+        "nemesis_sched_priority: recheck storm ran across commits; "
+        "consensus_commit admissions stayed under the wait bound, "
+        "queue never stalled, per-class series live"
+    )
+
+
+scenario_sched_priority.self_start = True
+
+
 def scenario_crash_sweep(net: ProcTestnet) -> None:
     """(f) Crash-at-every-fail.fail()-index, networked (parity with the
     reference's test/persist/test_failure_indices.sh, but against live
@@ -503,12 +562,13 @@ SCENARIOS = {
     "nemesis_delay_proposer": scenario_delay_proposer,
     "nemesis_flood": scenario_flood,
     "nemesis_flapping_device": scenario_flapping_device,
+    "nemesis_sched_priority": scenario_sched_priority,
     "nemesis_crash_sweep": scenario_crash_sweep,
 }
 
 # the sub-10-minute set the CI nemesis job and tier-1 wrappers draw from
 FAST = ["nemesis_byzantine", "nemesis_partition", "nemesis_delay_proposer",
-        "nemesis_flood", "nemesis_flapping_device"]
+        "nemesis_flood", "nemesis_flapping_device", "nemesis_sched_priority"]
 
 
 def run(names=None, n: int = 4) -> None:

@@ -12,6 +12,7 @@ import asyncio
 from dataclasses import dataclass
 
 from tendermint_tpu.blockchain import BlockPool
+from tendermint_tpu.device.priorities import Priority, priority_scope
 from tendermint_tpu.encoding import DecodeError, Reader, Writer
 from tendermint_tpu.libs.log import NOP, Logger
 from tendermint_tpu.p2p.base_reactor import BaseReactor, ChannelDescriptor
@@ -265,7 +266,11 @@ class BlockchainReactor(BaseReactor):
             keys.append(key)
         if not entries:
             return
-        for key, err in zip(keys, verify_commits(entries)):
+        # catch-up work: the device scheduler must never let this window
+        # delay a commit verify on a co-resident validator's hot path
+        with priority_scope(Priority.FASTSYNC):
+            results = verify_commits(entries)
+        for key, err in zip(keys, results):
             if err is None:
                 self._verified_ahead.add(key)
             else:

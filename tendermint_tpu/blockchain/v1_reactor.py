@@ -19,6 +19,7 @@ from tendermint_tpu.blockchain.reactor import (
     encode_bc_message,
 )
 from tendermint_tpu.blockchain.v1 import BcFSM, Event, State
+from tendermint_tpu.device.priorities import Priority, priority_scope
 from tendermint_tpu.libs.log import NOP, Logger
 from tendermint_tpu.p2p.base_reactor import BaseReactor, ChannelDescriptor
 from tendermint_tpu.types import BlockID
@@ -151,10 +152,13 @@ class BlockchainReactorV1(BaseReactor):
             first_id = BlockID(block.hash(), first_parts.header())
             err = None
             try:
-                self.state.validators.verify_commit(
-                    self.state.chain_id, first_id, block.header.height,
-                    second.block.last_commit,
-                )
+                # FASTSYNC class: queued behind any concurrent commit
+                # verify at the device scheduler, never ahead of it
+                with priority_scope(Priority.FASTSYNC):
+                    self.state.validators.verify_commit(
+                        self.state.chain_id, first_id, block.header.height,
+                        second.block.last_commit,
+                    )
             except VerifyError as e:
                 err = e
                 self.log.error("v1 block verify failed", height=block.header.height, err=str(e))

@@ -21,6 +21,7 @@ from typing import Callable, Sequence
 
 from tendermint_tpu.crypto import PubKey
 from tendermint_tpu.crypto.multisig import PubKeyMultisigThreshold
+from tendermint_tpu.device.priorities import current_priority, priority_scope
 
 # Whole-dispatch bound on the concurrent per-curve group map (ADVICE r4:
 # wedged daemon workers are never replaced, so an unbounded wait blocks
@@ -171,12 +172,20 @@ class BatchVerifier:
                 sum(len(g[0]) for g in self._groups.values())
             )
 
+        # the submitter's device-priority class (consensus commit, fast
+        # sync, lite, mempool recheck — device/priorities.py): captured
+        # here because the pool workers below do NOT inherit the caller's
+        # contextvars, and the scheduler must see the right admission class
+        pri = current_priority()
+        sp.set(priority=pri.label)
+
         def run_group(entry):
             key_type, (items, pubs, msgs, sigs) = entry
             backend = _BACKENDS.get(key_type)
-            if backend is not None:
-                return backend([p.bytes() for p in pubs], msgs, sigs)
-            return [p.verify(m, s) for p, m, s in zip(pubs, msgs, sigs)]
+            with priority_scope(pri):
+                if backend is not None:
+                    return backend([p.bytes() for p in pubs], msgs, sigs)
+                return [p.verify(m, s) for p, m, s in zip(pubs, msgs, sigs)]
 
         groups = list(self._groups.items())
         if len(groups) > 1:

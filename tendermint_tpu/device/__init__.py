@@ -1,0 +1,31 @@
+"""tendermint_tpu.device — the unified device-dispatch subsystem.
+
+One process-wide DeviceScheduler owns the admission queue, the priority
+classes, the cross-subsystem batch packer, the wedged-device circuit
+breaker and the verdict-fetch pool; every signature verification in the
+node routes through it (see device/scheduler.py and
+docs/device_scheduler.md).
+
+This package __init__ stays import-light on purpose: priority tagging is
+used by consensus/blockchain/lite/mempool call sites that must not drag
+the jax/ops stack in; the scheduler module loads on first get_scheduler().
+"""
+from tendermint_tpu.device.priorities import (
+    Priority,
+    current_priority,
+    priority_scope,
+)
+
+__all__ = [
+    "Priority",
+    "current_priority",
+    "priority_scope",
+    "get_scheduler",
+]
+
+
+def get_scheduler():
+    """The process-wide DeviceScheduler (lazy import of the scheduler)."""
+    from tendermint_tpu.device.scheduler import get_scheduler as _get
+
+    return _get()

@@ -1,0 +1,63 @@
+"""Priority classes for the device-dispatch scheduler.
+
+Every signature verification in the node is submitted to the process-wide
+DeviceScheduler (tendermint_tpu/device/scheduler.py) under one of four
+admission classes. Strict priority decides who reaches the device first
+when the queue is contended; an aging tick promotes long-waiting requests
+one class per aging interval so low classes cannot starve:
+
+- CONSENSUS_COMMIT — the liveness-critical hot loop: vote and commit
+  signatures on the consensus path. Nothing may delay a commit verify.
+- FASTSYNC — catch-up replay (blockchain/ v0/v1 reactors). Throughput
+  matters, but a syncing replica must never crowd out a validator's
+  commit path when both share a device.
+- LITE — light-client header verification (lite/).
+- MEMPOOL_RECHECK — post-commit recheck storms; pure background work.
+
+The class travels as a contextvar so call sites tag whole code regions
+(`with priority_scope(Priority.FASTSYNC): ...`) and every BatchVerifier /
+ops-backend submission inside inherits it without threading a parameter
+through the crypto seam. Worker threads do NOT inherit the submitter's
+context — crypto/batch re-pins the captured class inside its pool workers.
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import enum
+
+
+class Priority(enum.IntEnum):
+    """Lower value = higher priority (strict-priority pop order)."""
+
+    CONSENSUS_COMMIT = 0
+    FASTSYNC = 1
+    LITE = 2
+    MEMPOOL_RECHECK = 3
+
+    @property
+    def label(self) -> str:
+        """Metric label value (`tendermint_device_queue_depth{class=...}`)."""
+        return self.name.lower()
+
+
+# Default is the highest class: untagged verification work is almost always
+# the consensus path (vote ingest, commit verify, evidence), and a mistagged
+# background caller only costs fairness, never liveness.
+_current: contextvars.ContextVar[Priority] = contextvars.ContextVar(
+    "tmtpu_device_priority", default=Priority.CONSENSUS_COMMIT
+)
+
+
+def current_priority() -> Priority:
+    return _current.get()
+
+
+@contextlib.contextmanager
+def priority_scope(priority: Priority):
+    """Tag every device submission inside the block with `priority`."""
+    token = _current.set(Priority(priority))
+    try:
+        yield
+    finally:
+        _current.reset(token)

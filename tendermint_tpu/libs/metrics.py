@@ -35,6 +35,18 @@ class Collector:
         self._metrics.append(m)
         return m
 
+    def histogram_vec(
+        self,
+        subsystem: str,
+        name: str,
+        help_: str = "",
+        label: str = "class",
+        buckets: list[float] | None = None,
+    ) -> "HistogramVec":
+        m = HistogramVec(self._full(subsystem, name), help_, label, buckets)
+        self._metrics.append(m)
+        return m
+
     def _full(self, subsystem: str, name: str) -> str:
         return f"{self.namespace}_{subsystem}_{name}"
 
@@ -167,6 +179,48 @@ class Histogram(_Metric):
         lines.append(f'{self.name}_bucket{{le="+Inf"}} {self._n}')
         lines.append(f"{self.name}_sum {self._sum:g}")
         lines.append(f"{self.name}_count {self._n}")
+        return lines
+
+
+class HistogramVec(_Metric):
+    """One histogram family keyed by a single label (e.g. the device
+    scheduler's priority class): one HELP/TYPE head, per-child bucket
+    lines with the label merged before `le` (labels sorted, per the
+    exposition convention this module follows elsewhere)."""
+
+    kind = "histogram"
+
+    def __init__(
+        self, name: str, help_: str, label: str, buckets: list[float] | None = None
+    ) -> None:
+        super().__init__(name, help_)
+        self.label = label
+        self.buckets = sorted(buckets or DEFAULT_BUCKETS)
+        self._children: dict[str, Histogram] = {}
+
+    def labels(self, value) -> Histogram:
+        child = self._children.get(str(value))
+        if child is None:
+            child = self._children[str(value)] = Histogram(
+                self.name, "", self.buckets
+            )
+        return child
+
+    def observe(self, label_value, value: float) -> None:
+        self.labels(label_value).observe(value)
+
+    def render(self) -> list[str]:
+        lines = self._head()
+        for lv in sorted(self._children):
+            child = self._children[lv]
+            pair = f'{self.label}="{_esc_label(lv)}"'
+            cum = 0
+            for b, c in zip(self.buckets, child._counts):
+                cum += c
+                lines.append(f'{self.name}_bucket{{{pair},le="{b:g}"}} {cum}')
+            lines.append(f'{self.name}_bucket{{{pair},le="+Inf"}} {child._n}')
+            lines.append(f"{self.name}_sum{{{pair}}} {child._sum:g}")
+            lines.append(f"{self.name}_count{{{pair}}} {child._n}")
         return lines
 
 
@@ -307,6 +361,29 @@ class DeviceMetrics:
             "device_occupancy", "cpu_route_signatures_total",
             "Signatures the router verified on the host paths "
             "(below device threshold or no accelerator)",
+        )
+        # device-scheduler admission plane (ISSUE 8): per-priority-class
+        # queue health + packer efficiency, fed by DEVICE.record_sched_*
+        # from tendermint_tpu/device/scheduler.py
+        self.sched_queue_depth = c.gauge(
+            "device", "queue_depth",
+            "Admission-queue depth per priority class",
+        )
+        self.sched_queue_wait = c.histogram_vec(
+            "device", "queue_wait_seconds",
+            "Admission-queue wait before device dispatch, per priority class",
+            "class",
+            [0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10],
+        )
+        self.sched_packed = c.histogram(
+            "device", "packed_requests_per_batch",
+            "Cross-subsystem requests coalesced into one device dispatch",
+            [1, 2, 3, 4, 6, 8, 12, 16, 32],
+        )
+        self.sched_preempted_total = c.counter(
+            "device", "preempted_total",
+            "Queued requests passed over by a later-arriving "
+            "higher-priority dispatch, per class",
         )
 
 

@@ -383,6 +383,15 @@ class DeviceTelemetry:
         # cpu_fallbacks, which are device FAILURES
         self.cpu_route_batches = 0
         self.cpu_route_sigs = 0
+        # device-scheduler admission accounting (ISSUE 8): per-priority-
+        # class submit/dispatch/queue-wait/preemption counters plus the
+        # packer's coalescing stats, fed by device/scheduler.py; backs the
+        # tendermint_device_queue_* / packed_requests_per_batch /
+        # preempted_total series and debug_device's "scheduler" section
+        self.sched_classes: dict[str, dict] = {}
+        self.sched_packed_batches = 0
+        self.sched_packed_requests = 0
+        self.sched_max_packed = 0
 
     def set_metrics(self, dm) -> None:
         self._metrics = dm
@@ -471,6 +480,74 @@ class DeviceTelemetry:
         lanes = self.lanes_dispatched + self.lanes_padded
         return self.lanes_dispatched / lanes if lanes else 0.0
 
+    def _sched_cls_locked(self, label: str) -> dict:
+        return self.sched_classes.setdefault(
+            label,
+            {
+                "submitted": 0,
+                "dispatched": 0,
+                "queue_depth": 0,
+                "wait_s_total": 0.0,
+                "wait_s_max": 0.0,
+                "preempted": 0,
+                "rejected": 0,
+            },
+        )
+
+    def record_sched_submit(self, label: str, depth: int | None) -> None:
+        """One request admitted to the scheduler under priority class
+        `label`; `depth` is that class's queue depth after admission.
+        None means the work routed inline to the host paths — count the
+        submit but leave the live queue-depth reading alone (an inline
+        submit must not zero the gauge while real work is queued)."""
+        with self._lock:
+            c = self._sched_cls_locked(label)
+            c["submitted"] += 1
+            if depth is not None:
+                c["queue_depth"] = depth
+        dm = self._metrics
+        if dm is not None and depth is not None:
+            dm.sched_queue_depth.set(depth, **{"class": label})
+
+    def record_sched_dispatch(self, label: str, wait_s: float, depth: int) -> None:
+        """One queued request handed to the device dispatch after waiting
+        `wait_s` in the admission queue."""
+        wait_s = max(0.0, wait_s)
+        with self._lock:
+            c = self._sched_cls_locked(label)
+            c["dispatched"] += 1
+            c["wait_s_total"] += wait_s
+            c["wait_s_max"] = max(c["wait_s_max"], wait_s)
+            c["queue_depth"] = depth
+        dm = self._metrics
+        if dm is not None:
+            dm.sched_queue_wait.observe(label, wait_s)
+            dm.sched_queue_depth.set(depth, **{"class": label})
+
+    def record_sched_pack(self, n_requests: int) -> None:
+        """One device dispatch coalescing `n_requests` queued requests."""
+        with self._lock:
+            self.sched_packed_batches += 1
+            self.sched_packed_requests += n_requests
+            self.sched_max_packed = max(self.sched_max_packed, n_requests)
+        dm = self._metrics
+        if dm is not None:
+            dm.sched_packed.observe(n_requests)
+
+    def record_sched_preempt(self, label: str, n: int = 1) -> None:
+        """Earlier-arrived class-`label` work passed over by a
+        later-arriving higher-priority dispatch."""
+        with self._lock:
+            self._sched_cls_locked(label)["preempted"] += n
+        dm = self._metrics
+        if dm is not None:
+            dm.sched_preempted_total.inc(n, **{"class": label})
+
+    def record_sched_reject(self, label: str, n: int = 1) -> None:
+        """Queued work rejected because the scheduler stopped."""
+        with self._lock:
+            self._sched_cls_locked(label)["rejected"] += n
+
     def record_breaker(self, tripped: bool, retry_in_s: float = 0.0) -> None:
         with self._lock:
             changed = tripped != self.breaker_tripped
@@ -519,6 +596,23 @@ class DeviceTelemetry:
                     "cpu_route": {
                         "batches": self.cpu_route_batches,
                         "sigs": self.cpu_route_sigs,
+                    },
+                },
+                "scheduler": {
+                    "classes": {
+                        k: dict(v) for k, v in self.sched_classes.items()
+                    },
+                    "packing": {
+                        "batches": self.sched_packed_batches,
+                        "requests": self.sched_packed_requests,
+                        "max_packed": self.sched_max_packed,
+                        "avg_packed": round(
+                            self.sched_packed_requests
+                            / self.sched_packed_batches,
+                            3,
+                        )
+                        if self.sched_packed_batches
+                        else 0.0,
                     },
                 },
             }

@@ -11,6 +11,8 @@ linters don't know about. tmlint is an AST pass with four rule families:
 - TM3xx  JAX tracing hygiene in ops/ and crypto/batch.py: Python
          branches on tracers, host syncs, concrete shapes from tracers
 - TM4xx  service lifecycle: threads neither daemon nor joined
+- TM5xx  device-dispatch discipline: direct curve verify_batch calls
+         that bypass the DeviceScheduler admission queue
 
 Run it with ``python -m tendermint_tpu.lint``; see docs/lint.md for the
 rule catalogue, suppression syntax and the baseline ratchet.

@@ -168,12 +168,19 @@ def all_rules() -> list[Rule]:
     from tendermint_tpu.lint import (  # noqa: F401
         rules_async,
         rules_determinism,
+        rules_device,
         rules_jax,
         rules_lifecycle,
     )
 
     rules: list[Rule] = []
-    for mod in (rules_async, rules_determinism, rules_jax, rules_lifecycle):
+    for mod in (
+        rules_async,
+        rules_determinism,
+        rules_jax,
+        rules_lifecycle,
+        rules_device,
+    ):
         rules.extend(r() for r in mod.RULES)
     return rules
 

@@ -1,0 +1,83 @@
+"""TM5xx — device-dispatch discipline.
+
+Every signature verification must flow through the DeviceScheduler
+admission queue (tendermint_tpu/device/): one queue, one packer, one
+breaker, priority classes. A direct `ed25519_batch.verify_batch` /
+`secp_batch.verify_batch` call bypasses all of that — it would race the
+scheduler for the device and dodge the priority ordering the consensus
+hot path depends on. The only legitimate callers are the scheduler's own
+dispatch body and the curve modules' compatibility wrappers.
+"""
+from __future__ import annotations
+
+import ast
+
+from tendermint_tpu.lint.engine import Context, Rule, dotted_name
+
+_DIRECT_SUFFIXES = ("ed25519_batch.verify_batch", "secp_batch.verify_batch")
+_IMPORT_MODULES = (
+    "tendermint_tpu.ops.ed25519_batch",
+    "tendermint_tpu.ops.secp_batch",
+)
+# where direct calls stay legal: the scheduler's dispatch path, and the
+# curve modules themselves (wrappers + their internal dispatch bodies)
+_ALLOWED_PREFIXES = ("tendermint_tpu/device/",)
+_ALLOWED_FILES = frozenset(
+    {
+        "tendermint_tpu/ops/ed25519_batch.py",
+        "tendermint_tpu/ops/secp_batch.py",
+    }
+)
+
+
+def _allowed(rel_path: str) -> bool:
+    rel = rel_path.replace("\\", "/")
+    return rel in _ALLOWED_FILES or rel.startswith(_ALLOWED_PREFIXES)
+
+
+class TM501DirectDeviceVerify(Rule):
+    code = "TM501"
+    name = "direct-device-verify"
+    help = (
+        "Direct ed25519_batch.verify_batch / secp_batch.verify_batch "
+        "calls bypass the DeviceScheduler admission queue (priority "
+        "classes, batch packing, the breaker). Submit through "
+        "tendermint_tpu.device instead: get_scheduler().verify(curve, "
+        "pubs, msgs, sigs) or a crypto.batch.BatchVerifier."
+    )
+
+    def visit_Call(self, ctx: Context, node: ast.Call) -> None:
+        if _allowed(ctx.rel_path):
+            return
+        dotted = dotted_name(node.func)
+        if dotted is None:
+            return
+        if dotted in _DIRECT_SUFFIXES or dotted.endswith(
+            tuple("." + s for s in _DIRECT_SUFFIXES)
+        ):
+            ctx.report(
+                self.code,
+                node,
+                f"direct device verify `{dotted}(...)` outside "
+                "tendermint_tpu/device/",
+                "submit through the DeviceScheduler "
+                "(tendermint_tpu.device.get_scheduler().verify) so the "
+                "request gets a priority class and packs with other work",
+            )
+
+    def visit_ImportFrom(self, ctx: Context, node: ast.ImportFrom) -> None:
+        if _allowed(ctx.rel_path) or node.module not in _IMPORT_MODULES:
+            return
+        for alias in node.names:
+            if alias.name == "verify_batch":
+                ctx.report(
+                    self.code,
+                    node,
+                    f"importing verify_batch from {node.module} invites "
+                    "scheduler-bypassing direct calls",
+                    "import tendermint_tpu.device and submit through the "
+                    "scheduler instead",
+                )
+
+
+RULES = [TM501DirectDeviceVerify]

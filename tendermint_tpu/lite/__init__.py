@@ -18,6 +18,7 @@ from __future__ import annotations
 import struct
 from dataclasses import dataclass
 
+from tendermint_tpu.device.priorities import Priority, priority_scope
 from tendermint_tpu.encoding import Reader, Writer
 from tendermint_tpu.libs.db import DB
 from tendermint_tpu.libs.log import NOP, Logger
@@ -247,12 +248,15 @@ class BaseVerifier:
         if signed_header.header.validators_hash != self.valset.hash():
             raise LiteError("validators hash mismatch")
         signed_header.validate_basic(self.chain_id)
-        self.valset.verify_commit(
-            self.chain_id,
-            signed_header.commit.block_id,
-            signed_header.height,
-            signed_header.commit,
-        )
+        # LITE class at the device scheduler: header verification yields
+        # to consensus-commit and fast-sync work on a shared device
+        with priority_scope(Priority.LITE):
+            self.valset.verify_commit(
+                self.chain_id,
+                signed_header.commit.block_id,
+                signed_header.height,
+                signed_header.commit,
+            )
 
 
 class DynamicVerifier:
@@ -299,12 +303,13 @@ class DynamicVerifier:
                 f"header {signed_header.height} validators hash does not match "
                 f"trusted next-validators"
             )
-        next_vals.verify_commit(
-            self.chain_id,
-            signed_header.commit.block_id,
-            signed_header.height,
-            signed_header.commit,
-        )
+        with priority_scope(Priority.LITE):
+            next_vals.verify_commit(
+                self.chain_id,
+                signed_header.commit.block_id,
+                signed_header.height,
+                signed_header.commit,
+            )
         self.headers_verified += 1
 
     def verify_chain(self, signed_headers: "list[SignedHeader]") -> None:
@@ -381,7 +386,8 @@ class DynamicVerifier:
             batched.append(sh)
             fcs.append(fc)
             prev_next_vals = fc.next_validators
-        errs = verify_commits(entries)
+        with priority_scope(Priority.LITE):
+            errs = verify_commits(entries)
         for sh, fc, err in zip(batched, fcs, errs):
             if err is not None:
                 # trust stops at the last verified predecessor; later
@@ -412,19 +418,20 @@ class DynamicVerifier:
             raise LiteError("fullCommit height must be greater than trusted")
         sh = source_fc.signed_header
         try:
-            if sh.header.validators_hash == trusted.next_validators.hash():
-                # adjacent or unchanged set: normal verify
-                trusted.next_validators.verify_commit(
-                    self.chain_id, sh.commit.block_id, sh.height, sh.commit
-                )
-            else:
-                trusted.next_validators.verify_future_commit(
-                    source_fc.validators,
-                    self.chain_id,
-                    sh.commit.block_id,
-                    sh.height,
-                    sh.commit,
-                )
+            with priority_scope(Priority.LITE):
+                if sh.header.validators_hash == trusted.next_validators.hash():
+                    # adjacent or unchanged set: normal verify
+                    trusted.next_validators.verify_commit(
+                        self.chain_id, sh.commit.block_id, sh.height, sh.commit
+                    )
+                else:
+                    trusted.next_validators.verify_future_commit(
+                        source_fc.validators,
+                        self.chain_id,
+                        sh.commit.block_id,
+                        sh.height,
+                        sh.commit,
+                    )
             self.headers_verified += 1
         except TooMuchChangeError:
             # bisect: trust the midpoint first (recursively), then retry

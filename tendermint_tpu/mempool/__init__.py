@@ -14,6 +14,7 @@ from collections import OrderedDict
 from dataclasses import dataclass, field
 
 from tendermint_tpu.abci import types as abci
+from tendermint_tpu.device.priorities import Priority, priority_scope
 from tendermint_tpu.types.tx import tx_hash
 from tendermint_tpu.libs.clist import CList
 from tendermint_tpu.libs.log import NOP, Logger
@@ -230,7 +231,17 @@ class CListMempool:
         self._notify_tx_available()
 
     async def _recheck_txs(self) -> None:
-        """Reference recheckTxs: pipelined CheckTx(recheck) for survivors."""
+        """Reference recheckTxs: pipelined CheckTx(recheck) for survivors.
+
+        Runs under the device scheduler's MEMPOOL_RECHECK class — the
+        lowest admission priority — so any signature work a recheck storm
+        triggers (an app verifying tx signatures through crypto/batch)
+        queues behind consensus-commit, fast-sync and lite verification
+        instead of delaying a commit at the device."""
+        with priority_scope(Priority.MEMPOOL_RECHECK):
+            await self._recheck_txs_inner()
+
+    async def _recheck_txs_inner(self) -> None:
         els = list(self.txs)
         futs = [
             self.app_conn.check_tx_async(el.value.tx, new_check=False) for el in els

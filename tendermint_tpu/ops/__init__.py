@@ -137,76 +137,80 @@ def _probe_small_path(curve: str, native_fn, serial_fn, sample) -> str:
     return choice
 
 
-def _ed25519_small(pubs, msgs, sigs):
+def _ed25519_sample():
+    from tendermint_tpu.utils import make_sig_batch
+
+    return make_sig_batch(64, msg_prefix=b"probe ")
+
+
+def _secp256k1_sample():
+    from tendermint_tpu.crypto import secp256k1 as sk
+
+    priv = sk.gen_priv_key(seed=b"small-path probe")
+    pub = priv.pub_key().bytes()
+    msgs_ = [b"probe %d" % i for i in range(64)]
+    return [pub] * 64, msgs_, [priv.sign(m) for m in msgs_]
+
+
+def _curve_spec(curve: str):
+    """(pub_cls, native batch fn, probe sample) per curve — the one place
+    the small-path machinery differs between ed25519 and secp256k1 (the
+    probe/try-native/serial skeleton below used to be duplicated)."""
     from tendermint_tpu.crypto import native
-    from tendermint_tpu.crypto.ed25519 import PubKeyEd25519
 
-    def sample():
-        from tendermint_tpu.utils import make_sig_batch
+    if curve == "ed25519":
+        from tendermint_tpu.crypto.ed25519 import PubKeyEd25519
 
-        return make_sig_batch(64, msg_prefix=b"probe ")
+        return PubKeyEd25519, native.ed25519_verify_batch, _ed25519_sample
+    from tendermint_tpu.crypto.secp256k1 import PubKeySecp256k1
+
+    return PubKeySecp256k1, native.secp256k1_verify_batch, _secp256k1_sample
+
+
+def _small_verify(curve, pubs, msgs, sigs):
+    """Sub-threshold host verification, shared skeleton for both curves:
+    probe native-vs-serial once per curve, prefer the winner, degrade to
+    the serial loop (per-signature error isolation) on native failure."""
+    pub_cls, native_fn, sample = _curve_spec(curve)
 
     def serial(p, m, s):
-        return serial_verify(PubKeyEd25519, p, m, s)
+        return serial_verify(pub_cls, p, m, s)
 
-    if _probe_small_path(
-        "ed25519", native.ed25519_verify_batch, serial, sample
-    ) == "native":
+    if _probe_small_path(curve, native_fn, serial, sample) == "native":
         try:
-            return native.ed25519_verify_batch(pubs, msgs, sigs)
+            return native_fn(pubs, msgs, sigs)
         except (RuntimeError, OSError):
             pass
     return serial(pubs, msgs, sigs)
 
 
-def _ed25519_backend(pubs, msgs, sigs):
-    if len(pubs) < effective_min_batch():
-        # explicit occupancy accounting for the host route: an all-CPU
-        # node (no accelerator, or every batch sub-threshold) reports
-        # WHY the device counters are zero instead of an ambiguous blank
-        from tendermint_tpu.libs import trace as _trace
-
-        _trace.DEVICE.record_cpu_route(len(pubs))
-        return _ed25519_small(pubs, msgs, sigs)
-    from tendermint_tpu.ops import ed25519_batch
-
-    return ed25519_batch.verify_batch(pubs, msgs, sigs)
+def _ed25519_small(pubs, msgs, sigs):
+    return _small_verify("ed25519", pubs, msgs, sigs)
 
 
 def _secp256k1_small(pubs, msgs, sigs):
-    from tendermint_tpu.crypto import native
-    from tendermint_tpu.crypto.secp256k1 import PubKeySecp256k1
+    return _small_verify("secp256k1", pubs, msgs, sigs)
 
-    def sample():
-        from tendermint_tpu.crypto import secp256k1 as sk
 
-        priv = sk.gen_priv_key(seed=b"small-path probe")
-        pub = priv.pub_key().bytes()
-        msgs_ = [b"probe %d" % i for i in range(64)]
-        return [pub] * 64, msgs_, [priv.sign(m) for m in msgs_]
+# The registered crypto.batch backends submit through the process-wide
+# DeviceScheduler (tendermint_tpu/device/): one admission queue + packer
+# + breaker for every subsystem's signatures. The scheduler keeps the
+# measured routing (scheduler.verify runs sub-threshold batches on the
+# host paths above, inline on the submitting thread) and dispatches
+# device-bound work by priority class (device/priorities.py contextvar:
+# consensus commit > fast sync > lite > mempool recheck).
 
-    def serial(p, m, s):
-        return serial_verify(PubKeySecp256k1, p, m, s)
 
-    if _probe_small_path(
-        "secp256k1", native.secp256k1_verify_batch, serial, sample
-    ) == "native":
-        try:
-            return native.secp256k1_verify_batch(pubs, msgs, sigs)
-        except (RuntimeError, OSError):
-            pass
-    return serial(pubs, msgs, sigs)
+def _ed25519_backend(pubs, msgs, sigs):
+    from tendermint_tpu.device import get_scheduler
+
+    return get_scheduler().verify("ed25519", pubs, msgs, sigs)
 
 
 def _secp256k1_backend(pubs, msgs, sigs):
-    if len(pubs) < effective_min_batch():
-        from tendermint_tpu.libs import trace as _trace
+    from tendermint_tpu.device import get_scheduler
 
-        _trace.DEVICE.record_cpu_route(len(pubs), curve="secp256k1")
-        return _secp256k1_small(pubs, msgs, sigs)
-    from tendermint_tpu.ops import secp_batch
-
-    return secp_batch.verify_batch(pubs, msgs, sigs)
+    return get_scheduler().verify("secp256k1", pubs, msgs, sigs)
 
 
 def _accumulation_hint() -> int:

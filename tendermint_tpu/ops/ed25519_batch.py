@@ -35,8 +35,6 @@ with S < L enforced and non-canonical R encodings rejected.
 from __future__ import annotations
 
 import hashlib
-import os
-import threading
 import time
 from functools import partial
 
@@ -45,6 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tendermint_tpu.crypto import ed25519_math as em
+from tendermint_tpu.device import scheduler as _dsched
 from tendermint_tpu.libs import trace as _trace
 from tendermint_tpu.ops import curve, field
 from tendermint_tpu.ops.limbs import LIMB_BITS, NLIMB
@@ -542,125 +541,18 @@ class _DeviceKeyCache:
 
 _dev_keys = _DeviceKeyCache()
 
-def _fetch_pool():
-    # daemon workers (libs.pool): a verdict fetch against a dead tunnel
-    # hangs forever, and ThreadPoolExecutor's non-daemon workers would
-    # then hang interpreter exit too; shared_pool serializes first-use
-    from tendermint_tpu.libs.pool import shared_pool
-
-    return shared_pool("tmtpu-fetch", 8)
-
-
-# Whole-batch bound on the concurrent verdict fetches. Normal fetches are
-# ~65 ms RPCs (tunneled) or microseconds (local); the bound only fires
-# when the device link is wedged — where without it the caller blocks
-# forever (ADVICE r4). Generous enough for a tunnel hiccup + execute
-# backlog; a stream that legitimately needs longer has already amortized
-# its work across chunks and will recompute on the CPU path below.
-_FETCH_TIMEOUT_S = float(os.environ.get("TMTPU_FETCH_TIMEOUT_S", 300.0))
-
-# After a fetch timeout (wedged link), how long later calls skip the device
-# entirely before ONE half-open probe is allowed through again.
-_BREAKER_RETRY_S = float(os.environ.get("TMTPU_BREAKER_RETRY_S", 600.0))
-
-
-class _CircuitBreaker:
-    """Wedged-device circuit breaker (ADVICE r5 medium).
-
-    Without it, the first fetch TimeoutError is paid AGAIN by every later
-    verify_batch call: the daemon fetch workers stay wedged and each commit
-    verify blocks the full _FETCH_TIMEOUT_S before degrading — a
-    multi-minute stall per height, forever, which is a consensus-liveness
-    failure even though nothing hangs indefinitely. After the first
-    timeout the breaker trips: later calls route straight to the CPU path
-    with no device wait until `retry_after` has elapsed, then exactly one
-    call probes the device again (half-open) — re-tripping on timeout,
-    closing on success. State is mirrored into libs/trace.DEVICE for the
-    debug_device route and the DeviceMetrics gauge.
-    """
-
-    def __init__(self, retry_after: float = _BREAKER_RETRY_S) -> None:
-        self.retry_after = retry_after
-        self.tripped = False
-        self.retry_at = 0.0
-        self._lock = threading.Lock()
-
-    def allow(self) -> bool:
-        """True when the device may be tried: closed, or half-open. The
-        half-open probe is CLAIMED atomically — granting it advances
-        retry_at a full window, so exactly one caller per window reaches
-        the (possibly still wedged) device and blocks on its fetch
-        timeout; concurrent callers keep routing to CPU instead of all
-        piling onto the dead link at once."""
-        with self._lock:
-            if not self.tripped:
-                return True
-            now = time.monotonic()
-            if now >= self.retry_at:
-                self.retry_at = now + self.retry_after
-                return True
-            return False
-
-    def trip(self) -> None:
-        with self._lock:
-            self.tripped = True
-            self.retry_at = time.monotonic() + self.retry_after
-        _trace.DEVICE.record_breaker(True, self.retry_after)
-
-    def reset(self) -> None:
-        with self._lock:
-            was = self.tripped
-            self.tripped = False
-            self.retry_at = 0.0
-        if was:
-            _trace.DEVICE.record_breaker(False, 0.0)
-
-    def release_probe(self) -> None:
-        """Return an unused half-open claim: a caller that passed allow()
-        but never actually reached the device (no valid lanes to dispatch,
-        or no device kernel for its curve) must not burn the window's one
-        probe — re-arm it for the next caller. No-op when closed."""
-        with self._lock:
-            if self.tripped:
-                self.retry_at = time.monotonic()
-
-    def state(self) -> dict:
-        with self._lock:
-            return {
-                "tripped": self.tripped,
-                "retry_in_s": round(max(0.0, self.retry_at - time.monotonic()), 3)
-                if self.tripped
-                else 0.0,
-                "retry_after_s": self.retry_after,
-            }
-
-
-breaker = _CircuitBreaker()
-
-
-def fetch_verdicts(arrays) -> list:
-    """Fetch dispatched device verdict arrays, BOUNDED: every entry comes
-    back as an np.ndarray or the Exception that fetching it raised —
-    TimeoutError for all of them when the whole batch exceeded
-    _FETCH_TIMEOUT_S (the wedged-device-link case, where an inline
-    np.asarray would block forever). Every fetch — including a single
-    chunk, which is every normal-sized commit — goes through the daemon
-    pool so the bound always applies. Shared by both curves' batch
-    verifiers."""
-
-    def fetch(d):
-        try:
-            return np.asarray(d)
-        except Exception as e:  # noqa: BLE001 — applied at caller's
-            # degrade step (the recompute path may itself compile)
-            return e
-
-    if not arrays:
-        return []
-    try:
-        return _fetch_pool().map(fetch, arrays, timeout=_FETCH_TIMEOUT_S)
-    except TimeoutError as e:
-        return [e] * len(arrays)
+# The wedged-device circuit breaker and the bounded verdict-fetch pool
+# moved to the unified dispatch service (tendermint_tpu/device/scheduler.py,
+# ROADMAP item 1): ONE breaker per DeviceScheduler instead of a module
+# global that secp_batch borrowed from this module, one fetch pool owned
+# by the scheduler. The names below are compatibility aliases; `breaker`
+# itself is served by the module __getattr__ at the bottom of this file
+# so debug_fault's trip_breaker/reset_breaker and the
+# nemesis_flapping_device scenario keep working unchanged.
+_CircuitBreaker = _dsched._CircuitBreaker
+fetch_verdicts = _dsched.fetch_verdicts
+_FETCH_TIMEOUT_S = _dsched._FETCH_TIMEOUT_S
+_BREAKER_RETRY_S = _dsched._BREAKER_RETRY_S
 
 # Multi-device dispatch: when more than one device is visible (a real TPU
 # slice, or the test suite's 8-virtual-CPU mesh) every chunk is
@@ -701,7 +593,25 @@ def _multi_device_fn():
 
 
 def verify_batch(pubs, msgs, sigs) -> list[bool]:
+    """DEPRECATED direct entry — thin compatibility wrapper.
+
+    Device verification flows through the process-wide DeviceScheduler
+    (tendermint_tpu/device/): this wrapper submits a device-targeted
+    request at the caller's priority class (device/priorities.py) and
+    blocks for the verdicts, so stray direct callers still share the one
+    admission queue, packer and breaker. On the scheduler's own dispatch
+    thread it runs the real dispatch body instead (tmlint TM501 flags new
+    direct calls outside tendermint_tpu/device/)."""
+    if _dsched.in_dispatch():
+        return _verify_batch_local(pubs, msgs, sigs)
+    return _dsched.get_scheduler().submit_sync(
+        "ed25519", pubs, msgs, sigs
+    ).result()
+
+
+def _verify_batch_local(pubs, msgs, sigs) -> list[bool]:
     """Full batched verification: host prep + one device launch per chunk.
+    Scheduler-dispatch body (callers go through `verify_batch`).
 
     Batches above kcache.MAX_BUCKET are verified in chunks so the set of
     compiled kernel variants stays bounded; the per-bucket callable comes
@@ -716,10 +626,11 @@ def verify_batch(pubs, msgs, sigs) -> list[bool]:
     (batch size, bucket, dispatch and fetch latency, timeout/fallback
     tags) attached to whatever consensus span is active, and every
     dispatch/fetch/degrade event updates libs/trace.DEVICE. A tripped
-    circuit breaker short-circuits to the device-free crypto path.
+    circuit breaker (the dispatching scheduler's) short-circuits to the
+    device-free crypto path.
     """
     n = len(pubs)
-    if not breaker.allow():
+    if not _dsched.active_breaker().allow():
         # wedged device link: route straight to the CPU path instead of
         # re-blocking _FETCH_TIMEOUT_S on every commit verify (ADVICE r5)
         from tendermint_tpu import ops as _ops
@@ -735,6 +646,7 @@ def verify_batch(pubs, msgs, sigs) -> list[bool]:
 
 def _verify_batch_device(pubs, msgs, sigs, n, kcache, sp) -> list[bool]:
     """verify_batch body under an open `ed25519_batch` span `sp`."""
+    breaker = _dsched.active_breaker()
     t_dispatch0 = time.monotonic()
     pending: list[tuple[int, int, object, tuple, np.ndarray, bool]] = []
     out = np.zeros(n, dtype=bool)
@@ -856,3 +768,14 @@ def _verify_batch_device(pubs, msgs, sigs, n, kcache, sp) -> list[bool]:
         # a claimed half-open probe on a call that never hit the device
         breaker.release_probe()
     return out.tolist()
+
+
+def __getattr__(name):
+    # Deprecated alias: the circuit breaker is a DeviceScheduler instance
+    # now (device/scheduler.py), not this module's global. Served lazily so
+    # debug_fault's trip_breaker/reset_breaker and the
+    # nemesis_flapping_device scenario keep working unchanged; a real
+    # module attribute (tests monkeypatch one) shadows this.
+    if name == "breaker":
+        return _dsched.get_scheduler().breaker
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

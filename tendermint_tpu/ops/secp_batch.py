@@ -28,6 +28,7 @@ import os
 import numpy as np
 
 from tendermint_tpu.crypto import secp256k1_math as sm
+from tendermint_tpu.device import scheduler as _dsched
 from tendermint_tpu.libs import trace as _trace
 
 NWORDS = 8
@@ -264,14 +265,29 @@ def _serial_verify(pubs, msgs, sigs) -> list[bool]:
 
 
 def verify_batch(pubs, msgs, sigs) -> list[bool]:
+    """DEPRECATED direct entry — thin compatibility wrapper.
+
+    Submits through the process-wide DeviceScheduler admission queue
+    (tendermint_tpu/device/) at the caller's priority class; on the
+    scheduler's own dispatch thread it runs the real dispatch body (tmlint
+    TM501 flags new direct calls outside tendermint_tpu/device/)."""
+    if _dsched.in_dispatch():
+        return _verify_batch_local(pubs, msgs, sigs)
+    return _dsched.get_scheduler().submit_sync(
+        "secp256k1", pubs, msgs, sigs
+    ).result()
+
+
+def _verify_batch_local(pubs, msgs, sigs) -> list[bool]:
     """Full batched verification: host prep + one device launch per chunk.
+    Scheduler-dispatch body (callers go through `verify_batch`).
 
     Chunk launches are dispatched asynchronously and collected at the end
-    (one device transfer + one execute each — see ed25519_batch.verify_batch
-    for the dispatch-cost rationale). Shares ed25519_batch's wedged-device
-    circuit breaker — both curves dispatch over the same link — and records
-    the same `secp_batch` device span + DEVICE telemetry."""
-    from tendermint_tpu.ops import ed25519_batch as _edb
+    (one device transfer + one execute each — see ed25519_batch for the
+    dispatch-cost rationale). Consults the dispatching scheduler's
+    wedged-device circuit breaker — both curves dispatch over the same
+    link, through the same queue — and records the same `secp_batch`
+    device span + DEVICE telemetry."""
     from tendermint_tpu.ops import kcache
 
     n = len(pubs)
@@ -283,7 +299,7 @@ def verify_batch(pubs, msgs, sigs) -> list[bool]:
         # per retry window, and a caller that can never reach the device
         # must not starve ed25519's actual recovery probe
         return _serial_verify(pubs, msgs, sigs)
-    if not _edb.breaker.allow():
+    if not _dsched.active_breaker().allow():
         _trace.DEVICE.record_fallback("breaker_open", curve="secp256k1")
         with _trace.span("secp_cpu_fallback", batch_size=n, reason="breaker_open"):
             return _serial_verify(pubs, msgs, sigs)
@@ -295,8 +311,7 @@ def _verify_batch_device(pubs, msgs, sigs, n, fn, mfn, sharding, kcache, sp) -> 
     """verify_batch body under an open `secp_batch` span `sp`."""
     import time as _time
 
-    from tendermint_tpu.ops import ed25519_batch as _edb
-
+    breaker = _dsched.active_breaker()
     t_dispatch0 = _time.monotonic()
     pending: list[tuple[int, int, object, np.ndarray]] = []
     out = np.zeros(n, dtype=bool)
@@ -344,10 +359,10 @@ def _verify_batch_device(pubs, msgs, sigs, n, fn, mfn, sharding, kcache, sp) -> 
             out[lo:hi] = _serial_verify(pubs[lo:hi], msgs[lo:hi], sigs[lo:hi])
             continue
         pending.append((lo, hi, dev_out, mask))
-    # concurrent, BOUNDED fetches (shared helper): a wedged device link
-    # degrades every chunk to the serial path instead of blocking the
-    # caller forever
-    from tendermint_tpu.ops.ed25519_batch import fetch_verdicts
+    # concurrent, BOUNDED fetches (the scheduler's pool): a wedged device
+    # link degrades every chunk to the serial path instead of blocking
+    # the caller forever
+    fetch_verdicts = _dsched.fetch_verdicts
 
     sp.set(chunks=len(pending),
            dispatch_ms=round((_time.monotonic() - t_dispatch0) * 1e3, 3))
@@ -372,13 +387,13 @@ def _verify_batch_device(pubs, msgs, sigs, n, fn, mfn, sharding, kcache, sp) -> 
             (_time.monotonic() - t_dispatch0), queue_depth=len(pending)
         )
     if timed_out:
-        _edb.breaker.trip()
+        breaker.trip()
         _trace.DEVICE.record_timeout(curve="secp256k1")
         sp.set(timeout=True)
     elif pending:
-        _edb.breaker.reset()
+        breaker.reset()
         _trace.DEVICE.record_fetch(fetch_s, curve="secp256k1")
     else:
         # nothing dispatched: return the claimed half-open probe unused
-        _edb.breaker.release_probe()
+        breaker.release_probe()
     return out.tolist()

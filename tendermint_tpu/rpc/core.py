@@ -232,13 +232,19 @@ class Environment:
                 "stalls": wd.stalls,
                 "in_stall": wd.in_stall,
             }
-        breaker = self._device_snapshot()["breaker"]
+        dev_snap = self._device_snapshot()
+        breaker = dev_snap["breaker"]
+        sched_q = dev_snap.get("scheduler", {}).get("queues") or {}
         crashes = max(0, RECORDER.crashes - self.crash_baseline)
         degraded = []
         if loop is not None and loop["in_stall"]:
             degraded.append("loop_stalled")
         if breaker.get("tripped"):
             degraded.append("device_breaker_open")
+        if sched_q.get("stalled"):
+            # admission queue has work older than the stall bound: the
+            # dispatcher is wedged or the device is drowning in backlog
+            degraded.append("device_queue_stalled")
         if crashes:
             degraded.append("task_crashes")
         return {
@@ -524,12 +530,25 @@ class Environment:
         edb = _sys.modules.get("tendermint_tpu.ops.ed25519_batch")
         if edb is not None:
             snap["breaker"] = dict(snap["breaker"], **edb.breaker.state())
+        # live admission-queue state when the device scheduler is loaded
+        # (same lazy-module rule: a CPU-only node never imports it here)
+        dsched = _sys.modules.get("tendermint_tpu.device.scheduler")
+        if dsched is not None:
+            try:
+                snap.setdefault("scheduler", {})["queues"] = (
+                    dsched.get_scheduler().queue_state()
+                )
+            except Exception:  # noqa: BLE001 — diagnostics must not break
+                pass
         return snap
 
     async def debug_device(self) -> dict:
         """Device data-plane health: dispatch/pad/fetch counters, CPU
         fallbacks, occupancy (busy/idle, queue depth, fill ratio,
-        host-route work), and the wedged-device circuit breaker state."""
+        host-route work), the wedged-device circuit breaker state, and
+        the dispatch scheduler's admission plane (`scheduler`: per-class
+        submit/dispatch/queue-wait/preempt counters + packing stats, plus
+        `scheduler.queues` — live per-class depth and oldest wait)."""
         from tendermint_tpu.libs.recorder import RECORDER, clock_anchor
 
         snap = self._device_snapshot()

@@ -139,6 +139,22 @@ class TestQuickBench:
             assert r["vs_baseline"] >= 0
             assert r["measured_at_utc"].endswith("Z")
 
+    def test_scheduler_mode_emits_sched_metrics(self, capsys):
+        # the ISSUE 8 admission-pipeline mode: distinct metric names so
+        # bench_compare never cross-compares direct vs scheduler records
+        pytest.importorskip("cryptography", reason="crypto stack unavailable")
+        quick_bench.main(sizes=(4,), scheduler=True)
+        lines = [
+            json.loads(ln)
+            for ln in capsys.readouterr().out.splitlines()
+            if ln.startswith("{")
+        ]
+        assert [r["metric"] for r in lines] == [
+            "ed25519_commit_verify_4v_sched_per_sec"
+        ]
+        assert lines[0]["value"] > 0
+        assert "DeviceScheduler" in lines[0]["source"]
+
     def test_bank_atomic_overwrite(self, tmp_path):
         path = str(tmp_path / "banked_quick.json")
         quick_bench.bank({"a": 1}, path)

@@ -44,6 +44,14 @@ def test_nemesis_flapping_device():
 
 
 @pytest.mark.slow
+def test_nemesis_sched_priority():
+    """ISSUE 8 acceptance: a mempool recheck flood may not delay commit
+    verify — asserted through the device scheduler's per-class queue-wait
+    accounting and the live tendermint_device_queue_* series."""
+    nemesis.run(["nemesis_sched_priority"], n=4)
+
+
+@pytest.mark.slow
 def test_nemesis_crash_sweep(monkeypatch):
     """Crash at every fail.fail() index during commit / WAL replay with
     restart-and-verify. TMTPU_CRASH_INDEXES narrows the sweep; the suite

@@ -1,0 +1,601 @@
+"""DeviceScheduler — admission queue, priority classes, packing, breaker.
+
+The queue-behavior tests stub the dispatch seam (`_dispatch_curve`) so
+they run everywhere: no crypto stack, no jax, no device. The breaker-
+drain and ops-integration tests need the real curve modules and skip
+where the crypto stack is unavailable (same gate as test_trace).
+
+These are the acceptance tests of ISSUE 8: CONSENSUS_COMMIT work is
+dispatched ahead of a queued MEMPOOL_RECHECK flood, aging still
+completes the flood, concurrent same-curve submissions pack into one
+device dispatch, a tripped breaker drains the queue through the CPU
+fallback with correct verdicts, and stop() rejects queued work cleanly.
+"""
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.device.priorities import (
+    Priority,
+    current_priority,
+    priority_scope,
+)
+from tendermint_tpu.device.scheduler import (
+    DeviceScheduler,
+    SchedulerStopped,
+    active_breaker,
+    get_scheduler,
+)
+from tendermint_tpu.libs import trace as tmtrace
+
+
+def mk(tag: bytes, n: int = 1):
+    """A fake (pubs, msgs, sigs) batch whose verdicts the stub derives
+    from the msg suffix: b'...bad' lanes come back False."""
+    return [b"\x00" * 32] * n, [tag] * n, [b"\x00" * 64] * n
+
+
+class StubDispatch:
+    """Replaces DeviceScheduler._dispatch_curve: records every dispatch,
+    optionally blocks the first one so tests can build queue contention
+    deterministically."""
+
+    def __init__(self, block_first: bool = False):
+        self.calls: list[list[bytes]] = []
+        self.curves: list[str] = []
+        self.gate = threading.Event()
+        self.started = threading.Event()
+        self.block_first = block_first
+
+    def __call__(self, curve, pubs, msgs, sigs):
+        first = not self.calls
+        self.calls.append([bytes(m) for m in msgs])
+        self.curves.append(curve)
+        if first and self.block_first:
+            self.started.set()
+            assert self.gate.wait(10), "test never released the dispatch gate"
+        return [not m.endswith(b"bad") for m in msgs]
+
+
+@pytest.fixture
+def sched():
+    s = DeviceScheduler(aging_s=30.0)  # aging effectively off by default
+    yield s
+    s.shutdown()
+
+
+def _occupy(s: DeviceScheduler, stub: StubDispatch):
+    """Submit a blocker so everything after it queues behind one
+    in-flight dispatch."""
+    fut = s.submit_sync("ed25519", *mk(b"blocker"))
+    assert stub.started.wait(5), "dispatcher never picked up the blocker"
+    return fut
+
+
+class TestPriorityOrdering:
+    def test_consensus_dispatched_ahead_of_mempool_flood(self, sched):
+        stub = StubDispatch(block_first=True)
+        sched._dispatch_curve = stub
+        blocker = _occupy(sched, stub)
+        # a flood of low-priority work arrives FIRST...
+        flood = [
+            sched.submit_sync(
+                "ed25519", *mk(b"mem%d" % i), priority=Priority.MEMPOOL_RECHECK
+            )
+            for i in range(8)
+        ]
+        deadline = time.monotonic() + 5
+        while sched.queue_state()["depth_total"] < 8:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        # ...then one commit verify
+        commit = sched.submit_sync(
+            "ed25519", *mk(b"commit"), priority=Priority.CONSENSUS_COMMIT
+        )
+        stub.gate.set()
+        assert commit.result(5) == [True]
+        assert blocker.result(5) == [True]
+        for f in flood:
+            assert f.result(5) == [True]  # aging/strict pop still completes it
+        # the dispatch after the blocker must LEAD with the commit lane
+        assert stub.calls[1][0] == b"commit"
+
+    def test_strict_order_across_all_classes(self, sched):
+        stub = StubDispatch(block_first=True)
+        sched._dispatch_curve = stub
+        blocker = _occupy(sched, stub)
+        # enqueue in inverse priority order, one lane each, distinct curves
+        # disabled (same curve) so packing applies — order inside the pack
+        # is aged-priority order
+        order = [
+            (Priority.MEMPOOL_RECHECK, b"m"),
+            (Priority.LITE, b"l"),
+            (Priority.FASTSYNC, b"f"),
+            (Priority.CONSENSUS_COMMIT, b"c"),
+        ]
+        futs = [
+            sched.submit_sync("ed25519", *mk(tag), priority=p)
+            for p, tag in order
+        ]
+        deadline = time.monotonic() + 5
+        while sched.queue_state()["depth_total"] < 4:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        stub.gate.set()
+        for f in futs:
+            assert f.result(5) == [True]
+        blocker.result(5)
+        assert stub.calls[1] == [b"c", b"f", b"l", b"m"]
+
+    def test_no_preempt_count_for_packed_mates(self, sched):
+        # a same-curve request coalesced INTO the winning dispatch was
+        # not passed over — it must not inflate preempted_total
+        stub = StubDispatch(block_first=True)
+        sched._dispatch_curve = stub
+        before = (
+            tmtrace.DEVICE.snapshot()["scheduler"]["classes"]
+            .get("mempool_recheck", {})
+            .get("preempted", 0)
+        )
+        blocker = _occupy(sched, stub)
+        mem = sched.submit_sync(
+            "ed25519", *mk(b"mem"), priority=Priority.MEMPOOL_RECHECK
+        )
+        commit = sched.submit_sync(
+            "ed25519", *mk(b"commit"), priority=Priority.CONSENSUS_COMMIT
+        )
+        deadline = time.monotonic() + 5
+        while sched.queue_state()["depth_total"] < 2:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        stub.gate.set()
+        assert commit.result(5) == [True] and mem.result(5) == [True]
+        blocker.result(5)
+        assert len(stub.calls) == 2  # packed into one dispatch
+        after = (
+            tmtrace.DEVICE.snapshot()["scheduler"]["classes"]
+            .get("mempool_recheck", {})
+            .get("preempted", 0)
+        )
+        assert after == before
+
+    def test_preemption_accounting(self, sched):
+        stub = StubDispatch(block_first=True)
+        sched._dispatch_curve = stub
+        before = (
+            tmtrace.DEVICE.snapshot()["scheduler"]["classes"]
+            .get("mempool_recheck", {})
+            .get("preempted", 0)
+        )
+        blocker = _occupy(sched, stub)
+        # different curve so the commit CANNOT pack the mempool request —
+        # it must be genuinely passed over
+        mem = sched.submit_sync(
+            "secp256k1", *mk(b"mem"), priority=Priority.MEMPOOL_RECHECK
+        )
+        commit = sched.submit_sync(
+            "ed25519", *mk(b"commit"), priority=Priority.CONSENSUS_COMMIT
+        )
+        deadline = time.monotonic() + 5
+        while sched.queue_state()["depth_total"] < 2:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        stub.gate.set()
+        assert commit.result(5) == [True]
+        assert mem.result(5) == [True]
+        after = tmtrace.DEVICE.snapshot()["scheduler"]["classes"][
+            "mempool_recheck"
+        ]["preempted"]
+        assert after >= before + 1
+
+
+class TestAging:
+    def test_aged_mempool_beats_fresh_consensus(self):
+        s = DeviceScheduler(aging_s=0.02)
+        try:
+            stub = StubDispatch(block_first=True)
+            s._dispatch_curve = stub
+            blocker = _occupy(s, stub)
+            mem = s.submit_sync(
+                "ed25519", *mk(b"old-mem"), priority=Priority.MEMPOOL_RECHECK
+            )
+            # wait 3+ aging intervals: effective class reaches the top
+            time.sleep(0.12)
+            con = s.submit_sync(
+                "ed25519", *mk(b"new-con"), priority=Priority.CONSENSUS_COMMIT
+            )
+            stub.gate.set()
+            assert mem.result(5) == [True]
+            assert con.result(5) == [True]
+            blocker.result(5)
+            # aged request arrived earlier at equal effective class: leads
+            assert stub.calls[1][0] == b"old-mem"
+        finally:
+            s.shutdown()
+
+
+class TestPacking:
+    def test_concurrent_same_curve_submits_one_dispatch(self, sched):
+        stub = StubDispatch(block_first=True)
+        sched._dispatch_curve = stub
+        blocker = _occupy(sched, stub)
+        futs = [
+            sched.submit_sync("ed25519", *mk(b"req%d" % i, n=3), priority=p)
+            for i, p in enumerate(
+                [Priority.FASTSYNC, Priority.LITE, Priority.CONSENSUS_COMMIT]
+            )
+        ]
+        deadline = time.monotonic() + 5
+        while sched.queue_state()["depth_total"] < 3:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        packed_before = tmtrace.DEVICE.snapshot()["scheduler"]["packing"]
+        stub.gate.set()
+        for f in futs:
+            assert f.result(5) == [True] * 3
+        blocker.result(5)
+        # everything queued behind the blocker went out as ONE dispatch
+        assert len(stub.calls) == 2
+        assert len(stub.calls[1]) == 9
+        packed = tmtrace.DEVICE.snapshot()["scheduler"]["packing"]
+        assert packed["max_packed"] >= 3
+        assert packed["batches"] > packed_before["batches"]
+
+    def test_verdicts_scatter_to_the_right_request(self, sched):
+        stub = StubDispatch(block_first=True)
+        sched._dispatch_curve = stub
+        blocker = _occupy(sched, stub)
+        good = sched.submit_sync("ed25519", *mk(b"ok", n=2))
+        bad = sched.submit_sync("ed25519", *mk(b"sig-bad", n=2))
+        mixed_pubs, mixed_msgs, mixed_sigs = mk(b"ok", n=3)
+        mixed_msgs[1] = b"mid-bad"
+        mixed = sched.submit_sync("ed25519", mixed_pubs, mixed_msgs, mixed_sigs)
+        deadline = time.monotonic() + 5
+        while sched.queue_state()["depth_total"] < 3:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        stub.gate.set()
+        blocker.result(5)
+        assert good.result(5) == [True, True]
+        assert bad.result(5) == [False, False]
+        assert mixed.result(5) == [True, False, True]
+
+    def test_max_pack_respected(self):
+        s = DeviceScheduler(aging_s=30.0, max_pack=4)
+        try:
+            stub = StubDispatch(block_first=True)
+            s._dispatch_curve = stub
+            blocker = _occupy(s, stub)
+            futs = [s.submit_sync("ed25519", *mk(b"r%d" % i, n=3)) for i in range(3)]
+            deadline = time.monotonic() + 5
+            while s.queue_state()["depth_total"] < 3:
+                assert time.monotonic() < deadline
+                time.sleep(0.005)
+            stub.gate.set()
+            for f in futs:
+                assert f.result(5) == [True] * 3
+            blocker.result(5)
+            # 3 + 3 + 3 lanes with a 4-lane pack budget: no coalescing
+            assert all(len(c) <= 4 for c in stub.calls)
+        finally:
+            s.shutdown()
+
+
+class TestLifecycle:
+    def test_stop_rejects_queued_work_cleanly(self, sched):
+        stub = StubDispatch(block_first=True)
+        sched._dispatch_curve = stub
+        blocker = _occupy(sched, stub)
+        queued = [sched.submit_sync("ed25519", *mk(b"q%d" % i)) for i in range(4)]
+        sched.shutdown(join_timeout=0.1)  # in-flight blocker still held
+        for f in queued:
+            with pytest.raises(SchedulerStopped):
+                f.result(5)
+        rejected = tmtrace.DEVICE.snapshot()["scheduler"]["classes"][
+            "consensus_commit"
+        ]["rejected"]
+        assert rejected >= 4
+        # the in-flight dispatch still completes normally
+        stub.gate.set()
+        assert blocker.result(5) == [True]
+        # post-stop submissions degrade to inline dispatch on the caller
+        assert sched.submit_sync("ed25519", *mk(b"late")).result(1) == [True]
+
+    def test_base_service_start_stop(self, sched):
+        stub = StubDispatch()
+        sched._dispatch_curve = stub
+
+        async def main():
+            await sched.start()
+            out = await sched.submit("ed25519", *mk(b"async", n=2))
+            assert out == [True, True]
+            await sched.stop()
+
+        asyncio.run(main())
+        assert stub.calls and stub.calls[0] == [b"async", b"async"]
+
+    def test_unknown_curve_rejected(self, sched):
+        with pytest.raises(ValueError):
+            sched.submit_sync("p256", *mk(b"x"))
+
+    def test_dispatch_exception_propagates_to_every_future(self, sched):
+        boom = RuntimeError("kernel exploded")
+
+        def exploding(curve, pubs, msgs, sigs):
+            raise boom
+
+        stub = StubDispatch(block_first=True)
+        sched._dispatch_curve = stub
+        blocker = _occupy(sched, stub)
+        sched._dispatch_curve = exploding
+        futs = [sched.submit_sync("ed25519", *mk(b"r%d" % i)) for i in range(2)]
+        deadline = time.monotonic() + 5
+        while sched.queue_state()["depth_total"] < 2:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        stub.gate.set()
+        blocker.result(5)
+        for f in futs:
+            with pytest.raises(RuntimeError, match="kernel exploded"):
+                f.result(5)
+
+    def test_inline_submit_does_not_stomp_queue_depth(self):
+        dt = tmtrace.DeviceTelemetry()
+        dt.record_sched_submit("mempool_recheck", 40)  # queued backlog
+        dt.record_sched_submit("mempool_recheck", None)  # inline host route
+        c = dt.snapshot()["scheduler"]["classes"]["mempool_recheck"]
+        assert c["submitted"] == 2
+        assert c["queue_depth"] == 40  # backlog reading preserved
+
+    def test_tripped_breaker_dispatches_off_the_queue_thread(self):
+        """Wedged-device mode: a (possibly blocking) half-open probe must
+        not head-of-line-block the dispatcher — queued work keeps
+        draining while one group hangs on the dead link."""
+        s = DeviceScheduler(aging_s=30.0)
+        probe_gate = threading.Event()
+        probe_started = threading.Event()
+        drained = threading.Event()
+
+        def dispatch(curve, pubs, msgs, sigs):
+            if msgs[0] == b"probe":
+                probe_started.set()
+                assert probe_gate.wait(10)  # the wedged 300s fetch
+            return [True] * len(msgs)
+
+        s._dispatch_curve = dispatch
+        s.breaker.tripped = True  # tripped state without a retry window
+        try:
+            hung = s.submit_sync("ed25519", *mk(b"probe"))
+            # wait until the probe group is actually in flight — work
+            # submitted earlier would legitimately pack into its group
+            # and ride (= block with) it, like the pre-PR probing caller
+            assert probe_started.wait(5)
+            # while the probe hangs, later work must still complete
+            ok = s.submit_sync(
+                "ed25519", *mk(b"commit"), priority=Priority.CONSENSUS_COMMIT
+            )
+            assert ok.result(5) == [True]
+            drained.set()
+            probe_gate.set()
+            assert hung.result(5) == [True]
+            assert drained.is_set()
+        finally:
+            s.breaker.tripped = False
+            s.shutdown()
+
+    def test_queue_state_shape(self, sched):
+        qs = sched.queue_state()
+        assert set(qs["classes"]) == {
+            "consensus_commit", "fastsync", "lite", "mempool_recheck"
+        }
+        assert qs["stalled"] is False
+
+
+class TestPriorityContext:
+    def test_contextvar_default_and_scope(self):
+        assert current_priority() is Priority.CONSENSUS_COMMIT
+        with priority_scope(Priority.FASTSYNC):
+            assert current_priority() is Priority.FASTSYNC
+            with priority_scope(Priority.MEMPOOL_RECHECK):
+                assert current_priority() is Priority.MEMPOOL_RECHECK
+            assert current_priority() is Priority.FASTSYNC
+        assert current_priority() is Priority.CONSENSUS_COMMIT
+
+    def test_submit_uses_context_priority(self, sched):
+        stub = StubDispatch(block_first=True)
+        sched._dispatch_curve = stub
+        blocker = _occupy(sched, stub)
+        before = (
+            tmtrace.DEVICE.snapshot()["scheduler"]["classes"]
+            .get("lite", {})
+            .get("submitted", 0)
+        )
+        with priority_scope(Priority.LITE):
+            fut = sched.submit_sync("ed25519", *mk(b"tagged"))
+        stub.gate.set()
+        assert fut.result(5) == [True]
+        blocker.result(5)
+        after = tmtrace.DEVICE.snapshot()["scheduler"]["classes"]["lite"][
+            "submitted"
+        ]
+        assert after == before + 1
+
+
+class TestBreaker:
+    def test_scheduler_owns_its_breaker(self):
+        a = DeviceScheduler()
+        b = DeviceScheduler()
+        try:
+            assert a.breaker is not b.breaker
+            a.breaker.trip()
+            assert not a.breaker.allow()
+            assert b.breaker.allow()
+        finally:
+            a.breaker.reset()
+            a.shutdown()
+            b.shutdown()
+
+    def test_active_breaker_prefers_dispatching_scheduler(self):
+        s = DeviceScheduler()
+        seen = {}
+
+        def probe(curve, pubs, msgs, sigs):
+            seen["breaker"] = active_breaker()
+            return [True] * len(pubs)
+
+        s._dispatch_curve = probe
+        try:
+            assert s.submit_sync("ed25519", *mk(b"x")).result(5) == [True]
+            assert seen["breaker"] is s.breaker
+        finally:
+            s.shutdown()
+        # outside any dispatch, the process singleton's breaker rules
+        assert active_breaker() is get_scheduler().breaker
+
+
+class TestOpsIntegration:
+    """Routing through the real ops stack (skips without crypto/jax)."""
+
+    def _ops(self):
+        return pytest.importorskip(
+            "tendermint_tpu.ops", reason="crypto/jax stack unavailable"
+        )
+
+    def test_small_batch_routes_inline_to_host_path(self, monkeypatch):
+        ops = self._ops()
+        calls = {"small": 0}
+
+        def fake_small(pubs, msgs, sigs):
+            calls["small"] += 1
+            return [True] * len(pubs)
+
+        monkeypatch.delenv("TMTPU_MIN_DEVICE_BATCH", raising=False)
+        monkeypatch.setattr(ops, "_min_batch_probed", 64)
+        monkeypatch.setattr(ops, "_ed25519_small", fake_small)
+        before = tmtrace.DEVICE.snapshot()["scheduler"]["classes"].get(
+            "fastsync", {}
+        ).get("submitted", 0)
+        with priority_scope(Priority.FASTSYNC):
+            out = get_scheduler().verify(
+                "ed25519", [b"\x00" * 32] * 8, [b"m"] * 8, [b"\x00" * 64] * 8
+            )
+        assert out == [True] * 8
+        assert calls["small"] == 1  # inline, never queued
+        after = tmtrace.DEVICE.snapshot()["scheduler"]["classes"]["fastsync"][
+            "submitted"
+        ]
+        assert after == before + 1
+
+    def test_breaker_trip_drains_queue_via_cpu_fallback(self, monkeypatch):
+        self._ops()
+        pytest.importorskip(
+            "tendermint_tpu.ops.ed25519_batch",
+            reason="crypto/jax stack unavailable",
+        )
+        from tendermint_tpu.utils import make_sig_batch
+
+        pubs, msgs, sigs = make_sig_batch(8, msg_prefix=b"sched-breaker ")
+        s = DeviceScheduler()
+        s.breaker.trip()
+        try:
+            before = tmtrace.DEVICE.snapshot()["fallback_reasons"].get(
+                "breaker_open", 0
+            )
+            ok = s.submit_sync("ed25519", pubs, msgs, sigs).result(60)
+            assert ok == [True] * 8
+            bad = s.submit_sync(
+                "ed25519", pubs, msgs, [b"\x00" * 64] * 8
+            ).result(60)
+            assert bad == [False] * 8
+            after = tmtrace.DEVICE.snapshot()["fallback_reasons"][
+                "breaker_open"
+            ]
+            assert after >= before + 2
+        finally:
+            s.breaker.reset()
+            s.shutdown()
+
+    def test_crypto_batch_backend_routes_through_scheduler(self, monkeypatch):
+        ops = self._ops()
+        edb = pytest.importorskip(
+            "tendermint_tpu.ops.ed25519_batch",
+            reason="crypto/jax stack unavailable",
+        )
+        from tendermint_tpu.utils import make_sig_batch
+
+        monkeypatch.delenv("TMTPU_MIN_DEVICE_BATCH", raising=False)
+        monkeypatch.setattr(ops, "_min_batch_probed", 4)
+        seen = {}
+
+        def fake_device(pubs, msgs, sigs):
+            seen["in_dispatch"] = __import__(
+                "tendermint_tpu.device.scheduler", fromlist=["in_dispatch"]
+            ).in_dispatch()
+            return [True] * len(pubs)
+
+        monkeypatch.setattr(edb, "verify_batch", fake_device)
+        pubs, msgs, sigs = make_sig_batch(8, msg_prefix=b"via-backend ")
+        assert ops._ed25519_backend(pubs, msgs, sigs) == [True] * 8
+        # the fake ran on the scheduler's dispatcher, not the caller
+        assert seen["in_dispatch"] is True
+
+
+class TestMetricsSeries:
+    def test_device_metrics_exposes_scheduler_series(self):
+        from tendermint_tpu.libs import metrics as tmm
+
+        c = tmm.Collector()
+        dm = tmm.DeviceMetrics(c)
+        dm.sched_queue_depth.set(3, **{"class": "consensus_commit"})
+        dm.sched_queue_wait.observe("mempool_recheck", 0.02)
+        dm.sched_packed.observe(4)
+        dm.sched_preempted_total.inc(**{"class": "lite"})
+        text = c.render()
+        assert 'tendermint_device_queue_depth{class="consensus_commit"} 3' in text
+        assert (
+            'tendermint_device_queue_wait_seconds_bucket'
+            '{class="mempool_recheck",le="0.05"} 1' in text
+        )
+        assert 'tendermint_device_queue_wait_seconds_count{class="mempool_recheck"} 1' in text
+        assert "tendermint_device_packed_requests_per_batch_sum 4" in text
+        assert 'tendermint_device_preempted_total{class="lite"} 1' in text
+
+    def test_histogram_vec_renders_one_family_head(self):
+        from tendermint_tpu.libs import metrics as tmm
+
+        c = tmm.Collector("t")
+        v = c.histogram_vec("s", "h", "help text", "class", [1, 2])
+        v.observe("a", 0.5)
+        v.observe("b", 3.0)
+        lines = c.render().splitlines()
+        assert lines.count("# TYPE t_s_h histogram") == 1
+        assert 't_s_h_bucket{class="a",le="1"} 1' in lines
+        assert 't_s_h_bucket{class="b",le="+Inf"} 1' in lines
+        assert 't_s_h_sum{class="b"} 3' in lines
+
+    def test_telemetry_mirrors_scheduler_records(self):
+        from tendermint_tpu.libs import metrics as tmm
+
+        dt = tmtrace.DeviceTelemetry()
+        c = tmm.Collector()
+        dm = tmm.DeviceMetrics(c)
+        dt.set_metrics(dm)
+        dt.record_sched_submit("fastsync", 2)
+        dt.record_sched_dispatch("fastsync", 0.03, 1)
+        dt.record_sched_pack(3)
+        dt.record_sched_preempt("mempool_recheck")
+        snap = dt.snapshot()["scheduler"]
+        assert snap["classes"]["fastsync"]["submitted"] == 1
+        assert snap["classes"]["fastsync"]["dispatched"] == 1
+        assert snap["classes"]["fastsync"]["wait_s_max"] >= 0.03
+        assert snap["classes"]["mempool_recheck"]["preempted"] == 1
+        assert snap["packing"] == {
+            "batches": 1, "requests": 3, "max_packed": 3, "avg_packed": 3.0
+        }
+        text = c.render()
+        assert 'tendermint_device_queue_depth{class="fastsync"} 1' in text
+        assert 'tendermint_device_preempted_total{class="mempool_recheck"} 1' in text

@@ -539,6 +539,78 @@ def test_tm401_unnamed_thread_flagged():
     ) == ["TM401"]
 
 
+# --- TM501 direct-device-verify (ISSUE 8) ----------------------------------
+
+
+def test_tm501_fires_on_direct_attribute_call():
+    assert codes(
+        """
+        from tendermint_tpu.ops import ed25519_batch
+        def hot(pubs, msgs, sigs):
+            return ed25519_batch.verify_batch(pubs, msgs, sigs)
+        """
+    ) == ["TM501"]
+
+
+def test_tm501_fires_on_fully_dotted_secp_call():
+    assert codes(
+        """
+        import tendermint_tpu.ops.secp_batch
+        def hot(p, m, s):
+            return tendermint_tpu.ops.secp_batch.verify_batch(p, m, s)
+        """
+    ) == ["TM501"]
+
+
+def test_tm501_fires_on_from_import():
+    assert codes(
+        """
+        from tendermint_tpu.ops.ed25519_batch import verify_batch
+        """
+    ) == ["TM501"]
+
+
+def test_tm501_clean_inside_device_and_curve_modules():
+    src = """
+    from tendermint_tpu.ops import ed25519_batch
+    def dispatch(pubs, msgs, sigs):
+        return ed25519_batch.verify_batch(pubs, msgs, sigs)
+    """
+    assert codes(src, "tendermint_tpu/device/scheduler.py") == []
+    assert codes(src, "tendermint_tpu/ops/ed25519_batch.py") == []
+    assert codes(src, "tendermint_tpu/ops/secp_batch.py") == []
+
+
+def test_tm501_clean_on_scheduler_submission():
+    assert (
+        codes(
+            """
+            from tendermint_tpu.device import get_scheduler
+            def hot(pubs, msgs, sigs):
+                return get_scheduler().verify("ed25519", pubs, msgs, sigs)
+            """
+        )
+        == []
+    )
+
+
+def test_tm501_clean_on_other_verify_batch_receivers():
+    # crypto.batch.verify_batch (the BatchVerifier convenience wrapper)
+    # and unrelated objects with a verify_batch attr are not the device
+    # entry points
+    assert (
+        codes(
+            """
+            from tendermint_tpu.crypto import batch
+            def f(triples, native):
+                batch.verify_batch(triples)
+                native.verify_batch([], [], [])
+            """
+        )
+        == []
+    )
+
+
 # --- flight-recorder taps in rule scopes (libs/recorder, ISSUE 5) ----------
 
 
