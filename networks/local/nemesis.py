@@ -40,6 +40,24 @@ Scenarios (catalogue with invariants: docs/nemesis.md):
                             commit/replay; restart and verify (parity
                             with reference test/persist/
                             test_failure_indices.sh, networked).
+  nemesis_peer_garbage_storm — a real p2p client spews malformed frames
+                            on three reactor channels; the victim must
+                            BAN it (trust score below threshold) within
+                            a bounded window, keep it banned across
+                            redials, and keep committing.
+  nemesis_torn_wal        — SIGKILL a node, tear its WAL tail mid-frame;
+                            restart must auto-repair (.corrupt sidecar),
+                            replay, and re-converge with app-hash
+                            agreement.
+  nemesis_evidence_restart — evidence pending in a partitioned node's
+                            pool must survive that node's restart and
+                            still land COMMITTED on every node.
+  nemesis_valset_churn    — the validator set changes while a node is
+                            blackholed; after healing it must catch up
+                            to the new set with zero divergence.
+  nemesis_combined        — partition + flapping device breaker +
+                            mempool flood at once; the chain keeps
+                            committing and health tells the truth.
 
 Usage:
   python -m networks.local.nemesis                 # fast scenarios
@@ -156,6 +174,12 @@ class Nemesis:
         h = self.net.rpc(i, "health", timeout=10.0)
         assert h is not None, f"health failed on node{i}"
         return h
+
+    def debug_p2p(self, i: int) -> dict:
+        """Peer-quality snapshot: trust scores, live bans, dialer state."""
+        d = self.net.rpc(i, "debug_p2p", timeout=10.0)
+        assert d is not None, f"debug_p2p failed on node{i}"
+        return d
 
     def assert_no_crashes(self, nodes=None) -> None:
         """The ISSUE 7 standing invariant: tm_runtime_task_crashes_total
@@ -556,6 +580,457 @@ def scenario_crash_sweep(net: ProcTestnet) -> None:
 scenario_crash_sweep.self_start = True
 
 
+async def _garbage_storm_client(
+    host: str, port: int, node_id: str, network: str,
+    sessions: int = 6, frames_per_channel: int = 3,
+) -> dict:
+    """A REAL p2p client (full SecretConnection + NodeInfo handshake,
+    same node key every time) that sends undecodable frames on three
+    reactor channels — consensus votes (0x22), mempool (0x30), evidence
+    (0x38) — then redials after every disconnect. Returns client-side
+    stats; the victim-side truth is read over debug_p2p."""
+    import asyncio
+
+    from tendermint_tpu.crypto import ed25519
+    from tendermint_tpu.p2p.base_reactor import ChannelDescriptor
+    from tendermint_tpu.p2p.conn.connection import MConnection
+    from tendermint_tpu.p2p.key import NodeKey
+    from tendermint_tpu.p2p.netaddress import NetAddress
+    from tendermint_tpu.p2p.node_info import NodeInfo
+    from tendermint_tpu.p2p.transport import Transport
+
+    channels = [0x22, 0x30, 0x38]
+    key = NodeKey(ed25519.gen_priv_key())
+    ni = NodeInfo(
+        node_id=key.id(), listen_addr="127.0.0.1:0", network=network,
+        version="tendermint-tpu/0.1", channels=bytes(channels),
+        moniker="garbage-storm",
+    )
+    transport = Transport(key, ni)
+    stats = {"id": key.id(), "connects": 0, "dial_failures": 0, "frames": 0}
+    addr = NetAddress(node_id, host, port)
+    for _ in range(sessions):
+        try:
+            conn, _rni = await asyncio.wait_for(transport.dial(addr), 10.0)
+        except Exception:
+            # dial refused / conn cut during handshake — the banned case
+            # closes the socket right after accept
+            stats["dial_failures"] += 1
+            await asyncio.sleep(0.5)
+            continue
+        stats["connects"] += 1
+        closed = asyncio.Event()
+
+        async def _recv(ch_id, msg):
+            pass
+
+        async def _err(e, _closed=closed):
+            _closed.set()
+
+        mconn = MConnection(
+            conn, [ChannelDescriptor(c) for c in channels], _recv, _err
+        )
+        await mconn.start()
+        try:
+            for ch in channels:
+                for _ in range(frames_per_channel):
+                    if await mconn.send(ch, b"\xde\xad\xbe\xef" * 16):
+                        stats["frames"] += 1
+            # the victim cuts a misbehaving peer off; wait for it
+            try:
+                await asyncio.wait_for(closed.wait(), 10.0)
+            except asyncio.TimeoutError:
+                pass
+        finally:
+            await mconn.stop()
+        await asyncio.sleep(0.5)
+    return stats
+
+
+def scenario_peer_garbage_storm(net: ProcTestnet) -> None:
+    """(h) Behaviour-scored banning end to end (docs/p2p_resilience.md): a
+    peer spewing malformed frames on THREE reactor channels must be banned
+    within a bounded window (trust score below threshold, `peer_banned`
+    recorder event, live tm_p2p_peer_bans_total series), stay banned
+    across its redial attempts (`banned_reject` events), and the honest
+    chain must keep committing with clean fleet invariants."""
+    import asyncio
+
+    mports = enable_prometheus(net)
+    net.start_all()
+    net.wait_all(2)
+    nem = Nemesis(net)
+    victim = 0
+    st = net.rpc(victim, "status")
+    assert st is not None, "status failed"
+    network = st["node_info"]["network"]
+    vid = st["node_info"]["node_id"]
+    p2p_port = net.base_port + 2 * victim  # testnet CLI layout: p2p, rpc
+
+    stats = asyncio.run(
+        _garbage_storm_client("127.0.0.1", p2p_port, vid, network)
+    )
+    assert stats["frames"] >= 3, f"garbage client sent too little: {stats}"
+
+    # victim-side truth: the garbage peer is banned, its trust score is
+    # below the threshold, and redials were rejected while banned
+    deadline = time.monotonic() + 30
+    d = {}
+    while time.monotonic() < deadline:
+        d = nem.debug_p2p(victim)
+        if any(b["id"] == stats["id"] for b in d["bans"]):
+            break
+        time.sleep(0.5)
+    else:
+        raise AssertionError(f"garbage peer never banned: {d} / client {stats}")
+    assert d["trust"].get(stats["id"], 100) < d["ban_threshold"], d["trust"]
+    assert all(p["id"] != stats["id"] for p in d["peers"]), d["peers"]
+    kinds = nem.recorder_kinds(victim, "p2p")
+    assert ("p2p", "behaviour") in kinds, kinds
+    assert ("p2p", "peer_banned") in kinds, kinds
+    rejects = [
+        e for e in nem.recorder_events(victim, "p2p")
+        if e["kind"] == "banned_reject"
+        and e.get("fields", {}).get("peer") == stats["id"]
+    ]
+    assert rejects, "no banned_reject: the ban did not survive redials"
+
+    # the chain kept committing through the storm, and the ban series is live
+    base = max(net.height(i) or 2 for i in range(net.n))
+    net.wait_all(base + 2)
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{mports[victim]}/metrics", timeout=5
+    ) as r:
+        text = r.read().decode()
+    bans_line = [
+        ln for ln in text.splitlines()
+        if ln.startswith("tendermint_p2p_peer_bans_total")
+    ]
+    assert bans_line and float(bans_line[0].rsplit(" ", 1)[1]) >= 1, bans_line
+    nem.assert_agreement(base + 1)
+    nem.assert_no_crashes()
+    report = nem.fleet_report()
+    assert not report["violations"], report["violations"]
+    print(
+        f"nemesis_peer_garbage_storm: peer {stats['id'][:12]} banned after "
+        f"{stats['frames']} garbage frames ({len(rejects)} redials rejected), "
+        f"chain advanced to {base + 2}+, fleet invariants clean"
+    )
+
+
+scenario_peer_garbage_storm.self_start = True
+
+
+def scenario_torn_wal(net: ProcTestnet) -> None:
+    """(i) Restart durability, WAL half (ROADMAP item 5 residue): SIGKILL
+    a validator, tear its WAL tail mid-frame (a frame header promising
+    more payload than exists — the classic died-mid-fsync artifact), and
+    restart. The node must auto-repair at open (recorder `wal repair`
+    event, torn bytes preserved in a .corrupt sidecar), replay, rejoin
+    consensus, and re-converge with app-hash agreement."""
+    import glob
+    import struct as _struct
+
+    net.start_all()
+    net.wait_all(3)
+    nem = Nemesis(net)
+    victim = 0
+    net.kill(victim)  # SIGKILL: whatever was in flight stays as-is
+
+    wal_path = os.path.join(net.home(victim), "data", "cs.wal", "wal")
+    assert os.path.exists(wal_path), wal_path
+    torn = _struct.pack(">II", 0xDEADBEEF, 512) + b"\x00" * 100
+    with open(wal_path, "ab") as f:
+        f.write(torn)  # header claims 512 payload bytes; 100 present
+    size_before = os.path.getsize(wal_path)
+
+    net.start(victim)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if ("wal", "repair") in nem.recorder_kinds(victim, "wal"):
+            break
+        time.sleep(0.5)
+    else:
+        raise AssertionError("no wal repair event after restart with torn tail")
+    sidecars = glob.glob(wal_path + ".corrupt*")
+    assert sidecars, "torn bytes were not preserved in a .corrupt sidecar"
+    preserved = b"".join(open(p, "rb").read() for p in sorted(sidecars))
+    assert torn in preserved, "sidecar does not contain the torn tail"
+    assert os.path.getsize(wal_path) <= size_before - len(torn), (
+        "WAL head was not truncated to the last clean frame"
+    )
+
+    # the repaired node replays and re-converges with the fleet
+    target = max(net.height(i) or 3 for i in range(1, net.n)) + 2
+    got = net.wait_height(victim, target, timeout=180.0)
+    nem.assert_agreement(target - 1)
+    h = nem.health(victim)
+    assert h["ready"] is True and h["task_crashes"] == 0, h
+    nem.assert_no_crashes()
+    print(
+        f"nemesis_torn_wal: WAL auto-repaired ({len(sidecars)} sidecar(s)), "
+        f"node replayed and re-converged to {got} with app-hash agreement"
+    )
+
+
+scenario_torn_wal.self_start = True
+
+
+def scenario_evidence_restart(net: ProcTestnet) -> None:
+    """(j) Restart durability, evidence half (ROADMAP item 5 residue):
+    DuplicateVoteEvidence is injected into a PARTITIONED node's pool (it
+    cannot gossip out or commit — the evidence is pending in that pool
+    and nowhere else), the node is SIGKILLed and restarted, and the
+    evidence must still land COMMITTED in a block on every node — proof
+    that pending evidence survives the restart through libs/db."""
+    configure_nodes(net, _enable_fault_control)
+    net.start_all()
+    net.wait_all(3)
+    nem = Nemesis(net)
+    victim = 0
+
+    # partition FIRST: the evidence must exist only in the victim's pool
+    nem.isolate(victim)
+
+    # craft real evidence: node1's validator key double-signing height 2
+    # (the driver holds every testnet key, exactly like Byzantine hardware)
+    from tendermint_tpu.privval import FilePVKey
+    from tendermint_tpu.types import BlockID, PartSetHeader
+    from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+    from tendermint_tpu.types.vote import Vote, VoteType, now_ns
+
+    key = FilePVKey.load(
+        os.path.join(net.home(1), "config", "priv_validator_key.json")
+    )
+    gen = net.rpc(victim, "genesis", timeout=10.0)
+    assert gen is not None, "genesis RPC failed"
+    chain_id = gen["genesis"]["chain_id"]
+    vals = net.rpc(victim, "validators?height=2", timeout=10.0)
+    assert vals is not None, "validators RPC failed"
+    val_index = next(
+        i for i, v in enumerate(vals["validators"])
+        if v["address"] == key.address.hex()
+    )
+    ts = now_ns()
+    votes = []
+    for seed in (b"equivocation-a", b"equivocation-b"):
+        import hashlib
+
+        h = hashlib.sha256(seed).digest()
+        bid = BlockID(h, PartSetHeader(1, hashlib.sha256(h).digest()))
+        v = Vote(VoteType.PRECOMMIT, 2, 0, bid, ts, key.address, val_index)
+        votes.append(v.with_signature(key.priv_key.sign(v.sign_bytes(chain_id))))
+    ev = DuplicateVoteEvidence(key.pub_key, votes[0], votes[1])
+
+    res = net.rpc(
+        victim, f"broadcast_evidence?evidence={ev.encode().hex()}", timeout=10.0
+    )
+    assert res is not None and res.get("hash"), f"broadcast_evidence: {res}"
+    kinds = nem.recorder_kinds(victim, "evidence")
+    assert ("evidence", "added") in kinds, kinds
+
+    # restart the only holder of the pending evidence
+    net.kill(victim)
+    for i in range(1, net.n):
+        nem.fault(i, "heal")  # unblackhole the victim's id on the others
+    net.start(victim)
+
+    # restored from the DB...
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if ("evidence", "restored") in nem.recorder_kinds(victim, "evidence"):
+            break
+        time.sleep(0.5)
+    else:
+        raise AssertionError("evidence pool did not restore pending evidence")
+
+    # ...and still COMMITTED in a block on every node
+    ev_height = None
+    scanned = 0
+    deadline = time.monotonic() + 150
+    while ev_height is None and time.monotonic() < deadline:
+        top = net.height(1) or 1
+        h = scanned + 1
+        while h <= top:
+            r = net.rpc(1, f"block?height={h}", timeout=5.0)
+            if r is None:
+                break
+            if r["block"]["evidence"]:
+                ev_height = h
+                break
+            scanned = h
+            h += 1
+        if ev_height is None:
+            time.sleep(1.0)
+    assert ev_height is not None, (
+        "evidence pending before the restart was never committed after it"
+    )
+    for i in range(net.n):
+        net.wait_height(i, ev_height)
+        r = net.rpc(i, f"block?height={ev_height}", timeout=5.0)
+        assert r is not None and r["block"]["evidence"], (i, ev_height)
+    nem.assert_agreement(ev_height)
+    nem.assert_no_crashes()
+    print(
+        f"nemesis_evidence_restart: evidence survived node{victim}'s restart "
+        f"and committed at height {ev_height} on all {net.n} nodes"
+    )
+
+
+scenario_evidence_restart.self_start = True
+
+
+def scenario_valset_churn(net: ProcTestnet) -> None:
+    """(k) Validator-set churn under partition (ROADMAP item 5 residue):
+    while one validator is blackholed, the rest commit a validator-update
+    tx REMOVING it from the set (persistent_kvstore `val:` txs). After
+    healing, the removed node must catch up to the new, smaller set —
+    following a chain it no longer votes on — with zero block/app-hash
+    divergence."""
+
+    def mutate(i: int, cfg: dict) -> None:
+        cfg["base"]["proxy_app"] = (
+            f"persistent_kvstore:{os.path.join(net.home(i), 'data', 'kvstore')}"
+        )
+        _enable_fault_control(i, cfg)
+
+    configure_nodes(net, mutate)
+    net.start_all()
+    net.wait_all(3)
+    nem = Nemesis(net)
+    victim = net.n - 1
+
+    from tendermint_tpu import crypto
+    from tendermint_tpu.privval import FilePVKey
+
+    key = FilePVKey.load(
+        os.path.join(net.home(victim), "config", "priv_validator_key.json")
+    )
+    encoded_pk = crypto.encode_pubkey(key.pub_key).hex()
+
+    nem.isolate(victim)
+    h_cut = net.height(victim) or 3
+
+    # remove the partitioned validator: total power 4 -> 3, the 3 live
+    # validators still clear 2/3 both before and after the update
+    tx = "0x" + f"val:{encoded_pk}!0".encode().hex()
+    res = net.rpc(0, f"broadcast_tx_commit?tx={tx}", timeout=30.0)
+    assert res is not None and res.get("deliver_tx", {}).get("code", 1) == 0, res
+
+    deadline = time.monotonic() + 60
+    n_vals = net.n
+    while time.monotonic() < deadline:
+        vr = net.rpc(0, "validators", timeout=5.0)
+        if vr is not None:
+            n_vals = len(vr["validators"])
+            if n_vals == net.n - 1:
+                break
+        time.sleep(0.5)
+    assert n_vals == net.n - 1, f"validator set never shrank: {n_vals}"
+
+    base = max(net.height(i) or 3 for i in range(net.n - 1))
+    for i in range(net.n - 1):
+        net.wait_height(i, base + 3)
+
+    nem.heal_all()
+    head = max(net.height(i) or base for i in range(net.n - 1))
+    got = net.wait_height(victim, head, timeout=180.0)
+    # the churned node followed the new set: identical blocks + app hashes
+    for probe in (max(1, h_cut - 1), int(res["height"]) + 1, head):
+        nem.assert_agreement(probe)
+    vr = net.rpc(victim, "validators", timeout=5.0)
+    assert vr is not None and len(vr["validators"]) == net.n - 1, vr
+    nem.assert_no_crashes()
+    print(
+        f"nemesis_valset_churn: validator removed at height {res['height']} "
+        f"while partitioned; victim caught up to {got} on the new "
+        f"{net.n - 1}-validator set with zero divergence"
+    )
+
+
+scenario_valset_churn.self_start = True
+
+
+def scenario_combined(net: ProcTestnet) -> None:
+    """(l) Combined faults (ROADMAP item 5 residue): a partition, a
+    flapping device breaker, and a mempool flood hit SIMULTANEOUSLY. The
+    majority chain must keep committing, health must name exactly the
+    true degraded reasons (breaker open on the tripped node, nothing
+    false elsewhere), and after healing everything drains and converges."""
+    enable_prometheus(net)  # parity with production-style runs
+    configure_nodes(net, _enable_fault_control)
+    net.start_all()
+    net.wait_all(2)
+    nem = Nemesis(net)
+    part_victim = net.n - 1
+    breaker_victim = 0
+    rest = [i for i in range(net.n) if i != part_victim]
+
+    # all three faults at once
+    nem.isolate(part_victim)
+    res = nem.trip_breaker(breaker_victim)
+    assert res["breaker"].get("tripped") is True, res
+    keys: list[str] = []
+    for wave in range(3):
+        for k in range(40):
+            keyname = f"cb{os.getpid()}w{wave}k{k}"
+            tx = "0x" + f"{keyname}=v".encode().hex()
+            i = rest[k % len(rest)]
+            r = net.rpc(i, f"broadcast_tx_async?tx={tx}", timeout=10.0)
+            assert r is not None, f"flood tx failed on node{i}"
+            keys.append(keyname)
+        time.sleep(0.3)
+
+    # chain keeps committing THROUGH the combined faults
+    base = max(net.height(i) or 2 for i in rest)
+    for i in rest:
+        net.wait_height(i, base + 2, timeout=180.0)
+
+    # health tells the truth mid-fault: breaker reason on the tripped
+    # node, no fabricated reasons anywhere else
+    h = nem.health(breaker_victim)
+    assert "device_breaker_open" in h["degraded"], h
+    for i in rest:
+        if i == breaker_victim:
+            continue
+        h = nem.health(i)
+        assert h["status"] == "ok" and not h["degraded"], (i, h)
+
+    # heal everything; the net must fully recover
+    nem.reset_breaker(breaker_victim)
+    nem.heal_all()
+    h = nem.health(breaker_victim)
+    assert "device_breaker_open" not in h["degraded"], h
+
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        sizes = [
+            (net.rpc(i, "num_unconfirmed_txs") or {}).get("n_txs", -1)
+            for i in rest
+        ]
+        if all(s == 0 for s in sizes):
+            break
+        time.sleep(1.0)
+    else:
+        raise AssertionError(f"mempools never drained after heal: {sizes}")
+    head = max(net.height(i) or base for i in rest)
+    net.wait_height(part_victim, head, timeout=180.0)
+    nem.assert_agreement(max(1, head - 1))
+    kinds = nem.recorder_kinds(part_victim, "fault")
+    assert ("fault", "partition") in kinds and ("fault", "heal") in kinds, kinds
+    kinds = nem.recorder_kinds(breaker_victim, "device")
+    assert ("device", "breaker") in kinds, kinds
+    nem.assert_no_crashes()
+    print(
+        f"nemesis_combined: partition + open breaker + {len(keys)}-tx flood "
+        f"ran simultaneously; chain advanced to {base + 2}+, health truthful, "
+        f"full recovery after heal"
+    )
+
+
+scenario_combined.self_start = True
+
+
 SCENARIOS = {
     "nemesis_byzantine": scenario_byzantine,
     "nemesis_partition": scenario_partition,
@@ -564,11 +1039,21 @@ SCENARIOS = {
     "nemesis_flapping_device": scenario_flapping_device,
     "nemesis_sched_priority": scenario_sched_priority,
     "nemesis_crash_sweep": scenario_crash_sweep,
+    "nemesis_peer_garbage_storm": scenario_peer_garbage_storm,
+    "nemesis_torn_wal": scenario_torn_wal,
+    "nemesis_evidence_restart": scenario_evidence_restart,
+    "nemesis_valset_churn": scenario_valset_churn,
+    "nemesis_combined": scenario_combined,
 }
 
 # the sub-10-minute set the CI nemesis job and tier-1 wrappers draw from
 FAST = ["nemesis_byzantine", "nemesis_partition", "nemesis_delay_proposer",
-        "nemesis_flood", "nemesis_flapping_device", "nemesis_sched_priority"]
+        "nemesis_flood", "nemesis_flapping_device", "nemesis_sched_priority",
+        "nemesis_peer_garbage_storm"]
+
+# the restart-durability + residue set: nightly CI runs these after FAST
+DURABILITY = ["nemesis_torn_wal", "nemesis_evidence_restart",
+              "nemesis_valset_churn", "nemesis_combined"]
 
 
 def run(names=None, n: int = 4) -> None:

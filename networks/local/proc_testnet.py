@@ -662,6 +662,19 @@ def run(names=None, n: int = 4) -> None:
                 shutil.copy(os.path.join(net.root, "fleet_report.json"), keep)
             except OSError:
                 pass
+            # WAL .corrupt sidecars (auto-repair evidence) ride with the
+            # failure artifacts too — a repaired-then-still-failed run is
+            # undiagnosable without the torn bytes
+            import glob as _glob
+
+            for src in _glob.glob(
+                os.path.join(net.root, "node*", "data", "cs.wal", "*.corrupt*")
+            ):
+                rel = os.path.relpath(src, net.root).replace(os.sep, "_")
+                try:
+                    shutil.copy(src, os.path.join(keep, rel))
+                except OSError:
+                    pass
             for i in range(net.n):
                 src = os.path.join(net.root, f"node{i}.log")
                 try:

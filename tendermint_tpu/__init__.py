@@ -1,0 +1,10 @@
+"""tendermint-tpu: TPU-native BFT state-machine replication.
+
+Importing any submodule runs the Python 3.10 compatibility shims first
+(_pycompat installs an ``asyncio.timeout`` backport on interpreters that
+predate it) so the 3.11 asyncio idiom used throughout the codebase works
+everywhere ``requires-python`` allows.
+"""
+from tendermint_tpu import _pycompat
+
+_pycompat.install()

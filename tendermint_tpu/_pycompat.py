@@ -1,0 +1,95 @@
+"""Python 3.10 compatibility backports.
+
+The codebase is written against the Python 3.11 asyncio idiom —
+``async with asyncio.timeout(t): ...`` — across the RPC server/client,
+tools, proc-testnet scenarios, and the test suite, but pyproject declares
+``requires-python = ">=3.10"`` and some containers run 3.10, where
+``asyncio.timeout`` does not exist: every node-level call site died with
+``AttributeError: module 'asyncio' has no attribute 'timeout'``.
+
+``install()`` (called from the package ``__init__``) backports it onto
+the asyncio module so every call site — library code, tests, and
+subprocess-spawned nodes — keeps the 3.11 spelling. On 3.11+ it is a
+no-op.
+
+The backport raises ``_CompatTimeoutError``, which subclasses BOTH the
+builtin ``TimeoutError`` and ``asyncio.TimeoutError``: on 3.10 those are
+disjoint types (unified only in 3.11), and call sites here catch
+sometimes one, sometimes the other.
+"""
+from __future__ import annotations
+
+import asyncio
+
+
+class _CompatTimeoutError(TimeoutError, asyncio.TimeoutError):
+    pass
+
+
+class _Timeout:
+    """Minimal ``asyncio.timeout`` semantics: cancel the enclosing task
+    when the deadline passes, convert that cancellation into a
+    TimeoutError at the context boundary.
+
+    External-cancel discipline (the uncancel()-counting behaviour of the
+    real 3.11 implementation, approximated): the deadline callback
+    REFUSES to claim expiry when the task already has a cancellation
+    pending — an external cancel (service stop) that arrived first
+    always propagates as CancelledError, never resurrected into a
+    TimeoutError handler. Once expiry IS claimed, the resulting
+    CancelledError is converted whether or not it still carries our
+    sentinel message: cancellation crossing a task boundary (a timed-out
+    body awaiting `gather(...)` or a child task) arrives with empty args
+    on 3.10, and must still surface as TimeoutError."""
+
+    _SENTINEL = "tendermint_tpu._pycompat.timeout"
+
+    def __init__(self, delay: float | None) -> None:
+        self._delay = delay
+        self._expired = False
+        self._handle = None
+        self._task = None
+
+    async def __aenter__(self) -> "_Timeout":
+        if self._delay is not None:
+            loop = asyncio.get_running_loop()
+            self._task = asyncio.current_task()
+            self._handle = loop.call_later(self._delay, self._on_timeout)
+        return self
+
+    def _cancel_pending(self) -> bool:
+        """True when the task already has a cancellation in flight that
+        is NOT ours (3.10 internals: an undelivered `_must_cancel`, or a
+        cancelled future the task is awaiting)."""
+        t = self._task
+        if getattr(t, "_must_cancel", False):
+            return True
+        fw = getattr(t, "_fut_waiter", None)
+        return fw is not None and fw.cancelled()
+
+    def _on_timeout(self) -> None:
+        if self._task is None or self._task.done() or self._cancel_pending():
+            return
+        self._expired = True
+        self._task.cancel(self._SENTINEL)
+
+    async def __aexit__(self, exc_type, exc, tb):
+        if self._handle is not None:
+            self._handle.cancel()
+            self._handle = None
+        if (
+            self._expired
+            and exc_type is asyncio.CancelledError
+            and (not exc.args or exc.args[0] == self._SENTINEL)
+        ):
+            raise _CompatTimeoutError() from exc
+        return False
+
+
+def _timeout(delay: float | None) -> _Timeout:
+    return _Timeout(delay)
+
+
+def install() -> None:
+    if not hasattr(asyncio, "timeout"):
+        asyncio.timeout = _timeout  # type: ignore[attr-defined]

@@ -3,8 +3,22 @@
 Reference parity: behaviour/peer_behaviour.go + reporter.go — reactors
 report good/bad peer behaviours through an interface instead of calling
 Switch.StopPeerForError directly, decoupling protocol logic from peer
-management. The SwitchReporter forwards errors to the switch; the
+management. The SwitchReporter forwards behaviours to the switch; the
 MockReporter records for tests.
+
+Beyond the reference: every behaviour carries a trust weight, and the
+switch feeds each report into the peer's `p2p/trust.py` metric — the
+score the ban/accept/dial decisions consult (docs/p2p_resilience.md).
+Three independent axes per behaviour:
+
+- `is_error`   — protocol violation worth disconnecting for NOW
+                 (the reference's SwitchReporter semantics);
+- `is_bad`     — counts AGAINST the trust score (every error is bad,
+                 but e.g. unverifiable evidence is bad-not-error:
+                 plausibly height skew, not malice — reject the message,
+                 keep the peer, remember the smell);
+- `weight`     — how much this one event moves the metric (a fabricated
+                 block weighs more than a spammy invalid tx).
 """
 from __future__ import annotations
 
@@ -16,15 +30,50 @@ class PeerBehaviour:
     peer_id: str
     reason: str
     is_error: bool
+    # trust-metric input: weight of the event, and whether it counts as
+    # bad. `bad=None` means "bad iff is_error" (the common case).
+    weight: float = 1.0
+    bad: bool | None = None
 
-    # constructors matching the reference's behaviour vocabulary
+    @property
+    def is_bad(self) -> bool:
+        return self.is_error if self.bad is None else self.bad
+
+    # -- bad behaviours (reference vocabulary + our misbehaviour sources) --
+
     @classmethod
     def bad_message(cls, peer_id: str, explanation: str) -> "PeerBehaviour":
-        return cls(peer_id, f"bad message: {explanation}", True)
+        """Undecodable/invalid frame on any reactor channel."""
+        return cls(peer_id, f"bad message: {explanation}", True, weight=3.0)
 
     @classmethod
     def message_out_of_order(cls, peer_id: str, explanation: str) -> "PeerBehaviour":
-        return cls(peer_id, f"message out of order: {explanation}", True)
+        return cls(peer_id, f"message out of order: {explanation}", True, weight=1.0)
+
+    @classmethod
+    def bad_block(cls, peer_id: str, explanation: str) -> "PeerBehaviour":
+        """Fast-sync block whose commit failed verification at the head —
+        the most expensive lie a peer can tell."""
+        return cls(peer_id, f"bad block: {explanation}", True, weight=5.0)
+
+    @classmethod
+    def unverifiable_evidence(cls, peer_id: str, explanation: str) -> "PeerBehaviour":
+        """Evidence we could not verify. Not necessarily Byzantine (height
+        skew makes honest evidence unverifiable here), so: no disconnect,
+        small trust penalty — a peer that ONLY ever sends these decays."""
+        return cls(peer_id, f"unverifiable evidence: {explanation}", False,
+                   weight=0.5, bad=True)
+
+    @classmethod
+    def bad_tx(cls, peer_id: str, explanation: str) -> "PeerBehaviour":
+        """Gossiped tx rejected by CheckTx: spam pressure, not a protocol
+        violation (reference keeps the peer too). Deliberately lighter
+        than good_tx: an honest peer relaying txs that a block commit
+        races into invalidity must never trend toward a ban — only a
+        peer whose traffic is overwhelmingly rejects decays."""
+        return cls(peer_id, f"bad tx: {explanation}", False, weight=0.1, bad=True)
+
+    # -- good behaviours ---------------------------------------------------
 
     @classmethod
     def consensus_vote(cls, peer_id: str, explanation: str = "") -> "PeerBehaviour":
@@ -34,6 +83,10 @@ class PeerBehaviour:
     def block_part(cls, peer_id: str, explanation: str = "") -> "PeerBehaviour":
         return cls(peer_id, f"block part: {explanation}", False)
 
+    @classmethod
+    def good_tx(cls, peer_id: str, explanation: str = "") -> "PeerBehaviour":
+        return cls(peer_id, f"good tx: {explanation}", False, weight=0.2)
+
 
 class Reporter:
     async def report(self, behaviour: PeerBehaviour) -> None:
@@ -41,17 +94,14 @@ class Reporter:
 
 
 class SwitchReporter(Reporter):
-    """Forward error behaviours to the switch (reference reporter.go:17)."""
+    """Forward behaviours to the switch's trust/ban plane (reference
+    reporter.go:17, grown from stop-only to score-and-ban)."""
 
     def __init__(self, switch) -> None:
         self.switch = switch
 
     async def report(self, behaviour: PeerBehaviour) -> None:
-        peer = self.switch.peers.get(behaviour.peer_id)
-        if peer is None:
-            return
-        if behaviour.is_error:
-            await self.switch.stop_peer_for_error(peer, behaviour.reason)
+        await self.switch.report_behaviour(behaviour)
 
 
 class MockReporter(Reporter):

@@ -11,6 +11,7 @@ from __future__ import annotations
 import asyncio
 from dataclasses import dataclass
 
+from tendermint_tpu.behaviour import PeerBehaviour
 from tendermint_tpu.blockchain import BlockPool
 from tendermint_tpu.device.priorities import Priority, priority_scope
 from tendermint_tpu.encoding import DecodeError, Reader, Writer
@@ -186,7 +187,9 @@ class BlockchainReactor(BaseReactor):
             msg = decode_bc_message(msg_bytes)
         except Exception as e:
             self.log.error("bad blockchain message", peer=peer.id, err=repr(e))
-            await self.switch.stop_peer_for_error(peer, e)
+            await self.report(
+                peer, PeerBehaviour.bad_message(peer.id, f"blockchain: {e!r}")
+            )
             return
 
         if isinstance(msg, BlockRequestMessage):
@@ -305,13 +308,20 @@ class BlockchainReactor(BaseReactor):
             )
             # disconnect both senders (reference reactor.go poolRoutine
             # StopPeerForError) — pool removal alone lets a Byzantine peer
-            # rejoin on the next status broadcast and stall sync forever
+            # rejoin on the next status broadcast and stall sync forever.
+            # Routed as the heaviest behaviour: repeat offenders get banned
+            # and cannot rejoin at all.
             for bad in (
                 self.pool.redo_request(first.header.height),
                 self.pool.redo_request(first.header.height + 1),
             ):
-                if bad is not None:
-                    await self._on_pool_peer_error(bad, "sent invalid block")
+                if bad is not None and self.switch is not None:
+                    await self.report(
+                        self.switch.peers.get(bad),
+                        PeerBehaviour.bad_block(
+                            bad, f"invalid block at height {first.header.height}"
+                        ),
+                    )
             self._failed_ahead.pop(head_key, None)  # re-verify the redo
             return False
         self.pool.pop_request()

@@ -190,11 +190,13 @@ class BcFSM:
                 self._remove_peer(peer_id, effects)
             elif ev == Event.PROCESSED_BLOCK:
                 if data.get("err"):
-                    # verification failed: drop both involved peers, refetch
+                    # verification failed: drop both involved peers, refetch.
+                    # Distinct effect kind (not "error"): the reactor maps it
+                    # to the heaviest trust penalty (behaviour bad_block)
                     for h in (self.height, self.height + 1):
                         bd = self.received.pop(h, None)
                         if bd is not None:
-                            effects.append(("error", bd.peer_id, "invalid block"))
+                            effects.append(("bad_block", bd.peer_id, "invalid block"))
                             self._remove_peer(bd.peer_id, effects)
                 else:
                     self.received.pop(self.height, None)

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import asyncio
 
+from tendermint_tpu.behaviour import PeerBehaviour
 from tendermint_tpu.blockchain.reactor import (
     BLOCKCHAIN_CHANNEL,
     BlockRequestMessage,
@@ -72,7 +73,9 @@ class BlockchainReactorV1(BaseReactor):
         try:
             msg = decode_bc_message(msg_bytes)
         except Exception as e:
-            await self.switch.stop_peer_for_error(peer, e)
+            await self.report(
+                peer, PeerBehaviour.bad_message(peer.id, f"blockchain: {e!r}")
+            )
             return
         if isinstance(msg, BlockRequestMessage):
             block = self.block_store.load_block(msg.height)
@@ -123,6 +126,14 @@ class BlockchainReactorV1(BaseReactor):
                     await peer.send(
                         BLOCKCHAIN_CHANNEL, encode_bc_message(BlockRequestMessage(height))
                     )
+            elif kind == "bad_block":
+                # verification failure: the heaviest trust penalty — a
+                # repeat offender gets banned, not just dropped
+                _, peer_id, reason = eff
+                peer = self.switch.peers.get(peer_id) if self.switch else None
+                await self.report(
+                    peer, PeerBehaviour.bad_block(peer_id, str(reason)[:120])
+                )
             elif kind == "error":
                 _, peer_id, reason = eff
                 peer = self.switch.peers.get(peer_id) if self.switch else None

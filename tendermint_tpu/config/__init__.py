@@ -62,6 +62,19 @@ class P2PConfig:
     allow_duplicate_ip: bool = False
     handshake_timeout: float = 20.0
     dial_timeout: float = 3.0
+    # Peer-quality plane (docs/p2p_resilience.md): behaviour reports feed
+    # a per-peer trust metric (p2p/trust.py); a peer whose score crosses
+    # ban_threshold (0-100) after accumulating ban_min_bad_weight of bad
+    # behaviour is banned for ban_duration seconds (doubling for repeat
+    # offenders, persisted in the address book across restarts). The
+    # trust scores themselves persist in trust_file.
+    trust_file: str = "data/peer_trust.json"
+    ban_threshold: int = 20
+    ban_min_bad_weight: float = 6.0
+    ban_duration: float = 300.0
+    # Unified self-healing dialer (p2p/dialer.py): at most this many dial
+    # attempts in flight at once (churn throttling).
+    max_concurrent_dials: int = 8
     test_fuzz: bool = False
     # Nemesis fault control (libs/fault.py): wrap every peer link in a
     # runtime-controllable fault injector driven by the `debug_fault`

@@ -16,6 +16,7 @@ from __future__ import annotations
 import asyncio
 import time
 
+from tendermint_tpu.behaviour import PeerBehaviour
 from tendermint_tpu.consensus import messages as m
 from tendermint_tpu.consensus.round_state import PeerRoundState, RoundState, RoundStep
 from tendermint_tpu.consensus.state import ConsensusState
@@ -383,7 +384,9 @@ class ConsensusReactor(BaseReactor):
             m.validate_consensus_message(msg)
         except Exception as e:
             self.log.error("bad consensus message", peer=peer.id, err=repr(e))
-            await self.switch.stop_peer_for_error(peer, e)
+            await self.report(
+                peer, PeerBehaviour.bad_message(peer.id, f"consensus: {e!r}")
+            )
             return
         ps: PeerState = peer.get(PeerState.KEY)
         if ps is None:
@@ -441,6 +444,7 @@ class ConsensusReactor(BaseReactor):
             ps.apply_proposal_pol(msg)
         elif isinstance(msg, m.BlockPartMessage):
             ps.set_has_proposal_block_part(msg.height, msg.round, msg.part.index)
+            await self.report(peer, PeerBehaviour.block_part(peer.id))
             await self.cs.send_peer_msg(msg, peer.id)
 
     async def _receive_vote(self, peer, ps: PeerState, msg) -> None:
@@ -463,6 +467,9 @@ class ConsensusReactor(BaseReactor):
                 type=int(v.type), val=v.validator_index, peer=peer.id,
             )
             ps.set_has_vote(v.height, v.round, v.type, v.validator_index)
+            # ADR-039 good behaviour: decodable votes keep the peer's
+            # trust metric fed (float ops only on this hot path)
+            await self.report(peer, PeerBehaviour.consensus_vote(peer.id))
             await cs.send_peer_msg(msg, peer.id)
 
     async def _receive_vote_set_bits(self, peer, ps: PeerState, msg) -> None:

@@ -5,10 +5,21 @@ length (:270), Write vs fsynced WriteSync (:177,191), EndHeightMessage
 height barrier (:39), rotating autofile group storage, backward
 SearchForEndHeight (:213). Every message the state machine consumes is
 logged BEFORE processing so a crash replays deterministically.
+
+Auto-repair (reference wal.go:76 + the repair logic the reference leaves
+to an operator running `tendermint debug`): a process that dies mid-write
+leaves a torn frame at the tail — a truncated header, a short payload, or
+a CRC mismatch. `repair_wal` runs at every open: each WAL file is scanned
+with the same stop-at-first-corrupt frame machinery `decode_frames` uses,
+the torn tail is moved into a `<file>.corrupt` sidecar (never deleted —
+it is postmortem evidence), and the file is truncated to the last clean
+frame boundary. Replay then proceeds from an intact log instead of the
+node refusing to start or silently appending after garbage.
 """
 from __future__ import annotations
 
 import io
+import os
 import struct
 import time
 import zlib
@@ -129,10 +140,122 @@ def decode_frames(stream: io.BufferedIOBase):
         yield TimedWALMessage(time_ns, msg)
 
 
+def scan_clean_frames(stream: io.BufferedIOBase) -> tuple[int, int, str | None]:
+    """Walk frames, stopping at the first corrupt one. Returns
+    (n_clean_frames, clean_byte_length, error-or-None) — the byte length
+    is the truncation point auto-repair cuts at."""
+    frames = 0
+    clean = 0
+    try:
+        for _ in decode_frames(stream):
+            frames += 1
+            clean = stream.tell()
+    except WALCorruptionError as e:
+        return frames, clean, str(e)
+    return frames, clean, None
+
+
+def _sidecar_path(path: str) -> str:
+    """First free `<path>.corrupt[.N]` name — repeated crashes must not
+    overwrite earlier evidence."""
+    cand = path + ".corrupt"
+    n = 0
+    while os.path.exists(cand):
+        n += 1
+        cand = f"{path}.corrupt.{n}"
+    return cand
+
+
+def _wal_files(head_path: str) -> list[str]:
+    """The group's files in stream order: numbered chunks ascending, then
+    the head (mirrors autofile.Group.read_all without opening the head
+    for append)."""
+    d = os.path.dirname(head_path) or "."
+    base = os.path.basename(head_path)
+    chunks = []
+    if os.path.isdir(d):
+        for name in os.listdir(d):
+            if name.startswith(base + "."):
+                suffix = name[len(base) + 1:]
+                if suffix.isdigit():
+                    chunks.append(int(suffix))
+    out = [f"{head_path}.{i:03d}" for i in sorted(chunks)]
+    if os.path.exists(head_path):
+        out.append(head_path)
+    return out
+
+
+def repair_wal(head_path: str) -> list[dict]:
+    """Auto-repair every file of the WAL group at `head_path`.
+
+    For the FIRST file containing a corrupt frame: bytes from the last
+    clean frame boundary onward move to a `.corrupt` sidecar and the file
+    is truncated there. Every LATER file is untrusted (the stream after a
+    corrupt point has no anchored framing) and is moved aside wholesale —
+    in practice a crash tears only the final file, so this is the rare
+    multi-chunk corruption case, not the common path.
+
+    Returns one record per repaired file:
+    {path, sidecar, kept_bytes, removed_bytes, kept_frames, reason}.
+    Frames never span files (Group.write appends whole frames; rotation
+    renames complete files), so per-file scanning is exact.
+    """
+    repairs: list[dict] = []
+    corrupted = False
+    for path in _wal_files(head_path):
+        size = os.path.getsize(path)
+        if corrupted:
+            # everything after a torn file is untrusted: preserve wholesale
+            sidecar = _sidecar_path(path)
+            os.rename(path, sidecar)
+            repairs.append({
+                "path": path, "sidecar": sidecar, "kept_bytes": 0,
+                "removed_bytes": size, "kept_frames": 0,
+                "reason": "follows corrupt file",
+            })
+            continue
+        with open(path, "rb") as f:
+            frames, clean, err = scan_clean_frames(f)
+        if err is None:
+            continue
+        corrupted = True
+        sidecar = _sidecar_path(path)
+        with open(path, "rb") as f:
+            f.seek(clean)
+            torn = f.read()
+        with open(sidecar, "wb") as f:
+            f.write(torn)
+            f.flush()
+            os.fsync(f.fileno())
+        with open(path, "r+b") as f:
+            f.truncate(clean)
+            f.flush()
+            os.fsync(f.fileno())
+        repairs.append({
+            "path": path, "sidecar": sidecar, "kept_bytes": clean,
+            "removed_bytes": size - clean, "kept_frames": frames,
+            "reason": err,
+        })
+    for r in repairs:
+        RECORDER.record(
+            "wal", "repair", file=os.path.basename(r["path"]),
+            kept_bytes=r["kept_bytes"], removed_bytes=r["removed_bytes"],
+            kept_frames=r["kept_frames"], reason=r["reason"][:200],
+        )
+    return repairs
+
+
 class WAL:
     """Reference wal.go:57 baseWAL."""
 
-    def __init__(self, path: str, head_size_limit: int = 10 * 1024 * 1024) -> None:
+    def __init__(
+        self, path: str, head_size_limit: int = 10 * 1024 * 1024,
+        repair: bool = True,
+    ) -> None:
+        # auto-repair BEFORE the group opens the head for append: a torn
+        # tail would otherwise poison every later read (and a new frame
+        # appended after garbage is unreachable by the scanner)
+        self.repairs = repair_wal(path) if repair else []
         self.group = Group(path, head_size_limit=head_size_limit)
 
     def write(self, msg) -> None:

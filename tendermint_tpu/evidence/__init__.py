@@ -42,6 +42,9 @@ class EvidencePool:
         self.state_store = state_store
         self.state = state
         self.log = logger
+        # libs/metrics.EvidenceMetrics | None, set by the node when
+        # Prometheus is on (tm_evidence_* series)
+        self.metrics = None
         self.evidence_list = CList()  # gossip data structure
         self._in_list: dict[bytes, object] = {}
         # Seed the gossip list from the outqueue: priority order (reference
@@ -55,6 +58,19 @@ class EvidencePool:
             ev = decode_evidence(raw)
             if ev.hash() not in self._in_list:
                 self._in_list[ev.hash()] = self.evidence_list.push_back(ev)
+        if self._in_list:
+            # restart durability: pending evidence from a previous run is
+            # back on the gossip list — make the black box say so
+            RECORDER.record(
+                "evidence", "restored", count=len(self._in_list),
+            )
+
+    def _pending_count(self) -> int:
+        return sum(1 for _ in self._db.iterate_prefix(b"EV:pending:"))
+
+    def _set_pending_gauge(self) -> None:
+        if self.metrics is not None:
+            self.metrics.pending.set(self._pending_count())
 
     # -- keys (reference evidence/store.go:37-57) --------------------------
 
@@ -131,6 +147,7 @@ class EvidencePool:
             "evidence", "added", height=ev.height(),
             addr=ev.address().hex(), priority=priority,
         )
+        self._set_pending_gauge()
         self.log.info("added evidence", evidence=str(ev), priority=priority)
 
     def _stored_priority(self, ev: Evidence) -> int:
@@ -150,6 +167,10 @@ class EvidencePool:
                 "evidence", "committed", height=ev.height(),
                 addr=ev.address().hex(),
             )
+            if self.metrics is not None:
+                self.metrics.committed_total.inc()
+        if evidence:
+            self._set_pending_gauge()
 
     def _remove_pending(self, ev: Evidence) -> None:
         self._db.delete(self._pending_key(ev))
@@ -176,3 +197,6 @@ class EvidencePool:
                 "evidence", "pruned", count=pruned,
                 height=state.last_block_height, max_age=max_age,
             )
+            if self.metrics is not None:
+                self.metrics.pruned_total.inc(pruned)
+            self._set_pending_gauge()

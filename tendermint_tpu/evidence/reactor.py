@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import asyncio
 
+from tendermint_tpu.behaviour import PeerBehaviour
 from tendermint_tpu.encoding import Reader, Writer
 from tendermint_tpu.evidence import EvidenceError, EvidencePool
 from tendermint_tpu.libs.log import NOP, Logger
@@ -62,7 +63,9 @@ class EvidenceReactor(BaseReactor):
             evs = decode_evidence_message(msg_bytes)
         except Exception as e:
             self.log.error("bad evidence message", peer=peer.id, err=repr(e))
-            await self.switch.stop_peer_for_error(peer, e)
+            await self.report(
+                peer, PeerBehaviour.bad_message(peer.id, f"evidence: {e!r}")
+            )
             return
         for ev in evs:
             try:
@@ -71,12 +74,16 @@ class EvidenceReactor(BaseReactor):
                 # Not necessarily Byzantine: height skew between peers makes
                 # valid evidence unverifiable here (too old for us, or from a
                 # height we haven't stored validators for). Reject the
-                # evidence, keep the peer.
+                # evidence, keep the peer — but remember the smell: a peer
+                # that ONLY ever sends unverifiable evidence decays.
                 RECORDER.record(
                     "evidence", "rejected", peer=peer.id,
                     height=ev.height(), err=str(e)[:200],
                 )
                 self.log.info("rejected evidence from peer", peer=peer.id, err=str(e))
+                await self.report(
+                    peer, PeerBehaviour.unverifiable_evidence(peer.id, str(e)[:80])
+                )
 
     async def _broadcast_routine(self, peer) -> None:
         el = None

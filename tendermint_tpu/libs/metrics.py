@@ -263,6 +263,39 @@ class P2PMetrics:
         self.peer_send_bytes_total = c.counter(
             "p2p", "peer_send_bytes_total", "Bytes sent per channel"
         )
+        # peer-quality plane (docs/p2p_resilience.md): behaviour-scored
+        # banning + the unified self-healing dialer
+        self.peer_bans_total = c.counter(
+            "p2p", "peer_bans_total", "Peers banned on trust-score crossing"
+        )
+        self.banned_peers = c.gauge(
+            "p2p", "banned_peers", "Currently banned peers"
+        )
+        self.behaviour_bad_total = c.counter(
+            "p2p", "behaviour_bad_total", "Bad peer-behaviour reports"
+        )
+        self.dials_total = c.counter(
+            "p2p", "dials_total", "Outbound dial attempts (unified dialer)"
+        )
+        self.dial_failures_total = c.counter(
+            "p2p", "dial_failures_total", "Failed outbound dial attempts"
+        )
+
+
+class EvidenceMetrics:
+    """tm_evidence_* — the Byzantine-evidence pipeline, restart-durable
+    through libs/db (fed by evidence.EvidencePool)."""
+
+    def __init__(self, c: Collector) -> None:
+        self.pending = c.gauge(
+            "evidence", "pending", "Uncommitted evidence in the pool"
+        )
+        self.committed_total = c.counter(
+            "evidence", "committed_total", "Evidence committed in blocks"
+        )
+        self.pruned_total = c.counter(
+            "evidence", "pruned_total", "Expired evidence pruned from the pool"
+        )
 
 
 class MempoolMetrics:

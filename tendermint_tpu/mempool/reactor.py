@@ -10,10 +10,11 @@ from __future__ import annotations
 
 import asyncio
 
+from tendermint_tpu.behaviour import PeerBehaviour
 from tendermint_tpu.encoding import Reader, Writer
 from tendermint_tpu.libs.log import NOP, Logger
 from tendermint_tpu.libs.recorder import RECORDER
-from tendermint_tpu.mempool import CListMempool, MempoolError
+from tendermint_tpu.mempool import CListMempool, MempoolError, TxInCacheError
 from tendermint_tpu.p2p.base_reactor import BaseReactor, ChannelDescriptor
 
 MEMPOOL_CHANNEL = 0x30
@@ -61,12 +62,25 @@ class MempoolReactor(BaseReactor):
         except Exception as e:
             RECORDER.record("mempool", "bad_peer_msg", peer=peer.id, err=repr(e))
             self.log.error("bad mempool message", peer=peer.id, err=repr(e))
-            await self.switch.stop_peer_for_error(peer, e)
+            await self.report(
+                peer, PeerBehaviour.bad_message(peer.id, f"mempool: {e!r}")
+            )
             return
         try:
-            await self.mempool.check_tx(tx, sender=peer.id)
+            res = await self.mempool.check_tx(tx, sender=peer.id)
+        except TxInCacheError:
+            pass  # dup: normal gossip echo (reference :170)
         except MempoolError:
-            pass  # dup / full / invalid: all non-fatal (reference :170)
+            pass  # full: our problem, not the peer's
+        else:
+            # non-fatal trust signal either way: a peer gossiping txs the
+            # app rejects is spam pressure; valid txs replenish the score
+            if res.is_ok:
+                await self.report(peer, PeerBehaviour.good_tx(peer.id))
+            else:
+                await self.report(
+                    peer, PeerBehaviour.bad_tx(peer.id, f"code {res.code}")
+                )
 
     async def _broadcast_tx_routine(self, peer) -> None:
         """Reference :185 — follow the clist; skip txs the peer sent us."""

@@ -32,6 +32,7 @@ from tendermint_tpu.p2p.node_info import NodeInfo
 from tendermint_tpu.p2p.pex.addrbook import AddrBook
 from tendermint_tpu.p2p.pex.pex_reactor import PexReactor
 from tendermint_tpu.p2p.switch import Switch
+from tendermint_tpu.p2p.trust import TrustMetricStore
 from tendermint_tpu.p2p.transport import Transport
 from tendermint_tpu.privval import FilePV
 from tendermint_tpu.rpc.core import Environment
@@ -183,8 +184,12 @@ class Node(BaseService):
             else None,
             logger=log,
         )
+        # evidence survives restarts through the same durable backend as
+        # the block store (ROADMAP item 5 residue: pending evidence must
+        # still land committed after the pool's node restarts)
+        self.evidence_db = _open_db(cfg, "evidence")
         self.evidence_pool = EvidencePool(
-            _open_db(cfg, "evidence"), self.state_store, state, logger=log
+            self.evidence_db, self.state_store, state, logger=log
         )
 
         # 6. block executor + reactors
@@ -230,6 +235,16 @@ class Node(BaseService):
 
         wal_dir = os.path.dirname(cfg.wal_path)
         os.makedirs(wal_dir, exist_ok=True)
+        # a torn WAL tail (crash mid-fsync) auto-repairs at open: the
+        # corrupt segment is preserved in a .corrupt sidecar and replay
+        # proceeds from the last CRC-clean frame (consensus/wal.py)
+        wal = WAL(cfg.wal_path)
+        for r in wal.repairs:
+            log.info(
+                "WAL auto-repaired", file=r["path"], sidecar=r["sidecar"],
+                kept_frames=r["kept_frames"], removed_bytes=r["removed_bytes"],
+                reason=r["reason"],
+            )
         self.consensus_state = ConsensusState(
             cfg.consensus,
             state,
@@ -238,7 +253,7 @@ class Node(BaseService):
             mempool=self.mempool,
             evidence_pool=self.evidence_pool,
             priv_validator=self.priv_validator,
-            wal=WAL(cfg.wal_path),
+            wal=wal,
             event_bus=self.event_bus,
             logger=log,
             tracer=self.tracer,
@@ -288,12 +303,20 @@ class Node(BaseService):
                 prob_drop_rw=0.05, prob_delay=0.1, max_delay=0.1,
                 start_after=10.0,
             )
+        # peer-quality plane: trust scores persist next to the address
+        # book; bans persist IN the address book (docs/p2p_resilience.md)
+        self.trust_store = TrustMetricStore(cfg._abs(cfg.p2p.trust_file))
         self.switch = Switch(
             self.transport,
             max_inbound_peers=cfg.p2p.max_num_inbound_peers,
             max_outbound_peers=cfg.p2p.max_num_outbound_peers,
             fuzz_config=fuzz_config,
             fault_control=cfg.p2p.test_fault_control,
+            trust_store=self.trust_store,
+            ban_threshold=cfg.p2p.ban_threshold,
+            ban_min_bad_weight=cfg.p2p.ban_min_bad_weight,
+            ban_duration=cfg.p2p.ban_duration,
+            max_concurrent_dials=cfg.p2p.max_concurrent_dials,
         )
         self.switch.addr_book = self.addr_book
         for name, r in reactors.items():
@@ -364,6 +387,9 @@ class Node(BaseService):
             self.consensus_state.metrics = self.consensus_metrics
             self.mempool.metrics = self.mempool_metrics
             self.switch.metrics = self.p2p_metrics
+            self.evidence_metrics = tmm.EvidenceMetrics(self.metrics)
+            self.evidence_pool.metrics = self.evidence_metrics
+            self.evidence_pool._set_pending_gauge()  # restored pending
             for p in self.switch.peers.list():
                 p.metrics = self.p2p_metrics
             # event-fed gauges render no sample until their first event;
@@ -529,8 +555,9 @@ class Node(BaseService):
         ):
             RECORDER.set_dump_path(None)
         self.consensus_state.wal.close()
-        self.addr_book.save()
-        for db in (self.block_store_db, self.state_db):
+        self.addr_book.save()  # bans ride in the book's JSON
+        self.trust_store.save()
+        for db in (self.block_store_db, self.state_db, self.evidence_db):
             db.close()
 
     async def _metrics_sampler(self) -> None:

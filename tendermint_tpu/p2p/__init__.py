@@ -12,20 +12,29 @@ is asyncio-native: goroutine-per-peer in the reference maps to task-per-peer.
 """
 from __future__ import annotations
 
-from tendermint_tpu.p2p.key import NodeKey, node_id_from_pubkey
-from tendermint_tpu.p2p.node_info import NodeInfo
-from tendermint_tpu.p2p.netaddress import NetAddress
-from tendermint_tpu.p2p.base_reactor import BaseReactor, ChannelDescriptor
-from tendermint_tpu.p2p.peer import Peer
-from tendermint_tpu.p2p.switch import Switch
+import importlib
 
-__all__ = [
-    "NodeKey",
-    "node_id_from_pubkey",
-    "NodeInfo",
-    "NetAddress",
-    "BaseReactor",
-    "ChannelDescriptor",
-    "Peer",
-    "Switch",
-]
+# Lazy exports (PEP 562): `from tendermint_tpu.p2p import Switch` still
+# works, but importing a crypto-free submodule (trust, dialer, netaddress,
+# pex.addrbook) no longer drags the `cryptography`-backed key/transport
+# stack in — those modules must stay importable on hosts without the
+# crypto package (the libs/fault.py precedent).
+_EXPORTS = {
+    "NodeKey": "tendermint_tpu.p2p.key",
+    "node_id_from_pubkey": "tendermint_tpu.p2p.key",
+    "NodeInfo": "tendermint_tpu.p2p.node_info",
+    "NetAddress": "tendermint_tpu.p2p.netaddress",
+    "BaseReactor": "tendermint_tpu.p2p.base_reactor",
+    "ChannelDescriptor": "tendermint_tpu.p2p.base_reactor",
+    "Peer": "tendermint_tpu.p2p.peer",
+    "Switch": "tendermint_tpu.p2p.switch",
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    return getattr(importlib.import_module(mod), name)

@@ -1,0 +1,93 @@
+"""BanTable — the one implementation of the peer-ban policy.
+
+Used by the PEX address book (persistent bans, book clock) and by
+switches without an address book (local, monotonic clock). One place
+owns the escalation rule — duration doubles per offence, capped at a
+day — the lazy expiry pruning, and the listing shape, so the two
+backends can never diverge (docs/p2p_resilience.md).
+"""
+from __future__ import annotations
+
+import time
+
+BAN_CAP_SECONDS = 86400.0  # one day
+
+
+class BanTable:
+    def __init__(self, clock=None, our_ids: set[str] | None = None) -> None:
+        self._clock = clock or time.monotonic
+        self.our_ids = our_ids if our_ids is not None else set()
+        self._bans: dict[str, dict] = {}
+        # repeat-offender memory outliving individual ban windows (session
+        # only — the persisted trust scores are the durable reputation)
+        self._counts: dict[str, int] = {}
+
+    def ban(self, node_id: str, duration: float, reason: str = "") -> float:
+        """Ban `node_id` for `duration` seconds; repeated bans double the
+        effective duration (reputation decay has to be re-earned). Returns
+        the applied duration."""
+        if not node_id or node_id in self.our_ids:
+            return 0.0
+        count = self._counts.get(node_id, 0) + 1
+        self._counts[node_id] = count
+        applied = min(duration * (2 ** (count - 1)), BAN_CAP_SECONDS)
+        self._bans[node_id] = {
+            "expires": self._clock() + applied,
+            "reason": reason[:200],
+            "count": count,
+        }
+        return applied
+
+    def unban(self, node_id: str) -> None:
+        self._bans.pop(node_id, None)
+
+    def is_banned(self, node_id: str, now: float | None = None) -> bool:
+        b = self._bans.get(node_id)
+        if b is None:
+            return False
+        if (self._clock() if now is None else now) >= b["expires"]:
+            # expired bans are pruned; `_counts` keeps the escalation
+            # memory and the trust metric keeps the longer reputation
+            self._bans.pop(node_id, None)
+            return False
+        return True
+
+    def bans(self) -> list[dict]:
+        """Live bans (debug_p2p): [{id, remaining_s, reason, count}]."""
+        now = self._clock()
+        out = []
+        for node_id in list(self._bans):
+            b = self._bans.get(node_id)
+            if b is None or now >= b["expires"]:
+                self._bans.pop(node_id, None)
+                continue
+            out.append({
+                "id": node_id,
+                "remaining_s": round(b["expires"] - now, 1),
+                "reason": b["reason"],
+                "count": b["count"],
+            })
+        return out
+
+    def live(self) -> dict[str, dict]:
+        """Unexpired raw entries (persistence): id -> {expires(mono),
+        reason, count}."""
+        now = self._clock()
+        return {
+            node_id: b
+            for node_id, b in self._bans.items()
+            if b["expires"] > now
+        }
+
+    def restore(self, node_id: str, remaining: float, reason: str,
+                count: int) -> None:
+        """Re-create a ban with `remaining` seconds left (load path)."""
+        if not node_id or node_id in self.our_ids or remaining <= 0:
+            return
+        count = max(1, count)
+        self._bans[node_id] = {
+            "expires": self._clock() + min(remaining, BAN_CAP_SECONDS),
+            "reason": str(reason)[:200],
+            "count": count,
+        }
+        self._counts[node_id] = max(self._counts.get(node_id, 0), count)

@@ -29,6 +29,20 @@ class BaseReactor(BaseService):
     def set_switch(self, switch) -> None:
         self.switch = switch
 
+    async def report(self, peer, behaviour) -> None:
+        """Route a behaviour/PeerBehaviour into the switch's peer-quality
+        plane (trust score, bans, disconnect — ADR-039). Falls back to the
+        legacy stop-on-error contract for stub switches in tests that only
+        implement `stop_peer_for_error`."""
+        sw = self.switch
+        if sw is None:
+            return
+        report_behaviour = getattr(sw, "report_behaviour", None)
+        if report_behaviour is not None:
+            await report_behaviour(behaviour, peer=peer)
+        elif behaviour.is_error and peer is not None:
+            await sw.stop_peer_for_error(peer, behaviour.reason)
+
     def get_channels(self) -> list[ChannelDescriptor]:
         return []
 

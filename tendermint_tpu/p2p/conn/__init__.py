@@ -1,8 +1,25 @@
 """Connection substrate: SecretConnection (authenticated encryption) and
-MConnection (channel multiplexing) — reference p2p/conn/."""
+MConnection (channel multiplexing) — reference p2p/conn/.
+
+Lazy exports (PEP 562, like the p2p package itself): MConnection is pure
+asyncio, and importing it must not drag the `cryptography`-backed
+SecretConnection in on hosts without the crypto package.
+"""
 from __future__ import annotations
 
-from tendermint_tpu.p2p.conn.secret_connection import SecretConnection
-from tendermint_tpu.p2p.conn.connection import MConnection, ChannelStatus
+import importlib
 
-__all__ = ["SecretConnection", "MConnection", "ChannelStatus"]
+_EXPORTS = {
+    "SecretConnection": "tendermint_tpu.p2p.conn.secret_connection",
+    "MConnection": "tendermint_tpu.p2p.conn.connection",
+    "ChannelStatus": "tendermint_tpu.p2p.conn.connection",
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    return getattr(importlib.import_module(mod), name)

@@ -1,0 +1,218 @@
+"""Unified self-healing dialer — ONE backoff policy for every outbound dial.
+
+Before this module the node had two dialing planes with different (and
+partly broken) policies: the switch's `_reconnect_routine` gave up on
+persistent peers after a fixed attempt cap (reference switch.go:362 has a
+second, slower, *much longer* phase precisely so validators are never
+permanently abandoned), and the PEX `ensure_peers` loop fired one-shot
+dials with no backoff at all, so a flapping network redialed dead
+addresses every sweep. Both now route here.
+
+Policy (reference switch.go reconnectToPeer, :362):
+
+- fast phase — jittered exponential backoff from `base_delay` doubling to
+  `max_delay`, for up to `fast_attempts` attempts;
+- slow phase — persistent peers only: UNBOUNDED further attempts every
+  `slow_interval` (jittered). A validator peer is never abandoned; a
+  transient (PEX-discovered) address is dropped after `transient_attempts`
+  and left to the address book's staleness machinery;
+- banned targets are not dialed: transient addresses are dropped, while
+  persistent peers sleep a slow interval and re-check (the ban may have
+  been an operator action or a decayed misunderstanding — a validator
+  peer must come back once the ban expires);
+- at most `max_concurrent` dial attempts run at once, and consecutive
+  attempt *starts* are spaced `min_gap` apart — a restarted 100-node net
+  churning all its links must not stampede the event loop (dial
+  throttling under churn).
+
+Every transition is a flight-recorder event (`p2p dial/dial_backoff/
+dial_gave_up`) so a postmortem can see exactly why a link stayed down.
+The dialer spawns its loops through the owning service's `spawn`, so
+switch stop cancels them.
+"""
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+
+from tendermint_tpu.libs.recorder import RECORDER
+
+FAST_BASE_DELAY = 1.0
+FAST_MAX_DELAY = 30.0
+FAST_ATTEMPTS = 20
+SLOW_INTERVAL = 300.0
+TRANSIENT_ATTEMPTS = 3
+MAX_CONCURRENT_DIALS = 8
+MIN_DIAL_GAP = 0.05
+JITTER = 0.2  # +- fraction applied to every sleep
+
+
+class Dialer:
+    """Owns one redial loop per target address.
+
+    `dial_attempt(addr, persistent) -> bool` performs one dial + add-peer
+    attempt (the switch's `_dial_attempt`); `has_peer(peer_id) -> bool`
+    and `is_banned(peer_id) -> bool` gate attempts; `spawn` registers the
+    loop task with the owning service; `is_running()` ends loops at
+    shutdown.
+    """
+
+    def __init__(
+        self,
+        dial_attempt,
+        *,
+        has_peer,
+        is_banned,
+        spawn,
+        is_running,
+        base_delay: float = FAST_BASE_DELAY,
+        max_delay: float = FAST_MAX_DELAY,
+        fast_attempts: int = FAST_ATTEMPTS,
+        slow_interval: float = SLOW_INTERVAL,
+        transient_attempts: int = TRANSIENT_ATTEMPTS,
+        max_concurrent: int = MAX_CONCURRENT_DIALS,
+        min_gap: float = MIN_DIAL_GAP,
+        metrics=None,  # libs/metrics.P2PMetrics | None
+    ) -> None:
+        self._dial_attempt = dial_attempt
+        self._has_peer = has_peer
+        self._is_banned = is_banned
+        self._spawn = spawn
+        self._is_running = is_running
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+        self.fast_attempts = fast_attempts
+        self.slow_interval = slow_interval
+        self.transient_attempts = transient_attempts
+        self.min_gap = min_gap
+        self.metrics = metrics
+        self._sem = asyncio.Semaphore(max(1, max_concurrent))
+        self._next_start = 0.0  # monotonic; global inter-dial-start gap
+        self._loops: dict[str, asyncio.Task] = {}
+        self._persistent: dict[str, bool] = {}  # live loops' persistence
+        # live introspection for debug_p2p: id -> {phase, attempts, next_in}
+        self._state: dict[str, dict] = {}
+
+    # -- public API --------------------------------------------------------
+
+    def schedule(self, addr, persistent: bool = False) -> None:
+        """Ensure a dial loop exists for `addr`. A live loop dedupes the
+        call — EXCEPT that a transient loop is upgraded when the new
+        request is persistent (a PEX sweep may race the node's own
+        persistent-peer dial for the same address; the configured
+        validator peer must never inherit give-up-after-3 semantics)."""
+        key = addr.id or addr.dial_string()
+        t = self._loops.get(key)
+        if t is not None and not t.done():
+            if not persistent or self._persistent.get(key, False):
+                return
+            t.cancel()  # upgrade: restart the loop with persistent policy
+        self._persistent[key] = persistent
+        self._loops[key] = self._spawn(
+            self._dial_loop(key, addr, persistent), f"dial-{key[:8]}"
+        )
+
+    def cancel(self, peer_id: str) -> None:
+        t = self._loops.pop(peer_id, None)
+        if t is not None and not t.done():
+            t.cancel()
+        self._persistent.pop(peer_id, None)
+        self._state.pop(peer_id, None)
+
+    def snapshot(self) -> dict:
+        """Live per-target dial state (debug_p2p)."""
+        now = time.monotonic()
+        out = {}
+        for key, st in self._state.items():
+            d = dict(st)
+            due = d.pop("due", None)
+            if due is not None:
+                d["next_in_s"] = round(max(0.0, due - now), 3)
+            out[key] = d
+        return out
+
+    # -- internals ---------------------------------------------------------
+
+    def _jitter(self, t: float) -> float:
+        return t * (1.0 + random.uniform(-JITTER, JITTER))
+
+    async def _throttle(self) -> float:
+        """Space dial starts `min_gap` apart globally; returns the wait
+        actually imposed. Single event loop: the read-modify below has no
+        suspension point, so no lock is needed."""
+        now = time.monotonic()
+        wait = max(0.0, self._next_start - now)
+        self._next_start = max(now, self._next_start) + self.min_gap
+        if wait > 0:
+            await asyncio.sleep(wait)
+        return wait
+
+    async def _attempt(self, addr, persistent: bool) -> bool:
+        async with self._sem:
+            await self._throttle()
+            m = self.metrics
+            if m is not None:
+                m.dials_total.inc()
+            ok = await self._dial_attempt(addr, persistent)
+            if not ok and m is not None:
+                m.dial_failures_total.inc()
+            return ok
+
+    async def _dial_loop(self, key: str, addr, persistent: bool) -> None:
+        attempts = 0
+        delay = self.base_delay
+        give_up_after = None if persistent else self.transient_attempts
+        try:
+            while self._is_running():
+                if addr.id and self._has_peer(addr.id):
+                    return
+                if addr.id and self._is_banned(addr.id):
+                    if not persistent:
+                        RECORDER.record("p2p", "dial_gave_up", peer=key,
+                                        attempts=attempts, reason="banned")
+                        return
+                    # persistent: sleep a slow interval and re-check — the
+                    # ban decays, the validator link must come back
+                    sleep_for = self._jitter(self.slow_interval)
+                    self._state[key] = {
+                        "phase": "banned", "attempts": attempts,
+                        "persistent": persistent,
+                        "due": time.monotonic() + sleep_for,
+                    }
+                    await asyncio.sleep(sleep_for)
+                    continue
+                self._state[key] = {
+                    "phase": "dialing", "attempts": attempts,
+                    "persistent": persistent,
+                }
+                if await self._attempt(addr, persistent):
+                    RECORDER.record("p2p", "dial", peer=key, ok=True,
+                                    attempts=attempts + 1)
+                    return
+                attempts += 1
+                if give_up_after is not None and attempts >= give_up_after:
+                    RECORDER.record("p2p", "dial_gave_up", peer=key,
+                                    attempts=attempts, reason="transient")
+                    return
+                if attempts >= self.fast_attempts:
+                    phase, sleep_for = "slow", self._jitter(self.slow_interval)
+                else:
+                    phase, sleep_for = "fast", self._jitter(delay)
+                    delay = min(delay * 2, self.max_delay)
+                RECORDER.record("p2p", "dial_backoff", peer=key, phase=phase,
+                                attempts=attempts, next_s=round(sleep_for, 2))
+                self._state[key] = {
+                    "phase": phase, "attempts": attempts,
+                    "persistent": persistent,
+                    "due": time.monotonic() + sleep_for,
+                }
+                await asyncio.sleep(sleep_for)
+        finally:
+            t = self._loops.get(key)
+            if t is not None and t is asyncio.current_task():
+                # an upgraded loop's cancelled predecessor must not tear
+                # down its successor's bookkeeping
+                self._loops.pop(key, None)
+                self._persistent.pop(key, None)
+                self._state.pop(key, None)

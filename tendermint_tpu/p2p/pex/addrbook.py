@@ -28,6 +28,7 @@ import random
 import time
 from dataclasses import dataclass, field
 
+from tendermint_tpu.p2p.bans import BanTable
 from tendermint_tpu.p2p.netaddress import NetAddress
 
 # reference p2p/pex/params.go
@@ -149,6 +150,12 @@ class AddrBook:
         ]
         self.n_new = 0
         self.n_old = 0
+        # behaviour-scored bans (docs/p2p_resilience.md, p2p/bans.py):
+        # kept OUTSIDE the buckets (a ban survives the entry being
+        # evicted) and persisted in the book's JSON with wall-clock
+        # expiries, so a banned garbage peer stays banned — with its
+        # REMAINING time — across a restart.
+        self._ban_table = BanTable(clock=self._clock, our_ids=self.our_ids)
         if file_path and os.path.exists(file_path):
             self.load(file_path)
 
@@ -358,12 +365,13 @@ class AddrBook:
         dialing loop, handled by restricting to available buckets)."""
         exclude = exclude or set()
         new_bias_pct = max(0, min(100, new_bias_pct))
+        now = self._clock()
         # buckets that still contain a non-excluded candidate
         avail_new: dict[int, list] = {}
         avail_old: dict[int, list] = {}
         n_new_avail = n_old_avail = 0
         for ka in self._lookup.values():
-            if ka.addr.id in exclude:
+            if ka.addr.id in exclude or self.is_banned(ka.addr.id, now):
                 continue
             tgt = avail_old if ka.is_old else avail_new
             tgt.setdefault(ka.buckets[0] if ka.buckets else 0, []).append(ka)
@@ -392,7 +400,12 @@ class AddrBook:
             return []
         n = max(min(MIN_GET_SELECTION, size), size * GET_SELECTION_PERCENT // 100)
         n = min(n, max_n, MAX_GET_SELECTION)
-        addrs = [ka.addr for ka in self._lookup.values()]
+        now = self._clock()
+        # banned addresses are not vouched for to other peers
+        addrs = [
+            ka.addr for ka in self._lookup.values()
+            if not self.is_banned(ka.addr.id, now)
+        ]
         random.shuffle(addrs)
         return addrs[:n]
 
@@ -424,6 +437,20 @@ class AddrBook:
         ka = self._lookup.get(addr.id)
         return bool(ka and ka.is_old)
 
+    # --- bans (delegated to the shared BanTable policy) -------------------
+
+    def ban(self, node_id: str, duration: float, reason: str = "") -> float:
+        return self._ban_table.ban(node_id, duration, reason)
+
+    def unban(self, node_id: str) -> None:
+        self._ban_table.unban(node_id)
+
+    def is_banned(self, node_id: str, now: float | None = None) -> bool:
+        return self._ban_table.is_banned(node_id, now)
+
+    def bans(self) -> list[dict]:
+        return self._ban_table.bans()
+
     # --- persistence ------------------------------------------------------
 
     def save(self, path: str | None = None) -> None:
@@ -438,7 +465,19 @@ class AddrBook:
             d["last_attempt"] = self._mono_to_wall(ka.last_attempt)
             d["last_success"] = self._mono_to_wall(ka.last_success)
             addrs.append(d)
-        doc = {"key": self.key, "addrs": addrs}
+        # live bans persist with wall-clock expiry (mirrors the timestamp
+        # treatment above: readable, and the REMAINING ban time survives
+        # a restart instead of resetting or evaporating)
+        bans = [
+            {
+                "id": node_id,
+                "expires": self._mono_to_wall(b["expires"]),
+                "reason": b["reason"],
+                "count": b["count"],
+            }
+            for node_id, b in self._ban_table.live().items()
+        ]
+        doc = {"key": self.key, "addrs": addrs, "bans": bans}
         tmp = path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(doc, f)
@@ -448,6 +487,16 @@ class AddrBook:
         with open(path, encoding="utf-8") as f:
             doc = json.load(f)
         self.key = doc.get("key", self.key)
+        for b in doc.get("bans", []):
+            # a ban expiry is a FUTURE timestamp: _wall_to_mono clamps the
+            # future to "now" (right for ages, wrong here) — convert the
+            # REMAINING time instead (expired-while-down bans drop out)
+            self._ban_table.restore(
+                b.get("id", ""),
+                float(b.get("expires", 0.0)) - self._wall(),
+                b.get("reason", ""),
+                int(b.get("count", 1)),
+            )
         for d in doc.get("addrs", []):
             ka = _KnownAddress.from_json(d)
             if ka.addr.id in self.our_ids:

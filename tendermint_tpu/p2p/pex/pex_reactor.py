@@ -10,6 +10,7 @@ from __future__ import annotations
 import asyncio
 import time
 
+from tendermint_tpu.behaviour import PeerBehaviour
 from tendermint_tpu.encoding import DecodeError, Reader, Writer
 from tendermint_tpu.p2p.base_reactor import BaseReactor, ChannelDescriptor
 from tendermint_tpu.p2p.netaddress import AddressError, NetAddress
@@ -102,14 +103,17 @@ class PexReactor(BaseReactor):
         try:
             kind, payload = decode_pex_message(msg_bytes)
         except (DecodeError, AddressError) as e:
-            await self.switch.stop_peer_for_error(peer, f"bad pex msg: {e}")
+            await self.report(peer, PeerBehaviour.bad_message(peer.id, f"pex: {e}"))
             return
         if kind == "request":
             now = time.monotonic()
             last = self._last_request_from.get(peer.id)
             if last is not None and now - last < MIN_REQUEST_INTERVAL:
-                await self.switch.stop_peer_for_error(
-                    peer, "pex request rate exceeded"
+                await self.report(
+                    peer,
+                    PeerBehaviour.message_out_of_order(
+                        peer.id, "pex request rate exceeded"
+                    ),
                 )
                 return
             self._last_request_from[peer.id] = now
@@ -125,7 +129,12 @@ class PexReactor(BaseReactor):
                 await self.switch.stop_peer_gracefully(peer)
         else:  # addrs
             if peer.id not in self._requested_of:
-                await self.switch.stop_peer_for_error(peer, "unsolicited pex addrs")
+                await self.report(
+                    peer,
+                    PeerBehaviour.message_out_of_order(
+                        peer.id, "unsolicited pex addrs"
+                    ),
+                )
                 return
             self._requested_of.discard(peer.id)
             for addr in payload:

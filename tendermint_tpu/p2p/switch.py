@@ -1,24 +1,47 @@
-"""Switch: peer lifecycle + reactor message routing.
+"""Switch: peer lifecycle + reactor message routing + peer quality.
 
 Reference parity: p2p/switch.go:67 — owns the transport, the peer set, and
 all reactors. `add_reactor` claims channel IDs (switch.go:154); `broadcast`
 fans out to every peer (switch.go:258); dial/accept routines add peers with
 retry + exponential backoff for persistent peers (switch.go:362,572).
+
+Peer quality (docs/p2p_resilience.md): reactors route misbehaviour through
+`behaviour/` reports into the per-peer `p2p/trust.py` metric; the switch
+bans peers whose score crosses the threshold (persisted in the PEX address
+book so bans survive restart), rejects banned peers on accept AND dial,
+and heals lost links through the unified `p2p/dialer.py` backoff dialer —
+persistent peers are never permanently abandoned.
 """
 from __future__ import annotations
 
 import asyncio
 import random
 
+from typing import TYPE_CHECKING
+
+from tendermint_tpu.behaviour import PeerBehaviour
 from tendermint_tpu.libs.recorder import RECORDER
 from tendermint_tpu.libs.service import BaseService
+from tendermint_tpu.p2p.bans import BanTable
+from tendermint_tpu.p2p.dialer import Dialer
 from tendermint_tpu.p2p.netaddress import NetAddress
 from tendermint_tpu.p2p.peer import Peer
-from tendermint_tpu.p2p.transport import RejectedError, Transport
+from tendermint_tpu.p2p.trust import TrustMetricStore
 
+if TYPE_CHECKING:  # Transport pulls the crypto stack; keep it type-only
+    from tendermint_tpu.p2p.transport import Transport
+
+# legacy fast-phase constants, now interpreted by p2p/dialer.py (the old
+# _reconnect_routine stopped FOR GOOD after MAX_RECONNECT_ATTEMPTS — the
+# dialer's slow phase continues persistent peers unboundedly instead)
 RECONNECT_BASE_DELAY = 1.0
 RECONNECT_MAX_DELAY = 300.0
 MAX_RECONNECT_ATTEMPTS = 20
+
+# behaviour-scored banning defaults (config p2p.* overrides via the node)
+BAN_THRESHOLD_SCORE = 20  # trust_score() in [0, 100]
+BAN_MIN_BAD_WEIGHT = 6.0  # accumulated bad weight before a ban can fire
+BAN_DURATION = 300.0  # seconds; repeat offenders double (addrbook.ban)
 
 
 class SwitchError(Exception):
@@ -58,6 +81,11 @@ class Switch(BaseService):
         max_outbound_peers: int = 10,
         fuzz_config=None,  # p2p.fuzz.FuzzConfig | None (config.p2p.test_fuzz)
         fault_control: bool = False,  # config.p2p.test_fault_control
+        trust_store: TrustMetricStore | None = None,
+        ban_threshold: int = BAN_THRESHOLD_SCORE,
+        ban_min_bad_weight: float = BAN_MIN_BAD_WEIGHT,
+        ban_duration: float = BAN_DURATION,
+        max_concurrent_dials: int = 8,
     ) -> None:
         super().__init__(name="Switch")
         self.transport = transport
@@ -70,12 +98,45 @@ class Switch(BaseService):
         self.max_inbound_peers = max_inbound_peers
         self.max_outbound_peers = max_outbound_peers
         self._dialing: set[str] = set()
-        self._reconnecting: set[str] = set()
         self._persistent_addrs: dict[str, NetAddress] = {}
         self.addr_book = None  # optional, set by PEX wiring
-        # libs/metrics.P2PMetrics | None, set by the node when Prometheus
-        # is on; propagated to each Peer for per-channel byte counters
-        self.metrics = None
+        self._metrics = None
+        # peer-quality plane: every behaviour report lands in the trust
+        # store; the ban decision needs BOTH a below-threshold score and
+        # enough accumulated bad weight (one unlucky frame disconnects
+        # but does not ban)
+        self.trust_store = trust_store or TrustMetricStore()
+        self.ban_threshold = ban_threshold
+        self.ban_min_bad_weight = ban_min_bad_weight
+        self.ban_duration = ban_duration
+        # backend when addr_book is None (tests, ad-hoc meshes): same
+        # shared BanTable policy, monotonic clock, no persistence
+        self._local_bans = BanTable()
+        # unified self-healing dialer: one backoff policy for persistent
+        # reconnects AND PEX-discovered addresses
+        self.dialer = Dialer(
+            self._dial_attempt,
+            has_peer=self.peers.has,
+            is_banned=self.is_banned,
+            spawn=self.spawn,
+            is_running=lambda: self.is_running,
+            base_delay=RECONNECT_BASE_DELAY,
+            fast_attempts=MAX_RECONNECT_ATTEMPTS,
+            slow_interval=RECONNECT_MAX_DELAY,
+            max_concurrent=max_concurrent_dials,
+        )
+
+    @property
+    def metrics(self):
+        """libs/metrics.P2PMetrics | None, set by the node when Prometheus
+        is on; propagated to each Peer (per-channel byte counters) and to
+        the dialer (dial attempt/failure counters)."""
+        return self._metrics
+
+    @metrics.setter
+    def metrics(self, m) -> None:
+        self._metrics = m
+        self.dialer.metrics = m
 
     def node_id(self) -> str:
         return self.transport.node_key.id()
@@ -107,6 +168,8 @@ class Switch(BaseService):
         for reactor in self.reactors.values():
             await reactor.stop()
         await self.transport.stop()
+        # trust-store persistence is the injecting owner's duty (the node
+        # saves it on stop); the self-created fallback store has no file
 
     async def _accept_routine(self) -> None:
         while True:
@@ -126,6 +189,77 @@ class Switch(BaseService):
                 self.logger.debug("inbound peer rejected: %s", e)
                 conn.close()
 
+    # --- peer quality: trust, behaviours, bans ---------------------------
+
+    def _ban_backend(self):
+        return self.addr_book if self.addr_book is not None else self._local_bans
+
+    def is_banned(self, peer_id: str) -> bool:
+        return self._ban_backend().is_banned(peer_id)
+
+    def _refresh_ban_gauge(self) -> int:
+        """bans() prunes expired entries as a side effect, so this keeps
+        the gauge honest wherever the ban set is touched or read."""
+        n = len(self._ban_backend().bans())
+        if self.metrics is not None:
+            self.metrics.banned_peers.set(n)
+        return n
+
+    def trust_score(self, peer_id: str) -> int:
+        return self.trust_store.get_peer_trust_metric(peer_id).trust_score()
+
+    async def report_behaviour(self, behaviour: PeerBehaviour, peer=None) -> None:
+        """The ADR-039 sink: feed the trust metric, ban on threshold
+        crossing, disconnect on error behaviours. Reactors reach this via
+        `BaseReactor.report` (behaviour.SwitchReporter forwards here)."""
+        pid = behaviour.peer_id
+        tm = self.trust_store.get_peer_trust_metric(pid)
+        if behaviour.is_bad:
+            tm.bad_event(behaviour.weight)
+        else:
+            tm.good_event(behaviour.weight)
+        if not behaviour.is_bad:
+            return  # good events are the hot path: no recording, no checks
+        score = tm.trust_score()
+        RECORDER.record(
+            "p2p", "behaviour", peer=pid, reason=behaviour.reason[:120],
+            weight=behaviour.weight, score=score,
+        )
+        if self.metrics is not None:
+            self.metrics.behaviour_bad_total.inc()
+        if peer is None:
+            peer = self.peers.get(pid)
+        if (
+            score < self.ban_threshold
+            and tm.total_bad >= self.ban_min_bad_weight
+            and not self.is_banned(pid)
+        ):
+            await self.ban_peer(pid, f"trust score {score} < {self.ban_threshold}"
+                                     f" ({behaviour.reason[:80]})")
+        elif behaviour.is_error and peer is not None:
+            await self.stop_peer_for_error(peer, behaviour.reason)
+
+    async def ban_peer(self, peer_id: str, reason: str) -> None:
+        """Ban + disconnect. The ban lives in the address book (persisted
+        across restarts with its remaining time) or the local fallback."""
+        applied = self._ban_backend().ban(peer_id, self.ban_duration, reason)
+        score = self.trust_score(peer_id)
+        RECORDER.record(
+            "p2p", "peer_banned", peer=peer_id, duration_s=round(applied, 1),
+            score=score, reason=str(reason)[:200],
+        )
+        if self.metrics is not None:
+            self.metrics.peer_bans_total.inc()
+        self._refresh_ban_gauge()
+        self.logger.info("banned peer %s for %.0fs: %s", peer_id, applied, reason)
+        peer = self.peers.get(peer_id)
+        if peer is not None:
+            await self.stop_peer_for_error(peer, f"banned: {reason}")
+
+    def unban_peer(self, peer_id: str) -> None:
+        self._ban_backend().unban(peer_id)
+        self._refresh_ban_gauge()
+
     # --- dialing ---------------------------------------------------------
 
     async def dial_peers_async(
@@ -134,12 +268,7 @@ class Switch(BaseService):
         for addr in addrs:
             if persistent and addr.id:
                 self._persistent_addrs[addr.id] = addr
-            self.spawn(self._dial_one(addr, persistent), f"dial-{addr.id[:8]}")
-
-    async def _dial_one(self, addr: NetAddress, persistent: bool) -> None:
-        ok = await self._dial_attempt(addr, persistent)
-        if not ok and persistent:
-            self._schedule_reconnect(addr)
+            self.dialer.schedule(addr, persistent)
 
     async def _dial_attempt(self, addr: NetAddress, persistent: bool) -> bool:
         """One dial + add-peer attempt with addr-book bookkeeping; returns
@@ -147,6 +276,8 @@ class Switch(BaseService):
         key = addr.id or addr.dial_string()
         if key in self._dialing or (addr.id and self.peers.has(addr.id)):
             return True
+        from tendermint_tpu.p2p.transport import RejectedError
+
         self._dialing.add(key)
         try:
             # jitter so a restarted network doesn't dial in lockstep
@@ -166,28 +297,6 @@ class Switch(BaseService):
         finally:
             self._dialing.discard(key)
 
-    def _schedule_reconnect(self, addr: NetAddress) -> None:
-        if addr.id in self._reconnecting or not self.is_running:
-            return
-        self._reconnecting.add(addr.id)
-        self.spawn(self._reconnect_routine(addr), f"reconnect-{addr.id[:8]}")
-
-    async def _reconnect_routine(self, addr: NetAddress) -> None:
-        """Exponential backoff redial for persistent peers
-        (reference switch.go:362 reconnectToPeer)."""
-        try:
-            delay = RECONNECT_BASE_DELAY
-            for _ in range(MAX_RECONNECT_ATTEMPTS):
-                await asyncio.sleep(delay * (1 + random.random() * 0.1))
-                if not self.is_running or self.peers.has(addr.id):
-                    return
-                if await self._dial_attempt(addr, persistent=True):
-                    return
-                delay = min(delay * 2, RECONNECT_MAX_DELAY)
-            self.logger.info("gave up reconnecting to %s", addr)
-        finally:
-            self._reconnecting.discard(addr.id)
-
     # --- peer management -------------------------------------------------
 
     async def _add_peer(
@@ -195,6 +304,13 @@ class Switch(BaseService):
     ) -> Peer:
         if ni.node_id == self.node_id():
             raise SwitchError("self connection")
+        if self.is_banned(ni.node_id):
+            # the quality gate: banned peers are refused on accept AND
+            # dial until the ban decays (reference ADR-039 direction)
+            RECORDER.record(
+                "p2p", "banned_reject", peer=ni.node_id, outbound=outbound,
+            )
+            raise SwitchError(f"peer {ni.node_id} is banned")
         if self.peers.has(ni.node_id):
             raise SwitchError(f"already connected to {ni.node_id}")
         persistent = persistent or ni.node_id in self._persistent_addrs
@@ -234,6 +350,8 @@ class Switch(BaseService):
             self.peers.remove(peer)
             await peer.stop()
             raise
+        # a live link stops the empty-interval decay of the trust history
+        self.trust_store.get_peer_trust_metric(peer.id).good_event(0.0)
         RECORDER.record("p2p", "peer_connected", peer=peer.id, outbound=outbound)
         if self.metrics is not None:
             self.metrics.peers.set(len(self.peers))
@@ -243,7 +361,12 @@ class Switch(BaseService):
     async def _route_receive(self, ch_id: int, peer: Peer, msg: bytes) -> None:
         reactor = self._reactors_by_ch.get(ch_id)
         if reactor is None:
-            await self.stop_peer_for_error(peer, f"msg on unclaimed channel {ch_id:#x}")
+            await self.report_behaviour(
+                PeerBehaviour.bad_message(
+                    peer.id, f"msg on unclaimed channel {ch_id:#x}"
+                ),
+                peer=peer,
+            )
             return
         await reactor.receive(ch_id, peer, msg)
 
@@ -259,13 +382,16 @@ class Switch(BaseService):
         if peer.persistent and self.is_running:
             addr = self._persistent_addrs.get(peer.id) or peer.socket_addr
             if addr is not None and addr.id:
-                self._schedule_reconnect(addr)
+                self.dialer.schedule(addr, persistent=True)
 
     async def stop_peer_gracefully(self, peer: Peer) -> None:
         await self._stop_and_remove(peer, "graceful stop")
 
     async def _stop_and_remove(self, peer: Peer, reason) -> None:
         self.peers.remove(peer)
+        # stop charging elapsed empty intervals against a peer we are no
+        # longer connected to (reference trust store PeerDisconnected)
+        self.trust_store.peer_disconnected(peer.id)
         RECORDER.record("p2p", "peer_disconnected", peer=peer.id,
                         reason=str(reason)[:200])
         if self.metrics is not None:
@@ -287,3 +413,28 @@ class Switch(BaseService):
     def num_peers(self) -> tuple[int, int]:
         out = sum(1 for p in self.peers.list() if p.outbound)
         return out, len(self.peers) - out
+
+    # --- introspection (debug_p2p route) ---------------------------------
+
+    def quality_snapshot(self) -> dict:
+        """Trust scores, live bans, and dialer state for debug_p2p."""
+        scores = {
+            pid: tm.trust_score()
+            for pid, tm in self.trust_store.metrics.items()
+        }
+        self._refresh_ban_gauge()  # debug_p2p reads re-sync expiry
+        return {
+            "peers": [
+                {
+                    "id": p.id,
+                    "outbound": p.outbound,
+                    "persistent": p.persistent,
+                    "trust_score": scores.get(p.id, 100),
+                }
+                for p in self.peers.list()
+            ],
+            "trust": scores,
+            "bans": self._ban_backend().bans(),
+            "ban_threshold": self.ban_threshold,
+            "dialer": self.dialer.snapshot(),
+        }

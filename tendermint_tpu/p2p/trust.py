@@ -42,16 +42,23 @@ class TrustMetric:
         self.history: list[float] = []
         self._interval_start = now()
         self.paused = False
+        # lifetime accumulators (this process): the ban decision requires
+        # a minimum total_bad so one unlucky frame can tank the SCORE
+        # without triggering a ban (docs/p2p_resilience.md)
+        self.total_good = 0.0
+        self.total_bad = 0.0
 
     def good_event(self, weight: float = 1.0) -> None:
         self._tick()
         self.paused = False
         self.good += weight
+        self.total_good += weight
 
     def bad_event(self, weight: float = 1.0) -> None:
         self._tick()
         self.paused = False
         self.bad += weight
+        self.total_bad += weight
 
     def pause(self) -> None:
         """Stop counting elapsed empty intervals against the peer
@@ -108,10 +115,25 @@ class TrustMetric:
 
 
 class TrustMetricStore:
-    """Per-peer metrics with JSON persistence (reference store.go)."""
+    """Per-peer metrics with JSON persistence (reference store.go).
 
-    def __init__(self, file_path: str | None = None, **metric_kwargs) -> None:
+    Bounded: a public node sees an open-ended stream of freshly minted
+    node ids (handshakes are cheap), so the in-memory map caps at
+    `max_metrics` — when full, PAUSED (disconnected) metrics with the
+    least interesting reputation (highest trust, least bad history) are
+    evicted first; live peers and known offenders are never displaced by
+    strangers. Persistence mirrors that: near-perfect scores carry no
+    information (a fresh metric starts at 1.0) and are not written, so
+    the JSON holds only peers with an actual track record.
+    """
+
+    # trust values at/above this are indistinguishable from "never seen"
+    UNINFORMATIVE = 0.95
+
+    def __init__(self, file_path: str | None = None,
+                 max_metrics: int = 10_000, **metric_kwargs) -> None:
         self.file_path = file_path
+        self.max_metrics = max_metrics
         self.metric_kwargs = metric_kwargs
         self.metrics: dict[str, TrustMetric] = {}
         self._saved_scores: dict[str, float] = {}
@@ -125,12 +147,29 @@ class TrustMetricStore:
     def get_peer_trust_metric(self, peer_id: str) -> TrustMetric:
         tm = self.metrics.get(peer_id)
         if tm is None:
+            if len(self.metrics) >= self.max_metrics:
+                self._evict_one()
             tm = TrustMetric(**self.metric_kwargs)
             saved = self._saved_scores.get(peer_id)
             if saved is not None:
                 tm.history = [saved]
             self.metrics[peer_id] = tm
         return tm
+
+    def _evict_one(self) -> None:
+        """Drop the least informative DISCONNECTED metric: highest trust,
+        fewest bad events. Falls back to the globally least-bad entry if
+        everything is somehow live (cap misconfigured below peer count)."""
+        candidates = [
+            (tm.total_bad, -tm._history_value(), pid)
+            for pid, tm in self.metrics.items()
+            if tm.paused
+        ] or [
+            (tm.total_bad, -tm._history_value(), pid)
+            for pid, tm in self.metrics.items()
+        ]
+        candidates.sort()
+        self.metrics.pop(candidates[0][2], None)
 
     def peer_disconnected(self, peer_id: str) -> None:
         tm = self.metrics.get(peer_id)
@@ -140,9 +179,16 @@ class TrustMetricStore:
     def save(self) -> None:
         if not self.file_path:
             return
-        scores = dict(self._saved_scores)
+        scores = {
+            pid: v for pid, v in self._saved_scores.items()
+            if v < self.UNINFORMATIVE
+        }
         for pid, tm in self.metrics.items():
-            scores[pid] = tm.trust_value()
+            v = tm.trust_value()
+            if v < self.UNINFORMATIVE:
+                scores[pid] = v
+            else:
+                scores.pop(pid, None)  # reputation re-earned: forget
         tmp = self.file_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(scores, f)

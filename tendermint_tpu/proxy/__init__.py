@@ -66,6 +66,18 @@ def default_client_creator(
         from tendermint_tpu.abci.examples import KVStoreApplication
 
         return LocalClientCreator(KVStoreApplication())
+    if proxy_app == "persistent_kvstore" or proxy_app.startswith(
+        "persistent_kvstore:"
+    ):
+        # "persistent_kvstore:<dir>" — disk persistence + validator-update
+        # txs (reference abci-cli "kvstore <dir>"); the dir rides in the
+        # proxy_app string so each testnet node gets its own state file
+        from tendermint_tpu.abci.examples import PersistentKVStoreApplication
+
+        _, _, app_dir = proxy_app.partition(":")
+        return LocalClientCreator(
+            PersistentKVStoreApplication(app_dir or "kvstore-data")
+        )
     if proxy_app == "counter":
         from tendermint_tpu.abci.examples import CounterApplication
 

@@ -596,6 +596,22 @@ class Environment:
             ),
         }
 
+    async def debug_p2p(self) -> dict:
+        """Peer-quality plane (docs/p2p_resilience.md): per-peer trust
+        scores from the behaviour-fed metric, live bans with remaining
+        time and escalation count, the ban threshold in force, and the
+        unified dialer's per-target state (phase fast/slow/banned,
+        attempts, time to next attempt)."""
+        from tendermint_tpu.libs.recorder import RECORDER, clock_anchor
+
+        sw = self.p2p_switch
+        if sw is None or not hasattr(sw, "quality_snapshot"):
+            return {"peers": [], "trust": {}, "bans": [], "dialer": {}}
+        out = sw.quality_snapshot()
+        out["moniker"] = RECORDER.moniker
+        out["anchor"] = clock_anchor()
+        return out
+
     async def debug_fault(
         self,
         action: str = "state",
@@ -942,6 +958,7 @@ class Environment:
             "debug_consensus_trace": self.debug_consensus_trace,
             "debug_device": self.debug_device,
             "debug_flight_recorder": self.debug_flight_recorder,
+            "debug_p2p": self.debug_p2p,
             "debug_fault": self.debug_fault,
             "broadcast_tx_async": self.broadcast_tx_async,
             "broadcast_tx_sync": self.broadcast_tx_sync,

@@ -158,6 +158,58 @@ class TestPriorityStore:
         assert len(pool.evidence_list) == 1
 
 
+class TestRestartDurability:
+    """ISSUE 9: pending evidence must survive a PROCESS restart — the
+    pool over the node's durable SQLite backend, reopened cold, must
+    still know, gossip, and commit what it knew before."""
+
+    def test_pending_survives_sqlite_reopen(self, tmp_path):
+        from tendermint_tpu.libs.db import SQLiteDB
+
+        pvs, vs, state, store = make_fixture(powers=(10, 20, 30))
+        path = str(tmp_path / "evidence.db")
+        db = SQLiteDB(path)
+        pool = EvidencePool(db, store, state)
+        evs = [make_evidence(pv, vs) for pv in pvs]
+        for ev in evs:
+            pool.add_evidence(ev)
+        pool.mark_broadcasted(evs[0])  # off the outqueue, still pending
+        pool.mark_committed([evs[1]])
+        db.close()  # the "restart"
+
+        db2 = SQLiteDB(path)
+        pool2 = EvidencePool(db2, store, state)
+        assert pool2.is_pending(evs[0]) and pool2.is_pending(evs[2])
+        assert pool2.is_committed(evs[1]) and not pool2.is_pending(evs[1])
+        # gossip list reseeded with exactly the uncommitted evidence
+        listed = {el.value.hash() for el in pool2.evidence_list}
+        assert listed == {evs[0].hash(), evs[2].hash()}
+        # outqueue priority (voting power) survived the round trip
+        prio = pool2.priority_evidence()
+        assert [ev.hash() for ev in prio] == [evs[2].hash()]
+        # and commit still lands after the restart
+        class _Blk:
+            evidence = [evs[0], evs[2]]
+
+        pool2.update(_Blk(), state)
+        assert pool2.is_committed(evs[0]) and pool2.is_committed(evs[2])
+        assert len(pool2.evidence_list) == 0
+        db2.close()
+
+    def test_metrics_fed_across_lifecycle(self):
+        from tendermint_tpu.libs.metrics import Collector, EvidenceMetrics
+
+        pvs, vs, state, store = make_fixture()
+        pool = EvidencePool(MemDB(), store, state)
+        pool.metrics = EvidenceMetrics(Collector("t"))
+        ev = make_evidence(pvs[0], vs)
+        pool.add_evidence(ev)
+        assert pool.metrics.pending._values[()] == 1
+        pool.mark_committed([ev])
+        assert pool.metrics.pending._values[()] == 0
+        assert pool.metrics.committed_total._values[()] == 1
+
+
 class _StubPeer:
     def __init__(self, pid="peer0"):
         self.id = pid

@@ -67,7 +67,10 @@ class TestBcFSM:
             senders[h] = pid
             fsm.handle(Event.BLOCK_RESPONSE, peer_id=pid, block=FakeBlock(h))
         eff = fsm.handle(Event.PROCESSED_BLOCK, err=ValueError("bad commit"))
-        errored = {e[1] for e in eff if e[0] == "error"}
+        # invalid blocks surface as the distinct "bad_block" effect (the
+        # reactor maps it to the heaviest trust penalty)
+        errored = {e[1] for e in eff if e[0] in ("error", "bad_block")}
+        assert any(e[0] == "bad_block" for e in eff)
         assert set(senders.values()) <= errored
         assert fsm.height == 1  # not advanced
         for pid in senders.values():

@@ -64,3 +64,39 @@ def test_nemesis_crash_sweep(monkeypatch):
     if not os.environ.get("TMTPU_CRASH_INDEXES"):
         monkeypatch.setenv("TMTPU_CRASH_INDEXES", "0,2,7")
     nemesis.run(["nemesis_crash_sweep"], n=4)
+
+
+def test_nemesis_peer_garbage_storm():
+    """ISSUE 9 acceptance: a peer spewing malformed frames on three
+    reactor channels is BANNED within a bounded window (trust score below
+    threshold, peer_banned event, live ban series), stays banned across
+    redials, and the chain keeps committing with clean fleet invariants."""
+    nemesis.run(["nemesis_peer_garbage_storm"], n=4)
+
+
+def test_nemesis_torn_wal():
+    """ISSUE 9 acceptance: a WAL torn mid-frame auto-repairs at open
+    (.corrupt sidecar preserved), the node replays and re-converges with
+    app-hash agreement."""
+    nemesis.run(["nemesis_torn_wal"], n=4)
+
+
+@pytest.mark.slow
+def test_nemesis_evidence_restart():
+    """ISSUE 9 acceptance: evidence pending before a restart is still
+    committed in a block after it."""
+    nemesis.run(["nemesis_evidence_restart"], n=4)
+
+
+@pytest.mark.slow
+def test_nemesis_valset_churn():
+    """ROADMAP item 5 residue: validator-set churn under partition —
+    heal and catch up to the new set with zero divergence."""
+    nemesis.run(["nemesis_valset_churn"], n=4)
+
+
+@pytest.mark.slow
+def test_nemesis_combined():
+    """ROADMAP item 5 residue: partition + flapping breaker + flood at
+    once; chain keeps committing and health stays truthful."""
+    nemesis.run(["nemesis_combined"], n=4)

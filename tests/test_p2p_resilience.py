@@ -1,0 +1,605 @@
+"""Self-healing peer plane tests (ISSUE 9): trust-metric decay math, ban
+threshold crossing + expiry, address-book ban persistence, the unified
+backoff dialer (incl. the persistent-peer regression the old
+MAX_RECONNECT_ATTEMPTS cap failed), and the switch's behaviour-report →
+trust → ban pipeline.
+
+Everything here is crypto-free by construction (the p2p package exports
+lazily): the switch is exercised with stub transports/peers; the real
+wire-level path is covered by the nemesis_peer_garbage_storm scenario.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+from types import SimpleNamespace
+
+import pytest
+
+from tendermint_tpu.behaviour import MockReporter, PeerBehaviour
+from tendermint_tpu.p2p.dialer import Dialer
+from tendermint_tpu.p2p.netaddress import NetAddress
+from tendermint_tpu.p2p.pex.addrbook import AddrBook
+from tendermint_tpu.p2p.switch import Switch
+from tendermint_tpu.p2p.trust import TrustMetric, TrustMetricStore
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---------------------------------------------------------------------------
+# trust metric decay math
+
+
+class TestTrustMetric:
+    def _tm(self, **kw):
+        clock = [0.0]
+        tm = TrustMetric(now=lambda: clock[0], **kw)
+        return tm, clock
+
+    def test_starts_fully_trusted(self):
+        tm, _ = self._tm()
+        assert tm.trust_value() == 1.0
+        assert tm.trust_score() == 100
+
+    def test_bad_events_tank_current_interval(self):
+        tm, _ = self._tm()
+        tm.bad_event(3.0)
+        # cur=0, hist=1.0 -> 0.8*0 + 0.2*1 + derivative penalty -1*0.5 -> 0
+        assert tm.trust_value() == 0.0
+        assert tm.total_bad == 3.0
+
+    def test_good_events_dilute_bad(self):
+        tm, _ = self._tm()
+        for _ in range(99):
+            tm.good_event()
+        tm.bad_event()
+        assert tm.trust_score() > 90
+
+    def test_interval_rollover_into_history(self):
+        tm, clock = self._tm(interval=10.0)
+        tm.bad_event()  # interval 0: score 0
+        clock[0] = 10.0
+        tm.good_event()  # rolls interval 0 into history
+        assert tm.history == [0.0]
+        # current interval all-good, history bad: proportional part
+        # dominates and the derivative penalty does not apply (d > 0)
+        assert 0.75 <= tm.trust_value() <= 0.85
+
+    def test_empty_intervals_are_neutral(self):
+        tm, clock = self._tm(interval=10.0)
+        tm.bad_event()
+        clock[0] = 50.0  # 4 empty intervals elapse
+        tm.good_event()
+        # empty intervals append neutral 1.0, fading the bad interval
+        assert tm.history[0] == 0.0
+        assert all(v == 1.0 for v in tm.history[1:])
+        assert tm.trust_score() > 80
+
+    def test_history_recency_weighting(self):
+        tm, clock = self._tm(interval=10.0)
+        # old bad interval, then many good ones: value recovers (decay)
+        tm.bad_event()
+        for i in range(1, 9):
+            clock[0] = 10.0 * i
+            tm.good_event()
+        early = tm.trust_value()
+        clock[0] = 90.0
+        tm.good_event()
+        assert tm.trust_value() >= early > 0.8
+
+    def test_pause_stops_empty_interval_accrual(self):
+        tm, clock = self._tm(interval=10.0)
+        tm.bad_event()
+        tm.pause()
+        clock[0] = 1000.0  # a long disconnection
+        # pausing froze history accrual: only the real (bad) interval rolls
+        tm.good_event()
+        assert tm.history == [0.0]
+
+    def test_max_history_bounded(self):
+        tm, clock = self._tm(interval=10.0, max_history=4)
+        for i in range(1, 20):
+            clock[0] = 10.0 * i
+            tm.good_event()
+        assert len(tm.history) <= 4
+
+    def test_score_clamped(self):
+        tm, _ = self._tm()
+        for _ in range(50):
+            tm.bad_event(10.0)
+        assert tm.trust_score() == 0
+        assert tm.trust_value() >= 0.0
+
+
+class TestTrustStore:
+    def test_save_load_roundtrip(self, tmp_path):
+        path = str(tmp_path / "trust.json")
+        store = TrustMetricStore(path)
+        tm = store.get_peer_trust_metric("peer-a")
+        for _ in range(10):
+            tm.bad_event(5.0)
+        store.save()
+        store2 = TrustMetricStore(path)
+        tm2 = store2.get_peer_trust_metric("peer-a")
+        # the saved low value seeds the restored metric's history
+        assert tm2.trust_score() < 50
+        # unknown peers still start trusted
+        assert store2.get_peer_trust_metric("peer-b").trust_score() == 100
+
+    def test_disconnect_pauses(self):
+        store = TrustMetricStore()
+        tm = store.get_peer_trust_metric("p")
+        store.peer_disconnected("p")
+        assert tm.paused
+
+    def test_capped_store_evicts_benign_strangers_first(self):
+        """A public node sees an open-ended stream of cheap fresh node
+        ids; the store must stay bounded, shedding disconnected
+        clean-history peers — never live peers or known offenders."""
+        store = TrustMetricStore(max_metrics=4)
+        offender = store.get_peer_trust_metric("offender")
+        offender.bad_event(10.0)
+        store.peer_disconnected("offender")
+        store.get_peer_trust_metric("live")  # stays unpaused
+        for i in range(10):
+            store.get_peer_trust_metric(f"stranger-{i}")
+            store.peer_disconnected(f"stranger-{i}")
+        assert store.size() <= 4
+        assert "offender" in store.metrics  # bad history is retained
+        assert "live" in store.metrics  # live peers never displaced
+
+    def test_save_skips_uninformative_scores(self, tmp_path):
+        path = str(tmp_path / "trust.json")
+        store = TrustMetricStore(path)
+        store.get_peer_trust_metric("clean")  # perfect score: no record
+        bad = store.get_peer_trust_metric("bad")
+        for _ in range(10):
+            bad.bad_event(5.0)
+        store.save()
+        with open(path, encoding="utf-8") as f:
+            saved = json.load(f)
+        assert "bad" in saved and "clean" not in saved
+
+
+# ---------------------------------------------------------------------------
+# address-book bans
+
+
+def _addr(i: int, port: int = 26656) -> NetAddress:
+    return NetAddress(("%02x" % i) * 20, f"10.0.0.{i}", port)
+
+
+class TestAddrBookBans:
+    def _book(self, tmp_path=None, mono=0.0, wall=1_700_000_000.0):
+        clocks = {"mono": [mono], "wall": [wall]}
+        book = AddrBook(
+            file_path=str(tmp_path / "book.json") if tmp_path else None,
+            clock=lambda: clocks["mono"][0],
+            wall=lambda: clocks["wall"][0],
+        )
+        return book, clocks
+
+    def test_ban_and_expiry(self):
+        book, clocks = self._book()
+        a = _addr(1)
+        assert book.ban(a.id, 100.0, "garbage") == 100.0
+        assert book.is_banned(a.id)
+        clocks["mono"][0] = 99.0
+        assert book.is_banned(a.id)
+        clocks["mono"][0] = 101.0
+        assert not book.is_banned(a.id)
+
+    def test_repeat_offender_doubles(self):
+        book, clocks = self._book()
+        a = _addr(1)
+        assert book.ban(a.id, 100.0) == 100.0
+        clocks["mono"][0] = 200.0  # first ban expired
+        assert not book.is_banned(a.id)
+        assert book.ban(a.id, 100.0) == 200.0  # escalation survives expiry
+        assert book.ban(a.id, 100.0) == 400.0
+
+    def test_banned_excluded_from_pick_and_selection(self):
+        book, _ = self._book()
+        for i in range(1, 6):
+            book.add_address(_addr(i), src_id="src")
+        book.ban(_addr(3).id, 1000.0)
+        for _ in range(50):
+            picked = book.pick_address()
+            assert picked is not None and picked.id != _addr(3).id
+        assert all(a.id != _addr(3).id for a in book.get_selection(100))
+
+    def test_ban_persistence_roundtrip_keeps_remaining_time(self, tmp_path):
+        """The PR 2 monotonic-clock treatment applied to bans: the file
+        stores a wall-clock expiry; a restart restores the REMAINING ban
+        time onto the new process's monotonic clock."""
+        book, clocks = self._book(tmp_path)
+        a = _addr(1)
+        book.ban(a.id, 600.0, reason="storm")
+        clocks["mono"][0] += 100.0  # 100s pass before the save
+        book.save()
+
+        # restart: fresh monotonic origin, 200 wall seconds later
+        clocks2 = {"mono": [7.0], "wall": [clocks["wall"][0] + 200.0]}
+        book2 = AddrBook(
+            file_path=str(tmp_path / "book.json"),
+            clock=lambda: clocks2["mono"][0],
+            wall=lambda: clocks2["wall"][0],
+        )
+        assert book2.is_banned(a.id)
+        bans = book2.bans()
+        assert len(bans) == 1
+        # 600 total - 100 before save - 200 down = ~300 remaining
+        assert abs(bans[0]["remaining_s"] - 300.0) < 1.0
+        assert bans[0]["reason"] == "storm"
+        clocks2["mono"][0] += 301.0
+        assert not book2.is_banned(a.id)
+
+    def test_expired_ban_not_restored(self, tmp_path):
+        book, clocks = self._book(tmp_path)
+        book.ban(_addr(1).id, 50.0)
+        book.save()
+        clocks2 = {"mono": [0.0], "wall": [clocks["wall"][0] + 100.0]}
+        book2 = AddrBook(
+            file_path=str(tmp_path / "book.json"),
+            clock=lambda: clocks2["mono"][0],
+            wall=lambda: clocks2["wall"][0],
+        )
+        assert not book2.is_banned(_addr(1).id)
+        assert book2.bans() == []
+
+    def test_ban_file_format_readable(self, tmp_path):
+        book, _ = self._book(tmp_path)
+        book.ban(_addr(1).id, 600.0, reason="why")
+        book.save()
+        with open(tmp_path / "book.json", encoding="utf-8") as f:
+            doc = json.load(f)
+        assert doc["bans"][0]["id"] == _addr(1).id
+        assert doc["bans"][0]["reason"] == "why"
+        assert doc["bans"][0]["expires"] > 1_000_000_000  # wall time
+
+
+# ---------------------------------------------------------------------------
+# unified dialer
+
+
+class _DialHarness:
+    """Stub dial plane: scripted attempt outcomes, spawn on the loop."""
+
+    def __init__(self, outcomes):
+        self.outcomes = list(outcomes)  # pop(0) per attempt; [] -> fail
+        self.attempts = 0
+        self.in_flight = 0
+        self.max_in_flight = 0
+        self.tasks: list[asyncio.Task] = []
+        self.banned: set[str] = set()
+        self.connected: set[str] = set()
+
+    async def dial_attempt(self, addr, persistent):
+        self.attempts += 1
+        self.in_flight += 1
+        self.max_in_flight = max(self.max_in_flight, self.in_flight)
+        await asyncio.sleep(0.01)
+        self.in_flight -= 1
+        ok = self.outcomes.pop(0) if self.outcomes else False
+        if ok:
+            self.connected.add(addr.id)
+        return ok
+
+    def spawn(self, coro, name=None):
+        t = asyncio.get_event_loop().create_task(coro, name=name)
+        self.tasks.append(t)
+        return t
+
+    def dialer(self, **kw):
+        kw.setdefault("base_delay", 0.01)
+        kw.setdefault("max_delay", 0.05)
+        kw.setdefault("fast_attempts", 3)
+        kw.setdefault("slow_interval", 0.05)
+        kw.setdefault("transient_attempts", 2)
+        kw.setdefault("min_gap", 0.0)
+        return Dialer(
+            self.dial_attempt,
+            has_peer=lambda pid: pid in self.connected,
+            is_banned=lambda pid: pid in self.banned,
+            spawn=self.spawn,
+            is_running=lambda: True,
+            **kw,
+        )
+
+    async def drain(self):
+        for t in self.tasks:
+            if not t.done():
+                t.cancel()
+        await asyncio.gather(*self.tasks, return_exceptions=True)
+
+
+class TestDialer:
+    def test_persistent_peer_redialed_past_old_cap(self):
+        """REGRESSION (ISSUE 9 satellite): the old Switch._reconnect_routine
+        gave up on persistent peers after MAX_RECONNECT_ATTEMPTS. The
+        unified dialer's slow phase must keep redialing a persistent peer
+        until it comes back — here the peer only answers on attempt 6,
+        twice past the fast-phase cap of 3."""
+        async def main():
+            h = _DialHarness([False] * 5 + [True])
+            d = h.dialer()
+            d.schedule(_addr(1), persistent=True)
+            await asyncio.wait_for(h.tasks[0], 10.0)
+            assert h.attempts == 6
+            assert _addr(1).id in h.connected
+            await h.drain()
+
+        run(main())
+
+    def test_transient_gives_up(self):
+        async def main():
+            h = _DialHarness([])  # always fail
+            d = h.dialer()
+            d.schedule(_addr(1), persistent=False)
+            await asyncio.wait_for(h.tasks[0], 10.0)
+            assert h.attempts == 2  # transient_attempts
+            await h.drain()
+
+        run(main())
+
+    def test_banned_persistent_waits_and_resumes(self):
+        async def main():
+            h = _DialHarness([True])
+            h.banned.add(_addr(1).id)
+            d = h.dialer(slow_interval=0.02)
+            d.schedule(_addr(1), persistent=True)
+            await asyncio.sleep(0.05)
+            assert h.attempts == 0  # never dialed while banned
+            assert d.snapshot()[_addr(1).id]["phase"] == "banned"
+            h.banned.clear()  # the ban decays
+            await asyncio.wait_for(h.tasks[0], 10.0)
+            assert _addr(1).id in h.connected
+            await h.drain()
+
+        run(main())
+
+    def test_banned_transient_dropped(self):
+        async def main():
+            h = _DialHarness([True])
+            h.banned.add(_addr(1).id)
+            d = h.dialer()
+            d.schedule(_addr(1), persistent=False)
+            await asyncio.wait_for(h.tasks[0], 10.0)
+            assert h.attempts == 0
+            await h.drain()
+
+        run(main())
+
+    def test_concurrency_cap(self):
+        async def main():
+            h = _DialHarness([True] * 16)
+            d = h.dialer(max_concurrent=2)
+            for i in range(1, 9):
+                d.schedule(_addr(i))
+            await asyncio.gather(*h.tasks)
+            assert h.attempts == 8
+            assert h.max_in_flight <= 2
+            await h.drain()
+
+        run(main())
+
+    def test_schedule_dedupes_live_loops(self):
+        async def main():
+            h = _DialHarness([False, True])
+            d = h.dialer()
+            d.schedule(_addr(1), persistent=True)
+            d.schedule(_addr(1), persistent=True)  # no second loop
+            await asyncio.wait_for(h.tasks[0], 10.0)
+            assert len(h.tasks) == 1
+            await h.drain()
+
+        run(main())
+
+    def test_already_connected_short_circuits(self):
+        async def main():
+            h = _DialHarness([])
+            h.connected.add(_addr(1).id)
+            d = h.dialer()
+            d.schedule(_addr(1), persistent=True)
+            await asyncio.wait_for(h.tasks[0], 10.0)
+            assert h.attempts == 0
+            await h.drain()
+
+        run(main())
+
+    def test_persistent_schedule_upgrades_live_transient_loop(self):
+        """A PEX sweep can race the node's own persistent-peer dial for
+        the SAME address: if the transient loop wins the schedule, the
+        later persistent schedule must upgrade it — a configured
+        validator peer must never inherit give-up-after-3 semantics."""
+        async def main():
+            h = _DialHarness([False] * 5 + [True])
+            d = h.dialer()
+            d.schedule(_addr(1), persistent=False)  # PEX got there first
+            await asyncio.sleep(0.005)
+            d.schedule(_addr(1), persistent=True)  # the node's own dial
+            # the upgraded loop outlives the transient cap (2) and keeps
+            # going through the slow phase until the peer answers
+            deadline = asyncio.get_event_loop().time() + 10.0
+            while _addr(1).id not in h.connected:
+                assert asyncio.get_event_loop().time() < deadline
+                await asyncio.sleep(0.01)
+            assert h.attempts >= 5
+            await h.drain()
+
+        run(main())
+
+    def test_min_gap_throttles_starts(self):
+        async def main():
+            import time as _time
+
+            h = _DialHarness([True] * 4)
+            d = h.dialer(min_gap=0.03, max_concurrent=8)
+            t0 = _time.monotonic()
+            for i in range(1, 5):
+                d.schedule(_addr(i))
+            await asyncio.gather(*h.tasks)
+            # 4 starts spaced >= 0.03 apart -> >= 0.09s total
+            assert _time.monotonic() - t0 >= 0.08
+            await h.drain()
+
+        run(main())
+
+
+# ---------------------------------------------------------------------------
+# switch: behaviour reports -> trust -> bans
+
+
+class _FakePeer:
+    def __init__(self, pid: str, persistent: bool = False):
+        self.id = pid
+        self.persistent = persistent
+        self.outbound = False
+        self.socket_addr = None
+        self.metrics = None
+        self.stops = 0
+
+    async def stop(self):
+        self.stops += 1
+
+
+def _stub_switch(**kw) -> Switch:
+    transport = SimpleNamespace(
+        node_key=SimpleNamespace(id=lambda: "self-id"),
+    )
+    kw.setdefault("ban_duration", 60.0)
+    return Switch(transport, **kw)
+
+
+class TestSwitchQuality:
+    def test_single_bad_message_disconnects_but_does_not_ban(self):
+        async def main():
+            sw = _stub_switch(ban_min_bad_weight=6.0)
+            p = _FakePeer("peer-a")
+            sw.peers.add(p)
+            await sw.report_behaviour(
+                PeerBehaviour.bad_message("peer-a", "garbage"), peer=p
+            )
+            assert p.stops == 1  # disconnected
+            assert not sw.is_banned("peer-a")  # but not banned yet
+
+        run(main())
+
+    def test_accumulated_garbage_bans(self):
+        async def main():
+            sw = _stub_switch(ban_min_bad_weight=6.0, ban_threshold=20)
+            p = _FakePeer("peer-a")
+            sw.peers.add(p)
+            await sw.report_behaviour(
+                PeerBehaviour.bad_message("peer-a", "g1"), peer=p
+            )
+            # the peer "reconnects" and spews again
+            sw.peers.add(p)
+            await sw.report_behaviour(
+                PeerBehaviour.bad_message("peer-a", "g2"), peer=p
+            )
+            assert sw.is_banned("peer-a")
+            assert sw.trust_score("peer-a") < sw.ban_threshold
+            snap = sw.quality_snapshot()
+            assert snap["bans"] and snap["bans"][0]["id"] == "peer-a"
+
+        run(main())
+
+    def test_good_traffic_outweighs_one_bad_frame(self):
+        async def main():
+            sw = _stub_switch()
+            p = _FakePeer("peer-a")
+            sw.peers.add(p)
+            for _ in range(200):
+                await sw.report_behaviour(
+                    PeerBehaviour.consensus_vote("peer-a"), peer=p
+                )
+            await sw.report_behaviour(
+                PeerBehaviour.bad_message("peer-a", "one-off"), peer=p
+            )
+            assert sw.trust_score("peer-a") > 80
+            assert not sw.is_banned("peer-a")
+
+        run(main())
+
+    def test_non_error_bad_behaviours_keep_peer(self):
+        async def main():
+            sw = _stub_switch()
+            p = _FakePeer("peer-a")
+            sw.peers.add(p)
+            await sw.report_behaviour(
+                PeerBehaviour.unverifiable_evidence("peer-a", "too old"), peer=p
+            )
+            await sw.report_behaviour(
+                PeerBehaviour.bad_tx("peer-a", "code 1"), peer=p
+            )
+            assert p.stops == 0  # never disconnected
+            assert sw.trust_score("peer-a") < 100
+
+        run(main())
+
+    def test_banned_peer_rejected_on_add(self):
+        async def main():
+            sw = _stub_switch()
+            await sw.ban_peer("peer-a", "test ban")
+            ni = SimpleNamespace(node_id="peer-a")
+            with pytest.raises(Exception, match="banned"):
+                await sw._add_peer(None, ni, outbound=False)
+
+        run(main())
+
+    def test_ban_uses_addr_book_when_present(self):
+        async def main():
+            sw = _stub_switch()
+            book = AddrBook()
+            sw.addr_book = book
+            await sw.ban_peer("peer-a", "book ban")
+            assert book.is_banned("peer-a")
+            assert sw.is_banned("peer-a")
+            sw.unban_peer("peer-a")
+            assert not sw.is_banned("peer-a")
+
+        run(main())
+
+    def test_heavy_bad_block_escalates_faster(self):
+        async def main():
+            sw = _stub_switch(ban_min_bad_weight=6.0)
+            p = _FakePeer("peer-a")
+            sw.peers.add(p)
+            # two invalid fast-sync blocks (weight 5 each) cross the
+            # accumulation floor where two weight-3 frames would not
+            await sw.report_behaviour(
+                PeerBehaviour.bad_block("peer-a", "h=5"), peer=p
+            )
+            sw.peers.add(p)
+            await sw.report_behaviour(
+                PeerBehaviour.bad_block("peer-a", "h=6"), peer=p
+            )
+            assert sw.is_banned("peer-a")
+
+        run(main())
+
+
+class TestBehaviourVocabulary:
+    def test_axes(self):
+        assert PeerBehaviour.bad_message("p", "x").is_error
+        assert PeerBehaviour.bad_message("p", "x").is_bad
+        assert not PeerBehaviour.unverifiable_evidence("p", "x").is_error
+        assert PeerBehaviour.unverifiable_evidence("p", "x").is_bad
+        assert not PeerBehaviour.bad_tx("p", "x").is_error
+        assert PeerBehaviour.bad_tx("p", "x").is_bad
+        assert not PeerBehaviour.consensus_vote("p").is_bad
+        assert not PeerBehaviour.block_part("p").is_bad
+        assert not PeerBehaviour.good_tx("p").is_bad
+        assert PeerBehaviour.bad_block("p", "x").weight > \
+            PeerBehaviour.bad_message("p", "x").weight
+
+    def test_mock_reporter_records(self):
+        async def main():
+            r = MockReporter()
+            await r.report(PeerBehaviour.bad_message("p", "x"))
+            assert len(r.get_behaviours("p")) == 1
+
+        run(main())

@@ -1,0 +1,113 @@
+"""asyncio.timeout 3.10 backport tests (tendermint_tpu/_pycompat.py).
+
+On 3.11+ the stdlib implementation is used and these assert the same
+contract, so the suite pins the semantics either way.
+"""
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+import tendermint_tpu  # noqa: F401 — installs the backport on 3.10
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestTimeoutBackport:
+    def test_expiry_raises_both_timeout_flavors(self):
+        async def main():
+            with pytest.raises(asyncio.TimeoutError):
+                async with asyncio.timeout(0.02):
+                    await asyncio.sleep(5)
+            with pytest.raises(TimeoutError):  # builtin flavor too
+                async with asyncio.timeout(0.02):
+                    await asyncio.sleep(5)
+
+        run(main())
+
+    def test_no_expiry_passes_through(self):
+        async def main():
+            async with asyncio.timeout(5.0):
+                await asyncio.sleep(0.01)
+            return 42
+
+        assert run(main()) == 42
+
+    def test_external_cancel_is_not_swallowed(self):
+        """A service stop must cancel a task waiting inside a timeout
+        context: the EXTERNAL CancelledError propagates as CancelledError,
+        never converted into TimeoutError."""
+
+        async def main():
+            entered = asyncio.Event()
+
+            async def victim():
+                async with asyncio.timeout(60.0):
+                    entered.set()
+                    await asyncio.sleep(10)
+
+            t = asyncio.get_running_loop().create_task(victim())
+            await entered.wait()
+            t.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await t
+            assert t.cancelled()
+
+        run(main())
+
+    def test_expiry_through_gather_and_child_tasks(self):
+        """Cancellation crossing a task boundary loses its message on
+        3.10 — a timed-out body awaiting gather() or a child task must
+        still surface TimeoutError, not leak CancelledError."""
+
+        async def main():
+            with pytest.raises(asyncio.TimeoutError):
+                async with asyncio.timeout(0.02):
+                    await asyncio.gather(asyncio.sleep(10), asyncio.sleep(10))
+            with pytest.raises(asyncio.TimeoutError):
+                async with asyncio.timeout(0.02):
+                    await asyncio.get_running_loop().create_task(
+                        asyncio.sleep(10)
+                    )
+
+        run(main())
+
+    def test_backport_never_claims_expiry_over_pending_external_cancel(self):
+        """The hostile window, pinned deterministically (backport only):
+        when an external cancellation is already in flight, a deadline
+        firing in the same window must NOT claim expiry — the external
+        CancelledError propagates instead of becoming TimeoutError."""
+        from tendermint_tpu import _pycompat
+
+        async def main():
+            entered = asyncio.Event()
+            tm = _pycompat._Timeout(60.0)
+
+            async def victim():
+                async with tm:
+                    entered.set()
+                    await asyncio.sleep(10)
+
+            t = asyncio.get_running_loop().create_task(victim())
+            await entered.wait()
+            t.cancel()  # external cancel requested...
+            tm._on_timeout()  # ...and the deadline fires in the same tick
+            assert tm._expired is False  # expiry refused
+            with pytest.raises(asyncio.CancelledError):
+                await t
+            assert t.cancelled()
+
+        run(main())
+
+    def test_nested_timeouts_attribute_to_inner(self):
+        async def main():
+            async with asyncio.timeout(5.0):
+                with pytest.raises(asyncio.TimeoutError):
+                    async with asyncio.timeout(0.02):
+                        await asyncio.sleep(10)
+                return "outer survived"
+
+        assert run(main()) == "outer survived"

@@ -1,0 +1,167 @@
+"""WAL auto-repair tests (ISSUE 9): a torn tail — truncated header, short
+payload, CRC mismatch, oversize length — must truncate to the last
+CRC-clean frame at open, preserving the corrupt bytes in a `.corrupt`
+sidecar, and replay must keep working from the repaired log.
+"""
+from __future__ import annotations
+
+import os
+import struct
+
+import pytest
+
+pytest.importorskip("cryptography", reason="WAL frames carry consensus messages")
+
+from tendermint_tpu.consensus.wal import (  # noqa: E402
+    WAL,
+    EndHeightMessage,
+    TimedWALMessage,
+    encode_frame,
+    repair_wal,
+    scan_clean_frames,
+)
+
+
+def _frames(heights) -> bytes:
+    return b"".join(
+        encode_frame(TimedWALMessage(1000 + h, EndHeightMessage(h)))
+        for h in heights
+    )
+
+
+def _write(path: str, data: bytes) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(data)
+
+
+class TestScan:
+    def test_clean_file(self, tmp_path):
+        p = str(tmp_path / "wal")
+        data = _frames([1, 2, 3])
+        _write(p, data)
+        with open(p, "rb") as f:
+            frames, clean, err = scan_clean_frames(f)
+        assert (frames, clean, err) == (3, len(data), None)
+
+    @pytest.mark.parametrize(
+        "torn",
+        [
+            b"\x01\x02\x03",  # truncated header
+            struct.pack(">II", 0xDEADBEEF, 64) + b"\x00" * 10,  # short payload
+            struct.pack(">II", 0, 2 << 20) + b"\x00" * 64,  # oversize length
+        ],
+        ids=["torn-header", "short-payload", "oversize"],
+    )
+    def test_torn_tail_detected(self, tmp_path, torn):
+        p = str(tmp_path / "wal")
+        data = _frames([1, 2])
+        _write(p, data + torn)
+        with open(p, "rb") as f:
+            frames, clean, err = scan_clean_frames(f)
+        assert (frames, clean) == (2, len(data))
+        assert err is not None
+
+    def test_crc_mismatch_detected(self, tmp_path):
+        p = str(tmp_path / "wal")
+        good = _frames([1])
+        bad = bytearray(_frames([2]))
+        bad[-1] ^= 0xFF  # flip a payload byte: CRC no longer matches
+        _write(p, good + bytes(bad))
+        with open(p, "rb") as f:
+            frames, clean, err = scan_clean_frames(f)
+        assert (frames, clean) == (1, len(good))
+        assert "crc" in err
+
+
+class TestRepair:
+    def test_repair_truncates_and_sidecars(self, tmp_path):
+        p = str(tmp_path / "wal")
+        clean = _frames([1, 2, 3])
+        torn = struct.pack(">II", 0xBAD, 512) + b"\x55" * 40
+        _write(p, clean + torn)
+        repairs = repair_wal(p)
+        assert len(repairs) == 1
+        r = repairs[0]
+        assert r["kept_frames"] == 3
+        assert r["kept_bytes"] == len(clean)
+        assert r["removed_bytes"] == len(torn)
+        assert os.path.getsize(p) == len(clean)
+        with open(r["sidecar"], "rb") as f:
+            assert f.read() == torn
+        # the repaired file scans clean
+        with open(p, "rb") as f:
+            assert scan_clean_frames(f) == (3, len(clean), None)
+
+    def test_repair_noop_on_clean_log(self, tmp_path):
+        p = str(tmp_path / "wal")
+        _write(p, _frames([1, 2]))
+        assert repair_wal(p) == []
+        assert not os.path.exists(p + ".corrupt")
+
+    def test_repair_noop_on_missing_log(self, tmp_path):
+        assert repair_wal(str(tmp_path / "nope" / "wal")) == []
+
+    def test_repair_idempotent(self, tmp_path):
+        p = str(tmp_path / "wal")
+        _write(p, _frames([1]) + b"\xff\xff\xff")
+        assert len(repair_wal(p)) == 1
+        assert repair_wal(p) == []  # second open: nothing left to repair
+
+    def test_repeated_crashes_keep_distinct_sidecars(self, tmp_path):
+        p = str(tmp_path / "wal")
+        _write(p, _frames([1]) + b"\xaa\xbb\xcc")
+        repair_wal(p)
+        with open(p, "ab") as f:
+            f.write(_frames([2]) + b"\x11\x22")
+        repairs = repair_wal(p)
+        assert repairs[0]["sidecar"].endswith(".corrupt.1")
+        with open(p + ".corrupt", "rb") as f:
+            assert f.read() == b"\xaa\xbb\xcc"
+        with open(p + ".corrupt.1", "rb") as f:
+            assert f.read() == b"\x11\x22"
+
+    def test_corrupt_chunk_quarantines_later_files(self, tmp_path):
+        """Frames never span files, so a corrupt ROTATED chunk makes every
+        later file untrusted: the chunk is truncated at its last clean
+        frame and the later files move aside wholesale."""
+        head = str(tmp_path / "wal")
+        chunk = head + ".000"
+        chunk_clean = _frames([1, 2])
+        _write(chunk, chunk_clean + b"\xde\xad")
+        head_data = _frames([3])
+        _write(head, head_data)
+        repairs = repair_wal(head)
+        assert [r["path"] for r in repairs] == [chunk, head]
+        assert os.path.getsize(chunk) == len(chunk_clean)
+        assert not os.path.exists(head)  # moved aside, not deleted
+        with open(repairs[1]["sidecar"], "rb") as f:
+            assert f.read() == head_data
+
+    def test_wal_open_repairs_and_appends(self, tmp_path):
+        """The integration shape the node hits: open a WAL whose tail is
+        torn, observe the repair record, and keep writing + reading."""
+        p = str(tmp_path / "cs.wal" / "wal")
+        _write(p, _frames([1, 2]) + struct.pack(">II", 1, 99) + b"\x00" * 7)
+        wal = WAL(p)
+        assert len(wal.repairs) == 1
+        wal.write(EndHeightMessage(3))
+        wal.flush()
+        heights = [
+            tm.msg.height for tm in wal.iter_all()
+            if isinstance(tm.msg, EndHeightMessage)
+        ]
+        assert heights == [1, 2, 3]
+        # the height barrier search sees a coherent log
+        assert wal.search_for_end_height(3) == []
+        wal.close()
+
+    def test_wal_open_repair_disabled(self, tmp_path):
+        p = str(tmp_path / "wal")
+        torn = b"\x01\x02\x03"
+        _write(p, _frames([1]) + torn)
+        wal = WAL(p, repair=False)
+        assert wal.repairs == []
+        wal.close()
+        with open(p, "rb") as f:
+            assert f.read().endswith(torn)  # untouched
