@@ -4,6 +4,16 @@ fully in-process over the real p2p stack.
 
 Usage: python -m benchmarks.fastsync_bench [heights] [validators] [txs/block]
        (defaults 300 4 20)
+       python -m benchmarks.fastsync_bench --table [validators ...]
+       (defaults 64 512 1024 2048 — the BASELINE configs 3-4 ladder)
+
+`--table` sweeps validator counts at a fixed signature budget (heights
+shrink as the per-commit signature count grows, so every rung verifies a
+comparable total), emits one bench_compare-compatible JSON record per
+rung (`fastsync_{v}v_blocks_per_sec`), and prints the blocks/s ×
+validator-count table recorded in docs/vote_pipeline.md. Large-set rungs
+flow the full pipeline: gossip -> batched verify-ahead (+ the verified-
+signature cache residual path) -> ApplyBlock.
 
 Builds an H-block chain offline (V validators sign every commit — the
 commit-verify work that dominates real fast sync, SURVEY §3.5 hot loop
@@ -193,10 +203,50 @@ async def run(height: int, n_vals: int, txs_per_block: int) -> float:
     return synced / dt
 
 
+def _table_heights(n_vals: int, sig_budget: int) -> int:
+    """Heights for one table rung: hold the total signature count near
+    `sig_budget` so a 2048-validator rung costs about what the
+    64-validator rung does, floor 6 so the pipeline actually pipelines."""
+    return max(6, sig_budget // max(1, n_vals))
+
+
+def table(val_counts=(64, 512, 1024, 2048), sig_budget: int = 20_000,
+          txs_per_block: int = 5) -> list[dict]:
+    """Validator-count sweep (ISSUE 10 satellite): BASELINE configs 3-4
+    shapes through gossip -> verify-ahead -> ApplyBlock."""
+    import json as _json
+    import time as _time
+
+    rows = []
+    for n_vals in val_counts:
+        heights = _table_heights(n_vals, sig_budget)
+        log(f"--- {n_vals} validators x {heights} heights ---")
+        bps = asyncio.run(run(heights, n_vals, txs_per_block))
+        record = {
+            "metric": f"fastsync_{n_vals}v_blocks_per_sec",
+            "value": round(bps, 2),
+            "unit": "blocks/s",
+            "validators": n_vals,
+            "heights": heights,
+            "commit_sigs_per_sec": round(bps * n_vals, 1),
+            "measured_at_utc": _time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", _time.gmtime()
+            ),
+            "source": f"benchmarks.fastsync_bench --table "
+                      f"({heights}h x {n_vals}v x {txs_per_block}tx)",
+        }
+        print(_json.dumps(record), flush=True)
+        rows.append(record)
+    log("")
+    log(f"{'validators':>10} | {'blocks/s':>9} | {'commit-sigs/s':>13}")
+    log(f"{'-' * 10}-+-{'-' * 9}-+-{'-' * 13}")
+    for r in rows:
+        log(f"{r['validators']:>10} | {r['value']:>9,.1f} | "
+            f"{r['commit_sigs_per_sec']:>13,.0f}")
+    return rows
+
+
 def main(argv):
-    height = int(argv[1]) if len(argv) > 1 else 300
-    n_vals = int(argv[2]) if len(argv) > 2 else 4
-    txs = int(argv[3]) if len(argv) > 3 else 20
     if os.environ.get("JAX_PLATFORMS"):
         import jax
 
@@ -206,6 +256,13 @@ def main(argv):
     # falls back to the serial OpenSSL path
     import tendermint_tpu.ops  # noqa: F401
 
+    if "--table" in argv:
+        vals = tuple(int(a) for a in argv[1:] if not a.startswith("--"))
+        table(vals or (64, 512, 1024, 2048))
+        return
+    height = int(argv[1]) if len(argv) > 1 else 300
+    n_vals = int(argv[2]) if len(argv) > 2 else 4
+    txs = int(argv[3]) if len(argv) > 3 else 20
     asyncio.run(run(height, n_vals, txs))
 
 

@@ -20,7 +20,8 @@ Reference anchor: the serial commit-verify loop this replaces is
 /root/reference/types/validator_set.go:591-633 (~150us per signature on
 modern x86 per BASELINE.md -> 6,667 verifies/s serial).
 
-Usage: python -m benchmarks.quick_bench [--scheduler] [n_validators ...]
+Usage: python -m benchmarks.quick_bench [--scheduler|--stream] [--prebake]
+                                        [n_validators ...]
 
 `--scheduler` measures the unified device-dispatch path (ISSUE 8): each
 commit is submitted through DeviceScheduler.verify at CONSENSUS_COMMIT
@@ -28,6 +29,21 @@ priority — admission queue + packer + breaker + routing included — and
 the records carry `_sched` metric names, so `tools/bench_compare.py` can
 gate the scheduler path against the direct-dispatch numbers and against
 its own trajectory in the next tunnel window.
+
+`--stream` measures the streaming vote pipeline (ISSUE 10): the warm-
+stream commit shape — n precommit signatures ingested burst-by-burst
+through `VoteSet.add_votes` (populating the verified-signature cache,
+exactly what a live height does), then the commit-boundary
+`ValidatorSet.verify_commit` which only dispatches the *residual* of
+never-streamed signatures (~0 when warm). Emits bench_compare-compatible
+records for the synchronous baseline, the streamed ingest, and the
+commit-boundary residual latency (unit ms — bench_compare treats ms/s
+units as lower-is-better) on the SAME shape.
+
+The escalation also measures one secp256k1 bucket through the scheduler
+path, and `--prebake` serializes the AOT executables for the largest
+ed25519 shape + the secp bucket (ops/aot.bake, device-free) so the next
+tunnel window banks them without paying the flagship compile.
 """
 from __future__ import annotations
 
@@ -54,7 +70,8 @@ def bank(record: dict, path: str = BANK_PATH) -> None:
     os.replace(tmp, path)
 
 
-def main(sizes=(100, 1000, 10_000), scheduler: bool = False) -> None:
+def main(sizes=(100, 1000, 10_000), scheduler: bool = False,
+         secp: bool = True) -> None:
     import numpy as np  # noqa: F401 — fail fast before touching the device
 
     import jax
@@ -132,10 +149,171 @@ def main(sizes=(100, 1000, 10_000), scheduler: bool = False) -> None:
             f"n={n}: {best * 1e3:.1f} ms/commit = {rate:,.0f} verifies/s "
             f"({record['vs_baseline']}x serial baseline) — banked"
         )
+    if secp:
+        secp_bucket(dev, suffix=suffix)
+
+
+def _record(metric: str, value: float, unit: str, platform: str,
+            kind: str, source: str, **extra) -> dict:
+    rec = {
+        "metric": metric,
+        "value": round(value, 3),
+        "unit": unit,
+        "platform": platform,
+        "device_kind": kind,
+        "measured_at_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "source": source,
+        **extra,
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def secp_bucket(dev, n: int = 1024, suffix: str = "") -> None:
+    """One secp256k1 bucket through the scheduler admission path — the
+    mixed-curve half of the banked numbers (BASELINE config 5)."""
+    try:
+        from tendermint_tpu.crypto import secp256k1 as sk
+        from tendermint_tpu.device import Priority, get_scheduler
+
+        priv = sk.gen_priv_key(seed=b"quick-bench secp bucket")
+        pub = priv.pub_key().bytes()
+        msgs = [b"secp bench %06d" % i for i in range(n)]
+        sigs = [priv.sign(m) for m in msgs]
+        sched = get_scheduler()
+        lat = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            ok = sched.verify(
+                "secp256k1", [pub] * n, msgs, sigs,
+                priority=Priority.CONSENSUS_COMMIT,
+            )
+            lat.append(time.perf_counter() - t0)
+            assert all(ok), "secp backend rejected valid signatures"
+        best = min(lat)
+        _record(
+            f"secp256k1_verify_{n}v{suffix}_per_sec", n / best, "verifies/s",
+            dev.platform, str(dev.device_kind),
+            f"benchmarks.quick_bench secp bucket best-of-3, n={n}",
+        )
+        log(f"secp n={n}: {best * 1e3:.1f} ms = {n / best:,.0f} verifies/s")
+    except Exception as e:  # noqa: BLE001 — the ed25519 bank must still land
+        log(f"secp bucket skipped: {e!r}")
+
+
+def stream_main(sizes=(10_000,)) -> None:
+    """Warm-stream commit shape (ISSUE 10): per size, measure
+    (a) the synchronous-batch baseline — cold `verify_commit`, one batch;
+    (b) streamed ingest — the same signatures through burst-by-burst
+        `VoteSet.add_votes`, the live vote path that fills the
+        verified-signature cache;
+    (c) the commit-boundary verify warm — only the residual (~0)
+        dispatches, the rest is a cache sweep."""
+    import hashlib
+
+    from tendermint_tpu.crypto import batch as crypto_batch
+    from tendermint_tpu.libs import trace as tmtrace
+    from tendermint_tpu.libs.sigcache import SIG_CACHE
+    from tendermint_tpu.types import (
+        BlockID, MockPV, PartSetHeader, ValidatorSet, VoteSet, VoteType,
+    )
+    from tendermint_tpu.types.validator import Validator
+    from tendermint_tpu.types.vote import Vote
+
+    try:
+        import jax
+
+        dev0 = jax.devices()[0]
+        platform, kind = dev0.platform, str(dev0.device_kind)
+    except Exception:  # noqa: BLE001 — CPU-only host: still a valid record
+        platform, kind = "cpu", "host"
+    chain_id = "quick-stream"
+    for n in sizes:
+        t0 = time.perf_counter()
+        pvs = [MockPV() for _ in range(n)]
+        valset = ValidatorSet([Validator(pv.get_pub_key(), 1) for pv in pvs])
+        h = hashlib.sha256(b"stream block %d" % n).digest()
+        bid = BlockID(h, PartSetHeader(1, h))
+        votes = []
+        for pv in pvs:
+            idx, _ = valset.get_by_address(pv.address)
+            v = Vote(
+                VoteType.PRECOMMIT, 1, 0, bid,
+                1_700_000_000_000_000_000 + idx, pv.address, idx,
+            )
+            votes.append(pv.sign_vote(chain_id, v))
+        log(f"n={n}: shape built in {time.perf_counter() - t0:.1f}s")
+
+        # commit construction (verifies once; stats reset below)
+        vs0 = VoteSet(chain_id, 1, 0, VoteType.PRECOMMIT, valset)
+        vs0.add_votes(votes)
+        commit = vs0.make_commit()
+
+        # (a) synchronous baseline: cold cache, ONE commit-boundary batch
+        SIG_CACHE.clear()
+        t0 = time.perf_counter()
+        valset.verify_commit(chain_id, bid, 1, commit)
+        t_sync = time.perf_counter() - t0
+
+        # (b) streamed ingest: bursts through the live vote path
+        SIG_CACHE.clear()
+        burst = max(64, min(crypto_batch.stream_flush_hint(), n))
+        vs1 = VoteSet(chain_id, 1, 0, VoteType.PRECOMMIT, valset)
+        t0 = time.perf_counter()
+        for lo in range(0, n, burst):
+            errs: list = []
+            vs1.add_votes(votes[lo:lo + burst], errors=errs)
+            assert not any(errs)
+        t_ingest = time.perf_counter() - t0
+
+        # (c) commit boundary, warm: residual ~0, cache sweep only
+        t0 = time.perf_counter()
+        valset.verify_commit(chain_id, bid, 1, commit)
+        t_warm = time.perf_counter() - t0
+        residual = tmtrace.DEVICE.snapshot()["commit_verify"]["residual_last"]
+
+        src = f"benchmarks.quick_bench --stream n={n}, burst={burst}"
+        _record(f"ed25519_stream_commit_{n}v_sync_per_sec", n / t_sync,
+                "verifies/s", platform, kind, src)
+        _record(f"ed25519_stream_ingest_{n}v_per_sec", n / t_ingest,
+                "verifies/s", platform, kind, src)
+        _record(f"ed25519_stream_commit_{n}v_warm_per_sec", n / t_warm,
+                "verifies/s", platform, kind, src,
+                vs_sync=round((n / t_warm) / (n / t_sync), 2))
+        _record(f"ed25519_stream_commit_{n}v_residual_ms", t_warm * 1e3,
+                "ms", platform, kind, src, residual_sigs=residual)
+        log(
+            f"n={n}: sync {t_sync * 1e3:.1f} ms | streamed ingest "
+            f"{t_ingest * 1e3:.1f} ms | commit residual {t_warm * 1e3:.2f} ms "
+            f"({residual} residual sigs) -> commit-boundary speedup "
+            f"{t_sync / t_warm:,.0f}x"
+        )
+
+
+def prebake(sizes) -> None:
+    """Serialize the AOT executables for the largest ed25519 shape and
+    the secp bucket (ops/aot.bake — device-free, topology compile), so
+    the next tunnel window loads instead of compiling."""
+    from tendermint_tpu.ops import aot, ed25519_batch
+
+    bucket = ed25519_batch._pad_to_bucket(max(sizes))
+    written = aot.bake([bucket], secp=True)
+    log(f"prebaked {len(written)} AOT executable(s) for bucket {bucket}: "
+        f"{[os.path.basename(p) for p in written]}")
 
 
 if __name__ == "__main__":
     args = sys.argv[1:]
     use_sched = "--scheduler" in args
+    use_stream = "--stream" in args
     sizes = tuple(int(a) for a in args if not a.startswith("--"))
-    main(sizes or (100, 1000, 10_000), scheduler=use_sched)
+    if use_stream:
+        stream_main(sizes or (10_000,))
+    else:
+        main(sizes or (100, 1000, 10_000), scheduler=use_sched,
+             secp="--no-secp" not in args)
+    if "--prebake" in args:
+        try:
+            prebake(sizes or (10_000,))
+        except Exception as e:  # noqa: BLE001 — prebake is best-effort
+            log(f"prebake skipped: {e!r}")

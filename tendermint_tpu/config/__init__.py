@@ -128,6 +128,16 @@ class ConsensusConfig:
     # pay at most one extra window of latency.
     vote_batch_max_window: float = 0.012
     vote_batch_cap: int = 4096
+    # Streaming vote-verification pipeline (docs/vote_pipeline.md): vote
+    # groups of at least vote_stream_min signatures verify OFF the
+    # consensus loop (DeviceScheduler submit at CONSENSUS class) while the
+    # next gossip window ingests; verdict application is a completion
+    # stage with serial-equivalent semantics. vote_stream_inflight bounds
+    # the pipeline depth (2 = classic double buffering). vote_stream_async
+    # = False restores the fully synchronous verify.
+    vote_stream_async: bool = True
+    vote_stream_min: int = 8
+    vote_stream_inflight: int = 2
 
     def propose_timeout(self, round_: int) -> float:
         return self.timeout_propose + self.timeout_propose_delta * round_

@@ -16,7 +16,8 @@ from __future__ import annotations
 
 import asyncio
 import time
-from dataclasses import dataclass
+from collections import deque
+from dataclasses import dataclass, field
 
 from tendermint_tpu.config import ConsensusConfig
 from tendermint_tpu.consensus import messages as m
@@ -30,9 +31,11 @@ from tendermint_tpu.consensus.wal import (
     NilWAL,
     WALTimeoutInfo,
 )
+from tendermint_tpu.device.priorities import Priority, priority_scope
 from tendermint_tpu.libs import fail
 from tendermint_tpu.libs import trace as tmtrace
 from tendermint_tpu.libs.recorder import RECORDER
+from tendermint_tpu.libs.sigcache import SIG_CACHE
 from tendermint_tpu.libs.events import EventSwitch
 from tendermint_tpu.libs.log import NOP, Logger
 from tendermint_tpu.libs.service import BaseService, spawn_logged
@@ -62,6 +65,24 @@ class _Internal:
     """Sentinel wrapper distinguishing our own messages in the WAL."""
 
     mi: MsgInfo
+
+
+@dataclass
+class _StreamBatch:
+    """One vote group in flight on the streaming verify pipeline: its
+    signatures are verifying off-loop (DeviceScheduler, CONSENSUS class)
+    while the consensus loop keeps ingesting the next gossip window.
+    Verdicts apply through `ConsensusState._stream_apply` in dispatch
+    order — the completion stage that preserves the serial-equivalent
+    accept/reject semantics `VoteSet.add_votes(errors=[])` documents."""
+
+    vote_set: object
+    votes: list
+    pending: object  # types.vote_set.PendingVotes
+    height: int
+    task: asyncio.Task | None = None
+    span: object | None = None
+    t0: float = field(default=0.0)
 
 
 class ConsensusState(BaseService):
@@ -109,6 +130,13 @@ class ConsensusState(BaseService):
         self.event_switch = EventSwitch()
         self._last_vote_time = 0
 
+        # streaming vote-verification pipeline (docs/vote_pipeline.md):
+        # bounded queue of vote batches whose signatures are verifying
+        # off-loop; verdicts apply in dispatch order
+        self._stream_inflight: deque[_StreamBatch] = deque()
+        self._stream_dispatched = 0
+        self._stream_applied = 0
+
         self.done_first_block = asyncio.Event()
         self.update_to_state(state)
 
@@ -123,6 +151,16 @@ class ConsensusState(BaseService):
 
     async def on_stop(self) -> None:
         await self.ticker.stop()
+        # in-flight stream verifies: nothing will apply their verdicts —
+        # cancel the wrappers (the worker thread finishes on its own and
+        # the result is dropped; exceptions are consumed by the cancel)
+        while self._stream_inflight:
+            sb = self._stream_inflight.popleft()
+            if sb.task is not None:
+                sb.task.cancel()
+            if sb.span is not None:
+                sb.span.set(cancelled=True)
+                self.tracer.finish(sb.span)
         self.wal.flush()
 
     def _catchup_replay(self) -> None:
@@ -180,6 +218,9 @@ class ConsensusState(BaseService):
         )
         self.state = state
         RECORDER.record("consensus", "new_height", height=height)
+        # verified-signature cache: entries older than the retain window
+        # can no longer appear in any commit this node will verify
+        SIG_CACHE.advance(height)
         m = self.metrics
         if m is not None and state.validators is not None:
             m.validators.set(state.validators.size())
@@ -213,28 +254,48 @@ class ConsensusState(BaseService):
         await self.peer_msg_queue.put(MsgInfo(msg, peer_id))
 
     async def receive_routine(self) -> None:
-        """Reference :587 — the single-threaded heart."""
+        """Reference :587 — the single-threaded heart. Extended for the
+        streaming vote pipeline: when verify batches are in flight, the
+        select also wakes on the oldest batch's verdicts, which apply
+        before any newer input (those votes arrived first)."""
         while True:
             peer_get = asyncio.ensure_future(self.peer_msg_queue.get())
             internal_get = asyncio.ensure_future(self.internal_msg_queue.get())
             tock_get = asyncio.ensure_future(self.ticker.tock.get())
+            waiters = {peer_get, internal_get, tock_get}
+            stream_head = (
+                self._stream_inflight[0].task if self._stream_inflight else None
+            )
+            if stream_head is not None:
+                waiters.add(stream_head)
             done, pending = await asyncio.wait(
-                {peer_get, internal_get, tock_get},
+                waiters,
                 return_when=asyncio.FIRST_COMPLETED,
             )
             for p in pending:
-                p.cancel()
+                if p is not stream_head:
+                    # the stream verify keeps running across loop turns;
+                    # only this turn's queue getters are abandoned
+                    p.cancel()
             try:
                 # .result() below is non-blocking: asyncio.wait just
                 # reported these futures done
+                if stream_head is not None and stream_head.done():
+                    await self._stream_apply_completed()
                 if internal_get in done:
                     mi = internal_get.result()  # tmlint: disable=TM101
+                    # serial order: in-flight vote batches precede our own
+                    # message — apply their verdicts before acting on it
+                    await self._stream_drain()
                     self.wal.write_sync(mi)  # our own msgs: fsync (:635)
                     await self.handle_msg(mi)
                 if peer_get in done:
                     await self._handle_peer_batch(peer_get.result())  # tmlint: disable=TM101
                 if tock_get in done:
                     ti = tock_get.result()  # tmlint: disable=TM101
+                    # timeout decisions must observe every tally already
+                    # dispatched for verification
+                    await self._stream_drain()
                     self.wal.write(
                         WALTimeoutInfo(ti.duration, ti.height, ti.round, int(ti.step))
                     )
@@ -296,7 +357,14 @@ class ConsensusState(BaseService):
         ):
             from tendermint_tpu.crypto import batch as _cb
 
-            hint = _cb.accumulation_hint()
+            # streamed flushes dispatch through the scheduler's packer,
+            # so one routing threshold already fills device lanes; the
+            # synchronous path keeps the amortizing multi-threshold hint
+            hint = (
+                _cb.stream_flush_hint()
+                if self.config.vote_stream_async
+                else _cb.accumulation_hint()
+            )
             cap = self.config.vote_batch_cap
             deadline = (
                 asyncio.get_event_loop().time()
@@ -337,6 +405,10 @@ class ConsensusState(BaseService):
                 votes.append(mi)
                 continue
             await self._flush_vote_run(votes)
+            # non-vote messages (proposal, block part) act on the tally:
+            # verdicts of every dispatched vote batch land first, so the
+            # outcome matches the serial arrival order
+            await self._stream_drain()
             # per-message error isolation, as if each were its own loop turn
             try:
                 await self.handle_msg(mi)
@@ -932,10 +1004,25 @@ class ConsensusState(BaseService):
             for mi in group:
                 await self.try_add_vote(mi.msg.vote, mi.peer_id)
             return
+        if (
+            self.config.vote_stream_async
+            and len(votes) >= max(1, self.config.vote_stream_min)
+        ):
+            await self._stream_dispatch(vs, votes, v0.height)
+            return
         errors = []
         added = vs.add_votes(votes, errors=errors)
+        await self._apply_vote_outcomes(votes, added, errors, v0.height)
+
+    async def _apply_vote_outcomes(
+        self, votes: list[Vote], added: list[bool], errors: list, height: int
+    ) -> None:
+        """Per-vote side effects after a bulk add — the exact events,
+        evidence, and step transitions a serial add_vote sequence would
+        have produced. Shared by the synchronous group path and the
+        streaming pipeline's verdict-apply stage."""
         for vote, ok, err in zip(votes, added, errors):
-            if self.rs.height != v0.height:
+            if self.rs.height != height:
                 # a vote earlier in this group completed a commit and moved
                 # us to the next height: the remaining votes are stale, and
                 # a serial add_vote would have dropped them here too
@@ -947,6 +1034,111 @@ class ConsensusState(BaseService):
             elif err is not None:
                 # same visibility a serial add_vote raise would have had
                 self.log.error("consensus error", err=repr(err))
+
+    # ------------------------------------------------------------------
+    # streaming vote-verification pipeline (docs/vote_pipeline.md).
+    #
+    # The synchronous group path above blocks the consensus loop on
+    # `bv.verify_all()` — the full device round trip. Here the verify
+    # stage runs off-loop: `VoteSet.begin_add_votes` prepares the batch
+    # (prechecks, dedup, verified-signature-cache sweep) on the loop,
+    # the cache-missed signatures dispatch through the crypto backends
+    # on a worker thread (device-bound groups queue on the
+    # DeviceScheduler at CONSENSUS class), and the verdicts apply back
+    # on the loop in dispatch order — batch N verifies on-device while
+    # gossip window N+1 ingests. Serial-equivalence is preserved by the
+    # apply-stage re-evaluation in `VoteSet.finish_add_votes` plus the
+    # drain barriers in receive_routine/_handle_peer_batch (non-vote
+    # messages, internal messages, and timeouts never act on a tally
+    # with unapplied verdicts).
+
+    async def _stream_dispatch(self, vs: VoteSet, votes: list[Vote], height: int) -> None:
+        errors: list = []
+        pending = vs.begin_add_votes(votes, errors=errors)
+        if pending.n_verify == 0:
+            # every signature was cached, duplicate, or precheck-rejected:
+            # nothing to dispatch — apply inline
+            added = vs.finish_add_votes(pending, [])
+            await self._apply_vote_outcomes(votes, added, errors, height)
+            return
+        if len(self._stream_inflight) >= max(1, self.config.vote_stream_inflight):
+            # pipeline full (double-buffer bound): absorb the oldest
+            # batch's verdicts before dispatching another
+            await self._stream_apply(self._stream_inflight.popleft())
+        sb = _StreamBatch(vs, votes, pending, height, t0=time.monotonic())
+        t, hs = self.tracer, self._height_span
+        if t.enabled and hs is not None:
+            sb.span = t.child(
+                hs, "vote_stream", height=height, n=len(votes),
+                verify=pending.n_verify,
+            )
+        sb.task = asyncio.ensure_future(self._stream_verify(pending))
+        self._stream_inflight.append(sb)
+        self._stream_dispatched += 1
+        RECORDER.record(
+            "consensus", "stream_dispatch", height=height, n=len(votes),
+            verify=pending.n_verify, inflight=len(self._stream_inflight),
+        )
+        mm = self.metrics
+        if mm is not None:
+            mm.stream_batches_total.inc()
+            mm.stream_inflight_batches.set(len(self._stream_inflight))
+
+    async def _stream_verify(self, pending) -> list[bool]:
+        """The off-loop verify stage: the prepared batch's cache-missed
+        signatures run through the crypto backends on a worker thread —
+        device-bound groups enter the DeviceScheduler's admission queue
+        at CONSENSUS class, sub-threshold groups take the host paths —
+        while the consensus loop keeps ingesting."""
+        with priority_scope(Priority.CONSENSUS_COMMIT):
+            return await asyncio.to_thread(pending.bv.verify_all)
+
+    async def _stream_apply(self, sb: _StreamBatch) -> None:
+        """Completion stage: apply one batch's verdicts with the exact
+        serial-equivalent semantics of the synchronous path."""
+        wait_s = 0.0
+        try:
+            results = await sb.task
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — same isolation as the
+            # sync path: _flush_vote_run logs a backend error and drops
+            # the group; no verdict ever applies unverified
+            self.log.error("consensus error", err=repr(e))
+            results = None
+        else:
+            wait_s = time.monotonic() - sb.t0
+            added = sb.vote_set.finish_add_votes(sb.pending, results)
+            self._stream_applied += 1
+            await self._apply_vote_outcomes(
+                sb.votes, added, sb.pending.errors, sb.height
+            )
+        if sb.span is not None:
+            sb.span.set(wait_ms=round(wait_s * 1e3, 3),
+                        failed=results is None)
+            self.tracer.finish(sb.span)
+        RECORDER.record(
+            "consensus", "stream_apply", height=sb.height, n=len(sb.votes),
+            wait_ms=round(wait_s * 1e3, 3),
+            inflight=len(self._stream_inflight),
+        )
+        mm = self.metrics
+        if mm is not None:
+            mm.stream_inflight_batches.set(len(self._stream_inflight))
+            if results is not None:
+                mm.stream_wait_seconds.observe(wait_s)
+
+    async def _stream_apply_completed(self) -> None:
+        """Apply every leading in-flight batch whose verify finished —
+        always oldest-first, so verdicts land in dispatch order."""
+        while self._stream_inflight and self._stream_inflight[0].task.done():
+            await self._stream_apply(self._stream_inflight.popleft())
+
+    async def _stream_drain(self) -> None:
+        """Barrier: wait for and apply ALL in-flight verdicts. Called
+        before any input that acts on the tally outside the vote path."""
+        while self._stream_inflight:
+            await self._stream_apply(self._stream_inflight.popleft())
 
     async def _post_add_vote(self, vote: Vote) -> None:
         """Events + step transitions after a vote lands (reference :1582)."""

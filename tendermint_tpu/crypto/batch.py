@@ -111,6 +111,32 @@ def accumulation_hint() -> int:
     return 2048
 
 
+def stream_flush_hint() -> int:
+    """Flush point for ASYNC-streamed accumulation (VoteStream /
+    consensus streaming dispatch). The plain accumulation hint targets a
+    multiple of the device routing threshold because a synchronous flush
+    must amortize its whole launch alone; a streamed flush dispatches
+    through the DeviceScheduler's packer, where it coalesces with
+    co-resident queued work — so it only needs to cross the scheduler's
+    routing threshold (`ops.effective_min_batch`) to fill device lanes.
+    Consulted lazily and only when ops is already loaded (the rpc/core
+    lazy-module rule: a hint read must never drag jax into a CPU-only
+    process); falls back to the plain hint otherwise."""
+    import sys
+
+    hint = accumulation_hint()
+    ops = sys.modules.get("tendermint_tpu.ops")
+    if ops is None:
+        return hint
+    try:
+        emb = int(ops.effective_min_batch())
+    except Exception:  # noqa: BLE001 — a failing probe must not break ingest
+        return hint
+    if emb >= (1 << 30):  # never-device sentinel: no launch to amortize
+        return hint
+    return max(1, min(hint, emb))
+
+
 class BatchVerifier:
     """Accumulate signatures, verify them all in grouped batches.
 

@@ -538,6 +538,17 @@ class DeviceScheduler(BaseService):
         finally:
             _TLS.scheduler = prev
 
+    def effective_min_batch(self) -> int:
+        """The routing threshold `verify` applies (ops.effective_min_batch):
+        batches at or past it queue for the device, smaller ones run the
+        host paths inline. Streaming accumulators (types.VoteStream, the
+        consensus vote pipeline) consult this as their flush high-water
+        mark — with the packer coalescing co-resident work, one
+        threshold's worth of streamed signatures already fills lanes."""
+        import tendermint_tpu.ops as ops
+
+        return ops.effective_min_batch()
+
     # -- introspection ------------------------------------------------------
 
     def queue_state(self) -> dict:

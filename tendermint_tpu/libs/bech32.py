@@ -1,0 +1,94 @@
+"""Bech32 (BIP-0173) — reference parity: libs/bech32/bech32.go, which
+wraps btcutil's encoder behind ConvertAndEncode / DecodeAndConvert for
+address display (Cosmos-SDK style `cosmos1...` strings).
+
+`convert_and_encode(hrp, data)` takes arbitrary 8-bit data (an address),
+regroups it into 5-bit words, and bech32-encodes; `decode_and_convert`
+is the exact inverse. Checksum errors, mixed case, and out-of-alphabet
+characters raise ValueError.
+"""
+from __future__ import annotations
+
+_CHARSET = "qpzry9x8gf2tvdw0s3jn54khce6mua7l"
+_GEN = (0x3B6A57B2, 0x26508E6D, 0x1EA119FA, 0x3D4233DD, 0x2A1462B3)
+
+
+def _polymod(values) -> int:
+    chk = 1
+    for v in values:
+        top = chk >> 25
+        chk = (chk & 0x1FFFFFF) << 5 ^ v
+        for i in range(5):
+            chk ^= _GEN[i] if (top >> i) & 1 else 0
+    return chk
+
+
+def _hrp_expand(hrp: str) -> list[int]:
+    return [ord(c) >> 5 for c in hrp] + [0] + [ord(c) & 31 for c in hrp]
+
+
+def _create_checksum(hrp: str, data: list[int]) -> list[int]:
+    polymod = _polymod(_hrp_expand(hrp) + data + [0] * 6) ^ 1
+    return [(polymod >> 5 * (5 - i)) & 31 for i in range(6)]
+
+
+def _convert_bits(data, from_bits: int, to_bits: int, pad: bool) -> list[int]:
+    acc = bits = 0
+    out: list[int] = []
+    maxv = (1 << to_bits) - 1
+    for value in data:
+        if value < 0 or value >> from_bits:
+            raise ValueError(f"invalid value {value} for {from_bits}-bit group")
+        acc = (acc << from_bits) | value
+        bits += from_bits
+        while bits >= to_bits:
+            bits -= to_bits
+            out.append((acc >> bits) & maxv)
+    if pad:
+        if bits:
+            out.append((acc << (to_bits - bits)) & maxv)
+    elif bits >= from_bits or (acc << (to_bits - bits)) & maxv:
+        raise ValueError("invalid padding in bit groups")
+    return out
+
+
+def encode(hrp: str, data: list[int]) -> str:
+    """Bech32-encode 5-bit words under `hrp` (lowercase output)."""
+    if not hrp or not all(33 <= ord(c) <= 126 for c in hrp):
+        raise ValueError(f"invalid HRP {hrp!r}")
+    if any(not 0 <= d <= 31 for d in data):
+        raise ValueError("data word out of 5-bit range")
+    hrp = hrp.lower()
+    combined = data + _create_checksum(hrp, data)
+    return hrp + "1" + "".join(_CHARSET[d] for d in combined)
+
+
+def decode(bech: str) -> tuple[str, list[int]]:
+    """-> (hrp, 5-bit words). Raises ValueError on any malformation."""
+    if bech.lower() != bech and bech.upper() != bech:
+        raise ValueError("mixed-case bech32 string")
+    bech = bech.lower()
+    pos = bech.rfind("1")
+    if pos < 1 or pos + 7 > len(bech) or len(bech) > 90:
+        raise ValueError("invalid bech32 separator position or length")
+    hrp, rest = bech[:pos], bech[pos + 1:]
+    if not all(33 <= ord(c) <= 126 for c in hrp):
+        raise ValueError("invalid character in HRP")
+    try:
+        data = [_CHARSET.index(c) for c in rest]
+    except ValueError:
+        raise ValueError("invalid character in data part") from None
+    if _polymod(_hrp_expand(hrp) + data) != 1:
+        raise ValueError("invalid bech32 checksum")
+    return hrp, data[:-6]
+
+
+def convert_and_encode(hrp: str, data: bytes) -> str:
+    """Reference bech32.ConvertAndEncode: 8-bit bytes -> bech32 string."""
+    return encode(hrp, _convert_bits(data, 8, 5, True))
+
+
+def decode_and_convert(bech: str) -> tuple[str, bytes]:
+    """Reference bech32.DecodeAndConvert: bech32 string -> (hrp, bytes)."""
+    hrp, data = decode(bech)
+    return hrp, bytes(_convert_bits(data, 5, 8, False))

@@ -252,6 +252,21 @@ class ConsensusMetrics:
             "consensus", "batch_verify_size", "Signatures per device batch",
             [1, 4, 16, 64, 256, 1024, 4096, 16384],
         )
+        # streaming vote pipeline (docs/vote_pipeline.md): async verify
+        # batches in flight while the consensus loop keeps ingesting
+        self.stream_inflight_batches = c.gauge(
+            "consensus", "stream_inflight_batches",
+            "Vote-verify batches in flight on the async streaming pipeline",
+        )
+        self.stream_batches_total = c.counter(
+            "consensus", "stream_batches_total",
+            "Vote batches dispatched through the async streaming pipeline",
+        )
+        self.stream_wait_seconds = c.histogram(
+            "consensus", "stream_wait_seconds",
+            "Stream-dispatch to verdict-apply latency",
+            [0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.5, 2],
+        )
 
 
 class P2PMetrics:
@@ -417,6 +432,39 @@ class DeviceMetrics:
             "device", "preempted_total",
             "Queued requests passed over by a later-arriving "
             "higher-priority dispatch, per class",
+        )
+        # verified-signature cache (libs/sigcache, ISSUE 10): the
+        # streamed vote path records every verified signature; commit-
+        # boundary verifies sweep the cache and dispatch only the
+        # residual. Fed by SIG_CACHE.set_metrics + DEVICE.
+        self.sigcache_hits_total = c.counter(
+            "device", "sigcache_hits_total",
+            "Verified-signature cache hits (signature math skipped)",
+        )
+        self.sigcache_misses_total = c.counter(
+            "device", "sigcache_misses_total",
+            "Verified-signature cache misses (live verify required)",
+        )
+        self.sigcache_entries = c.gauge(
+            "device", "sigcache_entries",
+            "Verified signatures currently cached",
+        )
+        self.sigcache_evicted_total = c.counter(
+            "device", "sigcache_evicted_total",
+            "Cache entries evicted (height advance or capacity)",
+        )
+        self.commit_residual_sigs = c.gauge(
+            "device", "commit_residual_sigs",
+            "Residual (uncached) signatures dispatched by the last "
+            "commit-boundary verify",
+        )
+        self.commit_cached_sigs_total = c.counter(
+            "device", "commit_cached_sigs_total",
+            "Commit-boundary signatures swept from the verified cache",
+        )
+        self.commit_residual_sigs_total = c.counter(
+            "device", "commit_residual_sigs_total",
+            "Commit-boundary signatures that needed a live verify",
         )
 
 

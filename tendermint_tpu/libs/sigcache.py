@@ -1,0 +1,181 @@
+"""Verified-signature cache — the commit-boundary half of the streaming
+vote pipeline (ROADMAP item 3, docs/vote_pipeline.md).
+
+Every signature the streamed vote path verifies (VoteSet.add_votes — the
+gossip micro-batches that arrive while a height is being decided) is
+recorded here keyed (sha256(sign bytes), pubkey, signature). By the time
+the commit boundary re-verifies those same signatures — the LastCommit
+check in state/validation.py, the `last_commit` re-ingest at node boot,
+fast sync's cross-height `verify_commits` — the batch it must actually
+dispatch is only the *residual* of never-streamed signatures, which on a
+live net is ~0: commit verify collapses to a cache sweep.
+
+Design constraints:
+- **Sound**: a hit asserts "this exact (pubkey, message, signature)
+  triple verified True before". The key binds all three (the message via
+  sha256 — second preimage infeasible), and only True verdicts are ever
+  stored, so a hit can never launder a bad signature. Structural checks
+  (height/round match, validator membership, quorum tally) always re-run;
+  only the curve math is skipped.
+- **Bounded**: entries are bucketed by the height they were verified for;
+  `advance(h)` drops buckets older than `retain` heights, and `put`
+  evicts the oldest buckets when `max_entries` is exceeded (fast sync can
+  push a million signatures through in one window). ~130 B/entry.
+- **Crypto-free import** (the libs/fault.py rule): consumers in types/
+  and state/ reach it through the crypto stack, but tests exercise it in
+  environments without the `cryptography` package.
+
+Disable with TMTPU_SIGCACHE=0 (hits never fire, puts are dropped) —
+every verdict then comes from a live verify, the pre-cache behavior.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+
+_MAX_ENTRIES = int(os.environ.get("TMTPU_SIGCACHE_MAX", 131072))
+_RETAIN_HEIGHTS = int(os.environ.get("TMTPU_SIGCACHE_RETAIN", 8))
+
+
+def _enabled_from_env() -> bool:
+    return os.environ.get("TMTPU_SIGCACHE", "1") not in ("0", "false", "no")
+
+
+class VerifiedSigCache:
+    """Bounded per-height cache of signatures that verified True."""
+
+    def __init__(
+        self,
+        max_entries: int = _MAX_ENTRIES,
+        retain_heights: int = _RETAIN_HEIGHTS,
+        enabled: bool | None = None,
+    ) -> None:
+        self.max_entries = max(1, int(max_entries))
+        self.retain_heights = max(1, int(retain_heights))
+        self.enabled = _enabled_from_env() if enabled is None else enabled
+        self._lock = threading.Lock()
+        # height -> {key: None} (dict as an ordered set); heights ordered
+        # by first insertion, which tracks chain order on every live path
+        self._by_height: dict[int, dict[bytes, None]] = {}
+        self._keys: dict[bytes, int] = {}  # key -> height
+        self.hits = 0
+        self.misses = 0
+        self.puts = 0
+        self.evicted = 0
+        self._metrics = None
+
+    # -- keying -------------------------------------------------------------
+
+    @staticmethod
+    def key(pub: bytes, msg: bytes, sig: bytes) -> bytes:
+        """Cache key binding the full triple; the message rides as a
+        sha256 digest so huge sign-bytes never bloat an entry."""
+        return hashlib.sha256(msg).digest() + bytes(pub) + bytes(sig)
+
+    # -- cache ops ----------------------------------------------------------
+
+    def hit(self, key: bytes) -> bool:
+        """True iff this exact triple verified True before. Counts the
+        lookup either way (the hit-ratio series)."""
+        if not self.enabled:
+            return False
+        with self._lock:
+            ok = key in self._keys
+            if ok:
+                self.hits += 1
+            else:
+                self.misses += 1
+        dm = self._metrics
+        if dm is not None:
+            (dm.sigcache_hits_total if ok else dm.sigcache_misses_total).inc()
+        return ok
+
+    def put(self, key: bytes, height: int) -> None:
+        """Record a signature that verified True for `height`."""
+        if not self.enabled:
+            return
+        evicted = 0
+        with self._lock:
+            if key in self._keys:
+                return
+            self._by_height.setdefault(height, {})[key] = None
+            self._keys[key] = height
+            self.puts += 1
+            while len(self._keys) > self.max_entries and len(self._by_height) > 1:
+                evicted += self._evict_oldest_locked()
+            entries = len(self._keys)
+        dm = self._metrics
+        if dm is not None:
+            dm.sigcache_entries.set(entries)
+            if evicted:
+                dm.sigcache_evicted_total.inc(evicted)
+
+    def advance(self, height: int) -> None:
+        """The chain moved to `height`: drop buckets verified for heights
+        older than `height - retain_heights` (their votes can no longer
+        appear in any commit the node will verify)."""
+        if not self.enabled:
+            return
+        floor = height - self.retain_heights
+        evicted = 0
+        with self._lock:
+            for h in [h for h in self._by_height if h < floor]:
+                evicted += self._drop_bucket_locked(h)
+            entries = len(self._keys)
+        dm = self._metrics
+        if dm is not None:
+            dm.sigcache_entries.set(entries)
+            if evicted:
+                dm.sigcache_evicted_total.inc(evicted)
+
+    def _evict_oldest_locked(self) -> int:
+        h = next(iter(self._by_height))
+        return self._drop_bucket_locked(h)
+
+    def _drop_bucket_locked(self, h: int) -> int:
+        bucket = self._by_height.pop(h, {})
+        for k in bucket:
+            self._keys.pop(k, None)
+        self.evicted += len(bucket)
+        return len(bucket)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._by_height.clear()
+            self._keys.clear()
+
+    def reset_stats(self) -> None:
+        with self._lock:
+            self.hits = self.misses = self.puts = self.evicted = 0
+
+    # -- introspection ------------------------------------------------------
+
+    def set_metrics(self, dm) -> None:
+        """Mirror into a libs/metrics.DeviceMetrics bundle (node wires
+        this when Prometheus is on, like trace.DEVICE.set_metrics)."""
+        self._metrics = dm
+        if dm is not None:
+            with self._lock:
+                dm.sigcache_entries.set(len(self._keys))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            lookups = self.hits + self.misses
+            return {
+                "enabled": self.enabled,
+                "entries": len(self._keys),
+                "heights": len(self._by_height),
+                "max_entries": self.max_entries,
+                "retain_heights": self.retain_heights,
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_ratio": round(self.hits / lookups, 6) if lookups else 0.0,
+                "puts": self.puts,
+                "evicted": self.evicted,
+            }
+
+
+# Process singleton, like trace.DEVICE and the flight recorder: the vote
+# path and the commit-boundary verifiers must share one cache.
+SIG_CACHE = VerifiedSigCache()

@@ -392,6 +392,14 @@ class DeviceTelemetry:
         self.sched_packed_batches = 0
         self.sched_packed_requests = 0
         self.sched_max_packed = 0
+        # commit-boundary verify accounting (ISSUE 10): how much of each
+        # commit verify the verified-signature cache (libs/sigcache)
+        # already covered vs the residual actually dispatched — the
+        # "commit verify collapses to a cache sweep" proof counters
+        self.commit_verifies = 0
+        self.commit_sigs_total = 0
+        self.commit_residual_total = 0
+        self.commit_residual_last = 0
 
     def set_metrics(self, dm) -> None:
         self._metrics = dm
@@ -548,6 +556,24 @@ class DeviceTelemetry:
         with self._lock:
             self._sched_cls_locked(label)["rejected"] += n
 
+    def record_commit_residual(self, total: int, residual: int) -> None:
+        """One commit-boundary verify: `total` signatures structurally
+        checked, `residual` of them actually dispatched (the rest swept
+        from the verified-signature cache)."""
+        with self._lock:
+            self.commit_verifies += 1
+            self.commit_sigs_total += total
+            self.commit_residual_total += residual
+            self.commit_residual_last = residual
+        _recorder.RECORDER.record(
+            "consensus", "commit_verify", total=total, residual=residual
+        )
+        dm = self._metrics
+        if dm is not None:
+            dm.commit_residual_sigs.set(residual)
+            dm.commit_cached_sigs_total.inc(total - residual)
+            dm.commit_residual_sigs_total.inc(residual)
+
     def record_breaker(self, tripped: bool, retry_in_s: float = 0.0) -> None:
         with self._lock:
             changed = tripped != self.breaker_tripped
@@ -597,6 +623,19 @@ class DeviceTelemetry:
                         "batches": self.cpu_route_batches,
                         "sigs": self.cpu_route_sigs,
                     },
+                },
+                "commit_verify": {
+                    "verifies": self.commit_verifies,
+                    "sigs_total": self.commit_sigs_total,
+                    "residual_total": self.commit_residual_total,
+                    "residual_last": self.commit_residual_last,
+                    "cached_frac": round(
+                        1.0
+                        - self.commit_residual_total / self.commit_sigs_total,
+                        6,
+                    )
+                    if self.commit_sigs_total
+                    else 0.0,
                 },
                 "scheduler": {
                     "classes": {

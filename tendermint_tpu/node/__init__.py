@@ -402,6 +402,9 @@ class Node(BaseService):
 
             self.device_metrics = tmm.DeviceMetrics(self.metrics)
             tmtrace.DEVICE.set_metrics(self.device_metrics)
+            from tendermint_tpu.libs.sigcache import SIG_CACHE
+
+            SIG_CACHE.set_metrics(self.device_metrics)
             self.runtime_metrics = tmm.RuntimeMetrics(self.metrics)
             RECORDER.set_metrics(self.runtime_metrics)
             mhost, mport = parse_laddr(cfg.instrumentation.prometheus_listen_addr)

@@ -498,9 +498,14 @@ class Environment:
         from tendermint_tpu.libs.recorder import clock_anchor
 
         cs = self.consensus_state
+        stream = {
+            "inflight": len(getattr(cs, "_stream_inflight", ())),
+            "dispatched": getattr(cs, "_stream_dispatched", 0),
+            "applied": getattr(cs, "_stream_applied", 0),
+        }
         tracer = getattr(cs, "tracer", None)
         if tracer is None or not tracer.enabled:
-            return {"enabled": False, "traces": []}
+            return {"enabled": False, "stream": stream, "traces": []}
         try:
             n = max(1, min(int(n), 100))
         except (TypeError, ValueError):
@@ -512,6 +517,7 @@ class Environment:
             "anchor": clock_anchor(),
             "total": tracer.completed,
             "total_dropped": tracer.dropped,
+            "stream": stream,
             "traces": tracer.traces(limit=n, name="height", since_ns=since_ns),
         }
         active = getattr(cs, "_height_span", None)
@@ -540,6 +546,11 @@ class Environment:
                 )
             except Exception:  # noqa: BLE001 — diagnostics must not break
                 pass
+        # verified-signature cache (libs/sigcache — crypto-free import):
+        # hit/miss/eviction counters + the commit-boundary residual proof
+        from tendermint_tpu.libs.sigcache import SIG_CACHE
+
+        snap["sigcache"] = SIG_CACHE.snapshot()
         return snap
 
     async def debug_device(self) -> dict:

@@ -38,11 +38,21 @@ RESP_BROADCAST_TX = pb.Desc(
 
 
 def _txres_to_proto(d: dict) -> dict:
-    """RPC-side tx-result dict (hex data) -> protobuf field dict."""
+    """RPC-side tx-result dict (hex data, `tx_response_json` shape) ->
+    protobuf field dict. Carries the FULL ResponseCheckTx/DeliverTx field
+    set — gas accounting, events, info, codespace — so a reference-built
+    gRPC client sees the same response a JSON-RPC client does (the
+    `_events_to_proto` compound-key dict <-> repeated Event mapping is
+    the abci/proto.py one the ABCI socket codec uses)."""
     return {
         "code": d.get("code", 0),
         "data": bytes.fromhex(d["data"]) if d.get("data") else b"",
         "log": d.get("log", ""),
+        "info": d.get("info", ""),
+        "gas_wanted": int(d.get("gas_wanted") or 0),
+        "gas_used": int(d.get("gas_used") or 0),
+        "events": pb._events_to_proto(d.get("events") or {}),
+        "codespace": d.get("codespace", ""),
     }
 
 
@@ -52,6 +62,11 @@ def _txres_from_proto(v: dict | None) -> dict:
         "code": v.get("code", 0),
         "data": v.get("data", b"").hex(),
         "log": v.get("log", ""),
+        "info": v.get("info", ""),
+        "gas_wanted": v.get("gas_wanted", 0),
+        "gas_used": v.get("gas_used", 0),
+        "events": pb._events_from_proto(v.get("events")),
+        "codespace": v.get("codespace", ""),
     }
 
 

@@ -17,8 +17,12 @@ Record shapes accepted per file:
 - JSONL / concatenated JSON lines of bare records (a quick_bench run
   with several sizes).
 
-All tracked metrics are rates (verifies/s, tx/s) — higher is better.
-`--lower-is-better` flips the direction for latency-style records.
+Direction is per metric: rate records (verifies/s, tx/s, blocks/s) are
+higher-is-better; latency records — unit `ms`/`s`, or a metric name
+ending `_ms`/`_seconds`, like the streaming pipeline's
+`ed25519_stream_commit_*_residual_ms` — are lower-is-better
+automatically. `--lower-is-better` forces the latency direction for
+every record (legacy flag, kept for explicit latency-only files).
 
 Usage:
     python -m tendermint_tpu.tools.bench_compare OLD NEW [--threshold 0.10]
@@ -74,10 +78,23 @@ def load_records(path: str) -> dict[str, dict]:
     return out
 
 
+def _lower_is_better(metric: str, record: dict) -> bool:
+    """Latency-style records regress UPWARD: detected from the unit
+    (`ms`, `s`, `seconds`) or the metric-name suffix."""
+    unit = str(record.get("unit", "")).lower()
+    if "/" in unit:  # a rate (verifies/s, blocks/s): higher is better
+        return False
+    return unit in ("ms", "s", "seconds") or metric.endswith(
+        ("_ms", "_seconds", "_latency")
+    )
+
+
 def compare(old: dict[str, dict], new: dict[str, dict],
             threshold: float = 0.10, lower_is_better: bool = False) -> dict:
     """Per-metric deltas over the intersection. A regression is a change
-    past `threshold` in the bad direction."""
+    past `threshold` in the bad direction — per-metric (latency units
+    regress upward, rates downward) unless `lower_is_better` forces the
+    latency direction for every record."""
     rows = []
     regressions = []
     for metric in sorted(set(old) & set(new)):
@@ -85,9 +102,8 @@ def compare(old: dict[str, dict], new: dict[str, dict],
         if ov == 0:
             continue
         delta = (nv - ov) / abs(ov)
-        regressed = (delta < -threshold) if not lower_is_better else (
-            delta > threshold
-        )
+        lower = lower_is_better or _lower_is_better(metric, new[metric])
+        regressed = (delta > threshold) if lower else (delta < -threshold)
         rows.append({
             "metric": metric,
             "old": ov,

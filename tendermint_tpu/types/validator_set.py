@@ -19,6 +19,8 @@ from typing import TYPE_CHECKING, Iterable
 
 from tendermint_tpu.crypto import PubKey, merkle
 from tendermint_tpu.crypto.batch import BatchVerifier
+from tendermint_tpu.libs import trace as _trace
+from tendermint_tpu.libs.sigcache import SIG_CACHE
 from tendermint_tpu.types.validator import Validator
 from tendermint_tpu.types.vote import BlockID, VoteType
 
@@ -43,6 +45,58 @@ def _trunc_div(a: int, b: int) -> int:
 
 class VerifyError(Exception):
     pass
+
+
+def _verify_triples_cached(
+    triples: "list[tuple[PubKey, bytes, bytes]]", height: int
+) -> list[bool]:
+    """Verify (pubkey, sign-bytes, signature) triples through the
+    verified-signature cache (libs/sigcache): hits are swept without
+    touching the crypto stack, and only the residual of never-streamed
+    signatures is batched to the backend. Newly verified signatures are
+    recorded for `height`, so the NEXT consumer of the same commit (the
+    proposal-block LastCommit check, the boot-time re-ingest) sweeps
+    them too. Telemetry: a `commit_verify` span with the residual size,
+    plus trace.DEVICE commit-residual counters."""
+    enabled = SIG_CACHE.enabled
+    keys: list[bytes | None] = []
+    flags: list[bool] = []
+    bv = BatchVerifier()
+    for pk, sb, sig in triples:
+        # disabled cache: skip the keying sha256 too (pre-cache hot path)
+        k = SIG_CACHE.key(pk.bytes(), sb, sig) if enabled else None
+        hit = k is not None and SIG_CACHE.hit(k)
+        keys.append(k)
+        flags.append(hit)
+        if not hit:
+            bv.add(pk, sb, sig)
+    residual = len(bv)
+    with _trace.span(
+        "commit_verify",
+        height=height,
+        total=len(triples),
+        cached=len(triples) - residual,
+        residual=residual,
+    ):
+        rest = iter(bv.verify_all())
+    results: list[bool] = []
+    for hit, k in zip(flags, keys):
+        if hit:
+            results.append(True)
+            continue
+        ok = next(rest)
+        if ok and k is not None:
+            SIG_CACHE.put(k, height)
+        results.append(ok)
+    _trace.DEVICE.record_commit_residual(len(triples), residual)
+    return results
+
+
+def _verify_items_cached(items, height: int) -> list[bool]:
+    """`_verify_triples_cached` over `_commit_precheck` items."""
+    return _verify_triples_cached(
+        [(pk, sb, sig) for pk, sb, sig, _val, _idx, _pc in items], height
+    )
 
 
 class TooMuchChangeError(VerifyError):
@@ -282,13 +336,15 @@ class ValidatorSet:
     def verify_commit(
         self, chain_id: str, block_id: BlockID, height: int, commit: "Commit"
     ) -> None:
-        """Reference validator_set.go:591-633 — hot loop #2. All precommit
-        signatures are verified in ONE device batch. Raises VerifyError."""
+        """Reference validator_set.go:591-633 — hot loop #2. Signatures
+        the streamed vote path already verified (libs/sigcache) are
+        swept from the cache; only the *residual* of never-streamed
+        signatures goes to the device — on a live net that residual is
+        ~0 and commit verify is a cache sweep. Raises VerifyError."""
         items = self._commit_precheck(chain_id, block_id, height, commit)
-        bv = BatchVerifier()
-        for pk, sb, sig, _val, _idx, _pc in items:
-            bv.add(pk, sb, sig)
-        self._commit_tally(block_id, items, bv.verify_all())
+        self._commit_tally(
+            block_id, items, _verify_items_cached(items, height)
+        )
 
     def verify_future_commit(
         self,
@@ -304,7 +360,7 @@ class ValidatorSet:
         old_vals = self
         new_set.verify_commit(chain_id, block_id, height, commit)
         round_ = commit.round()
-        bv = BatchVerifier()
+        triples = []
         indexed = []
         seen: set[int] = set()
         for idx, precommit in enumerate(commit.precommits):
@@ -320,9 +376,11 @@ class ValidatorSet:
             if val is None or old_idx in seen:
                 continue  # missing from old set, or double vote
             seen.add(old_idx)
-            bv.add(val.pub_key, commit.vote_sign_bytes(chain_id, idx), precommit.signature)
+            triples.append(
+                (val.pub_key, commit.vote_sign_bytes(chain_id, idx), precommit.signature)
+            )
             indexed.append((idx, precommit, val))
-        results = bv.verify_all()
+        results = _verify_triples_cached(triples, height)
         old_power = 0
         for ok, (idx, precommit, val) in zip(results, indexed):
             if not ok:
@@ -389,10 +447,14 @@ def verify_commits(
     (blockchain/v0/reactor.go:313 inside poolRoutine), a syncing node here
     fuses a whole window of pending heights into one signature batch, so
     the per-launch device dispatch cost amortizes over the window.
+    Signatures already in the verified-signature cache (a re-synced
+    window, or commits whose votes streamed through consensus) skip the
+    batch; only each commit's residual dispatches.
     """
     bv = BatchVerifier()
     per_entry: list = []
     errs: list[Exception | None] = [None] * len(entries)
+    total = 0
     for e_i, (vs, chain_id, block_id, height, commit) in enumerate(entries):
         try:
             items = vs._commit_precheck(chain_id, block_id, height, commit)
@@ -400,19 +462,43 @@ def verify_commits(
             errs[e_i] = ex
             per_entry.append(None)
             continue
+        flags = []
         for pk, sb, sig, _val, _idx, _pc in items:
-            bv.add(pk, sb, sig)
-        per_entry.append(items)
-    results = bv.verify_all()
-    pos = 0
-    for e_i, items in enumerate(per_entry):
-        if items is None:
+            k = (
+                SIG_CACHE.key(pk.bytes(), sb, sig)
+                if SIG_CACHE.enabled
+                else None
+            )
+            hit = k is not None and SIG_CACHE.hit(k)
+            flags.append((hit, k))
+            if not hit:
+                bv.add(pk, sb, sig)
+        total += len(items)
+        per_entry.append((items, flags, height))
+    residual = len(bv)
+    with _trace.span(
+        "commits_verify", commits=len(entries), total=total,
+        cached=total - residual, residual=residual,
+    ):
+        rest = iter(bv.verify_all())
+    for e_i, entry in enumerate(per_entry):
+        if entry is None:
             continue
-        chunk = results[pos:pos + len(items)]
-        pos += len(items)
+        items, flags, height = entry
+        chunk = []
+        for hit, k in flags:
+            if hit:
+                chunk.append(True)
+                continue
+            ok = next(rest)
+            if ok and k is not None:
+                SIG_CACHE.put(k, height)
+            chunk.append(ok)
         vs, _chain_id, block_id, _height, _commit = entries[e_i]
         try:
             vs._commit_tally(block_id, items, chunk)
         except VerifyError as ex:
             errs[e_i] = ex
+    if total:
+        _trace.DEVICE.record_commit_residual(total, residual)
     return errs

@@ -18,6 +18,7 @@ from dataclasses import dataclass
 from tendermint_tpu.crypto.batch import BatchVerifier
 from tendermint_tpu.libs.bit_array import BitArray
 from tendermint_tpu.libs.recorder import RECORDER
+from tendermint_tpu.libs.sigcache import SIG_CACHE
 from tendermint_tpu.types.validator_set import ValidatorSet
 from tendermint_tpu.types.vote import BlockID, Vote, VoteType
 
@@ -33,6 +34,36 @@ class ConflictingVoteError(VoteSetError):
         super().__init__(f"conflicting votes: {existing} vs {conflicting}")
         self.existing = existing
         self.conflicting = conflicting
+
+
+class PendingVotes:
+    """One prepared-but-unverified `add_votes` batch (the two-phase API
+    behind the streaming vote pipeline, docs/vote_pipeline.md).
+
+    `VoteSet.begin_add_votes` runs the structural prechecks, dedups, and
+    consults the verified-signature cache, leaving only the genuinely
+    unverified signatures queued on `bv`; the caller verifies those
+    however it likes (inline `bv.verify_all()`, or off-loop through the
+    device scheduler) and hands the verdicts to
+    `VoteSet.finish_add_votes`, which applies them with the exact
+    serial-equivalent accept/reject semantics `add_votes` documents —
+    including re-evaluating conflicts against any state that changed
+    while the batch was in flight.
+    """
+
+    __slots__ = ("votes", "checked", "bv", "collect", "errors")
+
+    def __init__(self, votes, checked, bv, collect, errors):
+        self.votes = votes
+        self.checked = checked
+        self.bv = bv
+        self.collect = collect
+        self.errors = errors
+
+    @property
+    def n_verify(self) -> int:
+        """Signatures that still need a live verify (cache misses)."""
+        return len(self.bv)
 
 
 @dataclass
@@ -98,11 +129,22 @@ class VoteSet:
         and the vote is reported False — each vote gets exactly the outcome
         it would have gotten through a serial add_vote sequence.
         """
+        pending = self.begin_add_votes(votes, errors=errors)
+        return self.finish_add_votes(pending, pending.bv.verify_all())
+
+    def begin_add_votes(
+        self, votes: list[Vote], errors: list | None = None
+    ) -> PendingVotes:
+        """Phase 1 of `add_votes`: structural checks, in-batch dedup, and
+        verified-signature-cache consult. Signatures the streamed path
+        already verified skip the batch entirely; only cache misses land
+        on the returned PendingVotes' BatchVerifier."""
         collect = errors is not None
         if collect:
             errors.extend([None] * len(votes))
         bv = BatchVerifier()
-        checked: list[tuple[Vote, int, Vote | None] | None] = []
+        # entry: (vote, power, conflict, cache key, cached) | None
+        checked: list[tuple[Vote, int, Vote | None, bytes, bool] | None] = []
         in_batch: set[tuple[int, bytes, bytes]] = set()
         for i, vote in enumerate(votes):
             try:
@@ -125,20 +167,43 @@ class VoteSet:
                 continue
             in_batch.add(key)
             power, conflict = prepared
-            bv.add(
-                self.val_set.validators[vote.validator_index].pub_key,
-                vote.sign_bytes(self.chain_id),
-                vote.signature,
+            pub = self.val_set.validators[vote.validator_index].pub_key
+            sign_bytes = vote.sign_bytes(self.chain_id)
+            # disabled cache (TMTPU_SIGCACHE=0): skip the keying sha256
+            # too — the escape hatch must restore the pre-cache hot path
+            ckey = (
+                SIG_CACHE.key(pub.bytes(), sign_bytes, vote.signature)
+                if SIG_CACHE.enabled
+                else None
             )
-            checked.append((vote, power, conflict))
-        results = iter(bv.verify_all())
+            cached = ckey is not None and SIG_CACHE.hit(ckey)
+            if not cached:
+                bv.add(pub, sign_bytes, vote.signature)
+            checked.append((vote, power, conflict, ckey, cached))
+        return PendingVotes(votes, checked, bv, collect, errors)
+
+    def finish_add_votes(
+        self, pending: PendingVotes, results: list[bool] | None = None
+    ) -> list[bool]:
+        """Phase 2 of `add_votes`: apply verdicts in batch order with the
+        serial-equivalent semantics documented on `add_votes`. `results`
+        is one bool per cache-missed signature (pending.bv order); state
+        that changed while the batch was in flight — earlier batch
+        members, or a whole other batch — is re-evaluated here, exactly
+        as the in-batch conflict re-check always did."""
+        votes, checked = pending.votes, pending.checked
+        collect, errors = pending.collect, pending.errors
+        results = iter(results if results is not None else ())
         out = []
         for i, (vote, item) in enumerate(zip(votes, checked)):
             if item is None:
                 out.append(False)  # duplicate or collected precheck error
                 continue
-            v, power, conflict = item
-            if not next(results):
+            v, power, conflict, ckey, cached = item
+            ok = True if cached else next(results)
+            if ok and not cached and ckey is not None:
+                SIG_CACHE.put(ckey, self.height)
+            if not ok:
                 err = VoteSetError(f"invalid signature for {v}")
                 if not collect:
                     raise err
@@ -381,13 +446,20 @@ class VoteStream:
     answer quorum queries) calls flush(). Exact duplicates across bursts
     are dropped at feed() so repeated gossip deliveries never occupy buffer
     space or verify lanes.
+
+    The default high-water mark consults the device scheduler's routing
+    threshold (`crypto.batch.stream_flush_hint`): with the scheduler's
+    packer coalescing co-resident work into one dispatch, a flush only
+    needs to cross `ops.effective_min_batch` — waiting for a multiple of
+    it (the synchronous accumulation hint) would add latency for lanes
+    the packer fills anyway.
     """
 
     def __init__(self, vote_set: VoteSet, high_water: int | None = None) -> None:
         from tendermint_tpu.crypto import batch as _cb
 
         self.vote_set = vote_set
-        self.high_water = high_water or _cb.accumulation_hint()
+        self.high_water = high_water or _cb.stream_flush_hint()
         self._pending: list[Vote] = []
         self._seen: set[tuple[int, bytes, bytes]] = set()
         self._results: list[bool] = []

@@ -1,0 +1,102 @@
+"""libs/bech32 — BIP-0173 vectors + the reference's ConvertAndEncode /
+DecodeAndConvert wrapper semantics (libs/bech32/bech32.go)."""
+from __future__ import annotations
+
+import hashlib
+
+import pytest
+
+from tendermint_tpu.libs import bech32
+
+# BIP-0173 valid test vectors (checksum must verify)
+VALID = [
+    "A12UEL5L",
+    "a12uel5l",
+    "an83characterlonghumanreadablepartthatcontainsthenumber1andtheexcludedcharactersbio1tt5tgs",
+    "abcdef1qpzry9x8gf2tvdw0s3jn54khce6mua7lmqqqxw",
+    "11qqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqc8247j",
+    "split1checkupstagehandshakeupstreamerranterredcaperred2y9e3w",
+    "?1ezyfcl",
+]
+
+# BIP-0173 invalid vectors (each must raise)
+INVALID = [
+    "\x201nwldj5",          # HRP char out of range
+    "\x7f1axkwrx",          # HRP char out of range
+    "an84characterslonghumanreadablepartthatcontainsthenumber1andtheexcludedcharactersbio1569pvx",
+    "pzry9x0s0muk",          # no separator
+    "1pzry9x0s0muk",         # empty HRP
+    "x1b4n0q5v",             # invalid data character
+    "li1dgmt3",              # too-short checksum
+    "de1lg7wt\xff",          # invalid checksum character
+    "A1G7SGD8",              # checksum calculated with uppercase HRP
+    "10a06t8",               # empty HRP
+    "1qzzfhee",              # empty HRP
+    "A12UEL5l",              # mixed case
+]
+
+
+class TestBIP173Vectors:
+    @pytest.mark.parametrize("bech", VALID)
+    def test_valid_checksums_decode(self, bech):
+        hrp, data = bech32.decode(bech)
+        assert hrp == bech.lower().rsplit("1", 1)[0]
+        # re-encoding canonicalizes to lowercase and round-trips
+        assert bech32.encode(hrp, data) == bech.lower()
+
+    @pytest.mark.parametrize("bech", INVALID)
+    def test_invalid_strings_raise(self, bech):
+        with pytest.raises(ValueError):
+            bech32.decode(bech)
+
+    def test_flipped_bit_breaks_checksum(self):
+        s = bech32.convert_and_encode("tm", b"\x00\x01\x02")
+        corrupted = s[:-1] + ("q" if s[-1] != "q" else "p")
+        with pytest.raises(ValueError):
+            bech32.decode(corrupted)
+
+
+class TestConvertAndEncode:
+    def test_reference_shasum_example_round_trips(self):
+        # the reference's own test (libs/bech32/bech32_test.go):
+        # ConvertAndEncode("shasum", sha256("test data"))
+        digest = hashlib.sha256(b"test data").digest()
+        s = bech32.convert_and_encode("shasum", digest)
+        assert s.startswith("shasum1") and s == s.lower()
+        hrp, out = bech32.decode_and_convert(s)
+        assert (hrp, out) == ("shasum", digest)
+
+    # 90-char total limit (BIP-0173): ~50 data bytes max under a 2-char
+    # HRP, which comfortably covers 20-byte addresses + 32-byte digests
+    @pytest.mark.parametrize("n", [0, 1, 19, 20, 32, 33, 48])
+    def test_round_trip_all_lengths(self, n):
+        data = bytes(range(n % 256))[:n] or b""
+        data = bytes((i * 37) % 256 for i in range(n))
+        s = bech32.convert_and_encode("tm", data)
+        hrp, out = bech32.decode_and_convert(s)
+        assert (hrp, out) == ("tm", data)
+
+    def test_address_shape(self):
+        # a 20-byte tendermint address: the display use case
+        addr = hashlib.sha256(b"val").digest()[:20]
+        s = bech32.convert_and_encode("cosmos", addr)
+        assert bech32.decode_and_convert(s) == ("cosmos", addr)
+
+    def test_nonzero_padding_rejected_on_decode(self):
+        # 5-bit words whose 8-bit regroup has nonzero padding are invalid
+        hrp, words = bech32.decode(bech32.encode("tm", [1]))
+        with pytest.raises(ValueError):
+            bech32._convert_bits(words, 5, 8, False)
+
+    def test_bad_hrp_rejected_on_encode(self):
+        with pytest.raises(ValueError):
+            bech32.encode("", [0])
+        with pytest.raises(ValueError):
+            bech32.convert_and_encode("b\x7fd", b"aa")
+
+    def test_out_of_range_word_rejected_on_encode(self):
+        # the Go reference encoder errors on words >= 32 too
+        with pytest.raises(ValueError):
+            bech32.encode("tm", [32])
+        with pytest.raises(ValueError):
+            bech32.encode("tm", [-1])

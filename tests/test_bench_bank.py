@@ -122,7 +122,7 @@ class TestQuickBench:
     def test_escalates_and_prints_json_per_size(self, capsys):
         # tiny sizes on CPU: same code path, bucket 128 (shared with the
         # rest of the suite's compile cache); platform!=tpu so no banking
-        quick_bench.main(sizes=(4, 8))
+        quick_bench.main(sizes=(4, 8), secp=False)
         lines = [
             json.loads(ln)
             for ln in capsys.readouterr().out.splitlines()
@@ -143,7 +143,7 @@ class TestQuickBench:
         # the ISSUE 8 admission-pipeline mode: distinct metric names so
         # bench_compare never cross-compares direct vs scheduler records
         pytest.importorskip("cryptography", reason="crypto stack unavailable")
-        quick_bench.main(sizes=(4,), scheduler=True)
+        quick_bench.main(sizes=(4,), scheduler=True, secp=False)
         lines = [
             json.loads(ln)
             for ln in capsys.readouterr().out.splitlines()
@@ -154,6 +154,54 @@ class TestQuickBench:
         ]
         assert lines[0]["value"] > 0
         assert "DeviceScheduler" in lines[0]["source"]
+
+    def test_secp_bucket_emits_record(self, capsys):
+        # the ISSUE 10 escalation extension: one secp256k1 bucket through
+        # the scheduler admission path (tiny n: same code path, CPU route)
+        pytest.importorskip("cryptography", reason="crypto stack unavailable")
+        from tendermint_tpu.crypto import secp256k1 as sk
+
+        try:
+            sk.gen_priv_key(seed=b"probe").sign(b"probe")
+        except Exception as e:  # noqa: BLE001 — e.g. stubbed EC backend
+            pytest.skip(f"secp256k1 unavailable: {e!r}")
+
+        class _Dev:
+            platform = "cpu"
+            device_kind = "host"
+
+        quick_bench.secp_bucket(_Dev(), n=8)
+        lines = [
+            json.loads(ln)
+            for ln in capsys.readouterr().out.splitlines()
+            if ln.startswith("{")
+        ]
+        assert [r["metric"] for r in lines] == ["secp256k1_verify_8v_per_sec"]
+        assert lines[0]["value"] > 0 and lines[0]["unit"] == "verifies/s"
+
+    def test_stream_mode_emits_warm_stream_records(self, capsys):
+        # the warm-stream commit shape: sync baseline, streamed ingest,
+        # warm commit-boundary rate, residual latency — and the warm
+        # number must beat the synchronous baseline on the same shape
+        pytest.importorskip("cryptography", reason="crypto stack unavailable")
+        quick_bench.stream_main(sizes=(12,))
+        lines = [
+            json.loads(ln)
+            for ln in capsys.readouterr().out.splitlines()
+            if ln.startswith("{")
+        ]
+        metrics = {r["metric"]: r for r in lines}
+        assert set(metrics) == {
+            "ed25519_stream_commit_12v_sync_per_sec",
+            "ed25519_stream_ingest_12v_per_sec",
+            "ed25519_stream_commit_12v_warm_per_sec",
+            "ed25519_stream_commit_12v_residual_ms",
+        }
+        resid = metrics["ed25519_stream_commit_12v_residual_ms"]
+        assert resid["unit"] == "ms" and resid["residual_sigs"] == 0
+        warm = metrics["ed25519_stream_commit_12v_warm_per_sec"]
+        sync = metrics["ed25519_stream_commit_12v_sync_per_sec"]
+        assert warm["value"] > sync["value"], (warm, sync)
 
     def test_bank_atomic_overwrite(self, tmp_path):
         path = str(tmp_path / "banked_quick.json")

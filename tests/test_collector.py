@@ -723,4 +723,31 @@ class TestBenchCompare:
         new = self._write(tmp_path, "new.json",
                           {"metric": "lat_ms", "value": 12.0})
         assert bench_compare.main([old, new, "--lower-is-better"]) == 1
-        assert bench_compare.main([old, new]) == 0
+        # per-metric direction (ISSUE 10): the `_ms` suffix marks a
+        # latency record — the upward move regresses WITHOUT the flag too
+        assert bench_compare.main([old, new]) == 1
+
+    def test_latency_unit_auto_direction(self, tmp_path):
+        # the streaming pipeline's residual-latency record: unit "ms"
+        # regresses upward, improves downward — no flag needed — while a
+        # rate record in the same file keeps the higher-is-better gate
+        def recs(residual, rate):
+            return [
+                {"metric": "ed25519_stream_commit_10000v_residual_ms",
+                 "value": residual, "unit": "ms"},
+                {"metric": "ed25519_stream_commit_10000v_warm_per_sec",
+                 "value": rate, "unit": "verifies/s"},
+            ]
+
+        old = self._write(tmp_path, "old.json", recs(5.0, 2e6))
+        worse = self._write(tmp_path, "worse.json", recs(9.0, 2e6))
+        better = self._write(tmp_path, "better.json", recs(1.0, 3e6))
+        assert bench_compare.main([old, worse]) == 1
+        assert bench_compare.main([old, better]) == 0
+        res = bench_compare.compare(
+            bench_compare.load_records(old),
+            bench_compare.load_records(worse),
+        )
+        assert res["regressions"] == [
+            "ed25519_stream_commit_10000v_residual_ms"
+        ]

@@ -60,14 +60,24 @@ class TestGRPCBroadcast:
             {
                 "check_tx": _txres_to_proto({"code": 0, "data": "", "log": "ok"}),
                 "deliver_tx": _txres_to_proto(
-                    {"code": 5, "data": "beef", "log": ""}
+                    {
+                        "code": 5, "data": "beef", "log": "",
+                        "info": "why", "gas_wanted": 100, "gas_used": 42,
+                        "events": {"app.key": ["v1", "v2"]},
+                        "codespace": "sdk",
+                    }
                 ),
             }
         )
         v = RESP_BROADCAST_TX.decode(body)
+        # the FULL ResponseCheckTx/DeliverTx field set round-trips —
+        # reference clients see gas accounting + events, not zeroes
         assert _txres_from_proto(v.get("check_tx")) == {
-            "code": 0, "data": "", "log": "ok",
+            "code": 0, "data": "", "log": "ok", "info": "",
+            "gas_wanted": 0, "gas_used": 0, "events": {}, "codespace": "",
         }
         assert _txres_from_proto(v.get("deliver_tx")) == {
-            "code": 5, "data": "beef", "log": "",
+            "code": 5, "data": "beef", "log": "", "info": "why",
+            "gas_wanted": 100, "gas_used": 42,
+            "events": {"app.key": ["v1", "v2"]}, "codespace": "sdk",
         }

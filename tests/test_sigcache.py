@@ -1,0 +1,153 @@
+"""Verified-signature cache (libs/sigcache — ISSUE 10).
+
+Crypto-free (the libs/fault.py rule): the cache stores opaque keys, so
+every semantic — hit/miss accounting, per-height eviction, capacity
+bounds, the disabled mode, metrics mirroring — is provable without the
+crypto stack. The end-to-end soundness (a hit never launders a bad
+signature) is pinned in tests/test_stream_pipeline.py over real keys.
+"""
+from __future__ import annotations
+
+from tendermint_tpu.libs.sigcache import VerifiedSigCache
+
+
+def k(tag: bytes) -> bytes:
+    return VerifiedSigCache.key(b"pub" + tag, b"msg" + tag, b"sig" + tag)
+
+
+class TestKeying:
+    def test_key_binds_all_three_components(self):
+        base = VerifiedSigCache.key(b"pub", b"msg", b"sig")
+        assert VerifiedSigCache.key(b"puB", b"msg", b"sig") != base
+        assert VerifiedSigCache.key(b"pub", b"msG", b"sig") != base
+        assert VerifiedSigCache.key(b"pub", b"msg", b"siG") != base
+        assert VerifiedSigCache.key(b"pub", b"msg", b"sig") == base
+
+    def test_message_is_digested_not_stored(self):
+        big = b"x" * 1_000_000
+        key = VerifiedSigCache.key(b"pub", big, b"sig")
+        assert len(key) == 32 + 3 + 3  # sha256 + pub + sig
+
+
+class TestHitMiss:
+    def test_put_then_hit(self):
+        c = VerifiedSigCache(enabled=True)
+        assert not c.hit(k(b"a"))  # miss counted
+        c.put(k(b"a"), height=5)
+        assert c.hit(k(b"a"))
+        snap = c.snapshot()
+        assert snap["hits"] == 1 and snap["misses"] == 1
+        assert snap["hit_ratio"] == 0.5
+        assert snap["entries"] == 1 and snap["puts"] == 1
+
+    def test_duplicate_put_is_idempotent(self):
+        c = VerifiedSigCache(enabled=True)
+        c.put(k(b"a"), height=5)
+        c.put(k(b"a"), height=6)  # same key, later height: first wins
+        assert c.snapshot()["entries"] == 1
+        c.advance(5 + c.retain_heights + 1)
+        assert not c.hit(k(b"a"))  # evicted under its ORIGINAL height
+
+    def test_disabled_never_hits_never_stores(self):
+        c = VerifiedSigCache(enabled=False)
+        c.put(k(b"a"), height=1)
+        assert not c.hit(k(b"a"))
+        snap = c.snapshot()
+        assert snap["entries"] == 0 and snap["hits"] == 0 == snap["misses"]
+        assert snap["enabled"] is False
+
+
+class TestEviction:
+    def test_advance_drops_heights_past_retain_window(self):
+        c = VerifiedSigCache(enabled=True, retain_heights=3)
+        for h in range(1, 6):
+            c.put(k(b"h%d" % h), height=h)
+        c.advance(6)  # floor = 3: heights 1, 2 drop
+        assert not c.hit(k(b"h1"))
+        assert not c.hit(k(b"h2"))
+        for h in (3, 4, 5):
+            assert c.hit(k(b"h%d" % h))
+        assert c.snapshot()["evicted"] == 2
+
+    def test_advance_backwards_is_harmless(self):
+        c = VerifiedSigCache(enabled=True, retain_heights=2)
+        c.put(k(b"a"), height=10)
+        c.advance(1)
+        assert c.hit(k(b"a"))
+
+    def test_capacity_evicts_oldest_height_buckets_first(self):
+        c = VerifiedSigCache(enabled=True, max_entries=4, retain_heights=100)
+        for i in range(3):
+            c.put(k(b"h1-%d" % i), height=1)
+        for i in range(3):
+            c.put(k(b"h2-%d" % i), height=2)
+        snap = c.snapshot()
+        assert snap["entries"] <= 4
+        # the height-1 bucket (oldest) paid the eviction
+        assert not c.hit(k(b"h1-0"))
+        assert c.hit(k(b"h2-2"))
+
+    def test_capacity_never_empties_the_live_bucket(self):
+        # a single huge height (fast-sync window) may exceed max_entries:
+        # eviction stops rather than dropping the bucket being filled
+        c = VerifiedSigCache(enabled=True, max_entries=2, retain_heights=100)
+        for i in range(5):
+            c.put(k(b"one-%d" % i), height=7)
+        assert c.snapshot()["entries"] == 5  # one bucket: kept whole
+        c.advance(7 + 101)
+        assert c.snapshot()["entries"] == 0
+
+
+class _Series:
+    def __init__(self):
+        self.value = 0.0
+
+    def inc(self, v: float = 1.0) -> None:
+        self.value += v
+
+    def set(self, v: float) -> None:
+        self.value = v
+
+
+class _StubMetrics:
+    def __init__(self):
+        self.sigcache_hits_total = _Series()
+        self.sigcache_misses_total = _Series()
+        self.sigcache_entries = _Series()
+        self.sigcache_evicted_total = _Series()
+
+
+class TestMetricsMirroring:
+    def test_counters_mirrored(self):
+        c = VerifiedSigCache(enabled=True, retain_heights=1)
+        dm = _StubMetrics()
+        c.set_metrics(dm)
+        c.hit(k(b"a"))
+        c.put(k(b"a"), height=1)
+        c.hit(k(b"a"))
+        assert dm.sigcache_hits_total.value == 1
+        assert dm.sigcache_misses_total.value == 1
+        assert dm.sigcache_entries.value == 1
+        c.advance(10)
+        assert dm.sigcache_entries.value == 0
+        assert dm.sigcache_evicted_total.value == 1
+
+    def test_set_metrics_syncs_current_entry_count(self):
+        c = VerifiedSigCache(enabled=True)
+        c.put(k(b"a"), height=1)
+        dm = _StubMetrics()
+        c.set_metrics(dm)
+        assert dm.sigcache_entries.value == 1
+
+
+class TestProcessSingleton:
+    def test_singleton_exists_and_snapshot_is_json_shaped(self):
+        import json
+
+        from tendermint_tpu.libs.sigcache import SIG_CACHE
+
+        snap = SIG_CACHE.snapshot()
+        json.dumps(snap)
+        for field in ("enabled", "entries", "hits", "misses", "hit_ratio",
+                      "puts", "evicted", "max_entries", "retain_heights"):
+            assert field in snap

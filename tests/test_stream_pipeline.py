@@ -1,0 +1,387 @@
+"""Streaming vote-verification pipeline (ISSUE 10, docs/vote_pipeline.md).
+
+Three layers:
+- VoteSet.begin_add_votes / finish_add_votes — the two-phase split must
+  produce byte-identical outcomes to the one-shot add_votes, including
+  when state mutates while a batch is "in flight" (cross-batch conflicts,
+  duplicates, height races).
+- The verified-signature cache end to end over REAL keys: streamed
+  signatures make the commit-boundary verify a cache sweep; a commit
+  containing never-streamed signatures still verifies fully; a bad
+  signature is never laundered by the cache.
+- ConsensusState._stream_dispatch/_stream_apply — async verdict
+  application preserves ordering, error isolation, and equivocation
+  visibility, and the drain barriers hold.
+"""
+from __future__ import annotations
+
+import asyncio
+import hashlib
+
+import pytest
+
+pytest.importorskip("cryptography", reason="vote crypto stack unavailable")
+
+from tendermint_tpu.libs import trace as tmtrace  # noqa: E402
+from tendermint_tpu.libs.sigcache import SIG_CACHE  # noqa: E402
+from tendermint_tpu.types import (  # noqa: E402
+    BlockID, MockPV, PartSetHeader, ValidatorSet, Vote, VoteSet, VoteType,
+)
+from tendermint_tpu.types.validator import Validator  # noqa: E402
+from tendermint_tpu.types.validator_set import VerifyError  # noqa: E402
+from tendermint_tpu.types.vote import now_ns  # noqa: E402
+from tendermint_tpu.types.vote_set import (  # noqa: E402
+    ConflictingVoteError, VoteSetError,
+)
+
+CHAIN_ID = "stream-pipe-chain"
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    """Each test starts cold and leaves nothing behind for the suite."""
+    SIG_CACHE.clear()
+    SIG_CACHE.reset_stats()
+    yield
+    SIG_CACHE.clear()
+    SIG_CACHE.reset_stats()
+
+
+def make_valset(n):
+    pvs = sorted([MockPV() for _ in range(n)], key=lambda p: p.address)
+    vs = ValidatorSet([Validator(pv.get_pub_key(), 10) for pv in pvs])
+    return vs, pvs
+
+
+def rand_block_id(seed=b"x"):
+    h = hashlib.sha256(seed).digest()
+    return BlockID(h, PartSetHeader(1, h))
+
+
+def make_vote(pv, vs, height, round_, type_, block_id):
+    idx, _ = vs.get_by_address(pv.address)
+    v = Vote(type_, height, round_, block_id, now_ns(), pv.address, idx)
+    return pv.sign_vote(CHAIN_ID, v)
+
+
+def mixed_batch(vs, pvs, bid):
+    """good, bad-sig, good, wrong-height, dup-of-first, good."""
+    good = [make_vote(pv, vs, 1, 0, VoteType.PREVOTE, bid) for pv in pvs]
+    bad_sig = good[1].with_signature(b"\x00" * 64)
+    wrong_h = make_vote(pvs[3], vs, 2, 0, VoteType.PREVOTE, bid)
+    return [good[0], bad_sig, good[2], wrong_h, good[0], good[4]]
+
+
+class TestTwoPhaseSerialEquivalence:
+    def test_begin_finish_matches_one_shot(self):
+        vs, pvs = make_valset(7)
+        bid = rand_block_id()
+        batch_a = mixed_batch(vs, pvs, bid)
+
+        one = VoteSet(CHAIN_ID, 1, 0, VoteType.PREVOTE, vs)
+        errs_one: list = []
+        out_one = one.add_votes(batch_a, errors=errs_one)
+
+        SIG_CACHE.clear()  # no cross-talk between the two runs
+        two = VoteSet(CHAIN_ID, 1, 0, VoteType.PREVOTE, vs)
+        errs_two: list = []
+        pending = two.begin_add_votes(batch_a, errors=errs_two)
+        results = pending.bv.verify_all()
+        out_two = two.finish_add_votes(pending, results)
+
+        assert out_one == out_two == [True, False, True, False, False, True]
+        assert [type(e) for e in errs_one] == [type(e) for e in errs_two]
+        assert str(one.votes_bit_array) == str(two.votes_bit_array)
+        assert one.sum == two.sum
+
+    def test_default_raise_mode_still_raises_in_finish(self):
+        vs, pvs = make_valset(4)
+        bid = rand_block_id()
+        voteset = VoteSet(CHAIN_ID, 1, 0, VoteType.PREVOTE, vs)
+        bad = make_vote(pvs[0], vs, 1, 0, VoteType.PREVOTE, bid).with_signature(
+            b"\x11" * 64
+        )
+        pending = voteset.begin_add_votes([bad])
+        with pytest.raises(VoteSetError):
+            voteset.finish_add_votes(pending, pending.bv.verify_all())
+
+    def test_cross_batch_conflict_detected_at_apply(self):
+        """Equivocation split across two in-flight batches is invisible
+        to both prechecks; the apply stage must still catch it."""
+        vs, pvs = make_valset(4)
+        voteset = VoteSet(CHAIN_ID, 1, 0, VoteType.PREVOTE, vs)
+        va = make_vote(pvs[0], vs, 1, 0, VoteType.PREVOTE, rand_block_id(b"a"))
+        vb = make_vote(pvs[0], vs, 1, 0, VoteType.PREVOTE, rand_block_id(b"b"))
+        errs_a: list = []
+        errs_b: list = []
+        pa = voteset.begin_add_votes([va], errors=errs_a)
+        pb = voteset.begin_add_votes([vb], errors=errs_b)  # before A applied
+        ra, rb = pa.bv.verify_all(), pb.bv.verify_all()
+        assert voteset.finish_add_votes(pa, ra) == [True]
+        assert voteset.finish_add_votes(pb, rb) == [False]
+        assert isinstance(errs_b[0], ConflictingVoteError)
+        assert errs_b[0].existing == va and errs_b[0].conflicting == vb
+
+    def test_cross_batch_duplicate_applies_false_without_error(self):
+        vs, pvs = make_valset(4)
+        voteset = VoteSet(CHAIN_ID, 1, 0, VoteType.PREVOTE, vs)
+        v = make_vote(pvs[0], vs, 1, 0, VoteType.PREVOTE, rand_block_id())
+        errs_a: list = []
+        errs_b: list = []
+        pa = voteset.begin_add_votes([v], errors=errs_a)
+        pb = voteset.begin_add_votes([v], errors=errs_b)
+        assert voteset.finish_add_votes(pa, pa.bv.verify_all()) == [True]
+        assert voteset.finish_add_votes(pb, pb.bv.verify_all()) == [False]
+        assert errs_b == [None]  # duplicate, not an error — as serial
+
+
+class TestCacheSemantics:
+    def test_streamed_votes_skip_reverify_in_new_voteset(self):
+        vs, pvs = make_valset(6)
+        bid = rand_block_id()
+        votes = [make_vote(pv, vs, 1, 0, VoteType.PREVOTE, bid) for pv in pvs]
+        first = VoteSet(CHAIN_ID, 1, 0, VoteType.PREVOTE, vs)
+        assert all(first.add_votes(votes))
+        # same votes into a fresh VoteSet (the last_commit re-ingest
+        # shape): zero signatures need verification
+        second = VoteSet(CHAIN_ID, 1, 0, VoteType.PREVOTE, vs)
+        pending = second.begin_add_votes(list(votes))
+        assert pending.n_verify == 0
+        assert all(second.finish_add_votes(pending, []))
+        assert second.has_two_thirds_majority()
+
+    def test_invalid_signature_is_never_cached(self):
+        vs, pvs = make_valset(4)
+        bid = rand_block_id()
+        bad = make_vote(pvs[0], vs, 1, 0, VoteType.PREVOTE, bid).with_signature(
+            b"\x22" * 64
+        )
+        voteset = VoteSet(CHAIN_ID, 1, 0, VoteType.PREVOTE, vs)
+        errs: list = []
+        assert voteset.add_votes([bad], errors=errs) == [False]
+        # retry in a fresh set: still a live verify, still rejected
+        retry = VoteSet(CHAIN_ID, 1, 0, VoteType.PREVOTE, vs)
+        pending = retry.begin_add_votes([bad])
+        assert pending.n_verify == 1
+        with pytest.raises(VoteSetError):
+            retry.finish_add_votes(pending, pending.bv.verify_all())
+
+    def test_cache_disabled_env_still_correct(self):
+        enabled = SIG_CACHE.enabled
+        SIG_CACHE.enabled = False
+        try:
+            vs, pvs = make_valset(4)
+            bid = rand_block_id()
+            votes = [make_vote(pv, vs, 1, 0, VoteType.PREVOTE, bid) for pv in pvs]
+            one = VoteSet(CHAIN_ID, 1, 0, VoteType.PREVOTE, vs)
+            assert all(one.add_votes(votes))
+            two = VoteSet(CHAIN_ID, 1, 0, VoteType.PREVOTE, vs)
+            pending = two.begin_add_votes(list(votes))
+            assert pending.n_verify == len(votes)  # nothing cached
+            assert all(two.finish_add_votes(pending, pending.bv.verify_all()))
+        finally:
+            SIG_CACHE.enabled = enabled
+
+
+def build_commit(vs, pvs, height=1, seed=b"commit"):
+    bid = rand_block_id(seed)
+    voteset = VoteSet(CHAIN_ID, height, 0, VoteType.PRECOMMIT, vs)
+    votes = [make_vote(pv, vs, height, 0, VoteType.PRECOMMIT, bid) for pv in pvs]
+    voteset.add_votes(votes)
+    return bid, voteset.make_commit(), votes
+
+
+class TestCommitBoundaryResidual:
+    def test_warm_commit_verify_is_cache_sweep(self):
+        vs, pvs = make_valset(5)
+        bid, commit, _ = build_commit(vs, pvs)
+        before = tmtrace.DEVICE.snapshot()["commit_verify"]
+        # the build streamed every precommit: residual must be 0
+        vs.verify_commit(CHAIN_ID, bid, 1, commit)
+        after = tmtrace.DEVICE.snapshot()["commit_verify"]
+        assert after["verifies"] == before["verifies"] + 1
+        assert after["residual_last"] == 0
+
+    def test_cold_commit_with_unstreamed_sigs_verifies_fully(self):
+        vs, pvs = make_valset(5)
+        bid, commit, _ = build_commit(vs, pvs)
+        SIG_CACHE.clear()  # synthetic: commit whose sigs never streamed
+        vs.verify_commit(CHAIN_ID, bid, 1, commit)
+        assert tmtrace.DEVICE.snapshot()["commit_verify"]["residual_last"] == len(pvs)
+
+    def test_partial_residual_only_unstreamed_dispatch(self):
+        vs, pvs = make_valset(6)
+        bid, commit, votes = build_commit(vs, pvs)
+        SIG_CACHE.clear()
+        # re-stream HALF the votes (fresh voteset, cold cache)
+        half = VoteSet(CHAIN_ID, 1, 0, VoteType.PRECOMMIT, vs)
+        half.add_votes(votes[:3])
+        vs.verify_commit(CHAIN_ID, bid, 1, commit)
+        assert tmtrace.DEVICE.snapshot()["commit_verify"]["residual_last"] == 3
+
+    def test_bad_sig_in_cold_commit_still_rejected_when_others_cached(self):
+        vs, pvs = make_valset(4)
+        bid, commit, votes = build_commit(vs, pvs)
+        # tamper one precommit signature inside the commit (never cached:
+        # the cache only ever holds verified-True triples)
+        victim = next(i for i, p in enumerate(commit.precommits) if p is not None)
+        commit.precommits[victim] = commit.precommits[victim].with_signature(
+            b"\x33" * 64
+        )
+        with pytest.raises(VerifyError):
+            vs.verify_commit(CHAIN_ID, bid, 1, commit)
+
+    def test_verify_commits_batch_residual_and_puts(self):
+        from tendermint_tpu.types.validator_set import verify_commits
+
+        vs, pvs = make_valset(4)
+        bid1, commit1, _ = build_commit(vs, pvs, height=1, seed=b"h1")
+        bid2, commit2, _ = build_commit(vs, pvs, height=2, seed=b"h2")
+        SIG_CACHE.clear()
+        entries = [
+            (vs, CHAIN_ID, bid1, 1, commit1),
+            (vs, CHAIN_ID, bid2, 2, commit2),
+        ]
+        assert verify_commits(entries) == [None, None]
+        # second pass: all 8 signatures now cached
+        before = SIG_CACHE.snapshot()["hits"]
+        assert verify_commits(entries) == [None, None]
+        assert SIG_CACHE.snapshot()["hits"] == before + 2 * len(pvs)
+
+
+class TestConsensusStreaming:
+    """ConsensusState-level: async dispatch + verdict application."""
+
+    def _run(self, tmp_path, n_vals, scenario):
+        from test_consensus import Fixture
+
+        async def main():
+            pvs = sorted([MockPV() for _ in range(n_vals)],
+                         key=lambda p: p.address)
+            f = Fixture(str(tmp_path), pvs=pvs, pv_index=0, use_wal=False,
+                        start_cs=False)
+            await f.start()
+            try:
+                await scenario(f, pvs)
+            finally:
+                await f.stop()
+
+        asyncio.run(main())
+
+    def test_burst_streams_and_applies_with_error_isolation(self, tmp_path):
+        from tendermint_tpu.consensus import messages as m
+        from tendermint_tpu.consensus.wal import MsgInfo
+
+        async def scenario(f, pvs):
+            cs = f.cs
+            cs.config.vote_stream_min = 2  # force streaming on small groups
+            bid = rand_block_id(b"stream-burst")
+            vs = cs.rs.validators
+            votes = []
+            for pv in pvs[1:]:
+                idx, _ = vs.get_by_address(pv.address)
+                v = Vote(VoteType.PREVOTE, cs.rs.height, 0, bid, now_ns(),
+                         pv.address, idx)
+                votes.append(pv.sign_vote(f.genesis.chain_id, v))
+            votes[2] = votes[2].with_signature(b"\x00" * 64)  # one bad sig
+            for v in votes[1:]:
+                cs.peer_msg_queue.put_nowait(MsgInfo(m.VoteMessage(v), "p"))
+            await cs._handle_peer_batch(MsgInfo(m.VoteMessage(votes[0]), "p"))
+            assert cs._stream_dispatched >= 1
+            assert cs._stream_inflight, "verify should be in flight"
+            await cs._stream_drain()
+            assert cs._stream_applied == cs._stream_dispatched
+            assert not cs._stream_inflight
+            prevotes = cs.rs.votes.prevotes(0)
+            # 8 of 9 landed (80 of 100 power): quorum despite the bad sig
+            maj, ok = prevotes.two_thirds_majority()
+            assert ok and maj == bid
+            idx_bad, _ = cs.rs.validators.get_by_address(votes[2].validator_address)
+            assert prevotes.get_by_index(idx_bad) is None
+
+        self._run(tmp_path, 10, scenario)
+
+    def test_stream_disabled_keeps_sync_path(self, tmp_path):
+        from tendermint_tpu.consensus import messages as m
+        from tendermint_tpu.consensus.wal import MsgInfo
+
+        async def scenario(f, pvs):
+            cs = f.cs
+            cs.config.vote_stream_async = False
+            bid = rand_block_id(b"sync-burst")
+            vs = cs.rs.validators
+            votes = []
+            for pv in pvs[1:]:
+                idx, _ = vs.get_by_address(pv.address)
+                v = Vote(VoteType.PREVOTE, cs.rs.height, 0, bid, now_ns(),
+                         pv.address, idx)
+                votes.append(pv.sign_vote(f.genesis.chain_id, v))
+            for v in votes[1:]:
+                cs.peer_msg_queue.put_nowait(MsgInfo(m.VoteMessage(v), "p"))
+            await cs._handle_peer_batch(MsgInfo(m.VoteMessage(votes[0]), "p"))
+            assert cs._stream_dispatched == 0
+            maj, ok = cs.rs.votes.prevotes(0).two_thirds_majority()
+            assert ok and maj == bid  # applied synchronously, no drain
+
+        self._run(tmp_path, 10, scenario)
+
+    def test_equivocation_across_stream_batches_becomes_evidence(self, tmp_path):
+        from tendermint_tpu.consensus import messages as m
+        from tendermint_tpu.consensus.wal import MsgInfo
+
+        async def scenario(f, pvs):
+            cs = f.cs
+            cs.config.vote_stream_min = 2
+            vs = cs.rs.validators
+            bids = [rand_block_id(b"eq-a"), rand_block_id(b"eq-b")]
+
+            def batch(bid, signers):
+                out = []
+                for pv in signers:
+                    idx, _ = vs.get_by_address(pv.address)
+                    v = Vote(VoteType.PREVOTE, cs.rs.height, 0, bid, now_ns(),
+                             pv.address, idx)
+                    out.append(pv.sign_vote(f.genesis.chain_id, v))
+                return out
+
+            a = batch(bids[0], pvs[1:4])
+            b = batch(bids[1], pvs[1:4])  # same validators, other block
+            for v in a[1:] + b:
+                cs.peer_msg_queue.put_nowait(MsgInfo(m.VoteMessage(v), "p"))
+            await cs._handle_peer_batch(MsgInfo(m.VoteMessage(a[0]), "p"))
+            await cs._stream_drain()
+            # the equivocations surfaced as evidence, exactly as serial
+            assert cs.evidence_pool is not None
+            assert len(cs.evidence_pool.pending_evidence()) == 3
+
+        self._run(tmp_path, 6, scenario)
+
+    def test_inflight_bounded_by_config(self, tmp_path):
+        from tendermint_tpu.consensus import messages as m
+        from tendermint_tpu.consensus.wal import MsgInfo
+
+        async def scenario(f, pvs):
+            cs = f.cs
+            cs.config.vote_stream_min = 2
+            cs.config.vote_stream_inflight = 1
+            vs = cs.rs.validators
+            for seed in (b"w1", b"w2", b"w3"):
+                bid = rand_block_id(seed)
+                votes = []
+                for pv in pvs[1:3]:
+                    idx, _ = vs.get_by_address(pv.address)
+                    v = Vote(VoteType.PREVOTE, cs.rs.height, 0, bid, now_ns(),
+                             pv.address, idx)
+                    votes.append(pv.sign_vote(f.genesis.chain_id, v))
+                # equivocating windows would conflict; distinct validators
+                # per window would exceed the tiny set — reuse the same
+                # two signers voting for the SAME block across windows
+                # (duplicates dedup to no-ops; only in-flight depth matters)
+                for v in votes[1:]:
+                    cs.peer_msg_queue.put_nowait(MsgInfo(m.VoteMessage(v), "p"))
+                await cs._handle_peer_batch(MsgInfo(m.VoteMessage(votes[0]), "p"))
+                assert len(cs._stream_inflight) <= 1
+            await cs._stream_drain()
+            assert not cs._stream_inflight
+
+        self._run(tmp_path, 6, scenario)

@@ -260,7 +260,11 @@ class TestDebugRoutes:
                 consensus_state=SimpleNamespace(tracer=tmtrace.NOP)
             )
             out2 = await env2.debug_consensus_trace()
-            assert out2 == {"enabled": False, "traces": []}
+            assert out2["enabled"] is False and out2["traces"] == []
+            # the streaming-pipeline block reports even with tracing off
+            assert out2["stream"] == {
+                "inflight": 0, "dispatched": 0, "applied": 0,
+            }
 
         try:
             asyncio.run(main())

@@ -215,6 +215,9 @@ class TestGossipBurstBatching:
                     lambda n, secs: batch_sizes.append(n)
                 )
                 await cs._handle_peer_batch(MsgInfo(m.VoteMessage(burst[0]), "peer"))
+                # the streaming pipeline applies verdicts asynchronously
+                # (receive_routine's job in a live node): barrier here
+                await cs._stream_drain()
                 prevotes = cs.rs.votes.prevotes(0)
                 # all 9 landed (90 of 100 power): quorum reached in one batch
                 maj, ok = prevotes.two_thirds_majority()
@@ -281,6 +284,7 @@ class TestGossipBurstBatching:
                     MsgInfo(m.VoteMessage(votes[0]), "peer")
                 )
                 await feed
+                await cs._stream_drain()  # async pipeline: apply verdicts
                 prevotes = cs.rs.votes.prevotes(0)
                 maj, ok = prevotes.two_thirds_majority()
                 assert ok and maj == bid
