@@ -20,8 +20,8 @@ Reference anchor: the serial commit-verify loop this replaces is
 /root/reference/types/validator_set.go:591-633 (~150us per signature on
 modern x86 per BASELINE.md -> 6,667 verifies/s serial).
 
-Usage: python -m benchmarks.quick_bench [--scheduler|--stream] [--prebake]
-                                        [n_validators ...]
+Usage: python -m benchmarks.quick_bench [--scheduler|--stream|--mesh [N]]
+                                        [--prebake] [n_validators ...]
 
 `--scheduler` measures the unified device-dispatch path (ISSUE 8): each
 commit is submitted through DeviceScheduler.verify at CONSENSUS_COMMIT
@@ -40,10 +40,17 @@ records for the synchronous baseline, the streamed ingest, and the
 commit-boundary residual latency (unit ms — bench_compare treats ms/s
 units as lower-is-better) on the SAME shape.
 
+`--mesh [N]` measures the mesh-sharded dispatch path (ISSUE 11): the
+same commit shape through DeviceScheduler.verify with the device mesh
+pinned to N (TMTPU_MESH), emitting `..._mesh{N}_per_sec` records plus a
+mesh=1 single-device reference — the trajectory gate's multi-chip row
+(MESH_r06.json was banked this way on the virtual 8-CPU host mesh).
+
 The escalation also measures one secp256k1 bucket through the scheduler
 path, and `--prebake` serializes the AOT executables for the largest
-ed25519 shape + the secp bucket (ops/aot.bake, device-free) so the next
-tunnel window banks them without paying the flagship compile.
+ed25519 shape + the secp bucket (ops/aot.bake, device-free; with --mesh
+also the batch-sharded mesh executables) so the next tunnel window banks
+them without paying the flagship compile.
 """
 from __future__ import annotations
 
@@ -70,13 +77,29 @@ def bank(record: dict, path: str = BANK_PATH) -> None:
     os.replace(tmp, path)
 
 
+def _commit_shapes(sizes, tag: bytes):
+    """Per requested size, the raw commit batch: <=128 unique keypairs
+    tiled out to n lanes. main/mesh_main must measure the SAME shape or
+    their records aren't comparable — one construction, not per-mode
+    copies. Yields (n, pubs, msgs, sigs)."""
+    from tendermint_tpu.crypto import ed25519
+
+    n_unique = min(128, min(sizes))
+    privs = [ed25519.gen_priv_key() for _ in range(n_unique)]
+    pubs_u = [p.pub_key().bytes() for p in privs]
+    for n in sizes:
+        reps = -(-n // n_unique)
+        msg = b"%s bench vote n=%06d" % (tag, n)
+        sigs_u = [p.sign(msg) for p in privs]
+        yield n, (pubs_u * reps)[:n], [msg] * n, (sigs_u * reps)[:n]
+
+
 def main(sizes=(100, 1000, 10_000), scheduler: bool = False,
          secp: bool = True) -> None:
     import numpy as np  # noqa: F401 — fail fast before touching the device
 
     import jax
 
-    from tendermint_tpu.crypto import ed25519
     from tendermint_tpu.ops import ed25519_batch, kcache
 
     kcache.enable_persistent_cache()
@@ -101,16 +124,7 @@ def main(sizes=(100, 1000, 10_000), scheduler: bool = False,
     log(f"device: {dev.platform} ({dev.device_kind})"
         + (" [scheduler path]" if scheduler else ""))
 
-    n_unique = min(128, min(sizes))
-    privs = [ed25519.gen_priv_key() for _ in range(n_unique)]
-    pubs_u = [p.pub_key().bytes() for p in privs]
-
-    for n in sizes:
-        reps = -(-n // n_unique)
-        pubs = (pubs_u * reps)[:n]
-        msg = b"quick bench vote n=%06d" % n
-        sigs_u = [p.sign(msg) for p in privs]
-        sigs = (sigs_u * reps)[:n]
+    for n, pubs, msgs, sigs in _commit_shapes(sizes, b"quick"):
         bucket = ed25519_batch._pad_to_bucket(n)
 
         t0 = time.perf_counter()
@@ -123,7 +137,7 @@ def main(sizes=(100, 1000, 10_000), scheduler: bool = False,
         lat = []
         for _ in range(3):
             t0 = time.perf_counter()
-            ok = verify(pubs, [msg] * n, sigs)
+            ok = verify(pubs, msgs, sigs)
             lat.append(time.perf_counter() - t0)
             assert all(ok), "kernel rejected valid signatures"
         best = min(lat)
@@ -290,14 +304,87 @@ def stream_main(sizes=(10_000,)) -> None:
         )
 
 
-def prebake(sizes) -> None:
+def mesh_main(sizes=(1024,), mesh_n: int | None = None) -> None:
+    """Mesh-sharded dispatch measurement (ISSUE 11): each commit batch
+    goes through the full DeviceScheduler admission path at
+    CONSENSUS_COMMIT priority with the mesh plan pinned to `mesh_n`
+    devices (TMTPU_MESH), emitting `..._mesh{N}_per_sec` records — the
+    trajectory gate's multi-chip row. A mesh=1 record on the same shape
+    rides along as the single-device reference.
+
+    On a host with no accelerator, run under
+    `XLA_FLAGS=--xla_force_host_platform_device_count=N JAX_PLATFORMS=cpu`
+    (the virtual host mesh): the scheduler + shard_map path measured is
+    the real one, the absolute rate is an environment floor (the XLA:CPU
+    limb kernel exists for correctness, not speed) — the record matters
+    so bench_compare has a mesh row the moment the tunnel returns."""
+    import jax
+
+    import tendermint_tpu.ops as ops
+    from tendermint_tpu.device import Priority, get_scheduler, mesh as dmesh
+    from tendermint_tpu.libs import trace as tmtrace
+    from tendermint_tpu.ops import kcache
+
+    kcache.enable_persistent_cache()
+    kcache.suppress_background_warm()
+    dev = jax.devices()[0]
+    if mesh_n is None:
+        mesh_n = dmesh.mesh_size()
+    else:
+        # name records by what the plan RESOLVES the request to (pow2
+        # floor, visible-device clamp), not the raw request: bench_compare
+        # joins rows by metric name, and a `mesh2048`-named row from an
+        # 8-device host would never overlap the banked `mesh8` trajectory
+        # — the gate would report no-overlap and silently gate nothing
+        os.environ["TMTPU_MESH"] = str(mesh_n)
+        resolved = dmesh.mesh_size()
+        if resolved != mesh_n:
+            log(f"requested mesh {mesh_n} resolved to {resolved} shard(s)")
+        mesh_n = resolved
+    if dev.platform != "tpu":
+        # the device threshold says never-device on a CPU backend; the
+        # mesh mode measures the device path itself, so admit it
+        ops._min_batch_probed = 8
+    sched = get_scheduler()
+    for n, pubs, msgs, sigs in _commit_shapes(sizes, b"mesh"):
+        for m in dict.fromkeys((1, mesh_n)):
+            os.environ["TMTPU_MESH"] = str(m)
+            lat = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                ok = sched.verify(
+                    "ed25519", pubs, msgs, sigs,
+                    priority=Priority.CONSENSUS_COMMIT,
+                )
+                lat.append(time.perf_counter() - t0)
+                assert all(ok), "mesh dispatch rejected valid signatures"
+            best = min(lat)
+            shards = tmtrace.DEVICE.snapshot()["mesh"]["last"].get(
+                "shards", 1
+            ) if m > 1 else 1
+            _record(
+                f"ed25519_commit_verify_{n}v_mesh{m}_per_sec", n / best,
+                "verifies/s", dev.platform, str(dev.device_kind),
+                f"benchmarks.quick_bench --mesh {m} best-of-3 via "
+                f"DeviceScheduler, n={n}",
+                vs_baseline=round((n / best) / BASELINE_VERIFIES_PER_SEC, 2),
+                shards=shards,
+            )
+            log(f"n={n} mesh={m}: {best * 1e3:.1f} ms = "
+                f"{n / best:,.0f} verifies/s ({shards} shard(s))")
+    os.environ.pop("TMTPU_MESH", None)
+
+
+def prebake(sizes, mesh_sizes=()) -> None:
     """Serialize the AOT executables for the largest ed25519 shape and
     the secp bucket (ops/aot.bake — device-free, topology compile), so
-    the next tunnel window loads instead of compiling."""
+    the next tunnel window loads instead of compiling. With `mesh_sizes`,
+    the batch-sharded mesh executables bake too (AOT_r05 topology bake:
+    sizes the 2x2 topology covers)."""
     from tendermint_tpu.ops import aot, ed25519_batch
 
     bucket = ed25519_batch._pad_to_bucket(max(sizes))
-    written = aot.bake([bucket], secp=True)
+    written = aot.bake([bucket], secp=True, mesh_sizes=mesh_sizes)
     log(f"prebaked {len(written)} AOT executable(s) for bucket {bucket}: "
         f"{[os.path.basename(p) for p in written]}")
 
@@ -306,14 +393,25 @@ if __name__ == "__main__":
     args = sys.argv[1:]
     use_sched = "--scheduler" in args
     use_stream = "--stream" in args
+    use_mesh = "--mesh" in args
+    mesh_n = None
+    if use_mesh:
+        # `--mesh [N]`: the value right after the flag (when it is an
+        # integer) is the mesh size, not a commit size
+        i = args.index("--mesh")
+        if i + 1 < len(args) and args[i + 1].isdigit():
+            mesh_n = int(args.pop(i + 1))
     sizes = tuple(int(a) for a in args if not a.startswith("--"))
     if use_stream:
         stream_main(sizes or (10_000,))
+    elif use_mesh:
+        mesh_main(sizes or (1024,), mesh_n=mesh_n)
     else:
         main(sizes or (100, 1000, 10_000), scheduler=use_sched,
              secp="--no-secp" not in args)
     if "--prebake" in args:
         try:
-            prebake(sizes or (10_000,))
+            prebake(sizes or (10_000,),
+                    mesh_sizes=(2, 4) if use_mesh else ())
         except Exception as e:  # noqa: BLE001 — prebake is best-effort
             log(f"prebake skipped: {e!r}")

@@ -27,8 +27,12 @@ TRY_SYNC_INTERVAL = 0.01  # reference reactor.go trySyncTicker 10ms
 STATUS_UPDATE_INTERVAL = 10.0
 SWITCH_TO_CONSENSUS_INTERVAL = 1.0
 # verify-ahead window: pending heights whose commits are fused into one
-# device batch (per-launch dispatch cost amortizes over the window)
+# device batch (per-launch dispatch cost amortizes over the window).
+# WINDOW is the floor; the live window grows with the device flush target
+# (see _verify_ahead_window) so cross-height packs fill mesh lanes, capped
+# to bound the 10ms sync tick's peek cost and the pool's readahead memory.
 VERIFY_AHEAD_WINDOW = 16
+VERIFY_AHEAD_WINDOW_MAX = 128
 
 
 @dataclass
@@ -247,6 +251,51 @@ class BlockchainReactor(BaseReactor):
             if not await self._try_sync_one():
                 await asyncio.sleep(TRY_SYNC_INTERVAL)
 
+    def _verify_ahead_window(self) -> int:
+        """Heights per verify-ahead flush, sized so one flush carries
+        about one synchronous device flush target
+        (`crypto.batch.accumulation_hint` — the batch size at which a
+        dispatch amortizes its launch, and the mesh plan shards the
+        bucket across chips) worth of commit signatures instead of
+        whatever happened to arrive. A 64-validator chain on an 8-device
+        mesh flushes ~33 heights as ONE mesh-sharded pack; a
+        2048-validator chain already fills lanes at the old fixed window.
+        NOT stream_flush_hint: that is the routing threshold (8 on a
+        local chip), which would keep the window at the floor on exactly
+        the hosts that have lanes to fill. Hosts that will never dispatch
+        to a device (no accelerator: the serial path gains nothing from a
+        bigger window, it only adds event-loop latency and readahead
+        memory) keep the old fixed window, as does any process that has
+        not loaded ops. Cap bounds peek cost and readahead memory."""
+        import os
+        import sys
+
+        ops = sys.modules.get("tendermint_tpu.ops")
+        if ops is None:
+            return VERIFY_AHEAD_WINDOW
+        if (
+            getattr(ops, "_min_batch_probed", None) is None
+            and "TMTPU_MIN_DEVICE_BATCH" not in os.environ
+        ):
+            # the routing threshold has not been probed yet and reading
+            # it would probe NOW — a blocking jit compile + timed device
+            # round trips (or a hang on a dead tunnel) on the event
+            # loop's 10ms sync tick. The first real verify probes it
+            # from the scheduler; until then keep the fixed window.
+            return VERIFY_AHEAD_WINDOW
+        try:
+            if int(ops.effective_min_batch()) >= (1 << 30):
+                return VERIFY_AHEAD_WINDOW  # never-device host
+        except Exception:  # noqa: BLE001 — a failing probe must not break sync
+            return VERIFY_AHEAD_WINDOW
+        from tendermint_tpu.crypto.batch import accumulation_hint
+
+        per_commit = max(1, len(self.state.validators))
+        # +1: the pair (h, h+1) verifies h from h+1's LastCommit, so a
+        # window of W blocks yields W-1 fused commits
+        want = -(-accumulation_hint() // per_commit) + 1
+        return max(VERIFY_AHEAD_WINDOW, min(VERIFY_AHEAD_WINDOW_MAX, want))
+
     def _verify_ahead(self, blocks: "list[Block]", vs_hash: bytes) -> None:
         """Fuse the unverified (block, next.last_commit) pairs of the window
         into ONE device batch (hot loop #3 across heights — the reference
@@ -287,7 +336,7 @@ class BlockchainReactor(BaseReactor):
     async def _try_sync_one(self) -> bool:
         """Verify+apply the first block using the second's LastCommit
         (reference reactor.go:271-330). Returns True if a block was applied."""
-        blocks = self.pool.peek_window(VERIFY_AHEAD_WINDOW)
+        blocks = self.pool.peek_window(self._verify_ahead_window())
         if len(blocks) < 2:
             return False
         first, second = blocks[0], blocks[1]

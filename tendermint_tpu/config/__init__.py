@@ -153,6 +153,17 @@ class ConsensusConfig:
 
 
 @dataclass
+class DeviceConfig:
+    # Device-mesh dispatch (docs/device_scheduler.md "Mesh dispatch"):
+    # how many devices the DeviceScheduler's packed batches shard across.
+    # 0 = auto (all visible devices), 1 = single-device dispatch
+    # bit-for-bit as before, N >= 2 = at most N (clamped to the largest
+    # power of two that the visible devices cover). The TMTPU_MESH env
+    # var overrides this at runtime.
+    mesh: int = 0
+
+
+@dataclass
 class TxIndexConfig:
     indexer: str = "kv"  # kv | null
 
@@ -195,6 +206,7 @@ class Config:
     mempool: MempoolConfig = field(default_factory=MempoolConfig)
     fast_sync: FastSyncConfig = field(default_factory=FastSyncConfig)
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    device: DeviceConfig = field(default_factory=DeviceConfig)
     tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
     instrumentation: InstrumentationConfig = field(default_factory=InstrumentationConfig)
 
@@ -262,6 +274,7 @@ class Config:
                 mempool=MempoolConfig(**d.get("mempool", {})),
                 fast_sync=FastSyncConfig(**d.get("fast_sync", {})),
                 consensus=ConsensusConfig(**d.get("consensus", {})),
+                device=DeviceConfig(**d.get("device", {})),
                 tx_index=TxIndexConfig(**d.get("tx_index", {})),
                 instrumentation=InstrumentationConfig(**d.get("instrumentation", {})),
             )

@@ -1,0 +1,253 @@
+"""Device-mesh plan — config/env-driven multi-chip routing for dispatch.
+
+Before this module each curve module (ops/ed25519_batch.py,
+ops/secp_batch.py) carried its own copy of the multi-device routing
+decision: probe `jax.devices()`, hand-derive a power-of-two prefix, build
+a shard_map program, cache it in a module global. The two copies had
+already drifted (secp gated itself to TPU, ed25519 did not) and neither
+was controllable — mesh size was whatever the process saw. This module is
+the one owner of that decision; the curve modules and the
+DeviceScheduler's dispatch bodies consult it.
+
+Resolution order for the mesh size (per dispatch curve):
+
+1. `TMTPU_MESH` env — ``auto`` = all visible devices (explicit auto is
+   the env speaking: it overrides the config target; UNSET falls through
+   to it); ``1``/``0`` = mesh off, single-device dispatch bit-for-bit as
+   before; ``N`` = at most N devices. An unparseable value falls back to
+   auto (dispatch must degrade, never break).
+2. `configure(n)` — the node's `config.device.mesh` (0 = auto).
+3. auto.
+
+The resolved size is clamped to the largest power of two ≤ min(visible,
+requested, 128): every `_pad_to_bucket` bucket is a power of two ≥ 128 or
+a multiple of 4096, so a power-of-two mesh always divides the padded
+batch — the divisibility guarantee `parallel/sharded.py` enforces
+(`shard_inputs` raises a clear error on ragged batches instead of an XLA
+shape crash).
+
+Curve admission mirrors what the curve modules measured: ed25519 meshes
+on any multi-device platform (the XLA kernel shards fine on the virtual
+CPU mesh); secp256k1 meshes only on TPU — on a CPU host the serial
+OpenSSL path beats a jitted limb kernel (see ops/secp_batch._device_fn)
+— unless `TMTPU_SECP_MESH=1` forces it on for the virtual-mesh tests.
+
+`build_plan` builds the pjit'd verifier (matched in/out shardings +
+donated sig buffers — SNIPPETS [2] pattern) through
+`parallel/sharded.py`'s builders but deliberately does NOT cache: the
+per-curve plan cache lives in the curve modules (`_sharded`), preserving
+the monkeypatch seams the routing tests pin (`build_stream_verifier`
+spies, `_sharded = None` resets).
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+# Mesh sizes are clamped here: meshes above 128 devices would need
+# buckets above the 128-lane minimum to keep every shard non-empty, and
+# no current slice is larger (the v4-8 target is 8 chips).
+MAX_MESH = 128
+
+_lock = threading.Lock()
+_configured: int | None = None  # node-config target; None/0 = auto
+_visible_memo: int | None = None
+
+
+def configure(n: int | None) -> None:
+    """Set the config-driven mesh target (`config.device.mesh`): 0/None =
+    auto, 1 = mesh off, N = at most N devices. `TMTPU_MESH` wins over
+    this. Import-light — never touches jax."""
+    global _configured
+    _configured = int(n) if n else None
+
+
+def reset() -> None:
+    """Forget PROBED state — the memoized device count, loaded mesh
+    executables, and the curve modules' built plans and device-resident
+    key blocks (tests that fake visibility; a process whose device
+    layout changed must not keep serving programs or buffers bound to
+    the old one). The curve plans must go too: they are keyed only by
+    mesh SIZE, so a layout rebuilt at the same size would otherwise keep
+    dispatching over dead device objects and silently degrade every
+    batch to single-device. The config target (`configure`) is the
+    node's boot configuration, not a probe: it survives; pass
+    configure(None) to clear it."""
+    import sys
+
+    global _visible_memo, _aot_gen
+    with _lock:
+        _visible_memo = None
+        _aot_gen += 1  # a load in flight must not repopulate post-reset
+        _aot_mesh_fns.clear()
+    # each curve module owns its caches and exposes one invalidation
+    # hook; via sys.modules on purpose — reset must stay import-light,
+    # and a curve module that was never imported has nothing cached
+    for name in (
+        "tendermint_tpu.ops.ed25519_batch",
+        "tendermint_tpu.ops.secp_batch",
+    ):
+        m = sys.modules.get(name)
+        hook = getattr(m, "invalidate_mesh_plan", None)
+        if hook is not None:
+            hook()
+
+
+def _visible_devices() -> int:
+    """Visible jax device count; 0 when jax is unavailable (a crypto-free
+    or accelerator-free process must resolve to mesh-off, not crash)."""
+    global _visible_memo
+    if _visible_memo is not None:
+        return _visible_memo
+    try:
+        import jax
+
+        n = len(jax.devices())
+    except Exception:  # noqa: BLE001 — no jax / no backend: mesh off
+        n = 0
+    with _lock:
+        _visible_memo = n
+    return n
+
+
+def _pow2_floor(n: int) -> int:
+    return 1 << (n.bit_length() - 1) if n > 0 else 0
+
+
+def target_size(visible: int, spec: str | None, configured: int | None) -> int:
+    """Pure resolution of the mesh size (unit-testable without jax):
+    `spec` is the TMTPU_MESH string (None = unset), `configured` the
+    config target (None = auto). Returns 1 when the mesh is off."""
+    want = None  # None = auto
+    env_auto = False  # explicit TMTPU_MESH=auto overrides the config target
+    if spec is not None:
+        s = spec.strip().lower()
+        if s == "auto":
+            env_auto = True
+        elif s:
+            try:
+                want = int(s)
+            except ValueError:
+                env_auto = True  # unparseable: degrade to auto, never break
+            else:
+                if want <= 1:
+                    return 1
+    if want is None and not env_auto:
+        if configured is not None:
+            if configured == 1:
+                return 1
+            want = configured if configured > 1 else None
+    if visible < 2:
+        return 1
+    n = min(visible, MAX_MESH, want if want is not None else visible)
+    return max(1, _pow2_floor(n))
+
+
+def _curve_admitted(curve: str) -> bool:
+    if curve != "secp256k1":
+        return True
+    if os.environ.get("TMTPU_SECP_MESH"):
+        return True
+    try:
+        import jax
+
+        return jax.default_backend() == "tpu"
+    except Exception:  # noqa: BLE001 — no backend: not admitted
+        return False
+
+
+def mesh_size(curve: str = "ed25519") -> int:
+    """The mesh size dispatch for `curve` will use right now (1 = the
+    single-device path)."""
+    n = target_size(
+        _visible_devices(), os.environ.get("TMTPU_MESH"), _configured
+    )
+    if n < 2:
+        return 1
+    return n if _curve_admitted(curve) else 1
+
+
+def build_plan(curve: str, n: int):
+    """Build the mesh program for `curve` over the first `n` visible
+    devices: (pjit'd verifier, NamedSharding for the packed wire blocks),
+    or None when the mesh cannot be built (the caller degrades to the
+    single-device path). No caching here — see the module docstring."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tendermint_tpu.ops import kcache
+    from tendermint_tpu.parallel import sharded as shard_mod
+
+    devices = jax.devices()
+    if n < 2 or len(devices) < n:
+        return None
+    # sharded programs have no export-blob layer; the persistent XLA
+    # cache is what saves the next process the cold compile
+    kcache.enable_persistent_cache()
+    mesh = shard_mod.make_batch_mesh(devices[:n])
+    # module-attribute call on purpose: the routing tests spy on the
+    # builders to pin that dispatch really goes through the mesh
+    if curve == "secp256k1":
+        fn = shard_mod.build_secp_stream_verifier(mesh)
+    else:
+        fn = shard_mod.build_stream_verifier(mesh)
+        if mesh.devices.flat[0].platform == "tpu":
+            # pre-baked per-bucket mesh executables (ops/aot.py
+            # bake(..., mesh_sizes=...)): an upload instead of a
+            # cold-window compile. Resolved per call because executables
+            # are bucket-specific; any load failure (version or topology
+            # skew) keeps the jit program built above for that bucket.
+            jit_fn = fn
+
+            def fn(keys, sigs, _jit=jit_fn, _n=n):
+                afn = _aot_mesh_fn(int(sigs.shape[1]), _n)
+                return afn(keys, sigs) if afn is not None else _jit(keys, sigs)
+
+    return fn, NamedSharding(mesh, P(None, shard_mod.AXIS))
+
+
+_AOT_UNTRIED = object()
+_aot_mesh_fns: dict[tuple[int, int], object] = {}  # (bucket, mesh) -> fn|None
+_aot_gen = 0  # bumped by reset(): invalidates loads already in flight
+
+
+def _aot_mesh_fn(bucket: int, n: int):
+    with _lock:
+        gen = _aot_gen
+        fn = _aot_mesh_fns.get((bucket, n), _AOT_UNTRIED)
+    if fn is _AOT_UNTRIED:
+        try:
+            from tendermint_tpu.ops import aot
+
+            fn = aot.load_mesh_verify_fn(bucket, n)
+        except Exception:  # noqa: BLE001 — AOT layer is best-effort
+            fn = None
+        with _lock:
+            # a reset() during the load means the executable was built
+            # for a device layout that no longer exists: don't cache it
+            if gen == _aot_gen:
+                _aot_mesh_fns[(bucket, n)] = fn
+    return fn
+
+
+def state() -> dict:
+    """Cheap introspection for debug_device: the configured/env target and
+    the resolved size per curve. Never forces a jax backend probe — a
+    CPU-only node serving a debug call must not pay device init; sizes
+    show as null until dispatch has probed."""
+    visible = _visible_memo
+    out: dict = {
+        "env": os.environ.get("TMTPU_MESH"),
+        "configured": _configured,
+        "visible_devices": visible,
+    }
+    if visible is None:
+        out["size"] = None
+    else:
+        out["size"] = target_size(
+            visible, os.environ.get("TMTPU_MESH"), _configured
+        )
+        out["curves"] = {
+            c: mesh_size(c) for c in ("ed25519", "secp256k1")
+        }
+    return out

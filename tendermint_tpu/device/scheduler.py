@@ -21,6 +21,15 @@ catch-up, lite header verification, mempool recheck — submits here:
   buckets and AOT cache apply unchanged below) and scatters the verdict
   slices back per request. A lone fast-sync chunk and a lite header
   burst that arrive together cost one launch, not two.
+- Packed dispatches are MESH-SHARDED: when the resolved device mesh
+  (device/mesh.py — `TMTPU_MESH`/config-driven; auto = all visible
+  devices, 1 = single-device bit-for-bit) has two or more devices, the
+  curve dispatch body splits the padded bucket across the mesh with
+  batch-sharded NamedSharding placement and gathers the ok-bitmap once
+  through the fetch pool (parallel/sharded.py stream verifiers, donated
+  sig buffers on TPU). Verdict scatter, breaker semantics and the
+  monkeypatch seams (`in_dispatch`/`_verify_batch_local`) are identical
+  on every mesh size.
 - The scheduler owns the wedged-device `_CircuitBreaker` (one instance
   per scheduler — no longer a module global secp borrows from ed25519)
   and the daemon verdict-fetch pool. Per-curve CPU/native fallbacks are
@@ -492,6 +501,20 @@ class DeviceScheduler(BaseService):
 
     def _dispatch_group_inner(self, group: list[_Request]) -> None:
         _trace.DEVICE.record_sched_pack(len(group))
+        try:
+            # refresh the resolved mesh PLAN size for this packed dispatch
+            # (device/mesh.py: TMTPU_MESH / config / visible devices) so
+            # debug_device and tendermint_device_mesh_size stay live as
+            # the plan changes; mesh_size never raises and memoizes its
+            # device probe, so this costs an env read per dispatch.
+            # Curve-independent on purpose: per-curve admission (secp is
+            # TPU-only) shows in mesh_dispatches_total{curve} — a secp
+            # dispatch on a non-TPU host must not flap the gauge to 1
+            from tendermint_tpu.device import mesh as _dmesh
+
+            _trace.DEVICE.record_mesh_size(_dmesh.mesh_size())
+        except Exception:  # noqa: BLE001 — telemetry must not break dispatch
+            pass
         pubs: list = []
         msgs: list = []
         sigs: list = []
@@ -521,8 +544,10 @@ class DeviceScheduler(BaseService):
     def _dispatch_curve(self, curve, pubs, msgs, sigs) -> list[bool]:
         """One packed dispatch through the curve's verify_batch. The
         wrapper sees in_dispatch() and runs the real device body (breaker
-        consult, kcache bucket, AOT cache, CPU degrade) — and tests keep
-        their seam: a monkeypatched verify_batch intercepts here."""
+        consult, kcache bucket, AOT cache, mesh-sharded launch when the
+        device/mesh.py plan resolves >= 2 devices, CPU degrade) — and
+        tests keep their seam: a monkeypatched verify_batch intercepts
+        here."""
         import importlib
 
         mod = importlib.import_module(_CURVES[curve][1])

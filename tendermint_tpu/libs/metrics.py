@@ -433,6 +433,24 @@ class DeviceMetrics:
             "Queued requests passed over by a later-arriving "
             "higher-priority dispatch, per class",
         )
+        # mesh-sharded dispatch (ISSUE 11): is packed work actually
+        # spreading across the device mesh, and how evenly. Fed by
+        # DEVICE.record_mesh_size / record_mesh_dispatch from the curve
+        # dispatch bodies (mesh routing: device/mesh.py).
+        self.mesh_size = c.gauge(
+            "device", "mesh_size",
+            "Devices in the resolved dispatch mesh (1 = single-device)",
+        )
+        self.mesh_dispatches_total = c.counter(
+            "device", "mesh_dispatches_total",
+            "Packed batches dispatched across the device mesh",
+        )
+        self.mesh_shard_occupancy = c.histogram(
+            "device", "mesh_shard_occupancy",
+            "Valid lanes / shard lanes, observed once per mesh shard "
+            "(padding concentrates in the tail shards)",
+            [0.1, 0.25, 0.5, 0.75, 0.9, 1.0],
+        )
         # verified-signature cache (libs/sigcache, ISSUE 10): the
         # streamed vote path records every verified signature; commit-
         # boundary verifies sweep the cache and dispatch only the

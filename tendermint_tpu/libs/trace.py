@@ -392,6 +392,15 @@ class DeviceTelemetry:
         self.sched_packed_batches = 0
         self.sched_packed_requests = 0
         self.sched_max_packed = 0
+        # mesh-sharded dispatch accounting (ISSUE 11): the resolved mesh
+        # size (1 = single-device), how many packed batches actually went
+        # out sharded, and the last mesh dispatch's shape — fed by the
+        # curve dispatch bodies via record_mesh_dispatch and by the
+        # scheduler's dispatcher via record_mesh_size
+        self.mesh_size = 1
+        self.mesh_dispatches = 0
+        self.mesh_lanes = 0
+        self.mesh_last: dict = {}
         # commit-boundary verify accounting (ISSUE 10): how much of each
         # commit verify the verified-signature cache (libs/sigcache)
         # already covered vs the residual actually dispatched — the
@@ -556,6 +565,46 @@ class DeviceTelemetry:
         with self._lock:
             self._sched_cls_locked(label)["rejected"] += n
 
+    def record_mesh_size(self, n: int) -> None:
+        """The resolved mesh PLAN size (device/mesh.py, curve-independent
+        — per-curve admission shows in the dispatch counters): 1 =
+        single-device path. Refreshed per dispatch so TMTPU_MESH / config
+        changes and device loss show up live; the only writer of the
+        mesh_size gauge, so it cannot flap with per-dispatch shard
+        counts."""
+        n = max(1, int(n))
+        with self._lock:
+            self.mesh_size = n
+        dm = self._metrics
+        if dm is not None:
+            dm.mesh_size.set(n)
+
+    def record_mesh_dispatch(
+        self, n: int, bucket: int, shards: int, curve: str = "ed25519"
+    ) -> None:
+        """One packed batch dispatched ACROSS the mesh: `n` valid lanes in
+        a `bucket`-lane padded batch split over `shards` devices. Padding
+        sits in the tail lanes, so per-shard occupancy is computed per
+        shard (tail shards may be all padding)."""
+        per = max(1, bucket // max(1, shards))
+        with self._lock:
+            self.mesh_dispatches += 1
+            self.mesh_lanes += n
+            self.mesh_last = {
+                "curve": curve, "size": n, "bucket": bucket,
+                "shards": shards, "lanes_per_shard": per,
+            }
+        _recorder.RECORDER.record(
+            "device", "mesh_dispatch", curve=curve, n=n, bucket=bucket,
+            shards=shards,
+        )
+        dm = self._metrics
+        if dm is not None:
+            dm.mesh_dispatches_total.inc(curve=curve)
+            for i in range(max(1, shards)):
+                valid = min(max(n - i * per, 0), per)
+                dm.mesh_shard_occupancy.observe(valid / per)
+
     def record_commit_residual(self, total: int, residual: int) -> None:
         """One commit-boundary verify: `total` signatures structurally
         checked, `residual` of them actually dispatched (the rest swept
@@ -636,6 +685,12 @@ class DeviceTelemetry:
                     )
                     if self.commit_sigs_total
                     else 0.0,
+                },
+                "mesh": {
+                    "size": self.mesh_size,
+                    "dispatches": self.mesh_dispatches,
+                    "lanes": self.mesh_lanes,
+                    "last": dict(self.mesh_last),
                 },
                 "scheduler": {
                     "classes": {

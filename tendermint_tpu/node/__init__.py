@@ -112,6 +112,14 @@ class Node(BaseService):
             RECORDER.set_dump_path(self._recorder_dump_path)
         self._crash_baseline = RECORDER.crashes
 
+        # device-mesh target (device/mesh.py): config.device.mesh — 0 =
+        # auto (all visible devices), 1 = single-device, N = clamp;
+        # TMTPU_MESH env wins. configure() is import-light (never touches
+        # jax), so a CPU-only node pays nothing here.
+        from tendermint_tpu.device import mesh as _dmesh
+
+        _dmesh.configure(cfg.device.mesh)
+
         # crypto backends: TPU kernel first (ops registers ed25519 on
         # import), then the native C++ core (secp256k1 always; ed25519 only
         # if the TPU path is absent) — the reference's cgo/nocgo gate.

@@ -121,10 +121,41 @@ def _kernel_plain(kname: str):
     return ed25519_batch.verify_kernel.__wrapped__
 
 
-def bake(buckets, kernels=("pallas", "xla"), secp: bool = True) -> list[str]:
+def _mesh_path(kname: str, bucket: int, mesh_n: int) -> str:
+    from tendermint_tpu.ops import kcache
+
+    return os.path.join(
+        _aot_dir(),
+        f"ed25519_verify_mesh{mesh_n}_{kname}_{bucket}"
+        f"_{kcache._source_version()}_{_versions()}.aotexec",
+    )
+
+
+def topology_mesh(mesh_n: int):
+    """A `mesh_n`-device batch mesh over the local compile-only topology
+    (None when the topology has fewer devices): the target the mesh-
+    sharded executables are baked for. The scheduler's dispatch plan
+    (device/mesh.py) shards packed buckets over exactly this axis."""
+    from jax.experimental import topologies
+
+    from tendermint_tpu.parallel import sharded as shard_mod
+
+    topo = topologies.get_topology_desc(TOPOLOGY, "tpu")
+    if len(topo.devices) < mesh_n:
+        return None
+    return shard_mod.make_batch_mesh(topo.devices[:mesh_n])
+
+
+def bake(
+    buckets, kernels=("pallas", "xla"), secp: bool = True, mesh_sizes=()
+) -> list[str]:
     """Compile + serialize each (kernel, bucket) against the local v5e
-    topology. Returns the list of paths written. Requires NO device: run
-    under JAX_PLATFORMS=cpu so jax never dials the tunnel."""
+    topology — single-device executables, plus batch-sharded mesh
+    executables for each size in `mesh_sizes` (AOT_r05 topology bake:
+    the 2x2 topology offers 4 devices, so mesh sizes 2 and 4 bake here;
+    larger slices bake on a host whose libtpu accepts their topology).
+    Returns the list of paths written. Requires NO device: run under
+    JAX_PLATFORMS=cpu so jax never dials the tunnel."""
     import jax
     from jax.experimental import serialize_executable, topologies
     from jax.sharding import SingleDeviceSharding
@@ -151,7 +182,74 @@ def bake(buckets, kernels=("pallas", "xla"), secp: bool = True) -> list[str]:
                 written.append(_path(kname, b))
         if secp:
             _bake_secp(b, sharding)
+        for mesh_n in sorted({int(m) for m in mesh_sizes if int(m) >= 2}):
+            p = _bake_mesh(b, mesh_n)
+            if p is not None:
+                written.append(p)
     return written
+
+
+def _bake_mesh(bucket: int, mesh_n: int) -> str | None:
+    """Bake the batch-sharded verify executable for one (bucket, mesh)
+    pair: the preferred TPU kernel jitted with the same matched
+    NamedSharding in/out specs + donated sig block the live mesh plan
+    uses (parallel/sharded.py), compiled against the topology mesh. The
+    bucket must divide over the mesh — guaranteed for the power-of-two
+    sizes device/mesh.py resolves."""
+    from tendermint_tpu.ops import kcache
+    from tendermint_tpu.parallel import sharded as shard_mod
+
+    if bucket % mesh_n:
+        print(
+            f"bake SKIPPED mesh{mesh_n} bucket {bucket}: not divisible",
+            file=sys.stderr,
+        )
+        return None
+    mesh = topology_mesh(mesh_n)
+    if mesh is None:
+        print(
+            f"bake SKIPPED mesh{mesh_n}: topology {TOPOLOGY} has too few "
+            f"devices",
+            file=sys.stderr,
+        )
+        return None
+    kname, _ = kcache._kernel_for("tpu")
+    path = _mesh_path(kname, bucket, mesh_n)
+    ks, ss = kcache._input_shapes(bucket)
+
+    def jitted():
+        # bake EXACTLY the program the live mesh plan runs: the shard_map-
+        # wrapped stream verifier (a Mosaic kernel cannot be GSPMD-
+        # partitioned by a bare pjit — it must stay inside the shard_map)
+        return shard_mod.build_stream_verifier(mesh, donate=True).jitted
+
+    ok = _bake_one_jitted(
+        path, jitted, (ks, ss), f"mesh{mesh_n} {kname} bucket {bucket}"
+    )
+    return path if ok else None
+
+
+def _bake_one_jitted(path: str, make_jitted, arg_shapes, label: str) -> bool:
+    """Like `_bake_one` but for a caller-jitted program (mesh bakes carry
+    their own shardings; re-wrapping them in a SingleDeviceSharding jit
+    would defeat the point)."""
+    from jax.experimental import serialize_executable
+
+    if os.path.exists(path):
+        return False
+    try:
+        compiled = make_jitted().lower(*arg_shapes).compile()
+        payload, in_tree, out_tree = serialize_executable.serialize(compiled)
+        _write(path, payload, in_tree, out_tree)
+        print(
+            f"baked {label}: {os.path.getsize(path):,} bytes",
+            file=sys.stderr,
+            flush=True,
+        )
+        return True
+    except Exception as e:  # noqa: BLE001 — bake the rest anyway
+        print(f"bake FAILED {label}: {e!r}", file=sys.stderr, flush=True)
+        return False
 
 
 def _bake_one(path: str, plain_fn, arg_shapes, sharding, label: str) -> bool:
@@ -358,6 +456,29 @@ def load_secp_fn(bucket: int):
     return lambda sigs, keys: compiled(sigs, keys)
 
 
+def load_mesh_verify_fn(bucket: int, mesh_n: int):
+    """Pre-baked batch-sharded ed25519 verify executable for one
+    (bucket, mesh size) on the live client, or None. The live mesh must
+    match the baked device count; a mismatch (or any deserialize failure)
+    is a cache miss and the caller keeps its jit program."""
+    import jax
+
+    from tendermint_tpu.ops import kcache
+
+    if len(jax.devices()) < mesh_n:
+        return None
+    kname, _ = kcache._kernel_for("tpu")
+    compiled = _load(_mesh_path(kname, bucket, mesh_n))
+    if compiled is None:
+        return None
+    print(
+        f"aot: loaded pre-baked mesh{mesh_n} {kname} executable, "
+        f"bucket {bucket}",
+        file=sys.stderr,
+    )
+    return lambda keys, sigs: compiled(keys, sigs)
+
+
 if __name__ == "__main__":
     # bake must never dial the tunnel: force CPU before jax initializes.
     # The env var alone is NOT enough — the axon plugin registers itself
@@ -368,6 +489,13 @@ if __name__ == "__main__":
     import jax as _jax
 
     _jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-    wanted = [int(a) for a in sys.argv[1:]] or [128, 1024, 2048, 12288, 131072]
-    paths = bake(wanted)
+    args = sys.argv[1:]
+    mesh_sizes: list[int] = []
+    if "--mesh" in args:
+        # bake batch-sharded executables too: every power-of-two mesh the
+        # topology covers (2 and 4 on the default 2x2)
+        args.remove("--mesh")
+        mesh_sizes = [2, 4]
+    wanted = [int(a) for a in args] or [128, 1024, 2048, 12288, 131072]
+    paths = bake(wanted, mesh_sizes=mesh_sizes)
     print(f"baked {len(paths)} new executables under {_aot_dir()}")

@@ -505,11 +505,18 @@ class _DeviceKeyCache:
     every chunk of a fast-sync stream over an unchanged valset) reuse the
     same (24, B) key block; keeping it on device halves the per-commit
     host->device traffic — and on a tunneled device skips one transfer RPC
-    entirely. Keyed by (pubkey bytes, bucket); bounded LRU (8 x ~12 MB at
-    the max bucket)."""
+    entirely. Keyed by (pubkey bytes, bucket, placement) — placement must
+    be part of the key because a mesh resize (TMTPU_MESH flip, config
+    change) changes the sharding a block was committed to, and feeding a
+    stale-placed block to the new mesh's executable is at best a silent
+    per-dispatch reshard and at worst a shape/sharding error that degrades
+    the dispatch to single-device every commit. NamedShardings hash by
+    value, so a plan rebuild at the same mesh size still hits. Bounded
+    LRU (8 x ~12 MB at the max bucket)."""
 
     def __init__(self, maxsize: int = 8) -> None:
-        self._d: dict[tuple[bytes, int], object] = {}
+        # (pubkey digest, bucket, sharding | None) -> device-resident block
+        self._d: dict[tuple[bytes, int, object], object] = {}
         self._maxsize = maxsize
 
     def get(self, chunk_pubs, keys_np, sharding=None, cacheable=True):
@@ -526,7 +533,7 @@ class _DeviceKeyCache:
         h = _hl.sha256()
         for p in chunk_pubs:
             h.update(bytes(p))
-        key = (h.digest(), keys_np.shape[1])
+        key = (h.digest(), keys_np.shape[1], sharding)
         dev = self._d.pop(key, None)
         if dev is None:
             # device_put treats sharding=None as default placement
@@ -554,42 +561,41 @@ fetch_verdicts = _dsched.fetch_verdicts
 _FETCH_TIMEOUT_S = _dsched._FETCH_TIMEOUT_S
 _BREAKER_RETRY_S = _dsched._BREAKER_RETRY_S
 
-# Multi-device dispatch: when more than one device is visible (a real TPU
-# slice, or the test suite's 8-virtual-CPU mesh) every chunk is
-# batch-sharded across the mesh via shard_map instead of running on one
-# chip (jit respecializes the one memoized callable per bucket shape).
-# The single-device path keeps kcache's export-blob fast start (exports
-# don't carry shardings).
-_sharded = None  # (fn, NamedSharding) | None, built once
+# Multi-device dispatch: mesh routing is owned by device/mesh.py — the
+# config/env-driven mesh plan (`TMTPU_MESH`: auto = all visible devices,
+# 1 = today's single-device path bit-for-bit, N = clamp; power-of-two
+# sizes only, so every _pad_to_bucket bucket divides over the mesh).
+# When the resolved mesh has >= 2 devices every chunk is batch-sharded
+# across it via shard_map (jit respecializes the one memoized callable
+# per bucket shape). The single-device path keeps kcache's export-blob
+# fast start (exports don't carry shardings).
+_sharded = None  # (fn, NamedSharding, mesh size) | None, rebuilt on change
 
 
 def _multi_device_fn():
-    import jax
+    from tendermint_tpu.device import mesh as dmesh
 
-    devices = jax.devices()
-    if len(devices) < 2:
+    n = dmesh.mesh_size("ed25519")
+    if n < 2:
         return None, None
     global _sharded
-    if _sharded is None:
-        from jax.sharding import NamedSharding, PartitionSpec as P
+    if _sharded is None or _sharded[2] != n:
+        built = dmesh.build_plan("ed25519", n)
+        if built is None:
+            return None, None
+        _sharded = (built[0], built[1], n)
+    return _sharded[0], _sharded[1]
 
-        from tendermint_tpu.ops import kcache
-        from tendermint_tpu.parallel import sharded as shard_mod
 
-        # the sharded program has no export-blob layer; the persistent XLA
-        # cache is what saves the next process the cold compile
-        kcache.enable_persistent_cache()
-        # largest power-of-two device prefix (capped at the minimum bucket,
-        # 128): every bucket is a power of two or a multiple of 4096, so a
-        # power-of-two mesh always divides the batch — a 6-device host
-        # meshes 4, not a shard_map shape error
-        p = 1 << (len(devices).bit_length() - 1)
-        mesh = shard_mod.make_batch_mesh(devices[: min(p, 128)])
-        _sharded = (
-            shard_mod.build_stream_verifier(mesh),
-            NamedSharding(mesh, P(None, shard_mod.AXIS)),
-        )
-    return _sharded
+def invalidate_mesh_plan() -> None:
+    """Drop every cache bound to the current device layout — the built
+    mesh plan and the device-resident key blocks. Called by
+    device/mesh.reset() when the layout changes: the plan is keyed only
+    by mesh SIZE, so a same-size rebuild would otherwise keep
+    dispatching over dead device objects."""
+    global _sharded
+    _sharded = None
+    _dev_keys._d.clear()
 
 
 def verify_batch(pubs, msgs, sigs) -> list[bool]:
@@ -674,18 +680,27 @@ def _verify_batch_device(pubs, msgs, sigs, n, kcache, sp) -> list[bool]:
                 # failure is not a kernel failure: degrade to the
                 # single-device path
                 dev_out = None
+            if from_sharded:
+                # outside the dispatch try: a throwing telemetry sink
+                # must not discard the completed mesh result or mislabel
+                # the fallback as sharded
+                try:
+                    _trace.DEVICE.record_mesh_dispatch(
+                        int(mask.sum()), packed.shape[1],
+                        int(sharding.mesh.size),
+                    )
+                except Exception:  # noqa: BLE001 — telemetry only
+                    pass
         if dev_out is None:
             try:
                 import jax
 
                 fn = kcache.get_verify_fn(packed.shape[1])
-                # after a failed sharded attempt the cache holds a
-                # mesh-placed key block: re-place plainly, don't reuse it
-                keys_arg = (
-                    jax.device_put(keys_np) if mfn is not None
-                    else _dev_keys.get(
-                        pubs[lo:hi], keys_np, cacheable=bool(mask.all())
-                    )
+                # placement is part of the key-cache key, so this lookup
+                # serves the default-placed block — never the mesh-placed
+                # one a failed sharded attempt above may have cached
+                keys_arg = _dev_keys.get(
+                    pubs[lo:hi], keys_np, cacheable=bool(mask.all())
                 )
                 # commit the sig block explicitly: a committed/uncommitted
                 # argument mix is a different jit cache key than the

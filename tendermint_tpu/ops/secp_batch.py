@@ -187,40 +187,37 @@ def _device_fn():
 _AOT_UNTRIED = object()
 _aot_fns: dict[int, object] = {}
 
-# Multi-device dispatch (SURVEY §7: both curves shard across chips). Same
-# shape as ed25519_batch._multi_device_fn: a batch-sharded shard_map over
-# the largest power-of-two device prefix. Gated to TPU by default — on a
-# CPU host the serial OpenSSL path beats a jitted limb kernel (see
-# _device_fn) — with TMTPU_SECP_MESH=1 forcing it on for the virtual-mesh
-# routing tests and dryruns.
-_sharded = None  # (fn, NamedSharding) | None, built once
+# Multi-device dispatch (SURVEY §7: both curves shard across chips).
+# Mesh routing is owned by device/mesh.py (config/env-driven TMTPU_MESH
+# plan, shared with ed25519): it keeps this curve's gate — TPU only by
+# default, because on a CPU host the serial OpenSSL path beats a jitted
+# limb kernel (see _device_fn) — with TMTPU_SECP_MESH=1 forcing it on
+# for the virtual-mesh routing tests and dryruns.
+_sharded = None  # (fn, NamedSharding, mesh size) | None, rebuilt on change
 
 
 def _multi_device_fn():
-    import jax
+    from tendermint_tpu.device import mesh as dmesh
 
-    if jax.default_backend() != "tpu" and not os.environ.get(
-        "TMTPU_SECP_MESH"
-    ):
-        return None, None
-    devices = jax.devices()
-    if len(devices) < 2:
+    n = dmesh.mesh_size("secp256k1")
+    if n < 2:
         return None, None
     global _sharded
-    if _sharded is None:
-        from jax.sharding import NamedSharding, PartitionSpec as P
+    if _sharded is None or _sharded[2] != n:
+        built = dmesh.build_plan("secp256k1", n)
+        if built is None:
+            return None, None
+        _sharded = (built[0], built[1], n)
+    return _sharded[0], _sharded[1]
 
-        from tendermint_tpu.ops import kcache
-        from tendermint_tpu.parallel import sharded as shard_mod
 
-        kcache.enable_persistent_cache()
-        p = 1 << (len(devices).bit_length() - 1)
-        mesh = shard_mod.make_batch_mesh(devices[: min(p, 128)])
-        _sharded = (
-            shard_mod.build_secp_stream_verifier(mesh),
-            NamedSharding(mesh, P(None, shard_mod.AXIS)),
-        )
-    return _sharded
+def invalidate_mesh_plan() -> None:
+    """Drop every cache bound to the current device layout (see
+    ed25519_batch.invalidate_mesh_plan — called by device/mesh.reset()
+    on a layout change)."""
+    global _sharded
+    _sharded = None
+    _dev_keys._d.clear()
 
 
 def host_verify_blocks(sigs_blk, keys_blk) -> np.ndarray:
@@ -338,15 +335,23 @@ def _verify_batch_device(pubs, msgs, sigs, n, fn, mfn, sharding, kcache, sp) -> 
                 # failure is not a kernel failure: degrade to the
                 # single-device path (or serial below)
                 dev_out = None
+            if dev_out is not None:
+                # outside the dispatch try: a throwing telemetry sink
+                # must not discard the completed mesh result
+                try:
+                    _trace.DEVICE.record_mesh_dispatch(
+                        int(mask.sum()), packed.shape[1],
+                        int(sharding.mesh.size), curve="secp256k1",
+                    )
+                except Exception:  # noqa: BLE001 — telemetry only
+                    pass
         if dev_out is None and fn is not None:
             try:
-                # after a failed sharded attempt the cache may hold a
-                # mesh-placed key block: re-place plainly, don't reuse it
-                keys_dev = (
-                    jax.device_put(keys_np) if mfn is not None
-                    else _dev_keys.get(
-                        pubs[lo:hi], keys_np, cacheable=bool(mask.all())
-                    )
+                # placement is part of the key-cache key, so this lookup
+                # serves the default-placed block — never the mesh-placed
+                # one a failed sharded attempt above may have cached
+                keys_dev = _dev_keys.get(
+                    pubs[lo:hi], keys_np, cacheable=bool(mask.all())
                 )
                 # commit both args: a committed/uncommitted mix is a
                 # separate jit cache key and re-traces the kernel (see

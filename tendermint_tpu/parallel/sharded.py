@@ -27,11 +27,48 @@ AXIS = "batch"
 _PACKED_SPEC = P(None, AXIS)
 
 
+def _shard_map(fn, mesh: Mesh, in_specs, out_specs):
+    """shard_map across jax versions: `jax.shard_map(..., check_vma=)` on
+    current jax, `jax.experimental.shard_map.shard_map(..., check_rep=)`
+    on 0.4.x (the container's pinned jax). The relaxed check is the same
+    either way: the Straus fori_loop carry starts from broadcast module
+    constants (identity point), which trips the varying-axes/replication
+    check even though every lane's compute is genuinely per-shard."""
+    try:
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    except (AttributeError, TypeError):
+        from jax.experimental.shard_map import shard_map as _legacy
+
+        return _legacy(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
+
+
 def make_batch_mesh(devices=None) -> Mesh:
     """A 1-D mesh over the batch axis (all chips verify-data-parallel)."""
     if devices is None:
         devices = jax.devices()
     return Mesh(np.asarray(devices), (AXIS,))
+
+
+def check_divisible(batch: int, mesh: Mesh) -> None:
+    """Raise a clear ValueError — not an XLA shape crash deep inside
+    shard_map — when a batch does not split evenly over the mesh.
+    `_pad_to_bucket` buckets (powers of two ≥ 128 and multiples of 4096)
+    are always divisible by the power-of-two meshes `device/mesh.py`
+    resolves; a ragged batch here means a caller bypassed the padding."""
+    n = int(mesh.size)
+    if n and batch % n:
+        raise ValueError(
+            f"batch of {batch} lanes does not divide over a {n}-device "
+            f"mesh — pad to a mesh-divisible bucket first "
+            f"(ops/ed25519_batch._pad_to_bucket guarantees this for the "
+            f"power-of-two meshes device/mesh.py builds)"
+        )
 
 
 def shard_inputs(mesh: Mesh, packed):
@@ -40,7 +77,17 @@ def shard_inputs(mesh: Mesh, packed):
     The batch dim must be divisible by the mesh size; `prepare_batch` pads to
     power-of-two buckets, so any power-of-two mesh divides it.
     """
+    check_divisible(int(packed.shape[1]), mesh)
     return jax.device_put(packed, NamedSharding(mesh, _PACKED_SPEC))
+
+
+def _donate_default(mesh: Mesh) -> bool:
+    """Whether the per-batch (signature) wire block should be donated to
+    the compiled program: on TPU donation lets XLA reuse the input HBM for
+    scratch so streamed buckets stay device-resident with no extra copy;
+    XLA:CPU does not implement buffer donation and would warn per program,
+    so the virtual test mesh leaves it off."""
+    return mesh.devices.flat[0].platform == "tpu"
 
 
 def build_sharded_verifier(mesh: Mesh):
@@ -52,14 +99,21 @@ def build_sharded_verifier(mesh: Mesh):
     )
 
 
-def build_stream_verifier(mesh: Mesh):
+def build_stream_verifier(mesh: Mesh, donate: bool | None = None):
     """jit'd (keys, sigs) -> ok bitmap, batch-sharded over the mesh, using
     the platform-preferred kernel per shard (the Pallas/Mosaic kernel on
     TPU, the XLA kernel elsewhere). This is the production multi-chip
-    entry: ed25519_batch.verify_batch routes through it whenever more than
-    one device is visible, so a v4-8 slice splits every chunk across its
+    entry: the DeviceScheduler's packed dispatches route through it (via
+    ops/ed25519_batch and device/mesh.py) whenever the resolved mesh has
+    more than one device, so a v4-8 slice splits every chunk across its
     chips with zero cross-chip traffic (verdicts are per-signature; the
-    quorum sum happens on host where 63-bit voting power lives)."""
+    quorum sum happens on host where 63-bit voting power lives).
+
+    The jit carries matched in/out shardings (callers place the wire
+    blocks with exactly these, so no resharding happens at the call
+    boundary) and — on TPU — donates the per-batch sig block so streamed
+    buckets stay device-resident (`donate` overrides; the cached pubkey
+    block is NEVER donated, it is reused across commits)."""
     import jax as _jax
 
     from tendermint_tpu.ops import kcache
@@ -70,17 +124,31 @@ def build_stream_verifier(mesh: Mesh):
     def local(keys, sigs):
         return kernel(keys, sigs)
 
-    mapped = _jax.shard_map(
-        local,
-        mesh=mesh,
-        in_specs=(P(None, AXIS), P(None, AXIS)),
-        out_specs=P(AXIS),
-        check_vma=False,
+    mapped = _shard_map(
+        local, mesh, (P(None, AXIS), P(None, AXIS)), P(AXIS)
     )
-    return _jax.jit(mapped)
+    sh = NamedSharding(mesh, _PACKED_SPEC)
+    jitted = _jax.jit(
+        mapped,
+        in_shardings=(sh, sh),
+        out_shardings=NamedSharding(mesh, P(AXIS)),
+        donate_argnums=(1,)
+        if (donate if donate is not None else _donate_default(mesh))
+        else (),
+    )
+
+    def run(keys, sigs):
+        check_divisible(int(sigs.shape[1]), mesh)
+        return jitted(keys, sigs)
+
+    # the raw jitted program, for AOT lowering (ops/aot.py bakes exactly
+    # the program the live path runs: a Mosaic kernel cannot be GSPMD-
+    # partitioned by pjit alone, it must stay wrapped in this shard_map)
+    run.jitted = jitted
+    return run
 
 
-def build_secp_stream_verifier(mesh: Mesh):
+def build_secp_stream_verifier(mesh: Mesh, donate: bool | None = None):
     """jit'd (sigs (32, B), keys (16, B)) -> ok bitmap for secp256k1-ECDSA,
     batch-sharded over the mesh (SURVEY §7: BOTH curves' batches shard
     across chips — a mixed-curve 10k-validator commit, BASELINE config 5's
@@ -116,14 +184,26 @@ def build_secp_stream_verifier(mesh: Mesh):
                 keys,
             )
 
-    mapped = jax.shard_map(
-        local,
-        mesh=mesh,
-        in_specs=(P(None, AXIS), P(None, AXIS)),
-        out_specs=P(AXIS),
-        check_vma=False,
+    mapped = _shard_map(
+        local, mesh, (P(None, AXIS), P(None, AXIS)), P(AXIS)
     )
-    return jax.jit(mapped)
+    sh = NamedSharding(mesh, _PACKED_SPEC)
+    jitted = jax.jit(
+        mapped,
+        in_shardings=(sh, sh),
+        out_shardings=NamedSharding(mesh, P(AXIS)),
+        # arg 0 is the per-batch sig block ((u1,u2,t1,t2) planes); the
+        # cached Q block (arg 1) is reused across batches — never donated
+        donate_argnums=(0,)
+        if (donate if donate is not None else _donate_default(mesh))
+        else (),
+    )
+
+    def run(sigs, keys):
+        check_divisible(int(sigs.shape[1]), mesh)
+        return jitted(sigs, keys)
+
+    return run
 
 
 def build_commit_verifier(mesh: Mesh):
@@ -141,11 +221,5 @@ def build_commit_verifier(mesh: Mesh):
         n_valid = jax.lax.psum(jnp.sum(ok.astype(jnp.int32)), AXIS)
         return ok, n_valid
 
-    # check_vma=False: the Shamir fori_loop carry starts from broadcast
-    # module constants (identity point), which trips the varying-axes check
-    # even though every lane's compute is genuinely per-shard.
-    mapped = jax.shard_map(
-        local, mesh=mesh, in_specs=(_PACKED_SPEC,), out_specs=(P(AXIS), P()),
-        check_vma=False,
-    )
+    mapped = _shard_map(local, mesh, (_PACKED_SPEC,), (P(AXIS), P()))
     return jax.jit(mapped)

@@ -546,6 +546,16 @@ class Environment:
                 )
             except Exception:  # noqa: BLE001 — diagnostics must not break
                 pass
+        # live mesh-plan state when the mesh module is loaded: the
+        # TMTPU_MESH/config target and per-curve resolved sizes merge
+        # into the telemetry counters' "mesh" block (state() never forces
+        # a device probe — sizes show as null until dispatch probed)
+        dmesh = _sys.modules.get("tendermint_tpu.device.mesh")
+        if dmesh is not None:
+            try:
+                snap.setdefault("mesh", {})["plan"] = dmesh.state()
+            except Exception:  # noqa: BLE001 — diagnostics must not break
+                pass
         # verified-signature cache (libs/sigcache — crypto-free import):
         # hit/miss/eviction counters + the commit-boundary residual proof
         from tendermint_tpu.libs.sigcache import SIG_CACHE

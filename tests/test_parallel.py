@@ -268,6 +268,15 @@ class TestProductionRouting:
         voteset.add_votes(votes)
         commit = voteset.make_commit()
 
+        # the vote ingest above populated the verified-signature cache
+        # (ISSUE 10) — a warm cache collapses verify_commits to a cache
+        # sweep with NOTHING to dispatch, which is correct behavior but
+        # not the routing claim under test; clear it so the commit batch
+        # actually reaches the device path
+        from tendermint_tpu.libs.sigcache import SIG_CACHE
+
+        SIG_CACHE.clear()
+
         # spy + threshold override AFTER the voteset is built, so the only
         # batch that can fire the spy is verify_commits' own
         calls = []

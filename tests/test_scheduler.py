@@ -394,6 +394,18 @@ class TestLifecycle:
         }
         assert qs["stalled"] is False
 
+    def test_dispatch_refreshes_mesh_size(self, sched, monkeypatch):
+        """Every packed dispatch re-reads the resolved mesh size
+        (device/mesh.py) so the tendermint_device_mesh_size gauge and
+        debug_device follow TMTPU_MESH/config changes live."""
+        from tendermint_tpu.device import mesh as dmesh
+
+        monkeypatch.setattr(dmesh, "mesh_size", lambda curve="ed25519": 4)
+        stub = StubDispatch()
+        sched._dispatch_curve = stub
+        assert sched.submit_sync("ed25519", *mk(b"meshy")).result(5) == [True]
+        assert tmtrace.DEVICE.snapshot()["mesh"]["size"] == 4
+
 
 class TestPriorityContext:
     def test_contextvar_default_and_scope(self):

@@ -1,0 +1,358 @@
+"""Mesh-sharded scheduler dispatch (ISSUE 11) on the virtual 8-CPU mesh.
+
+The acceptance tests of the mesh plan (`device/mesh.py`): TMTPU_MESH=1
+keeps the single-device path bit-for-bit, mesh=2/8 produce identical
+verdicts on the same inputs, a packed multi-class group scatters
+mixed verdicts to the right requests across shard boundaries, a tripped
+breaker drains a mesh dispatch through the CPU fallback with correct
+verdicts, and the padding policy guarantees mesh divisibility (a ragged
+batch raises a clear error, not an XLA shape crash).
+
+Resolution-policy and telemetry tests are crypto-free; everything that
+dispatches real signatures skips where the crypto stack is unavailable
+(same gate as test_scheduler.TestOpsIntegration).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.device import mesh as dmesh
+from tendermint_tpu.device.priorities import Priority
+from tendermint_tpu.device.scheduler import DeviceScheduler
+from tendermint_tpu.libs import trace as tmtrace
+
+
+def _ops():
+    return pytest.importorskip(
+        "tendermint_tpu.ops", reason="crypto/jax stack unavailable"
+    )
+
+
+class TestMeshResolution:
+    """target_size is pure — the whole TMTPU_MESH/config policy, no jax."""
+
+    def test_auto_uses_all_visible(self):
+        assert dmesh.target_size(8, None, None) == 8
+        assert dmesh.target_size(8, "auto", None) == 8
+        assert dmesh.target_size(8, "", None) == 8
+
+    def test_one_and_zero_disable(self):
+        assert dmesh.target_size(8, "1", None) == 1
+        assert dmesh.target_size(8, "0", None) == 1
+
+    def test_explicit_clamp(self):
+        assert dmesh.target_size(8, "4", None) == 4
+        assert dmesh.target_size(8, "64", None) == 8  # visible wins
+        assert dmesh.target_size(256, "200", None) == 128  # MAX_MESH cap
+
+    def test_power_of_two_floor(self):
+        # non-power-of-two requests and visibilities floor to a power of
+        # two so every _pad_to_bucket bucket divides over the mesh
+        assert dmesh.target_size(8, "3", None) == 2
+        assert dmesh.target_size(6, None, None) == 4
+        assert dmesh.target_size(8, "6", None) == 4
+
+    def test_single_device_host(self):
+        assert dmesh.target_size(1, None, None) == 1
+        assert dmesh.target_size(0, None, None) == 1
+        assert dmesh.target_size(1, "8", None) == 1
+
+    def test_unparseable_degrades_to_auto(self):
+        assert dmesh.target_size(8, "bogus", None) == 8
+
+    def test_config_target_and_env_precedence(self):
+        assert dmesh.target_size(8, None, 2) == 2
+        assert dmesh.target_size(8, None, 1) == 1
+        assert dmesh.target_size(8, None, 0) == 8  # configure() maps 0→None
+        assert dmesh.target_size(8, "1", 4) == 1  # env wins
+        assert dmesh.target_size(8, "4", 2) == 4
+        # explicit auto (and an unparseable value, which degrades to
+        # auto) is still the env speaking: it overrides the config
+        # target, so an operator can re-enable a config-disabled mesh
+        assert dmesh.target_size(8, "auto", 1) == 8
+        assert dmesh.target_size(8, "auto", 2) == 8
+        assert dmesh.target_size(8, "bogus", 1) == 8
+        # empty string reads as unset: config applies
+        assert dmesh.target_size(8, "", 2) == 2
+
+    def test_reset_forgets_probes_not_config(self, monkeypatch):
+        import sys
+        import types
+
+        # stand-in curve module: its _sharded plan is keyed only by mesh
+        # SIZE, so reset() must invoke its invalidation hook or a layout
+        # rebuilt at the same size keeps dispatching over dead device
+        # objects
+        fake = types.ModuleType("fake_ed25519_batch")
+        fake._sharded = ("fn", "sharding", 8)
+        fake._dev_keys = types.SimpleNamespace(_d={("k", 128, None): "blk"})
+
+        def _invalidate(mod=fake):
+            mod._sharded = None
+            mod._dev_keys._d.clear()
+
+        fake.invalidate_mesh_plan = _invalidate
+        monkeypatch.setitem(
+            sys.modules, "tendermint_tpu.ops.ed25519_batch", fake
+        )
+        dmesh.configure(2)
+        try:
+            dmesh._visible_memo = 8
+            dmesh._aot_mesh_fns[(128, 8)] = None
+            dmesh.reset()
+            assert dmesh._visible_memo is None
+            assert not dmesh._aot_mesh_fns
+            assert fake._sharded is None
+            assert not fake._dev_keys._d
+            # the config target is boot configuration, not a probe
+            assert dmesh._configured == 2
+        finally:
+            dmesh.configure(None)
+            dmesh.reset()
+
+
+class TestMeshTelemetry:
+    """Crypto-free: the mesh counters and series."""
+
+    def test_snapshot_mesh_block(self):
+        dt = tmtrace.DeviceTelemetry()
+        dt.record_mesh_size(8)
+        dt.record_mesh_dispatch(1000, 1024, 8)
+        snap = dt.snapshot()["mesh"]
+        assert snap["size"] == 8
+        assert snap["dispatches"] == 1
+        assert snap["lanes"] == 1000
+        assert snap["last"] == {
+            "curve": "ed25519", "size": 1000, "bucket": 1024,
+            "shards": 8, "lanes_per_shard": 128,
+        }
+
+    def test_metrics_series(self):
+        from tendermint_tpu.libs import metrics as tmm
+
+        dt = tmtrace.DeviceTelemetry()
+        c = tmm.Collector()
+        dm = tmm.DeviceMetrics(c)
+        dt.set_metrics(dm)
+        dt.record_mesh_size(4)
+        # 100 valid lanes in a 256-lane bucket over 4 shards (64/shard):
+        # shard occupancies 1.0, 0.5625, 0, 0 — padding in the tail
+        dt.record_mesh_dispatch(100, 256, 4)
+        text = c.render()
+        assert "tendermint_device_mesh_size 4" in text
+        assert (
+            'tendermint_device_mesh_dispatches_total{curve="ed25519"} 1'
+            in text
+        )
+        assert "tendermint_device_mesh_shard_occupancy_count 4" in text
+        # two empty tail shards land in the first bucket
+        assert (
+            'tendermint_device_mesh_shard_occupancy_bucket{le="0.1"} 2'
+            in text
+        )
+
+
+class TestDivisibility:
+    """Padding/divisibility properties: every bucket the scheduler's
+    pad-to-bucket policy emits divides over every mesh device/mesh.py can
+    resolve; ragged batches fail loudly."""
+
+    def test_every_bucket_divides_every_mesh(self):
+        _ops()
+        from tendermint_tpu.ops.ed25519_batch import _pad_to_bucket
+
+        meshes = [2, 4, 8, 16, 32, 64, 128]
+        for n in (1, 7, 100, 128, 129, 1000, 4095, 4097, 65536, 70000):
+            bucket = _pad_to_bucket(n)
+            for m in meshes:
+                assert bucket % m == 0, (n, bucket, m)
+
+    def test_shard_inputs_raises_clear_error_on_ragged(self):
+        _ops()
+        import jax
+
+        from tendermint_tpu.parallel import sharded
+
+        mesh = sharded.make_batch_mesh(jax.devices()[:8])
+        ragged = np.zeros((49, 100), dtype=np.int32)
+        with pytest.raises(ValueError, match="does not divide"):
+            sharded.shard_inputs(mesh, ragged)
+
+    def test_stream_verifier_raises_clear_error_on_ragged(self):
+        _ops()
+        import jax
+
+        from tendermint_tpu.parallel import sharded
+
+        mesh = sharded.make_batch_mesh(jax.devices()[:8])
+        fn = sharded.build_stream_verifier(mesh)
+        with pytest.raises(ValueError, match="does not divide"):
+            fn(
+                np.zeros((24, 100), dtype=np.int32),
+                np.zeros((25, 100), dtype=np.int32),
+            )
+
+
+# ---------------------------------------------------------------- real path
+
+
+N = 256  # one bucket for every dispatching test: one compile per mesh size
+
+
+def _batch_with_tampers(tampers, msg_prefix=b"sharded dispatch "):
+    from tendermint_tpu.utils import make_sig_batch
+
+    pubs, msgs, sigs = make_sig_batch(N, msg_prefix=msg_prefix)
+    for i in tampers:
+        sigs[i] = b"\x00" * 64
+    return pubs, msgs, sigs
+
+
+@pytest.fixture
+def mesh_sched(monkeypatch):
+    """A private scheduler over the real ops path with the device route
+    admitted (the CPU backend's never-device threshold would otherwise
+    keep everything on the host paths), mesh plan reset around the test."""
+    ops = _ops()
+    from tendermint_tpu.ops import ed25519_batch
+
+    monkeypatch.delenv("TMTPU_MESH", raising=False)
+    monkeypatch.delenv("TMTPU_MIN_DEVICE_BATCH", raising=False)
+    monkeypatch.setattr(ops, "_min_batch_probed", 8)
+    monkeypatch.setattr(ed25519_batch, "_sharded", None)
+    s = DeviceScheduler(aging_s=30.0)
+    yield s
+    s.shutdown()
+    ed25519_batch._sharded = None
+
+
+def _verify_via(sched, pubs, msgs, sigs, priority=None):
+    return sched.submit_sync(
+        "ed25519", pubs, msgs, sigs, priority=priority
+    ).result(600)
+
+
+class TestMeshParity:
+    def test_mesh1_is_the_single_device_path(self, mesh_sched, monkeypatch):
+        """TMTPU_MESH=1: verdict-identical to the pre-PR path, and no
+        mesh program is ever built."""
+        from tendermint_tpu.ops import ed25519_batch
+        from tendermint_tpu.parallel import sharded as shard_mod
+
+        def never(mesh):  # pragma: no cover - the assertion is the point
+            raise AssertionError("mesh=1 built a mesh program")
+
+        monkeypatch.setattr(shard_mod, "build_stream_verifier", never)
+        monkeypatch.setenv("TMTPU_MESH", "1")
+        tampers = {0, 31, 32, 255}
+        ok = _verify_via(mesh_sched, *_batch_with_tampers(tampers))
+        assert ok == [i not in tampers for i in range(N)]
+        assert ed25519_batch._sharded is None
+
+    def test_mesh_sizes_verdict_identical(self, mesh_sched, monkeypatch):
+        """mesh=1 / mesh=2 / mesh=8 on the same inputs: same verdicts,
+        tampers straddling every 8-shard boundary."""
+        tampers = {0, 31, 32, 63, 64, 95, 96, 127, 128, 159, 160, 191,
+                   192, 223, 224, 255}
+        batch = _batch_with_tampers(tampers)
+        expected = [i not in tampers for i in range(N)]
+        verdicts = {}
+        for m in ("1", "2", "8"):
+            monkeypatch.setenv("TMTPU_MESH", m)
+            verdicts[m] = _verify_via(mesh_sched, *batch)
+        assert verdicts["1"] == verdicts["2"] == verdicts["8"] == expected
+
+    def test_mesh_dispatch_feeds_telemetry(self, mesh_sched, monkeypatch):
+        monkeypatch.setenv("TMTPU_MESH", "8")
+        before = tmtrace.DEVICE.snapshot()["mesh"]["dispatches"]
+        ok = _verify_via(mesh_sched, *_batch_with_tampers(set()))
+        assert ok == [True] * N
+        snap = tmtrace.DEVICE.snapshot()["mesh"]
+        assert snap["dispatches"] >= before + 1
+        assert snap["last"]["shards"] == 8
+        assert snap["last"]["lanes_per_shard"] == N // 8
+        assert snap["size"] == 8
+
+
+class TestPackedGroupAcrossShards:
+    def test_multi_class_pack_scatters_mixed_verdicts(self, mesh_sched, monkeypatch):
+        """Three requests from three priority classes coalesce into ONE
+        mesh-sharded dispatch; each gets exactly its verdict slice, with
+        bad lanes landing on both sides of shard boundaries."""
+        monkeypatch.setenv("TMTPU_MESH", "8")
+        from tendermint_tpu.utils import make_sig_batch
+
+        s = mesh_sched
+        real = s._dispatch_curve
+        gate = threading.Event()
+        started = threading.Event()
+        calls = []
+        first = [True]
+
+        def gated(curve, pubs, msgs, sigs):
+            if first[0]:
+                first[0] = False
+                started.set()
+                assert gate.wait(600), "gate never released"
+            calls.append(len(pubs))
+            return real(curve, pubs, msgs, sigs)
+
+        s._dispatch_curve = gated
+        # blocker occupies the dispatcher so the three riders queue (same
+        # N lanes as everything else: one compiled bucket per mesh size)
+        bp, bm, bs = make_sig_batch(N, msg_prefix=b"blocker ")
+        blocker = s.submit_sync("ed25519", bp, bm, bs)
+        assert started.wait(60)
+
+        def req(n, tampers, prefix, priority):
+            pubs, msgs, sigs = make_sig_batch(n, msg_prefix=prefix)
+            for i in tampers:
+                sigs[i] = b"\x00" * 64
+            return (
+                s.submit_sync("ed25519", pubs, msgs, sigs, priority=priority),
+                [i not in tampers for i in range(n)],
+            )
+
+        # 96 + 100 + 60 = 256 lanes = one bucket over 8 shards (32/lane
+        # shard); request B's tampers sit at its own edges and across the
+        # packed batch's shard boundaries (96+31=127|128 boundary etc.)
+        fa, ea = req(96, {0, 95}, b"pack-a ", Priority.CONSENSUS_COMMIT)
+        fb, eb = req(100, {0, 31, 32, 99}, b"pack-b ", Priority.FASTSYNC)
+        fc, ec = req(60, {59}, b"pack-c ", Priority.LITE)
+        deadline = time.monotonic() + 60
+        while s.queue_state()["depth_total"] < 3:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        gate.set()
+        assert blocker.result(600) == [True] * N
+        assert fa.result(600) == ea
+        assert fb.result(600) == eb
+        assert fc.result(600) == ec
+        # the three riders went out as ONE packed dispatch
+        assert 256 in calls
+
+
+class TestBreakerFromMeshDispatch:
+    def test_tripped_breaker_drains_via_cpu_with_correct_verdicts(
+        self, mesh_sched, monkeypatch
+    ):
+        monkeypatch.setenv("TMTPU_MESH", "8")
+        tampers = {7, 128}
+        batch = _batch_with_tampers(tampers, msg_prefix=b"breaker mesh ")
+        mesh_sched.breaker.trip()
+        try:
+            before = tmtrace.DEVICE.snapshot()["fallback_reasons"].get(
+                "breaker_open", 0
+            )
+            ok = _verify_via(mesh_sched, *batch)
+            assert ok == [i not in tampers for i in range(N)]
+            after = tmtrace.DEVICE.snapshot()["fallback_reasons"][
+                "breaker_open"
+            ]
+            assert after >= before + 1
+        finally:
+            mesh_sched.breaker.reset()
