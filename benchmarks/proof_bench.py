@@ -1,0 +1,171 @@
+"""proof_bench — the state-sync serving plane's two numbers (ISSUE 12):
+
+1. verified `abci_query` throughput — the read-replica fleet's unit of
+   work: the server builds a merkle-proof-carrying response from the
+   provable kvstore, the client checks it against the verified app hash
+   (`lite.verify_abci_query_response` — exactly what
+   `lite.verified_abci_query` runs after bisection pins the header).
+   Serve and verify are measured separately: serving is O(state) tree
+   folding per query in this app, verification is O(log state) hashing,
+   so the ratio says how many stateless light clients one replica feeds.
+
+2. snapshot restore wall time — O(state) replica spin-up: chunked,
+   proof-carrying snapshot taken by `persistent_kvstore`, applied chunk
+   by chunk through the four ABCI snapshot methods with every RangeProof
+   checked (docs/state_sync.md), ending app-hash-identical.
+
+Pure hashlib + local ABCI — no device, no network, no `cryptography`
+package — so the records are comparable on any host. Output is
+bench_compare-compatible JSONL (the `PROOF_r*.json` trajectory rides the
+CI gate glob next to BENCH_r*/STREAM_r*/MESH_r*).
+
+Usage: python -m benchmarks.proof_bench [n_keys ...]   # default 2000 10000
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.examples.kvstore import (
+    KVStoreApplication,
+    PersistentKVStoreApplication,
+)
+from tendermint_tpu.lite.proxy import verify_abci_query_response
+
+DEFAULT_SIZES = (2000, 10000)
+QUERIES = 200
+
+
+def _utc() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def _record(metric: str, value: float, unit: str, source: str, **extra) -> dict:
+    return {
+        "metric": metric,
+        "value": round(value, 3),
+        "unit": unit,
+        "platform": "cpu",
+        "device_kind": "cpu",
+        "measured_at_utc": _utc(),
+        "source": source,
+        **extra,
+    }
+
+
+def _populate(app: KVStoreApplication, n_keys: int) -> None:
+    for i in range(n_keys):
+        app.deliver_tx(abci.RequestDeliverTx(tx=f"bench-{i:08d}=value-{i}".encode()))
+    app.end_block(abci.RequestEndBlock(height=1))
+    app.commit()
+
+
+def _response_dict(res: abci.ResponseQuery) -> dict:
+    """The rpc/core.py abci_query wire shape (hex), what a light client
+    actually receives and verifies."""
+    return {
+        "code": res.code,
+        "key": res.key.hex(),
+        "value": res.value.hex(),
+        "height": res.height,
+        "proof_ops": [
+            {"type": op.type, "key": op.key.hex(), "data": op.data.hex()}
+            for op in res.proof_ops
+        ],
+    }
+
+
+def bench_query(n_keys: int) -> list[dict]:
+    app = KVStoreApplication()
+    _populate(app, n_keys)
+    src = f"benchmarks.proof_bench n_keys={n_keys}, {QUERIES} proved queries"
+    keys = [f"bench-{(i * 7919) % n_keys:08d}".encode() for i in range(QUERIES)]
+
+    t0 = time.perf_counter()
+    responses = [
+        _response_dict(app.query(abci.RequestQuery(data=k, prove=True)))
+        for k in keys
+    ]
+    serve_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for resp in responses:
+        verify_abci_query_response(resp, app.app_hash)
+    verify_s = time.perf_counter() - t0
+
+    return [
+        _record(
+            f"proof_abci_query_serve_{n_keys}_per_sec", QUERIES / serve_s,
+            "queries/s", src,
+        ),
+        _record(
+            f"proof_abci_query_verify_{n_keys}_per_sec", QUERIES / verify_s,
+            "queries/s", src,
+        ),
+    ]
+
+
+def bench_restore(n_keys: int) -> list[dict]:
+    root = tempfile.mkdtemp(prefix="proof-bench-")
+    try:
+        server = PersistentKVStoreApplication(
+            os.path.join(root, "server"), snapshot_interval=1
+        )
+        _populate(server, n_keys)
+        snap = server.list_snapshots(abci.RequestListSnapshots()).snapshots[0]
+        chunks = [
+            server.load_snapshot_chunk(
+                abci.RequestLoadSnapshotChunk(height=snap.height, format=snap.format, chunk=i)
+            ).chunk
+            for i in range(snap.chunks)
+        ]
+        replica = PersistentKVStoreApplication(os.path.join(root, "replica"))
+
+        t0 = time.perf_counter()
+        offer = replica.offer_snapshot(
+            abci.RequestOfferSnapshot(snapshot=snap, app_hash=server.app_hash)
+        )
+        assert offer.result == abci.OFFER_SNAPSHOT_ACCEPT, offer
+        for i, chunk in enumerate(chunks):
+            res = replica.apply_snapshot_chunk(
+                abci.RequestApplySnapshotChunk(index=i, chunk=chunk, sender="bench")
+            )
+            assert res.result == abci.APPLY_CHUNK_ACCEPT, (i, res)
+        restore_s = time.perf_counter() - t0
+
+        assert replica.app_hash == server.app_hash
+        src = (
+            f"benchmarks.proof_bench n_keys={n_keys}, "
+            f"{snap.chunks} proof-checked chunks"
+        )
+        return [
+            _record(
+                f"snapshot_restore_{n_keys}_ms", restore_s * 1000.0, "ms", src,
+                chunks=snap.chunks,
+            ),
+            _record(
+                f"snapshot_restore_{n_keys}_keys_per_sec", n_keys / restore_s,
+                "keys/s", src,
+            ),
+        ]
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def main(argv: list[str]) -> int:
+    sizes = [int(a) for a in argv] or list(DEFAULT_SIZES)
+    for n_keys in sizes:
+        for rec in bench_query(n_keys) + bench_restore(n_keys):
+            print(json.dumps(rec), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

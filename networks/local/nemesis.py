@@ -1031,6 +1031,136 @@ def scenario_combined(net: ProcTestnet) -> None:
 scenario_combined.self_start = True
 
 
+def scenario_statesync(net: ProcTestnet) -> None:
+    """(m) State-sync bootstrap under adversarial serving (ISSUE 12
+    acceptance): the last node stays down while the rest build state
+    (persistent_kvstore snapshots every `interval` commits), ONE serving
+    peer is armed to serve provably-corrupt chunks, then the empty node
+    boots with `statesync.enable`. It must: verify the target header by
+    lite bisection (LITE class visible in debug_device), reject every
+    corrupt chunk BEFORE applying it (behaviour-scoring the offender and
+    re-fetching elsewhere), restore app-hash-identical to the replaying
+    nodes, and fast-sync only the residual heights — without ever having
+    held the early history."""
+    interval = 4
+    replica = net.n - 1
+    corrupt = net.n - 2
+    mports = enable_prometheus(net)
+
+    def mutate(i: int, cfg: dict) -> None:
+        cfg["base"]["proxy_app"] = (
+            f"persistent_kvstore:"
+            f"{os.path.join(net.home(i), 'data', 'kvstore')}:{interval}"
+        )
+        _enable_fault_control(i, cfg)
+        if i == replica:
+            ss = cfg.setdefault("statesync", {})
+            ss["enable"] = True
+            ss["rpc_servers"] = f"127.0.0.1:{net.rpc_port(0)}"
+            ss["discovery_time"] = 1.5
+            ss["chunk_request_timeout"] = 5.0
+
+    configure_nodes(net, mutate)
+    # small chunks -> every serving peer (the corrupt one included) gets
+    # chunk requests, so the proof-reject + refetch path MUST fire
+    chunk_env = {"TMTPU_SNAPSHOT_CHUNK_BYTES": "512"}
+    for i in range(net.n - 1):
+        env = dict(chunk_env)
+        if i == corrupt:
+            env["TMTPU_STATESYNC_CORRUPT"] = "1"  # fault-control-gated
+        net.start(i, env_extra=env)
+    for i in range(net.n - 1):
+        net.wait_height(i, 2)
+    nem = Nemesis(net)
+    nem.flood(120, prefix=f"ss{os.getpid()}-")  # state worth chunking
+    # ride past several snapshot points so every server holds manifests
+    for i in range(net.n - 1):
+        net.wait_height(i, 3 * interval + 2, timeout=300.0)
+
+    head_before = max(net.height(i) or 0 for i in range(net.n - 1))
+    net.start(replica, env_extra=chunk_env)
+    got = net.wait_height(replica, head_before, timeout=300.0)
+
+    # the restore actually happened, end to end
+    events = nem.recorder_events(replica, "statesync")
+    kinds = {e["kind"] for e in events}
+    for want in ("discovered", "header_verified", "offer", "chunk_applied",
+                 "restore_complete", "handoff"):
+        assert want in kinds, f"replica missing statesync/{want}: {kinds}"
+    assert "sync_failed" not in kinds and "fallback_fastsync" not in kinds, (
+        f"replica fell back to fast sync: {kinds}"
+    )
+    boot_h = next(
+        e["fields"]["height"] for e in events if e["kind"] == "restore_complete"
+    )
+    # O(state) boot: residual fast sync bounded by the snapshot cadence
+    # (+2 = the lite verifiability horizon: proving H needs H+1 and H+2)
+    assert boot_h >= head_before - interval - 2, (
+        f"stale snapshot restored: boot {boot_h}, head was {head_before}"
+    )
+    assert boot_h % interval == 0, f"boot height {boot_h} off the cadence"
+
+    # the corrupt peer was caught: proof-rejected, behaviour-scored,
+    # chunk re-fetched elsewhere — and the restore still completed
+    corrupt_id = net.node_id(corrupt)
+    bad = [e for e in events if e["kind"] == "bad_chunk"]
+    assert bad, f"no bad_chunk events — corrupt serving went undetected"
+    assert any(e["fields"]["peer"] == corrupt_id for e in bad), (
+        f"bad_chunk blamed the wrong peer: {bad} (corrupt={corrupt_id})"
+    )
+    assert ("statesync", "corrupt_serve") in nem.recorder_kinds(corrupt), (
+        "corrupt node never exercised its corrupt-serving hook"
+    )
+    behaved = [e for e in nem.recorder_events(replica, "p2p")
+               if e["kind"] == "behaviour" and "bad chunk" in e["fields"].get("reason", "")]
+    assert behaved, "bad_chunk never reached the behaviour plane"
+
+    # zero divergence: the snapshot-booted node matches the replayers
+    nem.assert_agreement(got)
+    nem.assert_agreement(max(boot_h + 1, got - 1))
+    # ...while never having held the pruned-away early history
+    assert net.rpc(replica, "block?height=1", timeout=5.0) is None, (
+        "snapshot-booted replica unexpectedly serves genesis history"
+    )
+    # a flooded key is queryable through the replica, proof included
+    key_hex = f"ss{os.getpid()}-0".encode().hex()
+    probe = net.rpc(replica, f"abci_query?data=0x{key_hex}&prove=true")
+    assert probe is not None and probe["response"].get("value"), probe
+    assert probe["response"].get("proof_ops"), probe
+
+    # the lite bisection ran through the device scheduler at LITE class
+    dev = net.rpc(replica, "debug_device", timeout=10.0)
+    assert dev is not None, "debug_device failed on replica"
+    lite_cls = (dev.get("scheduler") or {}).get("classes", {}).get("lite")
+    assert lite_cls and lite_cls["submitted"] > 0, (
+        f"no LITE-class scheduler admissions on the replica: {dev.get('scheduler')}"
+    )
+
+    # tm_statesync_* series are live and truthful
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{mports[replica]}/metrics", timeout=5
+    ) as r:
+        text = r.read().decode()
+    for series in ("tendermint_statesync_bootstrap_height",
+                   "tendermint_statesync_chunks_applied_total",
+                   "tendermint_statesync_chunk_failures_total"):
+        assert series in text, f"{series} missing from replica /metrics"
+    bh = [line for line in text.splitlines()
+          if line.startswith("tendermint_statesync_bootstrap_height")]
+    assert bh and float(bh[0].split()[-1]) == boot_h, bh
+
+    nem.assert_no_crashes()
+    print(
+        f"nemesis_statesync: empty node restored snapshot @{boot_h} "
+        f"({len(bad)} corrupt chunk(s) rejected + re-fetched, offender "
+        f"behaviour-scored), fast-synced the residual to {got}, "
+        f"app-hash-identical, genesis history never held"
+    )
+
+
+scenario_statesync.self_start = True
+
+
 SCENARIOS = {
     "nemesis_byzantine": scenario_byzantine,
     "nemesis_partition": scenario_partition,
@@ -1044,6 +1174,7 @@ SCENARIOS = {
     "nemesis_evidence_restart": scenario_evidence_restart,
     "nemesis_valset_churn": scenario_valset_churn,
     "nemesis_combined": scenario_combined,
+    "nemesis_statesync": scenario_statesync,
 }
 
 # the sub-10-minute set the CI nemesis job and tier-1 wrappers draw from

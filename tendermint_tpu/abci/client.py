@@ -41,6 +41,18 @@ class Client(BaseService):
     async def deliver_tx(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx: ...
     async def end_block(self, req: abci.RequestEndBlock) -> abci.ResponseEndBlock: ...
     async def commit(self) -> abci.ResponseCommit: ...
+    async def list_snapshots(
+        self, req: abci.RequestListSnapshots
+    ) -> abci.ResponseListSnapshots: ...
+    async def offer_snapshot(
+        self, req: abci.RequestOfferSnapshot
+    ) -> abci.ResponseOfferSnapshot: ...
+    async def load_snapshot_chunk(
+        self, req: abci.RequestLoadSnapshotChunk
+    ) -> abci.ResponseLoadSnapshotChunk: ...
+    async def apply_snapshot_chunk(
+        self, req: abci.RequestApplySnapshotChunk
+    ) -> abci.ResponseApplySnapshotChunk: ...
     async def flush(self) -> None: ...
 
     def deliver_tx_async(self, req: abci.RequestDeliverTx) -> "asyncio.Future":
@@ -94,6 +106,18 @@ class LocalClient(Client):
 
     async def commit(self):
         return await self._call(self.app.commit)
+
+    async def list_snapshots(self, req):
+        return await self._call(self.app.list_snapshots, req)
+
+    async def offer_snapshot(self, req):
+        return await self._call(self.app.offer_snapshot, req)
+
+    async def load_snapshot_chunk(self, req):
+        return await self._call(self.app.load_snapshot_chunk, req)
+
+    async def apply_snapshot_chunk(self, req):
+        return await self._call(self.app.apply_snapshot_chunk, req)
 
     async def flush(self) -> None:
         return None
@@ -242,6 +266,18 @@ class SocketClient(Client):
 
     async def commit(self):
         return await self._send_wait(abci.RequestCommit())
+
+    async def list_snapshots(self, req):
+        return await self._send_wait(req)
+
+    async def offer_snapshot(self, req):
+        return await self._send_wait(req)
+
+    async def load_snapshot_chunk(self, req):
+        return await self._send_wait(req)
+
+    async def apply_snapshot_chunk(self, req):
+        return await self._send_wait(req)
 
     async def flush(self) -> None:
         fut = self._send(abci.RequestFlush())

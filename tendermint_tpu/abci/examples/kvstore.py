@@ -10,12 +10,58 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 
 from tendermint_tpu.abci import types as abci
 from tendermint_tpu.crypto import merkle, sum_sha256
-from tendermint_tpu.encoding import Writer
+from tendermint_tpu.encoding import DecodeError, Reader, Writer
 
 VALIDATOR_TX_PREFIX = b"val:"
+
+# State-sync snapshot chunk format (format=1, docs/state_sync.md): each
+# chunk is a contiguous run of the SORTED state map — u32 start index,
+# u32 count, (key, value) pairs — followed by a merkle.RangeProof binding
+# those pairs to the snapshot's app hash. Chunks are sha256-addressed:
+# Snapshot.metadata carries the per-chunk digest list and Snapshot.hash
+# commits to all of them.
+SNAPSHOT_FORMAT = 1
+_CHUNK_TARGET_ENV = "TMTPU_SNAPSHOT_CHUNK_BYTES"
+CHUNK_TARGET_BYTES = 65536
+
+
+def encode_chunk(start: int, pairs: list[tuple[str, bytes]], proof: merkle.RangeProof) -> bytes:
+    w = Writer().u32(start).u32(len(pairs))
+    for k, v in pairs:
+        w.str(k).bytes(v)
+    w.bytes(proof.encode())
+    return w.build()
+
+
+def decode_chunk(data: bytes) -> tuple[int, list[tuple[str, bytes]], merkle.RangeProof]:
+    r = Reader(data)
+    start = r.u32()
+    pairs = [(r.str(), r.bytes()) for _ in range(r.u32())]
+    proof = merkle.RangeProof.decode(r.bytes())
+    r.expect_done()
+    return start, pairs, proof
+
+
+def encode_chunk_hashes(hashes: list[bytes]) -> bytes:
+    w = Writer().u32(len(hashes))
+    for h in hashes:
+        w.bytes(h)
+    return w.build()
+
+
+def decode_chunk_hashes(metadata: bytes) -> list[bytes]:
+    r = Reader(metadata)
+    hashes = [r.bytes() for _ in range(r.u32())]
+    r.expect_done()
+    return hashes
+
+
+def snapshot_hash(chunk_hashes: list[bytes]) -> bytes:
+    return sum_sha256(b"".join(chunk_hashes))
 
 
 class KVStoreApplication(abci.BaseApplication):
@@ -113,16 +159,227 @@ class KVStoreApplication(abci.BaseApplication):
 
 class PersistentKVStoreApplication(KVStoreApplication):
     """Adds disk persistence + validator-update transactions
-    (reference persistent_kvstore.go)."""
+    (reference persistent_kvstore.go), and — when `snapshot_interval` is
+    set — chunked, proof-carrying state snapshots every that-many commits
+    plus the matching restore path (the four ABCI state-sync methods).
+    Old blocks below the oldest kept snapshot are released via
+    ResponseCommit.retain_height, so a long-lived replica's block store
+    stays O(snapshot window), not O(history)."""
 
-    def __init__(self, db_dir: str) -> None:
+    def __init__(
+        self,
+        db_dir: str,
+        snapshot_interval: int = 0,
+        snapshot_keep: int = 2,
+    ) -> None:
         super().__init__()
         self.db_dir = db_dir
         os.makedirs(db_dir, exist_ok=True)
         self._db_path = os.path.join(db_dir, "kvstore_state.json")
         self.validators: dict[str, int] = {}  # pubkey hex -> power
         self._pending_updates: list[abci.ValidatorUpdate] = []
+        self.snapshot_interval = max(0, int(snapshot_interval))
+        self.snapshot_keep = max(1, int(snapshot_keep))
+        self._snapshot_dir = os.path.join(db_dir, "snapshots")
+        self._snapshots: dict[int, abci.Snapshot] = {}  # height -> manifest
+        self._restore: dict | None = None  # in-flight restore state
         self._load()
+        self._load_snapshots()
+
+    # -- snapshot serving side ---------------------------------------------
+
+    def _load_snapshots(self) -> None:
+        if not os.path.isdir(self._snapshot_dir):
+            return
+        for name in sorted(os.listdir(self._snapshot_dir)):
+            manifest = os.path.join(self._snapshot_dir, name, "manifest.json")
+            try:
+                with open(manifest, encoding="utf-8") as f:
+                    d = json.load(f)
+                snap = abci.Snapshot(
+                    height=d["height"],
+                    format=d["format"],
+                    chunks=d["chunks"],
+                    hash=bytes.fromhex(d["hash"]),
+                    metadata=bytes.fromhex(d["metadata"]),
+                )
+            except (OSError, ValueError, KeyError):
+                continue  # torn write of a dying snapshot attempt: skip it
+            self._snapshots[snap.height] = snap
+
+    def _chunk_path(self, height: int, index: int) -> str:
+        return os.path.join(self._snapshot_dir, f"{height:020d}", f"chunk_{index}")
+
+    def _take_snapshot(self) -> None:
+        """Chunk the sorted state map; every chunk carries a RangeProof to
+        the app hash just committed."""
+        keys = sorted(self._leaves)
+        if not keys:
+            return  # nothing to snapshot (and nothing to prove)
+        leaves = [self._leaves[k] for k in keys]
+        target = int(os.environ.get(_CHUNK_TARGET_ENV, CHUNK_TARGET_BYTES))
+        target = max(1, target)
+        chunks: list[bytes] = []
+        start = 0
+        # one subtree cache for the whole snapshot: adjacent chunk proofs
+        # share out-of-range subtree roots, so this runs on the commit
+        # path at O(n) total hashing instead of O(n × chunks)
+        subtrees: dict = {}
+        while start < len(keys):
+            size = 0
+            end = start
+            while end < len(keys) and (size == 0 or size < target):
+                size += len(keys[end]) + len(self.state[keys[end]]) + 16
+                end += 1
+            proof = merkle.range_proof(
+                leaves, start, end - start, subtree_cache=subtrees
+            )
+            pairs = [(k, self.state[k]) for k in keys[start:end]]
+            chunks.append(encode_chunk(start, pairs, proof))
+            start = end
+        chunk_hashes = [sum_sha256(c) for c in chunks]
+        snap = abci.Snapshot(
+            height=self.height,
+            format=SNAPSHOT_FORMAT,
+            chunks=len(chunks),
+            hash=snapshot_hash(chunk_hashes),
+            metadata=encode_chunk_hashes(chunk_hashes),
+        )
+        snap_dir = os.path.join(self._snapshot_dir, f"{snap.height:020d}")
+        os.makedirs(snap_dir, exist_ok=True)
+        for i, chunk in enumerate(chunks):
+            with open(self._chunk_path(snap.height, i), "wb") as f:
+                f.write(chunk)
+        # manifest LAST: its presence marks the snapshot complete
+        with open(os.path.join(snap_dir, "manifest.json"), "w", encoding="utf-8") as f:
+            json.dump(
+                {
+                    "height": snap.height,
+                    "format": snap.format,
+                    "chunks": snap.chunks,
+                    "hash": snap.hash.hex(),
+                    "metadata": snap.metadata.hex(),
+                    "app_hash": self.app_hash.hex(),
+                },
+                f,
+            )
+        self._snapshots[snap.height] = snap
+        for old in sorted(self._snapshots)[: -self.snapshot_keep]:
+            del self._snapshots[old]
+            shutil.rmtree(
+                os.path.join(self._snapshot_dir, f"{old:020d}"), ignore_errors=True
+            )
+
+    def retain_height(self) -> int:
+        """Blocks below the oldest kept snapshot are prunable — a peer
+        bootstrapping from our snapshots only ever fast-syncs forward from
+        one of them (advertised bases keep honest peers away from the
+        pruned range)."""
+        if not self._snapshots:
+            return 0
+        return min(self._snapshots)
+
+    def list_snapshots(self, req: abci.RequestListSnapshots) -> abci.ResponseListSnapshots:
+        snaps = [self._snapshots[h] for h in sorted(self._snapshots, reverse=True)]
+        return abci.ResponseListSnapshots(snapshots=snaps)
+
+    def load_snapshot_chunk(
+        self, req: abci.RequestLoadSnapshotChunk
+    ) -> abci.ResponseLoadSnapshotChunk:
+        snap = self._snapshots.get(req.height)
+        if snap is None or snap.format != req.format or not (0 <= req.chunk < snap.chunks):
+            return abci.ResponseLoadSnapshotChunk()
+        try:
+            with open(self._chunk_path(req.height, req.chunk), "rb") as f:
+                return abci.ResponseLoadSnapshotChunk(chunk=f.read())
+        except OSError:
+            return abci.ResponseLoadSnapshotChunk()
+
+    # -- snapshot restore side ---------------------------------------------
+
+    def offer_snapshot(self, req: abci.RequestOfferSnapshot) -> abci.ResponseOfferSnapshot:
+        snap = req.snapshot
+        if snap.format != SNAPSHOT_FORMAT:
+            return abci.ResponseOfferSnapshot(result=abci.OFFER_SNAPSHOT_REJECT_FORMAT)
+        if snap.height <= 0 or snap.chunks <= 0 or not req.app_hash:
+            return abci.ResponseOfferSnapshot(result=abci.OFFER_SNAPSHOT_REJECT)
+        try:
+            chunk_hashes = decode_chunk_hashes(snap.metadata)
+        except DecodeError:
+            return abci.ResponseOfferSnapshot(result=abci.OFFER_SNAPSHOT_REJECT)
+        if len(chunk_hashes) != snap.chunks or snapshot_hash(chunk_hashes) != snap.hash:
+            return abci.ResponseOfferSnapshot(result=abci.OFFER_SNAPSHOT_REJECT)
+        self._restore = {
+            "snapshot": snap,
+            "app_hash": req.app_hash,  # light-client-verified: the proof root
+            "chunk_hashes": chunk_hashes,
+            "applied": 0,
+            "pairs": [],
+        }
+        return abci.ResponseOfferSnapshot(result=abci.OFFER_SNAPSHOT_ACCEPT)
+
+    def apply_snapshot_chunk(
+        self, req: abci.RequestApplySnapshotChunk
+    ) -> abci.ResponseApplySnapshotChunk:
+        rs = self._restore
+        if rs is None:
+            return abci.ResponseApplySnapshotChunk(result=abci.APPLY_CHUNK_ABORT)
+
+        def retry() -> abci.ResponseApplySnapshotChunk:
+            # corrupt/forged chunk: never applied; ask the reactor to
+            # refetch this index from someone else and drop the sender
+            return abci.ResponseApplySnapshotChunk(
+                result=abci.APPLY_CHUNK_RETRY,
+                refetch_chunks=[req.index],
+                reject_senders=[req.sender] if req.sender else [],
+            )
+
+        if req.index != rs["applied"]:  # chunks apply strictly in order
+            return abci.ResponseApplySnapshotChunk(
+                result=abci.APPLY_CHUNK_RETRY, refetch_chunks=[rs["applied"]]
+            )
+        if sum_sha256(req.chunk) != rs["chunk_hashes"][req.index]:
+            return retry()
+        try:
+            start, pairs, proof = decode_chunk(req.chunk)
+        except DecodeError:
+            return retry()
+        leaves = [
+            Writer().str(k).bytes(sum_sha256(v)).build() for k, v in pairs
+        ]
+        if (
+            start != len(rs["pairs"])
+            or proof.start != start
+            or proof.count != len(pairs)
+            or not proof.verify(rs["app_hash"], leaves)
+        ):
+            return retry()
+        if req.index == rs["snapshot"].chunks - 1 and proof.total != start + len(pairs):
+            return retry()  # final chunk must complete the tree
+        rs["pairs"].extend(pairs)
+        rs["applied"] += 1
+        if rs["applied"] == rs["snapshot"].chunks:
+            self.state = {k: v for k, v in rs["pairs"]}
+            self._leaves = {k: self._leaf(k) for k in self.state}
+            # validator bookkeeping rides the snapshotted state as val:
+            # records (_set_validator_record) — rebuild the dict from them
+            self.validators = {
+                k[len("val:"):]: int(v)
+                for k, v in self.state.items()
+                if k.startswith("val:")
+            }
+            self.height = rs["snapshot"].height
+            self.tx_count = 0  # unknowable from state alone; provable mode unused
+            self.app_hash = self._compute_app_hash()
+            if self.app_hash != rs["app_hash"]:
+                # unreachable given the per-chunk proofs; belt + suspenders
+                self._restore = None
+                return abci.ResponseApplySnapshotChunk(
+                    result=abci.APPLY_CHUNK_REJECT_SNAPSHOT
+                )
+            self._save()
+            self._restore = None
+        return abci.ResponseApplySnapshotChunk(result=abci.APPLY_CHUNK_ACCEPT)
 
     def _load(self) -> None:
         if os.path.exists(self._db_path):
@@ -146,9 +403,26 @@ class PersistentKVStoreApplication(KVStoreApplication):
                 f,
             )
 
+    def _set_validator_record(self, pk_hex: str, power: int) -> None:
+        """Mirror the validator bookkeeping into the snapshotted state map
+        (the reference persistent_kvstore keeps validator records IN app
+        state for exactly this reason): a snapshot-restored replica
+        rebuilds `self.validators` from these keys, so restore loses
+        nothing. `val:` keys cannot collide with user txs — deliver_tx
+        routes anything with that prefix to the validator parser."""
+        key = f"val:{pk_hex}"
+        if power == 0:
+            self.state.pop(key, None)
+            self._leaves.pop(key, None)
+        else:
+            self.state[key] = str(power).encode()
+            if self.provable:
+                self._leaves[key] = self._leaf(key)
+
     def init_chain(self, req: abci.RequestInitChain) -> abci.ResponseInitChain:
         for vu in req.validators:
             self.validators[vu.pub_key.hex()] = vu.power
+            self._set_validator_record(vu.pub_key.hex(), vu.power)
         return abci.ResponseInitChain()
 
     def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
@@ -180,6 +454,7 @@ class PersistentKVStoreApplication(KVStoreApplication):
                 self.validators.pop(pub_key.hex(), None)
             else:
                 self.validators[pub_key.hex()] = power
+            self._set_validator_record(pub_key.hex(), power)
             return abci.ResponseDeliverTx(code=abci.CODE_TYPE_OK)
         return super().deliver_tx(req)
 
@@ -191,4 +466,12 @@ class PersistentKVStoreApplication(KVStoreApplication):
     def commit(self) -> abci.ResponseCommit:
         resp = super().commit()
         self._save()
+        if (
+            self.provable
+            and self.snapshot_interval
+            and self.height > 0
+            and self.height % self.snapshot_interval == 0
+        ):
+            self._take_snapshot()
+        resp.retain_height = self.retain_height() if self.snapshot_interval else 0
         return resp

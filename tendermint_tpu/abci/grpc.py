@@ -53,6 +53,10 @@ _METHODS = {
     "InitChain": abci.RequestInitChain,
     "BeginBlock": abci.RequestBeginBlock,
     "EndBlock": abci.RequestEndBlock,
+    "ListSnapshots": abci.RequestListSnapshots,
+    "OfferSnapshot": abci.RequestOfferSnapshot,
+    "LoadSnapshotChunk": abci.RequestLoadSnapshotChunk,
+    "ApplySnapshotChunk": abci.RequestApplySnapshotChunk,
 }
 
 
@@ -88,6 +92,14 @@ class GRPCApplication:
             return a.end_block(req)
         if isinstance(req, abci.RequestCommit):
             return a.commit()
+        if isinstance(req, abci.RequestListSnapshots):
+            return a.list_snapshots(req)
+        if isinstance(req, abci.RequestOfferSnapshot):
+            return a.offer_snapshot(req)
+        if isinstance(req, abci.RequestLoadSnapshotChunk):
+            return a.load_snapshot_chunk(req)
+        if isinstance(req, abci.RequestApplySnapshotChunk):
+            return a.apply_snapshot_chunk(req)
         raise ValueError(f"unknown request {req!r}")
 
 
@@ -265,6 +277,18 @@ class GRPCClient(Client):
 
     async def commit(self):
         return await self._call("Commit", abci.RequestCommit())
+
+    async def list_snapshots(self, req):
+        return await self._call("ListSnapshots", req)
+
+    async def offer_snapshot(self, req):
+        return await self._call("OfferSnapshot", req)
+
+    async def load_snapshot_chunk(self, req):
+        return await self._call("LoadSnapshotChunk", req)
+
+    async def apply_snapshot_chunk(self, req):
+        return await self._call("ApplySnapshotChunk", req)
 
     async def flush(self) -> None:
         """Wait for everything queued so far to have been executed."""

@@ -104,6 +104,7 @@ def _to_signed64(n: int) -> int:
 #   "msg"        embedded message, sub = Desc
 #   "rep_msg"    repeated embedded message, sub = Desc
 #   "rep_str"    repeated string
+#   "rep_u64"    repeated non-negative varint, PACKED (proto3 default)
 # Values are plain dicts at this layer; the mapping layer below converts
 # dict <-> the abci/types.py dataclasses.
 
@@ -155,6 +156,15 @@ class Desc:
                 for item in val:
                     enc = item.encode()
                     out += encode_uvarint(num << 3 | 2) + encode_uvarint(len(enc)) + enc
+            elif kind == "rep_u64":
+                if not val:
+                    continue
+                packed = b"".join(encode_uvarint(int(item)) for item in val)
+                out += (
+                    encode_uvarint(num << 3 | 2)
+                    + encode_uvarint(len(packed))
+                    + packed
+                )
             else:  # pragma: no cover - descriptor bug
                 raise AssertionError(f"bad kind {kind}")
         return bytes(out)
@@ -194,6 +204,22 @@ class Desc:
             # is malformed bytes, not a value to coerce or silently drop
             # (fuzz-found: .decode() on int; review-found: known i64 sent
             # as fixed64 decoded to its default)
+            if kind == "rep_u64":
+                # proto3 accepts BOTH packed (wt 2) and unpacked (wt 0)
+                # encodings for repeated varints (spec: parsers must)
+                if wt == 0:
+                    v.setdefault(attr, []).append(payload)
+                    continue
+                if wt != 2:
+                    raise DecodeError(
+                        f"{self.name}: field {num} kind {kind} got wire type {wt}"
+                    )
+                vals = v.setdefault(attr, [])
+                p = 0
+                while p < len(payload):
+                    item, p = decode_uvarint(payload, p)
+                    vals.append(item)
+                continue
             if wt != (2 if kind in ("str", "bytes", "msg", "rep_msg", "rep_str") else 0):
                 raise DecodeError(
                     f"{self.name}: field {num} kind {kind} got wire type {wt}"
@@ -292,6 +318,16 @@ PROOF_OP = Desc(
     [(1, "type", "str", None), (2, "key", "bytes", None), (3, "data", "bytes", None)],
 )
 PROOF = Desc("Proof", [(1, "ops", "rep_msg", PROOF_OP)])
+SNAPSHOT = Desc(
+    "Snapshot",
+    [
+        (1, "height", "u64", None),
+        (2, "format", "u64", None),
+        (3, "chunks", "u64", None),
+        (4, "hash", "bytes", None),
+        (5, "metadata", "bytes", None),
+    ],
+)
 
 REQ_ECHO = Desc("RequestEcho", [(1, "message", "str", None)])
 REQ_FLUSH = Desc("RequestFlush", [])
@@ -340,6 +376,19 @@ REQ_CHECK_TX = Desc(
 REQ_DELIVER_TX = Desc("RequestDeliverTx", [(1, "tx", "bytes", None)])
 REQ_END_BLOCK = Desc("RequestEndBlock", [(1, "height", "i64", None)])
 REQ_COMMIT = Desc("RequestCommit", [])
+REQ_LIST_SNAPSHOTS = Desc("RequestListSnapshots", [])
+REQ_OFFER_SNAPSHOT = Desc(
+    "RequestOfferSnapshot",
+    [(1, "snapshot", "msg", SNAPSHOT), (2, "app_hash", "bytes", None)],
+)
+REQ_LOAD_SNAPSHOT_CHUNK = Desc(
+    "RequestLoadSnapshotChunk",
+    [(1, "height", "u64", None), (2, "format", "u64", None), (3, "chunk", "u64", None)],
+)
+REQ_APPLY_SNAPSHOT_CHUNK = Desc(
+    "RequestApplySnapshotChunk",
+    [(1, "index", "u64", None), (2, "chunk", "bytes", None), (3, "sender", "str", None)],
+)
 
 RESP_EXCEPTION = Desc("ResponseException", [(1, "error", "str", None)])
 RESP_ECHO = Desc("ResponseEcho", [(1, "message", "str", None)])
@@ -400,7 +449,25 @@ RESP_END_BLOCK = Desc(
         (3, "events", "rep_msg", EVENT),
     ],
 )
-RESP_COMMIT = Desc("ResponseCommit", [(2, "data", "bytes", None)])
+RESP_COMMIT = Desc(
+    "ResponseCommit",
+    [(2, "data", "bytes", None), (3, "retain_height", "i64", None)],
+)
+RESP_LIST_SNAPSHOTS = Desc(
+    "ResponseListSnapshots", [(1, "snapshots", "rep_msg", SNAPSHOT)]
+)
+RESP_OFFER_SNAPSHOT = Desc("ResponseOfferSnapshot", [(1, "result", "u64", None)])
+RESP_LOAD_SNAPSHOT_CHUNK = Desc(
+    "ResponseLoadSnapshotChunk", [(1, "chunk", "bytes", None)]
+)
+RESP_APPLY_SNAPSHOT_CHUNK = Desc(
+    "ResponseApplySnapshotChunk",
+    [
+        (1, "result", "u64", None),
+        (2, "refetch_chunks", "rep_u64", None),
+        (3, "reject_senders", "rep_str", None),
+    ],
+)
 
 
 # ------------------------------------------------------- value converters
@@ -575,6 +642,27 @@ def _events_from_proto(evs: list[dict] | None) -> dict[str, list[str]]:
     return out
 
 
+def _snapshot_to_proto(s: "abci.Snapshot") -> dict:
+    return {
+        "height": s.height,
+        "format": s.format,
+        "chunks": s.chunks,
+        "hash": s.hash,
+        "metadata": s.metadata,
+    }
+
+
+def _snapshot_from_proto(v: dict | None) -> "abci.Snapshot":
+    v = v or {}
+    return abci.Snapshot(
+        height=v.get("height", 0),
+        format=v.get("format", 0),
+        chunks=v.get("chunks", 0),
+        hash=v.get("hash", b""),
+        metadata=v.get("metadata", b""),
+    )
+
+
 def _proof_to_proto(ops: list) -> dict | None:
     if not ops:
         return None
@@ -742,6 +830,48 @@ _REQ_MAP: list[tuple[int, type, Desc, Callable, Callable]] = [
         _mk(abci.RequestEndBlock, [("height", 0)]),
     ),
     (12, abci.RequestCommit, REQ_COMMIT, lambda o: {}, lambda v: abci.RequestCommit()),
+    # state-sync methods (v0.34 oneof numbering — new relative to the
+    # /root/reference schema, which predates ABCI snapshots)
+    (
+        13,
+        abci.RequestListSnapshots,
+        REQ_LIST_SNAPSHOTS,
+        lambda o: {},
+        lambda v: abci.RequestListSnapshots(),
+    ),
+    (
+        14,
+        abci.RequestOfferSnapshot,
+        REQ_OFFER_SNAPSHOT,
+        lambda o: {
+            "snapshot": _snapshot_to_proto(o.snapshot),
+            "app_hash": o.app_hash,
+        },
+        lambda v: abci.RequestOfferSnapshot(
+            snapshot=_snapshot_from_proto(v.get("snapshot")),
+            app_hash=v.get("app_hash", b""),
+        ),
+    ),
+    (
+        15,
+        abci.RequestLoadSnapshotChunk,
+        REQ_LOAD_SNAPSHOT_CHUNK,
+        lambda o: {"height": o.height, "format": o.format, "chunk": o.chunk},
+        _mk(
+            abci.RequestLoadSnapshotChunk,
+            [("height", 0), ("format", 0), ("chunk", 0)],
+        ),
+    ),
+    (
+        16,
+        abci.RequestApplySnapshotChunk,
+        REQ_APPLY_SNAPSHOT_CHUNK,
+        lambda o: {"index": o.index, "chunk": o.chunk, "sender": o.sender},
+        _mk(
+            abci.RequestApplySnapshotChunk,
+            [("index", 0), ("chunk", b""), ("sender", "")],
+        ),
+    ),
 ]
 
 _RESP_MAP: list[tuple[int, type, Desc, Callable, Callable]] = [
@@ -909,8 +1039,46 @@ _RESP_MAP: list[tuple[int, type, Desc, Callable, Callable]] = [
         12,
         abci.ResponseCommit,
         RESP_COMMIT,
-        lambda o: {"data": o.data},
-        _mk(abci.ResponseCommit, [("data", b"")]),
+        lambda o: {"data": o.data, "retain_height": o.retain_height},
+        _mk(abci.ResponseCommit, [("data", b""), ("retain_height", 0)]),
+    ),
+    (
+        14,
+        abci.ResponseListSnapshots,
+        RESP_LIST_SNAPSHOTS,
+        lambda o: {"snapshots": [_snapshot_to_proto(s) for s in o.snapshots]},
+        lambda v: abci.ResponseListSnapshots(
+            snapshots=[_snapshot_from_proto(s) for s in v.get("snapshots", [])]
+        ),
+    ),
+    (
+        15,
+        abci.ResponseOfferSnapshot,
+        RESP_OFFER_SNAPSHOT,
+        lambda o: {"result": o.result},
+        _mk(abci.ResponseOfferSnapshot, [("result", 0)]),
+    ),
+    (
+        16,
+        abci.ResponseLoadSnapshotChunk,
+        RESP_LOAD_SNAPSHOT_CHUNK,
+        lambda o: {"chunk": o.chunk},
+        _mk(abci.ResponseLoadSnapshotChunk, [("chunk", b"")]),
+    ),
+    (
+        17,
+        abci.ResponseApplySnapshotChunk,
+        RESP_APPLY_SNAPSHOT_CHUNK,
+        lambda o: {
+            "result": o.result,
+            "refetch_chunks": list(o.refetch_chunks),
+            "reject_senders": list(o.reject_senders),
+        },
+        lambda v: abci.ResponseApplySnapshotChunk(
+            result=v.get("result", 0),
+            refetch_chunks=[int(x) for x in v.get("refetch_chunks", [])],
+            reject_senders=list(v.get("reject_senders", [])),
+        ),
     ),
 ]
 

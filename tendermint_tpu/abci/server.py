@@ -114,4 +114,12 @@ class ABCIServer(BaseService):
             return a.end_block(req)
         if isinstance(req, abci.RequestCommit):
             return a.commit()
+        if isinstance(req, abci.RequestListSnapshots):
+            return a.list_snapshots(req)
+        if isinstance(req, abci.RequestOfferSnapshot):
+            return a.offer_snapshot(req)
+        if isinstance(req, abci.RequestLoadSnapshotChunk):
+            return a.load_snapshot_chunk(req)
+        if isinstance(req, abci.RequestApplySnapshotChunk):
+            return a.apply_snapshot_chunk(req)
         return abci.ResponseException(f"unknown request {req!r}")

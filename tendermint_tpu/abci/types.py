@@ -84,6 +84,51 @@ class EvidenceInfo:
         return cls(r.str(), r.bytes(), r.u64(), r.i64())
 
 
+@dataclass
+class Snapshot:
+    """abci.Snapshot (reference abci/types/types.proto Snapshot): an
+    app-state snapshot offered between nodes over the state-sync channel.
+    `hash` addresses the whole snapshot (sha256 over the chunk hashes);
+    `metadata` is app-specific — the kvstore packs the per-chunk sha256
+    list there so the reactor can reject a corrupt chunk before the app
+    sees it (docs/state_sync.md)."""
+
+    height: int = 0
+    format: int = 1
+    chunks: int = 0
+    hash: bytes = b""
+    metadata: bytes = b""
+
+    def encode_into(self, w: Writer) -> None:
+        w.u64(self.height).u32(self.format).u32(self.chunks)
+        w.bytes(self.hash).bytes(self.metadata)
+
+    @classmethod
+    def read(cls, r: Reader) -> "Snapshot":
+        return cls(r.u64(), r.u32(), r.u32(), r.bytes(), r.bytes())
+
+    def key(self) -> tuple:
+        """Identity for dedup across peers (reference statesync/snapshots.go)."""
+        return (self.height, self.format, self.chunks, self.hash, self.metadata)
+
+
+# ResponseOfferSnapshot.result (reference abci/types/types.proto)
+OFFER_SNAPSHOT_UNKNOWN = 0
+OFFER_SNAPSHOT_ACCEPT = 1
+OFFER_SNAPSHOT_ABORT = 2
+OFFER_SNAPSHOT_REJECT = 3
+OFFER_SNAPSHOT_REJECT_FORMAT = 4
+OFFER_SNAPSHOT_REJECT_SENDER = 5
+
+# ResponseApplySnapshotChunk.result
+APPLY_CHUNK_UNKNOWN = 0
+APPLY_CHUNK_ACCEPT = 1
+APPLY_CHUNK_ABORT = 2
+APPLY_CHUNK_RETRY = 3
+APPLY_CHUNK_RETRY_SNAPSHOT = 4
+APPLY_CHUNK_REJECT_SNAPSHOT = 5
+
+
 def _encode_events(w: Writer, events: dict[str, list[str]]) -> None:
     w.u32(len(events))
     for k in sorted(events):
@@ -172,6 +217,34 @@ class RequestEndBlock:
 @dataclass
 class RequestCommit:
     pass
+
+
+# -- state sync (reference abci/types/application.go StateSyncer methods) ---
+
+
+@dataclass
+class RequestListSnapshots:
+    pass
+
+
+@dataclass
+class RequestOfferSnapshot:
+    snapshot: Snapshot = field(default_factory=Snapshot)
+    app_hash: bytes = b""  # from the light-client-verified header
+
+
+@dataclass
+class RequestLoadSnapshotChunk:
+    height: int = 0
+    format: int = 0
+    chunk: int = 0
+
+
+@dataclass
+class RequestApplySnapshotChunk:
+    index: int = 0
+    chunk: bytes = b""
+    sender: str = ""  # peer id, so the app can ask to reject it
 
 
 # ---------------------------------------------------------------------------
@@ -303,6 +376,35 @@ class ResponseEndBlock:
 @dataclass
 class ResponseCommit:
     data: bytes = b""  # the app hash
+    # Reference v0.34 ResponseCommit.retain_height: blocks BELOW this
+    # height are no longer needed by the app and may be pruned from the
+    # block store — height retain_height itself is kept, matching
+    # BlockStore.prune (state/execution honours it; snapshot-booted
+    # replicas already advertise their base over fast sync, so peers
+    # never assume genesis history is present).
+    retain_height: int = 0
+
+
+@dataclass
+class ResponseListSnapshots:
+    snapshots: list[Snapshot] = field(default_factory=list)
+
+
+@dataclass
+class ResponseOfferSnapshot:
+    result: int = OFFER_SNAPSHOT_UNKNOWN
+
+
+@dataclass
+class ResponseLoadSnapshotChunk:
+    chunk: bytes = b""
+
+
+@dataclass
+class ResponseApplySnapshotChunk:
+    result: int = APPLY_CHUNK_UNKNOWN
+    refetch_chunks: list[int] = field(default_factory=list)
+    reject_senders: list[str] = field(default_factory=list)
 
 
 @dataclass
@@ -335,6 +437,21 @@ class Application:
 
     def commit(self) -> ResponseCommit: ...
 
+    # -- state sync (reference application.go StateSyncer; no-snapshot apps
+    # inherit the empty defaults from BaseApplication) -----------------
+
+    def list_snapshots(self, req: RequestListSnapshots) -> ResponseListSnapshots: ...
+
+    def offer_snapshot(self, req: RequestOfferSnapshot) -> ResponseOfferSnapshot: ...
+
+    def load_snapshot_chunk(
+        self, req: RequestLoadSnapshotChunk
+    ) -> ResponseLoadSnapshotChunk: ...
+
+    def apply_snapshot_chunk(
+        self, req: RequestApplySnapshotChunk
+    ) -> ResponseApplySnapshotChunk: ...
+
 
 class BaseApplication(Application):
     """No-op base (reference abci/types/application.go:33)."""
@@ -366,6 +483,22 @@ class BaseApplication(Application):
     def commit(self) -> ResponseCommit:
         return ResponseCommit()
 
+    def list_snapshots(self, req: RequestListSnapshots) -> ResponseListSnapshots:
+        return ResponseListSnapshots()
+
+    def offer_snapshot(self, req: RequestOfferSnapshot) -> ResponseOfferSnapshot:
+        return ResponseOfferSnapshot(result=OFFER_SNAPSHOT_REJECT)
+
+    def load_snapshot_chunk(
+        self, req: RequestLoadSnapshotChunk
+    ) -> ResponseLoadSnapshotChunk:
+        return ResponseLoadSnapshotChunk()
+
+    def apply_snapshot_chunk(
+        self, req: RequestApplySnapshotChunk
+    ) -> ResponseApplySnapshotChunk:
+        return ResponseApplySnapshotChunk(result=APPLY_CHUNK_ABORT)
+
 
 # ---------------------------------------------------------------------------
 # socket wire codec: tagged union
@@ -382,6 +515,10 @@ _REQ_TAGS: list[tuple[int, type]] = [
     (9, RequestDeliverTx),
     (10, RequestEndBlock),
     (11, RequestCommit),
+    (12, RequestListSnapshots),
+    (13, RequestOfferSnapshot),
+    (14, RequestLoadSnapshotChunk),
+    (15, RequestApplySnapshotChunk),
 ]
 _RESP_TAGS: list[tuple[int, type]] = [
     (1, ResponseEcho),
@@ -396,6 +533,10 @@ _RESP_TAGS: list[tuple[int, type]] = [
     (10, ResponseEndBlock),
     (11, ResponseCommit),
     (12, ResponseException),
+    (13, ResponseListSnapshots),
+    (14, ResponseOfferSnapshot),
+    (15, ResponseLoadSnapshotChunk),
+    (16, ResponseApplySnapshotChunk),
 ]
 
 
@@ -413,11 +554,19 @@ def _encode_msg(msg) -> bytes:
             w.str(val)
         elif isinstance(val, dict):
             _encode_events(w, val)
+        elif isinstance(val, Snapshot):
+            val.encode_into(w)
         elif isinstance(val, list):
             w.u32(len(val))
             for item in val:
                 if hasattr(item, "encode_into"):
                     item.encode_into(w)
+                elif isinstance(item, bool):
+                    w.bool(item)
+                elif isinstance(item, int):  # e.g. refetch_chunks
+                    w.u64(item)
+                elif isinstance(item, str):  # e.g. reject_senders
+                    w.str(item)
                 else:  # merkle.ProofOp
                     from tendermint_tpu.crypto.merkle import ProofOp
 
@@ -444,6 +593,14 @@ def _decode_msg(cls, data: bytes):
             kwargs[f.name] = r.str()
         elif "dict" in str(f.type):
             kwargs[f.name] = _read_events(r)
+        elif "list[Snapshot]" in str(f.type):
+            kwargs[f.name] = [Snapshot.read(r) for _ in range(r.u32())]
+        elif "Snapshot" in str(f.type):
+            kwargs[f.name] = Snapshot.read(r)
+        elif "list[int]" in str(f.type):
+            kwargs[f.name] = [r.u64() for _ in range(r.u32())]
+        elif "list[str]" in str(f.type):
+            kwargs[f.name] = [r.str() for _ in range(r.u32())]
         elif "ValidatorUpdate" in str(f.type):
             kwargs[f.name] = [ValidatorUpdate.read(r) for _ in range(r.u32())]
         elif "VoteInfo" in str(f.type):

@@ -73,6 +73,22 @@ class PeerBehaviour:
         peer whose traffic is overwhelmingly rejects decays."""
         return cls(peer_id, f"bad tx: {explanation}", False, weight=0.1, bad=True)
 
+    @classmethod
+    def bad_chunk(cls, peer_id: str, explanation: str) -> "PeerBehaviour":
+        """State-sync snapshot chunk that failed its hash or merkle-proof
+        check. Chunks are content-addressed (the snapshot manifest pins
+        every chunk's sha256), so a mismatch is a fabrication, not drift —
+        weighted like a bad block."""
+        return cls(peer_id, f"bad chunk: {explanation}", True, weight=5.0)
+
+    @classmethod
+    def chunk_timeout(cls, peer_id: str, explanation: str) -> "PeerBehaviour":
+        """Chunk request that timed out. Plausibly load or loss, not
+        malice: no disconnect, small penalty — a peer that only ever
+        stalls restores decays out of the fetch rotation."""
+        return cls(peer_id, f"chunk timeout: {explanation}", False,
+                   weight=0.5, bad=True)
+
     # -- good behaviours ---------------------------------------------------
 
     @classmethod

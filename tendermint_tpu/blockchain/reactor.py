@@ -160,6 +160,29 @@ class BlockchainReactor(BaseReactor):
         if self.pool.is_running:
             await self.pool.stop()
 
+    async def start_fast_sync(self, state) -> None:
+        """State-sync handoff (docs/state_sync.md): the store was just
+        bootstrapped at a snapshot height — begin fast sync there for the
+        residual heights. The node constructed this reactor with
+        fast_sync=False so the pool never started at genesis; re-anchor
+        it on the bootstrapped store and run the normal pool routine
+        (which hands to consensus when caught up)."""
+        if self.fast_sync and self.pool.is_running:
+            return  # already syncing (double handoff is a no-op)
+        self.initial_state = self.state = state
+        self.fast_sync = True
+        self.pool.height = self.block_store.height() + 1
+        self._verified_ahead.clear()
+        self._failed_ahead.clear()
+        await self.pool.start()
+        self.spawn(self._pool_routine(), "bc-pool-routine")
+        if self.switch is not None:
+            # learn peer ranges NOW instead of waiting out the 10s tick:
+            # peers advertise (base, height) and the pool starts fetching
+            await self.switch.broadcast(
+                BLOCKCHAIN_CHANNEL, encode_bc_message(StatusRequestMessage())
+            )
+
     # -- p2p plumbing -------------------------------------------------
 
     async def _send_block_request(self, height: int, peer_id: str) -> None:

@@ -55,6 +55,23 @@ class BlockchainReactorV1(BaseReactor):
             self.spawn(self._process_routine(), "bcv1-process")
             self.spawn(self._tick_routine(), "bcv1-tick")
 
+    async def start_fast_sync(self, state) -> None:
+        """State-sync handoff (docs/state_sync.md): re-anchor the FSM on
+        the freshly bootstrapped store and start syncing the residual
+        heights (the v0 reactor's start_fast_sync contract)."""
+        if self.fast_sync and self.fsm.state != State.FINISHED:
+            return
+        self.state = state
+        self.fast_sync = True
+        self.fsm = BcFSM(self.block_store.height() + 1, self.log)
+        await self._run_effects(self.fsm.handle(Event.START))
+        self.spawn(self._process_routine(), "bcv1-process")
+        self.spawn(self._tick_routine(), "bcv1-tick")
+        if self.switch is not None:
+            await self.switch.broadcast(
+                BLOCKCHAIN_CHANNEL, encode_bc_message(StatusRequestMessage())
+            )
+
     # -- p2p ----------------------------------------------------------
 
     async def add_peer(self, peer) -> None:

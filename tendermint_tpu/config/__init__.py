@@ -100,6 +100,36 @@ class FastSyncConfig:
 
 
 @dataclass
+class StateSyncConfig:
+    """State sync (docs/state_sync.md, reference config.go StateSyncConfig):
+    bootstrap a fresh node from an app-state snapshot discovered over p2p
+    instead of replaying the chain — O(state), not O(history). The target
+    header is verified by light-client bisection against `rpc_servers`
+    (device batches at LITE priority); every chunk carries a merkle proof
+    to that header's app hash, so a corrupt chunk can never apply. Only
+    an EMPTY node state-syncs; a restarted node falls through to fast
+    sync. Serving (answering peers' snapshot/chunk requests) is always on
+    — `enable` arms only the restore side."""
+
+    enable: bool = False
+    # comma-separated `host:port` JSON-RPC endpoints used by the light
+    # client for header verification (at least one required to sync)
+    rpc_servers: str = ""
+    # light-client trust anchor: first-contact header (height, hex block
+    # hash). 0/"" = trust-on-first-use of the current head — fine for lab
+    # nets, pin both in production.
+    trust_height: int = 0
+    trust_hash: str = ""
+    # how long to collect snapshot advertisements before picking one
+    discovery_time: float = 3.0
+    # per-request chunk fetch timeout; a peer that times out is retried
+    # elsewhere and behaviour-scored
+    chunk_request_timeout: float = 10.0
+    # parallel chunk fetchers (applies stay strictly in order)
+    chunk_fetchers: int = 4
+
+
+@dataclass
 class ConsensusConfig:
     wal_path: str = "data/cs.wal/wal"
     # timeouts in seconds (reference config.go:730-824, ms there)
@@ -205,6 +235,7 @@ class Config:
     p2p: P2PConfig = field(default_factory=P2PConfig)
     mempool: MempoolConfig = field(default_factory=MempoolConfig)
     fast_sync: FastSyncConfig = field(default_factory=FastSyncConfig)
+    statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
     device: DeviceConfig = field(default_factory=DeviceConfig)
     tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
@@ -273,6 +304,7 @@ class Config:
                 p2p=P2PConfig(**d.get("p2p", {})),
                 mempool=MempoolConfig(**d.get("mempool", {})),
                 fast_sync=FastSyncConfig(**d.get("fast_sync", {})),
+                statesync=StateSyncConfig(**d.get("statesync", {})),
                 consensus=ConsensusConfig(**d.get("consensus", {})),
                 device=DeviceConfig(**d.get("device", {})),
                 tx_index=TxIndexConfig(**d.get("tx_index", {})),

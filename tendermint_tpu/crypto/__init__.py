@@ -133,10 +133,24 @@ def pubkey_from_type_and_bytes(type_name: str, raw: bytes) -> PubKey:
     return from_bytes(raw)
 
 
-# Register the standard key types on import.
-from tendermint_tpu.crypto import ed25519 as _ed  # noqa: E402
-from tendermint_tpu.crypto import secp256k1 as _secp  # noqa: E402
-from tendermint_tpu.crypto import multisig as _multisig  # noqa: E402,F401
+# Register the standard key types on import. A host without the
+# `cryptography` package still gets the hashing + merkle + ProofOp layer
+# (pure hashlib) — the state-sync chunk/proof plumbing and its tests need
+# exactly that; anything touching actual keys raises the natural
+# ImportError at its own `from tendermint_tpu.crypto import ed25519`
+# (the p2p package-lazy-import precedent, docs/p2p_resilience.md).
+try:
+    from tendermint_tpu.crypto import ed25519 as _ed  # noqa: E402
+    from tendermint_tpu.crypto import secp256k1 as _secp  # noqa: E402
+    from tendermint_tpu.crypto import multisig as _multisig  # noqa: E402,F401
+except ImportError as _e:
+    # only the missing `cryptography` package is survivable — any other
+    # ImportError (a broken transitive import inside the key modules)
+    # must fail HERE, not at the first key decode with "unknown key type"
+    if _e.name != "cryptography" and not (_e.name or "").startswith(
+        "cryptography."
+    ):
+        raise
 
 __all__ = [
     "ADDRESS_SIZE",

@@ -177,6 +177,132 @@ def _trails_from_byte_slices(items: list[bytes]):
     return lefts + rights, root
 
 
+@dataclass
+class RangeProof:
+    """Proof that a CONTIGUOUS run of leaves [start, start+count) belongs to
+    a simple merkle tree of `total` leaves — the state-sync chunk proof
+    (docs/state_sync.md). One proof covers a whole chunk of consecutive
+    leaves instead of one SimpleProof per leaf: `aunts` are the roots of
+    the maximal subtrees that lie entirely OUTSIDE the range, listed in the
+    deterministic pre-order the verification fold consumes them.
+
+    No reference analog (the reference's state sync trusts chunk hashes
+    only and re-checks the final state hash); here every chunk is
+    independently bound to the verified header's app hash before it is
+    applied, so a corrupt chunk can never reach the application.
+    """
+
+    total: int
+    start: int
+    count: int
+    aunts: list[bytes] = field(default_factory=list)
+
+    def verify(self, root_hash: bytes, leaves: list[bytes]) -> bool:
+        """True iff `leaves` (raw leaf bytes, pre-hash) occupy
+        [start, start+count) of a tree whose root is `root_hash`."""
+        if self.count != len(leaves) or self.count <= 0:
+            return False
+        if self.start < 0 or self.start + self.count > self.total:
+            return False
+        hashes = [leaf_hash(item) for item in leaves]
+        state = {"aunt": 0, "leaf": 0, "bad": False}
+        end = self.start + self.count
+
+        def fold(lo: int, hi: int) -> bytes:
+            if state["bad"]:
+                return b""
+            if hi <= self.start or lo >= end:
+                # subtree entirely outside the range: consume one aunt
+                if state["aunt"] >= len(self.aunts):
+                    state["bad"] = True
+                    return b""
+                a = self.aunts[state["aunt"]]
+                state["aunt"] += 1
+                return a
+            if hi - lo == 1:
+                h = hashes[state["leaf"]]
+                state["leaf"] += 1
+                return h
+            k = _split_point(hi - lo)
+            left = fold(lo, lo + k)
+            right = fold(lo + k, hi)
+            return inner_hash(left, right)
+
+        computed = fold(0, self.total)
+        if state["bad"] or state["aunt"] != len(self.aunts):
+            return False  # truncated or padded aunt list
+        if state["leaf"] != self.count:
+            return False
+        return computed == root_hash
+
+    def encode(self) -> bytes:
+        from tendermint_tpu.encoding import Writer
+
+        w = Writer().u32(self.total).u32(self.start).u32(self.count)
+        w.u32(len(self.aunts))
+        for a in self.aunts:
+            w.bytes(a)
+        return w.build()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "RangeProof":
+        from tendermint_tpu.encoding import Reader
+
+        r = Reader(data)
+        total, start, count = r.u32(), r.u32(), r.u32()
+        aunts = [r.bytes() for _ in range(r.u32())]
+        r.expect_done()
+        return cls(total, start, count, aunts)
+
+
+def range_proof(
+    items: list[bytes],
+    start: int,
+    count: int,
+    subtree_cache: dict[tuple[int, int], bytes] | None = None,
+) -> RangeProof:
+    """Build the RangeProof for items[start:start+count] (the builder mirrors
+    RangeProof.verify's fold, emitting subtree roots where verify will
+    consume aunts).
+
+    `subtree_cache` memoizes (lo, hi) -> subtree root across calls. The
+    split points depend only on len(items), so proofs for every chunk of
+    one snapshot share it: pass one dict per snapshot and the whole set of
+    chunk proofs costs one tree pass (O(n) hashing) instead of re-hashing
+    the out-of-range subtrees from scratch per chunk (O(n × chunks))."""
+    total = len(items)
+    if count <= 0 or start < 0 or start + count > total:
+        raise ValueError(f"bad range [{start},{start + count}) of {total}")
+    end = start + count
+    aunts: list[bytes] = []
+
+    def subtree(lo: int, hi: int) -> bytes:
+        if subtree_cache is None:
+            return _py_hash_from_byte_slices(items[lo:hi])
+        h = subtree_cache.get((lo, hi))
+        if h is None:
+            if hi - lo == 1:
+                h = leaf_hash(items[lo])
+            else:  # hi > lo always (callers pass non-empty spans)
+                k = _split_point(hi - lo)
+                h = inner_hash(subtree(lo, lo + k), subtree(lo + k, hi))
+            subtree_cache[(lo, hi)] = h
+        return h
+
+    def walk(lo: int, hi: int) -> None:
+        if hi <= start or lo >= end:
+            aunts.append(subtree(lo, hi))
+            return
+        if hi - lo == 1:
+            return  # in-range leaf: verifier recomputes it
+        k = _split_point(hi - lo)
+        walk(lo, lo + k)
+        walk(lo + k, hi)
+
+    walk(0, total)
+    return RangeProof(total, start, count, aunts)
+
+
 # --- simple map (sorted KV hashing, reference simple_map.go) ---------------
 
 

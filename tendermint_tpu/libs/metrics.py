@@ -313,6 +313,44 @@ class EvidenceMetrics:
         )
 
 
+class StateSyncMetrics:
+    """tm_statesync_* — the snapshot bootstrap/serving plane
+    (docs/state_sync.md; fed by statesync.reactor.StateSyncReactor)."""
+
+    def __init__(self, c: Collector) -> None:
+        self.syncing = c.gauge(
+            "statesync", "syncing", "1 while a snapshot restore is in progress"
+        )
+        self.snapshots_discovered_total = c.counter(
+            "statesync", "snapshots_discovered_total",
+            "Distinct snapshots advertised by peers",
+        )
+        self.chunks_applied_total = c.counter(
+            "statesync", "chunks_applied_total",
+            "Snapshot chunks proof-checked and applied",
+        )
+        self.chunk_failures_total = c.counter(
+            "statesync", "chunk_failures_total",
+            "Chunk fetches that failed (bad proof, timeout, peer missing)",
+        )
+        self.chunks_served_total = c.counter(
+            "statesync", "chunks_served_total",
+            "Snapshot chunks served to bootstrapping peers",
+        )
+        self.lite_headers_verified_total = c.counter(
+            "statesync", "lite_headers_verified_total",
+            "Headers verified by light-client bisection during bootstrap",
+        )
+        self.restore_seconds = c.gauge(
+            "statesync", "restore_seconds",
+            "Wall time of the last completed snapshot restore",
+        )
+        self.bootstrap_height = c.gauge(
+            "statesync", "bootstrap_height",
+            "Height the node bootstrapped from a snapshot (0 = replayed)",
+        )
+
+
 class MempoolMetrics:
     def __init__(self, c: Collector) -> None:
         self.size = c.gauge("mempool", "size", "Unconfirmed txs")

@@ -446,3 +446,16 @@ class DynamicVerifier:
             self._verify_and_save(mid_trusted, source_fc)
             return
         self.trusted.save_full_commit(source_fc)
+
+
+# Lazy re-exports from lite.proxy (it imports this module, so a top-level
+# import here would be circular): `lite.verified_abci_query` is the
+# read-replica serving plane's verified query entry point
+# (docs/state_sync.md), `verify_abci_query_response` its pure,
+# crypto-free proof check.
+def __getattr__(name: str):
+    if name in ("verified_abci_query", "verify_abci_query_response", "LiteProxy"):
+        from tendermint_tpu.lite import proxy as _proxy
+
+        return getattr(_proxy, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

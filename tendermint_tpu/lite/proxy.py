@@ -94,6 +94,57 @@ def _valset_from_json(vals: list) -> ValidatorSet:
     )
 
 
+def verify_abci_query_response(
+    response: dict, app_hash: bytes, expected_key: bytes | None = None
+) -> None:
+    """Check one JSON-RPC `abci_query` response dict (hex-encoded key/
+    value/proof_ops, the rpc/core.py shape) against a VERIFIED app hash.
+    Pure hashlib — runs on hosts without the `cryptography` package, so
+    the proof plumbing is testable everywhere. Raises LiteError unless
+    the proof ops chain (key, value) to `app_hash` — and, when
+    `expected_key` is given, unless the proven key IS the requested one
+    (a lying node must not answer a query for key A with a correctly
+    proven (key B, value B) pair)."""
+    from tendermint_tpu.crypto.merkle import ProofOp, default_proof_runtime
+
+    key = bytes.fromhex(response.get("key") or "")
+    if expected_key is not None and key != expected_key:
+        raise LiteError(
+            f"abci_query response proves key {key.hex()!r}, "
+            f"not the requested {expected_key.hex()!r}"
+        )
+    value = bytes.fromhex(response.get("value") or "")
+    ops_json = response.get("proof_ops") or []
+    if not ops_json:
+        raise LiteError("abci_query response carries no proof to verify")
+    if not value:
+        # the kvstore proves presence only; an absent key yields no value
+        # AND no usable proof — nothing verifiable to hand the caller
+        raise LiteError("abci_query response has no value to prove")
+    ops = [
+        ProofOp(
+            o.get("type", ""),
+            bytes.fromhex(o.get("key") or ""),
+            bytes.fromhex(o.get("data") or ""),
+        )
+        for o in ops_json
+    ]
+    if not default_proof_runtime().verify_value(ops, app_hash, [key], value):
+        raise LiteError(
+            "abci_query proof does not chain to the verified app hash"
+        )
+
+
+async def verified_abci_query(
+    proxy: "LiteProxy", path: str = "", data: str = "", height: int = 0
+) -> dict:
+    """Module-level spelling of LiteProxy.verified_abci_query (what
+    `lite.verified_abci_query` resolves to): query through `proxy`'s
+    backing node and accept the answer only if its merkle proof chains to
+    a bisection-verified header's app hash."""
+    return await proxy.verified_abci_query(path=path, data=data, height=height)
+
+
 class RPCProvider(Provider):
     """Light-client source over a full node's RPC (reference
     lite/client/provider.go)."""
@@ -209,6 +260,71 @@ class LiteProxy:
         )
         await self._verify_header(sh)
         return resp
+
+    async def verified_abci_query(
+        self, path: str = "", data: str = "", height: int = 0
+    ) -> dict:
+        """`abci_query` whose answer is USELESS to a lying node: the
+        response's merkle proof must chain to the app hash of a header
+        this client verified by bisection (docs/state_sync.md — the
+        serving plane's read path). Returns the raw RPC json after
+        verification; raises LiteError on a missing/broken proof, a
+        tampered value, or a stale height."""
+        resp = await self.client.call(
+            "abci_query", path=path, data=data, height=height, prove=True
+        )
+        r = resp.get("response") or {}
+        if r.get("code", 0) != 0:
+            raise LiteError(f"abci_query failed: code={r.get('code')} {r.get('log')}")
+        state_height = r.get("height", 0)
+        if height and state_height != height:
+            raise LiteError(
+                f"stale abci_query response: asked for height {height}, "
+                f"node answered from {state_height}"
+            )
+        if state_height <= 0:
+            raise LiteError("abci_query response carries no height to verify against")
+        # app state at H is committed by header(H+1).app_hash — the same
+        # anchor the state-sync chunk proofs use. An app answering at the
+        # chain head means that header lands one block LATER: wait for it
+        # (the reference proxy's GetWithProof does client.WaitForHeight)
+        # instead of failing every head-of-chain query on a live net.
+        try:
+            commit_json = await self._verified_commit_waiting(state_height + 1)
+        except LiteError:
+            raise
+        except Exception as e:  # noqa: BLE001 — RPC/shape errors are a
+            # verification failure to the caller, never a raw escape
+            raise LiteError(f"could not verify header {state_height + 1}: {e!r}")
+        app_hash = bytes.fromhex(
+            commit_json["signed_header"]["header"]["app_hash"]
+        )
+        verify_abci_query_response(
+            r, app_hash, expected_key=bytes.fromhex(data) if data else None
+        )
+        return resp
+
+    async def _verified_commit_waiting(
+        self, height: int, timeout: float = 10.0
+    ) -> dict:
+        """verified_commit, waiting (bounded) for `height` to be committed
+        first — on a live chain the header after the queried state lands
+        within a block interval; on a halted chain this raises LiteError."""
+        import asyncio
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        while True:
+            st = await self.client.call("status")
+            if st["sync_info"]["latest_block_height"] >= height:
+                break
+            if _time.monotonic() >= deadline:
+                raise LiteError(
+                    f"header {height} not committed within {timeout}s — "
+                    f"cannot verify a head-of-chain query on a halted chain"
+                )
+            await asyncio.sleep(0.25)
+        return await self.verified_commit(height)
 
     async def verified_range(self, start: int, end: int) -> list[dict]:
         """Fetch + verify the commits for consecutive heights [start, end]
@@ -368,9 +484,19 @@ async def run_lite_proxy(
         return await client.call("broadcast_tx_commit", tx=tx)
 
     async def abci_query(path: str = "", data: str = "", height: int = 0, prove: bool = True):
-        return await client.call(
-            "abci_query", path=path, data=data, height=height, prove=prove
-        )
+        # verified by default — an unproven answer from the backing node
+        # is worthless to a light client (lite/proxy/query.go
+        # GetWithProof). prove=false is an explicit opt-out for apps that
+        # cannot prove (non-provable kvstore, absent keys): the response
+        # passes through unverified, exactly what the caller asked for.
+        if not prove:
+            return await client.call(
+                "abci_query", path=path, data=data, height=height, prove=False
+            )
+        try:
+            return await proxy.verified_abci_query(path=path, data=data, height=height)
+        except LiteError as e:
+            raise RPCError(INTERNAL_ERROR, f"query verification failed: {e}")
 
     server.register_routes(
         {

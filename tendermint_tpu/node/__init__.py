@@ -207,19 +207,38 @@ class Node(BaseService):
             mempool=self.mempool,
             evidence_pool=self.evidence_pool,
             event_bus=self.event_bus,
+            block_store=self.block_store,  # ResponseCommit.retain_height pruning
             logger=log,
         )
 
         fast_sync = cfg.base.fast_sync and self._consensus_possible(state)
+        # State sync (docs/state_sync.md): only a genuinely EMPTY node
+        # bootstraps from a snapshot — a restarted node has history and
+        # falls through to fast sync. When active, the blockchain reactor
+        # waits (fast_sync=False) until the statesync reactor hands off
+        # via start_fast_sync, and consensus waits behind fast sync as
+        # usual.
+        # (a block store whose height carries no block meta holds only a
+        # statesync bootstrap anchor — the restart shape of a sync that
+        # crashed between the anchor and the state save; re-arm and let
+        # bootstrap() re-anchor it rather than wedging fast sync at 1)
+        state_sync_active = (
+            cfg.statesync.enable
+            and state.last_block_height == 0
+            and self.block_store.load_block_meta(self.block_store.height())
+            is None
+        )
         if cfg.fast_sync.version == "v1":
             from tendermint_tpu.blockchain.v1_reactor import BlockchainReactorV1
 
             self.bc_reactor = BlockchainReactorV1(
-                state, self.block_exec, self.block_store, fast_sync=fast_sync, logger=log
+                state, self.block_exec, self.block_store,
+                fast_sync=fast_sync and not state_sync_active, logger=log,
             )
         else:
             self.bc_reactor = BlockchainReactor(
-                state, self.block_exec, self.block_store, fast_sync=fast_sync, logger=log
+                state, self.block_exec, self.block_store,
+                fast_sync=fast_sync and not state_sync_active, logger=log,
             )
 
         # consensus timeline tracer (default-off; debug_consensus_trace +
@@ -267,12 +286,37 @@ class Node(BaseService):
             tracer=self.tracer,
         )
         self.consensus_reactor = ConsensusReactor(
-            self.consensus_state, fast_sync=fast_sync, logger=log
+            # a state-syncing node's consensus waits for the fast-sync
+            # handoff chain (statesync -> fast sync -> consensus) even if
+            # fast sync itself was configured off
+            self.consensus_state, fast_sync=fast_sync or state_sync_active,
+            logger=log,
         )
         self.mempool_reactor = MempoolReactor(
             self.mempool, broadcast=cfg.mempool.broadcast, logger=log
         )
         self.evidence_reactor = EvidenceReactor(self.evidence_pool, logger=log)
+        from tendermint_tpu.statesync.reactor import StateSyncReactor
+
+        # serving is always on (any peer may bootstrap from our app's
+        # snapshots); the restore side arms only on a genuinely empty node
+        # with statesync.enable. The corrupt-serving nemesis hook needs
+        # BOTH the fault-control master switch and the env var, so a stray
+        # env var on a production node is inert.
+        self.statesync_reactor = StateSyncReactor(
+            cfg.statesync,
+            self.proxy_app,
+            self.state_store,
+            self.block_store,
+            chain_id=self.genesis_doc.chain_id,
+            home=cfg.root_dir,
+            enable_sync=state_sync_active,
+            corrupt_serving=(
+                cfg.p2p.test_fault_control
+                and os.environ.get("TMTPU_STATESYNC_CORRUPT") == "1"
+            ),
+            logger=log,
+        )
 
         # 7. transport + switch + addrbook + pex
         reactors = {
@@ -280,6 +324,7 @@ class Node(BaseService):
             "BLOCKCHAIN": self.bc_reactor,
             "CONSENSUS": self.consensus_reactor,
             "EVIDENCE": self.evidence_reactor,
+            "STATESYNC": self.statesync_reactor,
         }
         self.addr_book = AddrBook(
             cfg._abs(cfg.p2p.addr_book_file), our_ids={self.node_key.id()}
@@ -397,6 +442,8 @@ class Node(BaseService):
             self.switch.metrics = self.p2p_metrics
             self.evidence_metrics = tmm.EvidenceMetrics(self.metrics)
             self.evidence_pool.metrics = self.evidence_metrics
+            self.statesync_metrics = tmm.StateSyncMetrics(self.metrics)
+            self.statesync_reactor.metrics = self.statesync_metrics
             self.evidence_pool._set_pending_gauge()  # restored pending
             for p in self.switch.peers.list():
                 p.metrics = self.p2p_metrics

@@ -14,6 +14,15 @@ from __future__ import annotations
 
 import os
 
+# The device batch paths lean on the host crypto stack throughout (serial
+# CPU fallback, breaker drain verifies, parity oracles, key handling):
+# without the `cryptography` package the ops package cannot produce
+# correct verdicts. Declare the dependency at import so it fails HERE —
+# `tendermint_tpu.crypto` itself now imports crypto-free (the hash/merkle
+# /proof layer state sync needs, docs/state_sync.md), which would
+# otherwise let an ops import "succeed" and die mid-verify.
+from tendermint_tpu.crypto import ed25519 as _host_ed25519  # noqa: F401
+
 MIN_DEVICE_BATCH = int(os.environ.get("TMTPU_MIN_DEVICE_BATCH", "8"))
 
 _min_batch_probed: int | None = None

@@ -69,14 +69,22 @@ def default_client_creator(
     if proxy_app == "persistent_kvstore" or proxy_app.startswith(
         "persistent_kvstore:"
     ):
-        # "persistent_kvstore:<dir>" — disk persistence + validator-update
-        # txs (reference abci-cli "kvstore <dir>"); the dir rides in the
-        # proxy_app string so each testnet node gets its own state file
+        # "persistent_kvstore:<dir>[:<snapshot_interval>]" — disk
+        # persistence + validator-update txs (reference abci-cli "kvstore
+        # <dir>"); the dir rides in the proxy_app string so each testnet
+        # node gets its own state file. A trailing integer segment enables
+        # state-sync snapshots every that-many commits (docs/state_sync.md).
         from tendermint_tpu.abci.examples import PersistentKVStoreApplication
 
         _, _, app_dir = proxy_app.partition(":")
+        interval = 0
+        head, _, tail = app_dir.rpartition(":")
+        if head and tail.isdigit():
+            app_dir, interval = head, int(tail)
         return LocalClientCreator(
-            PersistentKVStoreApplication(app_dir or "kvstore-data")
+            PersistentKVStoreApplication(
+                app_dir or "kvstore-data", snapshot_interval=interval
+            )
         )
     if proxy_app == "counter":
         from tendermint_tpu.abci.examples import CounterApplication
@@ -147,8 +155,38 @@ class AppConnQuery:
         return await self._client.set_option(req)
 
 
+class AppConnSnapshot:
+    """The state-sync connection facade (reference proxy/app_conn.go
+    AppConnSnapshot, v0.34): snapshot serving + restore, kept off the
+    consensus/mempool/query connections so a replica answering chunk
+    requests never contends with block execution."""
+
+    def __init__(self, client: Client) -> None:
+        self._client = client
+
+    async def list_snapshots(
+        self, req: abci.RequestListSnapshots
+    ) -> abci.ResponseListSnapshots:
+        return await self._client.list_snapshots(req)
+
+    async def offer_snapshot(
+        self, req: abci.RequestOfferSnapshot
+    ) -> abci.ResponseOfferSnapshot:
+        return await self._client.offer_snapshot(req)
+
+    async def load_snapshot_chunk(
+        self, req: abci.RequestLoadSnapshotChunk
+    ) -> abci.ResponseLoadSnapshotChunk:
+        return await self._client.load_snapshot_chunk(req)
+
+    async def apply_snapshot_chunk(
+        self, req: abci.RequestApplySnapshotChunk
+    ) -> abci.ResponseApplySnapshotChunk:
+        return await self._client.apply_snapshot_chunk(req)
+
+
 class AppConns(BaseService):
-    """Reference proxy/multi_app_conn.go:30 — starts the three clients."""
+    """Reference proxy/multi_app_conn.go:30 — starts the four clients."""
 
     def __init__(self, creator: ClientCreator) -> None:
         super().__init__("AppConns")
@@ -156,6 +194,7 @@ class AppConns(BaseService):
         self.consensus: AppConnConsensus | None = None
         self.mempool: AppConnMempool | None = None
         self.query: AppConnQuery | None = None
+        self.snapshot: AppConnSnapshot | None = None
         self._clients: list[Client] = []
 
     async def on_start(self) -> None:
@@ -163,6 +202,7 @@ class AppConns(BaseService):
             ("consensus", AppConnConsensus),
             ("mempool", AppConnMempool),
             ("query", AppConnQuery),
+            ("snapshot", AppConnSnapshot),
         ):
             client = self._creator.new_client()
             await client.start()

@@ -70,6 +70,56 @@ def _txres_from_proto(v: dict | None) -> dict:
     }
 
 
+def _query_res_to_proto(d: dict) -> dict:
+    """RPC-side abci_query response dict (hex fields, rpc/core.py shape)
+    -> protobuf field dict. Carries proof_ops intact so a gRPC read
+    replica serves the same verifiable proofs the JSON-RPC path does
+    (docs/state_sync.md serving plane)."""
+    ops = [
+        {
+            "type": o.get("type", ""),
+            "key": bytes.fromhex(o.get("key") or ""),
+            "data": bytes.fromhex(o.get("data") or ""),
+        }
+        for o in d.get("proof_ops") or []
+    ]
+    return {
+        "code": d.get("code", 0),
+        "log": d.get("log", ""),
+        "info": d.get("info", ""),
+        "index": d.get("index", 0),
+        "key": bytes.fromhex(d["key"]) if d.get("key") else b"",
+        "value": bytes.fromhex(d["value"]) if d.get("value") else b"",
+        "proof": {"ops": ops} if ops else None,
+        "height": d.get("height", 0),
+        "codespace": d.get("codespace", ""),
+    }
+
+
+def _query_res_from_proto(v: dict | None) -> dict:
+    """Protobuf field dict -> the JSON-RPC response dict shape, so
+    lite.verify_abci_query_response consumes gRPC answers unchanged."""
+    v = v or {}
+    return {
+        "code": v.get("code", 0),
+        "log": v.get("log", ""),
+        "info": v.get("info", ""),
+        "index": v.get("index", 0),
+        "key": v.get("key", b"").hex(),
+        "value": v.get("value", b"").hex(),
+        "height": v.get("height", 0),
+        "codespace": v.get("codespace", ""),
+        "proof_ops": [
+            {
+                "type": o.get("type", ""),
+                "key": o.get("key", b"").hex(),
+                "data": o.get("data", b"").hex(),
+            }
+            for o in (v.get("proof") or {}).get("ops", [])
+        ],
+    }
+
+
 def _encode_response_broadcast_tx(check: dict, deliver: dict) -> bytes:
     w = Writer()
     for res in (check, deliver):
@@ -130,6 +180,25 @@ class GRPCBroadcastServer:
                 }
             )
 
+        async def abci_query_proto(request: bytes, context) -> bytes:
+            # the read-replica serving path (docs/state_sync.md): proof_ops
+            # ride the protobuf body, so a gRPC client can hand the answer
+            # to lite.verify_abci_query_response exactly like a JSON-RPC one
+            try:
+                v = pb.REQ_QUERY.decode(request)
+            except Exception as e:  # noqa: BLE001 — malformed bytes
+                await context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"bad RequestQuery: {e}",
+                )
+            res = await self.env.abci_query(
+                path=v.get("path", ""),
+                data=(v.get("data") or b"").hex(),
+                height=v.get("height", 0),
+                prove=bool(v.get("prove", False)),
+            )
+            return pb.RESP_QUERY.encode(_query_res_to_proto(res["response"]))
+
         identity = lambda b: b  # noqa: E731 — raw-bytes (de)serializers
 
         def _h(fn):
@@ -145,7 +214,11 @@ class GRPCBroadcastServer:
                 grpc.method_handlers_generic_handler(
                     SERVICE_PROTO,
                     # Ping bodies are empty messages in both codecs
-                    {"Ping": _h(ping), "BroadcastTx": _h(broadcast_tx_proto)},
+                    {
+                        "Ping": _h(ping),
+                        "BroadcastTx": _h(broadcast_tx_proto),
+                        "ABCIQuery": _h(abci_query_proto),
+                    },
                 ),
             )
         )
@@ -174,9 +247,27 @@ class GRPCBroadcastClient:
             request_serializer=identity,
             response_deserializer=identity,
         )
+        self._abci_query = self._channel.unary_unary(
+            f"/{SERVICE_PROTO}/ABCIQuery",
+            request_serializer=identity,
+            response_deserializer=identity,
+        )
 
     async def ping(self) -> None:
         await self._ping(b"")
+
+    async def abci_query(
+        self, path: str = "", data: bytes = b"", height: int = 0, prove: bool = False
+    ) -> dict:
+        """Proof-carrying query (protobuf bodies only — the serving-plane
+        method postdates the legacy CBE surface). Returns the JSON-RPC
+        response dict shape, proof_ops included."""
+        resp = await self._abci_query(
+            pb.REQ_QUERY.encode(
+                {"data": data, "path": path, "height": height, "prove": prove}
+            )
+        )
+        return _query_res_from_proto(pb.RESP_QUERY.decode(resp))
 
     async def broadcast_tx(self, tx: bytes) -> tuple[dict, dict]:
         if self.codec == "proto":

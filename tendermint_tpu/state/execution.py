@@ -36,12 +36,14 @@ class BlockExecutor:
         mempool=None,
         evidence_pool=None,
         event_bus: EventBus | None = None,
+        block_store=None,  # enables ResponseCommit.retain_height pruning
         logger: Logger = NOP,
     ) -> None:
         self.state_store = state_store
         self.app = app_conn
         self.mempool = mempool
         self.evidence_pool = evidence_pool
+        self.block_store = block_store
         self.metrics = None  # optional StateMetrics
         self.event_bus = event_bus
         self.logger = logger
@@ -92,12 +94,29 @@ class BlockExecutor:
             state, block_id, block, abci_responses, validator_updates
         )
 
-        app_hash = await self._commit(new_state, block)
+        commit_res = await self._commit(new_state, block)
+        app_hash = commit_res.data
         fail.fail()  # crash point: after app commit, before SaveState
 
         new_state.app_hash = app_hash
         self.state_store.save(new_state)
         fail.fail()  # crash point: after SaveState
+
+        # store retention (reference v0.34 execution.go pruneBlocks): the
+        # app releases history below retain_height — a snapshot-serving
+        # replica keeps only the blocks its snapshots can be residually
+        # fast-synced from; peers learn our base from StatusResponse
+        if commit_res.retain_height > 0 and self.block_store is not None:
+            try:
+                pruned = self.block_store.prune(commit_res.retain_height)
+            except Exception as e:  # noqa: BLE001 — pruning is best-effort
+                self.logger.error("block store prune failed", err=repr(e))
+            else:
+                if pruned:
+                    RECORDER.record(
+                        "state", "prune", retain_height=commit_res.retain_height,
+                        pruned=pruned,
+                    )
 
         if self.evidence_pool is not None:
             self.evidence_pool.update(block, new_state)
@@ -221,9 +240,10 @@ class BlockExecutor:
             app_hash=b"",  # filled after app commit
         )
 
-    async def _commit(self, state: State, block: Block) -> bytes:
+    async def _commit(self, state: State, block: Block):
         """Reference execution.go:188-232 Commit: mempool locked around app
-        commit + mempool update."""
+        commit + mempool update. Returns the full ResponseCommit — the
+        caller needs both the app hash and retain_height."""
         if self.mempool is not None:
             await self.mempool.lock()
         try:
@@ -236,7 +256,7 @@ class BlockExecutor:
                     block.data.txs,
                     pre_check=None,
                 )
-            return res.data
+            return res
         finally:
             if self.mempool is not None:
                 self.mempool.unlock()

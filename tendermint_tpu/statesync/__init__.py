@@ -1,0 +1,193 @@
+"""statesync — snapshot bootstrap + serving over p2p (docs/state_sync.md).
+
+Reference parity: statesync/ (v0.34) — SnapshotChannel (0x60) carries
+snapshot discovery (SnapshotsRequest / one SnapshotsResponse per
+advertised snapshot), ChunkChannel (0x61) carries chunk fetches. The
+reactor (reactor.py) serves both sides: every node answers requests from
+its app's `ListSnapshots`/`LoadSnapshotChunk`; a node with
+`statesync.enable` and an empty store additionally runs the Syncer —
+discover, light-client-verify the target header (LITE-priority device
+batches through `lite.DynamicVerifier` bisection), fetch chunks in
+parallel, apply through `OfferSnapshot`/`ApplySnapshotChunk`, bootstrap
+the block/state stores, and hand off to fast sync for the residual
+heights.
+
+Beyond the reference: chunks here carry `crypto/merkle.RangeProof`s to
+the verified app hash, so the app rejects a forged chunk BEFORE applying
+it, and the reactor feeds the offending peer to the behaviour plane
+(`bad_chunk`, docs/p2p_resilience.md) and re-fetches elsewhere — the
+reference only detects corruption at the final state-hash check.
+
+This module is import-light and crypto-free (messages + pool only); the
+reactor pulls in the p2p/lite stacks.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tendermint_tpu.abci.types import Snapshot
+from tendermint_tpu.encoding import DecodeError, Reader, Writer
+
+SNAPSHOT_CHANNEL = 0x60
+CHUNK_CHANNEL = 0x61
+
+# at most this many snapshots advertised per SnapshotsRequest (reference
+# statesync/reactor.go recentSnapshots)
+RECENT_SNAPSHOTS = 10
+
+
+# --------------------------------------------------------------- messages
+
+
+@dataclass
+class SnapshotsRequestMessage:
+    pass
+
+
+@dataclass
+class SnapshotsResponseMessage:
+    """One advertised snapshot (the reference sends one message per
+    snapshot so a torn peer never truncates the whole listing)."""
+
+    snapshot: Snapshot
+
+
+@dataclass
+class ChunkRequestMessage:
+    height: int
+    format: int
+    index: int
+
+
+@dataclass
+class ChunkResponseMessage:
+    height: int
+    format: int
+    index: int
+    missing: bool = False  # peer no longer has this snapshot/chunk
+    chunk: bytes = b""
+
+
+def encode_ss_message(msg) -> bytes:
+    w = Writer()
+    if isinstance(msg, SnapshotsRequestMessage):
+        w.u8(1)
+    elif isinstance(msg, SnapshotsResponseMessage):
+        w.u8(2)
+        msg.snapshot.encode_into(w)
+    elif isinstance(msg, ChunkRequestMessage):
+        w.u8(3).u64(msg.height).u32(msg.format).u32(msg.index)
+    elif isinstance(msg, ChunkResponseMessage):
+        w.u8(4).u64(msg.height).u32(msg.format).u32(msg.index)
+        w.bool(msg.missing).bytes(msg.chunk)
+    else:
+        raise TypeError(f"unknown statesync message {type(msg).__name__}")
+    return w.build()
+
+
+def decode_ss_message(data: bytes):
+    r = Reader(data)
+    tag = r.u8()
+    if tag == 1:
+        msg = SnapshotsRequestMessage()
+    elif tag == 2:
+        msg = SnapshotsResponseMessage(Snapshot.read(r))
+    elif tag == 3:
+        msg = ChunkRequestMessage(r.u64(), r.u32(), r.u32())
+    elif tag == 4:
+        msg = ChunkResponseMessage(r.u64(), r.u32(), r.u32(), r.bool(), r.bytes())
+    else:
+        raise DecodeError(f"unknown statesync message tag {tag}")
+    r.expect_done()
+    return msg
+
+
+# ------------------------------------------------------------------- pool
+
+
+@dataclass
+class _Offer:
+    snapshot: Snapshot
+    peers: set = field(default_factory=set)  # peer ids advertising it
+
+
+class SnapshotPool:
+    """Discovered snapshots keyed by identity, with the set of peers
+    advertising each (reference statesync/snapshots.go snapshotPool).
+    Selection prefers height (newest state), then peer count (fetch
+    parallelism + refetch headroom)."""
+
+    # advertisement caps: a peer serves at most RECENT_SNAPSHOTS, so a
+    # single id minting more than a few times that is flooding, not
+    # serving; the global cap bounds pool memory/rank work no matter how
+    # many ids an attacker cycles through (reference statesync/snapshots.go
+    # bounds the serving side only — the receiving pool must bound itself)
+    MAX_PER_PEER = 4 * RECENT_SNAPSHOTS
+    MAX_SNAPSHOTS = 128
+
+    def __init__(self) -> None:
+        self._offers: dict[tuple, _Offer] = {}
+        self._rejected: set[tuple] = set()  # formats/contents the app refused
+
+    def add(self, peer_id: str, snapshot: Snapshot) -> bool:
+        """Record an advertisement; returns True if the snapshot is new.
+        New keys past MAX_SNAPSHOTS, or a peer advertising more than
+        MAX_PER_PEER distinct snapshots, are dropped."""
+        key = snapshot.key()
+        if key in self._rejected:
+            return False
+        offer = self._offers.get(key)
+        if offer is None:
+            if len(self._offers) >= self.MAX_SNAPSHOTS:
+                return False
+            if (
+                sum(1 for o in self._offers.values() if peer_id in o.peers)
+                >= self.MAX_PER_PEER
+            ):
+                return False
+            self._offers[key] = _Offer(snapshot, {peer_id})
+            return True
+        offer.peers.add(peer_id)
+        return False
+
+    def reject(self, snapshot: Snapshot) -> None:
+        """The app refused this snapshot (format/content): never offer it
+        again, even if more peers advertise it."""
+        key = snapshot.key()
+        self._rejected.add(key)
+        self._offers.pop(key, None)
+
+    def remove_peer(self, peer_id: str) -> None:
+        for key in list(self._offers):
+            offer = self._offers[key]
+            offer.peers.discard(peer_id)
+            if not offer.peers:
+                del self._offers[key]
+
+    def peers_of(self, snapshot: Snapshot) -> list[str]:
+        offer = self._offers.get(snapshot.key())
+        return sorted(offer.peers) if offer else []
+
+    def best(self) -> Snapshot | None:
+        if not self._offers:
+            return None
+        offer = max(
+            self._offers.values(),
+            key=lambda o: (o.snapshot.height, len(o.peers)),
+        )
+        return offer.snapshot
+
+    def ranked(self) -> "list[Snapshot]":
+        """All candidates, best first — the Syncer walks this when the
+        leading snapshot turns out unfetchable."""
+        return [
+            o.snapshot
+            for o in sorted(
+                self._offers.values(),
+                key=lambda o: (o.snapshot.height, len(o.peers)),
+                reverse=True,
+            )
+        ]
+
+    def __len__(self) -> int:
+        return len(self._offers)

@@ -1,0 +1,203 @@
+"""Light-client trust bootstrap for state sync (docs/state_sync.md).
+
+Reference parity: statesync/stateprovider.go — a light-client-backed
+provider that yields the VERIFIED app hash, commit, and consensus state
+for the snapshot height. Header verification rides `lite.DynamicVerifier`
+bisection through `LiteProxy` (validator-set skipping over thousands of
+heights in a handful of LITE-priority device batches); everything else a
+bootstrapped State needs — validator sets, consensus params, results
+hash — is fetched over RPC and checked against hashes the verified
+headers commit to, so nothing unverified enters the state store.
+
+Height convention: a snapshot of app state at height H is proven by
+`header(H+1).app_hash` (the header AFTER the block whose commit produced
+that state), exactly the reference's `stateProvider.AppHash(height)`.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tendermint_tpu.libs.log import NOP, Logger
+from tendermint_tpu.lite import LiteError
+from tendermint_tpu.lite.proxy import (
+    LiteProxy,
+    _commit_from_json,
+    _header_from_json,
+    _valset_from_json,
+)
+from tendermint_tpu.rpc.client import HTTPClient
+from tendermint_tpu.state import State
+from tendermint_tpu.types.block import Version
+from tendermint_tpu.types.params import (
+    BlockParams,
+    ConsensusParams,
+    EvidenceParams,
+    ValidatorParams,
+)
+
+
+@dataclass
+class TrustedSnapshotState:
+    """Everything the stores need to anchor at snapshot height H, all of
+    it chained to light-client-verified headers."""
+
+    state: "State"  # post-block-H State (validators, params, app hash)
+    commit: object  # types.block.Commit FOR height H (store bootstrap)
+    app_hash: bytes  # header(H+1).app_hash — the chunk-proof root
+    headers_verified: int = 0  # bisection cost, for observability
+
+
+def _params_from_json(d: dict) -> ConsensusParams:
+    return ConsensusParams(
+        BlockParams(
+            d["block"]["max_bytes"], d["block"]["max_gas"], d["block"]["time_iota_ms"]
+        ),
+        EvidenceParams(d["evidence"]["max_age"]),
+        ValidatorParams(tuple(d["validator"]["pub_key_types"])),
+    )
+
+
+class LightBootstrap:
+    """One light client over the configured RPC servers; `state_for(H)`
+    is the single entry point the Syncer calls per candidate snapshot."""
+
+    def __init__(
+        self,
+        chain_id: str,
+        rpc_servers: "list[tuple[str, int]]",
+        home: str,
+        trust_height: int = 0,
+        trust_hash: str = "",
+        logger: Logger = NOP,
+    ) -> None:
+        if not rpc_servers:
+            raise LiteError("state sync requires at least one statesync.rpc_server")
+        self.chain_id = chain_id
+        self.servers = rpc_servers
+        self.home = home
+        self.trust_height = trust_height
+        self.trust_hash = trust_hash
+        self.log = logger
+        self.proxy: LiteProxy | None = None
+
+    async def start(self) -> None:
+        """Connect to the first reachable RPC server and anchor trust
+        (pinned trust_height/hash, or TOFU at the head for lab nets)."""
+        last_err: Exception | None = None
+        for host, port in self.servers:
+            client = HTTPClient(host, port)
+            try:
+                proxy = LiteProxy(self.chain_id, client, self.home, self.log)
+                await proxy.init_trust(self.trust_height or None)
+                if self.trust_hash:
+                    fc = proxy.trusted.latest_full_commit(self.chain_id, 1, 1 << 62)
+                    got = fc.signed_header.header.hash().hex()
+                    if got != self.trust_hash.lower():
+                        raise LiteError(
+                            f"trust anchor mismatch at height {fc.height}: "
+                            f"header {got} != configured trust_hash"
+                        )
+                self.proxy = proxy
+                return
+            except Exception as e:  # noqa: BLE001 — try the next server
+                last_err = e
+                await client.close()
+                self.log.info(
+                    "statesync rpc server unusable", server=f"{host}:{port}",
+                    err=repr(e),
+                )
+        raise LiteError(f"no usable statesync rpc server: {last_err!r}")
+
+    async def close(self) -> None:
+        if self.proxy is not None:
+            await self.proxy.client.close()
+
+    async def latest_height(self) -> int:
+        st = await self.proxy.client.call("status")
+        return st["sync_info"]["latest_block_height"]
+
+    async def _verified_header_commit(self, height: int):
+        resp = await self.proxy.verified_commit(height)
+        sh = resp["signed_header"]
+        return _header_from_json(sh["header"]), _commit_from_json(sh["commit"])
+
+    async def _checked_valset(self, height: int, want_hash: bytes):
+        # the validators route caps per_page at 100: paginate, or any set
+        # past 100 validators can never hash to the header's commitment
+        # and state sync silently degrades to full replay on exactly the
+        # large networks it targets
+        vals_json: list = []
+        page = 1
+        while True:
+            resp = await self.proxy.client.call(
+                "validators", height=height, per_page=100, page=page
+            )
+            vals_json.extend(resp["validators"])
+            if not resp["validators"] or len(vals_json) >= resp.get(
+                "total", len(vals_json)
+            ):
+                break
+            page += 1
+        vals = _valset_from_json(vals_json)
+        if vals.hash() != want_hash:
+            raise LiteError(
+                f"validator set at height {height} does not hash to the "
+                f"verified header's commitment"
+            )
+        return vals
+
+    async def state_for(self, height: int) -> TrustedSnapshotState:
+        """Build the verified post-block-`height` State. Raises LiteError
+        if any fetched artifact fails to chain to a verified header."""
+        proxy = self.proxy
+        if proxy is None:
+            raise LiteError("LightBootstrap not started")
+        before = proxy.verifier.headers_verified
+        # two verified headers pin everything: H (time, block id, valset
+        # hash) and H+1 (app hash, results hash, params hash, next valsets)
+        header_h, commit_h = await self._verified_header_commit(height)
+        header_n, _ = await self._verified_header_commit(height + 1)
+        if header_n.last_block_id.hash != header_h.hash():
+            raise LiteError(
+                f"verified headers {height}/{height + 1} do not chain"
+            )
+        validators = await self._checked_valset(
+            height + 1, header_n.validators_hash
+        )
+        next_validators = await self._checked_valset(
+            height + 2, header_n.next_validators_hash
+        )
+        last_validators = await self._checked_valset(
+            height, header_h.validators_hash
+        )
+        params_json = (
+            await proxy.client.call("consensus_params", height=height + 1)
+        )["consensus_params"]
+        params = _params_from_json(params_json)
+        if params.hash() != header_n.consensus_hash:
+            raise LiteError(
+                f"consensus params at height {height + 1} do not hash to the "
+                f"verified header's commitment"
+            )
+        state = State(
+            chain_id=self.chain_id,
+            version=Version(),
+            last_block_height=height,
+            last_block_total_tx=header_h.total_txs,
+            last_block_id=commit_h.block_id,
+            last_block_time=header_h.time,
+            validators=validators,
+            next_validators=next_validators,
+            last_validators=last_validators,
+            last_height_validators_changed=height + 1,
+            consensus_params=params,
+            last_height_consensus_params_changed=height + 1,
+            last_results_hash=header_n.last_results_hash,
+            app_hash=header_n.app_hash,
+        )
+        return TrustedSnapshotState(
+            state=state,
+            commit=commit_h,
+            app_hash=header_n.app_hash,
+            headers_verified=proxy.verifier.headers_verified - before,
+        )

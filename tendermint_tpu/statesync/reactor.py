@@ -1,0 +1,562 @@
+"""State-sync reactor — SnapshotChannel 0x60 + ChunkChannel 0x61.
+
+Reference parity: statesync/reactor.go + syncer.go (v0.34). Every node
+serves its app's snapshots (`ListSnapshots`/`LoadSnapshotChunk` over the
+snapshot AppConn); a node armed with `statesync.enable` and an empty
+block store additionally runs the Syncer on boot:
+
+  discover  — broadcast SnapshotsRequest, collect advertisements for
+              `discovery_time`;
+  verify    — light-client bisection (statesync/light.py) pins the
+              snapshot's app hash to a verified header, LITE-priority
+              device batches doing the validator-set skipping;
+  fetch     — chunks in parallel (`chunk_fetchers`) from the advertising
+              peers, per-request timeouts; failures feed the behaviour
+              plane (`bad_chunk` / `chunk_timeout`) and the chunk is
+              re-fetched from another peer;
+  apply     — strictly in order through `OfferSnapshot` /
+              `ApplySnapshotChunk`; the app proof-checks every chunk
+              against the verified app hash before touching state;
+  bootstrap — verified State into the state store, verified commit into
+              the empty block store;
+  hand off  — BlockchainReactor.start_fast_sync covers the residual
+              heights (≤ snapshot_interval behind the head), then
+              consensus takes over as usual.
+
+If no snapshot can be restored (no peers serving, every candidate
+rejected, light verification impossible) the node falls back to plain
+fast sync from genesis — state sync is an accelerator, never a liveness
+dependency.
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.behaviour import PeerBehaviour
+from tendermint_tpu.libs.log import NOP, Logger
+from tendermint_tpu.libs.recorder import RECORDER
+from tendermint_tpu.lite import LiteError
+from tendermint_tpu.p2p.base_reactor import BaseReactor, ChannelDescriptor
+from tendermint_tpu.rpc.jsonrpc import RPCError
+from tendermint_tpu.statesync import (
+    CHUNK_CHANNEL,
+    RECENT_SNAPSHOTS,
+    SNAPSHOT_CHANNEL,
+    ChunkRequestMessage,
+    ChunkResponseMessage,
+    SnapshotPool,
+    SnapshotsRequestMessage,
+    SnapshotsResponseMessage,
+    decode_ss_message,
+    encode_ss_message,
+)
+from tendermint_tpu.statesync.light import LightBootstrap
+
+# discovery rounds before giving up and falling back to fast sync
+DISCOVERY_ROUNDS = 10
+# fetch attempts per chunk before the whole snapshot is abandoned
+MAX_CHUNK_ATTEMPTS = 8
+
+
+class StateSyncAbort(Exception):
+    """The app returned ABORT — unrecoverable, do not retry."""
+
+
+class RestoreRetryable(Exception):
+    """Restore failed for a reason that does not implicate the snapshot
+    itself (fetch exhaustion, app RETRY_SNAPSHOT): the snapshot stays in
+    the pool and may be tried again in a later discovery round."""
+
+
+class StateSyncReactor(BaseReactor):
+    def __init__(
+        self,
+        config,  # config.StateSyncConfig
+        proxy_app,  # proxy.AppConns (snapshot + query conns)
+        state_store,
+        block_store,
+        chain_id: str,
+        home: str,  # light-client trust store directory
+        enable_sync: bool = False,
+        corrupt_serving: bool = False,  # nemesis hook, fault-gated by the node
+        logger: Logger = NOP,
+    ) -> None:
+        super().__init__("StateSyncReactor")
+        self.config = config
+        self.proxy_app = proxy_app
+        self.state_store = state_store
+        self.block_store = block_store
+        self.chain_id = chain_id
+        self.home = home
+        self.enable_sync = enable_sync
+        self.corrupt_serving = corrupt_serving
+        self.log = logger
+        self.metrics = None  # optional StateSyncMetrics, set by the node
+        self.pool = SnapshotPool()
+        self.syncing = False
+        self.synced_height = 0  # snapshot height restored, 0 = none
+        # in-flight chunk requests: (height, format, index) -> (peer_id, Future)
+        self._pending: dict[tuple, tuple[str, asyncio.Future]] = {}
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        return [
+            ChannelDescriptor(
+                SNAPSHOT_CHANNEL, priority=5,
+                # an advertisement carries the full chunk-hash manifest in
+                # Snapshot.metadata (~36 B/chunk for the kvstore): 64 KiB
+                # would cap discoverable snapshots at ~1800 chunks (~115 MB
+                # of state) and MConnection DROPS the advertising peer on
+                # overflow — 4 MiB covers ~7 GB of state at default chunks
+                send_queue_capacity=10, recv_message_capacity=1 << 22,
+            ),
+            ChannelDescriptor(
+                CHUNK_CHANNEL, priority=3,
+                send_queue_capacity=4, recv_message_capacity=1 << 24,
+            ),
+        ]
+
+    async def on_start(self) -> None:
+        if self.enable_sync:
+            self.syncing = True
+            if self.metrics is not None:
+                self.metrics.syncing.set(1)
+            self.spawn(self._sync_routine(), "statesync-syncer")
+
+    async def on_stop(self) -> None:
+        for _, fut in self._pending.values():
+            if not fut.done():
+                fut.cancel()
+        self._pending.clear()
+
+    # -- p2p plumbing -------------------------------------------------
+
+    async def add_peer(self, peer) -> None:
+        if self.syncing:
+            await peer.send(
+                SNAPSHOT_CHANNEL, encode_ss_message(SnapshotsRequestMessage())
+            )
+
+    async def remove_peer(self, peer, reason) -> None:
+        self.pool.remove_peer(peer.id)
+        for key, (pid, fut) in list(self._pending.items()):
+            if pid == peer.id and not fut.done():
+                fut.set_exception(ConnectionError(f"peer {pid} left"))
+
+    async def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
+        try:
+            msg = decode_ss_message(msg_bytes)
+        except Exception as e:
+            self.log.error("bad statesync message", peer=peer.id, err=repr(e))
+            await self.report(
+                peer, PeerBehaviour.bad_message(peer.id, f"statesync: {e!r}")
+            )
+            return
+
+        if isinstance(msg, SnapshotsRequestMessage):
+            await self._serve_snapshots(peer)
+        elif isinstance(msg, SnapshotsResponseMessage):
+            if self.syncing:
+                if self.pool.add(peer.id, msg.snapshot):
+                    RECORDER.record(
+                        "statesync", "discovered", peer=peer.id,
+                        height=msg.snapshot.height, format=msg.snapshot.format,
+                        chunks=msg.snapshot.chunks,
+                    )
+                    if self.metrics is not None:
+                        self.metrics.snapshots_discovered_total.inc()
+        elif isinstance(msg, ChunkRequestMessage):
+            await self._serve_chunk(peer, msg)
+        elif isinstance(msg, ChunkResponseMessage):
+            self._deliver_chunk(peer, msg)
+
+    # -- serving side -------------------------------------------------
+
+    async def _serve_snapshots(self, peer) -> None:
+        conn = self.proxy_app.snapshot
+        if conn is None:
+            return
+        res = await conn.list_snapshots(abci.RequestListSnapshots())
+        for snap in res.snapshots[:RECENT_SNAPSHOTS]:
+            await peer.send(
+                SNAPSHOT_CHANNEL,
+                encode_ss_message(SnapshotsResponseMessage(snap)),
+            )
+
+    async def _serve_chunk(self, peer, msg: ChunkRequestMessage) -> None:
+        conn = self.proxy_app.snapshot
+        if conn is None:
+            return
+        res = await conn.load_snapshot_chunk(
+            abci.RequestLoadSnapshotChunk(
+                height=msg.height, format=msg.format, chunk=msg.index
+            )
+        )
+        chunk = res.chunk
+        if chunk and self.corrupt_serving:
+            # nemesis hook (gated on p2p.test_fault_control at wiring):
+            # serve provably-corrupt bytes so the fetcher's proof check +
+            # behaviour scoring + refetch path is exercised end to end
+            chunk = chunk[:-1] + bytes([chunk[-1] ^ 0xFF])
+            RECORDER.record(
+                "statesync", "corrupt_serve", peer=peer.id, index=msg.index,
+            )
+        if self.metrics is not None and chunk:
+            self.metrics.chunks_served_total.inc()
+        await peer.send(
+            CHUNK_CHANNEL,
+            encode_ss_message(
+                ChunkResponseMessage(
+                    msg.height, msg.format, msg.index,
+                    missing=not chunk, chunk=chunk,
+                )
+            ),
+        )
+
+    # -- restore side -------------------------------------------------
+
+    def _deliver_chunk(self, peer, msg: ChunkResponseMessage) -> None:
+        key = (msg.height, msg.format, msg.index)
+        pending = self._pending.get(key)
+        if pending is None or pending[0] != peer.id:
+            return  # unsolicited or stale — a timed-out request's late echo
+        _, fut = pending
+        if fut.done():
+            return
+        if msg.missing:
+            fut.set_exception(LookupError(f"peer {peer.id} missing chunk"))
+        else:
+            fut.set_result(msg.chunk)
+
+    async def _request_chunk(self, peer, snapshot, index: int) -> bytes:
+        """One chunk from one peer, bounded by chunk_request_timeout."""
+        key = (snapshot.height, snapshot.format, index)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[key] = (peer.id, fut)
+        try:
+            await peer.send(
+                CHUNK_CHANNEL,
+                encode_ss_message(
+                    ChunkRequestMessage(snapshot.height, snapshot.format, index)
+                ),
+            )
+            async with asyncio.timeout(self.config.chunk_request_timeout):
+                return await fut
+        finally:
+            if self._pending.get(key) is not None and self._pending[key][1] is fut:
+                del self._pending[key]
+
+    async def _sync_routine(self) -> None:
+        try:
+            restored = await self._run_sync()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — sync is an accelerator:
+            # any failure degrades to plain fast sync, never to a dead node
+            self.log.error("state sync failed", err=repr(e))
+            RECORDER.record("statesync", "sync_failed", err=repr(e))
+            restored = False
+        self.syncing = False
+        if self.metrics is not None:
+            self.metrics.syncing.set(0)
+        if not restored:
+            RECORDER.record("statesync", "fallback_fastsync")
+            state = self.state_store.load()
+            await self._handoff(state)
+
+    async def _handoff(self, state) -> None:
+        bc = self.switch.reactor("BLOCKCHAIN") if self.switch else None
+        if bc is None:
+            self.log.error("no blockchain reactor to hand off to")
+            return
+        RECORDER.record(
+            "statesync", "handoff", height=self.block_store.height(),
+        )
+        await bc.start_fast_sync(state)
+
+    async def _run_sync(self) -> bool:
+        """The Syncer. Returns True when a snapshot was restored and the
+        stores are bootstrapped (handoff included)."""
+        cfg = self.config
+        servers = []
+        for s in cfg.rpc_servers.split(","):
+            s = s.strip()
+            if s:
+                host, _, port = s.rpartition(":")
+                servers.append((host or "127.0.0.1", int(port)))
+        light = LightBootstrap(
+            self.chain_id, servers, os.path.join(self.home, "statesync"),
+            trust_height=cfg.trust_height, trust_hash=cfg.trust_hash,
+            logger=self.log,
+        )
+        await light.start()
+        try:
+            return await self._sync_with(light)
+        finally:
+            await light.close()
+
+    async def _sync_with(self, light: LightBootstrap) -> bool:
+        cfg = self.config
+        tried: set[tuple] = set()
+        for round_ in range(DISCOVERY_ROUNDS):
+            if self.switch is not None:
+                await self.switch.broadcast(
+                    SNAPSHOT_CHANNEL, encode_ss_message(SnapshotsRequestMessage())
+                )
+            RECORDER.record("statesync", "discover", round=round_)
+            # collect for the WHOLE window — returning at the first
+            # advertisement would commit to the fastest peer's (possibly
+            # older) snapshot while newer offers and extra advertisers
+            # (fetch parallelism, refetch headroom) are still in flight
+            await asyncio.sleep(cfg.discovery_time)
+            # snapshot at the verifiable horizon: proving app hash H needs
+            # header H+1 AND the H+2 validator set (state_for checks the
+            # bootstrapped next_validators against header(H+1)'s
+            # commitment, and the RPC serves valsets only up to the
+            # store height) — so the head and head-1 are not yet provable
+            try:
+                horizon = await light.latest_height() - 2
+            except Exception as e:  # noqa: BLE001 — rpc blip: next round
+                self.log.info("statesync status fetch failed", err=repr(e))
+                continue
+            for snapshot in self.pool.ranked():
+                key = snapshot.key()
+                if key in tried or snapshot.height > horizon:
+                    continue
+                tried.add(key)
+                try:
+                    if await self._restore_snapshot(light, snapshot):
+                        return True
+                except StateSyncAbort:
+                    raise
+                except (
+                    LiteError,
+                    asyncio.TimeoutError,
+                    RestoreRetryable,
+                    OSError,  # rpc transport: ConnectionError and kin
+                    RPCError,  # rpc-level refusals (height not served yet)
+                ) as e:
+                    # transient w.r.t. the snapshot (RPC blip, slow peers,
+                    # header not yet verifiable): leave it in the pool and
+                    # let a later round retry it — permanent verdicts
+                    # (app reject, proof-failed content) were already
+                    # pool.reject()ed inside the restore path, and
+                    # ranked() never yields rejected keys again
+                    self.log.error(
+                        "snapshot restore failed", height=snapshot.height,
+                        err=repr(e),
+                    )
+                    tried.discard(key)
+        self.log.info("state sync found no usable snapshot; falling back")
+        return False
+
+    async def _restore_snapshot(self, light, snapshot) -> bool:
+        t0 = time.monotonic()
+        trusted = await light.state_for(snapshot.height)
+        RECORDER.record(
+            "statesync", "header_verified", height=snapshot.height,
+            lite_headers=trusted.headers_verified,
+        )
+        if self.metrics is not None:
+            self.metrics.lite_headers_verified_total.inc(
+                max(1, trusted.headers_verified)
+            )
+        conn = self.proxy_app.snapshot
+        offer = await conn.offer_snapshot(
+            abci.RequestOfferSnapshot(snapshot=snapshot, app_hash=trusted.app_hash)
+        )
+        RECORDER.record(
+            "statesync", "offer", height=snapshot.height, result=offer.result,
+        )
+        if offer.result == abci.OFFER_SNAPSHOT_ABORT:
+            raise StateSyncAbort("app aborted snapshot restore")
+        if offer.result != abci.OFFER_SNAPSHOT_ACCEPT:
+            self.pool.reject(snapshot)
+            return False
+        verdict = await self._fetch_and_apply(snapshot)
+        if verdict == "reject":  # the app condemned the snapshot's content
+            self.pool.reject(snapshot)
+            return False
+        if verdict != "applied":  # fetch exhaustion / app RETRY_SNAPSHOT
+            raise RestoreRetryable(f"chunk fetch/apply gave up: {verdict}")
+        # verify the app landed where the verified header says it must
+        # (reference syncer.go verifyApp)
+        info = await self.proxy_app.query.info(abci.RequestInfo())
+        if (
+            info.last_block_height != snapshot.height
+            or info.last_block_app_hash != trusted.app_hash
+        ):
+            # every chunk proof-checked yet the app landed wrong: the
+            # snapshot (or the app) is broken — never offer it again
+            self.pool.reject(snapshot)
+            raise LiteError(
+                f"app restore mismatch: app at {info.last_block_height}/"
+                f"{info.last_block_app_hash.hex()}, verified "
+                f"{snapshot.height}/{trusted.app_hash.hex()}"
+            )
+        # bootstrap the stores: the verified commit anchors fast sync at
+        # height+1, the verified State makes the node resume there. Anchor
+        # FIRST: a crash between the two leaves state at 0 plus a meta-less
+        # anchor, which the node recognizes at boot and re-arms state sync
+        # (bootstrap re-anchors over it); the reverse order would leave
+        # state at H over an empty store with no self-heal path.
+        self.block_store.bootstrap(snapshot.height, trusted.commit)
+        self.state_store.save(trusted.state)
+        self.state_store.save_validators(
+            snapshot.height, trusted.state.last_validators
+        )
+        self.synced_height = snapshot.height
+        restore_s = time.monotonic() - t0
+        if self.metrics is not None:
+            self.metrics.restore_seconds.set(round(restore_s, 3))
+            self.metrics.bootstrap_height.set(snapshot.height)
+        RECORDER.record(
+            "statesync", "restore_complete", height=snapshot.height,
+            chunks=snapshot.chunks, seconds=round(restore_s, 3),
+        )
+        self.log.info(
+            "state sync restored snapshot", height=snapshot.height,
+            chunks=snapshot.chunks, seconds=round(restore_s, 3),
+        )
+        await self._handoff(trusted.state)
+        return True
+
+    async def _fetch_and_apply(self, snapshot) -> str:
+        """Parallel fetch, strictly-ordered apply. Returns "applied" on
+        success, "reject" when the app condemned the snapshot's content
+        (REJECT_SNAPSHOT — permanent), or "retry" when it could not be
+        completed this attempt (peers exhausted, app RETRY_SNAPSHOT)."""
+        fetched: dict[int, tuple[bytes, str]] = {}  # index -> (chunk, sender)
+        attempts: dict[int, int] = {}
+        banned: set[str] = set()  # peers rejected for THIS snapshot
+        tried_by: dict[int, set] = {}
+        want = asyncio.Event()  # apply loop wake-up
+        queue: asyncio.Queue[int] = asyncio.Queue()
+        for i in range(snapshot.chunks):
+            queue.put_nowait(i)
+        failed = False
+
+        def peers_alive() -> list:
+            out = []
+            for pid in self.pool.peers_of(snapshot):
+                if pid in banned or self.switch is None:
+                    continue
+                p = self.switch.peers.get(pid)
+                if p is not None:
+                    out.append(p)
+            return out
+
+        async def fetcher() -> None:
+            nonlocal failed
+            while not failed:
+                index = await queue.get()
+                if attempts.get(index, 0) >= MAX_CHUNK_ATTEMPTS:
+                    failed = True
+                    want.set()
+                    return
+                attempts[index] = attempts.get(index, 0) + 1
+                peers = peers_alive()
+                fresh = [
+                    p for p in peers if p.id not in tried_by.get(index, set())
+                ]
+                if not peers:
+                    failed = True
+                    want.set()
+                    return
+                if not fresh:  # every peer tried: start over
+                    tried_by[index] = set()
+                    fresh = peers
+                peer = fresh[index % len(fresh)]
+                tried_by.setdefault(index, set()).add(peer.id)
+                try:
+                    chunk = await self._request_chunk(peer, snapshot, index)
+                except (asyncio.TimeoutError, LookupError, ConnectionError) as e:
+                    kind = (
+                        "chunk_timeout"
+                        if isinstance(e, asyncio.TimeoutError)
+                        else "chunk_unavailable"
+                    )
+                    RECORDER.record(
+                        "statesync", kind, peer=peer.id, index=index,
+                    )
+                    if self.metrics is not None:
+                        self.metrics.chunk_failures_total.inc()
+                    if isinstance(e, asyncio.TimeoutError):
+                        await self.report(
+                            peer,
+                            PeerBehaviour.chunk_timeout(
+                                peer.id, f"chunk {index} of {snapshot.height}"
+                            ),
+                        )
+                    queue.put_nowait(index)  # retry elsewhere
+                    continue
+                fetched[index] = (chunk, peer.id)
+                want.set()
+
+        fetchers = [
+            self.spawn(fetcher(), f"statesync-fetch-{i}")
+            for i in range(max(1, self.config.chunk_fetchers))
+        ]
+        try:
+            applied = 0
+            while applied < snapshot.chunks and not failed:
+                if applied not in fetched:
+                    want.clear()
+                    if applied not in fetched and not failed:
+                        await want.wait()
+                    continue
+                chunk, sender = fetched.pop(applied)
+                res = await self.proxy_app.snapshot.apply_snapshot_chunk(
+                    abci.RequestApplySnapshotChunk(
+                        index=applied, chunk=chunk, sender=sender
+                    )
+                )
+                if res.result == abci.APPLY_CHUNK_ACCEPT:
+                    applied += 1
+                    if self.metrics is not None:
+                        self.metrics.chunks_applied_total.inc()
+                    RECORDER.record(
+                        "statesync", "chunk_applied", index=applied - 1,
+                        peer=sender,
+                    )
+                    continue
+                if res.result == abci.APPLY_CHUNK_ABORT:
+                    raise StateSyncAbort("app aborted during chunk apply")
+                if res.result == abci.APPLY_CHUNK_REJECT_SNAPSHOT:
+                    return "reject"
+                if res.result == abci.APPLY_CHUNK_RETRY_SNAPSHOT:
+                    return "retry"
+                # RETRY: the proof/hash check failed — score every sender
+                # the app fingered, drop them from this snapshot's rotation,
+                # and re-queue the chunks it wants refetched
+                for pid in res.reject_senders:
+                    banned.add(pid)
+                    RECORDER.record(
+                        "statesync", "bad_chunk", peer=pid, index=applied,
+                        height=snapshot.height,
+                    )
+                    if self.metrics is not None:
+                        self.metrics.chunk_failures_total.inc()
+                    peer = self.switch.peers.get(pid) if self.switch else None
+                    await self.report(
+                        peer,
+                        PeerBehaviour.bad_chunk(
+                            pid,
+                            f"chunk {applied} of snapshot {snapshot.height} "
+                            f"failed its proof check",
+                        ),
+                    )
+                # the current chunk is always re-queued: it was popped from
+                # `fetched` above, and an app listing only OTHER chunks in
+                # refetch_chunks would otherwise strand it — no fetcher
+                # produces it again and the apply loop waits forever
+                refetch = set(res.refetch_chunks or ()) | {applied}
+                for idx in refetch:
+                    fetched.pop(idx, None)
+                    queue.put_nowait(idx)
+            return "retry" if failed else "applied"
+        finally:
+            for t in fetchers:
+                t.cancel()

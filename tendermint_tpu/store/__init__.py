@@ -113,3 +113,50 @@ class BlockStore:
     def load_seen_commit(self, height: int) -> Commit | None:
         raw = self._db.get(b"BS:seen:" + _h(height))
         return Commit.decode(raw) if raw else None
+
+    # -- state-sync support (reference store.go SaveSeenCommit + the v0.34
+    # statesync bootstrap, and PruneBlocks for ResponseCommit.retain_height)
+
+    def bootstrap(self, height: int, commit: Commit) -> None:
+        """Anchor an EMPTY store at a snapshot height: the node holds the
+        verified commit FOR `height` but no blocks at or below it — fast
+        sync resumes at height+1 and save_block's contiguity check passes.
+        Refused on a store with real history (bootstrap is a fresh-replica
+        operation; overwriting live blocks would corrupt them). A store
+        holding only a previous bootstrap anchor — no block meta at its
+        height — may be re-anchored: that is the restart-after-crash shape
+        of a state sync that died between bootstrap and the state save."""
+        old = self.height()
+        if old != 0:
+            if self._db.get(b"BS:meta:" + _h(old)) is not None:
+                raise ValueError(
+                    f"cannot bootstrap at {height}: store already at {old}"
+                )
+            self._db.delete(b"BS:commit:" + _h(old))
+            self._db.delete(b"BS:seen:" + _h(old))
+        self._db.set(b"BS:commit:" + _h(height), commit.encode())
+        self._db.set(b"BS:seen:" + _h(height), commit.encode())
+        self._db.set(b"BS:base", _h(height + 1))
+        self._db.set_sync(b"BS:height", _h(height))
+
+    def prune(self, retain_height: int) -> int:
+        """Delete blocks below `retain_height` (meta, parts, commits, seen),
+        advancing base — the store-side half of ResponseCommit.retain_height.
+        The current height is never pruned. Returns the number of heights
+        removed."""
+        base = self.base()
+        top = min(retain_height, self.height())
+        if base == 0 or top <= base:
+            return 0
+        pruned = 0
+        for h in range(base, top):
+            meta = self.load_block_meta(h)
+            if meta is not None:
+                for i in range(meta.block_id.parts.total):
+                    self._db.delete(b"BS:part:" + _h(h) + struct.pack(">I", i))
+                self._db.delete(b"BS:meta:" + _h(h))
+            self._db.delete(b"BS:commit:" + _h(h))
+            self._db.delete(b"BS:seen:" + _h(h))
+            pruned += 1
+        self._db.set_sync(b"BS:base", _h(top))
+        return pruned

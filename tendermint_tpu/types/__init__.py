@@ -1,21 +1,50 @@
 """Domain model (reference types/): blocks, votes, validators, evidence,
 events — built batch-first: every multi-signature verification path routes
-through crypto.batch.BatchVerifier so the TPU backend sees whole batches."""
-from tendermint_tpu.types.part_set import Part, PartSet, PartSetHeader  # noqa: F401
-from tendermint_tpu.types.vote import BlockID, Proposal, Vote, VoteType  # noqa: F401
-from tendermint_tpu.types.block import (  # noqa: F401
-    Block,
-    Commit,
-    Data,
-    Header,
-    SignedHeader,
-    make_block,
-)
-from tendermint_tpu.types.validator import Validator  # noqa: F401
-from tendermint_tpu.types.validator_set import ValidatorSet  # noqa: F401
-from tendermint_tpu.types.vote_set import VoteSet  # noqa: F401
-from tendermint_tpu.types.evidence import DuplicateVoteEvidence, Evidence  # noqa: F401
-from tendermint_tpu.types.priv_validator import MockPV, PrivValidator  # noqa: F401
-from tendermint_tpu.types.params import ConsensusParams  # noqa: F401
-from tendermint_tpu.types.genesis import GenesisDoc  # noqa: F401
-from tendermint_tpu.types.tx import Tx, tx_hash, txs_hash  # noqa: F401
+through crypto.batch.BatchVerifier so the TPU backend sees whole batches.
+
+Lazy exports (PEP 562, the p2p/__init__ precedent): `from tendermint_tpu.types
+import Block` still works, but importing a crypto-free submodule (params,
+part_set, tx) no longer drags the `cryptography`-backed key stack in via
+priv_validator — proto converters and the state-sync proof layer must stay
+importable on hosts without the crypto package.
+"""
+from __future__ import annotations
+
+import importlib
+
+_EXPORTS = {
+    "Part": "tendermint_tpu.types.part_set",
+    "PartSet": "tendermint_tpu.types.part_set",
+    "PartSetHeader": "tendermint_tpu.types.part_set",
+    "BlockID": "tendermint_tpu.types.vote",
+    "Proposal": "tendermint_tpu.types.vote",
+    "Vote": "tendermint_tpu.types.vote",
+    "VoteType": "tendermint_tpu.types.vote",
+    "Block": "tendermint_tpu.types.block",
+    "Commit": "tendermint_tpu.types.block",
+    "Data": "tendermint_tpu.types.block",
+    "Header": "tendermint_tpu.types.block",
+    "SignedHeader": "tendermint_tpu.types.block",
+    "make_block": "tendermint_tpu.types.block",
+    "Validator": "tendermint_tpu.types.validator",
+    "ValidatorSet": "tendermint_tpu.types.validator_set",
+    "VoteSet": "tendermint_tpu.types.vote_set",
+    "DuplicateVoteEvidence": "tendermint_tpu.types.evidence",
+    "Evidence": "tendermint_tpu.types.evidence",
+    "MockPV": "tendermint_tpu.types.priv_validator",
+    "PrivValidator": "tendermint_tpu.types.priv_validator",
+    "ConsensusParams": "tendermint_tpu.types.params",
+    "GenesisDoc": "tendermint_tpu.types.genesis",
+    "Tx": "tendermint_tpu.types.tx",
+    "tx_hash": "tendermint_tpu.types.tx",
+    "txs_hash": "tendermint_tpu.types.tx",
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    return getattr(importlib.import_module(mod), name)

@@ -143,6 +143,7 @@ class TestScheduleV2:
 
 class TestV1Live:
     def test_v1_syncs_from_producer(self, tmp_path):
+        pytest.importorskip("cryptography", reason="needs the host crypto stack")
         from test_blockchain import CHAIN_ID, SyncNode
         from tendermint_tpu.blockchain.v1_reactor import BlockchainReactorV1
         from tendermint_tpu.p2p.test_util import (

@@ -116,6 +116,7 @@ class TestConsensusWireFuzz:
 
 class TestFuzzedNet:
     def test_consensus_progresses_over_lossy_connections(self, tmp_path):
+        pytest.importorskip("cryptography", reason="needs the host crypto stack")
         """4 validators over connections that randomly drop/delay 10% of
         messages must still make (slower) progress — gossip is
         retry-structured, so losses only cost latency.

@@ -100,3 +100,12 @@ def test_nemesis_combined():
     """ROADMAP item 5 residue: partition + flapping breaker + flood at
     once; chain keeps committing and health stays truthful."""
     nemesis.run(["nemesis_combined"], n=4)
+
+
+@pytest.mark.slow
+def test_nemesis_statesync():
+    """ISSUE 12 acceptance: an empty node snapshot-boots against a live
+    net (lite-bisection-verified header, proof-checked chunks), rejects
+    and re-fetches a corrupt peer's chunks with behaviour scoring, and
+    converges app-hash-identical without ever holding genesis history."""
+    nemesis.run(["nemesis_statesync"], n=4)

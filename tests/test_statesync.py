@@ -1,0 +1,975 @@
+"""State-sync tier tests (docs/state_sync.md) — snapshot bootstrap +
+verified proof serving. Everything here is crypto-free (hashlib merkle
+only): the proof plumbing must be testable on hosts without the
+`cryptography` package, per the ISSUE-12 acceptance criteria."""
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci import proto as pb
+from tendermint_tpu.abci.examples.kvstore import (
+    KVStoreApplication,
+    PersistentKVStoreApplication,
+    SNAPSHOT_FORMAT,
+    decode_chunk,
+    decode_chunk_hashes,
+    encode_chunk_hashes,
+    snapshot_hash,
+)
+from tendermint_tpu.crypto import merkle, sum_sha256
+from tendermint_tpu.encoding import DecodeError, Writer
+from tendermint_tpu.libs.db import MemDB
+from tendermint_tpu.lite.proxy import verify_abci_query_response
+from tendermint_tpu.lite import LiteError
+from tendermint_tpu.statesync import (
+    ChunkRequestMessage,
+    ChunkResponseMessage,
+    SnapshotPool,
+    SnapshotsRequestMessage,
+    SnapshotsResponseMessage,
+    decode_ss_message,
+    encode_ss_message,
+)
+from tendermint_tpu.store import BlockStore
+from tendermint_tpu.types.block import Commit
+from tendermint_tpu.types.part_set import PartSetHeader
+from tendermint_tpu.types.vote import BlockID
+
+
+def _commit(h: bytes = b"\x11" * 32) -> Commit:
+    return Commit(BlockID(h, PartSetHeader(1, b"\x22" * 32)), [])
+
+
+# --------------------------------------------------------------------------
+# crypto/merkle: ProofOp / SimpleValueOp (ISSUE-12 satellite)
+
+
+class TestSimpleValueOp:
+    def _proved_map(self, kvs: dict[str, bytes]):
+        keys = sorted(kvs)
+        items = [
+            Writer().str(k).bytes(sum_sha256(kvs[k])).build() for k in keys
+        ]
+        root, proofs = merkle.proofs_from_byte_slices(items)
+        return keys, root, proofs
+
+    def test_roundtrip_and_verify(self):
+        kvs = {f"k{i}": f"v{i}".encode() for i in range(7)}
+        keys, root, proofs = self._proved_map(kvs)
+        for i, k in enumerate(keys):
+            op = merkle.SimpleValueOp(k.encode(), proofs[i]).proof_op()
+            # encode/decode round-trip through the ProofOp wire shape
+            assert op.type == merkle.SimpleValueOp.TYPE
+            decoded = merkle.SimpleValueOp.decode(op)
+            assert decoded.proof.total == len(keys)
+            assert decoded.proof.index == i
+            rt = merkle.default_proof_runtime()
+            assert rt.verify_value([op], root, [k.encode()], kvs[k])
+
+    def test_tampered_aunt_rejected(self):
+        kvs = {f"k{i}": f"v{i}".encode() for i in range(5)}
+        keys, root, proofs = self._proved_map(kvs)
+        p = proofs[2]
+        bad = merkle.SimpleProof(
+            p.total, p.index, p.leaf_hash,
+            [p.aunts[0][:-1] + bytes([p.aunts[0][-1] ^ 1])] + p.aunts[1:],
+        )
+        op = merkle.SimpleValueOp(b"k2", bad).proof_op()
+        rt = merkle.default_proof_runtime()
+        assert not rt.verify_value([op], root, [b"k2"], kvs["k2"])
+
+    def test_wrong_key_rejected(self):
+        kvs = {"a": b"1", "b": b"2", "c": b"3"}
+        keys, root, proofs = self._proved_map(kvs)
+        op = merkle.SimpleValueOp(b"a", proofs[0]).proof_op()
+        rt = merkle.default_proof_runtime()
+        # keypath says "b" but the op proves "a"
+        assert not rt.verify_value([op], root, [b"b"], b"1")
+        # right key, wrong value
+        assert not rt.verify_value([op], root, [b"a"], b"2")
+
+    def test_unknown_op_type_rejected(self):
+        rt = merkle.default_proof_runtime()
+        bogus = merkle.ProofOp("no-such-op", b"k", b"data")
+        assert not rt.verify_value([bogus], b"\x00" * 32, [b"k"], b"v")
+
+    def test_single_leaf_tree(self):
+        kvs = {"only": b"value"}
+        keys, root, proofs = self._proved_map(kvs)
+        op = merkle.SimpleValueOp(b"only", proofs[0]).proof_op()
+        rt = merkle.default_proof_runtime()
+        assert rt.verify_value([op], root, [b"only"], b"value")
+        assert not rt.verify_value([op], root, [b"only"], b"other")
+
+    def test_empty_tree_has_no_proofs(self):
+        root, proofs = merkle.proofs_from_byte_slices([])
+        assert proofs == []
+        assert root == merkle._hash(b"")
+
+    def test_proof_decode_garbage(self):
+        with pytest.raises(Exception):
+            merkle.SimpleProof.decode(b"\xff\xff")
+
+
+# --------------------------------------------------------------------------
+# crypto/merkle: RangeProof (the chunk proof)
+
+
+class TestRangeProof:
+    def test_partition_covers_tree(self):
+        items = [f"item-{i}".encode() for i in range(13)]
+        root = merkle.hash_from_byte_slices(items)
+        for start, count in ((0, 4), (4, 4), (8, 5), (0, 13), (12, 1)):
+            proof = merkle.range_proof(items, start, count)
+            assert proof.verify(root, items[start:start + count]), (start, count)
+
+    def test_encode_decode_roundtrip(self):
+        items = [bytes([i]) for i in range(9)]
+        proof = merkle.range_proof(items, 2, 5)
+        again = merkle.RangeProof.decode(proof.encode())
+        assert again == proof
+        assert again.verify(merkle.hash_from_byte_slices(items), items[2:7])
+
+    def test_single_and_full(self):
+        items = [b"solo"]
+        root = merkle.hash_from_byte_slices(items)
+        proof = merkle.range_proof(items, 0, 1)
+        assert proof.aunts == []
+        assert proof.verify(root, items)
+
+    def test_tampered_leaf_rejected(self):
+        items = [f"x{i}".encode() for i in range(8)]
+        root = merkle.hash_from_byte_slices(items)
+        proof = merkle.range_proof(items, 2, 3)
+        forged = list(items[2:5])
+        forged[1] = b"FORGED"
+        assert not proof.verify(root, forged)
+
+    def test_tampered_aunt_rejected(self):
+        items = [f"x{i}".encode() for i in range(8)]
+        root = merkle.hash_from_byte_slices(items)
+        proof = merkle.range_proof(items, 2, 3)
+        proof.aunts[0] = bytes(32)
+        assert not proof.verify(root, items[2:5])
+
+    def test_wrong_position_rejected(self):
+        items = [f"x{i}".encode() for i in range(8)]
+        root = merkle.hash_from_byte_slices(items)
+        proof = merkle.range_proof(items, 2, 3)
+        # right leaves, shifted window claim
+        shifted = merkle.RangeProof(proof.total, 3, 3, list(proof.aunts))
+        assert not shifted.verify(root, items[2:5])
+
+    def test_truncated_or_padded_aunts_rejected(self):
+        items = [f"x{i}".encode() for i in range(8)]
+        root = merkle.hash_from_byte_slices(items)
+        proof = merkle.range_proof(items, 2, 3)
+        truncated = merkle.RangeProof(proof.total, 2, 3, proof.aunts[:-1])
+        assert not truncated.verify(root, items[2:5])
+        padded = merkle.RangeProof(proof.total, 2, 3, proof.aunts + [bytes(32)])
+        assert not padded.verify(root, items[2:5])
+
+    def test_subtree_cache_parity(self):
+        """A shared cache (one per snapshot in _take_snapshot) must emit
+        byte-identical proofs to the uncached builder for every chunk."""
+        items = [f"kv-{i}".encode() for i in range(37)]
+        root = merkle.hash_from_byte_slices(items)
+        cache: dict = {}
+        for start, count in ((0, 10), (10, 10), (20, 10), (30, 7), (5, 1)):
+            cached = merkle.range_proof(items, start, count, subtree_cache=cache)
+            assert cached == merkle.range_proof(items, start, count)
+            assert cached.verify(root, items[start:start + count])
+
+    def test_bad_ranges(self):
+        items = [b"a", b"b"]
+        with pytest.raises(ValueError):
+            merkle.range_proof(items, 0, 0)
+        with pytest.raises(ValueError):
+            merkle.range_proof(items, 1, 2)
+        assert not merkle.RangeProof(2, 0, 2, []).verify(b"", [b"a"])
+
+
+# --------------------------------------------------------------------------
+# statesync message codec + snapshot pool
+
+
+class TestStateSyncMessages:
+    def test_roundtrip_all(self):
+        snap = abci.Snapshot(
+            height=40, format=1, chunks=3, hash=b"\xaa" * 32, metadata=b"meta"
+        )
+        for msg in (
+            SnapshotsRequestMessage(),
+            SnapshotsResponseMessage(snap),
+            ChunkRequestMessage(40, 1, 2),
+            ChunkResponseMessage(40, 1, 2, missing=False, chunk=b"\x01\x02"),
+            ChunkResponseMessage(40, 1, 2, missing=True),
+        ):
+            again = decode_ss_message(encode_ss_message(msg))
+            assert again == msg
+
+    def test_unknown_tag(self):
+        with pytest.raises(DecodeError):
+            decode_ss_message(b"\x99")
+
+    def test_abci_proto_roundtrip(self):
+        """The four snapshot methods survive the protobuf oneof codec
+        (gRPC/socket parity, ISSUE-12 satellite)."""
+        snap = abci.Snapshot(5, 1, 2, b"\xbb" * 32, b"m")
+        msgs = [
+            abci.RequestListSnapshots(),
+            abci.RequestOfferSnapshot(snapshot=snap, app_hash=b"\xcc" * 32),
+            abci.RequestLoadSnapshotChunk(height=5, format=1, chunk=1),
+            abci.RequestApplySnapshotChunk(index=1, chunk=b"data", sender="p1"),
+        ]
+        for req in msgs:
+            assert pb.decode_request(pb.encode_request(req)) == req
+        resps = [
+            abci.ResponseListSnapshots(snapshots=[snap]),
+            abci.ResponseOfferSnapshot(result=abci.OFFER_SNAPSHOT_ACCEPT),
+            abci.ResponseLoadSnapshotChunk(chunk=b"chunk"),
+            abci.ResponseApplySnapshotChunk(
+                result=abci.APPLY_CHUNK_RETRY,
+                refetch_chunks=[0, 2],
+                reject_senders=["bad-peer"],
+            ),
+            abci.ResponseCommit(data=b"\x01" * 32, retain_height=17),
+        ]
+        for resp in resps:
+            assert pb.decode_response(pb.encode_response(resp)) == resp
+
+    def test_abci_cbe_roundtrip(self):
+        snap = abci.Snapshot(5, 1, 2, b"\xbb" * 32, b"m")
+        msgs = [
+            abci.RequestOfferSnapshot(snapshot=snap, app_hash=b"\xcc" * 32),
+            abci.RequestApplySnapshotChunk(index=1, chunk=b"data", sender="p1"),
+        ]
+        for req in msgs:
+            assert abci.decode_request(abci.encode_request(req)) == req
+        resps = [
+            abci.ResponseListSnapshots(snapshots=[snap, snap]),
+            abci.ResponseApplySnapshotChunk(
+                result=abci.APPLY_CHUNK_RETRY,
+                refetch_chunks=[0, 2],
+                reject_senders=["bad-peer"],
+            ),
+        ]
+        for resp in resps:
+            assert abci.decode_response(abci.encode_response(resp)) == resp
+
+
+class TestSnapshotPool:
+    def _snap(self, h: int) -> abci.Snapshot:
+        return abci.Snapshot(h, 1, 1, bytes([h]) * 32, b"")
+
+    def test_add_dedup_best(self):
+        pool = SnapshotPool()
+        assert pool.add("p1", self._snap(10))
+        assert not pool.add("p2", self._snap(10))  # same snapshot, new peer
+        assert pool.add("p1", self._snap(20))
+        assert pool.best().height == 20
+        assert pool.peers_of(self._snap(10)) == ["p1", "p2"]
+        assert [s.height for s in pool.ranked()] == [20, 10]
+
+    def test_reject_is_sticky(self):
+        pool = SnapshotPool()
+        pool.add("p1", self._snap(10))
+        pool.reject(self._snap(10))
+        assert not pool.add("p2", self._snap(10))
+        assert pool.best() is None
+
+    def test_remove_peer_drops_orphans(self):
+        pool = SnapshotPool()
+        pool.add("p1", self._snap(10))
+        pool.add("p2", self._snap(10))
+        pool.remove_peer("p1")
+        assert pool.peers_of(self._snap(10)) == ["p2"]
+        pool.remove_peer("p2")
+        assert len(pool) == 0
+
+    def test_advertisement_caps(self):
+        """One peer minting snapshots is bounded per-peer; any number of
+        peers is bounded globally — but existing offers always accept new
+        advertisers (that is refetch headroom, not growth)."""
+        pool = SnapshotPool()
+        for h in range(1, SnapshotPool.MAX_PER_PEER + 1):
+            assert pool.add("flood", self._snap(h))
+        assert not pool.add("flood", self._snap(SnapshotPool.MAX_PER_PEER + 1))
+        assert len(pool) == SnapshotPool.MAX_PER_PEER
+        # a different peer may still offer new snapshots and join old ones
+        assert pool.add("honest", self._snap(SnapshotPool.MAX_PER_PEER + 1))
+        assert not pool.add("honest", self._snap(1))
+        assert "honest" in pool.peers_of(self._snap(1))
+        # fill to the global cap with one-offer peers
+        h = SnapshotPool.MAX_PER_PEER + 2
+        while len(pool) < SnapshotPool.MAX_SNAPSHOTS:
+            assert pool.add(f"p{h}", self._snap(h))
+            h += 1
+        assert not pool.add("late", self._snap(h))
+        # joining an existing offer still works at the cap
+        assert not pool.add("late", self._snap(1))
+        assert "late" in pool.peers_of(self._snap(1))
+
+
+# --------------------------------------------------------------------------
+# kvstore snapshots: take / serve / restore / reject corruption
+
+
+def _grow(app: KVStoreApplication, height: int, n_keys: int, tag: str) -> None:
+    for i in range(n_keys):
+        app.deliver_tx(abci.RequestDeliverTx(tx=f"{tag}{i}=val{i}".encode()))
+    app.end_block(abci.RequestEndBlock(height=height))
+    app.commit()
+
+
+class TestKVStoreSnapshots:
+    def _server(self, tmp_path, interval: int = 2) -> PersistentKVStoreApplication:
+        app = PersistentKVStoreApplication(
+            str(tmp_path / "server"), snapshot_interval=interval
+        )
+        for h in range(1, 5):
+            _grow(app, h, 8, f"h{h}-")
+        return app
+
+    def test_snapshot_taken_at_interval(self, tmp_path):
+        app = self._server(tmp_path)
+        res = app.list_snapshots(abci.RequestListSnapshots())
+        heights = [s.height for s in res.snapshots]
+        assert heights == [4, 2]  # newest first, keep=2
+        snap = res.snapshots[0]
+        assert snap.format == SNAPSHOT_FORMAT
+        hashes = decode_chunk_hashes(snap.metadata)
+        assert len(hashes) == snap.chunks
+        assert snapshot_hash(hashes) == snap.hash
+
+    def test_chunks_are_content_addressed_and_proved(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TMTPU_SNAPSHOT_CHUNK_BYTES", "64")  # force many chunks
+        app = self._server(tmp_path)
+        snap = app.list_snapshots(abci.RequestListSnapshots()).snapshots[0]
+        assert snap.chunks > 1
+        hashes = decode_chunk_hashes(snap.metadata)
+        covered = 0
+        for i in range(snap.chunks):
+            chunk = app.load_snapshot_chunk(
+                abci.RequestLoadSnapshotChunk(height=snap.height, format=1, chunk=i)
+            ).chunk
+            assert sum_sha256(chunk) == hashes[i]
+            start, pairs, proof = decode_chunk(chunk)
+            assert start == covered
+            leaves = [
+                Writer().str(k).bytes(sum_sha256(v)).build() for k, v in pairs
+            ]
+            assert proof.verify(app.app_hash, leaves)
+            covered += len(pairs)
+        assert covered == len(app.state)
+
+    def test_load_chunk_out_of_range(self, tmp_path):
+        app = self._server(tmp_path)
+        snap = app.list_snapshots(abci.RequestListSnapshots()).snapshots[0]
+        assert app.load_snapshot_chunk(
+            abci.RequestLoadSnapshotChunk(height=snap.height, format=1, chunk=99)
+        ).chunk == b""
+        assert app.load_snapshot_chunk(
+            abci.RequestLoadSnapshotChunk(height=777, format=1, chunk=0)
+        ).chunk == b""
+
+    def _offer(self, replica, snap, app_hash):
+        return replica.offer_snapshot(
+            abci.RequestOfferSnapshot(snapshot=snap, app_hash=app_hash)
+        )
+
+    def test_restore_end_to_end(self, tmp_path):
+        server = self._server(tmp_path)
+        snap = server.list_snapshots(abci.RequestListSnapshots()).snapshots[0]
+        replica = PersistentKVStoreApplication(str(tmp_path / "replica"))
+        offer = self._offer(replica, snap, server.app_hash)
+        assert offer.result == abci.OFFER_SNAPSHOT_ACCEPT
+        for i in range(snap.chunks):
+            chunk = server.load_snapshot_chunk(
+                abci.RequestLoadSnapshotChunk(height=snap.height, format=1, chunk=i)
+            ).chunk
+            res = replica.apply_snapshot_chunk(
+                abci.RequestApplySnapshotChunk(index=i, chunk=chunk, sender="srv")
+            )
+            assert res.result == abci.APPLY_CHUNK_ACCEPT
+        assert replica.app_hash == server.app_hash
+        assert replica.height == snap.height
+        assert replica.state == server.state
+        # restored state is durable: a reload sees it
+        again = PersistentKVStoreApplication(str(tmp_path / "replica"))
+        assert again.app_hash == server.app_hash
+
+    def test_corrupt_chunk_never_applies(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TMTPU_SNAPSHOT_CHUNK_BYTES", "64")
+        server = self._server(tmp_path)
+        snap = server.list_snapshots(abci.RequestListSnapshots()).snapshots[0]
+        replica = PersistentKVStoreApplication(str(tmp_path / "replica"))
+        assert self._offer(replica, snap, server.app_hash).result == abci.OFFER_SNAPSHOT_ACCEPT
+        good = server.load_snapshot_chunk(
+            abci.RequestLoadSnapshotChunk(height=snap.height, format=1, chunk=0)
+        ).chunk
+        corrupt = good[:-1] + bytes([good[-1] ^ 0xFF])
+        res = replica.apply_snapshot_chunk(
+            abci.RequestApplySnapshotChunk(index=0, chunk=corrupt, sender="evil")
+        )
+        assert res.result == abci.APPLY_CHUNK_RETRY
+        assert res.refetch_chunks == [0]
+        assert res.reject_senders == ["evil"]
+        assert replica.state == {}  # nothing applied
+        # the honest refetch then applies cleanly
+        res = replica.apply_snapshot_chunk(
+            abci.RequestApplySnapshotChunk(index=0, chunk=good, sender="srv")
+        )
+        assert res.result == abci.APPLY_CHUNK_ACCEPT
+
+    def test_forged_pairs_with_valid_encoding_rejected(self, tmp_path):
+        """A chunk that decodes fine but whose pairs don't match the
+        verified app hash must be rejected by the RangeProof, even if the
+        forger recomputes the chunk's content hash."""
+        server = self._server(tmp_path)
+        snap = server.list_snapshots(abci.RequestListSnapshots()).snapshots[0]
+        good = server.load_snapshot_chunk(
+            abci.RequestLoadSnapshotChunk(height=snap.height, format=1, chunk=0)
+        ).chunk
+        start, pairs, proof = decode_chunk(good)
+        pairs[0] = (pairs[0][0], b"FORGED-VALUE")
+        from tendermint_tpu.abci.examples.kvstore import encode_chunk
+
+        forged = encode_chunk(start, pairs, proof)
+        hashes = decode_chunk_hashes(snap.metadata)
+        hashes[0] = sum_sha256(forged)  # forger controls metadata too...
+        forged_snap = abci.Snapshot(
+            snap.height, snap.format, snap.chunks,
+            snapshot_hash(hashes), encode_chunk_hashes(hashes),
+        )
+        replica = PersistentKVStoreApplication(str(tmp_path / "replica"))
+        # ...but NOT the light-client-verified app hash the offer pins
+        assert self._offer(replica, forged_snap, server.app_hash).result \
+            == abci.OFFER_SNAPSHOT_ACCEPT
+        res = replica.apply_snapshot_chunk(
+            abci.RequestApplySnapshotChunk(index=0, chunk=forged, sender="evil")
+        )
+        assert res.result == abci.APPLY_CHUNK_RETRY
+        assert res.reject_senders == ["evil"]
+
+    def test_offer_rejects_bad_manifest(self, tmp_path):
+        replica = PersistentKVStoreApplication(str(tmp_path / "replica"))
+        snap = abci.Snapshot(4, 99, 1, b"\x01" * 32, b"")
+        assert self._offer(replica, snap, b"\x02" * 32).result \
+            == abci.OFFER_SNAPSHOT_REJECT_FORMAT
+        # metadata that doesn't hash to snapshot.hash
+        snap = abci.Snapshot(4, SNAPSHOT_FORMAT, 1, b"\x01" * 32,
+                             encode_chunk_hashes([b"\x03" * 32]))
+        assert self._offer(replica, snap, b"\x02" * 32).result \
+            == abci.OFFER_SNAPSHOT_REJECT
+
+    def test_out_of_order_chunk_asks_for_the_right_one(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TMTPU_SNAPSHOT_CHUNK_BYTES", "64")
+        server = self._server(tmp_path)
+        snap = server.list_snapshots(abci.RequestListSnapshots()).snapshots[0]
+        replica = PersistentKVStoreApplication(str(tmp_path / "replica"))
+        self._offer(replica, snap, server.app_hash)
+        later = server.load_snapshot_chunk(
+            abci.RequestLoadSnapshotChunk(height=snap.height, format=1, chunk=1)
+        ).chunk
+        res = replica.apply_snapshot_chunk(
+            abci.RequestApplySnapshotChunk(index=1, chunk=later, sender="srv")
+        )
+        assert res.result == abci.APPLY_CHUNK_RETRY
+        assert res.refetch_chunks == [0]
+
+    def test_retain_height_follows_oldest_snapshot(self, tmp_path):
+        app = self._server(tmp_path)
+        assert app.retain_height() == 2  # oldest kept snapshot (keep=2: 2,4)
+        resp = app.commit()
+        assert resp.retain_height == 2
+        replica = PersistentKVStoreApplication(str(tmp_path / "replica"))
+        assert replica.commit().retain_height == 0  # no snapshots configured
+
+
+# --------------------------------------------------------------------------
+# store: bootstrap + retention
+
+
+class TestBlockStoreBootstrapPrune:
+    def test_bootstrap_anchors_empty_store(self):
+        bs = BlockStore(MemDB())
+        commit = _commit()
+        bs.bootstrap(50, commit)
+        assert bs.height() == 50
+        assert bs.base() == 51  # no blocks at or below the anchor
+        assert bs.load_block_commit(50) is not None
+        assert bs.load_seen_commit(50) is not None
+
+    def test_bootstrap_reanchors_anchor_only_store(self):
+        # the restart-after-crash shape: a sync that died between the
+        # anchor and the state save leaves a meta-less anchor, which a
+        # re-armed state sync must be able to re-anchor (reactor docs)
+        bs = BlockStore(MemDB())
+        bs.bootstrap(50, _commit())
+        bs.bootstrap(60, _commit())
+        assert bs.height() == 60
+        assert bs.base() == 61
+        assert bs.load_block_commit(60) is not None
+        # the stale anchor's keys are gone
+        assert bs.load_block_commit(50) is None
+        assert bs.load_seen_commit(50) is None
+
+    def test_bootstrap_refuses_real_history(self):
+        db = MemDB()
+        bs = BlockStore(db)
+        bs.bootstrap(50, _commit())
+        # a real block meta at the store height = live history
+        db.set(b"BS:meta:" + (50).to_bytes(8, "big"), b"\x01")
+        with pytest.raises(ValueError):
+            bs.bootstrap(60, _commit())
+
+    def test_prune_advances_base(self):
+        db = MemDB()
+        bs = BlockStore(db)
+        # fabricate commits/seen at heights 1..10 the way bootstrap does,
+        # then walk the store up so prune has a range to delete
+        for h in range(1, 11):
+            db.set(b"BS:commit:" + h.to_bytes(8, "big"), _commit().encode())
+            db.set(b"BS:seen:" + h.to_bytes(8, "big"), _commit().encode())
+        db.set(b"BS:base", (1).to_bytes(8, "big"))
+        db.set(b"BS:height", (10).to_bytes(8, "big"))
+        pruned = bs.prune(6)
+        assert pruned == 5  # heights 1..5
+        assert bs.base() == 6
+        assert bs.load_block_commit(3) is None
+        assert bs.load_block_commit(6) is not None
+        # pruning never touches the current height
+        assert bs.prune(99) == 4  # 6..9; height 10 survives
+        assert bs.load_block_commit(10) is not None
+        # idempotent
+        assert bs.prune(6) == 0
+
+
+# --------------------------------------------------------------------------
+# lite: verified_abci_query proof check (pure part)
+
+
+class TestVerifiedQueryResponse:
+    def _query_response(self, app: KVStoreApplication, key: bytes) -> dict:
+        res = app.query(abci.RequestQuery(data=key, prove=True))
+        return {
+            "code": res.code,
+            "key": res.key.hex(),
+            "value": res.value.hex(),
+            "height": res.height,
+            "proof_ops": [
+                {"type": op.type, "key": op.key.hex(), "data": op.data.hex()}
+                for op in res.proof_ops
+            ],
+        }
+
+    def _app(self) -> KVStoreApplication:
+        app = KVStoreApplication()
+        _grow(app, 1, 6, "key")
+        return app
+
+    def test_honest_response_verifies(self):
+        app = self._app()
+        resp = self._query_response(app, b"key3")
+        verify_abci_query_response(resp, app.app_hash)  # no raise
+
+    def test_tampered_value_rejected(self):
+        app = self._app()
+        resp = self._query_response(app, b"key3")
+        resp["value"] = b"forged".hex()
+        with pytest.raises(LiteError):
+            verify_abci_query_response(resp, app.app_hash)
+
+    def test_wrong_root_rejected(self):
+        """Stale height in practice: the proof chains to a DIFFERENT app
+        hash than the verified header's."""
+        app = self._app()
+        resp = self._query_response(app, b"key3")
+        old_hash = app.app_hash
+        _grow(app, 2, 1, "more")  # state moves on
+        assert app.app_hash != old_hash
+        stale = self._query_response(app, b"key3")
+        with pytest.raises(LiteError):
+            # proof built from height-2 state against the height-1 header
+            verify_abci_query_response(stale, old_hash)
+
+    def test_missing_proof_rejected(self):
+        app = self._app()
+        resp = self._query_response(app, b"key3")
+        resp["proof_ops"] = []
+        with pytest.raises(LiteError):
+            verify_abci_query_response(resp, app.app_hash)
+
+    def test_key_substitution_rejected(self):
+        """A lying node answering a query for key A with a correctly
+        proven (key B, value B) pair must not verify."""
+        app = self._app()
+        resp = self._query_response(app, b"key3")  # B: proven, honest
+        with pytest.raises(LiteError):
+            verify_abci_query_response(
+                resp, app.app_hash, expected_key=b"key2"  # A: what we asked
+            )
+        # the honest case still passes with the key pinned
+        verify_abci_query_response(resp, app.app_hash, expected_key=b"key3")
+
+    def test_absent_value_rejected(self):
+        app = self._app()
+        resp = self._query_response(app, b"no-such-key")
+        with pytest.raises(LiteError):
+            verify_abci_query_response(resp, app.app_hash)
+
+    def test_grpc_dict_shape_verifies(self):
+        """The rpc/grpc.py ABCIQuery converters hand back exactly the
+        dict shape the verifier consumes (proof_ops intact)."""
+        from tendermint_tpu.rpc.grpc import _query_res_from_proto, _query_res_to_proto
+
+        app = self._app()
+        resp = self._query_response(app, b"key1")
+        roundtripped = _query_res_from_proto(_query_res_to_proto(resp))
+        verify_abci_query_response(roundtripped, app.app_hash)
+        assert roundtripped["proof_ops"] == resp["proof_ops"]
+
+
+# --------------------------------------------------------------------------
+# reactor integration (in-process, stub switch — the unit half of the
+# ISSUE-12 corrupt-chunk acceptance; the proc-testnet half is
+# networks/local/nemesis.py nemesis_statesync)
+
+from tendermint_tpu.abci.client import LocalClient
+from tendermint_tpu.config import StateSyncConfig
+from tendermint_tpu.proxy import AppConnQuery, AppConnSnapshot
+from tendermint_tpu.statesync import CHUNK_CHANNEL, SNAPSHOT_CHANNEL
+from tendermint_tpu.statesync.reactor import StateSyncReactor
+
+
+class _Proxy:
+    def __init__(self, app):
+        client = LocalClient(app)
+        self.snapshot = AppConnSnapshot(client)
+        self.query = AppConnQuery(client)
+
+
+class _ServingPeer:
+    """A peer that answers chunk requests from a real server app; `mode`
+    corrupts or withholds the bytes."""
+
+    def __init__(self, pid, server_app, reactor, mode="honest"):
+        self.id = pid
+        self.app = server_app
+        self.reactor = reactor
+        self.mode = mode
+        self.served = 0
+
+    async def send(self, ch_id, data):
+        msg = decode_ss_message(data)
+        if not isinstance(msg, ChunkRequestMessage):
+            return
+        res = self.app.load_snapshot_chunk(
+            abci.RequestLoadSnapshotChunk(
+                height=msg.height, format=msg.format, chunk=msg.index
+            )
+        )
+        chunk = res.chunk
+        if self.mode == "corrupt" and chunk:
+            chunk = chunk[:-1] + bytes([chunk[-1] ^ 0xFF])
+        if self.mode == "missing":
+            chunk = b""
+        self.served += 1
+        await self.reactor.receive(
+            CHUNK_CHANNEL, self,
+            encode_ss_message(
+                ChunkResponseMessage(
+                    msg.height, msg.format, msg.index,
+                    missing=not chunk, chunk=chunk,
+                )
+            ),
+        )
+
+
+class _Switch:
+    def __init__(self, peers):
+        self._peers = {p.id: p for p in peers}
+        self.peers = self
+        self.reports = []
+
+    def get(self, pid):
+        return self._peers.get(pid)
+
+    async def broadcast(self, ch_id, data):
+        pass
+
+    async def report_behaviour(self, behaviour, peer=None):
+        self.reports.append(behaviour)
+
+
+def _snapshot_server(tmp_path, monkeypatch, n_keys=40):
+    monkeypatch.setenv("TMTPU_SNAPSHOT_CHUNK_BYTES", "128")
+    server = PersistentKVStoreApplication(
+        str(tmp_path / "server"), snapshot_interval=1
+    )
+    _grow(server, 1, n_keys, "it-")
+    snap = server.list_snapshots(abci.RequestListSnapshots()).snapshots[0]
+    assert snap.chunks >= 3  # the refetch rotation needs room to matter
+    return server, snap
+
+
+def _reactor(tmp_path, app, peers):
+    r = StateSyncReactor(
+        StateSyncConfig(chunk_fetchers=2, chunk_request_timeout=0.5),
+        _Proxy(app),
+        state_store=None,
+        block_store=None,
+        chain_id="it-chain",
+        home=str(tmp_path / "home"),
+    )
+    r.switch = _Switch(peers)
+    return r
+
+
+class TestReactorFetchApply:
+    async def test_corrupt_peer_scored_and_refetched(self, tmp_path, monkeypatch):
+        server, snap = _snapshot_server(tmp_path, monkeypatch)
+        replica = PersistentKVStoreApplication(str(tmp_path / "replica"))
+        peers = []
+        reactor = _reactor(tmp_path, replica, peers)
+        peers.extend([
+            _ServingPeer("honest-1", server, reactor),
+            _ServingPeer("evil", server, reactor, mode="corrupt"),
+            _ServingPeer("honest-2", server, reactor),
+        ])
+        reactor.switch = _Switch(peers)
+        for p in peers:
+            reactor.pool.add(p.id, snap)
+        await reactor.start()
+        try:
+            offer = replica.offer_snapshot(
+                abci.RequestOfferSnapshot(snapshot=snap, app_hash=server.app_hash)
+            )
+            assert offer.result == abci.OFFER_SNAPSHOT_ACCEPT
+            assert await reactor._fetch_and_apply(snap) == "applied"
+        finally:
+            await reactor.stop()
+        # restored state is byte-identical despite the corrupt server
+        assert replica.app_hash == server.app_hash
+        assert replica.state == server.state
+        # the evil peer served at least once, was behaviour-scored with
+        # the heavy bad_chunk weight, and every retry landed elsewhere
+        evil = next(p for p in peers if p.id == "evil")
+        assert evil.served > 0
+        bad = [b for b in reactor.switch.reports if "bad chunk" in b.reason]
+        assert bad and all(b.peer_id == "evil" for b in bad)
+        assert all(b.weight == 5.0 for b in bad)
+
+    async def test_missing_chunks_fall_to_other_peers(self, tmp_path, monkeypatch):
+        server, snap = _snapshot_server(tmp_path, monkeypatch)
+        replica = PersistentKVStoreApplication(str(tmp_path / "replica"))
+        peers = []
+        reactor = _reactor(tmp_path, replica, peers)
+        peers.extend([
+            _ServingPeer("flaky", server, reactor, mode="missing"),
+            _ServingPeer("honest", server, reactor),
+        ])
+        reactor.switch = _Switch(peers)
+        for p in peers:
+            reactor.pool.add(p.id, snap)
+        await reactor.start()
+        try:
+            replica.offer_snapshot(
+                abci.RequestOfferSnapshot(snapshot=snap, app_hash=server.app_hash)
+            )
+            assert await reactor._fetch_and_apply(snap) == "applied"
+        finally:
+            await reactor.stop()
+        assert replica.app_hash == server.app_hash
+
+    async def test_all_peers_corrupt_fails_without_applying(
+        self, tmp_path, monkeypatch
+    ):
+        server, snap = _snapshot_server(tmp_path, monkeypatch)
+        replica = PersistentKVStoreApplication(str(tmp_path / "replica"))
+        peers = []
+        reactor = _reactor(tmp_path, replica, peers)
+        peers.append(_ServingPeer("evil", server, reactor, mode="corrupt"))
+        reactor.switch = _Switch(peers)
+        reactor.pool.add("evil", snap)
+        await reactor.start()
+        try:
+            replica.offer_snapshot(
+                abci.RequestOfferSnapshot(snapshot=snap, app_hash=server.app_hash)
+            )
+            assert await reactor._fetch_and_apply(snap) == "retry"
+        finally:
+            await reactor.stop()
+        # nothing ever touched the replica's state
+        assert replica.state == {}
+        assert replica.app_hash == b""
+
+    async def test_serving_side_answers_discovery_and_chunks(
+        self, tmp_path, monkeypatch
+    ):
+        server, snap = _snapshot_server(tmp_path, monkeypatch)
+        reactor = _reactor(tmp_path, server, [])
+
+        sent = []
+
+        class _Sink:
+            id = "client"
+
+            async def send(self, ch_id, data):
+                sent.append((ch_id, decode_ss_message(data)))
+
+        await reactor.start()
+        try:
+            await reactor.receive(
+                SNAPSHOT_CHANNEL, _Sink(),
+                encode_ss_message(SnapshotsRequestMessage()),
+            )
+            offers = [m for ch, m in sent if ch == SNAPSHOT_CHANNEL]
+            assert any(m.snapshot == snap for m in offers)
+            await reactor.receive(
+                CHUNK_CHANNEL, _Sink(),
+                encode_ss_message(ChunkRequestMessage(snap.height, snap.format, 0)),
+            )
+            ch, resp = sent[-1]
+            assert ch == CHUNK_CHANNEL and not resp.missing
+            assert sum_sha256(resp.chunk) == decode_chunk_hashes(snap.metadata)[0]
+        finally:
+            await reactor.stop()
+
+
+class TestValidatorRecordsRideSnapshots:
+    def test_restore_rebuilds_validator_bookkeeping(self, tmp_path, monkeypatch):
+        """Validator records live IN the snapshotted state (reference
+        persistent_kvstore idiom), so a restored replica keeps them."""
+        monkeypatch.setenv("TMTPU_SNAPSHOT_CHUNK_BYTES", "128")
+        server = PersistentKVStoreApplication(
+            str(tmp_path / "server"), snapshot_interval=1
+        )
+        pk1, pk2 = b"\x01" * 32, b"\x02" * 32
+        server.init_chain(
+            abci.RequestInitChain(validators=[abci.ValidatorUpdate(pk1, 10)])
+        )
+        server.deliver_tx(
+            abci.RequestDeliverTx(tx=f"val:{pk2.hex()}!7".encode())
+        )
+        _grow(server, 1, 10, "vkeys-")
+        assert server.validators == {pk1.hex(): 10, pk2.hex(): 7}
+        snap = server.list_snapshots(abci.RequestListSnapshots()).snapshots[0]
+        replica = PersistentKVStoreApplication(str(tmp_path / "replica"))
+        assert replica.offer_snapshot(
+            abci.RequestOfferSnapshot(snapshot=snap, app_hash=server.app_hash)
+        ).result == abci.OFFER_SNAPSHOT_ACCEPT
+        for i in range(snap.chunks):
+            chunk = server.load_snapshot_chunk(
+                abci.RequestLoadSnapshotChunk(
+                    height=snap.height, format=snap.format, chunk=i
+                )
+            ).chunk
+            assert replica.apply_snapshot_chunk(
+                abci.RequestApplySnapshotChunk(index=i, chunk=chunk, sender="s")
+            ).result == abci.APPLY_CHUNK_ACCEPT
+        assert replica.app_hash == server.app_hash
+        assert replica.validators == server.validators
+        # removal (power 0) also rides: the record leaves the state map
+        server.deliver_tx(
+            abci.RequestDeliverTx(tx=f"val:{pk2.hex()}!0".encode())
+        )
+        assert f"val:{pk2.hex()}" not in server.state
+        assert server.validators == {pk1.hex(): 10}
+
+
+class TestRetryAlwaysRequeuesCurrentChunk:
+    async def test_retry_listing_only_other_chunks_cannot_deadlock(
+        self, tmp_path, monkeypatch
+    ):
+        """An app answering RETRY with refetch_chunks that omit the chunk
+        just offered must not strand it: the loop popped it from `fetched`,
+        so unless it is re-queued no fetcher ever produces it again and
+        the apply loop waits forever."""
+        server, snap = _snapshot_server(tmp_path, monkeypatch)
+
+        class _PickyReplica(PersistentKVStoreApplication):
+            tantrums = 0
+
+            def apply_snapshot_chunk(self, req):
+                # reject chunk 1 once, pointing the refetch at chunk 0 only
+                if req.index == 1 and not self.tantrums:
+                    self.tantrums += 1
+                    return abci.ResponseApplySnapshotChunk(
+                        result=abci.APPLY_CHUNK_RETRY, refetch_chunks=[0]
+                    )
+                return super().apply_snapshot_chunk(req)
+
+        replica = _PickyReplica(str(tmp_path / "replica"))
+        peers = []
+        reactor = _reactor(tmp_path, replica, peers)
+        peers.append(_ServingPeer("honest", server, reactor))
+        reactor.switch = _Switch(peers)
+        reactor.pool.add("honest", snap)
+        await reactor.start()
+        try:
+            replica.offer_snapshot(
+                abci.RequestOfferSnapshot(snapshot=snap, app_hash=server.app_hash)
+            )
+            import asyncio
+
+            async with asyncio.timeout(10):
+                assert await reactor._fetch_and_apply(snap) == "applied"
+        finally:
+            await reactor.stop()
+        assert replica.tantrums == 1
+        assert replica.app_hash == server.app_hash
+
+
+class TestRestoreVerdicts:
+    """Transient failures must not condemn a snapshot (pool.reject is
+    reserved for app verdicts on content) — the sticky-reject half of the
+    ISSUE-12 retry semantics."""
+
+    async def test_lite_failure_keeps_snapshot_offerable(
+        self, tmp_path, monkeypatch
+    ):
+        server, snap = _snapshot_server(tmp_path, monkeypatch)
+        replica = PersistentKVStoreApplication(str(tmp_path / "replica"))
+        reactor = _reactor(tmp_path, replica, [])
+        reactor.pool.add("p1", snap)
+
+        class _Light:
+            async def state_for(self, h):
+                raise LiteError("rpc blip")
+
+        with pytest.raises(LiteError):
+            await reactor._restore_snapshot(_Light(), snap)
+        assert reactor.pool.best() is not None  # NOT rejected
+
+    async def test_fetch_exhaustion_is_retryable_not_rejected(
+        self, tmp_path, monkeypatch
+    ):
+        from types import SimpleNamespace
+
+        from tendermint_tpu.statesync.reactor import RestoreRetryable
+
+        server, snap = _snapshot_server(tmp_path, monkeypatch)
+        replica = PersistentKVStoreApplication(str(tmp_path / "replica"))
+        peers = []
+        reactor = _reactor(tmp_path, replica, peers)
+        peers.append(_ServingPeer("evil", server, reactor, mode="corrupt"))
+        reactor.switch = _Switch(peers)
+        reactor.pool.add("evil", snap)
+
+        class _Light:
+            async def state_for(self, h):
+                return SimpleNamespace(
+                    app_hash=server.app_hash, headers_verified=1,
+                    state=None, commit=None,
+                )
+
+        await reactor.start()
+        try:
+            with pytest.raises(RestoreRetryable):
+                await reactor._restore_snapshot(_Light(), snap)
+        finally:
+            await reactor.stop()
+        assert reactor.pool.best() is not None  # a later round may retry
+        # nothing touched the replica
+        assert replica.state == {}
