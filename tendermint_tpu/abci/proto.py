@@ -916,8 +916,8 @@ _RESP_MAP: list[tuple[int, type, Desc, Callable, Callable]] = [
         5,
         abci.ResponseSetOption,
         RESP_SET_OPTION,
-        lambda o: {"code": o.code, "log": o.log},
-        _mk(abci.ResponseSetOption, [("code", 0), ("log", "")]),
+        lambda o: {"code": o.code, "log": o.log, "info": o.info},
+        _mk(abci.ResponseSetOption, [("code", 0), ("log", ""), ("info", "")]),
     ),
     (
         6,

@@ -274,6 +274,7 @@ class ResponseInfo:
 class ResponseSetOption:
     code: int = CODE_TYPE_OK
     log: str = ""
+    info: str = ""  # reference carries it (types.proto); was dropped on both transports
 
 
 @dataclass

@@ -2,23 +2,39 @@
 
 The hot path's correctness story (deterministic consensus, non-blocking
 event loop, bounded jit recompilation) rests on invariants that ordinary
-linters don't know about. tmlint is an AST pass with four rule families:
+linters don't know about. tmlint runs two passes: per-file AST rules,
+then whole-program rules over a cross-file index with an inferred
+execution context (event loop / dispatcher thread / pool worker / jit /
+signal handler) per function — the Python analogue of the `-race` + vet
+gate the reference keeps in CI.
 
 - TM1xx  async hygiene: blocking calls / fire-and-forget tasks /
-         awaits under a threading lock inside ``async def``
+         awaits under a threading lock inside ``async def``; TM110
+         catches the blocking call hidden one helper deep via the
+         whole-program call graph
 - TM2xx  consensus determinism: wall-clock reads, shared unseeded
-         ``random``, set-ordered iteration feeding hashing
+         ``random``, set-ordered iteration feeding hashing; TM210
+         follows the taint through helper returns into sign-bytes/hash
+         construction
 - TM3xx  JAX tracing hygiene in ops/ and crypto/batch.py: Python
          branches on tracers, host syncs, concrete shapes from tracers
 - TM4xx  service lifecycle: threads neither daemon nor joined
 - TM5xx  device-dispatch discipline: direct curve verify_batch calls
-         that bypass the DeviceScheduler admission queue
+         (TM501) and submit paths with no priority class pinned (TM502)
+- TM6xx  wire conformance: p2p channel-id collisions (TM601), ABCI
+         proto<->CBE schema drift (TM602), telemetry names missing from
+         the docs catalogue (TM603)
+- TM111  the `-race` analogue: one instance attribute written from two
+         execution contexts with no common lock
 
 Run it with ``python -m tendermint_tpu.lint``; see docs/lint.md for the
-rule catalogue, suppression syntax and the baseline ratchet.
+rule catalogue, the context-inference model, suppression syntax, the
+suppression audit (``--list-suppressions``), ``--changed``/``--stats``
+and the baseline ratchet.
 """
 from tendermint_tpu.lint.config import LintConfig, load_config
 from tendermint_tpu.lint.engine import (
+    all_program_rules,
     all_rules,
     lint_paths,
     lint_source,
@@ -33,6 +49,7 @@ __all__ = [
     "Baseline",
     "Finding",
     "LintConfig",
+    "all_program_rules",
     "all_rules",
     "lint_paths",
     "lint_source",

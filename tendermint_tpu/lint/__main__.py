@@ -2,17 +2,70 @@
 
 Exit codes: 0 — clean (every finding baselined or suppressed),
 1 — new findings, 2 — usage/config error.
+
+Beyond the gate itself the CLI is the audit surface for the escape
+hatches: ``--list-suppressions`` prints every inline-suppressed finding
+(the reviewed judgment calls), ``--stats`` emits per-rule finding and
+suppression counts as JSON so the trajectory tooling
+(tools/bench_compare.py style) can gate on suppression-count creep, and
+``--changed`` lints only the files git says moved — the whole-program
+index still covers the full tree (warm from the cache), so
+interprocedural findings in changed files stay exact.
 """
 from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
 from pathlib import Path
 
 from tendermint_tpu.lint.config import load_config
-from tendermint_tpu.lint.engine import all_rules, lint_paths
+from tendermint_tpu.lint.engine import all_program_rules, all_rules, lint_paths
 from tendermint_tpu.lint.findings import JSON_SCHEMA_VERSION, Baseline
+
+
+def _git_changed(root: Path) -> set[str] | None:
+    """Root-relative paths of modified + untracked .py files, or None
+    when git is unavailable (callers fall back to a full run).
+
+    `git diff --name-only` emits TOPLEVEL-relative paths while findings
+    carry root-relative ones — when --root sits below the git toplevel
+    the two namespaces differ, so every path is rebased through the
+    toplevel; `git ls-files -o` is cwd-relative (cwd=root) already.
+    """
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            cwd=root, capture_output=True, text=True, timeout=30,
+        )
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD"],
+            cwd=root, capture_output=True, text=True, timeout=30,
+        )
+        untracked = subprocess.run(
+            ["git", "ls-files", "-o", "--exclude-standard"],
+            cwd=root, capture_output=True, text=True, timeout=30,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if top.returncode != 0 or diff.returncode != 0 or untracked.returncode != 0:
+        return None
+    toplevel = Path(top.stdout.strip())
+    out = set()
+    for line in diff.stdout.splitlines():
+        line = line.strip()
+        if not line.endswith(".py"):
+            continue
+        try:
+            out.add((toplevel / line).resolve().relative_to(root).as_posix())
+        except ValueError:
+            continue  # changed outside --root: not ours to report
+    for line in untracked.stdout.splitlines():
+        line = line.strip()
+        if line.endswith(".py"):
+            out.add(line)
+    return out
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -21,13 +74,29 @@ def main(argv: list[str] | None = None) -> int:
         description="consensus-aware static analysis (see docs/lint.md)",
     )
     ap.add_argument("paths", nargs="*", help="files/dirs (default: [tool.tmlint] paths)")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--format", choices=("text", "json", "github"), default="text",
+                    help="github = GitHub Actions ::error annotations")
     ap.add_argument("--root", default=".", help="repo root (pyproject + baseline live here)")
-    ap.add_argument("--baseline", default=None, help="baseline file (default from config)")
+    ap.add_argument("--baseline", nargs="?", const=None, default=None,
+                    help="baseline file (default from config; bare --baseline "
+                         "just makes the ratchet explicit)")
     ap.add_argument("--no-baseline", action="store_true", help="report grandfathered findings too")
     ap.add_argument("--write-baseline", action="store_true",
                     help="rewrite the baseline from the current findings and exit 0")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--list-suppressions", action="store_true",
+                    help="audit: print every inline-suppressed finding and exit 0")
+    ap.add_argument("--stats", action="store_true",
+                    help="emit per-rule finding/suppression counts as JSON and exit 0")
+    ap.add_argument("--changed", action="store_true",
+                    help="report findings only in files git sees as changed "
+                         "(index still covers the whole tree)")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated code prefixes to run (e.g. TM1,TM401)")
+    ap.add_argument("--exclude", action="append", default=[],
+                    help="extra directory name to skip (repeatable)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="ignore and do not write the per-module index cache")
     args = ap.parse_args(argv)
 
     root = Path(args.root).resolve()
@@ -35,23 +104,116 @@ def main(argv: list[str] | None = None) -> int:
         print(f"error: --root {args.root} is not a directory", file=sys.stderr)
         return 2
     config = load_config(root)
+    config.exclude.extend(args.exclude)
+
+    if args.select:
+        prefixes = tuple(
+            p.strip().upper() for p in args.select.split(",") if p.strip()
+        )
+        if not prefixes:
+            print("error: --select needs at least one code prefix", file=sys.stderr)
+            return 2
+        # rules outside the selection are disabled for this run — that
+        # also keys the cache fingerprint, so selected runs never reuse
+        # full-run findings and vice versa
+        for rule in all_rules() + all_program_rules():
+            if not rule.code.startswith(prefixes):
+                config.disable.append(rule.code)
 
     if args.list_rules:
-        for rule in all_rules():
+        for rule in all_rules() + all_program_rules():
+            if rule.code in config.disable:
+                continue
             print(f"{rule.code}  {rule.name}\n    {rule.help}")
         return 0
 
+    if args.baseline is not None and Path(args.baseline).is_dir():
+        # bare `--baseline` before a positional path makes argparse eat
+        # the path as the baseline FILE — fail loudly instead of crashing
+        # on read (or silently linting the wrong scope)
+        print(
+            f"error: --baseline value {args.baseline!r} is a directory — "
+            "for the bare ratchet form put paths first, or use "
+            "--baseline=<file>",
+            file=sys.stderr,
+        )
+        return 2
     baseline_path = Path(args.baseline) if args.baseline else root / config.baseline
     baseline = Baseline() if args.no_baseline else Baseline.load(baseline_path)
 
+    changed: set[str] | None = None
+    if args.changed:
+        changed = _git_changed(root)
+        if changed is None:
+            print("tmlint: --changed: git unavailable; linting everything",
+                  file=sys.stderr)
+
+    # explicit paths restrict what is REPORTED, not what is indexed: the
+    # whole-program rules (TM110 chains, TM111 contexts, TM502 pins)
+    # need the full [tool.tmlint] tree to resolve callees outside the
+    # requested subset, so the index always covers config.paths too
+    paths = None
+    report: set[str] | None = changed
+    if args.paths:
+        from tendermint_tpu.lint.engine import iter_py_files
+
+        subset = set()
+        for f in iter_py_files(args.paths, root, config.exclude):
+            try:
+                subset.add(f.resolve().relative_to(root).as_posix())
+            except ValueError:
+                subset.add(f.as_posix())
+        report = subset if changed is None else (subset & changed)
+        paths = list(config.paths) + [
+            p for p in args.paths if p not in config.paths
+        ]
+
+    want_suppressed = args.list_suppressions or args.stats
     findings = lint_paths(
-        paths=args.paths or None, root=root, config=config, baseline=baseline
+        paths=paths,
+        root=root,
+        config=config,
+        baseline=baseline,
+        keep_suppressed=want_suppressed,
+        use_cache=not args.no_cache,
+        changed=report,
     )
-    new = [f for f in findings if not f.baselined]
+    suppressed = [f for f in findings if f.suppressed]
+    live = [f for f in findings if not f.suppressed]
+    new = [f for f in live if not f.baselined]
+
+    if args.stats:
+        per_rule: dict[str, dict] = {}
+        for f in live:
+            per_rule.setdefault(f.code, {"findings": 0, "suppressed": 0})
+            per_rule[f.code]["findings"] += 1
+        for f in suppressed:
+            per_rule.setdefault(f.code, {"findings": 0, "suppressed": 0})
+            per_rule[f.code]["suppressed"] += 1
+        print(
+            json.dumps(
+                {
+                    "version": JSON_SCHEMA_VERSION,
+                    "rules": dict(sorted(per_rule.items())),
+                    "findings": len(live),
+                    "new": len(new),
+                    "baselined": len(live) - len(new),
+                    "suppressed": len(suppressed),
+                },
+                indent=1,
+            )
+        )
+        return 0
+
+    if args.list_suppressions:
+        for f in suppressed:
+            print(f.render())
+        print(f"tmlint: {len(suppressed)} inline suppression(s) in effect")
+        return 0
 
     if args.write_baseline:
-        Baseline.from_findings(findings).save(baseline_path)
-        print(f"wrote {len(findings)} finding(s) to {baseline_path}")
+        Baseline.from_findings(live).save(baseline_path)
+        print(f"wrote {len(live)} finding(s) to {baseline_path}")
         return 0
 
     if args.format == "json":
@@ -59,17 +221,21 @@ def main(argv: list[str] | None = None) -> int:
             json.dumps(
                 {
                     "version": JSON_SCHEMA_VERSION,
-                    "findings": [f.to_json() for f in findings],
+                    "findings": [f.to_json() for f in live],
                     "new": len(new),
-                    "baselined": len(findings) - len(new),
+                    "baselined": len(live) - len(new),
                 },
                 indent=1,
             )
         )
+    elif args.format == "github":
+        for f in new:
+            print(f.render_github())
+        print(f"tmlint: {len(new)} new finding(s)")
     else:
         for f in new:
             print(f.render())
-        n_base = len(findings) - len(new)
+        n_base = len(live) - len(new)
         print(
             f"tmlint: {len(new)} new finding(s), {n_base} baselined"
             + ("" if new else " — clean")

@@ -34,6 +34,15 @@ DEFAULT_JAX_PATHS = (
     "tendermint_tpu/ops",
     "tendermint_tpu/crypto/batch.py",
 )
+# Background subsystems that must pin a DeviceScheduler priority class
+# before any signature submission (TM502): unpinned work from here
+# dispatches at the CONSENSUS_COMMIT default and crowds the hot path.
+DEFAULT_PRIORITY_PATHS = (
+    "tendermint_tpu/blockchain",
+    "tendermint_tpu/lite",
+    "tendermint_tpu/mempool",
+    "tendermint_tpu/statesync",
+)
 
 
 @dataclass
@@ -48,12 +57,34 @@ class LintConfig:
         default_factory=lambda: list(DEFAULT_DETERMINISM_PATHS)
     )
     jax_paths: list[str] = field(default_factory=lambda: list(DEFAULT_JAX_PATHS))
+    priority_paths: list[str] = field(
+        default_factory=lambda: list(DEFAULT_PRIORITY_PATHS)
+    )
+    cache: str = ".tmlint_cache/index.json"  # per-module index cache
 
     def in_determinism_scope(self, rel_path: str) -> bool:
         return _in_scope(rel_path, self.determinism_paths)
 
     def in_jax_scope(self, rel_path: str) -> bool:
         return _in_scope(rel_path, self.jax_paths)
+
+    def in_priority_scope(self, rel_path: str) -> bool:
+        return _in_scope(rel_path, self.priority_paths)
+
+    def fingerprint(self) -> str:
+        """Cache key of everything that changes what a module's findings
+        are — a config edit must invalidate the whole findings cache."""
+        import hashlib
+
+        blob = repr(
+            (
+                sorted(self.disable),
+                sorted(self.determinism_paths),
+                sorted(self.jax_paths),
+                sorted(self.priority_paths),
+            )
+        )
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
 
 def _in_scope(rel_path: str, prefixes: list[str]) -> bool:
@@ -74,6 +105,9 @@ _KEY_MAP = {
     "determinism_paths": "determinism_paths",
     "jax-paths": "jax_paths",
     "jax_paths": "jax_paths",
+    "priority-paths": "priority_paths",
+    "priority_paths": "priority_paths",
+    "cache": "cache",
 }
 
 

@@ -1,0 +1,331 @@
+"""tmlint pass 2 — call-graph resolution and execution-context inference.
+
+The node is a braid of execution contexts: the asyncio event loop runs
+every reactor coroutine, the DeviceScheduler owns a dispatcher thread,
+`asyncio.to_thread`/executor submits fan work to pool workers, jitted
+bodies execute at trace time, and signal handlers interrupt anywhere.
+A function's hazards depend on *which of those it can run in* — a
+blocking call is fatal on the loop and routine on a worker; an unlocked
+attribute write is fine in one context and a data race across two.
+
+This module infers, for every function the indexer saw, the set of
+contexts it can execute in:
+
+- seeds: ``async def`` -> LOOP; jitted -> JIT; ``Thread(target=f)`` ->
+  THREAD; ``asyncio.to_thread(f)`` / ``executor.submit(f)`` /
+  ``run_in_executor`` / pool ``map`` -> WORKER; ``signal.signal`` /
+  ``add_signal_handler`` -> SIGNAL;
+- propagation: a *plain* call edge carries the caller's contexts into a
+  sync callee (the callee runs wherever its caller runs). Dispatch
+  boundaries do NOT propagate — the spawned side gets its seed context
+  instead — and calling an ``async def`` from anywhere yields a
+  coroutine that still runs on the loop.
+
+Resolution is deliberately conservative: bare names resolve through the
+module's functions and ``from x import y`` aliases, ``self.m``/``cls.m``
+through the enclosing class and its project-known bases, ``mod.fn``
+through module imports, and ``SINGLETON.method`` through module-level
+``NAME = ClassName(...)`` instances (RECORDER, DEVICE, FAULTS). A call
+that doesn't resolve contributes nothing — the rules built on top trade
+recall for a near-zero false-positive floor, and the fixture package in
+tests/ is the spec of what must resolve.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tendermint_tpu.lint.project import ProjectIndex
+
+LOOP = "loop"
+THREAD = "thread"
+WORKER = "worker"
+JIT = "jit"
+SIGNAL = "signal"
+
+_SPAWN_CTX = {"thread": THREAD, "worker": WORKER, "signal": SIGNAL, "task": LOOP}
+
+# FnKey = (rel_path, qualname)
+
+
+class Resolver:
+    """Static name -> function resolution over a ProjectIndex."""
+
+    def __init__(self, project: ProjectIndex):
+        self.project = project
+        # dotted module name -> rel path ("tendermint_tpu.libs.recorder"
+        # -> "tendermint_tpu/libs/recorder.py")
+        self.mod_by_dotted: dict[str, str] = {}
+        # class name -> [(rel, name)] for cross-module base resolution
+        self.class_sites: dict[str, list[tuple[str, str]]] = {}
+        for rel, idx in project.modules.items():
+            name = rel[:-3] if rel.endswith(".py") else rel
+            if name.endswith("/__init__"):
+                name = name[: -len("/__init__")]
+            self.mod_by_dotted[name.replace("/", ".")] = rel
+            for cls in idx.classes:
+                self.class_sites.setdefault(cls, []).append((rel, cls))
+
+    # -- class/method machinery ----------------------------------------------
+
+    def _resolve_class(self, rel: str, name: str) -> Optional[tuple[str, str]]:
+        """A class name as written in module `rel` -> (rel, class)."""
+        idx = self.project.module(rel)
+        if idx is None:
+            return None
+        base = name.split(".")[-1]
+        if name in idx.classes:
+            return (rel, name)
+        origin = idx.imports.get(name.split(".")[0])
+        if origin is not None:
+            target = self._module_attr(origin, name.split(".")[1:])
+            if target is not None:
+                trel, chain = target
+                if chain and chain[0] in self.project.module(trel).classes:
+                    return (trel, chain[0])
+                if not chain:
+                    # `from x import C` resolved to module x, attr C
+                    tail = origin.rsplit(".", 1)[-1]
+                    if tail in self.project.module(trel).classes:
+                        return (trel, tail)
+        sites = self.class_sites.get(base, [])
+        if len(sites) == 1:
+            return sites[0]
+        return None
+
+    def resolve_method(
+        self, rel: str, cls: str, method: str, _depth: int = 0
+    ) -> Optional[tuple[str, str]]:
+        """(rel, qualname) of `cls.method`, walking project-known bases."""
+        if _depth > 6:
+            return None
+        idx = self.project.module(rel)
+        if idx is None or cls not in idx.classes:
+            return None
+        qual = f"{cls}.{method}"
+        if qual in idx.functions:
+            return (rel, qual)
+        for base in idx.classes[cls]["bases"]:
+            site = self._resolve_class(rel, base)
+            if site is not None:
+                found = self.resolve_method(site[0], site[1], method, _depth + 1)
+                if found is not None:
+                    return found
+        return None
+
+    def _module_attr(
+        self, origin: str, extra: list[str]
+    ) -> Optional[tuple[str, list[str]]]:
+        """Map a dotted origin (+ trailing attrs) onto (rel, attr chain):
+        the longest prefix of the JOINT chain that names a project module
+        wins — `import a` followed by `a.b.fn()` must land in a/b.py,
+        not stop at the package root."""
+        parts = origin.split(".") + extra
+        for i in range(len(parts), 0, -1):
+            rel = self.mod_by_dotted.get(".".join(parts[:i]))
+            if rel is not None:
+                return (rel, parts[i:])
+        return None
+
+    # -- the main entry -------------------------------------------------------
+
+    def resolve(
+        self, rel: str, cls: Optional[str], name: str
+    ) -> Optional[tuple[str, str]]:
+        """A callee name as written inside (rel, class) -> FnKey or None."""
+        if not name or name.startswith("?"):
+            return None
+        idx = self.project.module(rel)
+        if idx is None:
+            return None
+        parts = name.split(".")
+        if parts[0] in ("self", "cls") and cls is not None:
+            if len(parts) != 2:
+                return None  # self.obj.method: receiver type unknown
+            return self.resolve_method(rel, cls, parts[1])
+        if len(parts) == 1:
+            if name in idx.functions:
+                return (rel, name)
+            origin = idx.imports.get(name)
+            if origin is None:
+                return None
+            return self._resolve_in(origin, [])
+        # dotted: expand a leading alias, else try as absolute module path
+        head = parts[0]
+        if head in idx.instances:  # module-local singleton
+            return self.resolve_method(rel, idx.instances[head], parts[-1])
+        origin = idx.imports.get(head)
+        if origin is not None:
+            return self._resolve_in(origin, parts[1:])
+        return self._resolve_in(".".join(parts[:-1]), parts[-1:])
+
+    def _resolve_in(self, origin: str, extra: list[str]) -> Optional[tuple[str, str]]:
+        target = self._module_attr(origin, extra)
+        if target is None:
+            return None
+        rel, chain = target
+        idx = self.project.module(rel)
+        if idx is None or not chain:
+            return None
+        if len(chain) == 1:
+            if chain[0] in idx.functions:
+                return (rel, chain[0])
+            return None
+        if len(chain) == 2:
+            first, second = chain
+            if first in idx.instances:
+                return self.resolve_method(rel, idx.instances[first], second)
+            if first in idx.classes:
+                return self.resolve_method(rel, first, second)
+        return None
+
+
+@dataclass
+class ContextInfo:
+    """Per-function inferred contexts, with a provenance chain per
+    context for diagnostics ("thread via DeviceScheduler._run ->
+    _pop_group_locked")."""
+
+    contexts: dict = field(default_factory=dict)  # ctx -> provenance str
+
+
+def infer_contexts(project: ProjectIndex, resolver: Resolver | None = None):
+    """-> (contexts: dict[FnKey, ContextInfo], resolver, edges).
+
+    `edges` is the resolved plain-call edge list
+    [(caller FnKey, callee FnKey, line, pinned)] — shared by the
+    reachability rules so the graph is built once.
+    """
+    resolver = resolver or Resolver(project)
+    infos: dict[tuple[str, str], ContextInfo] = {}
+    edges: list[tuple[tuple[str, str], tuple[str, str], int, bool]] = []
+
+    def info(key) -> ContextInfo:
+        return infos.setdefault(key, ContextInfo())
+
+    # seeds + edge resolution
+    for rel, idx in project.modules.items():
+        for qual, fs in idx.functions.items():
+            key = (rel, qual)
+            if fs.is_async:
+                info(key).contexts.setdefault(LOOP, "async def")
+            if fs.is_jit:
+                info(key).contexts.setdefault(JIT, "jitted")
+            for kind, target, line in fs.spawns:
+                tk = resolver.resolve(rel, fs.cls, target)
+                if tk is None:
+                    continue
+                ctx = _SPAWN_CTX.get(kind)
+                tfs = project.module(tk[0]).functions.get(tk[1])
+                if ctx is None or tfs is None:
+                    continue
+                if ctx == LOOP and not tfs.is_async:
+                    continue  # create_task of a sync call: not a context fact
+                info(tk).contexts.setdefault(
+                    ctx, f"{kind} target of {qual} ({rel}:{line})"
+                )
+            for c in fs.calls:
+                ck = resolver.resolve(rel, fs.cls, c.name)
+                if ck is not None and ck != key:
+                    edges.append((key, ck, c.line, c.pinned))
+
+    # propagate caller contexts into sync, non-jit callees to fixpoint
+    fwd: dict[tuple[str, str], list[tuple[str, str]]] = {}
+    for caller, callee, _line, _p in edges:
+        fwd.setdefault(caller, []).append(callee)
+    work = [k for k, ci in infos.items() if ci.contexts]
+    while work:
+        key = work.pop()
+        ci = infos.get(key)
+        if ci is None:
+            continue
+        for callee in fwd.get(key, ()):  # noqa: B020
+            cfs = project.module(callee[0]).functions.get(callee[1])
+            if cfs is None or cfs.is_async or cfs.is_jit:
+                continue
+            tgt = info(callee)
+            grew = False
+            for ctx, prov in ci.contexts.items():
+                if ctx not in tgt.contexts:
+                    src = key[1]
+                    tgt.contexts[ctx] = f"via {src} ({prov})"
+                    grew = True
+            if grew:
+                work.append(callee)
+    return infos, resolver, edges
+
+
+def blocking_chain(project: ProjectIndex, resolver: Resolver, key, _memo=None, _stack=None):
+    """None, or the chain proving `key` (transitively) makes a blocking
+    call: [(rel, line, desc), ...] ending at the direct site.
+
+    Positive results are always memoizable; a negative result is cached
+    only when the search was NOT truncated by cycle detection —
+    otherwise a mutually-recursive pair explored from one entry point
+    would poison the memo and hide the other entry point's real chain
+    (order-dependent false negatives)."""
+    _memo = _memo if _memo is not None else {}
+    _stack = _stack if _stack is not None else set()
+    if key in _memo:
+        return _memo[key]
+    if key in _stack:
+        return None  # truncated — caller must not memoize its own None
+    idx = project.module(key[0])
+    fs = idx.functions.get(key[1]) if idx else None
+    if fs is None:
+        return None
+    if fs.blocking:
+        line, what, _hint = fs.blocking[0]
+        _memo[key] = [(key[0], line, what)]
+        return _memo[key]
+    truncated = False
+    _stack.add(key)
+    try:
+        for c in fs.calls:
+            ck = resolver.resolve(key[0], fs.cls, c.name)
+            if ck is None or ck == key:
+                continue
+            if ck in _stack:
+                truncated = True
+                continue
+            cfs = project.module(ck[0]).functions.get(ck[1])
+            if cfs is None or cfs.is_async:
+                continue
+            sub = blocking_chain(project, resolver, ck, _memo, _stack)
+            if sub is not None:
+                chain = [(key[0], c.line, ck[1])] + sub
+                _memo[key] = chain
+                return chain
+            if ck not in _memo:
+                truncated = True  # callee's negative was itself truncated
+    finally:
+        _stack.discard(key)
+    if not truncated:
+        _memo[key] = None
+    return None
+
+
+def tainted_functions(project: ProjectIndex, resolver: Resolver) -> dict:
+    """FnKey -> reason, for functions whose RETURN value derives from a
+    wall-clock/random source (directly or through other tainted
+    functions). The interprocedural half of TM210."""
+    tainted: dict[tuple[str, str], str] = {}
+    for rel, idx in project.modules.items():
+        for qual, fs in idx.functions.items():
+            if fs.returns_taint:
+                tainted[(rel, qual)] = "returns a wall-clock/random value"
+    changed = True
+    while changed:
+        changed = False
+        for rel, idx in project.modules.items():
+            for qual, fs in idx.functions.items():
+                key = (rel, qual)
+                if key in tainted:
+                    continue
+                for name in fs.return_calls:
+                    ck = resolver.resolve(rel, fs.cls, name)
+                    if ck is not None and ck in tainted:
+                        tainted[key] = f"returns {ck[1]}(...), which {tainted[ck]}"
+                        changed = True
+                        break
+    return tainted

@@ -185,6 +185,14 @@ def all_rules() -> list[Rule]:
     return rules
 
 
+def all_program_rules() -> list:
+    """The whole-program (pass 2) rules: interprocedural + wire
+    conformance. Instances implement check(project, config, root)."""
+    from tendermint_tpu.lint import rules_program, rules_wire
+
+    return [r() for r in rules_program.RULES + rules_wire.RULES]
+
+
 # --- the single pass --------------------------------------------------------
 
 
@@ -240,8 +248,12 @@ def lint_source(
     rel_path: str,
     config: LintConfig | None = None,
     rules: list[Rule] | None = None,
+    keep_suppressed: bool = False,
 ) -> list[Finding]:
-    """Lint one module's source. Suppressions applied, baseline not."""
+    """Lint one module's source. Baseline not applied. Suppressed
+    findings are dropped unless ``keep_suppressed`` — then they come
+    back flagged ``suppressed=True`` (the --list-suppressions audit and
+    the --stats counters feed on them)."""
     config = config or LintConfig()
     rules = rules if rules is not None else all_rules()
     rules = [r for r in rules if r.code not in config.disable]
@@ -260,7 +272,13 @@ def lint_source(
     lines = source.splitlines()
     ctx = Context(rel_path=rel_path, config=config, lines=lines)
     _Walker(ctx, rules).visit(tree)
-    out = [f for f in ctx.findings if not is_suppressed(f, lines)]
+    out = []
+    for f in ctx.findings:
+        if is_suppressed(f, lines):
+            if keep_suppressed:
+                out.append(dataclasses.replace(f, suppressed=True))
+        else:
+            out.append(f)
     out.sort(key=lambda f: (f.path, f.line, f.col, f.code))
     return out
 
@@ -290,23 +308,137 @@ def lint_paths(
     config: LintConfig | None = None,
     baseline: Baseline | None = None,
     rules: list[Rule] | None = None,
+    keep_suppressed: bool = False,
+    program: bool = True,
+    use_cache: bool = True,
+    changed: set[str] | None = None,
+    reindexed_out: list[str] | None = None,
 ) -> list[Finding]:
-    """Lint a tree. Findings present in `baseline` come back with
-    ``baselined=True`` (the CLI/gate ignores them); new ones are live."""
+    """Lint a tree — both passes.
+
+    Pass 1 walks every file once, producing the per-file rule findings
+    AND the module index; both are cached in ``config.cache`` keyed by
+    (mtime, size, sha256, index version, config fingerprint), so a warm
+    run parses nothing. Pass 2 (``program=True``) runs the whole-program
+    rules (TM110/111/210/502, TM6xx) over the assembled ProjectIndex.
+
+    Findings present in `baseline` come back ``baselined=True`` (the
+    CLI/gate ignores them). `changed` (a set of repo-relative paths —
+    the ``--changed`` mode) restricts the *reported* findings to those
+    files while still indexing the whole tree, so interprocedural facts
+    stay whole-program. `reindexed_out`, when given, receives the rel
+    paths that were (re)indexed rather than served from cache.
+    """
+    from tendermint_tpu.lint.project import IndexCache, ProjectIndex, index_source
+
     root = Path(root).resolve()
     config = config or LintConfig()
     paths = paths or config.paths
     baseline = baseline or Baseline()
+    # a caller-supplied rule subset must not poison (or read) the shared
+    # findings cache, which is keyed on the config fingerprint only
+    use_cache = use_cache and rules is None
     rules = rules if rules is not None else all_rules()
+    rules = [r for r in rules if r.code not in config.disable]
+    cache = IndexCache(
+        (root / config.cache) if use_cache else None,
+        fingerprint=config.fingerprint(),
+    )
+    project = ProjectIndex(root=root)
     findings: list[Finding] = []
+    seen: set[str] = set()
     for f in iter_py_files(paths, root, config.exclude):
         try:
             rel = f.resolve().relative_to(root).as_posix()
         except ValueError:
             rel = f.as_posix()
-        source = f.read_text(encoding="utf-8")
-        for finding in lint_source(source, rel, config, rules):
-            if finding in baseline:
-                finding = dataclasses.replace(finding, baselined=True)
-            findings.append(finding)
-    return findings
+        if rel in seen:  # overlapping path args must not double-report
+            continue
+        seen.add(rel)
+        try:
+            stat = f.stat()
+        except OSError:
+            continue
+        box: dict = {}
+
+        def read(_f=f, _box=box) -> str:
+            if "src" not in _box:
+                _box["src"] = _f.read_text(encoding="utf-8")
+            return _box["src"]
+
+        entry = cache.lookup(rel, stat, read)
+        if entry is not None:
+            from tendermint_tpu.lint.project import ModuleIndex
+
+            project.modules[rel] = ModuleIndex.from_json(entry["index"])
+            file_findings = [Finding(**d) for d in entry["findings"]]
+        else:
+            source = read()
+            file_findings = lint_source(
+                source, rel, config, rules, keep_suppressed=True
+            )
+            index = index_source(source, rel)
+            project.modules[rel] = index
+            cache.store(
+                rel, stat, source, index, [fi.to_json() for fi in file_findings]
+            )
+        findings.extend(file_findings)
+    cache.save()
+    if reindexed_out is not None:
+        reindexed_out.extend(cache.reindexed)
+
+    if program:
+        findings.extend(
+            _run_program_rules(project, config, root, keep_suppressed=True)
+        )
+
+    out: list[Finding] = []
+    for finding in findings:
+        if changed is not None and finding.path not in changed:
+            continue
+        if finding.suppressed and not keep_suppressed:
+            continue
+        if finding in baseline:
+            finding = dataclasses.replace(finding, baselined=True)
+        out.append(finding)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return out
+
+
+def _run_program_rules(
+    project, config: LintConfig, root: Path, keep_suppressed: bool
+) -> list[Finding]:
+    """Pass 2. Inline suppressions apply to program findings exactly as
+    to per-file ones — the flagged line is re-read from the (few) files
+    that actually have findings."""
+    from tendermint_tpu.lint.findings import suppressed_codes
+    from tendermint_tpu.lint.rules_program import _Analysis
+
+    prog_rules = [r for r in all_program_rules() if r.code not in config.disable]
+    if not prog_rules:
+        return []
+    analysis = _Analysis(project)
+    raw: list[Finding] = []
+    for rule in prog_rules:
+        raw.extend(rule.check(project, config, root, analysis=analysis))
+    lines_cache: dict[str, list[str]] = {}
+    out: list[Finding] = []
+    for f in raw:
+        lines = lines_cache.get(f.path)
+        if lines is None:
+            try:
+                lines = (root / f.path).read_text(encoding="utf-8").splitlines()
+            except OSError:
+                lines = []
+            lines_cache[f.path] = lines
+        codes = (
+            suppressed_codes(lines[f.line - 1])
+            if 1 <= f.line <= len(lines)
+            else None
+        )
+        if codes is not None and ("all" in codes or f.code in codes):
+            if keep_suppressed:
+                out.append(dataclasses.replace(f, suppressed=True))
+            continue
+        out.append(f)
+    return out

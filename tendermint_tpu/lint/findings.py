@@ -37,6 +37,7 @@ class Finding:
     message: str
     hint: str = ""  # how to fix (or suppress) it
     baselined: bool = field(default=False, compare=False)
+    suppressed: bool = field(default=False, compare=False)  # inline-disabled
 
     @property
     def key(self) -> tuple[str, str, int]:
@@ -44,15 +45,28 @@ class Finding:
 
     def render(self) -> str:
         tag = " [baselined]" if self.baselined else ""
+        if self.suppressed:
+            tag += " [suppressed]"
         out = f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}{tag}"
         if self.hint:
             out += f"\n    hint: {self.hint}"
         return out
 
+    def render_github(self) -> str:
+        """GitHub Actions error-annotation format (one line; newlines in
+        the message become %0A per the workflow-command spec)."""
+        msg = self.message + (f" — hint: {self.hint}" if self.hint else "")
+        msg = msg.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+        return (
+            f"::error file={self.path},line={self.line},"
+            f"col={self.col + 1},title={self.code}::{msg}"
+        )
+
     def to_json(self) -> dict:
         d = asdict(self)
-        del d["baselined"]
-        d["baselined"] = self.baselined  # stable key order: flags last
+        for flag in ("baselined", "suppressed"):
+            del d[flag]
+            d[flag] = getattr(self, flag)  # stable key order: flags last
         return d
 
 

@@ -1,0 +1,682 @@
+"""tmlint pass 1 — the whole-program module indexer.
+
+PR 2's engine dispatches per-function AST rules one file at a time; the
+bug classes that sank real deployments since then (a blocking call one
+helper deep, an attribute shared between the asyncio loop and the
+scheduler's dispatcher thread, wall-clock taint laundered through a
+utility function) are invisible at that granularity. This module builds
+the cross-file view: one :class:`ModuleIndex` per file capturing every
+definition, call edge, attribute write (with the lock stack held at the
+write), taint/blocking site, dispatch boundary (``Thread(target=...)``,
+``asyncio.to_thread``, executor submits, signal handlers) and the
+declarative wire registries (p2p channel constants, ABCI ``Desc``
+tables, recorder/metrics names). Pass 2 (lint/contexts.py) resolves the
+call graph over these and the program rules (rules_program.py,
+rules_wire.py) run on top.
+
+Everything in an index is JSON-native — the on-disk cache
+(:class:`IndexCache`) is a single JSON document keyed by (mtime, size,
+sha256, INDEX_VERSION) per module, so a cached full-tree run re-parses
+only edited files. Pickle is deliberately not used (the AOT cache
+retired it for the same reason: a parseable-by-anyone cache file must
+not be an arbitrary-code-execution surface).
+
+Suppressions are honoured at *index* time for the transitive facts: a
+``# tmlint: disable=TM110`` on a blocking line removes the site from
+the blocking closure entirely (otherwise one reviewed site would
+re-fire at every caller), and likewise TM210 for taint sources.
+"""
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import re
+from dataclasses import asdict, dataclass, field
+from pathlib import Path
+
+from tendermint_tpu.lint.engine import dotted_name as dotted
+from tendermint_tpu.lint.engine import jit_static_names
+from tendermint_tpu.lint.findings import suppressed_codes
+from tendermint_tpu.lint.rules_async import (
+    BLOCKING_DOTTED,
+    BLOCKING_TAILS,
+    _is_blocking_wait_call,
+)
+
+# Bump when the summary shape changes: stale caches self-invalidate.
+INDEX_VERSION = 1
+
+# Interprocedural taint sources (TM210). Wider than TM201's wall-clock
+# set on purpose: monotonic/perf counters are per-process values — fine
+# for intervals, consensus-fatal once they feed sign-bytes or a hash.
+TAINT_CALLS = {
+    "time.time",
+    "time.time_ns",
+    "time.monotonic",
+    "time.monotonic_ns",
+    "time.perf_counter",
+    "time.perf_counter_ns",
+    "datetime.now",
+    "datetime.utcnow",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+    "os.urandom",
+    "uuid.uuid1",
+    "uuid.uuid4",
+    "secrets.token_bytes",
+    "secrets.token_hex",
+    "secrets.randbits",
+}
+_RANDOM_FNS = {
+    "random", "randrange", "randint", "randbytes", "getrandbits",
+    "choice", "choices", "shuffle", "sample", "uniform",
+}
+
+# Call names whose result feeds canonical bytes (TM210 sinks). Narrower
+# than TM203's name heuristic: `encode` alone is every wire message.
+SINK_RE = re.compile(r"sign_bytes|canonical|merkle|digest|sha\d|hash", re.IGNORECASE)
+
+# `with <expr>:` context expressions treated as thread locks for the
+# write-guard analysis (TM111). Condition objects wrap a lock.
+_LOCKISH = ("lock", "mutex", "cond")
+
+_CHANNEL_RE = re.compile(r"_CHANNEL$")
+
+
+def _is_lockish(expr: ast.AST) -> str | None:
+    d = dotted(expr)
+    if d is None:
+        return None
+    tail = d.rsplit(".", 1)[-1].lower()
+    return d if any(s in tail for s in _LOCKISH) else None
+
+
+def _is_literal_priority(node: ast.AST) -> bool:
+    """`Priority.FASTSYNC` / `priorities.Priority.LITE`: an explicit class
+    pin. A plain variable (`priority_scope(pri)`) is a re-pin of a value
+    captured elsewhere — pass-through, not a pin."""
+    d = dotted(node)
+    return d is not None and ("Priority." in d or d.startswith("Priority"))
+
+
+@dataclass
+class CallSite:
+    name: str  # dotted callee as written; "?.tail" when the receiver is dynamic
+    line: int
+    pinned: bool = False  # inside a literal priority_scope(...) block
+    arg_calls: list = field(default_factory=list)  # per-arg: [dotted call names]
+    arg_names: list = field(default_factory=list)  # per-arg: plain Name or None
+
+
+@dataclass
+class FunctionSummary:
+    qualname: str  # "fn", "Class.method", "outer.inner"
+    cls: str | None
+    line: int
+    is_async: bool
+    is_jit: bool
+    params: list = field(default_factory=list)
+    calls: list = field(default_factory=list)  # [CallSite]
+    blocking: list = field(default_factory=list)  # [[line, what, hint]]
+    taints: list = field(default_factory=list)  # [[line, what]]
+    returns_taint: bool = False
+    return_calls: list = field(default_factory=list)  # call names in return exprs
+    sink_calls: list = field(default_factory=list)  # [[name, line, [argcalls], [argnames]]]
+    sink_params: list = field(default_factory=list)  # params fed to sink calls
+    attr_writes: list = field(default_factory=list)  # [[attr, line, [locks]]]
+    pins: bool = False  # contains a literal priority_scope(...) pin
+    submits: list = field(default_factory=list)  # [[line, kind, pinned_or_literal_prio]]
+    spawns: list = field(default_factory=list)  # [[kind, target, line]]
+
+
+@dataclass
+class ModuleIndex:
+    rel_path: str
+    functions: dict = field(default_factory=dict)  # qualname -> FunctionSummary
+    classes: dict = field(default_factory=dict)  # name -> {bases, fields, methods}
+    imports: dict = field(default_factory=dict)  # alias -> dotted origin
+    instances: dict = field(default_factory=dict)  # module-level NAME -> class name
+    channels: list = field(default_factory=list)  # [[NAME, value, line]]
+    descs: list = field(default_factory=list)  # [{name, line, fields:[[num, attr, line]]}]
+    oneofs: dict = field(default_factory=dict)  # listname -> [[num, class_dotted, line]]
+    events: list = field(default_factory=list)  # [[subsystem, kind, line]]
+    metrics: list = field(default_factory=list)  # [[subsystem, name, line]]
+
+    def to_json(self) -> dict:
+        d = asdict(self)
+        d["functions"] = {q: asdict(s) for q, s in self.functions.items()}
+        return d
+
+    @classmethod
+    def from_json(cls, d: dict) -> "ModuleIndex":
+        m = cls(rel_path=d["rel_path"])
+        for q, s in d.get("functions", {}).items():
+            # never mutate `s`: it may be the LIVE cache entry, and a
+            # dirty run would then persist it with the calls stripped —
+            # silently blinding every whole-program rule on later runs
+            fs = FunctionSummary(**{**s, "calls": []})
+            fs.calls = [CallSite(**c) for c in s.get("calls", [])]
+            m.functions[q] = fs
+        for k in ("classes", "imports", "instances", "oneofs"):
+            setattr(m, k, d.get(k, {}))
+        for k in ("channels", "descs", "events", "metrics"):
+            setattr(m, k, d.get(k, []))
+        return m
+
+
+class _Indexer(ast.NodeVisitor):
+    def __init__(self, index: ModuleIndex, lines: list[str]):
+        self.idx = index
+        self.lines = lines
+        self.fn_stack: list[FunctionSummary] = []
+        self.cls_stack: list[str] = []
+        self.pin_depth = 0
+        self.lock_stack: list[str] = []
+        self.parents: list[ast.AST] = []
+
+    # -- helpers -------------------------------------------------------------
+
+    def _suppressed(self, line: int, *codes: str) -> bool:
+        if not (1 <= line <= len(self.lines)):
+            return False
+        got = suppressed_codes(self.lines[line - 1])
+        if got is None:
+            return False
+        return "all" in got or any(c in got for c in codes)
+
+    @property
+    def fn(self) -> FunctionSummary | None:
+        return self.fn_stack[-1] if self.fn_stack else None
+
+    def generic_visit(self, node: ast.AST) -> None:
+        self.parents.append(node)
+        try:
+            super().generic_visit(node)
+        finally:
+            self.parents.pop()
+
+    # -- defs ----------------------------------------------------------------
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        if not self.fn_stack and len(self.cls_stack) == 0:
+            fields = [
+                s.target.id
+                for s in node.body
+                if isinstance(s, ast.AnnAssign) and isinstance(s.target, ast.Name)
+            ]
+            self.idx.classes[node.name] = {
+                "bases": [d for d in map(dotted, node.bases) if d],
+                "fields": fields,
+                "line": node.lineno,
+                "methods": [],
+            }
+        self.cls_stack.append(node.name)
+        try:
+            self.generic_visit(node)
+        finally:
+            self.cls_stack.pop()
+
+    def _visit_fn(self, node, is_async: bool) -> None:
+        cls = self.cls_stack[-1] if self.cls_stack else None
+        if self.fn_stack:
+            qual = f"{self.fn_stack[-1].qualname}.{node.name}"
+        elif cls:
+            qual = f"{cls}.{node.name}"
+        else:
+            qual = node.name
+        args = node.args
+        params = [
+            a.arg
+            for a in args.posonlyargs + args.args + args.kwonlyargs
+        ]
+        summ = FunctionSummary(
+            qualname=qual,
+            cls=cls,
+            line=node.lineno,
+            is_async=is_async,
+            is_jit=jit_static_names(node) is not None,
+            params=params,
+        )
+        self.idx.functions[qual] = summ
+        if cls and cls in self.idx.classes and not self.fn_stack:
+            self.idx.classes[cls]["methods"].append(node.name)
+        self.fn_stack.append(summ)
+        # a nested def sees a fresh lock/pin state: its body runs later,
+        # not under the enclosing with-blocks
+        saved = (self.pin_depth, self.lock_stack)
+        self.pin_depth, self.lock_stack = 0, []
+        try:
+            self.generic_visit(node)
+        finally:
+            self.pin_depth, self.lock_stack = saved
+            self.fn_stack.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_fn(node, is_async=False)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._visit_fn(node, is_async=True)
+
+    # -- imports / module-level registries ------------------------------------
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            if a.asname:
+                self.idx.imports[a.asname] = a.name
+            else:
+                # `import a.b` binds only the ROOT name `a` — mapping it
+                # to "a.b" would resolve `a.fn()` into module a/b.py
+                root = a.name.split(".")[0]
+                self.idx.imports[root] = root
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module and node.level == 0:
+            for a in node.names:
+                if a.name != "*":
+                    self.idx.imports[a.asname or a.name] = f"{node.module}.{a.name}"
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if not self.fn_stack and not self.cls_stack:
+            self._module_assign(node)
+        self._maybe_attr_write(node.targets, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._maybe_attr_write([node.target], node.lineno)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._maybe_attr_write([node.target], node.lineno)
+        self.generic_visit(node)
+
+    def _maybe_attr_write(self, targets, line: int) -> None:
+        if self.fn is None:
+            return
+        for t in targets:
+            if isinstance(t, (ast.Tuple, ast.List)):
+                self._maybe_attr_write(list(t.elts), line)
+                continue
+            if (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+            ):
+                self.fn.attr_writes.append([t.attr, line, list(self.lock_stack)])
+
+    def _module_assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            if not isinstance(t, ast.Name):
+                continue
+            v = node.value
+            if (
+                _CHANNEL_RE.search(t.id)
+                and isinstance(v, ast.Constant)
+                and isinstance(v.value, int)
+            ):
+                self.idx.channels.append([t.id, v.value, node.lineno])
+            elif isinstance(v, ast.Call):
+                callee = dotted(v.func)
+                if callee == "Desc" and v.args:
+                    self._desc(t.id, v, node.lineno)
+                elif callee and callee[0].isupper() and "." not in callee:
+                    # NAME = ClassName(...): a module-level singleton —
+                    # NAME.method later resolves to ClassName.method
+                    self.idx.instances[t.id] = callee
+            elif isinstance(v, (ast.List, ast.Tuple)):
+                arms = []
+                for el in v.elts:
+                    if (
+                        isinstance(el, ast.Tuple)
+                        and len(el.elts) >= 2
+                        and isinstance(el.elts[0], ast.Constant)
+                        and isinstance(el.elts[0].value, int)
+                    ):
+                        ref = dotted(el.elts[1])
+                        if ref:
+                            arms.append([el.elts[0].value, ref, el.lineno])
+                if arms:
+                    self.idx.oneofs[t.id] = arms
+
+    def _desc(self, _name: str, call: ast.Call, line: int) -> None:
+        """`X = Desc("Name", [(num, "attr", kind, sub), ...])` — the ABCI
+        wire-registry shape (abci/proto.py)."""
+        first = call.args[0]
+        if not (isinstance(first, ast.Constant) and isinstance(first.value, str)):
+            return
+        fields = []
+        if len(call.args) > 1:
+            arr = call.args[1]
+            elts = arr.elts if isinstance(arr, (ast.List, ast.Tuple)) else []
+            # Desc("X", list(_SHARED_FIELDS)) — shared field tables resolve
+            # to [] here; the Desc of record is the one with the literal list
+            for el in elts:
+                if (
+                    isinstance(el, ast.Tuple)
+                    and len(el.elts) >= 2
+                    and isinstance(el.elts[0], ast.Constant)
+                    and isinstance(el.elts[1], ast.Constant)
+                ):
+                    fields.append([el.elts[0].value, el.elts[1].value, el.lineno])
+        self.idx.descs.append({"name": first.value, "line": line, "fields": fields})
+
+    # -- with: pins and locks --------------------------------------------------
+
+    def _classify_with(self, node):
+        pins = 0
+        locks = []
+        for item in node.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Call):
+                d = dotted(expr.func)
+                if d and d.rsplit(".", 1)[-1] == "priority_scope":
+                    if expr.args and _is_literal_priority(expr.args[0]):
+                        pins += 1
+                    continue
+            lock = _is_lockish(expr)
+            if lock:
+                locks.append(lock)
+        return pins, locks
+
+    def _visit_with(self, node) -> None:
+        pins, locks = self._classify_with(node)
+        if pins and self.fn is not None:
+            self.fn.pins = True
+        self.pin_depth += pins
+        self.lock_stack.extend(locks)
+        try:
+            self.generic_visit(node)
+        finally:
+            self.pin_depth -= pins
+            if locks:
+                del self.lock_stack[-len(locks):]
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with
+
+    # -- returns ---------------------------------------------------------------
+
+    def visit_Return(self, node: ast.Return) -> None:
+        if self.fn is not None and node.value is not None:
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Call):
+                    d = dotted(sub.func)
+                    if d is None:
+                        continue
+                    if self._is_taint_call(d):
+                        if not self._suppressed(sub.lineno, "TM201", "TM202", "TM210"):
+                            self.fn.returns_taint = True
+                    else:
+                        self.fn.return_calls.append(d)
+        self.generic_visit(node)
+
+    @staticmethod
+    def _is_taint_call(d: str) -> bool:
+        if d in TAINT_CALLS:
+            return True
+        return d.startswith("random.") and d.split(".", 1)[1] in _RANDOM_FNS
+
+    # -- calls -----------------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = self.fn
+        name = dotted(node.func)
+        tail = node.func.attr if isinstance(node.func, ast.Attribute) else name
+        if fn is not None:
+            self._record_call(fn, node, name, tail)
+        self._record_registry(node, name, tail)
+        self.generic_visit(node)
+
+    def _record_call(self, fn, node, name, tail) -> None:
+        line = node.lineno
+        arg_calls: list[list[str]] = []
+        arg_names: list = []
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            arg_names.append(arg.id if isinstance(arg, ast.Name) else None)
+            inner: list[str] = []
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Call):
+                    d = dotted(sub.func)
+                    if d:
+                        inner.append(d)
+            arg_calls.append(inner)
+        fn.calls.append(
+            CallSite(
+                name=name or f"?.{tail}" if tail else "?",
+                line=line,
+                pinned=self.pin_depth > 0,
+                arg_calls=arg_calls,
+                arg_names=arg_names,
+            )
+        )
+        # direct blocking sites (the TM101 tables) — suppression at the
+        # site kills the transitive closure too
+        awaited = bool(self.parents) and isinstance(self.parents[-1], ast.Await)
+        if not awaited and not self._suppressed(line, "TM101", "TM110"):
+            if name in BLOCKING_DOTTED:
+                fn.blocking.append([line, f"{name}(...)", BLOCKING_DOTTED[name]])
+            elif tail in BLOCKING_TAILS and _is_blocking_wait_call(node):
+                fn.blocking.append([line, f".{tail}(...)", BLOCKING_TAILS[tail]])
+            elif tail == "join" and name != "?" and _is_blocking_wait_call(node):
+                fn.blocking.append([line, ".join(...)", "thread/process join"])
+        # taint sources
+        if name and self._is_taint_call(name):
+            if not self._suppressed(line, "TM201", "TM202", "TM210"):
+                fn.taints.append([line, name])
+        # sink calls: callee name says the result feeds canonical bytes
+        sinkish = bool(name and SINK_RE.search(name)) or bool(
+            tail and SINK_RE.search(tail)
+        )
+        if not sinkish and tail == "update":
+            recv = dotted(node.func.value) if isinstance(node.func, ast.Attribute) else None
+            sinkish = bool(recv and SINK_RE.search(recv)) or bool(
+                SINK_RE.search(fn.qualname)
+            )
+        if sinkish:
+            fn.sink_calls.append([name or f"?.{tail}", line, arg_calls, arg_names])
+            for nm in arg_names:
+                if nm in fn.params and nm not in fn.sink_params:
+                    fn.sink_params.append(nm)
+        # dispatch boundaries
+        self._record_spawn(fn, node, name, tail)
+        # device-submit sites
+        kind = self._submit_kind(node, name, tail)
+        if kind:
+            literal_prio = any(
+                kw.arg == "priority" and _is_literal_priority(kw.value)
+                for kw in node.keywords
+            )
+            fn.submits.append([line, kind, self.pin_depth > 0 or literal_prio])
+
+    def _record_spawn(self, fn, node, name, tail) -> None:
+        def target_of(val) -> str | None:
+            if isinstance(val, ast.Call):  # spawn_logged(g(...)) spawns g
+                return dotted(val.func)
+            return dotted(val)
+
+        if name and name.rsplit(".", 1)[-1] in ("Thread", "Timer"):
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    t = target_of(kw.value)
+                    if t:
+                        fn.spawns.append(["thread", t, node.lineno])
+        elif (name and name.endswith("to_thread")) or tail == "to_thread":
+            if node.args:
+                t = target_of(node.args[0])
+                if t:
+                    fn.spawns.append(["worker", t, node.lineno])
+        elif tail == "run_in_executor" and len(node.args) >= 2:
+            t = target_of(node.args[1])
+            if t:
+                fn.spawns.append(["worker", t, node.lineno])
+        elif tail in ("submit", "map") and isinstance(node.func, ast.Attribute):
+            recv = (dotted(node.func.value) or "").lower()
+            if ("pool" in recv or "executor" in recv) and node.args:
+                t = target_of(node.args[0])
+                if t:
+                    fn.spawns.append(["worker", t, node.lineno])
+        elif name == "signal.signal" and len(node.args) >= 2:
+            t = target_of(node.args[1])
+            if t:
+                fn.spawns.append(["signal", t, node.lineno])
+        elif tail == "add_signal_handler" and len(node.args) >= 2:
+            t = target_of(node.args[1])
+            if t:
+                fn.spawns.append(["signal", t, node.lineno])
+        elif tail in ("create_task", "ensure_future") or name in (
+            "spawn_logged",
+            "asyncio.create_task",
+            "asyncio.ensure_future",
+        ):
+            if node.args:
+                t = target_of(node.args[0])
+                if t:
+                    fn.spawns.append(["task", t, node.lineno])
+
+    @staticmethod
+    def _submit_kind(node: ast.Call, name, tail) -> str | None:
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in ("submit", "submit_sync", "verify") and isinstance(
+                f.value, ast.Call
+            ):
+                inner = dotted(f.value.func)
+                if inner and inner.rsplit(".", 1)[-1] == "get_scheduler":
+                    return f"scheduler.{f.attr}"
+            if f.attr == "verify_all":
+                return "verify_all"
+        return None
+
+    # -- registry extraction ---------------------------------------------------
+
+    def _record_registry(self, node: ast.Call, name, tail) -> None:
+        line = node.lineno
+        strs = []
+        for a in node.args[:2]:
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                strs.append(a.value)
+            else:
+                break
+        if tail == "record" and len(strs) == 2:
+            self.idx.events.append([strs[0], strs[1], line])
+        elif tail in ("counter", "gauge", "histogram", "histogram_vec") and len(
+            strs
+        ) == 2:
+            self.idx.metrics.append([strs[0], strs[1], line])
+        elif name and name.rsplit(".", 1)[-1] == "ChannelDescriptor":
+            first = node.args[0] if node.args else None
+            for kw in node.keywords:
+                if kw.arg == "id":
+                    first = kw.value
+            if isinstance(first, ast.Constant) and isinstance(first.value, int):
+                self.idx.channels.append(["<literal>", first.value, line])
+
+
+def index_source(source: str, rel_path: str) -> ModuleIndex:
+    idx = ModuleIndex(rel_path=rel_path)
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return idx  # per-file pass reports TM001; nothing to index
+    _Indexer(idx, source.splitlines()).visit(tree)
+    return idx
+
+
+# ----------------------------------------------------------------- the cache
+
+
+class IndexCache:
+    """One JSON document mapping rel_path -> {key, index, findings}.
+
+    `key` is (mtime_ns, size, sha256, INDEX_VERSION). mtime+size gate the
+    fast path; on mismatch the source is hashed, and only a hash mismatch
+    re-indexes — so `touch` alone re-keys without a re-parse. The cache
+    also carries the per-file rule findings (all of them, suppressed ones
+    flagged) so a warm run does no parsing at all.
+    """
+
+    # configs kept side by side in the cache file: the CI job (and any
+    # local workflow) alternates full runs with --select subsets, and a
+    # single-config cache would cold-parse on every alternation
+    MAX_CONFIGS = 6
+
+    def __init__(self, path: str | Path | None, fingerprint: str = ""):
+        self.path = Path(path) if path else None
+        self.fingerprint = fingerprint
+        self.entries: dict[str, dict] = {}
+        self._configs: dict[str, dict] = {}  # fingerprint -> modules
+        self.dirty = False
+        self.reindexed: list[str] = []  # rel paths indexed fresh this run
+        if self.path is not None and self.path.exists():
+            try:
+                doc = json.loads(self.path.read_text(encoding="utf-8"))
+                if doc.get("version") == INDEX_VERSION:
+                    self._configs = doc.get("configs", {})
+                    self.entries = self._configs.get(fingerprint, {})
+            except (ValueError, OSError):
+                self.entries = {}
+
+    def lookup(self, rel: str, stat, source_of) -> dict | None:
+        """Cached entry for `rel` when still valid, else None. `stat` is
+        an os.stat_result; `source_of()` lazily reads the file for the
+        hash check when mtime/size moved."""
+        e = self.entries.get(rel)
+        if e is None:
+            return None
+        key = e.get("key", {})
+        if key.get("mtime_ns") == stat.st_mtime_ns and key.get("size") == stat.st_size:
+            return e
+        digest = hashlib.sha256(source_of().encode("utf-8")).hexdigest()
+        if key.get("sha256") == digest:
+            # content identical, stat moved (checkout, touch): re-key only
+            e["key"]["mtime_ns"] = stat.st_mtime_ns
+            e["key"]["size"] = stat.st_size
+            self.dirty = True
+            return e
+        return None
+
+    def store(self, rel: str, stat, source: str, index: ModuleIndex, findings) -> None:
+        self.entries[rel] = {
+            "key": {
+                "mtime_ns": stat.st_mtime_ns,
+                "size": stat.st_size,
+                "sha256": hashlib.sha256(source.encode("utf-8")).hexdigest(),
+            },
+            "index": index.to_json(),
+            "findings": findings,
+        }
+        self.dirty = True
+        self.reindexed.append(rel)
+
+    def save(self) -> None:
+        if self.path is None or not self.dirty:
+            return
+        self._configs.pop(self.fingerprint, None)
+        while len(self._configs) >= self.MAX_CONFIGS:
+            self._configs.pop(next(iter(self._configs)))  # oldest-inserted
+        self._configs[self.fingerprint] = self.entries
+        try:
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            tmp = self.path.with_suffix(".tmp")
+            tmp.write_text(
+                json.dumps(
+                    {"version": INDEX_VERSION, "configs": self._configs}
+                ),
+                encoding="utf-8",
+            )
+            tmp.replace(self.path)
+        except OSError:
+            pass  # a read-only tree just runs uncached
+
+
+@dataclass
+class ProjectIndex:
+    """Every module index plus the root, handed to pass-2 rules."""
+
+    root: Path
+    modules: dict = field(default_factory=dict)  # rel_path -> ModuleIndex
+
+    def module(self, rel: str) -> ModuleIndex | None:
+        return self.modules.get(rel)

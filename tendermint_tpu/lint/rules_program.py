@@ -1,0 +1,381 @@
+"""TM1xx/TM2xx/TM5xx whole-program rules — the interprocedural tier.
+
+These run once over the ProjectIndex (lint/project.py) + inferred
+contexts (lint/contexts.py), not per file. They are the Python analogue
+of the `-race` / vet gate the reference keeps in CI: the per-function
+rules catch the hazard written in one place; these catch it assembled
+from innocent-looking pieces across files.
+
+- TM110: a coroutine calls a sync helper that (transitively) blocks —
+  the stall TM101 cannot see because the `time.sleep` lives one or more
+  helpers deep.
+- TM111: an instance attribute written from >=2 execution contexts with
+  no common lock held at every write — a cross-thread data race.
+- TM210: wall-clock/random taint flowing through function returns into
+  sign-bytes/hash construction in a determinism path.
+- TM502: a device-submit path (DeviceScheduler submit / BatchVerifier
+  verify_all) reachable from a background subsystem with no
+  priority_scope pinned anywhere on the call chain — the work mistags
+  as CONSENSUS_COMMIT and steals the consensus hot path's priority.
+"""
+from __future__ import annotations
+
+from pathlib import Path
+
+from tendermint_tpu.lint.config import LintConfig
+from tendermint_tpu.lint.contexts import (
+    JIT,
+    blocking_chain,
+    infer_contexts,
+    tainted_functions,
+)
+from tendermint_tpu.lint.findings import Finding
+from tendermint_tpu.lint.project import ProjectIndex
+
+
+class ProgramRule:
+    """Base: whole-program rules implement check(project, config, root)."""
+
+    code = "TM000"
+    name = ""
+    help = ""
+
+    def check(
+        self, project: ProjectIndex, config: LintConfig, root: Path
+    ) -> list[Finding]:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def finding(self, rel: str, line: int, message: str, hint: str = "") -> Finding:
+        return Finding(
+            code=self.code, path=rel, line=line, col=0, message=message,
+            hint=hint or self.help,
+        )
+
+
+class _Analysis:
+    """Shared per-run analysis (contexts, resolver, edges) built once and
+    handed to every program rule — four rules, one graph."""
+
+    def __init__(self, project: ProjectIndex):
+        self.project = project
+        self.contexts, self.resolver, self.edges = infer_contexts(project)
+
+    def fn(self, key):
+        idx = self.project.module(key[0])
+        return idx.functions.get(key[1]) if idx else None
+
+    def ctxs(self, key) -> set:
+        ci = self.contexts.get(key)
+        return set(ci.contexts) if ci else set()
+
+
+# ---------------------------------------------------------------- TM110
+
+
+class TM110TransitiveBlockingInCoroutine(ProgramRule):
+    code = "TM110"
+    name = "transitively-blocking-call-from-coroutine"
+    help = (
+        "The called helper eventually executes a blocking call, so the "
+        "event loop stalls exactly as if the coroutine blocked directly "
+        "(TM101) — move the helper to `await asyncio.to_thread(...)`, or "
+        "make the chain non-blocking."
+    )
+
+    def check(self, project, config, root, analysis: _Analysis | None = None):
+        a = analysis or _Analysis(project)
+        findings: list[Finding] = []
+        memo: dict = {}
+        for rel, idx in project.modules.items():
+            for qual, fs in idx.functions.items():
+                if not fs.is_async:
+                    continue
+                for c in fs.calls:
+                    ck = a.resolver.resolve(rel, fs.cls, c.name)
+                    if ck is None or ck == (rel, qual):
+                        continue
+                    cfs = a.fn(ck)
+                    if cfs is None or cfs.is_async:
+                        continue
+                    chain = blocking_chain(project, a.resolver, ck, memo)
+                    if chain is None:
+                        continue
+                    hops = " -> ".join([ck[1]] + [step[-1] for step in chain[:-1]])
+                    site = chain[-1]
+                    findings.append(
+                        self.finding(
+                            rel,
+                            c.line,
+                            f"coroutine `{qual}` calls `{c.name}(...)`, which "
+                            f"blocks: {hops} -> `{site[2]}` ({site[0]}:{site[1]})",
+                        )
+                    )
+        return findings
+
+
+# ---------------------------------------------------------------- TM111
+
+
+# Known-safe idioms, reviewed once here instead of suppressed at every
+# write: single C-level stores/appends that are atomic under the GIL and
+# tolerate torn interleavings by design. Each entry names its argument.
+TM111_SAFE = {
+    # FlightRecorder.record: one deque.append + one int store per event;
+    # seq is advisory (collector cursoring), races lose nothing but an
+    # approximate high-water mark — the module docstring is the contract.
+    ("tendermint_tpu/libs/recorder.py", "FlightRecorder", "_last_seq"),
+}
+
+
+class TM111CrossContextUnlockedWrite(ProgramRule):
+    code = "TM111"
+    name = "cross-context-unlocked-write"
+    help = (
+        "The attribute is written from more than one execution context "
+        "(event loop / dispatcher thread / pool worker) with no lock "
+        "common to every write: a data race. Guard every write with one "
+        "lock, confine the attribute to a single context, or — for a "
+        "reviewed GIL-atomic idiom — suppress with the justification."
+    )
+
+    def check(self, project, config, root, analysis: _Analysis | None = None):
+        a = analysis or _Analysis(project)
+        findings: list[Finding] = []
+        for rel, idx in project.modules.items():
+            for cls in idx.classes:
+                findings.extend(self._check_class(a, rel, idx, cls))
+        return findings
+
+    def _check_class(self, a: _Analysis, rel, idx, cls):
+        # attr -> [(qualname, line, locks, ctxs)]
+        writes: dict[str, list] = {}
+        for qual, fs in idx.functions.items():
+            if fs.cls != cls or not fs.attr_writes:
+                continue
+            method = qual.rsplit(".", 1)[-1]
+            if method in ("__init__", "__new__", "__post_init__"):
+                continue  # construction happens-before publication
+            ctxs = a.ctxs((rel, qual)) - {JIT}
+            if not ctxs:
+                continue  # unreachable/unresolved: contributes no context
+            for attr, line, locks in fs.attr_writes:
+                writes.setdefault(attr, []).append((qual, line, set(locks), ctxs))
+        out = []
+        for attr, sites in writes.items():
+            if (rel, cls, attr) in TM111_SAFE:
+                continue
+            all_ctxs = set().union(*(s[3] for s in sites))
+            if len(all_ctxs) < 2:
+                continue
+            common = set.intersection(*(s[2] for s in sites))
+            if common:
+                continue
+            # report at a write reachable from the minority context
+            sites_sorted = sorted(sites, key=lambda s: (len(s[3]), s[1]))
+            qual, line, _locks, _ctxs = sites_sorted[0]
+            where = ", ".join(
+                f"`{q}` [{'/'.join(sorted(cx))}]" for q, _l, _k, cx in sites
+            )
+            out.append(
+                self.finding(
+                    rel,
+                    line,
+                    f"`self.{attr}` on {cls} is written from "
+                    f"{len(all_ctxs)} execution contexts "
+                    f"({'/'.join(sorted(all_ctxs))}) with no common lock: "
+                    f"{where}",
+                )
+            )
+        return out
+
+
+# ---------------------------------------------------------------- TM210
+
+
+class TM210InterproceduralDeterminismTaint(ProgramRule):
+    code = "TM210"
+    name = "determinism-taint-feeds-hash"
+    help = (
+        "A wall-clock/random-derived value reaches sign-bytes/hash "
+        "construction through a helper call — replicas hash different "
+        "bytes. Thread deterministic state in explicitly; TM201 only "
+        "sees the direct read, this chain hid it behind a return value."
+    )
+
+    def check(self, project, config, root, analysis: _Analysis | None = None):
+        a = analysis or _Analysis(project)
+        tainted = tainted_functions(project, a.resolver)
+        findings: list[Finding] = []
+        for rel, idx in project.modules.items():
+            if not config.in_determinism_scope(rel):
+                continue
+            for qual, fs in idx.functions.items():
+                # tainted helper results flowing into a sink call's args
+                for name, line, arg_calls, _argn in fs.sink_calls:
+                    for called in (d for per_arg in arg_calls for d in per_arg):
+                        ck = a.resolver.resolve(rel, fs.cls, called)
+                        if ck is not None and ck in tainted:
+                            findings.append(
+                                self.finding(
+                                    rel,
+                                    line,
+                                    f"`{name}(...)` consumes `{called}(...)`, "
+                                    f"which {tainted[ck]}",
+                                )
+                            )
+                # tainted values passed into a callee's hash-feeding param
+                for c in fs.calls:
+                    ck = a.resolver.resolve(rel, fs.cls, c.name)
+                    if ck is None:
+                        continue
+                    cfs = a.fn(ck)
+                    if cfs is None or not cfs.sink_params:
+                        continue
+                    params = cfs.params
+                    if params and params[0] in ("self", "cls"):
+                        params = params[1:]
+                    for i, called in enumerate(c.arg_calls):
+                        if i >= len(params):
+                            break
+                        if params[i] not in cfs.sink_params:
+                            continue
+                        for inner in called:
+                            ik = a.resolver.resolve(rel, fs.cls, inner)
+                            if ik is not None and ik in tainted:
+                                findings.append(
+                                    self.finding(
+                                        rel,
+                                        c.line,
+                                        f"`{c.name}(...)` feeds its "
+                                        f"`{cfs.params[i]}` parameter into "
+                                        f"hashing, and the argument comes "
+                                        f"from `{inner}(...)`, which "
+                                        f"{tainted[ik]}",
+                                    )
+                                )
+        return findings
+
+
+# ---------------------------------------------------------------- TM502
+
+
+class TM502UnpinnedDeviceSubmitPath(ProgramRule):
+    code = "TM502"
+    name = "device-submit-path-without-priority"
+    help = (
+        "This entry point reaches a DeviceScheduler submission with no "
+        "`priority_scope(...)` pinned anywhere on the chain, so the work "
+        "dispatches at the default CONSENSUS_COMMIT class and competes "
+        "with the consensus hot path. Pin the subsystem's class "
+        "(docs/device_scheduler.md) at the entry."
+    )
+
+    # the dispatch machinery itself is exempt: it owns the default
+    _MACHINERY = (
+        "tendermint_tpu/device/",
+        "tendermint_tpu/ops/",
+        "tendermint_tpu/crypto/",
+    )
+
+    def check(self, project, config, root, analysis: _Analysis | None = None):
+        a = analysis or _Analysis(project)
+        reaches: dict = {}
+
+        def reaches_unpinned(key, stack=frozenset()):
+            if key in reaches:
+                return reaches[key]
+            if key in stack:
+                return None
+            fs = a.fn(key)
+            if fs is None:
+                return None
+            for line, kind, pinned in fs.submits:
+                if not pinned:
+                    reaches[key] = (line, kind, [])
+                    return reaches[key]
+            stack = stack | {key}
+            for c in fs.calls:
+                if c.pinned:
+                    continue
+                ck = a.resolver.resolve(key[0], fs.cls, c.name)
+                if ck is None or ck == key:
+                    continue
+                cfs = a.fn(ck)
+                if cfs is None:
+                    continue
+                sub = reaches_unpinned(ck, stack)
+                if sub is not None:
+                    reaches[key] = (c.line, f"via {ck[1]}", [ck[1]] + sub[2])
+                    return reaches[key]
+            reaches[key] = None
+            return None
+
+        # reverse edges for the root walk
+        rev: dict = {}
+        for caller, callee, line, pinned in a.edges:
+            rev.setdefault(callee, []).append((caller, pinned))
+
+        def unpinned_root(key, seen=None) -> bool:
+            """True when some chain of unpinned calls leads here from a
+            function nobody in-project calls (a framework entry)."""
+            seen = seen if seen is not None else set()
+            if key in seen:
+                return False
+            seen.add(key)
+            callers = rev.get(key, [])
+            if not callers:
+                return True
+            for caller, pinned in callers:
+                if pinned:
+                    continue  # that path enters under a pin
+                if unpinned_root(caller, seen):
+                    return True
+            return False
+
+        def candidate(key) -> bool:
+            rel = key[0]
+            return (
+                config.in_priority_scope(rel)
+                and not rel.startswith(self._MACHINERY)
+                and reaches_unpinned(key) is not None
+                and unpinned_root(key)
+            )
+
+        findings = []
+        for rel, idx in project.modules.items():
+            if not config.in_priority_scope(rel):
+                continue
+            if rel.startswith(self._MACHINERY):
+                continue
+            for qual, fs in idx.functions.items():
+                key = (rel, qual)
+                if not candidate(key):
+                    continue
+                # report only at the TOPMOST candidate of each chain: a
+                # helper whose unpinned caller is itself a candidate will
+                # be covered by the caller's finding
+                if any(
+                    not pinned and candidate(caller)
+                    for caller, pinned in rev.get(key, [])
+                ):
+                    continue
+                line, what, chain = reaches_unpinned(key)
+                via = " -> ".join(chain) if chain else what
+                findings.append(
+                    self.finding(
+                        rel,
+                        line,
+                        f"`{qual}` reaches a device submission "
+                        f"({via or what}) with no priority_scope pinned on "
+                        "the chain",
+                    )
+                )
+        return findings
+
+
+RULES = [
+    TM110TransitiveBlockingInCoroutine,
+    TM111CrossContextUnlockedWrite,
+    TM210InterproceduralDeterminismTaint,
+    TM502UnpinnedDeviceSubmitPath,
+]

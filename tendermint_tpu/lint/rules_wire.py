@@ -1,0 +1,299 @@
+"""TM6xx — wire-schema and catalogue conformance.
+
+These rules cross-check *declarative registries* rather than code
+paths: the facts they compare are data the indexer lifted out of
+module-level constants, so the checks are exact (no heuristics, no
+suppression judgment calls) and a mismatch is a protocol bug by
+construction.
+
+- TM601: p2p channel IDs must be unique across every reactor. Two
+  reactors claiming one channel byte means the switch routes one
+  reactor's frames into the other's decoder — instant `bad_message`
+  storms against honest peers.
+- TM602: the ABCI wire registries must agree: no duplicate field
+  numbers or attrs inside a proto ``Desc``, every Desc attr maps onto
+  the CBE dataclass it mirrors (modulo the declared alias table), every
+  Request/Response dataclass rides exactly one oneof arm, and arm
+  numbers never collide.
+- TM603: every recorder event `(subsystem, kind)` and metrics series
+  `(subsystem, name)` emitted in code must appear in the
+  docs/observability.md catalogue — the fleet collector and operators
+  navigate by that table, so an undocumented event is invisible
+  telemetry.
+"""
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+from tendermint_tpu.lint.rules_program import ProgramRule
+
+
+class TM601ChannelIdCollision(ProgramRule):
+    code = "TM601"
+    name = "p2p-channel-id-collision"
+    help = (
+        "Two reactors declare the same p2p channel byte; the switch can "
+        "only deliver each channel to one reactor, so one of them "
+        "receives the other's frames. Pick an unused id (see the "
+        "channel table in docs/p2p_resilience.md)."
+    )
+
+    def check(self, project, config, root, analysis=None):
+        # value -> [(rel, name, line)], definitions only (imports of a
+        # shared constant are the same registry entry, not a collision)
+        by_value: dict[int, list] = {}
+        for rel, idx in project.modules.items():
+            for name, value, line in idx.channels:
+                if name == "<literal>":
+                    continue  # literal ChannelDescriptor ids checked below
+                by_value.setdefault(value, []).append((rel, name, line))
+        findings = []
+        for value, sites in sorted(by_value.items()):
+            if len(sites) < 2:
+                continue
+            first = sites[0]
+            for rel, name, line in sites[1:]:
+                findings.append(
+                    self.finding(
+                        rel,
+                        line,
+                        f"channel id {value:#04x} ({name}) collides with "
+                        f"{first[1]} ({first[0]}:{first[2]})",
+                    )
+                )
+        # a ChannelDescriptor built from a raw literal that collides with
+        # a named registry constant elsewhere
+        for rel, idx in project.modules.items():
+            named_here = {v for n, v, _l in idx.channels if n != "<literal>"}
+            for name, value, line in idx.channels:
+                if name != "<literal>" or value in named_here:
+                    continue
+                others = [s for s in by_value.get(value, []) if s[0] != rel]
+                if others:
+                    o = others[0]
+                    findings.append(
+                        self.finding(
+                            rel,
+                            line,
+                            f"literal channel id {value:#04x} collides with "
+                            f"{o[1]} ({o[0]}:{o[2]})",
+                        )
+                    )
+        return findings
+
+
+# proto attr -> CBE dataclass field renames that are *deliberate* (the
+# mapping lambdas in abci/proto.py translate them); everything else must
+# match by name. A tuple value means the proto field is a nested message
+# the CBE side flattens into several fields.
+TM602_ALIASES = {
+    ("RequestBeginBlock", "last_commit_info"): "last_commit_votes",
+    ("RequestCheckTx", "type"): "new_check",
+    ("ResponseQuery", "proof"): "proof_ops",
+    ("VoteInfo", "validator"): ("address", "power"),
+}
+# CBE-side fields with no proto wire counterpart by design (internal
+# bookkeeping the proto schema predates).
+TM602_CBE_ONLY: set = set()
+
+
+class TM602AbciSchemaMismatch(ProgramRule):
+    code = "TM602"
+    name = "abci-wire-schema-mismatch"
+    help = (
+        "The ABCI proto descriptors (abci/proto.py) and the CBE "
+        "dataclasses (abci/types.py) drifted: a field exists on one side "
+        "of the wire seam only, or a field/oneof number is duplicated. "
+        "Go/Rust apps see the proto side, in-process apps the CBE side — "
+        "they must carry the same data (docs/encoding.md)."
+    )
+
+    PROTO = "tendermint_tpu/abci/proto.py"
+    TYPES = "tendermint_tpu/abci/types.py"
+
+    def check(self, project, config, root, analysis=None):
+        proto = project.module(self.PROTO)
+        types_ = project.module(self.TYPES)
+        if proto is None or types_ is None:
+            return []  # fixture trees: nothing to cross-check
+        findings = []
+        class_fields = {
+            name: set(meta["fields"]) for name, meta in types_.classes.items()
+        }
+        seen_desc: dict[str, int] = {}
+        for desc in proto.descs:
+            name, line = desc["name"], desc["line"]
+            if name in seen_desc:
+                findings.append(
+                    self.finding(
+                        self.PROTO, line,
+                        f"duplicate Desc for message `{name}` "
+                        f"(first at line {seen_desc[name]})",
+                    )
+                )
+            seen_desc.setdefault(name, line)
+            nums: dict[int, str] = {}
+            attrs: set[str] = set()
+            for num, attr, fline in desc["fields"]:
+                if num in nums:
+                    findings.append(
+                        self.finding(
+                            self.PROTO, fline,
+                            f"{name}: field number {num} used by both "
+                            f"`{nums[num]}` and `{attr}`",
+                        )
+                    )
+                nums.setdefault(num, attr)
+                if attr in attrs:
+                    findings.append(
+                        self.finding(
+                            self.PROTO, fline,
+                            f"{name}: attr `{attr}` declared twice",
+                        )
+                    )
+                attrs.add(attr)
+            # cross-check against the CBE dataclass of the same name
+            fields = class_fields.get(name)
+            if fields is None or not desc["fields"]:
+                continue  # no CBE twin / shared-field Desc (checked via twin)
+            proto_mapped: set[str] = set()
+            for num, attr, fline in desc["fields"]:
+                mapped = TM602_ALIASES.get((name, attr), attr)
+                mapped = mapped if isinstance(mapped, tuple) else (mapped,)
+                proto_mapped.update(mapped)
+                missing = [m for m in mapped if m not in fields]
+                if missing:
+                    findings.append(
+                        self.finding(
+                            self.PROTO, fline,
+                            f"{name}.{attr} (field {num}) has no "
+                            f"counterpart on the CBE dataclass "
+                            f"abci/types.py::{name}",
+                        )
+                    )
+            for f in sorted(fields - proto_mapped):
+                if (name, f) in TM602_CBE_ONLY:
+                    continue
+                findings.append(
+                    self.finding(
+                        self.TYPES,
+                        types_.classes[name]["line"],
+                        f"{name}.{f} is CBE-only: the proto Desc carries "
+                        "no field for it, so proto-transport apps drop it",
+                    )
+                )
+        # oneof arms: numbers unique per envelope, every Request*/
+        # Response* dataclass mapped exactly once
+        mapped_classes: dict[str, int] = {}
+        for listname, arms in proto.oneofs.items():
+            nums = {}
+            for num, ref, line in arms:
+                cls = ref.rsplit(".", 1)[-1]
+                if num in nums:
+                    findings.append(
+                        self.finding(
+                            self.PROTO, line,
+                            f"{listname}: oneof arm number {num} used by "
+                            f"both {nums[num]} and {cls}",
+                        )
+                    )
+                nums.setdefault(num, cls)
+                if cls in mapped_classes:
+                    findings.append(
+                        self.finding(
+                            self.PROTO, line,
+                            f"{cls} rides two oneof arms "
+                            f"({mapped_classes[cls]} and {num})",
+                        )
+                    )
+                mapped_classes[cls] = num
+        if proto.oneofs:
+            for cls, meta in types_.classes.items():
+                if not cls.startswith(("Request", "Response")):
+                    continue
+                if cls in ("RequestBase",):
+                    continue
+                if cls not in mapped_classes:
+                    findings.append(
+                        self.finding(
+                            self.TYPES, meta["line"],
+                            f"{cls} is not mapped onto any proto oneof arm: "
+                            "proto-transport peers cannot exchange it",
+                        )
+                    )
+        return findings
+
+
+_MD_ROW = re.compile(r"^\s*\|([^|]*)\|([^|]*)\|")
+_MD_CODE = re.compile(r"`([^`]+)`")
+
+
+class TM603UndocumentedTelemetryName(ProgramRule):
+    code = "TM603"
+    name = "undocumented-telemetry-name"
+    help = (
+        "The event/series is emitted in code but missing from the "
+        "docs/observability.md catalogue — operators and the fleet "
+        "collector navigate by that table. Add a row (subsystem | name | "
+        "fields | source)."
+    )
+
+    DOCS = "docs/observability.md"
+
+    def check(self, project, config, root, analysis=None):
+        docs = Path(root) / self.DOCS
+        if not docs.exists():
+            return []  # fixture trees without docs: nothing to conform to
+        documented = self._documented(docs.read_text(encoding="utf-8"))
+        findings = []
+        seen: set[tuple[str, str, str]] = set()
+        for rel, idx in project.modules.items():
+            if rel.startswith(("tests/", "benchmarks/", "networks/", "tools/")):
+                continue
+            for sub, kind, line in idx.events:
+                k = ("event", sub, kind)
+                if (sub, kind) in documented or k in seen:
+                    continue
+                seen.add(k)
+                findings.append(
+                    self.finding(
+                        rel, line,
+                        f'recorder event ("{sub}", "{kind}") is not in the '
+                        f"{self.DOCS} event catalogue",
+                    )
+                )
+            for sub, name, line in idx.metrics:
+                k = ("metric", sub, name)
+                if (sub, name) in documented or k in seen:
+                    continue
+                seen.add(k)
+                findings.append(
+                    self.finding(
+                        rel, line,
+                        f'metrics series ("{sub}", "{name}") is not in the '
+                        f"{self.DOCS} series catalogue",
+                    )
+                )
+        return findings
+
+    @staticmethod
+    def _documented(text: str) -> set:
+        """(subsystem, name) pairs from every `| sub | `a` / `b` |` table
+        row; label suffixes (`{curve}`) and bold markers stripped."""
+        out = set()
+        for line in text.splitlines():
+            m = _MD_ROW.match(line)
+            if m is None:
+                continue
+            sub = m.group(1).strip().strip("*").strip()
+            if not sub or sub.startswith("-"):
+                continue
+            for name in _MD_CODE.findall(m.group(2)):
+                name = name.split("{", 1)[0].strip()
+                if name:
+                    out.add((sub, name))
+        return out
+
+
+RULES = [TM601ChannelIdCollision, TM602AbciSchemaMismatch, TM603UndocumentedTelemetryName]

@@ -144,7 +144,10 @@ class Node(BaseService):
         try:
             from tendermint_tpu.crypto import native
 
-            native.register()
+            # register() may BUILD the .so (make, up to 300 s) — off-loop,
+            # or every timer and peer the embedder already runs stalls
+            # behind the compiler (tmlint TM110)
+            await asyncio.to_thread(native.register)
         except Exception as e:
             log.info("native batch backend unavailable", err=repr(e))
 

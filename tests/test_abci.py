@@ -55,6 +55,8 @@ class TestWireCodec:
         resps = [
             abci.ResponseEcho("hi"),
             abci.ResponseInfo("d", "v", 1, 5, b"hash"),
+            # ISSUE 13 / TM602 regression: info must survive the wire
+            abci.ResponseSetOption(0, "ok", "details"),
             abci.ResponseCheckTx(code=1, log="bad", events={"k": ["v1", "v2"]}),
             abci.ResponseDeliverTx(code=0, data=b"result"),
             abci.ResponseEndBlock([abci.ValidatorUpdate(b"pk", 7)], b"", {}),

@@ -179,7 +179,9 @@ class TestOracleInterop:
         ("echo", abci.ResponseEcho("pong")),
         ("flush", abci.ResponseFlush()),
         ("info", abci.ResponseInfo("{}", "0.32.3", 1, 42, b"\xab" * 20)),
-        ("set_option", abci.ResponseSetOption(0, "ok")),
+        # info rides too (ISSUE 13 / TM602: the field existed in the proto
+        # Desc but the CBE dataclass dropped it on both transports)
+        ("set_option", abci.ResponseSetOption(0, "ok", "details")),
         (
             "check_tx",
             abci.ResponseCheckTx(

@@ -125,6 +125,23 @@ def test_tmlint_tree_clean_against_baseline():
     )
 
 
+def test_tmlint_v2_rules_registered():
+    """ISSUE 13 acceptance: the whole-program rule families are live in
+    the default run (the tree-clean gate above exercises them all)."""
+    from tendermint_tpu.lint import all_program_rules, all_rules
+
+    codes = {r.code for r in all_rules()} | {r.code for r in all_program_rules()}
+    expected = {
+        "TM101", "TM102", "TM103", "TM110",  # async (incl. whole-program)
+        "TM201", "TM202", "TM203", "TM210",  # determinism (incl. taint)
+        "TM301", "TM302", "TM303",           # jax tracing
+        "TM401", "TM111",                    # lifecycle + the -race analogue
+        "TM501", "TM502",                    # device-dispatch discipline
+        "TM601", "TM602", "TM603",           # wire conformance
+    }
+    assert expected <= codes, expected - codes
+
+
 def test_tmlint_baseline_holds_no_fire_and_forget():
     """ISSUE 4 acceptance: the TM102 class (dangling ensure_future /
     create_task) was fixed outright, not grandfathered — the baseline

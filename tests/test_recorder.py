@@ -66,7 +66,10 @@ class TestRing:
             except Exception as e:  # noqa: BLE001
                 errors.append(e)
 
-        threads = [threading.Thread(target=writer, args=(t,)) for t in range(4)]
+        threads = [
+            threading.Thread(target=writer, args=(t,))  # tmlint: disable=TM401 — joined via the list below
+            for t in range(4)
+        ]
         for t in threads:
             t.start()
         for _ in range(50):
@@ -166,7 +169,7 @@ class TestWatchdogStallDump:
             try:
                 await asyncio.sleep(0.15)  # healthy first: loop_lag sampled
                 assert wd.loop_lag < 0.25
-                time.sleep(0.8)  # deadlock stand-in: block the loop thread
+                time.sleep(0.8)  # tmlint: disable=TM101 — deliberate stall: the watchdog must fire
                 await asyncio.sleep(0.2)  # let the watchdog thread report
             finally:
                 wd.stop()

@@ -750,7 +750,9 @@ def test_cli_json_schema_and_exit_codes(tmp_path):
     doc = json.loads(dirty.stdout)
     assert doc["version"] == 1 and doc["new"] == 1
     f = doc["findings"][0]
-    assert set(f) == {"code", "path", "line", "col", "message", "hint", "baselined"}
+    assert set(f) == {
+        "code", "path", "line", "col", "message", "hint", "baselined", "suppressed",
+    }
     assert f["code"] == "TM101" and f["path"] == "pkg/a.py" and f["line"] == 3
 
     wrote = _run_cli("--write-baseline", cwd=tmp_path)

@@ -45,7 +45,7 @@ class TestLoopWatchdog:
             await asyncio.sleep(0.1)  # let the watchdog see a healthy loop
             try:
                 # a deadlock stand-in: block the loop thread outright
-                time.sleep(1.0)
+                time.sleep(1.0)  # tmlint: disable=TM101 — deliberate stall under test
                 await asyncio.sleep(0.2)  # let the watchdog thread report
             finally:
                 wd.stop()
@@ -74,7 +74,7 @@ class TestLoopWatchdog:
             )
             wd.start()
             try:
-                time.sleep(0.8)  # one long stall episode
+                time.sleep(0.8)  # tmlint: disable=TM101 — one long stall episode, on purpose
                 await asyncio.sleep(0.2)
             finally:
                 wd.stop()
