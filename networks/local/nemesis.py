@@ -30,6 +30,9 @@ Scenarios (catalogue with invariants: docs/nemesis.md):
   nemesis_delay_proposer  — asymmetric outbound delay on the proposer;
                             chain keeps committing, no divergence.
   nemesis_flood           — mempool flood + recheck storm under load.
+  nemesis_mempool_flood   — greedy-client storm vs the flowrate-limited
+                            front door: limiter engages, consensus
+                            commit latency stays flat, nobody banned.
   nemesis_flapping_device — trip/reset the device breaker mid-consensus
                             on one validator; health degrades truthfully
                             and consensus never stalls.
@@ -440,6 +443,101 @@ def scenario_flood(net: ProcTestnet) -> None:
 
 
 scenario_flood.self_start = True
+
+
+def scenario_mempool_flood(net: ProcTestnet) -> None:
+    """(ISSUE 14) A greedy client storms one node's front door while the
+    chain runs: the flowrate limiter must engage (structured JSONRPC
+    refusals + recorder events + live tm_mempool_* series), consensus
+    commit latency must stay flat (per-node debug_device CONSENSUS_COMMIT
+    wait accounting), and NO honest peer may be banned — gossip
+    over-limit drops score a non-error weight by design."""
+    mports = enable_prometheus(net)
+
+    def mutate(i: int, cfg: dict) -> None:
+        cfg["rpc"]["tx_rate_limit"] = 120.0     # per-client broadcast cap
+        cfg["mempool"]["gossip_tx_rate"] = 30.0  # per-peer gossip cap
+
+    configure_nodes(net, mutate)
+    net.start_all()
+    net.wait_all(2)
+    nem = Nemesis(net)
+    base = max(net.height(i) or 2 for i in range(net.n))
+
+    # the greedy client: waved async-tx storm against node0, far over the
+    # 120 tx/s ceiling; refusals are expected and counted
+    accepted = 0
+    limited = 0
+    for wave in range(5):
+        for k in range(300):
+            tx = "0x" + f"mf{os.getpid()}w{wave}k{k}=v".encode().hex()
+            url = (
+                f"http://127.0.0.1:{net.rpc_port(0)}/"
+                f"broadcast_tx_async?tx={tx}"
+            )
+            try:
+                with urllib.request.urlopen(url, timeout=10.0) as r:
+                    body = json.loads(r.read())
+            except OSError:
+                continue
+            if "result" in body:
+                accepted += 1
+            else:
+                err = body.get("error") or {}
+                assert err.get("code") == -32001, f"unexpected error: {body}"
+                limited += 1
+        time.sleep(0.3)
+    assert accepted > 0, "limiter refused everything — ceiling too low"
+    assert limited > 0, (
+        f"limiter never engaged ({accepted} accepted) — storm too slow?"
+    )
+
+    # the chain keeps committing THROUGH the storm, and commit-class
+    # device admissions never waited behind the flood
+    net.wait_all(base + 3, timeout=240.0)
+    for i in range(net.n):
+        dev = net.rpc(i, "debug_device", timeout=10.0)
+        assert dev is not None, f"debug_device failed on node{i}"
+        sched = dev.get("scheduler") or {}
+        cc = (sched.get("classes") or {}).get("consensus_commit") or {}
+        assert cc.get("wait_s_max", 0.0) < 2.0, (
+            f"node{i}: commit verify delayed behind the flood: {cc}"
+        )
+        queues = sched.get("queues") or {}
+        assert not queues.get("stalled", False), f"node{i}: {queues}"
+        h = nem.health(i)
+        assert "device_queue_stalled" not in h["degraded"], h
+
+    # limiter visibility: recorder events on the stormed node, per-peer
+    # gossip drops somewhere in the fleet, and the series on /metrics
+    kinds = nem.recorder_kinds(0, "mempool")
+    assert ("mempool", "rate_limited") in kinds, kinds
+    all_kinds = set()
+    for i in range(net.n):
+        all_kinds |= nem.recorder_kinds(i, "mempool")
+    assert ("mempool", "gossip_rate_limited") in all_kinds, all_kinds
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{mports[0]}/metrics", timeout=5
+    ) as r:
+        text = r.read().decode()
+    assert "tendermint_mempool_rate_limited_total" in text
+    assert "tendermint_mempool_batched_txs_total" in text
+    assert "tendermint_mempool_size" in text
+
+    # abuse-resistance invariant: the storm is spam pressure, not a
+    # protocol violation — nobody gets banned for it
+    for i in range(net.n):
+        p2p = nem.debug_p2p(i)
+        assert not p2p.get("bans"), f"node{i} banned a peer: {p2p['bans']}"
+    nem.assert_no_crashes()
+    print(
+        f"nemesis_mempool_flood: {accepted} accepted / {limited} "
+        f"rate-limited through 5 waves; chain advanced {base}->{base + 3} "
+        f"with flat commit-class waits and zero bans"
+    )
+
+
+scenario_mempool_flood.self_start = True
 
 
 def scenario_flapping_device(net: ProcTestnet) -> None:
@@ -1166,6 +1264,7 @@ SCENARIOS = {
     "nemesis_partition": scenario_partition,
     "nemesis_delay_proposer": scenario_delay_proposer,
     "nemesis_flood": scenario_flood,
+    "nemesis_mempool_flood": scenario_mempool_flood,
     "nemesis_flapping_device": scenario_flapping_device,
     "nemesis_sched_priority": scenario_sched_priority,
     "nemesis_crash_sweep": scenario_crash_sweep,
@@ -1179,8 +1278,8 @@ SCENARIOS = {
 
 # the sub-10-minute set the CI nemesis job and tier-1 wrappers draw from
 FAST = ["nemesis_byzantine", "nemesis_partition", "nemesis_delay_proposer",
-        "nemesis_flood", "nemesis_flapping_device", "nemesis_sched_priority",
-        "nemesis_peer_garbage_storm"]
+        "nemesis_flood", "nemesis_mempool_flood", "nemesis_flapping_device",
+        "nemesis_sched_priority", "nemesis_peer_garbage_storm"]
 
 # the restart-durability + residue set: nightly CI runs these after FAST
 DURABILITY = ["nemesis_torn_wal", "nemesis_evidence_restart",

@@ -36,6 +36,9 @@ class Client(BaseService):
     async def set_option(self, req: abci.RequestSetOption) -> abci.ResponseSetOption: ...
     async def query(self, req: abci.RequestQuery) -> abci.ResponseQuery: ...
     async def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx: ...
+    async def check_tx_batch(
+        self, req: abci.RequestCheckTxBatch
+    ) -> abci.ResponseCheckTxBatch: ...
     async def init_chain(self, req: abci.RequestInitChain) -> abci.ResponseInitChain: ...
     async def begin_block(self, req: abci.RequestBeginBlock) -> abci.ResponseBeginBlock: ...
     async def deliver_tx(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx: ...
@@ -91,6 +94,18 @@ class LocalClient(Client):
 
     async def check_tx(self, req):
         return await self._call(self.app.check_tx, req)
+
+    async def check_tx_batch(self, req):
+        """Bulk admission runs OFF the event loop: the whole point of the
+        batch surface is that the app fuses per-tx signature work into
+        one device-scheduler submission, and that submission BLOCKS for
+        its verdicts — inline it would stall every other coroutine for
+        the duration of a device round trip. The app lock is held across
+        the thread hop, so app calls stay strictly serialized; to_thread
+        copies the contextvars, so the mempool's MEMPOOL_CHECK priority
+        scope reaches the backend."""
+        async with self._lock:
+            return await asyncio.to_thread(self.app.check_tx_batch, req)
 
     async def init_chain(self, req):
         return await self._call(self.app.init_chain, req)
@@ -250,6 +265,9 @@ class SocketClient(Client):
         return await self._send_wait(req)
 
     async def check_tx(self, req):
+        return await self._send_wait(req)
+
+    async def check_tx_batch(self, req):
         return await self._send_wait(req)
 
     async def init_chain(self, req):

@@ -1,7 +1,9 @@
 """Example ABCI applications — the standard test fixtures
-(reference abci/example/: kvstore, persistent_kvstore, counter)."""
+(reference abci/example/: kvstore, persistent_kvstore, counter) plus the
+signed token-transfer workload (transfer, docs/tx_ingestion.md)."""
 from tendermint_tpu.abci.examples.counter import CounterApplication  # noqa: F401
 from tendermint_tpu.abci.examples.kvstore import (  # noqa: F401
     KVStoreApplication,
     PersistentKVStoreApplication,
 )
+from tendermint_tpu.abci.examples.transfer import TransferApplication  # noqa: F401

@@ -1,0 +1,404 @@
+"""Token-transfer example app — the second real workload (ISSUE 14).
+
+Where the kvstore exercises raw commit throughput, this app exercises the
+BASELINE config-5 mixed-curve shape at the APP layer: every transaction
+carries a real signature (secp256k1 or ed25519), and admission verifies
+them in BULK through the batch CheckTx surface (`check_tx_batch`) — one
+backend call per ingest bucket, routed through the DeviceScheduler at
+MEMPOOL_CHECK priority by the mempool's priority scope — while
+nonce/balance bookkeeping stays per-tx. On a validator that is already
+streaming ed25519 votes through the scheduler, transfer traffic proves
+mixed ed25519 (votes) + secp256k1 (txs) work packs onto one mesh.
+
+Transaction wire format (CBE, docs/tx_ingestion.md):
+
+    tx         = u8(curve_tag) bytes(pub) bytes(to) u64(amount) u64(nonce) bytes(sig)
+    sign bytes = str(DOMAIN) u8(curve_tag) bytes(pub) bytes(to) u64(amount) u64(nonce)
+    curve_tag  : 1 = ed25519 (32-byte pub), 2 = secp256k1 (33-byte compressed)
+    address    = sha256(pub)[:20]
+
+State machine: every account starts at `initial_balance` (faucet model —
+deterministic across nodes, no genesis ceremony needed for benches);
+a transfer requires the SENDER's exact next nonce (replay protection)
+and sufficient balance. CheckTx runs against a shadow "check state"
+that is replaced by the committed state at every Commit (the standard
+ABCI convention), so a burst of sequential nonces from one account all
+admit while a replayed or gapped nonce rejects.
+
+Signature verification backend, best-available:
+  1. the registered crypto.batch backend (tendermint_tpu.ops — device or
+     native route THROUGH the DeviceScheduler, so admission work shows up
+     under the MEMPOOL_CHECK class in debug_device);
+  2. the native batch library (crypto/native.py, thread-parallel C++);
+  3. the pure-python math oracles (crypto/*_math.py) — correct anywhere,
+     fast nowhere; keeps the app usable in dependency-free environments.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+from collections import OrderedDict
+from dataclasses import dataclass
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.encoding import DecodeError, Reader, Writer
+
+DOMAIN = "tmtpu/transfer/v1"
+
+CURVE_ED25519 = 1
+CURVE_SECP256K1 = 2
+_CURVE_NAMES = {CURVE_ED25519: "ed25519", CURVE_SECP256K1: "secp256k1"}
+_CURVE_TAGS = {v: k for k, v in _CURVE_NAMES.items()}
+_PUB_SIZES = {CURVE_ED25519: 32, CURVE_SECP256K1: 33}
+
+ADDRESS_SIZE = 20
+
+# response codes (codespace "transfer")
+CODE_OK = abci.CODE_TYPE_OK
+CODE_ENCODING = 1
+CODE_BAD_SIGNATURE = 2
+CODE_BAD_NONCE = 3
+CODE_INSUFFICIENT_FUNDS = 4
+CODE_BAD_CURVE = 5
+
+# bound on the admission-verified tx-hash cache DeliverTx consults to
+# skip re-verifying signatures it already checked (txs arriving in a
+# block from another node's mempool still verify fully)
+_CHECKED_CACHE = 65536
+
+
+def address(pub: bytes) -> bytes:
+    return hashlib.sha256(pub).digest()[:ADDRESS_SIZE]
+
+
+@dataclass
+class TransferTx:
+    curve: int
+    pub: bytes
+    to: bytes
+    amount: int
+    nonce: int
+    sig: bytes
+
+    @property
+    def sender(self) -> bytes:
+        return address(self.pub)
+
+    def sign_bytes(self) -> bytes:
+        return sign_bytes(self.curve, self.pub, self.to, self.amount, self.nonce)
+
+
+def sign_bytes(curve: int, pub: bytes, to: bytes, amount: int, nonce: int) -> bytes:
+    return (
+        Writer().str(DOMAIN).u8(curve).bytes(pub).bytes(to)
+        .u64(amount).u64(nonce).build()
+    )
+
+
+# the signed payload is the DOMAIN prefix + the tx minus its trailing
+# signature field (u32 length prefix + 64 bytes) — slicing beats
+# re-encoding every field on the admission hot path
+_DOMAIN_PREFIX = Writer().str(DOMAIN).build()
+_SIG_FIELD_LEN = 4 + 64
+
+
+def sign_bytes_of(tx: bytes) -> bytes:
+    """sign_bytes derived from the encoded tx (== the field-wise
+    construction above; pinned by a test)."""
+    return _DOMAIN_PREFIX + tx[:-_SIG_FIELD_LEN]
+
+
+def encode_tx(curve: int, pub: bytes, to: bytes, amount: int, nonce: int, sig: bytes) -> bytes:
+    return (
+        Writer().u8(curve).bytes(pub).bytes(to).u64(amount).u64(nonce)
+        .bytes(sig).build()
+    )
+
+
+def decode_tx(tx: bytes) -> TransferTx:
+    r = Reader(tx)
+    curve = r.u8()
+    if curve not in _CURVE_NAMES:
+        raise DecodeError(f"unknown curve tag {curve}")
+    pub = r.bytes()
+    if len(pub) != _PUB_SIZES[curve]:
+        raise DecodeError(f"bad pubkey size {len(pub)} for curve {curve}")
+    to = r.bytes()
+    if len(to) != ADDRESS_SIZE:
+        raise DecodeError(f"bad recipient size {len(to)}")
+    amount = r.u64()
+    nonce = r.u64()
+    sig = r.bytes()
+    if len(sig) != 64:
+        raise DecodeError(f"bad signature size {len(sig)}")
+    r.expect_done()
+    return TransferTx(curve, pub, to, amount, nonce, sig)
+
+
+def make_tx(curve_name: str, priv: bytes, to: bytes, amount: int, nonce: int) -> bytes:
+    """Sign + encode a transfer with the pure-python dev signers
+    (crypto/*_math.py) — works without the `cryptography` package; the
+    signatures verify on every backend. Workload-generation helper for
+    ingest_bench, tests, and the proc scenario."""
+    curve = _CURVE_TAGS[curve_name]
+    if curve == CURVE_ED25519:
+        from tendermint_tpu.crypto import ed25519_math as m
+    else:
+        from tendermint_tpu.crypto import secp256k1_math as m
+    pub = m.pub_from_priv(priv)
+    sig = m.sign(priv, sign_bytes(curve, pub, to, amount, nonce))
+    return encode_tx(curve, pub, to, amount, nonce, sig)
+
+
+def verify_sigs(curve_name: str, pubs, msgs, sigs) -> list[bool]:
+    """Bulk-verify one curve's triples on the best available backend (see
+    module docstring). Raw-bytes API on purpose: the PubKey key stack
+    needs the `cryptography` package, the backends don't."""
+    if not pubs:
+        return []
+    from tendermint_tpu.crypto import batch as cbatch
+
+    backend = cbatch.get_backend(curve_name)
+    if backend is not None:
+        return list(backend(list(pubs), list(msgs), list(sigs)))
+    from tendermint_tpu.crypto import native
+
+    if native.load() is not None:
+        if curve_name == "ed25519":
+            return native.ed25519_verify_batch(pubs, msgs, sigs)
+        return native.secp256k1_verify_batch(pubs, msgs, sigs)
+    if curve_name == "ed25519":
+        from tendermint_tpu.crypto import ed25519_math as m
+    else:
+        from tendermint_tpu.crypto import secp256k1_math as m
+    return [m.verify(p, s_msg, s) for p, s_msg, s in zip(pubs, msgs, sigs)]
+
+
+class TransferApplication(abci.BaseApplication):
+    def __init__(self, curve: str = "secp256k1", initial_balance: int = 10**9) -> None:
+        if curve not in _CURVE_TAGS:
+            raise ValueError(f"unknown curve {curve!r}")
+        # advisory default for workload tooling; the wire accepts both
+        self.curve = curve
+        self.initial_balance = int(initial_balance)
+        # committed state
+        self.balances: dict[bytes, int] = {}
+        self.nonces: dict[bytes, int] = {}
+        self.height = 0
+        self.app_hash = b""
+        self.tx_count = 0
+        # CheckTx shadow state (replaced by committed state at Commit)
+        self._check_balances: dict[bytes, int] = {}
+        self._check_nonces: dict[bytes, int] = {}
+        # admission-verified tx hashes: DeliverTx skips re-verifying these
+        self._checked: OrderedDict[bytes, None] = OrderedDict()
+        # current block's delivered-tx digest accumulator
+        self._block_hasher = hashlib.sha256()
+        self._block_txs = 0
+
+    # -- balances ------------------------------------------------------------
+
+    def balance(self, addr: bytes) -> int:
+        return self.balances.get(addr, self.initial_balance)
+
+    def nonce(self, addr: bytes) -> int:
+        return self.nonces.get(addr, 0)
+
+    def _check_balance(self, addr: bytes) -> int:
+        return self._check_balances.get(addr, self.balance(addr))
+
+    def _check_nonce(self, addr: bytes) -> int:
+        return self._check_nonces.get(addr, self.nonce(addr))
+
+    # -- admission -----------------------------------------------------------
+
+    def _mark_checked(self, tx: bytes) -> None:
+        key = hashlib.sha256(tx).digest()
+        self._checked[key] = None
+        self._checked.move_to_end(key)
+        while len(self._checked) > _CHECKED_CACHE:
+            self._checked.popitem(last=False)
+
+    def _stateful_check(self, t: TransferTx) -> abci.ResponseCheckTx:
+        """Nonce/balance admission against the CheckTx shadow state;
+        applies the tx to the shadow on success."""
+        sender = t.sender
+        expected = self._check_nonce(sender)
+        if t.nonce != expected:
+            return abci.ResponseCheckTx(
+                code=CODE_BAD_NONCE, codespace="transfer",
+                log=f"bad nonce {t.nonce}, expected {expected}",
+            )
+        bal = self._check_balance(sender)
+        if bal < t.amount:
+            return abci.ResponseCheckTx(
+                code=CODE_INSUFFICIENT_FUNDS, codespace="transfer",
+                log=f"balance {bal} < amount {t.amount}",
+            )
+        self._check_nonces[sender] = expected + 1
+        self._check_balances[sender] = bal - t.amount
+        self._check_balances[t.to] = self._check_balance(t.to) + t.amount
+        return abci.ResponseCheckTx(code=CODE_OK, gas_wanted=1)
+
+    def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
+        return self.check_tx_batch(
+            abci.RequestCheckTxBatch([req.tx], req.new_check)
+        ).responses[0]
+
+    def check_tx_batch(self, req: abci.RequestCheckTxBatch) -> abci.ResponseCheckTxBatch:
+        """Signatures in bulk, nonce/balance per tx (module docstring).
+
+        On recheck (new_check=False) signatures were already verified at
+        admission — only the stateful pass reruns against the fresh
+        shadow state, so a post-commit recheck storm costs zero
+        signature work."""
+        out: list[abci.ResponseCheckTx | None] = [None] * len(req.txs)
+        parsed: list[tuple[int, TransferTx]] = []
+        for i, tx in enumerate(req.txs):
+            try:
+                parsed.append((i, decode_tx(tx)))
+            except DecodeError as e:
+                out[i] = abci.ResponseCheckTx(
+                    code=CODE_ENCODING, codespace="transfer", log=str(e)
+                )
+        if req.new_check:
+            by_curve: dict[str, list[tuple[int, TransferTx]]] = {}
+            for i, t in parsed:
+                by_curve.setdefault(_CURVE_NAMES[t.curve], []).append((i, t))
+            sig_ok: dict[int, bool] = {}
+            for curve_name, items in by_curve.items():
+                verdicts = verify_sigs(
+                    curve_name,
+                    [t.pub for _, t in items],
+                    [sign_bytes_of(req.txs[i]) for i, _ in items],
+                    [t.sig for _, t in items],
+                )
+                for (i, _), ok in zip(items, verdicts):
+                    sig_ok[i] = bool(ok)
+            for i, t in parsed:
+                if not sig_ok.get(i, False):
+                    out[i] = abci.ResponseCheckTx(
+                        code=CODE_BAD_SIGNATURE, codespace="transfer",
+                        log="signature verification failed",
+                    )
+        for i, t in parsed:
+            if out[i] is not None:
+                continue
+            res = self._stateful_check(t)
+            if res.is_ok and req.new_check:
+                self._mark_checked(req.txs[i])
+            out[i] = res
+        return abci.ResponseCheckTxBatch(responses=out)  # type: ignore[arg-type]
+
+    # -- delivery ------------------------------------------------------------
+
+    def deliver_tx(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx:
+        try:
+            t = decode_tx(req.tx)
+        except DecodeError as e:
+            return abci.ResponseDeliverTx(
+                code=CODE_ENCODING, codespace="transfer", log=str(e)
+            )
+        key = hashlib.sha256(req.tx).digest()
+        if key in self._checked:
+            del self._checked[key]
+        else:
+            # not admission-verified HERE (block built elsewhere): verify
+            ok = verify_sigs(
+                _CURVE_NAMES[t.curve], [t.pub], [sign_bytes_of(req.tx)], [t.sig]
+            )[0]
+            if not ok:
+                return abci.ResponseDeliverTx(
+                    code=CODE_BAD_SIGNATURE, codespace="transfer",
+                    log="signature verification failed",
+                )
+        sender = t.sender
+        expected = self.nonce(sender)
+        if t.nonce != expected:
+            return abci.ResponseDeliverTx(
+                code=CODE_BAD_NONCE, codespace="transfer",
+                log=f"bad nonce {t.nonce}, expected {expected}",
+            )
+        bal = self.balance(sender)
+        if bal < t.amount:
+            return abci.ResponseDeliverTx(
+                code=CODE_INSUFFICIENT_FUNDS, codespace="transfer",
+                log=f"balance {bal} < amount {t.amount}",
+            )
+        self.nonces[sender] = expected + 1
+        self.balances[sender] = bal - t.amount
+        self.balances[t.to] = self.balance(t.to) + t.amount
+        self.tx_count += 1
+        self._block_hasher.update(key)
+        self._block_txs += 1
+        return abci.ResponseDeliverTx(
+            code=CODE_OK, gas_used=1,
+            events={
+                "transfer.from": [sender.hex()],
+                "transfer.to": [t.to.hex()],
+                "transfer.amount": [str(t.amount)],
+            },
+        )
+
+    def commit(self) -> abci.ResponseCommit:
+        self.height += 1
+        # app hash: a chain over delivered-tx digests — deterministic in
+        # the applied tx sequence, O(block) not O(state)
+        h = hashlib.sha256()
+        h.update(self.app_hash)
+        h.update(self._block_hasher.digest())
+        h.update(self.tx_count.to_bytes(8, "big"))
+        self.app_hash = h.digest()
+        self._block_hasher = hashlib.sha256()
+        self._block_txs = 0
+        # CheckTx shadow state restarts from the committed state; the
+        # mempool's recheck replays surviving txs into it in clist order
+        self._check_balances = {}
+        self._check_nonces = {}
+        return abci.ResponseCommit(data=self.app_hash)
+
+    # -- info/query ----------------------------------------------------------
+
+    def info(self, req: abci.RequestInfo) -> abci.ResponseInfo:
+        return abci.ResponseInfo(
+            data=json.dumps(
+                {"accounts": len(self.balances), "curve": self.curve}
+            ),
+            version="transfer/0.1.0",
+            last_block_height=self.height,
+            last_block_app_hash=self.app_hash,
+        )
+
+    def query(self, req: abci.RequestQuery) -> abci.ResponseQuery:
+        """Paths: /balance and /nonce, data = 20-byte address (raw or
+        hex). Unproven reads of the committed state."""
+        data = req.data
+        if len(data) == 2 * ADDRESS_SIZE:
+            try:
+                data = bytes.fromhex(data.decode())
+            except ValueError:
+                pass
+        if len(data) != ADDRESS_SIZE:
+            return abci.ResponseQuery(
+                code=CODE_ENCODING, codespace="transfer",
+                log=f"query data must be a {ADDRESS_SIZE}-byte address",
+            )
+        if req.path == "/nonce":
+            val = self.nonce(data)
+        else:
+            val = self.balance(data)
+        return abci.ResponseQuery(
+            code=CODE_OK, key=req.data, value=str(val).encode(),
+            height=self.height,
+        )
+
+    def init_chain(self, req: abci.RequestInitChain) -> abci.ResponseInitChain:
+        if req.app_state_bytes:
+            try:
+                opts = json.loads(req.app_state_bytes)
+                self.initial_balance = int(
+                    opts.get("initial_balance", self.initial_balance)
+                )
+            except (ValueError, TypeError, AttributeError):
+                pass
+        return abci.ResponseInitChain()

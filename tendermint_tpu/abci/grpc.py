@@ -48,6 +48,7 @@ _METHODS = {
     "SetOption": abci.RequestSetOption,
     "DeliverTx": abci.RequestDeliverTx,
     "CheckTx": abci.RequestCheckTx,
+    "CheckTxBatch": abci.RequestCheckTxBatch,
     "Query": abci.RequestQuery,
     "Commit": abci.RequestCommit,
     "InitChain": abci.RequestInitChain,
@@ -86,6 +87,8 @@ class GRPCApplication:
             return a.begin_block(req)
         if isinstance(req, abci.RequestCheckTx):
             return a.check_tx(req)
+        if isinstance(req, abci.RequestCheckTxBatch):
+            return a.check_tx_batch(req)
         if isinstance(req, abci.RequestDeliverTx):
             return a.deliver_tx(req)
         if isinstance(req, abci.RequestEndBlock):
@@ -262,6 +265,9 @@ class GRPCClient(Client):
 
     async def check_tx(self, req):
         return await self._call("CheckTx", req)
+
+    async def check_tx_batch(self, req):
+        return await self._call("CheckTxBatch", req)
 
     async def init_chain(self, req):
         return await self._call("InitChain", req)

@@ -104,6 +104,7 @@ def _to_signed64(n: int) -> int:
 #   "msg"        embedded message, sub = Desc
 #   "rep_msg"    repeated embedded message, sub = Desc
 #   "rep_str"    repeated string
+#   "rep_bytes"  repeated bytes
 #   "rep_u64"    repeated non-negative varint, PACKED (proto3 default)
 # Values are plain dicts at this layer; the mapping layer below converts
 # dict <-> the abci/types.py dataclasses.
@@ -156,6 +157,11 @@ class Desc:
                 for item in val:
                     enc = item.encode()
                     out += encode_uvarint(num << 3 | 2) + encode_uvarint(len(enc)) + enc
+            elif kind == "rep_bytes":
+                # every item is emitted, including empty ones: repeated
+                # presence is meaningful (a zero-byte tx is still a tx)
+                for item in val:
+                    out += encode_uvarint(num << 3 | 2) + encode_uvarint(len(item)) + item
             elif kind == "rep_u64":
                 if not val:
                     continue
@@ -220,7 +226,7 @@ class Desc:
                     item, p = decode_uvarint(payload, p)
                     vals.append(item)
                 continue
-            if wt != (2 if kind in ("str", "bytes", "msg", "rep_msg", "rep_str") else 0):
+            if wt != (2 if kind in ("str", "bytes", "msg", "rep_msg", "rep_str", "rep_bytes") else 0):
                 raise DecodeError(
                     f"{self.name}: field {num} kind {kind} got wire type {wt}"
                 )
@@ -240,6 +246,8 @@ class Desc:
                 v.setdefault(attr, []).append(sub.decode(payload))
             elif kind == "rep_str":
                 v.setdefault(attr, []).append(payload.decode())
+            elif kind == "rep_bytes":
+                v.setdefault(attr, []).append(bytes(payload))
         return v
 
 
@@ -373,6 +381,13 @@ REQ_BEGIN_BLOCK = Desc(
 REQ_CHECK_TX = Desc(
     "RequestCheckTx", [(1, "tx", "bytes", None), (2, "type", "i32", None)]
 )
+# batch admission extension (docs/tx_ingestion.md) — NOT in the reference
+# types.proto; `type` follows RequestCheckTx's CheckTxType enum (0 = new,
+# 1 = recheck)
+REQ_CHECK_TX_BATCH = Desc(
+    "RequestCheckTxBatch",
+    [(1, "txs", "rep_bytes", None), (2, "type", "i32", None)],
+)
 REQ_DELIVER_TX = Desc("RequestDeliverTx", [(1, "tx", "bytes", None)])
 REQ_END_BLOCK = Desc("RequestEndBlock", [(1, "height", "i64", None)])
 REQ_COMMIT = Desc("RequestCommit", [])
@@ -440,6 +455,9 @@ _TX_RESULT_FIELDS = [
     (8, "codespace", "str", None),
 ]
 RESP_CHECK_TX = Desc("ResponseCheckTx", list(_TX_RESULT_FIELDS))
+RESP_CHECK_TX_BATCH = Desc(
+    "ResponseCheckTxBatch", [(1, "responses", "rep_msg", RESP_CHECK_TX)]
+)
 RESP_DELIVER_TX = Desc("ResponseDeliverTx", list(_TX_RESULT_FIELDS))
 RESP_END_BLOCK = Desc(
     "ResponseEndBlock",
@@ -685,6 +703,33 @@ def _proof_from_proto(v: dict | None) -> list:
 # Each entry: dataclass -> (oneof field number, Desc, to_dict, from_dict).
 
 
+def _checktx_to_proto(o: "abci.ResponseCheckTx") -> dict:
+    """Shared by the ResponseCheckTx arm and each batch-response item."""
+    return {
+        "code": o.code,
+        "data": o.data,
+        "log": o.log,
+        "info": o.info,
+        "gas_wanted": o.gas_wanted,
+        "gas_used": o.gas_used,
+        "events": _events_to_proto(o.events),
+        "codespace": o.codespace,
+    }
+
+
+def _checktx_from_proto(v: dict) -> "abci.ResponseCheckTx":
+    return abci.ResponseCheckTx(
+        code=v.get("code", 0),
+        data=v.get("data", b""),
+        log=v.get("log", ""),
+        info=v.get("info", ""),
+        gas_wanted=v.get("gas_wanted", 0),
+        gas_used=v.get("gas_used", 0),
+        events=_events_from_proto(v.get("events")),
+        codespace=v.get("codespace", ""),
+    )
+
+
 def _mk(cls, attrs_defaults: list[tuple[str, Any]]):
     def from_dict(v: dict):
         return cls(**{a: v.get(a, d) for a, d in attrs_defaults})
@@ -813,6 +858,18 @@ _REQ_MAP: list[tuple[int, type, Desc, Callable, Callable]] = [
         lambda o: {"tx": o.tx, "type": 0 if o.new_check else 1},
         lambda v: abci.RequestCheckTx(
             tx=v.get("tx", b""), new_check=v.get("type", 0) == 0
+        ),
+    ),
+    # batch admission extension — oneof number 20 is past every arm the
+    # v0.34 reference schema uses, so a reference peer treats it as an
+    # unknown field (empty oneof -> exception response, clean fallback)
+    (
+        20,
+        abci.RequestCheckTxBatch,
+        REQ_CHECK_TX_BATCH,
+        lambda o: {"txs": list(o.txs), "type": 0 if o.new_check else 1},
+        lambda v: abci.RequestCheckTxBatch(
+            txs=list(v.get("txs", [])), new_check=v.get("type", 0) == 0
         ),
     ),
     (
@@ -970,25 +1027,17 @@ _RESP_MAP: list[tuple[int, type, Desc, Callable, Callable]] = [
         9,
         abci.ResponseCheckTx,
         RESP_CHECK_TX,
-        lambda o: {
-            "code": o.code,
-            "data": o.data,
-            "log": o.log,
-            "info": o.info,
-            "gas_wanted": o.gas_wanted,
-            "gas_used": o.gas_used,
-            "events": _events_to_proto(o.events),
-            "codespace": o.codespace,
-        },
-        lambda v: abci.ResponseCheckTx(
-            code=v.get("code", 0),
-            data=v.get("data", b""),
-            log=v.get("log", ""),
-            info=v.get("info", ""),
-            gas_wanted=v.get("gas_wanted", 0),
-            gas_used=v.get("gas_used", 0),
-            events=_events_from_proto(v.get("events")),
-            codespace=v.get("codespace", ""),
+        _checktx_to_proto,
+        _checktx_from_proto,
+    ),
+    # batch admission extension (pairs with RequestCheckTxBatch arm 20)
+    (
+        18,
+        abci.ResponseCheckTxBatch,
+        RESP_CHECK_TX_BATCH,
+        lambda o: {"responses": [_checktx_to_proto(r) for r in o.responses]},
+        lambda v: abci.ResponseCheckTxBatch(
+            responses=[_checktx_from_proto(r) for r in v.get("responses", [])]
         ),
     ),
     (

@@ -108,6 +108,8 @@ class ABCIServer(BaseService):
             return a.begin_block(req)
         if isinstance(req, abci.RequestCheckTx):
             return a.check_tx(req)
+        if isinstance(req, abci.RequestCheckTxBatch):
+            return a.check_tx_batch(req)
         if isinstance(req, abci.RequestDeliverTx):
             return a.deliver_tx(req)
         if isinstance(req, abci.RequestEndBlock):

@@ -205,6 +205,20 @@ class RequestCheckTx:
 
 
 @dataclass
+class RequestCheckTxBatch:
+    """Batch admission (docs/tx_ingestion.md): one round trip carries a
+    whole ingest bucket so the app can fuse per-tx signature work into a
+    single device-scheduler submission. NOT in the reference protocol —
+    an extension this repo's node and apps speak on every transport; the
+    mempool falls back to per-tx CheckTx (loudly) when the app side
+    errors on it (reference Go apps answer the unknown oneof arm with an
+    exception response, so the probe degrades cleanly)."""
+
+    txs: list[bytes] = field(default_factory=list)
+    new_check: bool = True  # False = post-commit recheck of survivors
+
+
+@dataclass
 class RequestDeliverTx:
     tx: bytes = b""
 
@@ -337,6 +351,13 @@ class ResponseCheckTx:
 
 
 @dataclass
+class ResponseCheckTxBatch:
+    """One ResponseCheckTx per RequestCheckTxBatch.txs entry, in order."""
+
+    responses: list[ResponseCheckTx] = field(default_factory=list)
+
+
+@dataclass
 class ResponseDeliverTx:
     code: int = CODE_TYPE_OK
     data: bytes = b""
@@ -428,6 +449,8 @@ class Application:
 
     def check_tx(self, req: RequestCheckTx) -> ResponseCheckTx: ...
 
+    def check_tx_batch(self, req: RequestCheckTxBatch) -> ResponseCheckTxBatch: ...
+
     def init_chain(self, req: RequestInitChain) -> ResponseInitChain: ...
 
     def begin_block(self, req: RequestBeginBlock) -> ResponseBeginBlock: ...
@@ -468,6 +491,17 @@ class BaseApplication(Application):
 
     def check_tx(self, req: RequestCheckTx) -> ResponseCheckTx:
         return ResponseCheckTx(code=CODE_TYPE_OK)
+
+    def check_tx_batch(self, req: RequestCheckTxBatch) -> ResponseCheckTxBatch:
+        """Default: per-tx loop through check_tx — apps without batchable
+        work inherit correct (if unfused) batch semantics for free. Apps
+        with bulk signature verification override this (examples/
+        transfer.py) to verify the whole bucket in one backend call."""
+        return ResponseCheckTxBatch(
+            responses=[
+                self.check_tx(RequestCheckTx(tx, req.new_check)) for tx in req.txs
+            ]
+        )
 
     def init_chain(self, req: RequestInitChain) -> ResponseInitChain:
         return ResponseInitChain()
@@ -520,6 +554,7 @@ _REQ_TAGS: list[tuple[int, type]] = [
     (13, RequestOfferSnapshot),
     (14, RequestLoadSnapshotChunk),
     (15, RequestApplySnapshotChunk),
+    (16, RequestCheckTxBatch),
 ]
 _RESP_TAGS: list[tuple[int, type]] = [
     (1, ResponseEcho),
@@ -538,6 +573,7 @@ _RESP_TAGS: list[tuple[int, type]] = [
     (14, ResponseOfferSnapshot),
     (15, ResponseLoadSnapshotChunk),
     (16, ResponseApplySnapshotChunk),
+    (17, ResponseCheckTxBatch),
 ]
 
 
@@ -564,10 +600,17 @@ def _encode_msg(msg) -> bytes:
                     item.encode_into(w)
                 elif isinstance(item, bool):
                     w.bool(item)
+                elif isinstance(item, bytes):  # e.g. RequestCheckTxBatch.txs
+                    w.bytes(item)
                 elif isinstance(item, int):  # e.g. refetch_chunks
                     w.u64(item)
                 elif isinstance(item, str):  # e.g. reject_senders
                     w.str(item)
+                elif isinstance(item, ResponseCheckTx):
+                    # nested message: length-prefixed recursive encoding
+                    # (covers every field incl. info/codespace, unlike the
+                    # legacy ResponseCheckTx.encode wire shape)
+                    w.bytes(_encode_msg(item))
                 else:  # merkle.ProofOp
                     from tendermint_tpu.crypto.merkle import ProofOp
 
@@ -594,6 +637,12 @@ def _decode_msg(cls, data: bytes):
             kwargs[f.name] = r.str()
         elif "dict" in str(f.type):
             kwargs[f.name] = _read_events(r)
+        elif "list[bytes]" in str(f.type):
+            kwargs[f.name] = [r.bytes() for _ in range(r.u32())]
+        elif "list[ResponseCheckTx]" in str(f.type):
+            kwargs[f.name] = [
+                _decode_msg(ResponseCheckTx, r.bytes()) for _ in range(r.u32())
+            ]
         elif "list[Snapshot]" in str(f.type):
             kwargs[f.name] = [Snapshot.read(r) for _ in range(r.u32())]
         elif "Snapshot" in str(f.type):

@@ -65,6 +65,16 @@ class PeerBehaviour:
                    weight=0.5, bad=True)
 
     @classmethod
+    def tx_flood(cls, peer_id: str, explanation: str = "") -> "PeerBehaviour":
+        """Gossiped tx dropped by the per-peer flowrate limiter BEFORE
+        CheckTx (docs/tx_ingestion.md). Non-error and lighter than even
+        bad_tx: an honest peer relaying a legitimate burst is exactly who
+        hits this, so the weight exists only to make a peer whose traffic
+        is *persistently* over-limit visible in the trust metric — it can
+        never dominate a ban decision on its own."""
+        return cls(peer_id, f"tx flood: {explanation}", False, weight=0.05, bad=True)
+
+    @classmethod
     def bad_tx(cls, peer_id: str, explanation: str) -> "PeerBehaviour":
         """Gossiped tx rejected by CheckTx: spam pressure, not a protocol
         violation (reference keeps the peer too). Deliberately lighter

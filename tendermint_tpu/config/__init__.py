@@ -40,6 +40,12 @@ class RPCConfig:
     max_subscription_clients: int = 100
     max_subscriptions_per_client: int = 5
     timeout_broadcast_tx_commit: float = 10.0
+    # Per-client broadcast_tx_* flowrate ceiling (txs/s per remote host;
+    # 0 = unlimited). Over-limit calls get a structured "rate-limited"
+    # JSONRPC error instead of queueing unboundedly (docs/tx_ingestion.md).
+    tx_rate_limit: float = 0.0
+    # burst credit as a multiple of tx_rate_limit (token-bucket depth)
+    tx_rate_burst: float = 2.0
 
 
 @dataclass
@@ -92,6 +98,18 @@ class MempoolConfig:
     max_txs_bytes: int = 1073741824
     cache_size: int = 10000
     max_tx_bytes: int = 1048576
+    # Batched admission (docs/tx_ingestion.md): incoming txs park in an
+    # ingest bucket that flushes as ONE CheckTxBatch round trip when it
+    # crosses the streaming flush hint or after batch_window seconds.
+    # batch_max pins the bucket high-water explicitly (0 = consult the
+    # hint, capped at 4096). batch=False restores per-tx admission.
+    batch: bool = True
+    batch_window: float = 0.002
+    batch_max: int = 0
+    # Per-peer gossip tx-rate ceiling (txs/s; 0 = unlimited): over-limit
+    # gossip is dropped before CheckTx and feeds the behaviour plane with
+    # a non-error weight — an honest burst never trends toward a ban.
+    gossip_tx_rate: float = 0.0
 
 
 @dataclass

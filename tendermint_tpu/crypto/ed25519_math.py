@@ -126,6 +126,44 @@ def verify_scalar_range(s_bytes: bytes) -> bool:
     return int.from_bytes(s_bytes, "little") < L
 
 
+def _expand_priv(priv: bytes) -> tuple[int, bytes]:
+    """RFC 8032 §5.1.5: seed -> (clamped scalar, prefix)."""
+    import hashlib
+
+    h = hashlib.sha512(priv).digest()
+    a = int.from_bytes(h[:32], "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    return a, h[32:]
+
+
+def pub_from_priv(priv: bytes) -> bytes:
+    """32-byte seed -> compressed public key (RFC 8032 §5.1.5).
+
+    Dev/bench tool (with `sign` below): NOT constant-time — it exists so
+    signed workloads (the transfer app, ingest_bench) can be generated in
+    environments without the `cryptography` package. Production keys stay
+    on crypto/ed25519.py's OpenSSL-backed stack."""
+    a, _ = _expand_priv(priv)
+    return compress(scalar_mult(a, BASE))
+
+
+def sign(priv: bytes, msg: bytes) -> bytes:
+    """RFC 8032 §5.1.6 deterministic signing (dev/bench tool — see
+    pub_from_priv). Output verifies on every path in this repo: the
+    `cryptography` stack, the native batch, the device kernel, and
+    `verify` below."""
+    import hashlib
+
+    a, prefix = _expand_priv(priv)
+    pub = compress(scalar_mult(a, BASE))
+    r = reduce_scalar(hashlib.sha512(prefix + msg).digest())
+    r_enc = compress(scalar_mult(r, BASE))
+    k = reduce_scalar(hashlib.sha512(r_enc + pub + msg).digest())
+    s = (r + k * a) % L
+    return r_enc + s.to_bytes(32, "little")
+
+
 def verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
     """Reference single verify, used as test oracle (RFC 8032 §5.1.7)."""
     import hashlib

@@ -97,6 +97,60 @@ def msg_scalar(msg: bytes) -> int:
     return int.from_bytes(hashlib.sha256(msg).digest(), "big") % N
 
 
+def _rfc6979_ks(priv: bytes, z: int):
+    """RFC 6979 §3.2 deterministic nonce stream (HMAC-SHA256)."""
+    import hmac
+    import hashlib as _hl
+
+    h1 = z.to_bytes(32, "big")
+    v = b"\x01" * 32
+    k = b"\x00" * 32
+    k = hmac.new(k, v + b"\x00" + priv + h1, _hl.sha256).digest()
+    v = hmac.new(k, v, _hl.sha256).digest()
+    k = hmac.new(k, v + b"\x01" + priv + h1, _hl.sha256).digest()
+    v = hmac.new(k, v, _hl.sha256).digest()
+    while True:
+        v = hmac.new(k, v, _hl.sha256).digest()
+        cand = int.from_bytes(v, "big")
+        if 1 <= cand < N:
+            yield cand
+        k = hmac.new(k, v + b"\x00", _hl.sha256).digest()
+        v = hmac.new(k, v, _hl.sha256).digest()
+
+
+def pub_from_priv(priv: bytes) -> bytes:
+    """32-byte privkey -> 33-byte compressed pubkey.
+
+    Dev/bench tool (with `sign` below): NOT constant-time — it exists so
+    signed workloads (the transfer app, ingest_bench) can be generated in
+    environments without the `cryptography` package. Production keys stay
+    on crypto/secp256k1.py's OpenSSL-backed stack."""
+    x, y = to_affine(scalar_mult(int.from_bytes(priv, "big") % N, G))
+    return bytes([2 + (y & 1)]) + x.to_bytes(32, "big")
+
+
+def sign(priv: bytes, msg: bytes) -> bytes:
+    """Deterministic ECDSA (RFC 6979), compact r||s with the low-S rule —
+    verifies bit-for-bit on `verify` above, the OpenSSL stack, the native
+    batch, and the device kernel. Dev/bench tool (see pub_from_priv)."""
+    d = int.from_bytes(priv, "big")
+    if not 0 < d < N:
+        raise ValueError("privkey scalar out of range")
+    z = msg_scalar(msg)
+    for k in _rfc6979_ks(priv, z):
+        x, _y = to_affine(scalar_mult(k, G))
+        r = x % N
+        if r == 0:
+            continue
+        s = pow(k, N - 2, N) * ((z + r * d) % N) % N
+        if s == 0:
+            continue
+        if s > HALF_N:
+            s = N - s
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+    raise AssertionError("unreachable: RFC 6979 stream exhausted")
+
+
 def verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
     """Oracle ECDSA verify with the low-S rule — mirrors
     crypto/secp256k1.PubKeySecp256k1.verify bit-for-bit."""

@@ -1,7 +1,7 @@
 """Priority classes for the device-dispatch scheduler.
 
 Every signature verification in the node is submitted to the process-wide
-DeviceScheduler (tendermint_tpu/device/scheduler.py) under one of four
+DeviceScheduler (tendermint_tpu/device/scheduler.py) under one of five
 admission classes. Strict priority decides who reaches the device first
 when the queue is contended; an aging tick promotes long-waiting requests
 one class per aging interval so low classes cannot starve:
@@ -12,6 +12,11 @@ one class per aging interval so low classes cannot starve:
   matters, but a syncing replica must never crowd out a validator's
   commit path when both share a device.
 - LITE — light-client header verification (lite/).
+- MEMPOOL_CHECK — first-time tx admission (the mempool ingestion
+  accumulator's batched CheckTx, docs/tx_ingestion.md). User-facing —
+  a client is awaiting the broadcast_tx verdict — so it outranks
+  recheck, but an admission storm must still queue behind everything
+  consensus needs.
 - MEMPOOL_RECHECK — post-commit recheck storms; pure background work.
 
 The class travels as a contextvar so call sites tag whole code regions
@@ -33,7 +38,8 @@ class Priority(enum.IntEnum):
     CONSENSUS_COMMIT = 0
     FASTSYNC = 1
     LITE = 2
-    MEMPOOL_RECHECK = 3
+    MEMPOOL_CHECK = 3
+    MEMPOOL_RECHECK = 4
 
     @property
     def label(self) -> str:

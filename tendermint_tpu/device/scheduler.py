@@ -1,6 +1,6 @@
 """DeviceScheduler — the process-wide device-dispatch service.
 
-One admission queue, four priority classes, cross-subsystem batch packing
+One admission queue, five priority classes, cross-subsystem batch packing
 (ROADMAP item 1). Before this subsystem each curve module
 (ops/ed25519_batch.py, ops/secp_batch.py) owned its own daemon fetch pool,
 bucket routing and verdict fetch, shared a circuit breaker by module import
@@ -255,7 +255,7 @@ class _Request:
 
 
 # How long a queued request waits before its effective class improves by
-# one (the aging tick). Three intervals take MEMPOOL_RECHECK to the top
+# one (the aging tick). Four intervals take MEMPOOL_RECHECK to the top
 # class, bounding worst-case background latency under a consensus flood.
 _AGING_S = float(os.environ.get("TMTPU_SCHED_AGING_S", 0.25))
 

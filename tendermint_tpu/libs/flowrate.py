@@ -2,11 +2,14 @@
 
 Reference parity: libs/flowrate/flowrate.go — per-connection send/recv rate
 monitors with EMA rates and limit computation; used by MConnection and the
-fast-sync block pool (blockchain/v0/pool.go:452).
+fast-sync block pool (blockchain/v0/pool.go:452). `KeyedRateLimiter` below
+extends the same token-bucket idea to per-key (per-client, per-peer)
+event-rate ceilings — the mempool front door (docs/tx_ingestion.md).
 """
 from __future__ import annotations
 
 import time
+from collections import OrderedDict
 from dataclasses import dataclass
 
 
@@ -81,3 +84,68 @@ class Monitor:
             duration=dur,
             idle=now - self._last,
         )
+
+
+class KeyedRateLimiter:
+    """Per-key token buckets for event-rate ceilings (txs/s per RPC
+    client, per gossip peer). Each key earns `rate` tokens/s up to
+    `burst` banked; `allow(key)` spends one. Long-idle keys cannot bank
+    unbounded credit (the bucket caps at `burst`), and the key table
+    itself is LRU-bounded so an address-rotating flood cannot grow it
+    without limit — evicting a key forgets at most one burst of history,
+    which only ever errs toward ALLOWING, never toward punishing a
+    stranger for someone else's spend.
+
+    rate <= 0 disables the limiter: allow() is always True and no state
+    is kept.
+    """
+
+    MAX_KEYS = 4096
+
+    def __init__(self, rate: float, burst: float | None = None,
+                 max_keys: int = MAX_KEYS, clock=time.monotonic) -> None:
+        self.rate = float(rate)
+        self.burst = float(burst) if burst is not None else max(1.0, self.rate)
+        if self.burst < 1.0:
+            self.burst = 1.0
+        self.max_keys = max(1, int(max_keys))
+        self._clock = clock
+        # key -> (tokens_at_stamp, stamp)
+        self._buckets: OrderedDict[str, tuple[float, float]] = OrderedDict()
+        self.denied = 0
+        self.allowed = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.rate > 0
+
+    def allow(self, key: str, n: float = 1.0) -> bool:
+        """Spend `n` tokens from `key`'s bucket; False = over limit."""
+        if self.rate <= 0:
+            return True
+        now = self._clock()
+        tokens, stamp = self._buckets.get(key, (self.burst, now))
+        tokens = min(self.burst, tokens + (now - stamp) * self.rate)
+        ok = tokens >= n
+        if ok:
+            tokens -= n
+            self.allowed += 1
+        else:
+            self.denied += 1
+        self._buckets[key] = (tokens, now)
+        self._buckets.move_to_end(key)
+        while len(self._buckets) > self.max_keys:
+            self._buckets.popitem(last=False)
+        return ok
+
+    def forget(self, key: str) -> None:
+        self._buckets.pop(key, None)
+
+    def snapshot(self) -> dict:
+        return {
+            "rate": self.rate,
+            "burst": self.burst,
+            "keys": len(self._buckets),
+            "allowed": self.allowed,
+            "denied": self.denied,
+        }

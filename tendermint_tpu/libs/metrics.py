@@ -363,6 +363,19 @@ class MempoolMetrics:
             "mempool", "residency_seconds", "Admission-to-commit residency",
             [0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60],
         )
+        # batched admission (docs/tx_ingestion.md)
+        self.batched_txs = c.counter(
+            "mempool", "batched_txs_total",
+            "Txs admitted through batched CheckTx flushes",
+        )
+        self.batch_lanes = c.histogram(
+            "mempool", "batch_lanes", "Txs per ingest-bucket flush",
+            [1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 4096],
+        )
+        self.rate_limited = c.counter(
+            "mempool", "rate_limited_total",
+            "Txs refused by the flowrate limiter (RPC + gossip)",
+        )
 
 
 class StateMetrics:

@@ -90,6 +90,7 @@ class TM601ChannelIdCollision(ProgramRule):
 TM602_ALIASES = {
     ("RequestBeginBlock", "last_commit_info"): "last_commit_votes",
     ("RequestCheckTx", "type"): "new_check",
+    ("RequestCheckTxBatch", "type"): "new_check",
     ("ResponseQuery", "proof"): "proof_ops",
     ("VoteInfo", "validator"): ("address", "power"),
 }

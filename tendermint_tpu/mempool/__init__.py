@@ -5,20 +5,33 @@ app-admitted txs with an LRU dedup cache (:211,660), app-callback-driven
 admission (:363), ReapMaxBytesMaxGas for proposals (:462), post-commit
 Update + recheck (:520,582), optional WAL (:135). The gossip reactor lives
 in tendermint_tpu/mempool/reactor.py.
+
+Beyond the reference — batch-first admission (docs/tx_ingestion.md):
+incoming txs from RPC and gossip park in a bounded ingest bucket that
+flushes as ONE `CheckTxBatch` ABCI round trip (under the device
+scheduler's MEMPOOL_CHECK class) when the bucket crosses the streaming
+flush hint or a small deadline expires. Verdicts scatter back to each
+waiting `check_tx` caller, admitted txs enter the clist in arrival order
+(serial-equivalent to the per-tx path), and a layered seen-tx dedup —
+live pool membership, the in-flight bucket, a height-ringed
+recently-committed set, then the LRU — short-circuits duplicates before
+they ever reach the app.
 """
 from __future__ import annotations
 
 import asyncio
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 
 from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.client import ABCIClientError
 from tendermint_tpu.device.priorities import Priority, priority_scope
 from tendermint_tpu.types.tx import tx_hash
 from tendermint_tpu.libs.clist import CList
 from tendermint_tpu.libs.log import NOP, Logger
 from tendermint_tpu.libs.recorder import RECORDER
+from tendermint_tpu.libs.service import spawn_logged
 
 
 class MempoolError(Exception):
@@ -51,8 +64,8 @@ class TxCache:
         self.size = size
         self._map: OrderedDict[bytes, None] = OrderedDict()
 
-    def push(self, tx: bytes) -> bool:
-        key = tx_hash(tx)
+    def push(self, tx: bytes, key: bytes | None = None) -> bool:
+        key = tx_hash(tx) if key is None else key
         if key in self._map:
             self._map.move_to_end(key)
             return False
@@ -61,11 +74,28 @@ class TxCache:
         self._map[key] = None
         return True
 
-    def remove(self, tx: bytes) -> None:
-        self._map.pop(tx_hash(tx), None)
+    def remove(self, tx: bytes, key: bytes | None = None) -> None:
+        self._map.pop(tx_hash(tx) if key is None else key, None)
 
     def reset(self) -> None:
         self._map.clear()
+
+
+class _PendingTx:
+    """One tx parked in the ingest bucket, awaiting its batch verdict.
+    `fut` is None for fire-and-forget parks (check_txs_bulk — the async
+    broadcast path needs no per-tx verdict plumbing); a later duplicate
+    that DOES want the verdict upgrades it in place."""
+
+    __slots__ = ("tx", "key", "fut", "senders")
+
+    def __init__(
+        self, tx: bytes, key: bytes, fut: asyncio.Future | None, sender: str | None
+    ):
+        self.tx = tx
+        self.key = key
+        self.fut = fut
+        self.senders: set = {sender} if sender else set()
 
 
 class CListMempool:
@@ -79,6 +109,10 @@ class CListMempool:
         keep_invalid_txs_in_cache: bool = False,
         recheck: bool = True,
         wal_path: str | None = None,
+        batch: bool = True,
+        batch_window: float = 0.002,
+        batch_max: int = 0,
+        committed_retain: int = 8,
         logger: Logger = NOP,
     ) -> None:
         self.app_conn = app_conn
@@ -98,6 +132,31 @@ class CListMempool:
         # live-path Prometheus (libs/metrics.MempoolMetrics), set by the
         # node when instrumentation.prometheus is on; taps guard on None
         self.metrics = None
+        # -- batched admission (docs/tx_ingestion.md) -----------------------
+        # An app_conn without the batch surface (test stubs, mocks) keeps
+        # the fully serial per-tx path; a real AppConnMempool whose APP
+        # turns out not to implement CheckTxBatch degrades per-tx loudly
+        # on the first flush (_batch_supported flips False).
+        self._batch_enabled = bool(batch) and hasattr(app_conn, "check_tx_batch")
+        self._batch_window = max(0.0, float(batch_window))
+        self._batch_max = int(batch_max)
+        self._batch_supported: bool | None = None
+        self._bucket: list[_PendingTx] = []
+        self._bucket_bytes = 0
+        self._bucket_target = 0  # memoized high-water; reset per take
+        self._pending: dict[bytes, _PendingTx] = {}  # tx hash -> parked entry
+        self._pending_bytes = 0
+        self._deadline_task: asyncio.Task | None = None
+        self._flush_queue: deque[list[_PendingTx]] = deque()
+        self._flush_active = False
+        # recently-committed seen-set, ringed per height: dedup that a
+        # flood cannot churn out of the LRU (a gossip echo of a tx
+        # committed a few blocks ago must short-circuit before ABCI, and
+        # must never be RE-admitted into the clist). Entries age out
+        # `committed_retain` commits after their block.
+        self._committed_retain = max(1, int(committed_retain))
+        self._committed_ring: deque[set[bytes]] = deque()
+        self._committed_set: set[bytes] = set()
         self._wal = None
         if wal_path:
             from tendermint_tpu.libs.autofile import Group
@@ -126,39 +185,322 @@ class CListMempool:
     # -- admission ----------------------------------------------------------
 
     async def check_tx(self, tx: bytes, sender: str | None = None) -> abci.ResponseCheckTx:
-        """Reference clist_mempool.go:211 CheckTx + resCbFirstTime (:363)."""
-        if len(self.txs) >= self.max_txs or self._txs_bytes + len(tx) > self.max_txs_bytes:
+        """Reference clist_mempool.go:211 CheckTx + resCbFirstTime (:363).
+
+        Batch-first: unless batching is off (config, or an app_conn
+        without the surface), the tx parks in the ingest bucket and this
+        coroutine awaits its scattered verdict — one ABCI round trip per
+        BUCKET, not per tx. Dedup layers fire before the bucket, in
+        cost order: live pool membership (robust to LRU churn — a flood
+        must never evict the hash of a tx still IN the pool and let its
+        gossip echo re-admit a duplicate), the recently-committed ring,
+        the in-flight bucket (a duplicate shares the pending verdict),
+        then the LRU's historic window."""
+        key = tx_hash(tx)
+        el = self._tx_map.get(key)
+        if el is not None:
+            if sender is not None:
+                el.value.senders.add(sender)
+            raise TxInCacheError("tx already in mempool")
+        if key in self._committed_set:
+            raise TxInCacheError("tx recently committed")
+        ent = self._pending.get(key)
+        if ent is not None:
+            # duplicate of an in-flight tx: share the batch verdict
+            # instead of burning a second CheckTx round trip (a
+            # fire-and-forget park gains a future on demand)
+            if sender is not None:
+                ent.senders.add(sender)
+            if ent.fut is None:
+                ent.fut = asyncio.get_running_loop().create_future()
+            RECORDER.record("mempool", "dedup_inflight", bytes=len(tx))
+            return await ent.fut
+        if (
+            len(self.txs) + len(self._pending) >= self.max_txs
+            or self._txs_bytes + self._pending_bytes + len(tx) > self.max_txs_bytes
+        ):
             RECORDER.record("mempool", "full", size=len(self.txs),
                             bytes=self._txs_bytes)
             raise MempoolFullError(f"mempool full: {len(self.txs)} txs")
-        if not self.cache.push(tx):
-            # record the extra sender for no-echo gossip, then reject
-            el = self._tx_map.get(tx_hash(tx))
-            if el is not None and sender is not None:
-                el.value.senders.add(sender)
+        if not self.cache.push(tx, key=key):
             raise TxInCacheError("tx already in cache")
         if self._wal is not None:
             self._wal.write(tx + b"\n")
             self._wal.flush()
+        if not self._batch_enabled:
+            return await self._check_tx_serial(tx, key, sender)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        ent = _PendingTx(tx, key, fut, sender)
+        self._pending[key] = ent
+        self._pending_bytes += len(tx)
+        self._bucket.append(ent)
+        self._bucket_bytes += len(tx)
+        if len(self._bucket) >= self._high_water():
+            self._take_bucket("lanes")
+        elif self._deadline_task is None or self._deadline_task.done():
+            self._deadline_task = spawn_logged(
+                self._deadline_flush(), logger=self.logger,
+                name="mempool-ingest-deadline",
+            )
+        return await fut
+
+    async def _check_tx_serial(self, tx: bytes, key: bytes, sender) -> abci.ResponseCheckTx:
+        """The pre-batch admission path: one awaited ABCI round trip."""
         res = await self.app_conn.check_tx(tx)
         if res.is_ok:
             self._add_tx(tx, res.gas_wanted, sender)
         else:
             if not self._keep_invalid_in_cache:
-                self.cache.remove(tx)
+                self.cache.remove(tx, key=key)
             RECORDER.record("mempool", "reject", code=res.code, bytes=len(tx))
             if self.metrics is not None:
                 self.metrics.failed_txs.inc()
             self.logger.debug("rejected bad tx", code=res.code, log=res.log)
         return res
 
-    def _add_tx(self, tx: bytes, gas_wanted: int, sender: str | None) -> None:
+    async def check_txs_bulk(self, txs: list[bytes]) -> int:
+        """Fire-and-forget bulk admission for the async-ack broadcast
+        path (docs/tx_ingestion.md): park a whole burst into the ingest
+        bucket with NO per-tx future/task — the dominant Python cost of
+        draining a flood one coroutine at a time. Dedup, capacity, WAL
+        and verdict handling are identical to check_tx; outcomes land in
+        the recorder/metrics instead of a caller. Returns how many txs
+        were parked (the rest deduped or hit capacity). Falls back to
+        awaited per-tx rounds when batching is off."""
+        if not self._batch_enabled:
+            parked = 0
+            for tx in txs:
+                try:
+                    await self.check_tx(tx)
+                    parked += 1
+                except MempoolError:
+                    pass
+            return parked
+        parked = 0
+        wal_dirty = False
+        high_water = self._high_water()
+        for tx in txs:
+            key = tx_hash(tx)
+            el = self._tx_map.get(key)
+            if el is not None or key in self._committed_set:
+                continue
+            ent = self._pending.get(key)
+            if ent is not None:
+                RECORDER.record("mempool", "dedup_inflight", bytes=len(tx))
+                continue
+            if (
+                len(self.txs) + len(self._pending) >= self.max_txs
+                or self._txs_bytes + self._pending_bytes + len(tx)
+                > self.max_txs_bytes
+            ):
+                RECORDER.record("mempool", "full", size=len(self.txs),
+                                bytes=self._txs_bytes)
+                continue
+            if not self.cache.push(tx, key=key):
+                continue
+            if self._wal is not None:
+                self._wal.write(tx + b"\n")
+                wal_dirty = True
+            ent = _PendingTx(tx, key, None, None)
+            self._pending[key] = ent
+            self._pending_bytes += len(tx)
+            self._bucket.append(ent)
+            self._bucket_bytes += len(tx)
+            parked += 1
+            if len(self._bucket) >= high_water:
+                self._take_bucket("lanes")
+        if wal_dirty:
+            # one flush per burst: nothing is admitted before the batch
+            # flush anyway, so per-tx fsyncs bought no durability — they
+            # were the dominant per-tx syscall cost of the bulk path
+            self._wal.flush()
+        if self._bucket and (
+            self._deadline_task is None or self._deadline_task.done()
+        ):
+            self._deadline_task = spawn_logged(
+                self._deadline_flush(), logger=self.logger,
+                name="mempool-ingest-deadline",
+            )
+        return parked
+
+    # -- ingest accumulator (docs/tx_ingestion.md) --------------------------
+
+    def _high_water(self) -> int:
+        """Bucket lanes that trigger an immediate flush. The streaming
+        flush hint (crypto.batch.stream_flush_hint — the scheduler's
+        routing threshold when ops is loaded, the accumulation hint
+        otherwise) is the point where a flush fills device lanes; the
+        deadline bounds latency below it. Memoized per bucket cycle —
+        consulting the hint per parked tx showed up in the ingest-bench
+        profile."""
+        hw = self._bucket_target
+        if hw:
+            return hw
+        if self._batch_max > 0:
+            hw = self._batch_max
+        else:
+            from tendermint_tpu.crypto import batch as _cb
+
+            # cap 4096: the native batch path saturates its thread fan-out
+            # around there, and one flush must stay well under the device
+            # scheduler's max-pack
+            hw = max(1, min(_cb.stream_flush_hint(), 4096))
+        self._bucket_target = hw
+        return hw
+
+    def _take_bucket(self, trigger: str) -> None:
+        """Move the live bucket onto the FIFO flush queue. One drainer
+        task applies flushed buckets strictly in take order, so admitted
+        txs enter the clist exactly as the serial path would have."""
+        if not self._bucket:
+            return
+        bucket, self._bucket = self._bucket, []
+        self._bucket_bytes = 0
+        self._bucket_target = 0  # re-consult the hint next cycle
+        if self._deadline_task is not None and not self._deadline_task.done():
+            self._deadline_task.cancel()
+        self._deadline_task = None
+        RECORDER.record("mempool", "batch_flush", lanes=len(bucket),
+                        trigger=trigger)
+        self._flush_queue.append(bucket)
+        if not self._flush_active:
+            self._flush_active = True
+            spawn_logged(
+                self._flush_drain(), logger=self.logger,
+                name="mempool-ingest-flush",
+            )
+
+    async def _deadline_flush(self) -> None:
+        await asyncio.sleep(self._batch_window)
+        self._deadline_task = None
+        self._take_bucket("deadline")
+
+    async def _flush_drain(self) -> None:
+        try:
+            while self._flush_queue:
+                bucket = self._flush_queue.popleft()
+                await self._flush_one(bucket)
+        finally:
+            self._flush_active = False
+
+    async def _flush_one(self, bucket: list[_PendingTx]) -> None:
+        txs = [e.tx for e in bucket]
+        try:
+            # MEMPOOL_CHECK class (device/priorities.py): a client is
+            # awaiting the verdict, so admission outranks recheck — but
+            # an admission storm still queues behind consensus/fastsync/
+            # lite at the device
+            with priority_scope(Priority.MEMPOOL_CHECK):
+                responses = await self._batch_check(txs, new_check=True)
+        except BaseException as e:  # noqa: BLE001 — scattered per future:
+            # a stopped scheduler / lost app conn must reject every
+            # waiting broadcast_tx caller, not strand them
+            for ent in bucket:
+                self._pending.pop(ent.key, None)
+                self._pending_bytes -= len(ent.tx)
+                if not self._keep_invalid_in_cache:
+                    self.cache.remove(ent.tx, key=ent.key)
+                if ent.fut is not None and not ent.fut.done():
+                    ent.fut.set_exception(
+                        e if isinstance(e, Exception) else MempoolError(repr(e))
+                    )
+            RECORDER.record("mempool", "batch_error", txs=len(bucket),
+                            err=repr(e))
+            if isinstance(e, (asyncio.CancelledError, GeneratorExit, KeyboardInterrupt, SystemExit)):
+                raise
+            return
+        if self.metrics is not None:
+            self.metrics.batched_txs.inc(len(bucket))
+            self.metrics.batch_lanes.observe(len(bucket))
+        for ent, res in zip(bucket, responses):
+            self._pending.pop(ent.key, None)
+            self._pending_bytes -= len(ent.tx)
+            if res.is_ok:
+                # the tx may have COMMITTED (gossiped copy in another
+                # node's proposal) or been re-admitted while this bucket
+                # was in flight: the caller's verdict stands, but it must
+                # never re-enter the clist — a kvstore-style app without
+                # replay protection would happily execute it twice
+                if ent.key in self._committed_set or ent.key in self._tx_map:
+                    if ent.fut is not None and not ent.fut.done():
+                        ent.fut.set_result(res)
+                    continue
+                # re-check capacity at apply: the pool may have filled
+                # while this bucket was in flight
+                if (
+                    len(self.txs) >= self.max_txs
+                    or self._txs_bytes + len(ent.tx) > self.max_txs_bytes
+                ):
+                    self.cache.remove(ent.tx, key=ent.key)
+                    RECORDER.record("mempool", "full", size=len(self.txs),
+                                    bytes=self._txs_bytes)
+                    if ent.fut is not None and not ent.fut.done():
+                        ent.fut.set_exception(
+                            MempoolFullError(f"mempool full: {len(self.txs)} txs")
+                        )
+                    continue
+                self._add_tx(ent.tx, res.gas_wanted, None, senders=ent.senders,
+                             key=ent.key)
+            else:
+                if not self._keep_invalid_in_cache:
+                    self.cache.remove(ent.tx, key=ent.key)
+                RECORDER.record("mempool", "reject", code=res.code,
+                                bytes=len(ent.tx))
+                if self.metrics is not None:
+                    self.metrics.failed_txs.inc()
+                self.logger.debug("rejected bad tx", code=res.code, log=res.log)
+            if ent.fut is not None and not ent.fut.done():
+                ent.fut.set_result(res)
+
+    async def _batch_check(
+        self, txs: list[bytes], new_check: bool
+    ) -> list[abci.ResponseCheckTx]:
+        """One CheckTxBatch round trip, with the LOUD per-tx fallback for
+        apps that error on the batch surface (a reference-built app
+        answers the unknown oneof arm with an exception response; a
+        stale gRPC app is UNIMPLEMENTED). After the first failure every
+        later bucket goes straight per-tx. The scheduler class is pinned
+        by the caller: _flush_one scopes MEMPOOL_CHECK, _recheck_txs
+        scopes MEMPOOL_RECHECK."""
+        if self._batch_supported is not False:
+            try:
+                out = await self.app_conn.check_tx_batch(
+                    txs, new_check=new_check
+                )
+            except (ABCIClientError, NotImplementedError, AttributeError) as e:
+                self._batch_supported = False
+                self.logger.error(
+                    "app does not implement CheckTxBatch; admission "
+                    "degrades to per-tx round trips (batch fusion lost)",
+                    err=repr(e), txs=len(txs),
+                )
+                RECORDER.record("mempool", "batch_fallback", txs=len(txs),
+                                err=repr(e))
+            else:
+                self._batch_supported = True
+                return out
+        futs = [
+            self.app_conn.check_tx_async(t, new_check=new_check) for t in txs
+        ]
+        await self.app_conn.flush()
+        return [await f for f in futs]
+
+    def _add_tx(
+        self,
+        tx: bytes,
+        gas_wanted: int,
+        sender: str | None,
+        senders: set | None = None,
+        key: bytes | None = None,
+    ) -> None:
         mtx = MempoolTx(
-            tx, self.height, gas_wanted, {sender} if sender else set(),
+            tx, self.height, gas_wanted,
+            set(senders) if senders is not None
+            else ({sender} if sender else set()),
             added_mono=time.monotonic(),
         )
         el = self.txs.push_back(mtx)
-        self._tx_map[tx_hash(tx)] = el
+        self._tx_map[key if key is not None else tx_hash(tx)] = el
         self._txs_bytes += len(tx)
         RECORDER.record("mempool", "add", bytes=len(tx), size=len(self.txs))
         m = self.metrics
@@ -213,15 +555,25 @@ class CListMempool:
         self._tx_available.clear()
         now = time.monotonic()
         removed = 0
+        committed: set[bytes] = set()
         for tx in txs:
-            self.cache.push(tx)  # committed txs stay in cache
-            el = self._tx_map.pop(tx_hash(tx), None)
+            key = tx_hash(tx)
+            self.cache.push(tx, key=key)  # committed txs stay in cache
+            committed.add(key)
+            el = self._tx_map.pop(key, None)
             if el is not None:
                 removed += 1
                 if self.metrics is not None and el.value.added_mono:
                     self.metrics.residency_seconds.observe(now - el.value.added_mono)
                 self._txs_bytes -= len(el.value.tx)
                 self.txs.remove(el)
+        # recently-committed ring: this block's tx hashes join the seen
+        # set; the oldest block's entries are evicted on this commit once
+        # the ring is full (LRU-churn-proof dedup, docs/tx_ingestion.md)
+        self._committed_ring.append(committed)
+        self._committed_set |= committed
+        while len(self._committed_ring) > self._committed_retain:
+            self._committed_set -= self._committed_ring.popleft()
         if self.recheck and len(self.txs) > 0:
             await self._recheck_txs()
         RECORDER.record("mempool", "update", height=height, committed=removed,
@@ -243,13 +595,31 @@ class CListMempool:
 
     async def _recheck_txs_inner(self) -> None:
         els = list(self.txs)
-        futs = [
-            self.app_conn.check_tx_async(el.value.tx, new_check=False) for el in els
-        ]
-        await self.app_conn.flush()
+        if self._batch_enabled:
+            # CheckTxBatch(new_check=False) for the survivor set — a
+            # recheck storm fuses its signature work the same way
+            # admission does (per-tx fallback shared with it). Chunked
+            # at the admission high-water: one unbounded batch would
+            # hold the app lock (LocalClient runs the fused verify under
+            # it) across a 5000-tx device round trip and block the next
+            # block's deliver calls — the priority inversion the
+            # MEMPOOL_RECHECK class exists to prevent.
+            cap = self._high_water()
+            txs = [el.value.tx for el in els]
+            responses: list[abci.ResponseCheckTx] = []
+            for off in range(0, len(txs), cap):
+                responses.extend(
+                    await self._batch_check(txs[off:off + cap], new_check=False)
+                )
+        else:
+            futs = [
+                self.app_conn.check_tx_async(el.value.tx, new_check=False)
+                for el in els
+            ]
+            await self.app_conn.flush()
+            responses = [await f for f in futs]
         dropped = 0
-        for el, fut in zip(els, futs):
-            res = await fut
+        for el, res in zip(els, responses):
             if not res.is_ok:
                 dropped += 1
                 tx = el.value.tx
@@ -263,11 +633,15 @@ class CListMempool:
             self.metrics.recheck_times.inc(len(els))
 
     def flush(self) -> None:
-        """Remove everything (reference Flush)."""
+        """Remove everything (reference Flush). Txs parked in the ingest
+        bucket stay in flight — their verdicts scatter normally; only the
+        admitted pool and the dedup windows reset."""
         for el in list(self.txs):
             self.txs.remove(el)
         self._tx_map.clear()
         self.cache.reset()
+        self._committed_ring.clear()
+        self._committed_set.clear()
         self._txs_bytes = 0
         RECORDER.record("mempool", "flush")
         if self.metrics is not None:

@@ -35,12 +35,28 @@ def decode_tx_message(data: bytes) -> bytes:
 
 
 class MempoolReactor(BaseReactor):
-    def __init__(self, mempool: CListMempool, broadcast: bool = True, logger: Logger = NOP) -> None:
+    def __init__(
+        self,
+        mempool: CListMempool,
+        broadcast: bool = True,
+        gossip_tx_rate: float = 0.0,
+        logger: Logger = NOP,
+    ) -> None:
         super().__init__("MempoolReactor")
         self.mempool = mempool
         self.broadcast = broadcast
         self.log = logger
         self._peer_tasks: dict[str, asyncio.Task] = {}
+        # per-peer gossip-ingest flowrate ceiling (docs/tx_ingestion.md):
+        # over-limit txs drop BEFORE CheckTx and score a tiny non-error
+        # behaviour weight — abuse pressure is visible in the trust
+        # metric, an honest burst never trends toward a ban. Off by
+        # default (config mempool.gossip_tx_rate).
+        from tendermint_tpu.libs.flowrate import KeyedRateLimiter
+
+        self.rate_limiter = KeyedRateLimiter(
+            gossip_tx_rate, burst=gossip_tx_rate * 2.0
+        )
 
     def get_channels(self) -> list[ChannelDescriptor]:
         return [ChannelDescriptor(MEMPOOL_CHANNEL, priority=5, recv_message_capacity=1 << 20)]
@@ -65,6 +81,12 @@ class MempoolReactor(BaseReactor):
             await self.report(
                 peer, PeerBehaviour.bad_message(peer.id, f"mempool: {e!r}")
             )
+            return
+        if self.rate_limiter.enabled and not self.rate_limiter.allow(peer.id):
+            RECORDER.record("mempool", "gossip_rate_limited", peer=peer.id)
+            if self.mempool.metrics is not None:
+                self.mempool.metrics.rate_limited.inc()
+            await self.report(peer, PeerBehaviour.tx_flood(peer.id))
             return
         try:
             res = await self.mempool.check_tx(tx, sender=peer.id)

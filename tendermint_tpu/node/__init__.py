@@ -193,6 +193,9 @@ class Node(BaseService):
             wal_path=os.path.join(cfg.root_dir, cfg.mempool.wal_dir)
             if cfg.mempool.wal_dir
             else None,
+            batch=cfg.mempool.batch,
+            batch_window=cfg.mempool.batch_window,
+            batch_max=cfg.mempool.batch_max,
             logger=log,
         )
         # evidence survives restarts through the same durable backend as
@@ -296,7 +299,10 @@ class Node(BaseService):
             logger=log,
         )
         self.mempool_reactor = MempoolReactor(
-            self.mempool, broadcast=cfg.mempool.broadcast, logger=log
+            self.mempool,
+            broadcast=cfg.mempool.broadcast,
+            gossip_tx_rate=cfg.mempool.gossip_tx_rate,
+            logger=log,
         )
         self.evidence_reactor = EvidenceReactor(self.evidence_pool, logger=log)
         from tendermint_tpu.statesync.reactor import StateSyncReactor

@@ -10,6 +10,7 @@ from __future__ import annotations
 import asyncio
 
 from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci import client as abci_client
 from tendermint_tpu.abci.client import Client, LocalClient, SocketClient
 from tendermint_tpu.libs.service import BaseService
 
@@ -86,6 +87,19 @@ def default_client_creator(
                 app_dir or "kvstore-data", snapshot_interval=interval
             )
         )
+    if proxy_app == "transfer" or proxy_app.startswith("transfer:"):
+        # "transfer[:<curve>[:<initial_balance>]]" — the signed token-
+        # transfer workload (docs/tx_ingestion.md): per-tx secp256k1 (or
+        # ed25519) signatures verified in bulk through the batch CheckTx
+        # surface and the device scheduler.
+        from tendermint_tpu.abci.examples import TransferApplication
+
+        parts = proxy_app.split(":")
+        curve = parts[1] if len(parts) > 1 and parts[1] else "secp256k1"
+        initial = int(parts[2]) if len(parts) > 2 and parts[2] else 10**9
+        return LocalClientCreator(
+            TransferApplication(curve=curve, initial_balance=initial)
+        )
     if proxy_app == "counter":
         from tendermint_tpu.abci.examples import CounterApplication
 
@@ -133,6 +147,22 @@ class AppConnMempool:
 
     async def check_tx(self, tx: bytes, new_check: bool = True) -> abci.ResponseCheckTx:
         return await self._client.check_tx(abci.RequestCheckTx(tx, new_check))
+
+    async def check_tx_batch(
+        self, txs: list[bytes], new_check: bool = True
+    ) -> list[abci.ResponseCheckTx]:
+        """One round trip for a whole ingest bucket (docs/tx_ingestion.md).
+        Raises whatever the transport raises — the mempool owns the loud
+        per-tx fallback for apps that don't implement the batch arm."""
+        res = await self._client.check_tx_batch(
+            abci.RequestCheckTxBatch(txs, new_check)
+        )
+        if len(res.responses) != len(txs):
+            raise abci_client.ABCIClientError(
+                f"CheckTxBatch returned {len(res.responses)} responses "
+                f"for {len(txs)} txs"
+            )
+        return res.responses
 
     async def flush(self) -> None:
         await self._client.flush()

@@ -16,8 +16,14 @@ from tendermint_tpu.abci import types as abci
 from tendermint_tpu.libs.log import NOP, Logger
 from tendermint_tpu.libs.pubsub import Query, SubscriptionCancelled
 from tendermint_tpu.libs.service import spawn_logged
-from tendermint_tpu.mempool import MempoolError, TxInCacheError
-from tendermint_tpu.rpc.jsonrpc import INTERNAL_ERROR, INVALID_PARAMS, RPCError
+from tendermint_tpu.libs.recorder import RECORDER
+from tendermint_tpu.mempool import MempoolError, MempoolFullError, TxInCacheError
+from tendermint_tpu.rpc.jsonrpc import (
+    INTERNAL_ERROR,
+    INVALID_PARAMS,
+    MEMPOOL_BUSY,
+    RPCError,
+)
 from tendermint_tpu.types import events as tmevents
 from tendermint_tpu.types.evidence import decode_evidence
 from tendermint_tpu.types.tx import tx_hash
@@ -195,6 +201,21 @@ class Environment:
         self._subscriber_seq = 0
         self._async_txs: list[bytes] = []
         self._async_drainer_active = False
+        # Per-client broadcast_tx_* flowrate ceiling (docs/tx_ingestion.md):
+        # keyed by the caller's remote host, token-bucket semantics. Off
+        # (rate 0) unless config.rpc.tx_rate_limit sets it.
+        from tendermint_tpu.libs.flowrate import KeyedRateLimiter
+
+        rate = getattr(getattr(config, "rpc", None), "tx_rate_limit", 0.0) or 0.0
+        burst_mult = getattr(getattr(config, "rpc", None), "tx_rate_burst", 2.0)
+        self.tx_limiter = KeyedRateLimiter(rate, burst=rate * burst_mult)
+        # async-ack admissions waiting on the drainer: bounded — a greedy
+        # client must hit the structured full error, not grow this list
+        # without limit (the pre-limit behavior under tm-bench floods)
+        self._async_txs_max = max(
+            1000,
+            int(getattr(getattr(config, "mempool", None), "size", 5000) or 5000),
+        )
 
     # ------------------------------------------------------------------
     # info routes
@@ -695,13 +716,53 @@ class Environment:
     # ------------------------------------------------------------------
     # tx routes
 
-    async def broadcast_tx_async(self, tx) -> dict:
+    def _admit_broadcast(self, ctx, n: int = 1) -> None:
+        """The mempool front door's flowrate gate (one token per TX, so
+        the bulk route cannot launder a flood past the ceiling):
+        over-limit callers get a structured MEMPOOL_BUSY error
+        (data="rate-limited") instead of unbounded queueing. Keyed by
+        remote host so one greedy client cannot starve the rest; off
+        unless config.rpc.tx_rate_limit."""
+        if not self.tx_limiter.enabled:
+            return
+        if n > self.tx_limiter.burst:
+            # a burst deeper than the bucket can NEVER succeed — tell the
+            # client to split instead of "retry" (retrying is futile)
+            raise RPCError(
+                INVALID_PARAMS,
+                f"burst of {n} txs exceeds the per-client bucket depth "
+                f"({self.tx_limiter.burst:g}); split the batch",
+                data="burst-too-large",
+            )
+        remote = getattr(ctx, "remote", None) or "?"
+        client = remote.rsplit(":", 1)[0]
+        if not self.tx_limiter.allow(client, n=n):
+            RECORDER.record("mempool", "rate_limited", client=client)
+            if self.mempool is not None and self.mempool.metrics is not None:
+                self.mempool.metrics.rate_limited.inc()
+            raise RPCError(
+                MEMPOOL_BUSY,
+                f"tx rate limit exceeded ({self.tx_limiter.rate:g} tx/s "
+                "per client); back off and retry",
+                data="rate-limited",
+            )
+
+    async def broadcast_tx_async(self, tx, ctx=None) -> dict:
         """CheckTx is NOT awaited (reference rpc/core/mempool.go).
 
         Queued txs drain through ONE background task per burst instead of
         one task per tx: under tm-bench flood every tx paid a Task object
         and scheduler pass here (a top node-profile cost)."""
+        self._admit_broadcast(ctx)
         raw = _tx_arg(tx)
+        if len(self._async_txs) >= self._async_txs_max:
+            RECORDER.record("mempool", "rate_limited", client="async-queue")
+            raise RPCError(
+                MEMPOOL_BUSY,
+                f"async tx queue full ({self._async_txs_max}); back off "
+                "and retry",
+                data="mempool is full",
+            )
         self._async_txs.append(raw)
         if not self._async_drainer_active:
             self._async_drainer_active = True
@@ -716,6 +777,20 @@ class Environment:
         try:
             while self._async_txs:
                 pending, self._async_txs = self._async_txs, []
+                # the whole burst parks in the mempool's ingest bucket in
+                # ONE call — no per-tx coroutine/future (the dominant
+                # Python cost of draining a flood one await at a time),
+                # and the burst fuses into a handful of CheckTxBatch
+                # round trips. Arrival order is preserved. Stub mempools
+                # without the bulk API keep the per-tx loop.
+                bulk = getattr(self.mempool, "check_txs_bulk", None)
+                if bulk is not None:
+                    try:
+                        await bulk(pending)
+                    except Exception as e:  # noqa: BLE001 — failure
+                        # isolation: async acks never surface outcomes
+                        self.log.error("bulk CheckTx failed", err=repr(e))
+                    continue
                 for raw in pending:
                     try:
                         await self.mempool.check_tx(raw)
@@ -724,15 +799,45 @@ class Environment:
                     except Exception as e:  # noqa: BLE001 — failure
                         # isolation: one tx's transport/app failure must
                         # not kill the shared drainer and strand the rest
-                        # of the burst — but unlike MempoolError it is
-                        # unexpected, so it gets a log line (the old
-                        # task-per-tx design surfaced it via the loop's
-                        # unhandled-exception handler)
                         self.log.error("async CheckTx failed", err=repr(e))
         finally:
             self._async_drainer_active = False
 
-    async def broadcast_tx_sync(self, tx) -> dict:
+    async def broadcast_txs_async(self, txs, ctx=None) -> dict:
+        """Bulk fire-and-forget broadcast for high-throughput clients
+        (docs/tx_ingestion.md): one call carries a comma-separated burst
+        of hex txs that parks into the mempool's ingest bucket as one
+        unit. The flowrate gate spends one token per TX, so the per-call
+        shape cannot launder a flood past the per-client ceiling; the
+        async-queue bound applies to the whole burst. Extension route —
+        not in the reference."""
+        if isinstance(txs, str):
+            items = [t for t in txs.split(",") if t]
+        elif isinstance(txs, list):
+            items = txs
+        else:
+            raise RPCError(INVALID_PARAMS, "txs must be a comma-separated "
+                                           "hex string or a list")
+        raws = [_tx_arg(t) for t in items]
+        self._admit_broadcast(ctx, n=max(1, len(raws)))
+        if len(self._async_txs) + len(raws) > self._async_txs_max:
+            RECORDER.record("mempool", "rate_limited", client="async-queue")
+            raise RPCError(
+                MEMPOOL_BUSY,
+                f"async tx queue full ({self._async_txs_max}); back off "
+                "and retry",
+                data="mempool is full",
+            )
+        self._async_txs.extend(raws)
+        if not self._async_drainer_active:
+            self._async_drainer_active = True
+            spawn_logged(
+                self._drain_async_txs(), logger=self.log, name="rpc-async-tx-drain"
+            )
+        return {"count": len(raws)}
+
+    async def broadcast_tx_sync(self, tx, ctx=None) -> dict:
+        self._admit_broadcast(ctx)
         raw = _tx_arg(tx)
         from tendermint_tpu.crypto import sum_sha256
 
@@ -740,6 +845,8 @@ class Environment:
             res = await self.mempool.check_tx(raw)
         except TxInCacheError:
             raise RPCError(INTERNAL_ERROR, "tx already in cache")
+        except MempoolFullError as e:
+            raise RPCError(MEMPOOL_BUSY, str(e), data="mempool is full")
         except MempoolError as e:
             raise RPCError(INTERNAL_ERROR, str(e))
         return {
@@ -749,9 +856,10 @@ class Environment:
             "hash": _hex(sum_sha256(raw)),
         }
 
-    async def broadcast_tx_commit(self, tx, timeout: float = 10.0) -> dict:
+    async def broadcast_tx_commit(self, tx, timeout: float = 10.0, ctx=None) -> dict:
         """Reference rpc/core/mempool.go BroadcastTxCommit: subscribe to the
         tx event, CheckTx, wait for DeliverTx."""
+        self._admit_broadcast(ctx)
         raw = _tx_arg(tx)
         txh = tx_hash(raw)
         self._subscriber_seq += 1
@@ -762,6 +870,8 @@ class Environment:
         try:
             try:
                 check_res = await self.mempool.check_tx(raw)
+            except MempoolFullError as e:
+                raise RPCError(MEMPOOL_BUSY, str(e), data="mempool is full")
             except MempoolError as e:
                 raise RPCError(INTERNAL_ERROR, str(e))
             if not check_res.is_ok:
@@ -982,6 +1092,7 @@ class Environment:
             "debug_p2p": self.debug_p2p,
             "debug_fault": self.debug_fault,
             "broadcast_tx_async": self.broadcast_tx_async,
+            "broadcast_txs_async": self.broadcast_txs_async,
             "broadcast_tx_sync": self.broadcast_tx_sync,
             "broadcast_tx_commit": self.broadcast_tx_commit,
             "unconfirmed_txs": self.unconfirmed_txs,

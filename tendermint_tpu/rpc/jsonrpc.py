@@ -30,6 +30,10 @@ INVALID_REQUEST = -32600
 METHOD_NOT_FOUND = -32601
 INVALID_PARAMS = -32602
 INTERNAL_ERROR = -32603
+# server-defined (-32000..-32099 application range): the mempool front
+# door refusing work it could only take by queueing unboundedly — the
+# client should back off and retry (docs/tx_ingestion.md)
+MEMPOOL_BUSY = -32001
 
 
 class RPCError(Exception):

@@ -44,6 +44,8 @@ class TestWireCodec:
                 [abci.EvidenceInfo("duplicate/vote", b"addr", 2, 100)],
             ),
             abci.RequestCheckTx(b"tx", False),
+            abci.RequestCheckTxBatch([b"t1", b"", b"t3"], False),
+            abci.RequestCheckTxBatch([]),
             abci.RequestDeliverTx(b"tx2"),
             abci.RequestEndBlock(9),
             abci.RequestCommit(),
@@ -58,6 +60,16 @@ class TestWireCodec:
             # ISSUE 13 / TM602 regression: info must survive the wire
             abci.ResponseSetOption(0, "ok", "details"),
             abci.ResponseCheckTx(code=1, log="bad", events={"k": ["v1", "v2"]}),
+            abci.ResponseCheckTxBatch(
+                [
+                    abci.ResponseCheckTx(code=0, gas_wanted=1),
+                    abci.ResponseCheckTx(
+                        code=4, log="poor", info="i", codespace="transfer",
+                        events={"k": ["v"]},
+                    ),
+                ]
+            ),
+            abci.ResponseCheckTxBatch([]),
             abci.ResponseDeliverTx(code=0, data=b"result"),
             abci.ResponseEndBlock([abci.ValidatorUpdate(b"pk", 7)], b"", {}),
             abci.ResponseCommit(b"apphash"),

@@ -205,6 +205,17 @@ class TestOracleInterop:
             arm, oracle,
         )
 
+    def test_batch_arm_unknown_to_reference_peer(self, oracle):
+        """The CheckTxBatch extension rides oneof arm 20/18 — numbers the
+        reference schema doesn't know. A reference-built peer must parse
+        the frame as a Request with NO arm set (proto3 unknown-field
+        skip), which its server answers with an exception response — the
+        clean trigger for the mempool's loud per-tx fallback."""
+        data = pb.encode_request(abci.RequestCheckTxBatch([b"a", b"b"]))
+        msg = oracle.Request()
+        msg.ParseFromString(data)
+        assert msg.WhichOneof("value") is None
+
     def test_query_response_with_proof(self, oracle):
         from tendermint_tpu.crypto.merkle import ProofOp
 

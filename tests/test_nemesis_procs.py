@@ -39,6 +39,15 @@ def test_nemesis_flood():
 
 
 @pytest.mark.slow
+def test_nemesis_mempool_flood():
+    """ISSUE 14 acceptance: a greedy client's waved async-tx storm — the
+    flowrate limiter engages with structured refusals, consensus commit
+    latency stays flat (CONSENSUS_COMMIT wait accounting), and no honest
+    peer is banned for the spam pressure."""
+    nemesis.run(["nemesis_mempool_flood"], n=4)
+
+
+@pytest.mark.slow
 def test_nemesis_flapping_device():
     nemesis.run(["nemesis_flapping_device"], n=4)
 

@@ -113,6 +113,7 @@ class TestPriorityOrdering:
         # is aged-priority order
         order = [
             (Priority.MEMPOOL_RECHECK, b"m"),
+            (Priority.MEMPOOL_CHECK, b"a"),  # admission outranks recheck
             (Priority.LITE, b"l"),
             (Priority.FASTSYNC, b"f"),
             (Priority.CONSENSUS_COMMIT, b"c"),
@@ -122,14 +123,14 @@ class TestPriorityOrdering:
             for p, tag in order
         ]
         deadline = time.monotonic() + 5
-        while sched.queue_state()["depth_total"] < 4:
+        while sched.queue_state()["depth_total"] < 5:
             assert time.monotonic() < deadline
             time.sleep(0.005)
         stub.gate.set()
         for f in futs:
             assert f.result(5) == [True]
         blocker.result(5)
-        assert stub.calls[1] == [b"c", b"f", b"l", b"m"]
+        assert stub.calls[1] == [b"c", b"f", b"l", b"a", b"m"]
 
     def test_no_preempt_count_for_packed_mates(self, sched):
         # a same-curve request coalesced INTO the winning dispatch was
@@ -390,7 +391,8 @@ class TestLifecycle:
     def test_queue_state_shape(self, sched):
         qs = sched.queue_state()
         assert set(qs["classes"]) == {
-            "consensus_commit", "fastsync", "lite", "mempool_recheck"
+            "consensus_commit", "fastsync", "lite", "mempool_check",
+            "mempool_recheck",
         }
         assert qs["stalled"] is False
 

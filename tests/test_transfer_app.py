@@ -1,0 +1,252 @@
+"""Transfer app state machine (ISSUE 14, docs/tx_ingestion.md).
+
+Runs without the `cryptography` package: workloads are signed with the
+pure-python dev signers (crypto/*_math.py) and verified through the app's
+backend ladder (registered backend > native batch > math oracle).
+"""
+from __future__ import annotations
+
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.examples import transfer as tr
+from tendermint_tpu.crypto import ed25519_math, secp256k1_math
+
+
+def _priv(i: int, curve: str = "secp256k1") -> bytes:
+    if curve == "ed25519":
+        return bytes([i]) * 32
+    return bytes([i]) * 31 + b"\x01"
+
+
+def _addr(i: int, curve: str = "secp256k1") -> bytes:
+    m = ed25519_math if curve == "ed25519" else secp256k1_math
+    return tr.address(m.pub_from_priv(_priv(i, curve)))
+
+
+def _tx(i: int, nonce: int, amount: int = 10, curve: str = "secp256k1",
+        to: bytes | None = None) -> bytes:
+    return tr.make_tx(curve, _priv(i, curve), to or _addr(99), amount, nonce)
+
+
+class TestSigners:
+    @pytest.mark.parametrize("curve", ["ed25519", "secp256k1"])
+    def test_math_signer_round_trip(self, curve):
+        m = ed25519_math if curve == "ed25519" else secp256k1_math
+        priv = _priv(7, curve)
+        pub = m.pub_from_priv(priv)
+        sig = m.sign(priv, b"msg")
+        assert m.verify(pub, b"msg", sig)
+        assert not m.verify(pub, b"msh", sig)
+        assert not m.verify(pub, b"msg", sig[:-1] + bytes([sig[-1] ^ 1]))
+
+    def test_secp_low_s(self):
+        for i in range(1, 6):
+            sig = secp256k1_math.sign(_priv(i), b"m%d" % i)
+            s = int.from_bytes(sig[32:], "big")
+            assert 0 < s <= secp256k1_math.HALF_N
+
+    @pytest.mark.parametrize("curve", ["ed25519", "secp256k1"])
+    def test_native_batch_accepts_math_signatures(self, curve):
+        from tendermint_tpu.crypto import native
+
+        if native.load() is None:
+            pytest.skip("native library unavailable")
+        m = ed25519_math if curve == "ed25519" else secp256k1_math
+        privs = [_priv(i, curve) for i in range(1, 9)]
+        pubs = [m.pub_from_priv(p) for p in privs]
+        msgs = [b"m%d" % i for i in range(8)]
+        sigs = [m.sign(p, msg) for p, msg in zip(privs, msgs)]
+        fn = (
+            native.ed25519_verify_batch
+            if curve == "ed25519"
+            else native.secp256k1_verify_batch
+        )
+        assert fn(pubs, msgs, sigs) == [True] * 8
+        sigs[3] = sigs[3][:-1] + bytes([sigs[3][-1] ^ 1])
+        assert fn(pubs, msgs, sigs) == [True] * 3 + [False] + [True] * 4
+
+
+class TestTxCodec:
+    @pytest.mark.parametrize("curve", ["ed25519", "secp256k1"])
+    def test_roundtrip(self, curve):
+        tx = _tx(1, 0, curve=curve)
+        t = tr.decode_tx(tx)
+        assert t.nonce == 0 and t.amount == 10
+        assert tr.encode_tx(t.curve, t.pub, t.to, t.amount, t.nonce, t.sig) == tx
+
+    def test_sign_bytes_slice_matches_field_encoding(self):
+        """sign_bytes_of (the admission hot path's slice) must equal the
+        field-wise construction the signers use, on both curves."""
+        for curve in ("ed25519", "secp256k1"):
+            tx = _tx(1, 3, amount=77, curve=curve)
+            t = tr.decode_tx(tx)
+            assert tr.sign_bytes_of(tx) == t.sign_bytes()
+
+    def test_malformed_rejects(self):
+        from tendermint_tpu.encoding import DecodeError
+
+        with pytest.raises(DecodeError):
+            tr.decode_tx(b"garbage")
+        t = tr.decode_tx(_tx(1, 0))
+        with pytest.raises(DecodeError):  # wrong pub size for curve tag
+            tr.decode_tx(
+                tr.encode_tx(tr.CURVE_ED25519, t.pub, t.to, 1, 0, t.sig)
+            )
+
+
+class TestStateMachine:
+    def test_happy_path_and_balances(self):
+        app = tr.TransferApplication(initial_balance=1000)
+        tx = _tx(1, 0, amount=100)
+        assert app.check_tx(abci.RequestCheckTx(tx)).is_ok
+        res = app.deliver_tx(abci.RequestDeliverTx(tx))
+        assert res.is_ok
+        assert res.events["transfer.amount"] == ["100"]
+        app.commit()
+        assert app.balance(_addr(1)) == 900
+        assert app.balance(_addr(99)) == 1100
+        assert app.nonce(_addr(1)) == 1
+
+    def test_replay_rejects(self):
+        app = tr.TransferApplication(initial_balance=1000)
+        tx = _tx(1, 0)
+        assert app.check_tx(abci.RequestCheckTx(tx)).is_ok
+        # same nonce again (identical tx or a different one): both reject
+        assert app.check_tx(abci.RequestCheckTx(tx)).code == tr.CODE_BAD_NONCE
+        assert (
+            app.check_tx(abci.RequestCheckTx(_tx(1, 0, amount=1))).code
+            == tr.CODE_BAD_NONCE
+        )
+        app.deliver_tx(abci.RequestDeliverTx(tx))
+        app.commit()
+        # replay after commit rejects at deliver too
+        assert app.deliver_tx(abci.RequestDeliverTx(tx)).code == tr.CODE_BAD_NONCE
+
+    def test_nonce_gap_rejects_but_sequence_admits(self):
+        app = tr.TransferApplication(initial_balance=1000)
+        assert (
+            app.check_tx(abci.RequestCheckTx(_tx(1, 5))).code
+            == tr.CODE_BAD_NONCE
+        )
+        # a burst of sequential nonces admits in one mempool lifetime
+        for n in range(4):
+            assert app.check_tx(abci.RequestCheckTx(_tx(1, n))).is_ok
+
+    def test_overdraft_rejects(self):
+        app = tr.TransferApplication(initial_balance=50)
+        assert (
+            app.check_tx(abci.RequestCheckTx(_tx(1, 0, amount=51))).code
+            == tr.CODE_INSUFFICIENT_FUNDS
+        )
+        # check-state tracks spends across a burst
+        assert app.check_tx(abci.RequestCheckTx(_tx(1, 0, amount=30))).is_ok
+        assert (
+            app.check_tx(abci.RequestCheckTx(_tx(1, 1, amount=30))).code
+            == tr.CODE_INSUFFICIENT_FUNDS
+        )
+        # deliver enforces against committed state
+        assert (
+            app.deliver_tx(abci.RequestDeliverTx(_tx(1, 0, amount=51))).code
+            == tr.CODE_INSUFFICIENT_FUNDS
+        )
+
+    def test_bad_signature_rejects(self):
+        app = tr.TransferApplication()
+        tx = bytearray(_tx(1, 0))
+        tx[-1] ^= 1
+        assert (
+            app.check_tx(abci.RequestCheckTx(bytes(tx))).code
+            == tr.CODE_BAD_SIGNATURE
+        )
+        assert (
+            app.deliver_tx(abci.RequestDeliverTx(bytes(tx))).code
+            == tr.CODE_BAD_SIGNATURE
+        )
+
+    def test_deliver_verifies_unchecked_txs(self):
+        """A block built on another node carries txs this app never
+        CheckTx'd — DeliverTx must verify their signatures itself."""
+        app = tr.TransferApplication(initial_balance=1000)
+        tx = _tx(1, 0)
+        assert app.deliver_tx(abci.RequestDeliverTx(tx)).is_ok  # full verify
+        bad = bytearray(_tx(2, 0))
+        bad[-2] ^= 0xFF
+        assert (
+            app.deliver_tx(abci.RequestDeliverTx(bytes(bad))).code
+            == tr.CODE_BAD_SIGNATURE
+        )
+
+    def test_batch_parity_with_serial(self):
+        txs = [_tx(1, 0), _tx(1, 1), _tx(2, 0, amount=10**12),
+               _tx(3, 0, curve="ed25519"), b"garbage"]
+        tampered = bytearray(_tx(4, 0))
+        tampered[-1] ^= 1
+        txs.append(bytes(tampered))
+        a = tr.TransferApplication(initial_balance=1000)
+        b = tr.TransferApplication(initial_balance=1000)
+        serial = [a.check_tx(abci.RequestCheckTx(t)).code for t in txs]
+        batch = [
+            r.code
+            for r in b.check_tx_batch(abci.RequestCheckTxBatch(txs)).responses
+        ]
+        assert serial == batch
+        assert batch == [
+            tr.CODE_OK, tr.CODE_OK, tr.CODE_INSUFFICIENT_FUNDS, tr.CODE_OK,
+            tr.CODE_ENCODING, tr.CODE_BAD_SIGNATURE,
+        ]
+
+    def test_recheck_skips_signatures_but_rechecks_state(self):
+        app = tr.TransferApplication(initial_balance=1000)
+        tx0, tx1 = _tx(1, 0), _tx(1, 1)
+        res = app.check_tx_batch(abci.RequestCheckTxBatch([tx0, tx1]))
+        assert all(r.is_ok for r in res.responses)
+        # block commits tx0 only; mempool rechecks tx1
+        app.deliver_tx(abci.RequestDeliverTx(tx0))
+        app.commit()
+        res = app.check_tx_batch(
+            abci.RequestCheckTxBatch([tx1], new_check=False)
+        )
+        assert res.responses[0].is_ok  # nonce 1 is now next: survives
+        # a recheck of the committed tx0 drops on nonce
+        res = app.check_tx_batch(
+            abci.RequestCheckTxBatch([tx0], new_check=False)
+        )
+        assert res.responses[0].code == tr.CODE_BAD_NONCE
+
+    def test_app_hash_deterministic_and_tx_sensitive(self):
+        def play(txs):
+            app = tr.TransferApplication(initial_balance=1000)
+            for t in txs:
+                app.deliver_tx(abci.RequestDeliverTx(t))
+            return app.commit().data
+
+        txs = [_tx(1, 0), _tx(2, 0)]
+        assert play(txs) == play(txs)
+        assert play(txs) != play(txs[:1])
+        assert play(txs) != play(list(reversed(txs)))
+
+    def test_query_balance_and_nonce(self):
+        app = tr.TransferApplication(initial_balance=500)
+        tx = _tx(1, 0, amount=20)
+        app.deliver_tx(abci.RequestDeliverTx(tx))
+        app.commit()
+        q = app.query(abci.RequestQuery(data=_addr(1), path="/balance"))
+        assert q.is_ok and q.value == b"480"
+        q = app.query(abci.RequestQuery(data=_addr(1).hex().encode(), path="/nonce"))
+        assert q.is_ok and q.value == b"1"
+        assert not app.query(abci.RequestQuery(data=b"short")).is_ok
+
+    def test_init_chain_sets_initial_balance(self):
+        app = tr.TransferApplication()
+        app.init_chain(
+            abci.RequestInitChain(app_state_bytes=b'{"initial_balance": 7}')
+        )
+        assert app.balance(_addr(1)) == 7
+
+    def test_mixed_curves_one_batch(self):
+        app = tr.TransferApplication(initial_balance=1000)
+        txs = [_tx(1, 0), _tx(2, 0, curve="ed25519"),
+               _tx(3, 0), _tx(4, 0, curve="ed25519")]
+        res = app.check_tx_batch(abci.RequestCheckTxBatch(txs))
+        assert [r.code for r in res.responses] == [0, 0, 0, 0]

@@ -1,0 +1,666 @@
+"""Device-batched tx admission (ISSUE 14, docs/tx_ingestion.md).
+
+Crypto-free: the ingest accumulator, its dedup layers, the CheckTxBatch
+ABCI surface on all three transports, and the flowrate limiters all run
+without the `cryptography` package (the app side is stubbed or the
+signature-free kvstore).
+"""
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.client import ABCIClientError
+from tendermint_tpu.libs.flowrate import KeyedRateLimiter
+from tendermint_tpu.mempool import (
+    CListMempool,
+    MempoolFullError,
+    TxInCacheError,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class ScriptedApp(abci.BaseApplication):
+    """check_tx verdict by suffix: ...bad -> code 1; records call shape."""
+
+    def __init__(self) -> None:
+        self.calls: list[tuple[str, int, bool]] = []
+
+    def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
+        self.calls.append(("single", 1, req.new_check))
+        return abci.ResponseCheckTx(
+            code=1 if req.tx.endswith(b"bad") else 0, gas_wanted=1
+        )
+
+    def check_tx_batch(self, req: abci.RequestCheckTxBatch) -> abci.ResponseCheckTxBatch:
+        self.calls.append(("batch", len(req.txs), req.new_check))
+        return abci.ResponseCheckTxBatch(
+            responses=[
+                abci.ResponseCheckTx(
+                    code=1 if t.endswith(b"bad") else 0, gas_wanted=1
+                )
+                for t in req.txs
+            ]
+        )
+
+
+async def _conns(app):
+    from tendermint_tpu.proxy import AppConns, LocalClientCreator
+
+    conns = AppConns(LocalClientCreator(app))
+    await conns.start()
+    return conns
+
+
+class TestIngestAccumulator:
+    def test_flush_on_high_water(self):
+        async def main():
+            app = ScriptedApp()
+            conns = await _conns(app)
+            try:
+                # batch_max=4: the 4th parked tx flushes without waiting
+                # for the deadline (window deliberately huge)
+                mp = CListMempool(
+                    conns.mempool, batch_window=30.0, batch_max=4
+                )
+                res = await asyncio.gather(
+                    *[mp.check_tx(b"tx%d" % i) for i in range(4)]
+                )
+                assert [r.code for r in res] == [0] * 4
+                assert app.calls == [("batch", 4, True)]
+                assert mp.size() == 4
+            finally:
+                await conns.stop()
+
+        run(main())
+
+    def test_flush_on_deadline(self):
+        async def main():
+            app = ScriptedApp()
+            conns = await _conns(app)
+            try:
+                mp = CListMempool(
+                    conns.mempool, batch_window=0.01, batch_max=1000
+                )
+                res = await mp.check_tx(b"lone")
+                assert res.is_ok
+                assert app.calls == [("batch", 1, True)]
+            finally:
+                await conns.stop()
+
+        run(main())
+
+    def test_verdict_scatter_mixed(self):
+        async def main():
+            app = ScriptedApp()
+            conns = await _conns(app)
+            try:
+                mp = CListMempool(conns.mempool, batch_window=0.005)
+                res = await asyncio.gather(
+                    mp.check_tx(b"a-ok"),
+                    mp.check_tx(b"b-bad"),
+                    mp.check_tx(b"c-ok"),
+                )
+                assert [r.code for r in res] == [0, 1, 0]
+                # only the admitted txs entered the pool
+                assert mp.size() == 2
+                # the rejected tx left the LRU (keep_invalid default off):
+                # a retry reaches the app again
+                res2 = await mp.check_tx(b"b-bad")
+                assert res2.code == 1
+            finally:
+                await conns.stop()
+
+        run(main())
+
+    def test_clist_order_is_arrival_order(self):
+        async def main():
+            app = ScriptedApp()
+            conns = await _conns(app)
+            try:
+                # two buckets flush back to back; admitted order must be
+                # arrival order across bucket boundaries
+                mp = CListMempool(conns.mempool, batch_window=30.0, batch_max=3)
+                futs = [
+                    asyncio.ensure_future(mp.check_tx(b"tx%02d" % i))
+                    for i in range(6)
+                ]
+                await asyncio.gather(*futs)
+                assert len(app.calls) == 2
+                reaped = mp.reap_max_txs(-1)
+                assert reaped == [b"tx%02d" % i for i in range(6)]
+            finally:
+                await conns.stop()
+
+        run(main())
+
+    def test_full_mempool_rejects_at_park(self):
+        async def main():
+            app = ScriptedApp()
+            conns = await _conns(app)
+            try:
+                mp = CListMempool(
+                    conns.mempool, max_txs=2, batch_window=0.005
+                )
+                ok = await asyncio.gather(
+                    mp.check_tx(b"t1"), mp.check_tx(b"t2")
+                )
+                assert all(r.is_ok for r in ok)
+                with pytest.raises(MempoolFullError):
+                    await mp.check_tx(b"t3")
+                # in-flight txs count toward capacity too
+                mp2 = CListMempool(
+                    conns.mempool, max_txs=1, batch_window=30.0, batch_max=10
+                )
+                f1 = asyncio.ensure_future(mp2.check_tx(b"p1"))
+                await asyncio.sleep(0)  # parked, not yet flushed
+                with pytest.raises(MempoolFullError):
+                    await mp2.check_tx(b"p2")
+                f1.cancel()
+            finally:
+                await conns.stop()
+
+        run(main())
+
+    def test_conn_failure_propagates_to_all_waiters(self):
+        class Down:
+            async def check_tx_batch(self, txs, new_check=True):
+                raise ConnectionResetError("app conn lost")
+
+        async def main():
+            mp = CListMempool(Down(), batch_window=0.005)
+            res = await asyncio.gather(
+                mp.check_tx(b"x1"), mp.check_tx(b"x2"),
+                return_exceptions=True,
+            )
+            assert all(isinstance(r, ConnectionResetError) for r in res)
+            assert mp.size() == 0
+            # cache entries were released: a retry is not a dup error
+            res2 = await asyncio.gather(
+                mp.check_tx(b"x1"), return_exceptions=True
+            )
+            assert isinstance(res2[0], ConnectionResetError)
+
+        run(main())
+
+    def test_inflight_duplicate_shares_verdict(self):
+        async def main():
+            app = ScriptedApp()
+            conns = await _conns(app)
+            try:
+                mp = CListMempool(conns.mempool, batch_window=0.01)
+                f1 = asyncio.ensure_future(mp.check_tx(b"dup"))
+                await asyncio.sleep(0)  # parked
+                f2 = asyncio.ensure_future(mp.check_tx(b"dup", sender="p9"))
+                r1, r2 = await asyncio.gather(f1, f2)
+                assert r1.is_ok and r2.is_ok
+                # ONE app call, ONE pool entry, gossip sender recorded
+                assert app.calls == [("batch", 1, True)]
+                assert mp.size() == 1
+                el = mp._tx_map[__import__(
+                    "tendermint_tpu.types.tx", fromlist=["tx_hash"]
+                ).tx_hash(b"dup")]
+                assert "p9" in el.value.senders
+            finally:
+                await conns.stop()
+
+        run(main())
+
+    def test_pool_and_committed_dedup_layers(self):
+        async def main():
+            app = ScriptedApp()
+            conns = await _conns(app)
+            try:
+                mp = CListMempool(
+                    conns.mempool, batch_window=0.005, committed_retain=2,
+                    cache_size=1,  # LRU churns instantly: the layers above
+                    # it must still dedup correctly
+                )
+                await mp.check_tx(b"t1")
+                await mp.check_tx(b"t2")  # LRU now only remembers t2
+                # t1 is still IN the pool: must dedup via _tx_map, never
+                # re-reach the app (the double-admission bug)
+                with pytest.raises(TxInCacheError):
+                    await mp.check_tx(b"t1")
+                assert mp.size() == 2
+                # commit t1: ring remembers it for committed_retain blocks
+                await mp.update(1, [b"t1"])
+                with pytest.raises(TxInCacheError):
+                    await mp.check_tx(b"t1")
+                await mp.update(2, [])
+                await mp.update(3, [])  # ring evicts height-1 entries
+                # churn t1 out of the 1-slot LRU too (committed txs stay
+                # in the LRU per the reference; the ring is the bounded-
+                # lifetime layer) — now re-admission is allowed
+                await mp.check_tx(b"t3")
+                res = await mp.check_tx(b"t1")
+                assert res.is_ok
+            finally:
+                await conns.stop()
+
+        run(main())
+
+    def test_committed_while_in_flight_never_readmitted(self):
+        """A tx that COMMITS while its bucket is awaiting the app (its
+        gossiped copy rode another node's proposal) must not re-enter
+        the clist at scatter — a replay-unprotected app would execute it
+        twice."""
+
+        class SlowConn:
+            def __init__(self):
+                self.gate = asyncio.Event()
+
+            async def check_tx_batch(self, txs, new_check=True):
+                await self.gate.wait()
+                return [abci.ResponseCheckTx(code=0, gas_wanted=1) for _ in txs]
+
+            def check_tx_async(self, tx, new_check=True):
+                fut = asyncio.get_event_loop().create_future()
+                fut.set_result(abci.ResponseCheckTx(code=0))
+                return fut
+
+            async def flush(self):
+                pass
+
+        async def main():
+            conn = SlowConn()
+            mp = CListMempool(conn, batch_window=30.0, batch_max=2)
+            f1 = asyncio.ensure_future(mp.check_tx(b"racer"))
+            f2 = asyncio.ensure_future(mp.check_tx(b"mate"))
+            await asyncio.sleep(0.01)  # both parked, flush awaiting gate
+            # the block containing "racer" commits on this node first
+            await mp.update(1, [b"racer"])
+            conn.gate.set()
+            r1, r2 = await asyncio.gather(f1, f2)
+            assert r1.is_ok and r2.is_ok  # verdicts still scatter
+            assert mp.reap_max_txs(-1) == [b"mate"]  # racer NOT re-added
+            assert mp.size() == 1
+
+        run(main())
+
+    def test_loud_fallback_per_tx(self):
+        class NoBatchConn:
+            """AppConnMempool shape whose batch arm errors (reference app
+            behind a socket answering the unknown oneof with an
+            exception response)."""
+
+            def __init__(self):
+                self.batch_calls = 0
+                self.single = []
+
+            async def check_tx_batch(self, txs, new_check=True):
+                self.batch_calls += 1
+                raise ABCIClientError("unknown request")
+
+            def check_tx_async(self, tx, new_check=True):
+                self.single.append(tx)
+                fut = asyncio.get_event_loop().create_future()
+                fut.set_result(abci.ResponseCheckTx(code=0, gas_wanted=1))
+                return fut
+
+            async def flush(self):
+                pass
+
+        async def main():
+            conn = NoBatchConn()
+            mp = CListMempool(conn, batch_window=0.005)
+            res = await asyncio.gather(mp.check_tx(b"a"), mp.check_tx(b"b"))
+            assert all(r.is_ok for r in res)
+            assert conn.batch_calls == 1  # probed once
+            assert conn.single == [b"a", b"b"]  # bucket re-ran per-tx
+            assert mp._batch_supported is False
+            # later buckets skip the probe entirely
+            await mp.check_tx(b"c")
+            assert conn.batch_calls == 1
+            assert mp.size() == 3
+
+        run(main())
+
+    def test_stub_conn_without_batch_surface_stays_serial(self):
+        class Plain:
+            def __init__(self):
+                self.calls = []
+
+            async def check_tx(self, tx, new_check=True):
+                self.calls.append(tx)
+                return abci.ResponseCheckTx(code=0, gas_wanted=1)
+
+        async def main():
+            conn = Plain()
+            mp = CListMempool(conn)
+            assert mp._batch_enabled is False
+            res = await mp.check_tx(b"t")
+            assert res.is_ok and conn.calls == [b"t"]
+
+        run(main())
+
+    def test_recheck_uses_batch_surface(self):
+        async def main():
+            app = ScriptedApp()
+            conns = await _conns(app)
+            try:
+                mp = CListMempool(conns.mempool, batch_window=0.005)
+                await asyncio.gather(*[mp.check_tx(b"r%d" % i) for i in range(3)])
+                app.calls.clear()
+                await mp.update(1, [b"r0"])
+                assert app.calls == [("batch", 2, False)]
+                assert mp.size() == 2
+            finally:
+                await conns.stop()
+
+        run(main())
+
+
+class TestBatchSurfaceTransports:
+    """CheckTxBatch round-trips on the CBE socket, the proto socket, and
+    gRPC — the same KVStore-derived app on each."""
+
+    @pytest.mark.parametrize("codec", ["cbe", "proto"])
+    def test_socket_roundtrip(self, codec):
+        from tendermint_tpu.abci.client import SocketClient
+        from tendermint_tpu.abci.server import ABCIServer
+
+        async def main():
+            app = ScriptedApp()
+            server = ABCIServer(app, "tcp://127.0.0.1:0", codec=codec)
+            await server.start()
+            client = SocketClient(
+                f"tcp://127.0.0.1:{server.port}", codec=codec
+            )
+            await client.start()
+            try:
+                resp = await client.check_tx_batch(
+                    abci.RequestCheckTxBatch([b"ok1", b"xbad", b"ok2"])
+                )
+                assert [r.code for r in resp.responses] == [0, 1, 0]
+                assert app.calls == [("batch", 3, True)]
+                # recheck flag survives the wire
+                resp = await client.check_tx_batch(
+                    abci.RequestCheckTxBatch([b"ok1"], new_check=False)
+                )
+                assert app.calls[-1] == ("batch", 1, False)
+                assert resp.responses[0].is_ok
+            finally:
+                await client.stop()
+                await server.stop()
+
+        run(main())
+
+    def test_grpc_roundtrip(self):
+        pytest.importorskip("grpc")
+        from tendermint_tpu.abci.grpc import GRPCABCIServer, GRPCClient
+
+        async def main():
+            app = ScriptedApp()
+            server = GRPCABCIServer(app, "127.0.0.1:0")
+            await server.start()
+            client = GRPCClient(f"127.0.0.1:{server.port}")
+            await client.start()
+            try:
+                resp = await client.check_tx_batch(
+                    abci.RequestCheckTxBatch([b"ok1", b"xbad"])
+                )
+                assert [r.code for r in resp.responses] == [0, 1]
+                assert app.calls == [("batch", 2, True)]
+            finally:
+                await client.stop()
+                await server.stop()
+
+        run(main())
+
+    def test_proto_codec_roundtrip_unit(self):
+        from tendermint_tpu.abci import proto as pb
+
+        req = abci.RequestCheckTxBatch([b"a", b"", b"ccc"], new_check=False)
+        assert pb.decode_request(pb.encode_request(req)) == req
+        assert pb.decode_bare("RequestCheckTxBatch", pb.encode_bare(req)) == req
+        resp = abci.ResponseCheckTxBatch(
+            [
+                abci.ResponseCheckTx(code=0, gas_wanted=2),
+                abci.ResponseCheckTx(code=4, codespace="transfer", log="poor"),
+            ]
+        )
+        assert pb.decode_response(pb.encode_response(resp)) == resp
+        assert (
+            pb.decode_bare("ResponseCheckTxBatch", pb.encode_bare(resp)) == resp
+        )
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+class TestKeyedRateLimiter:
+    def test_disabled_at_zero_rate(self):
+        lim = KeyedRateLimiter(0.0)
+        assert not lim.enabled
+        assert all(lim.allow("k") for _ in range(10_000))
+        assert lim.snapshot()["keys"] == 0  # no state kept
+
+    def test_burst_then_refill(self):
+        clk = FakeClock()
+        lim = KeyedRateLimiter(10.0, burst=20.0, clock=clk)
+        assert sum(lim.allow("c") for _ in range(25)) == 20  # burst depth
+        assert not lim.allow("c")
+        clk.t += 0.5  # 5 tokens earned
+        assert sum(lim.allow("c") for _ in range(10)) == 5
+        # idle forever: credit caps at burst, not rate*elapsed
+        clk.t += 3600.0
+        assert sum(lim.allow("c") for _ in range(40)) == 20
+
+    def test_keys_are_independent(self):
+        clk = FakeClock()
+        lim = KeyedRateLimiter(1.0, burst=1.0, clock=clk)
+        assert lim.allow("a")
+        assert not lim.allow("a")
+        assert lim.allow("b")  # a's spend never touches b
+
+    def test_lru_eviction_bounds_table(self):
+        clk = FakeClock()
+        lim = KeyedRateLimiter(1.0, burst=1.0, max_keys=3, clock=clk)
+        for k in "abcd":
+            lim.allow(k)
+        snap = lim.snapshot()
+        assert snap["keys"] == 3  # "a" evicted
+        # eviction errs toward allowing: "a" returns with a fresh bucket
+        assert lim.allow("a")
+
+    def test_counters(self):
+        clk = FakeClock()
+        lim = KeyedRateLimiter(1.0, burst=1.0, clock=clk)
+        lim.allow("x")
+        lim.allow("x")
+        snap = lim.snapshot()
+        assert snap["allowed"] == 1 and snap["denied"] == 1
+
+
+class TestRPCRateLimit:
+    def _env(self, rate: float):
+        from tendermint_tpu.config import Config
+        from tendermint_tpu.rpc.core import Environment
+
+        cfg = Config()
+        cfg.rpc.tx_rate_limit = rate
+
+        class MiniPool:
+            metrics = None
+
+            def __init__(self):
+                self.seen = []
+
+            async def check_tx(self, tx, sender=None):
+                self.seen.append(tx)
+                return abci.ResponseCheckTx(code=0)
+
+        pool = MiniPool()
+        return Environment(config=cfg, mempool=pool), pool
+
+    def test_over_limit_is_structured_error(self):
+        from tendermint_tpu.rpc.jsonrpc import MEMPOOL_BUSY, RPCError
+
+        class Ctx:
+            remote = "10.1.2.3:5555"
+
+        async def main():
+            env, pool = self._env(rate=2.0)
+            env.tx_limiter._clock = FakeClock()  # freeze time
+            ok = 0
+            for i in range(10):
+                try:
+                    await env.broadcast_tx_sync("%02x" % i, ctx=Ctx())
+                    ok += 1
+                except RPCError as e:
+                    assert e.code == MEMPOOL_BUSY
+                    assert e.data == "rate-limited"
+            assert ok == 4  # burst = 2x rate
+            # a different client is unaffected
+            class Other:
+                remote = "10.9.9.9:1"
+
+            await env.broadcast_tx_sync("ff", ctx=Other())
+
+        run(main())
+
+    def test_async_route_limited_and_queue_bounded(self):
+        from tendermint_tpu.rpc.jsonrpc import MEMPOOL_BUSY, RPCError
+
+        class Ctx:
+            remote = "10.1.2.3:5555"
+
+        async def main():
+            env, pool = self._env(rate=1.0)
+            env.tx_limiter._clock = FakeClock()
+            await env.broadcast_tx_async("aa", ctx=Ctx())
+            await env.broadcast_tx_async("ab", ctx=Ctx())  # burst = 2x rate
+            with pytest.raises(RPCError) as ei:
+                await env.broadcast_tx_async("bb", ctx=Ctx())
+            assert ei.value.code == MEMPOOL_BUSY
+            # unlimited env: the drainer backlog itself is bounded
+            env2, _ = self._env(rate=0.0)
+            env2._async_txs_max = 3
+            env2._async_drainer_active = True  # drainer never runs
+            for i in range(3):
+                await env2.broadcast_tx_async("%02x" % i)
+            with pytest.raises(RPCError) as ei:
+                await env2.broadcast_tx_async("99")
+            assert ei.value.code == MEMPOOL_BUSY
+            assert ei.value.data == "mempool is full"
+
+        run(main())
+
+    def test_bulk_route_spends_per_tx_tokens_and_bounds_queue(self):
+        from tendermint_tpu.rpc.jsonrpc import (
+            INVALID_PARAMS,
+            MEMPOOL_BUSY,
+            RPCError,
+        )
+
+        class Ctx:
+            remote = "10.4.4.4:1"
+
+        async def main():
+            env, pool = self._env(rate=5.0)  # burst 10
+            env.tx_limiter._clock = FakeClock()
+            res = await env.broadcast_txs_async("aa,bb,cc", ctx=Ctx())
+            assert res == {"count": 3}
+            # spending continues per TX: 3 of 10 tokens gone, an 8-burst
+            # is over the remaining credit -> structured refusal
+            with pytest.raises(RPCError) as ei:
+                await env.broadcast_txs_async(
+                    ",".join("%04x" % i for i in range(8)), ctx=Ctx()
+                )
+            assert ei.value.code == MEMPOOL_BUSY
+            assert ei.value.data == "rate-limited"
+            # a burst deeper than the bucket can NEVER succeed: distinct,
+            # non-retryable error telling the client to split
+            big = ",".join("%04x" % i for i in range(100))
+            with pytest.raises(RPCError) as ei:
+                await env.broadcast_txs_async(big, ctx=Ctx())
+            assert ei.value.code == INVALID_PARAMS
+            assert ei.value.data == "burst-too-large"
+            # queue bound applies to the whole burst
+            env2, _ = self._env(rate=0.0)
+            env2._async_txs_max = 5
+            env2._async_drainer_active = True
+            with pytest.raises(RPCError) as ei:
+                await env2.broadcast_txs_async(
+                    ",".join("%04x" % i for i in range(6))
+                )
+            assert ei.value.data == "mempool is full"
+
+        run(main())
+
+    def test_mempool_full_maps_to_busy(self):
+        from tendermint_tpu.config import Config
+        from tendermint_tpu.rpc.core import Environment
+        from tendermint_tpu.rpc.jsonrpc import MEMPOOL_BUSY, RPCError
+
+        class FullPool:
+            metrics = None
+
+            async def check_tx(self, tx, sender=None):
+                raise MempoolFullError("mempool full: 5000 txs")
+
+        async def main():
+            env = Environment(config=Config(), mempool=FullPool())
+            with pytest.raises(RPCError) as ei:
+                await env.broadcast_tx_sync("aa")
+            assert ei.value.code == MEMPOOL_BUSY
+            assert ei.value.data == "mempool is full"
+
+        run(main())
+
+
+class TestGossipRateLimit:
+    def test_over_limit_drops_before_checktx_and_scores_non_error(self):
+        from tendermint_tpu.mempool.reactor import (
+            MempoolReactor,
+            encode_tx_message,
+        )
+
+        class Pool:
+            metrics = None
+
+            def __init__(self):
+                self.seen = []
+
+            async def check_tx(self, tx, sender=None):
+                self.seen.append(tx)
+                return abci.ResponseCheckTx(code=0)
+
+        class SwitchStub:
+            def __init__(self):
+                self.reports = []
+
+            async def report_behaviour(self, behaviour, peer=None):
+                self.reports.append(behaviour)
+
+        class Peer:
+            id = "peer1"
+
+        async def main():
+            pool = Pool()
+            reactor = MempoolReactor(pool, broadcast=False, gossip_tx_rate=2.0)
+            reactor.rate_limiter._clock = FakeClock()
+            sw = SwitchStub()
+            reactor.set_switch(sw)
+            peer = Peer()
+            for i in range(10):
+                await reactor.receive(0x30, peer, encode_tx_message(b"g%d" % i))
+            assert len(pool.seen) == 4  # burst 2x rate
+            floods = [b for b in sw.reports if "tx flood" in b.reason]
+            assert len(floods) == 6
+            assert all(
+                not b.is_error and b.is_bad and b.weight <= 0.1 for b in floods
+            )
+
+        run(main())
