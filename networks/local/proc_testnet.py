@@ -516,6 +516,78 @@ def scenario_timeline(net: ProcTestnet) -> None:
 scenario_timeline.self_start = True  # rewrites configs before any node starts
 
 
+def scenario_txlife(net: ProcTestnet) -> None:
+    """Transaction-lifecycle acceptance (ISSUE 16): with txlife armed at
+    sample=1 on every node, one tx broadcast to node0 yields a fully
+    stitched cross-node timeline in the fleet report — rpc_received on
+    the origin, gossip_in on ≥2 other nodes, exactly one committed
+    height fleet-wide — and the collector's tx invariants (monotone core
+    stage order per node, single committed height) hold. tx_status joins
+    the indexer + mempool + timeline views for the same hash. The report
+    is written to <root>/fleet_report.json (preserved on failure)."""
+    configure_nodes(
+        net,
+        lambda i, cfg: cfg["instrumentation"].update(
+            txlife=True, txlife_sample=1
+        ),
+    )
+    net.start_all()
+    net.wait_all(2)
+    tx = "0x" + f"txl{os.getpid()}=1".encode().hex()
+    res = net.rpc(0, f"broadcast_tx_commit?tx={tx}", timeout=30.0)
+    assert res is not None and res.get("deliver_tx", {}).get("code", 1) == 0, res
+    txh = res["hash"].lower()
+    net.wait_all(int(res["height"]) + 1)
+
+    # tx_status on the origin: committed, with the sampled timeline
+    # (hash convention: bare lowercase hex, no 0x — rpc/core.py:7)
+    st = net.rpc(0, f"tx_status?hash={txh}")
+    assert st is not None and st["status"] == "committed", st
+    assert st["height"] == int(res["height"]), st
+    assert st["sampled"] and st["timeline"], st
+    stages = [e["stage"] for e in st["timeline"]]
+    assert stages[0] == "rpc_received" and "committed" in stages, stages
+
+    from tendermint_tpu.tools.collector import FleetCollector, render_text
+
+    endpoints = [f"http://127.0.0.1:{net.rpc_port(i)}" for i in range(net.n)]
+    fc = FleetCollector(endpoints, timeout=10.0)
+    fc.poll()
+    # second incremental poll: exercises the txl_seq cursor end to end
+    time.sleep(1.0)
+    fc.poll()
+    report = fc.report(commit_spread_s=5.0)
+    report_path = os.path.join(net.root, "fleet_report.json")
+    with open(report_path, "w", encoding="utf-8") as f:
+        json.dump(report, f, indent=1, sort_keys=True, default=str)
+
+    tl = report["txs"]["timelines"].get(txh)
+    assert tl is not None, (
+        f"tx {txh} not stitched; sampled txs: "
+        f"{sorted(report['txs']['timelines'])[:5]}"
+    )
+    # origin attribution: the first rpc_received is on the node we hit
+    assert tl["origin"] and "node0" in tl["origin"]["node"], tl["origin"]
+    # gossip reached at least 2 other nodes (n=4, BFT needs 2f+1 anyway)
+    assert len(tl["gossip_in"]) >= 2, tl["gossip_in"]
+    # one committed height fleet-wide, on every node
+    heights = {c["height"] for c in tl["committed"].values()}
+    assert heights == {int(res["height"])}, (heights, res["height"])
+    assert len(tl["committed"]) == net.n, sorted(tl["committed"])
+    assert txh in report["txs"]["complete"], report["txs"]["complete"]
+    assert not report["violations"], report["violations"]
+    print(render_text(report))
+    print(
+        f"txlife: tx {txh[:12]} stitched across {len(tl['stages'])} nodes "
+        f"(origin {tl['origin']['node']}, gossip_in on "
+        f"{len(tl['gossip_in'])} peers, committed at "
+        f"{res['height']} everywhere), invariants clean"
+    )
+
+
+scenario_txlife.self_start = True  # rewrites configs before any node starts
+
+
 def scenario_stream(net: ProcTestnet) -> None:
     """Streaming vote-pipeline acceptance (ISSUE 10): on a committing net
     with streaming forced on (vote_stream_min=1 so even this 4-validator
@@ -793,6 +865,7 @@ SCENARIOS = {
     "pex": scenario_pex,
     "metrics": scenario_metrics,
     "timeline": scenario_timeline,
+    "txlife": scenario_txlife,
     "stream": scenario_stream,
     "transfer": scenario_transfer,
     "soak": scenario_soak,

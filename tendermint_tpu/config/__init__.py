@@ -243,6 +243,18 @@ class InstrumentationConfig:
     # dumping (the ring and the debug_flight_recorder route stay live).
     flight_recorder_ring: int = 4096
     flight_recorder_dump_file: str = "data/flight_recorder.jsonl"
+    # Transaction lifecycle tracing (libs/txlife.py): per-tx stage
+    # timestamps (rpc_received → … → committed), hash-sampled so every
+    # node samples the SAME txs and the fleet collector can stitch one
+    # tx across nodes. Default-off; when off every tap is one boolean.
+    # TMTPU_TXLIFE_SAMPLE overrides both knobs (>0 enables at that
+    # rate, 0 forces off). Served by tx_status / debug_tx_lifecycle.
+    txlife: bool = False
+    txlife_sample: int = 16  # keep 1 tx in N (1 = every tx)
+    txlife_ring: int = 8192  # flat stage-event ring (cursor protocol)
+    # JSONL dump sink (rotating autofile.Group, dumped on node stop and
+    # SIGUSR1 when the plane is armed); empty disables dumping
+    txlife_dump_file: str = "data/tx_lifecycle.jsonl"
 
 
 @dataclass

@@ -35,6 +35,8 @@ from tendermint_tpu.device.priorities import Priority, priority_scope
 from tendermint_tpu.libs import fail
 from tendermint_tpu.libs import trace as tmtrace
 from tendermint_tpu.libs.recorder import RECORDER
+from tendermint_tpu.libs.txlife import TXLIFE
+from tendermint_tpu.types.tx import tx_hash
 from tendermint_tpu.libs.sigcache import SIG_CACHE
 from tendermint_tpu.libs.events import EventSwitch
 from tendermint_tpu.libs.log import NOP, Logger
@@ -807,6 +809,9 @@ class ConsensusState(BaseService):
             "consensus", "commit", height=height, round=self.rs.commit_round,
             txs=len(block.data.txs), interval_ms=round(interval * 1e3, 1),
         )
+        if TXLIFE.enabled:
+            for tx in block.data.txs:
+                TXLIFE.stage("committed", tx_hash(tx), height=height)
         m = self.metrics
         if m is None:
             return
@@ -909,6 +914,13 @@ class ConsensusState(BaseService):
             self.log.info("received complete proposal block",
                           height=rs.proposal_block.header.height,
                           hash=rs.proposal_block.hash())
+            if TXLIFE.enabled:
+                # fires on the proposer too — its own parts arrive
+                # through the internal queue, so this one tap covers
+                # every node that assembled the block
+                for tx in rs.proposal_block.data.txs:
+                    TXLIFE.stage("proposed", tx_hash(tx),
+                                 height=rs.height, round=rs.round)
             if self.event_bus:
                 await self.event_bus.publish_complete_proposal(self.round_state_event())
             prevotes = rs.votes.prevotes(rs.round)

@@ -378,6 +378,33 @@ class MempoolMetrics:
         )
 
 
+class TxMetrics:
+    """The tx-lifecycle plane (libs/txlife.py, docs/tx_ingestion.md):
+    per-stage dwell and broadcast→commit end-to-end latency of the
+    hash-sampled txs — the series ROADMAP item 1's DeliverTx work is
+    measured against."""
+
+    def __init__(self, c: Collector) -> None:
+        self.stage_seconds = c.histogram_vec(
+            "tx", "stage_seconds",
+            "Dwell between consecutive lifecycle stages of sampled txs",
+            label="stage",
+            buckets=[0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 0.5, 1, 2.5, 5, 10],
+        )
+        self.e2e_seconds = c.histogram(
+            "tx", "e2e_seconds",
+            "First-observed-stage to committed, per sampled tx",
+            [0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60],
+        )
+        self.sampled_total = c.counter(
+            "tx", "sampled_total", "Txs admitted to the lifecycle sampler"
+        )
+        self.committed_total = c.counter(
+            "tx", "committed_total", "Sampled txs observed through commit"
+        )
+
+
 class StateMetrics:
     def __init__(self, c: Collector) -> None:
         self.block_processing_time = c.histogram(

@@ -1,0 +1,319 @@
+"""Transaction lifecycle tracing — per-stage attribution per tx.
+
+The trace ring (libs/trace.py) times *heights*, the flight recorder
+(libs/recorder.py) records *reactor transitions* — but neither answers
+"where did THIS transaction spend its time between broadcast and
+commit". ROADMAP item 1 needs exactly that number (admitted→committed,
+per stage) before the DeliverTxBatch work can bank a win instead of
+inferring one. This module is the per-transaction plane: a bounded,
+hash-keyed store of monotonic stage timestamps —
+
+    rpc_received → parked → flushed → verdict
+        → gossip_out / gossip_in (per peer)
+        → proposed → delivered → committed
+
+— fed by taps in the RPC broadcast routes, the mempool ingest
+accumulator and gossip reactor, the consensus commit boundary, and the
+DeliverTx loop.
+
+Sampling is **deterministic by tx hash** (`int(hash[:8]) % sample == 0`)
+so every node in a fleet samples the *same* transactions — the fleet
+collector can stitch one tx's timeline across nodes (origin
+`rpc_received`, per-peer `gossip_in`, one committed height) without any
+coordination. The env override `TMTPU_TXLIFE_SAMPLE` and the
+`instrumentation.txlife*` config gate the whole plane; when disabled,
+every tap is one attribute read + return — the hot path stays flat
+(PR 13's batched-admission throughput must not pay for its own
+instrument).
+
+Storage mirrors the flight recorder's GIL-atomicity discipline: the
+flat event ring is a `deque(maxlen)` (one C-level append per stage,
+safe from the loop thread and worker threads without a lock) and the
+per-tx timeline index is an insertion-ordered dict bounded by entry
+count with FIFO eviction — like the `types/tx.py` hash memo. `seq` is
+`itertools.count` (race-free numbering), so the cursor protocol of
+`debug_tx_lifecycle` is exactly `debug_flight_recorder`'s:
+`since_seq` / `since_ns`, `total`, `total_dropped`.
+
+Timestamps are monotonic only — this is telemetry, never consensus
+input (tmlint TM2xx); the wall clock appears only in clock anchors and
+dump headers so an off-node reader can re-timebase (same scheme as the
+recorder, docs/observability.md "Timebase normalization").
+
+Crypto-free on purpose: keys are whatever 32-byte hash the caller
+computed (`types/tx.py tx_hash` in production, any bytes in tests), so
+`tests/test_txlife.py` runs without the crypto stack.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+
+DEFAULT_RING = 8192
+DEFAULT_TXS = 2048
+
+# Canonical stage order. Gossip stages sit between verdict and proposed
+# for display, but repeat per peer and — on a non-origin node — precede
+# everything local, so the monotone-ordering invariant (collector
+# --check) ranks only the CORE stages.
+STAGES = (
+    "rpc_received", "parked", "flushed", "verdict",
+    "gossip_out", "gossip_in",
+    "proposed", "delivered", "committed",
+)
+CORE_STAGES = (
+    "rpc_received", "parked", "flushed", "verdict",
+    "proposed", "delivered", "committed",
+)
+CORE_RANK = {s: i for i, s in enumerate(CORE_STAGES)}
+
+
+def sampled_key(key: bytes, sample: int) -> bool:
+    """The deterministic sampling decision: same tx hash → same answer
+    on every node, which is what makes fleet-wide stitching possible
+    with zero coordination. `sample` = keep one tx in N (1 = all)."""
+    if sample <= 1:
+        return True
+    return int.from_bytes(key[:8], "big") % sample == 0
+
+
+class TxLifeRecorder:
+    def __init__(self, maxlen: int = DEFAULT_RING,
+                 max_txs: int = DEFAULT_TXS) -> None:
+        self._enabled = False
+        self._sample = 1
+        self._ring: deque = deque(maxlen=maxlen)
+        self._seq = itertools.count(1)  # race-free event numbering
+        self._last_seq = 0
+        # per-tx timeline index: key -> list of (mono_ns, stage, fields).
+        # Insertion-ordered (py dicts), bounded by entries with FIFO
+        # eviction — the same bytes-bounded-memo idiom as types/tx.py.
+        self._txs: dict[bytes, list] = {}
+        self._max_txs = max_txs
+        self.sampled = 0  # txs ever admitted to the index
+        self.evicted = 0  # txs FIFO-evicted from the index
+        self.moniker = ""
+        self._metrics = None  # libs/metrics.TxMetrics | None
+        self._dump_path: str | None = None
+        self._group = None  # lazy autofile.Group — no file until a dump
+        self._dump_lock = threading.Lock()
+
+    # -- configuration -------------------------------------------------------
+
+    def configure(self, enabled: bool, sample: int = 1,
+                  ring: int | None = None, max_txs: int | None = None) -> None:
+        """Arm (or disarm) the plane. `TMTPU_TXLIFE_SAMPLE` overrides
+        both knobs from the environment: >0 enables with that rate,
+        0 forces the plane off — the bench/testnet switch that needs no
+        config file edit."""
+        env = os.environ.get("TMTPU_TXLIFE_SAMPLE", "").strip()
+        if env:
+            try:
+                rate = int(env)
+            except ValueError:
+                rate = -1
+            if rate == 0:
+                enabled = False
+            elif rate > 0:
+                enabled, sample = True, rate
+        self._sample = max(1, int(sample))
+        if ring and ring > 0 and ring != self._ring.maxlen:
+            self._ring = deque(self._ring, maxlen=ring)
+        if max_txs and max_txs > 0:
+            self._max_txs = max_txs
+        self._enabled = bool(enabled)
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    @property
+    def sample(self) -> int:
+        return self._sample
+
+    def set_metrics(self, tm) -> None:
+        self._metrics = tm
+
+    def set_moniker(self, moniker: str) -> None:
+        self.moniker = moniker or ""
+
+    # -- recording -----------------------------------------------------------
+
+    def stage(self, stage: str, key: bytes, **fields) -> None:
+        """Record one lifecycle stage for tx `key` (its hash). The
+        disabled path is this one boolean; unsampled txs cost one
+        modulo. Safe from any thread; never raises into the tap site."""
+        if not self._enabled:
+            return
+        if self._sample > 1 and int.from_bytes(key[:8], "big") % self._sample:
+            return
+        now = time.monotonic_ns()
+        seq = next(self._seq)
+        self._last_seq = seq
+        self._ring.append((seq, now, key, stage, fields))
+        tl = self._txs.get(key)
+        if tl is None:
+            tl = self._txs[key] = []
+            self.sampled += 1
+            while len(self._txs) > self._max_txs:
+                try:
+                    self._txs.pop(next(iter(self._txs)), None)
+                except (StopIteration, RuntimeError):
+                    break
+                self.evicted += 1
+        prev_ns = tl[-1][0] if tl else None
+        tl.append((now, stage, fields))
+        m = self._metrics
+        if m is not None:
+            if len(tl) == 1:
+                m.sampled_total.inc()
+            if prev_ns is not None:
+                m.stage_seconds.observe(stage, (now - prev_ns) / 1e9)
+            if stage == "committed":
+                m.e2e_seconds.observe((now - tl[0][0]) / 1e9)
+                m.committed_total.inc()
+
+    # -- reads ---------------------------------------------------------------
+
+    @property
+    def total(self) -> int:
+        """Stage events ever recorded (highest seq handed out)."""
+        ring = self._ring
+        try:
+            newest = ring[-1][0] if ring else 0
+        except IndexError:  # concurrent pop-through-eviction
+            newest = 0
+        return max(self._last_seq, newest)
+
+    @property
+    def total_dropped(self) -> int:
+        """Events evicted from the ring, ever — the reader-visible gap
+        bound, exactly the flight recorder's contract."""
+        return max(0, self.total - len(self._ring))
+
+    def timeline(self, key: bytes) -> list[dict]:
+        """One tx's stage timeline, oldest first (tx_status's view).
+        Empty when the tx was never sampled or has been evicted."""
+        tl = self._txs.get(key)
+        if not tl:
+            return []
+        return [self._event_dict(t, stage, fields) for t, stage, fields in tl]
+
+    def timelines(self) -> dict:
+        """Shallow copy of every live per-tx timeline: key -> list of
+        (mono_ns, stage, fields), oldest first. The in-process stitch
+        surface (ingest_bench); off-process readers use snapshot()."""
+        return {k: list(v) for k, v in self._txs.items()}
+
+    def snapshot(
+        self,
+        limit: int | None = None,
+        since_ns: int | None = None,
+        since_seq: int | None = None,
+        tx: bytes | None = None,
+    ) -> list[dict]:
+        """Flat ring contents as dicts, oldest first. `since_seq` /
+        `since_ns` are the incremental-scrape cursors (prefer
+        `since_seq`: seq strictly increases per event, a coarse
+        monotonic clock can stamp several events with one tick).
+        `tx` filters to one hash."""
+        events = list(self._ring.copy())
+        if since_ns is not None:
+            events = [e for e in events if e[1] > since_ns]
+        if since_seq is not None:
+            events = [e for e in events if e[0] > since_seq]
+        if tx is not None:
+            events = [e for e in events if e[2] == tx]
+        if limit is not None and limit >= 0:
+            events = events[-limit:] if limit else []
+        return [self._ring_dict(e) for e in events]
+
+    @staticmethod
+    def _ring_dict(e: tuple) -> dict:
+        seq, t, key, stage, fields = e
+        d: dict = {"seq": seq, "t_mono_ns": t, "tx": key.hex(),
+                   "stage": stage}
+        if fields:
+            d["fields"] = fields
+        return d
+
+    @staticmethod
+    def _event_dict(t: int, stage: str, fields: dict) -> dict:
+        d: dict = {"t_mono_ns": t, "stage": stage}
+        if fields:
+            d["fields"] = fields
+        return d
+
+    # -- maintenance ---------------------------------------------------------
+
+    def resize(self, maxlen: int) -> None:
+        if maxlen > 0 and maxlen != self._ring.maxlen:
+            self._ring = deque(self._ring, maxlen=maxlen)
+
+    def clear(self) -> None:
+        """Drop every timeline and ring event (tests / bench reruns).
+        Counters and seq keep counting — `total_dropped` stays honest."""
+        self._ring.clear()
+        self._txs.clear()
+
+    # -- dumping -------------------------------------------------------------
+
+    def set_dump_path(self, path: str | None) -> None:
+        with self._dump_lock:
+            if self._group is not None:
+                try:
+                    self._group.close()
+                except Exception:  # noqa: BLE001 — teardown must not raise
+                    pass
+                self._group = None
+            self._dump_path = path
+
+    def dump(self, reason: str) -> int:
+        """Header line + every ring event as JSONL to the configured
+        rotating sink (same scheme as the flight recorder; rides the
+        same CI failure-artifact globs). Returns events written, -1 on
+        no sink / failure. Never raises — runs from stop/failure paths."""
+        events = self.snapshot()
+        header = {
+            "tx_lifecycle_dump": reason,
+            "t_mono_ns": time.monotonic_ns(),
+            # operator-facing timestamp + re-timebase anchor only —
+            # never consensus input
+            "t_wall": time.time(),
+            "anchor": {"mono_ns": time.monotonic_ns(),
+                       "wall_ns": time.time_ns()},
+            "moniker": self.moniker,
+            "events": len(events),
+            "total": self.total,
+            "total_dropped": self.total_dropped,
+            "sampled": self.sampled,
+            "evicted": self.evicted,
+            "sample": self._sample,
+        }
+        lines = [json.dumps(header, default=str)]
+        lines.extend(json.dumps(e, default=str) for e in events)
+        payload = ("\n".join(lines) + "\n").encode()
+        with self._dump_lock:
+            if self._dump_path is None:
+                return -1
+            try:
+                if self._group is None:
+                    from tendermint_tpu.libs.autofile import Group
+
+                    self._group = Group(self._dump_path)
+                self._group.write(payload)
+                self._group.flush()
+                self._group.maybe_rotate()
+            except Exception:  # noqa: BLE001 — diagnostics only
+                return -1
+            return len(events)
+
+
+# Process-wide singleton, like recorder.RECORDER: the taps in rpc/
+# mempool/consensus/state record here without plumbing; the node arms it
+# from config.instrumentation (txlife / txlife_sample / txlife_ring).
+TXLIFE = TxLifeRecorder()

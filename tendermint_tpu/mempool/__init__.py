@@ -32,6 +32,7 @@ from tendermint_tpu.libs.clist import CList
 from tendermint_tpu.libs.log import NOP, Logger
 from tendermint_tpu.libs.recorder import RECORDER
 from tendermint_tpu.libs.service import spawn_logged
+from tendermint_tpu.libs.txlife import TXLIFE
 
 
 class MempoolError(Exception):
@@ -87,7 +88,7 @@ class _PendingTx:
     broadcast path needs no per-tx verdict plumbing); a later duplicate
     that DOES want the verdict upgrades it in place."""
 
-    __slots__ = ("tx", "key", "fut", "senders")
+    __slots__ = ("tx", "key", "fut", "senders", "parked_mono")
 
     def __init__(
         self, tx: bytes, key: bytes, fut: asyncio.Future | None, sender: str | None
@@ -96,6 +97,9 @@ class _PendingTx:
         self.key = key
         self.fut = fut
         self.senders: set = {sender} if sender else set()
+        # when the tx entered the ingest plane — feeds health's
+        # oldest_parked_tx_age_s (a wedged flush must be visible)
+        self.parked_mono = time.monotonic()
 
 
 class CListMempool:
@@ -149,6 +153,7 @@ class CListMempool:
         self._deadline_task: asyncio.Task | None = None
         self._flush_queue: deque[list[_PendingTx]] = deque()
         self._flush_active = False
+        self._flush_count = 0  # batch id stamped on txlife "flushed"
         # recently-committed seen-set, ringed per height: dedup that a
         # flood cannot churn out of the LRU (a gossip echo of a tx
         # committed a few blocks ago must short-circuit before ABCI, and
@@ -173,6 +178,36 @@ class CListMempool:
 
     def txs_bytes(self) -> int:
         return self._txs_bytes
+
+    def ingest_depth(self) -> int:
+        """Txs parked in the ingest plane (live bucket + queued flushes)
+        awaiting their batch verdict — NOT yet in the clist, so `size()`
+        alone under-reads the mempool during a flood."""
+        return len(self._pending)
+
+    def ingest_bytes(self) -> int:
+        return self._pending_bytes
+
+    def tx_state(self, key: bytes) -> str | None:
+        """Where tx `key` sits right now: "pending" (admitted, in the
+        clist awaiting a proposal) / "in_flight" (parked in the ingest
+        plane awaiting its batch verdict) / None (not here) — the
+        tx_status RPC route's mempool leg."""
+        if key in self._tx_map:
+            return "pending"
+        if key in self._pending:
+            return "in_flight"
+        return None
+
+    def oldest_parked_age_s(self) -> float:
+        """Age of the oldest parked tx. `_pending` is insertion-ordered
+        (arrival order) and drains FIFO, so the first entry is the
+        oldest — O(1) per health poll. 0 when nothing is parked."""
+        try:
+            ent = next(iter(self._pending.values()))
+        except StopIteration:
+            return 0.0
+        return max(0.0, time.monotonic() - ent.parked_mono)
 
     # -- locking around block commit (reference Lock/Unlock) ----------------
 
@@ -235,6 +270,7 @@ class CListMempool:
         self._pending_bytes += len(tx)
         self._bucket.append(ent)
         self._bucket_bytes += len(tx)
+        TXLIFE.stage("parked", key, src="gossip" if sender else "rpc")
         if len(self._bucket) >= self._high_water():
             self._take_bucket("lanes")
         elif self._deadline_task is None or self._deadline_task.done():
@@ -247,6 +283,7 @@ class CListMempool:
     async def _check_tx_serial(self, tx: bytes, key: bytes, sender) -> abci.ResponseCheckTx:
         """The pre-batch admission path: one awaited ABCI round trip."""
         res = await self.app_conn.check_tx(tx)
+        TXLIFE.stage("verdict", key, ok=res.is_ok, code=res.code)
         if res.is_ok:
             self._add_tx(tx, res.gas_wanted, sender)
         else:
@@ -306,6 +343,7 @@ class CListMempool:
             self._pending_bytes += len(tx)
             self._bucket.append(ent)
             self._bucket_bytes += len(tx)
+            TXLIFE.stage("parked", key, src="rpc")
             parked += 1
             if len(self._bucket) >= high_water:
                 self._take_bucket("lanes")
@@ -362,6 +400,11 @@ class CListMempool:
         self._deadline_task = None
         RECORDER.record("mempool", "batch_flush", lanes=len(bucket),
                         trigger=trigger)
+        if TXLIFE.enabled:
+            self._flush_count += 1
+            for ent in bucket:
+                TXLIFE.stage("flushed", ent.key, batch=self._flush_count,
+                             lanes=len(bucket), trigger=trigger)
         self._flush_queue.append(bucket)
         if not self._flush_active:
             self._flush_active = True
@@ -415,6 +458,7 @@ class CListMempool:
         for ent, res in zip(bucket, responses):
             self._pending.pop(ent.key, None)
             self._pending_bytes -= len(ent.tx)
+            TXLIFE.stage("verdict", ent.key, ok=res.is_ok, code=res.code)
             if res.is_ok:
                 # the tx may have COMMITTED (gossiped copy in another
                 # node's proposal) or been re-admitted while this bucket

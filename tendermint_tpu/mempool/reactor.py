@@ -14,7 +14,9 @@ from tendermint_tpu.behaviour import PeerBehaviour
 from tendermint_tpu.encoding import Reader, Writer
 from tendermint_tpu.libs.log import NOP, Logger
 from tendermint_tpu.libs.recorder import RECORDER
+from tendermint_tpu.libs.txlife import TXLIFE
 from tendermint_tpu.mempool import CListMempool, MempoolError, TxInCacheError
+from tendermint_tpu.types.tx import tx_hash
 from tendermint_tpu.p2p.base_reactor import BaseReactor, ChannelDescriptor
 
 MEMPOOL_CHANNEL = 0x30
@@ -88,6 +90,9 @@ class MempoolReactor(BaseReactor):
                 self.mempool.metrics.rate_limited.inc()
             await self.report(peer, PeerBehaviour.tx_flood(peer.id))
             return
+        # arrival time per delivering peer, BEFORE dedup/CheckTx — the
+        # cross-node propagation edge the fleet collector stitches
+        TXLIFE.stage("gossip_in", tx_hash(tx), peer=peer.id)
         try:
             res = await self.mempool.check_tx(tx, sender=peer.id)
         except TxInCacheError:
@@ -116,4 +121,5 @@ class MempoolReactor(BaseReactor):
                 if not ok:
                     await asyncio.sleep(0.1)
                     continue
+                TXLIFE.stage("gossip_out", tx_hash(mtx.tx), peer=peer.id)
             el = await el.next_wait()

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import threading
 
 from tendermint_tpu import proxy
 from tendermint_tpu.blockchain.reactor import BlockchainReactor
@@ -111,6 +112,21 @@ class Node(BaseService):
             )
             RECORDER.set_dump_path(self._recorder_dump_path)
         self._crash_baseline = RECORDER.crashes
+
+        # tx-lifecycle plane (libs/txlife.py): per-tx stage timestamps,
+        # deterministically hash-sampled so the fleet collector can
+        # stitch one tx across nodes. Default-off; TMTPU_TXLIFE_SAMPLE
+        # overrides the config gate inside configure().
+        from tendermint_tpu.libs.txlife import TXLIFE
+
+        TXLIFE.configure(
+            cfg.instrumentation.txlife,
+            sample=cfg.instrumentation.txlife_sample,
+            ring=cfg.instrumentation.txlife_ring,
+        )
+        TXLIFE.set_moniker(cfg.base.moniker)
+        if cfg.instrumentation.txlife_dump_file:
+            TXLIFE.set_dump_path(cfg._abs(cfg.instrumentation.txlife_dump_file))
 
         # device-mesh target (device/mesh.py): config.device.mesh — 0 =
         # auto (all visible devices), 1 = single-device, N = clamp;
@@ -471,6 +487,8 @@ class Node(BaseService):
             SIG_CACHE.set_metrics(self.device_metrics)
             self.runtime_metrics = tmm.RuntimeMetrics(self.metrics)
             RECORDER.set_metrics(self.runtime_metrics)
+            self.tx_metrics = tmm.TxMetrics(self.metrics)
+            TXLIFE.set_metrics(self.tx_metrics)
             mhost, mport = parse_laddr(cfg.instrumentation.prometheus_listen_addr)
             self.metrics_server = tmm.MetricsServer(self.metrics, mhost, mport)
         self.rpc_env.crash_baseline = self._crash_baseline
@@ -543,9 +561,17 @@ class Node(BaseService):
         try:
             import signal as _signal
 
-            loop.add_signal_handler(
-                _signal.SIGUSR1, lambda: RECORDER.dump_async("sigusr1")
-            )
+            from tendermint_tpu.libs.txlife import TXLIFE as _txl
+
+            def _sigusr1_dump() -> None:
+                RECORDER.dump_async("sigusr1")
+                if _txl.enabled:
+                    threading.Thread(
+                        target=_txl.dump, args=("sigusr1",),
+                        name="txlife-dump", daemon=True,
+                    ).start()
+
+            loop.add_signal_handler(_signal.SIGUSR1, _sigusr1_dump)
             self._sigusr1_installed = True
         except (NotImplementedError, ValueError, RuntimeError, AttributeError):
             pass
@@ -611,6 +637,9 @@ class Node(BaseService):
 
             tmtrace.DEVICE.set_metrics(None)
             RECORDER.set_metrics(None)
+            from tendermint_tpu.libs.txlife import TXLIFE as _txl_m
+
+            _txl_m.set_metrics(None)
         # stop-on-error postmortem: if any task died during this node's
         # run, the black box goes to disk before the sink is detached
         # (off-loop: a slow disk must not stall the remaining teardown)
@@ -621,6 +650,16 @@ class Node(BaseService):
             and RECORDER.dump_path == self._recorder_dump_path
         ):
             RECORDER.set_dump_path(None)
+        # tx-lifecycle postmortem: every armed run leaves its timelines
+        # on disk (the CI failure artifacts pick the JSONL up), then the
+        # process-wide singleton is disarmed for whoever shares the
+        # process next (tests run many nodes in one interpreter)
+        from tendermint_tpu.libs.txlife import TXLIFE as _txl
+
+        if _txl.enabled:
+            await asyncio.to_thread(_txl.dump, "node_stop")
+        _txl.set_dump_path(None)
+        _txl.configure(False)
         self.consensus_state.wal.close()
         self.addr_book.save()  # bans ride in the book's JSON
         self.trust_store.save()

@@ -17,6 +17,7 @@ from tendermint_tpu.libs.log import NOP, Logger
 from tendermint_tpu.libs.pubsub import Query, SubscriptionCancelled
 from tendermint_tpu.libs.service import spawn_logged
 from tendermint_tpu.libs.recorder import RECORDER
+from tendermint_tpu.libs.txlife import TXLIFE
 from tendermint_tpu.mempool import MempoolError, MempoolFullError, TxInCacheError
 from tendermint_tpu.rpc.jsonrpc import (
     INTERNAL_ERROR,
@@ -257,6 +258,20 @@ class Environment:
         breaker = dev_snap["breaker"]
         sched_q = dev_snap.get("scheduler", {}).get("queues") or {}
         crashes = max(0, RECORDER.crashes - self.crash_baseline)
+        # ingest-plane wedge signal: a parked tx older than the stall
+        # bound means the bucket flush pipeline is stuck — today that is
+        # invisible until the client times out. Bound is generous vs the
+        # ms-scale flush deadline; override via TMTPU_INGEST_STALL_S.
+        import os as _os
+
+        oldest_parked = 0.0
+        age_fn = getattr(self.mempool, "oldest_parked_age_s", None)
+        if age_fn is not None:
+            oldest_parked = round(age_fn(), 3)
+        try:
+            ingest_stall_s = float(_os.environ.get("TMTPU_INGEST_STALL_S", "5"))
+        except ValueError:
+            ingest_stall_s = 5.0
         degraded = []
         if loop is not None and loop["in_stall"]:
             degraded.append("loop_stalled")
@@ -266,6 +281,8 @@ class Environment:
             # admission queue has work older than the stall bound: the
             # dispatcher is wedged or the device is drowning in backlog
             degraded.append("device_queue_stalled")
+        if ingest_stall_s > 0 and oldest_parked > ingest_stall_s:
+            degraded.append("mempool_ingest_stalled")
         if crashes:
             degraded.append("task_crashes")
         return {
@@ -278,6 +295,7 @@ class Environment:
             "peers": peers,
             "loop": loop,
             "breaker": breaker,
+            "oldest_parked_tx_age_s": oldest_parked,
             "task_crashes": crashes,
         }
 
@@ -638,6 +656,83 @@ class Environment:
             ),
         }
 
+    async def debug_tx_lifecycle(
+        self,
+        n: int = 200,
+        tx: str | None = None,
+        since_ns: int | None = None,
+        since_seq: int | None = None,
+    ) -> dict:
+        """The tx-lifecycle plane (libs/txlife.py): the flat stage-event
+        ring of the hash-sampled txs, oldest first, with the exact
+        cursor protocol of debug_flight_recorder (`since_seq` preferred,
+        `since_ns` fallback, n<=2000, `total`/`total_dropped` for gap
+        detection). `tx` filters to one hash. The fleet collector polls
+        this route to stitch one tx's timeline across nodes — the
+        deterministic hash sampling means every node sampled the same
+        txs. Always available; `enabled` says whether the plane is
+        armed (`instrumentation.txlife` / TMTPU_TXLIFE_SAMPLE)."""
+        from tendermint_tpu.libs.recorder import clock_anchor
+
+        try:
+            n = max(1, min(int(n), 2000))
+        except (TypeError, ValueError):
+            raise RPCError(INVALID_PARAMS, "n must be an int")
+        return {
+            "enabled": TXLIFE.enabled,
+            "sample": TXLIFE.sample,
+            "sampled": TXLIFE.sampled,
+            "evicted": TXLIFE.evicted,
+            "moniker": TXLIFE.moniker,
+            "anchor": clock_anchor(),
+            "total": TXLIFE.total,
+            "total_dropped": TXLIFE.total_dropped,
+            "events": TXLIFE.snapshot(
+                limit=n,
+                since_ns=_cursor_arg(since_ns),
+                since_seq=_cursor_arg(since_seq),
+                tx=_unhex(tx) if tx else None,
+            ),
+        }
+
+    async def tx_status(self, hash: str) -> dict:
+        """Where is my transaction? One user-facing answer joining three
+        planes: the tx indexer (committed at which height), the mempool
+        (admitted to the clist = `pending`, or parked in the ingest
+        bucket = `in_flight_bucket`), and — when the tx was lifecycle-
+        sampled — its full stage timeline (`timeline`, monotonic
+        timestamps; `anchor` re-timebases them). `status` is one of
+        committed / pending / in_flight_bucket / unknown."""
+        from tendermint_tpu.libs.recorder import clock_anchor
+
+        key = _unhex(hash)
+        status = "unknown"
+        height = None
+        index = None
+        if self.tx_indexer is not None:
+            res = self.tx_indexer.get(key)
+            if res is not None:
+                status, height, index = "committed", res.height, res.index
+        if status == "unknown":
+            state_fn = getattr(self.mempool, "tx_state", None)
+            st = state_fn(key) if state_fn is not None else None
+            if st == "pending":
+                status = "pending"
+            elif st == "in_flight":
+                status = "in_flight_bucket"
+        timeline = TXLIFE.timeline(key)
+        out = {
+            "hash": hash,
+            "status": status,
+            "height": height,
+            "index": index,
+            "sampled": bool(timeline),
+            "anchor": clock_anchor(),
+        }
+        if timeline:
+            out["timeline"] = timeline
+        return out
+
     async def debug_p2p(self) -> dict:
         """Peer-quality plane (docs/p2p_resilience.md): per-peer trust
         scores from the behaviour-fed metric, live bans with remaining
@@ -763,6 +858,7 @@ class Environment:
                 "and retry",
                 data="mempool is full",
             )
+        TXLIFE.stage("rpc_received", tx_hash(raw), route="async")
         self._async_txs.append(raw)
         if not self._async_drainer_active:
             self._async_drainer_active = True
@@ -828,6 +924,9 @@ class Environment:
                 "and retry",
                 data="mempool is full",
             )
+        if TXLIFE.enabled:
+            for raw in raws:
+                TXLIFE.stage("rpc_received", tx_hash(raw), route="bulk_async")
         self._async_txs.extend(raws)
         if not self._async_drainer_active:
             self._async_drainer_active = True
@@ -839,6 +938,7 @@ class Environment:
     async def broadcast_tx_sync(self, tx, ctx=None) -> dict:
         self._admit_broadcast(ctx)
         raw = _tx_arg(tx)
+        TXLIFE.stage("rpc_received", tx_hash(raw), route="sync")
         from tendermint_tpu.crypto import sum_sha256
 
         try:
@@ -862,6 +962,7 @@ class Environment:
         self._admit_broadcast(ctx)
         raw = _tx_arg(tx)
         txh = tx_hash(raw)
+        TXLIFE.stage("rpc_received", txh, route="commit")
         self._subscriber_seq += 1
         subscriber = f"broadcast_tx_commit-{self._subscriber_seq}"
         sub = self.event_bus.subscribe(
@@ -896,12 +997,27 @@ class Environment:
         finally:
             self.event_bus.unsubscribe_all(subscriber)
 
+    def _ingest_view(self) -> dict:
+        """Ingest-bucket depth as separate fields: `total` stays the
+        clist count (reference-compatible), but a flood parks txs in the
+        in-flight ingest plane BEFORE they reach the clist — counting
+        only the clist under-reads the mempool exactly when the numbers
+        matter. Stub mempools without the batch plane report zeros."""
+        mp = self.mempool
+        depth = getattr(mp, "ingest_depth", None)
+        nbytes = getattr(mp, "ingest_bytes", None)
+        return {
+            "ingest_depth": depth() if depth is not None else 0,
+            "ingest_bytes": nbytes() if nbytes is not None else 0,
+        }
+
     async def unconfirmed_txs(self, limit: int = 30) -> dict:
         txs = self.mempool.reap_max_txs(max(1, min(limit, 100)))
         return {
             "n_txs": len(txs),
             "total": self.mempool.size(),
             "total_bytes": self.mempool.txs_bytes(),
+            **self._ingest_view(),
             "txs": [_hex(t) for t in txs],
         }
 
@@ -910,6 +1026,7 @@ class Environment:
             "n_txs": self.mempool.size(),
             "total": self.mempool.size(),
             "total_bytes": self.mempool.txs_bytes(),
+            **self._ingest_view(),
         }
 
     async def tx(self, hash: str, prove: bool = False) -> dict:
@@ -1089,6 +1206,7 @@ class Environment:
             "debug_consensus_trace": self.debug_consensus_trace,
             "debug_device": self.debug_device,
             "debug_flight_recorder": self.debug_flight_recorder,
+            "debug_tx_lifecycle": self.debug_tx_lifecycle,
             "debug_p2p": self.debug_p2p,
             "debug_fault": self.debug_fault,
             "broadcast_tx_async": self.broadcast_tx_async,
@@ -1098,6 +1216,7 @@ class Environment:
             "unconfirmed_txs": self.unconfirmed_txs,
             "num_unconfirmed_txs": self.num_unconfirmed_txs,
             "tx": self.tx,
+            "tx_status": self.tx_status,
             "tx_search": self.tx_search,
             "abci_info": self.abci_info,
             "abci_query": self.abci_query,

@@ -16,9 +16,11 @@ from tendermint_tpu import crypto
 from tendermint_tpu.libs import fail
 from tendermint_tpu.libs.log import NOP, Logger
 from tendermint_tpu.libs.recorder import RECORDER
+from tendermint_tpu.libs.txlife import TXLIFE
 from tendermint_tpu.state import ABCIResponses, State, StateStore
 from tendermint_tpu.state.validation import validate_block
 from tendermint_tpu.types import Block, BlockID
+from tendermint_tpu.types.tx import tx_hash
 from tendermint_tpu.types.event_bus import EventBus
 from tendermint_tpu.types.params import ConsensusParams
 from tendermint_tpu.types.validator import Validator
@@ -160,6 +162,13 @@ class BlockExecutor:
             if not resp.is_ok:
                 invalid += 1
             deliver_resps.append(resp)
+        if TXLIFE.enabled:
+            # one tap after the whole flush: futs are index-aligned with
+            # block.data.txs, and the ROADMAP-1 question is where the
+            # serial DeliverTx LOOP ends, not per-tx app latency
+            for tx, resp in zip(block.data.txs, deliver_resps):
+                TXLIFE.stage("delivered", tx_hash(tx),
+                             height=block.header.height, ok=resp.is_ok)
         if invalid:
             self.logger.info("invalid txs in block", count=invalid)
         end_resp = await self.app.end_block(abci.RequestEndBlock(block.header.height))

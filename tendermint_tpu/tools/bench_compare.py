@@ -24,6 +24,13 @@ ending `_ms`/`_seconds`, like the streaming pipeline's
 automatically. `--lower-is-better` forces the latency direction for
 every record (legacy flag, kept for explicit latency-only files).
 
+A record carrying `"gate": false` is informational: it is shown in the
+diff (flag `info`) but never counts as a regression, whichever side of
+the join carries the flag. Attribution-style numbers — e.g. the ingest
+bench's per-stage dwell percentiles, which legitimately swing several
+multiples with workload shape — ride the banked trajectory without
+turning shape noise into red builds.
+
 Usage:
     python -m tendermint_tpu.tools.bench_compare OLD NEW [--threshold 0.10]
 Exit codes: 0 ok / no overlap, 1 regression past threshold, 2 bad input.
@@ -103,13 +110,18 @@ def compare(old: dict[str, dict], new: dict[str, dict],
             continue
         delta = (nv - ov) / abs(ov)
         lower = lower_is_better or _lower_is_better(metric, new[metric])
-        regressed = (delta > threshold) if lower else (delta < -threshold)
+        gated = (old[metric].get("gate", True) is not False
+                 and new[metric].get("gate", True) is not False)
+        regressed = gated and (
+            (delta > threshold) if lower else (delta < -threshold)
+        )
         rows.append({
             "metric": metric,
             "old": ov,
             "new": nv,
             "delta_pct": round(delta * 100.0, 2),
             "regressed": regressed,
+            "gated": gated,
             "unit": new[metric].get("unit") or old[metric].get("unit") or "",
         })
         if regressed:
@@ -126,7 +138,12 @@ def compare(old: dict[str, dict], new: dict[str, dict],
 def render(result: dict) -> str:
     lines = []
     for r in result["rows"]:
-        flag = "REGRESSED" if r["regressed"] else "ok"
+        if r["regressed"]:
+            flag = "REGRESSED"
+        elif not r.get("gated", True):
+            flag = "info"
+        else:
+            flag = "ok"
         lines.append(
             f"{r['metric']:<48} {r['old']:>14,.1f} -> {r['new']:>14,.1f} "
             f"{r['unit']:<12} {r['delta_pct']:>+8.2f}%  {flag}"

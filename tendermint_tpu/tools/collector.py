@@ -59,7 +59,18 @@ TYPE_NAMES = {PREVOTE: "prevote", PRECOMMIT: "precommit"}
 
 # RPC routes scraped per node, with their query args
 ROUTES = ("status", "health", "validators", "debug_device",
-          "debug_consensus_trace", "debug_flight_recorder")
+          "debug_consensus_trace", "debug_flight_recorder",
+          "debug_tx_lifecycle")
+
+# libs/txlife.py CORE_STAGES, duplicated so this tool stays importable
+# with zero tendermint_tpu dependencies (it runs on any host with
+# stdlib python). Gossip stages are deliberately unranked: they repeat
+# per peer and, on a non-origin node, legitimately precede every local
+# core stage.
+TX_CORE_RANK = {
+    "rpc_received": 0, "parked": 1, "flushed": 2, "verdict": 3,
+    "proposed": 4, "delivered": 5, "committed": 6,
+}
 
 
 # ---------------------------------------------------------------- scraping
@@ -92,6 +103,10 @@ def scrape_node(endpoint: str, cursor: dict | None = None,
         "debug_flight_recorder": (
             f"?n=2000&since_seq={cursor.get('seq', 0)}"
             f"&since_ns={cursor.get('ns', 0)}"
+        ),
+        "debug_tx_lifecycle": (
+            f"?n=2000&since_seq={cursor.get('txl_seq', 0)}"
+            f"&since_ns={cursor.get('txl_ns', 0)}"
         ),
     }
     for route in ROUTES:
@@ -194,6 +209,20 @@ def normalize_events(scrape: dict) -> list[dict]:
         return []
     out = []
     for e in (scrape.get("debug_flight_recorder") or {}).get("events") or []:
+        d = dict(e)
+        d["t_wall_ns"] = int(e["t_mono_ns"]) + off
+        out.append(d)
+    return out
+
+
+def normalize_tx_events(scrape: dict) -> list[dict]:
+    """debug_tx_lifecycle events on the shared wall timebase — same
+    anchor discipline as normalize_events (no anchor, no events)."""
+    off = wall_offset_ns(scrape)
+    if off is None:
+        return []
+    out = []
+    for e in (scrape.get("debug_tx_lifecycle") or {}).get("events") or []:
         d = dict(e)
         d["t_wall_ns"] = int(e["t_mono_ns"]) + off
         out.append(d)
@@ -388,6 +417,120 @@ def phase_stats(analyzed: list[dict]) -> dict:
     return {label: percentiles_ms(v) for label, v in acc.items()}
 
 
+# ------------------------------------------------- tx-lifecycle stitching
+
+
+def stitch_txs(scrapes: list[dict],
+               extra_tx_events: dict[str, list[dict]] | None = None) -> dict:
+    """Merge per-node tx-lifecycle streams into per-tx cross-node
+    timelines. Sampling is deterministic by hash on every node, so a
+    sampled tx's events exist on EVERY node that saw it — the stitch is
+    a plain union keyed by hash."""
+    txs: dict[str, dict] = {}
+
+    def t_entry(txh: str) -> dict:
+        return txs.setdefault(txh, {
+            "origin": None,        # {"node", "t_wall_ns"} — first rpc_received
+            "stages": {},          # node -> [{stage, t_wall_ns, fields}, ...]
+            "gossip_in": {},       # node -> first arrival t_wall_ns
+            "committed": {},       # node -> {"height", "t_wall_ns"}
+        })
+
+    for scrape in scrapes:
+        node = node_name(scrape)
+        events = normalize_tx_events(scrape)
+        if extra_tx_events and node in extra_tx_events:
+            events = extra_tx_events[node] + events
+        for e in events:
+            txh = e.get("tx")
+            if not txh:
+                continue
+            stage, t = e.get("stage"), e["t_wall_ns"]
+            f = e.get("fields") or {}
+            entry = t_entry(txh)
+            entry["stages"].setdefault(node, []).append({
+                "stage": stage, "t_wall_ns": t,
+                **({"fields": f} if f else {}),
+            })
+            if stage == "rpc_received":
+                cur = entry["origin"]
+                if cur is None or t < cur["t_wall_ns"]:
+                    entry["origin"] = {"node": node, "t_wall_ns": t}
+            elif stage == "gossip_in":
+                if node not in entry["gossip_in"] or t < entry["gossip_in"][node]:
+                    entry["gossip_in"][node] = t
+            elif stage == "committed":
+                c = entry["committed"]
+                if node not in c or t < c[node]["t_wall_ns"]:
+                    c[node] = {"height": f.get("height"), "t_wall_ns": t}
+    for entry in txs.values():
+        for evs in entry["stages"].values():
+            evs.sort(key=lambda e: e["t_wall_ns"])
+    return txs
+
+
+def analyze_txs(txs: dict) -> dict:
+    """Derived fleet view of the stitched txs: how many were observed
+    end to end (origin rpc_received + committed somewhere), committed-
+    height agreement, and propagation-spread percentiles (origin's
+    first observation → last per-node gossip arrival — how long the
+    fleet takes to SEE a tx)."""
+    complete = []
+    spreads_ns = []
+    e2e_ns = []
+    for txh, entry in txs.items():
+        committed = entry["committed"]
+        if entry["origin"] and committed:
+            complete.append(txh)
+            t0 = entry["origin"]["t_wall_ns"]
+            e2e_ns.append(
+                min(c["t_wall_ns"] for c in committed.values()) - t0
+            )
+            if entry["gossip_in"]:
+                spreads_ns.append(max(entry["gossip_in"].values()) - t0)
+    return {
+        "n": len(txs),
+        "complete": sorted(complete),
+        "propagation_spread": percentiles_ms([x for x in spreads_ns if x >= 0]),
+        "e2e": percentiles_ms([x for x in e2e_ns if x >= 0]),
+    }
+
+
+def check_tx_invariants(txs: dict) -> list[str]:
+    """The tx-lifecycle invariants (--check): every sampled committed tx
+    has (a) a monotone CORE-stage ordering on every observing node —
+    time order must agree with rpc_received → parked → flushed →
+    verdict → proposed → delivered → committed (gossip stages are
+    per-peer and unranked) — and (b) a single committed height
+    fleet-wide."""
+    violations = []
+    for txh, entry in txs.items():
+        if not entry["committed"]:
+            continue
+        short = txh[:16]
+        heights = {c["height"] for c in entry["committed"].values()
+                   if c["height"] is not None}
+        if len(heights) > 1:
+            violations.append(
+                f"tx {short}: committed at multiple heights {sorted(heights)}"
+            )
+        for node, evs in entry["stages"].items():
+            max_rank, max_stage = -1, None
+            for e in evs:  # already time-sorted
+                rank = TX_CORE_RANK.get(e["stage"])
+                if rank is None:
+                    continue
+                if rank < max_rank:
+                    violations.append(
+                        f"tx {short}: stage order violated on {node} "
+                        f"({e['stage']} after {max_stage})"
+                    )
+                    break
+                if rank > max_rank:
+                    max_rank, max_stage = rank, e["stage"]
+    return violations
+
+
 # ------------------------------------------------------------- the report
 
 
@@ -495,15 +638,23 @@ def check_invariants(report: dict, commit_spread_s: float = 2.0) -> list[str]:
                 f"node {n['moniker']}: {n['task_crashes']} background "
                 f"task crash(es)"
             )
+    # tx-lifecycle invariants (when the txlife plane contributed events):
+    # monotone core-stage ordering per node, one committed height fleet-wide
+    violations.extend(check_tx_invariants(report.get("txs", {}).get(
+        "timelines", {}
+    )))
     return violations
 
 
 def build_report(scrapes: list[dict],
                  extra_events: dict[str, list[dict]] | None = None,
-                 commit_spread_s: float = 2.0) -> dict:
+                 commit_spread_s: float = 2.0,
+                 extra_tx_events: dict[str, list[dict]] | None = None) -> dict:
     """The fleet report: node inventory, stitched per-height timelines,
-    phase + propagation percentiles, device occupancy, invariants."""
+    phase + propagation percentiles, device occupancy, stitched per-tx
+    lifecycle timelines, invariants."""
     stitched = stitch(scrapes, extra_events)
+    txs = stitch_txs(scrapes, extra_tx_events)
     heights, observers = stitched["heights"], stitched["observers"]
     # validator-set size: the validators route, else the widest vote
     # matrix actually observed
@@ -556,6 +707,7 @@ def build_report(scrapes: list[dict],
         "propagation": propagation_stats(heights),
         "device": device_summary(scrapes),
         "traces": trace_summary(scrapes),
+        "txs": {"timelines": txs, **analyze_txs(txs)},
     }
     report["violations"] = check_invariants(report, commit_spread_s)
     return report
@@ -612,6 +764,16 @@ def render_text(report: dict) -> str:
                 f"device[{node}]: 0 dispatches (cpu route: "
                 f"{cpu.get('sigs', 0)} sigs in {cpu.get('batches', 0)} batches)"
             )
+    txs = report.get("txs") or {}
+    if txs.get("n"):
+        prop_tx = txs["propagation_spread"]
+        e2e = txs["e2e"]
+        lines.append(
+            f"txs: {txs['n']} sampled, {len(txs['complete'])} stitched "
+            f"end-to-end; fleet propagation p50={prop_tx['p50_ms']}ms "
+            f"max={prop_tx['max_ms']}ms; e2e p50={e2e['p50_ms']}ms "
+            f"p90={e2e['p90_ms']}ms"
+        )
     if report["violations"]:
         lines.append("VIOLATIONS:")
         lines.extend(f"  - {v}" for v in report["violations"])
@@ -639,6 +801,7 @@ class FleetCollector:
         self.timeout = timeout
         self.cursors: dict[str, dict] = {}
         self._events: dict[str, list[dict]] = {}  # endpoint -> wall events
+        self._tx_events: dict[str, list[dict]] = {}  # endpoint -> txlife events
         self._traces: dict[str, dict] = {}  # endpoint -> height -> trace
         self._names: dict[str, str] = {}  # endpoint -> last-known moniker
         self._last_scrapes: list[dict] = []
@@ -658,6 +821,15 @@ class FleetCollector:
                 ) or cur.get("seq", 0)
                 cur["ns"] = max(e["t_mono_ns"] for e in events)
                 self._events.setdefault(ep, []).extend(events)
+            tx_events = normalize_tx_events(s)
+            if tx_events:
+                cur = self.cursors.setdefault(ep, {})
+                cur["txl_seq"] = max(
+                    (e.get("seq", 0) for e in tx_events),
+                    default=cur.get("txl_seq", 0),
+                ) or cur.get("txl_seq", 0)
+                cur["txl_ns"] = max(e["t_mono_ns"] for e in tx_events)
+                self._tx_events.setdefault(ep, []).extend(tx_events)
             tr = s.get("debug_consensus_trace") or {}
             if tr.get("enabled"):
                 a = tr.get("anchor") or {}
@@ -677,6 +849,7 @@ class FleetCollector:
         # scrape contributes the non-event surfaces (status/health/device)
         scrapes = []
         extra: dict[str, list[dict]] = {}
+        extra_tx: dict[str, list[dict]] = {}
         for s in self._last_scrapes:
             s = dict(s)
             ep = s["endpoint"]
@@ -692,15 +865,20 @@ class FleetCollector:
             fr = dict(s.get("debug_flight_recorder") or {})
             fr["events"] = []  # events come from the accumulator instead
             s["debug_flight_recorder"] = fr
+            txl = dict(s.get("debug_tx_lifecycle") or {})
+            txl["events"] = []
+            s["debug_tx_lifecycle"] = txl
             if self._traces.get(ep):
                 tr = dict(s.get("debug_consensus_trace") or {})
                 tr["enabled"] = True
                 tr["traces"] = list(self._traces[ep].values())
                 s["debug_consensus_trace"] = tr
             extra[node_name(s)] = self._events.get(ep, [])
+            extra_tx[node_name(s)] = self._tx_events.get(ep, [])
             scrapes.append(s)
         return build_report(scrapes, extra_events=extra,
-                            commit_spread_s=commit_spread_s)
+                            commit_spread_s=commit_spread_s,
+                            extra_tx_events=extra_tx)
 
 
 # ------------------------------------------------------------------- CLI

@@ -751,3 +751,38 @@ class TestBenchCompare:
         assert res["regressions"] == [
             "ed25519_stream_commit_10000v_residual_ms"
         ]
+
+    def test_ungated_record_never_regresses(self, tmp_path):
+        # attribution rows ("gate": false — the ingest bench's per-stage
+        # dwell percentiles) are shown but never fail the build, whichever
+        # side of the join carries the flag; gated rows in the same file
+        # still gate
+        def recs(stage, rate, flag_old):
+            return [
+                {"metric": "ingest_x_batched_stage_flushed_p99_ms",
+                 "value": stage, "unit": "ms",
+                 **({"gate": False} if flag_old else {})},
+                {"metric": "ingest_x_batched_tx_per_sec",
+                 "value": rate, "unit": "tx/s"},
+            ]
+
+        old = self._write(tmp_path, "old.json", recs(33.0, 5000.0, True))
+        # stage p99 triples (would regress if gated); rate holds
+        new = self._write(tmp_path, "new.json", recs(99.0, 4900.0, False))
+        assert bench_compare.main([old, new]) == 0
+        res = bench_compare.compare(
+            bench_compare.load_records(old),
+            bench_compare.load_records(new),
+        )
+        by = {r["metric"]: r for r in res["rows"]}
+        assert not by["ingest_x_batched_stage_flushed_p99_ms"]["gated"]
+        assert by["ingest_x_batched_tx_per_sec"]["gated"]
+        # flag on the NEW side alone also exempts the row
+        old2 = self._write(tmp_path, "old2.json", recs(33.0, 5000.0, False))
+        new2 = self._write(tmp_path, "new2.json", recs(99.0, 500.0, True))
+        res2 = bench_compare.compare(
+            bench_compare.load_records(old2),
+            bench_compare.load_records(new2),
+        )
+        # ... but the collapsed rate still fails
+        assert res2["regressions"] == ["ingest_x_batched_tx_per_sec"]

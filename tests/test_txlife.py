@@ -1,0 +1,438 @@
+"""Tx-lifecycle tracer tests (ISSUE 16 observability tentpole).
+
+Crypto-free by construction: txlife keys are opaque bytes (production
+hands it types/tx.py hashes; here any 32 bytes do), so sampling
+determinism, ring/index bounds, cursor semantics, metrics emission, the
+JSONL dump, and the fleet collector's cross-node tx stitching +
+invariants all run without the crypto stack.
+"""
+import json
+
+from tendermint_tpu.libs.metrics import Collector
+from tendermint_tpu.libs.metrics import TxMetrics
+from tendermint_tpu.libs.txlife import (
+    CORE_RANK,
+    CORE_STAGES,
+    TxLifeRecorder,
+    sampled_key,
+)
+from tendermint_tpu.tools.collector import (
+    analyze_txs,
+    build_report,
+    check_tx_invariants,
+    render_text,
+    stitch_txs,
+)
+
+
+def key(i: int) -> bytes:
+    return i.to_bytes(8, "big") + b"\x00" * 24
+
+
+# ---------------------------------------------------------------- sampling
+
+
+class TestSampling:
+    def test_deterministic_across_nodes(self):
+        """Two recorders (= two nodes) at the same rate sample exactly
+        the same txs — the property fleet-wide stitching rests on."""
+        a, b = TxLifeRecorder(), TxLifeRecorder()
+        a.configure(True, sample=4)
+        b.configure(True, sample=4)
+        for i in range(200):
+            a.stage("parked", key(i))
+            b.stage("committed", key(i))
+        kept_a = {e["tx"] for e in a.snapshot()}
+        kept_b = {e["tx"] for e in b.snapshot()}
+        assert kept_a == kept_b
+        assert 0 < len(kept_a) < 200
+        for i in range(200):
+            assert (key(i).hex() in kept_a) == sampled_key(key(i), 4)
+
+    def test_sample_one_keeps_all(self):
+        r = TxLifeRecorder()
+        r.configure(True, sample=1)
+        for i in range(50):
+            r.stage("parked", key(i))
+        assert r.sampled == 50
+        assert sampled_key(b"\xff" * 32, 1) and sampled_key(b"\xff" * 32, 0)
+
+    def test_unsampled_tx_records_nothing(self):
+        r = TxLifeRecorder()
+        r.configure(True, sample=1 << 62)  # nothing but key(0) passes
+        r.stage("parked", key(1))
+        r.stage("committed", key(1))
+        assert r.total == 0 and r.timeline(key(1)) == []
+
+    def test_env_override_enables(self, monkeypatch):
+        monkeypatch.setenv("TMTPU_TXLIFE_SAMPLE", "3")
+        r = TxLifeRecorder()
+        r.configure(False)  # config says off; env wins
+        assert r.enabled and r.sample == 3
+
+    def test_env_override_forces_off(self, monkeypatch):
+        monkeypatch.setenv("TMTPU_TXLIFE_SAMPLE", "0")
+        r = TxLifeRecorder()
+        r.configure(True, sample=1)
+        assert not r.enabled
+
+    def test_env_override_garbage_ignored(self, monkeypatch):
+        monkeypatch.setenv("TMTPU_TXLIFE_SAMPLE", "many")
+        r = TxLifeRecorder()
+        r.configure(True, sample=2)
+        assert r.enabled and r.sample == 2
+
+    def test_disabled_is_inert(self):
+        r = TxLifeRecorder()
+        r.stage("parked", key(1))
+        assert r.total == 0 and r.sampled == 0
+
+
+# ------------------------------------------------------------ ring + index
+
+
+class TestBounds:
+    def test_ring_eviction_and_total_dropped(self):
+        r = TxLifeRecorder(maxlen=4)
+        r.configure(True)
+        for i in range(10):
+            r.stage("parked", key(i))
+        snap = r.snapshot()
+        assert len(snap) == 4
+        assert [e["seq"] for e in snap] == [7, 8, 9, 10]  # oldest first
+        assert r.total == 10 and r.total_dropped == 6
+
+    def test_tx_index_fifo_eviction(self):
+        r = TxLifeRecorder(max_txs=2)
+        r.configure(True)
+        for i in range(3):
+            r.stage("parked", key(i))
+        assert r.sampled == 3 and r.evicted == 1
+        assert r.timeline(key(0)) == []  # oldest tx gone
+        assert r.timeline(key(2))  # newest survives
+        assert set(r.timelines()) == {key(1), key(2)}
+
+    def test_timeline_order_and_fields(self):
+        r = TxLifeRecorder()
+        r.configure(True)
+        r.stage("rpc_received", key(1), route="sync")
+        r.stage("parked", key(1))
+        r.stage("committed", key(1), height=7)
+        tl = r.timeline(key(1))
+        assert [e["stage"] for e in tl] == ["rpc_received", "parked", "committed"]
+        assert tl[0]["fields"] == {"route": "sync"}
+        assert tl[-1]["fields"] == {"height": 7}
+        assert tl[0]["t_mono_ns"] <= tl[-1]["t_mono_ns"]
+
+    def test_clear_keeps_counters_honest(self):
+        r = TxLifeRecorder()
+        r.configure(True)
+        r.stage("parked", key(1))
+        r.clear()
+        assert r.snapshot() == [] and r.timeline(key(1)) == []
+        r.stage("parked", key(2))
+        assert r.total == 2  # seq keeps counting across clear
+        assert r.total_dropped == 1
+
+
+# ----------------------------------------------------------------- cursors
+
+
+class TestCursors:
+    def fill(self):
+        r = TxLifeRecorder()
+        r.configure(True)
+        for i in range(5):
+            r.stage("parked", key(i))
+        return r
+
+    def test_since_seq_strictly_greater(self):
+        r = self.fill()
+        assert [e["seq"] for e in r.snapshot(since_seq=3)] == [4, 5]
+        assert r.snapshot(since_seq=5) == []
+
+    def test_cursor_resume_is_gapless(self):
+        """The collector's poll loop: read, remember the last seq, read
+        again — the two reads partition the stream exactly."""
+        r = self.fill()
+        first = r.snapshot(limit=3)  # newest 3 of 5... oldest-first
+        cursor = first[-1]["seq"]
+        r.stage("flushed", key(9))
+        second = r.snapshot(since_seq=cursor)
+        assert [e["seq"] for e in second] == [6]
+
+    def test_since_ns_filters(self):
+        r = self.fill()
+        mid = r.snapshot()[2]["t_mono_ns"]
+        newer = r.snapshot(since_ns=mid)
+        assert all(e["t_mono_ns"] > mid for e in newer)
+
+    def test_tx_filter_and_limit(self):
+        r = TxLifeRecorder()
+        r.configure(True)
+        for i in range(4):
+            r.stage("parked", key(1))
+            r.stage("parked", key(2))
+        only = r.snapshot(tx=key(1))
+        assert len(only) == 4
+        assert {e["tx"] for e in only} == {key(1).hex()}
+        assert len(r.snapshot(limit=3)) == 3
+
+
+# ----------------------------------------------------------------- metrics
+
+
+class TestMetrics:
+    def test_stage_and_e2e_series(self):
+        c = Collector()
+        r = TxLifeRecorder()
+        r.configure(True)
+        r.set_metrics(TxMetrics(c))
+        r.stage("rpc_received", key(1))
+        r.stage("parked", key(1))
+        r.stage("committed", key(1), height=3)
+        r.stage("rpc_received", key(2))  # sampled, never committed
+        text = c.render()
+        assert "tendermint_tx_sampled_total 2" in text
+        assert "tendermint_tx_committed_total 1" in text
+        assert 'tendermint_tx_stage_seconds_count{stage="parked"} 1' in text
+        assert 'tendermint_tx_stage_seconds_count{stage="committed"} 1' in text
+        # the first stage has no predecessor: no delta series for it
+        assert 'stage="rpc_received"' not in text
+        assert "tendermint_tx_e2e_seconds_count 1" in text
+
+    def test_detached_metrics_safe(self):
+        r = TxLifeRecorder()
+        r.configure(True)
+        r.set_metrics(None)
+        r.stage("committed", key(1))  # must not raise
+        assert r.total == 1
+
+
+# -------------------------------------------------------------------- dump
+
+
+class TestDump:
+    def test_dump_header_and_events(self, tmp_path):
+        r = TxLifeRecorder()
+        r.configure(True, sample=2)
+        r.set_moniker("nodeX")
+        for i in range(6):
+            r.stage("parked", key(i))
+        path = str(tmp_path / "txlife.jsonl")
+        r.set_dump_path(path)
+        n = r.dump("test")
+        r.set_dump_path(None)
+        assert n == r.total == len(r.snapshot())
+        lines = [json.loads(ln) for ln in open(path, encoding="utf-8")]
+        head = lines[0]
+        assert head["tx_lifecycle_dump"] == "test"
+        assert head["moniker"] == "nodeX" and head["sample"] == 2
+        assert head["events"] == n and len(lines) == 1 + n
+        assert {"mono_ns", "wall_ns"} <= set(head["anchor"])
+        assert all("tx" in e and "stage" in e for e in lines[1:])
+
+    def test_dump_without_sink(self):
+        r = TxLifeRecorder()
+        r.configure(True)
+        r.stage("parked", key(1))
+        assert r.dump("test") == -1
+
+
+# ------------------------------------------- fleet stitching (collector)
+
+
+TX = "ab" * 32
+TX2 = "cd" * 32
+
+
+def ev(seq, t, stage, tx=TX, **fields):
+    d = {"seq": seq, "t_mono_ns": t, "tx": tx, "stage": stage}
+    if fields:
+        d["fields"] = fields
+    return d
+
+
+def scrape(node, anchor_mono, anchor_wall, events):
+    """A canned collector scrape: each node gets its own (skewed)
+    monotonic base; the wall anchor is what re-timebases them."""
+    anchor = {"mono_ns": anchor_mono, "wall_ns": anchor_wall}
+    return {
+        "ok": True,
+        "endpoint": f"http://{node}",
+        "status": {"node_info": {"moniker": node}},
+        "debug_flight_recorder": {"anchor": anchor, "events": []},
+        "debug_tx_lifecycle": {"anchor": anchor, "events": events},
+    }
+
+
+WALL0 = 1_700_000_000_000_000_000
+
+
+def canned_fleet(commit_height_n1=5):
+    """Origin node0 (mono base 1e9) + replica node1 (mono base 7e9,
+    started 2ms later on the wall clock): the tx is received on node0,
+    gossips to node1, commits on both."""
+    n0 = scrape("node0", 1_000_000_000, WALL0, [
+        ev(1, 1_000_100_000, "rpc_received", route="sync"),
+        ev(2, 1_000_200_000, "parked"),
+        ev(3, 1_000_300_000, "flushed", batch=1, lanes=2),
+        ev(4, 1_000_400_000, "verdict", ok=True),
+        ev(5, 1_000_500_000, "gossip_out", peer="n1"),
+        ev(6, 1_002_000_000, "committed", height=5),
+    ])
+    n1 = scrape("node1", 7_000_000_000, WALL0 + 2_000_000, [
+        ev(1, 7_000_900_000, "gossip_in", peer="n0"),
+        ev(2, 7_001_000_000, "parked"),
+        ev(3, 7_002_100_000, "committed", height=commit_height_n1),
+    ])
+    return [n0, n1]
+
+
+class TestStitch:
+    def test_cross_node_timeline(self):
+        txs = stitch_txs(canned_fleet())
+        tl = txs[TX]
+        assert tl["origin"]["node"] == "node0"
+        # skewed mono bases re-timebased: node1's gossip_in lands AFTER
+        # node0's rpc_received on the shared wall axis
+        assert tl["gossip_in"]["node1"] > tl["origin"]["t_wall_ns"]
+        assert set(tl["committed"]) == {"node0", "node1"}
+        assert {c["height"] for c in tl["committed"].values()} == {5}
+        stages0 = [e["stage"] for e in tl["stages"]["node0"]]
+        assert stages0 == ["rpc_received", "parked", "flushed", "verdict",
+                           "gossip_out", "committed"]
+
+    def test_analyze_complete_and_percentiles(self):
+        txs = stitch_txs(canned_fleet())
+        a = analyze_txs(txs)
+        assert a["n"] == 1 and a["complete"] == [TX]
+        # origin -> node1 gossip_in: 2ms wall skew + 0.9ms mono - 0.1ms
+        assert a["propagation_spread"]["n"] == 1
+        assert 2.0 < a["propagation_spread"]["max_ms"] < 3.5
+        assert a["e2e"]["n"] == 1
+
+    def test_invariant_clean(self):
+        txs = stitch_txs(canned_fleet())
+        assert check_tx_invariants(txs) == []
+
+    def test_invariant_split_height(self):
+        txs = stitch_txs(canned_fleet(commit_height_n1=6))
+        v = check_tx_invariants(txs)
+        assert len(v) == 1 and "multiple heights" in v[0]
+
+    def test_invariant_stage_order(self):
+        fleet = canned_fleet()
+        evs = fleet[0]["debug_tx_lifecycle"]["events"]
+        evs[5]["t_mono_ns"] = 1_000_250_000  # committed before flushed
+        v = check_tx_invariants(stitch_txs(fleet))
+        assert any("stage order" in s for s in v)
+
+    def test_gossip_stages_unranked(self):
+        """Per-peer gossip stamps precede every local stage on a replica
+        — the invariant must not flag them (only CORE stages rank)."""
+        assert "gossip_in" not in CORE_RANK and "gossip_out" not in CORE_RANK
+        assert CORE_RANK["committed"] == len(CORE_STAGES) - 1
+
+    def test_report_and_render(self):
+        rep = build_report(canned_fleet())
+        assert rep["txs"]["n"] == 1 and rep["violations"] == []
+        text = render_text(rep)
+        assert "txs: 1 sampled, 1 stitched end-to-end" in text
+
+    def test_second_tx_incomplete_not_stitched_complete(self):
+        fleet = canned_fleet()
+        fleet[1]["debug_tx_lifecycle"]["events"].append(
+            ev(4, 7_003_000_000, "gossip_in", tx=TX2, peer="n2"))
+        a = analyze_txs(stitch_txs(fleet))
+        assert a["n"] == 2 and a["complete"] == [TX]
+
+    def test_extra_tx_events_accumulator(self):
+        """FleetCollector hands build_report the cursor-accumulated
+        (already wall-normalized) events separately; the stitch must
+        merge them with the live scrape's."""
+        from tendermint_tpu.tools.collector import normalize_tx_events
+
+        fleet = canned_fleet()
+        extra = {"node1": normalize_tx_events(fleet[1])}
+        fleet[1]["debug_tx_lifecycle"]["events"] = []
+        txs = stitch_txs(fleet, extra)
+        assert set(txs[TX]["committed"]) == {"node0", "node1"}
+
+    def test_scrape_stitch_over_http(self):
+        """The wire path the proc-testnet txlife scenario uses, minus the
+        node: two HTTP servers answer the URI-transport routes from the
+        canned fleet, the collector polls twice — the txl_seq cursor must
+        ride the second debug_tx_lifecycle query string, and the report
+        must stitch the tx across both 'nodes' with clean invariants."""
+        import http.server
+        import threading
+        import urllib.parse
+
+        from tendermint_tpu.tools.collector import FleetCollector
+
+        fleet = canned_fleet()
+        seen_since: list[tuple[str, str]] = []
+
+        def make_handler(fixture):
+            class H(http.server.BaseHTTPRequestHandler):
+                def do_GET(self):
+                    path = urllib.parse.urlparse(self.path)
+                    route = path.path.lstrip("/")
+                    q = urllib.parse.parse_qs(path.query)
+                    result = fixture.get(route)
+                    if route == "debug_tx_lifecycle" and result is not None:
+                        since = int(q.get("since_seq", ["0"])[0])
+                        seen_since.append((fixture["endpoint"], str(since)))
+                        result = dict(result, events=[
+                            e for e in result["events"] if e["seq"] > since
+                        ])
+                    if result is None:
+                        body = json.dumps(
+                            {"jsonrpc": "2.0", "id": 1,
+                             "error": {"code": -32601, "message": "no route"}}
+                        ).encode()
+                    else:
+                        body = json.dumps(
+                            {"jsonrpc": "2.0", "id": 1, "result": result}
+                        ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+
+                def log_message(self, *a):
+                    pass
+
+            return H
+
+        servers = []
+        try:
+            endpoints = []
+            for fx in fleet:
+                srv = http.server.ThreadingHTTPServer(
+                    ("127.0.0.1", 0), make_handler(fx))
+                t = threading.Thread(target=srv.serve_forever, daemon=True)
+                t.start()
+                servers.append((srv, t))
+                endpoints.append(f"http://127.0.0.1:{srv.server_address[1]}")
+            fc = FleetCollector(endpoints, timeout=5.0)
+            fc.poll()
+            fc.poll()
+            # first poll starts at cursor 0; the second passes the max
+            # seq each node served (node0 ring tops out at 6, node1 at 3)
+            per_node = {}
+            for node, since in seen_since:
+                per_node.setdefault(node, []).append(since)
+            assert [v[0] for v in per_node.values()] == ["0", "0"]
+            assert sorted(v[1] for v in per_node.values()) == ["3", "6"]
+            report = fc.report()
+            tl = report["txs"]["timelines"][TX]
+            assert tl["origin"]["node"] == "node0"
+            assert set(tl["committed"]) == {"node0", "node1"}
+            assert report["txs"]["complete"] == [TX]
+            assert report["violations"] == []
+        finally:
+            for srv, t in servers:
+                srv.shutdown()
+                t.join()
