@@ -1,0 +1,141 @@
+"""Latency-budget trajectory bench (ISSUE 17 observability tentpole).
+
+Exercises the fleet collector's `--budget` decomposition end to end on
+a deterministic canned 4-node capture — wildly skewed per-node
+monotonic clocks, full prevote/precommit matrices, apply_block + WAL
+fsync + device busy/sched taps — and emits the resulting
+bench_compare-compatible BUDGET rows (`budget_height_total_ms`,
+per-stage p50s, `budget_attribution_frac`; all `gate: false`). The
+banked `BUDGET_r*.json` trajectory rides the same CI loop as the
+BENCH/STREAM/MESH records, so a future change to the stitcher or the
+budget math that silently drops attribution shows up as a trajectory
+diff, not a mystery.
+
+The fixture is synthetic ON PURPOSE: the bench pins the budget
+*algorithm* (quorum-arrival anchors, monotone clamping, lead-node
+apply/fsync split, residual naming), which must be exact regardless of
+host speed, so a dependency-free environment banks identical numbers
+to a TPU host. The live-fleet numbers ride the `budget` proc_testnet
+scenario instead.
+
+Usage:
+    python -m benchmarks.budget_bench [--heights N] [--json PATH]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from tendermint_tpu.tools.collector import build_report, budget_records
+
+MS = 1_000_000  # ns
+N_VALS = 4
+WALL0 = 1_754_000_000_000_000_000
+# distinct, huge monotonic-origin skews per node: any stitch that
+# forgets the mono<->wall anchors produces garbage, not near-misses
+SKEWS = {0: 0, 1: 7_200 * 10**9, 2: -3_600 * 10**9, 3: 123_456_789_012}
+
+
+def _height_events(h: int, t0: int, observer: int
+                   ) -> list[tuple[int, str, str, dict]]:
+    """One node's events for height h on the shared wall timeline:
+    proposal, per-validator vote receipt + count, maj23, apply, fsync,
+    commit — with a per-observer gossip delay so the budget's
+    fleet-wide first-observation anchors differ from any single node's
+    view."""
+    delay = observer * 2 * MS
+    ev = [(t0 + delay, "consensus", "proposal", {"height": h, "round": 0})]
+    for tname, base in (("prevote", 10), ("precommit", 30)):
+        tcode = 1 if tname == "prevote" else 2
+        for val in range(N_VALS):
+            t = t0 + (base + val) * MS + delay
+            ev.append((t, "consensus", "vote_recv",
+                       {"height": h, "round": 0, "type": tcode,
+                        "val": val, "peer": f"peer{val}"}))
+            ev.append((t + MS, "consensus", "vote",
+                       {"height": h, "round": 0, "type": tcode, "val": val}))
+        ev.append((t0 + (base + N_VALS + 1) * MS + delay, "consensus",
+                   "maj23", {"height": h, "round": 0, "type": tcode,
+                             "power": 3}))
+    # device overlays land inside the height window on the lead node
+    if observer == 0:
+        ev.append((t0 + 12 * MS, "device", "sched_dispatch",
+                   {"cls": "consensus", "wait_ms": 0.4, "depth": 1}))
+        ev.append((t0 + 13 * MS, "device", "busy",
+                   {"ms": 2.5, "depth": 1}))
+    ev.append((t0 + 46 * MS + delay, "state", "apply_block",
+               {"height": h, "txs": 0, "ms": 2.0,
+                "app_hash": f"{h:02d}" * 4}))
+    ev.append((t0 + 48 * MS + delay, "wal", "fsync", {"ms": 1.5}))
+    ev.append((t0 + 50 * MS + delay, "consensus", "commit",
+               {"height": h, "round": 0, "txs": 0}))
+    return ev
+
+
+def _node_scrape(i: int, events_wall: list, height: int) -> dict:
+    walloff = WALL0 - SKEWS[i]
+    events = [
+        {"seq": seq, "t_mono_ns": t_wall - walloff,
+         "sub": sub, "kind": kind, "fields": fields}
+        for seq, (t_wall, sub, kind, fields) in enumerate(events_wall, 1)
+    ]
+    return {
+        "endpoint": f"http://127.0.0.1:{26657 + 2 * i}",
+        "ok": True,
+        "errors": {},
+        "status": {"node_info": {"moniker": f"node{i}"},
+                   "sync_info": {"latest_block_height": height}},
+        "health": {"status": "ok", "ready": True, "peers": 3,
+                   "task_crashes": 0, "degraded": []},
+        "validators": {"total": N_VALS},
+        "debug_device": None,
+        "debug_consensus_trace": {"enabled": False, "traces": []},
+        "debug_flight_recorder": {
+            "crashes": 0, "dumps": 0, "moniker": f"node{i}",
+            "anchor": {"mono_ns": 1_000_000, "wall_ns": walloff + 1_000_000},
+            "total": len(events), "total_dropped": 0, "events": events,
+        },
+    }
+
+
+def fleet_scrapes(n_heights: int) -> list[dict]:
+    scrapes = []
+    for i in range(4):
+        ev = []
+        for h in range(1, n_heights + 1):
+            ev.extend(_height_events(h, WALL0 + h * 1000 * MS, observer=i))
+        scrapes.append(_node_scrape(i, ev, height=n_heights))
+    return scrapes
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m benchmarks.budget_bench")
+    ap.add_argument("--heights", type=int, default=8)
+    ap.add_argument("--json", default=None,
+                    help="also write the JSONL rows to this path")
+    args = ap.parse_args(argv)
+
+    report = build_report(fleet_scrapes(args.heights), budget=True)
+    budget = report["budget"]
+    if budget["n_heights"] != args.heights:
+        print(f"budget_bench: stitched {budget['n_heights']} of "
+              f"{args.heights} heights", file=sys.stderr)
+        return 1
+    stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    rows = [
+        dict(r, measured_at_utc=stamp,
+             source=f"benchmarks.budget_bench heights={args.heights}")
+        for r in budget_records(budget)
+    ]
+    out = "\n".join(json.dumps(r, sort_keys=True) for r in rows) + "\n"
+    sys.stdout.write(out)
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as f:
+            f.write(out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -19,6 +19,9 @@ Scenarios:
   metrics          — live-path telemetry tells the truth under traffic.
   timeline         — the fleet collector stitches a cross-node per-height
                      timeline with a complete vote-arrival matrix.
+  budget           — per-commit latency budgets attribute each height's
+                     wall time; zero post-warmup recompiles; debug_profile
+                     captures a bounded profiler window on a live node.
 
 Usage:
   python -m networks.local.proc_testnet            # all scenarios, n=4
@@ -588,6 +591,96 @@ def scenario_txlife(net: ProcTestnet) -> None:
 scenario_txlife.self_start = True  # rewrites configs before any node starts
 
 
+def scenario_budget(net: ProcTestnet) -> None:
+    """Device-efficiency acceptance (ISSUE 17): on a live committing net
+    the collector's --budget plane decomposes every stitched height's
+    proposal→commit wall time into named additive stages — attribution
+    ≥ 0.95 with a dominant term per height — the post-warmup net mints
+    ZERO fresh XLA compiles between two polls (the recompile-storm
+    counters stay flat), and the fault-gated debug_profile route
+    captures a bounded host-profile window whose artifacts exist on
+    disk. The report lands in <root>/budget_report.json (preserved on
+    failure for the CI artifact upload)."""
+    mports = enable_prometheus(net)
+    configure_nodes(
+        net, lambda i, cfg: cfg["p2p"].update(test_fault_control=True)
+    )
+    net.start_all()
+    net.wait_all(2)
+    # traffic: one committed tx, then a couple more heights to budget
+    tx = "0x" + f"bg{os.getpid()}=1".encode().hex()
+    res = net.rpc(0, f"broadcast_tx_commit?tx={tx}", timeout=30.0)
+    assert res is not None and res.get("deliver_tx", {}).get("code", 1) == 0, res
+    net.wait_all(int(res["height"]) + 2)
+
+    def compile_totals() -> dict[int, float]:
+        """Fleet-wide tendermint_device_compiles_total per node (0.0
+        when a node never compiled — this net pins JAX_PLATFORMS=cpu,
+        so ANY nonzero delta is a post-warmup recompile)."""
+        totals: dict[int, float] = {}
+        for i in range(net.n):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{mports[i]}/metrics", timeout=5
+            ) as r:
+                text = r.read().decode()
+            totals[i] = sum(
+                float(line.rsplit(" ", 1)[1])
+                for line in text.splitlines()
+                if line.startswith("tendermint_device_compiles_total")
+            )
+        return totals
+
+    from tendermint_tpu.tools.collector import FleetCollector, render_text
+
+    endpoints = [f"http://127.0.0.1:{net.rpc_port(i)}" for i in range(net.n)]
+    fc = FleetCollector(endpoints, timeout=10.0)
+    warm = compile_totals()  # post-warmup compile baseline
+    fc.poll()
+    time.sleep(1.0)
+    fc.poll()
+    report = fc.report(commit_spread_s=5.0, budget=True)
+    after = compile_totals()
+    assert after == warm, ("post-warmup recompiles detected", warm, after)
+    report_path = os.path.join(net.root, "budget_report.json")
+    with open(report_path, "w", encoding="utf-8") as f:
+        json.dump(report, f, indent=1, sort_keys=True, default=str)
+
+    budget = report.get("budget")
+    assert budget and budget["n_heights"] > 0, (
+        f"no budgeted heights; see {report_path}"
+    )
+    for row in budget["heights"]:
+        assert row["attribution_frac"] >= 0.95, row
+        assert row["dominant"] in row["stages"], row
+    assert budget["attribution_frac_min"] >= 0.95, budget
+    assert budget["dominant_counts"], budget
+    assert not report["violations"], report["violations"]
+
+    # on-demand capture: a bounded window on node0 through the
+    # fault-gated route; artifacts are real files under node0's root
+    out = net.rpc(0, "debug_profile?action=start&seconds=30")
+    assert out is not None and out["capture"]["active"] is True, out
+    time.sleep(0.3)
+    out = net.rpc(0, "debug_profile?action=stop", timeout=15.0)
+    assert out is not None and out["capture"]["active"] is False, out
+    pstats = [a for a in out["artifacts"] if a.endswith("host_profile.pstats")]
+    assert pstats and os.path.exists(pstats[0]), out
+    print(render_text(report))
+    print(
+        f"budget: {budget['n_heights']} heights decomposed (attribution "
+        f">= {budget['attribution_frac_min']:.2f}, dominant "
+        + ", ".join(
+            f"{k} x{v}"
+            for k, v in sorted(budget["dominant_counts"].items())
+        )
+        + f"), zero post-warmup recompiles, "
+        f"{len(out['artifacts'])} capture artifact(s)"
+    )
+
+
+scenario_budget.self_start = True  # rewrites configs before any node starts
+
+
 def scenario_stream(net: ProcTestnet) -> None:
     """Streaming vote-pipeline acceptance (ISSUE 10): on a committing net
     with streaming forced on (vote_stream_min=1 so even this 4-validator
@@ -866,6 +959,7 @@ SCENARIOS = {
     "metrics": scenario_metrics,
     "timeline": scenario_timeline,
     "txlife": scenario_txlife,
+    "budget": scenario_budget,
     "stream": scenario_stream,
     "transfer": scenario_transfer,
     "soak": scenario_soak,
@@ -901,12 +995,14 @@ def run(names=None, n: int = 4) -> None:
                 print(f"--- generator stderr ---\n{err.decode(errors='replace')[-1500:]}",
                       file=sys.stderr)
             keep = tempfile.mkdtemp(prefix=f"tmtpu-{name}-failed-")
-            # the collector's fleet report (timeline scenario) rides with
-            # the logs so CI can upload it as a failure artifact
-            try:
-                shutil.copy(os.path.join(net.root, "fleet_report.json"), keep)
-            except OSError:
-                pass
+            # the collector's fleet/budget reports (timeline/budget
+            # scenarios) ride with the logs so CI can upload them as
+            # failure artifacts
+            for rpt in ("fleet_report.json", "budget_report.json"):
+                try:
+                    shutil.copy(os.path.join(net.root, rpt), keep)
+                except OSError:
+                    pass
             # WAL .corrupt sidecars (auto-repair evidence) ride with the
             # failure artifacts too — a repaired-then-still-failed run is
             # undiagnosable without the torn bytes
@@ -914,6 +1010,9 @@ def run(names=None, n: int = 4) -> None:
 
             for src in _glob.glob(
                 os.path.join(net.root, "node*", "data", "cs.wal", "*.corrupt*")
+            ) + _glob.glob(
+                # debug_profile capture artifacts (budget scenario)
+                os.path.join(net.root, "node*", "profiles", "*", "*")
             ):
                 rel = os.path.relpath(src, net.root).replace(os.sep, "_")
                 try:

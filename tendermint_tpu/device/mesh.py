@@ -187,6 +187,7 @@ def build_plan(curve: str, n: int):
     mesh = shard_mod.make_batch_mesh(devices[:n])
     # module-attribute call on purpose: the routing tests spy on the
     # builders to pin that dispatch really goes through the mesh
+    # (compile tracking happens inside the builders — device/profiler)
     if curve == "secp256k1":
         fn = shard_mod.build_secp_stream_verifier(mesh)
     else:
@@ -222,6 +223,12 @@ def _aot_mesh_fn(bucket: int, n: int):
             fn = aot.load_mesh_verify_fn(bucket, n)
         except Exception:  # noqa: BLE001 — AOT layer is best-effort
             fn = None
+        if fn is not None:
+            # pre-baked executable deserialized into the live client:
+            # an upload, not a compile — booked as a cache hit
+            from tendermint_tpu.device import profiler as _profiler
+
+            _profiler.PROFILER.record_cache_hit(f"ed25519_mesh{n}", "aot")
         with _lock:
             # a reset() during the load means the executable was built
             # for a device layout that no longer exists: don't cache it

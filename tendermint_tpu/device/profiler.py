@@ -1,0 +1,460 @@
+"""Device-efficiency observatory: compile, waste, and memory accounting.
+
+The device plane's performance pathologies are invisible by default:
+XLA recompiles happen silently inside the first call with a new shape,
+padding waste hides inside per-dispatch occupancy numbers, and device
+memory pressure only shows up when an allocation fails.  This module
+owns the accounting that makes them first-class signals:
+
+* **Recompile tracking** — every jit entry point (kcache kernels,
+  export-blob closures, mesh plans, sharded/stream verifiers) is
+  wrapped with :func:`wrap`, which times the first call per
+  (fn, shape-signature) — JAX traces and compiles synchronously inside
+  that call — and reports it to :data:`PROFILER`.  AOT-prebaked
+  executables and deserialized export blobs are *loads*, not traces,
+  and are counted as cache hits instead.  A burst of compiles after
+  warmup (`storm()`) degrades `health()` with `device_recompile_storm`.
+* **Padding waste** — cumulative wasted-lane accounting per bucket,
+  priority class, and mesh-shard count, layered on the per-dispatch
+  occupancy series in ``libs/trace.py``.
+* **Memory watermarks** — `jax` device memory stats polled
+  opportunistically (the CPU backend does not expose them; TPU/GPU do).
+* **On-demand capture** — a bounded `jax.profiler.trace` + host
+  `cProfile` window driven by the fault-control-gated ``debug_profile``
+  RPC route.
+
+Import discipline mirrors ``libs/trace.py``: stdlib only at module
+level; `jax` is only ever reached through ``sys.modules`` so a
+CPU-only node that never imported the ops stack stays jax-free.
+"""
+from __future__ import annotations
+
+import cProfile
+import os
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Optional
+
+from tendermint_tpu.libs.recorder import RECORDER
+
+__all__ = ["DeviceProfiler", "PROFILER", "wrap", "signature_of"]
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
+def signature_of(args: tuple) -> str:
+    """Shape signature of a call: the tuple of arg shapes (dtype-free —
+    the bucketed pipeline never varies dtype per bucket).  Non-array
+    args contribute their repr so a Python-scalar argument that would
+    retrace shows up as a distinct signature too."""
+    parts: list[str] = []
+    for a in args:
+        shape = getattr(a, "shape", None)
+        if shape is not None:
+            parts.append("x".join(str(d) for d in shape) or "scalar")
+        else:
+            parts.append(repr(a))
+    return "|".join(parts)
+
+
+class DeviceProfiler:
+    """Process-wide compile/waste/memory accounting + capture window.
+
+    Thread-safe: dispatch happens on scheduler worker threads, RPC
+    reads happen on the event loop, and warm subprocesses never import
+    this module at all.
+    """
+
+    # capture windows are operator-bounded: long traces make multi-GB
+    # artifacts and cProfile adds per-call overhead while enabled
+    MAX_CAPTURE_S = 120.0
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # --- compile accounting ---
+        self._sigs: dict[str, set[str]] = {}  # fn -> seen signatures
+        self._compiles: dict[str, int] = {}  # fn -> compile count
+        self._compile_s: dict[str, float] = {}  # fn -> compile wall time
+        self._cache_hits: dict[str, int] = {}  # kind -> count
+        self._recent: deque[float] = deque(maxlen=256)  # mono ts of compiles
+        self._first_compile_t: Optional[float] = None
+        # --- padding waste ---
+        self._waste_bucket: dict[int, dict[str, int]] = {}
+        self._waste_class: dict[str, dict[str, int]] = {}
+        self._waste_shards: dict[int, dict[str, int]] = {}
+        # --- memory watermarks ---
+        self._mem_in_use: dict[str, int] = {}  # device -> bytes in use
+        self._mem_peak: dict[str, int] = {}  # device -> peak bytes
+        self._mem_limit: dict[str, int] = {}
+        # --- capture window ---
+        self._cap: Optional[dict[str, Any]] = None
+        self._cap_history: deque[dict[str, Any]] = deque(maxlen=8)
+        self._metrics = None
+
+    # ------------------------------------------------------------------
+    # metrics mirror (same contract as trace.DEVICE / recorder.RECORDER)
+
+    def set_metrics(self, dm) -> None:
+        """Attach a DeviceMetrics bundle (None detaches)."""
+        with self._lock:
+            self._metrics = dm
+            if dm is None:
+                return
+            # replay cumulative state so a late-attached bundle (metrics
+            # come up after the first prewarm) does not under-report
+            for fn, n in self._compiles.items():
+                dm.compiles_total.inc(n, fn=fn)
+            total_s = sum(self._compile_s.values())
+            if total_s:
+                dm.compile_seconds.inc(total_s)
+            for kind, n in self._cache_hits.items():
+                dm.compile_cache_hits_total.inc(n, kind=kind)
+
+    # ------------------------------------------------------------------
+    # compile tracking
+
+    def record_compile(self, fn: str, sig: str, seconds: float) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._sigs.setdefault(fn, set()).add(sig)
+            self._compiles[fn] = self._compiles.get(fn, 0) + 1
+            self._compile_s[fn] = self._compile_s.get(fn, 0.0) + seconds
+            self._recent.append(now)
+            if self._first_compile_t is None:
+                self._first_compile_t = now
+            dm = self._metrics
+        RECORDER.record(
+            "device", "compile", fn=fn, sig=sig, ms=round(seconds * 1e3, 3)
+        )
+        if dm is not None:
+            dm.compiles_total.inc(fn=fn)
+            dm.compile_seconds.inc(seconds)
+
+    def record_cache_hit(self, fn: str, kind: str) -> None:
+        """A compiled executable was *loaded*, not traced: TPU AOT
+        prebake (`kind="aot"`), persistent-cache-backed export blob
+        (`kind="export"`), or the in-process memo (`kind="memo"`)."""
+        with self._lock:
+            self._cache_hits[kind] = self._cache_hits.get(kind, 0) + 1
+            dm = self._metrics
+        if dm is not None:
+            dm.compile_cache_hits_total.inc(kind=kind)
+
+    def seen(self, fn: str, sig: str) -> bool:
+        with self._lock:
+            return sig in self._sigs.get(fn, ())
+
+    def storm(self) -> bool:
+        """True when compiles exceed the rate threshold after warmup.
+
+        Warmup is a grace window from the *first* compile: prewarm and
+        first-dispatch compiles inside it never count.  Thresholds are
+        env-tunable (test knobs, same idiom as TMTPU_INGEST_STALL_S):
+        TMTPU_COMPILE_STORM_N compiles within TMTPU_COMPILE_STORM_WINDOW_S
+        seconds, ignoring the first TMTPU_COMPILE_STORM_GRACE_S seconds.
+        """
+        n_thresh = _env_int("TMTPU_COMPILE_STORM_N", 5)
+        window = _env_float("TMTPU_COMPILE_STORM_WINDOW_S", 60.0)
+        grace = _env_float("TMTPU_COMPILE_STORM_GRACE_S", 120.0)
+        now = time.monotonic()
+        with self._lock:
+            first = self._first_compile_t
+            if first is None:
+                return False
+            warm_edge = first + grace
+            recent = [t for t in self._recent if t >= now - window and t > warm_edge]
+        return len(recent) >= n_thresh
+
+    # ------------------------------------------------------------------
+    # padding waste (per bucket / priority class / mesh-shard count)
+
+    def record_padding(
+        self,
+        valid: int,
+        bucket: int,
+        *,
+        cls: str = "unknown",
+        shards: int = 1,
+    ) -> None:
+        padded = max(0, bucket - valid)
+        with self._lock:
+            for table, key in (
+                (self._waste_bucket, bucket),
+                (self._waste_class, cls),
+                (self._waste_shards, shards),
+            ):
+                row = table.setdefault(key, {"valid": 0, "padded": 0})
+                row["valid"] += valid
+                row["padded"] += padded
+            dm = self._metrics
+        if dm is not None:
+            if padded:
+                dm.pad_lanes_by_class_total.inc(padded, cls=cls)
+            dm.wasted_lane_frac.set(self._wasted_frac())
+
+    def _wasted_frac(self) -> float:
+        valid = sum(r["valid"] for r in self._waste_bucket.values())
+        padded = sum(r["padded"] for r in self._waste_bucket.values())
+        total = valid + padded
+        return (padded / total) if total else 0.0
+
+    # ------------------------------------------------------------------
+    # device memory watermarks
+
+    def record_memory(self) -> None:
+        """Poll jax device memory stats where the backend exposes them.
+
+        Never imports jax: if the ops stack hasn't pulled it in, there
+        is no device memory to account for.  The CPU backend returns no
+        stats — that's fine, the gauges just stay absent.
+        """
+        jax_mod = sys.modules.get("jax")
+        if jax_mod is None:
+            return
+        try:
+            devices = jax_mod.local_devices()
+        except Exception:
+            return
+        for dev in devices:
+            try:
+                stats = dev.memory_stats()
+            except Exception:
+                stats = None
+            if not stats:
+                continue
+            name = f"{getattr(dev, 'platform', 'dev')}:{getattr(dev, 'id', 0)}"
+            in_use = int(stats.get("bytes_in_use", 0))
+            peak = int(stats.get("peak_bytes_in_use", in_use))
+            limit = int(stats.get("bytes_limit", 0))
+            with self._lock:
+                self._mem_in_use[name] = in_use
+                self._mem_peak[name] = max(self._mem_peak.get(name, 0), peak)
+                if limit:
+                    self._mem_limit[name] = limit
+                dm = self._metrics
+            if dm is not None:
+                dm.memory_bytes_in_use.set(in_use, device=name)
+                dm.memory_peak_bytes.set(self._mem_peak[name], device=name)
+
+    # ------------------------------------------------------------------
+    # on-demand capture window (debug_profile RPC)
+
+    def start_capture(
+        self, out_dir: str, seconds: float = 10.0, jax_trace: bool = True
+    ) -> dict[str, Any]:
+        """Open a bounded capture window: host cProfile always, plus a
+        jax.profiler trace when jax is importable and the backend
+        cooperates.  A daemon timer force-stops at the bound so an
+        operator who never calls stop can't leave profiling enabled."""
+        seconds = max(0.5, min(float(seconds), self.MAX_CAPTURE_S))
+        with self._lock:
+            if self._cap is not None:
+                raise RuntimeError("capture already active")
+            os.makedirs(out_dir, exist_ok=True)
+            cap: dict[str, Any] = {
+                "dir": out_dir,
+                "t0_mono": time.monotonic(),
+                "seconds": seconds,
+                "jax_trace": False,
+            }
+            prof = cProfile.Profile()
+            cap["cprofile"] = prof
+            if jax_trace:
+                jax_mod = sys.modules.get("jax")
+                if jax_mod is not None:
+                    try:
+                        jax_mod.profiler.start_trace(
+                            os.path.join(out_dir, "jax_trace")
+                        )
+                        cap["jax_trace"] = True
+                    except Exception:
+                        cap["jax_trace"] = False
+            timer = threading.Timer(seconds, self._timer_stop)
+            timer.daemon = True
+            cap["timer"] = timer
+            self._cap = cap
+            prof.enable()
+            timer.start()
+        RECORDER.record(
+            "device", "profile_start", dir=out_dir, seconds=seconds,
+            jax=cap["jax_trace"],
+        )
+        return {
+            "dir": out_dir,
+            "seconds": seconds,
+            "jax_trace": cap["jax_trace"],
+        }
+
+    def _timer_stop(self) -> None:
+        try:
+            self.stop_capture()
+        except Exception:
+            pass
+
+    def stop_capture(self) -> dict[str, Any]:
+        with self._lock:
+            cap = self._cap
+            if cap is None:
+                raise RuntimeError("no capture active")
+            self._cap = None
+            prof: cProfile.Profile = cap["cprofile"]
+            prof.disable()
+        timer: threading.Timer = cap["timer"]
+        timer.cancel()
+        if timer is not threading.current_thread():
+            # reap the auto-stop thread (a cancelled Timer exits at once;
+            # an expired one is the caller itself and skips the join)
+            timer.join(timeout=1.0)
+        artifacts = []
+        host_path = os.path.join(cap["dir"], "host_profile.pstats")
+        try:
+            prof.dump_stats(host_path)
+            artifacts.append(host_path)
+        except Exception:
+            host_path = None
+        if cap["jax_trace"]:
+            jax_mod = sys.modules.get("jax")
+            if jax_mod is not None:
+                try:
+                    jax_mod.profiler.stop_trace()
+                    artifacts.append(os.path.join(cap["dir"], "jax_trace"))
+                except Exception:
+                    pass
+        duration = time.monotonic() - cap["t0_mono"]
+        result = {
+            "dir": cap["dir"],
+            "duration_s": round(duration, 3),
+            "jax_trace": cap["jax_trace"],
+            "artifacts": artifacts,
+        }
+        with self._lock:
+            self._cap_history.append(result)
+        RECORDER.record(
+            "device", "profile_stop", dir=cap["dir"],
+            duration_s=result["duration_s"], artifacts=len(artifacts),
+        )
+        return result
+
+    def capture_state(self) -> dict[str, Any]:
+        with self._lock:
+            cap = self._cap
+            state: dict[str, Any] = {
+                "active": cap is not None,
+                "history": list(self._cap_history),
+            }
+            if cap is not None:
+                state["dir"] = cap["dir"]
+                state["since_s"] = round(time.monotonic() - cap["t0_mono"], 3)
+                state["jax_trace"] = cap["jax_trace"]
+        return state
+
+    # ------------------------------------------------------------------
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            snap: dict[str, Any] = {
+                "compiles": dict(self._compiles),
+                "compiles_total": sum(self._compiles.values()),
+                "compile_seconds": round(sum(self._compile_s.values()), 6),
+                "compile_seconds_by_fn": {
+                    k: round(v, 6) for k, v in self._compile_s.items()
+                },
+                "signatures": {k: sorted(v) for k, v in self._sigs.items()},
+                "cache_hits": dict(self._cache_hits),
+                "waste": {
+                    "by_bucket": {
+                        str(k): dict(v) for k, v in self._waste_bucket.items()
+                    },
+                    "by_class": {k: dict(v) for k, v in self._waste_class.items()},
+                    "by_shards": {
+                        str(k): dict(v) for k, v in self._waste_shards.items()
+                    },
+                    "wasted_lane_frac": round(self._wasted_frac(), 6),
+                },
+                "memory": {
+                    "in_use_bytes": dict(self._mem_in_use),
+                    "peak_bytes": dict(self._mem_peak),
+                    "limit_bytes": dict(self._mem_limit),
+                },
+            }
+        snap["storm"] = self.storm()
+        snap["capture"] = self.capture_state()
+        return snap
+
+    def reset(self) -> None:
+        """Test hook: drop all accounting (not the active capture)."""
+        with self._lock:
+            self._sigs.clear()
+            self._compiles.clear()
+            self._compile_s.clear()
+            self._cache_hits.clear()
+            self._recent.clear()
+            self._first_compile_t = None
+            self._waste_bucket.clear()
+            self._waste_class.clear()
+            self._waste_shards.clear()
+            self._mem_in_use.clear()
+            self._mem_peak.clear()
+            self._mem_limit.clear()
+
+
+PROFILER = DeviceProfiler()
+
+
+def wrap(fn_name: str, fn: Callable, profiler: DeviceProfiler | None = None):
+    """Wrap a jit-compiled callable with first-call compile tracking.
+
+    JAX traces and compiles synchronously inside the first call for a
+    given shape signature (dispatch of the *result* is async, but the
+    trace/lower/compile pipeline is not), so timing the first-seen
+    signature measures compile cost.  Subsequent calls with a seen
+    signature go straight through.  The per-wrapper ``seen`` set is the
+    fast path; the profiler's cross-wrapper ledger is authoritative, so
+    re-wrapping the same underlying program (builders that run per
+    dispatch, e.g. secp ``_device_fn``) never double-counts.
+    """
+    prof = profiler if profiler is not None else PROFILER
+    seen: set[str] = set()
+    lock = threading.Lock()
+
+    def wrapped(*args, **kwargs):
+        sig = signature_of(args)
+        with lock:
+            hit = sig in seen
+        if hit or prof.seen(fn_name, sig):
+            with lock:
+                seen.add(sig)
+            return fn(*args, **kwargs)
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        with lock:
+            first = sig not in seen
+            seen.add(sig)
+        if first:
+            prof.record_compile(fn_name, sig, dt)
+        return out
+
+    wrapped.__wrapped__ = fn  # type: ignore[attr-defined]
+    wrapped.__name__ = getattr(fn, "__name__", fn_name)
+    return wrapped

@@ -421,6 +421,39 @@ class RuntimeMetrics:
             "runtime", "task_crashes_total",
             "Background tasks that died with an exception (spawn_logged)",
         )
+        # process-resource gauges (ISSUE 17): sampled at 1 Hz by the
+        # node's _metrics_sampler; the RSS series also feeds the
+        # libs/reswatch leak heuristic behind health()'s
+        # resource_leak_suspected degraded reason
+        self.rss_bytes = c.gauge(
+            "runtime", "rss_bytes", "Resident set size of the node process"
+        )
+        self.open_fds = c.gauge(
+            "runtime", "open_fds", "Open file descriptors held by the process"
+        )
+        self.asyncio_tasks = c.gauge(
+            "runtime", "asyncio_tasks", "Live asyncio tasks on the node loop"
+        )
+        self.recorder_dropped = c.gauge(
+            "runtime", "recorder_dropped",
+            "Flight-recorder events overwritten before any reader saw them",
+        )
+        self.txlife_dropped = c.gauge(
+            "runtime", "txlife_dropped",
+            "Tx-lifecycle ring/index events dropped under pressure",
+        )
+        self.sigcache_size = c.gauge(
+            "runtime", "sigcache_size",
+            "Verified-signature cache entries (sampler view of the sigcache)",
+        )
+        self.mempool_cache_size = c.gauge(
+            "runtime", "mempool_cache_size",
+            "Seen-tx dedup-LRU entries held by the mempool",
+        )
+        self.rss_slope_bps = c.gauge(
+            "runtime", "rss_slope_bps",
+            "Least-squares RSS slope over the leak-watch window (bytes/s)",
+        )
 
 
 class DeviceMetrics:
@@ -561,6 +594,39 @@ class DeviceMetrics:
         self.commit_residual_sigs_total = c.counter(
             "device", "commit_residual_sigs_total",
             "Commit-boundary signatures that needed a live verify",
+        )
+        # device-efficiency observatory (ISSUE 17): compile, padding-
+        # waste, and memory accounting, fed by device/profiler.PROFILER
+        self.compiles_total = c.counter(
+            "device", "compiles_total",
+            "XLA compiles observed per jit entry point (label: fn)",
+        )
+        self.compile_seconds = c.counter(
+            "device", "compile_seconds",
+            "Cumulative wall time spent inside first-call XLA compiles",
+        )
+        self.compile_cache_hits_total = c.counter(
+            "device", "compile_cache_hits_total",
+            "Compiled executables loaded instead of traced "
+            "(label kind: aot | export | memo)",
+        )
+        self.wasted_lane_frac = c.gauge(
+            "device", "wasted_lane_frac",
+            "Cumulative padded lanes / dispatched lanes (0.0 = no waste)",
+        )
+        self.pad_lanes_by_class_total = c.counter(
+            "device", "pad_lanes_by_class_total",
+            "Padding lanes dispatched, attributed to the scheduling "
+            "priority class that led the batch (label: cls)",
+        )
+        self.memory_bytes_in_use = c.gauge(
+            "device", "memory_bytes_in_use",
+            "Device memory in use per accelerator (absent on backends "
+            "without memory_stats)",
+        )
+        self.memory_peak_bytes = c.gauge(
+            "device", "memory_peak_bytes",
+            "High-water device memory per accelerator",
         )
 
 

@@ -1,0 +1,128 @@
+"""Process resource sampling + RSS leak heuristic (stdlib only).
+
+The 1 Hz ``_metrics_sampler`` in ``node/__init__.py`` feeds process
+samples here and mirrors them into the ``tm_runtime_*`` gauges;
+``health()`` reads :meth:`ResourceWatch.suspected` for the
+``resource_leak_suspected`` degraded reason.  Everything is /proc-based
+with graceful degradation (macOS/containers without /proc lose fd
+counts, not the RSS slope, which falls back to ``resource``).
+
+The leak heuristic is deliberately dumb and tunable: a sustained
+positive RSS slope across the whole watch window.  GC sawtooth and
+one-off allocations produce flat or spiky windows; a leak produces a
+monotone ramp.  Thresholds are env-tunable test knobs in the
+TMTPU_INGEST_STALL_S idiom.
+"""
+from __future__ import annotations
+
+import os
+import time
+from collections import deque
+from typing import Any, Optional
+
+__all__ = ["ResourceWatch", "RESWATCH", "read_rss_bytes", "count_open_fds"]
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def read_rss_bytes() -> Optional[int]:
+    """Resident set size in bytes, or None when unknowable."""
+    try:
+        with open("/proc/self/status", "rb") as f:
+            for line in f:
+                if line.startswith(b"VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    try:
+        import resource
+
+        ru = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        # linux reports KiB; darwin reports bytes
+        return ru * 1024 if ru < 1 << 40 else ru
+    except Exception:
+        return None
+
+
+def count_open_fds() -> Optional[int]:
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return None
+
+
+class ResourceWatch:
+    """Sliding window of (monotonic_t, rss_bytes) samples.
+
+    Not thread-locked: the single sampler task is the only writer, and
+    readers (health) tolerate a torn deque view — appends are atomic
+    under the GIL, same contract as the flight recorder ring.
+    """
+
+    def __init__(self) -> None:
+        self._samples: deque[tuple[float, int]] = deque(maxlen=4096)
+
+    def note_rss(self, rss_bytes: int, t: Optional[float] = None) -> None:
+        """Record one RSS sample (t defaults to time.monotonic();
+        injectable for tests)."""
+        now = time.monotonic() if t is None else t
+        self._samples.append((now, int(rss_bytes)))
+        # trim to ~2x the watch window so a long-lived node doesn't
+        # judge today's slope against yesterday's baseline
+        window = _env_float("TMTPU_RSS_LEAK_WINDOW_S", 300.0)
+        while self._samples and self._samples[0][0] < now - 2 * window:
+            self._samples.popleft()
+
+    def slope_bps(self) -> Optional[float]:
+        """Least-squares RSS slope (bytes/second) over the watch window,
+        or None when the window is not yet filled."""
+        window = _env_float("TMTPU_RSS_LEAK_WINDOW_S", 300.0)
+        samples = list(self._samples)
+        if not samples:
+            return None
+        now = samples[-1][0]
+        recent = [(t, r) for t, r in samples if t >= now - window]
+        if len(recent) < 8:
+            return None
+        span = recent[-1][0] - recent[0][0]
+        if span < 0.5 * window:
+            return None  # not enough history to call a sustained trend
+        n = len(recent)
+        mean_t = sum(t for t, _ in recent) / n
+        mean_r = sum(r for _, r in recent) / n
+        num = sum((t - mean_t) * (r - mean_r) for t, r in recent)
+        den = sum((t - mean_t) ** 2 for t, _ in recent)
+        if den == 0:
+            return None
+        return num / den
+
+    def suspected(self) -> bool:
+        """True on a sustained positive RSS slope above threshold."""
+        slope = self.slope_bps()
+        if slope is None:
+            return False
+        return slope >= _env_float("TMTPU_RSS_LEAK_BPS", 65536.0)
+
+    def snapshot(self) -> dict[str, Any]:
+        samples = list(self._samples)
+        slope = self.slope_bps()
+        return {
+            "samples": len(samples),
+            "rss_bytes": samples[-1][1] if samples else None,
+            "slope_bps": round(slope, 1) if slope is not None else None,
+            "suspected": self.suspected(),
+        }
+
+    def reset(self) -> None:
+        self._samples.clear()
+
+
+RESWATCH = ResourceWatch()

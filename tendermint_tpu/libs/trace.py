@@ -468,6 +468,11 @@ class DeviceTelemetry:
             self.queue_depth = queue_depth
             self.peak_queue_depth = max(self.peak_queue_depth, queue_depth)
             frac = self._busy_frac_locked()
+        # one event per verify call (bounded rate): the --budget report
+        # window-assigns device-busy wall time to stitched heights
+        _recorder.RECORDER.record(
+            "device", "busy", ms=round(seconds * 1e3, 3), depth=queue_depth
+        )
         dm = self._metrics
         if dm is not None:
             dm.occ_busy_seconds_total.inc(seconds)
@@ -536,6 +541,13 @@ class DeviceTelemetry:
             c["wait_s_total"] += wait_s
             c["wait_s_max"] = max(c["wait_s_max"], wait_s)
             c["queue_depth"] = depth
+        # per-dispatch queue-wait event: the collector's --budget report
+        # window-assigns these to stitched heights (same bounded rate as
+        # the ("device", "dispatch") event)
+        _recorder.RECORDER.record(
+            "device", "sched_dispatch", cls=label,
+            wait_ms=round(wait_s * 1e3, 3), depth=depth,
+        )
         dm = self._metrics
         if dm is not None:
             dm.sched_queue_wait.observe(label, wait_s)

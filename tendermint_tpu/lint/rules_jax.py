@@ -15,7 +15,17 @@ from __future__ import annotations
 
 import ast
 
-from tendermint_tpu.lint.engine import Context, FuncInfo, Rule, attr_tail, dotted_name
+from tendermint_tpu.lint.engine import (
+    _JIT_NAMES,
+    _int_elements,
+    _str_elements,
+    Context,
+    FuncInfo,
+    Rule,
+    attr_tail,
+    dotted_name,
+    jit_static_names,
+)
 
 _SHAPE_BUILDERS = {
     "arange",
@@ -189,4 +199,122 @@ class TM303RuntimeShapeInJit(Rule):
             )
 
 
-RULES = [TM301PythonBranchOnTracer, TM302HostSyncInJit, TM303RuntimeShapeInJit]
+def _scalar_literal_src(node: ast.AST) -> str | None:
+    """The source form of a Python scalar/shape literal, or None.
+
+    Matches bare int/float/bool constants, negated numbers, and tuples/
+    lists made purely of them (shape literals) — the argument kinds
+    that arrive at a jit boundary as weak-typed tracers and, the moment
+    the kernel uses them as a size or branch, either throw or mint a
+    fresh compile per distinct value."""
+    if isinstance(node, ast.Constant) and isinstance(
+        node.value, (bool, int, float)
+    ):
+        return repr(node.value)
+    if (
+        isinstance(node, ast.UnaryOp)
+        and isinstance(node.op, (ast.USub, ast.UAdd))
+        and isinstance(node.operand, ast.Constant)
+        and isinstance(node.operand.value, (int, float))
+    ):
+        sign = "-" if isinstance(node.op, ast.USub) else "+"
+        return f"{sign}{node.operand.value!r}"
+    if isinstance(node, (ast.Tuple, ast.List)):
+        parts = [_scalar_literal_src(e) for e in node.elts]
+        if parts and all(p is not None for p in parts):
+            return f"({', '.join(parts)})"
+    return None
+
+
+class TM304UnpinnedScalarToJit(Rule):
+    code = "TM304"
+    name = "unpinned-scalar-to-jit"
+    help = (
+        "A Python scalar or shape literal passed to a jitted function "
+        "as a TRACED argument becomes a weak-typed 0-d tracer: using it "
+        "as a size/branch inside the kernel throws or re-specializes "
+        "per value, and it silently widens the compile-cache key space "
+        "the bucketed-batch discipline exists to bound. Pin it via "
+        "static_argnames (trace-time constant) or pass a device array."
+    )
+
+    def visit_Module(self, ctx: Context, node: ast.Module) -> None:
+        if not ctx.config.in_jax_scope(ctx.rel_path):
+            return
+        # phase 1: jitted callables visible in this module — decorated
+        # defs, plus `g = jax.jit(f, static_argnames=...)` rebinds
+        funcs: dict[str, ast.AST] = {}
+        for n in ast.walk(node):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                funcs.setdefault(n.name, n)
+        jitted: dict[str, tuple[list[str], set[str]]] = {}
+        for n in ast.walk(node):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                static = jit_static_names(n)
+                if static is not None:
+                    params = [
+                        a.arg for a in n.args.posonlyargs + n.args.args
+                    ]
+                    jitted[n.name] = (params, static)
+            elif isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+                call = n.value
+                if dotted_name(call.func) not in _JIT_NAMES or not call.args:
+                    continue
+                inner = funcs.get(
+                    call.args[0].id
+                ) if isinstance(call.args[0], ast.Name) else None
+                if inner is None:
+                    continue
+                params = [
+                    a.arg for a in inner.args.posonlyargs + inner.args.args
+                ]
+                static = set()
+                for kw in call.keywords:
+                    if kw.arg == "static_argnames":
+                        static |= _str_elements(kw.value)
+                    elif kw.arg == "static_argnums":
+                        for i in _int_elements(kw.value):
+                            if 0 <= i < len(params):
+                                static.add(params[i])
+                for tgt in n.targets:
+                    if isinstance(tgt, ast.Name):
+                        jitted[tgt.id] = (params, static)
+        if not jitted:
+            return
+        # phase 2: call sites of those callables with scalar/shape
+        # literals bound to non-static parameters
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call):
+                continue
+            if not isinstance(call.func, ast.Name):
+                continue
+            info = jitted.get(call.func.id)
+            if info is None:
+                continue
+            params, static = info
+            bound = [
+                (params[i] if i < len(params) else None, arg)
+                for i, arg in enumerate(call.args)
+            ] + [(kw.arg, kw.value) for kw in call.keywords if kw.arg]
+            for param, arg in bound:
+                if param is None or param in static:
+                    continue
+                src = _scalar_literal_src(arg)
+                if src is not None:
+                    ctx.report(
+                        self.code,
+                        arg,
+                        f"Python scalar {src} traced into jitted "
+                        f"`{call.func.id}` via parameter `{param}` (not in "
+                        "static_argnames)",
+                        "add the parameter to static_argnames, or pass a "
+                        "device array so the cache key stays shape-only",
+                    )
+
+
+RULES = [
+    TM301PythonBranchOnTracer,
+    TM302HostSyncInJit,
+    TM303RuntimeShapeInJit,
+    TM304UnpinnedScalarToJit,
+]

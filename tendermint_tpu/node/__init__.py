@@ -482,6 +482,9 @@ class Node(BaseService):
 
             self.device_metrics = tmm.DeviceMetrics(self.metrics)
             tmtrace.DEVICE.set_metrics(self.device_metrics)
+            from tendermint_tpu.device.profiler import PROFILER
+
+            PROFILER.set_metrics(self.device_metrics)
             from tendermint_tpu.libs.sigcache import SIG_CACHE
 
             SIG_CACHE.set_metrics(self.device_metrics)
@@ -637,6 +640,9 @@ class Node(BaseService):
 
             tmtrace.DEVICE.set_metrics(None)
             RECORDER.set_metrics(None)
+            from tendermint_tpu.device.profiler import PROFILER as _prof_m
+
+            _prof_m.set_metrics(None)
             from tendermint_tpu.libs.txlife import TXLIFE as _txl_m
 
             _txl_m.set_metrics(None)
@@ -674,7 +680,18 @@ class Node(BaseService):
         height doubles as the fast-sync catch-all (blocks applied by the
         blockchain reactor bypass the consensus commit tap), and the
         fast_syncing flag flips inside the reactor."""
+        import sys as _sys
+
+        from tendermint_tpu.libs.reswatch import (
+            RESWATCH,
+            count_open_fds,
+            read_rss_bytes,
+        )
+        from tendermint_tpu.libs.sigcache import SIG_CACHE
+        from tendermint_tpu.libs.txlife import TXLIFE as _txl
+
         cm = self.consensus_metrics
+        rm = self.runtime_metrics
         while True:
             cm.height.set(self.block_store.height())
             rs = self.consensus_state.rs
@@ -682,6 +699,30 @@ class Node(BaseService):
                 cm.validators.set(rs.validators.size())
                 cm.validators_power.set(rs.validators.total_voting_power())
             cm.fast_syncing.set(1 if self.consensus_reactor.fast_sync else 0)
+            # process-resource gauges (ISSUE 17): RSS feeds the reswatch
+            # leak heuristic behind health()'s resource_leak_suspected
+            rss = read_rss_bytes()
+            if rss is not None:
+                RESWATCH.note_rss(rss)
+                rm.rss_bytes.set(rss)
+                slope = RESWATCH.slope_bps()
+                if slope is not None:
+                    rm.rss_slope_bps.set(slope)
+            fds = count_open_fds()
+            if fds is not None:
+                rm.open_fds.set(fds)
+            rm.asyncio_tasks.set(len(asyncio.all_tasks()))
+            rm.recorder_dropped.set(RECORDER.total_dropped)
+            rm.txlife_dropped.set(_txl.total_dropped)
+            rm.sigcache_size.set(SIG_CACHE.snapshot().get("entries", 0))
+            dedup = getattr(getattr(self.mempool, "cache", None), "_map", None)
+            if dedup is not None:
+                rm.mempool_cache_size.set(len(dedup))
+            # device memory watermarks: only when the ops stack already
+            # pulled jax in (never import it from the sampler)
+            prof_mod = _sys.modules.get("tendermint_tpu.device.profiler")
+            if prof_mod is not None and "jax" in _sys.modules:
+                prof_mod.PROFILER.record_memory()
             await asyncio.sleep(1.0)
 
     # convenience accessors (reference node.go getters)

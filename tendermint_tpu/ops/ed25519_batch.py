@@ -43,7 +43,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from tendermint_tpu.crypto import ed25519_math as em
+from tendermint_tpu.device import profiler as _profiler
 from tendermint_tpu.device import scheduler as _dsched
+from tendermint_tpu.device.priorities import current_priority as _current_priority
 from tendermint_tpu.libs import trace as _trace
 from tendermint_tpu.ops import curve, field
 from tendermint_tpu.ops.limbs import LIMB_BITS, NLIMB
@@ -715,6 +717,17 @@ def _verify_batch_device(pubs, msgs, sigs, n, kcache, sp) -> list[bool]:
                 if kcache._kernel_for(kcache._platform())[0] == "xla":
                     raise  # the failing kernel IS the XLA kernel
                 dev_out = verify_kernel(keys_np, sigs_np)
+        try:
+            # cumulative waste ledger (device/profiler): the priority
+            # class resolves here because _dispatch_group_inner runs
+            # under the lead request's contextvars
+            _profiler.PROFILER.record_padding(
+                int(mask.sum()), packed.shape[1],
+                cls=_current_priority().label,
+                shards=int(sharding.mesh.size) if from_sharded else 1,
+            )
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
         pending.append(
             (lo, hi, dev_out, (keys_np, sigs_np), mask, from_sharded)
         )

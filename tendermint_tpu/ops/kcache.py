@@ -29,6 +29,9 @@ import hashlib
 import os
 import threading
 
+from tendermint_tpu.device import profiler as _profiler
+
+
 def _host_tag() -> str:
     """Fingerprint of this host's CPU features. XLA:CPU AOT artifacts are
     machine-feature-specific — loading a cache written on a different host
@@ -299,6 +302,7 @@ def get_verify_fn(bucket: int):
     with _lock:
         fn = _fns.get(key)
     if fn is not None:
+        _profiler.PROFILER.record_cache_hit("ed25519_verify", "memo")
         return fn
 
     import jax
@@ -317,6 +321,9 @@ def get_verify_fn(bucket: int):
         except Exception:  # noqa: BLE001 — AOT layer is best-effort
             fn = None
         if fn is not None:
+            # deserializing a pre-baked executable is an upload, not a
+            # compile: the observatory books it as a cache hit
+            _profiler.PROFILER.record_cache_hit("ed25519_verify", "aot")
             with _lock:
                 _fns[key] = fn
             return fn
@@ -330,7 +337,14 @@ def get_verify_fn(bucket: int):
         try:
             with open(path, "rb") as f:
                 exp = jax.export.deserialize(f.read())
-            fn = lambda keys, sigs: exp.call(keys, sigs)  # noqa: E731
+            # the blob skips the trace; the first call still compiles
+            # (usually a persistent-cache hit) — wrap() times it, and
+            # the deserialize itself counts as an export-cache hit
+            _profiler.PROFILER.record_cache_hit("ed25519_verify", "export")
+            fn = _profiler.wrap(
+                "ed25519_verify_export",
+                lambda keys, sigs: exp.call(keys, sigs),  # noqa: E731
+            )
         except FileNotFoundError:
             pass
         except Exception:  # noqa: BLE001 — corrupt/stale blob: fall through
@@ -347,7 +361,10 @@ def get_verify_fn(bucket: int):
                 _spawn_warm_process([bucket])
     if fn is None:
         _, kernel = _kernel_for(platform)
-        fn = lambda keys, sigs: kernel(keys, sigs)  # noqa: E731
+        fn = _profiler.wrap(
+            "ed25519_verify",
+            lambda keys, sigs: kernel(keys, sigs),  # noqa: E731
+        )
     with _lock:
         _fns[key] = fn
     return fn

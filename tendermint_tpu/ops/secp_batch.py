@@ -28,7 +28,9 @@ import os
 import numpy as np
 
 from tendermint_tpu.crypto import secp256k1_math as sm
+from tendermint_tpu.device import profiler as _profiler
 from tendermint_tpu.device import scheduler as _dsched
+from tendermint_tpu.device.priorities import current_priority as _current_priority
 from tendermint_tpu.libs import trace as _trace
 
 NWORDS = 8
@@ -161,7 +163,9 @@ def _device_fn():
     from tendermint_tpu.ops import pallas_secp
 
     if os.environ.get("TMTPU_NO_AOT_CACHE"):
-        return pallas_secp.secp_verify_kernel
+        return _profiler.wrap("secp_verify", pallas_secp.secp_verify_kernel)
+
+    timed_kernel = _profiler.wrap("secp_verify", pallas_secp.secp_verify_kernel)
 
     def dispatch(sigs, keys):
         # per-bucket pre-baked executable (ops/aot.py) when one exists —
@@ -176,10 +180,13 @@ def _device_fn():
                 fn = aot.load_secp_fn(b)
             except Exception:  # noqa: BLE001 — AOT layer is best-effort
                 fn = None
+            if fn is not None:
+                # pre-baked executable: an upload, not a compile
+                _profiler.PROFILER.record_cache_hit("secp_verify", "aot")
             _aot_fns[b] = fn
         if fn is not None:
             return fn(sigs, keys)
-        return pallas_secp.secp_verify_kernel(sigs, keys)
+        return timed_kernel(sigs, keys)
 
     return dispatch
 
@@ -325,6 +332,7 @@ def _verify_batch_device(pubs, msgs, sigs, n, fn, mfn, sharding, kcache, sp) -> 
         import jax
 
         dev_out = None
+        from_sharded = False
         if mfn is not None:
             try:
                 keys_dev = _dev_keys.get(
@@ -336,6 +344,7 @@ def _verify_batch_device(pubs, msgs, sigs, n, fn, mfn, sharding, kcache, sp) -> 
                 # single-device path (or serial below)
                 dev_out = None
             if dev_out is not None:
+                from_sharded = True
                 # outside the dispatch try: a throwing telemetry sink
                 # must not discard the completed mesh result
                 try:
@@ -363,6 +372,16 @@ def _verify_batch_device(pubs, msgs, sigs, n, fn, mfn, sharding, kcache, sp) -> 
         if dev_out is None:
             out[lo:hi] = _serial_verify(pubs[lo:hi], msgs[lo:hi], sigs[lo:hi])
             continue
+        try:
+            # cumulative waste ledger (device/profiler); the priority
+            # class resolves under the lead request's contextvars
+            _profiler.PROFILER.record_padding(
+                int(mask.sum()), packed.shape[1],
+                cls=_current_priority().label,
+                shards=int(sharding.mesh.size) if from_sharded else 1,
+            )
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
         pending.append((lo, hi, dev_out, mask))
     # concurrent, BOUNDED fetches (the scheduler's pool): a wedged device
     # link degrades every chunk to the serial path instead of blocking

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tendermint_tpu.device import profiler as _profiler
 from tendermint_tpu.ops import ed25519_batch
 
 AXIS = "batch"
@@ -92,10 +93,13 @@ def _donate_default(mesh: Mesh) -> bool:
 
 def build_sharded_verifier(mesh: Mesh):
     """jit the verify kernel with explicit batch shardings over `mesh`."""
-    return jax.jit(
-        lambda packed: ed25519_batch.verify_core(*ed25519_batch.unpack(packed)),
-        in_shardings=(NamedSharding(mesh, _PACKED_SPEC),),
-        out_shardings=NamedSharding(mesh, P(AXIS)),
+    return _profiler.wrap(
+        f"ed25519_packed_mesh{mesh.size}",
+        jax.jit(
+            lambda packed: ed25519_batch.verify_core(*ed25519_batch.unpack(packed)),
+            in_shardings=(NamedSharding(mesh, _PACKED_SPEC),),
+            out_shardings=NamedSharding(mesh, P(AXIS)),
+        ),
     )
 
 
@@ -137,9 +141,11 @@ def build_stream_verifier(mesh: Mesh, donate: bool | None = None):
         else (),
     )
 
+    timed = _profiler.wrap(f"ed25519_stream_mesh{mesh.size}", jitted)
+
     def run(keys, sigs):
         check_divisible(int(sigs.shape[1]), mesh)
-        return jitted(keys, sigs)
+        return timed(keys, sigs)
 
     # the raw jitted program, for AOT lowering (ops/aot.py bakes exactly
     # the program the live path runs: a Mosaic kernel cannot be GSPMD-
@@ -199,9 +205,11 @@ def build_secp_stream_verifier(mesh: Mesh, donate: bool | None = None):
         else (),
     )
 
+    timed = _profiler.wrap(f"secp_stream_mesh{mesh.size}", jitted)
+
     def run(sigs, keys):
         check_divisible(int(sigs.shape[1]), mesh)
-        return jitted(sigs, keys)
+        return timed(sigs, keys)
 
     return run
 
@@ -222,4 +230,4 @@ def build_commit_verifier(mesh: Mesh):
         return ok, n_valid
 
     mapped = _shard_map(local, mesh, (_PACKED_SPEC,), (P(AXIS), P()))
-    return jax.jit(mapped)
+    return _profiler.wrap(f"ed25519_commit_mesh{mesh.size}", jax.jit(mapped))

@@ -263,6 +263,7 @@ class Environment:
         # invisible until the client times out. Bound is generous vs the
         # ms-scale flush deadline; override via TMTPU_INGEST_STALL_S.
         import os as _os
+        import sys as _sys
 
         oldest_parked = 0.0
         age_fn = getattr(self.mempool, "oldest_parked_age_s", None)
@@ -285,6 +286,19 @@ class Environment:
             degraded.append("mempool_ingest_stalled")
         if crashes:
             degraded.append("task_crashes")
+        # recompile storm (device/profiler): a burst of XLA compiles
+        # after warmup means shape churn is defeating the bucketed-batch
+        # cache — every one stalls dispatch for seconds. Lazy module
+        # lookup, same contract as _device_snapshot: if the ops stack
+        # never loaded, there is nothing to report.
+        prof_mod = _sys.modules.get("tendermint_tpu.device.profiler")
+        if prof_mod is not None and prof_mod.PROFILER.storm():
+            degraded.append("device_recompile_storm")
+        # sustained RSS growth (libs/reswatch, fed by _metrics_sampler)
+        from tendermint_tpu.libs.reswatch import RESWATCH
+
+        if RESWATCH.suspected():
+            degraded.append("resource_leak_suspected")
         return {
             "status": "degraded" if degraded else "ok",
             "degraded": degraded,
@@ -595,6 +609,16 @@ class Environment:
                 snap.setdefault("mesh", {})["plan"] = dmesh.state()
             except Exception:  # noqa: BLE001 — diagnostics must not break
                 pass
+        # device-efficiency observatory (device/profiler): compile
+        # counters, cache hits, padding waste, memory watermarks. Lazy:
+        # if nothing on this node ever touched the jit entry points the
+        # module isn't loaded and the block is simply absent.
+        prof_mod = _sys.modules.get("tendermint_tpu.device.profiler")
+        if prof_mod is not None:
+            try:
+                snap["profiler"] = prof_mod.PROFILER.snapshot()
+            except Exception:  # noqa: BLE001 — diagnostics must not break
+                pass
         # verified-signature cache (libs/sigcache — crypto-free import):
         # hit/miss/eviction counters + the commit-boundary residual proof
         from tendermint_tpu.libs.sigcache import SIG_CACHE
@@ -806,6 +830,58 @@ class Environment:
             raise RPCError(INVALID_PARAMS, str(e))
         out = {"action": action, "faults": FAULTS.snapshot()}
         out["breaker"] = self._device_snapshot()["breaker"]
+        return out
+
+    async def debug_profile(
+        self, action: str = "status", seconds: float = 10.0
+    ) -> dict:
+        """On-demand profiler capture (device/profiler.py): a bounded
+        host `cProfile` window plus a `jax.profiler` trace when the jax
+        runtime is live.  Gated on `config.p2p.test_fault_control`
+        exactly like `debug_fault` — profiling adds per-call overhead
+        and writes artifacts to disk, so it is an operator action, never
+        an always-on route.  Actions:
+
+        - `status` — capture state + recent artifact history;
+        - `start` — open a window (auto-stops after `seconds`,
+          clamped to 120 s); returns the artifact directory;
+        - `stop` — close the window now; returns the artifact paths.
+
+        The fleet collector (`tools/collector.py --capture-profile`)
+        drives this route on every node and gathers the paths.
+        """
+        cfg = self.config
+        if cfg is None or not cfg.p2p.test_fault_control:
+            raise RPCError(
+                INVALID_PARAMS,
+                "fault control disabled (config p2p.test_fault_control)",
+            )
+        import os as _os
+        import time as _time
+
+        from tendermint_tpu.device.profiler import PROFILER
+        from tendermint_tpu.libs.recorder import clock_anchor
+
+        out: dict = {"action": action}
+        try:
+            if action == "start":
+                root = getattr(cfg, "root_dir", None) or "."
+                out_dir = _os.path.join(
+                    root, "profiles", f"capture_{int(_time.time() * 1e3)}"
+                )
+                out.update(PROFILER.start_capture(out_dir, seconds=seconds))
+            elif action == "stop":
+                # stop_capture reaps the auto-stop timer thread (a short
+                # join) and dumps the pstats file — off the event loop
+                out.update(await asyncio.to_thread(PROFILER.stop_capture))
+            elif action != "status":
+                raise RPCError(INVALID_PARAMS, f"unknown action {action!r}")
+        except RuntimeError as e:
+            # double start / stop with no window: caller error, not ours
+            raise RPCError(INVALID_PARAMS, str(e))
+        out["capture"] = PROFILER.capture_state()
+        out["moniker"] = RECORDER.moniker
+        out["anchor"] = clock_anchor()
         return out
 
     # ------------------------------------------------------------------
@@ -1209,6 +1285,7 @@ class Environment:
             "debug_tx_lifecycle": self.debug_tx_lifecycle,
             "debug_p2p": self.debug_p2p,
             "debug_fault": self.debug_fault,
+            "debug_profile": self.debug_profile,
             "broadcast_tx_async": self.broadcast_tx_async,
             "broadcast_txs_async": self.broadcast_txs_async,
             "broadcast_tx_sync": self.broadcast_tx_sync,

@@ -417,6 +417,284 @@ def phase_stats(analyzed: list[dict]) -> dict:
     return {label: percentiles_ms(v) for label, v in acc.items()}
 
 
+# ------------------------------------------------------- latency budgets
+
+NORTH_STAR_MS = 5.0  # the paper's per-commit latency target
+
+# the additive budget stages, in pipeline order (docs/observability.md
+# "Latency budget report")
+BUDGET_STAGES = (
+    "gossip_wait_prevote_ms", "verify_prevote_ms",
+    "gossip_wait_precommit_ms", "verify_precommit_ms",
+    "apply_ms", "wal_fsync_ms", "commit_residual_ms",
+)
+
+
+def collect_aux_events(scrapes: list[dict],
+                       extra_events: dict[str, list[dict]] | None = None,
+                       ) -> dict:
+    """Window-assignable auxiliary events per node, on the shared wall
+    timebase: WAL fsyncs (no height field — assigned to a height's
+    window by time), state apply_block durations (height-keyed), and
+    the device plane's busy / sched_dispatch / compile taps."""
+    aux: dict = {"fsync": {}, "apply": {}, "busy": {}, "sched": {},
+                 "compile": {}}
+    for scrape in scrapes:
+        node = node_name(scrape)
+        events = normalize_events(scrape)
+        if extra_events and node in extra_events:
+            events = extra_events[node] + events
+        for e in events:
+            sub, kind = e.get("sub"), e.get("kind")
+            f = e.get("fields") or {}
+            t = e["t_wall_ns"]
+            if sub == "wal" and kind == "fsync":
+                aux["fsync"].setdefault(node, []).append(
+                    (t, float(f.get("ms", 0.0))))
+            elif sub == "state" and kind == "apply_block":
+                if f.get("height") is not None:
+                    aux["apply"].setdefault(node, {}).setdefault(
+                        int(f["height"]), float(f.get("ms", 0.0)))
+            elif sub == "device" and kind == "busy":
+                aux["busy"].setdefault(node, []).append(
+                    (t, float(f.get("ms", 0.0))))
+            elif sub == "device" and kind == "sched_dispatch":
+                aux["sched"].setdefault(node, []).append(
+                    (t, float(f.get("wait_ms", 0.0))))
+            elif sub == "device" and kind == "compile":
+                aux["compile"].setdefault(node, []).append(
+                    (t, float(f.get("ms", 0.0))))
+    return aux
+
+
+def _quorum_time(cell: dict, n_validators: int) -> int | None:
+    """Earliest wall time at which votes from a +2/3 quorum of distinct
+    validators had ARRIVED anywhere in the fleet: per validator the
+    earliest observation (gossip receipt preferred, first COUNT as
+    fallback), sorted, quorum-th taken. This is the raw-arrival bound —
+    everything between it and the maj23 tap is local verify/count
+    work, not gossip."""
+    if n_validators <= 0:
+        return None
+    arrivals = []
+    votes, recv = cell.get("votes", {}), cell.get("recv", {})
+    for val in set(votes) | set(recv):
+        ts = list((recv.get(val) or {}).values()) \
+            + list((votes.get(val) or {}).values())
+        if ts:
+            arrivals.append(min(ts))
+    need = (2 * n_validators) // 3 + 1
+    if len(arrivals) < need:
+        return None
+    return sorted(arrivals)[need - 1]
+
+
+def budget_height(h: int, entry: dict, aux: dict,
+                  n_validators: int) -> dict | None:
+    """Decompose one stitched height's wall time (first proposal
+    observation → first commit observation, fleet-wide) into additive
+    stages that sum to ~the total:
+
+        gossip_wait_prevote    proposal → prevote quorum ARRIVED
+        verify_prevote         prevote quorum arrived → maj23 COUNTED
+        gossip_wait_precommit  prevote maj23 → precommit quorum arrived
+        verify_precommit       precommit quorum arrived → maj23 counted
+        apply                  apply_block duration on the lead node
+        wal_fsync              fsync time inside the window on the lead
+        commit_residual        the rest of maj23→commit (named, never
+                               silently dropped)
+
+    plus non-additive overlays (device busy, scheduler queue wait,
+    compile time, per-tx DeliverTx spans) that run CONCURRENTLY with
+    the stages and attribute the same wall time a second way. Anchors
+    are forced monotone (running max): a missing or skew-inverted
+    anchor collapses its stage to 0 rather than going negative."""
+    prop = entry.get("proposal")
+    commits = entry.get("commit") or {}
+    if not prop or not commits:
+        return None
+    commit_round = max(c["round"] for c in commits.values())
+    rd = (entry.get("rounds") or {}).get(commit_round, {})
+    pv, pc = rd.get("prevote", {}), rd.get("precommit", {})
+
+    t_prop = prop["t_wall_ns"]
+    t_commit = min(c["t_wall_ns"] for c in commits.values())
+    raw = [
+        _quorum_time(pv, n_validators),
+        min(pv["maj23"].values()) if pv.get("maj23") else None,
+        _quorum_time(pc, n_validators),
+        min(pc["maj23"].values()) if pc.get("maj23") else None,
+        t_commit,
+    ]
+    anchors = [t_prop]
+    for t in raw:
+        anchors.append(anchors[-1] if t is None else max(anchors[-1], t))
+    t_prop, t_pv_q, t_pv_maj, t_pc_q, t_pc_maj, t_commit = anchors
+    total_ms = (t_commit - t_prop) / 1e6
+    if total_ms <= 0:
+        return None
+
+    stages = {
+        "gossip_wait_prevote_ms": round((t_pv_q - t_prop) / 1e6, 3),
+        "verify_prevote_ms": round((t_pv_maj - t_pv_q) / 1e6, 3),
+        "gossip_wait_precommit_ms": round((t_pc_q - t_pv_maj) / 1e6, 3),
+        "verify_precommit_ms": round((t_pc_maj - t_pc_q) / 1e6, 3),
+    }
+    # the commit window (precommit maj23 → commit) splits into apply +
+    # fsync + residual on the LEAD node (earliest committer — its work
+    # sits on the fleet's critical path)
+    window_ms = (t_commit - t_pc_maj) / 1e6
+    lead = min(commits, key=lambda n: commits[n]["t_wall_ns"])
+    apply_ms = min(aux["apply"].get(lead, {}).get(h, 0.0), window_ms)
+    fsync_ms = sum(m for t, m in aux["fsync"].get(lead, [])
+                   if t_prop <= t <= t_commit)
+    fsync_ms = min(fsync_ms, max(0.0, window_ms - apply_ms))
+    stages["apply_ms"] = round(apply_ms, 3)
+    stages["wal_fsync_ms"] = round(fsync_ms, 3)
+    stages["commit_residual_ms"] = round(
+        max(0.0, window_ms - apply_ms - fsync_ms), 3)
+
+    def windowed(table: dict) -> float:
+        return round(sum(
+            m for evs in table.values() for t, m in evs
+            if t_prop <= t <= t_commit
+        ), 3)
+
+    attributed = sum(stages.values())
+    dominant = max(BUDGET_STAGES, key=lambda k: stages[k])
+    return {
+        "height": h,
+        "total_ms": round(total_ms, 3),
+        "stages": stages,
+        "attribution_frac": round(min(1.0, attributed / total_ms), 4),
+        "dominant": dominant,
+        "dominant_ms": stages[dominant],
+        "lead_node": lead,
+        "overlays": {
+            "device_busy_ms": windowed(aux["busy"]),
+            "sched_queue_wait_ms": windowed(aux["sched"]),
+            "compile_ms": windowed(aux["compile"]),
+        },
+        "vs_north_star": round(total_ms / NORTH_STAR_MS, 2),
+    }
+
+
+def _deliver_spans_ms(txs: dict, h: int) -> float:
+    """Summed per-tx DeliverTx round-trip spans for txs committed at
+    height h: first `proposed` observation → last `delivered`
+    observation across the fleet (an overlay — spans overlap)."""
+    total = 0.0
+    for entry in txs.values():
+        heights = {c.get("height") for c in entry["committed"].values()}
+        if h not in heights:
+            continue
+        proposed, delivered = [], []
+        for evs in entry["stages"].values():
+            for e in evs:
+                if e["stage"] == "proposed":
+                    proposed.append(e["t_wall_ns"])
+                elif e["stage"] == "delivered":
+                    delivered.append(e["t_wall_ns"])
+        if proposed and delivered:
+            span = (max(delivered) - min(proposed)) / 1e6
+            if span > 0:
+                total += span
+    return round(total, 3)
+
+
+def budget_report(heights: dict, aux: dict, n_validators: int,
+                  txs: dict | None = None) -> dict:
+    """The per-commit latency-budget report: every stitchable height
+    decomposed (budget_height), per-stage percentiles across heights,
+    dominant-term tally, and the score against the 5 ms north star."""
+    per_height = []
+    for h, entry in sorted(heights.items()):
+        b = budget_height(h, entry, aux, n_validators)
+        if b is None:
+            continue
+        if txs:
+            b["overlays"]["deliver_tx_ms"] = _deliver_spans_ms(txs, h)
+        per_height.append(b)
+    stage_acc: dict[str, list[int]] = {}
+    totals, fracs = [], []
+    dominant_counts: dict[str, int] = {}
+    for b in per_height:
+        totals.append(int(b["total_ms"] * 1e6))
+        fracs.append(b["attribution_frac"])
+        dominant_counts[b["dominant"]] = dominant_counts.get(
+            b["dominant"], 0) + 1
+    for k in BUDGET_STAGES:
+        stage_acc[k] = [int(b["stages"][k] * 1e6) for b in per_height]
+    return {
+        "north_star_ms": NORTH_STAR_MS,
+        "n_heights": len(per_height),
+        "heights": per_height,
+        "total": percentiles_ms(totals),
+        "stages": {k: percentiles_ms(v) for k, v in stage_acc.items()},
+        "dominant_counts": dominant_counts,
+        "attribution_frac_min": round(min(fracs), 4) if fracs else 0.0,
+    }
+
+
+def budget_records(budget: dict, *, platform: str = "fleet",
+                   source: str = "collector") -> list[dict]:
+    """bench_compare-schema rows (ms gate downward-is-better; all rows
+    `gate: false` — the budget trajectory is informational, banked as
+    BUDGET_r* alongside the HEAD_r*/BASE_r* records)."""
+    if not budget or not budget["n_heights"]:
+        return []
+    rows = [{
+        "metric": "budget_height_total_ms",
+        "value": budget["total"]["p50_ms"], "unit": "ms",
+        "platform": platform, "kind": "budget", "source": source,
+        "gate": False, "n_heights": budget["n_heights"],
+    }]
+    for k in BUDGET_STAGES:
+        rows.append({
+            "metric": f"budget_{k}",
+            "value": budget["stages"][k]["p50_ms"], "unit": "ms",
+            "platform": platform, "kind": "budget", "source": source,
+            "gate": False,
+        })
+    rows.append({
+        "metric": "budget_attribution_frac",
+        "value": budget["attribution_frac_min"], "unit": "frac",
+        "platform": platform, "kind": "budget", "source": source,
+        "gate": False,
+    })
+    return rows
+
+
+def fleet_capture_profile(endpoints: list[str], seconds: float = 5.0,
+                          timeout: float = 5.0) -> dict:
+    """Drive a bounded `debug_profile` capture window on every node and
+    gather the artifact paths. The window auto-stops node-side, so if
+    the explicit stop races the timer we fall back to the status view
+    (whose history carries the artifacts)."""
+    out: dict = {}
+    for ep in endpoints:
+        ep = ep.rstrip("/")
+        try:
+            out[ep] = {"start": _get_json(
+                f"{ep}/debug_profile?action=start&seconds={seconds}", timeout)}
+        except Exception as e:  # noqa: BLE001 — per-node isolation
+            out[ep] = {"error": repr(e)}
+    time.sleep(min(float(seconds), 120.0))
+    for ep, entry in out.items():
+        if "error" in entry:
+            continue
+        try:
+            entry["stop"] = _get_json(
+                f"{ep}/debug_profile?action=stop", timeout)
+        except Exception:  # noqa: BLE001 — timer may have stopped it first
+            try:
+                entry["stop"] = _get_json(
+                    f"{ep}/debug_profile?action=status", timeout)
+            except Exception as e:  # noqa: BLE001
+                entry["error"] = repr(e)
+    return out
+
+
 # ------------------------------------------------- tx-lifecycle stitching
 
 
@@ -541,13 +819,30 @@ def device_summary(scrapes: list[dict]) -> dict:
         if dev is None:
             continue
         occ = dev.get("occupancy", {})
-        out[node_name(s)] = {
+        row = {
             "dispatches": dev.get("dispatches", 0),
             "lanes_dispatched": dev.get("lanes_dispatched", 0),
             "cpu_fallbacks": dev.get("cpu_fallbacks", 0),
             "breaker_tripped": dev.get("breaker", {}).get("tripped", False),
             "occupancy": occ,
         }
+        # device-efficiency plane (device/profiler.py, when the node has
+        # a live jax stack): compile counts, recompile-storm flag, and
+        # the cumulative wasted-lane fraction
+        prof = dev.get("profiler")
+        if prof:
+            row["profiler"] = {
+                "compiles_total": prof.get("compiles_total", 0),
+                "compiles": prof.get("compiles", {}),
+                "compile_seconds": prof.get("compile_seconds", 0.0),
+                "cache_hits": prof.get("cache_hits", {}),
+                "storm": prof.get("storm", False),
+                "wasted_lane_frac":
+                    (prof.get("waste") or {}).get("wasted_lane_frac", 0.0),
+                "memory_peak_bytes":
+                    (prof.get("memory") or {}).get("peak_bytes", {}),
+            }
+        out[node_name(s)] = row
     return out
 
 
@@ -649,10 +944,12 @@ def check_invariants(report: dict, commit_spread_s: float = 2.0) -> list[str]:
 def build_report(scrapes: list[dict],
                  extra_events: dict[str, list[dict]] | None = None,
                  commit_spread_s: float = 2.0,
-                 extra_tx_events: dict[str, list[dict]] | None = None) -> dict:
+                 extra_tx_events: dict[str, list[dict]] | None = None,
+                 budget: bool = False) -> dict:
     """The fleet report: node inventory, stitched per-height timelines,
     phase + propagation percentiles, device occupancy, stitched per-tx
-    lifecycle timelines, invariants."""
+    lifecycle timelines, invariants; with `budget` also the per-commit
+    latency-budget decomposition (`report["budget"]`)."""
     stitched = stitch(scrapes, extra_events)
     txs = stitch_txs(scrapes, extra_tx_events)
     heights, observers = stitched["heights"], stitched["observers"]
@@ -689,6 +986,7 @@ def build_report(scrapes: list[dict],
             "ready": hl.get("ready"),
             "peers": hl.get("peers"),
             "task_crashes": hl.get("task_crashes"),
+            "degraded": hl.get("degraded") or [],
             "recorder_total_dropped":
                 (s.get("debug_flight_recorder") or {}).get("total_dropped"),
             "errors": s.get("errors") or {},
@@ -709,6 +1007,9 @@ def build_report(scrapes: list[dict],
         "traces": trace_summary(scrapes),
         "txs": {"timelines": txs, **analyze_txs(txs)},
     }
+    if budget:
+        aux = collect_aux_events(scrapes, extra_events)
+        report["budget"] = budget_report(heights, aux, n_validators, txs)
     report["violations"] = check_invariants(report, commit_spread_s)
     return report
 
@@ -764,6 +1065,31 @@ def render_text(report: dict) -> str:
                 f"device[{node}]: 0 dispatches (cpu route: "
                 f"{cpu.get('sigs', 0)} sigs in {cpu.get('batches', 0)} batches)"
             )
+        prof = dev.get("profiler")
+        if prof:
+            lines.append(
+                f"  compiles={prof['compiles_total']} "
+                f"({prof['compile_seconds']:.3f}s) "
+                f"cache_hits={prof['cache_hits']} "
+                f"waste={prof['wasted_lane_frac']:.1%}"
+                f"{' RECOMPILE-STORM' if prof['storm'] else ''}"
+            )
+    budget = report.get("budget")
+    if budget and budget["n_heights"]:
+        lines.append(
+            f"latency budget ({budget['n_heights']} heights, north star "
+            f"{budget['north_star_ms']}ms): total p50="
+            f"{budget['total']['p50_ms']}ms p90={budget['total']['p90_ms']}ms, "
+            f"attribution >= {budget['attribution_frac_min']:.1%}"
+        )
+        for k in BUDGET_STAGES:
+            p = budget["stages"][k]
+            lines.append(f"  {k:<28} p50={p['p50_ms']:<9} p90={p['p90_ms']}")
+        dom = ", ".join(
+            f"{k} x{n}" for k, n in sorted(budget["dominant_counts"].items(),
+                                          key=lambda kv: -kv[1])
+        )
+        lines.append(f"  dominant terms: {dom}")
     txs = report.get("txs") or {}
     if txs.get("n"):
         prop_tx = txs["propagation_spread"]
@@ -844,7 +1170,8 @@ class FleetCollector:
         self._last_scrapes = scrapes
         return scrapes
 
-    def report(self, commit_spread_s: float = 2.0) -> dict:
+    def report(self, commit_spread_s: float = 2.0,
+               budget: bool = False) -> dict:
         # the accumulated history IS the event/trace stream; the last
         # scrape contributes the non-event surfaces (status/health/device)
         scrapes = []
@@ -878,7 +1205,7 @@ class FleetCollector:
             scrapes.append(s)
         return build_report(scrapes, extra_events=extra,
                             commit_spread_s=commit_spread_s,
-                            extra_tx_events=extra_tx)
+                            extra_tx_events=extra_tx, budget=budget)
 
 
 # ------------------------------------------------------------------- CLI
@@ -907,14 +1234,39 @@ def main(argv: list[str] | None = None) -> int:
                     help="incremental polls to take (cursor-based)")
     ap.add_argument("--poll-interval", type=float, default=1.0)
     ap.add_argument("--timeout", type=float, default=5.0)
+    ap.add_argument("--budget", action="store_true",
+                    help="add the per-commit latency-budget decomposition "
+                         "(report['budget']) scored against the 5 ms north "
+                         "star")
+    ap.add_argument("--budget-records", default=None,
+                    help="also write bench_compare-schema BUDGET rows "
+                         "(JSONL) to this path; implies --budget")
+    ap.add_argument("--capture-profile", type=float, default=None,
+                    metavar="SECONDS",
+                    help="drive a bounded debug_profile capture window on "
+                         "every node before reporting (needs fault control "
+                         "enabled node-side); artifact paths land in "
+                         "report['profile_capture']")
     args = ap.parse_args(argv)
 
     fc = FleetCollector(args.endpoints, args.metrics, args.timeout)
+    capture = None
+    if args.capture_profile:
+        capture = fleet_capture_profile(args.endpoints, args.capture_profile,
+                                        args.timeout)
     for i in range(max(1, args.poll)):
         fc.poll()
         if i + 1 < args.poll:
             time.sleep(args.poll_interval)
-    report = fc.report(commit_spread_s=args.commit_spread_s)
+    want_budget = args.budget or bool(args.budget_records)
+    report = fc.report(commit_spread_s=args.commit_spread_s,
+                       budget=want_budget)
+    if capture is not None:
+        report["profile_capture"] = capture
+    if args.budget_records:
+        with open(args.budget_records, "w", encoding="utf-8") as f:
+            for row in budget_records(report.get("budget") or {}):
+                f.write(json.dumps(row, sort_keys=True) + "\n")
     if args.json:
         with open(args.json, "w", encoding="utf-8") as f:
             json.dump(report, f, indent=1, sort_keys=True)

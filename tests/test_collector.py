@@ -786,3 +786,128 @@ class TestBenchCompare:
         )
         # ... but the collapsed rate still fails
         assert res2["regressions"] == ["ingest_x_batched_tx_per_sec"]
+
+
+# --- latency budgets (ISSUE 17 tentpole) -----------------------------------
+
+
+def _budget_scrapes(n_heights: int = 3) -> list:
+    """The standard skewed-clock fleet plus the budget's aux events:
+    WAL fsyncs (no height field — window-assigned) and device
+    busy/sched_dispatch/compile taps on node0 (the lead committer)."""
+    scrapes = _fleet_scrapes(n_heights)
+    # rebuild node0 with the extra events woven into each height window
+    ev = [(WALL0 + 1 * MS, "node", "clock_anchor",
+           {"wall_ns": WALL0 + 1 * MS, "moniker": "node0"})]
+    for h in range(1, n_heights + 1):
+        t0 = WALL0 + h * 1000 * MS
+        ev.extend(_height_events(h, t0, observer=0))
+        ev.append((t0 + 12 * MS, "device", "sched_dispatch",
+                   {"cls": "consensus", "wait_ms": 0.5, "depth": 1}))
+        ev.append((t0 + 13 * MS, "device", "busy", {"ms": 2.0, "depth": 1}))
+        ev.append((t0 + 47 * MS, "wal", "fsync", {"ms": 1.25}))
+    scrapes[0] = _node_scrape(0, ev, height=n_heights)
+    return scrapes
+
+
+class TestBudget:
+    def test_budget_decomposes_and_attributes_fully(self):
+        from tendermint_tpu.tools.collector import BUDGET_STAGES
+
+        report = build_report(_budget_scrapes(), budget=True)
+        b = report["budget"]
+        assert b["n_heights"] == 3
+        assert b["north_star_ms"] == 5.0
+        for hb in b["heights"]:
+            # monotone anchors + named residual => full attribution
+            assert hb["attribution_frac"] >= 0.95
+            assert set(hb["stages"]) == set(BUDGET_STAGES)
+            assert hb["total_ms"] == pytest.approx(50.0, abs=0.5)
+            # fixture: precommit votes arrive latest => gossip dominates
+            assert hb["dominant"] == "gossip_wait_precommit_ms"
+            assert hb["dominant_ms"] == max(hb["stages"].values())
+            assert hb["vs_north_star"] == pytest.approx(
+                hb["total_ms"] / 5.0, abs=0.01)
+            # node0 commits first (zero gossip delay) => the lead
+            assert hb["lead_node"] == "node0"
+            # lead-node apply + windowed fsync landed in the split
+            assert hb["stages"]["apply_ms"] == pytest.approx(1.0)
+            assert hb["stages"]["wal_fsync_ms"] == pytest.approx(1.25)
+            # device overlays window-assigned from node0's taps
+            assert hb["overlays"]["device_busy_ms"] == pytest.approx(2.0)
+            assert hb["overlays"]["sched_queue_wait_ms"] == pytest.approx(0.5)
+            assert hb["overlays"]["compile_ms"] == 0.0
+        assert b["dominant_counts"] == {"gossip_wait_precommit_ms": 3}
+        assert b["attribution_frac_min"] >= 0.95
+        assert b["stages"]["verify_prevote_ms"]["p50_ms"] > 0
+
+    def test_budget_absent_without_flag_and_text_rendering(self):
+        report = build_report(_budget_scrapes())
+        assert "budget" not in report
+        report = build_report(_budget_scrapes(), budget=True)
+        text = render_text(report)
+        assert "latency budget" in text
+        assert "gossip_wait_precommit_ms" in text
+        assert "dominant terms:" in text
+
+    def test_budget_records_ride_bench_compare_ungated(self, tmp_path):
+        from tendermint_tpu.tools.collector import budget_records
+
+        report = build_report(_budget_scrapes(), budget=True)
+        rows = budget_records(report["budget"])
+        metrics = {r["metric"] for r in rows}
+        assert "budget_height_total_ms" in metrics
+        assert "budget_attribution_frac" in metrics
+        assert all(r["gate"] is False for r in rows)
+        p = tmp_path / "BUDGET_test.json"
+        p.write_text("\n".join(json.dumps(r) for r in rows))
+        # self-comparison through the real gate must be clean
+        assert bench_compare.main([str(p), str(p)]) == 0
+
+    def test_budget_skips_unstitchable_heights(self):
+        # a height with commits but no proposal cannot be decomposed
+        scrapes = _fleet_scrapes(2)
+        report = build_report(scrapes, budget=True)
+        full = report["budget"]["n_heights"]
+        for s in scrapes:
+            fr = s["debug_flight_recorder"]
+            fr["events"] = [
+                e for e in fr["events"]
+                if not (e["kind"] == "proposal"
+                        and e.get("fields", {}).get("height") == 1)
+            ]
+        report = build_report(scrapes, budget=True)
+        assert report["budget"]["n_heights"] == full - 1
+        assert [hb["height"] for hb in report["budget"]["heights"]] == [2]
+
+    def test_fleet_collector_report_budget_passthrough(self):
+        from unittest import mock
+
+        from tendermint_tpu.tools import collector as col
+
+        scrapes = _budget_scrapes()
+        fc = FleetCollector([s["endpoint"] for s in scrapes])
+        with mock.patch.object(col, "scrape_fleet", return_value=scrapes):
+            fc.poll()
+        report = fc.report(budget=True)
+        assert report["budget"]["n_heights"] == 3
+        assert report["budget"]["attribution_frac_min"] >= 0.95
+
+    def test_device_summary_surfaces_profiler_plane(self):
+        scrapes = _budget_scrapes()
+        scrapes[0]["debug_device"]["profiler"] = {
+            "compiles": {"ed25519_verify": 2},
+            "compiles_total": 2,
+            "compile_seconds": 3.25,
+            "cache_hits": {"aot": 1},
+            "storm": True,
+            "waste": {"wasted_lane_frac": 0.21875},
+            "memory": {"peak_bytes": {"tpu:0": 123456}},
+        }
+        scrapes[0]["health"]["degraded"] = ["device_recompile_storm"]
+        report = build_report(scrapes)
+        prof = report["device"]["node0"]["profiler"]
+        assert prof["compiles_total"] == 2 and prof["storm"] is True
+        assert prof["wasted_lane_frac"] == 0.21875
+        assert report["nodes"][0]["degraded"] == ["device_recompile_storm"]
+        assert "RECOMPILE-STORM" in render_text(report)

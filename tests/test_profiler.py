@@ -1,0 +1,276 @@
+"""Device-efficiency observatory tests (ISSUE 17 tentpole).
+
+Everything here is crypto- and jax-free: `device/profiler.py` is
+import-light by design (stdlib + recorder), `wrap()` only needs objects
+with a `.shape`, and `libs/reswatch.py` takes injected timestamps. The
+RPC surface (`debug_profile` gating, health degradation) rides in the
+Environment tests below under importorskip("cryptography"), same
+precedent as tests/test_recorder.py's RPC surface.
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+
+import pytest
+
+from tendermint_tpu.device.profiler import DeviceProfiler, signature_of, wrap
+from tendermint_tpu.libs.recorder import RECORDER
+from tendermint_tpu.libs.reswatch import (
+    ResourceWatch,
+    count_open_fds,
+    read_rss_bytes,
+)
+
+
+class _Arr:
+    """Stand-in for a device array: shape is all wrap() looks at."""
+
+    def __init__(self, *shape: int) -> None:
+        self.shape = shape
+
+
+class TestCompileTracking:
+    def test_wrap_counts_one_compile_per_shape_signature(self):
+        prof = DeviceProfiler()
+        calls = []
+        timed = wrap("k", lambda *a: calls.append(a), profiler=prof)
+        timed(_Arr(3, 4))
+        timed(_Arr(3, 4))  # same signature: no new compile
+        timed(_Arr(5, 4))  # new leading dim: recompile
+        assert len(calls) == 3
+        snap = prof.snapshot()
+        assert snap["compiles"] == {"k": 2}
+        assert snap["compiles_total"] == 2
+        assert sorted(snap["signatures"]["k"]) == ["3x4", "5x4"]
+        assert snap["compile_seconds"] >= 0
+
+    def test_wrap_emits_recorder_event(self):
+        prof = DeviceProfiler()
+        wrap("evk", lambda x: None, profiler=prof)(_Arr(7, 2))
+        evs = [
+            e for e in RECORDER.snapshot(subsystem="device")
+            if e["kind"] == "compile" and e["fields"]["fn"] == "evk"
+        ]
+        assert evs and evs[-1]["fields"]["sig"] == "7x2"
+        assert evs[-1]["fields"]["ms"] >= 0
+
+    def test_rewrapped_builder_never_double_counts(self):
+        # secp _device_fn rebuilds its wrapper per dispatch: the
+        # profiler ledger, not the per-wrapper memo, is authoritative
+        prof = DeviceProfiler()
+        wrap("fn", lambda x: None, profiler=prof)(_Arr(8))
+        wrap("fn", lambda x: None, profiler=prof)(_Arr(8))  # fresh wrapper
+        assert prof.snapshot()["compiles"] == {"fn": 1}
+
+    def test_signature_of_mixes_shapes_and_scalars(self):
+        assert signature_of((_Arr(2, 3), 7, _Arr(4))) == "2x3|7|4"
+
+    def test_cache_hits_are_not_compiles(self):
+        prof = DeviceProfiler()
+        prof.record_cache_hit("k", "aot")
+        prof.record_cache_hit("k", "aot")
+        prof.record_cache_hit("k", "export")
+        snap = prof.snapshot()
+        assert snap["cache_hits"] == {"aot": 2, "export": 1}
+        assert snap["compiles_total"] == 0
+
+
+class TestStormDetection:
+    def test_storm_trips_after_warmup_grace(self, monkeypatch):
+        monkeypatch.setenv("TMTPU_COMPILE_STORM_N", "3")
+        monkeypatch.setenv("TMTPU_COMPILE_STORM_WINDOW_S", "60")
+        monkeypatch.setenv("TMTPU_COMPILE_STORM_GRACE_S", "0")
+        prof = DeviceProfiler()
+        assert prof.storm() is False  # no compiles at all
+        prof.record_compile("a", "1", 0.0)  # the warmup-edge compile
+        prof.record_compile("a", "2", 0.0)
+        prof.record_compile("a", "3", 0.0)
+        assert prof.storm() is False  # 2 post-grace compiles < threshold 3
+        prof.record_compile("a", "4", 0.0)
+        assert prof.storm() is True
+
+    def test_warmup_grace_absorbs_prewarm_burst(self, monkeypatch):
+        monkeypatch.setenv("TMTPU_COMPILE_STORM_N", "3")
+        monkeypatch.setenv("TMTPU_COMPILE_STORM_GRACE_S", "3600")
+        prof = DeviceProfiler()
+        for i in range(10):
+            prof.record_compile("warm", str(i), 0.0)
+        assert prof.storm() is False
+
+
+class TestPaddingAndMemory:
+    def test_padding_accounting_by_bucket_class_shards(self):
+        prof = DeviceProfiler()
+        prof.record_padding(100, 128, cls="consensus", shards=4)
+        prof.record_padding(128, 128, cls="mempool", shards=1)
+        w = prof.snapshot()["waste"]
+        assert w["by_bucket"]["128"] == {"valid": 228, "padded": 28}
+        assert w["by_class"]["consensus"] == {"valid": 100, "padded": 28}
+        assert w["by_class"]["mempool"] == {"valid": 128, "padded": 0}
+        assert w["by_shards"]["4"]["padded"] == 28
+        assert w["wasted_lane_frac"] == pytest.approx(28 / 256)
+
+    def test_metrics_mirror_and_late_attach_replay(self):
+        from tendermint_tpu.libs.metrics import Collector, DeviceMetrics
+
+        prof = DeviceProfiler()
+        prof.record_compile("k", "64", 0.25)
+        prof.record_cache_hit("k", "aot")
+        # late attach (node metrics come up after first prewarm):
+        # cumulative state must replay into the bundle
+        coll = Collector()
+        dm = DeviceMetrics(coll)
+        prof.set_metrics(dm)
+        prof.record_padding(100, 128, cls="consensus")
+        text = coll.render()
+        assert 'tendermint_device_compiles_total{fn="k"} 1' in text
+        assert 'tendermint_device_compile_cache_hits_total{kind="aot"} 1' in text
+        assert "tendermint_device_compile_seconds 0.25" in text
+        assert "tendermint_device_wasted_lane_frac" in text
+        prof.set_metrics(None)
+
+
+class TestCaptureLifecycle:
+    def test_capture_start_stop_produces_host_artifact(self, tmp_path):
+        prof = DeviceProfiler()
+        out = prof.start_capture(str(tmp_path / "cap"), seconds=30.0,
+                                 jax_trace=False)
+        assert out["dir"].endswith("cap")
+        state = prof.capture_state()
+        assert state["active"] is True
+        with pytest.raises(RuntimeError):
+            prof.start_capture(str(tmp_path / "cap2"))  # one window at a time
+        res = prof.stop_capture()
+        assert os.path.exists(os.path.join(res["dir"], "host_profile.pstats"))
+        assert res["artifacts"] and res["duration_s"] < 30.0
+        assert prof.capture_state()["active"] is False
+        assert prof.capture_state()["history"][-1]["dir"] == res["dir"]
+        with pytest.raises(RuntimeError):
+            prof.stop_capture()  # nothing active
+
+    def test_capture_auto_stops_at_bound(self, tmp_path):
+        import time
+
+        prof = DeviceProfiler()
+        prof.start_capture(str(tmp_path / "cap"), seconds=0.5,
+                           jax_trace=False)
+        deadline = time.monotonic() + 5.0
+        while prof.capture_state()["active"] and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert prof.capture_state()["active"] is False
+        assert prof.capture_state()["history"]
+
+
+class TestResourceWatch:
+    def test_readers_return_plausible_values(self):
+        assert read_rss_bytes() > 1_000_000  # a python process is >1MB
+        assert count_open_fds() > 0
+
+    def test_slope_detects_sustained_growth(self, monkeypatch):
+        monkeypatch.setenv("TMTPU_RSS_LEAK_WINDOW_S", "300")
+        monkeypatch.setenv("TMTPU_RSS_LEAK_BPS", "65536")
+        rw = ResourceWatch()
+        assert rw.slope_bps() is None  # too few samples
+        for i in range(20):
+            rw.note_rss(10_000_000 + i * 100_000 * 15, t=1000.0 + i * 15)
+        slope = rw.slope_bps()
+        assert slope == pytest.approx(100_000.0, rel=0.01)
+        assert rw.suspected() is True
+        snap = rw.snapshot()
+        assert snap["suspected"] is True and snap["samples"] == 20
+
+    def test_flat_rss_is_not_suspected(self, monkeypatch):
+        monkeypatch.setenv("TMTPU_RSS_LEAK_WINDOW_S", "300")
+        rw = ResourceWatch()
+        for i in range(20):
+            rw.note_rss(50_000_000 + (i % 2) * 1024, t=2000.0 + i * 15)
+        assert rw.suspected() is False
+
+
+class TestRPCSurface:
+    """debug_profile gating + health integration: needs the Environment
+    (rpc.core's import chain reaches the crypto stack)."""
+
+    def _environment(self):
+        pytest.importorskip("cryptography", reason="crypto stack unavailable")
+        from tendermint_tpu.rpc.core import Environment
+
+        return Environment
+
+    def test_debug_profile_gated_on_fault_control(self, tmp_path):
+        from types import SimpleNamespace
+
+        Environment = self._environment()
+        from tendermint_tpu.rpc.jsonrpc import RPCError
+
+        async def main():
+            env = Environment(consensus_state=None)
+            env.config = SimpleNamespace(
+                p2p=SimpleNamespace(test_fault_control=False),
+                root_dir=str(tmp_path),
+            )
+            with pytest.raises(RPCError):
+                await env.debug_profile(action="status")
+            env.config.p2p.test_fault_control = True
+            out = await env.debug_profile(action="status")
+            assert out["capture"]["active"] is False
+            out = await env.debug_profile(action="start", seconds=30.0)
+            assert out["capture"]["active"] is True
+            assert out["dir"].startswith(str(tmp_path))
+            out = await env.debug_profile(action="stop")
+            assert out["capture"]["active"] is False
+            assert any(a.endswith("host_profile.pstats")
+                       for a in out["artifacts"])
+            with pytest.raises(RPCError):
+                await env.debug_profile(action="stop")  # nothing active
+            with pytest.raises(RPCError):
+                await env.debug_profile(action="frobnicate")
+
+        asyncio.run(main())
+
+    def test_health_degrades_on_recompile_storm(self, monkeypatch):
+        Environment = self._environment()
+        from tendermint_tpu.device.profiler import PROFILER
+
+        monkeypatch.setenv("TMTPU_COMPILE_STORM_N", "3")
+        monkeypatch.setenv("TMTPU_COMPILE_STORM_WINDOW_S", "60")
+        monkeypatch.setenv("TMTPU_COMPILE_STORM_GRACE_S", "0")
+
+        async def main():
+            env = Environment(consensus_state=None)
+            env.crash_baseline = RECORDER.crashes
+            h = await env.health()
+            assert "device_recompile_storm" not in h["degraded"]
+            for i in range(5):
+                PROFILER.record_compile("storm_test", f"sig{i}", 0.0)
+            try:
+                h = await env.health()
+                assert h["status"] == "degraded"
+                assert "device_recompile_storm" in h["degraded"]
+            finally:
+                PROFILER.reset()
+            h = await env.health()
+            assert "device_recompile_storm" not in h["degraded"]
+
+        asyncio.run(main())
+
+    def test_health_degrades_on_rss_leak(self):
+        Environment = self._environment()
+        from tendermint_tpu.libs.reswatch import RESWATCH
+
+        async def main():
+            env = Environment(consensus_state=None)
+            env.crash_baseline = RECORDER.crashes
+            try:
+                for i in range(20):
+                    RESWATCH.note_rss(10_000_000 + i * 10_000_000,
+                                      t=5000.0 + i * 15)
+                h = await env.health()
+                assert "resource_leak_suspected" in h["degraded"]
+            finally:
+                RESWATCH.reset()
+            h = await env.health()
+            assert "resource_leak_suspected" not in h["degraded"]
+
+        asyncio.run(main())

@@ -371,6 +371,77 @@ def test_tm303_clean_on_static_or_shape_derived_sizes():
     assert codes(src, OPS) == []
 
 
+# --- TM304 unpinned-scalar-to-jit -----------------------------------------
+
+
+def test_tm304_fires_on_scalar_literal_to_jitted_def():
+    src = jit_src("""
+        @jax.jit
+        def k(x, n):
+            return x * n
+        def caller(x):
+            return k(x, 8)
+        """)
+    assert codes(src, OPS) == ["TM304"]
+
+
+def test_tm304_fires_on_shape_tuple_and_kwarg():
+    src = jit_src("""
+        @jax.jit
+        def k(x, shape, scale):
+            return x.reshape(shape) * scale
+        def caller(x):
+            return k(x, (64, 32), scale=2.0)
+        """)
+    assert codes(src, OPS) == ["TM304", "TM304"]
+
+
+def test_tm304_fires_on_jit_assignment_form():
+    src = jit_src("""
+        def f(x, n):
+            return x * n
+        g = jax.jit(f)
+        def caller(x):
+            return g(x, 3)
+        """)
+    assert codes(src, OPS) == ["TM304"]
+
+
+def test_tm304_clean_on_static_argnames_both_forms():
+    src = jit_src("""
+        @partial(jax.jit, static_argnames=("n",))
+        def k(x, n):
+            return x * n
+        def f(x, n):
+            return x * n
+        g = jax.jit(f, static_argnames=("n",))
+        h = jax.jit(f, static_argnums=(1,))
+        def caller(x):
+            return k(x, 8) + g(x, 3) + h(x, 4)
+        """)
+    assert codes(src, OPS) == []
+
+
+def test_tm304_clean_on_array_args_and_out_of_scope():
+    src = jit_src("""
+        @jax.jit
+        def k(x, y):
+            return x + y
+        def caller(x, arr):
+            return k(x, arr)  # names, not literals: shape-keyed cache
+        """)
+    assert codes(src, OPS) == []
+    # same scalar call site outside the jax-paths scope: not flagged
+    scalar = jit_src("""
+        @jax.jit
+        def k(x, n):
+            return x * n
+        def caller(x):
+            return k(x, 8)
+        """)
+    assert codes(scalar, ANY) == []
+
+
 # --- jit decorator parsing -------------------------------------------------
 
 
