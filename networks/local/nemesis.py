@@ -61,6 +61,11 @@ Scenarios (catalogue with invariants: docs/nemesis.md):
   nemesis_combined        — partition + flapping device breaker +
                             mempool flood at once; the chain keeps
                             committing and health tells the truth.
+  nemesis_deliver_mixed   — one node forced onto the serial per-tx
+                            DeliverTx path (TMTPU_DELIVER_BATCH=0)
+                            while the rest run DeliverTxBatch; both
+                            paths byte-identical: app-hash agreement,
+                            correct lane shapes, zero fallbacks.
 
 Usage:
   python -m networks.local.nemesis                 # fast scenarios
@@ -1259,6 +1264,100 @@ def scenario_statesync(net: ProcTestnet) -> None:
 scenario_statesync.self_start = True
 
 
+def scenario_deliver_mixed(net: ProcTestnet) -> None:
+    """(o) Mixed-fleet block execution: one node is forced onto the
+    serial per-tx DeliverTx path via the TMTPU_DELIVER_BATCH=0 kill
+    switch while the rest of the fleet executes blocks through the
+    single DeliverTxBatch round trip. Both paths must be byte-identical
+    — signed transfers commit on every node with app-hash agreement at
+    a shared height, the serial node's flight recorder shows per-tx
+    lanes (lanes == txs) with ZERO fallback pins (the kill switch is a
+    choice, not a failure), the batched nodes show exactly one lane per
+    block, and nothing crashes."""
+    from tendermint_tpu.abci.examples import transfer as tr
+    from tendermint_tpu.crypto import secp256k1_math as sm
+
+    def mutate(i: int, cfg: dict) -> None:
+        cfg["base"]["proxy_app"] = "transfer"
+
+    configure_nodes(net, mutate)
+    serial = net.n - 1
+    for i in range(net.n):
+        if i == serial:
+            net.start(i, env_extra={"TMTPU_DELIVER_BATCH": "0"})
+        else:
+            net.start(i)
+    net.wait_all(2)
+
+    # workload: 2 senders x 8 sequential nonces, each sender pinned to
+    # one front door so its nonce sequence admits in order
+    privs = [bytes([30 + s]) * 31 + b"\x01" for s in range(2)]
+    to = tr.address(sm.pub_from_priv(b"\x55" * 31 + b"\x01"))
+    submitted = 0
+    for nonce in range(8):
+        for s, priv in enumerate(privs):
+            tx = tr.make_tx("secp256k1", priv, to, 7, nonce)
+            res = net.rpc(
+                s % 2, f"broadcast_tx_sync?tx=0x{tx.hex()}", timeout=30.0,
+            )
+            assert res is not None and res.get("code") == 0, (nonce, res)
+            submitted += 1
+
+    # every transfer applies on EVERY node — including the serial one
+    want = str(10**9 + 7 * submitted).encode().hex()
+    deadline = time.monotonic() + 120
+    missing = set(range(net.n))
+    while missing and time.monotonic() < deadline:
+        for i in sorted(missing):
+            r = net.rpc(
+                i, f'abci_query?path="/balance"&data=0x{to.hex()}'
+            )
+            if r and r["response"].get("value") == want:
+                missing.discard(i)
+        time.sleep(0.5)
+    assert not missing, f"transfers not applied on nodes {sorted(missing)}"
+
+    # recorder truth, per execution mode: batched nodes collapse each
+    # tx-bearing block to one lane; the serial node fans out per tx with
+    # no fallback events (env choice, not a pinned failure)
+    nem = Nemesis(net)
+    for i in range(net.n):
+        events = nem.recorder_events(i, "state")
+        batches = [e for e in events if e["kind"] == "deliver_batch"]
+        assert batches, f"node{i} recorded no deliver_batch events"
+        falls = [e for e in events if e["kind"] == "deliver_batch_fallback"]
+        assert not falls, f"node{i} hit the per-tx fallback: {falls}"
+        assert sum(e["fields"]["txs"] for e in batches) == submitted, (
+            f"node{i} delivered wrong tx total"
+        )
+        if i == serial:
+            assert all(
+                e["fields"]["lanes"] == e["fields"]["txs"] for e in batches
+            ), f"serial node{i} did not fan out per tx: {batches}"
+            assert all(
+                e["fields"]["fallback"] is False for e in batches
+            ), f"kill switch mislabelled as fallback on node{i}: {batches}"
+        else:
+            assert all(e["fields"]["lanes"] == 1 for e in batches), (
+                f"batched node{i} split a block across lanes: {batches}"
+            )
+
+    # zero divergence between the two execution paths at a height every
+    # node has reached
+    h = min(net.height(i) or 1 for i in range(net.n))
+    nem.assert_agreement(h)
+    nem.assert_agreement(max(1, h - 1))
+    nem.assert_no_crashes()
+    print(
+        f"nemesis_deliver_mixed: {submitted} transfers committed on a "
+        f"mixed fleet (node{serial} serial via kill switch, rest batched), "
+        f"app-hash agreement @{h}, zero fallbacks, zero crashes"
+    )
+
+
+scenario_deliver_mixed.self_start = True
+
+
 SCENARIOS = {
     "nemesis_byzantine": scenario_byzantine,
     "nemesis_partition": scenario_partition,
@@ -1274,6 +1373,7 @@ SCENARIOS = {
     "nemesis_valset_churn": scenario_valset_churn,
     "nemesis_combined": scenario_combined,
     "nemesis_statesync": scenario_statesync,
+    "nemesis_deliver_mixed": scenario_deliver_mixed,
 }
 
 # the sub-10-minute set the CI nemesis job and tier-1 wrappers draw from

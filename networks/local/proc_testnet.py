@@ -769,7 +769,13 @@ def scenario_transfer(net: ProcTestnet) -> None:
     transfers is admitted through the batch CheckTx surface, commits on
     all nodes with balances/nonces agreeing, and the CheckTx signature
     work is VISIBLY routed through the device scheduler — debug_device
-    must show MEMPOOL_CHECK-class admissions and live batch series."""
+    must show MEMPOOL_CHECK-class admissions and live batch series.
+
+    Execution is batch-first too (DeliverTxBatch): every node must show
+    exactly one `deliver_batch` event per committed tx-bearing block
+    (lanes=1, zero per-tx fallbacks), and the app's `deliver_verify`
+    events must show the block's signature work collapsed to <=1
+    scheduler dispatch per curve."""
     from tendermint_tpu.abci.examples import transfer as tr
     from tendermint_tpu.crypto import secp256k1_math as sm
 
@@ -842,9 +848,51 @@ def scenario_transfer(net: ProcTestnet) -> None:
         text = r.read().decode()
     assert "tendermint_mempool_batched_txs_total" in text
     assert "tendermint_mempool_batch_lanes" in text
+
+    # batch-first execution (DeliverTxBatch tentpole): every node ran
+    # each tx-bearing block as ONE batch round trip — no per-tx fallback
+    # anywhere, no block split across batches — and the transfer app's
+    # per-block verification sweep took <=1 scheduler dispatch per curve
+    # (this workload is single-curve, so <=1 total per block)
+    total_batches = 0
+    for i in range(net.n):
+        fr = net.rpc(
+            i, "debug_flight_recorder?subsystem=state&n=2000", timeout=10.0
+        )
+        assert fr is not None, f"debug_flight_recorder failed on node{i}"
+        events = fr["events"]
+        falls = [e for e in events if e["kind"] == "deliver_batch_fallback"]
+        assert not falls, f"per-tx delivery fallback on node{i}: {falls}"
+        batches = [e for e in events if e["kind"] == "deliver_batch"]
+        assert batches, f"no deliver_batch events on node{i}"
+        heights = [e["fields"]["height"] for e in batches]
+        assert len(heights) == len(set(heights)), (
+            f"node{i}: a block was delivered in more than one batch: "
+            f"{sorted(heights)}"
+        )
+        for e in batches:
+            assert e["fields"]["lanes"] == 1, (i, e)
+            assert e["fields"]["fallback"] is False, (i, e)
+        assert sum(e["fields"]["txs"] for e in batches) == submitted, (
+            i, batches,
+        )
+        fra = net.rpc(
+            i, "debug_flight_recorder?subsystem=app&n=2000", timeout=10.0
+        )
+        assert fra is not None, f"debug_flight_recorder(app) failed on node{i}"
+        sweeps = [
+            e for e in fra["events"] if e["kind"] == "deliver_verify"
+        ]
+        assert sweeps, f"no deliver_verify events on node{i}"
+        for e in sweeps:
+            f = e["fields"]
+            assert f["dispatches"] <= 1, (i, e)  # <=1 per curve, 1 curve
+            assert f["cached"] + f["verified"] == f["txs"], (i, e)
+        total_batches += len(batches)
     print(
         f"transfer: {submitted} secp-signed transfers committed on all "
-        f"{net.n} nodes; MEMPOOL_CHECK admissions live on {ok_nodes} nodes"
+        f"{net.n} nodes; MEMPOOL_CHECK admissions live on {ok_nodes} nodes; "
+        f"{total_batches} single-lane delivery batches, zero fallbacks"
     )
 
 

@@ -1,7 +1,9 @@
 """abci-cli — exercise an ABCI application from the command line.
 
 Reference parity: abci/cmd/abci-cli — subcommands echo/info/deliver_tx/
-check_tx/commit/query against a running ABCI server, a batch/console mode
+check_tx/commit/query against a running ABCI server (plus this repo's
+deliver_tx_batch extension: every positional arg is one tx, answered with
+per-tx codes), a batch/console mode
 reading commands from stdin (the reference's .abci script files under
 abci/tests/test_cli/), and `kvstore`/`counter` to serve the example apps.
 
@@ -42,6 +44,16 @@ async def run_command(client: SocketClient, cmd: str, args: list[str]) -> str:
     if cmd == "deliver_tx":
         res = await client.deliver_tx(abci.RequestDeliverTx(tx=_parse_bytes(args[0]) if args else b""))
         return f"-> code: {res.code}" + (f"\n-> log: {res.log}" if res.log else "")
+    if cmd == "deliver_tx_batch":
+        res = await client.deliver_tx_batch(
+            abci.RequestDeliverTxBatch(txs=[_parse_bytes(a) for a in args])
+        )
+        out = []
+        for i, r in enumerate(res.responses):
+            out.append(
+                f"-> [{i}] code: {r.code}" + (f" log: {r.log}" if r.log else "")
+            )
+        return "\n".join(out) if out else "-> (empty batch)"
     if cmd == "check_tx":
         res = await client.check_tx(abci.RequestCheckTx(tx=_parse_bytes(args[0]) if args else b""))
         return f"-> code: {res.code}" + (f"\n-> log: {res.log}" if res.log else "")
@@ -140,8 +152,9 @@ def main(argv=None) -> int:
     p.add_argument(
         "command",
         choices=[
-            "echo", "info", "deliver_tx", "check_tx", "commit", "query",
-            "set_option", "console", "batch", "kvstore", "counter",
+            "echo", "info", "deliver_tx", "deliver_tx_batch", "check_tx",
+            "commit", "query", "set_option", "console", "batch", "kvstore",
+            "counter",
         ],
     )
     p.add_argument("args", nargs="*")

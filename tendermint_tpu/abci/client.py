@@ -42,6 +42,9 @@ class Client(BaseService):
     async def init_chain(self, req: abci.RequestInitChain) -> abci.ResponseInitChain: ...
     async def begin_block(self, req: abci.RequestBeginBlock) -> abci.ResponseBeginBlock: ...
     async def deliver_tx(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx: ...
+    async def deliver_tx_batch(
+        self, req: abci.RequestDeliverTxBatch
+    ) -> abci.ResponseDeliverTxBatch: ...
     async def end_block(self, req: abci.RequestEndBlock) -> abci.ResponseEndBlock: ...
     async def commit(self) -> abci.ResponseCommit: ...
     async def list_snapshots(
@@ -115,6 +118,17 @@ class LocalClient(Client):
 
     async def deliver_tx(self, req):
         return await self._call(self.app.deliver_tx, req)
+
+    async def deliver_tx_batch(self, req):
+        """Block execution runs OFF the event loop, same shape as
+        check_tx_batch: the app fuses the whole block's signature work
+        into one device-scheduler submission per curve, and that
+        submission BLOCKS for its verdicts. The app lock is held across
+        the thread hop, so app calls stay strictly serialized; to_thread
+        copies the contextvars, so the executor's CONSENSUS_COMMIT
+        priority scope reaches the backend."""
+        async with self._lock:
+            return await asyncio.to_thread(self.app.deliver_tx_batch, req)
 
     async def end_block(self, req):
         return await self._call(self.app.end_block, req)
@@ -277,6 +291,9 @@ class SocketClient(Client):
         return await self._send_wait(req)
 
     async def deliver_tx(self, req):
+        return await self._send_wait(req)
+
+    async def deliver_tx_batch(self, req):
         return await self._send_wait(req)
 
     async def end_block(self, req):

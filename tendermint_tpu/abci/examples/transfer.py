@@ -292,26 +292,11 @@ class TransferApplication(abci.BaseApplication):
 
     # -- delivery ------------------------------------------------------------
 
-    def deliver_tx(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx:
-        try:
-            t = decode_tx(req.tx)
-        except DecodeError as e:
-            return abci.ResponseDeliverTx(
-                code=CODE_ENCODING, codespace="transfer", log=str(e)
-            )
-        key = hashlib.sha256(req.tx).digest()
-        if key in self._checked:
-            del self._checked[key]
-        else:
-            # not admission-verified HERE (block built elsewhere): verify
-            ok = verify_sigs(
-                _CURVE_NAMES[t.curve], [t.pub], [sign_bytes_of(req.tx)], [t.sig]
-            )[0]
-            if not ok:
-                return abci.ResponseDeliverTx(
-                    code=CODE_BAD_SIGNATURE, codespace="transfer",
-                    log="signature verification failed",
-                )
+    def _apply_transfer(self, t: TransferTx, key: bytes) -> abci.ResponseDeliverTx:
+        """The stateful tail of delivery — nonce/balance checks + apply —
+        shared verbatim by deliver_tx and deliver_tx_batch so the two
+        paths cannot drift (the batch surface fuses ONLY signature
+        verification; the per-tx apply order is identical)."""
         sender = t.sender
         expected = self.nonce(sender)
         if t.nonce != expected:
@@ -339,6 +324,90 @@ class TransferApplication(abci.BaseApplication):
                 "transfer.amount": [str(t.amount)],
             },
         )
+
+    def deliver_tx(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx:
+        try:
+            t = decode_tx(req.tx)
+        except DecodeError as e:
+            return abci.ResponseDeliverTx(
+                code=CODE_ENCODING, codespace="transfer", log=str(e)
+            )
+        key = hashlib.sha256(req.tx).digest()
+        if key in self._checked:
+            del self._checked[key]
+        else:
+            # not admission-verified HERE (block built elsewhere): verify
+            ok = verify_sigs(
+                _CURVE_NAMES[t.curve], [t.pub], [sign_bytes_of(req.tx)], [t.sig]
+            )[0]
+            if not ok:
+                return abci.ResponseDeliverTx(
+                    code=CODE_BAD_SIGNATURE, codespace="transfer",
+                    log="signature verification failed",
+                )
+        return self._apply_transfer(t, key)
+
+    def deliver_tx_batch(self, req: abci.RequestDeliverTxBatch) -> abci.ResponseDeliverTxBatch:
+        """Whole-block delivery: signature work fused to ONE bulk-verify
+        call per curve, everything else per tx in block order.
+
+        CheckTx-verified txs collapse to verified-hash cache sweeps (the
+        sweep consumes the entry in block order, so a duplicate tx later
+        in the same block misses the cache and fully verifies — exactly
+        the serial path's behaviour); foreign txs (block built on another
+        node from gossip we never admitted) batch-verify in bulk through
+        the same backend ladder admission uses, here under the executor's
+        CONSENSUS_COMMIT priority scope. Responses are byte-identical to
+        per-tx deliver_tx over the same sequence (pinned by tests)."""
+        from tendermint_tpu.libs.recorder import RECORDER
+
+        out: list[abci.ResponseDeliverTx | None] = [None] * len(req.txs)
+        parsed: list[tuple[int, TransferTx]] = []
+        for i, tx in enumerate(req.txs):
+            try:
+                parsed.append((i, decode_tx(tx)))
+            except DecodeError as e:
+                out[i] = abci.ResponseDeliverTx(
+                    code=CODE_ENCODING, codespace="transfer", log=str(e)
+                )
+        keys = {i: hashlib.sha256(req.txs[i]).digest() for i, _ in parsed}
+        cached = 0
+        foreign: list[tuple[int, TransferTx]] = []
+        for i, t in parsed:
+            if keys[i] in self._checked:
+                del self._checked[keys[i]]
+                cached += 1
+            else:
+                foreign.append((i, t))
+        by_curve: dict[str, list[tuple[int, TransferTx]]] = {}
+        for i, t in foreign:
+            by_curve.setdefault(_CURVE_NAMES[t.curve], []).append((i, t))
+        for curve_name, items in by_curve.items():
+            verdicts = verify_sigs(
+                curve_name,
+                [t.pub for _, t in items],
+                [sign_bytes_of(req.txs[i]) for i, _ in items],
+                [t.sig for _, t in items],
+            )
+            for (i, _), ok in zip(items, verdicts):
+                if not ok:
+                    out[i] = abci.ResponseDeliverTx(
+                        code=CODE_BAD_SIGNATURE, codespace="transfer",
+                        log="signature verification failed",
+                    )
+        for i, t in parsed:
+            if out[i] is None:
+                out[i] = self._apply_transfer(t, keys[i])
+        # curve split + cache efficiency for the observability plane
+        # (docs/observability.md): `dispatches` pins the ≤1-scheduler-
+        # dispatch-per-curve invariant, `cached` the CheckTx-cache sweep
+        RECORDER.record(
+            "app", "deliver_verify", height=self.height + 1,
+            txs=len(req.txs), cached=cached, verified=len(foreign),
+            dispatches=len(by_curve),
+            curves={c: len(items) for c, items in by_curve.items()},
+        )
+        return abci.ResponseDeliverTxBatch(responses=out)  # type: ignore[arg-type]
 
     def commit(self) -> abci.ResponseCommit:
         self.height += 1

@@ -47,6 +47,7 @@ _METHODS = {
     "Info": abci.RequestInfo,
     "SetOption": abci.RequestSetOption,
     "DeliverTx": abci.RequestDeliverTx,
+    "DeliverTxBatch": abci.RequestDeliverTxBatch,
     "CheckTx": abci.RequestCheckTx,
     "CheckTxBatch": abci.RequestCheckTxBatch,
     "Query": abci.RequestQuery,
@@ -91,6 +92,8 @@ class GRPCApplication:
             return a.check_tx_batch(req)
         if isinstance(req, abci.RequestDeliverTx):
             return a.deliver_tx(req)
+        if isinstance(req, abci.RequestDeliverTxBatch):
+            return a.deliver_tx_batch(req)
         if isinstance(req, abci.RequestEndBlock):
             return a.end_block(req)
         if isinstance(req, abci.RequestCommit):
@@ -277,6 +280,9 @@ class GRPCClient(Client):
 
     async def deliver_tx(self, req):
         return await self._call("DeliverTx", req)
+
+    async def deliver_tx_batch(self, req):
+        return await self._call("DeliverTxBatch", req)
 
     async def end_block(self, req):
         return await self._call("EndBlock", req)

@@ -389,6 +389,11 @@ REQ_CHECK_TX_BATCH = Desc(
     [(1, "txs", "rep_bytes", None), (2, "type", "i32", None)],
 )
 REQ_DELIVER_TX = Desc("RequestDeliverTx", [(1, "tx", "bytes", None)])
+# batch execution extension (docs/tx_ingestion.md) — NOT in the reference
+# types.proto; the execution-side twin of RequestCheckTxBatch
+REQ_DELIVER_TX_BATCH = Desc(
+    "RequestDeliverTxBatch", [(1, "txs", "rep_bytes", None)]
+)
 REQ_END_BLOCK = Desc("RequestEndBlock", [(1, "height", "i64", None)])
 REQ_COMMIT = Desc("RequestCommit", [])
 REQ_LIST_SNAPSHOTS = Desc("RequestListSnapshots", [])
@@ -459,6 +464,9 @@ RESP_CHECK_TX_BATCH = Desc(
     "ResponseCheckTxBatch", [(1, "responses", "rep_msg", RESP_CHECK_TX)]
 )
 RESP_DELIVER_TX = Desc("ResponseDeliverTx", list(_TX_RESULT_FIELDS))
+RESP_DELIVER_TX_BATCH = Desc(
+    "ResponseDeliverTxBatch", [(1, "responses", "rep_msg", RESP_DELIVER_TX)]
+)
 RESP_END_BLOCK = Desc(
     "ResponseEndBlock",
     [
@@ -730,6 +738,33 @@ def _checktx_from_proto(v: dict) -> "abci.ResponseCheckTx":
     )
 
 
+def _delivertx_to_proto(o: "abci.ResponseDeliverTx") -> dict:
+    """Shared by the ResponseDeliverTx arm and each batch-response item."""
+    return {
+        "code": o.code,
+        "data": o.data,
+        "log": o.log,
+        "info": o.info,
+        "gas_wanted": o.gas_wanted,
+        "gas_used": o.gas_used,
+        "events": _events_to_proto(o.events),
+        "codespace": o.codespace,
+    }
+
+
+def _delivertx_from_proto(v: dict) -> "abci.ResponseDeliverTx":
+    return abci.ResponseDeliverTx(
+        code=v.get("code", 0),
+        data=v.get("data", b""),
+        log=v.get("log", ""),
+        info=v.get("info", ""),
+        gas_wanted=v.get("gas_wanted", 0),
+        gas_used=v.get("gas_used", 0),
+        events=_events_from_proto(v.get("events")),
+        codespace=v.get("codespace", ""),
+    )
+
+
 def _mk(cls, attrs_defaults: list[tuple[str, Any]]):
     def from_dict(v: dict):
         return cls(**{a: v.get(a, d) for a, d in attrs_defaults})
@@ -878,6 +913,17 @@ _REQ_MAP: list[tuple[int, type, Desc, Callable, Callable]] = [
         REQ_DELIVER_TX,
         lambda o: {"tx": o.tx},
         _mk(abci.RequestDeliverTx, [("tx", b"")]),
+    ),
+    # batch execution extension — oneof number 21 is past every arm the
+    # v0.34 reference schema uses (20 = CheckTxBatch), so a reference peer
+    # treats it as an unknown field (empty oneof -> exception response,
+    # clean fallback)
+    (
+        21,
+        abci.RequestDeliverTxBatch,
+        REQ_DELIVER_TX_BATCH,
+        lambda o: {"txs": list(o.txs)},
+        lambda v: abci.RequestDeliverTxBatch(txs=list(v.get("txs", []))),
     ),
     (
         11,
@@ -1044,25 +1090,17 @@ _RESP_MAP: list[tuple[int, type, Desc, Callable, Callable]] = [
         10,
         abci.ResponseDeliverTx,
         RESP_DELIVER_TX,
-        lambda o: {
-            "code": o.code,
-            "data": o.data,
-            "log": o.log,
-            "info": o.info,
-            "gas_wanted": o.gas_wanted,
-            "gas_used": o.gas_used,
-            "events": _events_to_proto(o.events),
-            "codespace": o.codespace,
-        },
-        lambda v: abci.ResponseDeliverTx(
-            code=v.get("code", 0),
-            data=v.get("data", b""),
-            log=v.get("log", ""),
-            info=v.get("info", ""),
-            gas_wanted=v.get("gas_wanted", 0),
-            gas_used=v.get("gas_used", 0),
-            events=_events_from_proto(v.get("events")),
-            codespace=v.get("codespace", ""),
+        _delivertx_to_proto,
+        _delivertx_from_proto,
+    ),
+    # batch execution extension (pairs with RequestDeliverTxBatch arm 21)
+    (
+        19,
+        abci.ResponseDeliverTxBatch,
+        RESP_DELIVER_TX_BATCH,
+        lambda o: {"responses": [_delivertx_to_proto(r) for r in o.responses]},
+        lambda v: abci.ResponseDeliverTxBatch(
+            responses=[_delivertx_from_proto(r) for r in v.get("responses", [])]
         ),
     ),
     (

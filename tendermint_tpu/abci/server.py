@@ -112,6 +112,8 @@ class ABCIServer(BaseService):
             return a.check_tx_batch(req)
         if isinstance(req, abci.RequestDeliverTx):
             return a.deliver_tx(req)
+        if isinstance(req, abci.RequestDeliverTxBatch):
+            return a.deliver_tx_batch(req)
         if isinstance(req, abci.RequestEndBlock):
             return a.end_block(req)
         if isinstance(req, abci.RequestCommit):

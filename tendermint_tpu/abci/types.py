@@ -224,6 +224,19 @@ class RequestDeliverTx:
 
 
 @dataclass
+class RequestDeliverTxBatch:
+    """Batch execution (docs/tx_ingestion.md): one round trip carries the
+    whole decided block so the app can fuse per-tx signature work into a
+    single device-scheduler submission per curve. NOT in the reference
+    protocol — the execution-side twin of RequestCheckTxBatch; the block
+    executor falls back to per-tx DeliverTx (loudly) when the app side
+    errors on it (reference Go apps answer the unknown oneof arm with an
+    exception response, so the probe degrades cleanly)."""
+
+    txs: list[bytes] = field(default_factory=list)
+
+
+@dataclass
 class RequestEndBlock:
     height: int = 0
 
@@ -389,6 +402,13 @@ class ResponseDeliverTx:
 
 
 @dataclass
+class ResponseDeliverTxBatch:
+    """One ResponseDeliverTx per RequestDeliverTxBatch.txs entry, in order."""
+
+    responses: list[ResponseDeliverTx] = field(default_factory=list)
+
+
+@dataclass
 class ResponseEndBlock:
     validator_updates: list[ValidatorUpdate] = field(default_factory=list)
     consensus_param_updates: bytes = b""
@@ -457,6 +477,8 @@ class Application:
 
     def deliver_tx(self, req: RequestDeliverTx) -> ResponseDeliverTx: ...
 
+    def deliver_tx_batch(self, req: RequestDeliverTxBatch) -> ResponseDeliverTxBatch: ...
+
     def end_block(self, req: RequestEndBlock) -> ResponseEndBlock: ...
 
     def commit(self) -> ResponseCommit: ...
@@ -512,6 +534,16 @@ class BaseApplication(Application):
     def deliver_tx(self, req: RequestDeliverTx) -> ResponseDeliverTx:
         return ResponseDeliverTx(code=CODE_TYPE_OK)
 
+    def deliver_tx_batch(self, req: RequestDeliverTxBatch) -> ResponseDeliverTxBatch:
+        """Default: per-tx loop through deliver_tx — apps without batchable
+        work inherit correct (if unfused) block execution for free. Apps
+        with bulk signature verification override this (examples/
+        transfer.py) to verify the whole block in one backend call per
+        curve."""
+        return ResponseDeliverTxBatch(
+            responses=[self.deliver_tx(RequestDeliverTx(tx)) for tx in req.txs]
+        )
+
     def end_block(self, req: RequestEndBlock) -> ResponseEndBlock:
         return ResponseEndBlock()
 
@@ -555,6 +587,7 @@ _REQ_TAGS: list[tuple[int, type]] = [
     (14, RequestLoadSnapshotChunk),
     (15, RequestApplySnapshotChunk),
     (16, RequestCheckTxBatch),
+    (17, RequestDeliverTxBatch),
 ]
 _RESP_TAGS: list[tuple[int, type]] = [
     (1, ResponseEcho),
@@ -574,6 +607,7 @@ _RESP_TAGS: list[tuple[int, type]] = [
     (15, ResponseLoadSnapshotChunk),
     (16, ResponseApplySnapshotChunk),
     (17, ResponseCheckTxBatch),
+    (18, ResponseDeliverTxBatch),
 ]
 
 
@@ -606,10 +640,11 @@ def _encode_msg(msg) -> bytes:
                     w.u64(item)
                 elif isinstance(item, str):  # e.g. reject_senders
                     w.str(item)
-                elif isinstance(item, ResponseCheckTx):
+                elif isinstance(item, (ResponseCheckTx, ResponseDeliverTx)):
                     # nested message: length-prefixed recursive encoding
                     # (covers every field incl. info/codespace, unlike the
-                    # legacy ResponseCheckTx.encode wire shape)
+                    # legacy ResponseCheckTx/ResponseDeliverTx.encode wire
+                    # shape)
                     w.bytes(_encode_msg(item))
                 else:  # merkle.ProofOp
                     from tendermint_tpu.crypto.merkle import ProofOp
@@ -642,6 +677,10 @@ def _decode_msg(cls, data: bytes):
         elif "list[ResponseCheckTx]" in str(f.type):
             kwargs[f.name] = [
                 _decode_msg(ResponseCheckTx, r.bytes()) for _ in range(r.u32())
+            ]
+        elif "list[ResponseDeliverTx]" in str(f.type):
+            kwargs[f.name] = [
+                _decode_msg(ResponseDeliverTx, r.bytes()) for _ in range(r.u32())
             ]
         elif "list[Snapshot]" in str(f.type):
             kwargs[f.name] = [Snapshot.read(r) for _ in range(r.u32())]

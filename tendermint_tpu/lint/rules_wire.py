@@ -91,6 +91,11 @@ TM602_ALIASES = {
     ("RequestBeginBlock", "last_commit_info"): "last_commit_votes",
     ("RequestCheckTx", "type"): "new_check",
     ("RequestCheckTxBatch", "type"): "new_check",
+    # RequestDeliverTxBatch / ResponseDeliverTxBatch (batch execution,
+    # oneof arms 21/19): attrs match by name (`txs` / `responses`), so no
+    # alias row is needed — the field cross-check and the oneof-arm
+    # uniqueness checks still cover the pair (a regression fixture in
+    # tests/test_tmlint_program.py pins dup-number drift on it).
     ("ResponseQuery", "proof"): "proof_ops",
     ("VoteInfo", "validator"): ("address", "power"),
 }

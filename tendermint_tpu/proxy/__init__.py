@@ -128,6 +128,18 @@ class AppConnConsensus:
     def deliver_tx_async(self, tx: bytes) -> asyncio.Future:
         return self._client.deliver_tx_async(abci.RequestDeliverTx(tx))
 
+    async def deliver_tx_batch(self, txs: list[bytes]) -> list[abci.ResponseDeliverTx]:
+        """One round trip for a whole decided block (docs/tx_ingestion.md).
+        Raises whatever the transport raises — the block executor owns the
+        loud per-tx fallback for apps that don't implement the batch arm."""
+        res = await self._client.deliver_tx_batch(abci.RequestDeliverTxBatch(txs))
+        if len(res.responses) != len(txs):
+            raise abci_client.ABCIClientError(
+                f"DeliverTxBatch returned {len(res.responses)} responses "
+                f"for {len(txs)} txs"
+            )
+        return res.responses
+
     async def end_block(self, req: abci.RequestEndBlock) -> abci.ResponseEndBlock:
         return await self._client.end_block(req)
 

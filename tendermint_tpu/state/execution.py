@@ -10,9 +10,13 @@ durability boundaries as the reference (execution.go:131,136,167,173).
 """
 from __future__ import annotations
 
+import os
+
 from tendermint_tpu import proxy
 from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.client import ABCIClientError
 from tendermint_tpu import crypto
+from tendermint_tpu.device.priorities import Priority, priority_scope
 from tendermint_tpu.libs import fail
 from tendermint_tpu.libs.log import NOP, Logger
 from tendermint_tpu.libs.recorder import RECORDER
@@ -49,6 +53,14 @@ class BlockExecutor:
         self.metrics = None  # optional StateMetrics
         self.event_bus = event_bus
         self.logger = logger
+        # Batch-first delivery (docs/tx_ingestion.md): one DeliverTxBatch
+        # round trip per block so the app can fuse the block's signature
+        # work into one scheduler dispatch per curve. TMTPU_DELIVER_BATCH=0
+        # is the kill switch (forced-serial node in a mixed fleet); the
+        # flag also pins to False after the first app-side batch failure
+        # so reference-built apps pay the probe exactly once.
+        self._deliver_batch = os.environ.get("TMTPU_DELIVER_BATCH", "1") != "0"
+        self._deliver_batch_pinned = False  # True once fallback pinned
 
     # -- proposal creation (reference execution.go:84) ----------------------
 
@@ -153,26 +165,71 @@ class BlockExecutor:
                 block.hash(), block.header.encode(), commit_votes, byz
             )
         )
-        futs = [self.app.deliver_tx_async(tx) for tx in block.data.txs]
-        await self.app.flush()
-        deliver_resps = []
-        invalid = 0
-        for fut in futs:
-            resp = await fut
-            if not resp.is_ok:
-                invalid += 1
-            deliver_resps.append(resp)
-        if TXLIFE.enabled:
-            # one tap after the whole flush: futs are index-aligned with
-            # block.data.txs, and the ROADMAP-1 question is where the
-            # serial DeliverTx LOOP ends, not per-tx app latency
-            for tx, resp in zip(block.data.txs, deliver_resps):
-                TXLIFE.stage("delivered", tx_hash(tx),
-                             height=block.header.height, ok=resp.is_ok)
+        deliver_resps = await self._deliver_block_txs(block)
+        invalid = sum(1 for resp in deliver_resps if not resp.is_ok)
         if invalid:
             self.logger.info("invalid txs in block", count=invalid)
         end_resp = await self.app.end_block(abci.RequestEndBlock(block.header.height))
         return ABCIResponses(deliver_resps, end_resp, begin_resp)
+
+    async def _deliver_block_txs(self, block: Block) -> list[abci.ResponseDeliverTx]:
+        """Batch-first block delivery: ONE DeliverTxBatch round trip per
+        block so the app can fuse the whole block's signature work into a
+        single scheduler dispatch per curve (docs/tx_ingestion.md). The
+        serial pipelined loop survives as the loud fallback for
+        reference-built apps without the batch arm (pinned after the first
+        failure) and as the TMTPU_DELIVER_BATCH=0 kill-switch path; both
+        paths produce byte-identical responses — the batch arm fuses only
+        signature verification, never per-tx apply order."""
+        import time as _time
+
+        txs = block.data.txs
+        if not txs:
+            return []
+        height = block.header.height
+        _t0 = _time.monotonic()
+        deliver_resps: list[abci.ResponseDeliverTx] | None = None
+        if self._deliver_batch:
+            try:
+                # explicit tag (the contextvar default is already
+                # CONSENSUS_COMMIT, but block execution must never inherit
+                # a narrower scope from its caller); LocalClient's
+                # to_thread copies the context into the app thread
+                with priority_scope(Priority.CONSENSUS_COMMIT):
+                    deliver_resps = await self.app.deliver_tx_batch(list(txs))
+            except (ABCIClientError, NotImplementedError, AttributeError) as e:
+                # loud fallback, pinned: a reference-built app answers the
+                # unknown batch arm with an exception response exactly once
+                self._deliver_batch = False
+                self._deliver_batch_pinned = True
+                self.logger.error(
+                    "DeliverTxBatch unsupported by app; "
+                    "pinned to per-tx DeliverTx",
+                    height=height, err=repr(e),
+                )
+                RECORDER.record(
+                    "state", "deliver_batch_fallback", height=height,
+                    txs=len(txs), err=repr(e),
+                )
+        lanes = 1 if deliver_resps is not None else len(txs)
+        if deliver_resps is None:
+            futs = [self.app.deliver_tx_async(tx) for tx in txs]
+            await self.app.flush()
+            deliver_resps = [await fut for fut in futs]
+        RECORDER.record(
+            "state", "deliver_batch", height=height, txs=len(txs),
+            lanes=lanes, fallback=self._deliver_batch_pinned,
+            ms=round((_time.monotonic() - _t0) * 1e3, 1),
+        )
+        if TXLIFE.enabled:
+            # one tap at the batch boundary: responses are index-aligned
+            # with block.data.txs; `batch` is how many txs shared the ABCI
+            # round trip (the whole block batched, 1 on the serial path)
+            batch_size = len(txs) if lanes == 1 else 1
+            for tx, resp in zip(txs, deliver_resps):
+                TXLIFE.stage("delivered", tx_hash(tx), height=height,
+                             ok=resp.is_ok, batch=batch_size)
+        return deliver_resps
 
     def _last_commit_info(self, state: State, block: Block) -> list[abci.VoteInfo]:
         votes: list[abci.VoteInfo] = []

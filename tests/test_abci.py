@@ -47,6 +47,8 @@ class TestWireCodec:
             abci.RequestCheckTxBatch([b"t1", b"", b"t3"], False),
             abci.RequestCheckTxBatch([]),
             abci.RequestDeliverTx(b"tx2"),
+            abci.RequestDeliverTxBatch([b"t1", b"", b"t3"]),
+            abci.RequestDeliverTxBatch([]),
             abci.RequestEndBlock(9),
             abci.RequestCommit(),
         ]
@@ -71,6 +73,17 @@ class TestWireCodec:
             ),
             abci.ResponseCheckTxBatch([]),
             abci.ResponseDeliverTx(code=0, data=b"result"),
+            abci.ResponseDeliverTxBatch(
+                [
+                    abci.ResponseDeliverTx(
+                        code=0, gas_used=1, events={"transfer.from": ["aa"]}
+                    ),
+                    abci.ResponseDeliverTx(
+                        code=3, log="bad nonce", codespace="transfer"
+                    ),
+                ]
+            ),
+            abci.ResponseDeliverTxBatch([]),
             abci.ResponseEndBlock([abci.ValidatorUpdate(b"pk", 7)], b"", {}),
             abci.ResponseCommit(b"apphash"),
             abci.ResponseException("boom"),
@@ -188,6 +201,101 @@ class TestSocketClientServer:
                 await client.stop()
             finally:
                 await server.stop()
+
+        run(main())
+
+
+class TestDeliverBatchTransports:
+    """DeliverTxBatch round-trips on the CBE socket, the proto socket,
+    and gRPC — the execution twin of the CheckTxBatch transport matrix
+    (tests/test_tx_ingestion.py::TestBatchSurfaceTransports)."""
+
+    class RecordingApp(abci.BaseApplication):
+        """deliver_tx verdict by suffix: ...bad -> code 1; records shape."""
+
+        def __init__(self) -> None:
+            self.calls: list[tuple[str, int]] = []
+
+        def deliver_tx(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx:
+            self.calls.append(("single", 1))
+            return abci.ResponseDeliverTx(
+                code=1 if req.tx.endswith(b"bad") else 0, data=req.tx
+            )
+
+        def deliver_tx_batch(
+            self, req: abci.RequestDeliverTxBatch
+        ) -> abci.ResponseDeliverTxBatch:
+            self.calls.append(("batch", len(req.txs)))
+            return abci.ResponseDeliverTxBatch(
+                responses=[
+                    abci.ResponseDeliverTx(
+                        code=1 if t.endswith(b"bad") else 0, data=t
+                    )
+                    for t in req.txs
+                ]
+            )
+
+    @pytest.mark.parametrize("codec", ["cbe", "proto"])
+    def test_socket_roundtrip(self, codec):
+        async def main():
+            app = self.RecordingApp()
+            server = ABCIServer(app, "tcp://127.0.0.1:0", codec=codec)
+            await server.start()
+            client = SocketClient(f"tcp://127.0.0.1:{server.port}", codec=codec)
+            await client.start()
+            try:
+                resp = await client.deliver_tx_batch(
+                    abci.RequestDeliverTxBatch([b"ok1", b"xbad", b"ok2"])
+                )
+                assert [r.code for r in resp.responses] == [0, 1, 0]
+                assert [r.data for r in resp.responses] == [b"ok1", b"xbad", b"ok2"]
+                assert app.calls == [("batch", 3)]
+                resp = await client.deliver_tx_batch(
+                    abci.RequestDeliverTxBatch([])
+                )
+                assert resp.responses == []
+            finally:
+                await client.stop()
+                await server.stop()
+
+        run(main())
+
+    def test_grpc_roundtrip(self):
+        pytest.importorskip("grpc")
+        from tendermint_tpu.abci.grpc import GRPCABCIServer, GRPCClient
+
+        async def main():
+            app = self.RecordingApp()
+            server = GRPCABCIServer(app, "127.0.0.1:0")
+            await server.start()
+            client = GRPCClient(f"127.0.0.1:{server.port}")
+            await client.start()
+            try:
+                resp = await client.deliver_tx_batch(
+                    abci.RequestDeliverTxBatch([b"ok1", b"xbad"])
+                )
+                assert [r.code for r in resp.responses] == [0, 1]
+                assert app.calls == [("batch", 2)]
+            finally:
+                await client.stop()
+                await server.stop()
+
+        run(main())
+
+    def test_proxy_consensus_conn(self):
+        """AppConnConsensus.deliver_tx_batch: one round trip, responses
+        index-aligned with the txs it was handed."""
+
+        async def main():
+            app = self.RecordingApp()
+            conns = proxy.AppConns(proxy.LocalClientCreator(app))
+            await conns.start()
+            try:
+                resps = await conns.consensus.deliver_tx_batch([b"a", b"zbad"])
+                assert [r.code for r in resps] == [0, 1]
+                assert app.calls == [("batch", 2)]
+            finally:
+                await conns.stop()
 
         run(main())
 

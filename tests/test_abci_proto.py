@@ -216,6 +216,44 @@ class TestOracleInterop:
         msg.ParseFromString(data)
         assert msg.WhichOneof("value") is None
 
+    def test_deliver_batch_arm_unknown_to_reference_peer(self, oracle):
+        """Same probe for the execution twin: DeliverTxBatch rides oneof
+        arms 21/19 — a reference-built peer parses the frame with NO arm
+        set and answers with an exception response, which is exactly what
+        trips the block executor's loud per-tx fallback."""
+        data = pb.encode_request(abci.RequestDeliverTxBatch([b"a", b"b"]))
+        msg = oracle.Request()
+        msg.ParseFromString(data)
+        assert msg.WhichOneof("value") is None
+        rdata = pb.encode_response(
+            abci.ResponseDeliverTxBatch([abci.ResponseDeliverTx(code=0)])
+        )
+        rmsg = oracle.Response()
+        rmsg.ParseFromString(rdata)
+        assert rmsg.WhichOneof("value") is None
+
+    def test_deliver_batch_self_roundtrip(self):
+        """Our proto codec round-trips the batch-execution pair (the
+        oracle can't — its schema predates the extension arms)."""
+        req = abci.RequestDeliverTxBatch([b"t1", b"", b"t3"])
+        assert pb.decode_request(pb.encode_request(req)) == req
+        assert pb.decode_request(
+            pb.encode_request(abci.RequestDeliverTxBatch([]))
+        ) == abci.RequestDeliverTxBatch([])
+        resp = abci.ResponseDeliverTxBatch(
+            [
+                abci.ResponseDeliverTx(
+                    code=0, data=b"d", gas_used=2,
+                    events={"transfer.to": ["bb"]},
+                ),
+                abci.ResponseDeliverTx(code=3, log="bad", codespace="transfer"),
+            ]
+        )
+        assert pb.decode_response(pb.encode_response(resp)) == resp
+        assert pb.decode_response(
+            pb.encode_response(abci.ResponseDeliverTxBatch([]))
+        ) == abci.ResponseDeliverTxBatch([])
+
     def test_query_response_with_proof(self, oracle):
         from tendermint_tpu.crypto.merkle import ProofOp
 

@@ -1,0 +1,206 @@
+"""Batch-first block delivery unit tests (docs/tx_ingestion.md).
+
+Crypto-free twin of test_state.py::TestDeliverTxBatchExecution: drives
+BlockExecutor._deliver_block_txs with a stub block so the batch/serial/
+fallback seam is covered without the signed-commit machinery (which
+needs the `cryptography` package this tier can run without).
+"""
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu import proxy
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.examples import KVStoreApplication
+from tendermint_tpu.libs.db import MemDB
+from tendermint_tpu.libs.recorder import RECORDER
+from tendermint_tpu.state import StateStore
+from tendermint_tpu.state.execution import BlockExecutor
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class _Header:
+    def __init__(self, height):
+        self.height = height
+
+
+class _Data:
+    def __init__(self, txs):
+        self.txs = txs
+
+
+class _Block:
+    def __init__(self, height, txs):
+        self.header = _Header(height)
+        self.data = _Data(txs)
+
+
+class CountingApp(KVStoreApplication):
+    def __init__(self):
+        super().__init__()
+        self.batch_calls = 0
+        self.single_calls = 0
+
+    def deliver_tx(self, req):
+        self.single_calls += 1
+        return super().deliver_tx(req)
+
+    def deliver_tx_batch(self, req):
+        self.batch_calls += 1
+        return super().deliver_tx_batch(req)
+
+
+class RefusingApp(CountingApp):
+    """A reference-built app: the batch arm always errors."""
+
+    def deliver_tx_batch(self, req):
+        self.batch_calls += 1
+        raise NotImplementedError("unknown DeliverTxBatch arm")
+
+
+async def _executor(app):
+    conns = proxy.AppConns(proxy.LocalClientCreator(app))
+    await conns.start()
+    return BlockExecutor(StateStore(MemDB()), conns.consensus), conns
+
+
+class TestDeliverBlockTxs:
+    def test_batch_path_one_call_and_parity(self):
+        async def main():
+            txs = [f"k{i}=v{i}".encode() for i in range(6)]
+            app = CountingApp()
+            ex, conns = await _executor(app)
+            seq0 = RECORDER.total
+            resps = await ex._deliver_block_txs(_Block(1, txs))
+            await conns.stop()
+            assert app.batch_calls == 1
+            assert [r.code for r in resps] == [0] * 6
+            # serial reference run on a fresh app: responses identical
+            s_app = CountingApp()
+            serial = [s_app.deliver_tx(abci.RequestDeliverTx(t)) for t in txs]
+            assert resps == serial
+            ev = [
+                e for e in RECORDER.snapshot(subsystem="state", since_seq=seq0)
+                if e["kind"] == "deliver_batch"
+            ]
+            assert len(ev) == 1
+            assert ev[0]["fields"]["lanes"] == 1
+            assert ev[0]["fields"]["txs"] == 6
+            assert ev[0]["fields"]["fallback"] is False
+
+        run(main())
+
+    def test_empty_block_skips_round_trip(self):
+        async def main():
+            app = CountingApp()
+            ex, conns = await _executor(app)
+            seq0 = RECORDER.total
+            assert await ex._deliver_block_txs(_Block(1, [])) == []
+            await conns.stop()
+            assert app.batch_calls == 0 and app.single_calls == 0
+            assert not [
+                e for e in RECORDER.snapshot(subsystem="state", since_seq=seq0)
+                if e["kind"] == "deliver_batch"
+            ]
+
+        run(main())
+
+    def test_kill_switch_forces_serial(self, monkeypatch):
+        monkeypatch.setenv("TMTPU_DELIVER_BATCH", "0")
+
+        async def main():
+            app = CountingApp()
+            ex, conns = await _executor(app)
+            seq0 = RECORDER.total
+            resps = await ex._deliver_block_txs(_Block(1, [b"a=1", b"b=2"]))
+            await conns.stop()
+            assert app.batch_calls == 0
+            assert app.single_calls == 2
+            assert all(r.is_ok for r in resps)
+            ev = [
+                e for e in RECORDER.snapshot(subsystem="state", since_seq=seq0)
+                if e["kind"] == "deliver_batch"
+            ]
+            # still observable (mixed-fleet accounting), but serial lanes
+            # and NO fallback flag: the kill switch is config, not failure
+            assert ev[0]["fields"]["lanes"] == 2
+            assert ev[0]["fields"]["fallback"] is False
+
+        run(main())
+
+    def test_fallback_pins_after_first_failure(self):
+        async def main():
+            app = RefusingApp()
+            ex, conns = await _executor(app)
+            seq0 = RECORDER.total
+            r1 = await ex._deliver_block_txs(_Block(1, [b"a=1", b"b=2"]))
+            r2 = await ex._deliver_block_txs(_Block(2, [b"c=3"]))
+            await conns.stop()
+            assert app.batch_calls == 1  # probe paid exactly once
+            assert app.single_calls == 3
+            assert all(r.is_ok for r in r1 + r2)
+            events = RECORDER.snapshot(subsystem="state", since_seq=seq0)
+            falls = [e for e in events if e["kind"] == "deliver_batch_fallback"]
+            assert len(falls) == 1
+            assert falls[0]["fields"]["height"] == 1
+            assert "NotImplementedError" in falls[0]["fields"]["err"]
+            batched = [e for e in events if e["kind"] == "deliver_batch"]
+            assert [e["fields"]["lanes"] for e in batched] == [2, 1]
+            assert all(e["fields"]["fallback"] for e in batched)
+
+        run(main())
+
+    def test_count_mismatch_rejected_at_proxy(self):
+        from tendermint_tpu.abci.client import ABCIClientError
+
+        class ShortApp(KVStoreApplication):
+            def deliver_tx_batch(self, req):
+                return abci.ResponseDeliverTxBatch(
+                    responses=[abci.ResponseDeliverTx(code=0)]
+                )
+
+        async def main():
+            conns = proxy.AppConns(proxy.LocalClientCreator(ShortApp()))
+            await conns.start()
+            try:
+                with pytest.raises(ABCIClientError, match="2 txs"):
+                    await conns.consensus.deliver_tx_batch([b"a=1", b"b=2"])
+            finally:
+                await conns.stop()
+
+        run(main())
+
+    def test_count_mismatch_trips_executor_fallback(self):
+        class ShortApp(CountingApp):
+            def deliver_tx_batch(self, req):
+                self.batch_calls += 1
+                return abci.ResponseDeliverTxBatch(
+                    responses=[abci.ResponseDeliverTx(code=0)]
+                )
+
+        async def main():
+            app = ShortApp()
+            ex, conns = await _executor(app)
+            resps = await ex._deliver_block_txs(_Block(1, [b"a=1", b"b=2", b"c=3"]))
+            await conns.stop()
+            assert app.batch_calls == 1  # pinned after the rejection
+            assert app.single_calls == 3  # every tx re-delivered serially
+            assert [r.code for r in resps] == [0, 0, 0]
+            assert ex._deliver_batch is False and ex._deliver_batch_pinned
+
+        run(main())
+
+    def test_base_application_default_fans_out(self):
+        """Apps that never heard of the batch arm but subclass
+        BaseApplication get the per-tx default — no fallback needed."""
+        app = KVStoreApplication()
+        resp = app.deliver_tx_batch(
+            abci.RequestDeliverTxBatch([b"x=1", b"noequals", b"y=2"])
+        )
+        assert [r.code for r in resp.responses] == [0, 0, 0]
+        assert app.deliver_tx_batch(abci.RequestDeliverTxBatch([])).responses == []

@@ -226,3 +226,160 @@ class TestTxIndexer:
         hx = sum_sha256(b"tx-a").hex()
         hits3 = idx.search(Query.parse(f"tx.hash='{hx}'"))
         assert [h.tx for h in hits3] == [b"tx-a"]
+
+class CountingKVStore(KVStoreApplication):
+    """Records how block delivery reached the app (batch vs serial)."""
+
+    def __init__(self):
+        super().__init__()
+        self.batch_calls = 0
+        self.single_calls = 0
+
+    def deliver_tx(self, req):
+        self.single_calls += 1
+        return super().deliver_tx(req)
+
+    def deliver_tx_batch(self, req):
+        self.batch_calls += 1
+        return super().deliver_tx_batch(req)
+
+
+class RefusingBatchApp(KVStoreApplication):
+    """A reference-built app: the batch arm always errors."""
+
+    def __init__(self):
+        super().__init__()
+        self.batch_attempts = 0
+
+    def deliver_tx_batch(self, req):
+        self.batch_attempts += 1
+        raise NotImplementedError("unknown DeliverTxBatch arm")
+
+
+class TestDeliverTxBatchExecution:
+    """Batch-first block delivery (docs/tx_ingestion.md): one
+    DeliverTxBatch round trip per block, byte-identical to the serial
+    path, with a loud pinned fallback for reference-built apps."""
+
+    def test_one_batch_call_per_block(self):
+        from tendermint_tpu.libs.recorder import RECORDER
+
+        async def main():
+            app = CountingKVStore()
+            seq0 = RECORDER.total
+            await make_chain(3, app, txs_per_block=4)
+            assert app.batch_calls == 3  # exactly one per block
+            # the BaseApplication default fans out per tx INSIDE the app;
+            # those are not extra ABCI round trips
+            assert app.single_calls == 12
+            events = RECORDER.snapshot(subsystem="state", since_seq=seq0)
+            batched = [e for e in events if e["kind"] == "deliver_batch"]
+            assert len(batched) == 3
+            for e in batched:
+                assert e["fields"]["lanes"] == 1  # whole block, one lane
+                assert e["fields"]["txs"] == 4
+                assert e["fields"]["fallback"] is False
+
+        asyncio.run(main())
+
+    def test_kill_switch_forces_serial(self, monkeypatch):
+        from tendermint_tpu.libs.recorder import RECORDER
+
+        monkeypatch.setenv("TMTPU_DELIVER_BATCH", "0")
+
+        async def main():
+            app = CountingKVStore()
+            seq0 = RECORDER.total
+            await make_chain(2, app, txs_per_block=3)
+            assert app.batch_calls == 0
+            assert app.single_calls == 6
+            events = RECORDER.snapshot(subsystem="state", since_seq=seq0)
+            batched = [e for e in events if e["kind"] == "deliver_batch"]
+            # the event still fires (one per block) so a mixed fleet is
+            # observable, but with one lane per tx and NO fallback flag
+            # (the kill switch is configuration, not a failure)
+            assert len(batched) == 2
+            for e in batched:
+                assert e["fields"]["lanes"] == 3
+                assert e["fields"]["fallback"] is False
+
+        asyncio.run(main())
+
+    def test_batch_and_serial_responses_byte_identical(self, monkeypatch):
+        async def play():
+            return await make_chain(3, KVStoreApplication(), txs_per_block=3)
+
+        state_b, store_b, *_ = asyncio.run(play())
+        monkeypatch.setenv("TMTPU_DELIVER_BATCH", "0")
+        state_s, store_s, *_ = asyncio.run(play())
+        for h in (1, 2, 3):
+            rb = store_b.load_abci_responses(h)
+            rs = store_s.load_abci_responses(h)
+            assert rb is not None and rs is not None
+            assert rb.encode() == rs.encode()  # order, codes, data, events
+        assert state_b.app_hash == state_s.app_hash
+        assert state_b.last_results_hash == state_s.last_results_hash
+
+    def test_fallback_pins_after_first_failure(self):
+        from tendermint_tpu.libs.recorder import RECORDER
+
+        async def main():
+            app = RefusingBatchApp()
+            seq0 = RECORDER.total
+            state, *_ = await make_chain(3, app, txs_per_block=2)
+            assert state.last_block_height == 3
+            assert app.height == 3  # chain still advanced, serially
+            assert app.batch_attempts == 1  # probe paid exactly once
+            events = RECORDER.snapshot(subsystem="state", since_seq=seq0)
+            falls = [e for e in events if e["kind"] == "deliver_batch_fallback"]
+            assert len(falls) == 1
+            assert falls[0]["fields"]["txs"] == 2
+            assert "NotImplementedError" in falls[0]["fields"]["err"]
+            batched = [e for e in events if e["kind"] == "deliver_batch"]
+            assert len(batched) == 3
+            for e in batched:  # all three blocks delivered serially, loudly
+                assert e["fields"]["fallback"] is True
+                assert e["fields"]["lanes"] == e["fields"]["txs"] == 2
+
+        asyncio.run(main())
+
+    def test_count_mismatch_rejected_at_proxy(self):
+        from tendermint_tpu.abci.client import ABCIClientError
+        from tendermint_tpu.abci import types as abci_t
+
+        class ShortApp(KVStoreApplication):
+            def deliver_tx_batch(self, req):
+                return abci_t.ResponseDeliverTxBatch(
+                    responses=[abci_t.ResponseDeliverTx(code=0)]
+                )
+
+        async def main():
+            conns = proxy.AppConns(proxy.LocalClientCreator(ShortApp()))
+            await conns.start()
+            try:
+                with pytest.raises(ABCIClientError, match="2 txs"):
+                    await conns.consensus.deliver_tx_batch([b"a=1", b"b=2"])
+            finally:
+                await conns.stop()
+
+        asyncio.run(main())
+
+    def test_count_mismatch_trips_executor_fallback(self):
+        """A buggy batch arm (wrong response count) must not corrupt the
+        chain: the proxy rejects it, the executor pins serial delivery."""
+
+        class ShortApp(CountingKVStore):
+            def deliver_tx_batch(self, req):
+                self.batch_calls += 1
+                return abci.ResponseDeliverTxBatch(
+                    responses=[abci.ResponseDeliverTx(code=0)]
+                )
+
+        async def main():
+            app = ShortApp()
+            state, *_ = await make_chain(2, app, txs_per_block=3)
+            assert state.last_block_height == 2
+            assert app.batch_calls == 1  # pinned after the rejection
+            assert app.single_calls == 6  # every tx re-delivered serially
+
+        asyncio.run(main())

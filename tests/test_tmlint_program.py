@@ -965,6 +965,56 @@ def test_tm602_live_tree_aliases_hold():
     assert fs == [], [f.render() for f in fs]
 
 
+def test_tm602_deliver_tx_batch_drift_caught(tmp_path):
+    """Regression fixture for the batch-execution pair: a duplicate field
+    number inside RequestDeliverTxBatch AND a second oneof arm reusing
+    its number (21) must both be flagged — the extension arms get the
+    same drift coverage as the reference schema."""
+    fs = run_lint(
+        tmp_path,
+        {
+            "tendermint_tpu/__init__.py": "",
+            "tendermint_tpu/abci/__init__.py": "",
+            "tendermint_tpu/abci/types.py": """
+                from dataclasses import dataclass
+
+                @dataclass
+                class RequestDeliverTx:
+                    tx: bytes = b""
+
+                @dataclass
+                class RequestDeliverTxBatch:
+                    txs: list = None
+                    stray: bytes = b""
+                """,
+            "tendermint_tpu/abci/proto.py": _proto_fixture(
+                """
+                REQ_DELIVER_TX = Desc("RequestDeliverTx", [
+                    (1, "tx", "bytes", None),
+                ])
+                REQ_DELIVER_TX_BATCH = Desc("RequestDeliverTxBatch", [
+                    (1, "txs", "rep_bytes", None),
+                    (1, "stray", "bytes", None),
+                ])
+                """,
+                """
+                _REQ_MAP = [
+                    (19, abci.RequestDeliverTx, None, None, None),
+                    (21, abci.RequestDeliverTxBatch, None, None, None),
+                    (21, abci.RequestDeliverTx, None, None, None),
+                ]
+                """,
+            ),
+        },
+        config=LintConfig(paths=["tendermint_tpu"]),
+    )
+    msgs = [f.message for f in fs if f.code == "TM602"]
+    assert any(
+        "RequestDeliverTxBatch: field number 1" in m for m in msgs
+    ), msgs
+    assert any("arm number 21" in m for m in msgs), msgs
+
+
 # --- TM603 telemetry docs conformance ---------------------------------------
 
 _DOCS = """

@@ -250,3 +250,107 @@ class TestStateMachine:
                _tx(3, 0), _tx(4, 0, curve="ed25519")]
         res = app.check_tx_batch(abci.RequestCheckTxBatch(txs))
         assert [r.code for r in res.responses] == [0, 0, 0, 0]
+
+
+class TestDeliverTxBatch:
+    """Batch-first block execution on the transfer app: one verification
+    sweep per block, byte-identical to the serial DeliverTx loop."""
+
+    def _parity(self, txs):
+        a = tr.TransferApplication(initial_balance=1000)
+        b = tr.TransferApplication(initial_balance=1000)
+        serial = [a.deliver_tx(abci.RequestDeliverTx(t)) for t in txs]
+        batch = b.deliver_tx_batch(abci.RequestDeliverTxBatch(list(txs))).responses
+        assert serial == batch  # codes, data, logs, events — everything
+        assert a.commit().data == b.commit().data
+        for i in range(1, 6):
+            assert a.balance(_addr(i)) == b.balance(_addr(i))
+            assert a.nonce(_addr(i)) == b.nonce(_addr(i))
+        return batch
+
+    def test_batch_parity_mixed_curves_and_verdicts(self):
+        txs = [
+            _tx(1, 0),                        # ok, secp
+            _tx(2, 0, curve="ed25519"),       # ok, ed25519
+            _tx(1, 1),                        # ok, sequential nonce
+            _tx(3, 5),                        # nonce gap -> BAD_NONCE
+            _tx(4, 0, amount=10**12),         # overdraft
+            b"garbage",                       # undecodable
+        ]
+        tampered = bytearray(_tx(5, 0))
+        tampered[-1] ^= 1
+        txs.append(bytes(tampered))           # bad signature
+        batch = self._parity(txs)
+        assert [r.code for r in batch] == [
+            tr.CODE_OK, tr.CODE_OK, tr.CODE_OK, tr.CODE_BAD_NONCE,
+            tr.CODE_INSUFFICIENT_FUNDS, tr.CODE_ENCODING,
+            tr.CODE_BAD_SIGNATURE,
+        ]
+
+    def test_batch_parity_replay_and_duplicate_in_block(self):
+        tx = _tx(1, 0)
+        # the same tx twice in one block: first applies, the duplicate
+        # fails on nonce — identically on both paths (and identically
+        # whether or not CheckTx pre-verified it)
+        batch = self._parity([tx, tx, _tx(1, 1)])
+        assert [r.code for r in batch] == [
+            tr.CODE_OK, tr.CODE_BAD_NONCE, tr.CODE_OK,
+        ]
+
+    def test_batch_parity_with_checked_cache(self):
+        """CheckTx-verified txs must produce the same delivery results via
+        the verified-hash cache sweep as a cold serial delivery does."""
+        txs = [_tx(1, 0), _tx(2, 0, curve="ed25519"), _tx(3, 0)]
+        a = tr.TransferApplication(initial_balance=1000)
+        b = tr.TransferApplication(initial_balance=1000)
+        for t in txs:  # b pre-admits (populates its verified-hash cache)
+            assert b.check_tx(abci.RequestCheckTx(t)).is_ok
+        serial = [a.deliver_tx(abci.RequestDeliverTx(t)) for t in txs]
+        batch = b.deliver_tx_batch(abci.RequestDeliverTxBatch(list(txs))).responses
+        assert serial == batch
+        assert a.commit().data == b.commit().data
+
+    def test_one_dispatch_per_curve_and_cache_sweep(self):
+        """The deliver_verify event proves the block's signature work
+        collapsed: CheckTx-verified txs sweep the cache, foreign txs are
+        ONE bulk-verify per curve."""
+        from tendermint_tpu.libs.recorder import RECORDER
+
+        app = tr.TransferApplication(initial_balance=1000)
+        local = [_tx(1, 0), _tx(2, 0)]
+        for t in local:
+            assert app.check_tx(abci.RequestCheckTx(t)).is_ok
+        foreign = [_tx(3, 0), _tx(4, 0), _tx(5, 0, curve="ed25519")]
+        seq0 = RECORDER.total
+        res = app.deliver_tx_batch(
+            abci.RequestDeliverTxBatch(local + foreign)
+        )
+        assert all(r.is_ok for r in res.responses)
+        ev = [
+            e for e in RECORDER.snapshot(subsystem="app", since_seq=seq0)
+            if e["kind"] == "deliver_verify"
+        ]
+        assert len(ev) == 1
+        f = ev[0]["fields"]
+        assert f["txs"] == 5
+        assert f["cached"] == 2          # CheckTx-verified: cache sweep
+        assert f["verified"] == 3        # gossip-proposed: bulk verify
+        assert f["dispatches"] == 2      # ONE per curve, not one per tx
+        assert f["curves"] == {"secp256k1": 2, "ed25519": 1}
+
+    def test_all_cached_block_needs_zero_dispatches(self):
+        from tendermint_tpu.libs.recorder import RECORDER
+
+        app = tr.TransferApplication(initial_balance=1000)
+        txs = [_tx(1, 0), _tx(2, 0, curve="ed25519")]
+        for t in txs:
+            assert app.check_tx(abci.RequestCheckTx(t)).is_ok
+        seq0 = RECORDER.total
+        res = app.deliver_tx_batch(abci.RequestDeliverTxBatch(txs))
+        assert all(r.is_ok for r in res.responses)
+        ev = [
+            e for e in RECORDER.snapshot(subsystem="app", since_seq=seq0)
+            if e["kind"] == "deliver_verify"
+        ]
+        assert ev[0]["fields"]["dispatches"] == 0
+        assert ev[0]["fields"]["cached"] == 2
