@@ -119,7 +119,12 @@ async def _amain(args) -> int:
             f"{args.command} ABCI app listening on {args.address} ({args.abci})",
             file=sys.stderr,
         )
-        await asyncio.Event().wait()
+        try:
+            await asyncio.Event().wait()
+        finally:
+            # Ctrl-C cancels the wait: close the listener and its
+            # per-connection handlers before the loop shuts down
+            await server.stop()
         return 0
 
     if args.abci == "grpc":

@@ -11,7 +11,13 @@ gate the reference keeps in CI.
 - TM1xx  async hygiene: blocking calls / fire-and-forget tasks /
          awaits under a threading lock inside ``async def``; TM110
          catches the blocking call hidden one helper deep via the
-         whole-program call graph
+         whole-program call graph; TM120/TM121 build the global
+         lock-order graph (deadlock cycles, blocking — or a
+         ``submit_sync`` device round trip — while holding a lock,
+         at any call depth)
+- TM13x  exception flow: a coroutine's bare except swallowing
+         asyncio cancellation (TM130), a reactor ``receive`` dropping
+         peer attribution (TM131)
 - TM2xx  consensus determinism: wall-clock reads, shared unseeded
          ``random``, set-ordered iteration feeding hashing; TM210
          follows the taint through helper returns into sign-bytes/hash
@@ -19,6 +25,8 @@ gate the reference keeps in CI.
 - TM3xx  JAX tracing hygiene in ops/ and crypto/batch.py: Python
          branches on tracers, host syncs, concrete shapes from tracers
 - TM4xx  service lifecycle: threads neither daemon nor joined
+         (TM401), services started but never stopped (TM420), WAL/db
+         handles opened with no reachable close (TM421)
 - TM5xx  device-dispatch discipline: direct curve verify_batch calls
          (TM501) and submit paths with no priority class pinned (TM502)
 - TM6xx  wire conformance: p2p channel-id collisions (TM601), ABCI
@@ -28,9 +36,10 @@ gate the reference keeps in CI.
          execution contexts with no common lock
 
 Run it with ``python -m tendermint_tpu.lint``; see docs/lint.md for the
-rule catalogue, the context-inference model, suppression syntax, the
-suppression audit (``--list-suppressions``), ``--changed``/``--stats``
-and the baseline ratchet.
+rule catalogue, the context-inference model, the v3 dataflow tier,
+suppression syntax, the suppression audit (``--list-suppressions``),
+the budget gate (``--check-budget`` vs tmlint_budget.json),
+``--changed``/``--stats``/``--format sarif`` and the baseline ratchet.
 """
 from tendermint_tpu.lint.config import LintConfig, load_config
 from tendermint_tpu.lint.engine import (
@@ -44,6 +53,7 @@ from tendermint_tpu.lint.findings import (
     Finding,
     suppressed_codes,
 )
+from tendermint_tpu.lint.sarif import to_sarif
 
 __all__ = [
     "Baseline",
@@ -55,4 +65,5 @@ __all__ = [
     "lint_source",
     "load_config",
     "suppressed_codes",
+    "to_sarif",
 ]

@@ -68,14 +68,67 @@ def _git_changed(root: Path) -> set[str] | None:
     return out
 
 
+def _check_budget(root: Path, suppressed) -> int:
+    """The suppression-creep gate. tmlint_budget.json commits per-rule
+    inline-suppression counts; this fails (exit 1) when any rule
+    FAMILY's live count exceeds its budgeted sum. Raising a budget is
+    then always a reviewed diff to the budget file in the same PR —
+    never a drive-by `# tmlint: disable` slipping through green CI.
+    Families are the code prefix (TM1xx -> "TM1"): shuffling a
+    suppression between sibling rules is not creep."""
+    budget_path = root / "tmlint_budget.json"
+    if not budget_path.exists():
+        print(
+            "tmlint: no tmlint_budget.json — seed it from the current "
+            "counts: python -m tendermint_tpu.lint --stats",
+            file=sys.stderr,
+        )
+        return 2
+    try:
+        doc = json.loads(budget_path.read_text(encoding="utf-8"))
+    except ValueError as e:
+        print(f"tmlint: tmlint_budget.json is not valid JSON: {e}", file=sys.stderr)
+        return 2
+    budgeted: dict[str, int] = {}
+    for code, count in doc.get("rules", {}).items():
+        fam = str(code)[:3].upper()
+        budgeted[fam] = budgeted.get(fam, 0) + int(count)
+    current: dict[str, int] = {}
+    for f in suppressed:
+        fam = f.code[:3]
+        current[fam] = current.get(fam, 0) + 1
+    over = {
+        fam: (n, budgeted.get(fam, 0))
+        for fam, n in sorted(current.items())
+        if n > budgeted.get(fam, 0)
+    }
+    for fam, (n, allowed) in over.items():
+        print(
+            f"tmlint: suppression budget exceeded for {fam}xx: "
+            f"{n} inline suppression(s), budget allows {allowed}"
+        )
+    if over:
+        print(
+            "tmlint: new suppressions need a reviewed budget bump — "
+            "update tmlint_budget.json in the same change "
+            "(counts: python -m tendermint_tpu.lint --stats)"
+        )
+        return 1
+    total = sum(current.values())
+    print(f"tmlint: suppression budget ok ({total} in effect)")
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tendermint_tpu.lint",
         description="consensus-aware static analysis (see docs/lint.md)",
     )
     ap.add_argument("paths", nargs="*", help="files/dirs (default: [tool.tmlint] paths)")
-    ap.add_argument("--format", choices=("text", "json", "github"), default="text",
-                    help="github = GitHub Actions ::error annotations")
+    ap.add_argument("--format", choices=("text", "json", "github", "sarif"),
+                    default="text",
+                    help="github = GitHub Actions ::error annotations; "
+                         "sarif = SARIF 2.1.0 for code scanning")
     ap.add_argument("--root", default=".", help="repo root (pyproject + baseline live here)")
     ap.add_argument("--baseline", nargs="?", const=None, default=None,
                     help="baseline file (default from config; bare --baseline "
@@ -88,6 +141,9 @@ def main(argv: list[str] | None = None) -> int:
                     help="audit: print every inline-suppressed finding and exit 0")
     ap.add_argument("--stats", action="store_true",
                     help="emit per-rule finding/suppression counts as JSON and exit 0")
+    ap.add_argument("--check-budget", action="store_true",
+                    help="fail if any rule family's inline-suppression count "
+                         "exceeds the committed tmlint_budget.json")
     ap.add_argument("--changed", action="store_true",
                     help="report findings only in files git sees as changed "
                          "(index still covers the whole tree)")
@@ -168,7 +224,7 @@ def main(argv: list[str] | None = None) -> int:
             p for p in args.paths if p not in config.paths
         ]
 
-    want_suppressed = args.list_suppressions or args.stats
+    want_suppressed = args.list_suppressions or args.stats or args.check_budget
     findings = lint_paths(
         paths=paths,
         root=root,
@@ -205,6 +261,9 @@ def main(argv: list[str] | None = None) -> int:
         )
         return 0
 
+    if args.check_budget:
+        return _check_budget(root, suppressed)
+
     if args.list_suppressions:
         for f in suppressed:
             print(f.render())
@@ -216,7 +275,16 @@ def main(argv: list[str] | None = None) -> int:
         print(f"wrote {len(live)} finding(s) to {baseline_path}")
         return 0
 
-    if args.format == "json":
+    if args.format == "sarif":
+        from tendermint_tpu.lint.sarif import to_sarif
+
+        active = [
+            r
+            for r in all_rules() + all_program_rules()
+            if r.code not in config.disable
+        ]
+        print(json.dumps(to_sarif(live, active), indent=1))
+    elif args.format == "json":
         print(
             json.dumps(
                 {

@@ -275,7 +275,7 @@ def blocking_chain(project: ProjectIndex, resolver: Resolver, key, _memo=None, _
     if fs is None:
         return None
     if fs.blocking:
-        line, what, _hint = fs.blocking[0]
+        line, what = fs.blocking[0][:2]
         _memo[key] = [(key[0], line, what)]
         return _memo[key]
     truncated = False

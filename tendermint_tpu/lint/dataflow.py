@@ -1,0 +1,328 @@
+"""tmlint v3 dataflow layer — lock identity, the global lock-order
+graph, and the blocking closure that also understands device round
+trips.
+
+The PR 12 index already records, per function, every ordered lock
+acquisition (``FunctionSummary.acquires``: lock name, line, the locks
+already held, sync/async) and every call site with the sync locks held
+at it (``CallSite.locks``). This module assembles those per-function
+facts into whole-program ones:
+
+- :func:`lock_identity` canonicalises a lock *as written* into a stable
+  program-wide name. ``self._lock`` becomes ``<module>::<Class>._lock``
+  (one identity per class — the instance-granularity loss is the usual
+  static trade and errs toward reporting), an imported module-level lock
+  resolves to its defining module, anything else stays module-local.
+- :func:`acquire_closure` answers "which locks can this function end up
+  holding?" by following sync call edges through the resolver — the
+  interprocedural half of the lock-order graph.
+- :class:`LockGraph` + :func:`build_lock_graph` turn nesting facts into
+  ordered edges (``A acquired before B``) with provenance, and
+  :func:`find_cycles` reports each strongly-connected knot once. A
+  cycle means two code paths take the same locks in opposite orders:
+  each is deadlock-free alone, together they can wedge the process
+  (TM120).
+- :func:`sync_blocking_chain` is :func:`~tendermint_tpu.lint.contexts.
+  blocking_chain` extended with the device boundary: a
+  ``scheduler.submit_sync(...)`` parks the calling thread for a full
+  device round trip, so reaching one while holding a threading lock
+  stalls every contender just like ``time.sleep`` would (TM121,
+  docs/device_scheduler.md).
+
+Like everything in pass 2, resolution is conservative: an unresolved
+callee or dynamic lock receiver contributes nothing, trading recall for
+a near-zero false-positive floor.
+"""
+from __future__ import annotations
+
+from tendermint_tpu.lint.contexts import Resolver
+from tendermint_tpu.lint.project import ProjectIndex
+
+# FnKey = (rel_path, qualname); LockId = str
+
+
+def lock_identity(
+    resolver: Resolver, rel: str, cls: str | None, name: str
+) -> str:
+    """Canonical program-wide identity for a lock expression `name` as
+    written inside (rel, cls)."""
+    parts = name.split(".")
+    if parts[0] in ("self", "cls") and cls is not None and len(parts) > 1:
+        return f"{rel}::{cls}.{'.'.join(parts[1:])}"
+    idx = resolver.project.module(rel)
+    if idx is not None and parts[0] in idx.imports:
+        target = resolver._module_attr(idx.imports[parts[0]], parts[1:])
+        if target is not None:
+            trel, chain = target
+            attr = ".".join(chain) or idx.imports[parts[0]].rsplit(".", 1)[-1]
+            return f"{trel}::{attr}"
+    return f"{rel}::{name}"
+
+
+def acquire_closure(
+    project: ProjectIndex, resolver: Resolver, key, _memo=None, _stack=None
+) -> list:
+    """[(lock_id, "`qual` (rel:line)")] — every lock `key` may acquire,
+    directly or through any sync call chain, with the acquiring site.
+
+    Memoization follows blocking_chain's discipline: a result computed
+    under cycle truncation is returned but never cached, so mutual
+    recursion cannot poison the memo with a partial closure.
+    """
+    _memo = {} if _memo is None else _memo
+    _stack = set() if _stack is None else _stack
+    if key in _memo:
+        return _memo[key]
+    if key in _stack:
+        return []
+    idx = project.module(key[0])
+    fs = idx.functions.get(key[1]) if idx else None
+    if fs is None:
+        return []
+    out: dict[str, str] = {}
+    truncated = False
+    _stack.add(key)
+    try:
+        for lock, line, _outers, _kind in fs.acquires:
+            lid = lock_identity(resolver, key[0], fs.cls, lock)
+            out.setdefault(lid, f"`{key[1]}` ({key[0]}:{line})")
+        for c in fs.calls:
+            ck = resolver.resolve(key[0], fs.cls, c.name)
+            if ck is None or ck == key:
+                continue
+            if ck in _stack:
+                truncated = True
+                continue
+            cfs = project.module(ck[0]).functions.get(ck[1])
+            if cfs is None or cfs.is_async:
+                continue  # calling async yields a coroutine, runs later
+            sub = acquire_closure(project, resolver, ck, _memo, _stack)
+            if ck not in _memo:
+                truncated = True
+            for lid, via in sub:
+                out.setdefault(lid, via)
+    finally:
+        _stack.discard(key)
+    res = sorted(out.items())
+    if not truncated:
+        _memo[key] = res
+    return res
+
+
+class LockGraph:
+    """Directed lock-order graph: an edge A -> B means some code path
+    acquires B while already holding A. Provenance per edge is
+    (rel, line, description); the first one recorded wins
+    (deterministic: modules and functions iterate in index order)."""
+
+    def __init__(self):
+        self.edges: dict[str, dict[str, tuple]] = {}  # u -> v -> provenance
+
+    def add(self, u: str, v: str, provenance: tuple) -> None:
+        if u == v:
+            return  # re-acquiring the same lock is RLock reentrancy, not order
+        self.edges.setdefault(u, {}).setdefault(v, provenance)
+
+    def nodes(self) -> set[str]:
+        out = set(self.edges)
+        for tgts in self.edges.values():
+            out.update(tgts)
+        return out
+
+
+def build_lock_graph(project: ProjectIndex, resolver: Resolver) -> LockGraph:
+    g = LockGraph()
+    closure_memo: dict = {}
+    for rel, idx in project.modules.items():
+        for qual, fs in idx.functions.items():
+            # intra-function nesting: `with a: with b:` orders a before b
+            for lock, line, outers, _kind in fs.acquires:
+                lid = lock_identity(resolver, rel, fs.cls, lock)
+                for outer in outers:
+                    g.add(
+                        lock_identity(resolver, rel, fs.cls, outer),
+                        lid,
+                        (
+                            rel,
+                            line,
+                            f"`{qual}` acquires `{lock}` while holding "
+                            f"`{outer}` ({rel}:{line})",
+                        ),
+                    )
+            # interprocedural: a call made under a lock orders that lock
+            # before everything the callee's closure can acquire
+            for c in fs.calls:
+                if not c.locks:
+                    continue
+                ck = resolver.resolve(rel, fs.cls, c.name)
+                if ck is None or ck == (rel, qual):
+                    continue
+                cfs = project.module(ck[0]).functions.get(ck[1])
+                if cfs is None or cfs.is_async:
+                    continue
+                for lid, via in acquire_closure(
+                    project, resolver, ck, closure_memo
+                ):
+                    for held in c.locks:
+                        g.add(
+                            lock_identity(resolver, rel, fs.cls, held),
+                            lid,
+                            (
+                                rel,
+                                c.line,
+                                f"`{qual}` ({rel}:{c.line}) holds `{held}` "
+                                f"and calls `{ck[1]}`, which acquires {via}",
+                            ),
+                        )
+    return g
+
+
+def find_cycles(graph: LockGraph) -> list[list[tuple[str, str, str]]]:
+    """Each lock-order cycle once, as its edge list
+    [(u, v, provenance), ...] — u of the first edge == v of the last.
+
+    Strongly-connected components (iterative Tarjan) locate the knots;
+    within a component the shortest cycle through its smallest node is
+    reported, so the output is deterministic and one finding covers one
+    knot rather than every rotation of it.
+    """
+    edges = graph.edges
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    sccs: list[list[str]] = []
+    counter = [0]
+
+    def strongconnect(root: str) -> None:
+        work = [(root, iter(sorted(edges.get(root, ()))))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(edges.get(w, ())))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[v])
+            if low[v] == index[v]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == v:
+                        break
+                if len(scc) > 1:
+                    sccs.append(sorted(scc))
+
+    for node in sorted(graph.nodes()):
+        if node not in index:
+            strongconnect(node)
+
+    cycles = []
+    for scc in sccs:
+        members = set(scc)
+        start = scc[0]
+        # BFS for the shortest path start -> ... -> start inside the SCC
+        prev: dict[str, str] = {}
+        queue = [start]
+        found = None
+        visited = {start}
+        while queue and found is None:
+            nxt: list[str] = []
+            for u in queue:
+                for v in sorted(edges.get(u, ())):
+                    if v == start:
+                        found = u
+                        break
+                    if v in members and v not in visited:
+                        visited.add(v)
+                        prev[v] = u
+                        nxt.append(v)
+                if found is not None:
+                    break
+            queue = nxt
+        if found is None:
+            continue  # unreachable for a true SCC
+        path = [start]
+        node = found
+        back = []
+        while node != start:
+            back.append(node)
+            node = prev[node]
+        path.extend(reversed(back))
+        cycle = []
+        for i, u in enumerate(path):
+            v = path[(i + 1) % len(path)]
+            cycle.append((u, v, edges[u][v]))
+        cycles.append(cycle)
+    return cycles
+
+
+def sync_blocking_chain(
+    project: ProjectIndex, resolver: Resolver, key, _memo=None, _stack=None
+):
+    """None, or the chain proving `key` (transitively) parks its thread:
+    [(rel, line, desc), ...] ending at the direct site. Superset of
+    contexts.blocking_chain: a `scheduler.submit_sync(...)` device
+    submission is a terminal too — the calling thread waits out a full
+    device round trip (docs/device_scheduler.md)."""
+    _memo = _memo if _memo is not None else {}
+    _stack = _stack if _stack is not None else set()
+    if key in _memo:
+        return _memo[key]
+    if key in _stack:
+        return None  # truncated — caller must not memoize its own None
+    idx = project.module(key[0])
+    fs = idx.functions.get(key[1]) if idx else None
+    if fs is None:
+        return None
+    if fs.blocking:
+        line, what = fs.blocking[0][:2]
+        _memo[key] = [(key[0], line, what)]
+        return _memo[key]
+    for line, kind, _pinned, *_held in fs.submits:
+        if kind == "scheduler.submit_sync":
+            _memo[key] = [(key[0], line, "scheduler.submit_sync(...)")]
+            return _memo[key]
+    truncated = False
+    _stack.add(key)
+    try:
+        for c in fs.calls:
+            ck = resolver.resolve(key[0], fs.cls, c.name)
+            if ck is None or ck == key:
+                continue
+            if ck in _stack:
+                truncated = True
+                continue
+            cfs = project.module(ck[0]).functions.get(ck[1])
+            if cfs is None or cfs.is_async:
+                continue
+            sub = sync_blocking_chain(project, resolver, ck, _memo, _stack)
+            if sub is not None:
+                chain = [(key[0], c.line, ck[1])] + sub
+                _memo[key] = chain
+                return chain
+            if ck not in _memo:
+                truncated = True  # callee's negative was itself truncated
+    finally:
+        _stack.discard(key)
+    if not truncated:
+        _memo[key] = None
+    return None

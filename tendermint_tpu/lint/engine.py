@@ -186,11 +186,14 @@ def all_rules() -> list[Rule]:
 
 
 def all_program_rules() -> list:
-    """The whole-program (pass 2) rules: interprocedural + wire
-    conformance. Instances implement check(project, config, root)."""
-    from tendermint_tpu.lint import rules_program, rules_wire
+    """The whole-program (pass 2) rules: interprocedural, dataflow, and
+    wire conformance. Instances implement check(project, config, root)."""
+    from tendermint_tpu.lint import rules_dataflow, rules_program, rules_wire
 
-    return [r() for r in rules_program.RULES + rules_wire.RULES]
+    return [
+        r()
+        for r in rules_program.RULES + rules_dataflow.RULES + rules_wire.RULES
+    ]
 
 
 # --- the single pass --------------------------------------------------------

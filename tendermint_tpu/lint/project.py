@@ -45,7 +45,7 @@ from tendermint_tpu.lint.rules_async import (
 )
 
 # Bump when the summary shape changes: stale caches self-invalidate.
-INDEX_VERSION = 1
+INDEX_VERSION = 2
 
 # Interprocedural taint sources (TM210). Wider than TM201's wall-clock
 # set on purpose: monotonic/perf counters are per-process values — fine
@@ -107,6 +107,7 @@ class CallSite:
     pinned: bool = False  # inside a literal priority_scope(...) block
     arg_calls: list = field(default_factory=list)  # per-arg: [dotted call names]
     arg_names: list = field(default_factory=list)  # per-arg: plain Name or None
+    locks: list = field(default_factory=list)  # sync (threading) locks held here
 
 
 @dataclass
@@ -118,7 +119,7 @@ class FunctionSummary:
     is_jit: bool
     params: list = field(default_factory=list)
     calls: list = field(default_factory=list)  # [CallSite]
-    blocking: list = field(default_factory=list)  # [[line, what, hint]]
+    blocking: list = field(default_factory=list)  # [[line, what, hint, [locks]]]
     taints: list = field(default_factory=list)  # [[line, what]]
     returns_taint: bool = False
     return_calls: list = field(default_factory=list)  # call names in return exprs
@@ -126,8 +127,16 @@ class FunctionSummary:
     sink_params: list = field(default_factory=list)  # params fed to sink calls
     attr_writes: list = field(default_factory=list)  # [[attr, line, [locks]]]
     pins: bool = False  # contains a literal priority_scope(...) pin
-    submits: list = field(default_factory=list)  # [[line, kind, pinned_or_literal_prio]]
+    submits: list = field(default_factory=list)  # [[line, kind, pinned, [locks]]]
     spawns: list = field(default_factory=list)  # [[kind, target, line]]
+    # v3 dataflow facts:
+    acquires: list = field(default_factory=list)  # [[lock, line, [outers], kind]]
+    handlers: list = field(default_factory=list)
+    # handlers: [[line, kind, reraises, attributed, cancel_handled]] where
+    # kind is "bare" | "BaseException" | "Exception" (narrow excepts are
+    # not recorded — they cannot swallow what they do not catch)
+    ctors: list = field(default_factory=list)  # [["x"|"self.attr", Ctor, line]]
+    escapes: list = field(default_factory=list)  # local names that leave the fn
 
 
 @dataclass
@@ -173,6 +182,9 @@ class _Indexer(ast.NodeVisitor):
         self.cls_stack: list[str] = []
         self.pin_depth = 0
         self.lock_stack: list[str] = []
+        # threading locks only (sync `with`): an asyncio lock never blocks
+        # the thread, so the TM12x held-lock facts must not include it
+        self.sync_lock_stack: list[str] = []
         self.parents: list[ast.AST] = []
 
     # -- helpers -------------------------------------------------------------
@@ -244,12 +256,12 @@ class _Indexer(ast.NodeVisitor):
         self.fn_stack.append(summ)
         # a nested def sees a fresh lock/pin state: its body runs later,
         # not under the enclosing with-blocks
-        saved = (self.pin_depth, self.lock_stack)
-        self.pin_depth, self.lock_stack = 0, []
+        saved = (self.pin_depth, self.lock_stack, self.sync_lock_stack)
+        self.pin_depth, self.lock_stack, self.sync_lock_stack = 0, [], []
         try:
             self.generic_visit(node)
         finally:
-            self.pin_depth, self.lock_stack = saved
+            self.pin_depth, self.lock_stack, self.sync_lock_stack = saved
             self.fn_stack.pop()
 
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
@@ -281,7 +293,43 @@ class _Indexer(ast.NodeVisitor):
         if not self.fn_stack and not self.cls_stack:
             self._module_assign(node)
         self._maybe_attr_write(node.targets, node.lineno)
+        self._maybe_ctor(node.targets, node.value, node.lineno)
+        self._maybe_escape(node.targets, node.value)
         self.generic_visit(node)
+
+    def _maybe_ctor(self, targets, value, line: int) -> None:
+        """`x = ClassName(...)` / `self.attr = ClassName(...)` inside a
+        function: the def site for the lifecycle rules (TM420/TM421)."""
+        if self.fn is None or not isinstance(value, ast.Call):
+            return
+        callee = dotted(value.func)
+        if callee is None:
+            return
+        last = callee.rsplit(".", 1)[-1]
+        if not (last[:1].isupper() or last == "new_db"):
+            return
+        for t in targets:
+            if isinstance(t, ast.Name):
+                self.fn.ctors.append([t.id, callee, line])
+            elif (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+            ):
+                self.fn.ctors.append([f"self.{t.attr}", callee, line])
+
+    def _maybe_escape(self, targets, value) -> None:
+        """Local names whose value is re-bound somewhere the function
+        can't track (an attribute, a container slot, another name): the
+        lifecycle rules treat escaping handles as not-ours-to-close."""
+        if self.fn is None or value is None:
+            return
+        if any(isinstance(t, (ast.Attribute, ast.Subscript)) for t in targets):
+            for sub in ast.walk(value):
+                if isinstance(sub, ast.Name):
+                    self.fn.escapes.append(sub.id)
+        elif isinstance(value, ast.Name):
+            self.fn.escapes.append(value.id)
 
     def visit_AugAssign(self, node: ast.AugAssign) -> None:
         self._maybe_attr_write([node.target], node.lineno)
@@ -366,7 +414,7 @@ class _Indexer(ast.NodeVisitor):
 
     def _classify_with(self, node):
         pins = 0
-        locks = []
+        locks = []  # [(name, line)]
         for item in node.items:
             expr = item.context_expr
             if isinstance(expr, ast.Call):
@@ -377,24 +425,117 @@ class _Indexer(ast.NodeVisitor):
                     continue
             lock = _is_lockish(expr)
             if lock:
-                locks.append(lock)
+                locks.append((lock, getattr(expr, "lineno", node.lineno)))
         return pins, locks
 
-    def _visit_with(self, node) -> None:
+    def _visit_with(self, node, kind: str) -> None:
         pins, locks = self._classify_with(node)
         if pins and self.fn is not None:
             self.fn.pins = True
         self.pin_depth += pins
-        self.lock_stack.extend(locks)
+        for lock, line in locks:
+            # the ordered-nesting fact for the lock-order graph: every
+            # lock already held is an "acquired before" edge source. A
+            # suppression at the acquire site removes its edges globally.
+            if self.fn is not None and not self._suppressed(line, "TM120"):
+                self.fn.acquires.append(
+                    [lock, line, list(self.lock_stack), kind]
+                )
+            self.lock_stack.append(lock)
+            if kind == "sync":
+                self.sync_lock_stack.append(lock)
         try:
             self.generic_visit(node)
         finally:
             self.pin_depth -= pins
             if locks:
                 del self.lock_stack[-len(locks):]
+                if kind == "sync":
+                    del self.sync_lock_stack[-len(locks):]
 
-    visit_With = _visit_with
-    visit_AsyncWith = _visit_with
+    def visit_With(self, node: ast.With) -> None:
+        # a sync with-statement on a lock-named object is a threading
+        # lock (asyncio.Lock only supports `async with`)
+        self._visit_with(node, "sync")
+
+    def visit_AsyncWith(self, node: ast.AsyncWith) -> None:
+        self._visit_with(node, "async")
+
+    # -- exception handlers ----------------------------------------------------
+
+    _ATTRIB_TAILS = {
+        "report", "report_behaviour", "record", "record_crash",
+        "stop_peer_for_error", "ban", "exception",
+    }
+    _LOG_TAILS = {"error", "warning", "critical", "info", "debug", "log"}
+
+    @staticmethod
+    def _body_walk(body):
+        """Walk handler statements, pruning nested defs/lambdas — their
+        bodies run later, outside the except clause."""
+        stack = list(body)
+        while stack:
+            node = stack.pop()
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                ):
+                    continue
+                stack.append(child)
+
+    def _handler_attributed(self, handler: ast.ExceptHandler) -> bool:
+        """A call on the handler path that keeps the failure attributable:
+        a behaviour report / recorder event / peer ban, or any log call."""
+        for sub in self._body_walk(handler.body):
+            if not isinstance(sub, ast.Call):
+                continue
+            tail = sub.func.attr if isinstance(sub.func, ast.Attribute) else None
+            if tail in self._ATTRIB_TAILS:
+                return True
+            if tail in self._LOG_TAILS:
+                recv = dotted(sub.func.value) or ""
+                if "log" in recv.lower():
+                    return True
+        return False
+
+    def visit_Try(self, node: ast.Try) -> None:
+        fn = self.fn
+        if fn is not None:
+            cancel_handled = False
+            for h in node.handlers:
+                names = []
+                if h.type is not None:
+                    exprs = (
+                        h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+                    )
+                    names = [d for d in map(dotted, exprs) if d]
+                tails = {n.rsplit(".", 1)[-1] for n in names}
+                if "CancelledError" in tails:
+                    # an earlier dedicated clause: cancellation never
+                    # reaches the broad handler below it
+                    cancel_handled = True
+                if h.type is None:
+                    kind = "bare"
+                elif "BaseException" in tails:
+                    kind = "BaseException"
+                elif "Exception" in tails:
+                    kind = "Exception"
+                else:
+                    continue
+                reraises = any(
+                    isinstance(s, ast.Raise) for s in self._body_walk(h.body)
+                )
+                fn.handlers.append(
+                    [
+                        h.lineno,
+                        kind,
+                        reraises,
+                        self._handler_attributed(h),
+                        cancel_handled,
+                    ]
+                )
+        self.generic_visit(node)
 
     # -- returns ---------------------------------------------------------------
 
@@ -410,6 +551,25 @@ class _Indexer(ast.NodeVisitor):
                             self.fn.returns_taint = True
                     else:
                         self.fn.return_calls.append(d)
+                elif isinstance(sub, ast.Name):
+                    self.fn.escapes.append(sub.id)
+        self.generic_visit(node)
+
+    def _visit_yield(self, node) -> None:
+        if self.fn is not None and node.value is not None:
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Name):
+                    self.fn.escapes.append(sub.id)
+        self.generic_visit(node)
+
+    visit_Yield = _visit_yield
+    visit_YieldFrom = _visit_yield
+
+    def visit_Raise(self, node: ast.Raise) -> None:
+        if self.fn is not None:
+            for part in (node.exc, node.cause):
+                if isinstance(part, ast.Name):
+                    self.fn.escapes.append(part.id)
         self.generic_visit(node)
 
     @staticmethod
@@ -449,18 +609,20 @@ class _Indexer(ast.NodeVisitor):
                 pinned=self.pin_depth > 0,
                 arg_calls=arg_calls,
                 arg_names=arg_names,
+                locks=list(self.sync_lock_stack),
             )
         )
         # direct blocking sites (the TM101 tables) — suppression at the
         # site kills the transitive closure too
         awaited = bool(self.parents) and isinstance(self.parents[-1], ast.Await)
-        if not awaited and not self._suppressed(line, "TM101", "TM110"):
+        held = list(self.sync_lock_stack)
+        if not awaited and not self._suppressed(line, "TM101", "TM110", "TM121"):
             if name in BLOCKING_DOTTED:
-                fn.blocking.append([line, f"{name}(...)", BLOCKING_DOTTED[name]])
+                fn.blocking.append([line, f"{name}(...)", BLOCKING_DOTTED[name], held])
             elif tail in BLOCKING_TAILS and _is_blocking_wait_call(node):
-                fn.blocking.append([line, f".{tail}(...)", BLOCKING_TAILS[tail]])
+                fn.blocking.append([line, f".{tail}(...)", BLOCKING_TAILS[tail], held])
             elif tail == "join" and name != "?" and _is_blocking_wait_call(node):
-                fn.blocking.append([line, ".join(...)", "thread/process join"])
+                fn.blocking.append([line, ".join(...)", "thread/process join", held])
         # taint sources
         if name and self._is_taint_call(name):
             if not self._suppressed(line, "TM201", "TM202", "TM210"):
@@ -488,7 +650,9 @@ class _Indexer(ast.NodeVisitor):
                 kw.arg == "priority" and _is_literal_priority(kw.value)
                 for kw in node.keywords
             )
-            fn.submits.append([line, kind, self.pin_depth > 0 or literal_prio])
+            fn.submits.append(
+                [line, kind, self.pin_depth > 0 or literal_prio, held]
+            )
 
     def _record_spawn(self, fn, node, name, tail) -> None:
         def target_of(val) -> str | None:

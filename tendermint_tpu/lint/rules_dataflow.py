@@ -1,0 +1,498 @@
+"""TM12x/TM13x/TM42x whole-program dataflow rules — the v3 tier.
+
+Built on lint/dataflow.py over the same ProjectIndex as the PR 12
+rules, these catch the classic distributed-runtime killers the
+per-function tier cannot see:
+
+- TM120: a lock-order inversion — two code paths take the same locks in
+  opposite orders. Each path is deadlock-free alone; interleaved they
+  wedge the process with no stack trace pointing at either.
+- TM121: a threading lock held across something that parks the thread —
+  a blocking call (the interprocedural closure of TM103) or a
+  `scheduler.submit_sync(...)` device round trip. Every other contender
+  stalls for the full duration; if one of them is the event loop, the
+  node stops.
+- TM130: a coroutine's bare `except` / `except BaseException` that
+  never re-raises — it swallows `asyncio.CancelledError`, so `stop()`
+  hangs waiting for a task that ignored its cancellation.
+- TM131: a reactor `receive` handler whose broad except drops peer
+  attribution: no behaviour report, no log, no recorder event — a
+  malformed message from a byzantine peer vanishes without the peer
+  ever being scored (docs/observability.md).
+- TM420: a Service subclass constructed and started but stopped on no
+  path — its spawned tasks/threads outlive every shutdown.
+- TM421: an `autofile.Group` / `libs.db` handle opened with no
+  reachable `close()` — buffered writes are lost on shutdown and fds
+  leak per restart cycle.
+
+Lifecycle tracking (TM420/TM421) is path-insensitive def-use over the
+index: a receiver that escapes the function (returned, yielded, stored
+in a container, passed along) is somebody else's to close and is safe
+by omission — the rules trade recall for a near-zero false-positive
+floor, like every pass-2 rule.
+"""
+from __future__ import annotations
+
+from tendermint_tpu.lint.contexts import Resolver
+from tendermint_tpu.lint.dataflow import (
+    build_lock_graph,
+    find_cycles,
+    sync_blocking_chain,
+)
+from tendermint_tpu.lint.rules_program import ProgramRule, _Analysis
+
+
+def _derives(
+    resolver: Resolver, rel: str, cls: str, base_names: set, _depth: int = 0
+) -> bool:
+    """True when `cls` (as defined in `rel`) transitively names a base
+    whose final component is in `base_names` — resolved through the
+    project where possible, by written name otherwise."""
+    if _depth > 6:
+        return False
+    idx = resolver.project.module(rel)
+    if idx is None or cls not in idx.classes:
+        return False
+    for base in idx.classes[cls]["bases"]:
+        if base.rsplit(".", 1)[-1] in base_names:
+            return True
+        site = resolver._resolve_class(rel, base)
+        if site is not None and _derives(
+            resolver, site[0], site[1], base_names, _depth + 1
+        ):
+            return True
+    return False
+
+
+def _scope_summaries(idx, qual, fs):
+    """`fs` plus the summaries of every function nested inside it.
+    Nested defs close over the enclosing function's locals (the
+    `svc.spawn(self_stopper())` shape stops the service from a closure),
+    so their start/stop/close calls — and their escapes — count for the
+    outer scope. Shadowing a name inside the closure errs toward not
+    reporting, like every pass-2 trade."""
+    out = [fs]
+    prefix = qual + "."
+    for q2, fs2 in idx.functions.items():
+        if q2.startswith(prefix):
+            out.append(fs2)
+    return out
+
+
+# ---------------------------------------------------------------- TM120
+
+
+class TM120LockOrderInversion(ProgramRule):
+    code = "TM120"
+    name = "lock-order-inversion"
+    help = (
+        "Two code paths acquire these locks in opposite orders; threads "
+        "interleaving them deadlock with each holding what the other "
+        "wants. Pick one global order (document it where the locks are "
+        "defined) and re-nest the minority path, or collapse the locks "
+        "into one."
+    )
+
+    def check(self, project, config, root, analysis: _Analysis | None = None):
+        a = analysis or _Analysis(project)
+        graph = build_lock_graph(project, a.resolver)
+        findings = []
+        for cycle in find_cycles(graph):
+            locks = [u for u, _v, _prov in cycle]
+            ring = " -> ".join(
+                lid.split("::", 1)[-1] for lid in locks + [locks[0]]
+            )
+            chains = "; ".join(prov[2] for _u, _v, prov in cycle)
+            rel, line, _desc = cycle[0][2]
+            findings.append(
+                self.finding(
+                    rel,
+                    line,
+                    f"lock-order inversion `{ring}`: {chains}",
+                )
+            )
+        return findings
+
+
+# ---------------------------------------------------------------- TM121
+
+
+class TM121BlockingWhileHoldingLock(ProgramRule):
+    code = "TM121"
+    name = "blocking-while-holding-lock"
+    help = (
+        "The thread parks with the lock held — every other contender "
+        "(possibly the event loop) stalls for the full duration. Shrink "
+        "the critical section so the blocking step runs lock-free, or "
+        "hand the work to the scheduler *before* taking the lock."
+    )
+
+    def check(self, project, config, root, analysis: _Analysis | None = None):
+        a = analysis or _Analysis(project)
+        memo: dict = {}
+        findings = []
+        for rel, idx in project.modules.items():
+            for qual, fs in idx.functions.items():
+                for line, what, _hint, *rest in fs.blocking:
+                    held = rest[0] if rest else []
+                    if held:
+                        findings.append(
+                            self.finding(
+                                rel,
+                                line,
+                                f"`{qual}` makes blocking call `{what}` "
+                                f"while holding `{held[-1]}`",
+                            )
+                        )
+                for line, kind, _pinned, *rest in fs.submits:
+                    held = rest[0] if rest else []
+                    if kind == "scheduler.submit_sync" and held:
+                        findings.append(
+                            self.finding(
+                                rel,
+                                line,
+                                f"`{qual}` submits a synchronous device "
+                                f"round trip (`submit_sync`) while holding "
+                                f"`{held[-1]}`",
+                            )
+                        )
+                for c in fs.calls:
+                    if not c.locks:
+                        continue
+                    ck = a.resolver.resolve(rel, fs.cls, c.name)
+                    if ck is None or ck == (rel, qual):
+                        continue
+                    cfs = a.fn(ck)
+                    if cfs is None or cfs.is_async:
+                        continue
+                    chain = sync_blocking_chain(project, a.resolver, ck, memo)
+                    if chain is None:
+                        continue
+                    hops = " -> ".join(
+                        [ck[1]] + [step[-1] for step in chain[:-1]]
+                    )
+                    site = chain[-1]
+                    findings.append(
+                        self.finding(
+                            rel,
+                            c.line,
+                            f"`{qual}` holds `{c.locks[-1]}` across "
+                            f"`{c.name}(...)`, which blocks: {hops} -> "
+                            f"`{site[2]}` ({site[0]}:{site[1]})",
+                        )
+                    )
+        return findings
+
+
+# ---------------------------------------------------------------- TM130
+
+
+class TM130CancellationSwallow(ProgramRule):
+    code = "TM130"
+    name = "cancellation-swallowed-in-coroutine"
+    help = (
+        "asyncio delivers cancellation as a CancelledError raised at the "
+        "await point, and CancelledError derives from BaseException "
+        "precisely so `except Exception` stays safe — a bare except (or "
+        "`except BaseException`) that returns normally eats it, and the "
+        "task's `stop()`/`cancel()` then hangs forever. Re-raise, catch "
+        "`Exception` instead, or add a dedicated `except "
+        "asyncio.CancelledError: raise` clause first."
+    )
+
+    def check(self, project, config, root, analysis: _Analysis | None = None):
+        findings = []
+        for rel, idx in project.modules.items():
+            for qual, fs in idx.functions.items():
+                if not fs.is_async:
+                    continue  # cancellation is only delivered at awaits
+                for line, kind, reraises, _attr, cancel_handled in fs.handlers:
+                    if kind not in ("bare", "BaseException"):
+                        continue  # `except Exception` does not catch it
+                    if reraises or cancel_handled:
+                        continue
+                    what = (
+                        "bare `except:`"
+                        if kind == "bare"
+                        else "`except BaseException`"
+                    )
+                    findings.append(
+                        self.finding(
+                            rel,
+                            line,
+                            f"{what} in coroutine `{qual}` swallows "
+                            "asyncio.CancelledError — the task becomes "
+                            "uncancellable",
+                        )
+                    )
+        return findings
+
+
+# ---------------------------------------------------------------- TM131
+
+
+class TM131ReceiveDropsPeerAttribution(ProgramRule):
+    code = "TM131"
+    name = "receive-handler-drops-peer-attribution"
+    help = (
+        "A reactor's receive() is the only place a malformed or "
+        "malicious message still has its sender attached. Swallowing the "
+        "error without a behaviour report, log line, or recorder event "
+        "means the byzantine peer is never scored and the operator never "
+        "sees the failure (docs/observability.md). Report before "
+        "dropping: log the peer id and record the event."
+    )
+
+    _REACTOR_BASES = {"BaseReactor"}
+
+    def check(self, project, config, root, analysis: _Analysis | None = None):
+        a = analysis or _Analysis(project)
+        findings = []
+        for rel, idx in project.modules.items():
+            for cls in idx.classes:
+                if not _derives(a.resolver, rel, cls, self._REACTOR_BASES):
+                    continue
+                fs = idx.functions.get(f"{cls}.receive")
+                if fs is None:
+                    continue
+                for line, kind, reraises, attributed, _ch in fs.handlers:
+                    if reraises or attributed:
+                        continue
+                    what = "bare `except:`" if kind == "bare" else f"`except {kind}`"
+                    findings.append(
+                        self.finding(
+                            rel,
+                            line,
+                            f"{what} in `{cls}.receive` drops the failure "
+                            "with no behaviour report, log, or recorder "
+                            "event — the peer is never attributed",
+                        )
+                    )
+        return findings
+
+
+# ---------------------------------------------------------------- TM420
+
+
+class TM420ServiceNeverStopped(ProgramRule):
+    code = "TM420"
+    name = "service-started-never-stopped"
+    help = (
+        "The service is started on some path but no path ever stops it: "
+        "its spawned tasks/threads outlive shutdown, holding sockets and "
+        "flushing nothing. Mirror every `.start()` with a `.stop()` on "
+        "the owner's stop path (BaseService.on_stop is the usual home)."
+    )
+
+    _SERVICE_BASES = {"BaseService"}
+
+    def _is_service(self, resolver: Resolver, rel: str, ctor: str) -> bool:
+        if ctor.rsplit(".", 1)[-1] in self._SERVICE_BASES:
+            return True
+        site = resolver._resolve_class(rel, ctor)
+        return site is not None and _derives(
+            resolver, site[0], site[1], self._SERVICE_BASES
+        )
+
+    def check(self, project, config, root, analysis: _Analysis | None = None):
+        a = analysis or _Analysis(project)
+        findings = []
+        for rel, idx in project.modules.items():
+            findings.extend(self._check_class_attrs(a, rel, idx))
+            findings.extend(self._check_locals(a, rel, idx))
+        return findings
+
+    def _check_class_attrs(self, a: _Analysis, rel, idx):
+        out = []
+        for cls in idx.classes:
+            ctor_of: dict[str, tuple] = {}  # attr -> (ctor, line, qual)
+            started: set[str] = set()
+            stopped: set[str] = set()
+            for qual, fs in idx.functions.items():
+                if fs.cls != cls:
+                    continue
+                for target, ctor, line in fs.ctors:
+                    if target.startswith("self."):
+                        ctor_of.setdefault(target[5:], (ctor, line, qual))
+                for c in fs.calls:
+                    parts = c.name.split(".")
+                    if len(parts) == 3 and parts[0] == "self":
+                        if parts[2] == "start":
+                            started.add(parts[1])
+                        elif parts[2] == "stop":
+                            stopped.add(parts[1])
+            for attr, (ctor, line, qual) in sorted(ctor_of.items()):
+                if attr not in started or attr in stopped:
+                    continue
+                if not self._is_service(a.resolver, rel, ctor):
+                    continue
+                out.append(
+                    self.finding(
+                        rel,
+                        line,
+                        f"`self.{attr}` ({ctor}, built in `{qual}`) is "
+                        f"started but no method of {cls} ever stops it",
+                    )
+                )
+        return out
+
+    def _check_locals(self, a: _Analysis, rel, idx):
+        out = []
+        for qual, fs in idx.functions.items():
+            local = {
+                t: (ctor, line)
+                for t, ctor, line in fs.ctors
+                if not t.startswith("self.")
+            }
+            if not local:
+                continue
+            started: set[str] = set()
+            stopped: set[str] = set()
+            escaping = set()
+            for scope in _scope_summaries(idx, qual, fs):
+                escaping.update(scope.escapes)
+                for c in scope.calls:
+                    parts = c.name.split(".")
+                    if len(parts) == 2:
+                        if parts[1] == "start":
+                            started.add(parts[0])
+                        elif parts[1] == "stop":
+                            stopped.add(parts[0])
+                    for nm in c.arg_names:
+                        if nm:
+                            escaping.add(nm)
+            for var, (ctor, line) in sorted(local.items()):
+                if var not in started or var in stopped or var in escaping:
+                    continue
+                if not self._is_service(a.resolver, rel, ctor):
+                    continue
+                out.append(
+                    self.finding(
+                        rel,
+                        line,
+                        f"`{var}` ({ctor}) is started but `{qual}` never "
+                        "stops it and it does not escape the function",
+                    )
+                )
+        return out
+
+
+# ---------------------------------------------------------------- TM421
+
+
+class TM421HandleNeverClosed(ProgramRule):
+    code = "TM421"
+    name = "file-or-db-handle-never-closed"
+    help = (
+        "The handle buffers writes (autofile.Group) or owns an fd/"
+        "connection (libs.db): with no reachable close(), the tail of "
+        "the WAL is lost on shutdown and the descriptor leaks per "
+        "restart cycle. Close it on the owner's stop path, or hand it "
+        "to whoever does."
+    )
+
+    def _handle_kind(self, resolver: Resolver, rel: str, ctor: str) -> str | None:
+        """Non-None when `ctor` (as written in rel) builds a closeable
+        handle this rule owns: autofile.Group, a libs/db class (MemDB
+        holds no OS resource and is exempt), or the new_db factory."""
+        site = resolver._resolve_class(rel, ctor)
+        if site is not None:
+            trel, cname = site
+            base = trel.rsplit("/", 1)[-1]
+            if base == "autofile.py" and cname == "Group":
+                return "autofile.Group"
+            if base == "db.py" and cname != "MemDB":
+                if cname.endswith("DB") or _derives(resolver, trel, cname, {"DB"}):
+                    return f"db.{cname}"
+            return None
+        if ctor.rsplit(".", 1)[-1] == "new_db":
+            fk = resolver.resolve(rel, None, ctor)
+            if fk is not None and fk[0].rsplit("/", 1)[-1] == "db.py":
+                return "db.new_db"
+        return None
+
+    def check(self, project, config, root, analysis: _Analysis | None = None):
+        a = analysis or _Analysis(project)
+        findings = []
+        for rel, idx in project.modules.items():
+            findings.extend(self._check_class_attrs(a, rel, idx))
+            findings.extend(self._check_locals(a, rel, idx))
+        return findings
+
+    def _check_class_attrs(self, a: _Analysis, rel, idx):
+        out = []
+        for cls in idx.classes:
+            ctor_of: dict[str, tuple] = {}
+            closed: set[str] = set()
+            for qual, fs in idx.functions.items():
+                if fs.cls != cls:
+                    continue
+                for target, ctor, line in fs.ctors:
+                    if target.startswith("self."):
+                        ctor_of.setdefault(target[5:], (ctor, line, qual))
+                for c in fs.calls:
+                    parts = c.name.split(".")
+                    if len(parts) == 3 and parts[0] == "self" and parts[2] == "close":
+                        closed.add(parts[1])
+            for attr, (ctor, line, qual) in sorted(ctor_of.items()):
+                if attr in closed:
+                    continue
+                kind = self._handle_kind(a.resolver, rel, ctor)
+                if kind is None:
+                    continue
+                out.append(
+                    self.finding(
+                        rel,
+                        line,
+                        f"`self.{attr}` ({kind}, opened in `{qual}`) is "
+                        f"never closed by any method of {cls}",
+                    )
+                )
+        return out
+
+    def _check_locals(self, a: _Analysis, rel, idx):
+        out = []
+        for qual, fs in idx.functions.items():
+            local = {
+                t: (ctor, line)
+                for t, ctor, line in fs.ctors
+                if not t.startswith("self.")
+            }
+            if not local:
+                continue
+            closed: set[str] = set()
+            escaping = set()
+            for scope in _scope_summaries(idx, qual, fs):
+                escaping.update(scope.escapes)
+                for c in scope.calls:
+                    parts = c.name.split(".")
+                    if len(parts) == 2 and parts[1] == "close":
+                        closed.add(parts[0])
+                    for nm in c.arg_names:
+                        if nm:
+                            escaping.add(nm)
+            for var, (ctor, line) in sorted(local.items()):
+                if var in closed or var in escaping:
+                    continue
+                kind = self._handle_kind(a.resolver, rel, ctor)
+                if kind is None:
+                    continue
+                out.append(
+                    self.finding(
+                        rel,
+                        line,
+                        f"`{var}` ({kind}) is opened but `{qual}` neither "
+                        "closes it nor hands it off",
+                    )
+                )
+        return out
+
+
+RULES = [
+    TM120LockOrderInversion,
+    TM121BlockingWhileHoldingLock,
+    TM130CancellationSwallow,
+    TM131ReceiveDropsPeerAttribution,
+    TM420ServiceNeverStopped,
+    TM421HandleNeverClosed,
+]

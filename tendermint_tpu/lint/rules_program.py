@@ -289,7 +289,7 @@ class TM502UnpinnedDeviceSubmitPath(ProgramRule):
             fs = a.fn(key)
             if fs is None:
                 return None
-            for line, kind, pinned in fs.submits:
+            for line, kind, pinned, *_held in fs.submits:
                 if not pinned:
                     reaches[key] = (line, kind, [])
                     return reaches[key]

@@ -1,0 +1,87 @@
+"""SARIF 2.1.0 output — the GitHub code-scanning surface.
+
+One run, one driver ("tmlint"), one result per finding. The driver's
+``rules`` array carries a descriptor for every rule that actually fired
+(GitHub resolves ``result.ruleId`` against it for the rule help popup);
+emitting only the fired subset keeps the document small and means the
+artifact is self-describing without importing every rule module.
+
+Levels: a finding still failing the gate is ``error``; a baselined one
+is ``note`` — code scanning then shows the ratchet's tail without
+alerting on it. Suppressed findings never reach this layer (the CLI
+filters them exactly as for the text formats).
+"""
+from __future__ import annotations
+
+from tendermint_tpu.lint.findings import Finding
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def to_sarif(findings: list[Finding], rules: list) -> dict:
+    """SARIF document for `findings`. `rules` is the active rule
+    instances (per-file + program) — source of the descriptors."""
+    by_code = {}
+    for r in rules:
+        by_code.setdefault(r.code, r)
+    fired = sorted({f.code for f in findings})
+    descriptors = []
+    index_of: dict[str, int] = {}
+    for code in fired:
+        rule = by_code.get(code)
+        desc = {
+            "id": code,
+            "name": getattr(rule, "name", "") or code,
+            "shortDescription": {"text": getattr(rule, "name", "") or code},
+        }
+        help_text = getattr(rule, "help", "")
+        if help_text:
+            desc["fullDescription"] = {"text": help_text}
+        index_of[code] = len(descriptors)
+        descriptors.append(desc)
+    results = []
+    for f in findings:
+        message = f.message + (f" — hint: {f.hint}" if f.hint else "")
+        results.append(
+            {
+                "ruleId": f.code,
+                "ruleIndex": index_of[f.code],
+                "level": "note" if f.baselined else "error",
+                "message": {"text": message},
+                "locations": [
+                    {
+                        "physicalLocation": {
+                            "artifactLocation": {
+                                "uri": f.path,
+                                "uriBaseId": "SRCROOT",
+                            },
+                            "region": {
+                                "startLine": max(1, f.line),
+                                "startColumn": f.col + 1,
+                            },
+                        }
+                    }
+                ],
+            }
+        )
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "tmlint",
+                        "informationUri": "docs/lint.md",
+                        "rules": descriptors,
+                    }
+                },
+                "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+                "results": results,
+            }
+        ],
+    }

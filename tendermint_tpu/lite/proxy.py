@@ -511,4 +511,9 @@ async def run_lite_proxy(
     logger.info("lite proxy listening", laddr=listen_addr, chain_id=chain_id)
     import asyncio
 
-    await asyncio.Event().wait()  # serve forever
+    try:
+        await asyncio.Event().wait()  # serve forever
+    finally:
+        # cancellation (Ctrl-C) lands here: close the listener cleanly
+        # so in-flight verified queries are not torn mid-response
+        await server.stop()

@@ -168,6 +168,15 @@ class CListMempool:
 
             self._wal = Group(wal_path)
 
+    def close_wal(self) -> None:
+        """Flush and close the tx WAL (reference clist_mempool.go
+        CloseWAL). Group.write buffers in-process: skipping this on
+        shutdown drops the buffered tail — exactly the txs most recently
+        admitted — and leaks the fd across restart cycles."""
+        if self._wal is not None:
+            self._wal.close()
+            self._wal = None
+
     # -- sizing -------------------------------------------------------------
 
     def __len__(self) -> int:

@@ -629,6 +629,8 @@ class Node(BaseService):
         await self.indexer_service.stop()
         await self.event_bus.stop()
         await self.proxy_app.stop()
+        # after proxy_app: no in-flight CheckTx can append to the WAL now
+        self.mempool.close_wal()
         if getattr(self, "tracer", None) is not None:
             from tendermint_tpu.libs import trace as tmtrace
 

@@ -138,6 +138,9 @@ def test_tmlint_v2_rules_registered():
         "TM401", "TM111",                    # lifecycle + the -race analogue
         "TM501", "TM502",                    # device-dispatch discipline
         "TM601", "TM602", "TM603",           # wire conformance
+        "TM120", "TM121",                    # v3 lock-order dataflow
+        "TM130", "TM131",                    # v3 exception flow
+        "TM420", "TM421",                    # v3 resource lifecycle
     }
     assert expected <= codes, expected - codes
 
