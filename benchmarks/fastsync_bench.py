@@ -187,6 +187,16 @@ async def run(height: int, n_vals: int, txs_per_block: int) -> float:
                         f"ranges={getattr(sync_reactor.pool, '_peers', '?')}")
                 await asyncio.sleep(0.02)
             dt = time.perf_counter() - t0
+            # wire-cost attribution from the syncing switch's own traffic
+            # ledger: every block_response it pulled, payload bytes as
+            # counted at the message boundary (docs/observability.md
+            # "Wire efficiency")
+            fetched_msgs = fetched_bytes = 0
+            for entry in switches[1].traffic.snapshot()["peers"].values():
+                for r in entry["series"]:
+                    if r["dir"] == "recv" and r["type"] == "block_response":
+                        fetched_msgs += r["msgs"]
+                        fetched_bytes += r["bytes"]
         finally:
             await test_util.stop_switches(switches)
             await event_bus.stop()
@@ -198,9 +208,16 @@ async def run(height: int, n_vals: int, txs_per_block: int) -> float:
         f"fast-synced {synced} blocks ({txs_per_block} txs, {n_vals} commit "
         f"sigs each) in {dt:.2f}s: {synced / dt:,.1f} blocks/s, "
         f"{sigs / dt:,.0f} commit-sigs/s verified through the batched "
-        f"verify-ahead path"
+        f"verify-ahead path; {fetched_bytes / 1e6:.2f}MB fetched over "
+        f"{fetched_msgs} block responses"
     )
-    return synced / dt
+    return {
+        "blocks_per_sec": synced / dt,
+        "fetched_msgs": fetched_msgs,
+        "fetched_bytes": fetched_bytes,
+        "blocks_per_fetched_mb":
+            synced / max(1e-9, fetched_bytes / 1e6),
+    }
 
 
 def _table_heights(n_vals: int, sig_budget: int) -> int:
@@ -221,7 +238,11 @@ def table(val_counts=(64, 512, 1024, 2048), sig_budget: int = 20_000,
     for n_vals in val_counts:
         heights = _table_heights(n_vals, sig_budget)
         log(f"--- {n_vals} validators x {heights} heights ---")
-        bps = asyncio.run(run(heights, n_vals, txs_per_block))
+        res = asyncio.run(run(heights, n_vals, txs_per_block))
+        bps = res["blocks_per_sec"]
+        stamp = _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime())
+        source = (f"benchmarks.fastsync_bench --table "
+                  f"({heights}h x {n_vals}v x {txs_per_block}tx)")
         record = {
             "metric": f"fastsync_{n_vals}v_blocks_per_sec",
             "value": round(bps, 2),
@@ -229,20 +250,39 @@ def table(val_counts=(64, 512, 1024, 2048), sig_budget: int = 20_000,
             "validators": n_vals,
             "heights": heights,
             "commit_sigs_per_sec": round(bps * n_vals, 1),
-            "measured_at_utc": _time.strftime(
-                "%Y-%m-%dT%H:%M:%SZ", _time.gmtime()
-            ),
-            "source": f"benchmarks.fastsync_bench --table "
-                      f"({heights}h x {n_vals}v x {txs_per_block}tx)",
+            "measured_at_utc": stamp,
+            "source": source,
         }
         print(_json.dumps(record), flush=True)
         rows.append(record)
+        # wire efficiency of the fetch itself: blocks applied per MB
+        # pulled off the wire (ledger-attributed block_response payload)
+        wire = {
+            "metric": f"fastsync_{n_vals}v_blocks_per_fetched_mb",
+            "value": round(res["blocks_per_fetched_mb"], 2),
+            "unit": "blocks/MB",
+            "validators": n_vals,
+            "heights": heights,
+            "fetched_bytes": res["fetched_bytes"],
+            "fetched_msgs": res["fetched_msgs"],
+            "measured_at_utc": stamp,
+            "source": source,
+        }
+        print(_json.dumps(wire), flush=True)
+        rows.append(wire)
     log("")
-    log(f"{'validators':>10} | {'blocks/s':>9} | {'commit-sigs/s':>13}")
-    log(f"{'-' * 10}-+-{'-' * 9}-+-{'-' * 13}")
+    log(f"{'validators':>10} | {'blocks/s':>9} | {'commit-sigs/s':>13} | "
+        f"{'blocks/MB':>9}")
+    log(f"{'-' * 10}-+-{'-' * 9}-+-{'-' * 13}-+-{'-' * 9}")
+    by_vals = {r["validators"]: r for r in rows
+               if r["metric"].endswith("blocks_per_fetched_mb")}
     for r in rows:
+        if "commit_sigs_per_sec" not in r:
+            continue
+        wire = by_vals.get(r["validators"], {})
         log(f"{r['validators']:>10} | {r['value']:>9,.1f} | "
-            f"{r['commit_sigs_per_sec']:>13,.0f}")
+            f"{r['commit_sigs_per_sec']:>13,.0f} | "
+            f"{wire.get('value', 0):>9,.1f}")
     return rows
 
 

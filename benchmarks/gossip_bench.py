@@ -1,0 +1,213 @@
+"""Gossip wire-efficiency benchmark — per-channel goodput and framing
+overhead through the real MConnection packet layer (ISSUE 20 tentpole,
+docs/observability.md "Wire efficiency").
+
+Two MConnections run back-to-back over an in-memory duplex pipe — no
+sockets, no SecretConnection, no crypto — so the bench isolates exactly
+the costs the traffic observatory accounts for: packet chunking, framing
+bytes, flush batching, and flowrate-throttle wait. The flood mirrors the
+ingest bench's shape per simulated height: one 4 KB block part (DATA
+0x21, chunked into 4+ packets), a burst of 128 B votes (VOTE 0x22), and
+a tx-dominated mempool burst of 256 B txs (MEMPOOL 0x30).
+
+Every record is bench_compare-compatible JSONL on stdout (banked as
+`NET_r*.json`): per-channel goodput in MB/s (gated, higher-is-better)
+plus informational framing-overhead and throttle-wait records
+(`gate: false` — they swing with flood shape, not with regressions).
+
+Usage: python -m benchmarks.gossip_bench [heights] (default 200)
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+import time
+
+from tendermint_tpu.p2p.base_reactor import ChannelDescriptor
+from tendermint_tpu.p2p.conn.connection import MConnConfig, MConnection
+
+CH_DATA = 0x21
+CH_VOTE = 0x22
+CH_MEMPOOL = 0x30
+
+# ingest flood shape per simulated height (tx-dominated, like the
+# ingest bench's admission storm)
+BLOCK_PART_BYTES = 4096
+VOTES_PER_HEIGHT = 8
+VOTE_BYTES = 128
+TXS_PER_HEIGHT = 64
+TX_BYTES = 256
+
+CHANNEL_NAMES = {CH_DATA: "block_part", CH_VOTE: "vote", CH_MEMPOOL: "tx"}
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+class _PipeConn:
+    """In-memory half of a duplex link with the SecretConnection surface
+    MConnection needs (write/drain/read_msg/close), minus the crypto.
+    Each write is one message-layer frame, exactly like the encrypted
+    transport's length-prefixed packets."""
+
+    def __init__(self) -> None:
+        self._rx: asyncio.Queue[bytes | None] = asyncio.Queue()
+        self.peer: _PipeConn | None = None
+        self.wire_bytes = 0  # everything written, payload + framing
+
+    async def write(self, data: bytes) -> None:
+        self.wire_bytes += len(data)
+        await self.peer._rx.put(bytes(data))
+
+    async def drain(self) -> None:
+        pass
+
+    async def read_msg(self) -> bytes:
+        pkt = await self._rx.get()
+        if pkt is None:
+            raise ConnectionError("pipe closed")
+        return pkt
+
+    def close(self) -> None:
+        self._rx.put_nowait(None)
+        if self.peer is not None:
+            self.peer._rx.put_nowait(None)
+
+
+def _pipe_pair() -> tuple[_PipeConn, _PipeConn]:
+    a, b = _PipeConn(), _PipeConn()
+    a.peer, b.peer = b, a
+    return a, b
+
+
+async def run(heights: int) -> dict:
+    descs = [
+        ChannelDescriptor(CH_DATA, priority=10, send_queue_capacity=200),
+        ChannelDescriptor(CH_VOTE, priority=10, send_queue_capacity=400),
+        ChannelDescriptor(CH_MEMPOOL, priority=5, send_queue_capacity=2000),
+    ]
+    # default send_rate (5 MB/s, config.go:473) so the throttle path is
+    # on the clock like a real link; tight flush so the bench measures
+    # the wire, not the batching timer
+    cfg = MConnConfig(flush_throttle=0.005)
+    conn_a, conn_b = _pipe_pair()
+
+    recv: dict[int, list[int]] = {d.id: [0, 0] for d in descs}  # msgs, bytes
+    done = asyncio.Event()
+    expect_msgs = heights * (1 + VOTES_PER_HEIGHT + TXS_PER_HEIGHT)
+
+    async def on_receive(ch_id: int, msg: bytes) -> None:
+        row = recv[ch_id]
+        row[0] += 1
+        row[1] += len(msg)
+        if sum(r[0] for r in recv.values()) >= expect_msgs:
+            done.set()
+
+    async def on_error(e: Exception) -> None:
+        raise AssertionError(e) from e
+
+    async def sink_error(e: Exception) -> None:
+        pass
+
+    sender = MConnection(conn_a, descs, lambda c, m: asyncio.sleep(0),
+                         sink_error, cfg)
+    receiver = MConnection(conn_b, descs, on_receive, on_error, cfg)
+    await sender.start()
+    await receiver.start()
+    try:
+        t0 = time.perf_counter()
+        part = b"\xbb" * BLOCK_PART_BYTES
+        vote = b"\x06" + b"\xcc" * (VOTE_BYTES - 1)
+        tx = b"\x01" + b"\xdd" * (TX_BYTES - 1)
+        for _ in range(heights):
+            await sender.send(CH_DATA, part)
+            for _ in range(VOTES_PER_HEIGHT):
+                await sender.send(CH_VOTE, vote)
+            for _ in range(TXS_PER_HEIGHT):
+                await sender.send(CH_MEMPOOL, tx)
+        await asyncio.wait_for(done.wait(), 300.0)
+        dt = time.perf_counter() - t0
+        snap = sender.traffic_snapshot()
+    finally:
+        await sender.stop()
+        await receiver.stop()
+
+    payload = sum(r[1] for r in recv.values())
+    wire = conn_a.wire_bytes
+    return {
+        "dt": dt,
+        "recv": recv,
+        "payload_bytes": payload,
+        "wire_bytes": wire,
+        "framing_bytes": snap["sent_framing_bytes"],
+        "throttle_wait_s": snap["throttle_wait_s"],
+        "channels": snap["channels"],
+        "msgs": sum(r[0] for r in recv.values()),
+    }
+
+
+def records(res: dict, heights: int) -> list[dict]:
+    stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    source = (f"benchmarks.gossip_bench heights={heights} "
+              f"(part={BLOCK_PART_BYTES}B, {VOTES_PER_HEIGHT}x{VOTE_BYTES}B "
+              f"votes, {TXS_PER_HEIGHT}x{TX_BYTES}B txs per height)")
+    base = {"platform": "cpu", "device_kind": "cpu",
+            "measured_at_utc": stamp, "source": source}
+    dt = res["dt"]
+    out = []
+    for ch_id, (msgs, nbytes) in sorted(res["recv"].items()):
+        name = CHANNEL_NAMES[ch_id]
+        chan = res["channels"].get(f"{ch_id:#04x}", {})
+        out.append({
+            "metric": f"gossip_{name}_goodput_mb_per_s",
+            "value": round(nbytes / 1e6 / dt, 3),
+            "unit": "MB/s",
+            "msgs": msgs,
+            "msgs_per_sec": round(msgs / dt, 1),
+            "packets": chan.get("sent_packets", 0),
+            **base,
+        })
+    out.append({
+        "metric": "gossip_total_msgs_per_sec",
+        "value": round(res["msgs"] / dt, 1),
+        "unit": "msgs/s",
+        "payload_mb_per_sec": round(res["payload_bytes"] / 1e6 / dt, 3),
+        **base,
+    })
+    # overhead records are informational (gate: false): they track the
+    # flood shape, and bench_compare would read "% went up" as a win
+    out.append({
+        "metric": "gossip_framing_overhead_pct",
+        "value": round(100.0 * res["framing_bytes"]
+                       / max(1, res["wire_bytes"]), 3),
+        "unit": "%",
+        "framing_bytes": res["framing_bytes"],
+        "wire_bytes": res["wire_bytes"],
+        "gate": False,
+        **base,
+    })
+    out.append({
+        "metric": "gossip_throttle_wait_ms",
+        "value": round(res["throttle_wait_s"] * 1e3, 3),
+        "unit": "ms",
+        "gate": False,
+        **base,
+    })
+    return out
+
+
+def main(argv: list[str]) -> None:
+    heights = int(argv[1]) if len(argv) > 1 else 200
+    res = asyncio.run(run(heights))
+    log(f"gossip flood: {res['msgs']} msgs "
+        f"({res['payload_bytes'] / 1e6:.2f}MB payload, "
+        f"{res['framing_bytes'] / 1e3:.1f}KB framing) in {res['dt']:.2f}s; "
+        f"throttle wait {res['throttle_wait_s'] * 1e3:.0f}ms")
+    for rec in records(res, heights):
+        print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv)

@@ -591,6 +591,71 @@ def scenario_txlife(net: ProcTestnet) -> None:
 scenario_txlife.self_start = True  # rewrites configs before any node starts
 
 
+def scenario_traffic(net: ProcTestnet) -> None:
+    """Wire-efficiency acceptance (ISSUE 20): with committed traffic on a
+    4-node net, two collector polls (the second rides the traffic_seq
+    cursor) stitch a fully-populated bandwidth matrix — every node
+    reports nonzero bytes both ways against every other node — with live
+    per-type vote and tx series on every node, a gossip amplification
+    factor within the redundancy invariant bound, and clean fleet
+    invariants. The report lands in <root>/fleet_report.json (preserved
+    on failure for the CI artifact upload)."""
+    net.wait_all(2)
+    # committed traffic so the mempool tx series is live fleet-wide
+    for i in range(3):
+        tx = "0x" + f"tr{os.getpid()}k{i}=1".encode().hex()
+        res = net.rpc(0, f"broadcast_tx_commit?tx={tx}", timeout=30.0)
+        assert res is not None and res.get("deliver_tx", {}).get("code", 1) == 0, res
+    net.wait_all(int(res["height"]) + 2)
+
+    from tendermint_tpu.tools.collector import FleetCollector, render_text
+
+    endpoints = [f"http://127.0.0.1:{net.rpc_port(i)}" for i in range(net.n)]
+    fc = FleetCollector(endpoints, timeout=10.0)
+    fc.poll()
+    time.sleep(1.5)
+    # second incremental poll: the ledger read resumes from the seq
+    # cursor, and the accumulated (cumulative) rows must not shrink
+    fc.poll()
+    report = fc.report(commit_spread_s=5.0)
+    report_path = os.path.join(net.root, "fleet_report.json")
+    with open(report_path, "w", encoding="utf-8") as f:
+        json.dump(report, f, indent=1, sort_keys=True, default=str)
+
+    traffic = report["traffic"]
+    matrix = traffic["matrix"]
+    monikers = {n["moniker"] for n in report["nodes"]}
+    assert len(matrix) == net.n, sorted(matrix)
+    per_node_types: dict[str, dict] = {}
+    for obs, row in matrix.items():
+        # fully populated: every other node present, bytes both ways
+        assert set(row) == monikers - {obs}, (obs, sorted(row))
+        agg: dict[str, int] = {}
+        for remote, cell in row.items():
+            assert cell["sent_bytes"] > 0 and cell["recv_bytes"] > 0, (
+                obs, remote, cell
+            )
+            for mtype, bt in cell["by_type"].items():
+                agg[mtype] = (agg.get(mtype, 0) + bt["sent_msgs"]
+                              + bt["recv_msgs"])
+        per_node_types[obs] = agg
+    for obs, agg in per_node_types.items():
+        assert agg.get("vote", 0) > 0, (obs, agg)
+        assert agg.get("tx", 0) > 0, (obs, agg)
+    # gossip redundancy within the invariant bound (the same bound
+    # check_invariants enforces — assert the inputs are live too)
+    amp = traffic["amplification"]["vote"]
+    assert amp["delivered"] > 0, amp
+    assert amp["amplification"] <= max(2.0, float(net.n)), amp
+    assert not report["violations"], report["violations"]
+    print(render_text(report))
+    print(
+        f"traffic: {net.n}x{net.n} matrix stitched, vote amplification "
+        f"x{amp['amplification']} ({amp['delivered']} delivered, "
+        f"{amp['redundant']} redundant), invariants clean"
+    )
+
+
 def scenario_budget(net: ProcTestnet) -> None:
     """Device-efficiency acceptance (ISSUE 17): on a live committing net
     the collector's --budget plane decomposes every stitched height's
@@ -1007,6 +1072,7 @@ SCENARIOS = {
     "metrics": scenario_metrics,
     "timeline": scenario_timeline,
     "txlife": scenario_txlife,
+    "traffic": scenario_traffic,
     "budget": scenario_budget,
     "stream": scenario_stream,
     "transfer": scenario_transfer,

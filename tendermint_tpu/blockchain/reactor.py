@@ -61,6 +61,17 @@ class StatusResponseMessage:
     height: int
 
 
+# tag byte -> traffic-accounting label (wire-efficiency observatory);
+# shared by the v0 and v1 reactors, which speak the same codec
+BC_TYPE_LABELS: dict[int, str] = {
+    1: "block_request",
+    2: "block_response",
+    3: "no_block_response",
+    4: "status_request",
+    5: "status_response",
+}
+
+
 def encode_bc_message(msg) -> bytes:
     w = Writer()
     if isinstance(msg, BlockRequestMessage):
@@ -98,6 +109,8 @@ def decode_bc_message(data: bytes):
 
 
 class BlockchainReactor(BaseReactor):
+    traffic_family = "blockchain"
+
     def __init__(
         self,
         state,  # state.State snapshot at boot
@@ -150,6 +163,9 @@ class BlockchainReactor(BaseReactor):
                 recv_message_capacity=1 << 22,
             )
         ]
+
+    def classify(self, ch_id: int, msg: bytes) -> str:
+        return BC_TYPE_LABELS.get(msg[0], "other") if msg else "other"
 
     async def on_start(self) -> None:
         if self.fast_sync:
@@ -231,6 +247,11 @@ class BlockchainReactor(BaseReactor):
                     encode_bc_message(NoBlockResponseMessage(msg.height)),
                 )
         elif isinstance(msg, BlockResponseMessage):
+            req = self.pool.requesters.get(msg.block.header.height)
+            if req is None or req.block is not None or req.peer_id != peer.id:
+                # unsolicited, already-filled, or wrong-peer response: the
+                # pool will drop it, but the block's bytes were spent
+                self.note_redundant(peer, "block")
             self.pool.add_block(peer.id, msg.block, len(msg_bytes))
         elif isinstance(msg, StatusRequestMessage):
             await peer.send(

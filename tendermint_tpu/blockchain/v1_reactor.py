@@ -10,6 +10,7 @@ import asyncio
 
 from tendermint_tpu.behaviour import PeerBehaviour
 from tendermint_tpu.blockchain.reactor import (
+    BC_TYPE_LABELS,
     BLOCKCHAIN_CHANNEL,
     BlockRequestMessage,
     BlockResponseMessage,
@@ -32,6 +33,8 @@ STATUS_INTERVAL = 10.0
 
 
 class BlockchainReactorV1(BaseReactor):
+    traffic_family = "blockchain"
+
     def __init__(self, state, block_exec, block_store, fast_sync: bool, logger: Logger = NOP) -> None:
         super().__init__("BlockchainReactorV1")
         self.state = state
@@ -48,6 +51,9 @@ class BlockchainReactorV1(BaseReactor):
                 recv_message_capacity=1 << 22,
             )
         ]
+
+    def classify(self, ch_id: int, msg: bytes) -> str:
+        return BC_TYPE_LABELS.get(msg[0], "other") if msg else "other"
 
     async def on_start(self) -> None:
         if self.fast_sync:
@@ -123,6 +129,10 @@ class BlockchainReactorV1(BaseReactor):
                 )
             )
         elif isinstance(msg, BlockResponseMessage):
+            if self.block_store.height() >= msg.block.header.height:
+                # already stored (late or duplicate response): the FSM
+                # drops it, but the block's bytes were spent on the wire
+                self.note_redundant(peer, "block")
             await self._run_effects(
                 self.fsm.handle(Event.BLOCK_RESPONSE, peer_id=peer.id, block=msg.block)
             )

@@ -94,6 +94,21 @@ _TAGS: list[tuple[int, type]] = [
     (9, VoteSetBitsMessage),
 ]
 
+# tag byte -> traffic-accounting label (wire-efficiency observatory);
+# tags are unique across all four consensus channels, so one map serves
+# STATE/DATA/VOTE/VOTE_SET_BITS alike
+TYPE_LABELS: dict[int, str] = {
+    1: "new_round_step",
+    2: "new_valid_block",
+    3: "proposal",
+    4: "proposal_pol",
+    5: "block_part",
+    6: "vote",
+    7: "has_vote",
+    8: "vote_set_maj23",
+    9: "vote_set_bits",
+}
+
 
 def encode_consensus_message(msg) -> bytes:
     w = Writer()

@@ -257,6 +257,8 @@ def _votes_get(votes, idx: int):
 
 
 class ConsensusReactor(BaseReactor):
+    traffic_family = "consensus"
+
     def __init__(self, cs: ConsensusState, fast_sync: bool = False, logger: Logger = NOP) -> None:
         super().__init__("ConsensusReactor")
         self.cs = cs
@@ -356,6 +358,10 @@ class ConsensusReactor(BaseReactor):
             ChannelDescriptor(VOTE_SET_BITS_CHANNEL, priority=1, send_queue_capacity=2),
         ]
 
+    def classify(self, ch_id: int, msg: bytes) -> str:
+        # tags are unique across all four consensus channels; one peek
+        return m.TYPE_LABELS.get(msg[0], "other") if msg else "other"
+
     def init_peer(self, peer) -> None:
         peer.set(PeerState.KEY, PeerState(peer))
 
@@ -443,6 +449,15 @@ class ConsensusReactor(BaseReactor):
         elif isinstance(msg, m.ProposalPOLMessage):
             ps.apply_proposal_pol(msg)
         elif isinstance(msg, m.BlockPartMessage):
+            rs = self.cs.rs
+            if (
+                msg.height == rs.height
+                and rs.proposal_block_parts is not None
+                and rs.proposal_block_parts.bit_array().get_index(msg.part.index)
+            ):
+                # part already held: a normal gossip race (two peers both
+                # saw the gap), but pure wire waste — count it
+                self.note_redundant(peer, "block_part")
             ps.set_has_proposal_block_part(msg.height, msg.round, msg.part.index)
             await self.report(peer, PeerBehaviour.block_part(peer.id))
             await self.cs.send_peer_msg(msg, peer.id)
@@ -466,6 +481,18 @@ class ConsensusReactor(BaseReactor):
                 "consensus", "vote_recv", height=v.height, round=v.round,
                 type=int(v.type), val=v.validator_index, peer=peer.id,
             )
+            if v.height == rs.height and rs.votes is not None:
+                vs = (
+                    rs.votes.prevotes(v.round)
+                    if v.type == VoteType.PREVOTE
+                    else rs.votes.precommits(v.round)
+                )
+                if vs is not None and vs.votes_bit_array.get_index(
+                    v.validator_index
+                ):
+                    # already counted via another peer: the redundancy the
+                    # gossip amplification factor measures
+                    self.note_redundant(peer, "vote")
             ps.set_has_vote(v.height, v.round, v.type, v.validator_index)
             # ADR-039 good behaviour: decodable votes keep the peer's
             # trust metric fed (float ops only on this hot path)

@@ -39,6 +39,8 @@ def decode_evidence_message(data: bytes) -> list:
 
 
 class EvidenceReactor(BaseReactor):
+    traffic_family = "evidence"
+
     def __init__(self, pool: EvidencePool, logger: Logger = NOP) -> None:
         super().__init__("EvidenceReactor")
         self.pool = pool
@@ -47,6 +49,9 @@ class EvidenceReactor(BaseReactor):
 
     def get_channels(self) -> list[ChannelDescriptor]:
         return [ChannelDescriptor(EVIDENCE_CHANNEL, priority=5, recv_message_capacity=1 << 20)]
+
+    def classify(self, ch_id: int, msg: bytes) -> str:
+        return "evidence" if msg and msg[0] == 1 else "other"
 
     async def add_peer(self, peer) -> None:
         self._peer_tasks[peer.id] = self.spawn(
@@ -68,6 +73,11 @@ class EvidenceReactor(BaseReactor):
             )
             return
         for ev in evs:
+            if self.pool.is_pending(ev) or self.pool.is_committed(ev):
+                # already held or already punished: the delivery carried
+                # nothing new (normal gossip echo, but wire waste)
+                self.note_redundant(peer, "evidence")
+                continue
             try:
                 self.pool.add_evidence(ev)
             except EvidenceError as e:

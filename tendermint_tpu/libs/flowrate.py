@@ -29,10 +29,12 @@ class Monitor:
     """EMA rate monitor; `limit()` returns how many bytes may be transferred
     now to stay under a target rate (token-bucket style)."""
 
-    def __init__(self, sample_period: float = 0.1, window: float = 1.0) -> None:
+    def __init__(self, sample_period: float = 0.1, window: float = 1.0,
+                 clock=time.monotonic) -> None:
         self._period = sample_period
         self.window = window
-        self._start = time.monotonic()
+        self._clock = clock
+        self._start = clock()
         self._last = self._start
         self._sample_start = self._start
         self._sample_bytes = 0
@@ -41,10 +43,11 @@ class Monitor:
         self._cur_rate = 0.0
         self._peak = 0.0
 
-    def update(self, n: int) -> None:
-        now = time.monotonic()
-        self._total += n
-        self._sample_bytes += n
+    def _tick(self, now: float) -> None:
+        """Fold the pending sample window into the EMA. Called from every
+        read path too, so an idle period contributes zero-byte samples and
+        the windowed rate DECAYS instead of holding the last burst value
+        until the next update()."""
         elapsed = now - self._sample_start
         if elapsed >= self._period:
             rate = self._sample_bytes / elapsed
@@ -54,6 +57,12 @@ class Monitor:
             self._samples += 1
             self._sample_start = now
             self._sample_bytes = 0
+
+    def update(self, n: int) -> None:
+        now = self._clock()
+        self._total += n
+        self._sample_bytes += n
+        self._tick(now)
         self._last = now
 
     def limit(self, want: int, rate_limit: float) -> int:
@@ -65,14 +74,24 @@ class Monitor:
         the same way)."""
         if rate_limit <= 0:
             return want
-        now = time.monotonic()
+        now = self._clock()
         elapsed = max(now - self._start, 1e-9)
         credit = rate_limit * elapsed - self._total
         credit = min(credit, rate_limit * self.window)
         return max(0, min(want, int(credit)))
 
+    def utilization(self, rate_cap: float) -> float:
+        """Current windowed rate as a fraction of the configured cap
+        (0.0 when uncapped). Read-path ticking means a gone-quiet link
+        reports ~0, not its last burst."""
+        self._tick(self._clock())
+        if rate_cap <= 0:
+            return 0.0
+        return self._cur_rate / rate_cap
+
     def status(self) -> Status:
-        now = time.monotonic()
+        now = self._clock()
+        self._tick(now)
         dur = now - self._start
         return Status(
             bytes=self._total,

@@ -295,6 +295,36 @@ class P2PMetrics:
         self.dial_failures_total = c.counter(
             "p2p", "dial_failures_total", "Failed outbound dial attempts"
         )
+        # wire-efficiency observatory (docs/observability.md "Wire
+        # efficiency"): per-(channel, message-type) traffic, redundant
+        # deliveries per reactor, and the link-pressure gauges fed from
+        # the 1 Hz sampler via Switch.sample_traffic_gauges
+        self.msg_sent_total = c.counter(
+            "p2p", "msg_sent_total", "Messages sent per channel and type"
+        )
+        self.msg_sent_bytes = c.counter(
+            "p2p", "msg_sent_bytes", "Payload bytes sent per channel and type"
+        )
+        self.msg_received_total = c.counter(
+            "p2p", "msg_received_total", "Messages received per channel and type"
+        )
+        self.msg_received_bytes = c.counter(
+            "p2p", "msg_received_bytes",
+            "Payload bytes received per channel and type",
+        )
+        self.redundant_received_total = c.counter(
+            "p2p", "redundant_received_total",
+            "Deliveries that carried nothing new (vote already counted, "
+            "block part already held, tx already cached...)",
+        )
+        self.send_queue_depth = c.gauge(
+            "p2p", "send_queue_depth",
+            "Per-peer per-channel send-queue occupancy",
+        )
+        self.flowrate_utilization = c.gauge(
+            "p2p", "flowrate_utilization",
+            "Windowed link rate as a fraction of the configured cap",
+        )
 
 
 class EvidenceMetrics:

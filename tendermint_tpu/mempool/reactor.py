@@ -37,6 +37,8 @@ def decode_tx_message(data: bytes) -> bytes:
 
 
 class MempoolReactor(BaseReactor):
+    traffic_family = "mempool"
+
     def __init__(
         self,
         mempool: CListMempool,
@@ -62,6 +64,9 @@ class MempoolReactor(BaseReactor):
 
     def get_channels(self) -> list[ChannelDescriptor]:
         return [ChannelDescriptor(MEMPOOL_CHANNEL, priority=5, recv_message_capacity=1 << 20)]
+
+    def classify(self, ch_id: int, msg: bytes) -> str:
+        return "tx" if msg and msg[0] == 1 else "other"
 
     async def add_peer(self, peer) -> None:
         if self.broadcast:
@@ -96,7 +101,9 @@ class MempoolReactor(BaseReactor):
         try:
             res = await self.mempool.check_tx(tx, sender=peer.id)
         except TxInCacheError:
-            pass  # dup: normal gossip echo (reference :170)
+            # dup: normal gossip echo (reference :170) — but wire spend
+            # for nothing, so it counts toward gossip amplification
+            self.note_redundant(peer, "tx")
         except MempoolError:
             pass  # full: our problem, not the peer's
         else:

@@ -720,6 +720,10 @@ class Node(BaseService):
             dedup = getattr(getattr(self.mempool, "cache", None), "_map", None)
             if dedup is not None:
                 rm.mempool_cache_size.set(len(dedup))
+            # wire-efficiency gauges (send-queue depth, flowrate
+            # utilization) + the sendq-stall tracker behind health()'s
+            # p2p_sendqueue_stalled — queue occupancy has no event site
+            self.switch.sample_traffic_gauges()
             # device memory watermarks: only when the ops stack already
             # pulled jax in (never import it from the sampler)
             prof_mod = _sys.modules.get("tendermint_tpu.device.profiler")

@@ -22,9 +22,14 @@ class ChannelDescriptor:
 
 
 class BaseReactor(BaseService):
+    #: short family label for traffic accounting — the {reactor} label on
+    #: tm_p2p_redundant_received_total and the ledger's redundancy key
+    traffic_family = "other"
+
     def __init__(self, name: str) -> None:
         super().__init__(name=name)
         self.switch = None  # set by Switch.add_reactor
+        self._redundant_ctrs: dict[str, object] = {}
 
     def set_switch(self, switch) -> None:
         self.switch = switch
@@ -42,6 +47,35 @@ class BaseReactor(BaseService):
             await report_behaviour(behaviour, peer=peer)
         elif behaviour.is_error and peer is not None:
             await sw.stop_peer_for_error(peer, behaviour.reason)
+
+    def classify(self, ch_id: int, msg: bytes) -> str:
+        """Cheap message-type label for the (peer, channel, type) traffic
+        rollup — typically one tag-byte peek, never a full decode. Must
+        not raise on garbage: unknown frames are 'other' (the decode path
+        reports them as behaviour, not the accountant)."""
+        return "other"
+
+    def note_redundant(self, peer, kind: str, n: int = 1) -> None:
+        """Report a delivery that carried nothing new (vote already
+        counted, block part already held, tx already cached...). Feeds
+        the switch's traffic ledger and the redundant-received counter;
+        a no-op under stub switches without the traffic plane."""
+        sw = self.switch
+        if sw is None or n <= 0:
+            return
+        ledger = getattr(sw, "traffic", None)
+        if ledger is not None:
+            pid = peer.id if peer is not None else "?"
+            ledger.note_redundant(pid, self.traffic_family, kind, n)
+        m = getattr(sw, "metrics", None)
+        if m is not None:
+            ctr = self._redundant_ctrs.get(kind)
+            if ctr is None:
+                ctr = m.redundant_received_total.bind(
+                    reactor=self.traffic_family, kind=kind
+                )
+                self._redundant_ctrs[kind] = ctr
+            ctr.inc(n)
 
     def get_channels(self) -> list[ChannelDescriptor]:
         return []

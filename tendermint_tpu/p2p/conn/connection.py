@@ -53,6 +53,14 @@ class _Channel:
         self.sending: bytes | None = None  # message currently being chunked
         self.sent_offset = 0
         self.max_payload = max_payload
+        # packet-layer traffic accounting (wire-efficiency observatory):
+        # packets vs messages separates chunking cost from payload volume
+        self.sent_msgs = 0
+        self.sent_bytes = 0  # payload only; framing on the MConnection
+        self.sent_packets = 0
+        self.recv_msgs = 0
+        self.recv_bytes = 0
+        self.recv_packets = 0
 
     def is_send_pending(self) -> bool:
         return self.sending is not None or not self.queue.empty()
@@ -98,6 +106,13 @@ class MConnection(BaseService):
         self._send_monitor = Monitor()
         self._recv_monitor = Monitor()
         self._errored = False
+        # link-level overhead accounting: framing = every wire byte that
+        # is not channel payload (packet tags + headers + ping/pong), and
+        # the cumulative time the send routine slept in the flowrate
+        # throttle — the two costs goodput numbers must subtract
+        self.sent_framing_bytes = 0
+        self.recv_framing_bytes = 0
+        self.throttle_wait_s = 0.0
 
     async def on_start(self) -> None:
         self.spawn(self._send_routine(), "mconn-send")
@@ -153,15 +168,23 @@ class MConnection(BaseService):
                 while True:
                     if self._pong_pending:
                         self._pong_pending -= 1
-                        await self._write_packet(Writer().u8(_PKT_PONG).build())
+                        pong = Writer().u8(_PKT_PONG).build()
+                        await self._write_packet(pong)
+                        self.sent_framing_bytes += len(pong)
                         continue
                     ch = self._pick_channel()
                     if ch is None:
                         break
                     chunk, eof = ch.next_packet()
                     w = Writer().u8(_PKT_MSG).u8(ch.desc.id).bool(eof).bytes(chunk)
-                    await self._write_packet(w.build())
+                    pkt = w.build()
+                    await self._write_packet(pkt)
                     ch.recently_sent += len(chunk)
+                    ch.sent_packets += 1
+                    ch.sent_bytes += len(chunk)
+                    self.sent_framing_bytes += len(pkt) - len(chunk)
+                    if eof:
+                        ch.sent_msgs += 1
                     # flush-throttled mid-burst drain (connection.go:74
                     # flushThrottle, default 100ms): a long burst flushes
                     # every flush_throttle seconds — batching writes —
@@ -195,7 +218,9 @@ class MConnection(BaseService):
                 allowed = self._send_monitor.limit(len(pkt), rate)
                 if allowed >= target:
                     break
-                await asyncio.sleep((target - allowed) / rate)
+                wait = (target - allowed) / rate
+                self.throttle_wait_s += wait
+                await asyncio.sleep(wait)
         await self._conn.write(pkt)
         self._send_monitor.update(len(pkt))
 
@@ -209,9 +234,11 @@ class MConnection(BaseService):
                 r = Reader(pkt)
                 tag = r.u8()
                 if tag == _PKT_PING:
+                    self.recv_framing_bytes += len(pkt)
                     self._pong_pending += 1
                     self._send_wake.set()
                 elif tag == _PKT_PONG:
+                    self.recv_framing_bytes += len(pkt)
                     self._last_pong = time.monotonic()
                 elif tag == _PKT_MSG:
                     ch_id = r.u8()
@@ -220,6 +247,9 @@ class MConnection(BaseService):
                     ch = self._channels.get(ch_id)
                     if ch is None:
                         raise DecodeError(f"packet on unknown channel {ch_id:#x}")
+                    ch.recv_packets += 1
+                    ch.recv_bytes += len(data)
+                    self.recv_framing_bytes += len(pkt) - len(data)
                     ch.recving += data
                     if len(ch.recving) > ch.desc.recv_message_capacity:
                         raise DecodeError(
@@ -227,6 +257,7 @@ class MConnection(BaseService):
                             f"{ch.desc.recv_message_capacity}"
                         )
                     if eof:
+                        ch.recv_msgs += 1
                         msg = bytes(ch.recving)
                         ch.recving.clear()
                         await self._on_receive(ch_id, msg)
@@ -243,7 +274,9 @@ class MConnection(BaseService):
         try:
             while True:
                 await asyncio.sleep(self.config.ping_interval)
-                await self._write_packet(Writer().u8(_PKT_PING).build())
+                ping = Writer().u8(_PKT_PING).build()
+                await self._write_packet(ping)
+                self.sent_framing_bytes += len(ping)
                 await self._conn.drain()
                 await asyncio.sleep(self.config.pong_timeout)
                 if time.monotonic() - self._last_pong > (
@@ -276,3 +309,32 @@ class MConnection(BaseService):
             )
             for ch in self._channels.values()
         ]
+
+    def traffic_snapshot(self) -> dict:
+        """Packet-layer accounting for debug_traffic: per-channel
+        msgs/packets/payload-bytes both ways plus queue depth, and the
+        link-level framing/throttle/utilization costs."""
+        return {
+            "channels": {
+                f"{ch.desc.id:#04x}": {
+                    "sent_msgs": ch.sent_msgs,
+                    "sent_packets": ch.sent_packets,
+                    "sent_bytes": ch.sent_bytes,
+                    "recv_msgs": ch.recv_msgs,
+                    "recv_packets": ch.recv_packets,
+                    "recv_bytes": ch.recv_bytes,
+                    "send_queue_size": ch.queue.qsize(),
+                    "send_queue_capacity": ch.desc.send_queue_capacity,
+                }
+                for ch in self._channels.values()
+            },
+            "sent_framing_bytes": self.sent_framing_bytes,
+            "recv_framing_bytes": self.recv_framing_bytes,
+            "throttle_wait_s": round(self.throttle_wait_s, 6),
+            "send_utilization": round(
+                self._send_monitor.utilization(self.config.send_rate), 4
+            ),
+            "recv_utilization": round(
+                self._recv_monitor.utilization(self.config.recv_rate), 4
+            ),
+        }

@@ -37,6 +37,15 @@ class Peer(BaseService):
         self.metrics = None
         self._send_ctrs: dict[int, object] = {}
         self._recv_ctrs: dict[int, object] = {}
+        # wire-efficiency observatory, set by the switch alongside
+        # metrics: the per-switch TrafficLedger and the reactor-boundary
+        # classify dispatcher (ch_id, msg) -> message-type label. The
+        # send side is attributed here (the only place that sees every
+        # outbound message); the receive side rolls up in the switch's
+        # _route_receive, which already resolves the reactor.
+        self.traffic = None
+        self.classify = None
+        self._send_msg_ctrs: dict[tuple[int, str], tuple] = {}
 
         async def _recv(ch_id: int, msg: bytes) -> None:
             if self.metrics is not None:
@@ -69,18 +78,36 @@ class Peer(BaseService):
             cache[ch_id] = ctr
         ctr.inc(n)
 
-    async def send(self, ch_id: int, msg: bytes) -> bool:
-        ok = await self.mconn.send(ch_id, msg)
-        if ok and self.metrics is not None:
+    def _account_send(self, ch_id: int, msg: bytes) -> None:
+        if self.traffic is None and self.metrics is None:
+            return
+        mtype = self.classify(ch_id, msg) if self.classify is not None else "other"
+        if self.traffic is not None:
+            self.traffic.note_msg(self.id, ch_id, mtype, "sent", len(msg))
+        if self.metrics is not None:
             self._count(self._send_ctrs, self.metrics.peer_send_bytes_total,
                         ch_id, len(msg))
+            pair = self._send_msg_ctrs.get((ch_id, mtype))
+            if pair is None:
+                labels = {"channel": f"{ch_id:#04x}", "type": mtype}
+                pair = (
+                    self.metrics.msg_sent_total.bind(**labels),
+                    self.metrics.msg_sent_bytes.bind(**labels),
+                )
+                self._send_msg_ctrs[(ch_id, mtype)] = pair
+            pair[0].inc()
+            pair[1].inc(len(msg))
+
+    async def send(self, ch_id: int, msg: bytes) -> bool:
+        ok = await self.mconn.send(ch_id, msg)
+        if ok:
+            self._account_send(ch_id, msg)
         return ok
 
     def try_send(self, ch_id: int, msg: bytes) -> bool:
         ok = self.mconn.try_send(ch_id, msg)
-        if ok and self.metrics is not None:
-            self._count(self._send_ctrs, self.metrics.peer_send_bytes_total,
-                        ch_id, len(msg))
+        if ok:
+            self._account_send(ch_id, msg)
         return ok
 
     def set(self, key: str, value) -> None:

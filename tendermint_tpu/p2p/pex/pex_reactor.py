@@ -54,6 +54,8 @@ def decode_pex_message(data: bytes):
 
 
 class PexReactor(BaseReactor):
+    traffic_family = "pex"
+
     def __init__(
         self,
         book: AddrBook,
@@ -70,6 +72,14 @@ class PexReactor(BaseReactor):
     def get_channels(self):
         return [ChannelDescriptor(id=PEX_CHANNEL, priority=1,
                                   recv_message_capacity=64 * 1024)]
+
+    def classify(self, ch_id: int, msg: bytes) -> str:
+        if msg:
+            if msg[0] == _MSG_REQUEST:
+                return "request"
+            if msg[0] == _MSG_ADDRS:
+                return "addrs"
+        return "other"
 
     async def on_start(self) -> None:
         self.spawn(self._ensure_peers_routine(), "pex-ensure")
@@ -129,6 +139,9 @@ class PexReactor(BaseReactor):
                 await self.switch.stop_peer_gracefully(peer)
         else:  # addrs
             if peer.id not in self._requested_of:
+                # unsolicited addrs are dropped whole: everything in the
+                # message was wire waste
+                self.note_redundant(peer, "addrs")
                 await self.report(
                     peer,
                     PeerBehaviour.message_out_of_order(

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import asyncio
 import random
+import time
 
 from typing import TYPE_CHECKING
 
@@ -26,6 +27,7 @@ from tendermint_tpu.p2p.bans import BanTable
 from tendermint_tpu.p2p.dialer import Dialer
 from tendermint_tpu.p2p.netaddress import NetAddress
 from tendermint_tpu.p2p.peer import Peer
+from tendermint_tpu.p2p.traffic import TrafficLedger
 from tendermint_tpu.p2p.trust import TrustMetricStore
 
 if TYPE_CHECKING:  # Transport pulls the crypto stack; keep it type-only
@@ -101,6 +103,14 @@ class Switch(BaseService):
         self._persistent_addrs: dict[str, NetAddress] = {}
         self.addr_book = None  # optional, set by PEX wiring
         self._metrics = None
+        # wire-efficiency observatory: per-switch ledger of
+        # (peer, channel, message-type, direction) message/byte counters
+        # plus redundant deliveries; surfaced by the debug_traffic route
+        self.traffic = TrafficLedger()
+        self._recv_msg_ctrs: dict[tuple[int, str], tuple] = {}
+        # (peer_id, ch_id) -> monotonic t0 when the send queue was first
+        # seen saturated; cleared when it drains (sendq_stall_age)
+        self._sendq_sat: dict[tuple[str, int], float] = {}
         # peer-quality plane: every behaviour report lands in the trust
         # store; the ban decision needs BOTH a below-threshold score and
         # enough accumulated bad weight (one unlucky frame disconnects
@@ -339,6 +349,8 @@ class Switch(BaseService):
             socket_addr=socket_addr,
         )
         peer.metrics = self.metrics  # per-channel byte counters from byte 0
+        peer.traffic = self.traffic  # (peer, channel, type) rollup
+        peer.classify = self._classify  # reactor-boundary type decoder
         for reactor in self.reactors.values():
             reactor.init_peer(peer)
         self.peers.add(peer)
@@ -358,7 +370,34 @@ class Switch(BaseService):
         self.logger.info("added peer %s (%s)", peer, "out" if outbound else "in")
         return peer
 
+    def _classify(self, ch_id: int, msg: bytes) -> str:
+        """Message-type label via the owning reactor's classify hook — a
+        tag-byte peek, not a decode (the traffic plane must stay cheap)."""
+        reactor = self._reactors_by_ch.get(ch_id)
+        if reactor is None:
+            return "other"
+        return reactor.classify(ch_id, msg)
+
+    def _account_receive(self, ch_id: int, peer: Peer, msg: bytes) -> None:
+        mtype = self._classify(ch_id, msg)
+        self.traffic.note_msg(peer.id, ch_id, mtype, "recv", len(msg))
+        if self._metrics is not None:
+            pair = self._recv_msg_ctrs.get((ch_id, mtype))
+            if pair is None:
+                labels = {"channel": f"{ch_id:#04x}", "type": mtype}
+                pair = (
+                    self._metrics.msg_received_total.bind(**labels),
+                    self._metrics.msg_received_bytes.bind(**labels),
+                )
+                self._recv_msg_ctrs[(ch_id, mtype)] = pair
+            pair[0].inc()
+            pair[1].inc(len(msg))
+
     async def _route_receive(self, ch_id: int, peer: Peer, msg: bytes) -> None:
+        # account before dispatch so even rejected/garbage frames show up
+        # in the wire ledger — they cost bandwidth whether or not a
+        # reactor accepts them
+        self._account_receive(ch_id, peer, msg)
         reactor = self._reactors_by_ch.get(ch_id)
         if reactor is None:
             await self.report_behaviour(
@@ -413,6 +452,59 @@ class Switch(BaseService):
     def num_peers(self) -> tuple[int, int]:
         out = sum(1 for p in self.peers.list() if p.outbound)
         return out, len(self.peers) - out
+
+    # --- wire-efficiency observatory -------------------------------------
+
+    def sendq_stall_age(self, now: float | None = None) -> float:
+        """Longest time (s) any peer channel's send queue has stayed
+        saturated, 0.0 when none is. Lazy scan: called by health() and the
+        1 Hz gauge sampler, so a stall older than TMTPU_SENDQ_STALL_S
+        degrades health without a dedicated watcher task."""
+        now = time.monotonic() if now is None else now
+        live: set[tuple[str, int]] = set()
+        for p in self.peers.list():
+            for ch in p.mconn._channels.values():
+                cap = ch.desc.send_queue_capacity
+                if cap > 0 and ch.queue.qsize() >= cap:
+                    key = (p.id, ch.desc.id)
+                    live.add(key)
+                    self._sendq_sat.setdefault(key, now)
+        for key in list(self._sendq_sat):
+            if key not in live:
+                del self._sendq_sat[key]
+        if not self._sendq_sat:
+            return 0.0
+        return max(now - t0 for t0 in self._sendq_sat.values())
+
+    def sample_traffic_gauges(self) -> None:
+        """Feed the send-queue depth and flowrate-utilization gauges from
+        each live MConnection; driven by the node's 1 Hz metrics sampler.
+        Also advances the sendq-stall tracker so health() sees stalls even
+        between its own polls."""
+        self.sendq_stall_age()
+        m = self._metrics
+        if m is None:
+            return
+        for p in self.peers.list():
+            mc = p.mconn
+            pid = p.id[:8]
+            for ch in mc._channels.values():
+                m.send_queue_depth.set(
+                    ch.queue.qsize(), peer=pid, channel=f"{ch.desc.id:#04x}"
+                )
+            m.flowrate_utilization.set(
+                round(mc._send_monitor.utilization(mc.config.send_rate), 4),
+                peer=pid, direction="send",
+            )
+            m.flowrate_utilization.set(
+                round(mc._recv_monitor.utilization(mc.config.recv_rate), 4),
+                peer=pid, direction="recv",
+            )
+
+    def traffic_conn_snapshot(self) -> dict:
+        """Per-peer packet-layer accounting (framing overhead, throttle
+        wait, queue depths, utilization) for debug_traffic."""
+        return {p.id: p.mconn.traffic_snapshot() for p in self.peers.list()}
 
     # --- introspection (debug_p2p route) ---------------------------------
 

@@ -1,0 +1,106 @@
+"""Per-(peer, channel, message-type) traffic ledger — the wire-efficiency
+observatory's accounting core (docs/observability.md "Wire efficiency").
+
+Every message the switch sends or routes is attributed here: the peer it
+crossed, the channel byte, the message type (decoded cheaply at the
+reactor boundary by each reactor's `classify(ch_id, msg)` tag peek), the
+direction, and its payload bytes. Reactors additionally report
+*redundant* deliveries — a vote already counted, a block part already
+held, a tx already in the dedup cache, a duplicate snapshot chunk — so
+gossip amplification (delivered ÷ useful) is measurable per fleet.
+
+The ledger is per-Switch (never process-global): in-process meshes and
+benches run several switches on one loop, and a shared ledger would
+blend their flows. Every mutation stamps a strictly-increasing `seq`
+from one counter, so `snapshot(since_seq)` returns only the series that
+changed after a cursor — the recorder-style incremental-read contract
+the `debug_traffic` RPC route and the fleet collector ride.
+"""
+from __future__ import annotations
+
+import itertools
+
+
+class TrafficLedger:
+    """Cumulative message/byte counters keyed
+    (peer_id, channel, type, direction) plus redundant-delivery counters
+    keyed (peer_id, reactor, kind). Single-threaded by construction (all
+    taps run on the node's event loop)."""
+
+    def __init__(self) -> None:
+        self._seq = itertools.count(1)
+        self.last_seq = 0
+        # (peer_id, ch_id, mtype, direction) -> [msgs, bytes, seq]
+        self._series: dict[tuple[str, int, str, str], list] = {}
+        # (peer_id, reactor, kind) -> [count, seq]
+        self._redundant: dict[tuple[str, str, str], list] = {}
+
+    def note_msg(self, peer_id: str, ch_id: int, mtype: str,
+                 direction: str, nbytes: int) -> None:
+        """Attribute one whole message (chunked or not — the caller taps
+        at the message boundary, so a multi-packet message counts once)."""
+        seq = next(self._seq)
+        self.last_seq = seq
+        row = self._series.get((peer_id, ch_id, mtype, direction))
+        if row is None:
+            self._series[(peer_id, ch_id, mtype, direction)] = [1, nbytes, seq]
+        else:
+            row[0] += 1
+            row[1] += nbytes
+            row[2] = seq
+
+    def note_redundant(self, peer_id: str, reactor: str, kind: str,
+                       n: int = 1) -> None:
+        seq = next(self._seq)
+        self.last_seq = seq
+        row = self._redundant.get((peer_id, reactor, kind))
+        if row is None:
+            self._redundant[(peer_id, reactor, kind)] = [n, seq]
+        else:
+            row[0] += n
+            row[1] = seq
+
+    def snapshot(self, since_seq: int = 0) -> dict:
+        """Per-peer cumulative snapshots of every series that changed
+        after `since_seq` (0 = everything). Values are cumulative, not
+        deltas — a reader that missed polls still converges by replacing
+        each (channel, type, dir) row with the newest one it sees."""
+        peers: dict[str, dict] = {}
+
+        def peer_entry(pid: str) -> dict:
+            return peers.setdefault(pid, {"series": [], "redundant": []})
+
+        for (pid, ch_id, mtype, direction), row in self._series.items():
+            if row[2] <= since_seq:
+                continue
+            peer_entry(pid)["series"].append({
+                "channel": ch_id, "type": mtype, "dir": direction,
+                "msgs": row[0], "bytes": row[1], "seq": row[2],
+            })
+        for (pid, reactor, kind), row in self._redundant.items():
+            if row[1] <= since_seq:
+                continue
+            peer_entry(pid)["redundant"].append({
+                "reactor": reactor, "kind": kind,
+                "count": row[0], "seq": row[1],
+            })
+        return {"seq": self.last_seq, "peers": peers}
+
+    def totals(self) -> dict:
+        """Whole-ledger rollup: per-direction msgs/bytes and the summed
+        redundant count — the cheap health view."""
+        out = {
+            "sent_msgs": 0, "sent_bytes": 0,
+            "recv_msgs": 0, "recv_bytes": 0,
+            "redundant": 0,
+        }
+        for (_pid, _ch, _mt, direction), row in self._series.items():
+            if direction == "sent":
+                out["sent_msgs"] += row[0]
+                out["sent_bytes"] += row[1]
+            else:
+                out["recv_msgs"] += row[0]
+                out["recv_bytes"] += row[1]
+        for row in self._redundant.values():
+            out["redundant"] += row[0]
+        return out

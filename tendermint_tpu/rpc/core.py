@@ -284,6 +284,22 @@ class Environment:
             degraded.append("device_queue_stalled")
         if ingest_stall_s > 0 and oldest_parked > ingest_stall_s:
             degraded.append("mempool_ingest_stalled")
+        # outbound-wire wedge signal: a peer channel's send queue pinned
+        # at capacity past the bound means gossip to that peer is stuck
+        # (dead link the pong timeout hasn't caught, or a throttle set
+        # below the traffic the node must move). Override via
+        # TMTPU_SENDQ_STALL_S; <= 0 disables.
+        try:
+            sendq_stall_s = float(_os.environ.get("TMTPU_SENDQ_STALL_S", "5"))
+        except ValueError:
+            sendq_stall_s = 5.0
+        sendq_age = 0.0
+        if self.p2p_switch is not None:
+            age_fn = getattr(self.p2p_switch, "sendq_stall_age", None)
+            if age_fn is not None:
+                sendq_age = round(age_fn(), 3)
+        if sendq_stall_s > 0 and sendq_age > sendq_stall_s:
+            degraded.append("p2p_sendqueue_stalled")
         if crashes:
             degraded.append("task_crashes")
         # recompile storm (device/profiler): a burst of XLA compiles
@@ -310,6 +326,7 @@ class Environment:
             "loop": loop,
             "breaker": breaker,
             "oldest_parked_tx_age_s": oldest_parked,
+            "sendq_stall_age_s": sendq_age,
             "task_crashes": crashes,
         }
 
@@ -772,6 +789,37 @@ class Environment:
         out["moniker"] = RECORDER.moniker
         out["anchor"] = clock_anchor()
         return out
+
+    async def debug_traffic(self, since_seq: int | None = None) -> dict:
+        """Wire-efficiency observatory (docs/observability.md "Wire
+        efficiency"): the per-(peer, channel, message-type) traffic
+        ledger, redundant-delivery counters per reactor, and each live
+        link's packet-layer accounting (chunking/framing overhead,
+        flowrate-throttle wait, queue depths, utilization).
+
+        Incremental scrape, recorder-style: pass the last `seq` seen as
+        `since_seq` and only ledger rows that changed after it come back.
+        Rows are CUMULATIVE counters, not deltas — a poller that missed
+        a poll converges by replacing each (peer, channel, type, dir)
+        row with the newest one it sees. `conns` is always the full
+        current snapshot (it is small and per-link)."""
+        from tendermint_tpu.libs.recorder import RECORDER, clock_anchor
+
+        sw = self.p2p_switch
+        ledger = getattr(sw, "traffic", None) if sw is not None else None
+        if ledger is None:
+            return {
+                "seq": 0, "peers": {}, "conns": {},
+                "totals": {}, "sendq_stall_age_s": 0.0,
+                "moniker": RECORDER.moniker, "anchor": clock_anchor(),
+            }
+        snap = ledger.snapshot(since_seq=_cursor_arg(since_seq) or 0)
+        snap["conns"] = sw.traffic_conn_snapshot()
+        snap["totals"] = ledger.totals()
+        snap["sendq_stall_age_s"] = round(sw.sendq_stall_age(), 3)
+        snap["moniker"] = RECORDER.moniker
+        snap["anchor"] = clock_anchor()
+        return snap
 
     async def debug_fault(
         self,
@@ -1284,6 +1332,7 @@ class Environment:
             "debug_flight_recorder": self.debug_flight_recorder,
             "debug_tx_lifecycle": self.debug_tx_lifecycle,
             "debug_p2p": self.debug_p2p,
+            "debug_traffic": self.debug_traffic,
             "debug_fault": self.debug_fault,
             "debug_profile": self.debug_profile,
             "broadcast_tx_async": self.broadcast_tx_async,

@@ -57,6 +57,14 @@ from tendermint_tpu.statesync.light import LightBootstrap
 
 # discovery rounds before giving up and falling back to fast sync
 DISCOVERY_ROUNDS = 10
+
+# tag byte -> traffic-accounting label (wire-efficiency observatory)
+SS_TYPE_LABELS: dict[int, str] = {
+    1: "snapshots_request",
+    2: "snapshots_response",
+    3: "chunk_request",
+    4: "chunk_response",
+}
 # fetch attempts per chunk before the whole snapshot is abandoned
 MAX_CHUNK_ATTEMPTS = 8
 
@@ -72,6 +80,8 @@ class RestoreRetryable(Exception):
 
 
 class StateSyncReactor(BaseReactor):
+    traffic_family = "statesync"
+
     def __init__(
         self,
         config,  # config.StateSyncConfig
@@ -117,6 +127,9 @@ class StateSyncReactor(BaseReactor):
                 send_queue_capacity=4, recv_message_capacity=1 << 24,
             ),
         ]
+
+    def classify(self, ch_id: int, msg: bytes) -> str:
+        return SS_TYPE_LABELS.get(msg[0], "other") if msg else "other"
 
     async def on_start(self) -> None:
         if self.enable_sync:
@@ -167,6 +180,10 @@ class StateSyncReactor(BaseReactor):
                     )
                     if self.metrics is not None:
                         self.metrics.snapshots_discovered_total.inc()
+                else:
+                    # already advertised (or rejected/over cap): the
+                    # manifest bytes carried nothing new
+                    self.note_redundant(peer, "snapshot")
         elif isinstance(msg, ChunkRequestMessage):
             await self._serve_chunk(peer, msg)
         elif isinstance(msg, ChunkResponseMessage):
@@ -221,9 +238,13 @@ class StateSyncReactor(BaseReactor):
         key = (msg.height, msg.format, msg.index)
         pending = self._pending.get(key)
         if pending is None or pending[0] != peer.id:
-            return  # unsolicited or stale — a timed-out request's late echo
+            # unsolicited or stale — a timed-out request's late echo; the
+            # chunk bytes were spent for nothing
+            self.note_redundant(peer, "chunk")
+            return
         _, fut = pending
         if fut.done():
+            self.note_redundant(peer, "chunk")
             return
         if msg.missing:
             fut.set_exception(LookupError(f"peer {peer.id} missing chunk"))

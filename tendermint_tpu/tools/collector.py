@@ -60,7 +60,7 @@ TYPE_NAMES = {PREVOTE: "prevote", PRECOMMIT: "precommit"}
 # RPC routes scraped per node, with their query args
 ROUTES = ("status", "health", "validators", "debug_device",
           "debug_consensus_trace", "debug_flight_recorder",
-          "debug_tx_lifecycle")
+          "debug_tx_lifecycle", "debug_traffic")
 
 # libs/txlife.py CORE_STAGES, duplicated so this tool stays importable
 # with zero tendermint_tpu dependencies (it runs on any host with
@@ -108,6 +108,7 @@ def scrape_node(endpoint: str, cursor: dict | None = None,
             f"?n=2000&since_seq={cursor.get('txl_seq', 0)}"
             f"&since_ns={cursor.get('txl_ns', 0)}"
         ),
+        "debug_traffic": f"?since_seq={cursor.get('traffic_seq', 0)}",
     }
     for route in ROUTES:
         try:
@@ -868,6 +869,215 @@ def trace_summary(scrapes: list[dict]) -> dict:
     return out
 
 
+# ------------------------------------------------ wire-efficiency stitching
+
+
+def merge_traffic(acc: dict, snap: dict) -> None:
+    """Fold one cumulative `debug_traffic` snapshot into an accumulator.
+    Ledger rows are cumulative counters, so accumulation is replacement:
+    the newest row per (peer, channel, type, dir) / (peer, reactor, kind)
+    key wins, and a poller that missed polls still converges."""
+    for pid, entry in (snap.get("peers") or {}).items():
+        rows = acc.setdefault("peers", {}).setdefault(
+            pid, {"series": {}, "redundant": {}}
+        )
+        for row in entry.get("series") or []:
+            rows["series"][(row["channel"], row["type"], row["dir"])] = row
+        for row in entry.get("redundant") or []:
+            rows["redundant"][(row["reactor"], row["kind"])] = row
+    for k in ("conns", "totals", "sendq_stall_age_s", "moniker", "anchor"):
+        if snap.get(k) is not None:
+            acc[k] = snap[k]
+    acc["seq"] = max(acc.get("seq", 0), snap.get("seq", 0))
+
+
+def traffic_as_snapshot(acc: dict) -> dict:
+    """Accumulator back to the `debug_traffic` wire shape (row lists)."""
+    peers = {}
+    for pid, rows in (acc.get("peers") or {}).items():
+        peers[pid] = {
+            "series": list(rows["series"].values()),
+            "redundant": list(rows["redundant"].values()),
+        }
+    out = dict(acc)
+    out["peers"] = peers
+    return out
+
+
+def peer_monikers(scrapes: list[dict]) -> dict[str, str]:
+    """node_id -> moniker for every scraped node, so ledger rows keyed by
+    the remote's p2p id resolve to fleet display names."""
+    out = {}
+    for s in scrapes:
+        ni = (s.get("status") or {}).get("node_info") or {}
+        if ni.get("node_id"):
+            out[ni["node_id"]] = ni.get("moniker") or node_name(s)
+    return out
+
+
+def _flow_cell() -> dict:
+    return {"sent_msgs": 0, "sent_bytes": 0, "recv_msgs": 0,
+            "recv_bytes": 0, "by_type": {}}
+
+
+def traffic_matrix(scrapes: list[dict]) -> dict:
+    """Fleet bandwidth matrix: matrix[observer][remote] aggregates the
+    observer's own ledger rows against that remote, split per message
+    type in `by_type`. Both directions come from the observer's ledger
+    (its sent row is the remote's recv row seen from the other side, so
+    every link shows up even when one endpoint was never scraped)."""
+    ids = peer_monikers(scrapes)
+    matrix: dict[str, dict] = {}
+    for s in scrapes:
+        tr = s.get("debug_traffic")
+        if not tr:
+            continue
+        row = matrix.setdefault(node_name(s), {})
+        for pid, entry in (tr.get("peers") or {}).items():
+            cell = row.setdefault(ids.get(pid, pid[:12]), _flow_cell())
+            for r in entry.get("series") or []:
+                d = "sent" if r["dir"] == "sent" else "recv"
+                cell[f"{d}_msgs"] += r["msgs"]
+                cell[f"{d}_bytes"] += r["bytes"]
+                bt = cell["by_type"].setdefault(
+                    r["type"], {"sent_msgs": 0, "sent_bytes": 0,
+                                "recv_msgs": 0, "recv_bytes": 0}
+                )
+                bt[f"{d}_msgs"] += r["msgs"]
+                bt[f"{d}_bytes"] += r["bytes"]
+    return matrix
+
+
+# gossip classes for the amplification factor: message-type label on the
+# wire, (reactor, kind) key of the matching redundancy tap
+TRAFFIC_CLASSES = {
+    "vote": ("vote", ("consensus", "vote")),
+    "tx": ("tx", ("mempool", "tx")),
+}
+
+
+def gossip_amplification(scrapes: list[dict]) -> dict:
+    """Delivered ÷ theoretical-minimum deliveries per gossip class,
+    fleet-wide. The theoretical minimum is one useful delivery per
+    (message, node) — i.e. delivered minus the redundant deliveries the
+    reactors reported — so a perfectly efficient fleet scores 1.0 and
+    every echo raises it."""
+    out = {}
+    for cls, (mtype, red_key) in TRAFFIC_CLASSES.items():
+        delivered = redundant = 0
+        for s in scrapes:
+            tr = s.get("debug_traffic") or {}
+            for entry in (tr.get("peers") or {}).values():
+                for r in entry.get("series") or []:
+                    if r["dir"] == "recv" and r["type"] == mtype:
+                        delivered += r["msgs"]
+                for r in entry.get("redundant") or []:
+                    if (r["reactor"], r["kind"]) == red_key:
+                        redundant += r["count"]
+        accepted = max(0, delivered - redundant)
+        out[cls] = {
+            "delivered": delivered,
+            "redundant": redundant,
+            "accepted": accepted,
+            "amplification": round(delivered / max(1, accepted), 3),
+        }
+    return out
+
+
+def fastsync_fetch_attribution(scrapes: list[dict]) -> dict:
+    """Fast-sync wire cost per node: block_response messages/bytes each
+    node PULLED (recv side of its own ledger), the bytes-per-block rate,
+    and the fleet rollup."""
+    nodes = {}
+    fleet_blocks = fleet_bytes = 0
+    for s in scrapes:
+        tr = s.get("debug_traffic") or {}
+        blocks = nbytes = 0
+        for entry in (tr.get("peers") or {}).values():
+            for r in entry.get("series") or []:
+                if r["dir"] == "recv" and r["type"] == "block_response":
+                    blocks += r["msgs"]
+                    nbytes += r["bytes"]
+        if blocks or nbytes:
+            nodes[node_name(s)] = {
+                "blocks_fetched": blocks,
+                "bytes_fetched": nbytes,
+                "bytes_per_block": round(nbytes / max(1, blocks), 1),
+            }
+            fleet_blocks += blocks
+            fleet_bytes += nbytes
+    return {
+        "nodes": nodes,
+        "fleet": {
+            "blocks_fetched": fleet_blocks,
+            "bytes_fetched": fleet_bytes,
+            "bytes_per_block": round(fleet_bytes / max(1, fleet_blocks), 1),
+        },
+    }
+
+
+def traffic_summary(scrapes: list[dict]) -> dict:
+    """report["traffic"]: the fleet bandwidth matrix, per-class gossip
+    amplification, fast-sync fetch attribution, and each node's ledger
+    totals + link-overhead rollup (framing bytes, throttle wait)."""
+    nodes = {}
+    for s in scrapes:
+        tr = s.get("debug_traffic")
+        if not tr:
+            continue
+        framing_sent = framing_recv = 0
+        throttle_s = 0.0
+        for conn in (tr.get("conns") or {}).values():
+            framing_sent += conn.get("sent_framing_bytes", 0)
+            framing_recv += conn.get("recv_framing_bytes", 0)
+            throttle_s += conn.get("throttle_wait_s", 0.0)
+        nodes[node_name(s)] = {
+            "totals": tr.get("totals") or {},
+            "sent_framing_bytes": framing_sent,
+            "recv_framing_bytes": framing_recv,
+            "throttle_wait_s": round(throttle_s, 6),
+            "sendq_stall_age_s": tr.get("sendq_stall_age_s", 0.0),
+        }
+    return {
+        "nodes": nodes,
+        "matrix": traffic_matrix(scrapes),
+        "amplification": gossip_amplification(scrapes),
+        "fastsync": fastsync_fetch_attribution(scrapes),
+    }
+
+
+# redundancy invariant floor: below this many deliveries per class the
+# amplification ratio is dominated by startup noise, not gossip behavior
+MIN_AMPLIFICATION_SAMPLE = 20
+
+
+def check_traffic_invariants(report: dict) -> list[str]:
+    """Gossip-redundancy bound: on a healthy fleet each vote needs at
+    most one delivery per node, so fleet amplification beyond ~n_nodes
+    (every peer echoing to every other) means the wire is doing work the
+    protocol doesn't need. The bound is deliberately loose — it catches
+    storms, not tuning opportunities."""
+    violations = []
+    traffic = report.get("traffic") or {}
+    amp = (traffic.get("amplification") or {}).get("vote")
+    if not amp:
+        return violations
+    n_nodes = len(report.get("observers") or []) or len(
+        report.get("nodes") or []
+    )
+    bound = max(2.0, float(n_nodes))
+    if (
+        amp["delivered"] >= MIN_AMPLIFICATION_SAMPLE
+        and amp["amplification"] > bound
+    ):
+        violations.append(
+            f"vote gossip amplification {amp['amplification']} > bound "
+            f"{bound} ({amp['delivered']} delivered, "
+            f"{amp['redundant']} redundant)"
+        )
+    return violations
+
+
 def check_invariants(report: dict, commit_spread_s: float = 2.0) -> list[str]:
     """Cross-node invariants a healthy fleet must satisfy; returns human-
     readable violations (empty = clean)."""
@@ -938,6 +1148,8 @@ def check_invariants(report: dict, commit_spread_s: float = 2.0) -> list[str]:
     violations.extend(check_tx_invariants(report.get("txs", {}).get(
         "timelines", {}
     )))
+    # gossip-redundancy bound (when the traffic plane contributed rows)
+    violations.extend(check_traffic_invariants(report))
     return violations
 
 
@@ -1006,6 +1218,7 @@ def build_report(scrapes: list[dict],
         "device": device_summary(scrapes),
         "traces": trace_summary(scrapes),
         "txs": {"timelines": txs, **analyze_txs(txs)},
+        "traffic": traffic_summary(scrapes),
     }
     if budget:
         aux = collect_aux_events(scrapes, extra_events)
@@ -1100,6 +1313,26 @@ def render_text(report: dict) -> str:
             f"max={prop_tx['max_ms']}ms; e2e p50={e2e['p50_ms']}ms "
             f"p90={e2e['p90_ms']}ms"
         )
+    traffic = report.get("traffic") or {}
+    if traffic.get("nodes"):
+        for cls, a in (traffic.get("amplification") or {}).items():
+            lines.append(
+                f"gossip[{cls}]: {a['delivered']} delivered "
+                f"({a['redundant']} redundant) amplification x"
+                f"{a['amplification']}"
+            )
+        for node, row in traffic.get("matrix", {}).items():
+            flows = ", ".join(
+                f"{remote}: tx {cell['sent_bytes']}B rx {cell['recv_bytes']}B"
+                for remote, cell in sorted(row.items())
+            )
+            lines.append(f"wire[{node}]: {flows}")
+        fs = (traffic.get("fastsync") or {}).get("fleet") or {}
+        if fs.get("blocks_fetched"):
+            lines.append(
+                f"fastsync: {fs['blocks_fetched']} blocks fetched over "
+                f"{fs['bytes_fetched']}B ({fs['bytes_per_block']}B/block)"
+            )
     if report["violations"]:
         lines.append("VIOLATIONS:")
         lines.extend(f"  - {v}" for v in report["violations"])
@@ -1129,6 +1362,7 @@ class FleetCollector:
         self._events: dict[str, list[dict]] = {}  # endpoint -> wall events
         self._tx_events: dict[str, list[dict]] = {}  # endpoint -> txlife events
         self._traces: dict[str, dict] = {}  # endpoint -> height -> trace
+        self._traffic: dict[str, dict] = {}  # endpoint -> ledger accumulator
         self._names: dict[str, str] = {}  # endpoint -> last-known moniker
         self._last_scrapes: list[dict] = []
 
@@ -1167,6 +1401,11 @@ class FleetCollector:
                 for t in tr.get("traces", []):
                     key = (t.get("attrs") or {}).get("height") or t.get("t0")
                     acc[key] = t
+            snap = s.get("debug_traffic")
+            if snap:
+                merge_traffic(self._traffic.setdefault(ep, {}), snap)
+                self.cursors.setdefault(ep, {})["traffic_seq"] = \
+                    snap.get("seq", 0)
         self._last_scrapes = scrapes
         return scrapes
 
@@ -1200,6 +1439,10 @@ class FleetCollector:
                 tr["enabled"] = True
                 tr["traces"] = list(self._traces[ep].values())
                 s["debug_consensus_trace"] = tr
+            if self._traffic.get(ep):
+                # the accumulator carries the full cumulative ledger even
+                # when the last incremental poll only returned deltas
+                s["debug_traffic"] = traffic_as_snapshot(self._traffic[ep])
             extra[node_name(s)] = self._events.get(ep, [])
             extra_tx[node_name(s)] = self._tx_events.get(ep, [])
             scrapes.append(s)

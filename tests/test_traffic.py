@@ -1,0 +1,646 @@
+"""Wire-efficiency observatory tests (ISSUE 20 tentpole).
+
+All crypto-free: MConnection runs over an in-memory duplex pipe, the
+Switch/Peer rollup uses stub transports, and the collector math chews a
+canned skewed 4-node fixture — so packet/message accounting, redundancy
+taps, cursor resume, bandwidth-matrix stitching, gossip amplification,
+and the bench record schema are all exercised without `cryptography`.
+The live end-to-end path is the `traffic` proc_testnet scenario in
+tests/test_testnet_procs.py (importorskip("cryptography")).
+"""
+import asyncio
+import json
+
+import pytest
+
+from tendermint_tpu.libs.flowrate import Monitor
+from tendermint_tpu.libs.metrics import Collector, P2PMetrics
+from tendermint_tpu.p2p.base_reactor import BaseReactor, ChannelDescriptor
+from tendermint_tpu.p2p.conn.connection import MConnConfig, MConnection
+from tendermint_tpu.p2p.node_info import NodeInfo
+from tendermint_tpu.p2p.peer import Peer
+from tendermint_tpu.p2p.switch import Switch
+from tendermint_tpu.p2p.traffic import TrafficLedger
+from tendermint_tpu.tools import bench_compare
+from tendermint_tpu.tools.collector import (
+    FleetCollector,
+    check_traffic_invariants,
+    gossip_amplification,
+    merge_traffic,
+    traffic_as_snapshot,
+    traffic_matrix,
+    traffic_summary,
+)
+
+
+class _PipeConn:
+    """In-memory half of a duplex link with the message-layer surface
+    MConnection expects (write/drain/read_msg/close), minus the crypto."""
+
+    def __init__(self) -> None:
+        self._rx: asyncio.Queue = asyncio.Queue()
+        self.peer = None
+        self.wire_bytes = 0
+
+    async def write(self, data: bytes) -> None:
+        self.wire_bytes += len(data)
+        await self.peer._rx.put(bytes(data))
+
+    async def drain(self) -> None:
+        pass
+
+    async def read_msg(self) -> bytes:
+        pkt = await self._rx.get()
+        if pkt is None:
+            raise ConnectionError("pipe closed")
+        return pkt
+
+    def close(self) -> None:
+        self._rx.put_nowait(None)
+        if self.peer is not None:
+            self.peer._rx.put_nowait(None)
+
+
+def _pipe_pair():
+    a, b = _PipeConn(), _PipeConn()
+    a.peer, b.peer = b, a
+    return a, b
+
+
+def _node_info(node_id: str) -> NodeInfo:
+    return NodeInfo(
+        node_id=node_id, listen_addr="127.0.0.1:0", network="traffic-test",
+        version="tendermint-tpu/0.1", channels=bytes([0x30]),
+        moniker=node_id[:6],
+    )
+
+
+async def _run_mconn_pair(descs, sends, config=None):
+    """Drive `sends` through a sender/receiver MConnection pair; returns
+    (sender MConn, receiver MConn, sender pipe, received list)."""
+    conn_a, conn_b = _pipe_pair()
+    received = []
+    done = asyncio.Event()
+
+    async def on_receive(ch_id, msg):
+        received.append((ch_id, msg))
+        if len(received) >= len(sends):
+            done.set()
+
+    async def quiet(e):
+        pass
+
+    async def noop_receive(ch_id, msg):
+        pass
+
+    sender = MConnection(conn_a, descs, noop_receive, quiet, config)
+    receiver = MConnection(conn_b, descs, on_receive, quiet, config)
+    await sender.start()
+    await receiver.start()
+    try:
+        for ch_id, msg in sends:
+            assert await sender.send(ch_id, msg)
+        await asyncio.wait_for(done.wait(), 10.0)
+        return sender.traffic_snapshot(), receiver.traffic_snapshot(), conn_a, received
+    finally:
+        await sender.stop()
+        await receiver.stop()
+
+
+class TestChannelCounters:
+    """_Channel/MConnection packet-layer accounting: messages counted at
+    the message boundary, packets at the chunk boundary, framing = every
+    wire byte that is not payload."""
+
+    def test_chunked_message_counted_once(self):
+        descs = [ChannelDescriptor(0x21)]
+        msg = b"\xaa" * 2500  # 3 packets at the 1024 max payload
+        snd, rcv, pipe, received = asyncio.run(
+            _run_mconn_pair(descs, [(0x21, msg)],
+                            MConnConfig(flush_throttle=0.001))
+        )
+        ch = snd["channels"]["0x21"]
+        assert ch["sent_msgs"] == 1
+        assert ch["sent_packets"] == 3
+        assert ch["sent_bytes"] == 2500
+        rch = rcv["channels"]["0x21"]
+        assert rch["recv_msgs"] == 1
+        assert rch["recv_packets"] == 3
+        assert rch["recv_bytes"] == 2500
+        assert received == [(0x21, msg)]
+        # framing accounts for exactly the non-payload wire bytes
+        assert snd["sent_framing_bytes"] > 0
+        assert pipe.wire_bytes == 2500 + snd["sent_framing_bytes"]
+        assert rcv["recv_framing_bytes"] == snd["sent_framing_bytes"]
+
+    def test_multiple_channels_accounted_separately(self):
+        descs = [ChannelDescriptor(0x21), ChannelDescriptor(0x30)]
+        sends = [(0x21, b"p" * 100), (0x30, b"t" * 40), (0x30, b"u" * 60)]
+        snd, _rcv, _pipe, _ = asyncio.run(_run_mconn_pair(descs, sends))
+        assert snd["channels"]["0x21"]["sent_msgs"] == 1
+        assert snd["channels"]["0x30"]["sent_msgs"] == 2
+        assert snd["channels"]["0x30"]["sent_bytes"] == 100
+
+    def test_snapshot_carries_link_costs(self):
+        descs = [ChannelDescriptor(0x21)]
+        snd, _rcv, _pipe, _ = asyncio.run(
+            _run_mconn_pair(descs, [(0x21, b"x" * 10)])
+        )
+        for key in ("sent_framing_bytes", "recv_framing_bytes",
+                    "throttle_wait_s", "send_utilization",
+                    "recv_utilization"):
+            assert key in snd, key
+
+
+class TestLedger:
+    def test_note_msg_accumulates_and_seq_advances(self):
+        led = TrafficLedger()
+        led.note_msg("peerA", 0x30, "tx", "sent", 100)
+        led.note_msg("peerA", 0x30, "tx", "sent", 50)
+        led.note_msg("peerA", 0x22, "vote", "recv", 80)
+        snap = led.snapshot()
+        rows = {(r["channel"], r["type"], r["dir"]): r
+                for r in snap["peers"]["peerA"]["series"]}
+        assert rows[(0x30, "tx", "sent")]["msgs"] == 2
+        assert rows[(0x30, "tx", "sent")]["bytes"] == 150
+        assert rows[(0x22, "vote", "recv")]["msgs"] == 1
+        assert snap["seq"] == 3
+
+    def test_cursor_resume_returns_only_changed_rows(self):
+        """debug_traffic's recorder-style contract: snapshot(since_seq)
+        returns only series touched after the cursor, with CUMULATIVE
+        values, so a reader that missed polls converges by replacement."""
+        led = TrafficLedger()
+        led.note_msg("peerA", 0x30, "tx", "sent", 100)
+        led.note_msg("peerA", 0x22, "vote", "recv", 80)
+        first = led.snapshot()
+        cursor = first["seq"]
+        assert led.snapshot(since_seq=cursor)["peers"] == {}
+        led.note_msg("peerA", 0x30, "tx", "sent", 25)
+        led.note_redundant("peerA", "mempool", "tx")
+        second = led.snapshot(since_seq=cursor)
+        series = second["peers"]["peerA"]["series"]
+        assert [(r["channel"], r["type"]) for r in series] == [(0x30, "tx")]
+        # cumulative, not delta
+        assert series[0]["msgs"] == 2 and series[0]["bytes"] == 125
+        assert second["peers"]["peerA"]["redundant"] == [
+            {"reactor": "mempool", "kind": "tx", "count": 1,
+             "seq": second["seq"]}
+        ]
+        # the untouched vote row stays out of the incremental read
+        assert all(r["type"] != "vote" for r in series)
+
+    def test_totals_rollup(self):
+        led = TrafficLedger()
+        led.note_msg("a", 0x30, "tx", "sent", 10)
+        led.note_msg("b", 0x30, "tx", "recv", 20)
+        led.note_redundant("b", "mempool", "tx", 3)
+        assert led.totals() == {
+            "sent_msgs": 1, "sent_bytes": 10,
+            "recv_msgs": 1, "recv_bytes": 20, "redundant": 3,
+        }
+
+
+class TestPeerSwitchRollup:
+    """Send side attributed in Peer._account_send, receive side in
+    Switch._account_receive — both land in the same per-switch ledger
+    keyed (peer, channel, type, dir)."""
+
+    def test_peer_send_rollup_counts_chunked_message_once(self):
+        async def go():
+            conn_a, conn_b = _pipe_pair()
+
+            async def sink(*a):
+                pass
+
+            peer = Peer(conn_a, _node_info("peerchunky"),
+                        [ChannelDescriptor(0x30)], sink, sink, outbound=True)
+            peer.traffic = TrafficLedger()
+            peer.classify = lambda ch, msg: "tx"
+            c = Collector()
+            peer.metrics = P2PMetrics(c)
+            await peer.start()
+            try:
+                assert await peer.send(0x30, b"\x01" + b"z" * 2999)
+                await asyncio.sleep(0.05)
+            finally:
+                await peer.stop()
+                conn_b.close()
+            return peer.traffic.snapshot(), c.render()
+
+        snap, text = asyncio.run(go())
+        rows = snap["peers"]["peerchunky"]["series"]
+        assert rows == [{"channel": 0x30, "type": "tx", "dir": "sent",
+                         "msgs": 1, "bytes": 3000, "seq": 1}]
+        # the per-(channel, type) metrics series carry the same message
+        assert 'tendermint_p2p_msg_sent_total{channel="0x30",type="tx"} 1' \
+            in text
+        assert 'tendermint_p2p_msg_sent_bytes{channel="0x30",type="tx"} 3000' \
+            in text
+
+    def test_switch_recv_rollup_classifies_at_reactor_boundary(self):
+        class TxReactor(BaseReactor):
+            traffic_family = "mempool"
+
+            def __init__(self):
+                super().__init__(name="TxReactor")
+                self.got = []
+
+            def get_channels(self):
+                return [ChannelDescriptor(0x30)]
+
+            def classify(self, ch_id, msg):
+                return "tx" if msg and msg[0] == 1 else "other"
+
+            async def receive(self, ch_id, peer, msg_bytes):
+                self.got.append(msg_bytes)
+
+        async def go():
+            sw = Switch(transport=None)
+            reactor = TxReactor()
+            sw.add_reactor("MEMPOOL", reactor)
+            conn_a, _conn_b = _pipe_pair()
+
+            async def sink(*a):
+                pass
+
+            peer = Peer(conn_a, _node_info("peerrecv"),
+                        [ChannelDescriptor(0x30)], sink, sink, outbound=False)
+            await sw._route_receive(0x30, peer, b"\x01tx-payload")
+            await sw._route_receive(0x30, peer, b"\xffgarbage")
+            return sw.traffic.snapshot(), reactor.got
+
+        snap, got = asyncio.run(go())
+        rows = {(r["type"], r["dir"]): r
+                for r in snap["peers"]["peerrecv"]["series"]}
+        assert rows[("tx", "recv")]["msgs"] == 1
+        assert rows[("tx", "recv")]["bytes"] == len(b"\x01tx-payload")
+        # unknown tag still costs bandwidth: counted as "other"
+        assert rows[("other", "recv")]["msgs"] == 1
+        assert len(got) == 2
+
+
+class TestRedundancyTaps:
+    def test_note_redundant_feeds_ledger_and_metrics(self):
+        class VoteReactor(BaseReactor):
+            traffic_family = "consensus"
+
+        class _StubPeer:
+            id = "peerdup"
+
+        reactor = VoteReactor(name="VoteReactor")
+        sw = Switch(transport=None)
+        c = Collector()
+        sw.metrics = P2PMetrics(c)
+        reactor.set_switch(sw)
+        reactor.note_redundant(_StubPeer(), "vote")
+        reactor.note_redundant(_StubPeer(), "vote", 2)
+        reactor.note_redundant(_StubPeer(), "block_part")
+        snap = sw.traffic.snapshot()
+        red = {(r["reactor"], r["kind"]): r["count"]
+               for r in snap["peers"]["peerdup"]["redundant"]}
+        assert red == {("consensus", "vote"): 3,
+                       ("consensus", "block_part"): 1}
+        text = c.render()
+        assert ('tendermint_p2p_redundant_received_total'
+                '{kind="vote",reactor="consensus"} 3') in text
+
+    def test_note_redundant_is_noop_without_traffic_plane(self):
+        class _Bare:  # a stub switch without ledger or metrics
+            pass
+
+        r = BaseReactor(name="r")
+        r.set_switch(_Bare())
+        r.note_redundant(None, "vote")  # must not raise
+
+    def test_reactor_families_and_classify_tables(self):
+        """Every reactor family declares its ledger label, and the cheap
+        tag-peek classifiers map the gossip hot paths."""
+        from tendermint_tpu.blockchain.reactor import (
+            BC_TYPE_LABELS, BlockchainReactor,
+        )
+        from tendermint_tpu.blockchain.v1_reactor import BlockchainReactorV1
+        from tendermint_tpu.consensus.messages import TYPE_LABELS
+        from tendermint_tpu.evidence.reactor import EvidenceReactor
+        from tendermint_tpu.mempool.reactor import MempoolReactor
+        from tendermint_tpu.p2p.pex.pex_reactor import PexReactor
+        from tendermint_tpu.statesync.reactor import (
+            SS_TYPE_LABELS, StateSyncReactor,
+        )
+
+        assert MempoolReactor.traffic_family == "mempool"
+        assert EvidenceReactor.traffic_family == "evidence"
+        assert BlockchainReactor.traffic_family == "blockchain"
+        assert BlockchainReactorV1.traffic_family == "blockchain"
+        assert PexReactor.traffic_family == "pex"
+        assert StateSyncReactor.traffic_family == "statesync"
+        assert TYPE_LABELS[6] == "vote"
+        assert TYPE_LABELS[5] == "block_part"
+        assert BC_TYPE_LABELS[2] == "block_response"
+        assert SS_TYPE_LABELS[4] == "chunk_response"
+        # tag-peek classify, no decode: first byte is the codec tag
+        assert MempoolReactor.classify(None, 0x30, b"\x01...") == "tx"
+        assert MempoolReactor.classify(None, 0x30, b"") == "other"
+        assert BlockchainReactor.classify(None, 0x40, b"\x02xx") \
+            == "block_response"
+        assert StateSyncReactor.classify(None, 0x61, b"\x04") \
+            == "chunk_response"
+        assert PexReactor.classify(None, 0x00, b"\x01") == "addrs"
+
+
+class TestFlowrateMonitor:
+    def test_utilization_tracks_cap(self):
+        t = [0.0]
+        m = Monitor(sample_period=0.1, window=1.0, clock=lambda: t[0])
+        for _ in range(50):  # long enough for the EMA to converge
+            t[0] += 0.1
+            m.update(100)  # 1000 B/s
+        assert m.utilization(2000) == pytest.approx(0.5, rel=0.05)
+        assert m.utilization(0) == 0.0
+
+    def test_idle_period_decays_windowed_rate(self):
+        """The satellite fix: a gone-quiet link must report ~0, not hold
+        the last burst value forever (read paths tick the EMA)."""
+        t = [0.0]
+        m = Monitor(sample_period=0.1, window=1.0, clock=lambda: t[0])
+        for _ in range(10):
+            t[0] += 0.1
+            m.update(1000)
+        burst = m.utilization(10_000)
+        assert burst > 0.5
+        # idle, no update() calls at all: one tick may still fold a
+        # pending partial sample (<=5% of cap), the next decays to zero
+        t[0] += 5.0
+        assert m.utilization(10_000) < 0.05
+        t[0] += 5.0
+        assert m.utilization(10_000) == 0.0
+        assert m.status().cur_rate == 0.0
+
+
+# ---------------------------------------------------- collector stitching
+
+NODE_IDS = [f"{c * 40}" for c in "abcd"]
+MONIKERS = {NODE_IDS[i]: f"node{i}" for i in range(4)}
+
+
+def _series(ch, mtype, dir_, msgs, nbytes, seq=1):
+    return {"channel": ch, "type": mtype, "dir": dir_,
+            "msgs": msgs, "bytes": nbytes, "seq": seq}
+
+
+def _traffic_scrape(i: int, peers: dict, seq: int = 100) -> dict:
+    """A canned scrape for node i carrying only the surfaces the traffic
+    plane reads (status.node_info + debug_traffic)."""
+    return {
+        "endpoint": f"http://127.0.0.1:{26657 + 2 * i}",
+        "ok": True,
+        "errors": {},
+        "status": {
+            "node_info": {"moniker": f"node{i}", "node_id": NODE_IDS[i]},
+            "sync_info": {"latest_block_height": 3},
+        },
+        "health": {"status": "ok", "ready": True, "peers": 3,
+                   "task_crashes": 0},
+        "debug_traffic": {
+            "seq": seq,
+            "peers": peers,
+            "conns": {},
+            "totals": {},
+            "sendq_stall_age_s": 0.0,
+            "moniker": f"node{i}",
+        },
+    }
+
+
+def _skewed_fleet(vote_recv=10, vote_red=2, tx_from_node0=50) -> list[dict]:
+    """4 nodes; node0 is the tx source (skewed mempool flow), votes flow
+    all-to-all, node3 fast-synced 5 blocks from node1."""
+    scrapes = []
+    for i in range(4):
+        peers = {}
+        for j in range(4):
+            if j == i:
+                continue
+            series = [
+                _series(0x22, "vote", "recv", vote_recv, vote_recv * 120),
+                _series(0x22, "vote", "sent", vote_recv, vote_recv * 120),
+            ]
+            if i == 0:
+                series.append(_series(0x30, "tx", "sent", tx_from_node0,
+                                      tx_from_node0 * 250))
+            else:
+                series.append(_series(0x30, "tx", "recv", tx_from_node0,
+                                      tx_from_node0 * 250))
+                # non-source nodes echo a few txs around
+                series.append(_series(0x30, "tx", "sent", 5, 5 * 250))
+            if i == 3 and j == 1:
+                series.append(_series(0x40, "block_response", "recv",
+                                      5, 5_000_000))
+            peers[NODE_IDS[j]] = {
+                "series": series,
+                "redundant": [
+                    {"reactor": "consensus", "kind": "vote",
+                     "count": vote_red, "seq": 1},
+                ],
+            }
+        scrapes.append(_traffic_scrape(i, peers))
+    return scrapes
+
+
+class TestTrafficMatrix:
+    def test_matrix_fully_populated_with_monikers(self):
+        matrix = traffic_matrix(_skewed_fleet())
+        assert sorted(matrix) == ["node0", "node1", "node2", "node3"]
+        for obs, row in matrix.items():
+            assert sorted(row) == sorted(
+                set(MONIKERS.values()) - {obs}
+            ), (obs, row)
+            for cell in row.values():
+                assert cell["sent_bytes"] > 0 and cell["recv_bytes"] > 0
+
+    def test_matrix_skew_and_type_breakdown(self):
+        matrix = traffic_matrix(_skewed_fleet(tx_from_node0=50))
+        # node0's mempool flow is one-directional per remote
+        cell = matrix["node0"]["node1"]
+        assert cell["by_type"]["tx"]["sent_msgs"] == 50
+        assert cell["by_type"]["tx"]["sent_bytes"] == 50 * 250
+        assert cell["by_type"]["tx"]["recv_msgs"] == 0
+        # the fast-sync pull shows up only on the node3 -> node1 edge
+        assert "block_response" in matrix["node3"]["node1"]["by_type"]
+        assert "block_response" not in matrix["node3"]["node2"]["by_type"]
+        # unknown peer ids fall back to a truncated id, never KeyError
+        extra = _skewed_fleet()
+        extra[0]["debug_traffic"]["peers"]["f" * 40] = {
+            "series": [_series(0x22, "vote", "recv", 1, 120)],
+            "redundant": [],
+        }
+        assert "f" * 12 in traffic_matrix(extra)["node0"]
+
+
+class TestGossipAmplification:
+    def test_amplification_math(self):
+        # 4 nodes x 3 remotes x 10 votes = 120 delivered; 4x3x2=24
+        # redundant -> accepted 96 -> amplification 1.25
+        amp = gossip_amplification(_skewed_fleet(vote_recv=10, vote_red=2))
+        assert amp["vote"] == {"delivered": 120, "redundant": 24,
+                               "accepted": 96, "amplification": 1.25}
+        # txs: 3 sinks x 3 remotes x 50 recv = 450 delivered, 0 reported
+        # redundant -> amplification 1.0
+        assert amp["tx"]["delivered"] == 450
+        assert amp["tx"]["amplification"] == 1.0
+
+    def test_invariant_fires_only_over_bound_with_sample(self):
+        def report_for(vote_recv, vote_red):
+            scrapes = _skewed_fleet(vote_recv=vote_recv, vote_red=vote_red)
+            return {
+                "traffic": traffic_summary(scrapes),
+                "observers": [f"node{i}" for i in range(4)],
+                "nodes": [],
+            }
+
+        # healthy: amplification 1.25 <= bound 4
+        assert check_traffic_invariants(report_for(10, 2)) == []
+        # vote storm: 120 delivered, 110 redundant per-node-pair ->
+        # accepted 12*(10-?)... make nearly everything redundant
+        bad = check_traffic_invariants(report_for(10, 9))
+        assert bad and "amplification" in bad[0]
+        # same ratio but under the sample floor: stays quiet
+        assert check_traffic_invariants(report_for(1, 1)) == []
+
+    def test_fastsync_attribution(self):
+        summary = traffic_summary(_skewed_fleet())
+        fs = summary["fastsync"]
+        assert fs["nodes"] == {
+            "node3": {"blocks_fetched": 5, "bytes_fetched": 5_000_000,
+                      "bytes_per_block": 1_000_000.0},
+        }
+        assert fs["fleet"]["blocks_fetched"] == 5
+
+
+class TestTrafficAccumulator:
+    def test_merge_replaces_cumulative_rows(self):
+        acc = {}
+        merge_traffic(acc, {
+            "seq": 5,
+            "peers": {"p1": {
+                "series": [_series(0x30, "tx", "sent", 10, 1000, seq=5)],
+                "redundant": [],
+            }},
+            "totals": {"sent_msgs": 10},
+        })
+        # second (incremental) snapshot: same row, newer cumulative value
+        merge_traffic(acc, {
+            "seq": 9,
+            "peers": {"p1": {
+                "series": [_series(0x30, "tx", "sent", 25, 2500, seq=9)],
+                "redundant": [{"reactor": "mempool", "kind": "tx",
+                               "count": 2, "seq": 8}],
+            }},
+            "totals": {"sent_msgs": 25},
+        })
+        snap = traffic_as_snapshot(acc)
+        assert snap["seq"] == 9
+        assert snap["peers"]["p1"]["series"] == [
+            _series(0x30, "tx", "sent", 25, 2500, seq=9)
+        ]
+        assert snap["peers"]["p1"]["redundant"][0]["count"] == 2
+        assert snap["totals"] == {"sent_msgs": 25}
+        assert json.dumps(snap)  # wire shape stays JSON-serializable
+
+    def test_fleet_collector_traffic_cursor_resume(self, monkeypatch):
+        """poll() twice: the second scrape serves only rows past the
+        traffic_seq cursor, and report() still carries the full
+        accumulated matrix (cumulative rows, replacement merge)."""
+        fleet = _skewed_fleet()
+
+        def fake_scrape_fleet(endpoints, metrics, cursors, timeout):
+            out = []
+            for ep in endpoints:
+                s = json.loads(json.dumps(
+                    next(x for x in fleet if x["endpoint"] == ep)
+                ))
+                since = ((cursors or {}).get(ep) or {}).get("traffic_seq", 0)
+                tr = s["debug_traffic"]
+                for entry in tr["peers"].values():
+                    entry["series"] = [r for r in entry["series"]
+                                       if r["seq"] > since]
+                    entry["redundant"] = [r for r in entry["redundant"]
+                                          if r["seq"] > since]
+                tr["peers"] = {pid: e for pid, e in tr["peers"].items()
+                               if e["series"] or e["redundant"]}
+                out.append(s)
+            return out
+
+        from tendermint_tpu.tools import collector as col
+
+        monkeypatch.setattr(col, "scrape_fleet", fake_scrape_fleet)
+        fc = FleetCollector([s["endpoint"] for s in fleet])
+        fc.poll()
+        assert all(c.get("traffic_seq") == 100
+                   for c in fc.cursors.values())
+        second = fc.poll()
+        # cursor honored: the incremental read returned no rows
+        assert all(not s["debug_traffic"]["peers"] for s in second)
+        report = fc.report()
+        matrix = report["traffic"]["matrix"]
+        assert sorted(matrix) == ["node0", "node1", "node2", "node3"]
+        assert matrix["node0"]["node1"]["sent_bytes"] > 0
+        assert report["traffic"]["amplification"]["vote"]["delivered"] == 120
+
+
+class TestBenchRecordSchema:
+    def test_gossip_bench_records_through_bench_compare(self, tmp_path):
+        from benchmarks.gossip_bench import records
+
+        res = {
+            "dt": 2.0,
+            "recv": {0x21: [200, 819200], 0x22: [1600, 204800],
+                     0x30: [12800, 3276800]},
+            "payload_bytes": 4300800,
+            "wire_bytes": 4400000,
+            "framing_bytes": 99200,
+            "throttle_wait_s": 0.1,
+            "channels": {"0x21": {"sent_packets": 800}},
+            "msgs": 14600,
+        }
+        recs = records(res, heights=200)
+        names = {r["metric"] for r in recs}
+        assert {"gossip_block_part_goodput_mb_per_s",
+                "gossip_vote_goodput_mb_per_s",
+                "gossip_tx_goodput_mb_per_s",
+                "gossip_total_msgs_per_sec",
+                "gossip_framing_overhead_pct",
+                "gossip_throttle_wait_ms"} <= names
+        for r in recs:
+            assert r["value"] >= 0 and r["unit"]
+        path = tmp_path / "NET_rXX.json"
+        path.write_text("\n".join(json.dumps(r) for r in recs))
+        loaded = bench_compare.load_records(str(path))
+        assert set(loaded) == names
+        result = bench_compare.compare(loaded, loaded)
+        assert result["regressions"] == []
+        # overhead/throttle records ride ungated (informational)
+        by_name = {r["metric"]: r for r in result["rows"]}
+        assert by_name["gossip_framing_overhead_pct"]["gated"] is False
+        assert by_name["gossip_tx_goodput_mb_per_s"]["gated"] is True
+
+    def test_goodput_regression_gates(self):
+        old = {"gossip_tx_goodput_mb_per_s":
+               {"metric": "gossip_tx_goodput_mb_per_s", "value": 4.0,
+                "unit": "MB/s"}}
+        new = {"gossip_tx_goodput_mb_per_s":
+               {"metric": "gossip_tx_goodput_mb_per_s", "value": 3.0,
+                "unit": "MB/s"}}
+        assert bench_compare.compare(old, new)["regressions"] == [
+            "gossip_tx_goodput_mb_per_s"
+        ]
+
+    def test_fastsync_wire_record_is_higher_is_better(self):
+        rec = {"metric": "fastsync_4v_blocks_per_fetched_mb",
+               "value": 12.5, "unit": "blocks/MB"}
+        assert bench_compare._lower_is_better(rec["metric"], rec) is False
+        # shrinking blocks/MB (more bytes per block) must regress
+        worse = dict(rec, value=10.0)
+        out = bench_compare.compare({rec["metric"]: rec},
+                                    {rec["metric"]: worse}, threshold=0.1)
+        assert out["regressions"] == [rec["metric"]]
